@@ -1,0 +1,53 @@
+"""infinistore-tpu: a TPU-native disaggregated KV-cache memory pool.
+
+A CPU-hosted pinned-DRAM pool server plus an accelerator-side client that
+lets LLM inference engines (vLLM-TPU) offload, share and reuse paged KV
+caches across hosts. Same capability surface as bd-iaas-us/infiniStore,
+re-designed for TPU hosts: POSIX shared memory replaces CUDA-IPC for the
+same-host path, framed TCP over DCN replaces ibverbs RDMA for the
+cross-host path, and the JAX/XLA edge (`infinistore_tpu.tpu`) moves bytes
+between TPU HBM and the pool.
+"""
+
+from ._native import (  # noqa: F401
+    FAKE_TOKEN,
+    KEY_NOT_FOUND,
+    OK,
+    REMOTE_BLOCK_DTYPE,
+    status_name,
+)
+from .config import (  # noqa: F401
+    TYPE_AUTO,
+    TYPE_SHM,
+    TYPE_STREAM,
+    ClientConfig,
+    ServerConfig,
+)
+from .lib import (  # noqa: F401
+    InfiniStoreError,
+    InfiniStoreKeyNotFound,
+    InfinityConnection,
+    Logger,
+    check_supported,
+    set_log_level,
+)
+from .server import InfiniStoreServer  # noqa: F401
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ClientConfig",
+    "ServerConfig",
+    "InfinityConnection",
+    "InfiniStoreServer",
+    "InfiniStoreError",
+    "InfiniStoreKeyNotFound",
+    "Logger",
+    "TYPE_AUTO",
+    "TYPE_SHM",
+    "TYPE_STREAM",
+    "check_supported",
+    "set_log_level",
+    "REMOTE_BLOCK_DTYPE",
+    "FAKE_TOKEN",
+]

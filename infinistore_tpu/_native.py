@@ -1,0 +1,212 @@
+"""ctypes bindings to libinfinistore_tpu.so.
+
+Parity target: the reference's pybind11 module ``_infinistore``
+(/root/reference/src/pybind.cpp). pybind11 is not available in this
+environment, so the native core exports a C ABI and this module is the
+binding layer. ctypes releases the GIL around every foreign call, matching
+the reference's ``py::call_guard<py::gil_scoped_release>`` behavior
+(pybind.cpp:49-187), and allocate/pin results land in caller-provided
+buffers viewed zero-copy as numpy structured arrays (the analogue of
+``PYBIND11_NUMPY_DTYPE(remote_block_t)``, pybind.cpp:47).
+"""
+
+import ctypes as ct
+import os
+import struct
+import subprocess
+import threading
+
+import numpy as np
+
+_LIB_DIR = os.path.join(os.path.dirname(__file__), "_native")
+_LIB_PATH = os.path.join(_LIB_DIR, "libinfinistore_tpu.so")
+_NATIVE_SRC = os.path.join(os.path.dirname(__file__), "..", "native")
+
+# numpy view of istpu::RemoteBlock (native/src/common.h).
+REMOTE_BLOCK_DTYPE = np.dtype(
+    [("status", "<u4"), ("pool_idx", "<u4"), ("token", "<u8"), ("offset", "<u8")]
+)
+
+# Status codes (native/src/common.h).
+OK = 200
+PARTIAL = 206
+BAD_REQUEST = 400
+KEY_NOT_FOUND = 404
+TIMEOUT_ERR = 408
+CONFLICT = 409
+UNCOMMITTED = 425
+INTERNAL_ERROR = 500
+OUT_OF_MEMORY = 507
+
+FAKE_TOKEN = 0
+
+CALLBACK = ct.CFUNCTYPE(None, ct.c_uint32, ct.c_void_p)
+
+_build_lock = threading.Lock()
+_lib = None
+
+
+def _build_native():
+    """Build the shared library from source if it is missing/stale."""
+    makefile = os.path.join(_NATIVE_SRC, "Makefile")
+    if not os.path.exists(makefile):
+        raise RuntimeError(
+            f"native library missing at {_LIB_PATH} and no source tree found"
+        )
+    subprocess.run(
+        ["make", "-C", os.path.abspath(_NATIVE_SRC)],
+        check=True,
+        capture_output=True,
+    )
+
+
+def _decls(lib):
+    c = ct
+    decl = [
+        ("ist_set_log_level", None, [c.c_int]),
+        ("ist_log_msg", None, [c.c_int, c.c_char_p]),
+        # server
+        (
+            "ist_server_create",
+            c.c_void_p,
+            [c.c_char_p, c.c_uint16, c.c_uint64, c.c_uint64, c.c_int,
+             c.c_uint64, c.c_int, c.c_char_p],
+        ),
+        ("ist_server_start", c.c_int, [c.c_void_p]),
+        ("ist_server_stop", None, [c.c_void_p]),
+        ("ist_server_destroy", None, [c.c_void_p]),
+        ("ist_server_kvmap_len", c.c_uint64, [c.c_void_p]),
+        ("ist_server_purge", c.c_uint64, [c.c_void_p]),
+        ("ist_server_stats", c.c_int, [c.c_void_p, c.c_char_p, c.c_int]),
+        ("ist_server_shm_prefix", c.c_int, [c.c_void_p, c.c_char_p, c.c_int]),
+        # client
+        (
+            "ist_conn_create",
+            c.c_void_p,
+            [c.c_char_p, c.c_uint16, c.c_int, c.c_uint64, c.c_int],
+        ),
+        ("ist_conn_connect", c.c_int, [c.c_void_p]),
+        ("ist_conn_close", None, [c.c_void_p]),
+        ("ist_conn_destroy", None, [c.c_void_p]),
+        ("ist_conn_shm_active", c.c_int, [c.c_void_p]),
+        ("ist_conn_block_size", c.c_uint32, [c.c_void_p]),
+        ("ist_conn_inflight", c.c_uint64, [c.c_void_p]),
+        (
+            "ist_allocate",
+            c.c_uint32,
+            [c.c_void_p, c.c_char_p, c.c_uint64, c.c_uint32, c.c_uint32,
+             c.c_void_p],
+        ),
+        (
+            "ist_write_async",
+            c.c_uint32,
+            [c.c_void_p, c.c_uint32, c.c_uint32, c.POINTER(c.c_uint64),
+             c.POINTER(c.c_void_p), CALLBACK, c.c_void_p],
+        ),
+        (
+            "ist_read_async",
+            c.c_uint32,
+            [c.c_void_p, c.c_uint32, c.c_char_p, c.c_uint64, c.c_uint32,
+             c.POINTER(c.c_void_p), CALLBACK, c.c_void_p],
+        ),
+        (
+            "ist_shm_write_async",
+            c.c_uint32,
+            [c.c_void_p, c.c_uint32, c.c_uint32, c.POINTER(c.c_uint64),
+             c.c_void_p, c.POINTER(c.c_void_p), CALLBACK, c.c_void_p],
+        ),
+        (
+            "ist_shm_read_async",
+            c.c_uint32,
+            [c.c_void_p, c.c_uint32, c.c_char_p, c.c_uint64, c.c_uint32,
+             c.POINTER(c.c_void_p), CALLBACK, c.c_void_p],
+        ),
+        ("ist_sync", c.c_uint32, [c.c_void_p, c.c_int]),
+        ("ist_commit", c.c_uint32, [c.c_void_p, c.POINTER(c.c_uint64), c.c_uint32]),
+        (
+            "ist_pin",
+            c.c_uint32,
+            [c.c_void_p, c.c_char_p, c.c_uint64, c.c_uint32, c.c_void_p,
+             c.POINTER(c.c_uint64)],
+        ),
+        ("ist_release", c.c_uint32, [c.c_void_p, c.c_uint64]),
+        ("ist_check_exist", c.c_int, [c.c_void_p, c.c_char_p, c.c_uint32]),
+        (
+            "ist_get_match_last_index",
+            c.c_uint32,
+            [c.c_void_p, c.c_char_p, c.c_uint64, c.c_uint32,
+             c.POINTER(c.c_int32)],
+        ),
+        ("ist_client_purge", c.c_uint32, [c.c_void_p, c.POINTER(c.c_uint64)]),
+        (
+            "ist_delete_keys",
+            c.c_uint32,
+            [c.c_void_p, c.c_char_p, c.c_uint64, c.c_uint32,
+             c.POINTER(c.c_uint64)],
+        ),
+        ("ist_client_stats", c.c_uint32, [c.c_void_p, c.c_char_p, c.c_int]),
+        ("ist_sync_rpc", c.c_uint32, [c.c_void_p]),
+        ("ist_pool_count", c.c_uint64, [c.c_void_p]),
+        ("ist_pool_base", c.c_void_p, [c.c_void_p, c.c_uint32, c.POINTER(c.c_uint64)]),
+        ("ist_refresh_pools", c.c_int, [c.c_void_p]),
+        # allocator test hooks
+        ("ist_mm_create", c.c_void_p, [c.c_uint64, c.c_uint64, c.c_int, c.c_uint64]),
+        ("ist_mm_destroy", None, [c.c_void_p]),
+        (
+            "ist_mm_allocate",
+            c.c_int,
+            [c.c_void_p, c.c_uint64, c.POINTER(c.c_uint32), c.POINTER(c.c_uint64)],
+        ),
+        (
+            "ist_mm_deallocate",
+            c.c_int,
+            [c.c_void_p, c.c_uint32, c.c_uint64, c.c_uint64],
+        ),
+        ("ist_mm_used_bytes", c.c_uint64, [c.c_void_p]),
+        ("ist_mm_total_bytes", c.c_uint64, [c.c_void_p]),
+        ("ist_mm_num_pools", c.c_uint64, [c.c_void_p]),
+    ]
+    for name, restype, argtypes in decl:
+        fn = getattr(lib, name)
+        fn.restype = restype
+        fn.argtypes = argtypes
+
+
+def get_lib():
+    """Load (building if needed) the native library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            _build_native()
+        lib = ct.CDLL(_LIB_PATH)
+        _decls(lib)
+        _lib = lib
+    return _lib
+
+
+def pack_keys(keys):
+    """Serialize a key list as [u32 len + utf8 bytes]* for the C ABI."""
+    parts = []
+    for k in keys:
+        kb = k.encode() if isinstance(k, str) else bytes(k)
+        parts.append(struct.pack("<I", len(kb)))
+        parts.append(kb)
+    return b"".join(parts)
+
+
+def status_name(code):
+    return {
+        OK: "OK",
+        PARTIAL: "PARTIAL",
+        BAD_REQUEST: "BAD_REQUEST",
+        KEY_NOT_FOUND: "KEY_NOT_FOUND",
+        TIMEOUT_ERR: "TIMEOUT",
+        CONFLICT: "CONFLICT",
+        UNCOMMITTED: "UNCOMMITTED",
+        INTERNAL_ERROR: "INTERNAL_ERROR",
+        OUT_OF_MEMORY: "OUT_OF_MEMORY",
+    }.get(code, f"STATUS_{code}")

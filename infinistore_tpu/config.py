@@ -1,0 +1,119 @@
+"""Configuration classes for infinistore-tpu.
+
+Parity target: the reference's plain config structs mirrored through pybind
+into kwargs-based Python classes with ``verify()`` validation
+(/root/reference/infinistore/lib.py:21-128, src/config.h:13-32). The
+RDMA-specific knobs (dev_name, ib_port, link_type) have no TPU-host
+equivalent and are replaced by the transport knobs of the two TPU-native
+paths: SHM (same-host shared memory, the CUDA-IPC analogue) and STREAM
+(TCP/DCN, the RDMA analogue).
+"""
+
+import os
+
+# Connection types (reference: TYPE_LOCAL_GPU / TYPE_RDMA, lib.py:13-15).
+TYPE_SHM = "SHM"        # same-host one-sided shared-memory path
+TYPE_STREAM = "STREAM"  # cross-host DCN/TCP streamed path
+TYPE_AUTO = "AUTO"      # probe SHM, fall back to STREAM
+
+_LOG_LEVELS = ("error", "debug", "info", "warning")
+
+
+class ClientConfig:
+    """Client-side connection configuration.
+
+    Attributes:
+        host_addr (str): server address.
+        service_port (int): server data-plane port.
+        connection_type (str): TYPE_SHM, TYPE_STREAM or TYPE_AUTO.
+        window_bytes (int): flow-control cap on outstanding streamed-write
+            payload (the analogue of the reference's MAX_RDMA_WRITE_WR=4096
+            outstanding-WR budget, src/protocol.h:23-34).
+        timeout_ms (int): sync/rpc timeout (reference: 10 s sync timeout,
+            src/libinfinistore.cpp:276).
+        log_level (str): error|warning|info|debug; the
+            INFINISTORE_LOG_LEVEL env var overrides (reference lib.py:45-48).
+    """
+
+    def __init__(self, **kwargs):
+        self.host_addr = kwargs.get("host_addr", "127.0.0.1")
+        self.service_port = kwargs.get("service_port", 22345)
+        self.connection_type = kwargs.get("connection_type", TYPE_AUTO)
+        self.window_bytes = kwargs.get("window_bytes", 64 << 20)
+        self.timeout_ms = kwargs.get("timeout_ms", 10000)
+        if "INFINISTORE_LOG_LEVEL" in os.environ:
+            self.log_level = os.environ["INFINISTORE_LOG_LEVEL"].lower()
+        else:
+            self.log_level = kwargs.get("log_level", "warning")
+
+    def __repr__(self):
+        return (
+            f"ClientConfig(host_addr='{self.host_addr}', "
+            f"service_port={self.service_port}, "
+            f"connection_type='{self.connection_type}', "
+            f"window_bytes={self.window_bytes}, "
+            f"timeout_ms={self.timeout_ms}, log_level='{self.log_level}')"
+        )
+
+    def verify(self):
+        if self.connection_type not in (TYPE_SHM, TYPE_STREAM, TYPE_AUTO):
+            raise Exception("Invalid connection type")
+        if not self.host_addr:
+            raise Exception("Host address is empty")
+        if not self.service_port:
+            raise Exception("Service port is 0")
+        if self.log_level not in _LOG_LEVELS:
+            raise Exception("log level should be error, debug, info or warning")
+        if self.window_bytes <= 0:
+            raise Exception("window_bytes must be positive")
+
+
+class ServerConfig:
+    """Server configuration.
+
+    Attributes mirror the reference (lib.py:94-128): ``prealloc_size`` in
+    GB, ``minimal_allocate_size`` in KB (the pool block granularity),
+    ``auto_increase`` growth (reference grows 10 GB per extension,
+    src/mempool.h:14-15 — here ``extend_size`` GB, default 1).
+    """
+
+    def __init__(self, **kwargs):
+        self.host = kwargs.get("host", "0.0.0.0")
+        self.service_port = kwargs.get("service_port", 22345)
+        self.manage_port = kwargs.get("manage_port", 18080)
+        self.log_level = kwargs.get("log_level", "warning")
+        self.prealloc_size = kwargs.get("prealloc_size", 16)  # GB
+        self.minimal_allocate_size = kwargs.get("minimal_allocate_size", 64)  # KB
+        self.auto_increase = kwargs.get("auto_increase", False)
+        self.extend_size = kwargs.get("extend_size", 1)  # GB per extension
+        self.enable_shm = kwargs.get("enable_shm", True)
+        self.shm_prefix = kwargs.get("shm_prefix", "")
+        # Accepted for reference CLI compatibility; unused on TPU hosts.
+        self.dev_name = kwargs.get("dev_name", "")
+        self.link_type = kwargs.get("link_type", "")
+
+    def __repr__(self):
+        return (
+            f"ServerConfig(host='{self.host}', "
+            f"service_port={self.service_port}, manage_port={self.manage_port}, "
+            f"log_level='{self.log_level}', prealloc_size={self.prealloc_size}, "
+            f"minimal_allocate_size={self.minimal_allocate_size}, "
+            f"auto_increase={self.auto_increase}, enable_shm={self.enable_shm})"
+        )
+
+    def verify(self):
+        # service_port 0 = bind an ephemeral port (test-friendly; the bound
+        # port is returned by InfiniStoreServer.start()).
+        if self.service_port is None or self.service_port < 0:
+            raise Exception("Service port invalid")
+        if not self.manage_port:
+            raise Exception("Manage port is 0")
+        if self.log_level not in _LOG_LEVELS:
+            raise Exception("log level should be error, debug, info or warning")
+        # Reference floor: 16 KB blocks (lib.py:126-128).
+        if self.minimal_allocate_size < 16:
+            raise Exception("minimal allocate size should be greater than 16")
+        if self.minimal_allocate_size & (self.minimal_allocate_size - 1):
+            raise Exception("minimal allocate size must be a power of two (KB)")
+        if self.prealloc_size <= 0:
+            raise Exception("prealloc_size must be positive")

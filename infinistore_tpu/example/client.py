@@ -1,0 +1,87 @@
+"""Basic sync API usage (parity with reference example/client.py): put/get
+round-trips over both paths with per-op latency printouts, including the
+host↔accelerator matrix when a TPU/JAX device is present."""
+
+import argparse
+import time
+import uuid
+
+import numpy as np
+
+from infinistore_tpu import (
+    ClientConfig,
+    InfinityConnection,
+    TYPE_AUTO,
+    TYPE_SHM,
+    TYPE_STREAM,
+)
+
+
+def run(host, port, ctype):
+    conn = InfinityConnection(
+        ClientConfig(host_addr=host, service_port=port, connection_type=ctype)
+    )
+    conn.connect()
+    print(f"connected, path={'SHM' if conn.shm_connected else 'STREAM'}")
+
+    page = 4096  # elements
+    nblocks = 16
+    src = np.random.default_rng(0).random(page * nblocks).astype(np.float32)
+    keys = [f"example_{uuid.uuid4()}" for _ in range(nblocks)]
+
+    t0 = time.perf_counter()
+    blocks = conn.allocate(keys, page * 4)
+    conn.write_cache(src, [i * page for i in range(nblocks)], page, blocks)
+    t_write = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    conn.sync()
+    t_sync = time.perf_counter() - t0
+
+    dst = np.zeros_like(src)
+    t0 = time.perf_counter()
+    conn.read_cache(dst, [(k, i * page) for i, k in enumerate(keys)], page)
+    conn.sync()
+    t_read = time.perf_counter() - t0
+
+    assert np.array_equal(src, dst)
+    mb = src.nbytes / (1 << 20)
+    print(
+        f"write {mb:.2f} MB in {t_write*1e3:.2f} ms, sync {t_sync*1e3:.2f} ms, "
+        f"read {t_read*1e3:.2f} ms"
+    )
+
+    # Accelerator round-trip when JAX is available (the cpu↔gpu matrix of
+    # reference example/client.py:77-85, TPU-style).
+    try:
+        from infinistore_tpu import tpu
+
+        store = tpu.TpuKVStore(conn)
+        x = np.random.default_rng(1).random((page,)).astype(np.float32)
+        import jax
+
+        xd = jax.device_put(x)
+        k = f"tpu_{uuid.uuid4()}"
+        store.put_arrays([(k, xd)])
+        conn.sync()
+        back = store.get_array(k, shape=x.shape, dtype=x.dtype)
+        assert np.array_equal(np.asarray(back), x)
+        print("device array round-trip OK")
+    except (ImportError, RuntimeError) as e:
+        print(f"(skipping device round-trip: {e})")
+
+    conn.delete_keys(keys)
+    conn.close()
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--service-port", type=int, default=22345)
+    p.add_argument("--path", choices=["auto", "shm", "stream"], default="auto")
+    args = p.parse_args()
+    run(
+        args.host,
+        args.service_port,
+        {"auto": TYPE_AUTO, "shm": TYPE_SHM, "stream": TYPE_STREAM}[args.path],
+    )

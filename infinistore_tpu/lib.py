@@ -1,0 +1,614 @@
+"""Python client API for infinistore-tpu.
+
+Parity target: the reference ``infinistore/lib.py`` ``InfinityConnection``
+(sync + asyncio variants, torch tensors in/out, element-size scaling of
+offsets, callback→future bridging via ``loop.call_soon_threadsafe``,
+lib.py:330-707). Differences, all TPU-driven:
+
+- Tensors are numpy arrays (host) or ``jax.Array`` (accelerator); torch
+  CPU tensors also work. The accelerator edge (TPU HBM staging, per-layer
+  overlap) lives in :mod:`infinistore_tpu.tpu`.
+- The two data paths are SHM (same-host one-sided shared memory — the
+  CUDA-IPC analogue) and STREAM (TCP/DCN — the RDMA analogue). The
+  connection probes SHM and falls back automatically (TYPE_AUTO).
+- ``register_mr`` is a no-op kept for API compatibility: TCP/SHM need no
+  memory-region registration (the reference registers MRs for verbs,
+  libinfinistore.cpp:1166-1201).
+"""
+
+import asyncio
+import ctypes as ct
+import logging
+import threading
+
+import numpy as np
+
+from . import _native
+from ._native import (
+    FAKE_TOKEN,
+    KEY_NOT_FOUND,
+    OK,
+    REMOTE_BLOCK_DTYPE,
+    TIMEOUT_ERR,
+    pack_keys,
+    status_name,
+)
+from .config import TYPE_AUTO, TYPE_SHM, TYPE_STREAM, ClientConfig
+
+_LOG_LEVEL_TO_NATIVE = {"debug": 0, "info": 1, "warning": 2, "error": 3}
+
+
+class InfiniStoreError(Exception):
+    """Error raised for failed store operations, carrying the status code."""
+
+    def __init__(self, status, message=""):
+        self.status = status
+        super().__init__(f"{message} (status={status_name(status)})")
+
+
+class InfiniStoreKeyNotFound(InfiniStoreError):
+    pass
+
+
+class Logger:
+    """Routes Python-side logs into the native logger so both languages
+    share one sink/format (reference ``log_msg`` bridge, lib.py:131-150)."""
+
+    @staticmethod
+    def _emit(level, msg):
+        try:
+            _native.get_lib().ist_log_msg(level, str(msg).encode())
+        except Exception:
+            logging.getLogger("infinistore_tpu").log(
+                [logging.DEBUG, logging.INFO, logging.WARNING, logging.ERROR][
+                    min(level, 3)
+                ],
+                msg,
+            )
+
+    @classmethod
+    def debug(cls, msg):
+        cls._emit(0, msg)
+
+    @classmethod
+    def info(cls, msg):
+        cls._emit(1, msg)
+
+    @classmethod
+    def warning(cls, msg):
+        cls._emit(2, msg)
+
+    @classmethod
+    def error(cls, msg):
+        cls._emit(3, msg)
+
+
+def set_log_level(level_name):
+    _native.get_lib().ist_set_log_level(
+        _LOG_LEVEL_TO_NATIVE.get(level_name, 2)
+    )
+
+
+def check_supported():
+    """Environment sanity check (reference checks nv_peer_mem + ibv
+    PORT_ACTIVE, lib.py:208-251). The TPU-host requirements are just a
+    writable /dev/shm for the SHM path."""
+    import os
+
+    if not os.access("/dev/shm", os.W_OK):
+        Logger.warning("/dev/shm not writable: SHM path unavailable")
+        return False
+    return True
+
+
+def _as_src_array(cache):
+    """View `cache` as a C-contiguous host array without copying when
+    possible. jax.Arrays are brought to host (one device→host transfer —
+    use infinistore_tpu.tpu for the staged zero-copy path)."""
+    if isinstance(cache, np.ndarray):
+        arr = cache
+    elif hasattr(cache, "__array__"):
+        arr = np.asarray(cache)
+    else:
+        raise TypeError(f"unsupported cache type: {type(cache)!r}")
+    if not arr.flags["C_CONTIGUOUS"]:
+        raise ValueError("cache tensor must be contiguous")
+    return arr
+
+
+def _as_dst_array(cache):
+    if not isinstance(cache, np.ndarray):
+        raise TypeError(
+            "read destination must be a writable numpy array "
+            "(use infinistore_tpu.tpu to read into jax Arrays)"
+        )
+    if not cache.flags["C_CONTIGUOUS"] or not cache.flags["WRITEABLE"]:
+        raise ValueError("read destination must be contiguous and writable")
+    return cache
+
+
+class InfinityConnection:
+    """A connection to one infinistore-tpu server.
+
+    The method surface mirrors the reference ``InfinityConnection``:
+    ``connect``, ``allocate_rdma``, ``rdma_write_cache``, ``read_cache``,
+    ``local_gpu_write_cache``, ``sync``, ``check_exist``,
+    ``get_match_last_index``, plus the async variants. Unified,
+    path-agnostic names (``allocate``/``write_cache``) are the primary API.
+    """
+
+    def __init__(self, config: ClientConfig):
+        config.verify()
+        self.config = config
+        self._lib = _native.get_lib()
+        set_log_level(config.log_level)
+        self._h = None
+        self.connected = False
+        self.shm_connected = False
+        self.stream_connected = False
+        # Keep (callback, buffers) alive until async ops complete.
+        self._keepalive = {}
+        self._keepalive_id = 0
+        self._keepalive_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # connection lifecycle
+    # ------------------------------------------------------------------
+
+    def connect(self):
+        if self.connected:
+            raise Exception("Already connected")
+        want_shm = self.config.connection_type in (TYPE_SHM, TYPE_AUTO)
+        if self.config.connection_type == TYPE_SHM and self.config.host_addr not in (
+            "127.0.0.1",
+            "localhost",
+        ):
+            raise Exception("SHM connection must be to localhost")
+        self._h = self._lib.ist_conn_create(
+            self.config.host_addr.encode(),
+            self.config.service_port,
+            1 if want_shm else 0,
+            self.config.window_bytes,
+            self.config.timeout_ms,
+        )
+        if not self._h:
+            raise Exception("Failed to create connection")
+        if self._lib.ist_conn_connect(self._h) != 0:
+            self._lib.ist_conn_destroy(self._h)
+            self._h = None
+            raise Exception(
+                f"Failed to connect to "
+                f"{self.config.host_addr}:{self.config.service_port}"
+            )
+        self.shm_connected = bool(self._lib.ist_conn_shm_active(self._h))
+        if self.config.connection_type == TYPE_SHM and not self.shm_connected:
+            self.close()
+            raise Exception("SHM path requested but unavailable")
+        self.stream_connected = not self.shm_connected
+        self.connected = True
+        return 0
+
+    def close(self):
+        if self._h:
+            self._lib.ist_conn_close(self._h)
+            self._lib.ist_conn_destroy(self._h)
+            self._h = None
+        self.connected = False
+        self.shm_connected = False
+        self.stream_connected = False
+
+    def __enter__(self):
+        self.connect()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def _check(self):
+        if not self.connected:
+            raise Exception("Not connected to any instance")
+
+    # ------------------------------------------------------------------
+    # allocate
+    # ------------------------------------------------------------------
+
+    def allocate(self, keys, page_size_in_bytes):
+        """Reserve uncommitted blocks for ``keys``; returns a numpy
+        structured array of RemoteBlocks (status, pool_idx, token, offset).
+        Duplicated keys come back with ``token == FAKE_TOKEN`` and are
+        skipped on write (first-writer-wins dedup, reference
+        infinistore.cpp:353-359)."""
+        self._check()
+        blob = pack_keys(keys)
+        out = np.zeros(len(keys), dtype=REMOTE_BLOCK_DTYPE)
+        st = self._lib.ist_allocate(
+            self._h,
+            blob,
+            len(blob),
+            len(keys),
+            page_size_in_bytes,
+            out.ctypes.data_as(ct.c_void_p),
+        )
+        if st != OK:
+            raise InfiniStoreError(st, "allocate failed")
+        if (out["status"] == _native.OUT_OF_MEMORY).any():
+            raise InfiniStoreError(_native.OUT_OF_MEMORY, "allocate failed")
+        return out
+
+    # Reference-compatible alias (lib.py:685-707).
+    def allocate_rdma(self, keys, page_size_in_bytes):
+        return self.allocate(keys, page_size_in_bytes)
+
+    async def allocate_rdma_async(self, keys, page_size_in_bytes):
+        # Allocation is a single small rpc; run it off-loop.
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self.allocate, keys, page_size_in_bytes
+        )
+
+    allocate_async = allocate_rdma_async
+
+    # ------------------------------------------------------------------
+    # write
+    # ------------------------------------------------------------------
+
+    def _prep_write(self, cache, offsets, page_size, remote_blocks):
+        arr = _as_src_array(cache)
+        esize = arr.itemsize
+        page_bytes = page_size * esize
+        blocks = np.ascontiguousarray(remote_blocks, dtype=REMOTE_BLOCK_DTYPE)
+        if len(offsets) != len(blocks):
+            raise ValueError("offsets and remote_blocks length mismatch")
+        base = arr.ctypes.data
+        nbytes = arr.nbytes
+        srcs = []
+        toks = []
+        for off, tok in zip(offsets, blocks["token"]):
+            byte_off = off * esize
+            if byte_off < 0 or byte_off + page_bytes > nbytes:
+                raise ValueError("offset out of tensor bounds")
+            srcs.append(base + byte_off)
+            toks.append(tok)
+        return arr, page_bytes, blocks, srcs, toks
+
+    def _write_async_native(self, cache, offsets, page_size, remote_blocks, cb):
+        """Shared async write plumbing; picks SHM vs STREAM path."""
+        arr, page_bytes, blocks, srcs, toks = self._prep_write(
+            cache, offsets, page_size, remote_blocks
+        )
+        n = len(srcs)
+        SrcArr = ct.c_void_p * n
+        TokArr = ct.c_uint64 * n
+        src_arr = SrcArr(*srcs)
+        tok_arr = TokArr(*[int(t) for t in toks])
+        ka = self._keep(cb, (arr, blocks, src_arr, tok_arr))
+        if self.shm_connected:
+            # The server may have auto-extended into pools we haven't
+            # mapped yet; refresh before the native copy so it never sees
+            # an unmapped pool_idx (it fails the op rather than committing
+            # unwritten blocks if this races).
+            if len(blocks) and int(blocks["pool_idx"].max()) >= int(
+                self._lib.ist_pool_count(self._h)
+            ):
+                self.refresh_pools()
+            st = self._lib.ist_shm_write_async(
+                self._h, page_bytes, n, tok_arr,
+                blocks.ctypes.data_as(ct.c_void_p), src_arr, ka.c_cb, None,
+            )
+        else:
+            # Streamed path: skip FAKE (dedup) blocks client-side
+            # (reference skips fake blocks in the WR chain,
+            # libinfinistore.cpp:905-910).
+            real = [(t, s) for t, s in zip(toks, srcs) if t != FAKE_TOKEN]
+            if not real:
+                self._drop_keep(ka.kid)
+                cb(OK)
+                return
+            rn = len(real)
+            r_toks = (ct.c_uint64 * rn)(*[int(t) for t, _ in real])
+            r_srcs = (ct.c_void_p * rn)(*[s for _, s in real])
+            ka.bufs = (arr, blocks, r_toks, r_srcs)
+            st = self._lib.ist_write_async(
+                self._h, page_bytes, rn, r_toks, r_srcs, ka.c_cb, None
+            )
+        if st != OK:
+            self._drop_keep(ka.kid)
+            raise InfiniStoreError(st, "write submit failed")
+
+    def write_cache(self, cache, offsets, page_size, remote_blocks):
+        """Write ``len(offsets)`` pages of ``page_size`` elements from
+        ``cache`` into previously allocated ``remote_blocks``.
+        Offsets/page_size are in elements (scaled by the tensor element
+        size, matching reference lib.py:460-472)."""
+        self._check()
+        done = threading.Event()
+        result = {}
+
+        def cb(status):
+            result["status"] = status
+            done.set()
+
+        self._write_async_native(cache, offsets, page_size, remote_blocks, cb)
+        if not done.wait(self.config.timeout_ms / 1000):
+            raise InfiniStoreError(TIMEOUT_ERR, "write timed out")
+        if result["status"] != OK:
+            raise InfiniStoreError(result["status"], "write failed")
+        return 0
+
+    def rdma_write_cache(self, cache, offsets, page_size, remote_blocks):
+        return self.write_cache(cache, offsets, page_size, remote_blocks)
+
+    async def rdma_write_cache_async(self, cache, offsets, page_size,
+                                     remote_blocks):
+        self._check()
+        loop = asyncio.get_running_loop()
+        future = loop.create_future()
+
+        def cb(status):
+            loop.call_soon_threadsafe(_finish_future, future, status, "write")
+
+        self._write_async_native(cache, offsets, page_size, remote_blocks, cb)
+        return await future
+
+    write_cache_async = rdma_write_cache_async
+
+    def local_gpu_write_cache(self, cache, blocks, page_size):
+        """One-call write of (key, offset) pairs: allocate + write + the
+        allocate-side dedup, mirroring the reference local path
+        (lib.py:360-394 → server write_cache infinistore.cpp:702-804)."""
+        self._check()
+        keys = [k for k, _ in blocks]
+        offsets = [off for _, off in blocks]
+        esize = _as_src_array(cache).itemsize
+        remote_blocks = self.allocate(keys, page_size * esize)
+        self.write_cache(cache, offsets, page_size, remote_blocks)
+        return 0
+
+    async def local_gpu_write_cache_async(self, cache, blocks, page_size):
+        keys = [k for k, _ in blocks]
+        offsets = [off for _, off in blocks]
+        esize = _as_src_array(cache).itemsize
+        remote_blocks = await self.allocate_async(keys, page_size * esize)
+        await self.write_cache_async(cache, offsets, page_size, remote_blocks)
+        return 0
+
+    # ------------------------------------------------------------------
+    # read
+    # ------------------------------------------------------------------
+
+    def _read_async_native(self, cache, blocks, page_size, cb):
+        arr = _as_dst_array(cache)
+        esize = arr.itemsize
+        page_bytes = page_size * esize
+        keys = [k for k, _ in blocks]
+        base = arr.ctypes.data
+        nbytes = arr.nbytes
+        dsts = []
+        for _, off in blocks:
+            byte_off = off * esize
+            if byte_off < 0 or byte_off + page_bytes > nbytes:
+                raise ValueError("offset out of tensor bounds")
+            dsts.append(base + byte_off)
+        n = len(dsts)
+        blob = pack_keys(keys)
+        DstArr = ct.c_void_p * n
+        dst_arr = DstArr(*dsts)
+        ka = self._keep(cb, (arr, dst_arr, blob))
+        fn = (
+            self._lib.ist_shm_read_async
+            if self.shm_connected
+            else self._lib.ist_read_async
+        )
+        st = fn(self._h, page_bytes, blob, len(blob), n, dst_arr, ka.c_cb, None)
+        if st != OK:
+            self._drop_keep(ka.kid)
+            raise InfiniStoreError(st, "read submit failed")
+
+    def read_cache(self, cache, blocks, page_size):
+        """Read pages for (key, offset) pairs into ``cache`` (offsets in
+        elements). Missing/uncommitted keys raise
+        :class:`InfiniStoreKeyNotFound` (reference returns KEY_NOT_FOUND,
+        infinistore.cpp:607)."""
+        self._check()
+        done = threading.Event()
+        result = {}
+
+        def cb(status):
+            result["status"] = status
+            done.set()
+
+        self._read_async_native(cache, blocks, page_size, cb)
+        if not done.wait(self.config.timeout_ms / 1000):
+            raise InfiniStoreError(TIMEOUT_ERR, "read timed out")
+        st = result["status"]
+        if st == KEY_NOT_FOUND:
+            raise InfiniStoreKeyNotFound(st, "key not found")
+        if st != OK:
+            raise InfiniStoreError(st, "read failed")
+        return 0
+
+    async def read_cache_async(self, cache, blocks, page_size):
+        self._check()
+        loop = asyncio.get_running_loop()
+        future = loop.create_future()
+
+        def cb(status):
+            loop.call_soon_threadsafe(_finish_future, future, status, "read")
+
+        self._read_async_native(cache, blocks, page_size, cb)
+        return await future
+
+    # ------------------------------------------------------------------
+    # control ops
+    # ------------------------------------------------------------------
+
+    def sync(self):
+        """Barrier: wait until all async ops on this connection completed
+        and are visible to every other connection (reference sync_rdma /
+        sync_local; the visibility guarantee is stronger here — see
+        native/src/server.h commit-race note)."""
+        self._check()
+        st = self._lib.ist_sync(self._h, self.config.timeout_ms)
+        if st != OK:
+            raise InfiniStoreError(st, "sync failed")
+        return 0
+
+    async def sync_async(self):
+        return await asyncio.get_running_loop().run_in_executor(None, self.sync)
+
+    def check_exist(self, key):
+        self._check()
+        kb = key.encode()
+        ret = self._lib.ist_check_exist(self._h, kb, len(kb))
+        if ret < 0:
+            raise InfiniStoreError(-ret, "check_exist failed")
+        return ret == 1
+
+    def get_match_last_index(self, keys):
+        """Longest cached prefix of the key list — THE prefix-cache-hit
+        primitive for vLLM (reference infinistore.cpp:1092-1108). Raises
+        if no key matches (reference lib.py:627-643)."""
+        self._check()
+        blob = pack_keys(keys)
+        idx = ct.c_int32(-1)
+        st = self._lib.ist_get_match_last_index(
+            self._h, blob, len(blob), len(keys), ct.byref(idx)
+        )
+        if st != OK:
+            raise InfiniStoreError(st, "get_match_last_index failed")
+        if idx.value < 0:
+            raise Exception("can't find a match")
+        return idx.value
+
+    def register_mr(self, cache):
+        """No-op for API compatibility (no MR registration on TCP/SHM)."""
+        self._check()
+        _as_src_array(cache)
+        return 1
+
+    def purge(self):
+        self._check()
+        count = ct.c_uint64(0)
+        st = self._lib.ist_client_purge(self._h, ct.byref(count))
+        if st != OK:
+            raise InfiniStoreError(st, "purge failed")
+        return count.value
+
+    def delete_keys(self, keys):
+        self._check()
+        blob = pack_keys(keys)
+        count = ct.c_uint64(0)
+        st = self._lib.ist_delete_keys(
+            self._h, blob, len(blob), len(keys), ct.byref(count)
+        )
+        if st != OK:
+            raise InfiniStoreError(st, "delete failed")
+        return count.value
+
+    def stats(self):
+        self._check()
+        buf = ct.create_string_buffer(4096)
+        st = self._lib.ist_client_stats(self._h, buf, len(buf))
+        if st != OK:
+            raise InfiniStoreError(st, "stats failed")
+        import json
+
+        return json.loads(buf.value.decode())
+
+    # ------------------------------------------------------------------
+    # zero-copy pool access (used by infinistore_tpu.tpu)
+    # ------------------------------------------------------------------
+
+    def pool_view(self, pool_idx):
+        """numpy uint8 view over a mapped SHM pool — lets JAX device_put/
+        device_get move bytes directly between TPU and the server pool
+        (the nv_peer_mem zero-copy analogue)."""
+        self._check()
+        if not self.shm_connected:
+            raise Exception("pool_view requires the SHM path")
+        size = ct.c_uint64(0)
+        base = self._lib.ist_pool_base(self._h, pool_idx, ct.byref(size))
+        if not base:
+            raise IndexError(f"no pool {pool_idx}")
+        buf = (ct.c_ubyte * size.value).from_address(base)
+        return np.frombuffer(buf, dtype=np.uint8)
+
+    def pin(self, keys):
+        """Pin committed blocks; returns (lease_id, RemoteBlock array)."""
+        self._check()
+        blob = pack_keys(keys)
+        out = np.zeros(len(keys), dtype=REMOTE_BLOCK_DTYPE)
+        lease = ct.c_uint64(0)
+        st = self._lib.ist_pin(
+            self._h, blob, len(blob), len(keys),
+            out.ctypes.data_as(ct.c_void_p), ct.byref(lease),
+        )
+        if st == KEY_NOT_FOUND:
+            raise InfiniStoreKeyNotFound(st, "pin: key not found")
+        if st != OK:
+            raise InfiniStoreError(st, "pin failed")
+        return lease.value, out
+
+    def release(self, lease_id):
+        self._check()
+        st = self._lib.ist_release(self._h, lease_id)
+        if st != OK:
+            raise InfiniStoreError(st, "release failed")
+
+    def commit(self, tokens):
+        """Commit tokens after writing pool memory directly (zero-copy
+        path). FAKE tokens are filtered natively."""
+        self._check()
+        toks = np.ascontiguousarray(tokens, dtype=np.uint64)
+        st = self._lib.ist_commit(
+            self._h,
+            toks.ctypes.data_as(ct.POINTER(ct.c_uint64)),
+            len(toks),
+        )
+        if st != OK:
+            raise InfiniStoreError(st, "commit failed")
+
+    def refresh_pools(self):
+        self._check()
+        return self._lib.ist_refresh_pools(self._h)
+
+    # ------------------------------------------------------------------
+    # keepalive plumbing for async callbacks
+    # ------------------------------------------------------------------
+
+    class _Keep:
+        __slots__ = ("c_cb", "bufs", "kid")
+
+    def _keep(self, py_cb, bufs):
+        ka = InfinityConnection._Keep()
+        with self._keepalive_lock:
+            self._keepalive_id += 1
+            kid = self._keepalive_id
+        ka.kid = kid
+        ka.bufs = bufs
+
+        def trampoline(status, _ud):
+            try:
+                py_cb(status)
+            finally:
+                self._drop_keep(kid)
+
+        ka.c_cb = _native.CALLBACK(trampoline)
+        with self._keepalive_lock:
+            self._keepalive[kid] = ka
+        return ka
+
+    def _drop_keep(self, kid):
+        with self._keepalive_lock:
+            self._keepalive.pop(kid, None)
+
+
+def _finish_future(future, status, what):
+    if future.cancelled():
+        return
+    if status == OK:
+        future.set_result(0)
+    elif status == KEY_NOT_FOUND:
+        future.set_exception(InfiniStoreKeyNotFound(status, f"{what} failed"))
+    else:
+        future.set_exception(InfiniStoreError(status, f"{what} failed"))

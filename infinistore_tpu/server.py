@@ -1,0 +1,246 @@
+"""Server CLI + management control plane.
+
+Parity target: reference ``infinistore/server.py`` (C13 in SURVEY.md §2):
+argparse flags, a FastAPI/uvicorn manage plane with ``POST /purge``,
+``GET /kvmap_len`` and ``POST /selftest/{port}``, optional warmup
+subprocess, and OOM-score protection. FastAPI/uvicorn are not available in
+this environment, so the manage plane is a stdlib ThreadingHTTPServer with
+the same endpoints (+ ``GET /stats`` and ``GET /health`` beyond parity).
+
+Unlike the reference — which embeds its libuv loop *inside* the Python
+uvloop (lib.py:193-204, infinistore.cpp:1276-1285) — the native server
+here runs its own epoll loop on a dedicated thread, so the Python process
+only hosts the control plane and stays fully responsive.
+"""
+
+import argparse
+import ctypes as ct
+import json
+import signal
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import _native
+from .config import ServerConfig
+from .lib import Logger, set_log_level
+
+
+class InfiniStoreServer:
+    """Owns the native server instance. Usable programmatically (tests,
+    benchmarks) or via the ``infinistore-tpu`` CLI."""
+
+    def __init__(self, config: ServerConfig):
+        config.verify()
+        self.config = config
+        self._lib = _native.get_lib()
+        set_log_level(config.log_level)
+        self._h = None
+        self.service_port = None
+
+    def start(self):
+        if self._h is not None:
+            raise Exception("server already started")
+        cfg = self.config
+        self._h = self._lib.ist_server_create(
+            cfg.host.encode(),
+            cfg.service_port,
+            int(cfg.prealloc_size * (1 << 30)),
+            cfg.minimal_allocate_size << 10,
+            1 if cfg.auto_increase else 0,
+            int(cfg.extend_size * (1 << 30)),
+            1 if cfg.enable_shm else 0,
+            cfg.shm_prefix.encode(),
+        )
+        port = self._lib.ist_server_start(self._h)
+        if port < 0:
+            self._lib.ist_server_destroy(self._h)
+            self._h = None
+            raise Exception("failed to start server (bind error?)")
+        self.service_port = port
+        return port
+
+    def stop(self):
+        if self._h is not None:
+            self._lib.ist_server_stop(self._h)
+            self._lib.ist_server_destroy(self._h)
+            self._h = None
+
+    def kvmap_len(self):
+        return int(self._lib.ist_server_kvmap_len(self._h))
+
+    def purge(self):
+        return int(self._lib.ist_server_purge(self._h))
+
+    def stats(self):
+        buf = ct.create_string_buffer(4096)
+        self._lib.ist_server_stats(self._h, buf, len(buf))
+        return json.loads(buf.value.decode())
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def _selftest(service_port):
+    """RDMA-loopback self-test analogue (reference server.py:41-91):
+    write/read/verify a small payload through the real data path."""
+    import numpy as np
+
+    from .config import ClientConfig
+    from .lib import InfinityConnection
+
+    conn = InfinityConnection(
+        ClientConfig(host_addr="127.0.0.1", service_port=service_port)
+    )
+    try:
+        conn.connect()
+        src = np.arange(4096, dtype=np.float32)
+        key = "selftest_key"
+        conn.delete_keys([key])
+        blocks = conn.allocate([key], src.nbytes)
+        conn.write_cache(src, [0], src.size, blocks)
+        conn.sync()
+        dst = np.zeros_like(src)
+        conn.read_cache(dst, [(key, 0)], src.size)
+        conn.sync()
+        ok = bool(np.array_equal(src, dst))
+        conn.delete_keys([key])
+        return ok
+    finally:
+        conn.close()
+
+
+def make_control_plane(server: InfiniStoreServer):
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code, payload):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/kvmap_len":
+                self._send(200, server.kvmap_len())
+            elif self.path == "/stats":
+                self._send(200, server.stats())
+            elif self.path == "/health":
+                self._send(200, {"status": "ok"})
+            else:
+                self._send(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path == "/purge":
+                n = server.purge()
+                self._send(200, {"purged": n})
+            elif self.path.startswith("/selftest"):
+                parts = self.path.rstrip("/").split("/")
+                port = (
+                    int(parts[-1])
+                    if parts[-1].isdigit()
+                    else server.service_port
+                )
+                try:
+                    ok = _selftest(port)
+                    self._send(200 if ok else 500, {"selftest": ok})
+                except Exception as e:  # pragma: no cover - error path
+                    self._send(500, {"selftest": False, "error": str(e)})
+            else:
+                self._send(404, {"error": "not found"})
+
+        def log_message(self, fmt, *args):
+            Logger.debug("manage: " + fmt % args)
+
+    return ThreadingHTTPServer((server.config.host, server.config.manage_port),
+                               Handler)
+
+
+def prevent_oom():
+    """Shield the store from the OOM killer (reference server.py:202-205)."""
+    try:
+        with open("/proc/self/oom_score_adj", "w") as f:
+            f.write("-1000")
+    except OSError:
+        Logger.warning("could not adjust oom_score_adj (not privileged)")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="infinistore-tpu",
+        description="TPU-native KV-cache memory-pool server",
+    )
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--service-port", type=int, default=22345)
+    p.add_argument("--manage-port", type=int, default=18080)
+    p.add_argument("--log-level", default="warning",
+                   choices=["error", "warning", "info", "debug"])
+    p.add_argument("--prealloc-size", type=float, default=16,
+                   help="pool preallocation in GB")
+    p.add_argument("--minimal-allocate-size", type=int, default=64,
+                   help="pool block granularity in KB")
+    p.add_argument("--auto-increase", action="store_true",
+                   help="grow the pool when usage crosses 50%%")
+    p.add_argument("--extend-size", type=float, default=1,
+                   help="GB added per auto-increase")
+    p.add_argument("--no-shm", action="store_true",
+                   help="disable the same-host shared-memory path")
+    p.add_argument("--warmup", action="store_true",
+                   help="run a warmup round-trip after startup")
+    p.add_argument("--no-oom-protect", action="store_true")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    config = ServerConfig(
+        host=args.host,
+        service_port=args.service_port,
+        manage_port=args.manage_port,
+        log_level=args.log_level,
+        prealloc_size=args.prealloc_size,
+        minimal_allocate_size=args.minimal_allocate_size,
+        auto_increase=args.auto_increase,
+        extend_size=args.extend_size,
+        enable_shm=not args.no_shm,
+    )
+    server = InfiniStoreServer(config)
+    server.start()
+    Logger.info(f"service on :{server.service_port}")
+
+    if not args.no_oom_protect:
+        prevent_oom()
+    if args.warmup:
+        import subprocess
+
+        subprocess.Popen(
+            [sys.executable, "-m", "infinistore_tpu.warmup",
+             "--service-port", str(server.service_port)]
+        )
+
+    httpd = make_control_plane(server)
+    Logger.info(f"manage plane on :{config.manage_port}")
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        stop.set()
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    try:
+        httpd.serve_forever()
+    finally:
+        httpd.server_close()
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,240 @@
+"""TPU/JAX accelerator edge: move ``jax.Array`` KV pages to/from the store.
+
+This is the TPU-native replacement for the reference's accelerator path,
+which registers CUDA device pointers for GPUDirect RDMA (nv_peer_mem,
+reference lib.py:244-251, libinfinistore.cpp:1166-1201) and moves bytes
+with ``cudaMemcpyAsync`` through IPC-shared device memory
+(infinistore.cpp:570-804). TPUs expose no device-pointer/IPC model, so the
+equivalent design is explicit host staging through the server's pool:
+
+- **get (store → TPU)**: pin the committed blocks, build a numpy view
+  directly over the mapped SHM pool, and ``jax.device_put`` from that
+  view — XLA's host-to-device DMA reads straight out of the server pool,
+  with no intermediate host copy. This is the moral equivalent of the
+  GPUDirect zero-copy read.
+- **put (TPU → store)**: device-to-host transfer (``np.asarray`` /
+  ``copy_to_host_async``) followed by a one-sided memcpy into the
+  allocated pool blocks + commit. One host-side copy, matching the
+  reference's D2H ``cudaMemcpyAsync`` into the pool.
+- **per-layer overlap**: ``LayerStreamer`` starts each layer's
+  device→host copy asynchronously and overlaps the store write of layer k
+  with the transfer of layer k+1 (the reference's prefill upload-thread
+  pattern, demo_prefill.py:57-77, design.rst:56-59).
+
+Everything works identically against the STREAM path (remote server) —
+the staging buffer is then private memory and the client streams it over
+TCP — so code written against this module is host-topology agnostic.
+"""
+
+import numpy as np
+
+from .lib import InfinityConnection
+
+try:  # jax is optional at import time (CPU-only control planes)
+    import jax
+    import jax.numpy as jnp
+
+    _HAS_JAX = True
+except ImportError:  # pragma: no cover
+    _HAS_JAX = False
+
+
+def _require_jax():
+    if not _HAS_JAX:
+        raise RuntimeError("infinistore_tpu.tpu requires jax")
+
+
+def _to_host(arr):
+    """Device → host as a C-contiguous numpy array."""
+    return np.ascontiguousarray(np.asarray(arr))
+
+
+class TpuKVStore:
+    """High-level KV-page interface over an :class:`InfinityConnection`.
+
+    Pages are fixed-size byte blocks addressed by content keys, exactly
+    like the reference's vLLM integration (design.rst:54-63): the engine
+    derives keys from token-prefix hashes, calls
+    :meth:`get_match_last_index` to find the cached prefix, reads those
+    pages, and writes back the new ones layer by layer.
+    """
+
+    def __init__(self, conn: InfinityConnection):
+        self.conn = conn
+
+    # -- generic arrays --------------------------------------------------
+
+    def put_arrays(self, items, sync=False):
+        """Store [(key, array)] pairs. Arrays may be jax.Arrays (device)
+        or numpy arrays (host); each array becomes one page."""
+        if not items:
+            return
+        host = [(k, _to_host(a)) for k, a in items]
+        # Group by nbytes so each allocate/write batch has a uniform page
+        # size (protocol pages are uniform per request).
+        by_size = {}
+        for k, a in host:
+            by_size.setdefault(a.nbytes, []).append((k, a))
+        for nbytes, group in by_size.items():
+            keys = [k for k, _ in group]
+            blocks = self.conn.allocate(keys, nbytes)
+            flat = np.concatenate([a.reshape(-1).view(np.uint8) for _, a in group])
+            offsets = [i * nbytes for i in range(len(group))]
+            self.conn.write_cache(flat, offsets, nbytes, blocks)
+        if sync:
+            self.conn.sync()
+
+    def get_array(self, key, shape, dtype, device=None):
+        """Fetch one array. On the SHM path the device transfer reads
+        directly from the pinned server pool (zero host copy)."""
+        _require_jax()
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        if self.conn.shm_connected:
+            lease, blocks = self.conn.pin([key])
+            try:
+                pool = self.conn.pool_view(int(blocks["pool_idx"][0]))
+                off = int(blocks["offset"][0])
+                view = pool[off : off + nbytes].view(dtype).reshape(shape)
+                out = jax.device_put(view, device)
+                out.block_until_ready()
+            finally:
+                self.conn.release(lease)
+            return out
+        buf = np.empty(nbytes, dtype=np.uint8)
+        self.conn.read_cache(buf, [(key, 0)], nbytes)
+        self.conn.sync()
+        return jax.device_put(buf.view(dtype).reshape(shape), device)
+
+    # -- paged KV --------------------------------------------------------
+
+    def put_kv_pages(self, keys, pages, sync=False):
+        """Store a batch of uniform KV pages.
+
+        ``pages``: array of shape [n_pages, ...] (jax or numpy); page i is
+        stored under keys[i]. One allocate + one write round-trip for the
+        whole batch (the reference's batched multi-block op,
+        lib.py:439-475).
+        """
+        host = _to_host(pages)
+        n = host.shape[0]
+        if n != len(keys):
+            raise ValueError("len(keys) must equal pages.shape[0]")
+        page_elems = int(np.prod(host.shape[1:]))
+        flat = host.reshape(n * page_elems)
+        blocks = self.conn.allocate(keys, page_elems * host.itemsize)
+        self.conn.write_cache(
+            flat, [i * page_elems for i in range(n)], page_elems, blocks
+        )
+        if sync:
+            self.conn.sync()
+        return blocks
+
+    def get_kv_pages(self, keys, page_shape, dtype, device=None):
+        """Fetch pages for ``keys``; returns a device array of shape
+        [len(keys), *page_shape]. SHM path: single device_put gathers all
+        pages straight from the pinned pool."""
+        _require_jax()
+        dtype = np.dtype(dtype)
+        page_elems = int(np.prod(page_shape))
+        page_bytes = page_elems * dtype.itemsize
+        n = len(keys)
+        if n == 0:
+            return jnp.zeros((0, *page_shape), dtype=dtype)
+        if self.conn.shm_connected:
+            lease, blocks = self.conn.pin(keys)
+            try:
+                # Per-page views over the pool; stack is the only host
+                # copy and happens inside XLA's transfer when possible.
+                views = []
+                for i in range(n):
+                    pool = self.conn.pool_view(int(blocks["pool_idx"][i]))
+                    off = int(blocks["offset"][i])
+                    views.append(
+                        pool[off : off + page_bytes].view(dtype).reshape(page_shape)
+                    )
+                stacked = np.stack(views)
+                out = jax.device_put(stacked, device)
+                out.block_until_ready()
+            finally:
+                self.conn.release(lease)
+            return out
+        buf = np.empty(n * page_bytes, dtype=np.uint8)
+        self.conn.read_cache(
+            buf, [(k, i * page_bytes) for i, k in enumerate(keys)], page_bytes
+        )
+        self.conn.sync()
+        return jax.device_put(
+            buf.view(dtype).reshape(n, *page_shape), device
+        )
+
+    def cached_prefix_len(self, keys):
+        """How many leading pages of ``keys`` are already cached
+        (get_match_last_index + 1; 0 if none)."""
+        try:
+            return self.conn.get_match_last_index(keys) + 1
+        except Exception:
+            return 0
+
+
+class LayerStreamer:
+    """Overlap per-layer KV upload with compute (reference
+    demo_prefill.py:57-77: per-layer CUDA event + upload thread feeding
+    local_gpu_write_cache).
+
+    Usage::
+
+        streamer = LayerStreamer(conn)
+        for layer in range(n_layers):
+            kv = compute_layer(layer)          # jax.Array
+            streamer.submit(f"{prefix}_{layer}", kv)
+        streamer.finish()                       # barriers all writes
+
+    ``submit`` starts the device→host copy asynchronously and hands the
+    store write to the connection's IO thread; compute for the next layer
+    proceeds immediately.
+    """
+
+    def __init__(self, conn: InfinityConnection):
+        self.conn = conn
+        self._pending = []  # (key, host_future) not yet written
+        self._errors = []
+
+    def submit(self, key, array):
+        _require_jax()
+        if hasattr(array, "copy_to_host_async"):
+            array.copy_to_host_async()
+        self._drain_ready()
+        self._pending.append((key, array))
+
+    def _drain_ready(self):
+        # Write out any arrays whose host copy has landed. jax arrays
+        # don't expose "is host copy done", so we write all pending each
+        # drain — np.asarray is a no-op wait once the async copy finished.
+        for key, arr in self._pending:
+            host = _to_host(arr)
+            blocks = self.conn.allocate([key], host.nbytes)
+            done = _ErrSink(self._errors, key)
+            self.conn._write_async_native(
+                host.reshape(-1), [0], host.size, blocks, done
+            )
+        self._pending.clear()
+
+    def finish(self):
+        """Flush remaining layers and barrier (conn.sync)."""
+        self._drain_ready()
+        self.conn.sync()
+        if self._errors:
+            raise RuntimeError(f"layer uploads failed: {self._errors}")
+
+
+class _ErrSink:
+    def __init__(self, errors, key):
+        self.errors = errors
+        self.key = key
+
+    def __call__(self, status):
+        from ._native import OK
+
+        if status != OK:
+            self.errors.append((self.key, status))

@@ -1,0 +1,67 @@
+"""Warmup: prime connections and data paths with a verify round-trip.
+
+Parity target: reference ``infinistore/warmup.py`` — a per-CUDA-device
+local write/read/verify loop that pre-opens CUDA IPC handles and primes
+CUDA contexts (warmup.py:7-49). On a TPU host the expensive lazy costs are
+(a) the client's SHM pool mapping + page faults and (b) the first JAX
+device transfer; both are primed here.
+"""
+
+import argparse
+import sys
+import uuid
+
+import numpy as np
+
+from .config import ClientConfig
+from .lib import InfinityConnection, Logger
+
+
+def warm_up(service_port=22345, host="127.0.0.1", size_kb=256, prime_jax=False):
+    conn = InfinityConnection(
+        ClientConfig(host_addr=host, service_port=service_port)
+    )
+    conn.connect()
+    try:
+        src = np.random.default_rng(0).integers(
+            0, 255, size_kb << 10, dtype=np.uint8
+        )
+        key = f"warmup_{uuid.uuid4()}"
+        blocks = conn.allocate([key], src.nbytes)
+        conn.write_cache(src, [0], src.size, blocks)
+        conn.sync()
+        dst = np.zeros_like(src)
+        conn.read_cache(dst, [(key, 0)], src.size)
+        conn.sync()
+        if not np.array_equal(src, dst):
+            raise RuntimeError("warmup round-trip mismatch")
+        conn.delete_keys([key])
+        if prime_jax:
+            # Prime the TPU transfer path (first compile/transfer is slow).
+            import jax
+            import jax.numpy as jnp
+
+            x = jnp.zeros(1024, dtype=jnp.bfloat16)
+            jax.block_until_ready(x + 1)
+        Logger.info(
+            f"warmup ok ({'SHM' if conn.shm_connected else 'STREAM'} path, "
+            f"{size_kb} KB)"
+        )
+        return True
+    finally:
+        conn.close()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--service-port", type=int, default=22345)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--size-kb", type=int, default=256)
+    p.add_argument("--prime-jax", action="store_true")
+    args = p.parse_args(argv)
+    ok = warm_up(args.service_port, args.host, args.size_kb, args.prime_jax)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

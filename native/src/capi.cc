@@ -1,0 +1,399 @@
+// capi.cc — extern "C" binding surface (C11 in SURVEY.md §2).
+//
+// Parity target: reference src/pybind.cpp — a pybind11 module exposing
+// Connection methods with the GIL released and server control functions
+// (register_server, purge_kv_map, get_kvmap_len, log fns). pybind11 is not
+// available in this environment, so the binding is a plain C ABI consumed
+// by ctypes (ctypes releases the GIL around foreign calls, giving the same
+// concurrency property as py::call_guard<py::gil_scoped_release>).
+//
+// The reference crosses allocate results into Python as zero-copy numpy
+// structured arrays (PYBIND11_NUMPY_DTYPE(remote_block_t), pybind.cpp:47);
+// here the caller passes a preallocated RemoteBlock[n] that numpy can view
+// with a structured dtype — the same zero-copy effect.
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "client.h"
+#include "common.h"
+#include "log.h"
+#include "server.h"
+
+using namespace istpu;
+
+namespace {
+
+// Parse a key blob: [u32 len, bytes]*n (built by the Python layer).
+bool parse_keys(const uint8_t* blob, uint64_t blob_len, uint32_t nkeys,
+                std::vector<std::string>* out) {
+    BufReader r(blob, size_t(blob_len));
+    out->reserve(nkeys);
+    for (uint32_t i = 0; i < nkeys; ++i) {
+        out->push_back(r.str());
+        if (!r.ok()) return false;
+    }
+    return true;
+}
+
+// Callback ABI for async completions: cb(status, user_data).
+typedef void (*ist_callback)(uint32_t status, void* user_data);
+
+DoneFn wrap_cb(ist_callback cb, void* ud) {
+    if (cb == nullptr) return DoneFn{};
+    return [cb, ud](uint32_t status, std::vector<uint8_t>) { cb(status, ud); };
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- logging ----------------------------------------------------------
+
+void ist_set_log_level(int level) { set_log_level(level); }
+void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }
+
+// ---- server -----------------------------------------------------------
+
+void* ist_server_create(const char* host, uint16_t port,
+                        uint64_t prealloc_bytes, uint64_t block_size,
+                        int auto_extend, uint64_t extend_bytes, int enable_shm,
+                        const char* shm_prefix) {
+    ServerConfig cfg;
+    cfg.host = host ? host : "0.0.0.0";
+    cfg.port = port;
+    cfg.prealloc_bytes = prealloc_bytes;
+    cfg.block_size = block_size;
+    cfg.auto_extend = auto_extend != 0;
+    cfg.extend_bytes = extend_bytes;
+    cfg.enable_shm = enable_shm != 0;
+    if (shm_prefix && shm_prefix[0]) cfg.shm_prefix = shm_prefix;
+    return new Server(cfg);
+}
+
+int ist_server_start(void* h) {
+    auto* s = static_cast<Server*>(h);
+    if (!s->start()) return -1;
+    return int(s->bound_port());
+}
+
+void ist_server_stop(void* h) { static_cast<Server*>(h)->stop(); }
+
+void ist_server_destroy(void* h) { delete static_cast<Server*>(h); }
+
+uint64_t ist_server_kvmap_len(void* h) {
+    return static_cast<Server*>(h)->kvmap_len();
+}
+
+uint64_t ist_server_purge(void* h) { return static_cast<Server*>(h)->purge(); }
+
+int ist_server_stats(void* h, char* buf, int cap) {
+    std::string s = static_cast<Server*>(h)->stats_json();
+    int n = int(s.size());
+    if (n >= cap) n = cap - 1;
+    memcpy(buf, s.data(), size_t(n));
+    buf[n] = 0;
+    return n;
+}
+
+int ist_server_shm_prefix(void* h, char* buf, int cap) {
+    const std::string& s = static_cast<Server*>(h)->shm_prefix();
+    int n = int(s.size());
+    if (n >= cap) n = cap - 1;
+    memcpy(buf, s.data(), size_t(n));
+    buf[n] = 0;
+    return n;
+}
+
+// ---- client -----------------------------------------------------------
+
+void* ist_conn_create(const char* host, uint16_t port, int use_shm,
+                      uint64_t window_bytes, int timeout_ms) {
+    ClientConfig cfg;
+    cfg.host = host ? host : "127.0.0.1";
+    cfg.port = port;
+    cfg.use_shm = use_shm != 0;
+    if (window_bytes) cfg.window_bytes = window_bytes;
+    if (timeout_ms) cfg.timeout_ms = timeout_ms;
+    return new Connection(cfg);
+}
+
+int ist_conn_connect(void* h) {
+    return static_cast<Connection*>(h)->connect_server();
+}
+
+void ist_conn_close(void* h) { static_cast<Connection*>(h)->close_conn(); }
+void ist_conn_destroy(void* h) { delete static_cast<Connection*>(h); }
+
+int ist_conn_shm_active(void* h) {
+    return static_cast<Connection*>(h)->shm_active() ? 1 : 0;
+}
+
+uint32_t ist_conn_block_size(void* h) {
+    return static_cast<Connection*>(h)->server_block_size();
+}
+
+uint64_t ist_conn_inflight(void* h) {
+    return static_cast<Connection*>(h)->inflight();
+}
+
+// Allocate: fills out[nkeys]; returns rpc status.
+uint32_t ist_allocate(void* h, const uint8_t* keys_blob, uint64_t blob_len,
+                      uint32_t nkeys, uint32_t block_size, RemoteBlock* out) {
+    auto* c = static_cast<Connection*>(h);
+    std::vector<std::string> keys;
+    if (!parse_keys(keys_blob, blob_len, nkeys, &keys)) return BAD_REQUEST;
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    w.u32(block_size);
+    w.keys(keys);
+    std::vector<uint8_t> resp;
+    uint32_t st = c->rpc(OP_ALLOCATE, std::move(body), &resp);
+    if (st != OK) return st;
+    BufReader r(resp.data(), resp.size());
+    uint32_t n = r.u32();
+    const uint8_t* raw = r.raw(size_t(n) * sizeof(RemoteBlock));
+    if (raw == nullptr || n != nkeys) return INTERNAL_ERROR;
+    memcpy(out, raw, size_t(n) * sizeof(RemoteBlock));
+    return OK;
+}
+
+// Streamed write of n blocks from srcs[i] (STREAM path).
+uint32_t ist_write_async(void* h, uint32_t block_size, uint32_t n,
+                         const uint64_t* tokens, const void* const* srcs,
+                         ist_callback cb, void* ud) {
+    auto* c = static_cast<Connection*>(h);
+    std::vector<uint64_t> toks(tokens, tokens + n);
+    std::vector<const void*> sp(srcs, srcs + n);
+    c->write_async(block_size, std::move(toks), std::move(sp),
+                   wrap_cb(cb, ud));
+    return OK;
+}
+
+uint32_t ist_read_async(void* h, uint32_t block_size, const uint8_t* keys_blob,
+                        uint64_t blob_len, uint32_t nkeys, void* const* dsts,
+                        ist_callback cb, void* ud) {
+    auto* c = static_cast<Connection*>(h);
+    std::vector<std::string> keys;
+    if (!parse_keys(keys_blob, blob_len, nkeys, &keys)) return BAD_REQUEST;
+    std::vector<void*> dp(dsts, dsts + nkeys);
+    c->read_async(block_size, std::move(keys), std::move(dp), wrap_cb(cb, ud));
+    return OK;
+}
+
+uint32_t ist_shm_write_async(void* h, uint32_t block_size, uint32_t n,
+                             const uint64_t* tokens, const RemoteBlock* blocks,
+                             const void* const* srcs, ist_callback cb,
+                             void* ud) {
+    auto* c = static_cast<Connection*>(h);
+    std::vector<uint64_t> toks;
+    for (uint32_t i = 0; i < n; ++i) {
+        if (tokens[i] != FAKE_TOKEN) toks.push_back(tokens[i]);
+    }
+    std::vector<RemoteBlock> blks(blocks, blocks + n);
+    std::vector<const void*> sp(srcs, srcs + n);
+    c->shm_write_async(block_size, std::move(toks), std::move(blks),
+                       std::move(sp), wrap_cb(cb, ud));
+    return OK;
+}
+
+uint32_t ist_shm_read_async(void* h, uint32_t block_size,
+                            const uint8_t* keys_blob, uint64_t blob_len,
+                            uint32_t nkeys, void* const* dsts, ist_callback cb,
+                            void* ud) {
+    auto* c = static_cast<Connection*>(h);
+    std::vector<std::string> keys;
+    if (!parse_keys(keys_blob, blob_len, nkeys, &keys)) return BAD_REQUEST;
+    std::vector<void*> dp(dsts, dsts + nkeys);
+    c->shm_read_async(block_size, std::move(keys), std::move(dp),
+                      wrap_cb(cb, ud));
+    return OK;
+}
+
+uint32_t ist_sync(void* h, int timeout_ms) {
+    return static_cast<Connection*>(h)->sync(timeout_ms);
+}
+
+// Commit previously allocated tokens (used by the zero-copy Python path
+// that writes pool memory directly).
+uint32_t ist_commit(void* h, const uint64_t* tokens, uint32_t n) {
+    auto* c = static_cast<Connection*>(h);
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    uint32_t real = 0;
+    for (uint32_t i = 0; i < n; ++i) {
+        if (tokens[i] != FAKE_TOKEN) real++;
+    }
+    w.u32(real);
+    for (uint32_t i = 0; i < n; ++i) {
+        if (tokens[i] != FAKE_TOKEN) w.u64(tokens[i]);
+    }
+    return c->rpc(OP_COMMIT, std::move(body), nullptr);
+}
+
+// Pin committed keys; fills out[nkeys] with pool locations and *lease.
+uint32_t ist_pin(void* h, const uint8_t* keys_blob, uint64_t blob_len,
+                 uint32_t nkeys, RemoteBlock* out, uint64_t* lease) {
+    auto* c = static_cast<Connection*>(h);
+    std::vector<std::string> keys;
+    if (!parse_keys(keys_blob, blob_len, nkeys, &keys)) return BAD_REQUEST;
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    w.keys(keys);
+    std::vector<uint8_t> resp;
+    uint32_t st = c->rpc(OP_PIN, std::move(body), &resp);
+    if (st != OK) return st;
+    BufReader r(resp.data(), resp.size());
+    *lease = r.u64();
+    uint32_t n = r.u32();
+    const uint8_t* raw = r.raw(size_t(n) * sizeof(RemoteBlock));
+    if (raw == nullptr || n != nkeys) return INTERNAL_ERROR;
+    memcpy(out, raw, size_t(n) * sizeof(RemoteBlock));
+    return OK;
+}
+
+uint32_t ist_release(void* h, uint64_t lease) {
+    auto* c = static_cast<Connection*>(h);
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    w.u64(lease);
+    return c->rpc(OP_RELEASE, std::move(body), nullptr);
+}
+
+int ist_check_exist(void* h, const char* key, uint32_t klen) {
+    auto* c = static_cast<Connection*>(h);
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    w.str(std::string(key, klen));
+    uint32_t st = c->rpc(OP_CHECK_EXIST, std::move(body), nullptr);
+    if (st == OK) return 1;
+    if (st == KEY_NOT_FOUND) return 0;
+    return -int(st);
+}
+
+// Returns rpc status; *index gets the match result (-1 = none).
+uint32_t ist_get_match_last_index(void* h, const uint8_t* keys_blob,
+                                  uint64_t blob_len, uint32_t nkeys,
+                                  int32_t* index) {
+    auto* c = static_cast<Connection*>(h);
+    std::vector<std::string> keys;
+    if (!parse_keys(keys_blob, blob_len, nkeys, &keys)) return BAD_REQUEST;
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    w.keys(keys);
+    std::vector<uint8_t> resp;
+    uint32_t st = c->rpc(OP_GET_MATCH_LAST_IDX, std::move(body), &resp);
+    if (st != OK) return st;
+    BufReader r(resp.data(), resp.size());
+    *index = r.i32();
+    return OK;
+}
+
+uint32_t ist_client_purge(void* h, uint64_t* count) {
+    auto* c = static_cast<Connection*>(h);
+    std::vector<uint8_t> resp;
+    uint32_t st = c->rpc(OP_PURGE, {}, &resp);
+    if (st == OK && count) {
+        BufReader r(resp.data(), resp.size());
+        *count = r.u64();
+    }
+    return st;
+}
+
+uint32_t ist_delete_keys(void* h, const uint8_t* keys_blob, uint64_t blob_len,
+                         uint32_t nkeys, uint64_t* count) {
+    auto* c = static_cast<Connection*>(h);
+    std::vector<std::string> keys;
+    if (!parse_keys(keys_blob, blob_len, nkeys, &keys)) return BAD_REQUEST;
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    w.keys(keys);
+    std::vector<uint8_t> resp;
+    uint32_t st = c->rpc(OP_DELETE, std::move(body), &resp);
+    if (st == OK && count) {
+        BufReader r(resp.data(), resp.size());
+        *count = r.u64();
+    }
+    return st;
+}
+
+uint32_t ist_client_stats(void* h, char* buf, int cap) {
+    auto* c = static_cast<Connection*>(h);
+    std::vector<uint8_t> resp;
+    uint32_t st = c->rpc(OP_STATS, {}, &resp);
+    if (st != OK) return st;
+    BufReader r(resp.data(), resp.size());
+    std::string s = r.str();
+    int n = int(s.size());
+    if (n >= cap) n = cap - 1;
+    memcpy(buf, s.data(), size_t(n));
+    buf[n] = 0;
+    return OK;
+}
+
+uint32_t ist_sync_rpc(void* h) {
+    return static_cast<Connection*>(h)->rpc(OP_SYNC, {}, nullptr);
+}
+
+// Pool mapping access for the zero-copy numpy/JAX path.
+uint64_t ist_pool_count(void* h) {
+    return static_cast<Connection*>(h)->pool_count();
+}
+
+void* ist_pool_base(void* h, uint32_t idx, uint64_t* size_out) {
+    size_t sz = 0;
+    uint8_t* p = static_cast<Connection*>(h)->pool_base(idx, &sz);
+    if (size_out) *size_out = sz;
+    return p;
+}
+
+int ist_refresh_pools(void* h) {
+    return static_cast<Connection*>(h)->refresh_pools();
+}
+
+// ---- direct allocator access for unit tests ---------------------------
+
+void* ist_mm_create(uint64_t initial, uint64_t block_size, int auto_extend,
+                    uint64_t extend) {
+    try {
+        return new MM(initial, block_size, "", auto_extend != 0, extend);
+    } catch (...) {
+        return nullptr;
+    }
+}
+
+void ist_mm_destroy(void* h) { delete static_cast<MM*>(h); }
+
+int ist_mm_allocate(void* h, uint64_t size, uint32_t* pool_idx,
+                    uint64_t* offset) {
+    PoolLoc loc;
+    if (!static_cast<MM*>(h)->allocate(size, &loc)) return -1;
+    *pool_idx = loc.pool_idx;
+    *offset = loc.offset;
+    return 0;
+}
+
+int ist_mm_deallocate(void* h, uint32_t pool_idx, uint64_t offset,
+                      uint64_t size) {
+    auto* mm = static_cast<MM*>(h);
+    if (pool_idx >= mm->num_pools()) return -1;
+    PoolLoc loc;
+    loc.pool_idx = pool_idx;
+    loc.offset = offset;
+    loc.ptr = const_cast<uint8_t*>(mm->pool(pool_idx).base()) + offset;
+    return mm->deallocate(loc, size) ? 0 : -1;
+}
+
+uint64_t ist_mm_used_bytes(void* h) {
+    return static_cast<MM*>(h)->used_bytes();
+}
+
+uint64_t ist_mm_total_bytes(void* h) {
+    return static_cast<MM*>(h)->total_bytes();
+}
+
+uint64_t ist_mm_num_pools(void* h) {
+    return static_cast<MM*>(h)->num_pools();
+}
+
+}  // extern "C"

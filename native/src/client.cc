@@ -1,0 +1,765 @@
+#include "client.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <cstring>
+
+#include "log.h"
+
+namespace istpu {
+
+namespace {
+
+int connect_tcp(const std::string& host, uint16_t port, int timeout_ms) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    std::string port_s = std::to_string(port);
+    if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0) return -1;
+    int fd = socket(res->ai_family, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (fd < 0) {
+        freeaddrinfo(res);
+        return -1;
+    }
+    timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    int rc = connect(fd, res->ai_addr, res->ai_addrlen);
+    freeaddrinfo(res);
+    if (rc != 0) {
+        close(fd);
+        return -1;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    int buf = int(SOCK_BUF_BYTES);
+    setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+    setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+    return fd;
+}
+
+// Blocking exact send/recv for the bootstrap HELLO (reference
+// send_exact/recv_exact, src/utils.cpp:19-46).
+bool send_exact(int fd, const void* p, size_t n) {
+    const uint8_t* b = static_cast<const uint8_t*>(p);
+    while (n > 0) {
+        ssize_t r = send(fd, b, n, MSG_NOSIGNAL);
+        if (r <= 0) {
+            if (r < 0 && errno == EINTR) continue;
+            return false;
+        }
+        b += r;
+        n -= size_t(r);
+    }
+    return true;
+}
+
+bool recv_exact(int fd, void* p, size_t n) {
+    uint8_t* b = static_cast<uint8_t*>(p);
+    while (n > 0) {
+        ssize_t r = recv(fd, b, n, 0);
+        if (r <= 0) {
+            if (r < 0 && errno == EINTR) continue;
+            return false;
+        }
+        b += r;
+        n -= size_t(r);
+    }
+    return true;
+}
+
+}  // namespace
+
+Connection::Connection(const ClientConfig& cfg) : cfg_(cfg) {
+    rdrain_.resize(1 << 20);
+}
+
+Connection::~Connection() { close_conn(); }
+
+int Connection::connect_server() {
+    fd_ = connect_tcp(cfg_.host, cfg_.port, cfg_.timeout_ms);
+    if (fd_ < 0) {
+        IST_ERROR("connect to %s:%u failed", cfg_.host.c_str(), cfg_.port);
+        return -1;
+    }
+    // Bootstrap HELLO on the still-blocking socket.
+    WireHeader h = make_header(OP_HELLO, 0, 0, 0);
+    if (!send_exact(fd_, &h, sizeof(h))) return -1;
+    WireHeader rh;
+    if (!recv_exact(fd_, &rh, sizeof(rh)) || !header_valid(rh)) return -1;
+    std::vector<uint8_t> body(rh.body_len);
+    if (!recv_exact(fd_, body.data(), body.size())) return -1;
+    BufReader r(body.data(), body.size());
+    uint32_t status = r.u32();
+    if (status != OK) return -1;
+    server_block_size_ = r.u32();
+    uint32_t shm_enabled = r.u32();
+    {
+        std::lock_guard<std::mutex> lk(pools_mu_);
+        if (cfg_.use_shm && shm_enabled) {
+            if (map_pools_locked(r) == 0 && !pools_.empty()) {
+                shm_active_ = true;
+            }
+        }
+    }
+
+    // Switch to the IO thread regime.
+    int fl = fcntl(fd_, F_GETFL, 0);
+    fcntl(fd_, F_SETFL, fl | O_NONBLOCK);
+    epoll_fd_ = epoll_create1(EPOLL_CLOEXEC);
+    wake_fd_ = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = wake_fd_;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, wake_fd_, &ev);
+    ev.events = EPOLLIN;
+    ev.data.fd = fd_;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd_, &ev);
+    running_.store(true);
+    broken_.store(false);
+    io_thread_ = std::thread([this] { io_loop(); });
+    IST_INFO("connected to %s:%u (shm=%s, block=%u)", cfg_.host.c_str(),
+             cfg_.port, shm_active_ ? "on" : "off", server_block_size_);
+    return 0;
+}
+
+int Connection::map_pools_locked(BufReader& r) {
+    uint32_t npools = r.u32();
+    if (!r.ok() || npools > 4096) return -1;
+    for (uint32_t i = 0; i < npools; ++i) {
+        std::string name = r.str();
+        uint64_t size = r.u64();
+        if (!r.ok()) return -1;
+        if (i < pools_.size()) continue;  // already mapped
+        if (name.empty()) return -1;      // anonymous pool: no SHM path
+        int fd = shm_open(("/" + name).c_str(), O_RDWR, 0);
+        if (fd < 0) {
+            IST_DEBUG("shm_open %s failed (remote server?)", name.c_str());
+            return -1;
+        }
+        void* mem = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                         fd, 0);
+        close(fd);
+        if (mem == MAP_FAILED) return -1;
+        pools_.push_back(PoolMap{name, static_cast<uint8_t*>(mem), size});
+    }
+    return 0;
+}
+
+void Connection::close_conn() {
+    if (running_.exchange(false)) {
+        wake();
+        if (io_thread_.joinable()) io_thread_.join();
+    }
+    if (fd_ >= 0) close(fd_);
+    if (epoll_fd_ >= 0) close(epoll_fd_);
+    if (wake_fd_ >= 0) close(wake_fd_);
+    fd_ = epoll_fd_ = wake_fd_ = -1;
+    std::lock_guard<std::mutex> lk(pools_mu_);
+    for (auto& p : pools_) munmap(p.base, p.size);
+    pools_.clear();
+    shm_active_ = false;
+}
+
+void Connection::wake() {
+    if (wake_fd_ >= 0) {
+        uint64_t one = 1;
+        ssize_t n = write(wake_fd_, &one, sizeof(one));
+        (void)n;
+    }
+}
+
+size_t Connection::pool_count() {
+    std::lock_guard<std::mutex> lk(pools_mu_);
+    return pools_.size();
+}
+
+uint8_t* Connection::pool_base(uint32_t idx, size_t* size_out) {
+    std::lock_guard<std::mutex> lk(pools_mu_);
+    if (idx >= pools_.size()) return nullptr;
+    if (size_out) *size_out = pools_[idx].size;
+    return pools_[idx].base;
+}
+
+int Connection::refresh_pools() {
+    std::vector<uint8_t> resp;
+    uint32_t st = rpc(OP_HELLO, {}, &resp);
+    if (st != OK) return -1;
+    BufReader r(resp.data(), resp.size());
+    r.u32();  // block size
+    uint32_t shm_enabled = r.u32();
+    if (!shm_enabled) return -1;
+    std::lock_guard<std::mutex> lk(pools_mu_);
+    return map_pools_locked(r);
+}
+
+// ---------------------------------------------------------------------------
+// Submission plumbing
+// ---------------------------------------------------------------------------
+
+void Connection::rpc_async(uint8_t op, std::vector<uint8_t> body, DoneFn done) {
+    if (broken_.load() || !running_.load()) {
+        if (done) done(INTERNAL_ERROR, {});
+        return;
+    }
+    auto body_p = std::make_shared<std::vector<uint8_t>>(std::move(body));
+    Submit s;
+    s.fn = [this, op, body_p, done = std::move(done)]() mutable {
+        Pending p;
+        p.op = op;
+        p.done = std::move(done);
+        enqueue_msg(op, std::move(*body_p), {}, std::move(p));
+    };
+    {
+        std::lock_guard<std::mutex> lk(submit_mu_);
+        submits_.push_back(std::move(s));
+    }
+    wake();
+}
+
+uint32_t Connection::rpc(uint8_t op, std::vector<uint8_t> body,
+                         std::vector<uint8_t>* resp_body) {
+    struct WaitState {
+        std::mutex mu;
+        std::condition_variable cv;
+        bool done = false;
+        uint32_t status = TIMEOUT_ERR;
+        std::vector<uint8_t> body;
+    };
+    auto st = std::make_shared<WaitState>();
+    rpc_async(op, std::move(body),
+              [st](uint32_t status, std::vector<uint8_t> b) {
+                  std::lock_guard<std::mutex> lk(st->mu);
+                  st->status = status;
+                  st->body = std::move(b);
+                  st->done = true;
+                  st->cv.notify_all();
+              });
+    std::unique_lock<std::mutex> lk(st->mu);
+    if (!st->cv.wait_for(lk, std::chrono::milliseconds(cfg_.timeout_ms),
+                         [&] { return st->done; })) {
+        return TIMEOUT_ERR;
+    }
+    if (resp_body) *resp_body = std::move(st->body);
+    return st->status;
+}
+
+void Connection::write_async(uint32_t block_size, std::vector<uint64_t> tokens,
+                             std::vector<const void*> srcs, DoneFn done) {
+    inflight_++;
+    if (broken_.load() || !running_.load()) {
+        if (done) done(INTERNAL_ERROR, {});
+        finish_op();
+        return;
+    }
+    uint64_t payload = uint64_t(block_size) * tokens.size();
+    auto toks = std::make_shared<std::vector<uint64_t>>(std::move(tokens));
+    auto sp = std::make_shared<std::vector<const void*>>(std::move(srcs));
+    Submit s;
+    s.window_cost = payload;
+    s.fn = [this, block_size, toks, sp, payload,
+            done = std::move(done)]() mutable {
+        std::vector<uint8_t> body;
+        BufWriter w(body);
+        w.u32(block_size);
+        w.u32(uint32_t(toks->size()));
+        for (uint64_t t : *toks) w.u64(t);
+        std::vector<std::pair<const uint8_t*, size_t>> segs;
+        segs.reserve(sp->size());
+        for (const void* p : *sp) {
+            segs.emplace_back(static_cast<const uint8_t*>(p), block_size);
+        }
+        Pending pend;
+        pend.op = OP_WRITE;
+        pend.payload_bytes = payload;
+        // Keep gather sources alive until completion.
+        pend.done = [this, sp, done = std::move(done)](
+                        uint32_t status, std::vector<uint8_t> b) {
+            if (done) done(status, std::move(b));
+            finish_op();
+        };
+        enqueue_msg(OP_WRITE, std::move(body), std::move(segs),
+                    std::move(pend));
+    };
+    {
+        std::lock_guard<std::mutex> lk(submit_mu_);
+        submits_.push_back(std::move(s));
+    }
+    wake();
+}
+
+void Connection::read_async(uint32_t block_size,
+                            std::vector<std::string> keys,
+                            std::vector<void*> dsts, DoneFn done) {
+    inflight_++;
+    if (broken_.load() || !running_.load()) {
+        if (done) done(INTERNAL_ERROR, {});
+        finish_op();
+        return;
+    }
+    auto ks = std::make_shared<std::vector<std::string>>(std::move(keys));
+    auto dp = std::make_shared<std::vector<void*>>(std::move(dsts));
+    Submit s;
+    s.fn = [this, block_size, ks, dp, done = std::move(done)]() mutable {
+        std::vector<uint8_t> body;
+        BufWriter w(body);
+        w.u32(block_size);
+        w.keys(*ks);
+        Pending pend;
+        pend.op = OP_READ;
+        pend.scatter.reserve(dp->size());
+        for (void* p : *dp) {
+            pend.scatter.emplace_back(static_cast<uint8_t*>(p), block_size);
+        }
+        pend.done = [this, dp, done = std::move(done)](
+                        uint32_t status, std::vector<uint8_t> b) {
+            if (done) done(status, std::move(b));
+            finish_op();
+        };
+        enqueue_msg(OP_READ, std::move(body), {}, std::move(pend));
+    };
+    {
+        std::lock_guard<std::mutex> lk(submit_mu_);
+        submits_.push_back(std::move(s));
+    }
+    wake();
+}
+
+void Connection::shm_write_async(uint32_t block_size,
+                                 std::vector<uint64_t> tokens,
+                                 std::vector<RemoteBlock> blocks,
+                                 std::vector<const void*> srcs, DoneFn done) {
+    inflight_++;
+    if (broken_.load() || !running_.load()) {
+        if (done) done(INTERNAL_ERROR, {});
+        finish_op();
+        return;
+    }
+    auto toks = std::make_shared<std::vector<uint64_t>>(std::move(tokens));
+    auto blks = std::make_shared<std::vector<RemoteBlock>>(std::move(blocks));
+    auto sp = std::make_shared<std::vector<const void*>>(std::move(srcs));
+    Submit s;
+    s.fn = [this, block_size, toks, blks, sp, done = std::move(done)]() mutable {
+        // One-sided copies into the mapped pool (CUDA-IPC memcpy analogue,
+        // reference write_cache infinistore.cpp:702-804 — but client-side).
+        // A block in a pool this client has not mapped (server extended
+        // after our HELLO) is NOT silently skipped: its token is excluded
+        // from the commit and the op fails so the caller can
+        // refresh_pools() and retry — committing an unwritten block would
+        // serve garbage under that key forever.
+        std::vector<uint64_t> ok_toks;
+        bool copy_failed = false;
+        {
+            std::lock_guard<std::mutex> lk(pools_mu_);
+            for (size_t i = 0; i < blks->size(); ++i) {
+                const RemoteBlock& b = (*blks)[i];
+                if (b.token == FAKE_TOKEN) continue;  // dedup: skip
+                if (b.pool_idx < pools_.size() &&
+                    b.offset + block_size <= pools_[b.pool_idx].size) {
+                    memcpy(pools_[b.pool_idx].base + b.offset, (*sp)[i],
+                           block_size);
+                    ok_toks.push_back(b.token);
+                } else {
+                    copy_failed = true;
+                }
+            }
+        }
+        std::vector<uint8_t> body;
+        BufWriter w(body);
+        w.u32(uint32_t(ok_toks.size()));
+        for (uint64_t t : ok_toks) w.u64(t);
+        Pending pend;
+        pend.op = OP_COMMIT;
+        pend.done = [this, copy_failed, done = std::move(done)](
+                        uint32_t status, std::vector<uint8_t> b) {
+            if (copy_failed && status == OK) status = INTERNAL_ERROR;
+            if (done) done(status, std::move(b));
+            finish_op();
+        };
+        enqueue_msg(OP_COMMIT, std::move(body), {}, std::move(pend));
+    };
+    {
+        std::lock_guard<std::mutex> lk(submit_mu_);
+        submits_.push_back(std::move(s));
+    }
+    wake();
+}
+
+void Connection::shm_read_async(uint32_t block_size,
+                                std::vector<std::string> keys,
+                                std::vector<void*> dsts, DoneFn done) {
+    inflight_++;
+    if (broken_.load() || !running_.load()) {
+        if (done) done(INTERNAL_ERROR, {});
+        finish_op();
+        return;
+    }
+    auto ks = std::make_shared<std::vector<std::string>>(std::move(keys));
+    auto dp = std::make_shared<std::vector<void*>>(std::move(dsts));
+    Submit s;
+    s.fn = [this, block_size, ks, dp, done = std::move(done)]() mutable {
+        std::vector<uint8_t> body;
+        BufWriter w(body);
+        w.keys(*ks);
+        Pending pend;
+        pend.op = OP_PIN;
+        pend.done = [this, block_size, dp, done = std::move(done)](
+                        uint32_t status, std::vector<uint8_t> b) mutable {
+            if (status != OK) {
+                if (done) done(status, std::move(b));
+                finish_op();
+                return;
+            }
+            BufReader r(b.data(), b.size());
+            uint64_t lease = r.u64();
+            uint32_t n = r.u32();
+            const uint8_t* raw = r.raw(size_t(n) * sizeof(RemoteBlock));
+            uint32_t st = OK;
+            if (raw == nullptr || n != dp->size()) {
+                st = INTERNAL_ERROR;
+            } else {
+                std::lock_guard<std::mutex> lk(pools_mu_);
+                for (uint32_t i = 0; i < n; ++i) {
+                    RemoteBlock blk;
+                    memcpy(&blk, raw + i * sizeof(RemoteBlock), sizeof(blk));
+                    if (blk.pool_idx < pools_.size() &&
+                        blk.offset + block_size <= pools_[blk.pool_idx].size) {
+                        memcpy((*dp)[i], pools_[blk.pool_idx].base + blk.offset,
+                               block_size);
+                    } else {
+                        st = INTERNAL_ERROR;
+                    }
+                }
+            }
+            // Fire-and-forget release; the pin lease has served its purpose.
+            std::vector<uint8_t> rbody;
+            BufWriter rw(rbody);
+            rw.u64(lease);
+            Pending rel;
+            rel.op = OP_RELEASE;
+            rel.done = [](uint32_t, std::vector<uint8_t>) {};
+            enqueue_msg(OP_RELEASE, std::move(rbody), {}, std::move(rel));
+            if (done) done(st, {});
+            finish_op();
+        };
+        enqueue_msg(OP_PIN, std::move(body), {}, std::move(pend));
+    };
+    {
+        std::lock_guard<std::mutex> lk(submit_mu_);
+        submits_.push_back(std::move(s));
+    }
+    wake();
+}
+
+uint32_t Connection::sync(int timeout_ms) {
+    if (timeout_ms <= 0) timeout_ms = cfg_.timeout_ms;
+    std::unique_lock<std::mutex> lk(sync_mu_);
+    bool ok = sync_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                [&] { return inflight_.load() == 0; });
+    if (!ok) return TIMEOUT_ERR;
+    return broken_.load() ? INTERNAL_ERROR : OK;
+}
+
+void Connection::finish_op() {
+    std::lock_guard<std::mutex> lk(sync_mu_);
+    inflight_--;
+    sync_cv_.notify_all();
+}
+
+// ---------------------------------------------------------------------------
+// IO thread
+// ---------------------------------------------------------------------------
+
+void Connection::enqueue_msg(uint8_t op, std::vector<uint8_t> body,
+                             std::vector<std::pair<const uint8_t*, size_t>> segs,
+                             Pending pending) {
+    if (broken_.load()) {
+        if (pending.done) pending.done(INTERNAL_ERROR, {});
+        return;
+    }
+    uint64_t seq = next_seq_++;
+    uint64_t payload = 0;
+    for (auto& s : segs) payload += s.second;
+    OutMsg m;
+    m.meta.resize(sizeof(WireHeader) + body.size());
+    WireHeader h = make_header(op, seq, uint32_t(body.size()), payload);
+    memcpy(m.meta.data(), &h, sizeof(h));
+    if (!body.empty()) memcpy(m.meta.data() + sizeof(h), body.data(), body.size());
+    m.segs = std::move(segs);
+    m.payload_bytes = pending.payload_bytes;
+    window_used_ += pending.payload_bytes;
+    pending_[seq] = std::move(pending);
+    sendq_.push_back(std::move(m));
+}
+
+void Connection::drain_submits() {
+    // Window-gated drain (reference overflow queue drained from the CQ
+    // thread, libinfinistore.cpp:334-360).
+    while (true) {
+        Submit s;
+        {
+            std::lock_guard<std::mutex> lk(submit_mu_);
+            if (!overflow_.empty()) {
+                if (overflow_.front().window_cost + window_used_ >
+                        cfg_.window_bytes &&
+                    window_used_ > 0) {
+                    return;  // wait for credit
+                }
+                s = std::move(overflow_.front());
+                overflow_.pop_front();
+            } else if (!submits_.empty()) {
+                s = std::move(submits_.front());
+                submits_.pop_front();
+                if (s.window_cost + window_used_ > cfg_.window_bytes &&
+                    window_used_ > 0) {
+                    overflow_.push_front(std::move(s));
+                    return;
+                }
+            } else {
+                return;
+            }
+        }
+        s.fn();
+    }
+}
+
+void Connection::io_loop() {
+    constexpr int kMaxEvents = 8;
+    epoll_event events[kMaxEvents];
+    bool want_write = false;
+    while (running_.load()) {
+        drain_submits();
+        if (!flush_send()) {
+            fail_all(INTERNAL_ERROR);
+            return;
+        }
+        bool need_write = !sendq_.empty();
+        if (need_write != want_write) {
+            want_write = need_write;
+            epoll_event ev{};
+            ev.events = EPOLLIN | (want_write ? uint32_t(EPOLLOUT) : 0u);
+            ev.data.fd = fd_;
+            epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, fd_, &ev);
+        }
+        int n = epoll_wait(epoll_fd_, events, kMaxEvents, 200);
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            fail_all(INTERNAL_ERROR);
+            return;
+        }
+        for (int i = 0; i < n; ++i) {
+            int fd = events[i].data.fd;
+            if (fd == wake_fd_) {
+                uint64_t v;
+                ssize_t r = read(wake_fd_, &v, sizeof(v));
+                (void)r;
+                continue;
+            }
+            if (events[i].events & (EPOLLHUP | EPOLLERR)) {
+                fail_all(INTERNAL_ERROR);
+                return;
+            }
+            if (events[i].events & EPOLLIN) {
+                if (!handle_readable()) {
+                    fail_all(INTERNAL_ERROR);
+                    return;
+                }
+            }
+        }
+    }
+    // Graceful shutdown: fail anything still pending.
+    fail_all(INTERNAL_ERROR);
+}
+
+bool Connection::flush_send() {
+    while (!sendq_.empty()) {
+        OutMsg& m = sendq_.front();
+        iovec iov[64];
+        int niov = 0;
+        if (!m.meta_done) {
+            iov[niov].iov_base = m.meta.data() + m.off;
+            iov[niov].iov_len = m.meta.size() - m.off;
+            niov++;
+        }
+        for (size_t s = m.seg_idx; s < m.segs.size() && niov < 64; ++s) {
+            size_t skip = (s == m.seg_idx && m.meta_done) ? m.off : 0;
+            iov[niov].iov_base = const_cast<uint8_t*>(m.segs[s].first) + skip;
+            iov[niov].iov_len = m.segs[s].second - skip;
+            niov++;
+        }
+        ssize_t w = writev(fd_, iov, niov);
+        if (w < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+            return false;
+        }
+        size_t left = size_t(w);
+        if (!m.meta_done) {
+            size_t take = std::min(left, m.meta.size() - m.off);
+            m.off += take;
+            left -= take;
+            if (m.off == m.meta.size()) {
+                m.meta_done = true;
+                m.off = 0;
+            }
+        }
+        while (left > 0 && m.seg_idx < m.segs.size()) {
+            size_t take = std::min(left, m.segs[m.seg_idx].second - m.off);
+            m.off += take;
+            left -= take;
+            if (m.off == m.segs[m.seg_idx].second) {
+                m.seg_idx++;
+                m.off = 0;
+            }
+        }
+        if (m.meta_done && m.seg_idx == m.segs.size()) {
+            sendq_.pop_front();
+        } else if (w == 0) {
+            return true;
+        }
+    }
+    return true;
+}
+
+bool Connection::handle_readable() {
+    while (true) {
+        if (in_payload_) {
+            while (rpayload_left_ > 0) {
+                uint8_t* dst;
+                size_t room;
+                if (rseg_ < rscatter_.size()) {
+                    dst = rscatter_[rseg_].first + rseg_off_;
+                    room = rscatter_[rseg_].second - rseg_off_;
+                } else {
+                    dst = rdrain_.data();
+                    room = rdrain_.size();
+                }
+                if (room > rpayload_left_) room = size_t(rpayload_left_);
+                ssize_t r = recv(fd_, dst, room, 0);
+                if (r == 0) return false;
+                if (r < 0) {
+                    if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+                    return false;
+                }
+                rpayload_left_ -= uint64_t(r);
+                if (rseg_ < rscatter_.size()) {
+                    rseg_off_ += size_t(r);
+                    if (rseg_off_ == rscatter_[rseg_].second) {
+                        rseg_++;
+                        rseg_off_ = 0;
+                    }
+                }
+            }
+            in_payload_ = false;
+            // Payload complete → finish the response.
+            uint32_t status = INTERNAL_ERROR;
+            std::vector<uint8_t> rest;
+            if (rbody_.size() >= 4) {
+                BufReader br(rbody_.data(), rbody_.size());
+                status = br.u32();
+                rest.assign(rbody_.begin() + 4, rbody_.end());
+            }
+            complete(rseq_, status, std::move(rest));
+            rhdr_got_ = 0;
+            continue;
+        }
+        if (rhdr_got_ < sizeof(WireHeader)) {
+            ssize_t r = recv(fd_, reinterpret_cast<uint8_t*>(&rhdr_) + rhdr_got_,
+                             sizeof(WireHeader) - rhdr_got_, 0);
+            if (r == 0) return false;
+            if (r < 0) {
+                if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+                return false;
+            }
+            rhdr_got_ += size_t(r);
+            if (rhdr_got_ < sizeof(WireHeader)) continue;
+            if (!header_valid(rhdr_)) return false;
+            rbody_.resize(rhdr_.body_len);
+            rbody_got_ = 0;
+        }
+        if (rbody_got_ < rbody_.size()) {
+            ssize_t r = recv(fd_, rbody_.data() + rbody_got_,
+                             rbody_.size() - rbody_got_, 0);
+            if (r == 0) return false;
+            if (r < 0) {
+                if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+                return false;
+            }
+            rbody_got_ += size_t(r);
+            if (rbody_got_ < rbody_.size()) continue;
+        }
+        // Full header+body.
+        rseq_ = rhdr_.seq;
+        if (rhdr_.payload_len > 0) {
+            auto it = pending_.find(rseq_);
+            rscatter_ = it != pending_.end()
+                            ? it->second.scatter
+                            : std::vector<std::pair<uint8_t*, size_t>>{};
+            rpayload_left_ = rhdr_.payload_len;
+            rseg_ = 0;
+            rseg_off_ = 0;
+            in_payload_ = true;
+            continue;
+        }
+        BufReader br(rbody_.data(), rbody_.size());
+        uint32_t status = rbody_.size() >= 4 ? br.u32() : INTERNAL_ERROR;
+        std::vector<uint8_t> rest;
+        if (rbody_.size() > 4) rest.assign(rbody_.begin() + 4, rbody_.end());
+        complete(rseq_, status, std::move(rest));
+        rhdr_got_ = 0;
+    }
+}
+
+void Connection::complete(uint64_t seq, uint32_t status,
+                          std::vector<uint8_t> body) {
+    auto it = pending_.find(seq);
+    if (it == pending_.end()) return;
+    Pending p = std::move(it->second);
+    pending_.erase(it);
+    window_used_ -= p.payload_bytes;
+    if (p.done) p.done(status, std::move(body));
+}
+
+void Connection::fail_all(uint32_t status) {
+    broken_.store(true);
+    // Complete pendings.
+    std::vector<Pending> ps;
+    ps.reserve(pending_.size());
+    for (auto& [seq, p] : pending_) ps.push_back(std::move(p));
+    pending_.clear();
+    window_used_ = 0;
+    for (auto& p : ps) {
+        if (p.done) p.done(status, {});
+    }
+    // Fail queued submissions by running them — enqueue_msg sees broken_
+    // and completes them with INTERNAL_ERROR immediately.
+    while (true) {
+        Submit s;
+        {
+            std::lock_guard<std::mutex> lk(submit_mu_);
+            if (!overflow_.empty()) {
+                s = std::move(overflow_.front());
+                overflow_.pop_front();
+            } else if (!submits_.empty()) {
+                s = std::move(submits_.front());
+                submits_.pop_front();
+            } else {
+                break;
+            }
+        }
+        s.fn();
+    }
+    sync_cv_.notify_all();
+}
+
+}  // namespace istpu

@@ -1,0 +1,194 @@
+// client.h — native client connection (C6 in SURVEY.md §2).
+//
+// Parity target: reference src/libinfinistore.{h,cpp}: a `Connection`
+// owning a TCP control socket + RC queue pair, with a dedicated CQ thread
+// completing async ops (cq_handler, libinfinistore.cpp:285-430), an
+// inflight counter + condition variable behind `sync_rdma`
+// (libinfinistore.cpp:273-283, 10 s timeout), and write flow control
+// (signal every 32 WRs, max 4096 outstanding, overflow queued and drained
+// from the CQ thread, :898-987).
+//
+// TPU-native design: one IO thread per connection owns the socket and
+// multiplexes (a) a submission queue fed by callers through an eventfd and
+// (b) socket readiness. All ops — sync and async — flow through it, so the
+// socket has a single owner and responses complete in order. Async
+// completions run arbitrary std::function callbacks (the Python layer
+// bridges them onto asyncio loops exactly like the reference's
+// callback → loop.call_soon_threadsafe pattern, lib.py:427-437).
+//
+// Flow control: instead of verbs WR budgets, outstanding streamed-write
+// payload is capped at `window_bytes`; submissions past the cap wait in an
+// overflow queue drained as completions arrive (reference overflow queue:
+// libinfinistore.cpp:334-360).
+//
+// Data paths:
+//   - STREAM: gather payload straight from user buffers with writev
+//     (client-side zero copy), scatter READ payload straight into user
+//     buffers from the socket.
+//   - SHM: map the server's POSIX-shm pools (CUDA-IPC analogue); writes
+//     are one-sided memcpy + OP_COMMIT, reads are OP_PIN → memcpy →
+//     OP_RELEASE. Pool base pointers are exported so the Python/JAX layer
+//     can hand pool memory directly to the TPU runtime (device_put/get on
+//     a view — the nv_peer_mem zero-copy analogue).
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "protocol.h"
+
+namespace istpu {
+
+struct ClientConfig {
+    std::string host = "127.0.0.1";
+    uint16_t port = 22345;
+    bool use_shm = true;  // try the SHM path (falls back to STREAM)
+    uint64_t window_bytes = DEFAULT_WINDOW_BYTES;
+    int timeout_ms = 10000;  // reference sync timeout (10 s)
+};
+
+using DoneFn = std::function<void(uint32_t status, std::vector<uint8_t> body)>;
+
+class Connection {
+   public:
+    explicit Connection(const ClientConfig& cfg);
+    ~Connection();
+
+    // TCP connect + HELLO; maps shm pools when available. 0 on success.
+    int connect_server();
+    void close_conn();
+    bool shm_active() const { return shm_active_; }
+    uint32_t server_block_size() const { return server_block_size_; }
+
+    // --- generic async RPC (body only) ---
+    void rpc_async(uint8_t op, std::vector<uint8_t> body, DoneFn done);
+    // Sync helper: waits with the config timeout.
+    uint32_t rpc(uint8_t op, std::vector<uint8_t> body,
+                 std::vector<uint8_t>* resp_body);
+
+    // --- streamed write (STREAM path put) ---
+    // srcs[i] supplies block_size bytes for tokens[i]; buffers must stay
+    // valid until `done` fires. Queues behind the flow-control window.
+    void write_async(uint32_t block_size, std::vector<uint64_t> tokens,
+                     std::vector<const void*> srcs, DoneFn done);
+
+    // --- streamed read (STREAM path get, server-push) ---
+    void read_async(uint32_t block_size, std::vector<std::string> keys,
+                    std::vector<void*> dsts, DoneFn done);
+
+    // --- SHM path ---
+    // One-sided memcpy into mapped pool blocks + OP_COMMIT. Runs the copy
+    // on the IO thread so the async API never blocks the caller.
+    void shm_write_async(uint32_t block_size, std::vector<uint64_t> tokens,
+                         std::vector<RemoteBlock> blocks,
+                         std::vector<const void*> srcs, DoneFn done);
+    // OP_PIN → memcpy out → OP_RELEASE.
+    void shm_read_async(uint32_t block_size, std::vector<std::string> keys,
+                        std::vector<void*> dsts, DoneFn done);
+
+    // Pool mapping access for the zero-copy Python path.
+    size_t pool_count();
+    uint8_t* pool_base(uint32_t idx, size_t* size_out);
+    // Re-HELLO to pick up newly extended pools.
+    int refresh_pools();
+
+    // Wait until all async ops completed (reference sync_rdma/sync_local).
+    uint32_t sync(int timeout_ms);
+
+    uint64_t inflight() const { return inflight_.load(); }
+
+   private:
+    struct OutMsg {
+        std::vector<uint8_t> meta;
+        std::vector<std::pair<const uint8_t*, size_t>> segs;
+        size_t seg_idx = 0;
+        size_t off = 0;
+        bool meta_done = false;
+        uint64_t payload_bytes = 0;  // counted against the window
+    };
+
+    struct Pending {
+        uint8_t op = 0;
+        std::vector<std::pair<uint8_t*, size_t>> scatter;  // READ payload
+        DoneFn done;
+        uint64_t payload_bytes = 0;  // window credit released on completion
+    };
+
+    struct Submit {
+        // Runs on the IO thread; may enqueue OutMsg + Pending. Used for
+        // plain rpcs, streamed ops and shm copy jobs alike.
+        std::function<void()> fn;
+        uint64_t window_cost = 0;  // >0: hold until window has room
+    };
+
+    void io_loop();
+    void wake();
+    void drain_submits();
+    void enqueue_msg(uint8_t op, std::vector<uint8_t> body,
+                     std::vector<std::pair<const uint8_t*, size_t>> segs,
+                     Pending pending);
+    bool flush_send();
+    bool handle_readable();
+    void complete(uint64_t seq, uint32_t status, std::vector<uint8_t> body);
+    void fail_all(uint32_t status);
+    void finish_op();  // inflight--, cv notify
+    int map_pools_locked(BufReader& r);
+
+    ClientConfig cfg_;
+    int fd_ = -1;
+    int wake_fd_ = -1;
+    int epoll_fd_ = -1;
+    std::thread io_thread_;
+    std::atomic<bool> running_{false};
+    std::atomic<bool> broken_{false};
+
+    std::mutex submit_mu_;
+    std::deque<Submit> submits_;
+    std::deque<Submit> overflow_;  // waiting for window credit
+
+    // IO-thread-only state.
+    std::deque<OutMsg> sendq_;
+    std::unordered_map<uint64_t, Pending> pending_;
+    uint64_t next_seq_ = 1;
+    uint64_t window_used_ = 0;
+    // recv state machine
+    WireHeader rhdr_{};
+    size_t rhdr_got_ = 0;
+    std::vector<uint8_t> rbody_;
+    size_t rbody_got_ = 0;
+    uint64_t rpayload_left_ = 0;
+    size_t rseg_ = 0;
+    size_t rseg_off_ = 0;
+    std::vector<std::pair<uint8_t*, size_t>> rscatter_;
+    uint64_t rseq_ = 0;
+    std::vector<uint8_t> rdrain_;
+    bool in_payload_ = false;
+
+    // sync support
+    std::atomic<uint64_t> inflight_{0};
+    std::mutex sync_mu_;
+    std::condition_variable sync_cv_;
+
+    // shm pools
+    std::mutex pools_mu_;
+    struct PoolMap {
+        std::string name;
+        uint8_t* base = nullptr;
+        size_t size = 0;
+    };
+    std::vector<PoolMap> pools_;
+    bool shm_active_ = false;
+    uint32_t server_block_size_ = 0;
+};
+
+}  // namespace istpu

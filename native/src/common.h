@@ -1,0 +1,108 @@
+// common.h — shared types for the infinistore-tpu native core.
+//
+// Design notes (vs the reference, bd-iaas-us/infiniStore):
+//   The reference moves bulk data with one-sided ibverbs RDMA WRITE and
+//   CUDA-IPC + cudaMemcpyAsync (see /root/reference/src/protocol.h:12-18).
+//   On TPU hosts there is no ibverbs/nv_peer_mem stack; the equivalent
+//   native paths here are:
+//     - SHM path (same host): the server's memory pool lives in POSIX
+//       shared memory; clients map it and do one-sided memcpy, the
+//       analogue of CUDA-IPC one-sided access (reference
+//       src/infinistore.cpp:702-804).
+//     - STREAM path (cross host / DCN): length-prefixed framed messages
+//       over TCP with payload bytes scattered directly into pool blocks
+//       (the DCN stand-in for one-sided RDMA WRITE, reference
+//       src/libinfinistore.cpp:866-1003).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace istpu {
+
+// ---------------------------------------------------------------------------
+// Status codes. HTTP-flavoured like the reference (src/protocol.h:54-61).
+// ---------------------------------------------------------------------------
+enum Status : uint32_t {
+    OK = 200,
+    PARTIAL = 206,
+    BAD_REQUEST = 400,
+    KEY_NOT_FOUND = 404,
+    TIMEOUT_ERR = 408,
+    CONFLICT = 409,
+    UNCOMMITTED = 425,       // key exists but two-phase commit not finished
+    INTERNAL_ERROR = 500,
+    OUT_OF_MEMORY = 507,
+};
+
+// ---------------------------------------------------------------------------
+// Op codes (reference has 9 ops, src/protocol.h:39-47; we cover the same
+// surface plus PIN/RELEASE for the one-sided SHM read lease and
+// DELETE/STATS beyond parity).
+// ---------------------------------------------------------------------------
+enum Op : uint8_t {
+    OP_HELLO = 1,            // negotiate; returns pool table for SHM mapping
+    OP_ALLOCATE = 2,         // reserve uncommitted blocks for keys
+    OP_WRITE = 3,            // streamed put; commits on full receipt
+    OP_READ = 4,             // server-push get (payload in response)
+    OP_COMMIT = 5,           // commit blocks written one-sided via SHM
+    OP_PIN = 6,              // pin committed blocks + return offsets (SHM get)
+    OP_RELEASE = 7,          // release a pin lease
+    OP_CHECK_EXIST = 8,      // key present && committed
+    OP_GET_MATCH_LAST_IDX = 9,  // longest-prefix binary search
+    OP_SYNC = 10,            // barrier: acked once all prior ops applied
+    OP_PURGE = 11,           // drop all committed+uncommitted entries
+    OP_STATS = 12,           // JSON stats blob
+    OP_DELETE = 13,          // drop specific keys
+};
+
+// ---------------------------------------------------------------------------
+// Wire header. The reference uses a 9-byte packed {magic,op,body_size}
+// (src/protocol.h:67-71); we add a version byte, a sequence id for async
+// request/response matching (the analogue of wr_id in the reference's CQ
+// completions, src/libinfinistore.cpp:285-430), and a separate 64-bit
+// payload length so bulk bytes stream after the body without copies.
+// ---------------------------------------------------------------------------
+constexpr uint32_t MAGIC = 0x49535450;  // "ISTP"
+constexpr uint8_t WIRE_VERSION = 1;
+
+#pragma pack(push, 1)
+struct WireHeader {
+    uint32_t magic;
+    uint8_t version;
+    uint8_t op;
+    uint16_t flags;
+    uint64_t seq;        // echoed in the response
+    uint32_t body_len;   // serialized metadata length
+    uint64_t payload_len;  // bulk bytes following the body
+};
+#pragma pack(pop)
+static_assert(sizeof(WireHeader) == 28, "wire header must be packed");
+
+// Sizing knobs (reference: src/protocol.h:23-34, retuned for TCP/DCN).
+constexpr size_t MAX_BODY_LEN = 8u << 20;          // sanity cap on metadata
+constexpr size_t DEFAULT_WINDOW_BYTES = 64u << 20; // client inflight cap
+constexpr size_t SOCK_BUF_BYTES = 8u << 20;        // SO_SNDBUF/SO_RCVBUF hint
+constexpr uint32_t MAX_KEYS_PER_OP = 1u << 20;
+
+// Sentinel token for deduplicated (already present) keys; the client skips
+// writing payload for these. Reference: FAKE_REMOTE_BLOCK rkey/addr sentinel
+// (src/protocol.h:108-109, src/protocol.cpp:33-35).
+constexpr uint64_t FAKE_TOKEN = 0;
+
+// A block location the server hands out on allocate. `token` addresses the
+// uncommitted entry for WRITE/COMMIT; (pool_idx, offset) lets a same-host
+// client address the block inside the mapped shared-memory pool.
+#pragma pack(push, 1)
+struct RemoteBlock {
+    uint32_t status;
+    uint32_t pool_idx;
+    uint64_t token;
+    uint64_t offset;
+};
+#pragma pack(pop)
+static_assert(sizeof(RemoteBlock) == 24, "RemoteBlock must be packed");
+
+}  // namespace istpu

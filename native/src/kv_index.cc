@@ -1,0 +1,112 @@
+#include "kv_index.h"
+
+#include "log.h"
+
+namespace istpu {
+
+Status KVIndex::allocate(const std::string& key, uint32_t size,
+                         RemoteBlock* out) {
+    if (map_.count(key) > 0) {
+        out->status = CONFLICT;
+        out->pool_idx = 0;
+        out->token = FAKE_TOKEN;
+        out->offset = 0;
+        return CONFLICT;
+    }
+    PoolLoc loc;
+    if (!mm_->allocate(size, &loc)) {
+        out->status = OUT_OF_MEMORY;
+        out->pool_idx = 0;
+        out->token = FAKE_TOKEN;
+        out->offset = 0;
+        return OUT_OF_MEMORY;
+    }
+    auto block = std::make_shared<Block>(mm_, loc, size);
+    uint64_t token = next_token_++;
+    map_[key] = Entry{block, size, /*committed=*/false};
+    inflight_[token] = Inflight{key, block, size};
+    out->status = OK;
+    out->pool_idx = loc.pool_idx;
+    out->token = token;
+    out->offset = loc.offset;
+    return OK;
+}
+
+uint8_t* KVIndex::write_dest(uint64_t token, uint32_t* size_out) {
+    auto it = inflight_.find(token);
+    if (it == inflight_.end()) return nullptr;
+    *size_out = it->second.size;
+    return static_cast<uint8_t*>(it->second.block->loc.ptr);
+}
+
+Status KVIndex::commit(uint64_t token) {
+    auto it = inflight_.find(token);
+    if (it == inflight_.end()) return CONFLICT;
+    auto mit = map_.find(it->second.key);
+    Status rc = CONFLICT;
+    // Only commit if the map still holds the exact block this token
+    // allocated (a purge+reallocate between allocate and commit must not
+    // make someone else's bytes visible under this key).
+    if (mit != map_.end() && mit->second.block == it->second.block) {
+        mit->second.committed = true;
+        rc = OK;
+    }
+    inflight_.erase(it);
+    return rc;
+}
+
+void KVIndex::abort(uint64_t token) {
+    auto it = inflight_.find(token);
+    if (it == inflight_.end()) return;
+    auto mit = map_.find(it->second.key);
+    if (mit != map_.end() && mit->second.block == it->second.block &&
+        !mit->second.committed) {
+        map_.erase(mit);
+    }
+    inflight_.erase(it);
+}
+
+const Entry* KVIndex::get_committed(const std::string& key) const {
+    auto it = map_.find(key);
+    if (it == map_.end() || !it->second.committed) return nullptr;
+    return &it->second;
+}
+
+bool KVIndex::check_exist(const std::string& key) const {
+    return get_committed(key) != nullptr;
+}
+
+int KVIndex::match_last_index(const std::vector<std::string>& keys) const {
+    int left = 0, right = int(keys.size());
+    while (left < right) {
+        int mid = left + (right - left) / 2;
+        if (map_.count(keys[size_t(mid)]) > 0) {
+            left = mid + 1;
+        } else {
+            right = mid;
+        }
+    }
+    return left - 1;
+}
+
+uint64_t KVIndex::pin(std::vector<BlockRef> blocks) {
+    uint64_t id = next_lease_++;
+    leases_[id] = std::move(blocks);
+    return id;
+}
+
+bool KVIndex::release(uint64_t lease_id) { return leases_.erase(lease_id) > 0; }
+
+size_t KVIndex::purge() {
+    size_t n = map_.size();
+    map_.clear();
+    return n;
+}
+
+size_t KVIndex::erase(const std::vector<std::string>& keys) {
+    size_t n = 0;
+    for (auto& k : keys) n += map_.erase(k);
+    return n;
+}
+
+}  // namespace istpu

@@ -1,0 +1,114 @@
+// kv_index.h — content-keyed block index with two-phase visibility.
+//
+// Parity target: reference kv_map machinery (src/infinistore.h:30-46 and
+// usage throughout src/infinistore.cpp):
+//   - kv_map: unordered_map<string, intrusive_ptr<PTR>> where PTR frees its
+//     pool block on last deref (infinistore.h:38-43) — here Block +
+//     shared_ptr with the pool deallocation in ~Block.
+//   - two-phase visibility via the `committed` flag: allocate creates an
+//     uncommitted entry; readers/check_exist only see committed entries
+//     (infinistore.cpp:436-454, :1077-1090); get_match_last_index counts
+//     uncommitted entries too (quirk preserved, :1092-1108).
+//   - first-writer-wins dedup: allocating an existing key (committed OR
+//     inflight) yields a FAKE sentinel the client skips
+//     (infinistore.cpp:353-359, :740-746).
+//   - inflight tracking: the reference keys inflight writes by remote addr
+//     (infinistore.cpp:63); we hand out opaque u64 tokens instead, each
+//     pinning its Block so a purge mid-write can never free memory that a
+//     write is landing in.
+//   - pins: during server-push reads the reference carries
+//     vector<intrusive_ptr<PTR>> in the verbs wr_id to keep blocks alive
+//     (infinistore.cpp:432,492,320-324). Here the send queue holds
+//     BlockRefs; for one-sided SHM reads clients take an explicit pin
+//     lease (OP_PIN/OP_RELEASE) — a primitive the reference's CUDA-IPC
+//     path performs implicitly inside the server.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "mempool.h"
+
+namespace istpu {
+
+// RAII pool block: deallocates on last reference drop.
+struct Block {
+    Block(MM* mm, const PoolLoc& loc, size_t size)
+        : mm(mm), loc(loc), size(size) {}
+    ~Block() { mm->deallocate(loc, size); }
+    Block(const Block&) = delete;
+    Block& operator=(const Block&) = delete;
+
+    MM* mm;
+    PoolLoc loc;
+    size_t size;
+};
+using BlockRef = std::shared_ptr<Block>;
+
+struct Entry {
+    BlockRef block;
+    uint32_t size = 0;
+    bool committed = false;
+};
+
+// Not thread-safe by itself; the owner (Server) serializes access.
+class KVIndex {
+   public:
+    explicit KVIndex(MM* mm) : mm_(mm) {}
+
+    // Reserve an uncommitted block for `key`. Returns:
+    //   OK        — new block; out filled, token registered
+    //   CONFLICT  — key already present (committed or inflight): dedup, the
+    //               caller should emit FAKE_TOKEN
+    //   OUT_OF_MEMORY — pool exhausted
+    Status allocate(const std::string& key, uint32_t size, RemoteBlock* out);
+
+    // Destination for an inflight token's payload (OP_WRITE scatter).
+    // Returns nullptr if the token is unknown.
+    uint8_t* write_dest(uint64_t token, uint32_t* size_out);
+
+    // Second phase: make the entry visible. OK, or CONFLICT if the entry
+    // was purged/replaced since allocation (write is discarded safely).
+    Status commit(uint64_t token);
+    // Abort an inflight allocation (client died mid-write).
+    void abort(uint64_t token);
+
+    // Committed lookup for reads. nullptr if missing or uncommitted.
+    const Entry* get_committed(const std::string& key) const;
+    bool check_exist(const std::string& key) const;  // exists && committed
+
+    // Reference algorithm verbatim in behavior (infinistore.cpp:1092-1108):
+    // binary search assuming presence is monotone over the key list
+    // (vLLM prefix pages); does NOT check committed.
+    int match_last_index(const std::vector<std::string>& keys) const;
+
+    // Pin committed blocks for one-sided SHM reads; returns lease id.
+    uint64_t pin(std::vector<BlockRef> blocks);
+    bool release(uint64_t lease_id);
+
+    size_t purge();  // drops all entries; inflight tokens survive harmlessly
+    size_t erase(const std::vector<std::string>& keys);
+    size_t size() const { return map_.size(); }
+    size_t inflight() const { return inflight_.size(); }
+    size_t leases() const { return leases_.size(); }
+
+   private:
+    struct Inflight {
+        std::string key;
+        BlockRef block;
+        uint32_t size;
+    };
+
+    MM* mm_;
+    std::unordered_map<std::string, Entry> map_;
+    std::unordered_map<uint64_t, Inflight> inflight_;
+    std::unordered_map<uint64_t, std::vector<BlockRef>> leases_;
+    uint64_t next_token_ = 1;  // 0 is FAKE_TOKEN
+    uint64_t next_lease_ = 1;
+};
+
+}  // namespace istpu

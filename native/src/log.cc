@@ -1,0 +1,60 @@
+#include "log.h"
+
+#include <atomic>
+#include <cstring>
+#include <ctime>
+#include <mutex>
+
+namespace istpu {
+
+static std::atomic<int> g_level{LOG_INFO};
+static std::mutex g_mu;
+
+void set_log_level(int level) { g_level.store(level); }
+int get_log_level() { return g_level.load(); }
+
+static const char* level_name(int level) {
+    switch (level) {
+        case LOG_DEBUG: return "debug";
+        case LOG_INFO: return "info";
+        case LOG_WARN: return "warn";
+        case LOG_ERROR: return "error";
+        default: return "?";
+    }
+}
+
+static void emit(int level, const char* file, int line, const char* msg) {
+    if (level < g_level.load()) return;
+    char ts[32];
+    struct timespec now;
+    clock_gettime(CLOCK_REALTIME, &now);
+    struct tm tmv;
+    localtime_r(&now.tv_sec, &tmv);
+    strftime(ts, sizeof(ts), "%H:%M:%S", &tmv);
+    // file:line only on warn/error, matching the reference's formatter split
+    // (src/log.cpp:5-18).
+    std::lock_guard<std::mutex> lk(g_mu);
+    if (level >= LOG_WARN && file != nullptr) {
+        const char* base = strrchr(file, '/');
+        fprintf(stderr, "[%s.%03ld] [istpu] [%s] [%s:%d] %s\n", ts,
+                now.tv_nsec / 1000000, level_name(level),
+                base ? base + 1 : file, line, msg);
+    } else {
+        fprintf(stderr, "[%s.%03ld] [istpu] [%s] %s\n", ts,
+                now.tv_nsec / 1000000, level_name(level), msg);
+    }
+}
+
+void log_msg(int level, const char* msg) { emit(level, nullptr, 0, msg); }
+
+void log_at(int level, const char* file, int line, const char* fmt, ...) {
+    if (level < g_level.load()) return;
+    char buf[1024];
+    va_list ap;
+    va_start(ap, fmt);
+    vsnprintf(buf, sizeof(buf), fmt, ap);
+    va_end(ap);
+    emit(level, file, line, buf);
+}
+
+}  // namespace istpu

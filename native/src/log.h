@@ -1,0 +1,33 @@
+// log.h — leveled console logger for the native core.
+// Parity target: reference src/log.{h,cpp} (spdlog singleton "infini" with
+// runtime level + file:line on warn/error). We avoid the spdlog dependency
+// and implement the same surface directly.
+#pragma once
+
+#include <cstdarg>
+#include <cstdio>
+
+namespace istpu {
+
+enum LogLevel : int {
+    LOG_DEBUG = 0,
+    LOG_INFO = 1,
+    LOG_WARN = 2,
+    LOG_ERROR = 3,
+    LOG_OFF = 4,
+};
+
+void set_log_level(int level);
+int get_log_level();
+// Bridge for Python-side logging so both languages share one sink
+// (reference: log_msg, src/log.cpp:20-33).
+void log_msg(int level, const char* msg);
+void log_at(int level, const char* file, int line, const char* fmt, ...)
+    __attribute__((format(printf, 4, 5)));
+
+#define IST_DEBUG(...) ::istpu::log_at(::istpu::LOG_DEBUG, __FILE__, __LINE__, __VA_ARGS__)
+#define IST_INFO(...) ::istpu::log_at(::istpu::LOG_INFO, __FILE__, __LINE__, __VA_ARGS__)
+#define IST_WARN(...) ::istpu::log_at(::istpu::LOG_WARN, __FILE__, __LINE__, __VA_ARGS__)
+#define IST_ERROR(...) ::istpu::log_at(::istpu::LOG_ERROR, __FILE__, __LINE__, __VA_ARGS__)
+
+}  // namespace istpu

@@ -1,0 +1,225 @@
+#include "mempool.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdlib>
+#include <stdexcept>
+
+#include "log.h"
+
+namespace istpu {
+
+MemoryPool::MemoryPool(size_t pool_size, size_t block_size,
+                       const std::string& shm_name)
+    : block_size_(block_size), shm_name_(shm_name) {
+    if (block_size == 0 || (block_size & (block_size - 1)) != 0) {
+        throw std::invalid_argument("block_size must be a power of two");
+    }
+    total_blocks_ = (pool_size + block_size - 1) / block_size;
+    if (total_blocks_ == 0) total_blocks_ = 1;
+    pool_size_ = total_blocks_ * block_size;
+    bitmap_.assign((total_blocks_ + 63) / 64, 0);
+
+    if (!shm_name_.empty()) {
+        std::string path = "/" + shm_name_;
+        shm_fd_ = shm_open(path.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+        if (shm_fd_ < 0) {
+            // Stale object from a crashed server: replace it.
+            shm_unlink(path.c_str());
+            shm_fd_ = shm_open(path.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+        }
+        if (shm_fd_ < 0) throw std::runtime_error("shm_open failed: " + path);
+        if (ftruncate(shm_fd_, (off_t)pool_size_) != 0) {
+            close(shm_fd_);
+            shm_unlink(path.c_str());
+            throw std::runtime_error("ftruncate failed for pool " + path);
+        }
+        void* mem = mmap(nullptr, pool_size_, PROT_READ | PROT_WRITE,
+                         MAP_SHARED, shm_fd_, 0);
+        if (mem == MAP_FAILED) {
+            close(shm_fd_);
+            shm_unlink(path.c_str());
+            throw std::runtime_error("mmap failed for pool " + path);
+        }
+        base_ = static_cast<uint8_t*>(mem);
+    } else {
+        void* mem = mmap(nullptr, pool_size_, PROT_READ | PROT_WRITE,
+                         MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+        if (mem == MAP_FAILED) throw std::runtime_error("anonymous mmap failed");
+        base_ = static_cast<uint8_t*>(mem);
+    }
+    // Pinning analogue of cudaHostRegister (reference mempool.cpp:29-45):
+    // best-effort, RLIMIT_MEMLOCK may forbid it.
+    if (mlock(base_, pool_size_) != 0) {
+        IST_DEBUG("mlock of %zu bytes declined (continuing unpinned)", pool_size_);
+    }
+    IST_INFO("pool ready: %zu MB, block %zu KB, shm=%s", pool_size_ >> 20,
+             block_size_ >> 10, shm_name_.empty() ? "<anon>" : shm_name_.c_str());
+}
+
+MemoryPool::~MemoryPool() {
+    if (base_) munmap(base_, pool_size_);
+    if (shm_fd_ >= 0) {
+        close(shm_fd_);
+        shm_unlink(("/" + shm_name_).c_str());
+    }
+}
+
+void MemoryPool::set_range(size_t start, size_t count, bool value) {
+    for (size_t i = start; i < start + count; ++i) {
+        if (value) {
+            bitmap_[i >> 6] |= (1ull << (i & 63));
+        } else {
+            bitmap_[i >> 6] &= ~(1ull << (i & 63));
+        }
+    }
+}
+
+size_t MemoryPool::find_first_fit(size_t count) const {
+    if (count > total_blocks_) return SIZE_MAX;
+    // Two passes: from the rolling hint to the end, then from 0. The hint
+    // keeps scans O(1) amortized for the allocate-heavy steady state.
+    for (int pass = 0; pass < 2; ++pass) {
+        size_t begin = pass == 0 ? search_hint_ : 0;
+        size_t end = pass == 0 ? total_blocks_ : search_hint_ + count;
+        if (end > total_blocks_) end = total_blocks_;
+        size_t run = 0;
+        for (size_t i = begin; i < end; ++i) {
+            if ((i & 63) == 0 && run == 0 && bitmap_[i >> 6] == ~0ull) {
+                i += 63;  // word fully used, skip
+                continue;
+            }
+            if (!bit(i)) {
+                if (++run == count) return i + 1 - count;
+            } else {
+                run = 0;
+            }
+        }
+    }
+    return SIZE_MAX;
+}
+
+void* MemoryPool::allocate(size_t size) {
+    if (size == 0) return nullptr;
+    size_t count = (size + block_size_ - 1) / block_size_;
+    size_t start = find_first_fit(count);
+    if (start == SIZE_MAX) return nullptr;
+    set_range(start, count, true);
+    used_blocks_ += count;
+    search_hint_ = start + count;
+    if (search_hint_ >= total_blocks_) search_hint_ = 0;
+    return base_ + start * block_size_;
+}
+
+bool MemoryPool::deallocate(void* ptr, size_t size) {
+    auto* p = static_cast<uint8_t*>(ptr);
+    if (p < base_ || p >= base_ + pool_size_) {
+        IST_ERROR("deallocate: pointer outside pool");
+        return false;
+    }
+    size_t byte_off = size_t(p - base_);
+    if (byte_off % block_size_ != 0) {
+        IST_ERROR("deallocate: pointer not block-aligned");
+        return false;
+    }
+    size_t start = byte_off / block_size_;
+    size_t count = (size + block_size_ - 1) / block_size_;
+    if (start + count > total_blocks_) {
+        IST_ERROR("deallocate: range exceeds pool");
+        return false;
+    }
+    // Double-free detection (reference mempool.cpp:139-148).
+    for (size_t i = start; i < start + count; ++i) {
+        if (!bit(i)) {
+            IST_ERROR("deallocate: double free at block %zu", i);
+            return false;
+        }
+    }
+    set_range(start, count, false);
+    used_blocks_ -= count;
+    search_hint_ = start;
+    return true;
+}
+
+MM::MM(size_t initial_size, size_t block_size, const std::string& shm_prefix,
+       bool auto_extend, size_t extend_size)
+    : block_size_(block_size),
+      shm_prefix_(shm_prefix),
+      auto_extend_(auto_extend),
+      extend_size_(extend_size ? extend_size : initial_size) {
+    std::string name =
+        shm_prefix_.empty() ? std::string() : shm_prefix_ + "_0";
+    pools_.emplace_back(
+        std::make_unique<MemoryPool>(initial_size, block_size_, name));
+}
+
+bool MM::allocate(size_t size, PoolLoc* out) {
+    for (uint32_t i = 0; i < pools_.size(); ++i) {
+        void* p = pools_[i]->allocate(size);
+        if (p != nullptr) {
+            out->ptr = p;
+            out->pool_idx = i;
+            out->offset = uint64_t(static_cast<uint8_t*>(p) - pools_[i]->base());
+            return true;
+        }
+    }
+    if (auto_extend_) {
+        // Nothing fit anywhere: force a new pool (at least large enough for
+        // this request) regardless of the usage threshold.
+        size_t want = extend_size_ > size ? extend_size_ : size;
+        if (!add_pool(want)) return false;
+        uint32_t i = uint32_t(pools_.size() - 1);
+        void* p = pools_[i]->allocate(size);
+        if (p != nullptr) {
+            out->ptr = p;
+            out->pool_idx = i;
+            out->offset = uint64_t(static_cast<uint8_t*>(p) - pools_[i]->base());
+            return true;
+        }
+    }
+    return false;
+}
+
+bool MM::add_pool(size_t size) {
+    std::string name = shm_prefix_.empty()
+                           ? std::string()
+                           : shm_prefix_ + "_" + std::to_string(pools_.size());
+    try {
+        pools_.emplace_back(
+            std::make_unique<MemoryPool>(size, block_size_, name));
+        IST_INFO("extended to %zu pools (%zu MB total)", pools_.size(),
+                 total_bytes() >> 20);
+        return true;
+    } catch (const std::exception& e) {
+        IST_WARN("pool extension failed: %s", e.what());
+        return false;
+    }
+}
+
+bool MM::deallocate(const PoolLoc& loc, size_t size) {
+    if (loc.pool_idx >= pools_.size()) return false;
+    return pools_[loc.pool_idx]->deallocate(loc.ptr, size);
+}
+
+void MM::maybe_extend() {
+    if (!auto_extend_) return;
+    if (pools_.back()->usage() <= kExtendThreshold) return;
+    add_pool(extend_size_);
+}
+
+size_t MM::total_bytes() const {
+    size_t n = 0;
+    for (auto& p : pools_) n += p->pool_size();
+    return n;
+}
+
+size_t MM::used_bytes() const {
+    size_t n = 0;
+    for (auto& p : pools_) n += p->used_blocks() * p->block_size();
+    return n;
+}
+
+}  // namespace istpu

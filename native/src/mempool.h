@@ -1,0 +1,115 @@
+// mempool.h — shared-memory block arena for the KV store.
+//
+// Parity target: reference src/mempool.{h,cpp} — a bitmap first-fit
+// allocator over one huge pinned arena, wrapped by a multi-pool `MM` that
+// auto-extends when the last pool passes 50% usage (mempool.h:13,
+// mempool.cpp:178-181), with double-free detection (mempool.cpp:139-148).
+//
+// TPU-native difference: the reference pins the arena with
+// cudaHostRegister + ibv_reg_mr so GPUs and NICs can DMA into it
+// (mempool.cpp:29-45). On a TPU host the consumers are (a) same-host
+// clients doing one-sided memcpy and (b) the DCN TCP path, so the arena is
+// a POSIX shared-memory object (shm_open + mmap) that any local client —
+// including the JAX host runtime staging TPU HBM transfers — can map
+// directly. `mlock` is attempted (best-effort) as the pinning analogue.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace istpu {
+
+class MemoryPool {
+   public:
+    // pool_size is rounded up to a multiple of block_size. If shm_name is
+    // non-empty the arena is a POSIX shm object with that name (without
+    // leading '/'); otherwise anonymous private memory (unit tests).
+    MemoryPool(size_t pool_size, size_t block_size, const std::string& shm_name);
+    ~MemoryPool();
+
+    MemoryPool(const MemoryPool&) = delete;
+    MemoryPool& operator=(const MemoryPool&) = delete;
+
+    // First-fit contiguous allocation of ceil(size/block_size) blocks.
+    // Returns nullptr if no contiguous run fits (reference
+    // mempool.cpp:57-114).
+    void* allocate(size_t size);
+    // Frees a previously allocated range; aborts the call (returns false)
+    // on double-free or unaligned pointer (reference mempool.cpp:116-150).
+    bool deallocate(void* ptr, size_t size);
+
+    bool contains(const void* ptr) const {
+        return ptr >= base_ && ptr < base_ + pool_size_;
+    }
+    uint8_t* base() const { return base_; }
+    size_t pool_size() const { return pool_size_; }
+    size_t block_size() const { return block_size_; }
+    size_t total_blocks() const { return total_blocks_; }
+    size_t used_blocks() const { return used_blocks_; }
+    double usage() const {
+        return total_blocks_ ? double(used_blocks_) / double(total_blocks_) : 0.0;
+    }
+    const std::string& shm_name() const { return shm_name_; }
+
+   private:
+    bool bit(size_t idx) const {
+        return bitmap_[idx >> 6] & (1ull << (idx & 63));
+    }
+    void set_range(size_t start, size_t count, bool value);
+    size_t find_first_fit(size_t count) const;
+
+    uint8_t* base_ = nullptr;
+    size_t pool_size_ = 0;
+    size_t block_size_ = 0;
+    size_t total_blocks_ = 0;
+    size_t used_blocks_ = 0;
+    size_t search_hint_ = 0;  // rolling start for first-fit scan
+    std::string shm_name_;
+    int shm_fd_ = -1;
+    std::vector<uint64_t> bitmap_;
+};
+
+// Location of an allocation inside the multi-pool (what crosses the wire as
+// RemoteBlock{pool_idx, offset}).
+struct PoolLoc {
+    void* ptr = nullptr;
+    uint32_t pool_idx = 0;
+    uint64_t offset = 0;
+};
+
+// Multi-pool manager (reference `MM`, mempool.cpp:152-188): allocations go
+// to the first pool with room; when the newest pool crosses
+// `extend_threshold` usage another pool of `extend_size` is appended.
+class MM {
+   public:
+    // shm_prefix empty => anonymous pools (tests). Otherwise pools are shm
+    // objects "<prefix>_<idx>".
+    MM(size_t initial_size, size_t block_size, const std::string& shm_prefix,
+       bool auto_extend, size_t extend_size);
+
+    bool allocate(size_t size, PoolLoc* out);
+    bool deallocate(const PoolLoc& loc, size_t size);
+    // Maybe append a pool; called after allocations (cheap no-op usually).
+    void maybe_extend();
+
+    size_t num_pools() const { return pools_.size(); }
+    const MemoryPool& pool(size_t i) const { return *pools_[i]; }
+    size_t total_bytes() const;
+    size_t used_bytes() const;
+    size_t block_size() const { return block_size_; }
+
+    static constexpr double kExtendThreshold = 0.5;  // mempool.h:13
+
+   private:
+    bool add_pool(size_t size);
+    size_t block_size_;
+    std::string shm_prefix_;
+    bool auto_extend_;
+    size_t extend_size_;
+    std::vector<std::unique_ptr<MemoryPool>> pools_;
+};
+
+}  // namespace istpu

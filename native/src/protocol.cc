@@ -1,0 +1,29 @@
+#include "protocol.h"
+
+namespace istpu {
+
+bool header_valid(const WireHeader& h) {
+    return h.magic == MAGIC && h.version == WIRE_VERSION &&
+           h.body_len <= MAX_BODY_LEN;
+}
+
+const char* op_name(uint8_t op) {
+    switch (op) {
+        case OP_HELLO: return "HELLO";
+        case OP_ALLOCATE: return "ALLOCATE";
+        case OP_WRITE: return "WRITE";
+        case OP_READ: return "READ";
+        case OP_COMMIT: return "COMMIT";
+        case OP_PIN: return "PIN";
+        case OP_RELEASE: return "RELEASE";
+        case OP_CHECK_EXIST: return "CHECK_EXIST";
+        case OP_GET_MATCH_LAST_IDX: return "GET_MATCH_LAST_IDX";
+        case OP_SYNC: return "SYNC";
+        case OP_PURGE: return "PURGE";
+        case OP_STATS: return "STATS";
+        case OP_DELETE: return "DELETE";
+        default: return "UNKNOWN";
+    }
+}
+
+}  // namespace istpu

@@ -1,0 +1,130 @@
+// protocol.h — framing + serialization for the control/data wire.
+//
+// Parity target: reference src/protocol.{h,cpp} + 4 FlatBuffers schemas
+// (meta_request.fbs, allocate_response.fbs, local_meta_request.fbs,
+// get_match_last_index.fbs). We use a hand-rolled little-endian format
+// instead of FlatBuffers: every message is WireHeader + bounds-checked
+// body, with bulk payload streamed after the body (never serialized).
+// This plays the role of the reference's FixedBufferAllocator
+// (protocol.h:95-106): metadata is small and built into a reusable
+// buffer; payload bytes go straight between socket and pool blocks.
+//
+// Body conventions:
+//   - all integers little-endian (x86/ARM hosts; TPU hosts are LE)
+//   - strings/keys: u32 length + raw bytes
+//   - every RESPONSE body begins with u32 status (Status enum)
+#pragma once
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace istpu {
+
+// Bounds-checked sequential writer over a growable buffer.
+class BufWriter {
+   public:
+    explicit BufWriter(std::vector<uint8_t>& buf) : buf_(buf) { buf_.clear(); }
+
+    void u8(uint8_t v) { raw(&v, 1); }
+    void u32(uint32_t v) { raw(&v, 4); }
+    void u64(uint64_t v) { raw(&v, 8); }
+    void i32(int32_t v) { raw(&v, 4); }
+    void str(const std::string& s) {
+        u32(uint32_t(s.size()));
+        raw(s.data(), s.size());
+    }
+    void bytes(const void* p, size_t n) { raw(p, n); }
+    void keys(const std::vector<std::string>& ks) {
+        u32(uint32_t(ks.size()));
+        for (auto& k : ks) str(k);
+    }
+    size_t size() const { return buf_.size(); }
+
+   private:
+    void raw(const void* p, size_t n) {
+        size_t off = buf_.size();
+        buf_.resize(off + n);
+        memcpy(buf_.data() + off, p, n);
+    }
+    std::vector<uint8_t>& buf_;
+};
+
+// Bounds-checked sequential reader; any overrun latches `ok() == false`
+// and subsequent reads return zeros (callers check once at the end).
+class BufReader {
+   public:
+    BufReader(const uint8_t* data, size_t len) : p_(data), end_(data + len) {}
+
+    uint8_t u8() { return rd<uint8_t>(); }
+    uint32_t u32() { return rd<uint32_t>(); }
+    uint64_t u64() { return rd<uint64_t>(); }
+    int32_t i32() { return rd<int32_t>(); }
+    std::string str() {
+        uint32_t n = u32();
+        if (!check(n)) return {};
+        std::string s(reinterpret_cast<const char*>(p_), n);
+        p_ += n;
+        return s;
+    }
+    bool keys(std::vector<std::string>* out, uint32_t max = MAX_KEYS_PER_OP) {
+        uint32_t n = u32();
+        if (n > max) {
+            ok_ = false;
+            return false;
+        }
+        out->reserve(n);
+        for (uint32_t i = 0; i < n && ok_; ++i) out->push_back(str());
+        return ok_;
+    }
+    const uint8_t* raw(size_t n) {
+        if (!check(n)) return nullptr;
+        const uint8_t* r = p_;
+        p_ += n;
+        return r;
+    }
+    bool ok() const { return ok_; }
+    size_t remaining() const { return size_t(end_ - p_); }
+
+   private:
+    template <typename T>
+    T rd() {
+        if (!check(sizeof(T))) return T{};
+        T v;
+        memcpy(&v, p_, sizeof(T));
+        p_ += sizeof(T);
+        return v;
+    }
+    bool check(size_t n) {
+        if (size_t(end_ - p_) < n) {
+            ok_ = false;
+            return false;
+        }
+        return true;
+    }
+    const uint8_t* p_;
+    const uint8_t* end_;
+    bool ok_ = true;
+};
+
+inline WireHeader make_header(uint8_t op, uint64_t seq, uint32_t body_len,
+                              uint64_t payload_len) {
+    WireHeader h;
+    h.magic = MAGIC;
+    h.version = WIRE_VERSION;
+    h.op = op;
+    h.flags = 0;
+    h.seq = seq;
+    h.body_len = body_len;
+    h.payload_len = payload_len;
+    return h;
+}
+
+// Validates magic/version and sanity-caps body length.
+bool header_valid(const WireHeader& h);
+
+const char* op_name(uint8_t op);
+
+}  // namespace istpu

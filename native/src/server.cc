@@ -1,0 +1,727 @@
+#include "server.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <cstring>
+
+#include "log.h"
+
+namespace istpu {
+
+namespace {
+
+void set_nonblock(int fd) {
+    int fl = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+void tune_socket(int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    int buf = int(SOCK_BUF_BYTES);
+    setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+    setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+}
+
+}  // namespace
+
+Server::Server(const ServerConfig& cfg) : cfg_(cfg) {
+    if (cfg_.shm_prefix.empty() && cfg_.enable_shm) {
+        cfg_.shm_prefix = "istpu_" + std::to_string(getpid()) + "_" +
+                          std::to_string(cfg_.port);
+    }
+}
+
+Server::~Server() { stop(); }
+
+bool Server::start() {
+    // Pool construction first — this is the slow, once-per-process part
+    // (reference: MemoryPool ctor malloc+pin+ibv_reg_mr, mempool.cpp:13-46).
+    try {
+        mm_ = std::make_unique<MM>(cfg_.prealloc_bytes, cfg_.block_size,
+                                   cfg_.enable_shm ? cfg_.shm_prefix : "",
+                                   cfg_.auto_extend, cfg_.extend_bytes);
+    } catch (const std::exception& e) {
+        IST_ERROR("pool init failed: %s", e.what());
+        return false;
+    }
+    index_ = std::make_unique<KVIndex>(mm_.get());
+
+    listen_fd_ = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(cfg_.port);
+    if (inet_pton(AF_INET, cfg_.host.c_str(), &addr.sin_addr) != 1) {
+        addr.sin_addr.s_addr = INADDR_ANY;
+    }
+    if (bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) != 0) {
+        IST_ERROR("bind %s:%u failed: %s", cfg_.host.c_str(), cfg_.port,
+                  strerror(errno));
+        close(listen_fd_);
+        listen_fd_ = -1;
+        return false;
+    }
+    socklen_t alen = sizeof(addr);
+    getsockname(listen_fd_, (sockaddr*)&addr, &alen);
+    bound_port_ = ntohs(addr.sin_port);
+    if (listen(listen_fd_, 128) != 0) {
+        close(listen_fd_);
+        listen_fd_ = -1;
+        return false;
+    }
+    set_nonblock(listen_fd_);
+
+    epoll_fd_ = epoll_create1(EPOLL_CLOEXEC);
+    wake_fd_ = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = listen_fd_;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, listen_fd_, &ev);
+    ev.data.fd = wake_fd_;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, wake_fd_, &ev);
+
+    running_.store(true);
+    thread_ = std::thread([this] { loop(); });
+    IST_INFO("server listening on %s:%u (pool %llu MB, block %llu KB, shm=%s)",
+             cfg_.host.c_str(), bound_port_,
+             (unsigned long long)(cfg_.prealloc_bytes >> 20),
+             (unsigned long long)(cfg_.block_size >> 10),
+             cfg_.enable_shm ? cfg_.shm_prefix.c_str() : "off");
+    return true;
+}
+
+void Server::stop() {
+    if (!running_.exchange(false)) return;
+    uint64_t one = 1;
+    ssize_t n = write(wake_fd_, &one, sizeof(one));
+    (void)n;
+    if (thread_.joinable()) thread_.join();
+    for (auto& [fd, c] : conns_) close(fd);
+    conns_.clear();
+    if (listen_fd_ >= 0) close(listen_fd_);
+    if (epoll_fd_ >= 0) close(epoll_fd_);
+    if (wake_fd_ >= 0) close(wake_fd_);
+    listen_fd_ = epoll_fd_ = wake_fd_ = -1;
+    index_.reset();
+    mm_.reset();
+}
+
+size_t Server::kvmap_len() {
+    std::lock_guard<std::mutex> lk(store_mu_);
+    return index_ ? index_->size() : 0;
+}
+
+size_t Server::purge() {
+    std::lock_guard<std::mutex> lk(store_mu_);
+    return index_ ? index_->purge() : 0;
+}
+
+std::string Server::stats_json() {
+    std::lock_guard<std::mutex> lk(store_mu_);
+    char buf[512];
+    snprintf(buf, sizeof(buf),
+             "{\"kvmap_len\": %zu, \"inflight\": %zu, \"leases\": %zu, "
+             "\"pools\": %zu, \"pool_bytes\": %zu, \"used_bytes\": %zu, "
+             "\"ops\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu, "
+             "\"connections\": %zu}",
+             index_ ? index_->size() : 0, index_ ? index_->inflight() : 0,
+             index_ ? index_->leases() : 0, mm_ ? mm_->num_pools() : 0,
+             mm_ ? mm_->total_bytes() : 0, mm_ ? mm_->used_bytes() : 0,
+             (unsigned long long)ops_.load(),
+             (unsigned long long)bytes_in_.load(),
+             (unsigned long long)bytes_out_.load(), size_t(n_conns_.load()));
+    return buf;
+}
+
+void Server::loop() {
+    constexpr int kMaxEvents = 64;
+    epoll_event events[kMaxEvents];
+    while (running_.load()) {
+        int n = epoll_wait(epoll_fd_, events, kMaxEvents, 500);
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            IST_ERROR("epoll_wait: %s", strerror(errno));
+            break;
+        }
+        for (int i = 0; i < n; ++i) {
+            int fd = events[i].data.fd;
+            uint32_t evs = events[i].events;
+            if (fd == wake_fd_) {
+                uint64_t v;
+                ssize_t r = read(wake_fd_, &v, sizeof(v));
+                (void)r;
+                continue;
+            }
+            if (fd == listen_fd_) {
+                accept_ready();
+                continue;
+            }
+            auto it = conns_.find(fd);
+            if (it == conns_.end()) continue;
+            Conn& c = *it->second;
+            if (evs & (EPOLLHUP | EPOLLERR)) {
+                close_conn(fd);
+                continue;
+            }
+            if (evs & EPOLLIN) {
+                conn_readable(c);
+                if (conns_.find(fd) == conns_.end()) continue;
+            }
+            if (evs & EPOLLOUT) conn_writable(c);
+        }
+    }
+}
+
+void Server::accept_ready() {
+    while (true) {
+        int fd = accept4(listen_fd_, nullptr, nullptr,
+                         SOCK_NONBLOCK | SOCK_CLOEXEC);
+        if (fd < 0) return;
+        tune_socket(fd);
+        auto c = std::make_unique<Conn>();
+        c->fd = fd;
+        epoll_event ev{};
+        ev.events = EPOLLIN;
+        ev.data.fd = fd;
+        epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
+        conns_[fd] = std::move(c);
+        n_conns_++;
+        IST_DEBUG("accepted fd=%d", fd);
+    }
+}
+
+void Server::close_conn(int fd) {
+    auto it = conns_.find(fd);
+    if (it == conns_.end()) return;
+    // Abort allocations this client never committed.
+    {
+        std::lock_guard<std::mutex> lk(store_mu_);
+        for (uint64_t tok : it->second->open_tokens) index_->abort(tok);
+    }
+    epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, fd, nullptr);
+    close(fd);
+    conns_.erase(it);
+    n_conns_--;
+    IST_DEBUG("closed fd=%d", fd);
+}
+
+void Server::update_epoll(Conn& c) {
+    bool want = !c.outq.empty();
+    if (want == c.want_write) return;
+    c.want_write = want;
+    epoll_event ev{};
+    ev.events = EPOLLIN | (want ? uint32_t(EPOLLOUT) : 0u);
+    ev.data.fd = c.fd;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, c.fd, &ev);
+}
+
+void Server::conn_readable(Conn& c) {
+    while (true) {
+        if (c.state == RState::HDR) {
+            ssize_t r = recv(c.fd, reinterpret_cast<uint8_t*>(&c.hdr) + c.hdr_got,
+                             sizeof(WireHeader) - c.hdr_got, 0);
+            if (r == 0) return close_conn(c.fd);
+            if (r < 0) {
+                if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+                return close_conn(c.fd);
+            }
+            bytes_in_ += uint64_t(r);
+            c.hdr_got += size_t(r);
+            if (c.hdr_got < sizeof(WireHeader)) continue;
+            if (!header_valid(c.hdr)) {
+                IST_WARN("bad header from fd=%d, closing", c.fd);
+                return close_conn(c.fd);
+            }
+            c.body.resize(c.hdr.body_len);
+            c.body_got = 0;
+            c.state = RState::BODY;
+            if (c.hdr.body_len == 0) {
+                handle_message(c);
+                if (c.dead) return close_conn(c.fd);
+                continue;
+            }
+        } else if (c.state == RState::BODY) {
+            ssize_t r = recv(c.fd, c.body.data() + c.body_got,
+                             c.body.size() - c.body_got, 0);
+            if (r == 0) return close_conn(c.fd);
+            if (r < 0) {
+                if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+                return close_conn(c.fd);
+            }
+            bytes_in_ += uint64_t(r);
+            c.body_got += size_t(r);
+            if (c.body_got < c.body.size()) continue;
+            handle_message(c);
+            if (c.dead) return close_conn(c.fd);
+        } else if (c.state == RState::PAYLOAD) {
+            // Scatter OP_WRITE payload straight into pool blocks — the TCP
+            // analogue of one-sided RDMA WRITE landing in the pool.
+            while (c.payload_left > 0) {
+                uint8_t* dst;
+                size_t room;
+                if (c.wseg < c.wdest.size()) {
+                    dst = c.wdest[c.wseg].first + c.wseg_off;
+                    room = c.wdest[c.wseg].second - c.wseg_off;
+                } else {  // excess payload beyond the plan: sink it
+                    if (c.sink.size() < (1u << 16)) c.sink.resize(1u << 16);
+                    dst = c.sink.data();
+                    room = c.sink.size();
+                    if (room > c.payload_left) room = size_t(c.payload_left);
+                }
+                if (room > c.payload_left) room = size_t(c.payload_left);
+                ssize_t r = recv(c.fd, dst, room, 0);
+                if (r == 0) return close_conn(c.fd);
+                if (r < 0) {
+                    if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+                    return close_conn(c.fd);
+                }
+                bytes_in_ += uint64_t(r);
+                c.payload_left -= uint64_t(r);
+                if (c.wseg < c.wdest.size()) {
+                    c.wseg_off += size_t(r);
+                    if (c.wseg_off == c.wdest[c.wseg].second) {
+                        c.wseg++;
+                        c.wseg_off = 0;
+                    }
+                }
+            }
+            finish_write(c);
+            if (c.dead) return close_conn(c.fd);
+        } else {  // DRAIN
+            if (c.sink.size() < (1u << 16)) c.sink.resize(1u << 16);
+            while (c.payload_left > 0) {
+                size_t room = c.sink.size();
+                if (room > c.payload_left) room = size_t(c.payload_left);
+                ssize_t r = recv(c.fd, c.sink.data(), room, 0);
+                if (r == 0) return close_conn(c.fd);
+                if (r < 0) {
+                    if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+                    return close_conn(c.fd);
+                }
+                c.payload_left -= uint64_t(r);
+            }
+            c.state = RState::HDR;
+            c.hdr_got = 0;
+        }
+    }
+}
+
+void Server::conn_writable(Conn& c) {
+    if (!flush_out(c)) {
+        close_conn(c.fd);
+        return;
+    }
+    update_epoll(c);
+}
+
+bool Server::flush_out(Conn& c) {
+    while (!c.outq.empty()) {
+        OutMsg& m = c.outq.front();
+        iovec iov[64];
+        int niov = 0;
+        if (!m.meta_done) {
+            iov[niov].iov_base = m.meta.data() + m.off;
+            iov[niov].iov_len = m.meta.size() - m.off;
+            niov++;
+        }
+        for (size_t s = m.seg_idx; s < m.segs.size() && niov < 64; ++s) {
+            size_t skip = (s == m.seg_idx && m.meta_done) ? m.off : 0;
+            iov[niov].iov_base = const_cast<uint8_t*>(m.segs[s].first) + skip;
+            iov[niov].iov_len = m.segs[s].second - skip;
+            niov++;
+        }
+        ssize_t w = writev(c.fd, iov, niov);
+        if (w < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+            return false;
+        }
+        bytes_out_ += uint64_t(w);
+        size_t left = size_t(w);
+        // Advance cursors.
+        if (!m.meta_done) {
+            size_t take = std::min(left, m.meta.size() - m.off);
+            m.off += take;
+            left -= take;
+            if (m.off == m.meta.size()) {
+                m.meta_done = true;
+                m.off = 0;
+            }
+        }
+        while (left > 0 && m.seg_idx < m.segs.size()) {
+            size_t take = std::min(left, m.segs[m.seg_idx].second - m.off);
+            m.off += take;
+            left -= take;
+            if (m.off == m.segs[m.seg_idx].second) {
+                m.seg_idx++;
+                m.off = 0;
+            }
+        }
+        if (m.meta_done && m.seg_idx == m.segs.size()) {
+            c.outq.pop_front();  // drops BlockRefs → unpins
+        } else if (w == 0) {
+            return true;
+        }
+    }
+    return true;
+}
+
+void Server::respond(Conn& c, uint64_t seq, uint8_t op,
+                     std::vector<uint8_t> body_bytes,
+                     std::vector<std::pair<const uint8_t*, size_t>> segs,
+                     std::vector<BlockRef> refs) {
+    uint64_t payload = 0;
+    for (auto& s : segs) payload += s.second;
+    OutMsg m;
+    m.meta.resize(sizeof(WireHeader) + body_bytes.size());
+    WireHeader h = make_header(op, seq, uint32_t(body_bytes.size()), payload);
+    memcpy(m.meta.data(), &h, sizeof(h));
+    if (!body_bytes.empty()) {
+        memcpy(m.meta.data() + sizeof(h), body_bytes.data(), body_bytes.size());
+    }
+    m.segs = std::move(segs);
+    m.refs = std::move(refs);
+    c.outq.push_back(std::move(m));
+    if (!flush_out(c)) {
+        c.dead = true;
+        return;
+    }
+    update_epoll(c);
+}
+
+void Server::handle_message(Conn& c) {
+    ops_++;
+    uint8_t op = c.hdr.op;
+    // WRITE transitions to payload scatter; everything else handles inline.
+    if (op == OP_WRITE) {
+        BufReader r(c.body.data(), c.body.size());
+        uint32_t block_size = r.u32();
+        uint32_t n = r.u32();
+        c.wdest.clear();
+        c.wtokens.clear();
+        c.wblock_size = block_size;
+        bool ok = r.ok() && n <= MAX_KEYS_PER_OP &&
+                  c.hdr.payload_len == uint64_t(n) * block_size;
+        if (ok) {
+            // Size the per-connection sink FIRST: pointers captured below
+            // must stay stable for the whole payload scatter.
+            if (c.sink.size() < block_size) c.sink.resize(block_size);
+            std::lock_guard<std::mutex> lk(store_mu_);
+            for (uint32_t i = 0; i < n; ++i) {
+                uint64_t tok = r.u64();
+                c.wtokens.push_back(tok);
+                uint32_t sz = 0;
+                uint8_t* dst = index_->write_dest(tok, &sz);
+                if (dst != nullptr && sz >= block_size) {
+                    c.wdest.emplace_back(dst, block_size);
+                } else {
+                    // Unknown/purged token: payload lands in the sink.
+                    c.wdest.emplace_back(c.sink.data(), block_size);
+                }
+            }
+            ok = r.ok();
+        }
+        if (!ok) {
+            // Drain the declared payload, then answer BAD_REQUEST.
+            c.payload_left = c.hdr.payload_len;
+            c.state = RState::DRAIN;
+            c.hdr_got = 0;
+            std::vector<uint8_t> body;
+            BufWriter w(body);
+            w.u32(BAD_REQUEST);
+            respond(c, c.hdr.seq, op, std::move(body));
+            return;
+        }
+        c.payload_left = c.hdr.payload_len;
+        c.wseg = 0;
+        c.wseg_off = 0;
+        c.state = RState::PAYLOAD;
+        if (c.payload_left == 0) finish_write(c);
+        return;
+    }
+
+    switch (op) {
+        case OP_HELLO: op_hello(c); break;
+        case OP_ALLOCATE: op_allocate(c); break;
+        case OP_READ: op_read(c); break;
+        case OP_COMMIT: op_commit(c); break;
+        case OP_PIN: op_pin(c); break;
+        case OP_RELEASE: op_release(c); break;
+        case OP_CHECK_EXIST: op_check_exist(c); break;
+        case OP_GET_MATCH_LAST_IDX: op_match(c); break;
+        case OP_SYNC:
+        case OP_PURGE:
+        case OP_STATS:
+        case OP_DELETE: op_simple(c); break;
+        default: {
+            std::vector<uint8_t> body;
+            BufWriter w(body);
+            w.u32(BAD_REQUEST);
+            respond(c, c.hdr.seq, op, std::move(body));
+        }
+    }
+    c.state = RState::HDR;
+    c.hdr_got = 0;
+}
+
+void Server::finish_write(Conn& c) {
+    // Commit everything that landed (two-phase visibility: entries become
+    // readable only now, after the bytes are in the pool).
+    uint32_t committed = 0;
+    {
+        std::lock_guard<std::mutex> lk(store_mu_);
+        for (uint64_t tok : c.wtokens) {
+            if (index_->commit(tok) == OK) committed++;
+            c.open_tokens.erase(tok);
+        }
+    }
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    w.u32(OK);
+    w.u32(committed);
+    respond(c, c.hdr.seq, OP_WRITE, std::move(body));
+    c.state = RState::HDR;
+    c.hdr_got = 0;
+}
+
+void Server::op_hello(Conn& c) {
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    std::lock_guard<std::mutex> lk(store_mu_);
+    w.u32(OK);
+    w.u32(uint32_t(mm_->block_size()));
+    w.u32(cfg_.enable_shm ? 1 : 0);
+    w.u32(uint32_t(mm_->num_pools()));
+    for (size_t i = 0; i < mm_->num_pools(); ++i) {
+        w.str(mm_->pool(i).shm_name());
+        w.u64(mm_->pool(i).pool_size());
+    }
+    respond(c, c.hdr.seq, OP_HELLO, std::move(body));
+}
+
+void Server::op_allocate(Conn& c) {
+    BufReader r(c.body.data(), c.body.size());
+    uint32_t block_size = r.u32();
+    std::vector<std::string> keys;
+    r.keys(&keys);
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    if (!r.ok() || block_size == 0) {
+        w.u32(BAD_REQUEST);
+        respond(c, c.hdr.seq, OP_ALLOCATE, std::move(body));
+        return;
+    }
+    std::vector<RemoteBlock> blocks(keys.size());
+    {
+        std::lock_guard<std::mutex> lk(store_mu_);
+        for (size_t i = 0; i < keys.size(); ++i) {
+            Status st = index_->allocate(keys[i], block_size, &blocks[i]);
+            if (st == OK) c.open_tokens.insert(blocks[i].token);
+        }
+        mm_->maybe_extend();
+    }
+    w.u32(OK);
+    w.u32(uint32_t(blocks.size()));
+    w.bytes(blocks.data(), blocks.size() * sizeof(RemoteBlock));
+    respond(c, c.hdr.seq, OP_ALLOCATE, std::move(body));
+}
+
+void Server::op_read(Conn& c) {
+    BufReader r(c.body.data(), c.body.size());
+    uint32_t block_size = r.u32();
+    std::vector<std::string> keys;
+    r.keys(&keys);
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    if (!r.ok()) {
+        w.u32(BAD_REQUEST);
+        respond(c, c.hdr.seq, OP_READ, std::move(body));
+        return;
+    }
+    std::vector<std::pair<const uint8_t*, size_t>> segs;
+    std::vector<BlockRef> refs;
+    {
+        std::lock_guard<std::mutex> lk(store_mu_);
+        for (auto& k : keys) {
+            const Entry* e = index_->get_committed(k);
+            if (e == nullptr || e->size < block_size) {
+                w.u32(KEY_NOT_FOUND);
+                respond(c, c.hdr.seq, OP_READ, std::move(body));
+                return;
+            }
+            segs.emplace_back(static_cast<const uint8_t*>(e->block->loc.ptr),
+                              size_t(block_size));
+            refs.push_back(e->block);  // pin until sent
+        }
+    }
+    w.u32(OK);
+    w.u32(uint32_t(keys.size()));
+    respond(c, c.hdr.seq, OP_READ, std::move(body), std::move(segs),
+            std::move(refs));
+}
+
+void Server::op_commit(Conn& c) {
+    BufReader r(c.body.data(), c.body.size());
+    uint32_t n = r.u32();
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    if (!r.ok() || n > MAX_KEYS_PER_OP) {
+        w.u32(BAD_REQUEST);
+        respond(c, c.hdr.seq, OP_COMMIT, std::move(body));
+        return;
+    }
+    uint32_t committed = 0;
+    {
+        std::lock_guard<std::mutex> lk(store_mu_);
+        for (uint32_t i = 0; i < n && r.ok(); ++i) {
+            uint64_t tok = r.u64();
+            if (index_->commit(tok) == OK) committed++;
+            c.open_tokens.erase(tok);
+        }
+    }
+    w.u32(r.ok() ? OK : BAD_REQUEST);
+    w.u32(committed);
+    respond(c, c.hdr.seq, OP_COMMIT, std::move(body));
+}
+
+void Server::op_pin(Conn& c) {
+    BufReader r(c.body.data(), c.body.size());
+    std::vector<std::string> keys;
+    r.keys(&keys);
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    if (!r.ok()) {
+        w.u32(BAD_REQUEST);
+        respond(c, c.hdr.seq, OP_PIN, std::move(body));
+        return;
+    }
+    std::vector<BlockRef> refs;
+    std::vector<RemoteBlock> blocks;
+    {
+        std::lock_guard<std::mutex> lk(store_mu_);
+        for (auto& k : keys) {
+            const Entry* e = index_->get_committed(k);
+            if (e == nullptr) {
+                w.u32(KEY_NOT_FOUND);
+                respond(c, c.hdr.seq, OP_PIN, std::move(body));
+                return;
+            }
+            RemoteBlock b;
+            b.status = OK;
+            b.pool_idx = e->block->loc.pool_idx;
+            b.token = 0;
+            b.offset = e->block->loc.offset;
+            blocks.push_back(b);
+            refs.push_back(e->block);
+        }
+        uint64_t lease = index_->pin(std::move(refs));
+        w.u32(OK);
+        w.u64(lease);
+        w.u32(uint32_t(blocks.size()));
+        w.bytes(blocks.data(), blocks.size() * sizeof(RemoteBlock));
+    }
+    respond(c, c.hdr.seq, OP_PIN, std::move(body));
+}
+
+void Server::op_release(Conn& c) {
+    BufReader r(c.body.data(), c.body.size());
+    uint64_t lease = r.u64();
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    bool ok;
+    {
+        std::lock_guard<std::mutex> lk(store_mu_);
+        ok = index_->release(lease);
+    }
+    w.u32(ok ? OK : KEY_NOT_FOUND);
+    respond(c, c.hdr.seq, OP_RELEASE, std::move(body));
+}
+
+void Server::op_check_exist(Conn& c) {
+    BufReader r(c.body.data(), c.body.size());
+    std::string key = r.str();
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    bool exists;
+    {
+        std::lock_guard<std::mutex> lk(store_mu_);
+        exists = r.ok() && index_->check_exist(key);
+    }
+    w.u32(exists ? OK : KEY_NOT_FOUND);
+    respond(c, c.hdr.seq, OP_CHECK_EXIST, std::move(body));
+}
+
+void Server::op_match(Conn& c) {
+    BufReader r(c.body.data(), c.body.size());
+    std::vector<std::string> keys;
+    r.keys(&keys);
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    if (!r.ok()) {
+        w.u32(BAD_REQUEST);
+        w.i32(-1);
+    } else {
+        std::lock_guard<std::mutex> lk(store_mu_);
+        w.u32(OK);
+        w.i32(index_->match_last_index(keys));
+    }
+    respond(c, c.hdr.seq, OP_GET_MATCH_LAST_IDX, std::move(body));
+}
+
+void Server::op_simple(Conn& c) {
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    switch (c.hdr.op) {
+        case OP_SYNC:
+            // The loop is serial per connection: by the time SYNC is
+            // handled, every earlier op on this connection has been applied
+            // (and, because writes commit before their ack, is visible to
+            // all connections). Reference analogue: sync_stream remain
+            // count polling (infinistore.cpp:1070-1075).
+            w.u32(OK);
+            break;
+        case OP_PURGE: {
+            size_t n;
+            {
+                std::lock_guard<std::mutex> lk(store_mu_);
+                n = index_->purge();
+            }
+            w.u32(OK);
+            w.u64(n);
+            break;
+        }
+        case OP_STATS: {
+            std::string s = stats_json();
+            w.u32(OK);
+            w.str(s);
+            break;
+        }
+        case OP_DELETE: {
+            BufReader r(c.body.data(), c.body.size());
+            std::vector<std::string> keys;
+            r.keys(&keys);
+            size_t n = 0;
+            if (r.ok()) {
+                std::lock_guard<std::mutex> lk(store_mu_);
+                n = index_->erase(keys);
+            }
+            w.u32(r.ok() ? OK : BAD_REQUEST);
+            w.u64(n);
+            break;
+        }
+    }
+    respond(c, c.hdr.seq, c.hdr.op, std::move(body));
+}
+
+}  // namespace istpu

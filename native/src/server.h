@@ -1,0 +1,171 @@
+// server.h — the KV-cache pool server.
+//
+// Parity target: reference src/infinistore.{h,cpp} (C1/C2/C3/C4 in
+// SURVEY.md §2): a single-threaded event-loop TCP server owning the memory
+// pool and kv index. The reference embeds a libuv loop inside Python's
+// uvloop (infinistore.cpp:1276-1285) and adds (a) a verbs completion
+// channel polled on the same loop for the RDMA path (:1040-1046) and (b) a
+// CUDA-IPC + cudaMemcpyAsync worker for the same-host GPU path (:570-804).
+//
+// TPU-native design: one epoll loop on a dedicated thread serves both data
+// paths —
+//   - STREAM path (DCN stand-in for RDMA): OP_WRITE payload bytes are
+//     scattered by the loop directly from the socket into pool blocks
+//     (no staging buffer), and OP_READ responses are gathered with
+//     writev straight out of pool blocks, with BlockRefs held by the send
+//     queue until the bytes are on the wire — the moral equivalent of the
+//     reference pinning blocks in wr_id during server-push RDMA WRITE
+//     (infinistore.cpp:432,492,320-324).
+//   - SHM path (CUDA-IPC stand-in): clients map the pool's POSIX shared
+//     memory and copy one-sided; the server only runs the
+//     allocate → (client memcpy) → commit visibility protocol and the
+//     pin/release lease protocol for reads.
+// The loop never blocks on bulk data for the SHM path, so the per-layer
+// overlap property (design.rst:56-59) is preserved: clients stream layer k
+// while computing layer k+1.
+//
+// Commit-race fix: the reference documents a cross-connection race where a
+// client counts a write complete when the commit message is *posted*, not
+// applied (libinfinistore.cpp:403-410). Here a write/commit is acked only
+// after the loop has applied it, and the loop linearizes all connections,
+// so a reader that starts after a writer's ack always sees the committed
+// entry.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+#include "common.h"
+#include "kv_index.h"
+#include "mempool.h"
+#include "protocol.h"
+
+namespace istpu {
+
+struct ServerConfig {
+    std::string host = "0.0.0.0";
+    uint16_t port = 22345;           // service port (reference default 22345)
+    uint64_t prealloc_bytes = 1ull << 30;
+    uint64_t block_size = 64 << 10;  // minimal_allocate_size (64 KB default)
+    bool auto_extend = false;
+    uint64_t extend_bytes = 1ull << 30;
+    bool enable_shm = true;          // expose the pool as POSIX shm
+    std::string shm_prefix;          // default derived from pid+port
+};
+
+class Server {
+   public:
+    explicit Server(const ServerConfig& cfg);
+    ~Server();
+
+    // Binds + spawns the loop thread. Returns false on bind failure.
+    bool start();
+    void stop();
+
+    // Control plane (thread-safe; reference exposes these over FastAPI —
+    // server.py:29-96 — our Python layer does the same via ctypes).
+    size_t kvmap_len();
+    size_t purge();
+    std::string stats_json();
+
+    uint16_t bound_port() const { return bound_port_; }
+    const std::string& shm_prefix() const { return cfg_.shm_prefix; }
+
+   private:
+    enum class RState { HDR, BODY, PAYLOAD, DRAIN };
+
+    struct OutMsg {
+        std::vector<uint8_t> meta;  // header + body
+        // Payload segments gathered from pool blocks (reads).
+        std::vector<std::pair<const uint8_t*, size_t>> segs;
+        std::vector<BlockRef> refs;  // keep blocks alive until sent
+        size_t seg_idx = 0;
+        size_t off = 0;  // offset within meta or segs[seg_idx]
+        bool meta_done = false;
+    };
+
+    struct Conn {
+        int fd = -1;
+        RState state = RState::HDR;
+        WireHeader hdr{};
+        size_t hdr_got = 0;
+        std::vector<uint8_t> body;
+        size_t body_got = 0;
+        // OP_WRITE scatter plan.
+        std::vector<std::pair<uint8_t*, uint32_t>> wdest;  // (ptr,size)
+        std::vector<uint64_t> wtokens;
+        uint32_t wblock_size = 0;
+        size_t wseg = 0;
+        size_t wseg_off = 0;
+        uint64_t payload_left = 0;
+        std::deque<OutMsg> outq;
+        bool want_write = false;
+        bool dead = false;  // fatal error; closed after unwinding
+        // Per-connection sink for payload of unknown/purged tokens; sized
+        // before pointer capture and never resized mid-scatter.
+        std::vector<uint8_t> sink;
+        // Uncommitted tokens allocated on this connection; aborted if the
+        // connection dies (improvement over the reference, which leaks
+        // uncommitted kv_map entries on client crash).
+        std::unordered_set<uint64_t> open_tokens;
+    };
+
+    void loop();
+    void accept_ready();
+    void conn_readable(Conn& c);
+    void conn_writable(Conn& c);
+    bool flush_out(Conn& c);  // false => fatal error, close
+    void close_conn(int fd);
+    void handle_message(Conn& c);  // full header+body (non-WRITE) received
+    void finish_write(Conn& c);    // WRITE payload fully scattered
+    void update_epoll(Conn& c);
+
+    void respond(Conn& c, uint64_t seq, uint8_t op,
+                 std::vector<uint8_t> body_bytes,
+                 std::vector<std::pair<const uint8_t*, size_t>> segs = {},
+                 std::vector<BlockRef> refs = {});
+
+    // op handlers (body parsed under store_mu_)
+    void op_hello(Conn& c);
+    void op_allocate(Conn& c);
+    void op_read(Conn& c);
+    void op_commit(Conn& c);
+    void op_pin(Conn& c);
+    void op_release(Conn& c);
+    void op_check_exist(Conn& c);
+    void op_match(Conn& c);
+    void op_simple(Conn& c);  // SYNC / PURGE / STATS / DELETE
+
+    ServerConfig cfg_;
+    uint16_t bound_port_ = 0;
+    int listen_fd_ = -1;
+    int epoll_fd_ = -1;
+    int wake_fd_ = -1;
+    std::thread thread_;
+    std::atomic<bool> running_{false};
+
+    // store_mu_ guards mm_/index_ so the Python control plane can call in
+    // from other threads; the loop takes it per message (the reference
+    // instead funnels everything through one uvloop thread,
+    // infinistore.cpp:1 comment — with a 1-core host the mutex costs
+    // nothing and removes the shared-loop coupling).
+    std::mutex store_mu_;
+    std::unique_ptr<MM> mm_;
+    std::unique_ptr<KVIndex> index_;
+
+    std::unordered_map<int, std::unique_ptr<Conn>> conns_;
+    std::atomic<uint64_t> n_conns_{0};  // stats-safe connection count
+
+    // stats
+    std::atomic<uint64_t> ops_{0}, bytes_in_{0}, bytes_out_{0};
+};
+
+}  // namespace istpu

@@ -1,0 +1,38 @@
+"""Packaging for infinistore-tpu.
+
+Parity target: reference setup.py drives `make` in src/ during build
+(/root/reference/setup.py:31-40) and installs an `infinistore` console
+script (:68-71). Here the native library is built by `make -C native` into
+infinistore_tpu/_native/ and shipped as package data.
+"""
+
+import subprocess
+from pathlib import Path
+
+from setuptools import find_packages, setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        native = Path(__file__).parent / "native"
+        subprocess.run(["make", "-C", str(native)], check=True)
+        super().run()
+
+
+setup(
+    name="infinistore-tpu",
+    version="0.1.0",
+    description="A TPU-native KV-cache memory pool",
+    packages=find_packages(include=["infinistore_tpu", "infinistore_tpu.*"]),
+    package_data={"infinistore_tpu": ["_native/*.so"]},
+    cmdclass={"build_py": BuildWithNative},
+    python_requires=">=3.10",
+    install_requires=["numpy"],
+    extras_require={"tpu": ["jax"], "test": ["pytest"]},
+    entry_points={
+        "console_scripts": [
+            "infinistore-tpu = infinistore_tpu.server:main",
+        ]
+    },
+)

@@ -1,0 +1,88 @@
+"""Full asyncio API tests (reference test_infinistore.py:390-417)."""
+
+import asyncio
+import uuid
+
+import numpy as np
+import pytest
+
+
+def key():
+    return str(uuid.uuid4())
+
+
+def test_async_roundtrip(conn, rng):
+    async def run():
+        page = 2048
+        nblocks = 4
+        src = rng.random(page * nblocks).astype(np.float32)
+        keys = [key() for _ in range(nblocks)]
+        blocks = await conn.allocate_rdma_async(keys, page * 4)
+        await conn.rdma_write_cache_async(
+            src, [i * page for i in range(nblocks)], page, blocks
+        )
+        await conn.sync_async()
+        dst = np.zeros_like(src)
+        await conn.read_cache_async(
+            dst, [(k, i * page) for i, k in enumerate(keys)], page
+        )
+        await conn.sync_async()
+        return np.array_equal(src, dst)
+
+    assert asyncio.run(run())
+
+
+def test_async_concurrent_writes(conn, rng):
+    """Many overlapping async writes then one sync (the per-layer overlap
+    pattern, reference demo_prefill.py:57-77)."""
+
+    async def run():
+        page = 1024
+        layers = 16
+        srcs = [rng.random(page).astype(np.float32) for _ in range(layers)]
+        keyss = [[key()] for _ in range(layers)]
+        blocks = []
+        for i in range(layers):
+            blocks.append(await conn.allocate_rdma_async(keyss[i], page * 4))
+        await asyncio.gather(
+            *[
+                conn.rdma_write_cache_async(srcs[i], [0], page, blocks[i])
+                for i in range(layers)
+            ]
+        )
+        await conn.sync_async()
+        ok = True
+        for i in range(layers):
+            dst = np.zeros(page, dtype=np.float32)
+            await conn.read_cache_async(dst, [(keyss[i][0], 0)], page)
+            ok = ok and np.array_equal(dst, srcs[i])
+        await conn.sync_async()
+        return ok
+
+    assert asyncio.run(run())
+
+
+def test_async_missing_key_raises(conn):
+    from infinistore_tpu import InfiniStoreKeyNotFound
+
+    async def run():
+        dst = np.zeros(256, dtype=np.float32)
+        with pytest.raises(InfiniStoreKeyNotFound):
+            await conn.read_cache_async(dst, [("nope_" + key(), 0)], 256)
+
+    asyncio.run(run())
+
+
+def test_local_gpu_write_cache_async(conn, rng):
+    async def run():
+        page = 512
+        src = rng.random(page).astype(np.float32)
+        k = key()
+        await conn.local_gpu_write_cache_async(src, [(k, 0)], page)
+        await conn.sync_async()
+        dst = np.zeros_like(src)
+        await conn.read_cache_async(dst, [(k, 0)], page)
+        await conn.sync_async()
+        return np.array_equal(src, dst)
+
+    assert asyncio.run(run())
