@@ -1,0 +1,176 @@
+"""Round benchmark: KV put/get throughput through the store (+ TPU staging).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Primary metric (BASELINE.json config 2): bulk put+get throughput of
+4 KB x 4096 keys, single client <-> CPU-hosted server over the same-host
+path, in GB/s (put and get each move the full payload; value is
+total_bytes_moved / total_time). The reference publishes no quantitative
+numbers (BASELINE.md), so vs_baseline is reported against a 1 GB/s
+nominal target — vs_baseline == value in GB/s.
+
+When a TPU is attached, the line also carries tpu_offload_GBps /
+tpu_restore_GBps: jax.Array KV pages device->store and store->device
+through the pinned pool (the nv_peer_mem-analogue path).
+"""
+
+import json
+import sys
+import time
+
+
+def bench_store(port, size_mb=64, block_kb=4, nkeys=None):
+    import numpy as np
+
+    from infinistore_tpu import ClientConfig, InfinityConnection
+
+    conn = InfinityConnection(
+        ClientConfig(host_addr="127.0.0.1", service_port=port)
+    )
+    conn.connect()
+    try:
+        block_bytes = block_kb << 10
+        n = nkeys if nkeys else (size_mb << 20) // block_bytes
+        total = n * block_bytes
+        src = np.random.default_rng(0).integers(0, 255, total, dtype=np.uint8)
+        keys = [f"bench_{i}" for i in range(n)]
+        batch = 512
+
+        t0 = time.perf_counter()
+        for s in range(0, n, batch):
+            chunk = keys[s : s + batch]
+            offs = [(s + j) * block_bytes for j in range(len(chunk))]
+            blocks = conn.allocate(chunk, block_bytes)
+            conn.write_cache(src, offs, block_bytes, blocks)
+        conn.sync()
+        t_put = time.perf_counter() - t0
+
+        dst = np.zeros_like(src)
+        t0 = time.perf_counter()
+        for s in range(0, n, batch):
+            chunk = keys[s : s + batch]
+            pairs = [(k, (s + j) * block_bytes) for j, k in enumerate(chunk)]
+            conn.read_cache(dst, pairs, block_bytes)
+        conn.sync()
+        t_get = time.perf_counter() - t0
+
+        assert np.array_equal(src, dst), "verification failed"
+
+        lat_dst = np.zeros(block_bytes, dtype=np.uint8)
+        lats = []
+        for k in keys[:200]:
+            t0 = time.perf_counter()
+            conn.read_cache(lat_dst, [(k, 0)], block_bytes)
+            lats.append(time.perf_counter() - t0)
+        p50_us = float(np.percentile(np.array(lats) * 1e6, 50))
+
+        gb = total / (1 << 30)
+        return {
+            "path": "SHM" if conn.shm_connected else "STREAM",
+            "nkeys": n,
+            "block_kb": block_kb,
+            "put_GBps": round(gb / t_put, 3),
+            "get_GBps": round(gb / t_get, 3),
+            "agg_GBps": round(2 * gb / (t_put + t_get), 3),
+            "p50_read_us": round(p50_us, 1),
+        }
+    finally:
+        conn.close()
+
+
+def bench_tpu(port):
+    """Device <-> store KV-page round trip on the attached accelerator."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from infinistore_tpu import ClientConfig, InfinityConnection
+        from infinistore_tpu.tpu import TpuKVStore
+
+        dev = jax.devices()[0]
+        conn = InfinityConnection(
+            ClientConfig(host_addr="127.0.0.1", service_port=port)
+        )
+        conn.connect()
+        try:
+            store = TpuKVStore(conn)
+            # 64 pages x 256 KB = 16 MB of bf16 KV pages.
+            n_pages, page = 64, (2048, 8, 8)
+            pages = jax.device_put(
+                jnp.asarray(
+                    np.random.default_rng(1).random((n_pages, *page)),
+                    dtype=jnp.bfloat16,
+                ),
+                dev,
+            )
+            jax.block_until_ready(pages)
+            keys = [f"tpu_bench_p{i}" for i in range(n_pages)]
+            nbytes = pages.nbytes
+
+            # Warm the transfer path (first device<->host transfer through
+            # the runtime is dominated by connection/compile setup).
+            wkeys = [f"tpu_warm_p{i}" for i in range(n_pages)]
+            store.put_kv_pages(wkeys, pages, sync=True)
+            jax.block_until_ready(
+                store.get_kv_pages(wkeys, page, jnp.bfloat16, device=dev)
+            )
+
+            t0 = time.perf_counter()
+            store.put_kv_pages(keys, pages, sync=True)
+            t_off = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            back = store.get_kv_pages(keys, page, jnp.bfloat16, device=dev)
+            jax.block_until_ready(back)
+            t_res = time.perf_counter() - t0
+
+            ok = bool(jnp.array_equal(back, pages))
+            gb = nbytes / (1 << 30)
+            return {
+                "tpu_device": str(dev),
+                "tpu_offload_GBps": round(gb / t_off, 3),
+                "tpu_restore_GBps": round(gb / t_res, 3),
+                "tpu_verified": ok,
+            }
+        finally:
+            conn.close()
+    except Exception as e:  # TPU absent or jax init failure: not fatal
+        return {"tpu_error": str(e)[:200]}
+
+
+def main():
+    from infinistore_tpu import InfiniStoreServer, ServerConfig
+
+    srv = InfiniStoreServer(
+        ServerConfig(
+            service_port=0,
+            prealloc_size=0.25,
+            minimal_allocate_size=16,
+            auto_increase=True,
+            extend_size=0.125,
+        )
+    )
+    port = srv.start()
+    try:
+        store_res = bench_store(port, block_kb=4, nkeys=4096)
+        srv.purge()
+        tpu_res = bench_tpu(port)
+    finally:
+        srv.stop()
+
+    value = store_res["agg_GBps"]
+    out = {
+        "metric": "kv_put_get_4KBx4096_agg_throughput",
+        "value": value,
+        "unit": "GB/s",
+        "vs_baseline": value,  # nominal 1 GB/s target; see module docstring
+        **store_res,
+        **tpu_res,
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,7 +24,13 @@ _NATIVE_SRC = os.path.join(os.path.dirname(__file__), "..", "native")
 
 # numpy view of istpu::RemoteBlock (native/src/common.h).
 REMOTE_BLOCK_DTYPE = np.dtype(
-    [("status", "<u4"), ("pool_idx", "<u4"), ("token", "<u8"), ("offset", "<u8")]
+    [
+        ("status", "<u4"),
+        ("pool_idx", "<u4"),
+        ("token", "<u8"),
+        ("offset", "<u8"),
+        ("size", "<u8"),
+    ]
 )
 
 # Status codes (native/src/common.h).
@@ -112,8 +118,8 @@ def _decls(lib):
         (
             "ist_shm_write_async",
             c.c_uint32,
-            [c.c_void_p, c.c_uint32, c.c_uint32, c.POINTER(c.c_uint64),
-             c.c_void_p, c.POINTER(c.c_void_p), CALLBACK, c.c_void_p],
+            [c.c_void_p, c.c_uint32, c.c_uint32, c.c_void_p,
+             c.POINTER(c.c_void_p), CALLBACK, c.c_void_p],
         ),
         (
             "ist_shm_read_async",
@@ -130,6 +136,7 @@ def _decls(lib):
              c.POINTER(c.c_uint64)],
         ),
         ("ist_release", c.c_uint32, [c.c_void_p, c.c_uint64]),
+        ("ist_abort", c.c_uint32, [c.c_void_p, c.POINTER(c.c_uint64), c.c_uint32]),
         ("ist_check_exist", c.c_int, [c.c_void_p, c.c_char_p, c.c_uint32]),
         (
             "ist_get_match_last_index",

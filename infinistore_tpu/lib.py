@@ -233,6 +233,12 @@ class InfinityConnection:
         if st != OK:
             raise InfiniStoreError(st, "allocate failed")
         if (out["status"] == _native.OUT_OF_MEMORY).any():
+            # Roll back the successful part of the batch: leaving those
+            # entries uncommitted would dedup-poison the keys (future
+            # allocates return FAKE, writes silently skip, reads 404).
+            ok_tokens = out["token"][out["status"] == OK]
+            if len(ok_tokens):
+                self.abort(ok_tokens)
             raise InfiniStoreError(_native.OUT_OF_MEMORY, "allocate failed")
         return out
 
@@ -259,6 +265,12 @@ class InfinityConnection:
         blocks = np.ascontiguousarray(remote_blocks, dtype=REMOTE_BLOCK_DTYPE)
         if len(offsets) != len(blocks):
             raise ValueError("offsets and remote_blocks length mismatch")
+        real = blocks["token"] != FAKE_TOKEN
+        if (blocks["size"][real] < page_bytes).any():
+            raise ValueError(
+                "page size exceeds the allocated block size for at least "
+                "one key (allocate() and write_cache() sizes must agree)"
+            )
         base = arr.ctypes.data
         nbytes = arr.nbytes
         srcs = []
@@ -292,7 +304,7 @@ class InfinityConnection:
             ):
                 self.refresh_pools()
             st = self._lib.ist_shm_write_async(
-                self._h, page_bytes, n, tok_arr,
+                self._h, page_bytes, n,
                 blocks.ctypes.data_as(ct.c_void_p), src_arr, ka.c_cb, None,
             )
         else:
@@ -567,6 +579,20 @@ class InfinityConnection:
         )
         if st != OK:
             raise InfiniStoreError(st, "commit failed")
+
+    def abort(self, tokens):
+        """Abort uncommitted allocation tokens so their keys become
+        allocatable again (used to undo partially-failed batch allocates;
+        the reference has no such undo and leaks uncommitted entries)."""
+        self._check()
+        toks = np.ascontiguousarray(tokens, dtype=np.uint64)
+        st = self._lib.ist_abort(
+            self._h,
+            toks.ctypes.data_as(ct.POINTER(ct.c_uint64)),
+            len(toks),
+        )
+        if st != OK:
+            raise InfiniStoreError(st, "abort failed")
 
     def refresh_pools(self):
         self._check()

@@ -1,0 +1,7 @@
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    decode_step,
+    init_params,
+    prefill,
+    train_step,
+)

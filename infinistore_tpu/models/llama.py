@@ -1,0 +1,241 @@
+"""Llama-style decoder with a paged KV cache — the flagship consumer of
+the store.
+
+The reference ships no model; its purpose is serving vLLM's paged KV
+blocks (reference docs/source/design.rst:54-63: the engine calls
+get_match_last_index / allocate / write / read layer by layer). This
+module provides the TPU-side engine stand-in used by benchmarks, tests
+and the graft entry: a GQA + RoPE + SwiGLU decoder (Llama-3-ish at
+miniature scale) whose KV cache lives in fixed-size pages — the exact
+unit the store transports — plus a jit-able training step for the
+multi-chip dry run.
+
+TPU-first choices: bf16 params with fp32 softmax/loss accumulation (MXU
+native), static shapes everywhere (page budgets are compile-time),
+functional pytree params (plain dicts — pjit/NamedSharding attach by leaf
+name, see parallel/mesh.py), no Python control flow inside jit.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.paged_attention import (
+    paged_decode_attention,
+    prefill_attention,
+    scatter_kv_to_pages,
+)
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 512
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    d_ff: int = 256
+    max_seq: int = 256
+    page_size: int = 16  # tokens per KV page (the store's transfer unit)
+    rope_theta: float = 10000.0
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+    def kv_page_shape(self):
+        """Shape of one K (or V) page for ONE layer — what goes into the
+        store as one block: [page_size, n_kv_heads, head_dim]."""
+        return (self.page_size, self.n_kv_heads, self.head_dim)
+
+    def kv_page_bytes(self):
+        import numpy as np
+
+        return int(np.prod(self.kv_page_shape())) * self.jdtype.itemsize
+
+
+def init_params(rng, cfg: LlamaConfig):
+    """Plain-dict pytree; leaf names match parallel.mesh sharding rules."""
+    dt = cfg.jdtype
+    keys = jax.random.split(rng, 2 + cfg.n_layers)
+    scale = cfg.d_model ** -0.5
+
+    def dense(k, shape):
+        return (jax.random.normal(k, shape) * scale).astype(dt)
+
+    layers = []
+    for li in range(cfg.n_layers):
+        k = jax.random.split(keys[2 + li], 7)
+        layers.append(
+            {
+                "ln1": jnp.ones(cfg.d_model, dtype=dt),
+                "wq": dense(k[0], (cfg.d_model, cfg.n_heads * cfg.head_dim)),
+                "wk": dense(k[1], (cfg.d_model, cfg.n_kv_heads * cfg.head_dim)),
+                "wv": dense(k[2], (cfg.d_model, cfg.n_kv_heads * cfg.head_dim)),
+                "wo": dense(k[3], (cfg.n_heads * cfg.head_dim, cfg.d_model)),
+                "ln2": jnp.ones(cfg.d_model, dtype=dt),
+                "w_gate": dense(k[4], (cfg.d_model, cfg.d_ff)),
+                "w_up": dense(k[5], (cfg.d_model, cfg.d_ff)),
+                "w_down": dense(k[6], (cfg.d_ff, cfg.d_model)),
+            }
+        )
+    return {
+        "embed": dense(keys[0], (cfg.vocab_size, cfg.d_model)),
+        "layers": layers,
+        "final_ln": jnp.ones(cfg.d_model, dtype=dt),
+        "lm_head": dense(keys[1], (cfg.d_model, cfg.vocab_size)),
+    }
+
+
+def rms_norm(x, w, eps=1e-5):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * w
+
+
+def rope(x, positions, theta):
+    """x: [..., seq, heads, hd]; positions broadcastable to [..., seq]."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = jnp.exp(
+        -jnp.log(theta) * jnp.arange(0, half, dtype=jnp.float32) / half
+    )
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., s, half]
+    cos = jnp.cos(angles)[..., None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[..., None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _qkv(layer, x, cfg, positions):
+    b = x.shape[0]
+    s = x.shape[1]
+    h = rms_norm(x, layer["ln1"])
+    q = (h @ layer["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = (h @ layer["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ layer["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _mlp(layer, x):
+    h = rms_norm(x, layer["ln2"])
+    return (jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])) @ layer[
+        "w_down"
+    ]
+
+
+def forward_dense(params, cfg: LlamaConfig, tokens):
+    """Dense causal forward (training / prefill compute). tokens:
+    [batch, seq] int32 → logits [batch, seq, vocab] (fp32)."""
+    b, s = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)
+    positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+    kvs = []
+    for layer in params["layers"]:
+        q, k, v = _qkv(layer, x, cfg, positions)
+        attn = prefill_attention(q, k, v, causal=True)
+        x = x + attn.reshape(b, s, -1) @ layer["wo"]
+        x = x + _mlp(layer, x)
+        kvs.append((k, v))
+    x = rms_norm(x, params["final_ln"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, kvs
+
+
+def prefill(params, cfg: LlamaConfig, tokens):
+    """Prefill: returns (logits, per-layer (k, v) arrays
+    [batch, seq, n_kv, hd]) — the KV to page out to the store."""
+    return forward_dense(params, cfg, tokens)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def decode_step(params, cfg: LlamaConfig, token, seq_lens, k_pages, v_pages,
+                page_table):
+    """One decode step over paged KV.
+
+    token:      [batch] int32 — current input token
+    seq_lens:   [batch] int32 — tokens already in cache (excl. current)
+    k_pages/v_pages: [n_layers, n_pages, page, n_kv, hd]
+    page_table: [batch, max_pages] int32
+
+    Returns (logits [batch, vocab] fp32, new k_pages, new v_pages). The
+    new token's KV is scattered into the page at seq_lens position.
+    """
+    b = token.shape[0]
+    x = jnp.take(params["embed"], token[:, None], axis=0)  # [b, 1, d]
+    positions = seq_lens[:, None]  # current position
+    page_idx_in_seq = seq_lens // cfg.page_size
+    target_page = jnp.take_along_axis(
+        page_table, page_idx_in_seq[:, None], axis=1
+    )[:, 0]
+    slot = seq_lens % cfg.page_size
+
+    new_k_pages, new_v_pages = [], []
+    for li, layer in enumerate(params["layers"]):
+        q, k, v = _qkv(layer, x, cfg, positions)
+        kp = scatter_kv_to_pages(k_pages[li], k, target_page, slot)
+        vp = scatter_kv_to_pages(v_pages[li], v, target_page, slot)
+        attn = paged_decode_attention(
+            q[:, 0], kp, vp, page_table, seq_lens + 1
+        )
+        x = x + attn.reshape(b, 1, -1) @ layer["wo"]
+        x = x + _mlp(layer, x)
+        new_k_pages.append(kp)
+        new_v_pages.append(vp)
+    x = rms_norm(x, params["final_ln"])
+    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    return logits, jnp.stack(new_k_pages), jnp.stack(new_v_pages)
+
+
+def loss_fn(params, cfg: LlamaConfig, tokens):
+    """Next-token cross-entropy (fp32 accumulation)."""
+    logits, _ = forward_dense(params, cfg, tokens[:, :-1])
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def train_step(params, opt_state, cfg: LlamaConfig, tokens, optimizer):
+    """One optimizer step (used by the multi-chip dry run; grads average
+    over the dp axis automatically under jit + NamedShardings)."""
+    loss, grads = jax.value_and_grad(loss_fn)(params, cfg, tokens)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = jax.tree_util.tree_map(
+        lambda p, u: (p + u).astype(p.dtype), params, updates
+    )
+    return params, opt_state, loss
+
+
+# ---------------------------------------------------------------------------
+# KV paging helpers: model pages ↔ store pages
+# ---------------------------------------------------------------------------
+
+def kv_to_pages(cfg: LlamaConfig, k, v):
+    """Split prefill KV [batch, seq, n_kv, hd] into store pages.
+
+    Returns (k_pages, v_pages) of shape [batch, n_pages, page, n_kv, hd]
+    with zero padding in the tail page — page-aligned exactly like the
+    store's fixed-size blocks."""
+    b, s, n_kv, hd = k.shape
+    n_pages = -(-s // cfg.page_size)
+    pad = n_pages * cfg.page_size - s
+    k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    shape = (b, n_pages, cfg.page_size, n_kv, hd)
+    return k.reshape(shape), v.reshape(shape)
+
+
+def page_keys(prefix, layer, kind, n_pages):
+    """Content-addressed store keys for a sequence's pages, one namespace
+    per (layer, k/v) — mirrors vLLM's per-layer block keys
+    (design.rst:54-63)."""
+    return [f"{prefix}/L{layer}/{kind}/p{i}" for i in range(n_pages)]

@@ -1,0 +1,6 @@
+from .paged_attention import (  # noqa: F401
+    gather_pages,
+    paged_decode_attention,
+    prefill_attention,
+    scatter_kv_to_pages,
+)

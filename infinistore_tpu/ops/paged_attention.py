@@ -1,0 +1,104 @@
+"""Paged-KV attention ops (XLA implementation).
+
+The reference is a KV *store*; the attention consuming those pages lives
+in the inference engine (vLLM). These ops are the TPU-side consumer the
+store was built for (BASELINE.json configs 3-5): KV lives in fixed-size
+pages addressed by a page table — the same unit the store moves — so
+offload/restore is a pure page-copy with no re-layout.
+
+Design for the MXU/XLA: everything is static-shaped; page gathering is a
+`jnp.take` (XLA gather, fuses with the following matmuls), masking is
+arithmetic (no dynamic control flow), softmax/matmuls run in fp32
+accumulation over bf16 operands. A pallas flash-decode kernel can replace
+`paged_decode_attention` later without changing callers.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def gather_pages(pages, page_indices):
+    """pages: [n_pages, page, ...]; page_indices: [batch, pages_per_seq]
+    → [batch, pages_per_seq, page, ...]."""
+    return jnp.take(pages, page_indices, axis=0)
+
+
+def scatter_kv_to_pages(pages, new_kv, page_indices, start_in_page):
+    """Write `new_kv` [batch, 1, n_kv, hd] (one decode step per sequence)
+    into `pages` at (page_indices[b], start_in_page[b]).
+
+    Functional update (XLA scatter): returns the new pages array. Batch
+    entries may target distinct pages; duplicate targets are undefined
+    (callers allocate one page per sequence tail, as vLLM does).
+    """
+    b = new_kv.shape[0]
+    flat_idx = page_indices  # [batch]
+    updated = pages.at[flat_idx, start_in_page].set(
+        new_kv[:, 0], mode="drop", unique_indices=False
+    )
+    del b
+    return updated
+
+
+def _repeat_kv(x, n_rep):
+    """GQA: repeat KV heads to match query heads.
+    x: [..., n_kv, hd] → [..., n_kv*n_rep, hd]."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=-2)
+
+
+def prefill_attention(q, k, v, causal=True):
+    """Dense causal attention for prefill.
+
+    q,k,v: [batch, seq, heads, hd] (k/v may have fewer heads — GQA).
+    Returns [batch, seq, heads, hd]. fp32 softmax accumulation.
+    """
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        s = q.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, seq_lens):
+    """Single-token decode attention over paged KV.
+
+    q:            [batch, n_heads, hd] (current-step queries)
+    k_pages/v_pages: [n_pages, page, n_kv, hd] (the store's page unit)
+    page_table:   [batch, max_pages] int32 page ids (padded arbitrarily)
+    seq_lens:     [batch] int32 — valid tokens per sequence (incl. current)
+
+    Returns [batch, n_heads, hd]. Static shapes throughout: max_pages is
+    the compile-time budget; invalid positions are masked arithmetically.
+    """
+    batch, n_heads, hd = q.shape
+    page = k_pages.shape[1]
+    n_kv = k_pages.shape[2]
+    max_pages = page_table.shape[1]
+    n_rep = n_heads // n_kv
+
+    k = gather_pages(k_pages, page_table)  # [b, mp, page, n_kv, hd]
+    v = gather_pages(v_pages, page_table)
+    k = k.reshape(batch, max_pages * page, n_kv, hd)
+    v = v.reshape(batch, max_pages * page, n_kv, hd)
+    k = _repeat_kv(k, n_rep)  # [b, T, n_heads, hd]
+    v = _repeat_kv(v, n_rep)
+
+    scale = hd ** -0.5
+    logits = jnp.einsum(
+        "bhd,bthd->bht", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    positions = jnp.arange(max_pages * page)[None, :]  # [1, T]
+    valid = positions < seq_lens[:, None]  # [b, T]
+    logits = jnp.where(valid[:, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bht,bthd->bhd", probs, v)

@@ -1,0 +1,6 @@
+from .mesh import (  # noqa: F401
+    MeshConfig,
+    make_mesh,
+    param_sharding_rules,
+    shard_params,
+)

@@ -1,0 +1,104 @@
+"""Device-mesh + sharding utilities for multi-chip serving and training.
+
+The reference store has no model parallelism (SURVEY.md §2: none of
+DP/TP/PP/SP/EP exist in bd-iaas-us/infiniStore) — its distributed story is
+client-side: many engines hitting one pool over RDMA. On TPU pods the
+engines themselves are SPMD programs over a `jax.sharding.Mesh`, so this
+module provides the mesh/sharding scaffolding those engine-side components
+(models/, ops/) use: a (dp, tp) mesh spanning ICI, NamedSharding rules for
+Llama-style parameters, and helpers to place a host pytree onto the mesh.
+
+Design per the scaling-book recipe: pick a mesh, annotate shardings with
+PartitionSpec, let XLA insert the collectives (psum/all-gather over ICI),
+profile, iterate. No hand-written collectives in the model code.
+"""
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass
+class MeshConfig:
+    dp: int = 1  # data parallel (outer axis: DCN-friendly)
+    tp: int = 1  # tensor parallel (inner axis: ICI-local)
+
+    @property
+    def n_devices(self):
+        return self.dp * self.tp
+
+
+def make_mesh(config: MeshConfig = None, devices=None) -> Mesh:
+    """Build a (dp, tp) mesh. With no config, uses all local devices as
+    tp=N (single-host serving default). Axis order puts dp outermost so a
+    multi-host mesh maps dp across DCN and tp within a pod's ICI."""
+    if devices is None:
+        devices = jax.devices()
+    if config is None:
+        config = MeshConfig(dp=1, tp=len(devices))
+    if config.n_devices != len(devices):
+        raise ValueError(
+            f"mesh {config.dp}x{config.tp} needs {config.n_devices} devices, "
+            f"got {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(config.dp, config.tp)
+    return Mesh(arr, axis_names=("dp", "tp"))
+
+
+def param_sharding_rules():
+    """PartitionSpec per parameter leaf-name for a Llama-style decoder.
+
+    Megatron-style TP: attention QKV and MLP up/gate are column-sharded
+    over heads/ffn (tp), attention-out and MLP down row-sharded so XLA
+    inserts one psum per block; embeddings/lm_head sharded over vocab.
+    Replicated elsewhere (norms, biases).
+    """
+    return {
+        "embed": P(None, "tp"),       # [vocab, d_model] — tp over d_model
+        "wq": P(None, "tp"),          # [d_model, n_heads*hd] col-parallel
+        "wk": P(None, "tp"),
+        "wv": P(None, "tp"),
+        "wo": P("tp", None),          # [n_heads*hd, d_model] row-parallel
+        "w_gate": P(None, "tp"),      # [d_model, d_ff]
+        "w_up": P(None, "tp"),
+        "w_down": P("tp", None),      # [d_ff, d_model]
+        "lm_head": P(None, "tp"),     # [d_model, vocab] — tp over vocab
+        "ln1": P(None),
+        "ln2": P(None),
+        "final_ln": P(None),
+    }
+
+
+def _leaf_spec(path, rules):
+    name = None
+    for p in reversed(path):
+        key = getattr(p, "key", None) or getattr(p, "name", None)
+        if key is not None:
+            name = str(key)
+            break
+    return rules.get(name, P())
+
+
+def param_shardings(mesh: Mesh, params):
+    """A pytree of NamedShardings matching `params` by leaf name."""
+    rules = param_sharding_rules()
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, _leaf_spec(path, rules)),
+        params,
+    )
+
+
+def shard_params(mesh: Mesh, params):
+    """Place a host-side parameter pytree onto the mesh."""
+    return jax.device_put(params, param_shardings(mesh, params))
+
+
+def data_sharding(mesh: Mesh):
+    """Batch-dim sharding for inputs (dp)."""
+    return NamedSharding(mesh, P("dp"))
+
+
+def replicated(mesh: Mesh):
+    return NamedSharding(mesh, P())

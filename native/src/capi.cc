@@ -182,18 +182,14 @@ uint32_t ist_read_async(void* h, uint32_t block_size, const uint8_t* keys_blob,
 }
 
 uint32_t ist_shm_write_async(void* h, uint32_t block_size, uint32_t n,
-                             const uint64_t* tokens, const RemoteBlock* blocks,
+                             const RemoteBlock* blocks,
                              const void* const* srcs, ist_callback cb,
                              void* ud) {
     auto* c = static_cast<Connection*>(h);
-    std::vector<uint64_t> toks;
-    for (uint32_t i = 0; i < n; ++i) {
-        if (tokens[i] != FAKE_TOKEN) toks.push_back(tokens[i]);
-    }
     std::vector<RemoteBlock> blks(blocks, blocks + n);
     std::vector<const void*> sp(srcs, srcs + n);
-    c->shm_write_async(block_size, std::move(toks), std::move(blks),
-                       std::move(sp), wrap_cb(cb, ud));
+    c->shm_write_async(block_size, std::move(blks), std::move(sp),
+                       wrap_cb(cb, ud));
     return OK;
 }
 
@@ -250,6 +246,23 @@ uint32_t ist_pin(void* h, const uint8_t* keys_blob, uint64_t blob_len,
     if (raw == nullptr || n != nkeys) return INTERNAL_ERROR;
     memcpy(out, raw, size_t(n) * sizeof(RemoteBlock));
     return OK;
+}
+
+// Abort uncommitted tokens (undo a partially-failed batch allocate so the
+// keys become writable again instead of permanently dedup-poisoned).
+uint32_t ist_abort(void* h, const uint64_t* tokens, uint32_t n) {
+    auto* c = static_cast<Connection*>(h);
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    uint32_t real = 0;
+    for (uint32_t i = 0; i < n; ++i) {
+        if (tokens[i] != FAKE_TOKEN) real++;
+    }
+    w.u32(real);
+    for (uint32_t i = 0; i < n; ++i) {
+        if (tokens[i] != FAKE_TOKEN) w.u64(tokens[i]);
+    }
+    return c->rpc(OP_ABORT, std::move(body), nullptr);
 }
 
 uint32_t ist_release(void* h, uint64_t lease) {

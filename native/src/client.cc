@@ -337,7 +337,6 @@ void Connection::read_async(uint32_t block_size,
 }
 
 void Connection::shm_write_async(uint32_t block_size,
-                                 std::vector<uint64_t> tokens,
                                  std::vector<RemoteBlock> blocks,
                                  std::vector<const void*> srcs, DoneFn done) {
     inflight_++;
@@ -346,11 +345,10 @@ void Connection::shm_write_async(uint32_t block_size,
         finish_op();
         return;
     }
-    auto toks = std::make_shared<std::vector<uint64_t>>(std::move(tokens));
     auto blks = std::make_shared<std::vector<RemoteBlock>>(std::move(blocks));
     auto sp = std::make_shared<std::vector<const void*>>(std::move(srcs));
     Submit s;
-    s.fn = [this, block_size, toks, blks, sp, done = std::move(done)]() mutable {
+    s.fn = [this, block_size, blks, sp, done = std::move(done)]() mutable {
         // One-sided copies into the mapped pool (CUDA-IPC memcpy analogue,
         // reference write_cache infinistore.cpp:702-804 — but client-side).
         // A block in a pool this client has not mapped (server extended
@@ -365,8 +363,12 @@ void Connection::shm_write_async(uint32_t block_size,
             for (size_t i = 0; i < blks->size(); ++i) {
                 const RemoteBlock& b = (*blks)[i];
                 if (b.token == FAKE_TOKEN) continue;  // dedup: skip
+                // Bounds: inside the mapped pool AND inside the allocated
+                // entry — a page larger than the allocation must fail, not
+                // overwrite the neighbouring keys' blocks.
                 if (b.pool_idx < pools_.size() &&
-                    b.offset + block_size <= pools_[b.pool_idx].size) {
+                    b.offset + block_size <= pools_[b.pool_idx].size &&
+                    block_size <= b.size) {
                     memcpy(pools_[b.pool_idx].base + b.offset, (*sp)[i],
                            block_size);
                     ok_toks.push_back(b.token);
@@ -425,33 +427,78 @@ void Connection::shm_read_async(uint32_t block_size,
             uint64_t lease = r.u64();
             uint32_t n = r.u32();
             const uint8_t* raw = r.raw(size_t(n) * sizeof(RemoteBlock));
-            uint32_t st = OK;
-            if (raw == nullptr || n != dp->size()) {
-                st = INTERNAL_ERROR;
-            } else {
-                std::lock_guard<std::mutex> lk(pools_mu_);
-                for (uint32_t i = 0; i < n; ++i) {
-                    RemoteBlock blk;
-                    memcpy(&blk, raw + i * sizeof(RemoteBlock), sizeof(blk));
-                    if (blk.pool_idx < pools_.size() &&
-                        blk.offset + block_size <= pools_[blk.pool_idx].size) {
-                        memcpy((*dp)[i], pools_[blk.pool_idx].base + blk.offset,
-                               block_size);
-                    } else {
-                        st = INTERNAL_ERROR;
+            auto blks = std::make_shared<std::vector<RemoteBlock>>();
+            bool parse_ok = raw != nullptr && n == dp->size();
+            if (parse_ok) {
+                blks->resize(n);
+                memcpy(blks->data(), raw, size_t(n) * sizeof(RemoteBlock));
+            }
+            // The copy step, shared between the direct path and the
+            // retry-after-HELLO path (server may have auto-extended into
+            // pools we haven't mapped yet).
+            auto do_copy = std::make_shared<std::function<void()>>();
+            *do_copy = [this, block_size, dp, blks, lease, parse_ok,
+                        done]() mutable {
+                uint32_t st = parse_ok ? OK : INTERNAL_ERROR;
+                if (parse_ok) {
+                    std::lock_guard<std::mutex> lk(pools_mu_);
+                    for (size_t i = 0; i < blks->size(); ++i) {
+                        const RemoteBlock& blk = (*blks)[i];
+                        if (blk.size < block_size) {
+                            // Entry smaller than the requested page:
+                            // mirror the STREAM path's KEY_NOT_FOUND
+                            // (server.cc op_read size check).
+                            st = KEY_NOT_FOUND;
+                        } else if (blk.pool_idx < pools_.size() &&
+                                   blk.offset + block_size <=
+                                       pools_[blk.pool_idx].size) {
+                            memcpy((*dp)[i],
+                                   pools_[blk.pool_idx].base + blk.offset,
+                                   block_size);
+                        } else {
+                            st = INTERNAL_ERROR;
+                        }
                     }
                 }
+                // Fire-and-forget release; the lease served its purpose.
+                std::vector<uint8_t> rbody;
+                BufWriter rw(rbody);
+                rw.u64(lease);
+                Pending rel;
+                rel.op = OP_RELEASE;
+                rel.done = [](uint32_t, std::vector<uint8_t>) {};
+                enqueue_msg(OP_RELEASE, std::move(rbody), {}, std::move(rel));
+                if (done) done(st, {});
+                finish_op();
+            };
+            bool need_refresh = false;
+            if (parse_ok) {
+                std::lock_guard<std::mutex> lk(pools_mu_);
+                for (const RemoteBlock& blk : *blks) {
+                    if (blk.pool_idx >= pools_.size()) need_refresh = true;
+                }
             }
-            // Fire-and-forget release; the pin lease has served its purpose.
-            std::vector<uint8_t> rbody;
-            BufWriter rw(rbody);
-            rw.u64(lease);
-            Pending rel;
-            rel.op = OP_RELEASE;
-            rel.done = [](uint32_t, std::vector<uint8_t>) {};
-            enqueue_msg(OP_RELEASE, std::move(rbody), {}, std::move(rel));
-            if (done) done(st, {});
-            finish_op();
+            if (!need_refresh) {
+                (*do_copy)();
+                return;
+            }
+            // Refresh the pool table inline on the IO thread (a sync rpc
+            // here would deadlock — responses complete on this thread).
+            Pending hp;
+            hp.op = OP_HELLO;
+            hp.done = [this, do_copy](uint32_t hst, std::vector<uint8_t> hb) {
+                if (hst == OK) {
+                    BufReader hr(hb.data(), hb.size());
+                    hr.u32();  // block size
+                    uint32_t shm_enabled = hr.u32();
+                    if (shm_enabled) {
+                        std::lock_guard<std::mutex> lk(pools_mu_);
+                        map_pools_locked(hr);
+                    }
+                }
+                (*do_copy)();
+            };
+            enqueue_msg(OP_HELLO, {}, {}, std::move(hp));
         };
         enqueue_msg(OP_PIN, std::move(body), {}, std::move(pend));
     };

@@ -89,8 +89,7 @@ class Connection {
     // --- SHM path ---
     // One-sided memcpy into mapped pool blocks + OP_COMMIT. Runs the copy
     // on the IO thread so the async API never blocks the caller.
-    void shm_write_async(uint32_t block_size, std::vector<uint64_t> tokens,
-                         std::vector<RemoteBlock> blocks,
+    void shm_write_async(uint32_t block_size, std::vector<RemoteBlock> blocks,
                          std::vector<const void*> srcs, DoneFn done);
     // OP_PIN → memcpy out → OP_RELEASE.
     void shm_read_async(uint32_t block_size, std::vector<std::string> keys,

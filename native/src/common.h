@@ -56,6 +56,7 @@ enum Op : uint8_t {
     OP_PURGE = 11,           // drop all committed+uncommitted entries
     OP_STATS = 12,           // JSON stats blob
     OP_DELETE = 13,          // drop specific keys
+    OP_ABORT = 14,           // abort uncommitted tokens (partial-alloc undo)
 };
 
 // ---------------------------------------------------------------------------
@@ -101,8 +102,10 @@ struct RemoteBlock {
     uint32_t pool_idx;
     uint64_t token;
     uint64_t offset;
+    uint64_t size;  // allocated block size — lets one-sided SHM clients
+                    // bounds-check their copies against the entry
 };
 #pragma pack(pop)
-static_assert(sizeof(RemoteBlock) == 24, "RemoteBlock must be packed");
+static_assert(sizeof(RemoteBlock) == 32, "RemoteBlock must be packed");
 
 }  // namespace istpu

@@ -11,6 +11,7 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
         out->pool_idx = 0;
         out->token = FAKE_TOKEN;
         out->offset = 0;
+        out->size = 0;
         return CONFLICT;
     }
     PoolLoc loc;
@@ -19,6 +20,7 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
         out->pool_idx = 0;
         out->token = FAKE_TOKEN;
         out->offset = 0;
+        out->size = 0;
         return OUT_OF_MEMORY;
     }
     auto block = std::make_shared<Block>(mm_, loc, size);
@@ -29,6 +31,7 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
     out->pool_idx = loc.pool_idx;
     out->token = token;
     out->offset = loc.offset;
+    out->size = size;
     return OK;
 }
 

@@ -1,16 +1,47 @@
 #include "mempool.h"
 
+#include <dirent.h>
 #include <fcntl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <cerrno>
+#include <csignal>
 #include <cstdlib>
 #include <stdexcept>
 
 #include "log.h"
 
 namespace istpu {
+
+// Names follow "istpu_<pid>_<port>[_idx]". Returns true when the embedded
+// pid no longer exists (safe to reclaim). Unknown formats → false (never
+// reclaim what we can't attribute).
+bool shm_owner_dead(const std::string& name) {
+    if (name.rfind("istpu_", 0) != 0) return false;
+    size_t start = 6;
+    size_t end = name.find('_', start);
+    if (end == std::string::npos) return false;
+    pid_t pid = pid_t(atoll(name.substr(start, end - start).c_str()));
+    if (pid <= 0) return false;
+    if (kill(pid, 0) == 0) return false;       // alive
+    return errno == ESRCH;                      // definitely gone
+}
+
+// Best-effort sweep of /dev/shm for pools left by crashed servers.
+void reclaim_stale_pools() {
+    DIR* d = opendir("/dev/shm");
+    if (d == nullptr) return;
+    while (dirent* e = readdir(d)) {
+        std::string n = e->d_name;
+        if (n.rfind("istpu_", 0) == 0 && shm_owner_dead(n)) {
+            IST_INFO("removing stale pool shm %s", n.c_str());
+            shm_unlink(("/" + n).c_str());
+        }
+    }
+    closedir(d);
+}
 
 MemoryPool::MemoryPool(size_t pool_size, size_t block_size,
                        const std::string& shm_name)
@@ -26,10 +57,23 @@ MemoryPool::MemoryPool(size_t pool_size, size_t block_size,
     if (!shm_name_.empty()) {
         std::string path = "/" + shm_name_;
         shm_fd_ = shm_open(path.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
-        if (shm_fd_ < 0) {
-            // Stale object from a crashed server: replace it.
-            shm_unlink(path.c_str());
-            shm_fd_ = shm_open(path.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+        if (shm_fd_ < 0 && errno == EEXIST) {
+            // Name collision. Only reclaim it if it belongs to a DEAD
+            // process (names embed the owner pid: istpu_<pid>_...);
+            // unlinking a live server's pool would silently corrupt its
+            // clients' mappings.
+            if (shm_owner_dead(shm_name_)) {
+                IST_WARN("reclaiming stale shm %s from dead owner",
+                         shm_name_.c_str());
+                shm_unlink(path.c_str());
+                shm_fd_ =
+                    shm_open(path.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+            } else {
+                throw std::runtime_error(
+                    "shm object " + path +
+                    " exists and its owner is alive (pick another "
+                    "shm_prefix/port)");
+            }
         }
         if (shm_fd_ < 0) throw std::runtime_error("shm_open failed: " + path);
         if (ftruncate(shm_fd_, (off_t)pool_size_) != 0) {

@@ -22,6 +22,12 @@
 
 namespace istpu {
 
+// Reclaim /dev/shm pool objects whose owner pid is dead (crashed servers;
+// run at server start). Names embed the owner pid so live pools are never
+// touched.
+void reclaim_stale_pools();
+bool shm_owner_dead(const std::string& name);
+
 class MemoryPool {
    public:
     // pool_size is rounded up to a multiple of block_size. If shm_name is

@@ -22,6 +22,7 @@ const char* op_name(uint8_t op) {
         case OP_PURGE: return "PURGE";
         case OP_STATS: return "STATS";
         case OP_DELETE: return "DELETE";
+        case OP_ABORT: return "ABORT";
         default: return "UNKNOWN";
     }
 }

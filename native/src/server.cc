@@ -36,14 +36,20 @@ void tune_socket(int fd) {
 
 Server::Server(const ServerConfig& cfg) : cfg_(cfg) {
     if (cfg_.shm_prefix.empty() && cfg_.enable_shm) {
+        // pid + process-wide serial: several servers in one process (tests,
+        // sharded deployments) and ephemeral ports must not collide.
+        static std::atomic<uint64_t> serial{0};
         cfg_.shm_prefix = "istpu_" + std::to_string(getpid()) + "_" +
-                          std::to_string(cfg_.port);
+                          std::to_string(cfg_.port) + "_" +
+                          std::to_string(serial.fetch_add(1));
     }
 }
 
 Server::~Server() { stop(); }
 
 bool Server::start() {
+    // Crashed predecessors may have left multi-GB pools in /dev/shm.
+    if (cfg_.enable_shm) reclaim_stale_pools();
     // Pool construction first — this is the slow, once-per-process part
     // (reference: MemoryPool ctor malloc+pin+ibv_reg_mr, mempool.cpp:13-46).
     try {
@@ -114,8 +120,13 @@ void Server::stop() {
     if (epoll_fd_ >= 0) close(epoll_fd_);
     if (wake_fd_ >= 0) close(wake_fd_);
     listen_fd_ = epoll_fd_ = wake_fd_ = -1;
-    index_.reset();
-    mm_.reset();
+    {
+        // Control-plane threads may still be inside kvmap_len/stats;
+        // serialize teardown with them.
+        std::lock_guard<std::mutex> lk(store_mu_);
+        index_.reset();
+        mm_.reset();
+    }
 }
 
 size_t Server::kvmap_len() {
@@ -460,6 +471,7 @@ void Server::handle_message(Conn& c) {
         case OP_RELEASE: op_release(c); break;
         case OP_CHECK_EXIST: op_check_exist(c); break;
         case OP_GET_MATCH_LAST_IDX: op_match(c); break;
+        case OP_ABORT: op_abort(c); break;
         case OP_SYNC:
         case OP_PURGE:
         case OP_STATS:
@@ -595,6 +607,28 @@ void Server::op_commit(Conn& c) {
     respond(c, c.hdr.seq, OP_COMMIT, std::move(body));
 }
 
+void Server::op_abort(Conn& c) {
+    BufReader r(c.body.data(), c.body.size());
+    uint32_t n = r.u32();
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    if (!r.ok() || n > MAX_KEYS_PER_OP) {
+        w.u32(BAD_REQUEST);
+        respond(c, c.hdr.seq, OP_ABORT, std::move(body));
+        return;
+    }
+    {
+        std::lock_guard<std::mutex> lk(store_mu_);
+        for (uint32_t i = 0; i < n && r.ok(); ++i) {
+            uint64_t tok = r.u64();
+            index_->abort(tok);
+            c.open_tokens.erase(tok);
+        }
+    }
+    w.u32(r.ok() ? OK : BAD_REQUEST);
+    respond(c, c.hdr.seq, OP_ABORT, std::move(body));
+}
+
 void Server::op_pin(Conn& c) {
     BufReader r(c.body.data(), c.body.size());
     std::vector<std::string> keys;
@@ -622,6 +656,7 @@ void Server::op_pin(Conn& c) {
             b.pool_idx = e->block->loc.pool_idx;
             b.token = 0;
             b.offset = e->block->loc.offset;
+            b.size = e->size;
             blocks.push_back(b);
             refs.push_back(e->block);
         }

@@ -138,6 +138,7 @@ class Server {
     void op_allocate(Conn& c);
     void op_read(Conn& c);
     void op_commit(Conn& c);
+    void op_abort(Conn& c);
     void op_pin(Conn& c);
     void op_release(Conn& c);
     void op_check_exist(Conn& c);

@@ -1,0 +1,115 @@
+"""Regression tests for safety properties found in review: size-mismatch
+rejection (no cross-key corruption), partial-OOM rollback, and stale-shm
+hygiene."""
+
+import uuid
+
+import numpy as np
+import pytest
+
+from infinistore_tpu import (
+    ClientConfig,
+    InfiniStoreError,
+    InfiniStoreServer,
+    InfinityConnection,
+    ServerConfig,
+)
+
+
+def key():
+    return str(uuid.uuid4())
+
+
+def test_write_larger_than_allocation_rejected(conn, rng):
+    """allocate 4 KB then write a 16 KB page must error, not overwrite
+    neighbouring keys' blocks."""
+    k = key()
+    blocks = conn.allocate([k], 4096)  # bytes
+    big = rng.random(4096).astype(np.float32)  # 16 KB
+    with pytest.raises(ValueError):
+        conn.write_cache(big, [0], 4096, blocks)  # 4096 f32 = 16 KB page
+
+
+def test_read_larger_than_allocation_rejected(conn, rng):
+    """Reading more than the committed entry's size must fail like a
+    missing key, not leak adjacent pool bytes."""
+    from infinistore_tpu import InfiniStoreKeyNotFound
+
+    k = key()
+    src = rng.random(1024).astype(np.uint8)
+    blocks = conn.allocate([k], 1024)
+    conn.write_cache(src, [0], 1024, blocks)
+    conn.sync()
+    big_dst = np.zeros(4096, dtype=np.uint8)
+    with pytest.raises((InfiniStoreKeyNotFound, InfiniStoreError)):
+        conn.read_cache(big_dst, [(k, 0)], 4096)
+
+
+def test_partial_oom_allocate_rolls_back():
+    """A batch allocate that hits OOM must abort its successful part so
+    the keys stay writable on retry (no dedup poisoning)."""
+    srv = InfiniStoreServer(
+        ServerConfig(
+            service_port=0,
+            prealloc_size=(128 << 10) / (1 << 30),  # 128 KB → 8 x 16 KB blocks
+            minimal_allocate_size=16,
+        )
+    )
+    srv.start()
+    try:
+        conn = InfinityConnection(
+            ClientConfig(host_addr="127.0.0.1", service_port=srv.service_port)
+        )
+        conn.connect()
+        try:
+            keys = [f"oom_{i}" for i in range(12)]  # 12 x 16 KB > 128 KB
+            with pytest.raises(InfiniStoreError):
+                conn.allocate(keys, 16 << 10)
+            # Rollback freed everything: the same keys allocate cleanly now.
+            blocks = conn.allocate(keys[:8], 16 << 10)
+            assert (blocks["status"] == 200).all()
+            assert (blocks["token"] != 0).all()  # real allocations, not dedup
+            src = np.zeros(8 * (16 << 10), dtype=np.uint8)
+            conn.write_cache(
+                src, [i * (16 << 10) for i in range(8)], 16 << 10, blocks
+            )
+            conn.sync()
+            assert conn.check_exist(keys[0])
+        finally:
+            conn.close()
+    finally:
+        srv.stop()
+
+
+def test_two_servers_distinct_shm(tmp_path):
+    """Two live servers must not steal each other's shm pools."""
+    cfg = dict(
+        service_port=0, prealloc_size=0.01, minimal_allocate_size=16
+    )
+    s1 = InfiniStoreServer(ServerConfig(**cfg))
+    s1.start()
+    s2 = InfiniStoreServer(ServerConfig(**cfg))
+    s2.start()
+    try:
+        for srv in (s1, s2):
+            conn = InfinityConnection(
+                ClientConfig(
+                    host_addr="127.0.0.1", service_port=srv.service_port
+                )
+            )
+            conn.connect()
+            k = key()
+            src = np.arange(1024, dtype=np.uint8)
+            b = conn.allocate([k], 1024)
+            conn.write_cache(src, [0], 1024, b)
+            conn.sync()
+            dst = np.zeros_like(src)
+            conn.read_cache(dst, [(k, 0)], 1024)
+            conn.sync()
+            assert np.array_equal(src, dst)
+            conn.close()
+        # Keys are isolated per server.
+        assert s1.kvmap_len() == 1 and s2.kvmap_len() == 1
+    finally:
+        s1.stop()
+        s2.stop()
