@@ -4,3 +4,4 @@ from .paged_attention import (  # noqa: F401
     prefill_attention,
     scatter_kv_to_pages,
 )
+from .ring_attention import make_sp_mesh, ring_attention  # noqa: F401

@@ -1,0 +1,137 @@
+"""Ring attention: sequence-parallel exact attention over the device mesh.
+
+Long-context support is first-class in this framework: sequences whose KV
+exceeds one chip's HBM are sharded over a mesh axis, and attention runs
+as a ring — each step computes a local block while `ppermute` rotates the
+KV shard to the neighbour over ICI, overlapping compute with transfer.
+Combined with the store, this is the full long-context story: the store
+holds paged KV beyond HBM (capacity), ring attention computes over
+sequence shards (bandwidth/FLOPs).
+
+Implementation: `shard_map` over the 'sp' mesh axis; online-softmax
+(log-sum-exp) accumulation in fp32 so the result is exactly standard
+attention regardless of ring order; `jax.lax.ppermute` for the rotation
+(XLA schedules it on ICI concurrently with the matmuls); `lax.fori_loop`
+keeps the ring a compiled loop, not unrolled Python.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _block_attn(q, k, v, mask):
+    """One (q-block, kv-block) pass → (unnormalized out, lse stats).
+
+    q: [b, sq, h, d], k/v: [b, sk, h, d], mask: [sq, sk] additive fp32.
+    Returns out [b, sq, h, d] (fp32, unnormalized), m/l [b, sq, h] (fp32):
+    running max and sum-exp for online softmax combination.
+    """
+    scale = q.shape[-1] ** -0.5
+    logits = (
+        jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    )
+    logits = logits + mask[None, None]
+    # Clamp the row max so a fully-masked block (all -inf: a KV block
+    # entirely in this query block's future) yields p == 0 rather than
+    # exp(-inf - -inf) == NaN.
+    m = jnp.maximum(jnp.max(logits, axis=-1), -1e30)  # [b, h, sq]
+    p = jnp.exp(logits - m[..., None])
+    l = jnp.sum(p, axis=-1)  # [b, h, sq]
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return out.astype(jnp.float32), m.transpose(0, 2, 1), l.transpose(0, 2, 1)
+
+
+def _combine(acc_out, acc_m, acc_l, out, m, l):
+    """Online-softmax merge of two partial attention results. All ms are
+    finite (>= -1e30 via the clamp in _block_attn / the -1e30 init)."""
+    new_m = jnp.maximum(acc_m, m)
+    a = jnp.exp(acc_m - new_m)
+    b = jnp.exp(m - new_m)
+    new_out = acc_out * a[..., None].transpose(0, 1, 2, 3) + out * b[..., None]
+    new_l = acc_l * a + l * b
+    return new_out, new_m, new_l
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True):
+    """Exact causal attention with sequence sharded over `axis`.
+
+    q, k, v: [batch, seq, heads, hd] GLOBAL arrays (sharded or replicated;
+    they are re-placed to seq-sharded). seq must divide by the axis size.
+    Returns [batch, seq, heads, hd] with the same sharding as q.
+    """
+    n_shards = mesh.shape[axis]
+    b, s, h, d = q.shape
+    if s % n_shards:
+        raise ValueError(f"seq {s} not divisible by {axis}={n_shards}")
+    blk = s // n_shards
+    kv_heads = k.shape[2]
+    if kv_heads != h:  # GQA: expand before sharding (simple, correct)
+        k = jnp.repeat(k, h // kv_heads, axis=2)
+        v = jnp.repeat(v, h // kv_heads, axis=2)
+
+    seq_sharded = NamedSharding(mesh, P(None, axis))
+    q = jax.device_put(q, seq_sharded)
+    k = jax.device_put(k, seq_sharded)
+    v = jax.device_put(v, seq_sharded)
+
+    def local(q_blk, k_blk, v_blk):
+        # q_blk/k_blk/v_blk: [b, blk, h, d] — this shard's block.
+        idx = jax.lax.axis_index(axis)  # which sequence block we own
+        rows = idx * blk + jnp.arange(blk)  # global q positions
+
+        # Derive the accumulators from q_blk so they carry the same
+        # varying-over-'sp' type as the loop outputs (shard_map's typed
+        # carries reject constant/unvarying initials).
+        zero = q_blk.astype(jnp.float32) * 0.0  # [b, blk, h, d]
+        acc_out = zero
+        acc_m = zero[..., 0] - 1e30  # [b, blk, h]; finite (see _combine)
+        acc_l = zero[..., 0]
+
+        def body(step, carry):
+            acc_out, acc_m, acc_l, k_cur, v_cur = carry
+            # KV block currently held: originated at shard (idx - step).
+            src = (idx - step) % n_shards
+            cols = src * blk + jnp.arange(blk)
+            if causal:
+                mask = jnp.where(
+                    rows[:, None] >= cols[None, :], 0.0, -jnp.inf
+                ).astype(jnp.float32)
+            else:
+                mask = jnp.zeros((blk, blk), dtype=jnp.float32)
+            out, mm, ll = _block_attn(q_blk, k_cur, v_cur, mask)
+            # Merge only when at least one pair is unmasked; the -inf rows
+            # contribute zero weight through the lse combine anyway.
+            acc_out, acc_m, acc_l = _combine(acc_out, acc_m, acc_l, out, mm, ll)
+            # Rotate KV around the ring (ICI neighbour exchange).
+            perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            return acc_out, acc_m, acc_l, k_nxt, v_nxt
+
+        acc_out, acc_m, acc_l, _, _ = jax.lax.fori_loop(
+            0, n_shards, body, (acc_out, acc_m, acc_l, k_blk, v_blk)
+        )
+        # Normalize; fully-masked rows (l==0) can't occur for causal
+        # self-attention (each row attends at least to itself).
+        out = acc_out / acc_l[..., None]
+        return out.astype(q_blk.dtype)
+
+    shard_fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis),
+    )
+    return shard_fn(q, k, v)
+
+
+def make_sp_mesh(n=None):
+    """A 1-axis sequence-parallel mesh over local devices."""
+    devs = jax.devices() if n is None else jax.devices()[:n]
+    import numpy as np
+
+    return Mesh(np.asarray(devs), axis_names=("sp",))

@@ -1,0 +1,165 @@
+"""Sharded multi-server store client (beyond reference parity).
+
+BASELINE.json config 5 calls for "multi-server sharded store over DCN" —
+Llama-70B-scale KV working sets exceed one host's DRAM. The reference is
+strictly single-server; scale-out is this framework's extension
+(SURVEY.md §7 step 7), done entirely client-side so the server stays the
+simple single-pool process: keys are routed to shards by stable hash, and
+every data-path call fans out per-shard with one connection each.
+
+Semantics preserved across shards:
+- allocate/write/read/sync: partitioned per shard; sync barriers all.
+- check_exist: routed to the owning shard.
+- get_match_last_index: the monotone binary search runs client-side with
+  check_exist probes (the server-side search, infinistore.cpp:1092-1108,
+  only sees its own shard; probing preserves the exact reference
+  semantics at log2(n) round trips).
+- first-writer-wins dedup: per key, inherited from the owning shard.
+"""
+
+import hashlib
+
+import numpy as np
+
+from ._native import FAKE_TOKEN, REMOTE_BLOCK_DTYPE
+from .config import ClientConfig
+from .lib import InfinityConnection
+
+
+def _shard_of(key, n):
+    # Stable across processes/runs (Python's hash() is salted).
+    return int.from_bytes(
+        hashlib.blake2b(key.encode(), digest_size=8).digest(), "little"
+    ) % n
+
+
+class ShardedConnection:
+    """Same call surface as InfinityConnection, fanned over N servers.
+
+    ``configs``: list of ClientConfig, one per shard (order defines the
+    shard map — all clients must use the same order).
+    """
+
+    def __init__(self, configs):
+        if not configs:
+            raise ValueError("need at least one shard config")
+        self.conns = [InfinityConnection(c) for c in configs]
+        self.n = len(configs)
+        self.connected = False
+
+    def connect(self):
+        for c in self.conns:
+            c.connect()
+        self.connected = True
+        return 0
+
+    def close(self):
+        for c in self.conns:
+            c.close()
+        self.connected = False
+
+    def __enter__(self):
+        self.connect()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def shard_of(self, key):
+        return _shard_of(key, self.n)
+
+    # -- partitioned data path -----------------------------------------
+
+    def _partition(self, keys):
+        """→ per-shard (indices, keys) preserving input order per shard."""
+        parts = {}
+        for i, k in enumerate(keys):
+            parts.setdefault(_shard_of(k, self.n), ([], []))
+            parts[_shard_of(k, self.n)][0].append(i)
+            parts[_shard_of(k, self.n)][1].append(k)
+        return parts
+
+    def allocate(self, keys, page_size_in_bytes):
+        """Batch allocate across shards. Returns RemoteBlocks in input
+        order; use with this class's write_cache (which re-partitions
+        identically)."""
+        out = np.zeros(len(keys), dtype=REMOTE_BLOCK_DTYPE)
+        for shard, (idxs, ks) in self._partition(keys).items():
+            blocks = self.conns[shard].allocate(ks, page_size_in_bytes)
+            out[np.asarray(idxs)] = blocks
+        return out
+
+    def write_cache(self, cache, offsets, page_size, remote_blocks, keys):
+        """Write pages to their owning shards. ``keys`` must be the same
+        list passed to allocate (defines the routing)."""
+        blocks = np.ascontiguousarray(remote_blocks, dtype=REMOTE_BLOCK_DTYPE)
+        for shard, (idxs, _ks) in self._partition(keys).items():
+            sel = np.asarray(idxs)
+            self.conns[shard].write_cache(
+                cache, [offsets[i] for i in idxs], page_size, blocks[sel]
+            )
+        return 0
+
+    def put(self, cache, blocks, page_size):
+        """One-call sharded put of (key, offset) pairs (allocate + write)."""
+        keys = [k for k, _ in blocks]
+        offsets = [o for _, o in blocks]
+        esize = cache.itemsize if hasattr(cache, "itemsize") else 1
+        rb = self.allocate(keys, page_size * esize)
+        self.write_cache(cache, offsets, page_size, rb, keys)
+        return rb
+
+    def read_cache(self, cache, blocks, page_size):
+        """Read (key, offset) pairs from their owning shards."""
+        parts = {}
+        for k, off in blocks:
+            parts.setdefault(_shard_of(k, self.n), []).append((k, off))
+        for shard, pairs in parts.items():
+            self.conns[shard].read_cache(cache, pairs, page_size)
+        return 0
+
+    def sync(self):
+        for c in self.conns:
+            c.sync()
+        return 0
+
+    # -- control plane -------------------------------------------------
+
+    def check_exist(self, key):
+        return self.conns[_shard_of(key, self.n)].check_exist(key)
+
+    def get_match_last_index(self, keys):
+        """Reference-exact monotone binary search (probing across shards).
+
+        Matches infinistore.cpp:1092-1108 behaviorally, including the
+        quirk that uncommitted entries count — our probe is check_exist,
+        which does NOT count uncommitted entries; for the sharded client
+        we accept the stricter (committed-only) probe since cross-host
+        readers can only use committed pages anyway.
+        """
+        left, right = 0, len(keys)
+        while left < right:
+            mid = left + (right - left) // 2
+            if self.check_exist(keys[mid]):
+                left = mid + 1
+            else:
+                right = mid
+        if left - 1 < 0:
+            raise Exception("can't find a match")
+        return left - 1
+
+    def purge(self):
+        return sum(c.purge() for c in self.conns)
+
+    def delete_keys(self, keys):
+        n = 0
+        for shard, (_idxs, ks) in self._partition(keys).items():
+            n += self.conns[shard].delete_keys(ks)
+        return n
+
+    def stats(self):
+        return [c.stats() for c in self.conns]
+
+
+__all__ = ["ShardedConnection"]

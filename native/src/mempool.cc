@@ -7,6 +7,7 @@
 #include <unistd.h>
 
 #include <cerrno>
+#include <cstring>
 #include <csignal>
 #include <cstdlib>
 #include <stdexcept>
@@ -44,7 +45,7 @@ void reclaim_stale_pools() {
 }
 
 MemoryPool::MemoryPool(size_t pool_size, size_t block_size,
-                       const std::string& shm_name)
+                       const std::string& shm_name, bool prefault)
     : block_size_(block_size), shm_name_(shm_name) {
     if (block_size == 0 || (block_size & (block_size - 1)) != 0) {
         throw std::invalid_argument("block_size must be a power of two");
@@ -97,8 +98,25 @@ MemoryPool::MemoryPool(size_t pool_size, size_t block_size,
     }
     // Pinning analogue of cudaHostRegister (reference mempool.cpp:29-45):
     // best-effort, RLIMIT_MEMLOCK may forbid it.
-    if (mlock(base_, pool_size_) != 0) {
+    bool pinned = mlock(base_, pool_size_) == 0;
+    if (!pinned) {
         IST_DEBUG("mlock of %zu bytes declined (continuing unpinned)", pool_size_);
+        if (prefault) {
+            // Pre-fault the arena: without it the first write to every
+            // page eats a soft fault on the data path (measured ~2x put
+            // throughput loss on a cold pool). MADV_POPULATE_WRITE fails
+            // with an error (instead of the SIGBUS a manual zero-write
+            // would take) when the backing tmpfs cannot commit the full
+            // size — lazy faulting then remains the behavior, matching
+            // the pre-prefault semantics.
+#ifdef MADV_POPULATE_WRITE
+            if (madvise(base_, pool_size_, MADV_POPULATE_WRITE) != 0) {
+                IST_WARN("prefault of %zu MB declined (%s); first-touch "
+                         "faults will show up on the data path",
+                         pool_size_ >> 20, strerror(errno));
+            }
+#endif
+        }
     }
     IST_INFO("pool ready: %zu MB, block %zu KB, shm=%s", pool_size_ >> 20,
              block_size_ >> 10, shm_name_.empty() ? "<anon>" : shm_name_.c_str());
@@ -196,8 +214,8 @@ MM::MM(size_t initial_size, size_t block_size, const std::string& shm_prefix,
       extend_size_(extend_size ? extend_size : initial_size) {
     std::string name =
         shm_prefix_.empty() ? std::string() : shm_prefix_ + "_0";
-    pools_.emplace_back(
-        std::make_unique<MemoryPool>(initial_size, block_size_, name));
+    pools_.emplace_back(std::make_unique<MemoryPool>(
+        initial_size, block_size_, name, /*prefault=*/true));
 }
 
 bool MM::allocate(size_t size, PoolLoc* out) {
@@ -232,8 +250,11 @@ bool MM::add_pool(size_t size) {
                            ? std::string()
                            : shm_prefix_ + "_" + std::to_string(pools_.size());
     try {
-        pools_.emplace_back(
-            std::make_unique<MemoryPool>(size, block_size_, name));
+        // No prefault: extensions are built on the serving path (under the
+        // server's store mutex); spreading the fault cost over writes
+        // beats stalling every client for the zero-fill.
+        pools_.emplace_back(std::make_unique<MemoryPool>(
+            size, block_size_, name, /*prefault=*/false));
         IST_INFO("extended to %zu pools (%zu MB total)", pools_.size(),
                  total_bytes() >> 20);
         return true;

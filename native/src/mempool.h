@@ -33,7 +33,8 @@ class MemoryPool {
     // pool_size is rounded up to a multiple of block_size. If shm_name is
     // non-empty the arena is a POSIX shm object with that name (without
     // leading '/'); otherwise anonymous private memory (unit tests).
-    MemoryPool(size_t pool_size, size_t block_size, const std::string& shm_name);
+    MemoryPool(size_t pool_size, size_t block_size,
+               const std::string& shm_name, bool prefault = false);
     ~MemoryPool();
 
     MemoryPool(const MemoryPool&) = delete;
