@@ -110,6 +110,12 @@ def _decls(lib):
              c.POINTER(c.c_void_p), CALLBACK, c.c_void_p],
         ),
         (
+            "ist_put_async",
+            c.c_uint32,
+            [c.c_void_p, c.c_uint32, c.c_char_p, c.c_uint64, c.c_uint32,
+             c.POINTER(c.c_void_p), CALLBACK, c.c_void_p],
+        ),
+        (
             "ist_read_async",
             c.c_uint32,
             [c.c_void_p, c.c_uint32, c.c_char_p, c.c_uint64, c.c_uint32,

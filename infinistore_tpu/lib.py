@@ -364,25 +364,93 @@ class InfinityConnection:
 
     write_cache_async = rdma_write_cache_async
 
+    def _put_async_native(self, cache, blocks, page_size, cb):
+        """One-call put of (key, offset) pairs.
+
+        STREAM path: a single OP_PUT round trip (server allocates, scatters
+        the payload into the pool and commits — the same 1-RTT shape as the
+        reference's local rw_local, infinistore.cpp:702-804).
+        SHM path: allocate rpc + one-sided memcpy + commit (2 RTTs but the
+        bulk bytes never cross a socket)."""
+        arr = _as_src_array(cache)
+        esize = arr.itemsize
+        page_bytes = page_size * esize
+        keys = [k for k, _ in blocks]
+        if self.shm_connected:
+            # allocate + one-sided memcpy + commit; _write_async_native
+            # does the offset validation.
+            remote_blocks = self.allocate(keys, page_bytes)
+            offsets = [off for _, off in blocks]
+            self._write_async_native(
+                cache, offsets, page_size, remote_blocks, cb
+            )
+            return
+        base = arr.ctypes.data
+        nbytes = arr.nbytes
+        srcs = []
+        for _, off in blocks:
+            byte_off = off * esize
+            if byte_off < 0 or byte_off + page_bytes > nbytes:
+                raise ValueError("offset out of tensor bounds")
+            srcs.append(base + byte_off)
+        n = len(srcs)
+        blob = pack_keys(keys)
+        src_arr = (ct.c_void_p * n)(*srcs)
+        ka = self._keep(cb, (arr, blob, src_arr))
+        st = self._lib.ist_put_async(
+            self._h, page_bytes, blob, len(blob), n, src_arr, ka.c_cb, None
+        )
+        if st != OK:
+            self._drop_keep(ka.kid)
+            raise InfiniStoreError(st, "put submit failed")
+
+    def put_cache(self, cache, blocks, page_size):
+        """Synchronous one-call put of (key, offset) pairs."""
+        self._check()
+        done = threading.Event()
+        result = {}
+
+        def cb(status):
+            result["status"] = status
+            done.set()
+
+        self._put_async_native(cache, blocks, page_size, cb)
+        if not done.wait(self.config.timeout_ms / 1000):
+            raise InfiniStoreError(TIMEOUT_ERR, "put timed out")
+        if result["status"] != OK:
+            raise InfiniStoreError(result["status"], "put failed")
+        return 0
+
+    async def put_cache_async(self, cache, blocks, page_size):
+        self._check()
+        if self.shm_connected:
+            # The SHM put needs a blocking allocate rpc first — run it off
+            # the event loop, then the async one-sided write.
+            keys = [k for k, _ in blocks]
+            esize = _as_src_array(cache).itemsize
+            remote_blocks = await self.allocate_async(keys, page_size * esize)
+            offsets = [off for _, off in blocks]
+            return await self.write_cache_async(
+                cache, offsets, page_size, remote_blocks
+            )
+        loop = asyncio.get_running_loop()
+        future = loop.create_future()
+
+        def cb(status):
+            loop.call_soon_threadsafe(_finish_future, future, status, "put")
+
+        self._put_async_native(cache, blocks, page_size, cb)
+        return await future
+
     def local_gpu_write_cache(self, cache, blocks, page_size):
         """One-call write of (key, offset) pairs: allocate + write + the
         allocate-side dedup, mirroring the reference local path
         (lib.py:360-394 → server write_cache infinistore.cpp:702-804)."""
         self._check()
-        keys = [k for k, _ in blocks]
-        offsets = [off for _, off in blocks]
-        esize = _as_src_array(cache).itemsize
-        remote_blocks = self.allocate(keys, page_size * esize)
-        self.write_cache(cache, offsets, page_size, remote_blocks)
-        return 0
+        return self.put_cache(cache, blocks, page_size)
 
     async def local_gpu_write_cache_async(self, cache, blocks, page_size):
-        keys = [k for k, _ in blocks]
-        offsets = [off for _, off in blocks]
-        esize = _as_src_array(cache).itemsize
-        remote_blocks = await self.allocate_async(keys, page_size * esize)
-        await self.write_cache_async(cache, offsets, page_size, remote_blocks)
-        return 0
+        return await self.put_cache_async(cache, blocks, page_size)
 
     # ------------------------------------------------------------------
     # read

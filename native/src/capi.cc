@@ -170,6 +170,18 @@ uint32_t ist_write_async(void* h, uint32_t block_size, uint32_t n,
     return OK;
 }
 
+uint32_t ist_put_async(void* h, uint32_t block_size,
+                       const uint8_t* keys_blob, uint64_t blob_len,
+                       uint32_t nkeys, const void* const* srcs,
+                       ist_callback cb, void* ud) {
+    auto* c = static_cast<Connection*>(h);
+    std::vector<std::string> keys;
+    if (!parse_keys(keys_blob, blob_len, nkeys, &keys)) return BAD_REQUEST;
+    std::vector<const void*> sp(srcs, srcs + nkeys);
+    c->put_async(block_size, std::move(keys), std::move(sp), wrap_cb(cb, ud));
+    return OK;
+}
+
 uint32_t ist_read_async(void* h, uint32_t block_size, const uint8_t* keys_blob,
                         uint64_t blob_len, uint32_t nkeys, void* const* dsts,
                         ist_callback cb, void* ud) {

@@ -299,6 +299,51 @@ void Connection::write_async(uint32_t block_size, std::vector<uint64_t> tokens,
     wake();
 }
 
+void Connection::put_async(uint32_t block_size,
+                           std::vector<std::string> keys,
+                           std::vector<const void*> srcs, DoneFn done) {
+    // One-RTT streamed put: allocate+write+commit server-side (OP_PUT).
+    // Dedup'd keys' payload is sunk by the server (first-writer-wins).
+    inflight_++;
+    if (broken_.load() || !running_.load()) {
+        if (done) done(INTERNAL_ERROR, {});
+        finish_op();
+        return;
+    }
+    uint64_t payload = uint64_t(block_size) * srcs.size();
+    auto ks = std::make_shared<std::vector<std::string>>(std::move(keys));
+    auto sp = std::make_shared<std::vector<const void*>>(std::move(srcs));
+    Submit s;
+    s.window_cost = payload;
+    s.fn = [this, block_size, ks, sp, payload,
+            done = std::move(done)]() mutable {
+        std::vector<uint8_t> body;
+        BufWriter w(body);
+        w.u32(block_size);
+        w.keys(*ks);
+        std::vector<std::pair<const uint8_t*, size_t>> segs;
+        segs.reserve(sp->size());
+        for (const void* p : *sp) {
+            segs.emplace_back(static_cast<const uint8_t*>(p), block_size);
+        }
+        Pending pend;
+        pend.op = OP_PUT;
+        pend.payload_bytes = payload;
+        pend.done = [this, sp, done = std::move(done)](
+                        uint32_t status, std::vector<uint8_t> b) {
+            if (done) done(status, std::move(b));
+            finish_op();
+        };
+        enqueue_msg(OP_PUT, std::move(body), std::move(segs),
+                    std::move(pend));
+    };
+    {
+        std::lock_guard<std::mutex> lk(submit_mu_);
+        submits_.push_back(std::move(s));
+    }
+    wake();
+}
+
 void Connection::read_async(uint32_t block_size,
                             std::vector<std::string> keys,
                             std::vector<void*> dsts, DoneFn done) {

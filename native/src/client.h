@@ -82,6 +82,10 @@ class Connection {
     void write_async(uint32_t block_size, std::vector<uint64_t> tokens,
                      std::vector<const void*> srcs, DoneFn done);
 
+    // --- streamed one-RTT put: allocate+write+commit (OP_PUT) ---
+    void put_async(uint32_t block_size, std::vector<std::string> keys,
+                   std::vector<const void*> srcs, DoneFn done);
+
     // --- streamed read (STREAM path get, server-push) ---
     void read_async(uint32_t block_size, std::vector<std::string> keys,
                     std::vector<void*> dsts, DoneFn done);

@@ -57,6 +57,7 @@ enum Op : uint8_t {
     OP_STATS = 12,           // JSON stats blob
     OP_DELETE = 13,          // drop specific keys
     OP_ABORT = 14,           // abort uncommitted tokens (partial-alloc undo)
+    OP_PUT = 15,             // streamed allocate+write+commit in one RTT
 };
 
 // ---------------------------------------------------------------------------

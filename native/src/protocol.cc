@@ -23,6 +23,7 @@ const char* op_name(uint8_t op) {
         case OP_STATS: return "STATS";
         case OP_DELETE: return "DELETE";
         case OP_ABORT: return "ABORT";
+        case OP_PUT: return "PUT";
         default: return "UNKNOWN";
     }
 }

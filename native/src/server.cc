@@ -14,6 +14,7 @@
 #include <cstring>
 
 #include "log.h"
+#include "utils.h"
 
 namespace istpu {
 
@@ -48,6 +49,7 @@ Server::Server(const ServerConfig& cfg) : cfg_(cfg) {
 Server::~Server() { stop(); }
 
 bool Server::start() {
+    install_crash_handler();
     // Crashed predecessors may have left multi-GB pools in /dev/shm.
     if (cfg_.enable_shm) reclaim_stale_pools();
     // Pool construction first — this is the slow, once-per-process part
@@ -141,18 +143,38 @@ size_t Server::purge() {
 
 std::string Server::stats_json() {
     std::lock_guard<std::mutex> lk(store_mu_);
-    char buf[512];
-    snprintf(buf, sizeof(buf),
-             "{\"kvmap_len\": %zu, \"inflight\": %zu, \"leases\": %zu, "
-             "\"pools\": %zu, \"pool_bytes\": %zu, \"used_bytes\": %zu, "
-             "\"ops\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu, "
-             "\"connections\": %zu}",
-             index_ ? index_->size() : 0, index_ ? index_->inflight() : 0,
-             index_ ? index_->leases() : 0, mm_ ? mm_->num_pools() : 0,
-             mm_ ? mm_->total_bytes() : 0, mm_ ? mm_->used_bytes() : 0,
-             (unsigned long long)ops_.load(),
-             (unsigned long long)bytes_in_.load(),
-             (unsigned long long)bytes_out_.load(), size_t(n_conns_.load()));
+    char buf[2048];
+    int off = snprintf(
+        buf, sizeof(buf),
+        "{\"kvmap_len\": %zu, \"inflight\": %zu, \"leases\": %zu, "
+        "\"pools\": %zu, \"pool_bytes\": %zu, \"used_bytes\": %zu, "
+        "\"ops\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu, "
+        "\"connections\": %zu, \"op_stats\": {",
+        index_ ? index_->size() : 0, index_ ? index_->inflight() : 0,
+        index_ ? index_->leases() : 0, mm_ ? mm_->num_pools() : 0,
+        mm_ ? mm_->total_bytes() : 0, mm_ ? mm_->used_bytes() : 0,
+        (unsigned long long)ops_.load(),
+        (unsigned long long)bytes_in_.load(),
+        (unsigned long long)bytes_out_.load(), size_t(n_conns_.load()));
+    // Per-op handler-time table (the reference logs per-op latency ad hoc,
+    // infinistore.cpp:1114,1162-1166; here it is queryable).
+    bool first = true;
+    for (int op = 1; op < kMaxOp; ++op) {
+        uint64_t n = op_count_[op].load(std::memory_order_relaxed);
+        if (n == 0) continue;
+        char entry[128];
+        int w = snprintf(entry, sizeof(entry),
+                         "%s\"%s\": {\"count\": %llu, \"total_us\": %llu}",
+                         first ? "" : ", ", op_name(uint8_t(op)),
+                         (unsigned long long)n,
+                         (unsigned long long)op_us_[op].load(
+                             std::memory_order_relaxed));
+        if (w < 0 || off + w >= int(sizeof(buf)) - 3) break;  // keep valid JSON
+        memcpy(buf + off, entry, size_t(w));
+        off += w;
+        first = false;
+    }
+    snprintf(buf + off, sizeof(buf) - size_t(off), "}}");
     return buf;
 }
 
@@ -413,7 +435,13 @@ void Server::respond(Conn& c, uint64_t seq, uint8_t op,
 
 void Server::handle_message(Conn& c) {
     ops_++;
+    long long t0 = now_us();
+    c.op_t0 = t0;
     uint8_t op = c.hdr.op;
+    if (op == OP_PUT) {
+        begin_put(c);
+        return;
+    }
     // WRITE transitions to payload scatter; everything else handles inline.
     if (op == OP_WRITE) {
         BufReader r(c.body.data(), c.body.size());
@@ -483,26 +511,104 @@ void Server::handle_message(Conn& c) {
             respond(c, c.hdr.seq, op, std::move(body));
         }
     }
+    account_op(op, now_us() - t0);
     c.state = RState::HDR;
     c.hdr_got = 0;
 }
 
-void Server::finish_write(Conn& c) {
-    // Commit everything that landed (two-phase visibility: entries become
-    // readable only now, after the bytes are in the pool).
-    uint32_t committed = 0;
+void Server::account_op(uint8_t op, long long us) {
+    if (op >= kMaxOp) return;
+    op_count_[op].fetch_add(1, std::memory_order_relaxed);
+    op_us_[op].fetch_add(uint64_t(us), std::memory_order_relaxed);
+}
+
+void Server::begin_put(Conn& c) {
+    // Body: u32 block_size, keys. Allocates on the spot; duplicate keys
+    // (first-writer-wins dedup) sink their payload slice. Reference
+    // analogue: the local path's one-call write with server-side
+    // allocate+dedup (infinistore.cpp:732-754).
+    BufReader r(c.body.data(), c.body.size());
+    uint32_t block_size = r.u32();
+    std::vector<std::string> keys;
+    r.keys(&keys);
+    bool ok = r.ok() && block_size > 0 &&
+              c.hdr.payload_len == uint64_t(keys.size()) * block_size;
+    c.wdest.clear();
+    c.wtokens.clear();
+    c.wblock_size = block_size;
+    if (!ok) {
+        c.payload_left = c.hdr.payload_len;
+        c.state = RState::DRAIN;
+        c.hdr_got = 0;
+        std::vector<uint8_t> body;
+        BufWriter w(body);
+        w.u32(BAD_REQUEST);
+        respond(c, c.hdr.seq, OP_PUT, std::move(body));
+        return;
+    }
+    if (c.sink.size() < block_size) c.sink.resize(block_size);
+    c.wput_oom = false;
     {
         std::lock_guard<std::mutex> lk(store_mu_);
-        for (uint64_t tok : c.wtokens) {
-            if (index_->commit(tok) == OK) committed++;
-            c.open_tokens.erase(tok);
+        for (auto& k : keys) {
+            RemoteBlock b;
+            Status st = index_->allocate(k, block_size, &b);
+            if (st == OK) {
+                c.wtokens.push_back(b.token);
+                c.open_tokens.insert(b.token);
+                uint32_t sz = 0;
+                uint8_t* dst = index_->write_dest(b.token, &sz);
+                c.wdest.emplace_back(dst, block_size);
+            } else {
+                // Dedup (CONFLICT): sink this key's slice, first writer
+                // wins. OOM: sink too, but fail the whole op below so the
+                // client sees the loss (all-or-nothing like the
+                // allocate+write path).
+                if (st == OUT_OF_MEMORY) c.wput_oom = true;
+                c.wdest.emplace_back(c.sink.data(), block_size);
+            }
+        }
+        mm_->maybe_extend();
+    }
+    c.payload_left = c.hdr.payload_len;
+    c.wseg = 0;
+    c.wseg_off = 0;
+    c.state = RState::PAYLOAD;
+    if (c.payload_left == 0) finish_write(c);
+}
+
+void Server::finish_write(Conn& c) {
+    uint32_t committed = 0;
+    bool fail_oom = c.hdr.op == OP_PUT && c.wput_oom;
+    {
+        std::lock_guard<std::mutex> lk(store_mu_);
+        if (fail_oom) {
+            // All-or-nothing: some keys of this PUT could not be
+            // allocated, so abort the ones that could — a partial commit
+            // would be invisible data loss behind an error the caller
+            // might retry wholesale.
+            for (uint64_t tok : c.wtokens) {
+                index_->abort(tok);
+                c.open_tokens.erase(tok);
+            }
+        } else {
+            // Commit everything that landed (two-phase visibility:
+            // entries become readable only now, after the bytes are in
+            // the pool).
+            for (uint64_t tok : c.wtokens) {
+                if (index_->commit(tok) == OK) committed++;
+                c.open_tokens.erase(tok);
+            }
         }
     }
     std::vector<uint8_t> body;
     BufWriter w(body);
-    w.u32(OK);
+    w.u32(fail_oom ? OUT_OF_MEMORY : OK);
     w.u32(committed);
-    respond(c, c.hdr.seq, OP_WRITE, std::move(body));
+    respond(c, c.hdr.seq, c.hdr.op, std::move(body));
+    // Handler time spans parse + allocate + payload scatter + commit
+    // (op_t0 stashed when the message header was handled).
+    account_op(c.hdr.op, now_us() - c.op_t0);
     c.state = RState::HDR;
     c.hdr_got = 0;
 }

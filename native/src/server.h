@@ -99,7 +99,7 @@ class Server {
         size_t hdr_got = 0;
         std::vector<uint8_t> body;
         size_t body_got = 0;
-        // OP_WRITE scatter plan.
+        // OP_WRITE / OP_PUT scatter plan.
         std::vector<std::pair<uint8_t*, uint32_t>> wdest;  // (ptr,size)
         std::vector<uint64_t> wtokens;
         uint32_t wblock_size = 0;
@@ -109,6 +109,8 @@ class Server {
         std::deque<OutMsg> outq;
         bool want_write = false;
         bool dead = false;  // fatal error; closed after unwinding
+        bool wput_oom = false;  // OP_PUT hit OOM: fail all-or-nothing
+        long long op_t0 = 0;    // message arrival time (op_stats)
         // Per-connection sink for payload of unknown/purged tokens; sized
         // before pointer capture and never resized mid-scatter.
         std::vector<uint8_t> sink;
@@ -125,7 +127,8 @@ class Server {
     bool flush_out(Conn& c);  // false => fatal error, close
     void close_conn(int fd);
     void handle_message(Conn& c);  // full header+body (non-WRITE) received
-    void finish_write(Conn& c);    // WRITE payload fully scattered
+    void finish_write(Conn& c);    // WRITE/PUT payload fully scattered
+    void begin_put(Conn& c);       // parse OP_PUT body, build scatter plan
     void update_epoll(Conn& c);
 
     void respond(Conn& c, uint64_t seq, uint8_t op,
@@ -166,7 +169,11 @@ class Server {
     std::atomic<uint64_t> n_conns_{0};  // stats-safe connection count
 
     // stats
+    static constexpr int kMaxOp = 32;
+    void account_op(uint8_t op, long long us);
     std::atomic<uint64_t> ops_{0}, bytes_in_{0}, bytes_out_{0};
+    std::atomic<uint64_t> op_count_[kMaxOp] = {};
+    std::atomic<uint64_t> op_us_[kMaxOp] = {};
 };
 
 }  // namespace istpu

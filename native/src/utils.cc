@@ -1,0 +1,54 @@
+#include "utils.h"
+
+#include <execinfo.h>
+#include <signal.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <initializer_list>
+
+namespace istpu {
+
+namespace {
+
+void crash_handler(int sig) {
+    // async-signal-safe-ish: write + backtrace_symbols_fd only.
+    const char msg[] = "\n=== infinistore-tpu crash backtrace ===\n";
+    ssize_t r = write(STDERR_FILENO, msg, sizeof(msg) - 1);
+    (void)r;
+    void* frames[64];
+    int n = backtrace(frames, 64);
+    backtrace_symbols_fd(frames, n, STDERR_FILENO);
+    // Restore default and re-raise so the process dies with the right
+    // status (reference re-raises too, utils.cpp:115-122).
+    signal(sig, SIG_DFL);
+    raise(sig);
+}
+
+}  // namespace
+
+void install_crash_handler() {
+    static std::atomic<bool> installed{false};
+    if (installed.exchange(true)) return;
+    // Prime backtrace(): glibc lazily dlopens libgcc (malloc!) on first
+    // use, which is not async-signal-safe inside the handler.
+    void* prime[4];
+    backtrace(prime, 4);
+    for (int sig : {SIGSEGV, SIGBUS, SIGABRT}) {
+        struct sigaction sa {};
+        sa.sa_handler = crash_handler;
+        sigemptyset(&sa.sa_mask);
+        sa.sa_flags = SA_RESETHAND;
+        sigaction(sig, &sa, nullptr);
+    }
+}
+
+long long now_us() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (long long)ts.tv_sec * 1000000 + ts.tv_nsec / 1000;
+}
+
+}  // namespace istpu

@@ -1,0 +1,21 @@
+// utils.h — process-level helpers (C9 in SURVEY.md §2).
+//
+// Parity target: reference src/utils.{h,cpp}: send_exact/recv_exact (ours
+// live in client.cc), CHECK_CUDA abort macro (no CUDA here), and the
+// crash signal_handler that dumps a boost::stacktrace
+// (utils.cpp:115-122, installed at server/client setup,
+// infinistore.cpp:1264-1268, libinfinistore.cpp:496-500). We use glibc
+// backtrace() instead of boost.
+#pragma once
+
+namespace istpu {
+
+// Install SIGSEGV/SIGBUS/SIGABRT handlers that dump a native backtrace to
+// stderr and then re-raise with default disposition (so exit codes and
+// core dumps behave normally). Idempotent.
+void install_crash_handler();
+
+// Monotonic microseconds (per-op latency accounting).
+long long now_us();
+
+}  // namespace istpu

@@ -1,0 +1,58 @@
+"""OP_PUT all-or-nothing on OOM: a streamed put that cannot allocate every
+key must fail visibly and leave no partial state."""
+
+import numpy as np
+import pytest
+
+from infinistore_tpu import (
+    ClientConfig,
+    InfiniStoreError,
+    InfiniStoreServer,
+    InfinityConnection,
+    ServerConfig,
+    TYPE_STREAM,
+)
+
+
+def test_put_oom_all_or_nothing():
+    srv = InfiniStoreServer(
+        ServerConfig(
+            service_port=0,
+            prealloc_size=(64 << 10) / (1 << 30),  # 64 KB = 4 x 16 KB blocks
+            minimal_allocate_size=16,
+        )
+    )
+    srv.start()
+    try:
+        conn = InfinityConnection(
+            ClientConfig(
+                host_addr="127.0.0.1",
+                service_port=srv.service_port,
+                connection_type=TYPE_STREAM,
+            )
+        )
+        conn.connect()
+        try:
+            page = 16 << 10
+            keys = [f"poom_{i}" for i in range(6)]  # 6 x 16 KB > 64 KB pool
+            src = np.zeros(6 * page, dtype=np.uint8)
+            with pytest.raises(InfiniStoreError):
+                conn.put_cache(
+                    src, [(k, i * page) for i, k in enumerate(keys)], page
+                )
+            # Nothing committed, nothing leaked uncommitted.
+            for k in keys:
+                assert not conn.check_exist(k)
+            assert srv.kvmap_len() == 0
+            # A fitting put on the same keys now succeeds.
+            conn.put_cache(
+                src[: 4 * page],
+                [(k, i * page) for i, k in enumerate(keys[:4])],
+                page,
+            )
+            conn.sync()
+            assert all(conn.check_exist(k) for k in keys[:4])
+        finally:
+            conn.close()
+    finally:
+        srv.stop()

@@ -1,0 +1,81 @@
+"""One-call put (OP_PUT streamed / SHM composed) + per-op stats tests."""
+
+import asyncio
+import uuid
+
+import numpy as np
+import pytest
+
+
+def key():
+    return str(uuid.uuid4())
+
+
+def test_put_cache_roundtrip(conn, rng):
+    page = 1024
+    n = 6
+    src = rng.random(page * n).astype(np.float32)
+    keys = [key() for _ in range(n)]
+    conn.put_cache(src, [(k, i * page) for i, k in enumerate(keys)], page)
+    conn.sync()
+    dst = np.zeros_like(src)
+    conn.read_cache(dst, [(k, i * page) for i, k in enumerate(keys)], page)
+    conn.sync()
+    assert np.array_equal(src, dst)
+
+
+def test_put_cache_dedup_first_writer_wins(conn, rng):
+    """OP_PUT must preserve dedup: a second put of the same key sinks its
+    payload server-side (reference first-writer-wins semantics)."""
+    page = 512
+    first = rng.random(page).astype(np.float32)
+    second = rng.random(page).astype(np.float32)
+    k = key()
+    conn.put_cache(first, [(k, 0)], page)
+    conn.sync()
+    conn.put_cache(second, [(k, 0)], page)
+    conn.sync()
+    dst = np.zeros_like(first)
+    conn.read_cache(dst, [(k, 0)], page)
+    conn.sync()
+    assert np.array_equal(dst, first)
+
+
+def test_put_cache_async(conn, rng):
+    async def run():
+        page = 256
+        src = rng.random(page * 4).astype(np.float32)
+        keys = [key() for _ in range(4)]
+        await asyncio.gather(
+            *[
+                conn.put_cache_async(
+                    src[i * page : (i + 1) * page], [(keys[i], 0)], page
+                )
+                for i in range(4)
+            ]
+        )
+        await conn.sync_async()
+        ok = True
+        for i, k in enumerate(keys):
+            dst = np.zeros(page, dtype=np.float32)
+            await conn.read_cache_async(dst, [(k, 0)], page)
+            ok = ok and np.array_equal(dst, src[i * page : (i + 1) * page])
+        await conn.sync_async()
+        return ok
+
+    assert asyncio.run(run())
+
+
+def test_op_stats_exposed(conn, rng):
+    page = 256
+    src = rng.random(page).astype(np.float32)
+    k = key()
+    conn.put_cache(src, [(k, 0)], page)
+    conn.sync()
+    s = conn.stats()
+    assert "op_stats" in s
+    assert any(
+        op in s["op_stats"] for op in ("PUT", "COMMIT", "ALLOCATE")
+    ), s["op_stats"]
+    for entry in s["op_stats"].values():
+        assert entry["count"] > 0 and entry["total_us"] >= 0
