@@ -23,10 +23,10 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.paged_attention import (
-    paged_decode_attention,
     prefill_attention,
     scatter_kv_to_pages,
 )
+from ..ops.pallas_paged_attention import decode_attention as paged_decode_attention
 
 
 @dataclass(frozen=True)
