@@ -88,6 +88,10 @@ class ServerConfig:
         self.extend_size = kwargs.get("extend_size", 1)  # GB per extension
         self.enable_shm = kwargs.get("enable_shm", True)
         self.shm_prefix = kwargs.get("shm_prefix", "")
+        # LRU-evict cold committed entries instead of returning OOM
+        # (beyond reference parity; off by default to match reference
+        # first-writer-wins-forever semantics).
+        self.enable_eviction = kwargs.get("enable_eviction", False)
         # Accepted for reference CLI compatibility; unused on TPU hosts.
         self.dev_name = kwargs.get("dev_name", "")
         self.link_type = kwargs.get("link_type", "")

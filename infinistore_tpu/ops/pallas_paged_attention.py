@@ -46,6 +46,21 @@ def _kernel(page_tbl_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
     seq_len = seq_lens_ref[b]
     start = j * page_size
 
+    @pl.when(start < seq_len)
+    def _step():
+        _attend(page_tbl_ref, seq_lens_ref, q_ref, k_ref, v_ref,
+                acc_ref, m_ref, l_ref, page_size=page_size, n_kv=n_kv,
+                hd=hd, n_heads=n_heads, scale=scale, start=start,
+                seq_len=seq_len)
+
+    @pl.when(j == n_pages - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def _attend(page_tbl_ref, seq_lens_ref, q_ref, k_ref, v_ref,
+            acc_ref, m_ref, l_ref, *, page_size, n_kv, hd, n_heads, scale,
+            start, seq_len):
     q = q_ref[0]  # [H, D] padded
     kv = k_ref[0].reshape(page_size, n_kv, hd)  # [P, n_kv, D]
     vv = v_ref[0].reshape(page_size, n_kv, hd)
@@ -94,10 +109,6 @@ def _kernel(page_tbl_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
     m_ref[...] = m_new
     l_ref[...] = l_prev * alpha + l_cur
 
-    @pl.when(j == n_pages - 1)
-    def _finish():
-        o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
-
 
 def _pad_to(x, axis, mult):
     size = x.shape[axis]
@@ -123,15 +134,22 @@ def paged_flash_decode(q, k_pages, v_pages, page_table, seq_lens,
     n_pages, page_size, n_kv, _ = k_pages.shape
     max_pages = page_table.shape[1]
 
-    # Pad to TPU tile boundaries: lanes (last dim) 128, sublanes 8.
+    # Pad to TPU tile boundaries: lanes (last dim) 128; sublane multiple
+    # is dtype-dependent (8 for f32, 16 for bf16 — pallas guide tiling
+    # table).
+    sublane = 16 if q.dtype == jnp.bfloat16 else 8
     q_p, _ = _pad_to(q, 2, 128)
     k_p, _ = _pad_to(k_pages, 3, 128)
     v_p, _ = _pad_to(v_pages, 3, 128)
     hd_p = q_p.shape[2]
     group = n_heads // n_kv
-    # Pad kv heads so n_heads_p = n_kv_p * group is a sublane multiple of 8.
-    kv_pad = (-(n_kv * group)) % 8
-    n_kv_p = n_kv + (kv_pad + group - 1) // group if kv_pad else n_kv
+    # Pad kv heads so n_heads_p = n_kv_p * group is a sublane multiple:
+    # n_kv_p must be a multiple of sublane/gcd(group, sublane) (works for
+    # any group size, incl. ones that don't divide the sublane count).
+    import math as _math
+
+    kv_mult = sublane // _math.gcd(group, sublane)
+    n_kv_p = ((n_kv + kv_mult - 1) // kv_mult) * kv_mult
     if n_kv_p != n_kv:
         k_p = jnp.pad(k_p, ((0, 0), (0, 0), (0, n_kv_p - n_kv), (0, 0)))
         v_p = jnp.pad(v_p, ((0, 0), (0, 0), (0, n_kv_p - n_kv), (0, 0)))
@@ -142,19 +160,23 @@ def paged_flash_decode(q, k_pages, v_pages, page_table, seq_lens,
     k_f = k_p.reshape(n_pages, page_size, n_kv_p * hd_p)
     v_f = v_p.reshape(n_pages, page_size, n_kv_p * hd_p)
 
+    def _page_idx(b, j, pt, sl):
+        # Clamp against the table contract ("padded arbitrarily" — the XLA
+        # path's jnp.take clamps OOB ids) AND freeze j at the sequence's
+        # last used page: when consecutive grid steps map to the same
+        # block index, pallas elides the re-fetch, so pages past
+        # seq_len cost no HBM traffic.
+        last_used = jnp.maximum(sl[b] - 1, 0) // page_size
+        jj = jnp.minimum(j, last_used)
+        return (jnp.clip(pt[b, jj], 0, n_pages - 1), 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # page_table, seq_lens
         grid=(batch, max_pages),
         in_specs=[
             pl.BlockSpec((1, n_heads_p, hd_p), lambda b, j, pt, sl: (b, 0, 0)),
-            pl.BlockSpec(
-                (1, page_size, n_kv_p * hd_p),
-                lambda b, j, pt, sl: (pt[b, j], 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, page_size, n_kv_p * hd_p),
-                lambda b, j, pt, sl: (pt[b, j], 0, 0),
-            ),
+            pl.BlockSpec((1, page_size, n_kv_p * hd_p), _page_idx),
+            pl.BlockSpec((1, page_size, n_kv_p * hd_p), _page_idx),
         ],
         out_specs=pl.BlockSpec(
             (1, n_heads_p, hd_p), lambda b, j, pt, sl: (b, 0, 0)

@@ -51,6 +51,7 @@ class InfiniStoreServer:
             int(cfg.extend_size * (1 << 30)),
             1 if cfg.enable_shm else 0,
             cfg.shm_prefix.encode(),
+            1 if cfg.enable_eviction else 0,
         )
         port = self._lib.ist_server_start(self._h)
         if port < 0:
@@ -190,6 +191,9 @@ def parse_args(argv=None):
                    help="GB added per auto-increase")
     p.add_argument("--no-shm", action="store_true",
                    help="disable the same-host shared-memory path")
+    p.add_argument("--enable-eviction", action="store_true",
+                   help="LRU-evict cold committed entries when the pool "
+                        "is full (instead of failing allocations)")
     p.add_argument("--warmup", action="store_true",
                    help="run a warmup round-trip after startup")
     p.add_argument("--no-oom-protect", action="store_true")
@@ -208,6 +212,7 @@ def main(argv=None):
         auto_increase=args.auto_increase,
         extend_size=args.extend_size,
         enable_shm=not args.no_shm,
+        enable_eviction=args.enable_eviction,
     )
     server = InfiniStoreServer(config)
     server.start()

@@ -15,7 +15,12 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
         return CONFLICT;
     }
     PoolLoc loc;
-    if (!mm_->allocate(size, &loc)) {
+    bool got = mm_->allocate(size, &loc);
+    if (!got && eviction_) {
+        // Make room from the cold end of the cache, then retry once.
+        if (evict_lru(size) > 0) got = mm_->allocate(size, &loc);
+    }
+    if (!got) {
         out->status = OUT_OF_MEMORY;
         out->pool_idx = 0;
         out->token = FAKE_TOKEN;
@@ -52,6 +57,7 @@ Status KVIndex::commit(uint64_t token) {
     // make someone else's bytes visible under this key).
     if (mit != map_.end() && mit->second.block == it->second.block) {
         mit->second.committed = true;
+        lru_touch(mit->second, mit->first);
         rc = OK;
     }
     inflight_.erase(it);
@@ -69,13 +75,14 @@ void KVIndex::abort(uint64_t token) {
     inflight_.erase(it);
 }
 
-const Entry* KVIndex::get_committed(const std::string& key) const {
+const Entry* KVIndex::get_committed(const std::string& key) {
     auto it = map_.find(key);
     if (it == map_.end() || !it->second.committed) return nullptr;
+    lru_touch(it->second, it->first);  // reads refresh recency
     return &it->second;
 }
 
-bool KVIndex::check_exist(const std::string& key) const {
+bool KVIndex::check_exist(const std::string& key) {
     return get_committed(key) != nullptr;
 }
 
@@ -103,13 +110,61 @@ bool KVIndex::release(uint64_t lease_id) { return leases_.erase(lease_id) > 0; }
 size_t KVIndex::purge() {
     size_t n = map_.size();
     map_.clear();
+    lru_.clear();
     return n;
 }
 
 size_t KVIndex::erase(const std::vector<std::string>& keys) {
     size_t n = 0;
-    for (auto& k : keys) n += map_.erase(k);
+    for (auto& k : keys) {
+        auto it = map_.find(k);
+        if (it == map_.end()) continue;
+        lru_drop(it->second);
+        map_.erase(it);
+        n++;
+    }
     return n;
+}
+
+void KVIndex::lru_touch(Entry& e, const std::string& key) {
+    if (!eviction_) return;
+    if (e.in_lru) lru_.erase(e.lru_it);
+    lru_.push_front(key);
+    e.lru_it = lru_.begin();
+    e.in_lru = true;
+}
+
+void KVIndex::lru_drop(Entry& e) {
+    if (e.in_lru) {
+        lru_.erase(e.lru_it);
+        e.in_lru = false;
+    }
+}
+
+size_t KVIndex::evict_lru(size_t want) {
+    size_t evicted = 0;
+    size_t freed = 0;
+    auto it = lru_.rbegin();
+    while (it != lru_.rend() && freed < want) {
+        auto mit = map_.find(*it);
+        // Skip entries whose blocks are pinned (reads in flight hold
+        // extra refs) — their memory would not return to the pool yet.
+        if (mit == map_.end()) {
+            it = std::reverse_iterator(lru_.erase(std::next(it).base()));
+            continue;
+        }
+        if (mit->second.block.use_count() > 1) {
+            ++it;
+            continue;
+        }
+        freed += mit->second.size;
+        lru_drop(mit->second);
+        map_.erase(mit);
+        evicted++;
+        evictions_++;
+        it = lru_.rbegin();  // list mutated; restart from the cold end
+    }
+    return evicted;
 }
 
 }  // namespace istpu

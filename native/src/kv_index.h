@@ -25,6 +25,7 @@
 #pragma once
 
 #include <cstdint>
+#include <list>
 #include <memory>
 #include <string>
 #include <unordered_map>
@@ -53,12 +54,20 @@ struct Entry {
     BlockRef block;
     uint32_t size = 0;
     bool committed = false;
+    // Position in the LRU list (valid when committed).
+    std::list<std::string>::iterator lru_it{};
+    bool in_lru = false;
 };
 
 // Not thread-safe by itself; the owner (Server) serializes access.
 class KVIndex {
    public:
-    explicit KVIndex(MM* mm) : mm_(mm) {}
+    // eviction=true enables LRU eviction of committed, unpinned entries
+    // when the pool is exhausted (beyond reference parity: the reference
+    // simply returns OOM forever once full — SURVEY.md §5 notes its only
+    // capacity answer is "capacity + chunking").
+    explicit KVIndex(MM* mm, bool eviction = false)
+        : mm_(mm), eviction_(eviction) {}
 
     // Reserve an uncommitted block for `key`. Returns:
     //   OK        — new block; out filled, token registered
@@ -77,9 +86,10 @@ class KVIndex {
     // Abort an inflight allocation (client died mid-write).
     void abort(uint64_t token);
 
-    // Committed lookup for reads. nullptr if missing or uncommitted.
-    const Entry* get_committed(const std::string& key) const;
-    bool check_exist(const std::string& key) const;  // exists && committed
+    // Committed lookup for reads (refreshes LRU recency). nullptr if
+    // missing or uncommitted.
+    const Entry* get_committed(const std::string& key);
+    bool check_exist(const std::string& key);  // exists && committed
 
     // Reference algorithm verbatim in behavior (infinistore.cpp:1092-1108):
     // binary search assuming presence is monotone over the key list
@@ -95,6 +105,12 @@ class KVIndex {
     size_t size() const { return map_.size(); }
     size_t inflight() const { return inflight_.size(); }
     size_t leases() const { return leases_.size(); }
+    uint64_t evictions() const { return evictions_; }
+
+    // Evict least-recently-used committed entries whose blocks are not
+    // pinned (use_count()==1) until `want` bytes could plausibly be
+    // freed or nothing evictable remains. Returns entries evicted.
+    size_t evict_lru(size_t want);
 
    private:
     struct Inflight {
@@ -103,7 +119,13 @@ class KVIndex {
         uint32_t size;
     };
 
+    void lru_touch(Entry& e, const std::string& key);
+    void lru_drop(Entry& e);
+
     MM* mm_;
+    bool eviction_ = false;
+    uint64_t evictions_ = 0;
+    std::list<std::string> lru_;  // front = most recent
     std::unordered_map<std::string, Entry> map_;
     std::unordered_map<uint64_t, Inflight> inflight_;
     std::unordered_map<uint64_t, std::vector<BlockRef>> leases_;

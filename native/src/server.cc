@@ -62,7 +62,7 @@ bool Server::start() {
         IST_ERROR("pool init failed: %s", e.what());
         return false;
     }
-    index_ = std::make_unique<KVIndex>(mm_.get());
+    index_ = std::make_unique<KVIndex>(mm_.get(), cfg_.enable_eviction);
 
     listen_fd_ = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
     if (listen_fd_ < 0) return false;
@@ -149,13 +149,14 @@ std::string Server::stats_json() {
         "{\"kvmap_len\": %zu, \"inflight\": %zu, \"leases\": %zu, "
         "\"pools\": %zu, \"pool_bytes\": %zu, \"used_bytes\": %zu, "
         "\"ops\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu, "
-        "\"connections\": %zu, \"op_stats\": {",
+        "\"connections\": %zu, \"evictions\": %llu, \"op_stats\": {",
         index_ ? index_->size() : 0, index_ ? index_->inflight() : 0,
         index_ ? index_->leases() : 0, mm_ ? mm_->num_pools() : 0,
         mm_ ? mm_->total_bytes() : 0, mm_ ? mm_->used_bytes() : 0,
         (unsigned long long)ops_.load(),
         (unsigned long long)bytes_in_.load(),
-        (unsigned long long)bytes_out_.load(), size_t(n_conns_.load()));
+        (unsigned long long)bytes_out_.load(), size_t(n_conns_.load()),
+        (unsigned long long)(index_ ? index_->evictions() : 0));
     // Per-op handler-time table (the reference logs per-op latency ad hoc,
     // infinistore.cpp:1114,1162-1166; here it is queryable).
     bool first = true;

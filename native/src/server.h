@@ -59,6 +59,7 @@ struct ServerConfig {
     uint64_t extend_bytes = 1ull << 30;
     bool enable_shm = true;          // expose the pool as POSIX shm
     std::string shm_prefix;          // default derived from pid+port
+    bool enable_eviction = false;    // LRU-evict committed entries on OOM
 };
 
 class Server {

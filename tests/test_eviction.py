@@ -1,0 +1,116 @@
+"""LRU eviction (beyond reference parity): a full pool evicts cold
+committed entries instead of failing allocations forever."""
+
+import numpy as np
+import pytest
+
+from infinistore_tpu import (
+    ClientConfig,
+    InfiniStoreError,
+    InfiniStoreKeyNotFound,
+    InfiniStoreServer,
+    InfinityConnection,
+    ServerConfig,
+)
+
+PAGE = 16 << 10  # one 16 KB block per key
+
+
+@pytest.fixture
+def evict_server():
+    srv = InfiniStoreServer(
+        ServerConfig(
+            service_port=0,
+            prealloc_size=(64 << 10) / (1 << 30),  # 4 blocks of 16 KB
+            minimal_allocate_size=16,
+            enable_eviction=True,
+        )
+    )
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def econn(evict_server):
+    c = InfinityConnection(
+        ClientConfig(
+            host_addr="127.0.0.1", service_port=evict_server.service_port
+        )
+    )
+    c.connect()
+    yield c
+    c.close()
+
+
+def _put(conn, key, value):
+    conn.put_cache(value, [(key, 0)], PAGE)
+    conn.sync()
+
+
+def test_eviction_makes_room(econn, evict_server, rng):
+    vals = {}
+    # 8 keys through a 4-block pool: the cold half gets evicted.
+    for i in range(8):
+        k = f"ev_{i}"
+        vals[k] = rng.integers(0, 255, PAGE, dtype=np.uint8)
+        _put(econn, k, vals[k])
+    # Newest keys survive and read back intact.
+    assert econn.check_exist("ev_7")
+    dst = np.zeros(PAGE, dtype=np.uint8)
+    econn.read_cache(dst, [("ev_7", 0)], PAGE)
+    econn.sync()
+    assert np.array_equal(dst, vals["ev_7"])
+    # Oldest keys were evicted.
+    assert not econn.check_exist("ev_0")
+    with pytest.raises(InfiniStoreKeyNotFound):
+        econn.read_cache(dst, [("ev_0", 0)], PAGE)
+    assert evict_server.stats()["evictions"] >= 4
+
+
+def test_reads_refresh_recency(econn, rng):
+    vals = {}
+    for i in range(4):
+        k = f"lru_{i}"
+        vals[k] = rng.integers(0, 255, PAGE, dtype=np.uint8)
+        _put(econn, k, vals[k])
+    # Touch the oldest so it becomes the hottest.
+    dst = np.zeros(PAGE, dtype=np.uint8)
+    econn.read_cache(dst, [("lru_0", 0)], PAGE)
+    econn.sync()
+    # Two more inserts evict lru_1/lru_2 — but not the refreshed lru_0.
+    for i in range(4, 6):
+        k = f"lru_{i}"
+        vals[k] = rng.integers(0, 255, PAGE, dtype=np.uint8)
+        _put(econn, k, vals[k])
+    assert econn.check_exist("lru_0")
+    assert not econn.check_exist("lru_1")
+
+
+def test_eviction_disabled_still_ooms(server, rng):
+    """The default (reference-parity) server keeps OOM semantics; `server`
+    fixture has eviction off but auto_increase on, so exhaust explicitly
+    with a dedicated instance."""
+    srv = InfiniStoreServer(
+        ServerConfig(
+            service_port=0,
+            prealloc_size=(32 << 10) / (1 << 30),
+            minimal_allocate_size=16,
+        )
+    )
+    srv.start()
+    try:
+        conn = InfinityConnection(
+            ClientConfig(host_addr="127.0.0.1", service_port=srv.service_port)
+        )
+        conn.connect()
+        try:
+            buf = np.zeros(PAGE, dtype=np.uint8)
+            _put(conn, "a", buf)
+            _put(conn, "b", buf)
+            with pytest.raises(InfiniStoreError):
+                _put(conn, "c", buf)
+        finally:
+            conn.close()
+    finally:
+        srv.stop()

@@ -69,3 +69,42 @@ def test_flash_full_pages():
     np.testing.assert_allclose(
         np.asarray(out_pl), np.asarray(out_ref), rtol=2e-5, atol=2e-5
     )
+
+
+def test_flash_bf16():
+    """bfloat16 — the production dtype (LlamaConfig default)."""
+    q, k, v, pt, sl = _mk(2, 8, 2, 128, 32, 16, 4, seed=5, dtype=jnp.bfloat16)
+    out_ref = paged_decode_attention(q, k, v, pt, sl)
+    out_pl = paged_flash_decode(q, k, v, pt, sl, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out_pl, dtype=np.float32),
+        np.asarray(out_ref, dtype=np.float32),
+        rtol=3e-2,
+        atol=3e-2,
+    )
+
+
+def test_flash_odd_group_size():
+    """GQA group 3 (does not divide the sublane count) — padding math."""
+    q, k, v, pt, sl = _mk(2, 6, 2, 128, 32, 16, 4, seed=6)
+    out_ref = paged_decode_attention(q, k, v, pt, sl)
+    out_pl = paged_flash_decode(q, k, v, pt, sl, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out_pl), np.asarray(out_ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_oob_page_table_padding():
+    """Padding entries may be out of range (contract: 'padded
+    arbitrarily'); the kernel must clamp, not fault."""
+    q, k, v, pt, _ = _mk(2, 8, 8, 128, 8, 16, 4, seed=7)
+    # Sequences use only the first 2 pages; pad the rest with garbage ids.
+    pt = pt.at[:, 2:].set(jnp.asarray([[-1, 9999], [12345, -7]]))
+    sl = jnp.asarray([20, 30], dtype=jnp.int32)  # within 2 pages
+    out_ref = paged_decode_attention(
+        q, k, v, jnp.clip(pt, 0, 7), sl
+    )
+    out_pl = paged_flash_decode(q, k, v, pt, sl, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out_pl), np.asarray(out_ref), rtol=2e-5, atol=2e-5
+    )
