@@ -66,6 +66,14 @@ def _attend(page_tbl_ref, seq_lens_ref, q_ref, k_ref, v_ref,
     vv = v_ref[0].reshape(page_size, n_kv, hd)
 
     group = n_heads // n_kv
+    # HIGHEST on f32 keeps full precision; on bf16 it would request a
+    # multi-pass algorithm Mosaic rejects ("Bad lhs type") — the MXU
+    # already accumulates bf16xbf16 in f32, so DEFAULT is exact there.
+    precision = (
+        jax.lax.Precision.HIGHEST
+        if q.dtype == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
     # Per-kv-head 2D matmuls, statically unrolled (Mosaic rejects 3D
     # batched dot_general; n_kv is small so the unroll is cheap and each
     # dot maps cleanly onto the MXU).
@@ -77,7 +85,7 @@ def _attend(page_tbl_ref, seq_lens_ref, q_ref, k_ref, v_ref,
             jax.lax.dot_general(
                 qh, kh, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
+                precision=precision,
             )  # [group, P]
         )
     logits = jnp.concatenate(logit_blocks, axis=0)  # [H, P]
@@ -101,7 +109,7 @@ def _attend(page_tbl_ref, seq_lens_ref, q_ref, k_ref, v_ref,
             jax.lax.dot_general(
                 ph.astype(vvh.dtype), vvh, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
+                precision=precision,
             )  # [group, D]
         )
     pv = jnp.concatenate(pv_blocks, axis=0)  # [H, D]
