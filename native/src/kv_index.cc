@@ -87,6 +87,22 @@ bool KVIndex::check_exist(const std::string& key) {
 }
 
 int KVIndex::match_last_index(const std::vector<std::string>& keys) const {
+    if (eviction_) {
+        // LRU eviction can remove any key, so presence is no longer
+        // monotone over the chain and a binary search could report a
+        // prefix whose middle keys are gone. Linear scan for the first
+        // hole instead — n is small (pages of one sequence) and each
+        // probe is one hash lookup.
+        int last = -1;
+        for (size_t i = 0; i < keys.size(); ++i) {
+            if (map_.count(keys[i]) == 0) break;
+            last = int(i);
+        }
+        return last;
+    }
+    // Without eviction keys are only removed by explicit purge/delete, so
+    // the reference's binary-search semantics hold (prefix chains are
+    // written front-to-back; infinistore.cpp:1092-1108).
     int left = 0, right = int(keys.size());
     while (left < right) {
         int mid = left + (right - left) / 2;
@@ -144,25 +160,32 @@ void KVIndex::lru_drop(Entry& e) {
 size_t KVIndex::evict_lru(size_t want) {
     size_t evicted = 0;
     size_t freed = 0;
+    const size_t bs = mm_->block_size();
     auto it = lru_.rbegin();
     while (it != lru_.rend() && freed < want) {
         auto mit = map_.find(*it);
-        // Skip entries whose blocks are pinned (reads in flight hold
-        // extra refs) — their memory would not return to the pool yet.
         if (mit == map_.end()) {
             it = std::reverse_iterator(lru_.erase(std::next(it).base()));
             continue;
         }
+        // Skip entries whose blocks are pinned (reads in flight hold
+        // extra refs) — their memory would not return to the pool yet.
         if (mit->second.block.use_count() > 1) {
             ++it;
             continue;
         }
-        freed += mit->second.size;
-        lru_drop(mit->second);
+        // Count the block-granular pool footprint, not the logical size —
+        // a 4 KB value in a 64 KB-block pool frees a whole block.
+        freed += (size_t(mit->second.size) + bs - 1) / bs * bs;
+        // Erase the victim in place and keep walking coldward from the
+        // same position (restarting at rbegin would re-scan every pinned
+        // cold entry per eviction, O(pinned x evicted) under the lock).
+        auto fwd = std::next(it).base();
+        mit->second.in_lru = false;
         map_.erase(mit);
+        it = std::reverse_iterator(lru_.erase(fwd));
         evicted++;
         evictions_++;
-        it = lru_.rbegin();  // list mutated; restart from the cold end
     }
     return evicted;
 }

@@ -87,6 +87,57 @@ def test_reads_refresh_recency(econn, rng):
     assert not econn.check_exist("lru_1")
 
 
+def test_match_last_index_sees_eviction_holes(econn, rng):
+    """With eviction on, presence over a key chain is not monotone: if the
+    chain's head is evicted while its tail survives, get_match_last_index
+    must report the hole (linear scan) instead of binary-searching past it
+    and promising a prefix whose early pages are gone."""
+    chain = [f"ch_{i}" for i in range(6)]
+    buf = rng.integers(0, 255, PAGE, dtype=np.uint8)
+    for k in chain:
+        _put(econn, k, buf)
+    # Pool holds 4 blocks: ch_0/ch_1 were evicted, ch_2..ch_5 survive.
+    assert not econn.check_exist("ch_0")
+    assert econn.check_exist("ch_5")
+    # A binary search would probe mid=3 (present) and report 5; the
+    # correct answer is "no prefix cached", which the API (reference
+    # lib.py:627-643 parity) surfaces as a raise.
+    with pytest.raises(Exception, match="can't find a match"):
+        econn.get_match_last_index(chain)
+
+
+def test_small_values_evict_minimally(rng):
+    """Eviction accounting is block-granular: values much smaller than the
+    pool block still free a whole block each, so making room for one block
+    evicts one entry — not size/value_size of them."""
+    srv = InfiniStoreServer(
+        ServerConfig(
+            service_port=0,
+            prealloc_size=(64 << 10) / (1 << 30),  # 4 blocks of 16 KB
+            minimal_allocate_size=16,
+            enable_eviction=True,
+        )
+    )
+    srv.start()
+    try:
+        conn = InfinityConnection(
+            ClientConfig(host_addr="127.0.0.1", service_port=srv.service_port)
+        )
+        conn.connect()
+        try:
+            small = rng.integers(0, 255, 1024, dtype=np.uint8)  # 1 KB
+            for i in range(5):  # 5th insert must evict exactly one entry
+                conn.put_cache(small, [(f"sm_{i}", 0)], 1024)
+                conn.sync()
+            assert srv.stats()["evictions"] == 1
+            assert not conn.check_exist("sm_0")
+            assert conn.check_exist("sm_1")
+        finally:
+            conn.close()
+    finally:
+        srv.stop()
+
+
 def test_eviction_disabled_still_ooms(server, rng):
     """The default (reference-parity) server keeps OOM semantics; `server`
     fixture has eviction off but auto_increase on, so exhaust explicitly
