@@ -133,6 +133,12 @@ def _decls(lib):
             [c.c_void_p, c.c_uint32, c.c_char_p, c.c_uint64, c.c_uint32,
              c.POINTER(c.c_void_p), CALLBACK, c.c_void_p],
         ),
+        (
+            "ist_read",
+            c.c_uint32,
+            [c.c_void_p, c.c_uint32, c.c_char_p, c.c_uint64, c.c_uint32,
+             c.POINTER(c.c_void_p), c.c_int],
+        ),
         ("ist_sync", c.c_uint32, [c.c_void_p, c.c_int]),
         ("ist_commit", c.c_uint32, [c.c_void_p, c.POINTER(c.c_uint64), c.c_uint32]),
         (
@@ -203,12 +209,12 @@ def get_lib():
 
 def pack_keys(keys):
     """Serialize a key list as [u32 len + utf8 bytes]* for the C ABI."""
-    parts = []
+    out = bytearray()
     for k in keys:
         kb = k.encode() if isinstance(k, str) else bytes(k)
-        parts.append(struct.pack("<I", len(kb)))
-        parts.append(kb)
-    return b"".join(parts)
+        out += len(kb).to_bytes(4, "little")
+        out += kb
+    return bytes(out)
 
 
 def status_name(code):

@@ -96,6 +96,13 @@ class Connection {
     void shm_write_async(uint32_t block_size, std::vector<RemoteBlock> blocks,
                          std::vector<const void*> srcs, DoneFn done);
     // OP_PIN → memcpy out → OP_RELEASE.
+    // Blocking SHM read on the CALLER's thread: one PIN rpc, then the
+    // copies run inline (the Python caller holds no GIL), then an async
+    // RELEASE. On a single-core host this halves the context switches of
+    // the submit->IO-thread-copy->callback path.
+    uint32_t shm_read_blocking(uint32_t block_size,
+                               std::vector<std::string> keys,
+                               std::vector<void*> dsts);
     void shm_read_async(uint32_t block_size, std::vector<std::string> keys,
                         std::vector<void*> dsts, DoneFn done);
 

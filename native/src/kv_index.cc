@@ -6,7 +6,11 @@ namespace istpu {
 
 Status KVIndex::allocate(const std::string& key, uint32_t size,
                          RemoteBlock* out) {
-    if (map_.count(key) > 0) {
+    // Single hash probe: try_emplace both answers the dedup check and
+    // reserves the slot (allocate is the server's hottest op — 4096
+    // keys per benchmark batch).
+    auto [mit, inserted] = map_.try_emplace(key);
+    if (!inserted) {
         out->status = CONFLICT;
         out->pool_idx = 0;
         out->token = FAKE_TOKEN;
@@ -18,9 +22,12 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
     bool got = mm_->allocate(size, &loc);
     if (!got && eviction_) {
         // Make room from the cold end of the cache, then retry once.
+        // (evict_lru cannot invalidate mit: it only erases committed
+        // entries, and this one is uncommitted and not in the LRU.)
         if (evict_lru(size) > 0) got = mm_->allocate(size, &loc);
     }
     if (!got) {
+        map_.erase(mit);
         out->status = OUT_OF_MEMORY;
         out->pool_idx = 0;
         out->token = FAKE_TOKEN;
@@ -30,7 +37,7 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
     }
     auto block = std::make_shared<Block>(mm_, loc, size);
     uint64_t token = next_token_++;
-    map_[key] = Entry{block, size, /*committed=*/false};
+    mit->second = Entry{block, size, /*committed=*/false};
     inflight_[token] = Inflight{key, block, size};
     out->status = OK;
     out->pool_idx = loc.pool_idx;
