@@ -711,6 +711,19 @@ void Connection::enqueue_msg(uint8_t op, std::vector<uint8_t> body,
     uint64_t seq = next_seq_++;
     uint64_t payload = 0;
     for (auto& s : segs) payload += s.second;
+    // Merge contiguous gather segments: batched put sources are slices of
+    // one buffer, so the whole payload usually collapses to a single iovec
+    // and flush_send's 64-iovec writev window covers it in one syscall.
+    size_t out = 0;
+    for (size_t i = 0; i < segs.size(); ++i) {
+        if (out > 0 &&
+            segs[out - 1].first + segs[out - 1].second == segs[i].first) {
+            segs[out - 1].second += segs[i].second;
+        } else {
+            segs[out++] = segs[i];
+        }
+    }
+    segs.resize(out);
     OutMsg m;
     m.meta.resize(sizeof(WireHeader) + body.size());
     WireHeader h = make_header(op, seq, uint32_t(body.size()), payload);
@@ -854,26 +867,54 @@ bool Connection::flush_send() {
 bool Connection::handle_readable() {
     while (true) {
         if (in_payload_) {
+            // Scatter the response payload into user buffers with one readv
+            // per up-to-64 destination runs (adjacent destinations merge),
+            // mirroring the server's write-side scatter.
             while (rpayload_left_ > 0) {
-                uint8_t* dst;
-                size_t room;
-                if (rseg_ < rscatter_.size()) {
-                    dst = rscatter_[rseg_].first + rseg_off_;
-                    room = rscatter_[rseg_].second - rseg_off_;
-                } else {
-                    dst = rdrain_.data();
-                    room = rdrain_.size();
+                iovec iov[64];
+                int niov = 0;
+                uint64_t planned = 0;
+                size_t seg = rseg_, seg_off = rseg_off_;
+                while (niov < 64 && seg < rscatter_.size() &&
+                       planned < rpayload_left_) {
+                    uint8_t* p = rscatter_[seg].first + seg_off;
+                    size_t room = rscatter_[seg].second - seg_off;
+                    if (room > rpayload_left_ - planned) {
+                        room = size_t(rpayload_left_ - planned);
+                    }
+                    if (niov > 0 &&
+                        static_cast<uint8_t*>(iov[niov - 1].iov_base) +
+                                iov[niov - 1].iov_len == p) {
+                        iov[niov - 1].iov_len += room;
+                    } else {
+                        iov[niov].iov_base = p;
+                        iov[niov].iov_len = room;
+                        niov++;
+                    }
+                    planned += room;
+                    seg++;
+                    seg_off = 0;
                 }
-                if (room > rpayload_left_) room = size_t(rpayload_left_);
-                ssize_t r = recv(fd_, dst, room, 0);
+                if (niov == 0) {  // beyond the scatter plan: drain
+                    iov[0].iov_base = rdrain_.data();
+                    iov[0].iov_len = rdrain_.size() > rpayload_left_
+                                         ? size_t(rpayload_left_)
+                                         : rdrain_.size();
+                    niov = 1;
+                }
+                ssize_t r = readv(fd_, iov, niov);
                 if (r == 0) return false;
                 if (r < 0) {
                     if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
                     return false;
                 }
                 rpayload_left_ -= uint64_t(r);
-                if (rseg_ < rscatter_.size()) {
-                    rseg_off_ += size_t(r);
+                size_t left = size_t(r);
+                while (left > 0 && rseg_ < rscatter_.size()) {
+                    size_t take = rscatter_[rseg_].second - rseg_off_;
+                    if (take > left) take = left;
+                    rseg_off_ += take;
+                    left -= take;
                     if (rseg_off_ == rscatter_[rseg_].second) {
                         rseg_++;
                         rseg_off_ = 0;
