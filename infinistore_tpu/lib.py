@@ -468,24 +468,31 @@ class InfinityConnection:
     # read
     # ------------------------------------------------------------------
 
-    def _read_async_native(self, cache, blocks, page_size, cb):
+    @staticmethod
+    def _prep_read(cache, blocks, page_size):
+        """Shared destination prep for the sync and async read paths:
+        coerce to an array, bounds-check the element offsets, and build the
+        packed key blob + per-block destination addresses."""
         arr = _as_dst_array(cache)
         esize = arr.itemsize
         page_bytes = page_size * esize
-        keys = [k for k, _ in blocks]
-        base = arr.ctypes.data
-        nbytes = arr.nbytes
         byte_offs = (
             np.asarray([off for _, off in blocks], dtype=np.int64) * esize
         )
         if len(byte_offs) and (
             int(byte_offs.min()) < 0
-            or int(byte_offs.max()) + page_bytes > nbytes
+            or int(byte_offs.max()) + page_bytes > arr.nbytes
         ):
             raise ValueError("offset out of tensor bounds")
-        n = len(byte_offs)
-        blob = pack_keys(keys)
-        dst_np = np.uint64(base) + byte_offs.astype(np.uint64)
+        blob = pack_keys([k for k, _ in blocks])
+        dst_np = np.uint64(arr.ctypes.data) + byte_offs.astype(np.uint64)
+        return arr, page_bytes, blob, dst_np
+
+    def _read_async_native(self, cache, blocks, page_size, cb):
+        arr, page_bytes, blob, dst_np = self._prep_read(
+            cache, blocks, page_size
+        )
+        n = len(dst_np)
         dst_arr = dst_np.ctypes.data_as(ct.POINTER(ct.c_void_p))
         ka = self._keep(cb, (arr, dst_np, blob))
         fn = (
@@ -504,26 +511,17 @@ class InfinityConnection:
         :class:`InfiniStoreKeyNotFound` (reference returns KEY_NOT_FOUND,
         infinistore.cpp:607)."""
         self._check()
-        arr = _as_dst_array(cache)
-        esize = arr.itemsize
-        page_bytes = page_size * esize
-        keys = [k for k, _ in blocks]
-        base = arr.ctypes.data
-        nbytes = arr.nbytes
-        byte_offs = (
-            np.asarray([off for _, off in blocks], dtype=np.int64) * esize
+        arr, page_bytes, blob, dst_np = self._prep_read(
+            cache, blocks, page_size
         )
-        if len(byte_offs) and (
-            int(byte_offs.min()) < 0
-            or int(byte_offs.max()) + page_bytes > nbytes
-        ):
-            raise ValueError("offset out of tensor bounds")
-        blob = pack_keys(keys)
-        dst_np = np.uint64(base) + byte_offs.astype(np.uint64)
         # Blocking native call (GIL released): waits on a C cv instead of
         # bouncing a ctypes callback through Python and a threading.Event.
+        # On a STREAM-path timeout the native layer tears the connection
+        # down before returning, so no late payload can land in our
+        # buffers. (The SHM path needs no teardown: copies run on this
+        # thread, and an abandoned PIN's lease is released natively.)
         st = self._lib.ist_read(
-            self._h, page_bytes, blob, len(blob), len(byte_offs),
+            self._h, page_bytes, blob, len(blob), len(dst_np),
             dst_np.ctypes.data_as(ct.POINTER(ct.c_void_p)),
             self.config.timeout_ms,
         )

@@ -262,7 +262,14 @@ uint32_t ist_read(void* h, uint32_t block_size, const uint8_t* keys_blob,
     std::unique_lock<std::mutex> lk(w->mu);
     if (!w->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
                         [&] { return w->fired; })) {
-        return TIMEOUT_ERR;  // callback still safe: it owns w via shared_ptr
+        // The pending OP_READ still holds raw pointers into the caller's
+        // buffers; once we return, those may be freed. Tear the connection
+        // down and wait for the IO thread to unwind so a late response can
+        // never scatter into freed memory. (The callback itself stays safe
+        // regardless — it owns w via shared_ptr.)
+        lk.unlock();
+        c->hard_fail();
+        return TIMEOUT_ERR;
     }
     return w->st;
 }

@@ -128,6 +128,7 @@ int Connection::connect_server() {
     epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd_, &ev);
     running_.store(true);
     broken_.store(false);
+    io_exited_.store(false);
     io_thread_ = std::thread([this] { io_loop(); });
     IST_INFO("connected to %s:%u (shm=%s, block=%u)", cfg_.host.c_str(),
              cfg_.port, shm_active_ ? "on" : "off", server_block_size_);
@@ -488,8 +489,47 @@ uint32_t Connection::shm_read_blocking(uint32_t block_size,
     std::vector<uint8_t> body;
     BufWriter w(body);
     w.keys(keys);
-    std::vector<uint8_t> resp;
-    uint32_t st = rpc(OP_PIN, std::move(body), &resp);
+    // PIN with an abandonment-aware wait: if the caller times out before
+    // the response lands, the late callback (on the IO thread) must still
+    // release the lease — otherwise the pinned blocks stay unevictable
+    // and undeletable forever.
+    struct PinWait {
+        std::mutex mu;
+        std::condition_variable cv;
+        bool fired = false;
+        bool abandoned = false;
+        uint32_t st = TIMEOUT_ERR;
+        std::vector<uint8_t> body;
+    };
+    auto pw = std::make_shared<PinWait>();
+    rpc_async(OP_PIN, std::move(body),
+              [this, pw](uint32_t status, std::vector<uint8_t> b) {
+                  std::unique_lock<std::mutex> lk(pw->mu);
+                  if (pw->abandoned) {
+                      lk.unlock();
+                      // Late PIN response on the IO thread: release the
+                      // lease the caller will never use.
+                      if (status == OK && b.size() >= 8) {
+                          BufReader lr(b.data(), b.size());
+                          enqueue_release(lr.u64());
+                      }
+                      return;
+                  }
+                  pw->st = status;
+                  pw->body = std::move(b);
+                  pw->fired = true;
+                  pw->cv.notify_all();
+              });
+    {
+        std::unique_lock<std::mutex> lk(pw->mu);
+        if (!pw->cv.wait_for(lk, std::chrono::milliseconds(cfg_.timeout_ms),
+                             [&] { return pw->fired; })) {
+            pw->abandoned = true;
+            return TIMEOUT_ERR;
+        }
+    }
+    uint32_t st = pw->st;
+    std::vector<uint8_t> resp = std::move(pw->body);
     if (st != OK) return st;
     BufReader r(resp.data(), resp.size());
     uint64_t lease = r.u64();
@@ -636,13 +676,7 @@ void Connection::shm_read_async(uint32_t block_size,
                 // for the release's socket write.
                 if (done) done(st, {});
                 finish_op();
-                std::vector<uint8_t> rbody;
-                BufWriter rw(rbody);
-                rw.u64(lease);
-                Pending rel;
-                rel.op = OP_RELEASE;
-                rel.done = [](uint32_t, std::vector<uint8_t>) {};
-                enqueue_msg(OP_RELEASE, std::move(rbody), {}, std::move(rel));
+                enqueue_release(lease);
             };
             bool need_refresh = false;
             if (parse_ok) {
@@ -680,6 +714,19 @@ void Connection::shm_read_async(uint32_t block_size,
         submits_.push_back(std::move(s));
     }
     wake();
+}
+
+void Connection::hard_fail() {
+    // Reject new submissions, then force the IO thread off the socket:
+    // shutdown makes its next recv/readv return 0, so it unwinds through
+    // fail_all and can no longer scatter payload into caller memory.
+    broken_.store(true);
+    if (fd_ >= 0) shutdown(fd_, SHUT_RDWR);
+    wake();
+    std::unique_lock<std::mutex> lk(sync_mu_);
+    sync_cv_.wait_for(lk, std::chrono::seconds(2), [&] {
+        return io_exited_.load() || !running_.load();
+    });
 }
 
 uint32_t Connection::sync(int timeout_ms) {
@@ -734,6 +781,16 @@ void Connection::enqueue_msg(uint8_t op, std::vector<uint8_t> body,
     window_used_ += pending.payload_bytes;
     pending_[seq] = std::move(pending);
     sendq_.push_back(std::move(m));
+}
+
+void Connection::enqueue_release(uint64_t lease) {
+    std::vector<uint8_t> rbody;
+    BufWriter rw(rbody);
+    rw.u64(lease);
+    Pending rel;
+    rel.op = OP_RELEASE;
+    rel.done = [](uint32_t, std::vector<uint8_t>) {};
+    enqueue_msg(OP_RELEASE, std::move(rbody), {}, std::move(rel));
 }
 
 void Connection::drain_submits() {
@@ -866,7 +923,16 @@ bool Connection::flush_send() {
 
 bool Connection::handle_readable() {
     while (true) {
+        // hard_fail() sets broken_ from another thread; bail before
+        // starting the next message so a payload that was already queued
+        // in the kernel receive buffer (SHUT_RD does not discard it) can
+        // never be scattered into buffers a timed-out caller has freed.
+        if (!in_payload_ && broken_.load()) return false;
         if (in_payload_) {
+            // Same hazard mid-scatter: once broken, dump the rest of this
+            // payload into the drain buffer — every pending completes with
+            // an error via fail_all, so the data is unwanted either way.
+            if (broken_.load()) rscatter_.clear();
             // Scatter the response payload into user buffers with one readv
             // per up-to-64 destination runs (adjacent destinations merge),
             // mirroring the server's write-side scatter.
@@ -1019,6 +1085,12 @@ void Connection::fail_all(uint32_t status) {
             }
         }
         s.fn();
+    }
+    {
+        // Hold sync_mu_ around store+notify so hard_fail cannot check the
+        // predicate, miss this transition, and sleep its full deadline.
+        std::lock_guard<std::mutex> lk(sync_mu_);
+        io_exited_.store(true);
     }
     sync_cv_.notify_all();
 }

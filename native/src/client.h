@@ -115,6 +115,12 @@ class Connection {
     // Wait until all async ops completed (reference sync_rdma/sync_local).
     uint32_t sync(int timeout_ms);
 
+    // Tear the connection down from a non-IO thread and wait (bounded)
+    // for the IO thread to unwind. Needed after a timed-out blocking op
+    // whose Pending still references caller-owned buffers (STREAM read
+    // scatter): without it a late response would land in freed memory.
+    void hard_fail();
+
     uint64_t inflight() const { return inflight_.load(); }
 
    private:
@@ -147,6 +153,8 @@ class Connection {
     void enqueue_msg(uint8_t op, std::vector<uint8_t> body,
                      std::vector<std::pair<const uint8_t*, size_t>> segs,
                      Pending pending);
+    // Fire-and-forget OP_RELEASE of a pin lease. IO thread only.
+    void enqueue_release(uint64_t lease);
     bool flush_send();
     bool handle_readable();
     void complete(uint64_t seq, uint32_t status, std::vector<uint8_t> body);
@@ -161,6 +169,7 @@ class Connection {
     std::thread io_thread_;
     std::atomic<bool> running_{false};
     std::atomic<bool> broken_{false};
+    std::atomic<bool> io_exited_{false};  // fail_all finished unwinding
 
     std::mutex submit_mu_;
     std::deque<Submit> submits_;

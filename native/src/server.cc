@@ -239,10 +239,12 @@ void Server::accept_ready() {
 void Server::close_conn(int fd) {
     auto it = conns_.find(fd);
     if (it == conns_.end()) return;
-    // Abort allocations this client never committed.
+    // Abort allocations this client never committed and drop any pin
+    // leases it still holds.
     {
         std::lock_guard<std::mutex> lk(store_mu_);
         for (uint64_t tok : it->second->open_tokens) index_->abort(tok);
+        for (uint64_t lease : it->second->open_leases) index_->release(lease);
     }
     epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, fd, nullptr);
     close(fd);
@@ -808,6 +810,7 @@ void Server::op_pin(Conn& c) {
             refs.push_back(e->block);
         }
         uint64_t lease = index_->pin(std::move(refs));
+        c.open_leases.insert(lease);
         w.u32(OK);
         w.u64(lease);
         w.u32(uint32_t(blocks.size()));
@@ -826,6 +829,7 @@ void Server::op_release(Conn& c) {
         std::lock_guard<std::mutex> lk(store_mu_);
         ok = index_->release(lease);
     }
+    c.open_leases.erase(lease);
     w.u32(ok ? OK : KEY_NOT_FOUND);
     respond(c, c.hdr.seq, OP_RELEASE, std::move(body));
 }

@@ -119,6 +119,9 @@ class Server {
         // connection dies (improvement over the reference, which leaks
         // uncommitted kv_map entries on client crash).
         std::unordered_set<uint64_t> open_tokens;
+        // Pin leases taken on this connection; released if it dies, so a
+        // crashed reader cannot pin pool blocks forever.
+        std::unordered_set<uint64_t> open_leases;
     };
 
     void loop();

@@ -113,3 +113,42 @@ def test_two_servers_distinct_shm(tmp_path):
     finally:
         s1.stop()
         s2.stop()
+
+
+def test_pin_lease_released_on_disconnect(server, rng):
+    """A client that takes a pin lease and dies without releasing it must
+    not pin pool blocks forever: the server drops a connection's leases
+    when it closes (native close_conn), so readers crashing mid-lease
+    cannot leak capacity."""
+    from infinistore_tpu import TYPE_SHM
+
+    def connect():
+        c = InfinityConnection(
+            ClientConfig(
+                host_addr="127.0.0.1",
+                service_port=server.service_port,
+                connection_type=TYPE_SHM,
+            )
+        )
+        c.connect()
+        return c
+
+    writer = connect()
+    k = key()
+    src = rng.random(256).astype(np.float32)
+    writer.put_cache(src, [(k, 0)], 256)
+    writer.sync()
+
+    reader = connect()
+    lease, blocks = reader.pin([k])
+    assert server.stats()["leases"] >= 1
+    # Close WITHOUT releasing the lease (crashed-reader simulation).
+    reader.close()
+    deadline = 50
+    while server.stats()["leases"] > 0 and deadline > 0:
+        import time
+
+        time.sleep(0.02)
+        deadline -= 1
+    assert server.stats()["leases"] == 0
+    writer.close()
