@@ -76,7 +76,8 @@ def _decls(lib):
             "ist_server_create",
             c.c_void_p,
             [c.c_char_p, c.c_uint16, c.c_uint64, c.c_uint64, c.c_int,
-             c.c_uint64, c.c_int, c.c_char_p, c.c_int],
+             c.c_uint64, c.c_int, c.c_char_p, c.c_int, c.c_char_p,
+             c.c_uint64],
         ),
         ("ist_server_start", c.c_int, [c.c_void_p]),
         ("ist_server_stop", None, [c.c_void_p]),

@@ -92,6 +92,16 @@ class ServerConfig:
         # (beyond reference parity; off by default to match reference
         # first-writer-wins-forever semantics).
         self.enable_eviction = kwargs.get("enable_eviction", False)
+        # Disk spill tier (the reference's aspirational SSD tier,
+        # design.rst:36 — no code exists there). ssd_size in GB; 0 = off.
+        # Cold entries spill to a file under ssd_path on pool pressure
+        # and promote back on read. Without enable_eviction this is
+        # spill-only: committed entries are never dropped. ssd_path must
+        # be set explicitly (no default: /tmp is tmpfs on many distros,
+        # which would silently spill into the RAM the tier exists to
+        # relieve; the native layer also warns when the target is tmpfs).
+        self.ssd_path = kwargs.get("ssd_path", "")
+        self.ssd_size = kwargs.get("ssd_size", 0)  # GB
         # Accepted for reference CLI compatibility; unused on TPU hosts.
         self.dev_name = kwargs.get("dev_name", "")
         self.link_type = kwargs.get("link_type", "")
@@ -121,3 +131,7 @@ class ServerConfig:
             raise Exception("minimal allocate size must be a power of two (KB)")
         if self.prealloc_size <= 0:
             raise Exception("prealloc_size must be positive")
+        if self.ssd_size < 0:
+            raise Exception("ssd_size must be >= 0")
+        if self.ssd_size > 0 and not self.ssd_path:
+            raise Exception("ssd_path required when ssd_size > 0")

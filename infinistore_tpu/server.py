@@ -52,6 +52,8 @@ class InfiniStoreServer:
             1 if cfg.enable_shm else 0,
             cfg.shm_prefix.encode(),
             1 if cfg.enable_eviction else 0,
+            cfg.ssd_path.encode(),
+            int(cfg.ssd_size * (1 << 30)),
         )
         port = self._lib.ist_server_start(self._h)
         if port < 0:
@@ -194,6 +196,13 @@ def parse_args(argv=None):
     p.add_argument("--enable-eviction", action="store_true",
                    help="LRU-evict cold committed entries when the pool "
                         "is full (instead of failing allocations)")
+    p.add_argument("--ssd-path", default="",
+                   help="directory for the disk spill tier's file "
+                        "(required with --ssd-size; avoid tmpfs mounts)")
+    p.add_argument("--ssd-size", type=float, default=0,
+                   help="disk spill tier capacity in GB (0 = disabled); "
+                        "cold entries spill to disk under pool pressure "
+                        "and promote back on read")
     p.add_argument("--warmup", action="store_true",
                    help="run a warmup round-trip after startup")
     p.add_argument("--no-oom-protect", action="store_true")
@@ -213,6 +222,8 @@ def main(argv=None):
         extend_size=args.extend_size,
         enable_shm=not args.no_shm,
         enable_eviction=args.enable_eviction,
+        ssd_path=args.ssd_path,
+        ssd_size=args.ssd_size,
     )
     server = InfiniStoreServer(config)
     server.start()

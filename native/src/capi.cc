@@ -62,7 +62,8 @@ void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }
 void* ist_server_create(const char* host, uint16_t port,
                         uint64_t prealloc_bytes, uint64_t block_size,
                         int auto_extend, uint64_t extend_bytes, int enable_shm,
-                        const char* shm_prefix, int enable_eviction) {
+                        const char* shm_prefix, int enable_eviction,
+                        const char* ssd_path, uint64_t ssd_bytes) {
     ServerConfig cfg;
     cfg.host = host ? host : "0.0.0.0";
     cfg.port = port;
@@ -73,6 +74,8 @@ void* ist_server_create(const char* host, uint16_t port,
     cfg.enable_shm = enable_shm != 0;
     if (shm_prefix && shm_prefix[0]) cfg.shm_prefix = shm_prefix;
     cfg.enable_eviction = enable_eviction != 0;
+    if (ssd_path && ssd_path[0]) cfg.ssd_path = ssd_path;
+    cfg.ssd_bytes = ssd_bytes;
     return new Server(cfg);
 }
 

@@ -1,0 +1,149 @@
+#include "disk_tier.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/vfs.h>
+#include <unistd.h>
+
+#include "log.h"
+
+namespace istpu {
+
+DiskTier::DiskTier(const std::string& path, uint64_t capacity,
+                   uint64_t block_size)
+    : block_size_(block_size) {
+    if (block_size == 0 || (block_size & (block_size - 1)) != 0) {
+        IST_ERROR("disk tier block_size must be a power of two");
+        return;
+    }
+    total_blocks_ = (capacity + block_size - 1) / block_size;
+    if (total_blocks_ == 0) total_blocks_ = 1;
+    capacity_ = total_blocks_ * block_size;
+    int fd = open(path.c_str(), O_CREAT | O_RDWR | O_TRUNC | O_CLOEXEC, 0600);
+    if (fd < 0) {
+        IST_ERROR("disk tier open(%s) failed: %s", path.c_str(),
+                  strerror(errno));
+        return;
+    }
+    // Unlink immediately: the fd keeps the extents alive, and a crashed
+    // server can never leak a multi-GB spill file on disk.
+    unlink(path.c_str());
+    // A tier on tmpfs spills into the RAM it exists to relieve — allow it
+    // (useful in tests) but say so loudly.
+    struct statfs sfs;
+    if (fstatfs(fd, &sfs) == 0 && sfs.f_type == 0x01021994 /* TMPFS */) {
+        IST_WARN("disk tier path %s is tmpfs (RAM-backed): spilled data "
+                 "still consumes memory — point --ssd-path at a real disk",
+                 path.c_str());
+    }
+    if (ftruncate(fd, off_t(capacity_)) != 0) {
+        IST_ERROR("disk tier ftruncate(%llu) failed: %s",
+                  (unsigned long long)capacity_, strerror(errno));
+        close(fd);
+        return;
+    }
+    bitmap_.assign(size_t((total_blocks_ + 63) / 64), 0);
+    fd_ = fd;
+    IST_INFO("disk tier ready: %s, %llu MB, block %llu KB", path.c_str(),
+             (unsigned long long)(capacity_ >> 20),
+             (unsigned long long)(block_size_ >> 10));
+}
+
+DiskTier::~DiskTier() {
+    if (fd_ >= 0) close(fd_);
+}
+
+void DiskTier::set_range(uint64_t start, uint64_t count, bool value) {
+    for (uint64_t i = start; i < start + count; ++i) {
+        if (value) {
+            bitmap_[i >> 6] |= (1ull << (i & 63));
+        } else {
+            bitmap_[i >> 6] &= ~(1ull << (i & 63));
+        }
+    }
+}
+
+int64_t DiskTier::find_first_fit(uint64_t count) const {
+    // Rolling-hint first fit, same policy as the DRAM pool allocator:
+    // scan hint→end, then start→end as the (rare) wrap-around fallback.
+    auto scan = [&](uint64_t from, uint64_t to) -> int64_t {
+        uint64_t run = 0, run_start = from;
+        for (uint64_t idx = from; idx < to; ++idx) {
+            if (bit(idx)) {
+                run = 0;
+                continue;
+            }
+            if (run == 0) run_start = idx;
+            if (++run == count) return int64_t(run_start);
+        }
+        return -1;
+    };
+    int64_t r = scan(search_hint_, total_blocks_);
+    if (r < 0 && search_hint_ > 0) r = scan(0, total_blocks_);
+    return r;
+}
+
+int64_t DiskTier::store(const void* src, uint32_t size) {
+    if (fd_ < 0 || size == 0) return -1;
+    uint64_t count = (uint64_t(size) + block_size_ - 1) / block_size_;
+    if (used_blocks_ + count > total_blocks_) return -1;
+    int64_t start = find_first_fit(count);
+    if (start < 0) return -1;
+    int64_t off = start * int64_t(block_size_);
+    const uint8_t* p = static_cast<const uint8_t*>(src);
+    uint64_t left = size;
+    int64_t woff = off;
+    while (left > 0) {
+        ssize_t w = pwrite(fd_, p, size_t(left), off_t(woff));
+        if (w <= 0) {
+            if (w < 0 && errno == EINTR) continue;
+            IST_ERROR("disk tier pwrite failed: %s", strerror(errno));
+            return -1;
+        }
+        p += w;
+        woff += w;
+        left -= uint64_t(w);
+    }
+    set_range(uint64_t(start), count, true);
+    used_blocks_ += count;
+    search_hint_ = (uint64_t(start) + count) % total_blocks_;
+    return off;
+}
+
+bool DiskTier::load(int64_t off, void* dst, uint32_t size) {
+    if (fd_ < 0) return false;
+    uint8_t* p = static_cast<uint8_t*>(dst);
+    uint64_t left = size;
+    int64_t roff = off;
+    while (left > 0) {
+        ssize_t r = pread(fd_, p, size_t(left), off_t(roff));
+        if (r <= 0) {
+            if (r < 0 && errno == EINTR) continue;
+            IST_ERROR("disk tier pread failed: %s", strerror(errno));
+            return false;
+        }
+        p += r;
+        roff += r;
+        left -= uint64_t(r);
+    }
+    return true;
+}
+
+void DiskTier::release(int64_t off, uint32_t size) {
+    if (fd_ < 0 || off < 0) return;
+    uint64_t start = uint64_t(off) / block_size_;
+    uint64_t count = (uint64_t(size) + block_size_ - 1) / block_size_;
+    if (start + count > total_blocks_) return;
+    set_range(start, count, false);
+    used_blocks_ -= count;
+    // Return the physical space to the filesystem right away.
+#ifdef FALLOC_FL_PUNCH_HOLE
+    fallocate(fd_, FALLOC_FL_PUNCH_HOLE | FALLOC_FL_KEEP_SIZE, off_t(off),
+              off_t(count * block_size_));
+#endif
+}
+
+}  // namespace istpu

@@ -20,9 +20,10 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
     }
     PoolLoc loc;
     bool got = mm_->allocate(size, &loc);
-    if (!got && eviction_) {
-        // Make room from the cold end of the cache, then retry once.
-        // (evict_lru cannot invalidate mit: it only erases committed
+    if (!got && track_lru()) {
+        // Make room from the cold end of the cache (spill to the disk
+        // tier when present, hard-evict otherwise), then retry once.
+        // (evict_lru cannot invalidate mit: it only touches committed
         // entries, and this one is uncommitted and not in the LRU.)
         if (evict_lru(size) > 0) got = mm_->allocate(size, &loc);
     }
@@ -37,7 +38,10 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
     }
     auto block = std::make_shared<Block>(mm_, loc, size);
     uint64_t token = next_token_++;
-    mit->second = Entry{block, size, /*committed=*/false};
+    Entry e;
+    e.block = block;
+    e.size = size;
+    mit->second = std::move(e);
     inflight_[token] = Inflight{key, block, size};
     out->status = OK;
     out->pool_idx = loc.pool_idx;
@@ -87,6 +91,72 @@ const Entry* KVIndex::get_committed(const std::string& key) {
     if (it == map_.end() || !it->second.committed) return nullptr;
     lru_touch(it->second, it->first);  // reads refresh recency
     return &it->second;
+}
+
+Status KVIndex::get_resident(const std::string& key, const Entry** out) {
+    *out = nullptr;
+    auto it = map_.find(key);
+    if (it == map_.end() || !it->second.committed) return KEY_NOT_FOUND;
+    Entry& e = it->second;
+    if (!e.block) {
+        // Spilled (disk) or in heap limbo: promote back into the pool
+        // (which may itself spill or evict colder entries — this entry
+        // is not in the LRU while non-resident, so it cannot become its
+        // own victim).
+        PoolLoc loc;
+        bool got = mm_->allocate(e.size, &loc);
+        if (!got && evict_lru(e.size) > 0) got = mm_->allocate(e.size, &loc);
+        if (got) {
+            auto block = std::make_shared<Block>(mm_, loc, e.size);
+            if (e.heap) {
+                memcpy(loc.ptr, e.heap->data(), e.size);
+                e.heap.reset();
+            } else if (!e.disk ||
+                       !e.disk->tier->load(e.disk->off, loc.ptr, e.size)) {
+                return INTERNAL_ERROR;  // IO error; block freed by RAII
+            }
+            e.block = std::move(block);
+            e.disk.reset();  // frees the disk extent
+        } else if (e.heap) {
+            // Already in limbo and the pool is still full: retryable.
+            return OUT_OF_MEMORY;
+        } else if (e.disk) {
+            // Pool AND disk full: bounce-swap. Lift this entry's bytes
+            // into a temp buffer, free its disk extent, spill a cold
+            // resident victim into that space, then land here in the pool
+            // — a read must not fail just because both tiers are at
+            // capacity.
+            std::vector<uint8_t> tmp(e.size);
+            if (!e.disk->tier->load(e.disk->off, tmp.data(), e.size)) {
+                return INTERNAL_ERROR;
+            }
+            e.disk.reset();
+            if (evict_lru(e.size) > 0) got = mm_->allocate(e.size, &loc);
+            if (!got) {
+                // Could not land in the pool (everything pinned, or the
+                // freed blocks are not contiguous). Park the bytes back:
+                // on disk if the extent is still free, else in RAM limbo
+                // — a committed entry is never dropped.
+                int64_t off = disk_->store(tmp.data(), e.size);
+                if (off >= 0) {
+                    e.disk = std::make_shared<DiskSpan>(disk_, off, e.size);
+                } else {
+                    e.heap = std::make_shared<std::vector<uint8_t>>(
+                        std::move(tmp));
+                }
+                return OUT_OF_MEMORY;  // retryable
+            }
+            auto block = std::make_shared<Block>(mm_, loc, e.size);
+            memcpy(loc.ptr, tmp.data(), e.size);
+            e.block = std::move(block);
+        } else {
+            return INTERNAL_ERROR;  // no location at all: cannot happen
+        }
+        promotes_++;
+    }
+    lru_touch(e, it->first);
+    *out = &e;
+    return OK;
 }
 
 bool KVIndex::check_exist(const std::string& key) {
@@ -150,7 +220,9 @@ size_t KVIndex::erase(const std::vector<std::string>& keys) {
 }
 
 void KVIndex::lru_touch(Entry& e, const std::string& key) {
-    if (!eviction_) return;
+    // Disk-resident entries stay out of the LRU: there is nothing to
+    // evict or spill until a read promotes them back.
+    if (!track_lru() || !e.block) return;
     if (e.in_lru) lru_.erase(e.lru_it);
     lru_.push_front(key);
     e.lru_it = lru_.begin();
@@ -165,36 +237,64 @@ void KVIndex::lru_drop(Entry& e) {
 }
 
 size_t KVIndex::evict_lru(size_t want) {
-    size_t evicted = 0;
+    size_t victims = 0;
     size_t freed = 0;
+    // Smallest size the tier refused this pass: a failed 4-block store
+    // must not stop 1-block victims from spilling into remaining space.
+    uint32_t disk_min_fail = UINT32_MAX;
     const size_t bs = mm_->block_size();
     auto it = lru_.rbegin();
     while (it != lru_.rend() && freed < want) {
         auto mit = map_.find(*it);
-        if (mit == map_.end()) {
+        if (mit == map_.end() || !mit->second.block) {
             it = std::reverse_iterator(lru_.erase(std::next(it).base()));
             continue;
         }
+        Entry& e = mit->second;
         // Skip entries whose blocks are pinned (reads in flight hold
         // extra refs) — their memory would not return to the pool yet.
-        if (mit->second.block.use_count() > 1) {
+        if (e.block.use_count() > 1) {
+            ++it;
+            continue;
+        }
+        // Spill to the disk tier first; hard-evict only when there is no
+        // tier or this victim cannot be stored (full/fragmented/EIO).
+        bool spilled = false;
+        if (disk_ != nullptr && e.size < disk_min_fail) {
+            int64_t off = disk_->store(e.block->loc.ptr, e.size);
+            if (off >= 0) {
+                e.disk = std::make_shared<DiskSpan>(disk_, off, e.size);
+                e.block.reset();  // frees the pool blocks
+                spilled = true;
+                spills_++;
+            } else {
+                disk_min_fail = e.size;
+            }
+        }
+        if (!spilled && !eviction_) {
+            // Spill-only mode (SSD tier without enable_eviction): never
+            // drop committed data — keep walking, a smaller victim may
+            // still fit the tier.
             ++it;
             continue;
         }
         // Count the block-granular pool footprint, not the logical size —
         // a 4 KB value in a 64 KB-block pool frees a whole block.
-        freed += (size_t(mit->second.size) + bs - 1) / bs * bs;
-        // Erase the victim in place and keep walking coldward from the
-        // same position (restarting at rbegin would re-scan every pinned
-        // cold entry per eviction, O(pinned x evicted) under the lock).
+        freed += (size_t(e.size) + bs - 1) / bs * bs;
+        // Remove the victim from the LRU in place and keep walking
+        // coldward from the same position (restarting at rbegin would
+        // re-scan every pinned cold entry per eviction, O(pinned x
+        // evicted) under the lock).
         auto fwd = std::next(it).base();
-        mit->second.in_lru = false;
-        map_.erase(mit);
+        e.in_lru = false;
+        if (!spilled) {
+            map_.erase(mit);
+            evictions_++;
+        }
         it = std::reverse_iterator(lru_.erase(fwd));
-        evicted++;
-        evictions_++;
+        victims++;
     }
-    return evicted;
+    return victims;
 }
 
 }  // namespace istpu

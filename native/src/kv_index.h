@@ -32,6 +32,7 @@
 #include <vector>
 
 #include "common.h"
+#include "disk_tier.h"
 #include "mempool.h"
 
 namespace istpu {
@@ -50,11 +51,30 @@ struct Block {
 };
 using BlockRef = std::shared_ptr<Block>;
 
+// RAII disk-tier extent: released on last reference drop.
+struct DiskSpan {
+    DiskSpan(DiskTier* tier, int64_t off, uint32_t size)
+        : tier(tier), off(off), size(size) {}
+    ~DiskSpan() { tier->release(off, size); }
+    DiskSpan(const DiskSpan&) = delete;
+    DiskSpan& operator=(const DiskSpan&) = delete;
+
+    DiskTier* tier;
+    int64_t off;
+    uint32_t size;
+};
+using DiskRef = std::shared_ptr<DiskSpan>;
+
 struct Entry {
-    BlockRef block;
+    BlockRef block;  // set while resident in the DRAM pool
+    DiskRef disk;    // set while spilled to the disk tier
+    // Last-resort limbo: holds the bytes when a bounce-swap promote freed
+    // the disk extent but could neither land in the pool nor re-store
+    // (pathological fragmentation). Committed data is never dropped.
+    std::shared_ptr<std::vector<uint8_t>> heap;
     uint32_t size = 0;
     bool committed = false;
-    // Position in the LRU list (valid when committed).
+    // Position in the LRU list (valid when committed and resident).
     std::list<std::string>::iterator lru_it{};
     bool in_lru = false;
 };
@@ -66,8 +86,15 @@ class KVIndex {
     // when the pool is exhausted (beyond reference parity: the reference
     // simply returns OOM forever once full — SURVEY.md §5 notes its only
     // capacity answer is "capacity + chunking").
-    explicit KVIndex(MM* mm, bool eviction = false)
-        : mm_(mm), eviction_(eviction) {}
+    //
+    // disk (optional) adds the spill tier: under pool pressure cold
+    // entries move to disk instead of being dropped, and reads promote
+    // them back (the reference's aspirational "SSD tier",
+    // design.rst:36). With disk but eviction=false, no committed entry
+    // is ever lost (first-writer-wins preserved); with both, disk-full
+    // falls back to hard eviction.
+    explicit KVIndex(MM* mm, bool eviction = false, DiskTier* disk = nullptr)
+        : mm_(mm), eviction_(eviction), disk_(disk) {}
 
     // Reserve an uncommitted block for `key`. Returns:
     //   OK        — new block; out filled, token registered
@@ -87,8 +114,14 @@ class KVIndex {
     void abort(uint64_t token);
 
     // Committed lookup for reads (refreshes LRU recency). nullptr if
-    // missing or uncommitted.
+    // missing or uncommitted. May return a disk-resident entry
+    // (block == nullptr) — use get_resident when the bytes are needed.
     const Entry* get_committed(const std::string& key);
+    // get_committed + promote from the disk tier into the pool if
+    // spilled. OK (*out set), KEY_NOT_FOUND (missing/uncommitted),
+    // OUT_OF_MEMORY (present but promotion failed — retryable, the data
+    // is intact), or INTERNAL_ERROR (tier IO error).
+    Status get_resident(const std::string& key, const Entry** out);
     bool check_exist(const std::string& key);  // exists && committed
 
     // Reference algorithm verbatim in behavior (infinistore.cpp:1092-1108):
@@ -106,6 +139,8 @@ class KVIndex {
     size_t inflight() const { return inflight_.size(); }
     size_t leases() const { return leases_.size(); }
     uint64_t evictions() const { return evictions_; }
+    uint64_t spills() const { return spills_; }
+    uint64_t promotes() const { return promotes_; }
 
     // Evict least-recently-used committed entries whose blocks are not
     // pinned (use_count()==1) until `want` bytes could plausibly be
@@ -122,9 +157,16 @@ class KVIndex {
     void lru_touch(Entry& e, const std::string& key);
     void lru_drop(Entry& e);
 
+    // LRU bookkeeping is needed for eviction and for spill-victim
+    // selection alike.
+    bool track_lru() const { return eviction_ || disk_ != nullptr; }
+
     MM* mm_;
     bool eviction_ = false;
+    DiskTier* disk_ = nullptr;
     uint64_t evictions_ = 0;
+    uint64_t spills_ = 0;
+    uint64_t promotes_ = 0;
     std::list<std::string> lru_;  // front = most recent
     std::unordered_map<std::string, Entry> map_;
     std::unordered_map<uint64_t, Inflight> inflight_;

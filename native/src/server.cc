@@ -62,7 +62,19 @@ bool Server::start() {
         IST_ERROR("pool init failed: %s", e.what());
         return false;
     }
-    index_ = std::make_unique<KVIndex>(mm_.get(), cfg_.enable_eviction);
+    if (cfg_.ssd_bytes > 0 && !cfg_.ssd_path.empty()) {
+        std::string f = cfg_.ssd_path + "/istpu_spill_" +
+                        std::to_string(getpid()) + "_" +
+                        std::to_string(cfg_.port) + ".dat";
+        disk_ = std::make_unique<DiskTier>(f, cfg_.ssd_bytes,
+                                           cfg_.block_size);
+        if (!disk_->ok()) {
+            IST_WARN("disk tier unavailable, continuing without spill");
+            disk_.reset();
+        }
+    }
+    index_ = std::make_unique<KVIndex>(mm_.get(), cfg_.enable_eviction,
+                                       disk_.get());
 
     listen_fd_ = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
     if (listen_fd_ < 0) return false;
@@ -124,9 +136,12 @@ void Server::stop() {
     listen_fd_ = epoll_fd_ = wake_fd_ = -1;
     {
         // Control-plane threads may still be inside kvmap_len/stats;
-        // serialize teardown with them.
+        // serialize teardown with them. Order matters: entries reference
+        // the disk tier (DiskSpan) and the pool (Block), so the index
+        // goes first.
         std::lock_guard<std::mutex> lk(store_mu_);
         index_.reset();
+        disk_.reset();
         mm_.reset();
     }
 }
@@ -149,14 +164,20 @@ std::string Server::stats_json() {
         "{\"kvmap_len\": %zu, \"inflight\": %zu, \"leases\": %zu, "
         "\"pools\": %zu, \"pool_bytes\": %zu, \"used_bytes\": %zu, "
         "\"ops\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu, "
-        "\"connections\": %zu, \"evictions\": %llu, \"op_stats\": {",
+        "\"connections\": %zu, \"evictions\": %llu, \"spills\": %llu, "
+        "\"promotes\": %llu, \"disk_bytes\": %llu, \"disk_used\": %llu, "
+        "\"op_stats\": {",
         index_ ? index_->size() : 0, index_ ? index_->inflight() : 0,
         index_ ? index_->leases() : 0, mm_ ? mm_->num_pools() : 0,
         mm_ ? mm_->total_bytes() : 0, mm_ ? mm_->used_bytes() : 0,
         (unsigned long long)ops_.load(),
         (unsigned long long)bytes_in_.load(),
         (unsigned long long)bytes_out_.load(), size_t(n_conns_.load()),
-        (unsigned long long)(index_ ? index_->evictions() : 0));
+        (unsigned long long)(index_ ? index_->evictions() : 0),
+        (unsigned long long)(index_ ? index_->spills() : 0),
+        (unsigned long long)(index_ ? index_->promotes() : 0),
+        (unsigned long long)(disk_ ? disk_->capacity_bytes() : 0),
+        (unsigned long long)(disk_ ? disk_->used_bytes() : 0));
     // Per-op handler-time table (the reference logs per-op latency ad hoc,
     // infinistore.cpp:1114,1162-1166; here it is queryable).
     bool first = true;
@@ -715,9 +736,13 @@ void Server::op_read(Conn& c) {
     {
         std::lock_guard<std::mutex> lk(store_mu_);
         for (auto& k : keys) {
-            const Entry* e = index_->get_committed(k);
-            if (e == nullptr || e->size < block_size) {
-                w.u32(KEY_NOT_FOUND);
+            // get_resident promotes spilled entries back into the pool.
+            // A failed promotion surfaces as its own (retryable) status,
+            // not KEY_NOT_FOUND — the data is still there.
+            const Entry* e = nullptr;
+            Status st = index_->get_resident(k, &e);
+            if (st != OK || e->size < block_size) {
+                w.u32(st != OK ? st : KEY_NOT_FOUND);
                 respond(c, c.hdr.seq, OP_READ, std::move(body));
                 return;
             }
@@ -794,9 +819,12 @@ void Server::op_pin(Conn& c) {
     {
         std::lock_guard<std::mutex> lk(store_mu_);
         for (auto& k : keys) {
-            const Entry* e = index_->get_committed(k);
-            if (e == nullptr) {
-                w.u32(KEY_NOT_FOUND);
+            // get_resident promotes spilled entries back into the pool;
+            // failed promotion is a retryable status, not KEY_NOT_FOUND.
+            const Entry* e = nullptr;
+            Status st = index_->get_resident(k, &e);
+            if (st != OK) {
+                w.u32(st);
                 respond(c, c.hdr.seq, OP_PIN, std::move(body));
                 return;
             }

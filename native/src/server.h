@@ -60,6 +60,12 @@ struct ServerConfig {
     bool enable_shm = true;          // expose the pool as POSIX shm
     std::string shm_prefix;          // default derived from pid+port
     bool enable_eviction = false;    // LRU-evict committed entries on OOM
+    // Disk spill tier (reference's aspirational SSD tier, design.rst:36):
+    // when ssd_bytes > 0, cold entries spill to a file under ssd_path on
+    // pool pressure and promote back on read. Without enable_eviction no
+    // committed entry is ever dropped (spill-only mode).
+    std::string ssd_path;
+    uint64_t ssd_bytes = 0;
 };
 
 class Server {
@@ -167,6 +173,7 @@ class Server {
     // nothing and removes the shared-loop coupling).
     std::mutex store_mu_;
     std::unique_ptr<MM> mm_;
+    std::unique_ptr<DiskTier> disk_;
     std::unique_ptr<KVIndex> index_;
 
     std::unordered_map<int, std::unique_ptr<Conn>> conns_;
