@@ -619,7 +619,7 @@ class InfinityConnection:
 
     def stats(self):
         self._check()
-        buf = ct.create_string_buffer(4096)
+        buf = ct.create_string_buffer(16384)
         st = self._lib.ist_client_stats(self._h, buf, len(buf))
         if st != OK:
             raise InfiniStoreError(st, "stats failed")

@@ -76,7 +76,7 @@ class InfiniStoreServer:
         return int(self._lib.ist_server_purge(self._h))
 
     def stats(self):
-        buf = ct.create_string_buffer(4096)
+        buf = ct.create_string_buffer(16384)
         self._lib.ist_server_stats(self._h, buf, len(buf))
         return json.loads(buf.value.decode())
 
@@ -118,6 +118,60 @@ def _selftest(service_port):
         conn.close()
 
 
+def _prometheus_metrics(stats):
+    """Render the native stats blob in Prometheus text format
+    (observability beyond the reference, which exposes only
+    /kvmap_len + /purge + /selftest — reference server.py:29-96)."""
+    g = [  # (stat key, metric name, help)
+        ("kvmap_len", "keys", "committed + inflight keys in the index"),
+        ("inflight", "inflight_writes", "uncommitted allocations"),
+        ("leases", "pin_leases", "active SHM read leases"),
+        ("pools", "pools", "DRAM pool count"),
+        ("pool_bytes", "pool_bytes", "total DRAM pool capacity"),
+        ("used_bytes", "pool_used_bytes", "allocated DRAM pool bytes"),
+        ("connections", "connections", "open client connections"),
+        ("disk_bytes", "disk_tier_bytes", "disk spill tier capacity"),
+        ("disk_used", "disk_tier_used_bytes", "disk spill tier usage"),
+    ]
+    c = [
+        ("ops", "ops", "requests handled"),
+        ("bytes_in", "bytes_in", "payload+metadata bytes received"),
+        ("bytes_out", "bytes_out", "payload+metadata bytes sent"),
+        ("evictions", "evictions", "entries hard-evicted under pressure"),
+        ("spills", "spills", "entries spilled to the disk tier"),
+        ("promotes", "promotes", "entries promoted back from disk"),
+    ]
+    lines = []
+    for key, name, help_ in g:
+        lines.append(f"# HELP infinistore_{name} {help_}")
+        lines.append(f"# TYPE infinistore_{name} gauge")
+        lines.append(f"infinistore_{name} {stats.get(key, 0)}")
+    for key, name, help_ in c:
+        lines.append(f"# HELP infinistore_{name}_total {help_}")
+        lines.append(f"# TYPE infinistore_{name}_total counter")
+        lines.append(f"infinistore_{name}_total {stats.get(key, 0)}")
+    # One contiguous group per metric (exposition-format requirement).
+    op_stats = stats.get("op_stats", {})
+    lines.append("# HELP infinistore_op_count_total per-op request count")
+    lines.append("# TYPE infinistore_op_count_total counter")
+    for op, s in op_stats.items():
+        lines.append(
+            f'infinistore_op_count_total{{op="{op}"}} {s.get("count", 0)}'
+        )
+    lines.append(
+        "# HELP infinistore_op_latency_us per-op handler latency "
+        "(us, histogram percentiles)"
+    )
+    lines.append("# TYPE infinistore_op_latency_us gauge")
+    for op, s in op_stats.items():
+        for q, label in (("p50_us", "0.5"), ("p99_us", "0.99")):
+            lines.append(
+                f'infinistore_op_latency_us{{op="{op}",'
+                f'quantile="{label}"}} {s.get(q, 0)}'
+            )
+    return "\n".join(lines) + "\n"
+
+
 def make_control_plane(server: InfiniStoreServer):
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code, payload):
@@ -128,11 +182,23 @@ def make_control_plane(server: InfiniStoreServer):
             self.end_headers()
             self.wfile.write(body)
 
+        def _send_text(self, code, text):
+            body = text.encode()
+            self.send_response(code)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):
             if self.path == "/kvmap_len":
                 self._send(200, server.kvmap_len())
             elif self.path == "/stats":
                 self._send(200, server.stats())
+            elif self.path == "/metrics":
+                self._send_text(200, _prometheus_metrics(server.stats()))
             elif self.path == "/health":
                 self._send(200, {"status": "ok"})
             else:

@@ -158,9 +158,9 @@ size_t Server::purge() {
 
 std::string Server::stats_json() {
     std::lock_guard<std::mutex> lk(store_mu_);
-    char buf[2048];
-    int off = snprintf(
-        buf, sizeof(buf),
+    char head[768];
+    snprintf(
+        head, sizeof(head),
         "{\"kvmap_len\": %zu, \"inflight\": %zu, \"leases\": %zu, "
         "\"pools\": %zu, \"pool_bytes\": %zu, \"used_bytes\": %zu, "
         "\"ops\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu, "
@@ -178,26 +178,29 @@ std::string Server::stats_json() {
         (unsigned long long)(index_ ? index_->promotes() : 0),
         (unsigned long long)(disk_ ? disk_->capacity_bytes() : 0),
         (unsigned long long)(disk_ ? disk_->used_bytes() : 0));
-    // Per-op handler-time table (the reference logs per-op latency ad hoc,
-    // infinistore.cpp:1114,1162-1166; here it is queryable).
+    std::string out = head;
+    // Per-op handler-time table with histogram percentiles (the reference
+    // logs per-op latency ad hoc, infinistore.cpp:1114,1162-1166; here it
+    // is queryable).
     bool first = true;
     for (int op = 1; op < kMaxOp; ++op) {
         uint64_t n = op_count_[op].load(std::memory_order_relaxed);
         if (n == 0) continue;
-        char entry[128];
-        int w = snprintf(entry, sizeof(entry),
-                         "%s\"%s\": {\"count\": %llu, \"total_us\": %llu}",
-                         first ? "" : ", ", op_name(uint8_t(op)),
-                         (unsigned long long)n,
-                         (unsigned long long)op_us_[op].load(
-                             std::memory_order_relaxed));
-        if (w < 0 || off + w >= int(sizeof(buf)) - 3) break;  // keep valid JSON
-        memcpy(buf + off, entry, size_t(w));
-        off += w;
+        char entry[192];
+        snprintf(entry, sizeof(entry),
+                 "%s\"%s\": {\"count\": %llu, \"total_us\": %llu, "
+                 "\"p50_us\": %llu, \"p99_us\": %llu}",
+                 first ? "" : ", ", op_name(uint8_t(op)),
+                 (unsigned long long)n,
+                 (unsigned long long)op_us_[op].load(
+                     std::memory_order_relaxed),
+                 (unsigned long long)op_percentile_us(op, 0.50),
+                 (unsigned long long)op_percentile_us(op, 0.99));
+        out += entry;
         first = false;
     }
-    snprintf(buf + off, sizeof(buf) - size_t(off), "}}");
-    return buf;
+    out += "}}";
+    return out;
 }
 
 void Server::loop() {
@@ -584,6 +587,28 @@ void Server::account_op(uint8_t op, long long us) {
     if (op >= kMaxOp) return;
     op_count_[op].fetch_add(1, std::memory_order_relaxed);
     op_us_[op].fetch_add(uint64_t(us), std::memory_order_relaxed);
+    int b = 0;
+    uint64_t v = us > 0 ? uint64_t(us) : 0;
+    while (v > 1 && b < kNumBuckets - 1) {
+        v >>= 1;
+        b++;
+    }
+    op_hist_[op][b].fetch_add(1, std::memory_order_relaxed);
+}
+
+uint64_t Server::op_percentile_us(int op, double q) const {
+    uint64_t total = 0;
+    for (int b = 0; b < kNumBuckets; ++b) {
+        total += op_hist_[op][b].load(std::memory_order_relaxed);
+    }
+    if (total == 0) return 0;
+    uint64_t rank = uint64_t(q * double(total - 1)) + 1;
+    uint64_t seen = 0;
+    for (int b = 0; b < kNumBuckets; ++b) {
+        seen += op_hist_[op][b].load(std::memory_order_relaxed);
+        if (seen >= rank) return 1ull << (b + 1);  // bucket upper bound
+    }
+    return 1ull << kNumBuckets;
 }
 
 void Server::begin_put(Conn& c) {
@@ -736,13 +761,22 @@ void Server::op_read(Conn& c) {
     {
         std::lock_guard<std::mutex> lk(store_mu_);
         for (auto& k : keys) {
+            // Cheap metadata check first: a read that will be refused for
+            // its size must not pay disk promotion (or churn the cache
+            // making pool room for it).
+            const Entry* meta = index_->get_committed(k);
+            if (meta == nullptr || meta->size < block_size) {
+                w.u32(KEY_NOT_FOUND);
+                respond(c, c.hdr.seq, OP_READ, std::move(body));
+                return;
+            }
             // get_resident promotes spilled entries back into the pool.
             // A failed promotion surfaces as its own (retryable) status,
             // not KEY_NOT_FOUND — the data is still there.
             const Entry* e = nullptr;
             Status st = index_->get_resident(k, &e);
-            if (st != OK || e->size < block_size) {
-                w.u32(st != OK ? st : KEY_NOT_FOUND);
+            if (st != OK) {
+                w.u32(st);
                 respond(c, c.hdr.seq, OP_READ, std::move(body));
                 return;
             }

@@ -181,10 +181,17 @@ class Server {
 
     // stats
     static constexpr int kMaxOp = 32;
+    // Power-of-two latency buckets: bucket i counts handler times in
+    // [2^i, 2^(i+1)) µs; the last bucket absorbs everything slower
+    // (~0.5 s+). Queryable percentiles beat the reference's ad-hoc
+    // per-request latency logging (infinistore.cpp:1114,1162-1166).
+    static constexpr int kNumBuckets = 20;
     void account_op(uint8_t op, long long us);
+    uint64_t op_percentile_us(int op, double q) const;
     std::atomic<uint64_t> ops_{0}, bytes_in_{0}, bytes_out_{0};
     std::atomic<uint64_t> op_count_[kMaxOp] = {};
     std::atomic<uint64_t> op_us_[kMaxOp] = {};
+    std::atomic<uint64_t> op_hist_[kMaxOp][kNumBuckets] = {};
 };
 
 }  // namespace istpu

@@ -1,0 +1,127 @@
+"""HTTP manage-plane tests: /health, /kvmap_len, /stats (with native
+latency percentiles), /metrics (Prometheus text), /purge, /selftest.
+
+The reference exposes /purge, /kvmap_len and /selftest over FastAPI
+(reference server.py:29-96) but has no metrics endpoint and no queryable
+latency stats; /stats percentiles and /metrics are beyond parity.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from infinistore_tpu import (
+    ClientConfig,
+    InfiniStoreServer,
+    InfinityConnection,
+    ServerConfig,
+    TYPE_STREAM,
+)
+from infinistore_tpu.server import make_control_plane
+
+
+@pytest.fixture(scope="module")
+def plane():
+    srv = InfiniStoreServer(
+        ServerConfig(
+            service_port=0,
+            manage_port=1,  # placeholder; rebound to ephemeral below
+            prealloc_size=0.01,
+            minimal_allocate_size=16,
+        )
+    )
+    srv.start()
+    srv.config.manage_port = 0  # ephemeral bind for the HTTP plane
+    httpd = make_control_plane(srv)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    conn = InfinityConnection(
+        ClientConfig(
+            host_addr="127.0.0.1",
+            service_port=srv.service_port,
+            connection_type=TYPE_STREAM,
+        )
+    )
+    conn.connect()
+    for i in range(20):
+        conn.put_cache(np.zeros(16384, dtype=np.uint8), [(f"cp{i}", 0)], 16384)
+        conn.sync()
+        dst = np.zeros(16384, dtype=np.uint8)
+        conn.read_cache(dst, [(f"cp{i}", 0)], 16384)
+        conn.sync()
+
+    yield base, srv, conn
+    conn.close()
+    httpd.shutdown()
+    srv.stop()
+
+
+def get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as r:
+        return r.read().decode()
+
+
+def post(base, path):
+    req = urllib.request.Request(base + path, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.read().decode()
+
+
+def test_health_and_kvmap_len(plane):
+    base, srv, _ = plane
+    assert json.loads(get(base, "/health")) == {"status": "ok"}
+    assert json.loads(get(base, "/kvmap_len")) == srv.kvmap_len() == 20
+
+
+def test_stats_latency_percentiles(plane):
+    base, _, _ = plane
+    stats = json.loads(get(base, "/stats"))
+    for op in ("PUT", "READ"):
+        s = stats["op_stats"][op]
+        assert s["count"] == 20
+        # Histogram percentiles: powers of two, ordered, nonzero.
+        assert 0 < s["p50_us"] <= s["p99_us"]
+        assert s["p99_us"] & (s["p99_us"] - 1) == 0
+
+
+def test_prometheus_metrics(plane):
+    base, _, _ = plane
+    text = get(base, "/metrics")
+    assert "# TYPE infinistore_keys gauge" in text
+    assert "infinistore_keys 20" in text
+    assert "# TYPE infinistore_ops_total counter" in text
+    assert 'infinistore_op_count_total{op="READ"} 20' in text
+    assert 'infinistore_op_latency_us{op="PUT",quantile="0.5"}' in text
+    # Exposition format: all samples of one metric form a contiguous group.
+    names = [
+        line.split("{", 1)[0].split(" ", 1)[0]
+        for line in text.strip().splitlines()
+        if not line.startswith("#")
+    ]
+    seen, prev = set(), None
+    for n in names:
+        if n != prev:
+            assert n not in seen, f"metric {n} split into multiple groups"
+            seen.add(n)
+        prev = n
+    # Every sample line parses as "name{labels} value" with numeric value.
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        float(value)
+
+
+def test_selftest_and_purge(plane):
+    base, srv, _ = plane
+    assert json.loads(post(base, f"/selftest/{srv.service_port}")) == {
+        "selftest": True
+    }
+    purged = json.loads(post(base, "/purge"))["purged"]
+    assert purged >= 20
+    assert srv.kvmap_len() == 0
