@@ -96,6 +96,13 @@ def _decls(lib):
         ("ist_conn_close", None, [c.c_void_p]),
         ("ist_conn_destroy", None, [c.c_void_p]),
         ("ist_conn_shm_active", c.c_int, [c.c_void_p]),
+        ("ist_conn_broken", c.c_int, [c.c_void_p]),
+        (
+            "ist_reclaim_orphans",
+            c.c_uint32,
+            [c.c_void_p, c.c_char_p, c.c_uint64, c.c_uint32,
+             c.POINTER(c.c_uint64)],
+        ),
         ("ist_conn_block_size", c.c_uint32, [c.c_void_p]),
         ("ist_conn_inflight", c.c_uint64, [c.c_void_p]),
         (

@@ -41,6 +41,10 @@ class ClientConfig:
         self.connection_type = kwargs.get("connection_type", TYPE_AUTO)
         self.window_bytes = kwargs.get("window_bytes", 64 << 20)
         self.timeout_ms = kwargs.get("timeout_ms", 10000)
+        # Reconnect once and retry key-addressed ops after a
+        # connection-level failure (timeout teardown / broken socket).
+        # Beyond reference parity: the reference has no client reconnect.
+        self.auto_reconnect = kwargs.get("auto_reconnect", False)
         if "INFINISTORE_LOG_LEVEL" in os.environ:
             self.log_level = os.environ["INFINISTORE_LOG_LEVEL"].lower()
         else:

@@ -155,6 +155,12 @@ class InfinityConnection:
         # caller through the completion path + sync barrier).
         self._async_errors = []
         self._async_errors_lock = threading.Lock()
+        # Reconnect bookkeeping: generation guards against concurrent
+        # double-reconnects; dead handles are freed only at close().
+        self._reconnect_lock = threading.Lock()
+        self._conn_gen = 0
+        self._dead_handles = []
+        self._ever_connected = False
 
     # ------------------------------------------------------------------
     # connection lifecycle
@@ -187,10 +193,16 @@ class InfinityConnection:
             )
         self.shm_connected = bool(self._lib.ist_conn_shm_active(self._h))
         if self.config.connection_type == TYPE_SHM and not self.shm_connected:
-            self.close()
+            # Tear down only the handle we just created — NOT close(),
+            # which would also free handles parked by reconnects while
+            # other threads may still be inside native calls on them.
+            self._lib.ist_conn_close(self._h)
+            self._lib.ist_conn_destroy(self._h)
+            self._h = None
             raise Exception("SHM path requested but unavailable")
         self.stream_connected = not self.shm_connected
         self.connected = True
+        self._ever_connected = True
         return 0
 
     def close(self):
@@ -198,9 +210,13 @@ class InfinityConnection:
             self._lib.ist_conn_close(self._h)
             self._lib.ist_conn_destroy(self._h)
             self._h = None
+        for h in self._dead_handles:  # handles parked by reconnects
+            self._lib.ist_conn_destroy(h)
+        self._dead_handles = []
         self.connected = False
         self.shm_connected = False
         self.stream_connected = False
+        self._ever_connected = False  # explicit close: no auto re-dial
 
     def __enter__(self):
         self.connect()
@@ -211,8 +227,112 @@ class InfinityConnection:
         return False
 
     def _check(self):
-        if not self.connected:
-            raise Exception("Not connected to any instance")
+        if self.connected:
+            return
+        if self.config.auto_reconnect and self._ever_connected:
+            # Either a reconnect is in progress on another thread (wait it
+            # out — the lock is held for the whole close+connect) or a
+            # previous reconnect attempt failed while the server was still
+            # down: re-dial here so the client recovers once the server is
+            # back instead of being wedged until a manual reconnect().
+            with self._reconnect_lock:
+                if self.connected:
+                    return
+                try:
+                    self._reconnect_locked()
+                    return
+                except Exception:
+                    pass
+        raise Exception("Not connected to any instance")
+
+    def reconnect(self):
+        """Tear down and re-establish this connection on a fresh native
+        handle (beyond reference parity — the reference has no client
+        reconnect, SURVEY.md §5). Outstanding async ops complete with
+        INTERNAL_ERROR; RemoteBlocks/tokens obtained before the reconnect
+        are invalid (allocate again). After a server restart the SHM pool
+        table is re-negotiated via HELLO, so both paths come back."""
+        with self._reconnect_lock:
+            self._reconnect_locked()
+        return 0
+
+    def _reconnect_locked(self):
+        # Close the old handle (shuts fds, joins the IO thread, fails all
+        # pending ops) but DEFER freeing it until the final close():
+        # another thread may still be inside a native call on it, and a
+        # closed-but-live handle fails such calls safely while a freed one
+        # is a use-after-free.
+        if self._h:
+            self._lib.ist_conn_close(self._h)
+            self._dead_handles.append(self._h)
+            # Leave self._h pointing at the closed handle until connect()
+            # swaps in the new one: a concurrent thread mid-call fails
+            # safely on a closed handle, but would NULL-deref on None
+            # (the capi layer also guards NULL as a backstop).
+        self.connected = False
+        self.shm_connected = False
+        self.stream_connected = False
+        self.connect()
+        self._conn_gen += 1
+
+    # Connection-level statuses worth a reconnect+retry. Definitive store
+    # answers (KEY_NOT_FOUND, CONFLICT, OUT_OF_MEMORY, BAD_REQUEST) are
+    # never retried.
+    _RETRYABLE = (TIMEOUT_ERR, _native.INTERNAL_ERROR)
+
+    def _run_reconnecting(self, fn, keys=None):
+        """Run ``fn``; when ``config.auto_reconnect`` is set, the error is
+        a connection-level status AND the native connection reports itself
+        broken (socket failure or timeout teardown — not an op-level error
+        on a healthy connection), reconnect once and retry. Only
+        key-addressed ops use this — token-based ops (write_cache/commit)
+        cannot be replayed because tokens die with the server session.
+
+        ``keys``: for put/allocate retries — keys the dead connection had
+        allocated but never committed may still be dedup-poisoned if the
+        server has not yet processed the old socket's close (which aborts
+        them). One batched OP_RECLAIM erases exactly those orphans (never
+        a concurrent writer's live allocation) so the retry can
+        re-allocate them."""
+        h0 = self._h
+        gen = self._conn_gen
+        try:
+            return fn()
+        except InfiniStoreError as e:
+            if (
+                not self.config.auto_reconnect
+                or e.status not in self._RETRYABLE
+            ):
+                raise
+            with self._reconnect_lock:
+                if self._conn_gen == gen:
+                    # Nobody reconnected since our attempt; only do it if
+                    # the connection is actually dead.
+                    if not self._h or not self._lib.ist_conn_broken(self._h):
+                        raise
+                    Logger.warning(f"connection failure ({e}); reconnecting")
+                    self._reconnect_locked()
+                elif self._h == h0:
+                    # Generation moved but the handle did not change: the
+                    # reconnect predates our attempt, so our failure is
+                    # its own story — don't mask it with a retry.
+                    raise
+                if keys:
+                    self._reclaim_orphans(keys)
+            return fn()
+
+    def _reclaim_orphans(self, keys):
+        # One batched rpc; the server erases only entries that are
+        # uncommitted AND have no live inflight token (their writer died
+        # before commit) — a concurrent writer's in-progress allocation
+        # of the same key is never disturbed.
+        blob = pack_keys(keys)
+        n = ct.c_uint64(0)
+        st = self._lib.ist_reclaim_orphans(
+            self._h, blob, len(blob), len(keys), ct.byref(n)
+        )
+        if st == OK and n.value:
+            Logger.warning(f"reclaimed {n.value} orphaned key(s) on retry")
 
     # ------------------------------------------------------------------
     # allocate
@@ -225,6 +345,12 @@ class InfinityConnection:
         skipped on write (first-writer-wins dedup, reference
         infinistore.cpp:353-359)."""
         self._check()
+        return self._run_reconnecting(
+            lambda: self._allocate_once(keys, page_size_in_bytes),
+            keys=keys,
+        )
+
+    def _allocate_once(self, keys, page_size_in_bytes):
         blob = pack_keys(keys)
         out = np.zeros(len(keys), dtype=REMOTE_BLOCK_DTYPE)
         st = self._lib.ist_allocate(
@@ -419,6 +545,12 @@ class InfinityConnection:
     def put_cache(self, cache, blocks, page_size):
         """Synchronous one-call put of (key, offset) pairs."""
         self._check()
+        return self._run_reconnecting(
+            lambda: self._put_cache_once(cache, blocks, page_size),
+            keys=[k for k, _ in blocks],
+        )
+
+    def _put_cache_once(self, cache, blocks, page_size):
         done = threading.Event()
         result = {}
 
@@ -511,6 +643,11 @@ class InfinityConnection:
         :class:`InfiniStoreKeyNotFound` (reference returns KEY_NOT_FOUND,
         infinistore.cpp:607)."""
         self._check()
+        return self._run_reconnecting(
+            lambda: self._read_cache_once(cache, blocks, page_size)
+        )
+
+    def _read_cache_once(self, cache, blocks, page_size):
         arr, page_bytes, blob, dst_np = self._prep_read(
             cache, blocks, page_size
         )
@@ -570,27 +707,35 @@ class InfinityConnection:
 
     def check_exist(self, key):
         self._check()
-        kb = key.encode()
-        ret = self._lib.ist_check_exist(self._h, kb, len(kb))
-        if ret < 0:
-            raise InfiniStoreError(-ret, "check_exist failed")
-        return ret == 1
+
+        def once():
+            kb = key.encode()
+            ret = self._lib.ist_check_exist(self._h, kb, len(kb))
+            if ret < 0:
+                raise InfiniStoreError(-ret, "check_exist failed")
+            return ret == 1
+
+        return self._run_reconnecting(once)
 
     def get_match_last_index(self, keys):
         """Longest cached prefix of the key list — THE prefix-cache-hit
         primitive for vLLM (reference infinistore.cpp:1092-1108). Raises
         if no key matches (reference lib.py:627-643)."""
         self._check()
-        blob = pack_keys(keys)
-        idx = ct.c_int32(-1)
-        st = self._lib.ist_get_match_last_index(
-            self._h, blob, len(blob), len(keys), ct.byref(idx)
-        )
-        if st != OK:
-            raise InfiniStoreError(st, "get_match_last_index failed")
-        if idx.value < 0:
-            raise Exception("can't find a match")
-        return idx.value
+
+        def once():
+            blob = pack_keys(keys)
+            idx = ct.c_int32(-1)
+            st = self._lib.ist_get_match_last_index(
+                self._h, blob, len(blob), len(keys), ct.byref(idx)
+            )
+            if st != OK:
+                raise InfiniStoreError(st, "get_match_last_index failed")
+            if idx.value < 0:
+                raise Exception("can't find a match")
+            return idx.value
+
+        return self._run_reconnecting(once)
 
     def register_mr(self, cache):
         """No-op for API compatibility (no MR registration on TCP/SHM)."""

@@ -127,21 +127,32 @@ void* ist_conn_create(const char* host, uint16_t port, int use_shm,
 }
 
 int ist_conn_connect(void* h) {
+    if (h == nullptr) return -1;
     return static_cast<Connection*>(h)->connect_server();
 }
 
-void ist_conn_close(void* h) { static_cast<Connection*>(h)->close_conn(); }
+void ist_conn_close(void* h) {
+    if (h != nullptr) static_cast<Connection*>(h)->close_conn();
+}
 void ist_conn_destroy(void* h) { delete static_cast<Connection*>(h); }
 
 int ist_conn_shm_active(void* h) {
+    if (h == nullptr) return 0;
     return static_cast<Connection*>(h)->shm_active() ? 1 : 0;
 }
 
+int ist_conn_broken(void* h) {
+    if (h == nullptr) return 1;
+    return static_cast<Connection*>(h)->is_broken() ? 1 : 0;
+}
+
 uint32_t ist_conn_block_size(void* h) {
+    if (h == nullptr) return 0;
     return static_cast<Connection*>(h)->server_block_size();
 }
 
 uint64_t ist_conn_inflight(void* h) {
+    if (h == nullptr) return 0;
     return static_cast<Connection*>(h)->inflight();
 }
 
@@ -149,6 +160,7 @@ uint64_t ist_conn_inflight(void* h) {
 uint32_t ist_allocate(void* h, const uint8_t* keys_blob, uint64_t blob_len,
                       uint32_t nkeys, uint32_t block_size, RemoteBlock* out) {
     auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
     std::vector<std::string> keys;
     if (!parse_keys(keys_blob, blob_len, nkeys, &keys)) return BAD_REQUEST;
     std::vector<uint8_t> body;
@@ -171,6 +183,7 @@ uint32_t ist_write_async(void* h, uint32_t block_size, uint32_t n,
                          const uint64_t* tokens, const void* const* srcs,
                          ist_callback cb, void* ud) {
     auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
     std::vector<uint64_t> toks(tokens, tokens + n);
     std::vector<const void*> sp(srcs, srcs + n);
     c->write_async(block_size, std::move(toks), std::move(sp),
@@ -183,6 +196,7 @@ uint32_t ist_put_async(void* h, uint32_t block_size,
                        uint32_t nkeys, const void* const* srcs,
                        ist_callback cb, void* ud) {
     auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
     std::vector<std::string> keys;
     if (!parse_keys(keys_blob, blob_len, nkeys, &keys)) return BAD_REQUEST;
     std::vector<const void*> sp(srcs, srcs + nkeys);
@@ -194,6 +208,7 @@ uint32_t ist_read_async(void* h, uint32_t block_size, const uint8_t* keys_blob,
                         uint64_t blob_len, uint32_t nkeys, void* const* dsts,
                         ist_callback cb, void* ud) {
     auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
     std::vector<std::string> keys;
     if (!parse_keys(keys_blob, blob_len, nkeys, &keys)) return BAD_REQUEST;
     std::vector<void*> dp(dsts, dsts + nkeys);
@@ -206,6 +221,7 @@ uint32_t ist_shm_write_async(void* h, uint32_t block_size, uint32_t n,
                              const void* const* srcs, ist_callback cb,
                              void* ud) {
     auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
     std::vector<RemoteBlock> blks(blocks, blocks + n);
     std::vector<const void*> sp(srcs, srcs + n);
     c->shm_write_async(block_size, std::move(blks), std::move(sp),
@@ -218,6 +234,7 @@ uint32_t ist_shm_read_async(void* h, uint32_t block_size,
                             uint32_t nkeys, void* const* dsts, ist_callback cb,
                             void* ud) {
     auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
     std::vector<std::string> keys;
     if (!parse_keys(keys_blob, blob_len, nkeys, &keys)) return BAD_REQUEST;
     std::vector<void*> dp(dsts, dsts + nkeys);
@@ -227,6 +244,7 @@ uint32_t ist_shm_read_async(void* h, uint32_t block_size,
 }
 
 uint32_t ist_sync(void* h, int timeout_ms) {
+    if (h == nullptr) return INTERNAL_ERROR;
     return static_cast<Connection*>(h)->sync(timeout_ms);
 }
 
@@ -239,6 +257,7 @@ uint32_t ist_read(void* h, uint32_t block_size, const uint8_t* keys_blob,
                   uint64_t blob_len, uint32_t nkeys, void* const* dsts,
                   int timeout_ms) {
     auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
     std::vector<std::string> keys;
     if (!parse_keys(keys_blob, blob_len, nkeys, &keys)) return BAD_REQUEST;
     std::vector<void*> dp(dsts, dsts + nkeys);
@@ -281,6 +300,7 @@ uint32_t ist_read(void* h, uint32_t block_size, const uint8_t* keys_blob,
 // that writes pool memory directly).
 uint32_t ist_commit(void* h, const uint64_t* tokens, uint32_t n) {
     auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
     std::vector<uint8_t> body;
     BufWriter w(body);
     uint32_t real = 0;
@@ -298,6 +318,7 @@ uint32_t ist_commit(void* h, const uint64_t* tokens, uint32_t n) {
 uint32_t ist_pin(void* h, const uint8_t* keys_blob, uint64_t blob_len,
                  uint32_t nkeys, RemoteBlock* out, uint64_t* lease) {
     auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
     std::vector<std::string> keys;
     if (!parse_keys(keys_blob, blob_len, nkeys, &keys)) return BAD_REQUEST;
     std::vector<uint8_t> body;
@@ -319,6 +340,7 @@ uint32_t ist_pin(void* h, const uint8_t* keys_blob, uint64_t blob_len,
 // keys become writable again instead of permanently dedup-poisoned).
 uint32_t ist_abort(void* h, const uint64_t* tokens, uint32_t n) {
     auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
     std::vector<uint8_t> body;
     BufWriter w(body);
     uint32_t real = 0;
@@ -334,6 +356,7 @@ uint32_t ist_abort(void* h, const uint64_t* tokens, uint32_t n) {
 
 uint32_t ist_release(void* h, uint64_t lease) {
     auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
     std::vector<uint8_t> body;
     BufWriter w(body);
     w.u64(lease);
@@ -342,6 +365,7 @@ uint32_t ist_release(void* h, uint64_t lease) {
 
 int ist_check_exist(void* h, const char* key, uint32_t klen) {
     auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return -int(INTERNAL_ERROR);
     std::vector<uint8_t> body;
     BufWriter w(body);
     w.str(std::string(key, klen));
@@ -356,6 +380,7 @@ uint32_t ist_get_match_last_index(void* h, const uint8_t* keys_blob,
                                   uint64_t blob_len, uint32_t nkeys,
                                   int32_t* index) {
     auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
     std::vector<std::string> keys;
     if (!parse_keys(keys_blob, blob_len, nkeys, &keys)) return BAD_REQUEST;
     std::vector<uint8_t> body;
@@ -371,6 +396,7 @@ uint32_t ist_get_match_last_index(void* h, const uint8_t* keys_blob,
 
 uint32_t ist_client_purge(void* h, uint64_t* count) {
     auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
     std::vector<uint8_t> resp;
     uint32_t st = c->rpc(OP_PURGE, {}, &resp);
     if (st == OK && count) {
@@ -383,6 +409,7 @@ uint32_t ist_client_purge(void* h, uint64_t* count) {
 uint32_t ist_delete_keys(void* h, const uint8_t* keys_blob, uint64_t blob_len,
                          uint32_t nkeys, uint64_t* count) {
     auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
     std::vector<std::string> keys;
     if (!parse_keys(keys_blob, blob_len, nkeys, &keys)) return BAD_REQUEST;
     std::vector<uint8_t> body;
@@ -397,8 +424,30 @@ uint32_t ist_delete_keys(void* h, const uint8_t* keys_blob, uint64_t blob_len,
     return st;
 }
 
+// Erase orphaned uncommitted entries (writer died before commit); used
+// by post-reconnect put retries. Entries with live writers are untouched.
+uint32_t ist_reclaim_orphans(void* h, const uint8_t* keys_blob,
+                             uint64_t blob_len, uint32_t nkeys,
+                             uint64_t* count) {
+    auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
+    std::vector<std::string> keys;
+    if (!parse_keys(keys_blob, blob_len, nkeys, &keys)) return BAD_REQUEST;
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    w.keys(keys);
+    std::vector<uint8_t> resp;
+    uint32_t st = c->rpc(OP_RECLAIM, std::move(body), &resp);
+    if (st == OK && count) {
+        BufReader r(resp.data(), resp.size());
+        *count = r.u64();
+    }
+    return st;
+}
+
 uint32_t ist_client_stats(void* h, char* buf, int cap) {
     auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
     std::vector<uint8_t> resp;
     uint32_t st = c->rpc(OP_STATS, {}, &resp);
     if (st != OK) return st;
@@ -412,15 +461,18 @@ uint32_t ist_client_stats(void* h, char* buf, int cap) {
 }
 
 uint32_t ist_sync_rpc(void* h) {
+    if (h == nullptr) return INTERNAL_ERROR;
     return static_cast<Connection*>(h)->rpc(OP_SYNC, {}, nullptr);
 }
 
 // Pool mapping access for the zero-copy numpy/JAX path.
 uint64_t ist_pool_count(void* h) {
+    if (h == nullptr) return 0;
     return static_cast<Connection*>(h)->pool_count();
 }
 
 void* ist_pool_base(void* h, uint32_t idx, uint64_t* size_out) {
+    if (h == nullptr) return nullptr;
     size_t sz = 0;
     uint8_t* p = static_cast<Connection*>(h)->pool_base(idx, &sz);
     if (size_out) *size_out = sz;
@@ -428,6 +480,7 @@ void* ist_pool_base(void* h, uint32_t idx, uint64_t* size_out) {
 }
 
 int ist_refresh_pools(void* h) {
+    if (h == nullptr) return -1;
     return static_cast<Connection*>(h)->refresh_pools();
 }
 

@@ -69,6 +69,10 @@ class Connection {
     void close_conn();
     bool shm_active() const { return shm_active_; }
     uint32_t server_block_size() const { return server_block_size_; }
+    // True once the connection is unusable (socket failure or hard_fail
+    // teardown) — the signal that a reconnect is warranted, as opposed to
+    // an op-level error on a healthy connection.
+    bool is_broken() const { return broken_.load() || !running_.load(); }
 
     // --- generic async RPC (body only) ---
     void rpc_async(uint8_t op, std::vector<uint8_t> body, DoneFn done);

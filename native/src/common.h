@@ -58,6 +58,9 @@ enum Op : uint8_t {
     OP_DELETE = 13,          // drop specific keys
     OP_ABORT = 14,           // abort uncommitted tokens (partial-alloc undo)
     OP_PUT = 15,             // streamed allocate+write+commit in one RTT
+    OP_RECLAIM = 16,         // erase ORPHANED uncommitted entries (keys
+                             // whose writer died before commit); entries
+                             // with a live inflight token are untouched
 };
 
 // ---------------------------------------------------------------------------

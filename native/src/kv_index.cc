@@ -1,5 +1,7 @@
 #include "kv_index.h"
 
+#include <unordered_set>
+
 #include "log.h"
 
 namespace istpu {
@@ -204,6 +206,22 @@ size_t KVIndex::purge() {
     size_t n = map_.size();
     map_.clear();
     lru_.clear();
+    return n;
+}
+
+size_t KVIndex::reclaim_orphans(const std::vector<std::string>& keys) {
+    std::unordered_set<const Block*> live;
+    live.reserve(inflight_.size());
+    for (auto& [tok, inf] : inflight_) live.insert(inf.block.get());
+    size_t n = 0;
+    for (auto& k : keys) {
+        auto it = map_.find(k);
+        if (it == map_.end() || it->second.committed) continue;
+        if (it->second.block && live.count(it->second.block.get())) continue;
+        lru_drop(it->second);
+        map_.erase(it);
+        n++;
+    }
     return n;
 }
 

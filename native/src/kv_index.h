@@ -135,6 +135,11 @@ class KVIndex {
 
     size_t purge();  // drops all entries; inflight tokens survive harmlessly
     size_t erase(const std::vector<std::string>& keys);
+    // Erase only ORPHANED entries among `keys`: uncommitted AND not backed
+    // by any live inflight token (their writer's connection died between
+    // allocate and commit, before the server processed the close). A
+    // concurrent writer's in-progress allocation is never disturbed.
+    size_t reclaim_orphans(const std::vector<std::string>& keys);
     size_t size() const { return map_.size(); }
     size_t inflight() const { return inflight_.size(); }
     size_t leases() const { return leases_.size(); }

@@ -24,6 +24,7 @@ const char* op_name(uint8_t op) {
         case OP_DELETE: return "DELETE";
         case OP_ABORT: return "ABORT";
         case OP_PUT: return "PUT";
+        case OP_RECLAIM: return "RECLAIM";
         default: return "UNKNOWN";
     }
 }

@@ -570,7 +570,8 @@ void Server::handle_message(Conn& c) {
         case OP_SYNC:
         case OP_PURGE:
         case OP_STATS:
-        case OP_DELETE: op_simple(c); break;
+        case OP_DELETE:
+        case OP_RECLAIM: op_simple(c); break;
         default: {
             std::vector<uint8_t> body;
             BufWriter w(body);
@@ -955,14 +956,16 @@ void Server::op_simple(Conn& c) {
             w.str(s);
             break;
         }
-        case OP_DELETE: {
+        case OP_DELETE:
+        case OP_RECLAIM: {
             BufReader r(c.body.data(), c.body.size());
             std::vector<std::string> keys;
             r.keys(&keys);
             size_t n = 0;
             if (r.ok()) {
                 std::lock_guard<std::mutex> lk(store_mu_);
-                n = index_->erase(keys);
+                n = c.hdr.op == OP_DELETE ? index_->erase(keys)
+                                          : index_->reclaim_orphans(keys);
             }
             w.u32(r.ok() ? OK : BAD_REQUEST);
             w.u64(n);

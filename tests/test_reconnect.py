@@ -1,0 +1,216 @@
+"""Client reconnect tests (beyond reference parity: the reference has no
+client reconnect — SURVEY.md §5 lists recovery as 'minimal ... no client
+reconnect'). Covers manual reconnect() and auto_reconnect retry across a
+server restart, on both data paths."""
+
+import numpy as np
+import pytest
+
+from infinistore_tpu import (
+    ClientConfig,
+    InfiniStoreError,
+    InfiniStoreKeyNotFound,
+    InfiniStoreServer,
+    InfinityConnection,
+    ServerConfig,
+    TYPE_SHM,
+    TYPE_STREAM,
+)
+
+BLOCK = 16 << 10
+
+
+def start_server(port=0):
+    srv = InfiniStoreServer(
+        ServerConfig(
+            service_port=port,
+            prealloc_size=0.01,
+            minimal_allocate_size=16,
+        )
+    )
+    srv.start()
+    return srv
+
+
+def connect(port, ctype, auto=False):
+    c = InfinityConnection(
+        ClientConfig(
+            host_addr="127.0.0.1",
+            service_port=port,
+            connection_type=ctype,
+            auto_reconnect=auto,
+            timeout_ms=3000,
+        )
+    )
+    c.connect()
+    return c
+
+
+@pytest.mark.parametrize("ctype", [TYPE_SHM, TYPE_STREAM])
+def test_manual_reconnect_after_server_restart(ctype):
+    srv = start_server()
+    port = srv.service_port
+    conn = connect(port, ctype)
+    try:
+        src = np.arange(BLOCK, dtype=np.uint8) % 251
+        conn.put_cache(src, [("rk0", 0)], BLOCK)
+        conn.sync()
+
+        srv.stop()
+        # Ops on the dead server fail with a connection-level error.
+        with pytest.raises((InfiniStoreError, Exception)):
+            conn.put_cache(src, [("rk1", 0)], BLOCK)
+
+        srv = start_server(port)  # same port, fresh (empty) store
+        conn.reconnect()
+        assert conn.connected
+        # Old data is gone (volatile store, like the reference)...
+        assert not conn.check_exist("rk0")
+        # ...but the connection is fully usable on the same path.
+        conn.put_cache(src, [("rk2", 0)], BLOCK)
+        conn.sync()
+        dst = np.zeros_like(src)
+        conn.read_cache(dst, [("rk2", 0)], BLOCK)
+        conn.sync()
+        assert np.array_equal(src, dst)
+        if ctype == TYPE_SHM:
+            assert conn.shm_connected  # pool table re-negotiated
+    finally:
+        conn.close()
+        srv.stop()
+
+
+@pytest.mark.parametrize("ctype", [TYPE_SHM, TYPE_STREAM])
+def test_auto_reconnect_retries_key_ops(ctype):
+    srv = start_server()
+    port = srv.service_port
+    conn = connect(port, ctype, auto=True)
+    try:
+        src = np.arange(BLOCK, dtype=np.uint8) % 249
+        conn.put_cache(src, [("ak0", 0)], BLOCK)
+        conn.sync()
+
+        srv.stop()
+        srv = start_server(port)
+
+        # First attempt hits the dead socket; the wrapper reconnects and
+        # retries — surfacing KeyNotFound (a *store* answer) proves the
+        # retry ran against the new server.
+        with pytest.raises(InfiniStoreKeyNotFound):
+            dst = np.zeros_like(src)
+            conn.read_cache(dst, [("ak0", 0)], BLOCK)
+
+        # Writes retry transparently too.
+        conn.put_cache(src, [("ak1", 0)], BLOCK)
+        conn.sync()
+        dst = np.zeros_like(src)
+        conn.read_cache(dst, [("ak1", 0)], BLOCK)
+        conn.sync()
+        assert np.array_equal(src, dst)
+        assert conn.check_exist("ak1")
+    finally:
+        conn.close()
+        srv.stop()
+
+
+def test_concurrent_auto_reconnect_single_generation():
+    """Many threads hitting a dead connection must coordinate on ONE
+    reconnect (generation check) and all complete their retries without
+    crashing or double-freeing the old native handle."""
+    import threading
+
+    srv = start_server()
+    port = srv.service_port
+    conn = connect(port, TYPE_STREAM, auto=True)
+    try:
+        src = np.arange(BLOCK, dtype=np.uint8) % 247
+        conn.put_cache(src, [("ck_seed", 0)], BLOCK)
+        conn.sync()
+
+        srv.stop()
+        srv = start_server(port)
+
+        errs = []
+
+        def worker(i):
+            try:
+                conn.put_cache(src, [(f"ck{i}", 0)], BLOCK)
+                dst = np.zeros_like(src)
+                conn.read_cache(dst, [(f"ck{i}", 0)], BLOCK)
+                assert np.array_equal(dst, src)
+            except Exception as e:  # pragma: no cover - failure signal
+                errs.append((i, repr(e)))
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert not errs, errs
+        # Exactly one reconnect happened for the shared failure.
+        assert conn._conn_gen == 1
+        assert conn.check_exist("ck3")
+    finally:
+        conn.close()
+        srv.stop()
+
+
+def test_recovers_after_failed_reconnect_attempt():
+    """If the retry's reconnect fails because the server is still down,
+    the client must not wedge: once the server is back, the next op
+    re-dials from _check() and succeeds without a manual reconnect()."""
+    srv = start_server()
+    port = srv.service_port
+    conn = connect(port, TYPE_STREAM, auto=True)
+    try:
+        src = np.arange(BLOCK, dtype=np.uint8) % 241
+        conn.put_cache(src, [("fr0", 0)], BLOCK)
+        conn.sync()
+
+        srv.stop()
+        # Server down: the retry's reconnect fails, op raises.
+        with pytest.raises(Exception):
+            conn.put_cache(src, [("fr1", 0)], BLOCK)
+        assert not conn.connected
+
+        srv = start_server(port)
+        # No manual reconnect: the next op re-dials transparently.
+        conn.put_cache(src, [("fr2", 0)], BLOCK)
+        conn.sync()
+        dst = np.zeros_like(src)
+        conn.read_cache(dst, [("fr2", 0)], BLOCK)
+        conn.sync()
+        assert np.array_equal(src, dst)
+    finally:
+        conn.close()
+        srv.stop()
+
+
+def test_reclaim_orphans_respects_live_writers():
+    """OP_RECLAIM must erase a dead writer's uncommitted key but leave a
+    live writer's in-progress allocation untouched."""
+    srv = start_server()
+    port = srv.service_port
+    live = connect(port, TYPE_STREAM)
+    probe = connect(port, TYPE_STREAM, auto=True)
+    try:
+        # Live writer allocates (uncommitted, inflight token held).
+        live_blocks = live.allocate(["live_k"], BLOCK)
+        assert (live_blocks["token"] != 0).all()
+        # Reclaim through the retry helper's rpc: live_k must survive.
+        probe._reclaim_orphans(["live_k", "ghost_k"])
+        assert srv.kvmap_len() == 1  # live_k still allocated
+        # The live writer can still finish its write+commit.
+        src = np.arange(BLOCK, dtype=np.uint8) % 239
+        live.write_cache(src, [0], BLOCK, live_blocks)
+        live.sync()
+        assert probe.check_exist("live_k")
+        # A committed key is never reclaimed.
+        probe._reclaim_orphans(["live_k"])
+        assert probe.check_exist("live_k")
+    finally:
+        live.close()
+        probe.close()
+        srv.stop()
