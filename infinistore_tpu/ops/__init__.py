@@ -4,4 +4,8 @@ from .paged_attention import (  # noqa: F401
     prefill_attention,
     scatter_kv_to_pages,
 )
+from .pallas_flash_attention import (  # noqa: F401
+    flash_prefill,
+    flash_prefill_attention,
+)
 from .ring_attention import make_sp_mesh, ring_attention  # noqa: F401

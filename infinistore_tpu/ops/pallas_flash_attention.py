@@ -1,0 +1,218 @@
+"""Pallas TPU kernel: flash attention for prefill (dense, causal, GQA).
+
+The XLA path (paged_attention.prefill_attention) materializes the full
+[batch, heads, S, S] logits tensor in HBM — O(S^2) memory, which is what
+caps prefill sequence length, the expensive phase of prefill/decode
+disaggregation. This kernel never materializes logits: the grid runs
+(batch*heads, q_blocks, kv_blocks) with the kv sweep innermost, holding a
+[BQ, head_dim] online-softmax accumulator in VMEM scratch; each step is
+one [BQ, BK] logits tile on the MXU, masked, and folded in. HBM traffic
+is one pass over Q and (per q-block) K/V; memory is O(S).
+
+Causal handling: kv blocks strictly above the diagonal are skipped for
+compute (pl.when) AND for HBM traffic — the k/v index map clamps the
+block index at the last one the diagonal touches, and pallas elides the
+re-fetch when consecutive grid steps map to the same block (same trick as
+pallas_paged_attention's page freeze).
+
+`flash_prefill` picks this kernel on TPU backends and falls back to the
+XLA path elsewhere (tests run the kernel in interpret mode so CPU CI
+covers the same code path bit-for-bit).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import paged_attention as xla_ref
+
+_NEG_INF = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+            bq, bk, seq_len, scale, causal):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q_start = qi * bq
+    k_start = ki * bk
+    # A kv block strictly above the causal diagonal contributes nothing.
+    live = (k_start <= q_start + bq - 1) if causal else (ki >= 0)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0]  # [BQ, D]
+        k = k_ref[0]  # [BK, D]
+        v = v_ref[0]
+        precision = xla_ref.matmul_precision(q.dtype)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision,
+        ) * scale  # [BQ, BK] f32
+        pos_q = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 0
+        )
+        pos_k = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 1
+        )
+        mask = pos_k < seq_len  # padded key positions contribute nothing
+        if causal:
+            mask = jnp.logical_and(mask, pos_k <= pos_q)
+        logits = jnp.where(mask, logits, _NEG_INF)
+
+        m_prev = m_ref[...]  # [BQ, 1]
+        l_prev = l_ref[...]
+        m_cur = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(logits - m_new)  # [BQ, BK]
+        l_cur = jnp.sum(p, axis=-1, keepdims=True)
+        alpha = jnp.exp(m_prev - m_new)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision,
+        )  # [BQ, D]
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * alpha + l_cur
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def _pad_axis(x, axis, mult):
+    pad = (-x.shape[axis]) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_prefill_attention(q, k, v, causal=True, block_q=None, block_k=None,
+                            interpret=False):
+    """Flash prefill attention (same contract as
+    paged_attention.prefill_attention).
+
+    q: [batch, seq, n_heads, hd]; k/v: [batch, seq, n_kv, hd] (GQA —
+    n_heads must be a multiple of n_kv). Returns [batch, seq, n_heads, hd].
+
+    block_q/block_k default to min(512, seq rounded up to 128): measured
+    on v5e, 512x512 runs ~13x faster than 128x128 at S=4096 (per-step
+    grid overhead dominates small blocks) and 4x faster than the XLA
+    path; smaller sequences shrink the block to avoid padding waste.
+    """
+    batch, seq_len, n_heads, hd = q.shape
+    auto = min(512, ((seq_len + 127) // 128) * 128)
+    if block_q is None:
+        block_q = auto
+    if block_k is None:
+        block_k = auto
+    n_kv = k.shape[2]
+    group = n_heads // n_kv
+    scale = hd ** -0.5
+
+    # Lay out as [batch*heads, seq, hd] rows; pad seq to the block size
+    # and head_dim to the 128-lane boundary (pallas guide tiling table).
+    qf = _pad_axis(_pad_axis(
+        q.transpose(0, 2, 1, 3).reshape(batch * n_heads, seq_len, hd),
+        1, block_q), 2, 128)
+    kf = _pad_axis(_pad_axis(
+        k.transpose(0, 2, 1, 3).reshape(batch * n_kv, seq_len, hd),
+        1, block_k), 2, 128)
+    vf = _pad_axis(_pad_axis(
+        v.transpose(0, 2, 1, 3).reshape(batch * n_kv, seq_len, hd),
+        1, block_k), 2, 128)
+    hd_p = qf.shape[2]
+    nq = qf.shape[1] // block_q
+    nk = kf.shape[1] // block_k
+
+    def _kv_row(bh):
+        # Grid row (b, h) → GQA kv row (b, h // group).
+        return (bh // n_heads) * n_kv + (bh % n_heads) // group
+
+    def _kv_idx(bh, qi, ki):
+        if causal:
+            # Freeze the kv block index past the diagonal: the compute is
+            # skipped (pl.when in the kernel) and the repeated index lets
+            # pallas elide the HBM fetch entirely.
+            last_live = (qi * block_q + block_q - 1) // block_k
+            ki = jnp.minimum(ki, last_live)
+        return (_kv_row(bh), ki, 0)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, bq=block_q, bk=block_k, seq_len=seq_len, scale=scale,
+            causal=causal,
+        ),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=(batch * n_heads, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd_p), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, hd_p), _kv_idx),
+            pl.BlockSpec((1, block_k, hd_p), _kv_idx),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, hd_p), lambda bh, qi, ki: (bh, qi, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, hd_p), jnp.float32),  # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),     # m
+            pltpu.VMEM((block_q, 1), jnp.float32),     # l
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = out[:, :seq_len, :hd]
+    return out.reshape(batch, n_heads, seq_len, hd).transpose(0, 2, 1, 3)
+
+
+# The forward kernel has no transpose rule (VMEM scratch accumulators +
+# pl.when), so training would fail at the backward pass. custom_vjp:
+# forward runs the kernel, backward differentiates the XLA path at the
+# same inputs — exact gradients at the XLA path's O(S^2) training cost
+# (what the model paid before the kernel existed). A flash backward
+# kernel can replace it later without touching callers.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_with_vjp(q, k, v, causal, interpret):
+    return flash_prefill_attention(q, k, v, causal=causal,
+                                   interpret=interpret)
+
+
+def _flash_fwd(q, k, v, causal, interpret):
+    return _flash_with_vjp(q, k, v, causal, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: xla_ref.prefill_attention(q, k, v, causal=causal),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash_with_vjp.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_prefill(q, k, v, causal=True):
+    """Prefill attention with automatic backend choice: the pallas flash
+    kernel on TPU (differentiable — see _flash_with_vjp), the XLA path
+    elsewhere."""
+    if jax.default_backend() == "tpu":
+        return _flash_with_vjp(q, k, v, causal, False)
+    return xla_ref.prefill_attention(q, k, v, causal=causal)

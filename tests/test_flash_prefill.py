@@ -1,0 +1,123 @@
+"""Flash prefill attention kernel tests (CPU, interpret mode — the same
+kernel code path the TPU compiles; hardware validation numbers live in
+the commit history: f32 err 2.4e-6 vs f64 ground truth where the XLA
+DEFAULT-precision path shows 1.0e-2, and ~4x faster at S=4096 on v5e)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from infinistore_tpu.ops.pallas_flash_attention import (
+    flash_prefill,
+    flash_prefill_attention,
+)
+from infinistore_tpu.ops.paged_attention import prefill_attention
+
+
+def _ref64(q, k, v, causal):
+    q, k, v = (np.asarray(x, np.float64) for x in (q, k, v))
+    B, S, H, D = q.shape
+    KV = k.shape[2]
+    k = np.repeat(k, H // KV, axis=2)
+    v = np.repeat(v, H // KV, axis=2)
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) * D ** -0.5
+    if causal:
+        mask = np.tril(np.ones((S, S), bool))
+        logits = np.where(mask[None, None], logits, -1e30)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+CASES = [
+    # (batch, seq, heads, kv_heads, hd, dtype, causal)
+    (2, 256, 8, 8, 64, jnp.float32, True),     # MHA
+    (2, 256, 8, 2, 64, jnp.float32, True),     # GQA group=4
+    (1, 300, 4, 4, 80, jnp.float32, True),     # seq+hd padding
+    (2, 128, 8, 4, 128, jnp.bfloat16, True),   # bf16
+    (1, 256, 8, 4, 64, jnp.float32, False),    # non-causal
+    (1, 640, 8, 4, 64, jnp.float32, True),     # multi-block both axes
+]
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_matches_f64_reference(case):
+    B, S, H, KV, D, dtype, causal = case
+    rng = np.random.default_rng(42)
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), dtype)
+    k = jnp.asarray(rng.standard_normal((B, S, KV, D)), dtype)
+    v = jnp.asarray(rng.standard_normal((B, S, KV, D)), dtype)
+    out = flash_prefill_attention(
+        q, k, v, causal=causal, block_q=128, block_k=128, interpret=True
+    )
+    gt = _ref64(q, k, v, causal)
+    err = float(np.abs(np.asarray(out, np.float64) - gt).max())
+    tol = 2e-2 if dtype == jnp.bfloat16 else 1e-5
+    assert err < tol, (case, err)
+
+
+def test_matches_xla_path():
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((1, 384, 8, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 384, 4, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 384, 4, 64)), jnp.float32)
+    out = flash_prefill_attention(
+        q, k, v, causal=True, block_q=128, block_k=128, interpret=True
+    )
+    ref = prefill_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_uneven_block_sizes():
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((1, 512, 4, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 512, 4, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 512, 4, 64)), jnp.float32)
+    gt = _ref64(q, k, v, True)
+    for bq, bk in [(128, 256), (256, 128), (512, 128)]:
+        out = flash_prefill_attention(
+            q, k, v, causal=True, block_q=bq, block_k=bk, interpret=True
+        )
+        err = float(np.abs(np.asarray(out, np.float64) - gt).max())
+        assert err < 1e-5, (bq, bk, err)
+
+
+def test_chooser_falls_back_off_tpu():
+    # On the CPU test mesh the chooser must route to the XLA path.
+    assert jax.default_backend() != "tpu"
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.standard_normal((1, 64, 4, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 64, 4, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 64, 4, 32)), jnp.float32)
+    out = flash_prefill(q, k, v, causal=True)
+    ref = prefill_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_gradients_through_kernel_path():
+    """The kernel path must be differentiable: custom_vjp runs the pallas
+    forward (interpret mode here) and the XLA backward. Gradients must
+    match differentiating the XLA path end-to-end."""
+    from infinistore_tpu.ops.pallas_flash_attention import _flash_with_vjp
+
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.standard_normal((1, 128, 4, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 128, 4, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 128, 4, 32)), jnp.float32)
+
+    def loss_kernel(q, k, v):
+        return jnp.sum(_flash_with_vjp(q, k, v, True, True) ** 2)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(prefill_attention(q, k, v, causal=True) ** 2)
+
+    gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gk, gx):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+        )
