@@ -19,13 +19,15 @@ import sys
 import time
 
 
-def bench_store(port, size_mb=64, block_kb=4, nkeys=None):
+def bench_store(port, size_mb=64, block_kb=4, nkeys=None, ctype="AUTO"):
     import numpy as np
 
     from infinistore_tpu import ClientConfig, InfinityConnection
 
     conn = InfinityConnection(
-        ClientConfig(host_addr="127.0.0.1", service_port=port)
+        ClientConfig(
+            host_addr="127.0.0.1", service_port=port, connection_type=ctype
+        )
     )
     conn.connect()
     try:
@@ -155,6 +157,16 @@ def main():
     try:
         store_res = bench_store(port, block_kb=4, nkeys=4096)
         srv.purge()
+        # DCN stand-in numbers: the same workload forced over the framed
+        # TCP path (what cross-host clients use). Secondary leg — a
+        # failure here must not discard the primary metric.
+        try:
+            stream_res = bench_store(
+                port, block_kb=4, nkeys=4096, ctype="STREAM"
+            )
+        except Exception as e:
+            stream_res = {"error": str(e)[:200]}
+        srv.purge()
         tpu_res = bench_tpu(port)
     finally:
         srv.stop()
@@ -166,6 +178,7 @@ def main():
         "unit": "GB/s",
         "vs_baseline": value,  # nominal 1 GB/s target; see module docstring
         **store_res,
+        **{f"stream_{k}": v for k, v in stream_res.items() if k != "path"},
         **tpu_res,
     }
     print(json.dumps(out))

@@ -117,14 +117,38 @@ def _as_src_array(cache):
 
 
 def _as_dst_array(cache):
-    if not isinstance(cache, np.ndarray):
+    if isinstance(cache, np.ndarray):
+        arr = cache
+    elif type(cache).__module__.split(".")[0] == "torch":
+        # CPU torch tensors share memory through __array__, so writes
+        # into the view land in the tensor — same zero-copy in/out
+        # contract as the reference's torch-first API (lib.py:522-565).
+        # Non-CPU tensors must be rejected HERE: converting via .cpu()
+        # would make the read land in a throwaway host copy while the
+        # caller's device tensor stays silently stale.
+        if getattr(cache, "device", None) is not None and \
+                cache.device.type != "cpu":
+            raise TypeError(
+                "read destination must live in host memory; got a torch "
+                f"tensor on {cache.device} (reads write in place — a "
+                ".cpu() copy would not update your tensor)"
+            )
+        try:
+            arr = np.asarray(cache.detach() if cache.requires_grad else cache)
+        except Exception as e:
+            raise TypeError(
+                f"torch tensor not viewable as numpy ({e}); read "
+                "destinations must be plain CPU tensors"
+            ) from None
+    else:
         raise TypeError(
-            "read destination must be a writable numpy array "
-            "(use infinistore_tpu.tpu to read into jax Arrays)"
+            "read destination must be a writable numpy array or CPU "
+            "torch tensor (use infinistore_tpu.tpu to read into jax "
+            "Arrays)"
         )
-    if not cache.flags["C_CONTIGUOUS"] or not cache.flags["WRITEABLE"]:
+    if not arr.flags["C_CONTIGUOUS"] or not arr.flags["WRITEABLE"]:
         raise ValueError("read destination must be contiguous and writable")
-    return cache
+    return arr
 
 
 class InfinityConnection:

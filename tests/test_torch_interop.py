@@ -1,0 +1,63 @@
+"""Torch-tensor interop: the reference's client API is torch-first
+(reference lib.py passes tensor.data_ptr() and scales offsets by element
+size). Here CPU torch tensors work zero-copy in both directions through
+numpy's shared-memory __array__ view — same offsets-in-elements
+contract, both data paths, f16/f32 like the reference's dtype matrix
+(test_infinistore.py:61-108)."""
+
+import uuid
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+def key():
+    return str(uuid.uuid4())
+
+
+@pytest.mark.parametrize("dtype", [torch.float16, torch.float32])
+def test_torch_roundtrip(conn, dtype):
+    page = 1024  # elements
+    src = torch.randn(4 * page, dtype=dtype)
+    keys = [key() for _ in range(4)]
+    blocks = [(k, i * page) for i, k in enumerate(keys)]
+    conn.put_cache(src, blocks, page)
+    conn.sync()
+
+    dst = torch.zeros_like(src)
+    conn.read_cache(dst, blocks, page)
+    conn.sync()
+    assert torch.equal(src, dst)
+
+
+def test_torch_allocate_write_path(conn):
+    page = 512
+    src = torch.arange(2 * page, dtype=torch.float32)
+    keys = [key(), key()]
+    esize = src.element_size()
+    blocks = conn.allocate(keys, page * esize)
+    conn.write_cache(src, [0, page], page, blocks)
+    conn.sync()
+    dst = torch.zeros_like(src)
+    conn.read_cache(dst, [(keys[0], 0), (keys[1], page)], page)
+    conn.sync()
+    assert torch.equal(src, dst)
+
+
+def test_noncontiguous_torch_rejected(conn):
+    t = torch.randn(64, 64).t()  # transposed: non-contiguous
+    with pytest.raises((ValueError, TypeError)):
+        conn.put_cache(t, [(key(), 0)], 64)
+
+
+def test_requires_grad_tensor_reads_in_place(conn):
+    src = torch.randn(1024, dtype=torch.float32)
+    k = key()
+    conn.put_cache(src, [(k, 0)], 1024)
+    conn.sync()
+    dst = torch.zeros(1024, dtype=torch.float32, requires_grad=True)
+    conn.read_cache(dst, [(k, 0)], 1024)
+    conn.sync()
+    assert torch.equal(src, dst.detach())
