@@ -1,0 +1,71 @@
+"""Int8 KV-page quantization (beyond reference parity).
+
+KV pages dominate both store capacity and transfer bytes. Symmetric int8
+with per-token-per-kv-head scales halves both versus bf16 (scales add
+~3% at hd=128) at ~0.4% relative error — the quantize/dequantize runs on
+the accelerator under jit, so the host/DCN ever sees only the packed
+int8 bytes.
+
+Wire format of one packed page (what goes into one store block):
+    [page * n_kv * hd]  int8 values
+    [page * n_kv]       f32 scales
+both C-order, concatenated. `packed_page_bytes` gives the block size.
+
+Quantization choice: symmetric absmax over the head dim (the finest
+granularity whose scales stay negligible). Zero pages quantize to zero
+(scale floor avoids 0/0).
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def packed_page_bytes(page_shape):
+    """Store block size of one packed page. page_shape = (page, n_kv, hd)."""
+    page, n_kv, hd = page_shape
+    return page * n_kv * hd + page * n_kv * 4
+
+
+@jax.jit
+def quantize_kv_pages(pages):
+    """pages: [n, page, n_kv, hd] float → (int8 [same shape],
+    f32 scales [n, page, n_kv])."""
+    absmax = jnp.max(jnp.abs(pages.astype(jnp.float32)), axis=-1)
+    scales = jnp.maximum(absmax / 127.0, 1e-8)
+    q = jnp.clip(
+        jnp.round(pages.astype(jnp.float32) / scales[..., None]),
+        -127, 127,
+    ).astype(jnp.int8)
+    return q, scales
+
+
+@functools.partial(jax.jit, static_argnames=("dtype",))
+def dequantize_kv_pages(q, scales, dtype):
+    """Inverse of quantize_kv_pages."""
+    return (q.astype(jnp.float32) * scales[..., None]).astype(dtype)
+
+
+def pack_pages_host(q, scales):
+    """Host-side pack: int8 values + f32 scale bytes per page →
+    uint8 [n, packed_page_bytes]."""
+    q = np.asarray(q)
+    scales = np.asarray(scales, dtype=np.float32)
+    n = q.shape[0]
+    vals = q.reshape(n, -1).view(np.uint8)
+    sc = scales.reshape(n, -1).view(np.uint8)
+    return np.concatenate([vals, sc], axis=1)
+
+
+def unpack_pages_host(packed, page_shape):
+    """Inverse of pack_pages_host: uint8 [n, packed_page_bytes] →
+    (int8 [n, *page_shape], f32 scales [n, page, n_kv])."""
+    page, n_kv, hd = page_shape
+    n = packed.shape[0]
+    nv = page * n_kv * hd
+    q = packed[:, :nv].view(np.int8).reshape(n, page, n_kv, hd)
+    scales = packed[:, nv:].copy().view(np.float32).reshape(n, page, n_kv)
+    return q, scales

@@ -110,6 +110,18 @@ class ShardedConnection:
         self.write_cache(cache, offsets, page_size, rb, keys)
         return rb
 
+    def put_cache(self, cache, blocks, page_size):
+        """InfinityConnection-compatible name: sharded put + barrier."""
+        self.put(cache, blocks, page_size)
+        self.sync()
+        return 0
+
+    def reconnect(self):
+        """Reconnect every shard (see InfinityConnection.reconnect)."""
+        for c in self.conns:
+            c.reconnect()
+        return 0
+
     def read_cache(self, cache, blocks, page_size):
         """Read (key, offset) pairs from their owning shards."""
         parts = {}

@@ -1,0 +1,111 @@
+"""Int8 KV quantization tests: pack/unpack round trip, reconstruction
+error bounds, end-to-end store round trip through TpuKVStore, and decode
+attention on dequantized pages staying close to the bf16 path."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from infinistore_tpu.ops import kv_quant
+from infinistore_tpu.ops.paged_attention import paged_decode_attention
+from infinistore_tpu.tpu import TpuKVStore
+
+
+def test_quantize_roundtrip_error():
+    rng = np.random.default_rng(0)
+    pages = jnp.asarray(
+        rng.standard_normal((8, 16, 4, 64)), jnp.float32
+    )
+    q, scales = kv_quant.quantize_kv_pages(pages)
+    assert q.dtype == jnp.int8 and scales.shape == (8, 16, 4)
+    back = kv_quant.dequantize_kv_pages(q, scales, jnp.float32)
+    # Symmetric int8 with per-(token, head) scales: worst case half a
+    # quantization step of the row absmax.
+    absmax = np.abs(np.asarray(pages)).max(axis=-1, keepdims=True)
+    err = np.abs(np.asarray(back) - np.asarray(pages))
+    assert (err <= absmax / 127.0 * 0.5 + 1e-6).all()
+    rel = np.linalg.norm(err) / np.linalg.norm(np.asarray(pages))
+    assert rel < 0.01
+
+
+def test_zero_page_safe():
+    pages = jnp.zeros((2, 4, 2, 32), jnp.float32)
+    q, scales = kv_quant.quantize_kv_pages(pages)
+    back = kv_quant.dequantize_kv_pages(q, scales, jnp.float32)
+    assert not np.isnan(np.asarray(back)).any()
+    assert (np.asarray(back) == 0).all()
+
+
+def test_pack_unpack_host():
+    rng = np.random.default_rng(1)
+    shape = (16, 4, 64)
+    q = rng.integers(-127, 128, (5, *shape), dtype=np.int8)
+    scales = rng.random((5, 16, 4)).astype(np.float32)
+    packed = kv_quant.pack_pages_host(q, scales)
+    assert packed.shape == (5, kv_quant.packed_page_bytes(shape))
+    q2, s2 = kv_quant.unpack_pages_host(packed, shape)
+    assert np.array_equal(q, q2)
+    assert np.array_equal(scales, s2)
+
+
+@pytest.mark.parametrize("ctype", ["SHM", "STREAM"])
+def test_store_roundtrip_quantized(server, ctype):
+    from infinistore_tpu import ClientConfig, InfinityConnection
+
+    conn = InfinityConnection(
+        ClientConfig(
+            host_addr="127.0.0.1",
+            service_port=server.service_port,
+            connection_type=ctype,
+        )
+    )
+    conn.connect()
+    try:
+        store = TpuKVStore(conn)
+        rng = np.random.default_rng(2)
+        page_shape = (16, 4, 64)
+        pages = jnp.asarray(
+            rng.standard_normal((6, *page_shape)), jnp.bfloat16
+        )
+        keys = [f"q_{ctype}_{i}" for i in range(6)]
+        store.put_kv_pages_quantized(keys, pages, sync=True)
+        back = store.get_kv_pages_quantized(keys, page_shape, jnp.bfloat16)
+        a = np.asarray(pages, np.float32)
+        b = np.asarray(back, np.float32)
+        rel = np.linalg.norm(a - b) / np.linalg.norm(a)
+        assert rel < 0.012, rel
+        # Half the bytes of the bf16 page (+ scale sidecar).
+        raw = int(np.prod(page_shape)) * 2
+        assert kv_quant.packed_page_bytes(page_shape) < raw * 0.55
+    finally:
+        conn.close()
+
+
+def test_decode_attention_on_quantized_pages():
+    """Decode attention over dequantized int8 pages must stay close to
+    attention over the original pages."""
+    rng = np.random.default_rng(3)
+    n_pages, page, n_kv, hd = 8, 16, 2, 64
+    batch, n_heads = 2, 4
+    k_pages = jnp.asarray(
+        rng.standard_normal((n_pages, page, n_kv, hd)), jnp.float32
+    )
+    v_pages = jnp.asarray(
+        rng.standard_normal((n_pages, page, n_kv, hd)), jnp.float32
+    )
+    q = jnp.asarray(rng.standard_normal((batch, n_heads, hd)), jnp.float32)
+    page_table = jnp.asarray(
+        rng.permutation(n_pages)[: 4 * batch].reshape(batch, 4), jnp.int32
+    )
+    seq_lens = jnp.asarray([50, 63], jnp.int32)
+
+    ref = paged_decode_attention(q, k_pages, v_pages, page_table, seq_lens)
+    kq, ks = kv_quant.quantize_kv_pages(k_pages)
+    vq, vs = kv_quant.quantize_kv_pages(v_pages)
+    k_deq = kv_quant.dequantize_kv_pages(kq, ks, jnp.float32)
+    v_deq = kv_quant.dequantize_kv_pages(vq, vs, jnp.float32)
+    out = paged_decode_attention(q, k_deq, v_deq, page_table, seq_lens)
+    err = float(jnp.max(jnp.abs(out - ref)))
+    assert err < 0.05, err

@@ -110,3 +110,21 @@ def test_sharded_dedup_and_delete(sconn, rng):
     assert sconn.delete_keys([k]) == 1
     assert not sconn.check_exist(k)
     del second
+
+
+def test_sharded_put_cache_and_reconnect(sconn):
+    """InfinityConnection-name parity (put_cache) and whole-fleet
+    reconnect (servers keep running, so data survives)."""
+    src = np.arange(4 * 1024, dtype=np.uint8)
+    blocks = [(f"pc{i}", i * 1024) for i in range(4)]
+    sconn.put_cache(src, blocks, 1024)
+    dst = np.zeros_like(src)
+    sconn.read_cache(dst, blocks, 1024)
+    sconn.sync()
+    assert np.array_equal(src, dst)
+
+    sconn.reconnect()
+    dst2 = np.zeros_like(src)
+    sconn.read_cache(dst2, blocks, 1024)
+    sconn.sync()
+    assert np.array_equal(src, dst2)
