@@ -49,6 +49,21 @@ def _to_host(arr):
     return np.ascontiguousarray(np.asarray(arr))
 
 
+def _device_put_owned(view, device):
+    """device_put that never aliases `view`'s memory. On accelerator
+    targets the transfer is a real DMA copy, so the pool view is handed
+    over zero-copy; on CPU targets PJRT may alias an aligned contiguous
+    host buffer (kImmutableZeroCopy), which would leave the returned
+    array pointing into the server pool after its lease is released —
+    force a private copy there."""
+    platform = device.platform if device is not None else jax.default_backend()
+    if platform == "cpu":
+        view = np.array(view, copy=True)
+    out = jax.device_put(view, device)
+    out.block_until_ready()
+    return out
+
+
 class TpuKVStore:
     """High-level KV-page interface over an :class:`InfinityConnection`.
 
@@ -96,8 +111,7 @@ class TpuKVStore:
                 pool = self.conn.pool_view(int(blocks["pool_idx"][0]))
                 off = int(blocks["offset"][0])
                 view = pool[off : off + nbytes].view(dtype).reshape(shape)
-                out = jax.device_put(view, device)
-                out.block_until_ready()
+                out = _device_put_owned(view, device)
             finally:
                 self.conn.release(lease)
             return out
@@ -144,18 +158,10 @@ class TpuKVStore:
         if self.conn.shm_connected:
             lease, blocks = self.conn.pin(keys)
             try:
-                # Per-page views over the pool; stack is the only host
-                # copy and happens inside XLA's transfer when possible.
-                views = []
-                for i in range(n):
-                    pool = self.conn.pool_view(int(blocks["pool_idx"][i]))
-                    off = int(blocks["offset"][i])
-                    views.append(
-                        pool[off : off + page_bytes].view(dtype).reshape(page_shape)
-                    )
-                stacked = np.stack(views)
-                out = jax.device_put(stacked, device)
-                out.block_until_ready()
+                stacked = self._pool_batch_view(
+                    blocks, n, page_bytes, dtype, page_shape
+                )
+                out = _device_put_owned(stacked, device)
             finally:
                 self.conn.release(lease)
             return out
@@ -212,16 +218,12 @@ class TpuKVStore:
             # viewed directly in the pinned server pool under a lease.
             lease, blocks = self.conn.pin(keys)
             try:
-                views = []
-                for i in range(n):
-                    pool = self.conn.pool_view(int(blocks["pool_idx"][i]))
-                    off = int(blocks["offset"][i])
-                    views.append(pool[off : off + block])
-                packed = np.stack(views)
+                packed = self._pool_batch_view(
+                    blocks, n, block, np.uint8, (block,)
+                )
                 q, scales = kv_quant.unpack_pages_host(packed, page_shape)
-                q = jax.device_put(q, device)
-                scales = jax.device_put(scales, device)
-                jax.block_until_ready(q)
+                q = _device_put_owned(q, device)
+                scales = jax.device_put(scales, device)  # .copy()'d in unpack
             finally:
                 self.conn.release(lease)
         else:
@@ -236,6 +238,31 @@ class TpuKVStore:
             q = jax.device_put(q, device)
             scales = jax.device_put(scales, device)
         return kv_quant.dequantize_kv_pages(q, scales, jnp.dtype(dtype))
+
+    def _pool_batch_view(self, blocks, n, page_bytes, dtype, page_shape):
+        """[n, *page_shape] view/copy over the pinned pool. First-fit
+        allocation makes batch allocations mostly contiguous, so the
+        common case is ONE zero-copy view of the pool — XLA's host→device
+        DMA then reads straight out of the server pool with no host copy
+        at all. Non-contiguous batches fall back to per-page views +
+        one stack copy."""
+        pool_idx = blocks["pool_idx"]
+        offs = blocks["offset"]
+        if n > 0 and (pool_idx == pool_idx[0]).all():
+            base = int(offs[0])
+            expect = base + np.arange(n, dtype=np.uint64) * page_bytes
+            if (offs == expect).all():
+                pool = self.conn.pool_view(int(pool_idx[0]))
+                flat = pool[base : base + n * page_bytes]
+                return flat.view(dtype).reshape(n, *page_shape)
+        views = []
+        for i in range(n):
+            pool = self.conn.pool_view(int(pool_idx[i]))
+            off = int(offs[i])
+            views.append(
+                pool[off : off + page_bytes].view(dtype).reshape(page_shape)
+            )
+        return np.stack(views)
 
     def cached_prefix_len(self, keys):
         """How many leading pages of ``keys`` are already cached
