@@ -41,6 +41,7 @@ KEY_NOT_FOUND = 404
 TIMEOUT_ERR = 408
 CONFLICT = 409
 UNCOMMITTED = 425
+BUSY = 429
 INTERNAL_ERROR = 500
 OUT_OF_MEMORY = 507
 
@@ -77,7 +78,7 @@ def _decls(lib):
             c.c_void_p,
             [c.c_char_p, c.c_uint16, c.c_uint64, c.c_uint64, c.c_int,
              c.c_uint64, c.c_int, c.c_char_p, c.c_int, c.c_char_p,
-             c.c_uint64],
+             c.c_uint64, c.c_uint64],
         ),
         ("ist_server_start", c.c_int, [c.c_void_p]),
         ("ist_server_stop", None, [c.c_void_p]),
@@ -234,6 +235,7 @@ def status_name(code):
         TIMEOUT_ERR: "TIMEOUT",
         CONFLICT: "CONFLICT",
         UNCOMMITTED: "UNCOMMITTED",
+        BUSY: "BUSY",
         INTERNAL_ERROR: "INTERNAL_ERROR",
         OUT_OF_MEMORY: "OUT_OF_MEMORY",
     }.get(code, f"STATUS_{code}")

@@ -106,6 +106,12 @@ class ServerConfig:
         # relieve; the native layer also warns when the target is tmpfs).
         self.ssd_path = kwargs.get("ssd_path", "")
         self.ssd_size = kwargs.get("ssd_size", 0)  # GB
+        # Server-side read backpressure: per-connection cap (MB) on bytes
+        # queued for send (and hence pool blocks pinned) to a slow reader.
+        # Reads past the cap fail with BUSY (retryable). The analogue of
+        # the reference's bounded push window (signal/32, window 4096 WRs,
+        # src/libinfinistore.cpp:898-987), denominated in bytes.
+        self.max_outq_size = kwargs.get("max_outq_size", 64)  # MB
         # Accepted for reference CLI compatibility; unused on TPU hosts.
         self.dev_name = kwargs.get("dev_name", "")
         self.link_type = kwargs.get("link_type", "")
@@ -139,3 +145,5 @@ class ServerConfig:
             raise Exception("ssd_size must be >= 0")
         if self.ssd_size > 0 and not self.ssd_path:
             raise Exception("ssd_path required when ssd_size > 0")
+        if self.max_outq_size <= 0:
+            raise Exception("max_outq_size must be positive (MB)")

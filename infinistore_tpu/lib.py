@@ -20,6 +20,7 @@ import asyncio
 import ctypes as ct
 import logging
 import threading
+import time
 
 import numpy as np
 
@@ -344,6 +345,23 @@ class InfinityConnection:
                 if keys:
                     self._reclaim_orphans(keys)
             return fn()
+
+    def _retry_busy(self, attempt):
+        """Run ``attempt(remaining_ms)`` retrying BUSY (server-side
+        backpressure: this connection has too many response bytes queued
+        or lease bytes pinned) with exponential backoff until
+        ``config.timeout_ms`` elapses. The remaining budget is handed to
+        each attempt so native waits never extend the caller's total
+        bound past the configured timeout. Returns the final status."""
+        deadline = time.monotonic() + self.config.timeout_ms / 1000.0
+        delay = 0.001
+        while True:
+            remaining_ms = int(max(1, (deadline - time.monotonic()) * 1000))
+            st = attempt(remaining_ms)
+            if st != _native.BUSY or time.monotonic() >= deadline:
+                return st
+            time.sleep(delay)
+            delay = min(delay * 2, 0.05)
 
     def _reclaim_orphans(self, keys):
         # One batched rpc; the server erases only entries that are
@@ -681,11 +699,18 @@ class InfinityConnection:
         # down before returning, so no late payload can land in our
         # buffers. (The SHM path needs no teardown: copies run on this
         # thread, and an abandoned PIN's lease is released natively.)
-        st = self._lib.ist_read(
-            self._h, page_bytes, blob, len(blob), len(dst_np),
-            dst_np.ctypes.data_as(ct.POINTER(ct.c_void_p)),
-            self.config.timeout_ms,
+        # BUSY (429) is the server's read backpressure — this connection
+        # has too many bytes queued/pinned — so retry with backoff until
+        # the configured timeout instead of surfacing a hard error.
+        st = self._retry_busy(
+            lambda remaining_ms: self._lib.ist_read(
+                self._h, page_bytes, blob, len(blob), len(dst_np),
+                dst_np.ctypes.data_as(ct.POINTER(ct.c_void_p)),
+                remaining_ms,
+            )
         )
+        if st == _native.BUSY:
+            raise InfiniStoreError(st, "read rejected by backpressure")
         if st == TIMEOUT_ERR:
             raise InfiniStoreError(TIMEOUT_ERR, "read timed out")
         if st == KEY_NOT_FOUND:
@@ -697,13 +722,28 @@ class InfinityConnection:
     async def read_cache_async(self, cache, blocks, page_size):
         self._check()
         loop = asyncio.get_running_loop()
-        future = loop.create_future()
+        # Deep pipelining is exactly how a healthy client can trip the
+        # server's per-connection outq cap, so BUSY here is expected
+        # steady-state behavior under load: back off and resubmit until
+        # the timeout rather than failing the read.
+        deadline = time.monotonic() + self.config.timeout_ms / 1000.0
+        delay = 0.001
+        while True:
+            future = loop.create_future()
 
-        def cb(status):
-            loop.call_soon_threadsafe(_finish_future, future, status, "read")
+            def cb(status):
+                loop.call_soon_threadsafe(
+                    _finish_future, future, status, "read"
+                )
 
-        self._read_async_native(cache, blocks, page_size, cb)
-        return await future
+            self._read_async_native(cache, blocks, page_size, cb)
+            try:
+                return await future
+            except InfiniStoreError as e:
+                if e.status != _native.BUSY or time.monotonic() >= deadline:
+                    raise
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, 0.05)
 
     # ------------------------------------------------------------------
     # control ops
@@ -815,14 +855,18 @@ class InfinityConnection:
         return np.frombuffer(buf, dtype=np.uint8)
 
     def pin(self, keys):
-        """Pin committed blocks; returns (lease_id, RemoteBlock array)."""
+        """Pin committed blocks; returns (lease_id, RemoteBlock array).
+        BUSY (this connection holds too many pinned bytes) is retried
+        with backoff until the configured timeout."""
         self._check()
         blob = pack_keys(keys)
         out = np.zeros(len(keys), dtype=REMOTE_BLOCK_DTYPE)
         lease = ct.c_uint64(0)
-        st = self._lib.ist_pin(
-            self._h, blob, len(blob), len(keys),
-            out.ctypes.data_as(ct.c_void_p), ct.byref(lease),
+        st = self._retry_busy(
+            lambda _remaining_ms: self._lib.ist_pin(
+                self._h, blob, len(blob), len(keys),
+                out.ctypes.data_as(ct.c_void_p), ct.byref(lease),
+            )
         )
         if st == KEY_NOT_FOUND:
             raise InfiniStoreKeyNotFound(st, "pin: key not found")

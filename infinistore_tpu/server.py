@@ -54,6 +54,7 @@ class InfiniStoreServer:
             1 if cfg.enable_eviction else 0,
             cfg.ssd_path.encode(),
             int(cfg.ssd_size * (1 << 30)),
+            int(cfg.max_outq_size * (1 << 20)),
         )
         port = self._lib.ist_server_start(self._h)
         if port < 0:
@@ -269,6 +270,10 @@ def parse_args(argv=None):
                    help="disk spill tier capacity in GB (0 = disabled); "
                         "cold entries spill to disk under pool pressure "
                         "and promote back on read")
+    p.add_argument("--max-outq-size", type=float, default=64,
+                   help="per-connection cap in MB on bytes queued to a "
+                        "slow reader; reads past the cap fail with BUSY "
+                        "(retryable)")
     p.add_argument("--warmup", action="store_true",
                    help="run a warmup round-trip after startup")
     p.add_argument("--no-oom-protect", action="store_true")
@@ -290,6 +295,7 @@ def main(argv=None):
         enable_eviction=args.enable_eviction,
         ssd_path=args.ssd_path,
         ssd_size=args.ssd_size,
+        max_outq_size=args.max_outq_size,
     )
     server = InfiniStoreServer(config)
     server.start()

@@ -63,7 +63,8 @@ void* ist_server_create(const char* host, uint16_t port,
                         uint64_t prealloc_bytes, uint64_t block_size,
                         int auto_extend, uint64_t extend_bytes, int enable_shm,
                         const char* shm_prefix, int enable_eviction,
-                        const char* ssd_path, uint64_t ssd_bytes) {
+                        const char* ssd_path, uint64_t ssd_bytes,
+                        uint64_t max_outq_bytes) {
     ServerConfig cfg;
     cfg.host = host ? host : "0.0.0.0";
     cfg.port = port;
@@ -76,6 +77,7 @@ void* ist_server_create(const char* host, uint16_t port,
     cfg.enable_eviction = enable_eviction != 0;
     if (ssd_path && ssd_path[0]) cfg.ssd_path = ssd_path;
     cfg.ssd_bytes = ssd_bytes;
+    if (max_outq_bytes) cfg.max_outq_bytes = max_outq_bytes;
     return new Server(cfg);
 }
 

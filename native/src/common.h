@@ -32,6 +32,8 @@ enum Status : uint32_t {
     KEY_NOT_FOUND = 404,
     TIMEOUT_ERR = 408,
     CONFLICT = 409,
+    BUSY = 429,              // server-side backpressure: retry later (the
+                             // reader's response queue is at its byte cap)
     UNCOMMITTED = 425,       // key exists but two-phase commit not finished
     INTERNAL_ERROR = 500,
     OUT_OF_MEMORY = 507,

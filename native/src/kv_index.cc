@@ -7,7 +7,7 @@
 namespace istpu {
 
 Status KVIndex::allocate(const std::string& key, uint32_t size,
-                         RemoteBlock* out) {
+                         RemoteBlock* out, uint64_t owner) {
     // Single hash probe: try_emplace both answers the dedup check and
     // reserves the slot (allocate is the server's hottest op — 4096
     // keys per benchmark batch).
@@ -44,7 +44,7 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
     e.block = block;
     e.size = size;
     mit->second = std::move(e);
-    inflight_[token] = Inflight{key, block, size};
+    inflight_[token] = Inflight{key, block, size, owner};
     out->status = OK;
     out->pool_idx = loc.pool_idx;
     out->token = token;
@@ -53,16 +53,20 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
     return OK;
 }
 
-uint8_t* KVIndex::write_dest(uint64_t token, uint32_t* size_out) {
+uint8_t* KVIndex::write_dest(uint64_t token, uint32_t* size_out,
+                             uint64_t owner) {
     auto it = inflight_.find(token);
-    if (it == inflight_.end()) return nullptr;
+    if (it == inflight_.end() || it->second.owner != owner) return nullptr;
     *size_out = it->second.size;
     return static_cast<uint8_t*>(it->second.block->loc.ptr);
 }
 
-Status KVIndex::commit(uint64_t token) {
+Status KVIndex::commit(uint64_t token, uint64_t owner) {
     auto it = inflight_.find(token);
     if (it == inflight_.end()) return CONFLICT;
+    // A forged commit must fail closed AND leave the real owner's inflight
+    // entry intact so the owner's own commit still lands.
+    if (it->second.owner != owner) return CONFLICT;
     auto mit = map_.find(it->second.key);
     Status rc = CONFLICT;
     // Only commit if the map still holds the exact block this token
@@ -77,9 +81,9 @@ Status KVIndex::commit(uint64_t token) {
     return rc;
 }
 
-void KVIndex::abort(uint64_t token) {
+void KVIndex::abort(uint64_t token, uint64_t owner) {
     auto it = inflight_.find(token);
-    if (it == inflight_.end()) return;
+    if (it == inflight_.end() || it->second.owner != owner) return;
     auto mit = map_.find(it->second.key);
     if (mit != map_.end() && mit->second.block == it->second.block &&
         !mit->second.committed) {

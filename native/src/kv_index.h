@@ -96,22 +96,31 @@ class KVIndex {
     explicit KVIndex(MM* mm, bool eviction = false, DiskTier* disk = nullptr)
         : mm_(mm), eviction_(eviction), disk_(disk) {}
 
-    // Reserve an uncommitted block for `key`. Returns:
+    // Reserve an uncommitted block for `key`, owned by connection `owner`.
+    // Tokens are usable only by their owning connection (the reference
+    // keys inflight state per client, infinistore.cpp:63,361-371 — without
+    // this, client A could commit or overwrite client B's in-flight
+    // allocation). Returns:
     //   OK        — new block; out filled, token registered
     //   CONFLICT  — key already present (committed or inflight): dedup, the
     //               caller should emit FAKE_TOKEN
     //   OUT_OF_MEMORY — pool exhausted
-    Status allocate(const std::string& key, uint32_t size, RemoteBlock* out);
+    Status allocate(const std::string& key, uint32_t size, RemoteBlock* out,
+                    uint64_t owner);
 
     // Destination for an inflight token's payload (OP_WRITE scatter).
-    // Returns nullptr if the token is unknown.
-    uint8_t* write_dest(uint64_t token, uint32_t* size_out);
+    // Returns nullptr if the token is unknown or owned by another
+    // connection (the forged payload lands in the sink).
+    uint8_t* write_dest(uint64_t token, uint32_t* size_out, uint64_t owner);
 
     // Second phase: make the entry visible. OK, or CONFLICT if the entry
-    // was purged/replaced since allocation (write is discarded safely).
-    Status commit(uint64_t token);
-    // Abort an inflight allocation (client died mid-write).
-    void abort(uint64_t token);
+    // was purged/replaced since allocation (write is discarded safely) or
+    // the token belongs to another connection (the real owner's inflight
+    // state is left untouched).
+    Status commit(uint64_t token, uint64_t owner);
+    // Abort an inflight allocation (client died mid-write). No-op on
+    // another connection's token.
+    void abort(uint64_t token, uint64_t owner);
 
     // Committed lookup for reads (refreshes LRU recency). nullptr if
     // missing or uncommitted. May return a disk-resident entry
@@ -157,6 +166,7 @@ class KVIndex {
         std::string key;
         BlockRef block;
         uint32_t size;
+        uint64_t owner;  // connection id that allocated this token
     };
 
     void lru_touch(Entry& e, const std::string& key);

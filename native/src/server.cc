@@ -158,7 +158,7 @@ size_t Server::purge() {
 
 std::string Server::stats_json() {
     std::lock_guard<std::mutex> lk(store_mu_);
-    char head[768];
+    char head[1024];
     snprintf(
         head, sizeof(head),
         "{\"kvmap_len\": %zu, \"inflight\": %zu, \"leases\": %zu, "
@@ -166,6 +166,8 @@ std::string Server::stats_json() {
         "\"ops\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu, "
         "\"connections\": %zu, \"evictions\": %llu, \"spills\": %llu, "
         "\"promotes\": %llu, \"disk_bytes\": %llu, \"disk_used\": %llu, "
+        "\"outq_bytes\": %llu, \"outq_cap\": %llu, \"reads_busy\": %llu, "
+        "\"lease_bytes\": %llu, \"pins_busy\": %llu, "
         "\"op_stats\": {",
         index_ ? index_->size() : 0, index_ ? index_->inflight() : 0,
         index_ ? index_->leases() : 0, mm_ ? mm_->num_pools() : 0,
@@ -177,7 +179,12 @@ std::string Server::stats_json() {
         (unsigned long long)(index_ ? index_->spills() : 0),
         (unsigned long long)(index_ ? index_->promotes() : 0),
         (unsigned long long)(disk_ ? disk_->capacity_bytes() : 0),
-        (unsigned long long)(disk_ ? disk_->used_bytes() : 0));
+        (unsigned long long)(disk_ ? disk_->used_bytes() : 0),
+        (unsigned long long)outq_total_.load(std::memory_order_relaxed),
+        (unsigned long long)cfg_.max_outq_bytes,
+        (unsigned long long)reads_busy_.load(std::memory_order_relaxed),
+        (unsigned long long)lease_total_.load(std::memory_order_relaxed),
+        (unsigned long long)pins_busy_.load(std::memory_order_relaxed));
     std::string out = head;
     // Per-op handler-time table with histogram percentiles (the reference
     // logs per-op latency ad hoc, infinistore.cpp:1114,1162-1166; here it
@@ -250,6 +257,7 @@ void Server::accept_ready() {
         tune_socket(fd);
         auto c = std::make_unique<Conn>();
         c->fd = fd;
+        c->id = next_conn_id_++;
         epoll_event ev{};
         ev.events = EPOLLIN;
         ev.data.fd = fd;
@@ -267,9 +275,15 @@ void Server::close_conn(int fd) {
     // leases it still holds.
     {
         std::lock_guard<std::mutex> lk(store_mu_);
-        for (uint64_t tok : it->second->open_tokens) index_->abort(tok);
-        for (uint64_t lease : it->second->open_leases) index_->release(lease);
+        for (uint64_t tok : it->second->open_tokens) {
+            index_->abort(tok, it->second->id);
+        }
+        for (auto& [lease, bytes] : it->second->open_leases) {
+            index_->release(lease);
+        }
     }
+    outq_total_.fetch_sub(it->second->outq_bytes, std::memory_order_relaxed);
+    lease_total_.fetch_sub(it->second->lease_bytes, std::memory_order_relaxed);
     epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, fd, nullptr);
     close(fd);
     conns_.erase(it);
@@ -456,6 +470,8 @@ bool Server::flush_out(Conn& c) {
             }
         }
         if (m.meta_done && m.seg_idx == m.segs.size()) {
+            c.outq_bytes -= m.total;
+            outq_total_.fetch_sub(m.total, std::memory_order_relaxed);
             c.outq.pop_front();  // drops BlockRefs → unpins
         } else if (w == 0) {
             return true;
@@ -492,6 +508,9 @@ void Server::respond(Conn& c, uint64_t seq, uint8_t op,
     }
     m.segs = std::move(segs);
     m.refs = std::move(refs);
+    m.total = m.meta.size() + size_t(payload);
+    c.outq_bytes += m.total;
+    outq_total_.fetch_add(m.total, std::memory_order_relaxed);
     c.outq.push_back(std::move(m));
     if (!flush_out(c)) {
         c.dead = true;
@@ -528,11 +547,13 @@ void Server::handle_message(Conn& c) {
                 uint64_t tok = r.u64();
                 c.wtokens.push_back(tok);
                 uint32_t sz = 0;
-                uint8_t* dst = index_->write_dest(tok, &sz);
+                uint8_t* dst = index_->write_dest(tok, &sz, c.id);
                 if (dst != nullptr && sz >= block_size) {
                     c.wdest.emplace_back(dst, block_size);
                 } else {
-                    // Unknown/purged token: payload lands in the sink.
+                    // Unknown/purged/foreign token: payload lands in the
+                    // sink (another connection's inflight block is never a
+                    // write destination).
                     c.wdest.emplace_back(c.sink.data(), block_size);
                 }
             }
@@ -642,12 +663,12 @@ void Server::begin_put(Conn& c) {
         std::lock_guard<std::mutex> lk(store_mu_);
         for (auto& k : keys) {
             RemoteBlock b;
-            Status st = index_->allocate(k, block_size, &b);
+            Status st = index_->allocate(k, block_size, &b, c.id);
             if (st == OK) {
                 c.wtokens.push_back(b.token);
                 c.open_tokens.insert(b.token);
                 uint32_t sz = 0;
-                uint8_t* dst = index_->write_dest(b.token, &sz);
+                uint8_t* dst = index_->write_dest(b.token, &sz, c.id);
                 c.wdest.emplace_back(dst, block_size);
             } else {
                 // Dedup (CONFLICT): sink this key's slice, first writer
@@ -678,7 +699,7 @@ void Server::finish_write(Conn& c) {
             // would be invisible data loss behind an error the caller
             // might retry wholesale.
             for (uint64_t tok : c.wtokens) {
-                index_->abort(tok);
+                index_->abort(tok, c.id);
                 c.open_tokens.erase(tok);
             }
         } else {
@@ -686,7 +707,7 @@ void Server::finish_write(Conn& c) {
             // entries become readable only now, after the bytes are in
             // the pool).
             for (uint64_t tok : c.wtokens) {
-                if (index_->commit(tok) == OK) committed++;
+                if (index_->commit(tok, c.id) == OK) committed++;
                 c.open_tokens.erase(tok);
             }
         }
@@ -734,7 +755,8 @@ void Server::op_allocate(Conn& c) {
     {
         std::lock_guard<std::mutex> lk(store_mu_);
         for (size_t i = 0; i < keys.size(); ++i) {
-            Status st = index_->allocate(keys[i], block_size, &blocks[i]);
+            Status st = index_->allocate(keys[i], block_size, &blocks[i],
+                                         c.id);
             if (st == OK) c.open_tokens.insert(blocks[i].token);
         }
         mm_->maybe_extend();
@@ -761,16 +783,33 @@ void Server::op_read(Conn& c) {
     std::vector<BlockRef> refs;
     {
         std::lock_guard<std::mutex> lk(store_mu_);
+        // Cheap metadata pass first: definitive answers (missing key,
+        // size mismatch) must not be masked by retryable BUSY, and a
+        // read that will be refused must not pay disk promotion (or
+        // churn the cache making pool room for it).
         for (auto& k : keys) {
-            // Cheap metadata check first: a read that will be refused for
-            // its size must not pay disk promotion (or churn the cache
-            // making pool room for it).
             const Entry* meta = index_->get_committed(k);
             if (meta == nullptr || meta->size < block_size) {
                 w.u32(KEY_NOT_FOUND);
                 respond(c, c.hdr.seq, OP_READ, std::move(body));
                 return;
             }
+        }
+        // Backpressure: refuse the whole read (retryably, before any
+        // pinning or disk promotion) if it would push this connection's
+        // queued bytes past the cap. A single over-cap read against an
+        // empty queue is still admitted so progress is always possible;
+        // the queue then being non-empty blocks further reads, so
+        // per-connection pinned memory is bounded by cap + one op.
+        uint64_t planned = uint64_t(keys.size()) * block_size;
+        if (c.outq_bytes > 0 &&
+            c.outq_bytes + planned > cfg_.max_outq_bytes) {
+            reads_busy_.fetch_add(1, std::memory_order_relaxed);
+            w.u32(BUSY);
+            respond(c, c.hdr.seq, OP_READ, std::move(body));
+            return;
+        }
+        for (auto& k : keys) {
             // get_resident promotes spilled entries back into the pool.
             // A failed promotion surfaces as its own (retryable) status,
             // not KEY_NOT_FOUND — the data is still there.
@@ -807,7 +846,7 @@ void Server::op_commit(Conn& c) {
         std::lock_guard<std::mutex> lk(store_mu_);
         for (uint32_t i = 0; i < n && r.ok(); ++i) {
             uint64_t tok = r.u64();
-            if (index_->commit(tok) == OK) committed++;
+            if (index_->commit(tok, c.id) == OK) committed++;
             c.open_tokens.erase(tok);
         }
     }
@@ -830,7 +869,7 @@ void Server::op_abort(Conn& c) {
         std::lock_guard<std::mutex> lk(store_mu_);
         for (uint32_t i = 0; i < n && r.ok(); ++i) {
             uint64_t tok = r.u64();
-            index_->abort(tok);
+            index_->abort(tok, c.id);
             c.open_tokens.erase(tok);
         }
     }
@@ -853,6 +892,27 @@ void Server::op_pin(Conn& c) {
     std::vector<RemoteBlock> blocks;
     {
         std::lock_guard<std::mutex> lk(store_mu_);
+        // Backpressure, mirroring op_read: bound the bytes a connection
+        // can hold pinned via leases. Metadata pre-pass so an over-cap
+        // pin is refused before paying disk promotion; a single over-cap
+        // pin against zero held leases is admitted (progress guarantee).
+        uint64_t planned = 0;
+        for (auto& k : keys) {
+            const Entry* meta = index_->get_committed(k);
+            if (meta == nullptr) {
+                w.u32(KEY_NOT_FOUND);
+                respond(c, c.hdr.seq, OP_PIN, std::move(body));
+                return;
+            }
+            planned += meta->size;
+        }
+        if (c.lease_bytes > 0 &&
+            c.lease_bytes + planned > cfg_.max_outq_bytes) {
+            pins_busy_.fetch_add(1, std::memory_order_relaxed);
+            w.u32(BUSY);
+            respond(c, c.hdr.seq, OP_PIN, std::move(body));
+            return;
+        }
         for (auto& k : keys) {
             // get_resident promotes spilled entries back into the pool;
             // failed promotion is a retryable status, not KEY_NOT_FOUND.
@@ -873,7 +933,9 @@ void Server::op_pin(Conn& c) {
             refs.push_back(e->block);
         }
         uint64_t lease = index_->pin(std::move(refs));
-        c.open_leases.insert(lease);
+        c.open_leases[lease] = planned;
+        c.lease_bytes += planned;
+        lease_total_.fetch_add(planned, std::memory_order_relaxed);
         w.u32(OK);
         w.u64(lease);
         w.u32(uint32_t(blocks.size()));
@@ -887,12 +949,20 @@ void Server::op_release(Conn& c) {
     uint64_t lease = r.u64();
     std::vector<uint8_t> body;
     BufWriter w(body);
-    bool ok;
-    {
-        std::lock_guard<std::mutex> lk(store_mu_);
-        ok = index_->release(lease);
+    // Leases are releasable only by the connection that took them
+    // (ids are sequential and therefore guessable; a foreign release
+    // would unpin blocks out from under the owner's one-sided copy).
+    auto lit = c.open_leases.find(lease);
+    bool ok = false;
+    if (lit != c.open_leases.end()) {
+        {
+            std::lock_guard<std::mutex> lk(store_mu_);
+            ok = index_->release(lease);
+        }
+        c.lease_bytes -= lit->second;
+        lease_total_.fetch_sub(lit->second, std::memory_order_relaxed);
+        c.open_leases.erase(lit);
     }
-    c.open_leases.erase(lease);
     w.u32(ok ? OK : KEY_NOT_FOUND);
     respond(c, c.hdr.seq, OP_RELEASE, std::move(body));
 }

@@ -66,6 +66,13 @@ struct ServerConfig {
     // committed entry is ever dropped (spill-only mode).
     std::string ssd_path;
     uint64_t ssd_bytes = 0;
+    // Server-side read backpressure: cap on bytes queued (and hence pool
+    // blocks pinned) per connection's send queue. A slow or malicious
+    // reader issuing many large OP_READs beyond this gets BUSY (retryable)
+    // instead of pinning unbounded pool memory. The reference bounds its
+    // push path with signal/32, window 4096 WRs
+    // (libinfinistore.cpp:898-987); this is the byte-denominated analogue.
+    uint64_t max_outq_bytes = 64ull << 20;
 };
 
 class Server {
@@ -97,10 +104,13 @@ class Server {
         size_t seg_idx = 0;
         size_t off = 0;  // offset within meta or segs[seg_idx]
         bool meta_done = false;
+        size_t total = 0;  // meta + payload bytes, for outq accounting
     };
 
     struct Conn {
         int fd = -1;
+        uint64_t id = 0;  // unique per accepted connection; owns its tokens
+        uint64_t outq_bytes = 0;  // bytes queued in outq (backpressure cap)
         RState state = RState::HDR;
         WireHeader hdr{};
         size_t hdr_got = 0;
@@ -125,9 +135,17 @@ class Server {
         // connection dies (improvement over the reference, which leaks
         // uncommitted kv_map entries on client crash).
         std::unordered_set<uint64_t> open_tokens;
-        // Pin leases taken on this connection; released if it dies, so a
-        // crashed reader cannot pin pool blocks forever.
-        std::unordered_set<uint64_t> open_leases;
+        // Pin leases taken on this connection (lease id → pinned bytes);
+        // released if it dies, so a crashed reader cannot pin pool blocks
+        // forever. OP_RELEASE only accepts leases in this map — lease ids
+        // are sequential, so without the owner check any client could
+        // guess and release another reader's lease mid-copy (the same
+        // forgery class as foreign write tokens).
+        std::unordered_map<uint64_t, uint64_t> open_leases;
+        // Bytes currently pinned by this connection's leases; OP_PIN past
+        // cfg_.max_outq_bytes gets BUSY like over-cap OP_READs, so an SHM
+        // client that never releases cannot pin the whole pool either.
+        uint64_t lease_bytes = 0;
     };
 
     void loop();
@@ -189,6 +207,13 @@ class Server {
     void account_op(uint8_t op, long long us);
     uint64_t op_percentile_us(int op, double q) const;
     std::atomic<uint64_t> ops_{0}, bytes_in_{0}, bytes_out_{0};
+    uint64_t next_conn_id_ = 1;  // loop thread only
+    // Aggregate outq bytes across connections + reads refused for
+    // backpressure; atomics so stats_json (control-plane thread) can read.
+    std::atomic<uint64_t> outq_total_{0};
+    std::atomic<uint64_t> reads_busy_{0};
+    std::atomic<uint64_t> lease_total_{0};
+    std::atomic<uint64_t> pins_busy_{0};
     std::atomic<uint64_t> op_count_[kMaxOp] = {};
     std::atomic<uint64_t> op_us_[kMaxOp] = {};
     std::atomic<uint64_t> op_hist_[kMaxOp][kNumBuckets] = {};
