@@ -200,32 +200,37 @@ class InfinityConnection:
             "localhost",
         ):
             raise Exception("SHM connection must be to localhost")
-        self._h = self._lib.ist_conn_create(
+        # Build the new connection entirely on a local before publishing:
+        # self._h is read by concurrent threads (reconnect discipline keeps
+        # it pointing at a live or closed-but-unfreed handle), so a
+        # half-connected handle that this method is about to destroy on
+        # failure must never be visible through it.
+        h = self._lib.ist_conn_create(
             self.config.host_addr.encode(),
             self.config.service_port,
             1 if want_shm else 0,
             self.config.window_bytes,
             self.config.timeout_ms,
         )
-        if not self._h:
+        if not h:
             raise Exception("Failed to create connection")
-        if self._lib.ist_conn_connect(self._h) != 0:
-            self._lib.ist_conn_destroy(self._h)
-            self._h = None
+        if self._lib.ist_conn_connect(h) != 0:
+            self._lib.ist_conn_destroy(h)  # never published: safe to free
             raise Exception(
                 f"Failed to connect to "
                 f"{self.config.host_addr}:{self.config.service_port}"
             )
-        self.shm_connected = bool(self._lib.ist_conn_shm_active(self._h))
-        if self.config.connection_type == TYPE_SHM and not self.shm_connected:
+        shm_active = bool(self._lib.ist_conn_shm_active(h))
+        if self.config.connection_type == TYPE_SHM and not shm_active:
             # Tear down only the handle we just created — NOT close(),
             # which would also free handles parked by reconnects while
             # other threads may still be inside native calls on them.
-            self._lib.ist_conn_close(self._h)
-            self._lib.ist_conn_destroy(self._h)
-            self._h = None
+            self._lib.ist_conn_close(h)
+            self._lib.ist_conn_destroy(h)
             raise Exception("SHM path requested but unavailable")
-        self.stream_connected = not self.shm_connected
+        self._h = h
+        self.shm_connected = shm_active
+        self.stream_connected = not shm_active
         self.connected = True
         self._ever_connected = True
         return 0
@@ -287,7 +292,11 @@ class InfinityConnection:
         # another thread may still be inside a native call on it, and a
         # closed-but-live handle fails such calls safely while a freed one
         # is a use-after-free.
-        if self._h:
+        # After a FAILED reconnect self._h still points at the handle a
+        # previous attempt parked (connect() only republishes on success),
+        # so guard against parking the same handle twice — close() would
+        # otherwise double-destroy it.
+        if self._h and self._h not in self._dead_handles:
             self._lib.ist_conn_close(self._h)
             self._dead_handles.append(self._h)
             # Leave self._h pointing at the closed handle until connect()

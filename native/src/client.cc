@@ -724,9 +724,20 @@ void Connection::hard_fail() {
     if (fd_ >= 0) shutdown(fd_, SHUT_RDWR);
     wake();
     std::unique_lock<std::mutex> lk(sync_mu_);
-    sync_cv_.wait_for(lk, std::chrono::seconds(2), [&] {
+    bool unwound = sync_cv_.wait_for(lk, std::chrono::seconds(2), [&] {
         return io_exited_.load() || !running_.load();
     });
+    lk.unlock();
+    if (!unwound) {
+        // The IO thread did not unwind (e.g. a completion callback stalled
+        // on the GIL). Our caller will free its buffers on return, so a
+        // later resumed scatter readv must not be able to touch them:
+        // clear the scatter plan under the same mutex the scatter loop
+        // holds across its readv — after this, payload can only land in
+        // the drain buffer.
+        std::lock_guard<std::mutex> slk(scatter_mu_);
+        rscatter_.clear();
+    }
 }
 
 uint32_t Connection::sync(int timeout_ms) {
@@ -929,14 +940,18 @@ bool Connection::handle_readable() {
         // never be scattered into buffers a timed-out caller has freed.
         if (!in_payload_ && broken_.load()) return false;
         if (in_payload_) {
-            // Same hazard mid-scatter: once broken, dump the rest of this
-            // payload into the drain buffer — every pending completes with
-            // an error via fail_all, so the data is unwanted either way.
-            if (broken_.load()) rscatter_.clear();
             // Scatter the response payload into user buffers with one readv
             // per up-to-64 destination runs (adjacent destinations merge),
-            // mirroring the server's write-side scatter.
+            // mirroring the server's write-side scatter. Each iteration
+            // holds scatter_mu_ so hard_fail can atomically retarget a
+            // wedged scatter at the drain buffer (see below).
             while (rpayload_left_ > 0) {
+                std::lock_guard<std::mutex> slk(scatter_mu_);
+                // Same hazard mid-scatter as the pre-message broken_
+                // check: once broken, dump the rest of this payload into
+                // the drain buffer — every pending completes with an
+                // error via fail_all, so the data is unwanted either way.
+                if (broken_.load()) rscatter_.clear();
                 iovec iov[64];
                 int niov = 0;
                 uint64_t planned = 0;

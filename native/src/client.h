@@ -195,6 +195,11 @@ class Connection {
     std::vector<std::pair<uint8_t*, size_t>> rscatter_;
     uint64_t rseq_ = 0;
     std::vector<uint8_t> rdrain_;
+    // Serializes the scatter readv with hard_fail's last-resort clearing
+    // of the scatter plan (when the IO thread fails to unwind in time a
+    // resumed readv must only be able to land in rdrain_, never in
+    // buffers the timed-out caller has since freed).
+    std::mutex scatter_mu_;
     bool in_payload_ = false;
 
     // sync support

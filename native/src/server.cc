@@ -20,6 +20,14 @@ namespace istpu {
 
 namespace {
 
+// Cap on disk-tier promotions a single OP_READ/OP_PIN may trigger: tier
+// IO runs synchronously on the event loop under store_mu_, so a batched
+// request over thousands of spilled keys would head-of-line block every
+// other connection for hundreds of ms. Past the cap the op fails with
+// BUSY; promoted entries stay resident, so the client's retry makes
+// monotonic progress in bounded slices.
+constexpr uint64_t kMaxPromotesPerOp = 64;
+
 void set_nonblock(int fd) {
     int fl = fcntl(fd, F_GETFL, 0);
     fcntl(fd, F_SETFL, fl | O_NONBLOCK);
@@ -628,7 +636,11 @@ uint64_t Server::op_percentile_us(int op, double q) const {
     uint64_t seen = 0;
     for (int b = 0; b < kNumBuckets; ++b) {
         seen += op_hist_[op][b].load(std::memory_order_relaxed);
-        if (seen >= rank) return 1ull << (b + 1);  // bucket upper bound
+        // Bucket b covers [2^b, 2^(b+1)) µs; report the midpoint rather
+        // than the upper bound (which biased every percentile up to 2x
+        // high and made the floor 2 µs — /metrics exposes these as
+        // exact-looking quantiles).
+        if (seen >= rank) return (1ull << b) + (1ull << b) / 2;
     }
     return 1ull << kNumBuckets;
 }
@@ -809,7 +821,18 @@ void Server::op_read(Conn& c) {
             respond(c, c.hdr.seq, OP_READ, std::move(body));
             return;
         }
+        uint64_t p0 = index_->promotes();
         for (auto& k : keys) {
+            // Bounded promotion slice per request (see kMaxPromotesPerOp).
+            if (index_->promotes() - p0 >= kMaxPromotesPerOp) {
+                const Entry* meta = index_->get_committed(k);
+                if (meta != nullptr && meta->block == nullptr) {
+                    reads_busy_.fetch_add(1, std::memory_order_relaxed);
+                    w.u32(BUSY);
+                    respond(c, c.hdr.seq, OP_READ, std::move(body));
+                    return;
+                }
+            }
             // get_resident promotes spilled entries back into the pool.
             // A failed promotion surfaces as its own (retryable) status,
             // not KEY_NOT_FOUND — the data is still there.
@@ -913,7 +936,18 @@ void Server::op_pin(Conn& c) {
             respond(c, c.hdr.seq, OP_PIN, std::move(body));
             return;
         }
+        uint64_t p0 = index_->promotes();
         for (auto& k : keys) {
+            // Bounded promotion slice per request (see kMaxPromotesPerOp).
+            if (index_->promotes() - p0 >= kMaxPromotesPerOp) {
+                const Entry* meta = index_->get_committed(k);
+                if (meta != nullptr && meta->block == nullptr) {
+                    pins_busy_.fetch_add(1, std::memory_order_relaxed);
+                    w.u32(BUSY);
+                    respond(c, c.hdr.seq, OP_PIN, std::move(body));
+                    return;
+                }
+            }
             // get_resident promotes spilled entries back into the pool;
             // failed promotion is a retryable status, not KEY_NOT_FOUND.
             const Entry* e = nullptr;
