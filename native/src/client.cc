@@ -307,7 +307,7 @@ void Connection::write_async(uint32_t block_size, std::vector<uint64_t> tokens,
 }
 
 void Connection::put_async(uint32_t block_size,
-                           std::vector<std::string> keys,
+                           std::vector<uint8_t> keys_body,
                            std::vector<const void*> srcs, DoneFn done) {
     // One-RTT streamed put: allocate+write+commit server-side (OP_PUT).
     // Dedup'd keys' payload is sunk by the server (first-writer-wins).
@@ -318,7 +318,7 @@ void Connection::put_async(uint32_t block_size,
         return;
     }
     uint64_t payload = uint64_t(block_size) * srcs.size();
-    auto ks = std::make_shared<std::vector<std::string>>(std::move(keys));
+    auto ks = std::make_shared<std::vector<uint8_t>>(std::move(keys_body));
     auto sp = std::make_shared<std::vector<const void*>>(std::move(srcs));
     Submit s;
     s.window_cost = payload;
@@ -327,7 +327,7 @@ void Connection::put_async(uint32_t block_size,
         std::vector<uint8_t> body;
         BufWriter w(body);
         w.u32(block_size);
-        w.keys(*ks);
+        w.bytes(ks->data(), ks->size());
         std::vector<std::pair<const uint8_t*, size_t>> segs;
         segs.reserve(sp->size());
         for (const void* p : *sp) {
@@ -352,7 +352,7 @@ void Connection::put_async(uint32_t block_size,
 }
 
 void Connection::read_async(uint32_t block_size,
-                            std::vector<std::string> keys,
+                            std::vector<uint8_t> keys_body,
                             std::vector<void*> dsts, DoneFn done) {
     inflight_++;
     if (broken_.load() || !running_.load()) {
@@ -360,14 +360,14 @@ void Connection::read_async(uint32_t block_size,
         finish_op();
         return;
     }
-    auto ks = std::make_shared<std::vector<std::string>>(std::move(keys));
+    auto ks = std::make_shared<std::vector<uint8_t>>(std::move(keys_body));
     auto dp = std::make_shared<std::vector<void*>>(std::move(dsts));
     Submit s;
     s.fn = [this, block_size, ks, dp, done = std::move(done)]() mutable {
         std::vector<uint8_t> body;
         BufWriter w(body);
         w.u32(block_size);
-        w.keys(*ks);
+        w.bytes(ks->data(), ks->size());
         Pending pend;
         pend.op = OP_READ;
         pend.scatter.reserve(dp->size());
@@ -483,12 +483,10 @@ void Connection::shm_write_async(uint32_t block_size,
 }
 
 uint32_t Connection::shm_read_blocking(uint32_t block_size,
-                                       std::vector<std::string> keys,
+                                       std::vector<uint8_t> keys_body,
                                        std::vector<void*> dsts) {
     if (broken_.load() || !running_.load()) return INTERNAL_ERROR;
-    std::vector<uint8_t> body;
-    BufWriter w(body);
-    w.keys(keys);
+    std::vector<uint8_t> body(std::move(keys_body));
     // PIN with an abandonment-aware wait: if the caller times out before
     // the response lands, the late callback (on the IO thread) must still
     // release the lease — otherwise the pinned blocks stay unevictable
@@ -609,7 +607,7 @@ uint32_t Connection::shm_read_blocking(uint32_t block_size,
 }
 
 void Connection::shm_read_async(uint32_t block_size,
-                                std::vector<std::string> keys,
+                                std::vector<uint8_t> keys_body,
                                 std::vector<void*> dsts, DoneFn done) {
     inflight_++;
     if (broken_.load() || !running_.load()) {
@@ -617,13 +615,11 @@ void Connection::shm_read_async(uint32_t block_size,
         finish_op();
         return;
     }
-    auto ks = std::make_shared<std::vector<std::string>>(std::move(keys));
+    auto ks = std::make_shared<std::vector<uint8_t>>(std::move(keys_body));
     auto dp = std::make_shared<std::vector<void*>>(std::move(dsts));
     Submit s;
     s.fn = [this, block_size, ks, dp, done = std::move(done)]() mutable {
-        std::vector<uint8_t> body;
-        BufWriter w(body);
-        w.keys(*ks);
+        std::vector<uint8_t> body(*ks);
         Pending pend;
         pend.op = OP_PIN;
         pend.done = [this, block_size, dp, done = std::move(done)](

@@ -138,6 +138,14 @@ class KVIndex {
     // (vLLM prefix pages); does NOT check committed.
     int match_last_index(const std::vector<std::string>& keys) const;
 
+    // Pre-size the index + inflight tables for `extra` upcoming
+    // allocations (batched allocate/put ops insert thousands of keys in
+    // one loop; without this the tables rehash mid-loop under store_mu_).
+    void reserve(size_t extra) {
+        map_.reserve(map_.size() + extra);
+        inflight_.reserve(inflight_.size() + extra);
+    }
+
     // Pin committed blocks for one-sided SHM reads; returns lease id.
     uint64_t pin(std::vector<BlockRef> blocks);
     bool release(uint64_t lease_id);

@@ -673,6 +673,8 @@ void Server::begin_put(Conn& c) {
     c.wput_oom = false;
     {
         std::lock_guard<std::mutex> lk(store_mu_);
+        index_->reserve(keys.size());
+        c.open_tokens.reserve(c.open_tokens.size() + keys.size());
         for (auto& k : keys) {
             RemoteBlock b;
             Status st = index_->allocate(k, block_size, &b, c.id);
@@ -766,6 +768,8 @@ void Server::op_allocate(Conn& c) {
     std::vector<RemoteBlock> blocks(keys.size());
     {
         std::lock_guard<std::mutex> lk(store_mu_);
+        index_->reserve(keys.size());
+        c.open_tokens.reserve(c.open_tokens.size() + keys.size());
         for (size_t i = 0; i < keys.size(); ++i) {
             Status st = index_->allocate(keys[i], block_size, &blocks[i],
                                          c.id);
