@@ -794,6 +794,15 @@ class InfinityConnection:
         """Longest cached prefix of the key list — THE prefix-cache-hit
         primitive for vLLM (reference infinistore.cpp:1092-1108). Raises
         if no key matches (reference lib.py:627-643)."""
+        idx = self._match_last_index_raw(keys)
+        if idx < 0:
+            raise Exception("can't find a match")
+        return idx
+
+    def _match_last_index_raw(self, keys):
+        """get_match_last_index returning -1 instead of raising when no
+        key matches (the sharded client merges per-shard results and a
+        miss on one shard is normal)."""
         self._check()
 
         def once():
@@ -804,8 +813,6 @@ class InfinityConnection:
             )
             if st != OK:
                 raise InfiniStoreError(st, "get_match_last_index failed")
-            if idx.value < 0:
-                raise Exception("can't find a match")
             return idx.value
 
         return self._run_reconnecting(once)
