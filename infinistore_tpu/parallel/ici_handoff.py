@@ -1,0 +1,266 @@
+"""ICI intra-pod KV handoff: device-to-device page transfer over the mesh.
+
+This is the third transport of SURVEY.md §2's TPU-native mapping (next to
+the SHM/host-DMA path and the DCN/STREAM path): when prefill and decode
+engines live in the SAME pod, KV pages should move chip-to-chip over ICI
+with a collective, never bouncing through host DRAM or DCN. The
+reference-side analogue being replaced is the GPUDirect path
+(/root/reference/infinistore/lib.py:244-251,
+/root/reference/src/libinfinistore.cpp:1166-1201) — RDMA directly between
+device memories.
+
+Design (store-keyed, SPMD):
+
+- ``IciKVPool`` owns ONE jax.Array of KV pages sharded over a mesh axis:
+  global shape [n_devices * slots_per_device, *page_shape], sharding
+  ``P(axis)`` — each device holds ``slots_per_device`` local page slots
+  (plus one hidden scratch slot that absorbs transfer padding).
+- A host-side directory maps content keys → (device, slot), mirroring the
+  store's kv index; ``match_last_index`` gives the same longest-prefix
+  probe the store serves (infinistore.cpp:1092-1108) so an engine can ask
+  "how much of this sequence is already resident in-pod".
+- ``handoff(moves)`` relocates keyed pages between devices with
+  ``shard_map`` + ``lax.ppermute``: every source concatenates its
+  outgoing slots into a fixed-width buffer, one collective permute moves
+  all (src → dst) routes of a round at once, receivers scatter into their
+  free slots (padding lands in the scratch slot). ppermute requires each
+  device to appear at most once as source and once as destination per
+  collective, so moves are greedily scheduled into matching rounds — the
+  steady disaggregation pairing (prefill chip i → decode chip j) is one
+  round.
+- Transfers are jitted per (n_xfer, perm) shape and cached — a steady
+  prefill→decode pairing compiles once and reuses the executable.
+
+The pool composes with the host store (``tpu.TpuKVStore``) as a faster
+tier: pages not resident in-pod are fetched from the store; pages evicted
+from the pool can be offloaded to it. The handoff itself never touches
+the host.
+"""
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map
+
+
+def make_pool_mesh(n_devices, axis="pool", devices=None):
+    """1-D mesh over the pod's chips; prefill and decode occupy disjoint
+    ranges of the same axis so the handoff rides ICI."""
+    if devices is None:
+        devices = jax.devices()[:n_devices]
+    return Mesh(np.asarray(devices), axis_names=(axis,))
+
+
+class IciKVPool:
+    """Store-keyed KV page pool resident across a mesh axis.
+
+    Parameters:
+        mesh: 1-D (or sliced) Mesh; the pool shards over ``axis``.
+        page_shape / dtype: one KV page's shape and dtype (uniform, like
+            the store's fixed block size).
+        slots_per_device: page capacity per chip.
+    """
+
+    def __init__(self, mesh, page_shape, dtype, slots_per_device,
+                 axis="pool"):
+        self.mesh = mesh
+        self.axis = axis
+        self.n_dev = mesh.shape[axis]
+        self.page_shape = tuple(page_shape)
+        self.dtype = jnp.dtype(dtype)
+        self.slots = int(slots_per_device)
+        # +1 hidden scratch slot per device: transfer padding and
+        # non-participating receivers scatter there instead of clobbering
+        # live pages.
+        self._local = self.slots + 1
+        self._sharding = NamedSharding(mesh, P(axis))
+        self.buffer = jax.device_put(
+            jnp.zeros((self.n_dev * self._local, *self.page_shape),
+                      dtype=self.dtype),
+            self._sharding,
+        )
+        self.directory = {}  # key -> (device, slot)
+        self._free = [list(range(self.slots)) for _ in range(self.n_dev)]
+        self._xfer_cache = {}
+
+    # -- directory (the store-keyed surface) ---------------------------
+
+    def check_exist(self, key):
+        return key in self.directory
+
+    def match_last_index(self, keys):
+        """Longest resident prefix — the in-pod twin of the store's
+        get_match_last_index probe."""
+        last = -1
+        for i, k in enumerate(keys):
+            if k not in self.directory:
+                break
+            last = i
+        return last
+
+    def device_of(self, key):
+        return self.directory[key][0]
+
+    def free_slots(self, device):
+        return len(self._free[device])
+
+    def _global_slot(self, device, slot):
+        return device * self._local + slot
+
+    # -- page injection / extraction -----------------------------------
+
+    def put(self, keys, pages, device):
+        """Host-injection path: place ``pages`` ([n, *page_shape]) under
+        ``keys`` on ``device``. (The hot prefill path writes pages from
+        on-device compute instead; this is the restore-from-host-store /
+        test path.) First-writer-wins like the store: existing keys are
+        skipped."""
+        pages = jnp.asarray(pages, dtype=self.dtype)
+        take = [i for i, k in enumerate(keys) if k not in self.directory]
+        if not take:
+            return
+        if len(take) > len(self._free[device]):
+            raise MemoryError(
+                f"device {device}: {len(take)} pages > "
+                f"{len(self._free[device])} free slots"
+            )
+        slots = [self._free[device].pop() for _ in take]
+        gidx = jnp.asarray(
+            [self._global_slot(device, s) for s in slots], dtype=jnp.int32
+        )
+        self.buffer = _scatter_pages(self.buffer, gidx, pages[jnp.asarray(take)])
+        for i, s in zip(take, slots):
+            self.directory[keys[i]] = (device, s)
+
+    def get(self, keys):
+        """Gather pages for ``keys`` (any placement) as one [n, *page]
+        device array (cross-device gather compiles to XLA collectives)."""
+        gidx = jnp.asarray(
+            [self._global_slot(*self.directory[k]) for k in keys],
+            dtype=jnp.int32,
+        )
+        return self.buffer[gidx]
+
+    def drop(self, keys):
+        """Release keys' slots (pages become garbage; directory is the
+        source of truth, like BlockRef release in the host store)."""
+        for k in keys:
+            dev, slot = self.directory.pop(k)
+            self._free[dev].append(slot)
+
+    # -- the ICI handoff ------------------------------------------------
+
+    def handoff(self, moves):
+        """Relocate keyed pages device-to-device over ICI.
+
+        ``moves``: {key: dst_device}. Pages move from their current
+        device (directory lookup) to ``dst_device`` via one
+        shard_map+ppermute per scheduling round. jax ppermute requires
+        source AND destination to be unique within one collective, so
+        routes are greedily scheduled into rounds that form a matching
+        (the common disaggregation pairing — prefill chip i feeding
+        decode chip j — is a single round). The directory and free lists
+        are updated; data moves entirely on-device.
+        """
+        # Group by (src, dst) route.
+        routes = {}
+        for key, dst in moves.items():
+            src, slot = self.directory[key]
+            if src == dst:
+                continue
+            routes.setdefault((src, dst), []).append((key, slot))
+        while routes:
+            # One round: each device at most once as source and once as
+            # destination (ppermute uniqueness on both sides).
+            round_routes = {}
+            used_src = set()
+            for (src, dst), items in list(routes.items()):
+                if dst not in round_routes and src not in used_src:
+                    round_routes[dst] = (src, items)
+                    used_src.add(src)
+                    del routes[(src, dst)]
+            self._handoff_round(round_routes)
+
+    def _handoff_round(self, round_routes):
+        """round_routes: {dst: (src, [(key, src_slot), ...])}."""
+        # Within a round each source serves exactly one destination, so
+        # the transfer width is the largest route's item count; shorter
+        # routes pad with the scratch slot on both ends.
+        n_xfer = max(len(items) for _src, items in round_routes.values())
+        perm = tuple(
+            sorted((src, dst) for dst, (src, _) in round_routes.items())
+        )
+        scratch = self.slots  # hidden slot index (local)
+        send = np.full((self.n_dev, n_xfer), scratch, dtype=np.int32)
+        recv = np.full((self.n_dev, n_xfer), scratch, dtype=np.int32)
+        fills = {}  # src -> next free position in its send row
+        placements = []  # (dst, key, position)
+        for dst, (src, items) in sorted(round_routes.items()):
+            for key, src_slot in items:
+                pos = fills.get(src, 0)
+                fills[src] = pos + 1
+                send[src, pos] = src_slot
+                placements.append((dst, key, pos))
+        # Destination slot assignment.
+        new_loc = {}
+        for dst, key, pos in placements:
+            if not self._free[dst]:
+                raise MemoryError(f"device {dst} has no free slots")
+            slot = self._free[dst].pop()
+            recv[dst, pos] = slot
+            new_loc[key] = (dst, slot)
+
+        fn = self._xfer_fn(n_xfer, perm)
+        send_d = jax.device_put(send, self._sharding)
+        recv_d = jax.device_put(recv, self._sharding)
+        self.buffer = fn(self.buffer, send_d, recv_d)
+
+        # Commit directory updates; old slots become free.
+        for key, (dst, slot) in new_loc.items():
+            src, old_slot = self.directory[key]
+            self.directory[key] = (dst, slot)
+            self._free[src].append(old_slot)
+
+    def _xfer_fn(self, n_xfer, perm):
+        key = (n_xfer, perm)
+        fn = self._xfer_cache.get(key)
+        if fn is None:
+            fn = _build_xfer(self.mesh, self.axis, perm, self._sharding)
+            self._xfer_cache[key] = fn
+        return fn
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_pages(buffer, gidx, pages):
+    return buffer.at[gidx].set(pages)
+
+
+def _build_xfer(mesh, axis, perm, sharding):
+    """Jitted one-round transfer: gather send slots, ppermute, scatter
+    into recv slots. Padding and non-receivers target the scratch slot,
+    so live pages are never clobbered."""
+
+    def local_xfer(local_pages, send_slots, recv_slots):
+        # local_pages: [local_slots, *page]; send/recv_slots: [1, n_xfer]
+        out = jax.lax.ppermute(
+            local_pages[send_slots[0]], axis, perm
+        )  # zeros on devices not a destination of `perm`
+        return local_pages.at[recv_slots[0]].set(out)
+
+    smapped = shard_map(
+        local_xfer,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=P(axis),
+    )
+    return jax.jit(smapped, donate_argnums=(0,))
+
+
+__all__ = ["IciKVPool", "make_pool_mesh"]
