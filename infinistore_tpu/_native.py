@@ -113,6 +113,13 @@ def _decls(lib):
              c.c_void_p],
         ),
         (
+            "ist_allocate_async",
+            c.c_uint32,
+            [c.c_void_p, c.c_char_p, c.c_uint64, c.c_uint32, c.c_uint32,
+             c.c_void_p, CALLBACK, c.c_void_p],
+        ),
+        ("ist_sync_async", c.c_uint32, [c.c_void_p, CALLBACK, c.c_void_p]),
+        (
             "ist_write_async",
             c.c_uint32,
             [c.c_void_p, c.c_uint32, c.c_uint32, c.POINTER(c.c_uint64),

@@ -429,10 +429,45 @@ class InfinityConnection:
         return self.allocate(keys, page_size_in_bytes)
 
     async def allocate_rdma_async(self, keys, page_size_in_bytes):
-        # Allocation is a single small rpc; run it off-loop.
-        return await asyncio.get_running_loop().run_in_executor(
-            None, self.allocate, keys, page_size_in_bytes
+        """Native async allocate: the OP_ALLOCATE rpc rides the
+        connection's IO thread and completes via callback onto the
+        running loop — no thread-pool hop (the reference's allocate is a
+        native async op with a promise, libinfinistore.cpp:748-858)."""
+        self._check()
+        blob = pack_keys(keys)
+        out = np.zeros(len(keys), dtype=REMOTE_BLOCK_DTYPE)
+        loop = asyncio.get_running_loop()
+        future = loop.create_future()
+
+        def cb(status):
+            loop.call_soon_threadsafe(
+                _finish_future, future, status, "allocate"
+            )
+
+        ka = self._keep(cb, (blob, out))
+        st = self._lib.ist_allocate_async(
+            self._h, blob, len(blob), len(keys), page_size_in_bytes,
+            out.ctypes.data_as(ct.c_void_p), ka.c_cb, None,
         )
+        if st != OK:
+            self._drop_keep(ka.kid)
+            raise InfiniStoreError(st, "allocate submit failed")
+        try:
+            # Bounded promise (reference: 5 s allocate timeout,
+            # libinfinistore.cpp:760); we use the config timeout.
+            await asyncio.wait_for(future, self.config.timeout_ms / 1000)
+        except asyncio.TimeoutError:
+            raise InfiniStoreError(
+                TIMEOUT_ERR, "allocate timed out"
+            ) from None
+        if (out["status"] == _native.OUT_OF_MEMORY).any():
+            # Same batch rollback as the sync path (abort is a sync rpc,
+            # so it must not run on the loop — error path only).
+            ok_tokens = out["token"][out["status"] == OK]
+            if len(ok_tokens):
+                await loop.run_in_executor(None, self.abort, ok_tokens)
+            raise InfiniStoreError(_native.OUT_OF_MEMORY, "allocate failed")
+        return out
 
     allocate_async = allocate_rdma_async
 
@@ -776,7 +811,32 @@ class InfinityConnection:
         return 0
 
     async def sync_async(self):
-        return await asyncio.get_running_loop().run_in_executor(None, self.sync)
+        """Native async barrier: completes when the connection's inflight
+        count drains to zero, via callback onto the running loop (no
+        executor hop)."""
+        self._check()
+        loop = asyncio.get_running_loop()
+        future = loop.create_future()
+
+        def cb(status):
+            loop.call_soon_threadsafe(_finish_future, future, status, "sync")
+
+        ka = self._keep(cb, ())
+        st = self._lib.ist_sync_async(self._h, ka.c_cb, None)
+        if st != OK:
+            self._drop_keep(ka.kid)
+            raise InfiniStoreError(st, "sync submit failed")
+        try:
+            await asyncio.wait_for(future, self.config.timeout_ms / 1000)
+        except asyncio.TimeoutError:
+            raise InfiniStoreError(TIMEOUT_ERR, "sync timed out") from None
+        with self._async_errors_lock:
+            errs, self._async_errors = self._async_errors, []
+        if errs:
+            raise InfiniStoreError(
+                errs[0], f"{len(errs)} pipelined write(s) failed"
+            )
+        return 0
 
     def check_exist(self, key):
         self._check()

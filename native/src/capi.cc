@@ -182,6 +182,49 @@ uint32_t ist_allocate(void* h, const uint8_t* keys_blob, uint64_t blob_len,
     return OK;
 }
 
+// Async allocate: the OP_ALLOCATE rpc rides the connection's IO thread
+// and `cb` fires on completion with `out[nkeys]` filled — the native
+// promise path of the reference's allocate_rdma_async
+// (libinfinistore.cpp:773-858), minus any thread-pool hop. `out` must
+// stay valid until the callback fires.
+uint32_t ist_allocate_async(void* h, const uint8_t* keys_blob,
+                            uint64_t blob_len, uint32_t nkeys,
+                            uint32_t block_size, RemoteBlock* out,
+                            ist_callback cb, void* ud) {
+    auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    w.u32(block_size);
+    w.u32(nkeys);
+    if (blob_len) w.bytes(keys_blob, size_t(blob_len));
+    c->rpc_async(OP_ALLOCATE, std::move(body),
+                 [out, nkeys, cb, ud](uint32_t st, std::vector<uint8_t> resp) {
+                     if (st == OK) {
+                         BufReader r(resp.data(), resp.size());
+                         uint32_t n = r.u32();
+                         const uint8_t* raw =
+                             r.raw(size_t(n) * sizeof(RemoteBlock));
+                         if (raw == nullptr || n != nkeys) {
+                             st = INTERNAL_ERROR;
+                         } else {
+                             memcpy(out, raw, size_t(n) * sizeof(RemoteBlock));
+                         }
+                     }
+                     if (cb) cb(st, ud);
+                 });
+    return OK;
+}
+
+// Async barrier: cb fires when the connection's inflight count drains to
+// zero (immediately if it already is).
+uint32_t ist_sync_async(void* h, ist_callback cb, void* ud) {
+    auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
+    c->sync_async(wrap_cb(cb, ud));
+    return OK;
+}
+
 // Streamed write of n blocks from srcs[i] (STREAM path).
 uint32_t ist_write_async(void* h, uint32_t block_size, uint32_t n,
                          const uint64_t* tokens, const void* const* srcs,

@@ -169,6 +169,15 @@ void Connection::close_conn() {
         wake();
         if (io_thread_.joinable()) io_thread_.join();
     }
+    // The IO thread has unwound (fail_all completed every pending op, so
+    // inflight drained through finish_op) — but a sync_async registered
+    // between the drain and here would otherwise wait forever.
+    std::vector<DoneFn> waiters;
+    {
+        std::lock_guard<std::mutex> lk(sync_mu_);
+        waiters.swap(sync_waiters_);
+    }
+    for (auto& w : waiters) w(INTERNAL_ERROR, {});
     if (fd_ >= 0) close(fd_);
     if (epoll_fd_ >= 0) close(epoll_fd_);
     if (wake_fd_ >= 0) close(wake_fd_);
@@ -745,10 +754,34 @@ uint32_t Connection::sync(int timeout_ms) {
     return broken_.load() ? INTERNAL_ERROR : OK;
 }
 
+void Connection::sync_async(DoneFn done) {
+    if (!done) return;
+    {
+        std::lock_guard<std::mutex> lk(sync_mu_);
+        if (inflight_.load() != 0) {
+            sync_waiters_.push_back(std::move(done));
+            return;
+        }
+    }
+    done(broken_.load() ? INTERNAL_ERROR : OK, {});
+}
+
 void Connection::finish_op() {
-    std::lock_guard<std::mutex> lk(sync_mu_);
-    inflight_--;
+    std::vector<DoneFn> waiters;
+    {
+        std::lock_guard<std::mutex> lk(sync_mu_);
+        inflight_--;
+        if (inflight_.load() == 0 && !sync_waiters_.empty()) {
+            waiters.swap(sync_waiters_);
+        }
+    }
     sync_cv_.notify_all();
+    if (!waiters.empty()) {
+        // Outside sync_mu_: a waiter may immediately submit new ops (which
+        // take sync_mu_ in their own finish_op) or call back into Python.
+        uint32_t st = broken_.load() ? INTERNAL_ERROR : OK;
+        for (auto& w : waiters) w(st, {});
+    }
 }
 
 // ---------------------------------------------------------------------------

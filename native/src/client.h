@@ -126,6 +126,13 @@ class Connection {
     // Wait until all async ops completed (reference sync_rdma/sync_local).
     uint32_t sync(int timeout_ms);
 
+    // Async barrier: `done` fires (from whichever thread completes the
+    // last op) once the inflight count reaches zero — immediately if it
+    // already is. The asyncio bridge built on this replaces a
+    // run-in-executor hop per sync (reference allocate/sync are native
+    // async ops with promises, libinfinistore.cpp:748-858).
+    void sync_async(DoneFn done);
+
     // Tear the connection down from a non-IO thread and wait (bounded)
     // for the IO thread to unwind. Needed after a timed-out blocking op
     // whose Pending still references caller-owned buffers (STREAM read
@@ -213,6 +220,7 @@ class Connection {
     std::atomic<uint64_t> inflight_{0};
     std::mutex sync_mu_;
     std::condition_variable sync_cv_;
+    std::vector<DoneFn> sync_waiters_;  // guarded by sync_mu_
 
     // shm pools
     std::mutex pools_mu_;

@@ -73,6 +73,36 @@ def test_async_missing_key_raises(conn):
     asyncio.run(run())
 
 
+def test_async_paths_never_hop_through_executor(conn, rng):
+    """allocate/write/sync/put async run on the connection's native
+    callback path (reference: native async ops with promises,
+    libinfinistore.cpp:748-858) — poisoning the loop's executor proves
+    no run_in_executor hop hides on the hot path."""
+
+    async def run():
+        loop = asyncio.get_running_loop()
+
+        def poisoned(*a, **kw):
+            raise AssertionError("async hot path used run_in_executor")
+
+        loop.run_in_executor = poisoned
+        page = 1024
+        src = rng.random(page).astype(np.float32)
+        keys = [key()]
+        blocks = await conn.allocate_async(keys, page * 4)
+        await conn.write_cache_async(src, [0], page, blocks)
+        await conn.sync_async()
+        src2 = rng.random(page).astype(np.float32)
+        await conn.put_cache_async(src2, [(key(), 0)], page)
+        await conn.sync_async()
+        dst = np.zeros_like(src)
+        await conn.read_cache_async(dst, [(keys[0], 0)], page)
+        await conn.sync_async()
+        return np.array_equal(src, dst)
+
+    assert asyncio.run(run())
+
+
 def test_local_gpu_write_cache_async(conn, rng):
     async def run():
         page = 512
