@@ -24,7 +24,7 @@ import numpy as np
 
 from infinistore_tpu import ClientConfig, InfinityConnection
 from infinistore_tpu.models import llama
-from infinistore_tpu.tpu import TpuKVStore
+from infinistore_tpu.tpu import LayerStreamer, TpuKVStore
 
 
 def run(host, port, seq_len=64):
@@ -45,18 +45,22 @@ def run(host, port, seq_len=64):
         ClientConfig(host_addr=host, service_port=port)
     )
     prefill_conn.connect()
-    store = TpuKVStore(prefill_conn)
     t0 = time.perf_counter()
     logits, kvs = llama.prefill(params, cfg, prompt)
     jax.block_until_ready(logits)
     t_compute = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    for li, (k, v) in enumerate(kvs):  # layer-by-layer, overlapped uploads
-        kp, vp = llama.kv_to_pages(cfg, k, v)
-        store.put_kv_pages(llama.page_keys(seq_id, li, "k", n_pages), kp[0])
-        store.put_kv_pages(llama.page_keys(seq_id, li, "v", n_pages), vp[0])
-    prefill_conn.sync()
+    with LayerStreamer(prefill_conn) as streamer:
+        for li, (k, v) in enumerate(kvs):  # layer-by-layer, non-blocking
+            kp, vp = llama.kv_to_pages(cfg, k, v)
+            streamer.submit_pages(
+                llama.page_keys(seq_id, li, "k", n_pages), kp[0]
+            )
+            streamer.submit_pages(
+                llama.page_keys(seq_id, li, "v", n_pages), vp[0]
+            )
+        streamer.finish()
     t_upload = time.perf_counter() - t0
     first_token = int(jnp.argmax(logits[0, -1]))
     prefill_conn.close()

@@ -333,27 +333,35 @@ class InfinityConnection:
         try:
             return fn()
         except InfiniStoreError as e:
-            if (
-                not self.config.auto_reconnect
-                or e.status not in self._RETRYABLE
-            ):
-                raise
-            with self._reconnect_lock:
-                if self._conn_gen == gen:
-                    # Nobody reconnected since our attempt; only do it if
-                    # the connection is actually dead.
-                    if not self._h or not self._lib.ist_conn_broken(self._h):
-                        raise
-                    Logger.warning(f"connection failure ({e}); reconnecting")
-                    self._reconnect_locked()
-                elif self._h == h0:
-                    # Generation moved but the handle did not change: the
-                    # reconnect predates our attempt, so our failure is
-                    # its own story — don't mask it with a retry.
-                    raise
-                if keys:
-                    self._reclaim_orphans(keys)
+            self._reconnect_for_retry(e, h0, gen, keys)
             return fn()
+
+    def _reconnect_for_retry(self, e, h0, gen, keys):
+        """The recovery half of :meth:`_run_reconnecting`: decide whether
+        the failure ``e`` (seen on handle ``h0`` at generation ``gen``)
+        warrants a reconnect+retry; re-raise ``e`` when it does not,
+        otherwise reconnect (unless someone already did) and reclaim
+        orphaned ``keys``. Blocking — the async paths call it off-loop."""
+        if (
+            not self.config.auto_reconnect
+            or e.status not in self._RETRYABLE
+        ):
+            raise e
+        with self._reconnect_lock:
+            if self._conn_gen == gen:
+                # Nobody reconnected since our attempt; only do it if
+                # the connection is actually dead.
+                if not self._h or not self._lib.ist_conn_broken(self._h):
+                    raise e
+                Logger.warning(f"connection failure ({e}); reconnecting")
+                self._reconnect_locked()
+            elif self._h == h0:
+                # Generation moved but the handle did not change: the
+                # reconnect predates our attempt, so our failure is
+                # its own story — don't mask it with a retry.
+                raise e
+            if keys:
+                self._reclaim_orphans(keys)
 
     def _retry_busy(self, attempt):
         """Run ``attempt(remaining_ms)`` retrying BUSY (server-side
@@ -431,9 +439,32 @@ class InfinityConnection:
     async def allocate_rdma_async(self, keys, page_size_in_bytes):
         """Native async allocate: the OP_ALLOCATE rpc rides the
         connection's IO thread and completes via callback onto the
-        running loop — no thread-pool hop (the reference's allocate is a
-        native async op with a promise, libinfinistore.cpp:748-858)."""
+        running loop — no thread-pool hop on the happy path (the
+        reference's allocate is a native async op with a promise,
+        libinfinistore.cpp:748-858). Connection failures get the same
+        reconnect + orphan-reclaim + single-retry treatment as the sync
+        path (that recovery runs off-loop — error path only)."""
         self._check()
+        h0, gen = self._h, self._conn_gen
+        try:
+            out = await self._allocate_async_rpc(keys, page_size_in_bytes)
+        except InfiniStoreError as e:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._reconnect_for_retry, e, h0, gen, keys
+            )
+            out = await self._allocate_async_rpc(keys, page_size_in_bytes)
+        if (out["status"] == _native.OUT_OF_MEMORY).any():
+            # Same batch rollback as the sync path (abort is a sync rpc,
+            # so it must not run on the loop — error path only).
+            ok_tokens = out["token"][out["status"] == OK]
+            if len(ok_tokens):
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self.abort, ok_tokens
+                )
+            raise InfiniStoreError(_native.OUT_OF_MEMORY, "allocate failed")
+        return out
+
+    async def _allocate_async_rpc(self, keys, page_size_in_bytes):
         blob = pack_keys(keys)
         out = np.zeros(len(keys), dtype=REMOTE_BLOCK_DTYPE)
         loop = asyncio.get_running_loop()
@@ -460,13 +491,6 @@ class InfinityConnection:
             raise InfiniStoreError(
                 TIMEOUT_ERR, "allocate timed out"
             ) from None
-        if (out["status"] == _native.OUT_OF_MEMORY).any():
-            # Same batch rollback as the sync path (abort is a sync rpc,
-            # so it must not run on the loop — error path only).
-            ok_tokens = out["token"][out["status"] == OK]
-            if len(ok_tokens):
-                await loop.run_in_executor(None, self.abort, ok_tokens)
-            raise InfiniStoreError(_native.OUT_OF_MEMORY, "allocate failed")
         return out
 
     allocate_async = allocate_rdma_async

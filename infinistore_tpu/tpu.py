@@ -16,15 +16,20 @@ equivalent design is explicit host staging through the server's pool:
   ``copy_to_host_async``) followed by a one-sided memcpy into the
   allocated pool blocks + commit. One host-side copy, matching the
   reference's D2H ``cudaMemcpyAsync`` into the pool.
-- **per-layer overlap**: ``LayerStreamer`` starts each layer's
-  device→host copy asynchronously and overlaps the store write of layer k
-  with the transfer of layer k+1 (the reference's prefill upload-thread
-  pattern, demo_prefill.py:57-77, design.rst:56-59).
+- **per-layer overlap**: ``LayerStreamer.submit`` kicks off the layer's
+  async device→host copy and enqueues it for a dedicated upload thread,
+  which reaps the copy and hands the store write to the connection's IO
+  thread — submit never blocks on D2H or the store, so compute of layer
+  k+1 overlaps the transfer+write of layer k (the reference's prefill
+  upload-thread pattern, demo_prefill.py:57-77, design.rst:56-59).
 
 Everything works identically against the STREAM path (remote server) —
 the staging buffer is then private memory and the client streams it over
 TCP — so code written against this module is host-topology agnostic.
 """
+
+import queue
+import threading
 
 import numpy as np
 
@@ -286,42 +291,116 @@ class LayerStreamer:
             streamer.submit(f"{prefix}_{layer}", kv)
         streamer.finish()                       # barriers all writes
 
-    ``submit`` starts the device→host copy asynchronously and hands the
-    store write to the connection's IO thread; compute for the next layer
-    proceeds immediately.
+    ``submit`` is NON-BLOCKING: it kicks off the async device→host copy
+    and enqueues the layer for a dedicated upload thread (the reference's
+    upload-thread pattern). The upload thread waits out the D2H copy,
+    allocates, and hands the store write to the connection's IO thread —
+    compute for the next layer never waits on the device transfer or the
+    store. ``finish`` drains the queue, barriers the connection, and
+    surfaces any per-layer errors; the streamer stays usable afterwards
+    for the next sequence.
     """
+
+    _STOP = object()
 
     def __init__(self, conn: InfinityConnection):
         self.conn = conn
-        self._pending = []  # (key, host_future) not yet written
-        self._errors = []
+        self._q = queue.Queue()
+        self._errors = []  # list.append is atomic; drained in finish()
+        self._thread = threading.Thread(
+            target=self._upload_loop, name="layer-streamer", daemon=True
+        )
+        self._thread.start()
 
     def submit(self, key, array):
+        """Queue one array (one page) for upload under ``key``."""
         _require_jax()
         if hasattr(array, "copy_to_host_async"):
-            array.copy_to_host_async()
-        self._drain_ready()
-        self._pending.append((key, array))
+            array.copy_to_host_async()  # start D2H now; thread reaps it
+        self._q.put((key, array, False))
 
-    def _drain_ready(self):
-        # Write out any arrays whose host copy has landed. jax arrays
-        # don't expose "is host copy done", so we write all pending each
-        # drain — np.asarray is a no-op wait once the async copy finished.
-        for key, arr in self._pending:
-            host = _to_host(arr)
-            blocks = self.conn.allocate([key], host.nbytes)
-            done = _ErrSink(self._errors, key)
-            self.conn._write_async_native(
-                host.reshape(-1), [0], host.size, blocks, done
-            )
-        self._pending.clear()
+    def submit_pages(self, keys, pages):
+        """Queue a [n_pages, ...] page batch; page i goes under keys[i]
+        (one allocate + one pipelined write for the batch, like
+        :meth:`TpuKVStore.put_kv_pages`)."""
+        _require_jax()
+        if len(keys) != pages.shape[0]:
+            raise ValueError("len(keys) must equal pages.shape[0]")
+        if hasattr(pages, "copy_to_host_async"):
+            pages.copy_to_host_async()
+        self._q.put((keys, pages, True))
+
+    def _upload_loop(self):
+        while True:
+            item = self._q.get()
+            try:
+                if item is LayerStreamer._STOP:
+                    return
+                key, arr, batched = item
+                try:
+                    host = _to_host(arr)  # waits only for the async D2H
+                    if batched:
+                        n = host.shape[0]
+                        page_elems = int(np.prod(host.shape[1:]))
+                        blocks = self.conn.allocate(
+                            key, page_elems * host.itemsize
+                        )
+                        self.conn._write_async_native(
+                            host.reshape(-1),
+                            [i * page_elems for i in range(n)],
+                            page_elems, blocks, _ErrSink(self._errors, key),
+                        )
+                    else:
+                        blocks = self.conn.allocate([key], host.nbytes)
+                        self.conn._write_async_native(
+                            host.reshape(-1), [0], host.size, blocks,
+                            _ErrSink(self._errors, key),
+                        )
+                except Exception as e:  # allocate / submit failure
+                    self._errors.append((key, e))
+            finally:
+                self._q.task_done()
 
     def finish(self):
-        """Flush remaining layers and barrier (conn.sync)."""
-        self._drain_ready()
-        self.conn.sync()
-        if self._errors:
-            raise RuntimeError(f"layer uploads failed: {self._errors}")
+        """Barrier: every submitted layer written and committed. Waits
+        for the upload queue to drain, then for the connection's inflight
+        writes (conn.sync); raises if any layer failed. The error list is
+        always drained, so a failed sequence never leaks stale errors
+        into the next sequence's finish()."""
+        self._q.join()
+        sync_exc = None
+        try:
+            self.conn.sync()
+        except Exception as e:
+            sync_exc = e
+        errs, self._errors = self._errors, []
+        if errs:
+            raise RuntimeError(f"layer uploads failed: {errs}") from sync_exc
+        if sync_exc is not None:
+            raise sync_exc
+
+    def close(self):
+        """Stop the upload thread (queued layers still drain first).
+        Raises if the thread will not stop — in that case it is still
+        inside native calls on ``conn``, and the caller must NOT destroy
+        the connection (freeing the handle under a live native call is a
+        use-after-free; a closed-but-undestroyed one fails safely)."""
+        self._q.put(LayerStreamer._STOP)
+        # Native ops are themselves bounded (rpc timeout + one reconnect
+        # retry), so a healthy-but-slow store still lets the thread exit
+        # within this window.
+        self._thread.join(timeout=60)
+        if self._thread.is_alive():
+            raise RuntimeError(
+                "layer-streamer upload thread did not stop; the store "
+                "connection must not be destroyed while it is running"
+            )
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 class _ErrSink:

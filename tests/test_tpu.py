@@ -57,20 +57,84 @@ def test_cached_prefix_len(store, rng):
 
 
 def test_layer_streamer_overlap(conn, rng):
-    streamer = tpu.LayerStreamer(conn)
-    layers = 8
-    prefix = key()
-    arrays = [
-        jnp.asarray(rng.random((256,)).astype(np.float32))
-        for _ in range(layers)
-    ]
-    for i, a in enumerate(arrays):
-        streamer.submit(f"{prefix}_{i}", a)
-    streamer.finish()
-    store = tpu.TpuKVStore(conn)
-    for i, a in enumerate(arrays):
-        got = store.get_array(f"{prefix}_{i}", (256,), np.float32)
-        assert np.array_equal(np.asarray(got), np.asarray(a))
+    with tpu.LayerStreamer(conn) as streamer:
+        layers = 8
+        prefix = key()
+        arrays = [
+            jnp.asarray(rng.random((256,)).astype(np.float32))
+            for _ in range(layers)
+        ]
+        for i, a in enumerate(arrays):
+            streamer.submit(f"{prefix}_{i}", a)
+        streamer.finish()
+        store = tpu.TpuKVStore(conn)
+        for i, a in enumerate(arrays):
+            got = store.get_array(f"{prefix}_{i}", (256,), np.float32)
+            assert np.array_equal(np.asarray(got), np.asarray(a))
+
+
+def test_layer_streamer_pages(conn, rng):
+    """submit_pages: a whole layer's page batch in one queue item."""
+    with tpu.LayerStreamer(conn) as streamer:
+        n_pages, page_shape = 4, (16, 8)
+        prefix = key()
+        pages = jnp.asarray(
+            rng.random((n_pages, *page_shape)).astype(np.float32)
+        )
+        keys = [f"{prefix}_p{i}" for i in range(n_pages)]
+        streamer.submit_pages(keys, pages)
+        streamer.finish()
+        store = tpu.TpuKVStore(conn)
+        out = store.get_kv_pages(keys, page_shape, np.float32)
+        assert np.array_equal(np.asarray(out), np.asarray(pages))
+
+
+class _StallingConn:
+    """Stub connection whose allocate blocks until released — lets the
+    test observe that submit() returns while the PREVIOUS layer's
+    allocate+write has not even started, i.e. submit never waits on the
+    store (VERDICT round-2 item 1 acceptance)."""
+
+    def __init__(self):
+        import threading
+
+        self.release = threading.Event()
+        self.uploaded = []
+        self.synced = 0
+
+    def allocate(self, keys, nbytes):
+        self.release.wait(10)
+        return {"keys": list(keys)}
+
+    def _write_async_native(self, flat, offsets, size, blocks, cb):
+        self.uploaded.extend(blocks["keys"])
+        from infinistore_tpu._native import OK
+
+        cb(OK)
+
+    def sync(self):
+        self.synced += 1
+
+
+def test_layer_streamer_submit_never_blocks(rng):
+    import time
+
+    stub = _StallingConn()
+    with tpu.LayerStreamer(stub) as streamer:
+        a = jnp.asarray(rng.random((128,)).astype(np.float32))
+        t0 = time.perf_counter()
+        streamer.submit("l0", a)
+        streamer.submit("l1", a)
+        streamer.submit("l2", a)
+        elapsed = time.perf_counter() - t0
+        # The store is stalled (allocate for l0 is blocked), yet all three
+        # submits returned and nothing has been written.
+        assert elapsed < 1.0
+        assert stub.uploaded == []
+        stub.release.set()
+        streamer.finish()
+        assert stub.uploaded == ["l0", "l1", "l2"]
+        assert stub.synced == 1
 
 
 def test_get_array_to_explicit_device(store, rng):
