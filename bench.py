@@ -184,6 +184,56 @@ def bench_sharded(n_shards=4, nkeys=4096, block_kb=4):
             s.stop()
 
 
+def bench_raw_tcp(total_bytes=64 << 20, chunk=256 << 10, passes=2):
+    """Raw loopback-socket bandwidth — the denominator for the north
+    star's ">=80% of raw DCN bandwidth" (BASELINE.json): one TCP
+    connection, sender streaming `total_bytes` in `chunk`-sized sendalls,
+    receiver recv_into-draining on a thread. Same host contention shape
+    as the STREAM leg (client + server share the 1-core box), no store in
+    the loop. Returns one-directional GB/s (best of `passes`) — directly
+    comparable to stream_agg_GBps, which is average one-directional rate
+    (each phase moves the full payload one way)."""
+    import socket
+    import threading
+
+    best = None
+    for _ in range(passes):
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(1)
+        port = lsock.getsockname()[1]
+        done = threading.Event()
+
+        def rx():
+            c, _ = lsock.accept()
+            buf = bytearray(chunk)
+            n = 0
+            while n < total_bytes:
+                m = c.recv_into(buf, chunk)
+                if m == 0:
+                    break
+                n += m
+            c.close()
+            done.set()
+
+        t = threading.Thread(target=rx, daemon=True)
+        t.start()
+        cli = socket.create_connection(("127.0.0.1", port))
+        payload = memoryview(bytes(chunk))
+        t0 = time.perf_counter()
+        sent = 0
+        while sent < total_bytes:
+            cli.sendall(payload)
+            sent += chunk
+        done.wait(60)  # bandwidth = bytes fully received / elapsed
+        dt = time.perf_counter() - t0
+        cli.close()
+        lsock.close()
+        t.join(5)
+        best = dt if best is None else min(best, dt)
+    return round(total_bytes / (1 << 30) / best, 3)
+
+
 def bench_overlap(port):
     """Prefill overlap-overhead leg — the reference's one published
     claim: layer-by-layer KV upload adds "no more than 1%" to prefill
@@ -274,7 +324,16 @@ def bench_overlap(port):
 
 
 def bench_tpu(port):
-    """Device <-> store KV-page transfers with raw-transfer control legs."""
+    """Device <-> store KV-page transfers with raw-transfer control legs.
+
+    Store passes and their raw controls are INTERLEAVED and both
+    best-of-N: the axon tunnel's bandwidth swings ~2x within a single
+    run, so single-sample controls prove nothing (round-2 published
+    restore_vs_ctrl = 2.19 — a "ceiling" slower than the store). With
+    interleaving, drift hits both legs alike and the best pass of each
+    is the environment's actual rate, so the vs_ctrl ratios are stable
+    near [0, ~1.1]. Ratios are computed from the rounded published GB/s
+    values so the artifact cross-checks."""
     try:
         import jax
         import jax.numpy as jnp
@@ -292,19 +351,25 @@ def bench_tpu(port):
             store = TpuKVStore(conn)
             n_pages, page = 64, (2048, 8, 8)
             page_elems = int(np.prod(page))
-            nbytes = n_pages * page_elems * 2  # bf16
+            page_bytes = page_elems * 2
+            nbytes = n_pages * page_bytes  # 16 MB, 2-byte elements
             gb = nbytes / (1 << 30)
+            passes = 3
 
             # ---- Phase R: store -> TPU restore (H2D), D2H-free ----
             # Ramp the H2D path at full size first: the session's first
             # transfers carry one-time setup cost (measured: first 16 MB
-            # H2D ~0.18 GB/s, second ~1.3 GB/s on idential-freshness
-            # content).
+            # H2D ~0.18 GB/s, second ~1.3 GB/s on identical-freshness
+            # content). Kept D2H-free: on the axon tunnel any D2H
+            # permanently degrades later H2D ~50x (BASELINE.md), and a
+            # D2H-free session is also the representative disaggregation
+            # shape (the decode host restores pages a different host
+            # prefilled).
             rng = np.random.default_rng(1)
-            warm_keys = [f"tpu_rwarm_p{i}" for i in range(n_pages)]
             # uint16 pages: same 2-byte element width as bf16 KV without
             # NaN semantics, so bit-exact verification can use
             # array_equal.
+            warm_keys = [f"tpu_rwarm_p{i}" for i in range(n_pages)]
             warm_pages = (
                 rng.integers(0, 255, nbytes, dtype=np.uint8)
                 .view(np.uint16)
@@ -322,33 +387,58 @@ def bench_tpu(port):
             )
             rkeys = [f"tpu_restore_p{i}" for i in range(n_pages)]
             store.put_kv_pages(rkeys, host_pages, sync=True)  # host-only
+            # Like-for-like control buffer: the store side serves H2D from
+            # an mlocked shm pool, so the raw-ceiling control must be
+            # equally pinned — a pageable heap copy measures the page-
+            # pinning win, not the store's overhead (observed: pool-view
+            # device_put 1.22x FASTER than a heap-buffer device_put).
+            import ctypes
+            import mmap
 
-            t0 = time.perf_counter()
-            restored = store.get_kv_pages(rkeys, page, np.uint16, device=dev)
-            jax.block_until_ready(restored)
-            t_res = time.perf_counter() - t0
+            ctrl_mm = mmap.mmap(-1, nbytes)
+            ctrl_buf = (
+                np.frombuffer(ctrl_mm, dtype=np.uint16)
+                .reshape(n_pages, *page)
+            )
+            ctrl_buf[:] = host_pages
+            addr = ctypes.addressof(ctypes.c_char.from_buffer(ctrl_mm))
+            # Record whether pinning actually took (RLIMIT_MEMLOCK can
+            # refuse 16 MB): an unpinned control would silently re-create
+            # the very control-trustworthiness gap this leg fixes.
+            ctrl_pinned = (
+                ctypes.CDLL(None).mlock(ctypes.c_void_p(addr), nbytes) == 0
+            )
 
-            # Control: raw device_put of the same content from private
-            # heap memory — what this environment's H2D path does with no
-            # store in the loop.
-            ctrl_buf = host_pages.copy()
-            t0 = time.perf_counter()
-            ctrl_dev = jax.device_put(ctrl_buf, dev)
-            jax.block_until_ready(ctrl_dev)
-            t_h2d = time.perf_counter() - t0
+            # Interleaved best-of-N. Re-reading the same keys / re-putting
+            # the same numpy buffer re-transfers every pass (H2D has no
+            # host-copy caching; only D2H caches on the jax array).
+            t_res, t_h2d = None, None
+            restored = ctrl_dev = None
+            for _ in range(passes):
+                t0 = time.perf_counter()
+                restored = store.get_kv_pages(
+                    rkeys, page, np.uint16, device=dev
+                )
+                jax.block_until_ready(restored)
+                t = time.perf_counter() - t0
+                t_res = t if t_res is None else min(t_res, t)
+
+                t0 = time.perf_counter()
+                ctrl_dev = jax.device_put(ctrl_buf, dev)
+                jax.block_until_ready(ctrl_dev)
+                t = time.perf_counter() - t0
+                t_h2d = t if t_h2d is None else min(t_h2d, t)
 
             # ---- Phase O: TPU -> store offload (D2H) ----
-            # (Everything below may issue D2H, which on the axon tunnel
-            # degrades later H2D — hence strictly after Phase R.)
+            # (Everything below may issue D2H — strictly after Phase R.)
             # Bit-exact restore check (the array_equal scalar crosses D2H).
             restore_ok = bool(jnp.array_equal(restored, ctrl_dev))
 
-            # Device-generated pages. One warm store round first (the
-            # transport content-dedups; steady-state disaggregation
-            # re-offloads content the transport has seen), then measure
-            # on a distinct device buffer with the same content — reusing
-            # `pages` would measure nothing: jax caches the host copy on
-            # the array object after the warm round's transfer.
+            # Device-generated pages; one warm store round primes the
+            # path. Every measured pass needs a FRESH device buffer
+            # (pages + 0): a buffer that already crossed D2H serves its
+            # cached host copy and measures nothing. Fresh keys per pass
+            # (first-writer-wins dedup).
             pages = jax.random.randint(
                 jax.random.PRNGKey(0), (n_pages, *page), 0, 2**16 - 1,
                 dtype=jnp.uint16
@@ -357,25 +447,27 @@ def bench_tpu(port):
             wkeys = [f"tpu_warm_p{i}" for i in range(n_pages)]
             store.put_kv_pages(wkeys, pages, sync=True)
 
-            pages_off = jax.block_until_ready(pages + 0)  # new buffer
-            okeys = [f"tpu_offload_p{i}" for i in range(n_pages)]
-            t0 = time.perf_counter()
-            store.put_kv_pages(okeys, pages_off, sync=True)
-            t_off = time.perf_counter() - t0
+            t_off, t_d2h = None, None
+            okeys = None
+            ctrl_host = None
+            for it in range(passes):
+                pages_off = jax.block_until_ready(pages + 0)  # new buffer
+                okeys = [f"tpu_offload{it}_p{i}" for i in range(n_pages)]
+                t0 = time.perf_counter()
+                store.put_kv_pages(okeys, pages_off, sync=True)
+                t = time.perf_counter() - t0
+                t_off = t if t_off is None else min(t_off, t)
 
-            # Control: raw device->host of yet another same-content
-            # buffer (again: a buffer that has already crossed D2H would
-            # serve its cached host copy and measure nothing).
-            pages_ctrl = jax.block_until_ready(pages + 0)
-            t0 = time.perf_counter()
-            ctrl_host = np.asarray(pages_ctrl)
-            t_d2h = time.perf_counter() - t0
+                pages_ctrl = jax.block_until_ready(pages + 0)
+                t0 = time.perf_counter()
+                ctrl_host = np.asarray(pages_ctrl)
+                t = time.perf_counter() - t0
+                t_d2h = t if t_d2h is None else min(t_d2h, t)
 
             # Offload round-trip check, host-only (no extra device
-            # transfer): what the store holds under okeys must equal the
-            # control leg's D2H copy of the same content.
+            # transfer): what the store holds under the last pass's okeys
+            # must equal the control leg's D2H copy of the same content.
             offload_back = np.empty(nbytes, dtype=np.uint8)
-            page_bytes = page_elems * 2
             conn.read_cache(
                 offload_back,
                 [(k, i * page_bytes) for i, k in enumerate(okeys)],
@@ -389,14 +481,23 @@ def bench_tpu(port):
                 )
             )
 
+            # Publish rounded rates; ratios recomputed from the rounded
+            # values so readers cross-checking the artifact get the same
+            # numbers (round-2 advisor finding).
+            r_res = round(gb / t_res, 3)
+            r_h2d = round(gb / t_h2d, 3)
+            r_off = round(gb / t_off, 3)
+            r_d2h = round(gb / t_d2h, 3)
             return {
                 "tpu_device": str(dev),
-                "tpu_restore_GBps": round(gb / t_res, 3),
-                "ctrl_h2d_GBps": round(gb / t_h2d, 3),
-                "restore_vs_ctrl": round(t_h2d / t_res, 2),
-                "tpu_offload_GBps": round(gb / t_off, 3),
-                "ctrl_d2h_GBps": round(gb / t_d2h, 3),
-                "offload_vs_ctrl": round(t_d2h / t_off, 2),
+                "tpu_bench_passes": passes,
+                "ctrl_pinned": ctrl_pinned,
+                "tpu_restore_GBps": r_res,
+                "ctrl_h2d_GBps": r_h2d,
+                "restore_vs_ctrl": round(r_res / r_h2d, 2) if r_h2d else None,
+                "tpu_offload_GBps": r_off,
+                "ctrl_d2h_GBps": r_d2h,
+                "offload_vs_ctrl": round(r_off / r_d2h, 2) if r_d2h else None,
                 "tpu_verified": restore_ok and offload_ok,
             }
         finally:
@@ -473,6 +574,29 @@ def main():
             )
         except Exception as e:
             stream_res = {"error": str(e)[:200]}
+        # Raw-socket denominator measured right next to the STREAM leg
+        # (same host state) so stream_vs_raw is an honest fraction of
+        # what loopback TCP can actually do here. Two numerators: the
+        # 4 KB-block leg (per-block index work dominates on 1 core) and a
+        # 64 KB-block leg — the realistic vLLM KV-page size (a 16-token
+        # page at 8 kv-heads x 128 head-dim in bf16 is 32-64 KB), where
+        # the STREAM engine saturates the raw socket.
+        try:
+            raw_gbps = bench_raw_tcp()
+            stream_res["raw_tcp_GBps"] = raw_gbps
+            if raw_gbps and "agg_GBps" in stream_res:
+                stream_res["vs_raw"] = round(
+                    stream_res["agg_GBps"] / raw_gbps, 2
+                )
+            srv.purge()
+            s64 = bench_store(port, block_kb=64, nkeys=256, ctype="STREAM")
+            stream_res["64k_agg_GBps"] = s64["agg_GBps"]
+            if raw_gbps:
+                stream_res["64k_vs_raw"] = round(
+                    s64["agg_GBps"] / raw_gbps, 2
+                )
+        except Exception as e:
+            stream_res["raw_tcp_error"] = str(e)[:200]
         srv.purge()
         overlap_res = bench_subprocess(
             "--overlap-leg", port, "overlap_error", timeout_s=240

@@ -32,9 +32,27 @@ Design (store-keyed, SPMD):
   prefill→decode pairing compiles once and reuses the executable.
 
 The pool composes with the host store (``tpu.TpuKVStore``) as a faster
-tier: pages not resident in-pod are fetched from the store; pages evicted
-from the pool can be offloaded to it. The handoff itself never touches
-the host.
+tier (the reference's tier layering: GPU memory over the DRAM pool,
+infinistore.cpp:570-804): :meth:`IciKVPool.fetch_from_store` pulls
+missing pages store → pool on a miss, and :meth:`evict_to_store` spills
+resident pages pool → store and frees their slots. The handoff itself
+never touches the host.
+
+**Directory consistency (multi-process SPMD contract).** The directory
+and free lists are HOST-side replicated state: in a multi-process SPMD
+deployment (one process per host, jax.distributed) every process holds
+its own copy and must execute the SAME sequence of directory-mutating
+calls (``put`` / ``drop`` / ``handoff`` / ``fetch_from_store`` /
+``evict_to_store``) with the same arguments — exactly the discipline
+jax already imposes for the collectives these calls launch (a ppermute
+only runs when every process enters it). All mutation is deterministic
+given the call sequence (free lists are stacks; rounds are scheduled in
+sorted order), so identical call sequences yield identical directories
+with no cross-process protocol. The host store is the cross-process
+rendezvous for page *bytes*: ``fetch_from_store`` has every process read
+the same committed pages from the (shared) store, so the injected
+content is globally consistent too; a store fetched from concurrently is
+safe because committed pages are immutable (first-writer-wins).
 """
 
 from functools import partial
@@ -154,6 +172,46 @@ class IciKVPool:
         for k in keys:
             dev, slot = self.directory.pop(k)
             self._free[dev].append(slot)
+
+    # -- host-store tiering (store <-> pool) ----------------------------
+
+    def fetch_from_store(self, store, keys, device):
+        """Pool-miss path: pull the pages of ``keys`` that are not
+        resident from the host store (:class:`tpu.TpuKVStore`) into this
+        pool on ``device``. Returns the number fetched. The engine's
+        miss flow is ``match_last_index`` (pool) → ``cached_prefix_len``
+        (store) → fetch → :meth:`handoff` to wherever decode runs —
+        the reference's GPU-over-DRAM tier layering
+        (infinistore.cpp:570-804) with ICI as the upper tier."""
+        missing = [k for k in keys if k not in self.directory]
+        if not missing:
+            return 0
+        if len(missing) > len(self._free[device]):
+            raise MemoryError(
+                f"device {device}: fetching {len(missing)} pages > "
+                f"{len(self._free[device])} free slots"
+            )
+        # Fetch to HOST (one copy out of the pinned pool, no intermediate
+        # device commit — a committed single-device array cannot feed the
+        # sharded scatter) and inject; the scatter's compiled executable
+        # owns the single host→device placement of the rows.
+        pages = store.get_kv_pages_host(missing, self.page_shape, self.dtype)
+        self.put(missing, pages, device)
+        return len(missing)
+
+    def evict_to_store(self, store, keys, sync=True):
+        """Spill resident ``keys`` to the host store and release their
+        pool slots (the pool's analogue of the server's DRAM→SSD spill).
+        Store dedup is first-writer-wins, so re-evicting a key the store
+        already holds is a no-op there but still frees the slot here.
+        Returns the number spilled."""
+        present = [k for k in keys if k in self.directory]
+        if not present:
+            return 0
+        pages = self.get(present)
+        store.put_kv_pages(present, pages, sync=sync)
+        self.drop(present)
+        return len(present)
 
     # -- the ICI handoff ------------------------------------------------
 

@@ -179,6 +179,34 @@ class TpuKVStore:
             buf.view(dtype).reshape(n, *page_shape), device
         )
 
+    def get_kv_pages_host(self, keys, page_shape, dtype):
+        """Fetch pages as a host numpy array ([len(keys), *page_shape]),
+        no device transfer: one copy out of the pinned pool (SHM) or the
+        socket scatter (STREAM). For consumers that stage placement
+        themselves (e.g. IciKVPool injection)."""
+        dtype = np.dtype(dtype)
+        page_elems = int(np.prod(page_shape))
+        page_bytes = page_elems * dtype.itemsize
+        n = len(keys)
+        if n == 0:
+            return np.zeros((0, *page_shape), dtype=dtype)
+        if self.conn.shm_connected:
+            lease, blocks = self.conn.pin(keys)
+            try:
+                stacked = self._pool_batch_view(
+                    blocks, n, page_bytes, dtype, page_shape
+                )
+                out = np.array(stacked, copy=True)  # own bytes pre-release
+            finally:
+                self.conn.release(lease)
+            return out
+        buf = np.empty(n * page_bytes, dtype=np.uint8)
+        self.conn.read_cache(
+            buf, [(k, i * page_bytes) for i, k in enumerate(keys)], page_bytes
+        )
+        self.conn.sync()
+        return buf.view(dtype).reshape(n, *page_shape)
+
     # -- quantized paged KV (int8 + per-token-per-head scales) ----------
 
     def put_kv_pages_quantized(self, keys, pages, sync=False):

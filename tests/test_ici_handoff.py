@@ -156,3 +156,42 @@ def test_xfer_executable_reuse(pool_mesh):
         pool.put([k], pages_for(rng, 1), device=0)
         pool.handoff({k: 4})
     assert len(pool._xfer_cache) == 1
+
+
+def test_store_pool_tiering(pool_mesh, shm_conn, rng):
+    """VERDICT round-2 item 4: the pool composes with the host store —
+    miss → fetch_from_store → handoff → readback bit-exact, and
+    evict_to_store spills pages back out to the store."""
+    from infinistore_tpu.tpu import TpuKVStore
+
+    store = TpuKVStore(shm_conn)
+    pool = make_pool(pool_mesh, slots=4)
+    keys = [f"tier_{i}" for i in range(3)]
+    pages = rng.standard_normal((3, *PAGE)).astype(np.float32)
+    # Pages live only in the host store (a different host prefilled them).
+    store.put_kv_pages(keys, pages, sync=True)
+    assert pool.match_last_index(keys) == -1  # pool miss
+
+    # Miss path: store → pool on device 1, then ICI handoff to device 5.
+    assert pool.fetch_from_store(store, keys, device=1) == 3
+    assert pool.fetch_from_store(store, keys, device=1) == 0  # resident now
+    assert pool.match_last_index(keys) == 2
+    pool.handoff({k: 5 for k in keys})
+    got = np.asarray(pool.get(keys))
+    assert np.array_equal(got, pages)
+    assert all(pool.device_of(k) == 5 for k in keys)
+
+    # Evict path: pool → store under fresh keys, slots freed, store holds
+    # the exact bytes.
+    ekeys = [f"tier_evict_{i}" for i in range(3)]
+    epages = rng.standard_normal((3, *PAGE)).astype(np.float32)
+    pool.put(ekeys, epages, device=2)
+    assert pool.evict_to_store(store, ekeys) == 3
+    assert pool.match_last_index(ekeys) == -1
+    assert pool.free_slots(2) == 4
+    back = np.asarray(store.get_kv_pages(ekeys, PAGE, np.float32))
+    assert np.array_equal(back, epages)
+
+    # Round-trip: evicted pages can be fetched back on a miss.
+    assert pool.fetch_from_store(store, ekeys, device=7) == 3
+    assert np.array_equal(np.asarray(pool.get(ekeys)), epages)
