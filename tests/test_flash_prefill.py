@@ -121,3 +121,35 @@ def test_gradients_through_kernel_path():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
         )
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_flash_backward_matches_xla_grads(case):
+    """The recompute-based O(S) pallas backward (VERDICT round-2 item 5)
+    must reproduce XLA-vjp gradients across MHA/GQA, padded seq/hd,
+    bf16, and non-causal."""
+    from infinistore_tpu.ops.pallas_flash_attention import _flash_with_vjp
+
+    B, S, H, KV, D, dtype, causal = case
+    rng = np.random.default_rng(13)
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), dtype)
+    k = jnp.asarray(rng.standard_normal((B, S, KV, D)), dtype)
+    v = jnp.asarray(rng.standard_normal((B, S, KV, D)), dtype)
+    # A non-uniform cotangent (weights) catches transposition mistakes a
+    # plain sum() would miss.
+    w = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+
+    def loss_kernel(q, k, v):
+        return jnp.sum(_flash_with_vjp(q, k, v, causal, True) * w)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(prefill_attention(q, k, v, causal=causal) * w)
+
+    gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    tol = 2e-1 if dtype == jnp.bfloat16 else 1e-3
+    for name, a, b in zip("qkv", gk, gx):
+        err = float(
+            np.abs(np.asarray(a, np.float64) - np.asarray(b, np.float64)).max()
+        )
+        assert err < tol, (case, name, err)
