@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Build an installable wheel + smoke-test it in a clean venv (reference
+# /root/reference/build_manylinux_wheels.sh parity).
+#
+# The reference builds cp310-312 manylinux wheels in a docker image and
+# auditwheel-excludes libibverbs. Here there is one native artifact —
+# libinfinistore_tpu.so, self-contained but for libc/libstdc++/librt —
+# shipped as package data (the Python side binds via ctypes, so the
+# wheel is pure-python-tagged and works across CPython versions; no
+# per-ABI builds needed). Without network/docker, "manylinux" auditing
+# is out of scope; the smoke test proves the wheel installs and serves.
+set -e
+cd "$(dirname "$0")"
+
+rm -rf build dist infinistore_tpu.egg-info
+python setup.py -q bdist_wheel
+echo "built: $(ls dist/*.whl)"
+
+# --- smoke test: install into a clean venv and run the selftest ---
+# Dependencies (numpy) come from the invoking environment via a .pth
+# bridge — there is no network in this environment; the package under
+# test still comes only from the wheel.
+SMOKE_DIR="$(mktemp -d)"
+trap 'rm -rf "$SMOKE_DIR"' EXIT
+python -m venv "$SMOKE_DIR/venv"
+host_site="$(python -c 'import numpy, os; print(os.path.dirname(os.path.dirname(numpy.__file__)))')"
+venv_site="$("$SMOKE_DIR/venv/bin/python" -c 'import site; print(site.getsitepackages()[0])')"
+echo "$host_site" > "$venv_site/host-deps.pth"
+"$SMOKE_DIR/venv/bin/pip" install -q --no-deps --no-index dist/*.whl
+cd "$SMOKE_DIR"  # off the repo tree: the wheel must stand alone
+out="$("$SMOKE_DIR/venv/bin/infinistore-tpu" --selftest)"
+echo "wheel smoke: $out"
+echo "$out" | grep -q '"selftest": true'
+echo "wheel OK"

@@ -19,7 +19,12 @@ import threading
 import numpy as np
 
 _LIB_DIR = os.path.join(os.path.dirname(__file__), "_native")
-_LIB_PATH = os.path.join(_LIB_DIR, "libinfinistore_tpu.so")
+# Overridable so sanitizer builds (libinfinistore_tpu_{tsan,asan}.so,
+# `make -C native tsan|asan`) can be loaded into the same test suite.
+_LIB_PATH = os.environ.get(
+    "INFINISTORE_TPU_NATIVE_LIB",
+    os.path.join(_LIB_DIR, "libinfinistore_tpu.so"),
+)
 _NATIVE_SRC = os.path.join(os.path.dirname(__file__), "..", "native")
 
 # numpy view of istpu::RemoteBlock (native/src/common.h).
@@ -216,6 +221,15 @@ def get_lib():
         if _lib is not None:
             return _lib
         if not os.path.exists(_LIB_PATH):
+            if "INFINISTORE_TPU_NATIVE_LIB" in os.environ:
+                # An explicit override names a specific build variant;
+                # auto-building would produce the DEFAULT library and
+                # still fail — fail fast with the actionable cause.
+                raise RuntimeError(
+                    f"INFINISTORE_TPU_NATIVE_LIB points at {_LIB_PATH}, "
+                    "which does not exist (build it first, e.g. "
+                    "`make -C native tsan|asan`)"
+                )
             _build_native()
         lib = ct.CDLL(_LIB_PATH)
         _decls(lib)

@@ -277,11 +277,30 @@ def parse_args(argv=None):
     p.add_argument("--warmup", action="store_true",
                    help="run a warmup round-trip after startup")
     p.add_argument("--no-oom-protect", action="store_true")
+    p.add_argument("--selftest", action="store_true",
+                   help="start an ephemeral server, run the loopback "
+                        "write/read self-test, print the result and exit "
+                        "(the installed-artifact smoke check; service "
+                        "equivalent: POST /selftest/{port})")
     return p.parse_args(argv)
 
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.selftest:
+        config = ServerConfig(
+            host="127.0.0.1", service_port=0, log_level=args.log_level,
+            prealloc_size=min(args.prealloc_size, 0.0625),
+            minimal_allocate_size=args.minimal_allocate_size,
+        )
+        server = InfiniStoreServer(config)
+        server.start()
+        try:
+            ok = _selftest(server.service_port)
+        finally:
+            server.stop()
+        print(json.dumps({"selftest": bool(ok)}))
+        return 0 if ok else 1
     config = ServerConfig(
         host=args.host,
         service_port=args.service_port,

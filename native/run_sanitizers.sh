@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# Run the native-heavy loopback test suite under TSAN and ASAN.
+#
+# The reference ships no sanitizer coverage (SURVEY.md §5: "no TSAN/ASAN
+# flags"); this closes that gap where it pays most — the client IO
+# thread vs caller-thread paths (hard_fail vs scatter, abandonment-safe
+# PIN, overflow-queue drain) and the server's connection teardown.
+#
+# Each sanitizer gets its own .so (make -C native tsan|asan), loaded via
+# INFINISTORE_TPU_NATIVE_LIB with the matching runtime LD_PRELOADed so
+# the interceptors initialize before Python dlopens the library.
+set -u
+cd "$(dirname "$0")/.."
+
+# Native-heavy loopback subset: drives every client/server thread
+# interaction without jax (sanitized runs are 5-20x slower; the jax/ops
+# tests exercise no native code).
+TESTS="tests/test_store_loopback.py tests/test_safety.py \
+tests/test_backpressure.py tests/test_reconnect.py tests/test_async.py \
+tests/test_put_op.py tests/test_put_oom.py tests/test_multiprocess.py \
+tests/test_eviction.py tests/test_ssd_tier.py"
+
+TSAN_RT="$(gcc -print-file-name=libtsan.so.2)"
+ASAN_RT="$(gcc -print-file-name=libasan.so.8)"
+[ -f "$TSAN_RT" ] || TSAN_RT=/lib/x86_64-linux-gnu/libtsan.so.2
+[ -f "$ASAN_RT" ] || ASAN_RT=/lib/x86_64-linux-gnu/libasan.so.8
+
+fail=0
+
+echo "=== building sanitizer libraries ==="
+make -C native tsan asan -j4 || exit 1
+
+echo "=== TSAN: $TESTS ==="
+# suppressions: the Python runtime itself is uninstrumented; TSAN only
+# sees our .so, so reports name istpu symbols when real.
+if ! LD_PRELOAD="$TSAN_RT" \
+   TSAN_OPTIONS="halt_on_error=0 exitcode=66 suppressions=$PWD/native/tsan.supp" \
+   INFINISTORE_TPU_NATIVE_LIB="$PWD/native/build/libinfinistore_tpu_tsan.so" \
+   python -m pytest $TESTS -x -q; then
+    echo "TSAN RUN FAILED"
+    fail=1
+fi
+
+echo "=== ASAN: $TESTS ==="
+# detect_leaks=0: CPython intentionally leaks interned objects at exit;
+# leak checking an embedded interpreter is all noise.
+if ! LD_PRELOAD="$ASAN_RT" \
+   ASAN_OPTIONS="detect_leaks=0 abort_on_error=1" \
+   INFINISTORE_TPU_NATIVE_LIB="$PWD/native/build/libinfinistore_tpu_asan.so" \
+   python -m pytest $TESTS -x -q; then
+    echo "ASAN RUN FAILED"
+    fail=1
+fi
+
+if [ "$fail" = 0 ]; then
+    echo "sanitizers: ALL CLEAN"
+fi
+exit $fail

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# One-command test entry (reference /root/reference/run_test.sh parity).
+# Builds the native library and runs the full hardware-free suite —
+# loopback servers on ephemeral ports, both data paths, and jax pinned
+# to a virtual 8-device CPU mesh by tests/conftest.py.
+set -e
+cd "$(dirname "$0")"
+make -C native
+exec python -m pytest tests/ -q "$@"
