@@ -269,3 +269,48 @@ def test_large_transfer(conn, rng):
     conn.read_cache(dst, [(k, i * page) for i, k in enumerate(keys)], page)
     conn.sync()
     assert np.array_equal(src, dst)
+
+
+def test_4kb_block_granularity_roundtrip():
+    """4 KB pool blocks (below the reference's 16 KB floor, config.py
+    rationale): batch allocations land contiguously, and data still
+    round-trips bit-exact on both paths."""
+    from infinistore_tpu import InfiniStoreServer, ServerConfig
+
+    srv = InfiniStoreServer(
+        ServerConfig(service_port=0, prealloc_size=0.03125,
+                     minimal_allocate_size=4)
+    )
+    port = srv.start()
+    try:
+        for ctype in (TYPE_SHM, TYPE_STREAM):
+            conn = InfinityConnection(
+                ClientConfig(host_addr="127.0.0.1", service_port=port,
+                             connection_type=ctype)
+            )
+            conn.connect()
+            try:
+                n, page = 64, 4096
+                # Every page distinct (rng bytes): with contiguous 4 KB
+                # allocations, key->block MISROUTING is exactly the bug
+                # class to catch — identical pages would mask it.
+                src = np.random.default_rng(9).integers(
+                    0, 255, n * page, dtype=np.uint8
+                )
+                keys = [f"g4_{ctype}_{i}" for i in range(n)]
+                blocks = conn.allocate(keys, page)
+                assert int(blocks["size"][0]) == 4096  # no 16 KB round-up
+                conn.write_cache(
+                    src, [i * page for i in range(n)], page, blocks
+                )
+                conn.sync()
+                dst = np.zeros_like(src)
+                conn.read_cache(
+                    dst, [(k, i * page) for i, k in enumerate(keys)], page
+                )
+                conn.sync()
+                assert np.array_equal(src, dst)
+            finally:
+                conn.close()
+    finally:
+        srv.stop()
