@@ -1,0 +1,250 @@
+"""Mixtral-style sparse-MoE decoder — second model family, and the
+expert-parallel (ep) consumer of the store.
+
+The reference ships no models (its scope is the KV pool; SURVEY.md §2);
+this family exists so the TPU engine side of the stack exercises expert
+parallelism end-to-end: MoE KV pages are identical store blocks (the
+attention stack is the same GQA+RoPE design as models/llama.py and pages
+out through the same kv_to_pages/page_keys helpers), while the FFN is a
+top-k routed expert layer whose experts shard over a mesh "ep" axis.
+
+TPU-first routing (GShard dense-dispatch formulation): routing is
+expressed entirely as static-shape einsums — a [tokens, experts,
+capacity] one-hot dispatch tensor scatters tokens to per-expert slots,
+experts run as ONE batched [E, C, d] x [E, d, ff] matmul on the MXU, and
+a combine einsum gathers weighted outputs back. No gather/scatter with
+dynamic shapes, no per-expert Python loops; with the expert dimension
+sharded P("ep"), XLA partitions the expert matmuls across chips and
+inserts the dispatch/combine collectives itself (the scaling-book
+recipe: annotate shardings, let the compiler place all-to-alls).
+Over-capacity tokens are dropped (standard switch/GShard semantics) and
+a load-balance auxiliary loss keeps the router spread.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import llama as _llama
+from .llama import rms_norm
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 512
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    d_ff: int = 256          # per-expert hidden size
+    n_experts: int = 4
+    top_k: int = 2
+    capacity_factor: float = 1.5
+    max_seq: int = 256
+    page_size: int = 16
+    rope_theta: float = 10000.0
+    dtype: str = "bfloat16"
+    aux_loss_weight: float = 0.01
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+    def kv_page_shape(self):
+        return (self.page_size, self.n_kv_heads, self.head_dim)
+
+    def capacity(self, n_tokens):
+        """Per-expert token slots: ceil(top_k * T / E * factor), rounded
+        up to 8 (sublane tile) so the expert batch stays MXU-friendly."""
+        c = int(np.ceil(self.top_k * n_tokens / self.n_experts
+                        * self.capacity_factor))
+        return max(8, -(-c // 8) * 8)
+
+
+def init_params(rng, cfg: MoEConfig):
+    """Plain-dict pytree. Attention leaves reuse the llama naming (the
+    tp sharding rules in parallel/mesh.py apply unchanged); expert
+    weights are stacked on a leading E axis for the ep sharding."""
+    dt = cfg.jdtype
+    keys = jax.random.split(rng, 2 + cfg.n_layers)
+    scale = cfg.d_model ** -0.5
+
+    def dense(k, shape):
+        return (jax.random.normal(k, shape) * scale).astype(dt)
+
+    layers = []
+    for li in range(cfg.n_layers):
+        k = jax.random.split(keys[2 + li], 9)
+        layers.append(
+            {
+                "ln1": jnp.ones(cfg.d_model, dtype=dt),
+                "wq": dense(k[0], (cfg.d_model, cfg.n_heads * cfg.head_dim)),
+                "wk": dense(k[1], (cfg.d_model, cfg.n_kv_heads * cfg.head_dim)),
+                "wv": dense(k[2], (cfg.d_model, cfg.n_kv_heads * cfg.head_dim)),
+                "wo": dense(k[3], (cfg.n_heads * cfg.head_dim, cfg.d_model)),
+                "ln2": jnp.ones(cfg.d_model, dtype=dt),
+                # Router in fp32: tiny, and routing decisions should not
+                # quantize with the bf16 params.
+                "router": (jax.random.normal(
+                    k[4], (cfg.d_model, cfg.n_experts)) * scale
+                ).astype(jnp.float32),
+                "e_gate": dense(k[5], (cfg.n_experts, cfg.d_model, cfg.d_ff)),
+                "e_up": dense(k[6], (cfg.n_experts, cfg.d_model, cfg.d_ff)),
+                "e_down": dense(k[7], (cfg.n_experts, cfg.d_ff, cfg.d_model)),
+            }
+        )
+    return {
+        "embed": dense(keys[0], (cfg.vocab_size, cfg.d_model)),
+        "layers": layers,
+        "final_ln": jnp.ones(cfg.d_model, dtype=dt),
+        "lm_head": dense(keys[1], (cfg.d_model, cfg.vocab_size)),
+    }
+
+
+def _route(layer, h, cfg: MoEConfig):
+    """Top-k routing → static dispatch/combine tensors + aux loss.
+
+    h: [T, d]. Returns (dispatch [T, E, C] bool-ish, combine [T, E, C]
+    fp32, aux_loss scalar).
+    """
+    T = h.shape[0]
+    E = cfg.n_experts
+    C = cfg.capacity(T)
+    logits = h.astype(jnp.float32) @ layer["router"]  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_w, top_idx = jax.lax.top_k(probs, cfg.top_k)  # [T, k]
+    # Renormalize the selected gates (Mixtral convention).
+    top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
+
+    # mask[t, e] = gate weight if e selected for t else 0.
+    sel = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)  # [T, k, E]
+    gates = jnp.einsum("tk,tke->te", top_w, sel)
+    chosen = jnp.sum(sel, axis=1)  # [T, E] in {0, 1}
+
+    # Position of each token within its expert's slot list — cumsum over
+    # tokens (static shape; earlier tokens win slots, later ones drop).
+    pos = jnp.cumsum(chosen, axis=0) - chosen  # [T, E], pos of t in e
+    keep = chosen * (pos < C)
+    dispatch = keep[..., None] * jax.nn.one_hot(
+        pos.astype(jnp.int32), C, dtype=jnp.float32
+    )
+    combine = dispatch * gates[..., None]  # [T, E, C]
+
+    # Switch-style load-balance loss: E * Σ_e (frac tokens to e) * (mean
+    # router prob of e) — minimized when both are uniform.
+    frac = jnp.mean(chosen, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def _moe_mlp(layer, x, cfg: MoEConfig):
+    """[B, S, d] → [B, S, d] through the routed expert FFN; also returns
+    the layer's aux loss."""
+    b, s, d = x.shape
+    h = rms_norm(x, layer["ln2"]).reshape(b * s, d)
+    dispatch, combine, aux = _route(layer, h, cfg)
+    # Scatter to per-expert slots: ONE einsum, [E, C, d] activations.
+    xe = jnp.einsum("tec,td->ecd", dispatch.astype(h.dtype), h)
+    # Batched expert SwiGLU on the MXU (E stacked matmuls; sharded over
+    # the ep axis when the params carry P("ep", ...) shardings).
+    a = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, layer["e_gate"]))
+    a = a * jnp.einsum("ecd,edf->ecf", xe, layer["e_up"])
+    oe = jnp.einsum("ecf,efd->ecd", a, layer["e_down"])
+    out = jnp.einsum("tec,ecd->td", combine.astype(oe.dtype), oe)
+    return out.reshape(b, s, d), aux
+
+
+def forward_dense(params, cfg: MoEConfig, tokens):
+    """Dense causal forward. tokens: [B, S] int32 → (logits [B, S, V]
+    fp32, per-layer (k, v), total aux loss)."""
+    b, s = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)
+    positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+    kvs = []
+    aux_total = jnp.float32(0)
+    for layer in params["layers"]:
+        q, k, v = _llama._qkv(layer, x, cfg, positions)
+        attn = _llama.flash_prefill(q, k, v, causal=True)
+        x = x + attn.reshape(b, s, -1) @ layer["wo"]
+        moe_out, aux = _moe_mlp(layer, x, cfg)
+        x = x + moe_out
+        kvs.append((k, v))
+        aux_total = aux_total + aux
+    x = rms_norm(x, params["final_ln"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, kvs, aux_total
+
+
+def prefill(params, cfg: MoEConfig, tokens):
+    logits, kvs, _ = forward_dense(params, cfg, tokens)
+    return logits, kvs
+
+
+def loss_fn(params, cfg: MoEConfig, tokens):
+    logits, _, aux = forward_dense(params, cfg, tokens[:, :-1])
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll) + cfg.aux_loss_weight * aux
+
+
+def train_step(params, opt_state, cfg: MoEConfig, tokens, optimizer):
+    loss, grads = jax.value_and_grad(loss_fn)(params, cfg, tokens)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = jax.tree_util.tree_map(
+        lambda p, u: (p + u).astype(p.dtype), params, updates
+    )
+    return params, opt_state, loss
+
+
+# ---------------------------------------------------------------------------
+# Expert-parallel sharding
+# ---------------------------------------------------------------------------
+
+def make_ep_mesh(dp, ep, devices=None):
+    """(dp, ep) mesh: data parallel outer (DCN-friendly), experts inner
+    (the dispatch/combine all-to-alls ride ICI)."""
+    if devices is None:
+        devices = jax.devices()[: dp * ep]
+    arr = np.asarray(devices).reshape(dp, ep)
+    return Mesh(arr, axis_names=("dp", "ep"))
+
+
+_EP_RULES = {
+    # Expert-stacked leaves shard over ep on the E axis; the router must
+    # be replicated (every token routes everywhere).
+    "e_gate": P("ep", None, None),
+    "e_up": P("ep", None, None),
+    "e_down": P("ep", None, None),
+}
+
+
+def param_shardings(mesh: Mesh, params):
+    """NamedShardings: experts over ep, everything else replicated
+    (attention tp can be layered on a third axis in larger meshes)."""
+
+    def spec(path, leaf):
+        name = None
+        for p in reversed(path):
+            key = getattr(p, "key", None) or getattr(p, "name", None)
+            if key is not None:
+                name = str(key)
+                break
+        return NamedSharding(mesh, _EP_RULES.get(name, P()))
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+__all__ = [
+    "MoEConfig", "init_params", "forward_dense", "prefill", "loss_fn",
+    "train_step", "make_ep_mesh", "param_shardings",
+]
