@@ -198,24 +198,32 @@ def decode_step(params, cfg: LlamaConfig, token, seq_lens, k_pages, v_pages,
     return logits, jnp.stack(new_k_pages), jnp.stack(new_v_pages)
 
 
-def loss_fn(params, cfg: LlamaConfig, tokens):
-    """Next-token cross-entropy (fp32 accumulation)."""
-    logits, _ = forward_dense(params, cfg, tokens[:, :-1])
-    targets = tokens[:, 1:]
+def token_nll(logits, targets):
+    """Mean next-token NLL (fp32 log-softmax) — shared by every model
+    family's loss."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(nll)
 
 
-def train_step(params, opt_state, cfg: LlamaConfig, tokens, optimizer):
+def loss_fn(params, cfg: LlamaConfig, tokens):
+    """Next-token cross-entropy (fp32 accumulation)."""
+    logits, _ = forward_dense(params, cfg, tokens[:, :-1])
+    return token_nll(logits, tokens[:, 1:])
+
+
+def train_step(params, opt_state, cfg, tokens, optimizer, loss=None):
     """One optimizer step (used by the multi-chip dry run; grads average
-    over the dp axis automatically under jit + NamedShardings)."""
-    loss, grads = jax.value_and_grad(loss_fn)(params, cfg, tokens)
+    over the dp axis automatically under jit + NamedShardings). The ONE
+    optimizer-step implementation for all model families — pass `loss`
+    to train a different family (moe.train_step does)."""
+    loss_f = loss_fn if loss is None else loss
+    loss_val, grads = jax.value_and_grad(loss_f)(params, cfg, tokens)
     updates, opt_state = optimizer.update(grads, opt_state, params)
     params = jax.tree_util.tree_map(
         lambda p, u: (p + u).astype(p.dtype), params, updates
     )
-    return params, opt_state, loss
+    return params, opt_state, loss_val
 
 
 # ---------------------------------------------------------------------------

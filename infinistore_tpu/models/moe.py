@@ -22,7 +22,6 @@ a load-balance auxiliary loss keeps the router spread.
 """
 
 from dataclasses import dataclass
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -191,19 +190,15 @@ def prefill(params, cfg: MoEConfig, tokens):
 
 def loss_fn(params, cfg: MoEConfig, tokens):
     logits, _, aux = forward_dense(params, cfg, tokens[:, :-1])
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll) + cfg.aux_loss_weight * aux
+    return (_llama.token_nll(logits, tokens[:, 1:])
+            + cfg.aux_loss_weight * aux)
 
 
 def train_step(params, opt_state, cfg: MoEConfig, tokens, optimizer):
-    loss, grads = jax.value_and_grad(loss_fn)(params, cfg, tokens)
-    updates, opt_state = optimizer.update(grads, opt_state, params)
-    params = jax.tree_util.tree_map(
-        lambda p, u: (p + u).astype(p.dtype), params, updates
+    # The shared optimizer step with this family's loss plugged in.
+    return _llama.train_step(
+        params, opt_state, cfg, tokens, optimizer, loss=loss_fn
     )
-    return params, opt_state, loss
 
 
 # ---------------------------------------------------------------------------

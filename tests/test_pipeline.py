@@ -1,0 +1,97 @@
+"""Pipeline-parallelism tests (8-device virtual CPU mesh)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from infinistore_tpu.parallel.pipeline import (
+    make_pp_mesh,
+    pipeline_apply,
+    stack_stage_params,
+    stage_shardings,
+)
+
+
+def stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def make_stages(rng, n_stages, d):
+    ks = jax.random.split(rng, n_stages)
+    return [
+        {
+            "w": jax.random.normal(k, (d, d)) / np.sqrt(d),
+            "b": jnp.zeros((d,)),
+        }
+        for k in ks
+    ]
+
+
+def sequential_reference(stages, x_micro):
+    out = []
+    for x in x_micro:
+        for p in stages:
+            x = stage_fn(p, x)
+        out.append(x)
+    return jnp.stack(out)
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(4, 8), (8, 8), (2, 3)])
+def test_pipeline_matches_sequential(n_stages, n_micro):
+    assert len(jax.devices()) >= n_stages
+    d, mb = 16, 4
+    stages = make_stages(jax.random.PRNGKey(0), n_stages, d)
+    x = jax.random.normal(jax.random.PRNGKey(1), (n_micro, mb, d))
+
+    mesh = make_pp_mesh(n_stages)
+    stacked = stack_stage_params(stages)
+    stacked = jax.device_put(stacked, stage_shardings(mesh, stacked))
+    got = jax.jit(
+        lambda p, x: pipeline_apply(stage_fn, p, x, mesh)
+    )(stacked, x)
+    ref = sequential_reference(stages, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_is_differentiable():
+    """jax must differentiate straight through the scan+ppermute
+    schedule; grads match the sequential reference."""
+    n_stages, n_micro, d, mb = 4, 6, 8, 2
+    stages = make_stages(jax.random.PRNGKey(2), n_stages, d)
+    x = jax.random.normal(jax.random.PRNGKey(3), (n_micro, mb, d))
+    mesh = make_pp_mesh(n_stages)
+    stacked = stack_stage_params(stages)
+
+    def loss_pp(p):
+        return jnp.sum(pipeline_apply(stage_fn, p, x, mesh) ** 2)
+
+    def loss_ref(p):
+        unstacked = [
+            jax.tree_util.tree_map(lambda l: l[i], p)
+            for i in range(n_stages)
+        ]
+        return jnp.sum(sequential_reference(unstacked, x) ** 2)
+
+    g_pp = jax.jit(jax.grad(loss_pp))(stacked)
+    g_ref = jax.grad(loss_ref)(stacked)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pp),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_bubble_schedule_length():
+    """The schedule is n_micro + S - 1 ticks — pin the bank/emit indexing
+    at the boundary (n_micro < S, the worst bubble case)."""
+    n_stages, n_micro, d, mb = 4, 2, 8, 2
+    stages = make_stages(jax.random.PRNGKey(4), n_stages, d)
+    x = jax.random.normal(jax.random.PRNGKey(5), (n_micro, mb, d))
+    mesh = make_pp_mesh(n_stages)
+    stacked = stack_stage_params(stages)
+    got = pipeline_apply(stage_fn, stacked, x, mesh)
+    ref = sequential_reference(stages, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
