@@ -91,6 +91,8 @@ def _decls(lib):
         ("ist_server_kvmap_len", c.c_uint64, [c.c_void_p]),
         ("ist_server_purge", c.c_uint64, [c.c_void_p]),
         ("ist_server_stats", c.c_int, [c.c_void_p, c.c_char_p, c.c_int]),
+        ("ist_server_snapshot", c.c_longlong, [c.c_void_p, c.c_char_p]),
+        ("ist_server_restore", c.c_longlong, [c.c_void_p, c.c_char_p]),
         ("ist_server_shm_prefix", c.c_int, [c.c_void_p, c.c_char_p, c.c_int]),
         # client
         (

@@ -81,6 +81,25 @@ class InfiniStoreServer:
         self._lib.ist_server_stats(self._h, buf, len(buf))
         return json.loads(buf.value.decode())
 
+    def snapshot(self, path):
+        """Write every committed entry to ``path`` (atomic tmp+rename).
+        Returns the entry count; raises on IO failure. Beyond reference
+        parity — the reference's store is volatile (restart ⇒ cache
+        cold, SURVEY.md §5)."""
+        n = int(self._lib.ist_server_snapshot(self._h, path.encode()))
+        if n < 0:
+            raise Exception(f"snapshot to {path} failed")
+        return n
+
+    def restore(self, path):
+        """Load a snapshot (existing keys win; stops when the pool is
+        full, keeping what fits). Returns entries loaded; raises on a
+        missing/corrupt file."""
+        n = int(self._lib.ist_server_restore(self._h, path.encode()))
+        if n < 0:
+            raise Exception(f"restore from {path} failed")
+        return n
+
     def __enter__(self):
         self.start()
         return self
@@ -173,7 +192,7 @@ def _prometheus_metrics(stats):
     return "\n".join(lines) + "\n"
 
 
-def make_control_plane(server: InfiniStoreServer):
+def make_control_plane(server: InfiniStoreServer, snapshot_path=None):
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code, payload):
             body = json.dumps(payload).encode()
@@ -221,6 +240,18 @@ def make_control_plane(server: InfiniStoreServer):
                     self._send(200 if ok else 500, {"selftest": ok})
                 except Exception as e:  # pragma: no cover - error path
                     self._send(500, {"selftest": False, "error": str(e)})
+            elif self.path == "/snapshot":
+                if not snapshot_path:
+                    self._send(
+                        400, {"error": "server started without "
+                                       "--snapshot-path"}
+                    )
+                    return
+                try:
+                    n = server.snapshot(snapshot_path)
+                    self._send(200, {"snapshot": n, "path": snapshot_path})
+                except Exception as e:
+                    self._send(500, {"error": str(e)})
             else:
                 self._send(404, {"error": "not found"})
 
@@ -276,6 +307,10 @@ def parse_args(argv=None):
                         "(retryable)")
     p.add_argument("--warmup", action="store_true",
                    help="run a warmup round-trip after startup")
+    p.add_argument("--snapshot-path", default="",
+                   help="snapshot file for warm restarts: loaded at "
+                        "startup when present, written by POST "
+                        "/snapshot and on SIGINT/SIGTERM shutdown")
     p.add_argument("--no-oom-protect", action="store_true")
     p.add_argument("--selftest", action="store_true",
                    help="start an ephemeral server, run the loopback "
@@ -320,6 +355,23 @@ def main(argv=None):
     server.start()
     Logger.info(f"service on :{server.service_port}")
 
+    if args.snapshot_path:
+        import os
+
+        if os.path.exists(args.snapshot_path):
+            # A corrupt snapshot degrades to a COLD start, never a boot
+            # failure (a supervisor would otherwise crash-loop on it).
+            try:
+                n = server.restore(args.snapshot_path)
+                Logger.info(
+                    f"restored {n} entries from {args.snapshot_path} "
+                    "(warm start)"
+                )
+            except Exception as e:
+                Logger.warning(
+                    f"snapshot restore failed ({e}); starting cold"
+                )
+
     if not args.no_oom_protect:
         prevent_oom()
     if args.warmup:
@@ -330,7 +382,7 @@ def main(argv=None):
              "--service-port", str(server.service_port)]
         )
 
-    httpd = make_control_plane(server)
+    httpd = make_control_plane(server, snapshot_path=args.snapshot_path)
     Logger.info(f"manage plane on :{config.manage_port}")
 
     stop = threading.Event()
@@ -345,6 +397,14 @@ def main(argv=None):
         httpd.serve_forever()
     finally:
         httpd.server_close()
+        if args.snapshot_path:
+            try:
+                n = server.snapshot(args.snapshot_path)
+                Logger.info(
+                    f"snapshotted {n} entries to {args.snapshot_path}"
+                )
+            except Exception as e:
+                Logger.warning(f"shutdown snapshot failed: {e}")
         server.stop()
     return 0
 

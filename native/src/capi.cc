@@ -109,6 +109,26 @@ int ist_server_stats(void* h, char* buf, int cap) {
     return n;
 }
 
+// Snapshot / restore the committed store (warm restarts — the
+// reference's store is volatile). Return entry count, -1 on error.
+long long ist_server_snapshot(void* h, const char* path) {
+    if (h == nullptr || path == nullptr) return -1;
+    try {
+        return static_cast<Server*>(h)->snapshot(path);
+    } catch (...) {  // no exception may cross the C ABI
+        return -1;
+    }
+}
+
+long long ist_server_restore(void* h, const char* path) {
+    if (h == nullptr || path == nullptr) return -1;
+    try {
+        return static_cast<Server*>(h)->restore(path);
+    } catch (...) {
+        return -1;
+    }
+}
+
 int ist_server_shm_prefix(void* h, char* buf, int cap) {
     const std::string& s = static_cast<Server*>(h)->shm_prefix();
     int n = int(s.size());

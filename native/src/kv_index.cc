@@ -1,5 +1,6 @@
 #include "kv_index.h"
 
+#include <cstring>
 #include <unordered_set>
 
 #include "log.h"
@@ -205,6 +206,41 @@ uint64_t KVIndex::pin(std::vector<BlockRef> blocks) {
 }
 
 bool KVIndex::release(uint64_t lease_id) { return leases_.erase(lease_id) > 0; }
+
+std::vector<KVIndex::SnapshotItem> KVIndex::snapshot_items() const {
+    std::vector<SnapshotItem> out;
+    out.reserve(map_.size());
+    for (const auto& [key, e] : map_) {
+        if (!e.committed) continue;
+        SnapshotItem it;
+        it.key = key;
+        it.block = e.block;
+        it.disk = e.disk;
+        it.heap = e.heap;
+        it.size = e.size;
+        if (it.block || it.disk || it.heap) out.push_back(std::move(it));
+    }
+    return out;
+}
+
+Status KVIndex::insert_committed(const std::string& key, const uint8_t* data,
+                                 uint32_t size) {
+    auto [mit, inserted] = map_.try_emplace(key);
+    if (!inserted) return CONFLICT;  // live data beats snapshot data
+    PoolLoc loc;
+    if (!mm_->allocate(size, &loc)) {  // no evict_lru: see header contract
+        map_.erase(mit);
+        return OUT_OF_MEMORY;
+    }
+    memcpy(loc.ptr, data, size);
+    Entry e;
+    e.block = std::make_shared<Block>(mm_, loc, size);
+    e.size = size;
+    e.committed = true;
+    mit->second = std::move(e);
+    if (track_lru()) lru_touch(mit->second, key);
+    return OK;
+}
 
 size_t KVIndex::purge() {
     size_t n = map_.size();

@@ -25,6 +25,7 @@
 #pragma once
 
 #include <cstdint>
+#include <functional>
 #include <list>
 #include <memory>
 #include <string>
@@ -149,6 +150,30 @@ class KVIndex {
     // Pin committed blocks for one-sided SHM reads; returns lease id.
     uint64_t pin(std::vector<BlockRef> blocks);
     bool release(uint64_t lease_id);
+
+    // One committed entry's refcounted byte handle — snapshot support.
+    // Exactly one of block/heap/disk is set; the shared_ptrs keep the
+    // bytes alive after the store lock is released, so serialization
+    // never stalls the data plane.
+    struct SnapshotItem {
+        std::string key;
+        BlockRef block;
+        DiskRef disk;
+        std::shared_ptr<std::vector<uint8_t>> heap;
+        uint32_t size = 0;
+    };
+    // Collect handles to every committed entry (cheap: refs only; call
+    // under the store lock, serialize afterwards without it).
+    std::vector<SnapshotItem> snapshot_items() const;
+
+    // Directly insert a COMMITTED entry (snapshot restore): pool
+    // allocate + copy + visible immediately, no token round-trip.
+    // CONFLICT when the key exists (first-writer-wins: live data beats
+    // snapshot data), OUT_OF_MEMORY when the pool cannot hold it.
+    // Never evicts live entries to make room — a restore must not churn
+    // hot data out in favor of stale snapshot data.
+    Status insert_committed(const std::string& key, const uint8_t* data,
+                            uint32_t size);
 
     size_t purge();  // drops all entries; inflight tokens survive harmlessly
     size_t erase(const std::vector<std::string>& keys);

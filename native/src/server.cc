@@ -143,10 +143,11 @@ void Server::stop() {
     if (wake_fd_ >= 0) close(wake_fd_);
     listen_fd_ = epoll_fd_ = wake_fd_ = -1;
     {
-        // Control-plane threads may still be inside kvmap_len/stats;
-        // serialize teardown with them. Order matters: entries reference
-        // the disk tier (DiskSpan) and the pool (Block), so the index
-        // goes first.
+        // Control-plane threads may still be inside kvmap_len/stats or a
+        // snapshot (whose BlockRefs deallocate into mm_); serialize
+        // teardown with both. Order matters: entries reference the disk
+        // tier (DiskSpan) and the pool (Block), so the index goes first.
+        std::lock_guard<std::mutex> slk(snap_mu_);
         std::lock_guard<std::mutex> lk(store_mu_);
         index_.reset();
         disk_.reset();
@@ -162,6 +163,147 @@ size_t Server::kvmap_len() {
 size_t Server::purge() {
     std::lock_guard<std::mutex> lk(store_mu_);
     return index_ ? index_->purge() : 0;
+}
+
+// Snapshot file layout: magic u64, version u32, count u64, then per
+// entry: klen u32, key bytes, size u32, data bytes. Little-endian (the
+// wire protocol's convention); count is rewritten after the walk.
+static constexpr uint64_t SNAP_MAGIC = 0x50414e5355505453ULL;  // "STPUSNAP"
+static constexpr uint32_t SNAP_VERSION = 1;
+
+long long Server::snapshot(const std::string& path) {
+    // snap_mu_ serializes concurrent snapshots (a shared tmp would let
+    // two writers publish an interleaved file) and blocks stop()'s
+    // teardown while the collected refs below are alive.
+    std::lock_guard<std::mutex> snap_lk(snap_mu_);
+    std::vector<KVIndex::SnapshotItem> items;
+    {
+        // Under the store lock: refs only. The file IO below runs
+        // lock-free — the data plane never stalls behind a store-sized
+        // write; the shared_ptrs pin blocks/extents instead.
+        std::lock_guard<std::mutex> lk(store_mu_);
+        if (!index_) return -1;
+        items = index_->snapshot_items();
+    }
+    std::string tmp =
+        path + ".tmp." + std::to_string(getpid());
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (f == nullptr) {
+        IST_WARN("snapshot: cannot open %s: %s", tmp.c_str(),
+                 strerror(errno));
+        return -1;
+    }
+    uint64_t count = uint64_t(items.size());
+    fwrite(&SNAP_MAGIC, sizeof(SNAP_MAGIC), 1, f);
+    fwrite(&SNAP_VERSION, sizeof(SNAP_VERSION), 1, f);
+    fwrite(&count, sizeof(count), 1, f);
+    std::vector<uint8_t> tmpbuf;
+    bool ok = true;
+    for (const auto& it : items) {
+        const uint8_t* p = nullptr;
+        if (it.block) {
+            p = static_cast<const uint8_t*>(it.block->loc.ptr);
+        } else if (it.heap) {
+            p = it.heap->data();
+        } else {  // disk-resident: read back through the tier (pread —
+                  // safe concurrently with the loop's bitmap mutations)
+            tmpbuf.resize(it.size);
+            if (!disk_ || !disk_->load(it.disk->off, tmpbuf.data(),
+                                       it.size)) {
+                ok = false;
+                break;
+            }
+            p = tmpbuf.data();
+        }
+        uint32_t klen = uint32_t(it.key.size());
+        fwrite(&klen, sizeof(klen), 1, f);
+        fwrite(it.key.data(), 1, klen, f);
+        fwrite(&it.size, sizeof(it.size), 1, f);
+        fwrite(p, 1, it.size, f);
+        if (ferror(f) != 0) {
+            ok = false;
+            break;
+        }
+    }
+    // Crash-durable atomic replace: flush to the kernel AND the device
+    // before the rename publishes the file, then persist the directory
+    // entry — fclose alone only reaches the page cache.
+    if (ok) ok = fflush(f) == 0 && fsync(fileno(f)) == 0;
+    if (fclose(f) != 0) ok = false;
+    if (!ok || rename(tmp.c_str(), path.c_str()) != 0) {
+        remove(tmp.c_str());
+        IST_WARN("snapshot to %s failed", path.c_str());
+        return -1;
+    }
+    std::string dir = path;
+    size_t slash = dir.find_last_of('/');
+    dir = slash == std::string::npos ? "." : dir.substr(0, slash);
+    int dfd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+    if (dfd >= 0) {
+        fsync(dfd);
+        close(dfd);
+    }
+    return (long long)count;
+}
+
+long long Server::restore(const std::string& path) {
+    FILE* f = fopen(path.c_str(), "rb");
+    if (f == nullptr) return -1;
+    // File size bounds every length field below: a corrupt count/klen/
+    // size cannot trigger a multi-GB resize/reserve (whose bad_alloc
+    // would otherwise cross the C ABI) — anything larger than the file
+    // itself is corruption by definition.
+    fseek(f, 0, SEEK_END);
+    long fsize_l = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    uint64_t fsize = fsize_l > 0 ? uint64_t(fsize_l) : 0;
+    uint64_t magic = 0;
+    uint32_t version = 0;
+    uint64_t count = 0;
+    long long loaded = -1;
+    if (fread(&magic, sizeof(magic), 1, f) == 1 && magic == SNAP_MAGIC &&
+        fread(&version, sizeof(version), 1, f) == 1 &&
+        version == SNAP_VERSION &&
+        fread(&count, sizeof(count), 1, f) == 1 &&
+        count <= fsize / 8) {  // each entry costs >= 8 header bytes
+        loaded = 0;
+        std::string key;
+        std::vector<uint8_t> data;
+        std::lock_guard<std::mutex> lk(store_mu_);
+        if (index_) index_->reserve(size_t(count));
+        for (uint64_t i = 0; index_ && i < count; ++i) {
+            uint32_t klen = 0, size = 0;
+            if (fread(&klen, sizeof(klen), 1, f) != 1 || klen > fsize) {
+                loaded = -1;
+                break;
+            }
+            key.resize(klen);
+            if (klen && fread(&key[0], 1, klen, f) != klen) {
+                loaded = -1;
+                break;
+            }
+            if (fread(&size, sizeof(size), 1, f) != 1 || size > fsize) {
+                loaded = -1;
+                break;
+            }
+            data.resize(size);
+            if (size && fread(data.data(), 1, size, f) != size) {
+                loaded = -1;
+                break;
+            }
+            Status st = index_->insert_committed(key, data.data(), size);
+            if (st == OK) {
+                loaded++;
+            } else if (st == OUT_OF_MEMORY) {
+                // Pool smaller than the snapshot: keep what fits.
+                IST_WARN("restore: pool full after %lld entries",
+                         loaded);
+                break;
+            }  // CONFLICT: live key wins, skip silently
+        }
+    }
+    fclose(f);
+    return loaded;
 }
 
 std::string Server::stats_json() {

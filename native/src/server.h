@@ -90,6 +90,14 @@ class Server {
     size_t purge();
     std::string stats_json();
 
+    // Snapshot every committed entry to `path` (atomic tmp+rename) /
+    // load a snapshot back (existing keys win; stops at pool-full).
+    // Returns entries written/loaded, -1 on IO/format error. Beyond
+    // reference parity: the reference's store is volatile ("restart =>
+    // cache cold", SURVEY.md §5 checkpoint/resume: none).
+    long long snapshot(const std::string& path);
+    long long restore(const std::string& path);
+
     uint16_t bound_port() const { return bound_port_; }
     const std::string& shm_prefix() const { return cfg_.shm_prefix; }
 
@@ -190,6 +198,11 @@ class Server {
     // infinistore.cpp:1 comment — with a 1-core host the mutex costs
     // nothing and removes the shared-loop coupling).
     std::mutex store_mu_;
+    // Serializes snapshot() calls against each other (two writers would
+    // corrupt the tmp file) and against stop() (a snapshot in flight
+    // holds BlockRefs whose destructors call into mm_; teardown must
+    // wait). Taken BEFORE store_mu_ everywhere.
+    std::mutex snap_mu_;
     std::unique_ptr<MM> mm_;
     std::unique_ptr<DiskTier> disk_;
     std::unique_ptr<KVIndex> index_;

@@ -1,0 +1,217 @@
+"""Store snapshot/restore tests (warm restarts — beyond reference
+parity: the reference's store is volatile, SURVEY.md §5
+checkpoint/resume: none)."""
+
+import os
+import uuid
+
+import numpy as np
+import pytest
+
+from infinistore_tpu import (
+    ClientConfig,
+    InfiniStoreServer,
+    InfinityConnection,
+    ServerConfig,
+)
+
+
+def _server(tmp_path, **kw):
+    cfg = dict(service_port=0, prealloc_size=0.03125,
+               minimal_allocate_size=4)
+    cfg.update(kw)
+    return InfiniStoreServer(ServerConfig(**cfg))
+
+
+def _put(conn, keys, rng, page=4096):
+    data = rng.integers(0, 255, len(keys) * page, dtype=np.uint8)
+    conn.put_cache(data, [(k, i * page) for i, k in enumerate(keys)], page)
+    conn.sync()
+    return data
+
+
+def _read(conn, keys, page=4096):
+    out = np.zeros(len(keys) * page, dtype=np.uint8)
+    conn.read_cache(out, [(k, i * page) for i, k in enumerate(keys)], page)
+    conn.sync()
+    return out
+
+
+def test_snapshot_restore_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    snap = str(tmp_path / "store.snap")
+    keys = [f"sn_{i}" for i in range(32)]
+
+    srv = _server(tmp_path)
+    port = srv.start()
+    conn = InfinityConnection(
+        ClientConfig(host_addr="127.0.0.1", service_port=port)
+    )
+    conn.connect()
+    data = _put(conn, keys, rng)
+    n = srv.snapshot(snap)
+    assert n == 32
+    conn.close()
+    srv.stop()  # cold stop: DRAM store gone
+
+    # Fresh server process-equivalent: restore brings the cache back warm.
+    srv2 = _server(tmp_path)
+    port2 = srv2.start()
+    assert srv2.kvmap_len() == 0
+    assert srv2.restore(snap) == 32
+    assert srv2.kvmap_len() == 32
+    conn2 = InfinityConnection(
+        ClientConfig(host_addr="127.0.0.1", service_port=port2)
+    )
+    conn2.connect()
+    assert np.array_equal(_read(conn2, keys), data)
+    assert conn2.get_match_last_index(keys) == len(keys) - 1
+    conn2.close()
+    srv2.stop()
+
+
+def test_restore_existing_keys_win(tmp_path):
+    """First-writer-wins extends to snapshots: live entries beat
+    snapshot entries for the same key."""
+    rng = np.random.default_rng(1)
+    snap = str(tmp_path / "store.snap")
+    srv = _server(tmp_path)
+    port = srv.start()
+    conn = InfinityConnection(
+        ClientConfig(host_addr="127.0.0.1", service_port=port)
+    )
+    conn.connect()
+    old = _put(conn, ["dup_key"], rng)
+    srv.snapshot(snap)
+    srv.purge()
+    new = _put(conn, ["dup_key"], rng)  # different bytes, same key
+    loaded = srv.restore(snap)
+    assert loaded == 0  # key exists — snapshot entry skipped
+    assert np.array_equal(_read(conn, ["dup_key"]), new)
+    assert not np.array_equal(old, new)
+    conn.close()
+    srv.stop()
+
+
+def test_restore_partial_on_small_pool(tmp_path):
+    """A pool smaller than the snapshot keeps what fits (no error, no
+    partial entries)."""
+    rng = np.random.default_rng(2)
+    snap = str(tmp_path / "store.snap")
+    srv = _server(tmp_path, prealloc_size=0.03125)
+    port = srv.start()
+    conn = InfinityConnection(
+        ClientConfig(host_addr="127.0.0.1", service_port=port)
+    )
+    conn.connect()
+    keys = [f"big_{i}" for i in range(28)]
+    _put(conn, keys, rng, page=1 << 20)  # 28 MB of a 32 MB pool
+    assert srv.snapshot(snap) == 28
+    conn.close()
+    srv.stop()
+
+    tiny = _server(tmp_path, prealloc_size=0.0078125)  # 8 MB pool
+    tiny.start()
+    loaded = tiny.restore(snap)
+    assert 0 < loaded < 28
+    assert tiny.kvmap_len() == loaded
+    tiny.stop()
+
+
+def test_restore_rejects_corrupt_file(tmp_path):
+    bad = tmp_path / "bad.snap"
+    bad.write_bytes(b"not a snapshot at all")
+    srv = _server(tmp_path)
+    srv.start()
+    with pytest.raises(Exception, match="restore"):
+        srv.restore(str(bad))
+    srv.stop()
+
+
+def test_snapshot_includes_disk_spilled_entries(tmp_path):
+    """Entries living in the SSD tier at snapshot time are read back
+    through the tier and land in the snapshot too."""
+    rng = np.random.default_rng(3)
+    snap = str(tmp_path / "store.snap")
+    srv = _server(
+        tmp_path, prealloc_size=0.0078125,  # 8 MB pool
+        ssd_path=str(tmp_path), ssd_size=0.03125,
+    )
+    port = srv.start()
+    conn = InfinityConnection(
+        ClientConfig(host_addr="127.0.0.1", service_port=port)
+    )
+    conn.connect()
+    keys = [f"sp_{i}" for i in range(12)]
+    # One page per put: earlier entries are committed (spillable) when
+    # later allocations hit pool pressure — 12 MB through an 8 MB pool.
+    page = 1 << 20
+    data = rng.integers(0, 255, len(keys) * page, dtype=np.uint8)
+    for i, k in enumerate(keys):
+        conn.put_cache(data[i * page:(i + 1) * page], [(k, 0)], page)
+        conn.sync()
+    stats = srv.stats()
+    assert stats["spills"] > 0, stats
+    assert srv.snapshot(snap) == 12
+    conn.close()
+    srv.stop()
+
+    srv2 = _server(tmp_path, prealloc_size=0.03125)
+    port2 = srv2.start()
+    assert srv2.restore(snap) == 12
+    conn2 = InfinityConnection(
+        ClientConfig(host_addr="127.0.0.1", service_port=port2)
+    )
+    conn2.connect()
+    assert np.array_equal(_read(conn2, keys, page=1 << 20), data)
+    conn2.close()
+    srv2.stop()
+
+
+def test_cli_snapshot_warm_start(tmp_path):
+    """The CLI surface: --snapshot-path restores at boot; POST /snapshot
+    writes the file on demand."""
+    import json
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    snap = str(tmp_path / "cli.snap")
+    mport = 18981
+    args = [
+        sys.executable, "-m", "infinistore_tpu.server",
+        "--service-port", "0", "--manage-port", str(mport),
+        "--prealloc-size", "0.03125", "--minimal-allocate-size", "4",
+        "--snapshot-path", snap, "--no-oom-protect",
+    ]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    proc = subprocess.Popen(args, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 20
+        while True:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{mport}/health", timeout=1
+                )
+                break
+            except Exception:
+                assert time.time() < deadline, "server did not come up"
+                time.sleep(0.2)
+        # The data plane port is ephemeral; scrape it via /stats? The
+        # manage plane doesn't expose it — use the snapshot flow only:
+        # write via a second in-process server? Simplest: drive /snapshot
+        # with an empty store and assert the file appears with 0 entries.
+        r = urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{mport}/snapshot", method="POST"
+            ),
+            timeout=10,
+        )
+        body = json.loads(r.read())
+        assert body["snapshot"] == 0 and os.path.exists(snap)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
