@@ -1,0 +1,108 @@
+"""HuggingFace ↔ infinistore_tpu weight bridge for the Llama family.
+
+A user coming from the reference stack serves HF checkpoints; this
+module loads a ``transformers`` Llama (model object or state dict) into
+the JAX model in models/llama.py, so the same weights drive the paged-KV
+engine, the store demos and the benchmarks. The conversion is pure
+layout work: torch ``nn.Linear`` stores [out, in] and computes
+``x @ W.T``, our params store [in, out] and compute ``x @ W`` — so every
+projection transposes; head layouts, the half-split RoPE convention
+(HF ``rotate_half``) and the SwiGLU wiring already agree, which the
+logits-parity test (tests/test_hf_bridge.py) pins numerically against
+``transformers`` itself.
+"""
+
+import numpy as np
+
+from .llama import LlamaConfig
+
+
+def config_from_hf(hf_cfg, page_size=16, dtype="float32"):
+    """Map a ``transformers.LlamaConfig`` onto :class:`LlamaConfig`.
+
+    Raises on checkpoint features the JAX model does not implement —
+    silently dropping them would load without error and diverge from
+    the parity the bridge promises."""
+    scaling = getattr(hf_cfg, "rope_scaling", None)
+    if scaling:
+        raise NotImplementedError(
+            f"rope_scaling={scaling!r} is not supported: our rope() uses "
+            "unscaled theta frequencies, so a Llama-3.1-style scaled "
+            "checkpoint would produce wrong logits at every position"
+        )
+    if getattr(hf_cfg, "attention_bias", False):
+        raise NotImplementedError(
+            "attention_bias=True checkpoints carry q/k/v/o biases the "
+            "JAX model has no slots for"
+        )
+    return LlamaConfig(
+        vocab_size=hf_cfg.vocab_size,
+        d_model=hf_cfg.hidden_size,
+        n_layers=hf_cfg.num_hidden_layers,
+        n_heads=hf_cfg.num_attention_heads,
+        n_kv_heads=hf_cfg.num_key_value_heads,
+        d_ff=hf_cfg.intermediate_size,
+        max_seq=hf_cfg.max_position_embeddings,
+        page_size=page_size,
+        rope_theta=float(hf_cfg.rope_theta),
+        norm_eps=float(hf_cfg.rms_norm_eps),
+        dtype=dtype,
+    )
+
+
+def _t(sd, name, dtype):
+    import jax.numpy as jnp
+
+    w = sd[name]
+    if hasattr(w, "detach"):  # torch tensor
+        w = w.detach().cpu().numpy()
+    return jnp.asarray(np.asarray(w), dtype=dtype)
+
+
+def params_from_hf(model_or_state_dict, cfg: LlamaConfig):
+    """Build the models/llama.py parameter pytree from a HF Llama model
+    (``LlamaForCausalLM``) or its state dict."""
+    sd = model_or_state_dict
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    dt = cfg.jdtype
+    layers = []
+    for li in range(cfg.n_layers):
+        p = f"model.layers.{li}."
+        layers.append(
+            {
+                "ln1": _t(sd, p + "input_layernorm.weight", dt),
+                "wq": _t(sd, p + "self_attn.q_proj.weight", dt).T,
+                "wk": _t(sd, p + "self_attn.k_proj.weight", dt).T,
+                "wv": _t(sd, p + "self_attn.v_proj.weight", dt).T,
+                "wo": _t(sd, p + "self_attn.o_proj.weight", dt).T,
+                "ln2": _t(sd, p + "post_attention_layernorm.weight", dt),
+                "w_gate": _t(sd, p + "mlp.gate_proj.weight", dt).T,
+                "w_up": _t(sd, p + "mlp.up_proj.weight", dt).T,
+                "w_down": _t(sd, p + "mlp.down_proj.weight", dt).T,
+            }
+        )
+    embed = _t(sd, "model.embed_tokens.weight", dt)
+    if "lm_head.weight" in sd:
+        lm_head = _t(sd, "lm_head.weight", dt).T
+    else:  # tied embeddings
+        lm_head = embed.T
+    return {
+        "embed": embed,
+        "layers": layers,
+        "final_ln": _t(sd, "model.norm.weight", dt),
+        "lm_head": lm_head,
+    }
+
+
+def load_hf(model_or_state_dict, hf_cfg=None, page_size=16,
+            dtype="float32"):
+    """One-call bridge: returns (cfg, params). ``hf_cfg`` defaults to
+    ``model.config`` when a model object is passed."""
+    if hf_cfg is None:
+        hf_cfg = model_or_state_dict.config
+    cfg = config_from_hf(hf_cfg, page_size=page_size, dtype=dtype)
+    return cfg, params_from_hf(model_or_state_dict, cfg)
+
+
+__all__ = ["config_from_hf", "params_from_hf", "load_hf"]

@@ -41,6 +41,7 @@ class LlamaConfig:
     max_seq: int = 256
     page_size: int = 16  # tokens per KV page (the store's transfer unit)
     rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
     dtype: str = "bfloat16"
 
     @property
@@ -117,7 +118,7 @@ def rope(x, positions, theta):
 def _qkv(layer, x, cfg, positions):
     b = x.shape[0]
     s = x.shape[1]
-    h = rms_norm(x, layer["ln1"])
+    h = rms_norm(x, layer["ln1"], cfg.norm_eps)
     q = (h @ layer["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
     k = (h @ layer["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
     v = (h @ layer["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
@@ -126,8 +127,8 @@ def _qkv(layer, x, cfg, positions):
     return q, k, v
 
 
-def _mlp(layer, x):
-    h = rms_norm(x, layer["ln2"])
+def _mlp(layer, x, eps=1e-5):
+    h = rms_norm(x, layer["ln2"], eps)
     return (jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])) @ layer[
         "w_down"
     ]
@@ -146,9 +147,9 @@ def forward_dense(params, cfg: LlamaConfig, tokens):
         # XLA path at S=4096 on v5e), XLA path elsewhere.
         attn = flash_prefill(q, k, v, causal=True)
         x = x + attn.reshape(b, s, -1) @ layer["wo"]
-        x = x + _mlp(layer, x)
+        x = x + _mlp(layer, x, cfg.norm_eps)
         kvs.append((k, v))
-    x = rms_norm(x, params["final_ln"])
+    x = rms_norm(x, params["final_ln"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, kvs
 
@@ -190,10 +191,10 @@ def decode_step(params, cfg: LlamaConfig, token, seq_lens, k_pages, v_pages,
             q[:, 0], kp, vp, page_table, seq_lens + 1
         )
         x = x + attn.reshape(b, 1, -1) @ layer["wo"]
-        x = x + _mlp(layer, x)
+        x = x + _mlp(layer, x, cfg.norm_eps)
         new_k_pages.append(kp)
         new_v_pages.append(vp)
-    x = rms_norm(x, params["final_ln"])
+    x = rms_norm(x, params["final_ln"], cfg.norm_eps)
     logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
     return logits, jnp.stack(new_k_pages), jnp.stack(new_v_pages)
 

@@ -46,6 +46,7 @@ class MoEConfig:
     max_seq: int = 256
     page_size: int = 16
     rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     aux_loss_weight: float = 0.01
 
@@ -149,7 +150,7 @@ def _moe_mlp(layer, x, cfg: MoEConfig):
     """[B, S, d] → [B, S, d] through the routed expert FFN; also returns
     the layer's aux loss."""
     b, s, d = x.shape
-    h = rms_norm(x, layer["ln2"]).reshape(b * s, d)
+    h = rms_norm(x, layer["ln2"], cfg.norm_eps).reshape(b * s, d)
     dispatch, combine, aux = _route(layer, h, cfg)
     # Scatter to per-expert slots: ONE einsum, [E, C, d] activations.
     xe = jnp.einsum("tec,td->ecd", dispatch.astype(h.dtype), h)
@@ -178,7 +179,7 @@ def forward_dense(params, cfg: MoEConfig, tokens):
         x = x + moe_out
         kvs.append((k, v))
         aux_total = aux_total + aux
-    x = rms_norm(x, params["final_ln"])
+    x = rms_norm(x, params["final_ln"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, kvs, aux_total
 

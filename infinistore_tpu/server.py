@@ -93,8 +93,9 @@ class InfiniStoreServer:
 
     def restore(self, path):
         """Load a snapshot (existing keys win; stops when the pool is
-        full, keeping what fits). Returns entries loaded; raises on a
-        missing/corrupt file."""
+        full, keeping what fits; a truncated tail keeps the valid
+        prefix and returns its count). Returns entries loaded; raises
+        when the file is missing or its header is not a snapshot."""
         n = int(self._lib.ist_server_restore(self._h, path.encode()))
         if n < 0:
             raise Exception(f"restore from {path} failed")

@@ -167,7 +167,8 @@ size_t Server::purge() {
 
 // Snapshot file layout: magic u64, version u32, count u64, then per
 // entry: klen u32, key bytes, size u32, data bytes. Little-endian (the
-// wire protocol's convention); count is rewritten after the walk.
+// wire protocol's convention). The item list is collected before any
+// byte is written, so the up-front count is final.
 static constexpr uint64_t SNAP_MAGIC = 0x50414e5355505453ULL;  // "STPUSNAP"
 static constexpr uint32_t SNAP_VERSION = 1;
 
@@ -185,65 +186,76 @@ long long Server::snapshot(const std::string& path) {
         if (!index_) return -1;
         items = index_->snapshot_items();
     }
-    std::string tmp =
-        path + ".tmp." + std::to_string(getpid());
-    FILE* f = fopen(tmp.c_str(), "wb");
-    if (f == nullptr) {
-        IST_WARN("snapshot: cannot open %s: %s", tmp.c_str(),
-                 strerror(errno));
-        return -1;
-    }
-    uint64_t count = uint64_t(items.size());
-    fwrite(&SNAP_MAGIC, sizeof(SNAP_MAGIC), 1, f);
-    fwrite(&SNAP_VERSION, sizeof(SNAP_VERSION), 1, f);
-    fwrite(&count, sizeof(count), 1, f);
-    std::vector<uint8_t> tmpbuf;
-    bool ok = true;
-    for (const auto& it : items) {
-        const uint8_t* p = nullptr;
-        if (it.block) {
-            p = static_cast<const uint8_t*>(it.block->loc.ptr);
-        } else if (it.heap) {
-            p = it.heap->data();
-        } else {  // disk-resident: read back through the tier (pread —
-                  // safe concurrently with the loop's bitmap mutations)
-            tmpbuf.resize(it.size);
-            if (!disk_ || !disk_->load(it.disk->off, tmpbuf.data(),
-                                       it.size)) {
+    long long result = [&]() -> long long {
+        std::string tmp = path + ".tmp." + std::to_string(getpid());
+        FILE* f = fopen(tmp.c_str(), "wb");
+        if (f == nullptr) {
+            IST_WARN("snapshot: cannot open %s: %s", tmp.c_str(),
+                     strerror(errno));
+            return -1;
+        }
+        uint64_t count = uint64_t(items.size());
+        fwrite(&SNAP_MAGIC, sizeof(SNAP_MAGIC), 1, f);
+        fwrite(&SNAP_VERSION, sizeof(SNAP_VERSION), 1, f);
+        fwrite(&count, sizeof(count), 1, f);
+        std::vector<uint8_t> tmpbuf;
+        bool ok = true;
+        for (const auto& it : items) {
+            const uint8_t* p = nullptr;
+            if (it.block) {
+                p = static_cast<const uint8_t*>(it.block->loc.ptr);
+            } else if (it.heap) {
+                p = it.heap->data();
+            } else {  // disk-resident: read back through the tier (pread
+                      // — safe alongside the loop's bitmap mutations)
+                tmpbuf.resize(it.size);
+                if (!disk_ || !disk_->load(it.disk->off, tmpbuf.data(),
+                                           it.size)) {
+                    ok = false;
+                    break;
+                }
+                p = tmpbuf.data();
+            }
+            uint32_t klen = uint32_t(it.key.size());
+            fwrite(&klen, sizeof(klen), 1, f);
+            fwrite(it.key.data(), 1, klen, f);
+            fwrite(&it.size, sizeof(it.size), 1, f);
+            fwrite(p, 1, it.size, f);
+            if (ferror(f) != 0) {
                 ok = false;
                 break;
             }
-            p = tmpbuf.data();
         }
-        uint32_t klen = uint32_t(it.key.size());
-        fwrite(&klen, sizeof(klen), 1, f);
-        fwrite(it.key.data(), 1, klen, f);
-        fwrite(&it.size, sizeof(it.size), 1, f);
-        fwrite(p, 1, it.size, f);
-        if (ferror(f) != 0) {
-            ok = false;
-            break;
+        // Crash-durable atomic replace: flush to the kernel AND the
+        // device before the rename publishes the file, then persist the
+        // directory entry — fclose alone only reaches the page cache.
+        if (ok) ok = fflush(f) == 0 && fsync(fileno(f)) == 0;
+        if (fclose(f) != 0) ok = false;
+        if (!ok || rename(tmp.c_str(), path.c_str()) != 0) {
+            remove(tmp.c_str());
+            IST_WARN("snapshot to %s failed", path.c_str());
+            return -1;
         }
+        std::string dir = path;
+        size_t slash = dir.find_last_of('/');
+        dir = slash == std::string::npos ? "." : dir.substr(0, slash);
+        int dfd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+        if (dfd >= 0) {
+            fsync(dfd);
+            close(dfd);
+        }
+        return (long long)count;
+    }();
+    {
+        // Drop the collected refs back under the store lock: a ref that
+        // became the LAST owner during the lock-free IO (purge/eviction
+        // raced it) would otherwise run ~Block/~DiskSpan — which mutate
+        // the UNSYNCHRONIZED pool/tier bitmaps — concurrently with the
+        // event loop's allocations.
+        std::lock_guard<std::mutex> lk(store_mu_);
+        items.clear();
     }
-    // Crash-durable atomic replace: flush to the kernel AND the device
-    // before the rename publishes the file, then persist the directory
-    // entry — fclose alone only reaches the page cache.
-    if (ok) ok = fflush(f) == 0 && fsync(fileno(f)) == 0;
-    if (fclose(f) != 0) ok = false;
-    if (!ok || rename(tmp.c_str(), path.c_str()) != 0) {
-        remove(tmp.c_str());
-        IST_WARN("snapshot to %s failed", path.c_str());
-        return -1;
-    }
-    std::string dir = path;
-    size_t slash = dir.find_last_of('/');
-    dir = slash == std::string::npos ? "." : dir.substr(0, slash);
-    int dfd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
-    if (dfd >= 0) {
-        fsync(dfd);
-        close(dfd);
-    }
-    return (long long)count;
+    return result;
 }
 
 long long Server::restore(const std::string& path) {
@@ -269,29 +281,47 @@ long long Server::restore(const std::string& path) {
         loaded = 0;
         std::string key;
         std::vector<uint8_t> data;
-        std::lock_guard<std::mutex> lk(store_mu_);
-        if (index_) index_->reserve(size_t(count));
-        for (uint64_t i = 0; index_ && i < count; ++i) {
+        {
+            std::lock_guard<std::mutex> lk(store_mu_);
+            if (index_) index_->reserve(size_t(count));
+        }
+        for (uint64_t i = 0; i < count; ++i) {
+            // File IO runs WITHOUT the store lock (a multi-GB restore
+            // on a live server must not stall the data plane); only the
+            // per-entry insert takes it.
             uint32_t klen = 0, size = 0;
-            if (fread(&klen, sizeof(klen), 1, f) != 1 || klen > fsize) {
-                loaded = -1;
+            bool entry_ok =
+                fread(&klen, sizeof(klen), 1, f) == 1 && klen <= fsize;
+            if (entry_ok) {
+                key.resize(klen);
+                entry_ok = klen == 0 ||
+                           fread(&key[0], 1, klen, f) == klen;
+            }
+            if (entry_ok) {
+                entry_ok = fread(&size, sizeof(size), 1, f) == 1 &&
+                           size <= fsize;
+            }
+            if (entry_ok) {
+                data.resize(size);
+                entry_ok = size == 0 ||
+                           fread(data.data(), 1, size, f) == size;
+            }
+            if (!entry_ok) {
+                // Truncated/corrupt tail: keep the valid prefix (the
+                // partial count is reported honestly — returning -1
+                // here would claim total failure for a store that now
+                // holds entries).
+                IST_WARN("restore: corrupt snapshot tail after %lld "
+                         "entries; keeping them",
+                         loaded);
                 break;
             }
-            key.resize(klen);
-            if (klen && fread(&key[0], 1, klen, f) != klen) {
-                loaded = -1;
-                break;
+            Status st;
+            {
+                std::lock_guard<std::mutex> lk(store_mu_);
+                if (!index_) break;
+                st = index_->insert_committed(key, data.data(), size);
             }
-            if (fread(&size, sizeof(size), 1, f) != 1 || size > fsize) {
-                loaded = -1;
-                break;
-            }
-            data.resize(size);
-            if (size && fread(data.data(), 1, size, f) != size) {
-                loaded = -1;
-                break;
-            }
-            Status st = index_->insert_committed(key, data.data(), size);
             if (st == OK) {
                 loaded++;
             } else if (st == OUT_OF_MEMORY) {
