@@ -765,8 +765,11 @@ class InfinityConnection:
         # bouncing a ctypes callback through Python and a threading.Event.
         # On a STREAM-path timeout the native layer tears the connection
         # down before returning, so no late payload can land in our
-        # buffers. (The SHM path needs no teardown: copies run on this
-        # thread, and an abandoned PIN's lease is released natively.)
+        # buffers. (SHM connections never need the teardown: bulk reads
+        # copy on this thread with an abandoned PIN's lease released
+        # natively, and small reads — which ride the socket for latency,
+        # capi.cc hybrid dispatch — scatter into a callback-owned bounce
+        # buffer.)
         # BUSY (429) is the server's read backpressure — this connection
         # has too many bytes queued/pinned — so retry with backoff until
         # the configured timeout instead of surfacing a hard error.
