@@ -1,0 +1,5 @@
+from .checkpoint import (  # noqa: F401
+    latest_step,
+    restore_train_state,
+    save_train_state,
+)

@@ -1,0 +1,71 @@
+"""Training checkpoint/resume for the model families (orbax-backed).
+
+The store side persists the KV cache (Server snapshot/restore,
+server.py --snapshot-path); this is the engine side of the same story:
+params + optimizer state + step for the training loops the model
+families expose (llama.train_step / moe.train_step), saved through
+orbax — the standard JAX checkpointing library — so checkpoints are
+sharding-aware: on restore into a live mesh, pass the sharded state as
+``template`` and each process loads only its shards.
+
+The reference has nothing to mirror here (SURVEY.md §5
+checkpoint/resume: none); this exists so a training job driving the
+multichip path is resumable end to end.
+"""
+
+import os
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_train_state(ckpt_dir, step, params, opt_state):
+    """Write one checkpoint under ``ckpt_dir/step_<N>`` (atomic: orbax
+    finalizes a tmp directory). Returns the checkpoint path."""
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+    ckptr = _checkpointer()
+    ckptr.save(path, {"params": params, "opt_state": opt_state})
+    ckptr.wait_until_finished()
+    return path
+
+
+def latest_step(ckpt_dir):
+    """Highest step with a finalized checkpoint, or None."""
+    try:
+        entries = os.listdir(ckpt_dir)
+    except FileNotFoundError:
+        return None
+    steps = [
+        int(e[5:])
+        for e in entries
+        if e.startswith("step_") and e[5:].isdigit()
+        # orbax writes into a tmp dir and renames on finalize; a crashed
+        # save leaves orbax-style tmp suffixes which never match here.
+    ]
+    return max(steps) if steps else None
+
+
+def restore_train_state(ckpt_dir, step=None, template=None):
+    """Load (step, params, opt_state). ``step`` defaults to the latest;
+    ``template`` (a pytree of like-structured — possibly sharded —
+    arrays) makes orbax restore with matching shardings/dtypes, which is
+    required for multi-process restores. Returns None when no
+    checkpoint exists."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            return None
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+    ckptr = _checkpointer()
+    if template is not None:
+        target = {"params": template[0], "opt_state": template[1]}
+        state = ckptr.restore(path, target)
+    else:
+        state = ckptr.restore(path)
+    return step, state["params"], state["opt_state"]
+
+
+__all__ = ["save_train_state", "restore_train_state", "latest_step"]

@@ -337,75 +337,65 @@ uint32_t ist_read(void* h, uint32_t block_size, const uint8_t* keys_blob,
         return c->shm_read_blocking(block_size, std::move(kb),
                                     std::move(dp));
     }
-    if (c->shm_active()) {
-        // Small-read socket path WITHOUT the stream path's
-        // teardown-on-timeout: payload scatters into an owned bounce
-        // buffer (a few us of memcpy at <=32 KB), so a late response
-        // after a timeout lands in callback-owned memory and the shared
-        // connection survives — the pin path's abandonment semantics
-        // are preserved.
-        struct SmallWait {
-            std::mutex mu;
-            std::condition_variable cv;
-            bool fired = false;
-            uint32_t st = TIMEOUT_ERR;
-            std::vector<uint8_t> buf;
-            std::vector<void*> user;
-            uint32_t bs = 0;
-            bool timed_out = false;
-        };
-        auto w = std::make_shared<SmallWait>();
-        w->buf.resize(total);
-        w->user = std::move(dp);
-        w->bs = block_size;
-        std::vector<void*> bdst(nkeys);
-        for (uint32_t i = 0; i < nkeys; ++i) {
-            bdst[i] = w->buf.data() + uint64_t(i) * block_size;
-        }
-        DoneFn done = [w](uint32_t st, std::vector<uint8_t>) {
-            std::lock_guard<std::mutex> lk(w->mu);
-            if (st == OK && !w->timed_out) {
-                for (size_t i = 0; i < w->user.size(); ++i) {
-                    memcpy(w->user[i], w->buf.data() + i * w->bs, w->bs);
-                }
-            }
-            w->st = st;
-            w->fired = true;
-            w->cv.notify_all();
-        };
-        c->read_async(block_size, std::move(kb), std::move(bdst),
-                      std::move(done));
-        std::unique_lock<std::mutex> lk(w->mu);
-        if (!w->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
-                            [&] { return w->fired; })) {
-            w->timed_out = true;  // late completion must not touch user
-            return TIMEOUT_ERR;
-        }
-        return w->st;
-    }
-    struct Wait {
+    // ONE waiter serves both socket branches; `buf` non-empty selects
+    // the bounce-buffer mode (scatter into owned memory, copy out to
+    // the user on a non-timed-out OK completion).
+    struct ReadWait {
         std::mutex mu;
         std::condition_variable cv;
         bool fired = false;
         uint32_t st = TIMEOUT_ERR;
+        bool timed_out = false;
+        std::vector<uint8_t> buf;
+        std::vector<void*> user;
+        uint32_t bs = 0;
     };
-    auto w = std::make_shared<Wait>();
+    auto w = std::make_shared<ReadWait>();
+    std::vector<void*> scatter;
+    if (c->shm_active()) {
+        // Small-read socket path WITHOUT the stream path's
+        // teardown-on-timeout: payload scatters into the owned bounce
+        // buffer (a few us of memcpy at <=32 KB), so a late response
+        // after a timeout lands in callback-owned memory and the shared
+        // connection survives — the pin path's abandonment semantics
+        // are preserved.
+        w->buf.resize(total);
+        w->user = std::move(dp);
+        w->bs = block_size;
+        scatter.resize(nkeys);
+        for (uint32_t i = 0; i < nkeys; ++i) {
+            scatter[i] = w->buf.data() + uint64_t(i) * block_size;
+        }
+    } else {
+        scatter = std::move(dp);  // direct into caller memory
+    }
     DoneFn done = [w](uint32_t st, std::vector<uint8_t>) {
         std::lock_guard<std::mutex> lk(w->mu);
+        if (st == OK && !w->buf.empty() && !w->timed_out) {
+            for (size_t i = 0; i < w->user.size(); ++i) {
+                memcpy(w->user[i], w->buf.data() + i * w->bs, w->bs);
+            }
+        }
         w->st = st;
         w->fired = true;
         w->cv.notify_all();
     };
-    c->read_async(block_size, std::move(kb), std::move(dp),
+    c->read_async(block_size, std::move(kb), std::move(scatter),
                   std::move(done));
     std::unique_lock<std::mutex> lk(w->mu);
     if (!w->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
                         [&] { return w->fired; })) {
-        // The pending OP_READ still holds raw pointers into the caller's
-        // buffers; once we return, those may be freed. Tear the connection
-        // down and wait for the IO thread to unwind so a late response can
-        // never scatter into freed memory. (The callback itself stays safe
-        // regardless — it owns w via shared_ptr.)
+        w->timed_out = true;
+        if (!w->buf.empty()) {
+            // Bounce mode: a late completion can only touch the
+            // callback-owned buffer — just abandon the read.
+            return TIMEOUT_ERR;
+        }
+        // Direct mode: the pending OP_READ still holds raw pointers into
+        // the caller's buffers; once we return, those may be freed. Tear
+        // the connection down and wait for the IO thread to unwind so a
+        // late response can never scatter into freed memory. (The
+        // callback itself stays safe regardless — it owns w.)
         lk.unlock();
         c->hard_fail();
         return TIMEOUT_ERR;

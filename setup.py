@@ -29,7 +29,11 @@ setup(
     cmdclass={"build_py": BuildWithNative},
     python_requires=">=3.10",
     install_requires=["numpy"],
-    extras_require={"tpu": ["jax"], "test": ["pytest"]},
+    extras_require={
+        "tpu": ["jax"],
+        "train": ["optax", "orbax-checkpoint"],
+        "test": ["pytest"],
+    },
     entry_points={
         "console_scripts": [
             "infinistore-tpu = infinistore_tpu.server:main",
