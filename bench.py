@@ -148,22 +148,27 @@ def bench_sharded(n_shards=4, nkeys=4096, block_kb=4):
         block_bytes = block_kb << 10
         total = nkeys * block_bytes
         src = np.random.default_rng(3).integers(0, 255, total, dtype=np.uint8)
-        keys = [f"sh_{i}" for i in range(nkeys)]
-        offs = [i * block_bytes for i in range(nkeys)]
-        pairs = list(zip(keys, offs))
+        t_put = t_get = None
+        for it in range(2):  # best-of-2 like the single-server legs
+            if it:
+                conn.purge()
+            keys = [f"sh{it}_{i}" for i in range(nkeys)]
+            offs = [i * block_bytes for i in range(nkeys)]
+            pairs = list(zip(keys, offs))
+            t0 = time.perf_counter()
+            blocks = conn.allocate(keys, block_bytes)
+            conn.write_cache(src, offs, block_bytes, blocks, keys)
+            conn.sync()
+            t = time.perf_counter() - t0
+            t_put = t if t_put is None else min(t_put, t)
 
-        t0 = time.perf_counter()
-        blocks = conn.allocate(keys, block_bytes)
-        conn.write_cache(src, offs, block_bytes, blocks, keys)
-        conn.sync()
-        t_put = time.perf_counter() - t0
-
-        dst = np.zeros_like(src)
-        t0 = time.perf_counter()
-        conn.read_cache(dst, pairs, block_bytes)
-        conn.sync()
-        t_get = time.perf_counter() - t0
-        assert np.array_equal(src, dst), "sharded verification failed"
+            dst = np.zeros_like(src)
+            t0 = time.perf_counter()
+            conn.read_cache(dst, pairs, block_bytes)
+            conn.sync()
+            t = time.perf_counter() - t0
+            t_get = t if t_get is None else min(t_get, t)
+            assert np.array_equal(src, dst), "sharded verification failed"
 
         # Prefix-probe latency: one concurrent rpc per shard + merge.
         lats = []

@@ -3,3 +3,4 @@ from .checkpoint import (  # noqa: F401
     restore_train_state,
     save_train_state,
 )
+from .profiling import ProfileWindow, profile_window  # noqa: F401

@@ -59,6 +59,8 @@ def restore_train_state(ckpt_dir, step=None, template=None):
         if step is None:
             return None
     path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+    if not os.path.isdir(path):  # explicit step that was never saved
+        return None
     ckptr = _checkpointer()
     if template is not None:
         target = {"params": template[0], "opt_state": template[1]}

@@ -1,0 +1,81 @@
+"""Tracing/profiling helpers joining the two observability planes.
+
+The store side already publishes native per-op latency histograms
+(/stats, /metrics — beyond the reference, which has only ad-hoc chrono
+logs, SURVEY.md §5); the engine side has jax's profiler. This module
+glues them for one workload window:
+
+    with profile_window(conn, trace_dir="/tmp/tb") as w:
+        run_workload()
+    print(w.op_deltas)      # store ops attributable to the window
+    # trace_dir holds the XLA/device trace, viewable in TensorBoard /
+    # Perfetto.
+
+`op_deltas` subtracts the server's cumulative per-op counters across
+the window, so a workload's store traffic is separable from everything
+else the server has served.
+"""
+
+from contextlib import contextmanager
+
+
+def _op_counts(stats):
+    if isinstance(stats, list):  # ShardedConnection.stats(): per-shard
+        merged = {}
+        for shard in stats:
+            for k, v in _op_counts(shard).items():
+                merged[k] = merged.get(k, 0) + v
+        return merged
+    out = {}
+    for op, s in (stats.get("op_stats") or {}).items():
+        out[op] = int(s.get("count", 0))
+    out["bytes_in"] = int(stats.get("bytes_in", 0))
+    out["bytes_out"] = int(stats.get("bytes_out", 0))
+    return out
+
+
+class ProfileWindow:
+    def __init__(self):
+        self.op_deltas = {}
+        self.stats_before = {}
+        self.stats_after = {}
+
+
+@contextmanager
+def profile_window(conn_or_server=None, trace_dir=None):
+    """Profile one workload window.
+
+    conn_or_server: anything with ``.stats()`` (InfinityConnection or
+        InfiniStoreServer) — per-op counter deltas land in
+        ``window.op_deltas``. Optional.
+    trace_dir: when set, wraps the window in ``jax.profiler`` so the
+        device/XLA timeline lands there (TensorBoard/Perfetto format).
+    """
+    w = ProfileWindow()
+    if conn_or_server is not None:
+        w.stats_before = conn_or_server.stats()
+    tracing = False
+    if trace_dir is not None:
+        import jax
+
+        jax.profiler.start_trace(str(trace_dir))
+        tracing = True
+    try:
+        yield w
+    finally:
+        if tracing:
+            import jax
+
+            jax.profiler.stop_trace()
+        if conn_or_server is not None:
+            w.stats_after = conn_or_server.stats()
+            before = _op_counts(w.stats_before)
+            after = _op_counts(w.stats_after)
+            w.op_deltas = {
+                k: after.get(k, 0) - before.get(k, 0)
+                for k in after
+                if after.get(k, 0) != before.get(k, 0)
+            }
+
+
+__all__ = ["profile_window", "ProfileWindow"]

@@ -352,7 +352,11 @@ uint32_t ist_read(void* h, uint32_t block_size, const uint8_t* keys_blob,
     };
     auto w = std::make_shared<ReadWait>();
     std::vector<void*> scatter;
-    if (c->shm_active()) {
+    // Mode flag is the connection type, NOT buf.empty(): a zero-byte
+    // read on an SHM connection has an empty bounce buffer yet must
+    // still keep the no-teardown timeout semantics.
+    const bool bounce = c->shm_active();
+    if (bounce) {
         // Small-read socket path WITHOUT the stream path's
         // teardown-on-timeout: payload scatters into the owned bounce
         // buffer (a few us of memcpy at <=32 KB), so a late response
@@ -386,7 +390,7 @@ uint32_t ist_read(void* h, uint32_t block_size, const uint8_t* keys_blob,
     if (!w->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
                         [&] { return w->fired; })) {
         w->timed_out = true;
-        if (!w->buf.empty()) {
+        if (bounce) {
             // Bounce mode: a late completion can only touch the
             // callback-owned buffer — just abandon the read.
             return TIMEOUT_ERR;

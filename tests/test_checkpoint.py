@@ -77,3 +77,43 @@ def test_latest_step_selection(tmp_path):
 def test_restore_empty_dir_returns_none(tmp_path):
     assert restore_train_state(tmp_path / "nope") is None
     assert latest_step(tmp_path / "nope") is None
+
+
+def test_profile_window_op_deltas(shm_conn, rng):
+    """The profiling window attributes exactly the workload's store ops
+    and byte counts to itself."""
+    from infinistore_tpu.utils import profile_window
+
+    page = 1024
+    src = rng.random(page).astype(np.float32)
+    with profile_window(shm_conn) as w:
+        shm_conn.put_cache(src, [("prof_key", 0)], page)
+        shm_conn.sync()
+        dst = np.zeros_like(src)
+        shm_conn.read_cache(dst, [("prof_key", 0)], page)
+        shm_conn.sync()
+    assert np.array_equal(src, dst)
+    assert w.op_deltas.get("ALLOCATE", 0) >= 1
+    # SHM puts move payload one-sided (memcpy, never the socket), but
+    # the small read rides the socket's server-push path — its payload
+    # shows up as bytes_out.
+    assert w.op_deltas.get("bytes_out", 0) >= src.nbytes
+    # A second, empty window sees none of that traffic.
+    with profile_window(shm_conn) as w2:
+        pass
+    assert w2.op_deltas.get("ALLOCATE", 0) == 0
+
+
+def test_profile_window_jax_trace(tmp_path):
+    """trace_dir captures a jax profiler trace for the window."""
+    import os
+
+    from infinistore_tpu.utils import profile_window
+
+    with profile_window(trace_dir=tmp_path / "trace") as _w:
+        x = jnp.ones((128, 128))
+        jax.block_until_ready(x @ x)
+    found = []
+    for root, _dirs, files in os.walk(tmp_path / "trace"):
+        found += files
+    assert found, "no trace files written"
