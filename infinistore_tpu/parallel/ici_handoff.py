@@ -209,7 +209,27 @@ class IciKVPool:
         if not present:
             return 0
         pages = self.get(present)
-        store.put_kv_pages(present, pages, sync=sync)
+        if getattr(pages, "is_fully_addressable", True) is False:
+            # Multi-process mesh: this process only holds its shards;
+            # gather the full pages, then have ONE designated writer
+            # commit them (N identical dedup'd writes would be wasted
+            # rpc load) and barrier before anyone proceeds — without
+            # the barrier a non-writer could drop its pool slots and
+            # immediately fetch_from_store BEFORE the writer's commit
+            # is visible, and the resulting one-sided miss would
+            # desynchronize the SPMD replay at the next collective.
+            # (sync=False is not honored here: the barrier needs the
+            # committed state.)
+            from jax.experimental import multihost_utils
+
+            import jax as _jax
+
+            pages = multihost_utils.process_allgather(pages, tiled=True)
+            if _jax.process_index() == 0:
+                store.put_kv_pages(present, pages, sync=True)
+            multihost_utils.sync_global_devices("istpu_evict_to_store")
+        else:
+            store.put_kv_pages(present, pages, sync=sync)
         self.drop(present)
         return len(present)
 

@@ -1,0 +1,137 @@
+"""REAL multi-process SPMD test of the ICI pool + host store tiering.
+
+Two OS processes form one global 2-device jax mesh (CPU backend,
+cross-process collectives over gloo) and replay the documented
+directory-consistency contract (parallel/ici_handoff.py): identical
+directory-mutating calls on both processes, the host store as the
+byte rendezvous, a cross-PROCESS handoff (the ppermute really crosses
+process boundaries here), and a gathered bit-exact readback. This is
+the multi-host shape of BASELINE config 4/5 scaled onto one box."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+WORKER = r'''
+import os, sys, time
+import numpy as np
+
+pid = int(sys.argv[1])
+coord_port = sys.argv[2]
+rdv = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{coord_port}",
+    num_processes=2, process_id=pid,
+)
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+
+from infinistore_tpu import ClientConfig, InfiniStoreServer, \
+    InfinityConnection, ServerConfig
+from infinistore_tpu.parallel.ici_handoff import IciKVPool, make_pool_mesh
+from infinistore_tpu.tpu import TpuKVStore
+
+PAGE = (8, 16)
+rng = np.random.default_rng(42)   # identical on both processes
+keys = [f"mp_{i}" for i in range(3)]
+pages = rng.standard_normal((3, *PAGE)).astype(np.float32)
+
+# Process 0 hosts the shared store; process 1 discovers the port.
+srv = None
+if pid == 0:
+    srv = InfiniStoreServer(ServerConfig(
+        service_port=0, prealloc_size=0.03125, minimal_allocate_size=4))
+    port = srv.start()
+    with open(rdv + ".tmp", "w") as f:
+        f.write(str(port))
+    os.rename(rdv + ".tmp", rdv)
+else:
+    deadline = time.time() + 30
+    while not os.path.exists(rdv):
+        assert time.time() < deadline, "no rendezvous"
+        time.sleep(0.1)
+    with open(rdv) as f:
+        port = int(f.read())
+
+conn = InfinityConnection(ClientConfig(
+    host_addr="127.0.0.1", service_port=port))
+conn.connect()
+store = TpuKVStore(conn)
+if pid == 0:
+    store.put_kv_pages(keys, pages, sync=True)  # prefill host writes
+multihost_utils.sync_global_devices("store_ready")
+
+# Both processes replay the SAME directory-op sequence (the contract).
+mesh = make_pool_mesh(2)
+pool = IciKVPool(mesh, PAGE, jnp.float32, slots_per_device=4)
+assert pool.match_last_index(keys) == -1
+n = pool.fetch_from_store(store, keys, device=0)
+assert n == 3, n
+# Cross-PROCESS handoff: device 0 lives on process 0, device 1 on
+# process 1 — the ppermute genuinely crosses the process boundary.
+pool.handoff({k: 1 for k in keys})
+assert all(pool.device_of(k) == 1 for k in keys)
+got = np.asarray(
+    multihost_utils.process_allgather(pool.get(keys), tiled=True)
+)
+assert np.array_equal(got, pages), "cross-process handoff corrupted pages"
+
+# Evict back out (gathers shards, dedups across the two writers) and
+# fetch again onto the other device.
+assert pool.evict_to_store(store, keys) == 3
+assert pool.match_last_index(keys) == -1
+assert pool.fetch_from_store(store, keys, device=1) == 3
+got2 = np.asarray(
+    multihost_utils.process_allgather(pool.get(keys), tiled=True)
+)
+assert np.array_equal(got2, pages)
+
+multihost_utils.sync_global_devices("done")
+conn.close()
+if srv is not None:
+    srv.stop()
+print(f"MPOK {pid}", flush=True)
+'''
+
+
+def test_two_process_spmd_pool_tiering(tmp_path):
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coord_port = s.getsockname()[1]
+    s.close()
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER)
+    rdv = str(tmp_path / "store_port")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    env.pop("XLA_FLAGS", None)  # 1 local device per process
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), str(coord_port), rdv],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process SPMD worker timed out")
+        outs.append((p.returncode, out, err))
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"proc {i} failed:\n{err[-3000:]}"
+        assert f"MPOK {i}" in out
