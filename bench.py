@@ -332,6 +332,66 @@ def bench_overlap(port):
         conn.close()
 
 
+def _bench_decode(dev, n_steps=32, batch=8):
+    """Steady-state paged-decode throughput of the flagship model on the
+    attached chip. Returns {decode_tok_s, decode_step_ms, decode_params_m}."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from infinistore_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=8192, d_model=1024, n_layers=4, n_heads=8, n_kv_heads=8,
+        d_ff=4096, max_seq=512, page_size=16,
+    )
+    with jax.default_device(dev):
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        n_params = sum(
+            int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
+        )
+        max_pages = 16  # 256-token budget per sequence
+        kv_shape = (cfg.n_layers, batch * max_pages, cfg.page_size,
+                    cfg.n_kv_heads, cfg.head_dim)
+        k_pages = jnp.zeros(kv_shape, dtype=cfg.jdtype)
+        v_pages = jnp.zeros_like(k_pages)
+        page_table = jnp.arange(
+            batch * max_pages, dtype=jnp.int32
+        ).reshape(batch, max_pages)
+        token0 = jnp.zeros((batch,), jnp.int32)
+        lens0 = jnp.full((batch,), 128, jnp.int32)  # mid-sequence state
+
+        def many_steps(params, token, lens, kp, vp):
+            def body(carry, _):
+                token, lens, kp, vp = carry
+                logits, kp, vp = llama.decode_step(
+                    params, cfg, token, lens, kp, vp, page_table
+                )
+                token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (token, lens + 1, kp, vp), None
+
+            (token, lens, kp, vp), _ = jax.lax.scan(
+                body, (token, lens, kp, vp), None, length=n_steps
+            )
+            return token
+
+        fn = jax.jit(many_steps)
+        out = fn(params, token0, lens0, k_pages, v_pages)
+        jax.block_until_ready(out)  # compile + warm
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = fn(params, token0, lens0, k_pages, v_pages)
+            jax.block_until_ready(out)
+            t = time.perf_counter() - t0
+            best = t if best is None else min(best, t)
+        return {
+            "decode_tok_s": round(n_steps * batch / best, 1),
+            "decode_step_ms": round(best / n_steps * 1e3, 3),
+            "decode_params_m": round(n_params / 1e6, 1),
+        }
+
+
 def bench_tpu(port):
     """Device <-> store KV-page transfers with raw-transfer control legs.
 
@@ -490,6 +550,18 @@ def bench_tpu(port):
                 )
             )
 
+            # ---- Phase D: serving throughput (paged decode on-chip) ----
+            # The store's consumer: the flagship paged-KV model decoding
+            # at steady state. Params are INITIALIZED ON DEVICE (no
+            # multi-hundred-MB H2D over the tunnel) and 32 decode steps
+            # run inside one jitted lax.scan so per-step tunnel dispatch
+            # cost cannot masquerade as kernel cost.
+            decode_res = {}
+            try:
+                decode_res = _bench_decode(dev)
+            except Exception as e:
+                decode_res = {"decode_error": str(e)[:160]}
+
             # Publish rounded rates; ratios recomputed from the rounded
             # values so readers cross-checking the artifact get the same
             # numbers (round-2 advisor finding).
@@ -508,6 +580,7 @@ def bench_tpu(port):
                 "ctrl_d2h_GBps": r_d2h,
                 "offload_vs_ctrl": round(r_off / r_d2h, 2) if r_d2h else None,
                 "tpu_verified": restore_ok and offload_ok,
+                **decode_res,
             }
         finally:
             conn.close()

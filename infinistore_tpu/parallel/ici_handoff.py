@@ -219,15 +219,33 @@ class IciKVPool:
             # is visible, and the resulting one-sided miss would
             # desynchronize the SPMD replay at the next collective.
             # (sync=False is not honored here: the barrier needs the
-            # committed state.)
+            # committed state. Symmetric writes would NOT remove the
+            # barrier: a process whose allocate dedups to FAKE writes
+            # nothing, so its own sync says nothing about the winner's
+            # commit.) The barrier doubles as the writer's status
+            # broadcast: on a failed put EVERY process raises before any
+            # directory mutation, so replicated directories never
+            # diverge — instead of the non-writers hanging forever while
+            # the writer unwinds.
             from jax.experimental import multihost_utils
 
             import jax as _jax
 
             pages = multihost_utils.process_allgather(pages, tiled=True)
+            ok = 1
             if _jax.process_index() == 0:
-                store.put_kv_pages(present, pages, sync=True)
-            multihost_utils.sync_global_devices("istpu_evict_to_store")
+                try:
+                    store.put_kv_pages(present, pages, sync=True)
+                except Exception:
+                    ok = 0
+            flags = multihost_utils.process_allgather(
+                jnp.asarray([ok], dtype=jnp.int32), tiled=True
+            )
+            if int(jnp.min(flags)) == 0:
+                raise RuntimeError(
+                    "evict_to_store: designated writer failed to commit; "
+                    "pool slots retained on every process"
+                )
         else:
             store.put_kv_pages(present, pages, sync=sync)
         self.drop(present)

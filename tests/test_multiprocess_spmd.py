@@ -113,7 +113,8 @@ def test_two_process_spmd_pool_tiering(tmp_path):
     worker.write_text(WORKER)
     rdv = str(tmp_path / "store_port")
     env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    repo_root = os.path.dirname(os.path.dirname(__file__))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     env.pop("XLA_FLAGS", None)  # 1 local device per process
     procs = [
         subprocess.Popen(
