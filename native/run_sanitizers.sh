@@ -18,7 +18,7 @@ cd "$(dirname "$0")/.."
 TESTS="tests/test_store_loopback.py tests/test_safety.py \
 tests/test_backpressure.py tests/test_reconnect.py tests/test_async.py \
 tests/test_put_op.py tests/test_put_oom.py tests/test_multiprocess.py \
-tests/test_eviction.py tests/test_ssd_tier.py tests/test_snapshot.py"
+tests/test_eviction.py tests/test_ssd_tier.py tests/test_snapshot.py tests/test_protocol_fuzz.py"
 
 TSAN_RT="$(gcc -print-file-name=libtsan.so.2)"
 ASAN_RT="$(gcc -print-file-name=libasan.so.8)"
