@@ -48,23 +48,57 @@ def _kernel(page_tbl_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(start < seq_len)
     def _step():
-        _attend(page_tbl_ref, seq_lens_ref, q_ref, k_ref, v_ref,
-                acc_ref, m_ref, l_ref, page_size=page_size, n_kv=n_kv,
-                hd=hd, n_heads=n_heads, scale=scale, start=start,
-                seq_len=seq_len)
+        _attend(q_ref[0],
+                k_ref[0].reshape(page_size, n_kv, hd),
+                v_ref[0].reshape(page_size, n_kv, hd),
+                acc_ref, m_ref, l_ref, n_kv=n_kv, n_heads=n_heads,
+                scale=scale, start=start, seq_len=seq_len)
 
     @pl.when(j == n_pages - 1)
     def _finish():
         o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
 
 
-def _attend(page_tbl_ref, seq_lens_ref, q_ref, k_ref, v_ref,
-            acc_ref, m_ref, l_ref, *, page_size, n_kv, hd, n_heads, scale,
-            start, seq_len):
-    q = q_ref[0]  # [H, D] padded
-    kv = k_ref[0].reshape(page_size, n_kv, hd)  # [P, n_kv, D]
-    vv = v_ref[0].reshape(page_size, n_kv, hd)
+def _kernel_q(page_tbl_ref, seq_lens_ref, q_ref, kq_ref, ks_ref, vq_ref,
+              vs_ref, o_ref, acc_ref, m_ref, l_ref, *,
+              page_size, n_kv, hd, n_heads, scale):
+    """Decode attention over INT8 pages: dequantize in VMEM right after
+    the page DMA — HBM traffic per page is half the bf16 kernel's (int8
+    values + per-token-per-head f32 scales ≈ 0.53x bf16 bytes)."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    n_pages = pl.num_programs(1)
 
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    seq_len = seq_lens_ref[b]
+    start = j * page_size
+
+    @pl.when(start < seq_len)
+    def _step():
+        kq = kq_ref[0].reshape(page_size, n_kv, hd)  # int8
+        vq = vq_ref[0].reshape(page_size, n_kv, hd)
+        ks = ks_ref[0]  # [P, n_kv] f32
+        vs = vs_ref[0]
+        kv = kq.astype(jnp.float32) * ks[..., None]
+        vv = vq.astype(jnp.float32) * vs[..., None]
+        _attend(q_ref[0].astype(jnp.float32), kv, vv,
+                acc_ref, m_ref, l_ref, n_kv=n_kv, n_heads=n_heads,
+                scale=scale, start=start, seq_len=seq_len)
+
+    @pl.when(j == n_pages - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def _attend(q, kv, vv, acc_ref, m_ref, l_ref, *, n_kv, n_heads, scale,
+            start, seq_len):
+    """One page's online-softmax fold. q: [H, D]; kv/vv: [P, n_kv, D]
+    (already dequantized if the pages are int8)."""
     group = n_heads // n_kv
     # HIGHEST on f32 keeps full precision; on bf16 it would request a
     # multi-pass algorithm Mosaic rejects ("Bad lhs type") — the MXU
@@ -128,6 +162,32 @@ def _pad_to(x, axis, mult):
     return jnp.pad(x, widths), size
 
 
+def _decode_dims(q_dtype, n_kv, group):
+    """Shared tile math for both decode kernels: (sublane, n_kv_p).
+    Pad kv heads so n_heads_p = n_kv_p * group is a sublane multiple:
+    n_kv_p must be a multiple of sublane/gcd(group, sublane) (works for
+    any group size, incl. ones that don't divide the sublane count)."""
+    import math as _math
+
+    sublane = 16 if q_dtype == jnp.bfloat16 else 8
+    kv_mult = sublane // _math.gcd(group, sublane)
+    return sublane, ((n_kv + kv_mult - 1) // kv_mult) * kv_mult
+
+
+def _make_page_idx(page_size, n_pages):
+    """Shared page index map: clamp against the table contract ("padded
+    arbitrarily" — the XLA path's jnp.take clamps OOB ids) AND freeze j
+    at the sequence's last used page, so pages past seq_len cost no HBM
+    traffic (pallas elides same-index re-fetches)."""
+
+    def _page_idx(b, j, pt, sl):
+        last_used = jnp.maximum(sl[b] - 1, 0) // page_size
+        jj = jnp.minimum(j, last_used)
+        return (jnp.clip(pt[b, jj], 0, n_pages - 1), 0, 0)
+
+    return _page_idx
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_flash_decode(q, k_pages, v_pages, page_table, seq_lens,
                        interpret=False):
@@ -145,19 +205,12 @@ def paged_flash_decode(q, k_pages, v_pages, page_table, seq_lens,
     # Pad to TPU tile boundaries: lanes (last dim) 128; sublane multiple
     # is dtype-dependent (8 for f32, 16 for bf16 — pallas guide tiling
     # table).
-    sublane = 16 if q.dtype == jnp.bfloat16 else 8
     q_p, _ = _pad_to(q, 2, 128)
     k_p, _ = _pad_to(k_pages, 3, 128)
     v_p, _ = _pad_to(v_pages, 3, 128)
     hd_p = q_p.shape[2]
     group = n_heads // n_kv
-    # Pad kv heads so n_heads_p = n_kv_p * group is a sublane multiple:
-    # n_kv_p must be a multiple of sublane/gcd(group, sublane) (works for
-    # any group size, incl. ones that don't divide the sublane count).
-    import math as _math
-
-    kv_mult = sublane // _math.gcd(group, sublane)
-    n_kv_p = ((n_kv + kv_mult - 1) // kv_mult) * kv_mult
+    _, n_kv_p = _decode_dims(q.dtype, n_kv, group)
     if n_kv_p != n_kv:
         k_p = jnp.pad(k_p, ((0, 0), (0, 0), (0, n_kv_p - n_kv), (0, 0)))
         v_p = jnp.pad(v_p, ((0, 0), (0, 0), (0, n_kv_p - n_kv), (0, 0)))
@@ -168,15 +221,7 @@ def paged_flash_decode(q, k_pages, v_pages, page_table, seq_lens,
     k_f = k_p.reshape(n_pages, page_size, n_kv_p * hd_p)
     v_f = v_p.reshape(n_pages, page_size, n_kv_p * hd_p)
 
-    def _page_idx(b, j, pt, sl):
-        # Clamp against the table contract ("padded arbitrarily" — the XLA
-        # path's jnp.take clamps OOB ids) AND freeze j at the sequence's
-        # last used page: when consecutive grid steps map to the same
-        # block index, pallas elides the re-fetch, so pages past
-        # seq_len cost no HBM traffic.
-        last_used = jnp.maximum(sl[b] - 1, 0) // page_size
-        jj = jnp.minimum(j, last_used)
-        return (jnp.clip(pt[b, jj], 0, n_pages - 1), 0, 0)
+    _page_idx = _make_page_idx(page_size, n_pages)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # page_table, seq_lens
@@ -213,6 +258,81 @@ def paged_flash_decode(q, k_pages, v_pages, page_table, seq_lens,
     return out[:, :n_heads, :hd]
 
 
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_flash_decode_quantized(q, k_q, k_s, v_q, v_s, page_table,
+                                 seq_lens, interpret=False):
+    """Flash-decode attention DIRECTLY over int8-quantized KV pages
+    (ops/kv_quant.py format): pages stay int8 in HBM — the decode cache
+    holds 2x the tokens — and each page's DMA moves ~0.53x the bf16
+    bytes, with dequantization fused into the kernel right after the
+    load. Same contract as paged_flash_decode otherwise. Measured on
+    v5e at 1024-token sequences (batch 8, 8 heads, hd 128): 2266 us vs
+    the bf16 kernel's 3099 us — 1.37x from the halved page traffic;
+    accuracy is the quantizer's (~0.4% rel).
+
+    k_q/v_q: int8 [n_pages, page, n_kv, hd];
+    k_s/v_s: f32 [n_pages, page, n_kv] (per-token-per-head scales).
+    """
+    batch, n_heads, hd = q.shape
+    n_pages, page_size, n_kv, _ = k_q.shape
+    max_pages = page_table.shape[1]
+
+    q_p, _ = _pad_to(q, 2, 128)
+    kq_p, _ = _pad_to(k_q, 3, 128)
+    vq_p, _ = _pad_to(v_q, 3, 128)
+    hd_p = q_p.shape[2]
+    group = n_heads // n_kv
+    _, n_kv_p = _decode_dims(q.dtype, n_kv, group)
+    k_s_p, v_s_p = k_s, v_s
+    if n_kv_p != n_kv:
+        kq_p = jnp.pad(kq_p, ((0, 0), (0, 0), (0, n_kv_p - n_kv), (0, 0)))
+        vq_p = jnp.pad(vq_p, ((0, 0), (0, 0), (0, n_kv_p - n_kv), (0, 0)))
+        k_s_p = jnp.pad(k_s, ((0, 0), (0, 0), (0, n_kv_p - n_kv)))
+        v_s_p = jnp.pad(v_s, ((0, 0), (0, 0), (0, n_kv_p - n_kv)))
+        q_p = jnp.pad(q_p, ((0, 0), (0, (n_kv_p - n_kv) * group), (0, 0)))
+    n_heads_p = n_kv_p * group
+
+    kq_f = kq_p.reshape(n_pages, page_size, n_kv_p * hd_p)
+    vq_f = vq_p.reshape(n_pages, page_size, n_kv_p * hd_p)
+
+    _page_idx = _make_page_idx(page_size, n_pages)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, n_heads_p, hd_p), lambda b, j, pt, sl: (b, 0, 0)),
+            pl.BlockSpec((1, page_size, n_kv_p * hd_p), _page_idx),
+            pl.BlockSpec((1, page_size, n_kv_p), _page_idx),
+            pl.BlockSpec((1, page_size, n_kv_p * hd_p), _page_idx),
+            pl.BlockSpec((1, page_size, n_kv_p), _page_idx),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, n_heads_p, hd_p), lambda b, j, pt, sl: (b, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_heads_p, hd_p), jnp.float32),
+            pltpu.VMEM((n_heads_p, 1), jnp.float32),
+            pltpu.VMEM((n_heads_p, 1), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _kernel_q,
+        page_size=page_size,
+        n_kv=n_kv_p,
+        hd=hd_p,
+        n_heads=n_heads_p,
+        scale=hd ** -0.5,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((batch, n_heads_p, hd_p), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(page_table, seq_lens, q_p, kq_f, k_s_p, vq_f, v_s_p)
+    return out[:, :n_heads, :hd]
+
+
 def decode_attention(q, k_pages, v_pages, page_table, seq_lens):
     """Paged decode attention with automatic backend choice: the pallas
     flash kernel on TPU, the XLA gather path elsewhere."""
@@ -221,3 +341,33 @@ def decode_attention(q, k_pages, v_pages, page_table, seq_lens):
     return xla_ref.paged_decode_attention(
         q, k_pages, v_pages, page_table, seq_lens
     )
+
+
+def decode_attention_quantized(q, k_q, k_s, v_q, v_s, page_table, seq_lens):
+    """Decode over int8 pages with automatic backend choice: fused
+    dequant-in-kernel on TPU; gather-then-dequantize + the XLA path
+    elsewhere (gathering FIRST keeps the fallback's footprint at the
+    referenced pages, not the whole pool — the capacity benefit
+    quantization buys must survive the fallback)."""
+    if jax.default_backend() == "tpu":
+        return paged_flash_decode_quantized(
+            q, k_q, k_s, v_q, v_s, page_table, seq_lens
+        )
+    from . import kv_quant
+
+    sel = jnp.clip(page_table, 0, k_q.shape[0] - 1)  # [batch, max_pages]
+    batch, max_pages = sel.shape
+    kg = kv_quant.dequantize_kv_pages(
+        jnp.take(k_q, sel.reshape(-1), axis=0),
+        jnp.take(k_s, sel.reshape(-1), axis=0), q.dtype,
+    )
+    vg = kv_quant.dequantize_kv_pages(
+        jnp.take(v_q, sel.reshape(-1), axis=0),
+        jnp.take(v_s, sel.reshape(-1), axis=0), q.dtype,
+    )
+    # The gathered pages are already in table order: re-index with the
+    # identity table over the gathered pool.
+    ident = jnp.arange(batch * max_pages, dtype=jnp.int32).reshape(
+        batch, max_pages
+    )
+    return xla_ref.paged_decode_attention(q, kg, vg, ident, seq_lens)

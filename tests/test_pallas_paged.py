@@ -108,3 +108,89 @@ def test_flash_oob_page_table_padding():
     np.testing.assert_allclose(
         np.asarray(out_pl), np.asarray(out_ref), rtol=2e-5, atol=2e-5
     )
+
+
+@pytest.mark.parametrize("dtype,n_heads,n_kv", [
+    (jnp.float32, 8, 4),
+    (jnp.float32, 4, 1),
+    (jnp.bfloat16, 8, 2),
+])
+def test_quantized_decode_matches_dequantized_reference(dtype, n_heads, n_kv):
+    """The int8 kernel (dequant fused after the page DMA) must match
+    dequantize-then-attend through the XLA path."""
+    from infinistore_tpu.ops import kv_quant
+    from infinistore_tpu.ops.pallas_paged_attention import (
+        paged_flash_decode_quantized,
+    )
+
+    rng = np.random.default_rng(17)
+    batch, hd, page, n_pages, max_pages = 3, 64, 16, 24, 6
+    q = jnp.asarray(rng.standard_normal((batch, n_heads, hd)), dtype)
+    pages = jnp.asarray(
+        rng.standard_normal((n_pages, page, n_kv, hd)), dtype
+    )
+    k_q, k_s = kv_quant.quantize_kv_pages(pages)
+    v_pages = jnp.asarray(
+        rng.standard_normal((n_pages, page, n_kv, hd)), dtype
+    )
+    v_q, v_s = kv_quant.quantize_kv_pages(v_pages)
+    page_table = jnp.asarray(
+        rng.permutation(n_pages)[: batch * max_pages].reshape(
+            batch, max_pages
+        ),
+        jnp.int32,
+    )
+    seq_lens = jnp.asarray([5, 37, 96], jnp.int32)
+
+    got = paged_flash_decode_quantized(
+        q, k_q, k_s, v_q, v_s, page_table, seq_lens, interpret=True
+    )
+    k_deq = kv_quant.dequantize_kv_pages(k_q, k_s, jnp.float32)
+    v_deq = kv_quant.dequantize_kv_pages(v_q, v_s, jnp.float32)
+    ref = paged_decode_attention(
+        q.astype(jnp.float32), k_deq, v_deq, page_table, seq_lens
+    )
+    tol = 3e-2 if dtype == jnp.bfloat16 else 2e-5
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref)))
+    assert err < tol, (dtype, n_heads, n_kv, err)
+
+
+def test_quantized_chooser_fallback_gathers_first():
+    """The non-TPU fallback of decode_attention_quantized must match the
+    full-dequant reference (it gathers int8 pages by table first)."""
+    from infinistore_tpu.ops import kv_quant
+    from infinistore_tpu.ops.pallas_paged_attention import (
+        decode_attention_quantized,
+    )
+
+    import jax
+
+    assert jax.default_backend() != "tpu"
+    rng = np.random.default_rng(23)
+    batch, n_heads, n_kv, hd, page = 2, 4, 2, 32, 8
+    n_pages, max_pages = 16, 4
+    q = jnp.asarray(rng.standard_normal((batch, n_heads, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((n_pages, page, n_kv, hd)),
+                    jnp.float32)
+    v = jnp.asarray(rng.standard_normal((n_pages, page, n_kv, hd)),
+                    jnp.float32)
+    k_q, k_s = kv_quant.quantize_kv_pages(k)
+    v_q, v_s = kv_quant.quantize_kv_pages(v)
+    page_table = jnp.asarray(
+        rng.permutation(n_pages)[: batch * max_pages].reshape(
+            batch, max_pages
+        ),
+        jnp.int32,
+    )
+    seq_lens = jnp.asarray([13, 29], jnp.int32)
+    got = decode_attention_quantized(
+        q, k_q, k_s, v_q, v_s, page_table, seq_lens
+    )
+    ref = paged_decode_attention(
+        q,
+        kv_quant.dequantize_kv_pages(k_q, k_s, jnp.float32),
+        kv_quant.dequantize_kv_pages(v_q, v_s, jnp.float32),
+        page_table, seq_lens,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
