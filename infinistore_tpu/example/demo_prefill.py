@@ -12,6 +12,13 @@ the connection's IO thread), and the decode "worker" — a fresh process in
 real deployments, a fresh connection here — discovers the cached prefix
 with get_match_last_index, restores the pages, and decodes the next
 tokens without recomputing the prompt.
+
+A third leg demonstrates the prefix-cache HIT on a *new* request that
+shares the prompt: restore the cached pages and prefill only the
+un-cached tail through the rectangular flash kernel
+(llama.prefill_with_prefix) — the reference's cross-host prefix-reuse
+scenario (design.rst:33-38) with the prefix's QKV/MLP/attention FLOPs
+skipped entirely.
 """
 
 import argparse
@@ -123,6 +130,25 @@ def run(host, port, seq_len=64):
     t_decode = time.perf_counter() - t0
     print(
         f"decode: 16 tokens in {t_decode*1e3:.1f} ms → {generated[:8]}..."
+    )
+
+    # ---- new request sharing the prompt: prefix-cache HIT path ----
+    s_new = cfg.page_size  # one new page of tokens after the shared prompt
+    cont = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (1, s_new)), dtype=jnp.int32
+    )
+    hit = dstore.cached_prefix_len(
+        llama.page_keys(seq_id, 0, "k", n_pages + s_new // cfg.page_size)
+    )
+    t0 = time.perf_counter()
+    prefix_kvs = llama.restore_prefix_kvs(dstore, cfg, seq_id, hit)
+    tail_logits, _ = llama.prefill_with_prefix(params, cfg, cont, prefix_kvs)
+    jax.block_until_ready(tail_logits)
+    t_hit = time.perf_counter() - t0
+    print(
+        f"prefix hit: {hit} pages reused, prefilled {s_new} new tokens "
+        f"over a {hit * cfg.page_size}-token cached prefix in "
+        f"{t_hit*1e3:.1f} ms (prefix FLOPs skipped)"
     )
     decode_conn.close()
 

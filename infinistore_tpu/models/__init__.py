@@ -3,5 +3,6 @@ from .llama import (  # noqa: F401
     decode_step,
     init_params,
     prefill,
+    prefill_with_prefix,
     train_step,
 )

@@ -134,18 +134,32 @@ def _mlp(layer, x, eps=1e-5):
     ]
 
 
-def forward_dense(params, cfg: LlamaConfig, tokens):
-    """Dense causal forward (training / prefill compute). tokens:
-    [batch, seq] int32 → logits [batch, seq, vocab] (fp32)."""
+def _forward_stack(params, cfg: LlamaConfig, tokens, prefix_kvs=None):
+    """The ONE decoder-stack loop shared by dense forward and
+    prefix-cached prefill (the cache-hit identity depends on these two
+    paths never diverging). With `prefix_kvs` (per-layer (k, v) of shape
+    [batch, P, n_kv, hd], post-RoPE), positions shift by P and each
+    layer attends over prefix + suffix KV through the rectangular flash
+    kernel; with None this reduces exactly to the dense causal forward."""
     b, s = tokens.shape
+    prefix_len = 0 if prefix_kvs is None else prefix_kvs[0][0].shape[1]
     x = jnp.take(params["embed"], tokens, axis=0)
-    positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+    positions = jnp.broadcast_to(
+        prefix_len + jnp.arange(s)[None], (b, s)
+    )
     kvs = []
-    for layer in params["layers"]:
+    for li, layer in enumerate(params["layers"]):
         q, k, v = _qkv(layer, x, cfg, positions)
+        if prefix_kvs is None:
+            k_full, v_full = k, v
+        else:
+            pk, pv = prefix_kvs[li]
+            k_full = jnp.concatenate([pk.astype(k.dtype), k], axis=1)
+            v_full = jnp.concatenate([pv.astype(v.dtype), v], axis=1)
         # Pallas flash kernel on TPU (O(S) memory, ~4x faster than the
-        # XLA path at S=4096 on v5e), XLA path elsewhere.
-        attn = flash_prefill(q, k, v, causal=True)
+        # XLA path at S=4096 on v5e), XLA path elsewhere. kv may be
+        # longer than q — the causal diagonal shifts by the prefix.
+        attn = flash_prefill(q, k_full, v_full, causal=True)
         x = x + attn.reshape(b, s, -1) @ layer["wo"]
         x = x + _mlp(layer, x, cfg.norm_eps)
         kvs.append((k, v))
@@ -154,10 +168,39 @@ def forward_dense(params, cfg: LlamaConfig, tokens):
     return logits, kvs
 
 
+def forward_dense(params, cfg: LlamaConfig, tokens):
+    """Dense causal forward (training / prefill compute). tokens:
+    [batch, seq] int32 → logits [batch, seq, vocab] (fp32)."""
+    return _forward_stack(params, cfg, tokens)
+
+
 def prefill(params, cfg: LlamaConfig, tokens):
     """Prefill: returns (logits, per-layer (k, v) arrays
     [batch, seq, n_kv, hd]) — the KV to page out to the store."""
     return forward_dense(params, cfg, tokens)
+
+
+def prefill_with_prefix(params, cfg: LlamaConfig, tokens, prefix_kvs):
+    """Suffix prefill over a cached prefix — the store's cache-HIT path.
+
+    This is what a prefix-cache hit buys (reference design.rst:54-63:
+    vLLM calls get_match_last_index, restores the matched pages, and
+    prefills only the un-cached tail): compute runs over the suffix
+    tokens only, attending over restored-prefix + suffix KV with the
+    causal diagonal shifted by the prefix length — O(s_new * (P + s_new))
+    attention FLOPs instead of O((P + s_new)^2) for a full re-prefill,
+    and none of the prefix's QKV/MLP matmuls.
+
+    tokens:     [batch, s_new] int32 — the NOT-cached suffix tokens.
+    prefix_kvs: per-layer list of (k, v), each [batch, P, n_kv, hd],
+                post-RoPE as produced by `prefill` / restored via
+                `pages_to_kv` — positions are absolute, so restored K
+                needs no re-rotation.
+
+    Returns (logits [batch, s_new, vocab] fp32, per-layer suffix (k, v)
+    [batch, s_new, n_kv, hd] — the new pages to put to the store).
+    """
+    return _forward_stack(params, cfg, tokens, prefix_kvs)
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -246,8 +289,44 @@ def kv_to_pages(cfg: LlamaConfig, k, v):
     return k.reshape(shape), v.reshape(shape)
 
 
+def pages_to_kv(cfg: LlamaConfig, k_pages, v_pages, length):
+    """Inverse of `kv_to_pages`: reassemble contiguous KV from store
+    pages. k_pages/v_pages: [batch, n_pages, page, n_kv, hd] →
+    (k, v) [batch, length, n_kv, hd], dropping tail-page padding."""
+    b, n_pages, page, n_kv, hd = k_pages.shape
+    k = k_pages.reshape(b, n_pages * page, n_kv, hd)[:, :length]
+    v = v_pages.reshape(b, n_pages * page, n_kv, hd)[:, :length]
+    return k, v
+
+
 def page_keys(prefix, layer, kind, n_pages):
     """Content-addressed store keys for a sequence's pages, one namespace
     per (layer, k/v) — mirrors vLLM's per-layer block keys
     (design.rst:54-63)."""
     return [f"{prefix}/L{layer}/{kind}/p{i}" for i in range(n_pages)]
+
+
+def restore_prefix_kvs(store, cfg: LlamaConfig, seq_id, n_pages):
+    """Restore a matched prefix from the store into the per-layer
+    contiguous (k, v) list `prefill_with_prefix` consumes — the
+    documented cache-HIT recipe after `store.cached_prefix_len` reports
+    `n_pages` hits for `seq_id`. `store` is a TpuKVStore (duck-typed:
+    needs get_kv_pages). Batch dim is 1 (one sequence per key prefix,
+    as vLLM's block tables are per-sequence)."""
+    prefix_kvs = []
+    for li in range(cfg.n_layers):
+        kp = store.get_kv_pages(
+            page_keys(seq_id, li, "k", n_pages), cfg.kv_page_shape(),
+            cfg.jdtype,
+        )
+        vp = store.get_kv_pages(
+            page_keys(seq_id, li, "v", n_pages), cfg.kv_page_shape(),
+            cfg.jdtype,
+        )
+        prefix_kvs.append(
+            pages_to_kv(
+                cfg, jnp.asarray(kp)[None], jnp.asarray(vp)[None],
+                n_pages * cfg.page_size,
+            )
+        )
+    return prefix_kvs

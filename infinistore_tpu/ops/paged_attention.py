@@ -62,9 +62,19 @@ def _repeat_kv(x, n_rep):
 def prefill_attention(q, k, v, causal=True):
     """Dense causal attention for prefill.
 
-    q,k,v: [batch, seq, heads, hd] (k/v may have fewer heads — GQA).
-    Returns [batch, seq, heads, hd]. fp32 softmax accumulation.
+    q: [batch, s_q, heads, hd]; k/v: [batch, s_kv, kv_heads, hd] (GQA).
+    s_kv may exceed s_q — prefix-cached prefill, where suffix queries
+    attend over restored-prefix + suffix KV; the causal diagonal shifts
+    right by s_kv - s_q (query i sees kv j <= i + prefix_len).
+    Returns [batch, s_q, heads, hd]. fp32 softmax accumulation.
     """
+    if causal and k.shape[1] < q.shape[1]:
+        # Same guard as the pallas path (_forward_impl): fully-masked
+        # query rows would otherwise return garbage silently.
+        raise ValueError(
+            f"causal attention needs kv_len >= q_len, got "
+            f"{k.shape[1]} < {q.shape[1]}"
+        )
     n_rep = q.shape[2] // k.shape[2]
     k = _repeat_kv(k, n_rep)
     v = _repeat_kv(v, n_rep)
@@ -75,8 +85,10 @@ def prefill_attention(q, k, v, causal=True):
         precision=precision,
     ) * scale
     if causal:
-        s = q.shape[1]
-        mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+        s_q, s_kv = q.shape[1], k.shape[1]
+        pos_q = jnp.arange(s_q)[:, None]
+        pos_k = jnp.arange(s_kv)[None, :]
+        mask = pos_k <= pos_q + (s_kv - s_q)
         logits = jnp.where(mask[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v, precision=precision)

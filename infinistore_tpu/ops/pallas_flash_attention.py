@@ -42,7 +42,7 @@ _NEG_INF = -1e30
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, *rest,
-            bq, bk, seq_len, scale, causal, with_lse=False):
+            bq, bk, q_len, kv_len, scale, causal, with_lse=False):
     if with_lse:  # extra lse output slot before the scratch refs
         lse_ref, acc_ref, m_ref, l_ref = rest
     else:
@@ -61,7 +61,10 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *rest,
     q_start = qi * bq
     k_start = ki * bk
     # A kv block strictly above the causal diagonal contributes nothing.
-    live = (k_start <= q_start + bq - 1) if causal else (ki >= 0)
+    # With a cached prefix (kv_len > q_len) the diagonal shifts right by
+    # the prefix length: query row i may see kv columns <= i + offset.
+    offset = kv_len - q_len
+    live = (k_start <= q_start + bq - 1 + offset) if causal else (ki >= 0)
 
     @pl.when(live)
     def _step():
@@ -74,7 +77,8 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *rest,
             preferred_element_type=jnp.float32,
             precision=precision,
         ) * scale  # [BQ, BK] f32
-        mask = _tile_mask(logits.shape, q_start, k_start, seq_len, causal)
+        mask = _tile_mask(logits.shape, q_start, k_start, q_len, kv_len,
+                          causal)
         logits = jnp.where(mask, logits, _NEG_INF)
 
         m_prev = m_ref[...]  # [BQ, 1]
@@ -119,21 +123,25 @@ def _auto_block(seq_len):
     return min(512, ((seq_len + 127) // 128) * 128)
 
 
-def _tile_mask(shape, q_start, k_start, seq_len, causal):
+def _tile_mask(shape, q_start, k_start, q_len, kv_len, causal):
     """Validity mask for one [BQ, BK] logits tile: padded query and key
     positions are dead, plus the causal triangle. ONE definition shared
     by the forward and both backward kernels — forward/backward masks
-    must never diverge."""
+    must never diverge.
+
+    kv_len may exceed q_len (prefix-cached prefill: suffix queries over
+    prefix + suffix KV); the causal diagonal then shifts right by the
+    prefix length kv_len - q_len."""
     pos_q = q_start + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
     pos_k = k_start + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
-    mask = jnp.logical_and(pos_k < seq_len, pos_q < seq_len)
+    mask = jnp.logical_and(pos_k < kv_len, pos_q < q_len)
     if causal:
-        mask = jnp.logical_and(mask, pos_k <= pos_q)
+        mask = jnp.logical_and(mask, pos_k <= pos_q + (kv_len - q_len))
     return mask
 
 
-def _bwd_tile(q, k, v, do, lse, dvec, q_start, k_start, seq_len, scale,
-              causal):
+def _bwd_tile(q, k, v, do, lse, dvec, q_start, k_start, q_len, kv_len,
+              scale, causal):
     """Shared backward tile recompute: probabilities p from q/k + saved
     lse, and dS = P * (dP - D) * scale. Returns (p, ds, precision)."""
     precision = xla_ref.matmul_precision(q.dtype)
@@ -141,7 +149,7 @@ def _bwd_tile(q, k, v, do, lse, dvec, q_start, k_start, seq_len, scale,
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32, precision=precision,
     ) * scale
-    mask = _tile_mask(logits.shape, q_start, k_start, seq_len, causal)
+    mask = _tile_mask(logits.shape, q_start, k_start, q_len, kv_len, causal)
     logits = jnp.where(mask, logits, _NEG_INF)
     p = jnp.exp(logits - lse)  # the forward's exact probabilities
     dp = jax.lax.dot_general(
@@ -152,7 +160,8 @@ def _bwd_tile(q, k, v, do, lse, dvec, q_start, k_start, seq_len, scale,
     return p, ds, precision
 
 
-def _make_row_maps(n_heads, n_kv, group, block_q, block_k, causal):
+def _make_row_maps(n_heads, n_kv, group, block_q, block_k, causal,
+                   offset=0):
     """Index-map closures shared by forward and backward pallas calls.
 
     _kv_row: grid row (b, h) → GQA kv row (b, h // group).
@@ -161,6 +170,9 @@ def _make_row_maps(n_heads, n_kv, group, block_q, block_k, causal):
     and the repeated index lets pallas elide the HBM fetch entirely.
     _q_idx (q sweep innermost): mirror image — q blocks strictly below
     the diagonal freeze at the first live one.
+
+    `offset` = kv_len - q_len (a cached prefix shifts the causal
+    diagonal right: query row i sees kv columns <= i + offset).
     """
 
     def _kv_row(r):
@@ -168,13 +180,13 @@ def _make_row_maps(n_heads, n_kv, group, block_q, block_k, causal):
 
     def _kv_idx(r, qi, ki):
         if causal:
-            last_live = (qi * block_q + block_q - 1) // block_k
+            last_live = (qi * block_q + block_q - 1 + offset) // block_k
             ki = jnp.minimum(ki, last_live)
         return (_kv_row(r), ki, 0)
 
     def _q_idx(r, ki, qi):
         if causal:
-            first_live = (ki * block_k) // block_q
+            first_live = jnp.maximum(ki * block_k - offset, 0) // block_q
             qi = jnp.maximum(qi, first_live)
         return (r, qi, 0)
 
@@ -190,10 +202,15 @@ def _layout_rows(x, heads, block):
 
 
 def _forward_impl(q, k, v, causal, block_q, block_k, interpret, with_lse):
-    batch, seq_len, n_heads, hd = q.shape
+    batch, q_len, n_heads, hd = q.shape
+    kv_len = k.shape[1]
     n_kv = k.shape[2]
     group = n_heads // n_kv
     scale = hd ** -0.5
+    if causal and kv_len < q_len:
+        raise ValueError(
+            f"causal attention needs kv_len >= q_len, got {kv_len} < {q_len}"
+        )
 
     qf = _layout_rows(q, n_heads, block_q)
     kf = _layout_rows(k, n_kv, block_k)
@@ -202,7 +219,8 @@ def _forward_impl(q, k, v, causal, block_q, block_k, interpret, with_lse):
     nq = qf.shape[1] // block_q
     nk = kf.shape[1] // block_k
     _, _kv_idx, _ = _make_row_maps(
-        n_heads, n_kv, group, block_q, block_k, causal
+        n_heads, n_kv, group, block_q, block_k, causal,
+        offset=kv_len - q_len,
     )
 
     out_shapes = [jax.ShapeDtypeStruct(qf.shape, q.dtype)]
@@ -219,8 +237,8 @@ def _forward_impl(q, k, v, causal, block_q, block_k, interpret, with_lse):
 
     res = pl.pallas_call(
         functools.partial(
-            _kernel, bq=block_q, bk=block_k, seq_len=seq_len, scale=scale,
-            causal=causal, with_lse=with_lse,
+            _kernel, bq=block_q, bk=block_k, q_len=q_len, kv_len=kv_len,
+            scale=scale, causal=causal, with_lse=with_lse,
         ),
         out_shape=out_shapes,
         grid=(batch * n_heads, nq, nk),
@@ -237,15 +255,15 @@ def _forward_impl(q, k, v, causal, block_q, block_k, interpret, with_lse):
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    out = res[0][:, :seq_len, :hd]
-    out = out.reshape(batch, n_heads, seq_len, hd).transpose(0, 2, 1, 3)
+    out = res[0][:, :q_len, :hd]
+    out = out.reshape(batch, n_heads, q_len, hd).transpose(0, 2, 1, 3)
     if not with_lse:
         return out
     # Residual logsumexp as unpadded [B, H, S] (lane 0 of the replicated
     # tile); padded rows are sliced off here and re-padded with ZEROS in
     # the backward — a padded row's raw lse is -inf (log 0), which would
     # turn the backward's exp/multiply chain into NaNs.
-    lse = res[1][:, :seq_len, 0].reshape(batch, n_heads, seq_len)
+    lse = res[1][:, :q_len, 0].reshape(batch, n_heads, q_len)
     return out, lse
 
 
@@ -257,19 +275,21 @@ def flash_prefill_attention(q, k, v, causal=True, block_q=None, block_k=None,
     """Flash prefill attention (same contract as
     paged_attention.prefill_attention).
 
-    q: [batch, seq, n_heads, hd]; k/v: [batch, seq, n_kv, hd] (GQA —
-    n_heads must be a multiple of n_kv). Returns [batch, seq, n_heads, hd].
+    q: [batch, s_q, n_heads, hd]; k/v: [batch, s_kv, n_kv, hd] (GQA —
+    n_heads must be a multiple of n_kv). Returns [batch, s_q, n_heads, hd].
+    s_kv may exceed s_q (prefix-cached prefill: suffix queries attending
+    over restored-prefix + suffix KV); under `causal` the diagonal then
+    shifts right by s_kv - s_q, i.e. query i sees kv j <= i + prefix_len.
 
     block_q/block_k default to min(512, seq rounded up to 128): measured
     on v5e, 512x512 runs ~13x faster than 128x128 at S=4096 (per-step
     grid overhead dominates small blocks) and 4x faster than the XLA
     path; smaller sequences shrink the block to avoid padding waste.
     """
-    seq_len = q.shape[1]
     if block_q is None:
-        block_q = _auto_block(seq_len)
+        block_q = _auto_block(q.shape[1])
     if block_k is None:
-        block_k = _auto_block(seq_len)
+        block_k = _auto_block(k.shape[1])
     return _forward_impl(
         q, k, v, causal, block_q, block_k, interpret, with_lse=False
     )
@@ -296,7 +316,7 @@ def flash_prefill_attention(q, k, v, causal=True, block_q=None, block_k=None,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
-                   dq_acc, *, bq, bk, seq_len, scale, causal):
+                   dq_acc, *, bq, bk, q_len, kv_len, scale, causal):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -307,7 +327,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
 
     q_start = qi * bq
     k_start = ki * bk
-    live = (k_start <= q_start + bq - 1) if causal else (ki >= 0)
+    offset = kv_len - q_len
+    live = (k_start <= q_start + bq - 1 + offset) if causal else (ki >= 0)
 
     @pl.when(live)
     def _step():
@@ -315,7 +336,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
         _, ds, precision = _bwd_tile(
             q_ref[0], k, v_ref[0], do_ref[0],
             lse_ref[0][:, :1], d_ref[0][:, :1],  # lane-replicated tiles
-            q_start, k_start, seq_len, scale, causal,
+            q_start, k_start, q_len, kv_len, scale, causal,
         )
         dq_acc[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -329,7 +350,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, d_ref, k_ref, v_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    bq, bk, seq_len, scale, causal):
+                    bq, bk, q_len, kv_len, scale, causal):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -341,7 +362,8 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, d_ref, k_ref, v_ref,
 
     q_start = qi * bq
     k_start = ki * bk
-    live = (q_start + bq - 1 >= k_start) if causal else (qi >= 0)
+    offset = kv_len - q_len
+    live = (q_start + bq - 1 + offset >= k_start) if causal else (qi >= 0)
 
     @pl.when(live)
     def _step():
@@ -350,7 +372,7 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, d_ref, k_ref, v_ref,
         p, ds, precision = _bwd_tile(
             q, k_ref[0], v_ref[0], do,
             lse_ref[0][:, :1], d_ref[0][:, :1],
-            q_start, k_start, seq_len, scale, causal,
+            q_start, k_start, q_len, kv_len, scale, causal,
         )
         # dV += P^T @ dO — contract the BQ axis of both (no transpose).
         dv_acc[...] += jax.lax.dot_general(
@@ -372,14 +394,15 @@ def _flash_backward(q, k, v, o, lse, g, causal, interpret,
                     block_q=None, block_k=None):
     """O(S)-memory gradients from the saved residuals. Returns
     (dq, dk, dv) with the input shapes/dtypes."""
-    batch, seq_len, n_heads, hd = q.shape
+    batch, q_len, n_heads, hd = q.shape
+    kv_len = k.shape[1]
     n_kv = k.shape[2]
     group = n_heads // n_kv
     scale = hd ** -0.5
     if block_q is None:
-        block_q = _auto_block(seq_len)
+        block_q = _auto_block(q_len)
     if block_k is None:
-        block_k = _auto_block(seq_len)
+        block_k = _auto_block(kv_len)
 
     qf = _layout_rows(q, n_heads, block_q)
     dof = _layout_rows(g, n_heads, block_q)
@@ -395,8 +418,8 @@ def _flash_backward(q, k, v, o, lse, g, causal, interpret,
     # Row scalars, lane-replicated; padded rows become ZERO (not -inf /
     # NaN), which the masked kernels turn into exactly-zero contributions.
     dvec = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    dvec = dvec.transpose(0, 2, 1).reshape(bh, seq_len)  # [BH, S]
-    lsef = lse.reshape(bh, seq_len)
+    dvec = dvec.transpose(0, 2, 1).reshape(bh, q_len)  # [BH, S]
+    lsef = lse.reshape(bh, q_len)
     dvec = jnp.broadcast_to(
         _pad_axis(dvec, 1, block_q)[..., None], (bh, sq_p, 128)
     )
@@ -405,7 +428,8 @@ def _flash_backward(q, k, v, o, lse, g, causal, interpret,
     )
 
     _kv_row, _kv_idx, _q_idx_b = _make_row_maps(
-        n_heads, n_kv, group, block_q, block_k, causal
+        n_heads, n_kv, group, block_q, block_k, causal,
+        offset=kv_len - q_len,
     )
 
     # --- kernel A: dq (kv sweep innermost, like the forward) ---
@@ -414,8 +438,8 @@ def _flash_backward(q, k, v, o, lse, g, causal, interpret,
 
     dqf = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, bq=block_q, bk=block_k, seq_len=seq_len,
-            scale=scale, causal=causal,
+            _bwd_dq_kernel, bq=block_q, bk=block_k, q_len=q_len,
+            kv_len=kv_len, scale=scale, causal=causal,
         ),
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
         grid=(bh, nq, nk),
@@ -431,7 +455,7 @@ def _flash_backward(q, k, v, o, lse, g, causal, interpret,
         scratch_shapes=[pltpu.VMEM((block_q, hd_p), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, dof, lsef, dvec)
-    dq = dqf[:, :seq_len, :hd].reshape(batch, n_heads, seq_len, hd)
+    dq = dqf[:, :q_len, :hd].reshape(batch, n_heads, q_len, hd)
     dq = dq.transpose(0, 2, 1, 3)
 
     # --- kernel B: dk/dv per q-head (q sweep innermost), then GQA-sum ---
@@ -443,8 +467,8 @@ def _flash_backward(q, k, v, o, lse, g, causal, interpret,
 
     dkf, dvf = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, bq=block_q, bk=block_k, seq_len=seq_len,
-            scale=scale, causal=causal,
+            _bwd_dkv_kernel, bq=block_q, bk=block_k, q_len=q_len,
+            kv_len=kv_len, scale=scale, causal=causal,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk_p, hd_p), k.dtype),
@@ -470,8 +494,8 @@ def _flash_backward(q, k, v, o, lse, g, causal, interpret,
         interpret=interpret,
     )(qf, dof, lsef, dvec, kf, vf)
     # Per-q-head grads → sum the GQA group onto each kv head.
-    dk = dkf[:, :seq_len, :hd].reshape(batch, n_kv, group, seq_len, hd)
-    dv = dvf[:, :seq_len, :hd].reshape(batch, n_kv, group, seq_len, hd)
+    dk = dkf[:, :kv_len, :hd].reshape(batch, n_kv, group, kv_len, hd)
+    dv = dvf[:, :kv_len, :hd].reshape(batch, n_kv, group, kv_len, hd)
     dk = dk.sum(axis=2).transpose(0, 2, 1, 3).astype(k.dtype)
     dv = dv.sum(axis=2).transpose(0, 2, 1, 3).astype(v.dtype)
     return dq, dk, dv
@@ -484,9 +508,9 @@ def _flash_with_vjp(q, k, v, causal, interpret):
 
 
 def _flash_fwd(q, k, v, causal, interpret):
-    block = _auto_block(q.shape[1])
     out, lse = _forward_impl(
-        q, k, v, causal, block, block, interpret, with_lse=True
+        q, k, v, causal, _auto_block(q.shape[1]), _auto_block(k.shape[1]),
+        interpret, with_lse=True,
     )
     return out, (q, k, v, out, lse)
 

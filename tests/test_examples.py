@@ -1,0 +1,15 @@
+"""The shipped examples must actually run (reference keeps its examples
+working against a live server; here they run hardware-free against the
+in-process loopback server — demo_prefill covers the full
+prefill→upload→match→restore→decode flow plus the prefix-cache-HIT
+suffix prefill)."""
+
+
+def test_demo_prefill_runs_end_to_end(server, capsys):
+    from infinistore_tpu.example import demo_prefill
+
+    demo_prefill.run("127.0.0.1", server.service_port, seq_len=32)
+    out = capsys.readouterr().out
+    assert "prefill: 32 tokens" in out
+    assert "restored KV" in out
+    assert "prefix hit:" in out

@@ -19,12 +19,14 @@ from infinistore_tpu.ops.paged_attention import prefill_attention
 def _ref64(q, k, v, causal):
     q, k, v = (np.asarray(x, np.float64) for x in (q, k, v))
     B, S, H, D = q.shape
+    SK = k.shape[1]
     KV = k.shape[2]
     k = np.repeat(k, H // KV, axis=2)
     v = np.repeat(v, H // KV, axis=2)
     logits = np.einsum("bqhd,bkhd->bhqk", q, k) * D ** -0.5
     if causal:
-        mask = np.tril(np.ones((S, S), bool))
+        # Rectangular causal: query i sees kv j <= i + (SK - S).
+        mask = np.arange(SK)[None, :] <= np.arange(S)[:, None] + (SK - S)
         logits = np.where(mask[None, None], logits, -1e30)
     p = np.exp(logits - logits.max(-1, keepdims=True))
     p /= p.sum(-1, keepdims=True)
@@ -96,6 +98,91 @@ def test_chooser_falls_back_off_tpu():
     out = flash_prefill(q, k, v, causal=True)
     ref = prefill_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+PREFIX_CASES = [
+    # (batch, s_q, prefix, heads, kv_heads, hd, dtype)
+    (1, 128, 128, 4, 4, 64, jnp.float32),    # one extra kv block
+    (2, 128, 384, 8, 2, 64, jnp.float32),    # GQA, long prefix
+    (1, 100, 60, 4, 4, 80, jnp.float32),     # both axes padded
+    (1, 128, 256, 8, 4, 128, jnp.bfloat16),  # bf16
+    (1, 256, 16, 4, 4, 64, jnp.float32),     # prefix < one block
+]
+
+
+@pytest.mark.parametrize("case", PREFIX_CASES)
+def test_prefix_offset_matches_f64_reference(case):
+    """Rectangular causal (prefix-cached prefill): suffix queries over
+    prefix + suffix KV; diagonal shifted right by the prefix length."""
+    B, S, P, H, KV, D, dtype = case
+    rng = np.random.default_rng(21)
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), dtype)
+    k = jnp.asarray(rng.standard_normal((B, P + S, KV, D)), dtype)
+    v = jnp.asarray(rng.standard_normal((B, P + S, KV, D)), dtype)
+    out = flash_prefill_attention(
+        q, k, v, causal=True, block_q=128, block_k=128, interpret=True
+    )
+    gt = _ref64(q, k, v, True)
+    err = float(np.abs(np.asarray(out, np.float64) - gt).max())
+    tol = 2e-2 if dtype == jnp.bfloat16 else 1e-5
+    assert err < tol, (case, err)
+    # The XLA fallback path must agree on the same rectangular contract.
+    ref = prefill_attention(q, k, v, causal=True)
+    err2 = float(np.abs(np.asarray(ref, np.float64) - gt).max())
+    assert err2 < tol, (case, err2)
+
+
+def test_prefix_offset_equals_full_prefill_suffix():
+    """Suffix rows of a full square prefill == rectangular prefill of the
+    suffix over the full KV — the identity the cache-hit path rests on."""
+    rng = np.random.default_rng(23)
+    B, P, S, H, D = 1, 192, 128, 4, 64
+    q = jnp.asarray(rng.standard_normal((B, P + S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, P + S, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, P + S, H, D)), jnp.float32)
+    full = flash_prefill_attention(
+        q, k, v, causal=True, block_q=128, block_k=128, interpret=True
+    )
+    tail = flash_prefill_attention(
+        q[:, P:], k, v, causal=True, block_q=128, block_k=128,
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(tail), np.asarray(full[:, P:]), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_prefix_backward_matches_xla_grads():
+    """The recompute backward must honor the shifted diagonal too."""
+    from infinistore_tpu.ops.pallas_flash_attention import _flash_with_vjp
+
+    rng = np.random.default_rng(29)
+    B, S, P, H, KV, D = 1, 128, 192, 4, 2, 64
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, P + S, KV, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, P + S, KV, D)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+
+    def loss_kernel(q, k, v):
+        return jnp.sum(_flash_with_vjp(q, k, v, True, True) * w)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(prefill_attention(q, k, v, causal=True) * w)
+
+    gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gk, gx):
+        err = float(
+            np.abs(np.asarray(a, np.float64) - np.asarray(b, np.float64)).max()
+        )
+        assert err < 1e-3, (name, err)
+
+
+def test_causal_rejects_kv_shorter_than_q():
+    q = jnp.zeros((1, 128, 4, 64), jnp.float32)
+    k = jnp.zeros((1, 64, 4, 64), jnp.float32)
+    with pytest.raises(ValueError, match="kv_len >= q_len"):
+        flash_prefill_attention(q, k, k, causal=True, interpret=True)
 
 
 def test_gradients_through_kernel_path():

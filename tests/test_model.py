@@ -132,6 +132,84 @@ def test_kv_pages_store_roundtrip(params, cfg, shm_conn):
         assert np.array_equal(np.asarray(got_v), np.asarray(vp[0]))
 
 
+def test_prefill_with_prefix_matches_full(params, cfg):
+    """Suffix prefill over cached prefix KV must reproduce the full
+    prefill's suffix logits AND suffix KV — the cache-hit path is a
+    FLOP-saving identity, not an approximation."""
+    rng = np.random.default_rng(3)
+    p_len, s_new = 24, 16
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (2, p_len + s_new)), dtype=jnp.int32
+    )
+    full_logits, full_kvs = llama.prefill(params, cfg, tokens)
+
+    _, prefix_kvs = llama.prefill(params, cfg, tokens[:, :p_len])
+    tail_logits, tail_kvs = llama.prefill_with_prefix(
+        params, cfg, tokens[:, p_len:], prefix_kvs
+    )
+    np.testing.assert_allclose(
+        np.asarray(tail_logits),
+        np.asarray(full_logits[:, p_len:]),
+        rtol=2e-4, atol=2e-4,
+    )
+    for (tk, tv), (fk, fv) in zip(tail_kvs, full_kvs):
+        np.testing.assert_allclose(
+            np.asarray(tk), np.asarray(fk[:, p_len:]), rtol=2e-4, atol=2e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(tv), np.asarray(fv[:, p_len:]), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_prefix_cache_hit_flow(params, cfg, shm_conn):
+    """The full vLLM cache-HIT loop against a real store: prefill A,
+    page out; a second request shares A's prefix — match → restore pages
+    → pages_to_kv → suffix-only prefill — and must land on the same
+    logits as prefilling from scratch."""
+    from infinistore_tpu.tpu import TpuKVStore
+
+    store = TpuKVStore(shm_conn)
+    rng = np.random.default_rng(5)
+    p_len = 16  # two pages — page-aligned prefix, as vLLM guarantees
+    s_new = 8
+    prefix_tokens = rng.integers(0, cfg.vocab_size, (1, p_len))
+    tokens = jnp.asarray(
+        np.concatenate(
+            [prefix_tokens, rng.integers(0, cfg.vocab_size, (1, s_new))],
+            axis=1,
+        ),
+        dtype=jnp.int32,
+    )
+
+    # Request 1: prefill the prefix, page it out to the store.
+    seq = f"pfx_{uuid.uuid4()}"
+    _, kvs = llama.prefill(params, cfg, tokens[:, :p_len])
+    n_pages = p_len // cfg.page_size
+    for li, (k, v) in enumerate(kvs):
+        kp, vp = llama.kv_to_pages(cfg, k, v)
+        store.put_kv_pages(llama.page_keys(seq, li, "k", n_pages), kp[0])
+        store.put_kv_pages(llama.page_keys(seq, li, "v", n_pages), vp[0])
+    shm_conn.sync()
+
+    # Request 2: detect the hit, restore, suffix-prefill.
+    want_pages = (p_len + s_new + cfg.page_size - 1) // cfg.page_size
+    hit = store.cached_prefix_len(
+        llama.page_keys(seq, 0, "k", want_pages)
+    )
+    assert hit == n_pages
+    prefix_kvs = llama.restore_prefix_kvs(store, cfg, seq, hit)
+    tail_logits, _ = llama.prefill_with_prefix(
+        params, cfg, tokens[:, p_len:], prefix_kvs
+    )
+
+    full_logits, _ = llama.prefill(params, cfg, tokens)
+    np.testing.assert_allclose(
+        np.asarray(tail_logits),
+        np.asarray(full_logits[:, p_len:]),
+        rtol=2e-4, atol=2e-4,
+    )
+
+
 def test_scatter_kv_to_pages():
     pages = jnp.zeros((4, 8, 2, 4))
     new = jnp.ones((2, 1, 2, 4))
