@@ -306,6 +306,23 @@ def page_keys(prefix, layer, kind, n_pages):
     return [f"{prefix}/L{layer}/{kind}/p{i}" for i in range(n_pages)]
 
 
+def restore_prefix_pages(store, cfg: LlamaConfig, key_fn, n_pages):
+    """Restore a matched prefix from the store in PAGE form: the one
+    get_kv_pages recipe every cache-hit consumer shares. `key_fn(layer,
+    kind)` returns that (layer, kind)'s n_pages keys (index-addressed
+    `page_keys` or the serving engine's content-addressed keys).
+    Returns (k_pages, v_pages) [n_layers, n_pages, page, n_kv, hd]."""
+    kp, vp = [], []
+    for li in range(cfg.n_layers):
+        kp.append(store.get_kv_pages(
+            key_fn(li, "k"), cfg.kv_page_shape(), cfg.jdtype,
+        ))
+        vp.append(store.get_kv_pages(
+            key_fn(li, "v"), cfg.kv_page_shape(), cfg.jdtype,
+        ))
+    return jnp.stack(kp), jnp.stack(vp)
+
+
 def restore_prefix_kvs(store, cfg: LlamaConfig, seq_id, n_pages):
     """Restore a matched prefix from the store into the per-layer
     contiguous (k, v) list `prefill_with_prefix` consumes — the
@@ -313,20 +330,12 @@ def restore_prefix_kvs(store, cfg: LlamaConfig, seq_id, n_pages):
     `n_pages` hits for `seq_id`. `store` is a TpuKVStore (duck-typed:
     needs get_kv_pages). Batch dim is 1 (one sequence per key prefix,
     as vLLM's block tables are per-sequence)."""
-    prefix_kvs = []
-    for li in range(cfg.n_layers):
-        kp = store.get_kv_pages(
-            page_keys(seq_id, li, "k", n_pages), cfg.kv_page_shape(),
-            cfg.jdtype,
-        )
-        vp = store.get_kv_pages(
-            page_keys(seq_id, li, "v", n_pages), cfg.kv_page_shape(),
-            cfg.jdtype,
-        )
-        prefix_kvs.append(
-            pages_to_kv(
-                cfg, jnp.asarray(kp)[None], jnp.asarray(vp)[None],
-                n_pages * cfg.page_size,
-            )
-        )
-    return prefix_kvs
+    kp, vp = restore_prefix_pages(
+        store, cfg, lambda li, kind: page_keys(seq_id, li, kind, n_pages),
+        n_pages,
+    )
+    return [
+        pages_to_kv(cfg, kp[li][None], vp[li][None],
+                    n_pages * cfg.page_size)
+        for li in range(cfg.n_layers)
+    ]

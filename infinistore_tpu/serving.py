@@ -1,0 +1,433 @@
+"""Continuous-batching serving engine over the paged-KV store.
+
+The reference stops at the store API and leaves the engine to vLLM
+(reference docs/source/design.rst:54-63 describes the engine-side loop it
+expects: get_match_last_index → restore → prefill the tail → decode →
+offload). This module IS that loop, TPU-native — the consumer that turns
+the store's primitives into end-to-end serving:
+
+- **Slot-based continuous batching**: a fixed batch of `max_slots`
+  sequences decodes in lockstep through ONE jitted `decode_step` (static
+  shapes — one compile, any request mix); requests are admitted into free
+  slots as others finish, vLLM-style.
+- **Paged HBM pool**: KV lives in fixed-size pages [n_layers,
+  total_pages, page, n_kv, hd] with a host-side free list and per-slot
+  page tables; pages are allocated on demand as sequences grow.
+- **Prefix-cache HIT admission**: page keys are content-addressed (a
+  hash chain over token ids, vLLM-style — see `content_page_keys`), so
+  any request whose prompt extends a cached token prefix automatically
+  restores those pages straight into the pool and prefills ONLY the
+  un-cached tail via the rectangular flash kernel
+  (models.llama.prefill_with_prefix) — no prefix recompute, no
+  caller-side sequence-id coordination.
+- **Offload on finish**: completed sequences' full pages go back to the
+  store (first-writer-wins dedup makes repeats free), so the next request
+  sharing the prompt — e.g. the next turn of the same conversation —
+  hits.
+
+TPU-first choices: decode is one fixed-shape jit over all slots (inactive
+slots scatter into a sacrificial scratch page and their logits are
+ignored on host); prefill lengths are bucketed to page multiples so the
+jit cache stays small; pool writes are a fixed-arity donated jit with
+out-of-range page ids dropped — no recompilation as counts vary.
+"""
+
+import hashlib
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .models import llama
+
+
+def content_page_digests(tokens, page_size, n_pages, namespace=""):
+    """Per-page content digests, vLLM-style: digest i is the hash CHAIN
+    over `namespace` plus all tokens up to the end of page i, so two
+    requests share exactly the pages whose full token prefix (and model
+    namespace) is identical — no caller-side sequence-id coordination,
+    and a divergent prompt can never restore another sequence's KV
+    (SURVEY §5: 'sequences become many fixed-size pages addressed by
+    content keys'). `namespace` must identify everything that shapes the
+    bytes: model/checkpoint id, page_size, dtype (see
+    ServingEngine._namespace) — without it, two engines with different
+    weights sharing one store would cross-hit each other's KV.
+
+    The digest is layer/kind-independent: compute it ONCE per sequence
+    and format the per-(layer, kind) keys with `content_page_keys`."""
+    digests = []
+    h = hashlib.sha256(namespace.encode())
+    for i in range(n_pages):
+        chunk = np.asarray(
+            tokens[i * page_size:(i + 1) * page_size], dtype=np.int32
+        )
+        h.update(chunk.tobytes())
+        digests.append(h.hexdigest()[:32])
+    return digests
+
+
+def content_page_keys(tokens, page_size, n_pages, layer, kind,
+                      namespace="", digests=None):
+    """Store keys for one (layer, kind) from content digests (computed
+    here unless the caller passes precomputed `digests`)."""
+    if digests is None:
+        digests = content_page_digests(tokens, page_size, n_pages,
+                                       namespace)
+    return [f"cp/{d}/L{layer}/{kind}" for d in digests]
+
+
+@dataclass(frozen=True)
+class ServingConfig:
+    max_slots: int = 4           # concurrent sequences (the static batch)
+    total_pages: int = 64        # HBM pool capacity (page 0 is scratch)
+    max_pages_per_seq: int = 16  # page-table width (compile-time budget)
+    eos_id: int = -1             # -1: no EOS, run to max_new_tokens
+    model_id: str = "default"    # distinct per checkpoint: part of the
+    #                              store-key namespace; engines with
+    #                              different weights sharing one store
+    #                              MUST use different model_ids
+
+
+@dataclass
+class Request:
+    request_id: str
+    prompt: list              # token ids
+    max_new_tokens: int = 16
+    cache: bool = True        # use the store for prefix reuse + offload
+
+
+@dataclass
+class _Slot:
+    req: Request
+    page_ids: list            # pool pages owned, in sequence order
+    seq_len: int              # tokens whose KV is in pages (incl. current step's input after the step)
+    cached_pages: int = 0     # pages restored from the store at admission
+    generated: list = field(default_factory=list)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _write_pages(k_pool, v_pool, ids, k_new, v_new):
+    """Scatter per-layer pages into the pool at `ids` ([m] int32; entries
+    == total_pages are out of range and dropped — fixed arity, no
+    recompiles as counts vary). k_new/v_new: [L, m, page, n_kv, hd]."""
+    k_pool = k_pool.at[:, ids].set(k_new, mode="drop")
+    v_pool = v_pool.at[:, ids].set(v_new, mode="drop")
+    return k_pool, v_pool
+
+
+class ServingEngine:
+    """Continuous-batching engine serving `models.llama` over the store.
+
+    `store` is a TpuKVStore (or None for store-less serving). Greedy
+    decoding; sampling is the caller's concern (logits hooks can be
+    added without touching the scheduler).
+    """
+
+    def __init__(self, params, cfg: llama.LlamaConfig, sconfig=None,
+                 store=None):
+        self.params = params
+        self.cfg = cfg
+        self.sc = sconfig or ServingConfig()
+        self.store = store
+        L = cfg.n_layers
+        shape = (L, self.sc.total_pages, cfg.page_size, cfg.n_kv_heads,
+                 cfg.head_dim)
+        self.k_pages = jnp.zeros(shape, dtype=cfg.jdtype)
+        self.v_pages = jnp.zeros_like(self.k_pages)
+        # Page 0 is the scratch page: inactive decode slots scatter their
+        # garbage KV there; sequences never own it.
+        self.free_pages = list(range(1, self.sc.total_pages))
+        self.page_table = np.zeros(
+            (self.sc.max_slots, self.sc.max_pages_per_seq), dtype=np.int32
+        )
+        self.slots = [None] * self.sc.max_slots
+        self.queue = []
+        self.outputs = {}
+        self.stats = {
+            "requests": 0, "prefix_hit_pages": 0, "restored_pages": 0,
+            "prefill_tokens": 0, "decode_steps": 0, "decoded_tokens": 0,
+            "offloaded_pages": 0,
+        }
+        self._prefill = jax.jit(partial(llama.prefill, params, cfg))
+        self._prefill_px = jax.jit(
+            partial(llama.prefill_with_prefix, params, cfg)
+        )
+        # Everything that shapes page BYTES goes into the key namespace:
+        # engines differing in any of these must never cross-hit.
+        self._ns = (
+            f"{self.sc.model_id}/p{cfg.page_size}/l{cfg.n_layers}"
+            f"/kv{cfg.n_kv_heads}x{cfg.head_dim}/{cfg.dtype}"
+        )
+
+    def _digests(self, tokens, n_pages):
+        return content_page_digests(
+            tokens, self.cfg.page_size, n_pages, namespace=self._ns
+        )
+
+    # ---- admission -----------------------------------------------------
+
+    def submit(self, req: Request):
+        if len(req.prompt) < 1:
+            raise ValueError("empty prompt")
+        need = -(-(len(req.prompt) + req.max_new_tokens) // self.cfg.page_size)
+        if need > self.sc.max_pages_per_seq:
+            raise ValueError(
+                f"request needs {need} pages > max_pages_per_seq "
+                f"{self.sc.max_pages_per_seq}"
+            )
+        self.queue.append(req)
+        self.stats["requests"] += 1
+
+    def _alloc(self, n):
+        if len(self.free_pages) < n:
+            return None
+        ids, self.free_pages = self.free_pages[:n], self.free_pages[n:]
+        return ids
+
+    def _pool_write(self, ids, k_new, v_new):
+        """Write [L, n, page, kv, hd] pages into the pool at `ids`,
+        padding to the fixed arity max_pages_per_seq."""
+        m = self.sc.max_pages_per_seq
+        n = len(ids)
+        ids_p = np.full(m, self.sc.total_pages, dtype=np.int32)
+        ids_p[:n] = ids
+        pad = [(0, 0), (0, m - n)] + [(0, 0)] * (k_new.ndim - 2)
+        self.k_pages, self.v_pages = _write_pages(
+            self.k_pages, self.v_pages, jnp.asarray(ids_p),
+            jnp.pad(k_new, pad), jnp.pad(v_new, pad),
+        )
+
+    def _probe_hit(self, req):
+        """Page-granular prefix hit, capped so at least one prompt token
+        remains to prefill (the engine needs its logits)."""
+        if self.store is None or not req.cache:
+            return 0
+        cap = (len(req.prompt) - 1) // self.cfg.page_size
+        if cap == 0:
+            return 0
+        digests = self._digests(req.prompt, cap)
+        hit = self.store.cached_prefix_len(
+            content_page_keys(req.prompt, self.cfg.page_size, cap, 0, "k",
+                              digests=digests)
+        )
+        return min(hit, cap)
+
+    def _admit(self, slot_idx, req):
+        cfg = self.cfg
+        page = cfg.page_size
+        n_prompt = len(req.prompt)
+        n_pages = -(-n_prompt // page)
+        ids = self._alloc(n_pages)
+        if ids is None:
+            return False  # pool pressure: stay queued
+        try:
+            hit = self._do_admit(slot_idx, req, ids, n_prompt, n_pages)
+        except BaseException:
+            # Restore/prefill failed (store eviction race, connection
+            # loss): the pages must go back or the pool leaks.
+            self.free_pages.extend(ids)
+            raise
+        del hit
+        return True
+
+    def _do_admit(self, slot_idx, req, ids, n_prompt, n_pages):
+        cfg = self.cfg
+        page = cfg.page_size
+        hit = self._probe_hit(req)
+        prefix_kvs = None
+        if hit > 0:
+            # Restore hit pages once: page form goes into the pool,
+            # contiguous form feeds the suffix prefill. Digests are
+            # layer/kind-independent — hash the prompt ONCE.
+            digests = self._digests(req.prompt, hit)
+            kp, vp = llama.restore_prefix_pages(
+                self.store, cfg,
+                lambda li, kind: content_page_keys(
+                    req.prompt, page, hit, li, kind, digests=digests
+                ),
+                hit,
+            )
+            self._pool_write(ids[:hit], kp, vp)
+            prefix_kvs = [
+                llama.pages_to_kv(cfg, kp[li][None], vp[li][None],
+                                  hit * page)
+                for li in range(cfg.n_layers)
+            ]
+            self.stats["prefix_hit_pages"] += hit
+            self.stats["restored_pages"] += hit * cfg.n_layers * 2
+
+        # Suffix prefill, bucketed to a page multiple (causal attention
+        # makes tail padding inert for the positions we read).
+        suffix = req.prompt[hit * page:]
+        s_real = len(suffix)
+        s_pad = -(-s_real // page) * page
+        toks = np.zeros((1, s_pad), dtype=np.int32)
+        toks[0, :s_real] = suffix
+        toks = jnp.asarray(toks)
+        if prefix_kvs is None:
+            logits, kvs = self._prefill(toks)
+        else:
+            logits, kvs = self._prefill_px(toks, prefix_kvs)
+        self.stats["prefill_tokens"] += s_real
+
+        # Page out the suffix KV into the pool (real tokens only).
+        k_sfx = jnp.stack([k[:, :s_real] for k, _ in kvs])  # [L,1,s,kv,hd]
+        v_sfx = jnp.stack([v[:, :s_real] for _, v in kvs])
+        kp_s, vp_s = [], []
+        for li in range(cfg.n_layers):
+            a, b = llama.kv_to_pages(cfg, k_sfx[li], v_sfx[li])
+            kp_s.append(a[0])
+            vp_s.append(b[0])
+        self._pool_write(ids[hit:], jnp.stack(kp_s), jnp.stack(vp_s))
+
+        row = np.zeros(self.sc.max_pages_per_seq, dtype=np.int32)
+        row[:n_pages] = ids
+        self.page_table[slot_idx] = row
+
+        first = int(jnp.argmax(logits[0, s_real - 1]))
+        self.slots[slot_idx] = _Slot(
+            req=req, page_ids=ids, seq_len=n_prompt, cached_pages=hit,
+            generated=[first],
+        )
+        return hit
+
+    # ---- decode --------------------------------------------------------
+
+    def _ensure_page(self, slot_idx, slot):
+        """The KV being appended this step lands at position seq_len —
+        allocate that page on demand (vLLM-style growth)."""
+        need_idx = slot.seq_len // self.cfg.page_size
+        if need_idx < len(slot.page_ids):
+            return True
+        ids = self._alloc(1)
+        if ids is None:
+            return False
+        slot.page_ids.extend(ids)
+        self.page_table[slot_idx, need_idx] = ids[0]
+        return True
+
+    def _finish(self, slot_idx, slot):
+        req = slot.req
+        self.outputs[req.request_id] = list(slot.generated)
+        if self.store is not None and req.cache:
+            # Offload FULL pages only — partial tail pages would poison
+            # page-granular prefix matching for future requests. Keys
+            # hash prompt + generated tokens, so a future request whose
+            # prompt extends this conversation hits these pages. Pages
+            # restored at admission are already in the store
+            # (first-writer-wins) — upload only [cached_pages:].
+            n_full = slot.seq_len // self.cfg.page_size
+            lo = slot.cached_pages
+            if n_full > lo:
+                toks = list(req.prompt) + slot.generated
+                digests = self._digests(toks, n_full)
+                for li in range(self.cfg.n_layers):
+                    sel = jnp.asarray(
+                        np.asarray(slot.page_ids[lo:n_full], np.int32)
+                    )
+                    k_keys = content_page_keys(
+                        toks, self.cfg.page_size, n_full, li, "k",
+                        digests=digests,
+                    )
+                    v_keys = content_page_keys(
+                        toks, self.cfg.page_size, n_full, li, "v",
+                        digests=digests,
+                    )
+                    self.store.put_kv_pages(
+                        k_keys[lo:],
+                        jnp.take(self.k_pages[li], sel, axis=0),
+                    )
+                    self.store.put_kv_pages(
+                        v_keys[lo:],
+                        jnp.take(self.v_pages[li], sel, axis=0),
+                    )
+                self.store.conn.sync()
+                self.stats["offloaded_pages"] += n_full - lo
+        self.free_pages.extend(slot.page_ids)
+        self.slots[slot_idx] = None
+
+    def step(self):
+        """One engine iteration: admit into free slots, then decode one
+        token for every active slot. Returns #active slots decoded."""
+        for i in range(self.sc.max_slots):
+            if self.slots[i] is None and self.queue:
+                if self._admit(i, self.queue[0]):
+                    self.queue.pop(0)
+
+        active = [
+            (i, s) for i, s in enumerate(self.slots) if s is not None
+        ]
+        if not active:
+            return 0
+
+        # Sequences at max_new_tokens finish BEFORE the step (their last
+        # sampled token never needs its KV appended).
+        for i, s in list(active):
+            done = len(s.generated) >= s.req.max_new_tokens or (
+                self.sc.eos_id >= 0 and s.generated
+                and s.generated[-1] == self.sc.eos_id
+            )
+            if done:
+                self._finish(i, s)
+        active = [
+            (i, s) for i, s in enumerate(self.slots) if s is not None
+        ]
+        if not active:
+            return 0
+
+        token = np.zeros(self.sc.max_slots, dtype=np.int32)
+        seq_lens = np.zeros(self.sc.max_slots, dtype=np.int32)
+        rows = np.zeros_like(self.page_table)  # inactive → scratch page 0
+        for i, s in active:
+            if not self._ensure_page(i, s):
+                # Pool exhausted mid-decode: finish the sequence early
+                # (its generated tokens so far are the output) rather
+                # than deadlock. Offload frees nothing here — pages are
+                # returned to the free list by _finish.
+                self._finish(i, s)
+                continue
+            token[i] = s.generated[-1]
+            seq_lens[i] = s.seq_len
+            rows[i] = self.page_table[i]
+        active = [
+            (i, s) for i, s in enumerate(self.slots) if s is not None
+        ]
+        if not active:
+            return 0
+
+        logits, self.k_pages, self.v_pages = llama.decode_step(
+            self.params, self.cfg,
+            jnp.asarray(token), jnp.asarray(seq_lens),
+            self.k_pages, self.v_pages, jnp.asarray(rows),
+        )
+        nxt = np.asarray(jnp.argmax(logits, axis=-1))
+        for i, s in active:
+            s.generated.append(int(nxt[i]))
+            s.seq_len += 1
+            self.stats["decoded_tokens"] += 1
+        self.stats["decode_steps"] += 1
+        return len(active)
+
+    def run(self, requests=()):
+        """Submit `requests`, drive the loop to completion, and return
+        {request_id: generated token list}."""
+        for r in requests:
+            self.submit(r)
+        while self.queue or any(s is not None for s in self.slots):
+            before = (len(self.queue), len(self.outputs))
+            decoded = self.step()
+            progressed = decoded > 0 or (
+                (len(self.queue), len(self.outputs)) != before
+            )
+            if not progressed and not any(
+                s is not None for s in self.slots
+            ):
+                # Every slot is free so the whole pool is free: the head
+                # request still not admitting means it never will.
+                raise RuntimeError(
+                    f"request {self.queue[0].request_id} needs more pool "
+                    f"pages than exist ({self.sc.total_pages - 1} usable)"
+                )
+        return dict(self.outputs)

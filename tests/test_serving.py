@@ -1,0 +1,217 @@
+"""Continuous-batching serving engine tests: batching must be a pure
+scheduling concern (same tokens as isolated runs), the store must carry
+prefixes across requests (multi-turn hit), and pool pressure must
+degrade gracefully."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from infinistore_tpu.models import llama
+from infinistore_tpu.serving import (
+    Request,
+    ServingConfig,
+    ServingEngine,
+    content_page_keys,
+)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return llama.LlamaConfig(
+        vocab_size=128,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=128,
+        max_seq=128,
+        page_size=8,
+        dtype="float32",
+    )
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return llama.init_params(jax.random.PRNGKey(0), cfg)
+
+
+def _prompt(rng, cfg, n):
+    return [int(t) for t in rng.integers(0, cfg.vocab_size, n)]
+
+
+def _dense_greedy_reference(params, cfg, prompt, n_new):
+    """Greedy generation by re-running the dense forward each step —
+    a paged-cache-free oracle for the engine's token stream."""
+    toks = list(prompt)
+    out = []
+    for _ in range(n_new):
+        logits, _ = llama.forward_dense(
+            params, cfg, jnp.asarray([toks], dtype=jnp.int32)
+        )
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+def test_single_request_matches_dense_reference(params, cfg):
+    rng = np.random.default_rng(0)
+    prompt = _prompt(rng, cfg, 13)  # non-page-aligned on purpose
+    eng = ServingEngine(params, cfg, ServingConfig(max_slots=2))
+    out = eng.run([Request("r0", prompt, max_new_tokens=6)])
+    ref = _dense_greedy_reference(params, cfg, prompt, 6)
+    assert out["r0"] == ref
+
+
+def test_continuous_batching_equals_isolated_runs(params, cfg):
+    """5 requests of mixed lengths through 2 slots: tokens must equal
+    each request's isolated single-slot run — batching is scheduling,
+    not math."""
+    rng = np.random.default_rng(1)
+    reqs = [
+        Request(f"r{i}", _prompt(rng, cfg, n), max_new_tokens=m)
+        for i, (n, m) in enumerate(
+            [(5, 4), (16, 7), (9, 1), (24, 5), (12, 3)]
+        )
+    ]
+    eng = ServingEngine(
+        params, cfg, ServingConfig(max_slots=2, total_pages=32)
+    )
+    out = eng.run(reqs)
+    assert set(out) == {f"r{i}" for i in range(5)}
+    for r in reqs:
+        solo = ServingEngine(params, cfg, ServingConfig(max_slots=1))
+        ref = solo.run(
+            [Request("x", r.prompt, max_new_tokens=r.max_new_tokens)]
+        )
+        assert out[r.request_id] == ref["x"], r.request_id
+    # All pages returned; no slot left behind.
+    assert sorted(eng.free_pages) == list(range(1, 32))
+    assert eng.slots == [None, None]
+    assert eng.stats["decoded_tokens"] > 0
+
+
+def test_multiturn_prefix_hit_through_store(params, cfg, shm_conn):
+    """Turn 2 of a conversation must HIT the pages turn 1 offloaded:
+    restored prefix + suffix-only prefill lands on the same tokens as a
+    store-less engine given the full prompt."""
+    from infinistore_tpu.tpu import TpuKVStore
+
+    rng = np.random.default_rng(2)
+    turn1 = _prompt(rng, cfg, 16)  # two full pages
+    store = TpuKVStore(shm_conn)
+
+    eng1 = ServingEngine(params, cfg, store=store)
+    out1 = eng1.run([Request("t1", turn1, max_new_tokens=8)])
+    assert eng1.stats["offloaded_pages"] > 0
+    assert eng1.stats["prefix_hit_pages"] == 0  # cold store
+
+    # Turn 2 prompt extends turn 1's prompt + reply (the cached tokens).
+    convo = turn1 + out1["t1"]
+    turn2 = convo[: (len(convo) // cfg.page_size) * cfg.page_size]
+    turn2 = turn2 + _prompt(rng, cfg, 5)
+    eng2 = ServingEngine(params, cfg, store=store)
+    out2 = eng2.run([Request("t2", turn2, max_new_tokens=6)])
+    assert eng2.stats["prefix_hit_pages"] > 0
+
+    cold = ServingEngine(params, cfg)  # no store: full prefill oracle
+    ref = cold.run([Request("x", turn2, max_new_tokens=6)])
+    assert out2["t2"] == ref["x"]
+
+
+def test_identical_prompts_share_pages(params, cfg, shm_conn):
+    """Two requests with the same prompt: the second admission hits the
+    first's offloaded pages (content addressing needs no seq ids)."""
+    from infinistore_tpu.tpu import TpuKVStore
+
+    rng = np.random.default_rng(3)
+    prompt = _prompt(rng, cfg, 24)
+    store = TpuKVStore(shm_conn)
+    eng = ServingEngine(params, cfg, store=store)
+    out_a = eng.run([Request("a", prompt, max_new_tokens=4)])
+    out_b = eng.run([Request("b", prompt, max_new_tokens=4)])
+    assert out_a["a"] == out_b["b"]
+    # 24 tokens = 3 pages; hit is capped at 2 so >=1 token prefills.
+    assert eng.stats["prefix_hit_pages"] == 2
+
+
+def test_cache_opt_out(params, cfg, shm_conn):
+    from infinistore_tpu.tpu import TpuKVStore
+
+    rng = np.random.default_rng(4)
+    prompt = _prompt(rng, cfg, 16)
+    store = TpuKVStore(shm_conn)
+    eng = ServingEngine(params, cfg, store=store)
+    eng.run([Request("a", prompt, max_new_tokens=2, cache=False)])
+    assert eng.stats["offloaded_pages"] == 0
+    eng.run([Request("b", prompt, max_new_tokens=2)])
+    assert eng.stats["prefix_hit_pages"] == 0  # nothing was offloaded
+
+
+def test_eos_stops_generation(params, cfg):
+    """Whatever token the model emits first, making IT the EOS id must
+    stop the sequence at length 1."""
+    rng = np.random.default_rng(5)
+    prompt = _prompt(rng, cfg, 9)
+    probe = ServingEngine(params, cfg)
+    first = probe.run([Request("p", prompt, max_new_tokens=1)])["p"][0]
+    eng = ServingEngine(
+        params, cfg, ServingConfig(eos_id=first)
+    )
+    out = eng.run([Request("r", prompt, max_new_tokens=50)])
+    assert out["r"] == [first]
+
+
+def test_pool_exhaustion_finishes_early_not_deadlocks(params, cfg):
+    """A pool too small for the requested generation length must end the
+    sequence early with the tokens produced so far — never hang."""
+    sc = ServingConfig(max_slots=1, total_pages=4, max_pages_per_seq=8)
+    eng = ServingEngine(params, cfg, sc)
+    prompt = list(range(1, 17))  # 2 pages; pool has 3 usable
+    out = eng.run([Request("r", prompt, max_new_tokens=40)])
+    assert 1 <= len(out["r"]) < 40
+    assert sorted(eng.free_pages) == [1, 2, 3]
+
+
+def test_impossible_request_raises(params, cfg):
+    sc = ServingConfig(max_slots=1, total_pages=3, max_pages_per_seq=8)
+    eng = ServingEngine(params, cfg, sc)
+    with pytest.raises(RuntimeError, match="more pool pages than exist"):
+        eng.run([Request("r", list(range(1, 33)), max_new_tokens=4)])
+
+
+def test_oversized_request_rejected_at_submit(params, cfg):
+    eng = ServingEngine(params, cfg, ServingConfig(max_pages_per_seq=2))
+    with pytest.raises(ValueError, match="max_pages_per_seq"):
+        eng.submit(Request("r", list(range(1, 17)), max_new_tokens=16))
+
+
+def test_model_namespace_prevents_cross_hits(params, cfg, shm_conn):
+    """Engines with different model_ids (different checkpoints) sharing
+    one store must never restore each other's KV."""
+    from infinistore_tpu.tpu import TpuKVStore
+
+    rng = np.random.default_rng(6)
+    prompt = _prompt(rng, cfg, 24)
+    store = TpuKVStore(shm_conn)
+    eng_a = ServingEngine(
+        params, cfg, ServingConfig(model_id="ckpt-a"), store=store
+    )
+    eng_a.run([Request("a", prompt, max_new_tokens=2)])
+    assert eng_a.stats["offloaded_pages"] > 0
+    eng_b = ServingEngine(
+        params, cfg, ServingConfig(model_id="ckpt-b"), store=store
+    )
+    eng_b.run([Request("b", prompt, max_new_tokens=2)])
+    assert eng_b.stats["prefix_hit_pages"] == 0
+
+
+def test_content_keys_diverge_with_any_token():
+    a = content_page_keys([1, 2, 3, 4, 5, 6, 7, 8], 4, 2, 0, "k")
+    b = content_page_keys([1, 2, 3, 4, 5, 6, 7, 9], 4, 2, 0, "k")
+    assert a[0] == b[0]          # first page identical
+    assert a[1] != b[1]          # second diverges
+    c = content_page_keys([9, 2, 3, 4, 5, 6, 7, 8], 4, 2, 0, "k")
+    assert a[0] != c[0] and a[1] != c[1]  # chain: early change poisons all
