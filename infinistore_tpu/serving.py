@@ -24,6 +24,14 @@ the store's primitives into end-to-end serving:
   store (first-writer-wins dedup makes repeats free), so the next request
   sharing the prompt — e.g. the next turn of the same conversation —
   hits.
+- **Preemption THROUGH the store**: when the HBM page pool runs out
+  mid-decode, a sequence is swapped out vLLM-style — but the swap device
+  is the disaggregated store, not local CPU RAM: its full pages are
+  offloaded, its pool pages freed, and it requeues at the front;
+  re-admission rides the ordinary prefix-HIT path (restore pages,
+  recompute only the partial tail page) and generation resumes exactly
+  where it stopped. Store-less engines preempt too — they just
+  recompute the prefix on resume.
 
 TPU-first choices: decode is one fixed-shape jit over all slots (inactive
 slots scatter into a sacrificial scratch page and their logits are
@@ -99,12 +107,25 @@ class Request:
 
 
 @dataclass
-class _Slot:
+class _Work:
+    """A request's schedulable state, surviving preemption: `prompt`
+    grows by the tokens generated before each swap-out, `done`
+    accumulates the request's full output across incarnations."""
     req: Request
+    prompt: list
+    done: list = field(default_factory=list)
+
+
+@dataclass
+class _Slot:
+    work: _Work
     page_ids: list            # pool pages owned, in sequence order
-    seq_len: int              # tokens whose KV is in pages (incl. current step's input after the step)
+    seq_len: int              # tokens whose KV is in pages
     cached_pages: int = 0     # pages restored from the store at admission
     generated: list = field(default_factory=list)
+
+    def total_generated(self):
+        return len(self.work.done) + len(self.generated)
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
@@ -148,7 +169,7 @@ class ServingEngine:
         self.stats = {
             "requests": 0, "prefix_hit_pages": 0, "restored_pages": 0,
             "prefill_tokens": 0, "decode_steps": 0, "decoded_tokens": 0,
-            "offloaded_pages": 0,
+            "offloaded_pages": 0, "preemptions": 0,
         }
         self._prefill = jax.jit(partial(llama.prefill, params, cfg))
         self._prefill_px = jax.jit(
@@ -177,7 +198,7 @@ class ServingEngine:
                 f"request needs {need} pages > max_pages_per_seq "
                 f"{self.sc.max_pages_per_seq}"
             )
-        self.queue.append(req)
+        self.queue.append(_Work(req=req, prompt=list(req.prompt)))
         self.stats["requests"] += 1
 
     def _alloc(self, n):
@@ -199,53 +220,50 @@ class ServingEngine:
             jnp.pad(k_new, pad), jnp.pad(v_new, pad),
         )
 
-    def _probe_hit(self, req):
+    def _probe_hit(self, work):
         """Page-granular prefix hit, capped so at least one prompt token
         remains to prefill (the engine needs its logits)."""
-        if self.store is None or not req.cache:
+        if self.store is None or not work.req.cache:
             return 0
-        cap = (len(req.prompt) - 1) // self.cfg.page_size
+        cap = (len(work.prompt) - 1) // self.cfg.page_size
         if cap == 0:
             return 0
-        digests = self._digests(req.prompt, cap)
+        digests = self._digests(work.prompt, cap)
         hit = self.store.cached_prefix_len(
-            content_page_keys(req.prompt, self.cfg.page_size, cap, 0, "k",
+            content_page_keys(work.prompt, self.cfg.page_size, cap, 0, "k",
                               digests=digests)
         )
         return min(hit, cap)
 
-    def _admit(self, slot_idx, req):
-        cfg = self.cfg
-        page = cfg.page_size
-        n_prompt = len(req.prompt)
-        n_pages = -(-n_prompt // page)
+    def _admit(self, slot_idx, work):
+        n_prompt = len(work.prompt)
+        n_pages = -(-n_prompt // self.cfg.page_size)
         ids = self._alloc(n_pages)
         if ids is None:
             return False  # pool pressure: stay queued
         try:
-            hit = self._do_admit(slot_idx, req, ids, n_prompt, n_pages)
+            self._do_admit(slot_idx, work, ids, n_prompt, n_pages)
         except BaseException:
             # Restore/prefill failed (store eviction race, connection
             # loss): the pages must go back or the pool leaks.
             self.free_pages.extend(ids)
             raise
-        del hit
         return True
 
-    def _do_admit(self, slot_idx, req, ids, n_prompt, n_pages):
+    def _do_admit(self, slot_idx, work, ids, n_prompt, n_pages):
         cfg = self.cfg
         page = cfg.page_size
-        hit = self._probe_hit(req)
+        hit = self._probe_hit(work)
         prefix_kvs = None
         if hit > 0:
             # Restore hit pages once: page form goes into the pool,
             # contiguous form feeds the suffix prefill. Digests are
             # layer/kind-independent — hash the prompt ONCE.
-            digests = self._digests(req.prompt, hit)
+            digests = self._digests(work.prompt, hit)
             kp, vp = llama.restore_prefix_pages(
                 self.store, cfg,
                 lambda li, kind: content_page_keys(
-                    req.prompt, page, hit, li, kind, digests=digests
+                    work.prompt, page, hit, li, kind, digests=digests
                 ),
                 hit,
             )
@@ -260,7 +278,7 @@ class ServingEngine:
 
         # Suffix prefill, bucketed to a page multiple (causal attention
         # makes tail padding inert for the positions we read).
-        suffix = req.prompt[hit * page:]
+        suffix = work.prompt[hit * page:]
         s_real = len(suffix)
         s_pad = -(-s_real // page) * page
         toks = np.zeros((1, s_pad), dtype=np.int32)
@@ -288,10 +306,9 @@ class ServingEngine:
 
         first = int(jnp.argmax(logits[0, s_real - 1]))
         self.slots[slot_idx] = _Slot(
-            req=req, page_ids=ids, seq_len=n_prompt, cached_pages=hit,
+            work=work, page_ids=ids, seq_len=n_prompt, cached_pages=hit,
             generated=[first],
         )
-        return hit
 
     # ---- decode --------------------------------------------------------
 
@@ -308,45 +325,66 @@ class ServingEngine:
         self.page_table[slot_idx, need_idx] = ids[0]
         return True
 
-    def _finish(self, slot_idx, slot):
-        req = slot.req
-        self.outputs[req.request_id] = list(slot.generated)
-        if self.store is not None and req.cache:
-            # Offload FULL pages only — partial tail pages would poison
-            # page-granular prefix matching for future requests. Keys
-            # hash prompt + generated tokens, so a future request whose
-            # prompt extends this conversation hits these pages. Pages
-            # restored at admission are already in the store
-            # (first-writer-wins) — upload only [cached_pages:].
-            n_full = slot.seq_len // self.cfg.page_size
-            lo = slot.cached_pages
-            if n_full > lo:
-                toks = list(req.prompt) + slot.generated
-                digests = self._digests(toks, n_full)
-                for li in range(self.cfg.n_layers):
-                    sel = jnp.asarray(
-                        np.asarray(slot.page_ids[lo:n_full], np.int32)
-                    )
-                    k_keys = content_page_keys(
-                        toks, self.cfg.page_size, n_full, li, "k",
-                        digests=digests,
-                    )
-                    v_keys = content_page_keys(
-                        toks, self.cfg.page_size, n_full, li, "v",
-                        digests=digests,
-                    )
-                    self.store.put_kv_pages(
-                        k_keys[lo:],
-                        jnp.take(self.k_pages[li], sel, axis=0),
-                    )
-                    self.store.put_kv_pages(
-                        v_keys[lo:],
-                        jnp.take(self.v_pages[li], sel, axis=0),
-                    )
-                self.store.conn.sync()
-                self.stats["offloaded_pages"] += n_full - lo
+    def _offload_full_pages(self, slot):
+        """Persist the slot's NEW full pages to the store (shared by
+        finish and preemption). Offloads FULL pages only — partial tail
+        pages would poison page-granular prefix matching — and skips
+        [0:cached_pages) which the store already holds
+        (first-writer-wins makes re-putting them wasted transfer). Keys
+        hash prompt + generated tokens, so a future request whose prompt
+        extends this sequence hits these pages."""
+        if self.store is None or not slot.work.req.cache:
+            return
+        n_full = slot.seq_len // self.cfg.page_size
+        lo = slot.cached_pages
+        if n_full <= lo:
+            return
+        toks = list(slot.work.prompt) + slot.generated
+        digests = self._digests(toks, n_full)
+        for li in range(self.cfg.n_layers):
+            sel = jnp.asarray(
+                np.asarray(slot.page_ids[lo:n_full], np.int32)
+            )
+            k_keys = content_page_keys(
+                toks, self.cfg.page_size, n_full, li, "k", digests=digests,
+            )
+            v_keys = content_page_keys(
+                toks, self.cfg.page_size, n_full, li, "v", digests=digests,
+            )
+            self.store.put_kv_pages(
+                k_keys[lo:], jnp.take(self.k_pages[li], sel, axis=0),
+            )
+            self.store.put_kv_pages(
+                v_keys[lo:], jnp.take(self.v_pages[li], sel, axis=0),
+            )
+        self.store.conn.sync()
+        self.stats["offloaded_pages"] += n_full - lo
+
+    def _release(self, slot_idx, slot):
         self.free_pages.extend(slot.page_ids)
         self.slots[slot_idx] = None
+
+    def _finish(self, slot_idx, slot):
+        self.outputs[slot.work.req.request_id] = (
+            slot.work.done + slot.generated
+        )
+        self._offload_full_pages(slot)
+        self._release(slot_idx, slot)
+
+    def _preempt(self, slot_idx, slot):
+        """Swap the sequence OUT through the store (vLLM's preemption
+        with the disaggregated pool as the swap device): persist its new
+        full pages, free its pool pages, and requeue it at the FRONT;
+        re-admission travels the normal prefix-HIT path — restore the
+        cached pages, recompute only the partial tail page — and decoding
+        resumes exactly where it left off."""
+        self._offload_full_pages(slot)
+        work = slot.work
+        work.done.extend(slot.generated)
+        work.prompt = list(work.prompt) + slot.generated
+        self._release(slot_idx, slot)
+        self.queue.insert(0, work)
+        self.stats["preemptions"] += 1
 
     def step(self):
         """One engine iteration: admit into free slots, then decode one
@@ -365,7 +403,7 @@ class ServingEngine:
         # Sequences at max_new_tokens finish BEFORE the step (their last
         # sampled token never needs its KV appended).
         for i, s in list(active):
-            done = len(s.generated) >= s.req.max_new_tokens or (
+            done = s.total_generated() >= s.work.req.max_new_tokens or (
                 self.sc.eos_id >= 0 and s.generated
                 and s.generated[-1] == self.sc.eos_id
             )
@@ -382,11 +420,16 @@ class ServingEngine:
         rows = np.zeros_like(self.page_table)  # inactive → scratch page 0
         for i, s in active:
             if not self._ensure_page(i, s):
-                # Pool exhausted mid-decode: finish the sequence early
-                # (its generated tokens so far are the output) rather
-                # than deadlock. Offload frees nothing here — pages are
-                # returned to the free list by _finish.
-                self._finish(i, s)
+                # Pool exhausted mid-decode. If other sequences are
+                # running, swap this one out through the store and let
+                # them drain — it resumes via the prefix-HIT path when
+                # pages free up. Alone, preemption can't help (the whole
+                # pool is already ours): finish early with the tokens
+                # produced so far rather than deadlock.
+                if len(active) > 1:
+                    self._preempt(i, s)
+                else:
+                    self._finish(i, s)
                 continue
             token[i] = s.generated[-1]
             seq_lens[i] = s.seq_len
@@ -427,7 +470,7 @@ class ServingEngine:
                 # Every slot is free so the whole pool is free: the head
                 # request still not admitting means it never will.
                 raise RuntimeError(
-                    f"request {self.queue[0].request_id} needs more pool "
+                    f"request {self.queue[0].req.request_id} needs more pool "
                     f"pages than exist ({self.sc.total_pages - 1} usable)"
                 )
         return dict(self.outputs)

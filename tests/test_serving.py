@@ -164,6 +164,57 @@ def test_eos_stops_generation(params, cfg):
     assert out["r"] == [first]
 
 
+def test_preemption_through_store_resumes_exactly(params, cfg, shm_conn):
+    """Two growing sequences in a pool too small for both: one must be
+    swapped out THROUGH the store and resume via the prefix-hit path,
+    finishing with exactly the tokens of an uncontended run."""
+    from infinistore_tpu.tpu import TpuKVStore
+
+    rng = np.random.default_rng(7)
+    reqs = [
+        Request(f"r{i}", _prompt(rng, cfg, 16), max_new_tokens=24)
+        for i in range(2)
+    ]
+    store = TpuKVStore(shm_conn)
+    sc = ServingConfig(max_slots=2, total_pages=8, max_pages_per_seq=8)
+    eng = ServingEngine(params, cfg, sc, store=store)
+    out = eng.run(
+        [Request(r.request_id, r.prompt, r.max_new_tokens) for r in reqs]
+    )
+    assert eng.stats["preemptions"] >= 1
+    assert eng.stats["prefix_hit_pages"] > 0  # resume restored pages
+    for r in reqs:
+        big = ServingEngine(
+            params, cfg, ServingConfig(max_slots=1, total_pages=16)
+        )
+        ref = big.run([Request("x", r.prompt, r.max_new_tokens)])
+        assert out[r.request_id] == ref["x"], r.request_id
+        assert len(out[r.request_id]) == 24, r.request_id
+    assert sorted(eng.free_pages) == list(range(1, 8))
+
+
+def test_preemption_without_store_recomputes(params, cfg):
+    """Preemption must work store-less: the prefix is recomputed on
+    resume instead of restored, with identical tokens."""
+    rng = np.random.default_rng(8)
+    reqs = [
+        Request(f"r{i}", _prompt(rng, cfg, 16), max_new_tokens=24)
+        for i in range(2)
+    ]
+    sc = ServingConfig(max_slots=2, total_pages=8, max_pages_per_seq=8)
+    eng = ServingEngine(params, cfg, sc)
+    out = eng.run(
+        [Request(r.request_id, r.prompt, r.max_new_tokens) for r in reqs]
+    )
+    assert eng.stats["preemptions"] >= 1
+    for r in reqs:
+        big = ServingEngine(
+            params, cfg, ServingConfig(max_slots=1, total_pages=16)
+        )
+        ref = big.run([Request("x", r.prompt, r.max_new_tokens)])
+        assert out[r.request_id] == ref["x"], r.request_id
+
+
 def test_pool_exhaustion_finishes_early_not_deadlocks(params, cfg):
     """A pool too small for the requested generation length must end the
     sequence early with the tokens produced so far — never hang."""
