@@ -306,20 +306,20 @@ def page_keys(prefix, layer, kind, n_pages):
     return [f"{prefix}/L{layer}/{kind}/p{i}" for i in range(n_pages)]
 
 
-def restore_prefix_pages(store, cfg: LlamaConfig, key_fn, n_pages):
+def restore_prefix_pages(store, cfg: LlamaConfig, key_fn, n_pages,
+                         getter=None):
     """Restore a matched prefix from the store in PAGE form: the one
     get_kv_pages recipe every cache-hit consumer shares. `key_fn(layer,
     kind)` returns that (layer, kind)'s n_pages keys (index-addressed
-    `page_keys` or the serving engine's content-addressed keys).
+    `page_keys` or the serving engine's content-addressed keys);
+    `getter` overrides the fetch method (e.g.
+    store.get_kv_pages_quantized for int8 pages).
     Returns (k_pages, v_pages) [n_layers, n_pages, page, n_kv, hd]."""
+    get = getter if getter is not None else store.get_kv_pages
     kp, vp = [], []
     for li in range(cfg.n_layers):
-        kp.append(store.get_kv_pages(
-            key_fn(li, "k"), cfg.kv_page_shape(), cfg.jdtype,
-        ))
-        vp.append(store.get_kv_pages(
-            key_fn(li, "v"), cfg.kv_page_shape(), cfg.jdtype,
-        ))
+        kp.append(get(key_fn(li, "k"), cfg.kv_page_shape(), cfg.jdtype))
+        vp.append(get(key_fn(li, "v"), cfg.kv_page_shape(), cfg.jdtype))
     return jnp.stack(kp), jnp.stack(vp)
 
 

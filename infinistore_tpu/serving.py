@@ -24,13 +24,20 @@ the store's primitives into end-to-end serving:
   store (first-writer-wins dedup makes repeats free), so the next request
   sharing the prompt — e.g. the next turn of the same conversation —
   hits.
+- **Quantized wire (opt-in)**: `ServingConfig(quantized_store=True)`
+  moves pages to/from the store int8-packed (per-token-per-head scales,
+  ops/kv_quant.py) — half the restore/offload bytes and store capacity
+  at ~0.4% KV error; quantized and raw pages live in disjoint key
+  namespaces so they can share one store safely.
 - **Preemption THROUGH the store**: when the HBM page pool runs out
   mid-decode, a sequence is swapped out vLLM-style — but the swap device
   is the disaggregated store, not local CPU RAM: its full pages are
   offloaded, its pool pages freed, and it requeues at the front;
   re-admission rides the ordinary prefix-HIT path (restore pages,
   recompute only the partial tail page) and generation resumes exactly
-  where it stopped. Store-less engines preempt too — they just
+  where it stopped (with `quantized_store` the restored prefix carries
+  the ~0.4% dequantization error, so a near-tie greedy step may diverge
+  from an uncontended run). Store-less engines preempt too — they just
   recompute the prefix on resume.
 
 TPU-first choices: decode is one fixed-shape jit over all slots (inactive
@@ -96,6 +103,11 @@ class ServingConfig:
     #                              store-key namespace; engines with
     #                              different weights sharing one store
     #                              MUST use different model_ids
+    quantized_store: bool = False  # int8 pages on the store wire: halves
+    #                                restore/offload bytes and store
+    #                                capacity use at ~0.4% KV error
+    #                                (ops/kv_quant.py); keys are
+    #                                namespaced apart from bf16 pages
 
 
 @dataclass
@@ -177,10 +189,17 @@ class ServingEngine:
         )
         # Everything that shapes page BYTES goes into the key namespace:
         # engines differing in any of these must never cross-hit.
+        wire = "q8" if self.sc.quantized_store else cfg.dtype
         self._ns = (
             f"{self.sc.model_id}/p{cfg.page_size}/l{cfg.n_layers}"
-            f"/kv{cfg.n_kv_heads}x{cfg.head_dim}/{cfg.dtype}"
+            f"/kv{cfg.n_kv_heads}x{cfg.head_dim}/{wire}"
         )
+        if store is not None and self.sc.quantized_store:
+            self._get_pages = store.get_kv_pages_quantized
+            self._put_pages = store.put_kv_pages_quantized
+        elif store is not None:
+            self._get_pages = store.get_kv_pages
+            self._put_pages = store.put_kv_pages
 
     def _digests(self, tokens, n_pages):
         return content_page_digests(
@@ -222,18 +241,20 @@ class ServingEngine:
 
     def _probe_hit(self, work):
         """Page-granular prefix hit, capped so at least one prompt token
-        remains to prefill (the engine needs its logits)."""
+        remains to prefill (the engine needs its logits). Returns
+        (hit, digests[:hit]) so the restore reuses the hash chain."""
         if self.store is None or not work.req.cache:
-            return 0
+            return 0, []
         cap = (len(work.prompt) - 1) // self.cfg.page_size
         if cap == 0:
-            return 0
+            return 0, []
         digests = self._digests(work.prompt, cap)
         hit = self.store.cached_prefix_len(
             content_page_keys(work.prompt, self.cfg.page_size, cap, 0, "k",
                               digests=digests)
         )
-        return min(hit, cap)
+        hit = min(hit, cap)
+        return hit, digests[:hit]
 
     def _admit(self, slot_idx, work):
         n_prompt = len(work.prompt)
@@ -253,19 +274,20 @@ class ServingEngine:
     def _do_admit(self, slot_idx, work, ids, n_prompt, n_pages):
         cfg = self.cfg
         page = cfg.page_size
-        hit = self._probe_hit(work)
+        hit, digests = self._probe_hit(work)
         prefix_kvs = None
         if hit > 0:
             # Restore hit pages once: page form goes into the pool,
             # contiguous form feeds the suffix prefill. Digests are
-            # layer/kind-independent — hash the prompt ONCE.
-            digests = self._digests(work.prompt, hit)
+            # layer/kind-independent and come from the probe — the
+            # prompt is hashed ONCE per admission.
             kp, vp = llama.restore_prefix_pages(
                 self.store, cfg,
                 lambda li, kind: content_page_keys(
                     work.prompt, page, hit, li, kind, digests=digests
                 ),
                 hit,
+                getter=self._get_pages,
             )
             self._pool_write(ids[:hit], kp, vp)
             prefix_kvs = [
@@ -351,10 +373,10 @@ class ServingEngine:
             v_keys = content_page_keys(
                 toks, self.cfg.page_size, n_full, li, "v", digests=digests,
             )
-            self.store.put_kv_pages(
+            self._put_pages(
                 k_keys[lo:], jnp.take(self.k_pages[li], sel, axis=0),
             )
-            self.store.put_kv_pages(
+            self._put_pages(
                 v_keys[lo:], jnp.take(self.v_pages[li], sel, axis=0),
             )
         self.store.conn.sync()

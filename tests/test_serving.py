@@ -239,6 +239,44 @@ def test_oversized_request_rejected_at_submit(params, cfg):
         eng.submit(Request("r", list(range(1, 17)), max_new_tokens=16))
 
 
+def test_quantized_store_wire(params, cfg, shm_conn):
+    """quantized_store=True: turn 2 hits turn 1's int8 pages, restores
+    through dequantization, and completes; quantized and raw pages never
+    cross-hit (disjoint namespaces)."""
+    from infinistore_tpu.tpu import TpuKVStore
+
+    rng = np.random.default_rng(9)
+    turn1 = _prompt(rng, cfg, 16)
+    store = TpuKVStore(shm_conn)
+    qcfg = ServingConfig(quantized_store=True)
+
+    eng1 = ServingEngine(params, cfg, qcfg, store=store)
+    out1 = eng1.run([Request("t1", turn1, max_new_tokens=8)])
+    assert eng1.stats["offloaded_pages"] > 0
+
+    convo = turn1 + out1["t1"]
+    turn2 = convo[: (len(convo) // cfg.page_size) * cfg.page_size]
+    turn2 = turn2 + _prompt(rng, cfg, 5)
+    eng2 = ServingEngine(params, cfg, qcfg, store=store)
+    out2 = eng2.run([Request("t2", turn2, max_new_tokens=6)])
+    assert eng2.stats["prefix_hit_pages"] > 0
+    assert len(out2["t2"]) == 6
+
+    # int8 is a different wire format: a raw-dtype engine must NOT hit
+    # the quantized pages (and vice versa) even for the same tokens.
+    raw = ServingEngine(params, cfg, store=store)
+    raw.run([Request("r", turn2, max_new_tokens=2)])
+    assert raw.stats["prefix_hit_pages"] == 0
+    # Vice versa: fresh-token raw pages must be invisible to q8 probes.
+    fresh = _prompt(rng, cfg, 24)
+    raw2 = ServingEngine(params, cfg, store=store)
+    raw2.run([Request("r2", fresh, max_new_tokens=2)])
+    assert raw2.stats["offloaded_pages"] > 0
+    q8 = ServingEngine(params, cfg, qcfg, store=store)
+    q8.run([Request("q", fresh, max_new_tokens=2)])
+    assert q8.stats["prefix_hit_pages"] == 0
+
+
 def test_model_namespace_prevents_cross_hits(params, cfg, shm_conn):
     """Engines with different model_ids (different checkpoints) sharing
     one store must never restore each other's KV."""
