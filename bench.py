@@ -303,30 +303,52 @@ def bench_overlap(port):
                 streamer.finish()
             return x
 
-        # Interleaved best-of-6: plain and streamed passes alternate so
-        # background-daemon noise hits both legs alike.
-        t_plain, t_stream = None, None
+        # Interleaved pairs: each streamed pass is compared to the plain
+        # pass adjacent to it, so slow-noise (hypervisor neighbors) hits
+        # both sides of a pair alike; the INTERQUARTILE MEAN of the
+        # per-pair overheads drops the passes that caught a noise spike
+        # (a min/min ratio is biased low when one plain pass lands in an
+        # unusually quiet window the streamed passes never saw).
+        pairs = []
+        t_plain_best, t_stream_best = None, None
         with LayerStreamer(conn) as streamer:
-            for it in range(6):
-                t0 = time.perf_counter()
-                run_prefill(None, "")
-                t = time.perf_counter() - t0
-                t_plain = t if t_plain is None else min(t_plain, t)
+            for it in range(12):
+                # Alternate order within pairs so a monotone load drift
+                # biases half the pairs up and half down.
+                def _plain():
+                    t0 = time.perf_counter()
+                    run_prefill(None, "")
+                    return time.perf_counter() - t0
 
-                t0 = time.perf_counter()
-                run_prefill(streamer, f"i{it}")  # fresh keys per pass
-                t = time.perf_counter() - t0
-                t_stream = t if t_stream is None else min(t_stream, t)
+                def _stream():
+                    t0 = time.perf_counter()
+                    run_prefill(streamer, f"i{it}")  # fresh keys per pass
+                    return time.perf_counter() - t0
+
+                if it % 2 == 0:
+                    tp, ts = _plain(), _stream()
+                else:
+                    ts, tp = _stream(), _plain()
+                pairs.append(100.0 * (ts - tp) / tp)
+                t_plain_best = (
+                    tp if t_plain_best is None else min(t_plain_best, tp)
+                )
+                t_stream_best = (
+                    ts if t_stream_best is None else min(t_stream_best, ts)
+                )
+        pairs.sort()
+        q = len(pairs) // 4
+        mid = pairs[q:len(pairs) - q]
+        iq_mean = sum(mid) / len(mid)
 
         kv_bytes = seq * kv_cols * 4
         return {
             "overlap_layers": layers,
             "overlap_kv_kb_per_layer": kv_bytes // 1024,
-            "overlap_prefill_ms": round(t_plain * 1e3, 2),
-            "overlap_streamed_ms": round(t_stream * 1e3, 2),
-            "overlap_overhead_pct": round(
-                100.0 * (t_stream - t_plain) / t_plain, 2
-            ),
+            "overlap_prefill_ms": round(t_plain_best * 1e3, 2),
+            "overlap_streamed_ms": round(t_stream_best * 1e3, 2),
+            "overlap_overhead_pct": round(iq_mean, 2),
+            "overlap_overhead_best_pct": round(pairs[0], 2),
         }
     finally:
         conn.close()

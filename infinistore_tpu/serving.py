@@ -48,6 +48,7 @@ out-of-range page ids dropped — no recompilation as counts vary.
 """
 
 import hashlib
+import logging
 from dataclasses import dataclass, field
 from functools import partial
 
@@ -55,6 +56,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .lib import InfiniStoreKeyNotFound
 from .models import llama
 
 
@@ -181,8 +183,14 @@ class ServingEngine:
         self.stats = {
             "requests": 0, "prefix_hit_pages": 0, "restored_pages": 0,
             "prefill_tokens": 0, "decode_steps": 0, "decoded_tokens": 0,
-            "offloaded_pages": 0, "preemptions": 0,
+            "offloaded_pages": 0, "preemptions": 0, "store_errors": 0,
+            "restore_misses": 0,
         }
+        # The store is an accelerator, never a dependency: after the
+        # first store failure the engine downgrades itself to store-less
+        # serving (full prefills, no offload) instead of failing
+        # requests on a cache.
+        self._store_ok = True
         self._prefill = jax.jit(partial(llama.prefill, params, cfg))
         self._prefill_px = jax.jit(
             partial(llama.prefill_with_prefix, params, cfg)
@@ -239,20 +247,34 @@ class ServingEngine:
             jnp.pad(k_new, pad), jnp.pad(v_new, pad),
         )
 
+    def _store_failed(self, what, exc):
+        """First store failure downgrades to store-less serving: the
+        cache accelerates, it must never fail a request."""
+        self._store_ok = False
+        self.stats["store_errors"] += 1
+        logging.getLogger("infinistore_tpu.serving").warning(
+            "store %s failed (%s: %s) — continuing store-less",
+            what, type(exc).__name__, exc,
+        )
+
     def _probe_hit(self, work):
         """Page-granular prefix hit, capped so at least one prompt token
         remains to prefill (the engine needs its logits). Returns
         (hit, digests[:hit]) so the restore reuses the hash chain."""
-        if self.store is None or not work.req.cache:
+        if self.store is None or not self._store_ok or not work.req.cache:
             return 0, []
         cap = (len(work.prompt) - 1) // self.cfg.page_size
         if cap == 0:
             return 0, []
         digests = self._digests(work.prompt, cap)
-        hit = self.store.cached_prefix_len(
-            content_page_keys(work.prompt, self.cfg.page_size, cap, 0, "k",
-                              digests=digests)
-        )
+        try:
+            hit = self.store.cached_prefix_len(
+                content_page_keys(work.prompt, self.cfg.page_size, cap, 0,
+                                  "k", digests=digests)
+            )
+        except Exception as e:
+            self._store_failed("probe", e)
+            return 0, []
         hit = min(hit, cap)
         return hit, digests[:hit]
 
@@ -281,22 +303,34 @@ class ServingEngine:
             # contiguous form feeds the suffix prefill. Digests are
             # layer/kind-independent and come from the probe — the
             # prompt is hashed ONCE per admission.
-            kp, vp = llama.restore_prefix_pages(
-                self.store, cfg,
-                lambda li, kind: content_page_keys(
-                    work.prompt, page, hit, li, kind, digests=digests
-                ),
-                hit,
-                getter=self._get_pages,
-            )
-            self._pool_write(ids[:hit], kp, vp)
-            prefix_kvs = [
-                llama.pages_to_kv(cfg, kp[li][None], vp[li][None],
-                                  hit * page)
-                for li in range(cfg.n_layers)
-            ]
-            self.stats["prefix_hit_pages"] += hit
-            self.stats["restored_pages"] += hit * cfg.n_layers * 2
+            try:
+                kp, vp = llama.restore_prefix_pages(
+                    self.store, cfg,
+                    lambda li, kind: content_page_keys(
+                        work.prompt, page, hit, li, kind, digests=digests
+                    ),
+                    hit,
+                    getter=self._get_pages,
+                )
+            except InfiniStoreKeyNotFound:
+                # Routine eviction race: the page was LRU-dropped
+                # between probe and restore. A cache MISS for this
+                # admission only — the store stays in use.
+                self.stats["restore_misses"] += 1
+                hit = 0
+            except Exception as e:
+                # Connection-class failure: downgrade to store-less.
+                self._store_failed("restore", e)
+                hit = 0
+            else:
+                self._pool_write(ids[:hit], kp, vp)
+                prefix_kvs = [
+                    llama.pages_to_kv(cfg, kp[li][None], vp[li][None],
+                                      hit * page)
+                    for li in range(cfg.n_layers)
+                ]
+                self.stats["prefix_hit_pages"] += hit
+                self.stats["restored_pages"] += hit * cfg.n_layers * 2
 
         # Suffix prefill, bucketed to a page multiple (causal attention
         # makes tail padding inert for the positions we read).
@@ -355,7 +389,8 @@ class ServingEngine:
         (first-writer-wins makes re-putting them wasted transfer). Keys
         hash prompt + generated tokens, so a future request whose prompt
         extends this sequence hits these pages."""
-        if self.store is None or not slot.work.req.cache:
+        if (self.store is None or not self._store_ok
+                or not slot.work.req.cache):
             return
         n_full = slot.seq_len // self.cfg.page_size
         lo = slot.cached_pages
@@ -363,23 +398,31 @@ class ServingEngine:
             return
         toks = list(slot.work.prompt) + slot.generated
         digests = self._digests(toks, n_full)
-        for li in range(self.cfg.n_layers):
-            sel = jnp.asarray(
-                np.asarray(slot.page_ids[lo:n_full], np.int32)
-            )
-            k_keys = content_page_keys(
-                toks, self.cfg.page_size, n_full, li, "k", digests=digests,
-            )
-            v_keys = content_page_keys(
-                toks, self.cfg.page_size, n_full, li, "v", digests=digests,
-            )
-            self._put_pages(
-                k_keys[lo:], jnp.take(self.k_pages[li], sel, axis=0),
-            )
-            self._put_pages(
-                v_keys[lo:], jnp.take(self.v_pages[li], sel, axis=0),
-            )
-        self.store.conn.sync()
+        try:
+            for li in range(self.cfg.n_layers):
+                sel = jnp.asarray(
+                    np.asarray(slot.page_ids[lo:n_full], np.int32)
+                )
+                k_keys = content_page_keys(
+                    toks, self.cfg.page_size, n_full, li, "k",
+                    digests=digests,
+                )
+                v_keys = content_page_keys(
+                    toks, self.cfg.page_size, n_full, li, "v",
+                    digests=digests,
+                )
+                self._put_pages(
+                    k_keys[lo:], jnp.take(self.k_pages[li], sel, axis=0),
+                )
+                self._put_pages(
+                    v_keys[lo:], jnp.take(self.v_pages[li], sel, axis=0),
+                )
+            self.store.conn.sync()
+        except Exception as e:
+            # The sequence's OUTPUT does not depend on the offload;
+            # losing it only costs future cache hits.
+            self._store_failed("offload", e)
+            return
         self.stats["offloaded_pages"] += n_full - lo
 
     def _release(self, slot_idx, slot):

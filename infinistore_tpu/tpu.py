@@ -69,6 +69,27 @@ def _device_put_owned(view, device):
     return out
 
 
+def _abort_uncommitted(conn, blocks):
+    """Best-effort rollback of an allocate whose write failed: leaving
+    the tokens uncommitted would dedup-poison the keys for EVERY client
+    of the store (get_match_last_index counts uncommitted entries;
+    re-puts silently skip; reads 404 — native/src/kv_index.h). If the
+    connection itself is dead the abort can't be sent, but then the
+    server's dead-connection cleanup aborts them for us."""
+    import numpy as _np
+
+    from ._native import FAKE_TOKEN, OK as _OK
+
+    toks = blocks["token"][
+        (blocks["status"] == _OK) & (blocks["token"] != FAKE_TOKEN)
+    ]
+    if len(toks):
+        try:
+            conn.abort(_np.asarray(toks, dtype=_np.uint64))
+        except Exception:
+            pass
+
+
 class TpuKVStore:
     """High-level KV-page interface over an :class:`InfinityConnection`.
 
@@ -100,7 +121,11 @@ class TpuKVStore:
             blocks = self.conn.allocate(keys, nbytes)
             flat = np.concatenate([a.reshape(-1).view(np.uint8) for _, a in group])
             offsets = [i * nbytes for i in range(len(group))]
-            self.conn.write_cache(flat, offsets, nbytes, blocks)
+            try:
+                self.conn.write_cache(flat, offsets, nbytes, blocks)
+            except BaseException:
+                _abort_uncommitted(self.conn, blocks)
+                raise
         if sync:
             self.conn.sync()
 
@@ -142,9 +167,13 @@ class TpuKVStore:
         page_elems = int(np.prod(host.shape[1:]))
         flat = host.reshape(n * page_elems)
         blocks = self.conn.allocate(keys, page_elems * host.itemsize)
-        self.conn.write_cache(
-            flat, [i * page_elems for i in range(n)], page_elems, blocks
-        )
+        try:
+            self.conn.write_cache(
+                flat, [i * page_elems for i in range(n)], page_elems, blocks
+            )
+        except BaseException:
+            _abort_uncommitted(self.conn, blocks)
+            raise
         if sync:
             self.conn.sync()
         return blocks
@@ -229,9 +258,14 @@ class TpuKVStore:
         packed = kv_quant.pack_pages_host(_to_host(q), _to_host(scales))
         block = kv_quant.packed_page_bytes(page_shape)
         blocks = self.conn.allocate(keys, block)
-        self.conn.write_cache(
-            packed.reshape(-1), [i * block for i in range(n)], block, blocks
-        )
+        try:
+            self.conn.write_cache(
+                packed.reshape(-1), [i * block for i in range(n)], block,
+                blocks,
+            )
+        except BaseException:
+            _abort_uncommitted(self.conn, blocks)
+            raise
         if sync:
             self.conn.sync()
         return blocks
@@ -299,11 +333,14 @@ class TpuKVStore:
 
     def cached_prefix_len(self, keys):
         """How many leading pages of ``keys`` are already cached
-        (get_match_last_index + 1; 0 if none)."""
-        try:
-            return self.conn.get_match_last_index(keys) + 1
-        except Exception:
-            return 0
+        (get_match_last_index + 1; 0 if none). Uses the raw variant —
+        a clean miss is 0, not an exception (get_match_last_index raises
+        on no-match for reference parity). Connection failures PROPAGATE
+        — swallowing them would make a dead store indistinguishable from
+        a cold one, so callers with a fallback (e.g. the serving
+        engine's store-less downgrade) could never trigger it at probe
+        time."""
+        return self.conn._match_last_index_raw(keys) + 1
 
 
 class LayerStreamer:

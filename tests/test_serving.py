@@ -297,6 +297,56 @@ def test_model_namespace_prevents_cross_hits(params, cfg, shm_conn):
     assert eng_b.stats["prefix_hit_pages"] == 0
 
 
+class _FlakyStore:
+    """Store stub that fails on the chosen operation — the engine must
+    degrade to store-less serving, never fail a request."""
+
+    def __init__(self, fail_on):
+        self.fail_on = fail_on
+        self.calls = []
+
+    def cached_prefix_len(self, keys):
+        self.calls.append("probe")
+        if self.fail_on == "probe":
+            raise ConnectionError("store down")
+        # Claim a hit only for the restore-failure case; the offload
+        # case must reach put_kv_pages, which a hit's get would shadow.
+        return 1 if self.fail_on == "get" else 0
+
+    def get_kv_pages(self, keys, page_shape, dtype, device=None):
+        self.calls.append("get")
+        if self.fail_on == "get":
+            raise ConnectionError("evicted mid-restore")
+        raise AssertionError("unexpected get")
+
+    def put_kv_pages(self, keys, pages, sync=False):
+        self.calls.append("put")
+        if self.fail_on == "put":
+            raise ConnectionError("store down")
+
+
+@pytest.mark.parametrize("fail_on", ["probe", "get", "put"])
+def test_store_failure_degrades_to_storeless(params, cfg, fail_on):
+    """A store failure at any point (probe, restore, offload) must cost
+    only cache hits — the request completes with exactly the tokens of
+    a store-less run, and the engine stops touching the broken store."""
+    rng = np.random.default_rng(10)
+    prompt = _prompt(rng, cfg, 16)
+    eng = ServingEngine(params, cfg, store=_FlakyStore(fail_on))
+    out = eng.run([Request("r", prompt, max_new_tokens=5)])
+    ref = ServingEngine(params, cfg).run(
+        [Request("x", prompt, max_new_tokens=5)]
+    )
+    assert out["r"] == ref["x"]
+    assert eng.stats["store_errors"] == 1
+    # Downgrade is sticky: a second request makes no store calls.
+    store = eng.store
+    n_calls = len(store.calls)
+    eng.run([Request("r2", prompt, max_new_tokens=3)])
+    assert len(store.calls) == n_calls
+    assert eng.stats["store_errors"] == 1
+
+
 def test_content_keys_diverge_with_any_token():
     a = content_page_keys([1, 2, 3, 4, 5, 6, 7, 8], 4, 2, 0, "k")
     b = content_page_keys([1, 2, 3, 4, 5, 6, 7, 9], 4, 2, 0, "k")

@@ -38,6 +38,33 @@ def test_put_get_bfloat16(store, rng):
     assert jnp.array_equal(y, x)
 
 
+def test_failed_put_aborts_allocation(store, rng, monkeypatch):
+    """A write failure after allocate must roll the tokens back —
+    leaving them uncommitted would dedup-poison the keys for every
+    client (re-puts silently skip, reads 404, and the keys count as
+    present in get_match_last_index)."""
+    n_pages, page_shape = 3, (8, 4)
+    pages = jnp.asarray(rng.random((n_pages, *page_shape)).astype(np.float32))
+    keys = [key() for _ in range(n_pages)]
+
+    real_write = store.conn.write_cache
+
+    def boom(*a, **kw):
+        raise ConnectionError("injected write failure")
+
+    monkeypatch.setattr(store.conn, "write_cache", boom)
+    with pytest.raises(ConnectionError):
+        store.put_kv_pages(keys, pages)
+    monkeypatch.setattr(store.conn, "write_cache", real_write)
+
+    # The keys must be fully usable again: a healthy re-put commits and
+    # reads back (would silently skip + 404 without the abort).
+    assert store.cached_prefix_len(keys) == 0
+    store.put_kv_pages(keys, pages, sync=True)
+    out = store.get_kv_pages(keys, page_shape, np.float32)
+    assert np.array_equal(np.asarray(out), np.asarray(pages))
+
+
 def test_kv_pages_roundtrip(store, rng):
     n_pages, page_shape = 6, (16, 8, 4)
     pages = jnp.asarray(rng.random((n_pages, *page_shape)).astype(np.float32))
