@@ -24,7 +24,9 @@ import jax.numpy as jnp
 
 from ..ops.pallas_flash_attention import flash_prefill
 from ..ops.paged_attention import (
+    multi_token_paged_attention,
     prefill_attention,  # noqa: F401 — kept as the XLA reference path
+    scatter_kv_multi,
     scatter_kv_to_pages,
 )
 from ..ops.pallas_paged_attention import decode_attention as paged_decode_attention
@@ -239,6 +241,58 @@ def decode_step(params, cfg: LlamaConfig, token, seq_lens, k_pages, v_pages,
         new_v_pages.append(vp)
     x = rms_norm(x, params["final_ln"], cfg.norm_eps)
     logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    return logits, jnp.stack(new_k_pages), jnp.stack(new_v_pages)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def verify_step(params, cfg: LlamaConfig, tokens, seq_lens, k_pages,
+                v_pages, page_table, valid_len=None):
+    """m-token decode over paged KV — speculative decoding's verify
+    step (and the chunked-prefill inner step). Consumes m tokens per
+    sequence in ONE pass and returns next-token logits at every one of
+    the m positions, exactly as if `decode_step` had run m times.
+
+    tokens:     [batch, m] int32 — token j lands at position
+                seq_lens[b] + j (its KV is scattered into the pages).
+    seq_lens:   [batch] int32 — tokens already in cache.
+    k_pages/v_pages: [n_layers, n_pages, page, n_kv, hd]
+    page_table: [batch, max_pages] int32 (pages covering positions up
+                to seq_lens + valid_len - 1 must be allocated).
+    valid_len:  [batch] int32 or None — tokens per row that are REAL;
+                padded columns (j >= valid_len[b]) scatter their KV
+                into page 0 (the engine's scratch page) so ragged
+                proposal counts can't clamp into — and corrupt — a
+                sequence's live pages. Requires m <= page_size. None
+                means all m are valid.
+
+    Returns (logits [batch, m, vocab] fp32, new k_pages, new v_pages).
+    A rejected speculative tail needs no rollback: its KV sits at
+    positions >= the accepted seq_len, which later steps overwrite
+    before attending (attention is masked by per-token length).
+    """
+    b, m = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)  # [b, m, d]
+    positions = seq_lens[:, None] + jnp.arange(m)[None, :]
+    page_idx_in_seq = positions // cfg.page_size  # [b, m]
+    target_page = jnp.take_along_axis(page_table, page_idx_in_seq, axis=1)
+    slot = positions % cfg.page_size
+    if valid_len is not None:
+        ok = jnp.arange(m)[None, :] < valid_len[:, None]  # [b, m]
+        target_page = jnp.where(ok, target_page, 0)
+        slot = jnp.where(ok, slot, jnp.arange(m)[None, :] % cfg.page_size)
+
+    new_k_pages, new_v_pages = [], []
+    for li, layer in enumerate(params["layers"]):
+        q, k, v = _qkv(layer, x, cfg, positions)
+        kp = scatter_kv_multi(k_pages[li], k, target_page, slot)
+        vp = scatter_kv_multi(v_pages[li], v, target_page, slot)
+        attn = multi_token_paged_attention(q, kp, vp, page_table, seq_lens)
+        x = x + attn.reshape(b, m, -1) @ layer["wo"]
+        x = x + _mlp(layer, x, cfg.norm_eps)
+        new_k_pages.append(kp)
+        new_v_pages.append(vp)
+    x = rms_norm(x, params["final_ln"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, jnp.stack(new_k_pages), jnp.stack(new_v_pages)
 
 

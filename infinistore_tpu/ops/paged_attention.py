@@ -40,6 +40,16 @@ def scatter_kv_to_pages(pages, new_kv, page_indices, start_in_page):
     return updated
 
 
+def scatter_kv_multi(pages, new_kv, page_indices, start_in_page):
+    """Multi-token variant: write `new_kv` [batch, m, n_kv, hd] at
+    (page_indices[b, j], start_in_page[b, j]) — the m tokens of a
+    speculative-verify or chunked-prefill step. Same scatter semantics
+    as `scatter_kv_to_pages`."""
+    return pages.at[page_indices, start_in_page].set(
+        new_kv, mode="drop", unique_indices=False
+    )
+
+
 def matmul_precision(dtype):
     """MXU precision policy shared by the XLA paths and pallas kernels.
 
@@ -92,6 +102,50 @@ def prefill_attention(q, k, v, causal=True):
         logits = jnp.where(mask[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v, precision=precision)
+
+
+def multi_token_paged_attention(q, k_pages, v_pages, page_table, seq_lens):
+    """m-token decode attention over paged KV — the verify step of
+    speculative decoding and the inner op of chunked prefill.
+
+    q:          [batch, m, n_heads, hd] — m new tokens per sequence,
+                whose KV has ALREADY been scattered into the pages at
+                positions seq_lens[b] + j.
+    k_pages/v_pages: [n_pages, page, n_kv, hd]
+    page_table: [batch, max_pages] int32
+    seq_lens:   [batch] int32 — tokens in cache BEFORE these m (so
+                token j attends to positions < seq_lens[b] + j + 1:
+                causal within the new block, full over the past).
+
+    Returns [batch, m, n_heads, hd]. Static shapes; per-batch lengths
+    are arithmetic masks (no dynamic control flow)."""
+    batch, m, n_heads, hd = q.shape
+    page = k_pages.shape[1]
+    n_kv = k_pages.shape[2]
+    max_pages = page_table.shape[1]
+    n_rep = n_heads // n_kv
+
+    k = gather_pages(k_pages, page_table).reshape(
+        batch, max_pages * page, n_kv, hd
+    )
+    v = gather_pages(v_pages, page_table).reshape(
+        batch, max_pages * page, n_kv, hd
+    )
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+
+    scale = hd ** -0.5
+    precision = matmul_precision(q.dtype)
+    logits = jnp.einsum(
+        "bmhd,bthd->bhmt", q, k, preferred_element_type=jnp.float32,
+        precision=precision,
+    ) * scale
+    t_pos = jnp.arange(max_pages * page)[None, None, :]  # [1, 1, T]
+    limit = (seq_lens[:, None] + jnp.arange(m)[None, :] + 1)[..., None]
+    valid = t_pos < limit  # [b, m, T]
+    logits = jnp.where(valid[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhmt,bthd->bmhd", probs, v, precision=precision)
 
 
 def paged_decode_attention(q, k_pages, v_pages, page_table, seq_lens):

@@ -110,6 +110,13 @@ class ServingConfig:
     #                                capacity use at ~0.4% KV error
     #                                (ops/kv_quant.py); keys are
     #                                namespaced apart from bf16 pages
+    spec_k: int = 0              # speculative decoding: propose up to k
+    #                              tokens per step and verify them in ONE
+    #                              multi-token pass (0 = off). Greedy
+    #                              acceptance — output tokens are
+    #                              IDENTICAL to non-speculative decoding;
+    #                              accepted proposals just arrive k-at-a-
+    #                              time. Requires spec_k + 1 <= page_size
 
 
 @dataclass
@@ -142,6 +149,25 @@ class _Slot:
         return len(self.work.done) + len(self.generated)
 
 
+def prompt_lookup_propose(context, k, ngram=2):
+    """Draft-model-free proposer (prompt-lookup / n-gram speculation):
+    find the most recent earlier occurrence of the context's last
+    `ngram` tokens and propose the k tokens that followed it. Free to
+    compute, surprisingly effective on repetitive text (code,
+    multi-turn chat, retrieval-augmented prompts); returns [] when the
+    pattern has no earlier occurrence."""
+    n = len(context)
+    if n < ngram + 1:
+        return []
+    tail = context[n - ngram:]
+    # Scan right-to-left for the latest match strictly before the tail.
+    for start in range(n - ngram - 1, -1, -1):
+        if context[start:start + ngram] == tail:
+            nxt = context[start + ngram:start + ngram + k]
+            return list(nxt)
+    return []
+
+
 @partial(jax.jit, donate_argnums=(0, 1))
 def _write_pages(k_pool, v_pool, ids, k_new, v_new):
     """Scatter per-layer pages into the pool at `ids` ([m] int32; entries
@@ -161,11 +187,19 @@ class ServingEngine:
     """
 
     def __init__(self, params, cfg: llama.LlamaConfig, sconfig=None,
-                 store=None):
+                 store=None, proposer=None):
         self.params = params
         self.cfg = cfg
         self.sc = sconfig or ServingConfig()
         self.store = store
+        if self.sc.spec_k + 1 > cfg.page_size:
+            raise ValueError(
+                f"spec_k + 1 ({self.sc.spec_k + 1}) must be <= page_size "
+                f"({cfg.page_size}): padded verify columns park in one "
+                f"scratch page"
+            )
+        self.proposer = proposer if proposer is not None \
+            else prompt_lookup_propose
         L = cfg.n_layers
         shape = (L, self.sc.total_pages, cfg.page_size, cfg.n_kv_heads,
                  cfg.head_dim)
@@ -184,7 +218,7 @@ class ServingEngine:
             "requests": 0, "prefix_hit_pages": 0, "restored_pages": 0,
             "prefill_tokens": 0, "decode_steps": 0, "decoded_tokens": 0,
             "offloaded_pages": 0, "preemptions": 0, "store_errors": 0,
-            "restore_misses": 0,
+            "restore_misses": 0, "spec_proposed": 0, "spec_accepted": 0,
         }
         # The store is an accelerator, never a dependency: after the
         # first store failure the engine downgrades itself to store-less
@@ -368,18 +402,22 @@ class ServingEngine:
 
     # ---- decode --------------------------------------------------------
 
-    def _ensure_page(self, slot_idx, slot):
-        """The KV being appended this step lands at position seq_len —
-        allocate that page on demand (vLLM-style growth)."""
-        need_idx = slot.seq_len // self.cfg.page_size
-        if need_idx < len(slot.page_ids):
-            return True
-        ids = self._alloc(1)
-        if ids is None:
-            return False
-        slot.page_ids.extend(ids)
-        self.page_table[slot_idx, need_idx] = ids[0]
+    def _ensure_pages(self, slot_idx, slot, last_pos):
+        """Allocate pages on demand (vLLM-style growth) so positions up
+        to and including `last_pos` are backed. Partial progress is
+        kept: pages allocated before a failure stay owned by the slot."""
+        need_idx = last_pos // self.cfg.page_size
+        while len(slot.page_ids) <= need_idx:
+            ids = self._alloc(1)
+            if ids is None:
+                return False
+            self.page_table[slot_idx, len(slot.page_ids)] = ids[0]
+            slot.page_ids.extend(ids)
         return True
+
+    def _ensure_page(self, slot_idx, slot):
+        """The KV being appended this step lands at position seq_len."""
+        return self._ensure_pages(slot_idx, slot, slot.seq_len)
 
     def _offload_full_pages(self, slot):
         """Persist the slot's NEW full pages to the store (shared by
@@ -480,6 +518,21 @@ class ServingEngine:
         if not active:
             return 0
 
+        if self.sc.spec_k > 0:
+            proposals = {}
+            for i, s in active:
+                ctx = list(s.work.prompt) + s.generated
+                allowed = s.work.req.max_new_tokens - s.total_generated()
+                p = list(self.proposer(ctx, self.sc.spec_k))
+                p = p[: max(0, allowed - 1)]
+                # A buggy/hostile proposer must not index out of vocab.
+                proposals[i] = [int(t) % self.cfg.vocab_size for t in p]
+            if any(proposals.values()):
+                return self._spec_decode(active, proposals)
+            # Every draft is empty: the plain single-token path below is
+            # strictly cheaper (pallas decode kernel, no (k+1)-wide
+            # verify FLOPs) — the common case on non-repetitive text.
+
         token = np.zeros(self.sc.max_slots, dtype=np.int32)
         seq_lens = np.zeros(self.sc.max_slots, dtype=np.int32)
         rows = np.zeros_like(self.page_table)  # inactive → scratch page 0
@@ -515,6 +568,83 @@ class ServingEngine:
             s.generated.append(int(nxt[i]))
             s.seq_len += 1
             self.stats["decoded_tokens"] += 1
+        self.stats["decode_steps"] += 1
+        return len(active)
+
+    def _spec_decode(self, active, proposals):
+        """Speculative step: verify each slot's draft (`proposals`,
+        precomputed by the caller) PLUS the mandatory current token in
+        one multi-token pass, and accept the longest greedy-matching
+        prefix + the bonus token. Token-stream parity with plain
+        decoding holds up to kernel numerics: verify runs the XLA
+        multi-token attention while plain decode runs the pallas
+        flash-decode kernel, so a logit near-tie within their
+        accumulation-order difference can flip a greedy choice (same
+        caveat class as quantized_store). Accepted drafts land
+        several-per-step, amortizing the per-step weight reads that
+        bound decode on TPU (HBM-bandwidth-limited)."""
+        m = self.sc.spec_k + 1
+        B = self.sc.max_slots
+        token = np.zeros((B, m), dtype=np.int32)
+        seq_lens = np.zeros(B, dtype=np.int32)
+        valid = np.zeros(B, dtype=np.int32)
+        rows = np.zeros_like(self.page_table)
+        props = {}
+        for i, s in active:
+            p = proposals[i]
+            if not self._ensure_pages(i, s, s.seq_len + len(p)):
+                # Shrink the draft to what the owned pages can back.
+                avail = (
+                    len(s.page_ids) * self.cfg.page_size - s.seq_len
+                )
+                if avail < 1:
+                    if len(active) > 1:
+                        self._preempt(i, s)
+                    else:
+                        self._finish(i, s)
+                    continue
+                p = p[: avail - 1]
+            token[i, 0] = s.generated[-1]
+            for j, t in enumerate(p):
+                token[i, 1 + j] = t
+            valid[i] = 1 + len(p)
+            seq_lens[i] = s.seq_len
+            rows[i] = self.page_table[i]
+            props[i] = p
+        active = [
+            (i, s) for i, s in enumerate(self.slots)
+            if s is not None and i in props
+        ]
+        if not active:
+            return 0
+
+        logits, self.k_pages, self.v_pages = llama.verify_step(
+            self.params, self.cfg,
+            jnp.asarray(token), jnp.asarray(seq_lens),
+            self.k_pages, self.v_pages, jnp.asarray(rows),
+            jnp.asarray(valid),
+        )
+        nxt = np.asarray(jnp.argmax(logits, axis=-1))  # [B, m]
+        for i, s in active:
+            p = props[i]
+            a = 0
+            while a < len(p) and p[a] == int(nxt[i, a]):
+                a += 1
+            appended = p[:a] + [int(nxt[i, a])]
+            if self.sc.eos_id >= 0 and self.sc.eos_id in appended:
+                # Nothing after the EOS may be emitted; the truncated
+                # advance keeps the seq_len/history invariant (pages
+                # beyond it hold stale KV that is masked and never
+                # offloaded).
+                appended = appended[: appended.index(self.sc.eos_id) + 1]
+            s.generated.extend(appended)
+            s.seq_len += len(appended)
+            self.stats["spec_proposed"] += len(p)
+            # Draft tokens actually EMITTED (EOS truncation may drop
+            # matched drafts; if the bonus was cut, every emitted token
+            # came from the draft).
+            self.stats["spec_accepted"] += min(a, len(appended))
+            self.stats["decoded_tokens"] += len(appended)
         self.stats["decode_steps"] += 1
         return len(active)
 

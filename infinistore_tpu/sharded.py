@@ -267,15 +267,21 @@ class ShardedConnection:
         Note: like the reference, the server-side search counts
         uncommitted entries (SURVEY.md §3.5 quirk) — the round-1 probe
         via check_exist was stricter (committed-only)."""
+        idx = self._match_last_index_raw(keys)
+        if idx < 0:
+            raise Exception("can't find a match")
+        return idx
+
+    def _match_last_index_raw(self, keys):
+        """get_match_last_index returning -1 instead of raising on a
+        clean miss — same contract as the InfinityConnection raw
+        variant (TpuKVStore.cached_prefix_len depends on it)."""
         parts = list(self._partition(keys).items())
         matches = self._fanout(
             [(self.conns[s]._match_last_index_raw, (ks,))
              for s, (_idxs, ks) in parts]
         )
-        idx = self._merge_match(keys, parts, matches)
-        if idx < 0:
-            raise Exception("can't find a match")
-        return idx
+        return self._merge_match(keys, parts, matches)
 
     async def get_match_last_index_async(self, keys):
         loop = asyncio.get_running_loop()

@@ -210,6 +210,60 @@ def test_prefix_cache_hit_flow(params, cfg, shm_conn):
     )
 
 
+def test_verify_step_equals_sequential_decode(params, cfg):
+    """verify_step must consume m tokens in one pass and reproduce m
+    sequential decode_steps — logits at every position AND the final
+    page contents (the invariant speculative decoding rests on)."""
+    rng = np.random.default_rng(7)
+    s, m = 12, 3
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (2, s)), dtype=jnp.int32
+    )
+    step_toks = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (2, m)), dtype=jnp.int32
+    )
+    _, kvs = llama.prefill(params, cfg, tokens)
+    total_pages, max_pages = 8, 4
+    shape = (cfg.n_layers, total_pages, cfg.page_size, cfg.n_kv_heads,
+             cfg.head_dim)
+    k_pages = jnp.zeros(shape, dtype=cfg.jdtype)
+    v_pages = jnp.zeros_like(k_pages)
+    # Batch row 0 owns pages 0-3, row 1 owns 4-7 (interleaved layout on
+    # purpose — exercises the per-row page tables).
+    pt = np.stack([np.arange(4), 4 + np.arange(4)]).astype(np.int32)
+    for li, (k, v) in enumerate(kvs):
+        kp, vp = llama.kv_to_pages(cfg, k, v)
+        for bi in range(2):
+            k_pages = k_pages.at[li, pt[bi, : kp.shape[1]]].set(kp[bi])
+            v_pages = v_pages.at[li, pt[bi, : vp.shape[1]]].set(vp[bi])
+    page_table = jnp.asarray(pt)
+    seq_lens = jnp.asarray([s, s], dtype=jnp.int32)
+
+    # Sequential reference: m single-token decode steps.
+    ks, vs = k_pages, v_pages
+    seq_logits = []
+    for j in range(m):
+        lg, ks, vs = llama.decode_step(
+            params, cfg, step_toks[:, j], seq_lens + j, ks, vs, page_table
+        )
+        seq_logits.append(lg)
+
+    ver_logits, kv2, vv2 = llama.verify_step(
+        params, cfg, step_toks, seq_lens, k_pages, v_pages, page_table
+    )
+    for j in range(m):
+        np.testing.assert_allclose(
+            np.asarray(ver_logits[:, j]), np.asarray(seq_logits[j]),
+            rtol=2e-4, atol=2e-4,
+        )
+    np.testing.assert_allclose(
+        np.asarray(kv2), np.asarray(ks), rtol=2e-5, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(vv2), np.asarray(vs), rtol=2e-5, atol=2e-5
+    )
+
+
 def test_scatter_kv_to_pages():
     pages = jnp.zeros((4, 8, 2, 4))
     new = jnp.ones((2, 1, 2, 4))

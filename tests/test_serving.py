@@ -14,6 +14,7 @@ from infinistore_tpu.serving import (
     ServingConfig,
     ServingEngine,
     content_page_keys,
+    prompt_lookup_propose,
 )
 
 
@@ -295,6 +296,107 @@ def test_model_namespace_prevents_cross_hits(params, cfg, shm_conn):
     )
     eng_b.run([Request("b", prompt, max_new_tokens=2)])
     assert eng_b.stats["prefix_hit_pages"] == 0
+
+
+def test_prompt_lookup_proposer():
+    # ...A B C x y z ... A B C -> propose x y z (latest match wins).
+    ctx = [1, 2, 3, 7, 8, 9, 4, 1, 2, 3, 5, 6, 0, 1, 2, 3]
+    assert prompt_lookup_propose(ctx, 3, ngram=3) == [5, 6, 0]
+    assert prompt_lookup_propose(ctx, 2, ngram=3) == [5, 6]
+    assert prompt_lookup_propose([1, 2, 3, 4], 3, ngram=2) == []
+    assert prompt_lookup_propose([5], 3) == []
+
+
+class _OracleProposer:
+    """Proposes the exact greedy continuation (precomputed) — every
+    draft accepted; the strongest stress on the verify/accept path."""
+
+    def __init__(self, lookup):
+        self.lookup = lookup  # {context tuple -> next tokens}
+
+    def __call__(self, context, k):
+        return self.lookup.get(tuple(context), [])[:k]
+
+
+@pytest.mark.parametrize("proposer_kind", ["oracle", "adversarial",
+                                           "lookup"])
+def test_speculative_decoding_token_parity(params, cfg, proposer_kind):
+    """Speculative decoding must emit EXACTLY the plain-decode tokens
+    whatever the proposer does — a perfect oracle (all accepted), an
+    adversarial one (all rejected), or real prompt-lookup."""
+    rng = np.random.default_rng(11)
+    base = _prompt(rng, cfg, 11)
+    n_new = 12
+    plain = ServingEngine(params, cfg, ServingConfig(max_slots=2))
+    ref = plain.run([Request("x", base, max_new_tokens=n_new)])["x"]
+
+    if proposer_kind == "oracle":
+        # Precompute greedy continuations at every context length.
+        lookup = {}
+        toks = list(base) + ref
+        for i in range(len(base), len(toks)):
+            lookup[tuple(toks[:i])] = toks[i:]
+        proposer = _OracleProposer(lookup)
+    elif proposer_kind == "adversarial":
+        def proposer(context, k):
+            return [(context[-1] + 13) % cfg.vocab_size] * k
+    else:
+        proposer = prompt_lookup_propose
+
+    eng = ServingEngine(
+        params, cfg, ServingConfig(max_slots=2, spec_k=3),
+        proposer=proposer,
+    )
+    out = eng.run([Request("r", base, max_new_tokens=n_new)])
+    assert out["r"] == ref, proposer_kind
+    if proposer_kind == "oracle":
+        assert eng.stats["spec_accepted"] > 0
+        # Every proposal accepted -> far fewer steps than tokens.
+        assert eng.stats["decode_steps"] < n_new - 1
+    if proposer_kind == "adversarial":
+        assert eng.stats["spec_accepted"] == 0
+        assert eng.stats["decode_steps"] == n_new - 1
+
+
+def test_speculative_batched_mixed_slots(params, cfg):
+    """Slots with and without accepted drafts share verify batches;
+    every request's tokens must still match its plain run."""
+    rng = np.random.default_rng(12)
+    reqs = [
+        Request(f"r{i}", _prompt(rng, cfg, n), max_new_tokens=mx)
+        for i, (n, mx) in enumerate([(9, 8), (17, 10), (5, 6)])
+    ]
+    eng = ServingEngine(
+        params, cfg, ServingConfig(max_slots=2, spec_k=2)
+    )
+    out = eng.run(
+        [Request(r.request_id, r.prompt, r.max_new_tokens) for r in reqs]
+    )
+    for r in reqs:
+        plain = ServingEngine(params, cfg, ServingConfig(max_slots=1))
+        ref = plain.run([Request("x", r.prompt, r.max_new_tokens)])
+        assert out[r.request_id] == ref["x"], r.request_id
+    assert eng.slots == [None, None]
+
+
+def test_speculative_eos_truncation(params, cfg):
+    """An EOS accepted mid-draft must end the output AT the EOS."""
+    rng = np.random.default_rng(13)
+    base = _prompt(rng, cfg, 9)
+    plain = ServingEngine(params, cfg)
+    ref = plain.run([Request("x", base, max_new_tokens=8)])["x"]
+    eos = ref[3]  # make the 4th generated token the EOS
+    want = ref[: 4]
+    lookup = {}
+    toks = list(base) + ref
+    for i in range(len(base), len(toks)):
+        lookup[tuple(toks[:i])] = toks[i:]
+    eng = ServingEngine(
+        params, cfg, ServingConfig(spec_k=3, eos_id=eos),
+        proposer=_OracleProposer(lookup),
+    )
+    out = eng.run([Request("r", base, max_new_tokens=8)])
+    assert out["r"] == want
 
 
 class _FlakyStore:

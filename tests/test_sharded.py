@@ -94,6 +94,23 @@ def test_sharded_match_last_index(sconn, rng):
         sconn.get_match_last_index([key(), key()])
 
 
+def test_sharded_cached_prefix_len(sconn, rng):
+    """TpuKVStore.cached_prefix_len must work over a ShardedConnection
+    (it uses the raw match variant — a clean miss is 0, never an
+    exception or AttributeError): the serving engine's prefix probe on
+    a sharded store depends on this."""
+    from infinistore_tpu.tpu import TpuKVStore
+
+    store = TpuKVStore(sconn)
+    assert store.cached_prefix_len([key(), key()]) == 0
+    page = 256
+    src = rng.random(page * 3).astype(np.float32)
+    keys = [f"cpl_{uuid.uuid4()}_{i}" for i in range(6)]
+    sconn.put(src, [(k, i * page) for i, k in enumerate(keys[:3])], page)
+    sconn.sync()
+    assert store.cached_prefix_len(keys) == 3
+
+
 def test_sharded_dedup_and_delete(sconn, rng):
     page = 256
     first = rng.random(page).astype(np.float32)
