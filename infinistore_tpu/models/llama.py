@@ -260,10 +260,13 @@ def verify_step(params, cfg: LlamaConfig, tokens, seq_lens, k_pages,
                 to seq_lens + valid_len - 1 must be allocated).
     valid_len:  [batch] int32 or None — tokens per row that are REAL;
                 padded columns (j >= valid_len[b]) scatter their KV
-                into page 0 (the engine's scratch page) so ragged
-                proposal counts can't clamp into — and corrupt — a
-                sequence's live pages. Requires m <= page_size. None
-                means all m are valid.
+                into page 0 (the engine's scratch page) at slot
+                j % page_size, so ragged counts can't clamp into — and
+                corrupt — a sequence's live pages. m may exceed
+                page_size: wrapped scratch slots collide, which is
+                harmless (scratch values are never attended — page 0
+                appears in no sequence's page table). None means all m
+                are valid.
 
     Returns (logits [batch, m, vocab] fp32, new k_pages, new v_pages).
     A rejected speculative tail needs no rollback: its KV sits at

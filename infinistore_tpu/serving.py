@@ -113,10 +113,15 @@ class ServingConfig:
     spec_k: int = 0              # speculative decoding: propose up to k
     #                              tokens per step and verify them in ONE
     #                              multi-token pass (0 = off). Greedy
-    #                              acceptance — output tokens are
-    #                              IDENTICAL to non-speculative decoding;
-    #                              accepted proposals just arrive k-at-a-
-    #                              time. Requires spec_k + 1 <= page_size
+    #                              acceptance — accepted proposals just
+    #                              arrive k-at-a-time (see _spec_decode
+    #                              for the kernel-numerics caveat)
+    prefill_chunk: int = 0       # chunked prefill (0 = off): admission
+    #                              consumes the prompt <= chunk tokens
+    #                              per engine step in a MIXED batch with
+    #                              decoding slots, so a long prompt
+    #                              never stalls other sequences' decode
+    #                              (vLLM-style chunked prefill)
 
 
 @dataclass
@@ -144,6 +149,9 @@ class _Slot:
     seq_len: int              # tokens whose KV is in pages
     cached_pages: int = 0     # pages restored from the store at admission
     generated: list = field(default_factory=list)
+    pending: list = field(default_factory=list)  # prompt tokens not yet
+    #                                              prefilled (chunked
+    #                                              prefill phase)
 
     def total_generated(self):
         return len(self.work.done) + len(self.generated)
@@ -192,12 +200,6 @@ class ServingEngine:
         self.cfg = cfg
         self.sc = sconfig or ServingConfig()
         self.store = store
-        if self.sc.spec_k + 1 > cfg.page_size:
-            raise ValueError(
-                f"spec_k + 1 ({self.sc.spec_k + 1}) must be <= page_size "
-                f"({cfg.page_size}): padded verify columns park in one "
-                f"scratch page"
-            )
         self.proposer = proposer if proposer is not None \
             else prompt_lookup_propose
         L = cfg.n_layers
@@ -219,6 +221,7 @@ class ServingEngine:
             "prefill_tokens": 0, "decode_steps": 0, "decoded_tokens": 0,
             "offloaded_pages": 0, "preemptions": 0, "store_errors": 0,
             "restore_misses": 0, "spec_proposed": 0, "spec_accepted": 0,
+            "chunk_steps": 0,
         }
         # The store is an accelerator, never a dependency: after the
         # first store failure the engine downgrades itself to store-less
@@ -358,13 +361,32 @@ class ServingEngine:
                 hit = 0
             else:
                 self._pool_write(ids[:hit], kp, vp)
-                prefix_kvs = [
-                    llama.pages_to_kv(cfg, kp[li][None], vp[li][None],
-                                      hit * page)
-                    for li in range(cfg.n_layers)
-                ]
+                if self.sc.prefill_chunk == 0:
+                    # Contiguous form for the one-shot suffix prefill;
+                    # the chunked path attends straight over the pages.
+                    prefix_kvs = [
+                        llama.pages_to_kv(cfg, kp[li][None], vp[li][None],
+                                          hit * page)
+                        for li in range(cfg.n_layers)
+                    ]
                 self.stats["prefix_hit_pages"] += hit
                 self.stats["restored_pages"] += hit * cfg.n_layers * 2
+
+        row = np.zeros(self.sc.max_pages_per_seq, dtype=np.int32)
+        row[:n_pages] = ids
+        if self.sc.prefill_chunk > 0:
+            # Chunked admission: no bulk prefill here — the prompt tail
+            # is consumed <= prefill_chunk tokens per engine step in a
+            # MIXED batch with decoding slots (_unified_step); restored
+            # pages already back the cached prefix, and chunk attention
+            # runs straight over the pages.
+            self.page_table[slot_idx] = row
+            self.slots[slot_idx] = _Slot(
+                work=work, page_ids=ids, seq_len=hit * page,
+                cached_pages=hit, generated=[],
+                pending=list(work.prompt[hit * page:]),
+            )
+            return
 
         # Suffix prefill, bucketed to a page multiple (causal attention
         # makes tail padding inert for the positions we read).
@@ -390,8 +412,6 @@ class ServingEngine:
             vp_s.append(b[0])
         self._pool_write(ids[hit:], jnp.stack(kp_s), jnp.stack(vp_s))
 
-        row = np.zeros(self.sc.max_pages_per_seq, dtype=np.int32)
-        row[:n_pages] = ids
         self.page_table[slot_idx] = row
 
         first = int(jnp.argmax(logits[0, s_real - 1]))
@@ -518,6 +538,9 @@ class ServingEngine:
         if not active:
             return 0
 
+        if any(s.pending for _, s in active):
+            return self._unified_step(active)
+
         if self.sc.spec_k > 0:
             proposals = {}
             for i, s in active:
@@ -571,6 +594,82 @@ class ServingEngine:
         self.stats["decode_steps"] += 1
         return len(active)
 
+    def _verify_batch(self, entries, m):
+        """Shared multi-token verify plumbing: pack {slot_idx: tokens}
+        into the padded [B, m] batch (ragged rows park their padding in
+        the scratch page via valid_len), run verify_step, and return
+        (refreshed active list, per-position argmax [B, m])."""
+        B = self.sc.max_slots
+        token = np.zeros((B, m), dtype=np.int32)
+        seq_lens = np.zeros(B, dtype=np.int32)
+        valid = np.zeros(B, dtype=np.int32)
+        rows = np.zeros_like(self.page_table)
+        for i, toks in entries.items():
+            s = self.slots[i]
+            token[i, : len(toks)] = toks
+            valid[i] = len(toks)
+            seq_lens[i] = s.seq_len
+            rows[i] = self.page_table[i]
+        active = [
+            (i, s) for i, s in enumerate(self.slots)
+            if s is not None and i in entries
+        ]
+        if not active:
+            return [], None
+        logits, self.k_pages, self.v_pages = llama.verify_step(
+            self.params, self.cfg,
+            jnp.asarray(token), jnp.asarray(seq_lens),
+            self.k_pages, self.v_pages, jnp.asarray(rows),
+            jnp.asarray(valid),
+        )
+        return active, np.asarray(jnp.argmax(logits, axis=-1))
+
+    def _unified_step(self, active):
+        """Mixed chunked-prefill + decode batch (vLLM-style): slots
+        still prefilling consume up to `prefill_chunk` prompt tokens,
+        decoding slots consume their one token, all in ONE multi-token
+        verify pass — a long prompt admission never stalls the other
+        sequences' decode. m is pinned to the chunk size so the jit
+        compiles once; ragged rows pad via valid_len (scratch-page
+        writes). Decode slots take single tokens here — speculation
+        resumes once no slot is prefilling."""
+        m = self.sc.prefill_chunk
+        entries = {}
+        for i, s in active:
+            if s.pending:
+                entries[i] = s.pending[: min(m, len(s.pending))]
+                # Pages were preallocated at admission — no ensure.
+            else:
+                if not self._ensure_page(i, s):
+                    # A prefilling slot is always also active here, so
+                    # there is another sequence to yield to.
+                    self._preempt(i, s)
+                    continue
+                entries[i] = [s.generated[-1]]
+        active, nxt = self._verify_batch(entries, m)
+        if not active:
+            return 0
+        decoded = False
+        for i, s in active:
+            t = len(entries[i])
+            if s.pending:
+                s.pending = s.pending[t:]
+                s.seq_len += t
+                self.stats["prefill_tokens"] += t
+                if not s.pending:
+                    # Prompt fully consumed: the last position's logits
+                    # yield the first generated token.
+                    s.generated = [int(nxt[i, t - 1])]
+            else:
+                s.generated.append(int(nxt[i, 0]))
+                s.seq_len += 1
+                self.stats["decoded_tokens"] += 1
+                decoded = True
+        self.stats["chunk_steps"] += 1
+        if decoded:
+            self.stats["decode_steps"] += 1
+        return len(active)
+
     def _spec_decode(self, active, proposals):
         """Speculative step: verify each slot's draft (`proposals`,
         precomputed by the caller) PLUS the mandatory current token in
@@ -584,11 +683,7 @@ class ServingEngine:
         several-per-step, amortizing the per-step weight reads that
         bound decode on TPU (HBM-bandwidth-limited)."""
         m = self.sc.spec_k + 1
-        B = self.sc.max_slots
-        token = np.zeros((B, m), dtype=np.int32)
-        seq_lens = np.zeros(B, dtype=np.int32)
-        valid = np.zeros(B, dtype=np.int32)
-        rows = np.zeros_like(self.page_table)
+        entries = {}
         props = {}
         for i, s in active:
             p = proposals[i]
@@ -604,27 +699,11 @@ class ServingEngine:
                         self._finish(i, s)
                     continue
                 p = p[: avail - 1]
-            token[i, 0] = s.generated[-1]
-            for j, t in enumerate(p):
-                token[i, 1 + j] = t
-            valid[i] = 1 + len(p)
-            seq_lens[i] = s.seq_len
-            rows[i] = self.page_table[i]
+            entries[i] = [s.generated[-1]] + p
             props[i] = p
-        active = [
-            (i, s) for i, s in enumerate(self.slots)
-            if s is not None and i in props
-        ]
+        active, nxt = self._verify_batch(entries, m)
         if not active:
             return 0
-
-        logits, self.k_pages, self.v_pages = llama.verify_step(
-            self.params, self.cfg,
-            jnp.asarray(token), jnp.asarray(seq_lens),
-            self.k_pages, self.v_pages, jnp.asarray(rows),
-            jnp.asarray(valid),
-        )
-        nxt = np.asarray(jnp.argmax(logits, axis=-1))  # [B, m]
         for i, s in active:
             p = props[i]
             a = 0
