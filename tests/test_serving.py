@@ -473,6 +473,44 @@ def test_chunked_prefill_with_store_hit(params, cfg, shm_conn):
     assert out2["t2"] == ref["x"]
 
 
+@pytest.mark.parametrize("seed", [21, 22, 23])
+def test_engine_config_fuzz_token_parity(params, cfg, seed, shm_conn):
+    """Property test: ANY engine configuration (slots, chunking,
+    speculation, store, pool pressure) must emit each request's
+    plain-engine token stream. Catches scheduler interactions no
+    single-feature test covers."""
+    from infinistore_tpu.tpu import TpuKVStore
+
+    rng = np.random.default_rng(seed)
+    n_req = int(rng.integers(2, 5))
+    reqs = [
+        Request(
+            f"r{i}",
+            _prompt(rng, cfg, int(rng.integers(3, 30))),
+            max_new_tokens=int(rng.integers(1, 14)),
+        )
+        for i in range(n_req)
+    ]
+    sc = ServingConfig(
+        max_slots=int(rng.integers(1, 4)),
+        total_pages=int(rng.integers(16, 48)),
+        prefill_chunk=int(rng.choice([0, 3, 8])),
+        spec_k=int(rng.choice([0, 2])),
+    )
+    store = TpuKVStore(shm_conn) if rng.random() < 0.5 else None
+    eng = ServingEngine(params, cfg, sc, store=store)
+    out = eng.run(
+        [Request(r.request_id, r.prompt, r.max_new_tokens) for r in reqs]
+    )
+    for r in reqs:
+        ref = ServingEngine(params, cfg).run(
+            [Request("x", r.prompt, r.max_new_tokens)]
+        )
+        assert out[r.request_id] == ref["x"], (seed, sc, r.request_id)
+    # No leaked pages whatever path was taken.
+    assert sorted(eng.free_pages) == list(range(1, sc.total_pages))
+
+
 class _FlakyStore:
     """Store stub that fails on the chosen operation — the engine must
     degrade to store-less serving, never fail a request."""
