@@ -13,3 +13,19 @@ def test_demo_prefill_runs_end_to_end(server, capsys):
     assert "prefill: 32 tokens" in out
     assert "restored KV" in out
     assert "prefix hit:" in out
+
+
+def test_serve_demo_runs_end_to_end(server, capsys):
+    import re
+
+    from infinistore_tpu.example import serve
+
+    serve.run("127.0.0.1", server.service_port)
+    out = capsys.readouterr().out
+    assert "turn 1: 3 requests" in out
+    assert "restored from the store" in out
+    m = re.search(r"speculative: (\d+)/(\d+) drafts accepted", out)
+    assert m, out
+    # Drafts must have been PROPOSED (deterministic on the repetitive
+    # prompt); acceptance depends on the random-weight model's whims.
+    assert int(m.group(2)) > 0
