@@ -130,16 +130,29 @@ class Request:
     prompt: list              # token ids
     max_new_tokens: int = 16
     cache: bool = True        # use the store for prefix reuse + offload
+    temperature: float = 0.0  # 0 = greedy; > 0 samples softmax(z/T)
+    top_k: int = 0            # 0 = full distribution; else top-k filter
+    seed: int = 0             # per-request sampling stream (reproducible
+    #                           across runs AND across preemptions — the
+    #                           RNG travels with the request's _Work)
 
 
 @dataclass
 class _Work:
     """A request's schedulable state, surviving preemption: `prompt`
     grows by the tokens generated before each swap-out, `done`
-    accumulates the request's full output across incarnations."""
+    accumulates the request's full output across incarnations, and
+    `rng` carries the sampling stream (one draw per generated token, so
+    a preempted-and-resumed sampled run replays identically to an
+    uncontended one)."""
     req: Request
     prompt: list
     done: list = field(default_factory=list)
+    rng: object = None
+
+    def __post_init__(self):
+        if self.req.temperature > 0 and self.rng is None:
+            self.rng = np.random.default_rng(self.req.seed)
 
 
 @dataclass
@@ -176,6 +189,20 @@ def prompt_lookup_propose(context, k, ngram=2):
     return []
 
 
+class _LazyHost:
+    """Device array → host, transferred at most once and only if read
+    (sampling slots need full logits rows; greedy slots never pay)."""
+
+    def __init__(self, arr):
+        self._arr = arr
+        self._host = None
+
+    def __call__(self):
+        if self._host is None:
+            self._host = np.asarray(self._arr)
+        return self._host
+
+
 @partial(jax.jit, donate_argnums=(0, 1))
 def _write_pages(k_pool, v_pool, ids, k_new, v_new):
     """Scatter per-layer pages into the pool at `ids` ([m] int32; entries
@@ -189,9 +216,11 @@ def _write_pages(k_pool, v_pool, ids, k_new, v_new):
 class ServingEngine:
     """Continuous-batching engine serving `models.llama` over the store.
 
-    `store` is a TpuKVStore (or None for store-less serving). Greedy
-    decoding; sampling is the caller's concern (logits hooks can be
-    added without touching the scheduler).
+    `store` is a TpuKVStore (or None for store-less serving). Decoding
+    is greedy by default; per-request seeded temperature/top-k sampling
+    via Request(temperature=..., top_k=..., seed=...) — the RNG stream
+    travels with the request, so sampled output reproduces across runs
+    and across preemptions.
     """
 
     def __init__(self, params, cfg: llama.LlamaConfig, sconfig=None,
@@ -414,13 +443,30 @@ class ServingEngine:
 
         self.page_table[slot_idx] = row
 
-        first = int(jnp.argmax(logits[0, s_real - 1]))
+        first = self._pick(work, np.asarray(logits[0, s_real - 1]))
         self.slots[slot_idx] = _Slot(
             work=work, page_ids=ids, seq_len=n_prompt, cached_pages=hit,
             generated=[first],
         )
 
     # ---- decode --------------------------------------------------------
+
+    def _pick(self, work, row):
+        """Next token from one logits row: greedy by default, seeded
+        temperature/top-k sampling when the request asked for it (one
+        RNG draw per generated token — the stream is reproducible
+        across runs and across preemptions)."""
+        req = work.req
+        if req.temperature <= 0:
+            return int(np.argmax(row))
+        z = np.asarray(row, dtype=np.float64) / req.temperature
+        if 0 < req.top_k < len(z):  # top_k >= vocab = full distribution
+            kth = np.partition(z, -req.top_k)[-req.top_k]
+            z = np.where(z >= kth, z, -np.inf)
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(work.rng.choice(len(p), p=p))
 
     def _ensure_pages(self, slot_idx, slot, last_pos):
         """Allocate pages on demand (vLLM-style growth) so positions up
@@ -544,6 +590,12 @@ class ServingEngine:
         if self.sc.spec_k > 0:
             proposals = {}
             for i, s in active:
+                if s.work.req.temperature > 0:
+                    # Greedy acceptance is only sound for greedy
+                    # requests (sampled acceptance needs rejection
+                    # sampling); sampling slots ride along draft-less.
+                    proposals[i] = []
+                    continue
                 ctx = list(s.work.prompt) + s.generated
                 allowed = s.work.req.max_new_tokens - s.total_generated()
                 p = list(self.proposer(ctx, self.sc.spec_k))
@@ -587,8 +639,13 @@ class ServingEngine:
             self.k_pages, self.v_pages, jnp.asarray(rows),
         )
         nxt = np.asarray(jnp.argmax(logits, axis=-1))
+        lhost = _LazyHost(logits)
         for i, s in active:
-            s.generated.append(int(nxt[i]))
+            if s.work.req.temperature > 0:
+                tok = self._pick(s.work, lhost()[i])
+            else:
+                tok = int(nxt[i])
+            s.generated.append(tok)
             s.seq_len += 1
             self.stats["decoded_tokens"] += 1
         self.stats["decode_steps"] += 1
@@ -598,7 +655,8 @@ class ServingEngine:
         """Shared multi-token verify plumbing: pack {slot_idx: tokens}
         into the padded [B, m] batch (ragged rows park their padding in
         the scratch page via valid_len), run verify_step, and return
-        (refreshed active list, per-position argmax [B, m])."""
+        (refreshed active list, per-position argmax [B, m], logits —
+        device-resident; sampling consumers pull rows to host)."""
         B = self.sc.max_slots
         token = np.zeros((B, m), dtype=np.int32)
         seq_lens = np.zeros(B, dtype=np.int32)
@@ -615,14 +673,14 @@ class ServingEngine:
             if s is not None and i in entries
         ]
         if not active:
-            return [], None
+            return [], None, None
         logits, self.k_pages, self.v_pages = llama.verify_step(
             self.params, self.cfg,
             jnp.asarray(token), jnp.asarray(seq_lens),
             self.k_pages, self.v_pages, jnp.asarray(rows),
             jnp.asarray(valid),
         )
-        return active, np.asarray(jnp.argmax(logits, axis=-1))
+        return active, np.asarray(jnp.argmax(logits, axis=-1)), logits
 
     def _unified_step(self, active):
         """Mixed chunked-prefill + decode batch (vLLM-style): slots
@@ -646,12 +704,14 @@ class ServingEngine:
                     self._preempt(i, s)
                     continue
                 entries[i] = [s.generated[-1]]
-        active, nxt = self._verify_batch(entries, m)
+        active, nxt, logits = self._verify_batch(entries, m)
         if not active:
             return 0
+        lhost = _LazyHost(logits)  # ONE transfer if any slot samples
         decoded = False
         for i, s in active:
             t = len(entries[i])
+            sampler = s.work.req.temperature > 0
             if s.pending:
                 s.pending = s.pending[t:]
                 s.seq_len += t
@@ -659,9 +719,13 @@ class ServingEngine:
                 if not s.pending:
                     # Prompt fully consumed: the last position's logits
                     # yield the first generated token.
-                    s.generated = [int(nxt[i, t - 1])]
+                    tok = (self._pick(s.work, lhost()[i, t - 1])
+                           if sampler else int(nxt[i, t - 1]))
+                    s.generated = [tok]
             else:
-                s.generated.append(int(nxt[i, 0]))
+                tok = (self._pick(s.work, lhost()[i, 0])
+                       if sampler else int(nxt[i, 0]))
+                s.generated.append(tok)
                 s.seq_len += 1
                 self.stats["decoded_tokens"] += 1
                 decoded = True
@@ -701,15 +765,21 @@ class ServingEngine:
                 p = p[: avail - 1]
             entries[i] = [s.generated[-1]] + p
             props[i] = p
-        active, nxt = self._verify_batch(entries, m)
+        active, nxt, logits = self._verify_batch(entries, m)
         if not active:
             return 0
+        lhost = _LazyHost(logits)  # ONE transfer if any slot samples
         for i, s in active:
             p = props[i]
             a = 0
             while a < len(p) and p[a] == int(nxt[i, a]):
                 a += 1
-            appended = p[:a] + [int(nxt[i, a])]
+            if s.work.req.temperature > 0:
+                # Draft-less sampling slot: one sampled token (a == 0).
+                bonus = self._pick(s.work, lhost()[i, 0])
+            else:
+                bonus = int(nxt[i, a])
+            appended = p[:a] + [bonus]
             if self.sc.eos_id >= 0 and self.sc.eos_id in appended:
                 # Nothing after the EOS may be emitted; the truncated
                 # advance keeps the seq_len/history invariant (pages

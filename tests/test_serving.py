@@ -473,6 +473,77 @@ def test_chunked_prefill_with_store_hit(params, cfg, shm_conn):
     assert out2["t2"] == ref["x"]
 
 
+def test_sampling_seeded_deterministic(params, cfg):
+    """temperature>0 with a seed must reproduce exactly across engines;
+    different seeds must diverge; temperature=0 stays pure greedy."""
+    rng = np.random.default_rng(17)
+    prompt = _prompt(rng, cfg, 10)
+
+    def gen(seed, temp=0.8):
+        eng = ServingEngine(params, cfg)
+        return eng.run(
+            [Request("r", prompt, max_new_tokens=12, temperature=temp,
+                     top_k=8, seed=seed)]
+        )["r"]
+
+    assert gen(1) == gen(1)
+    outs = {tuple(gen(s)) for s in range(5)}
+    assert len(outs) > 1  # 5 seeds all colliding would be a broken RNG
+    greedy = ServingEngine(params, cfg).run(
+        [Request("g", prompt, max_new_tokens=12)]
+    )["g"]
+    assert gen(2, temp=0.0) == greedy
+
+
+def test_sampling_survives_preemption(params, cfg, shm_conn):
+    """The RNG stream travels with the request: a sampled sequence that
+    is preempted and resumed must emit exactly the uncontended run's
+    tokens (one draw per token, no replays, no skips)."""
+    from infinistore_tpu.tpu import TpuKVStore
+
+    rng = np.random.default_rng(18)
+    reqs = [
+        Request(f"r{i}", _prompt(rng, cfg, 16), max_new_tokens=24,
+                temperature=0.7, seed=100 + i)
+        for i in range(2)
+    ]
+    store = TpuKVStore(shm_conn)
+    sc = ServingConfig(max_slots=2, total_pages=8, max_pages_per_seq=8)
+    eng = ServingEngine(params, cfg, sc, store=store)
+    out = eng.run(
+        [Request(r.request_id, r.prompt, r.max_new_tokens,
+                 temperature=r.temperature, seed=r.seed) for r in reqs]
+    )
+    assert eng.stats["preemptions"] >= 1
+    for r in reqs:
+        big = ServingEngine(
+            params, cfg, ServingConfig(max_slots=1, total_pages=16)
+        )
+        ref = big.run(
+            [Request("x", r.prompt, r.max_new_tokens,
+                     temperature=r.temperature, seed=r.seed)]
+        )
+        assert out[r.request_id] == ref["x"], r.request_id
+
+
+def test_sampling_rides_spec_and_chunked_paths(params, cfg):
+    """A sampling request through a spec_k/chunked engine must produce
+    its plain-engine sampled stream (drafts are disabled for it; chunk
+    logits feed the sampler)."""
+    rng = np.random.default_rng(19)
+    prompt = _prompt(rng, cfg, 18)
+    req = dict(max_new_tokens=10, temperature=0.9, top_k=4, seed=7)
+    ref = ServingEngine(params, cfg).run(
+        [Request("x", prompt, **req)]
+    )["x"]
+    for sc in [ServingConfig(spec_k=3), ServingConfig(prefill_chunk=4)]:
+        eng = ServingEngine(params, cfg, sc)
+        out = eng.run([Request("r", prompt, **req)])
+        assert out["r"] == ref, sc
+        if sc.spec_k:
+            assert eng.stats["spec_proposed"] == 0  # sampler: draft-less
+
+
 @pytest.mark.parametrize("seed", [21, 22, 23])
 def test_engine_config_fuzz_token_parity(params, cfg, seed, shm_conn):
     """Property test: ANY engine configuration (slots, chunking,
