@@ -459,11 +459,16 @@ class ServingEngine:
         req = work.req
         if req.temperature <= 0:
             return int(np.argmax(row))
-        z = np.asarray(row, dtype=np.float64) / req.temperature
+        z = np.asarray(row, dtype=np.float64)
+        # Subtract the max BEFORE dividing: z/T with a pathologically
+        # tiny T overflows to inf and inf-inf = NaN probabilities; with
+        # the max at 0 first, scaling can only push losers to -inf
+        # (exp -> 0, i.e. greedy), never produce NaN.
+        with np.errstate(over="ignore"):
+            z = (z - z.max()) / req.temperature
         if 0 < req.top_k < len(z):  # top_k >= vocab = full distribution
             kth = np.partition(z, -req.top_k)[-req.top_k]
             z = np.where(z >= kth, z, -np.inf)
-        z -= z.max()
         p = np.exp(z)
         p /= p.sum()
         return int(work.rng.choice(len(p), p=p))
