@@ -24,12 +24,14 @@ import jax.numpy as jnp
 
 from ..ops.pallas_flash_attention import flash_prefill
 from ..ops.paged_attention import (
-    multi_token_paged_attention,
     prefill_attention,  # noqa: F401 — kept as the XLA reference path
     scatter_kv_multi,
     scatter_kv_to_pages,
 )
-from ..ops.pallas_paged_attention import decode_attention as paged_decode_attention
+from ..ops.pallas_paged_attention import (
+    decode_attention as paged_decode_attention,
+    verify_attention as paged_verify_attention,
+)
 
 
 @dataclass(frozen=True)
@@ -289,7 +291,9 @@ def verify_step(params, cfg: LlamaConfig, tokens, seq_lens, k_pages,
         q, k, v = _qkv(layer, x, cfg, positions)
         kp = scatter_kv_multi(k_pages[li], k, target_page, slot)
         vp = scatter_kv_multi(v_pages[li], v, target_page, slot)
-        attn = multi_token_paged_attention(q, kp, vp, page_table, seq_lens)
+        # Pallas streaming kernel on TPU (pages HBM->VMEM, nothing
+        # gathered), XLA gather path elsewhere.
+        attn = paged_verify_attention(q, kp, vp, page_table, seq_lens)
         x = x + attn.reshape(b, m, -1) @ layer["wo"]
         x = x + _mlp(layer, x, cfg.norm_eps)
         new_k_pages.append(kp)

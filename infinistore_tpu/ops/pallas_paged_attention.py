@@ -96,10 +96,18 @@ def _kernel_q(page_tbl_ref, seq_lens_ref, q_ref, kq_ref, ks_ref, vq_ref,
 
 
 def _attend(q, kv, vv, acc_ref, m_ref, l_ref, *, n_kv, n_heads, scale,
-            start, seq_len):
-    """One page's online-softmax fold. q: [H, D]; kv/vv: [P, n_kv, D]
-    (already dequantized if the pages are int8)."""
-    group = n_heads // n_kv
+            start, seq_len, rows_per_kv=None, limit=None):
+    """One page's online-softmax fold, shared by ALL paged kernels.
+
+    q: [rows, D] with `rows_per_kv` consecutive query rows per kv head
+    (decode: the GQA group; verify: m_tok * group — the m-token fold);
+    kv/vv: [P, n_kv, D] (already dequantized if the pages are int8).
+    `limit` masks position pos < limit; a scalar (decode: seq_len) or a
+    [rows, 1] column (verify: per-token causal limits)."""
+    if rows_per_kv is None:
+        rows_per_kv = n_heads // n_kv
+    if limit is None:
+        limit = seq_len
     # HIGHEST on f32 keeps full precision; on bf16 it would request a
     # multi-pass algorithm Mosaic rejects ("Bad lhs type") — the MXU
     # already accumulates bf16xbf16 in f32, so DEFAULT is exact there.
@@ -113,40 +121,40 @@ def _attend(q, kv, vv, acc_ref, m_ref, l_ref, *, n_kv, n_heads, scale,
     # dot maps cleanly onto the MXU).
     logit_blocks = []
     for h in range(n_kv):
-        qh = q[h * group : (h + 1) * group]  # [group, D]
+        qh = q[h * rows_per_kv : (h + 1) * rows_per_kv]  # [rows_kv, D]
         kh = kv[:, h]  # [P, D]
         logit_blocks.append(
             jax.lax.dot_general(
                 qh, kh, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
                 precision=precision,
-            )  # [group, P]
+            )  # [rows_kv, P]
         )
-    logits = jnp.concatenate(logit_blocks, axis=0)  # [H, P]
+    logits = jnp.concatenate(logit_blocks, axis=0)  # [rows, P]
     logits = logits * scale  # true (unpadded) head-dim scale
     pos = start + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-    logits = jnp.where(pos < seq_len, logits, -1e30)
+    logits = jnp.where(pos < limit, logits, -1e30)
 
-    m_prev = m_ref[...]  # [H, 1]
+    m_prev = m_ref[...]  # [rows, 1]
     l_prev = l_ref[...]
-    m_cur = jnp.max(logits, axis=-1, keepdims=True)  # [H, 1]
+    m_cur = jnp.max(logits, axis=-1, keepdims=True)  # [rows, 1]
     m_new = jnp.maximum(m_prev, m_cur)
-    p = jnp.exp(logits - m_new)  # [H, P]
+    p = jnp.exp(logits - m_new)  # [rows, P]
     l_cur = jnp.sum(p, axis=-1, keepdims=True)
     alpha = jnp.exp(m_prev - m_new)
 
     pv_blocks = []
     for h in range(n_kv):
-        ph = p[h * group : (h + 1) * group]  # [group, P]
+        ph = p[h * rows_per_kv : (h + 1) * rows_per_kv]  # [rows_kv, P]
         vvh = vv[:, h]  # [P, D]
         pv_blocks.append(
             jax.lax.dot_general(
                 ph.astype(vvh.dtype), vvh, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
                 precision=precision,
-            )  # [group, D]
+            )  # [rows_kv, D]
         )
-    pv = jnp.concatenate(pv_blocks, axis=0)  # [H, D]
+    pv = jnp.concatenate(pv_blocks, axis=0)  # [rows, D]
     acc_ref[...] = acc_ref[...] * alpha + pv
     m_ref[...] = m_new
     l_ref[...] = l_prev * alpha + l_cur
@@ -174,14 +182,16 @@ def _decode_dims(q_dtype, n_kv, group):
     return sublane, ((n_kv + kv_mult - 1) // kv_mult) * kv_mult
 
 
-def _make_page_idx(page_size, n_pages):
+def _make_page_idx(page_size, n_pages, tok_offset=0):
     """Shared page index map: clamp against the table contract ("padded
     arbitrarily" — the XLA path's jnp.take clamps OOB ids) AND freeze j
     at the sequence's last used page, so pages past seq_len cost no HBM
-    traffic (pallas elides same-index re-fetches)."""
+    traffic (pallas elides same-index re-fetches). `tok_offset` extends
+    the used range by the m new tokens a verify step appends (decode:
+    0)."""
 
     def _page_idx(b, j, pt, sl):
-        last_used = jnp.maximum(sl[b] - 1, 0) // page_size
+        last_used = jnp.maximum(sl[b] + tok_offset - 1, 0) // page_size
         jj = jnp.minimum(j, last_used)
         return (jnp.clip(pt[b, jj], 0, n_pages - 1), 0, 0)
 
@@ -331,6 +341,138 @@ def paged_flash_decode_quantized(q, k_q, k_s, v_q, v_s, page_table,
         interpret=interpret,
     )(page_table, seq_lens, q_p, kq_f, k_s_p, vq_f, v_s_p)
     return out[:, :n_heads, :hd]
+
+
+def _kernel_multi(page_tbl_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, page_size, n_kv, hd, group,
+                  m_tok, scale):
+    """m-token verify attention over paged KV (speculative verify /
+    chunked prefill). Query rows are laid out kv-head-major —
+    row = h * (m_tok * group) + j * group + g for token j, query head
+    h*group+g — so each kv head's dot covers all m tokens' heads in one
+    MXU op; the causal limit is per ROW: token j sees positions
+    < seq_len + j + 1 (its own KV was scattered into the pages before
+    the call)."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    seq_len = seq_lens_ref[b]
+    start = j * page_size
+
+    @pl.when(start < seq_len + m_tok)
+    def _step():
+        rows_per_kv = m_tok * group
+        rows = n_kv * rows_per_kv
+        row = jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
+        tok = (row % rows_per_kv) // group  # token index per query row
+        _attend(q_ref[0],
+                k_ref[0].reshape(page_size, n_kv, hd),
+                v_ref[0].reshape(page_size, n_kv, hd),
+                acc_ref, m_ref, l_ref, n_kv=n_kv, n_heads=rows,
+                scale=scale, start=start, seq_len=seq_len,
+                rows_per_kv=rows_per_kv, limit=seq_len + tok + 1)
+
+    @pl.when(j == n_pages - 1)
+    def _finish():
+        # No l == 0 guard needed: page 0 holds position 0, which is
+        # < seq_len + tok + 1 for every row, so every row folds at
+        # least one valid logit (same invariant as the decode kernel).
+        o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_flash_verify(q, k_pages, v_pages, page_table, seq_lens,
+                       interpret=False):
+    """m-token flash verify over paged KV (same contract as
+    paged_attention.multi_token_paged_attention): q [batch, m, n_heads,
+    hd]; token j's KV must already be scattered at position
+    seq_lens[b] + j. Streams pages HBM → VMEM like the decode kernel —
+    nothing is gathered or materialized — with the causal limit applied
+    per token row. Returns [batch, m, n_heads, hd]."""
+    batch, m_tok, n_heads, hd = q.shape
+    n_pages, page_size, n_kv, _ = k_pages.shape
+    max_pages = page_table.shape[1]
+    group = n_heads // n_kv
+
+    q_p, _ = _pad_to(q, 3, 128)
+    k_p, _ = _pad_to(k_pages, 3, 128)
+    v_p, _ = _pad_to(v_pages, 3, 128)
+    hd_p = q_p.shape[3]
+    # Pad kv heads so n_kv_p * (m_tok * group) rows hit a sublane
+    # multiple (same math as decode, with the m-fold group).
+    _, n_kv_p = _decode_dims(q.dtype, n_kv, m_tok * group)
+    if n_kv_p != n_kv:
+        k_p = jnp.pad(k_p, ((0, 0), (0, 0), (0, n_kv_p - n_kv), (0, 0)))
+        v_p = jnp.pad(v_p, ((0, 0), (0, 0), (0, n_kv_p - n_kv), (0, 0)))
+        q_p = jnp.pad(
+            q_p, ((0, 0), (0, 0), (0, (n_kv_p - n_kv) * group), (0, 0))
+        )
+    rows = n_kv_p * m_tok * group
+
+    # kv-head-major query rows: [b, j, h*group+g] -> h*(m*group)+j*group+g.
+    q_r = q_p.reshape(batch, m_tok, n_kv_p, group, hd_p)
+    q_r = q_r.transpose(0, 2, 1, 3, 4).reshape(batch, rows, hd_p)
+
+    k_f = k_p.reshape(n_pages, page_size, n_kv_p * hd_p)
+    v_f = v_p.reshape(n_pages, page_size, n_kv_p * hd_p)
+
+    _page_idx = _make_page_idx(page_size, n_pages, tok_offset=m_tok)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, rows, hd_p), lambda b, j, pt, sl: (b, 0, 0)),
+            pl.BlockSpec((1, page_size, n_kv_p * hd_p), _page_idx),
+            pl.BlockSpec((1, page_size, n_kv_p * hd_p), _page_idx),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, rows, hd_p), lambda b, j, pt, sl: (b, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, hd_p), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _kernel_multi,
+        page_size=page_size,
+        n_kv=n_kv_p,
+        hd=hd_p,
+        group=group,
+        m_tok=m_tok,
+        scale=hd ** -0.5,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((batch, rows, hd_p), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(page_table, seq_lens, q_r, k_f, v_f)
+    # Invert the kv-major layout and strip padding.
+    out = out.reshape(batch, n_kv_p, m_tok, group, hd_p)
+    out = out.transpose(0, 2, 1, 3, 4).reshape(
+        batch, m_tok, n_kv_p * group, hd_p
+    )
+    return out[:, :, :n_heads, :hd]
+
+
+def verify_attention(q, k_pages, v_pages, page_table, seq_lens):
+    """m-token paged verify attention with automatic backend choice:
+    the pallas streaming kernel on TPU, the XLA gather path elsewhere."""
+    if jax.default_backend() == "tpu":
+        return paged_flash_verify(q, k_pages, v_pages, page_table, seq_lens)
+    return xla_ref.multi_token_paged_attention(
+        q, k_pages, v_pages, page_table, seq_lens
+    )
 
 
 def decode_attention(q, k_pages, v_pages, page_table, seq_lens):

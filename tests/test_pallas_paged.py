@@ -194,3 +194,140 @@ def test_quantized_chooser_fallback_gathers_first():
     )
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Multi-token verify kernel (speculative verify / chunked prefill).
+# ---------------------------------------------------------------------------
+
+def _mk_multi(batch, m, n_heads, n_kv, hd, n_pages, page, max_pages,
+              seed=0, dtype=np.float32):
+    from infinistore_tpu.ops.paged_attention import scatter_kv_multi
+
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(
+        rng.standard_normal((batch, m, n_heads, hd)), dtype=dtype
+    )
+    k = jnp.asarray(
+        rng.standard_normal((n_pages, page, n_kv, hd)), dtype=dtype
+    )
+    v = jnp.asarray(
+        rng.standard_normal((n_pages, page, n_kv, hd)), dtype=dtype
+    )
+    pt = jnp.asarray(
+        rng.permutation(n_pages)[: batch * max_pages].reshape(
+            batch, max_pages
+        ),
+        dtype=jnp.int32,
+    )
+    # Leave room for the m new tokens inside the table's page budget.
+    sl = jnp.asarray(
+        rng.integers(1, max_pages * page - m, batch), dtype=jnp.int32
+    )
+    # The contract: the m tokens' KV is already scattered at positions
+    # seq_lens + j before the attention call.
+    new_k = jnp.asarray(
+        rng.standard_normal((batch, m, n_kv, hd)), dtype=dtype
+    )
+    new_v = jnp.asarray(
+        rng.standard_normal((batch, m, n_kv, hd)), dtype=dtype
+    )
+    positions = sl[:, None] + jnp.arange(m)[None, :]
+    tgt = jnp.take_along_axis(pt, positions // page, axis=1)
+    slot = positions % page
+    k = scatter_kv_multi(k, new_k, tgt, slot)
+    v = scatter_kv_multi(v, new_v, tgt, slot)
+    return q, k, v, pt, sl
+
+
+@pytest.mark.parametrize(
+    "batch,m,n_heads,n_kv,hd,page",
+    [
+        (2, 4, 8, 8, 128, 16),   # MHA
+        (2, 3, 8, 2, 128, 16),   # GQA 4:1, odd m
+        (1, 5, 4, 2, 64, 8),     # padded head-dim + heads
+        (3, 2, 16, 4, 32, 8),    # heavy padding
+        (1, 1, 8, 4, 128, 16),   # m=1 degenerates to decode
+    ],
+)
+def test_verify_kernel_matches_xla(batch, m, n_heads, n_kv, hd, page):
+    from infinistore_tpu.ops.paged_attention import (
+        multi_token_paged_attention,
+    )
+    from infinistore_tpu.ops.pallas_paged_attention import (
+        paged_flash_verify,
+    )
+
+    q, k, v, pt, sl = _mk_multi(batch, m, n_heads, n_kv, hd, 32, page, 4)
+    ref = multi_token_paged_attention(q, k, v, pt, sl)
+    out = paged_flash_verify(q, k, v, pt, sl, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_verify_kernel_empty_cache_and_page_spanning_chunk():
+    """The chunked-prefill regimes: seq_len = 0 (first chunk — each
+    token attends only to the block's own scattered KV) and an m-token
+    block spanning several pages (m > page_size)."""
+    from infinistore_tpu.ops.paged_attention import (
+        multi_token_paged_attention,
+        scatter_kv_multi,
+    )
+    from infinistore_tpu.ops.pallas_paged_attention import (
+        paged_flash_verify,
+    )
+
+    rng = np.random.default_rng(41)
+    B, m, H, KV, hd, page, n_pages, mp = 2, 12, 4, 2, 64, 8, 16, 4
+    q = jnp.asarray(rng.standard_normal((B, m, H, hd)), jnp.float32)
+    k = jnp.asarray(
+        rng.standard_normal((n_pages, page, KV, hd)), jnp.float32
+    )
+    v = jnp.asarray(
+        rng.standard_normal((n_pages, page, KV, hd)), jnp.float32
+    )
+    pt = jnp.asarray(
+        rng.permutation(n_pages)[: B * mp].reshape(B, mp), jnp.int32
+    )
+    # Row 0: empty cache; row 1: mid-page start. m=12 spans 2-3 pages.
+    sl = jnp.asarray([0, 5], jnp.int32)
+    new_k = jnp.asarray(
+        rng.standard_normal((B, m, KV, hd)), jnp.float32
+    )
+    new_v = jnp.asarray(
+        rng.standard_normal((B, m, KV, hd)), jnp.float32
+    )
+    positions = sl[:, None] + jnp.arange(m)[None, :]
+    tgt = jnp.take_along_axis(pt, positions // page, axis=1)
+    k = scatter_kv_multi(k, new_k, tgt, positions % page)
+    v = scatter_kv_multi(v, new_v, tgt, positions % page)
+
+    ref = multi_token_paged_attention(q, k, v, pt, sl)
+    out = paged_flash_verify(q, k, v, pt, sl, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_verify_kernel_bf16():
+    from infinistore_tpu.ops.paged_attention import (
+        multi_token_paged_attention,
+    )
+    from infinistore_tpu.ops.pallas_paged_attention import (
+        paged_flash_verify,
+    )
+
+    q, k, v, pt, sl = _mk_multi(
+        2, 4, 8, 4, 128, 32, 16, 4, dtype=jnp.bfloat16
+    )
+    ref = multi_token_paged_attention(q, k, v, pt, sl)
+    out = paged_flash_verify(q, k, v, pt, sl, interpret=True)
+    err = float(
+        jnp.max(
+            jnp.abs(
+                out.astype(jnp.float32) - ref.astype(jnp.float32)
+            )
+        )
+    )
+    assert err < 3e-2, err
