@@ -135,6 +135,11 @@ class Request:
     seed: int = 0             # per-request sampling stream (reproducible
     #                           across runs AND across preemptions — the
     #                           RNG travels with the request's _Work)
+    on_token: object = None   # optional callable(request_id, token):
+    #                           streaming delivery, fired once per
+    #                           generated token as it is produced (incl.
+    #                           across preemptions; a mid-draft EOS
+    #                           truncation emits only the kept tokens)
 
 
 @dataclass
@@ -443,13 +448,26 @@ class ServingEngine:
 
         self.page_table[slot_idx] = row
 
-        first = self._pick(work, np.asarray(logits[0, s_real - 1]))
-        self.slots[slot_idx] = _Slot(
+        slot = _Slot(
             work=work, page_ids=ids, seq_len=n_prompt, cached_pages=hit,
-            generated=[first],
         )
+        self._emit(
+            slot, [self._pick(work, np.asarray(logits[0, s_real - 1]))]
+        )
+        self.slots[slot_idx] = slot
 
     # ---- decode --------------------------------------------------------
+
+    def _emit(self, slot, tokens):
+        """The ONE place generated tokens enter a slot: appends and
+        fires the request's streaming callback once per token (callback
+        failures are the caller's bug — they propagate)."""
+        slot.generated.extend(tokens)
+        cb = slot.work.req.on_token
+        if cb is not None:
+            rid = slot.work.req.request_id
+            for t in tokens:
+                cb(rid, t)
 
     def _pick(self, work, row):
         """Next token from one logits row: greedy by default, seeded
@@ -650,7 +668,7 @@ class ServingEngine:
                 tok = self._pick(s.work, lhost()[i])
             else:
                 tok = int(nxt[i])
-            s.generated.append(tok)
+            self._emit(s, [tok])
             s.seq_len += 1
             self.stats["decoded_tokens"] += 1
         self.stats["decode_steps"] += 1
@@ -726,11 +744,11 @@ class ServingEngine:
                     # yield the first generated token.
                     tok = (self._pick(s.work, lhost()[i, t - 1])
                            if sampler else int(nxt[i, t - 1]))
-                    s.generated = [tok]
+                    self._emit(s, [tok])
             else:
                 tok = (self._pick(s.work, lhost()[i, 0])
                        if sampler else int(nxt[i, 0]))
-                s.generated.append(tok)
+                self._emit(s, [tok])
                 s.seq_len += 1
                 self.stats["decoded_tokens"] += 1
                 decoded = True
@@ -791,7 +809,7 @@ class ServingEngine:
                 # beyond it hold stale KV that is masked and never
                 # offloaded).
                 appended = appended[: appended.index(self.sc.eos_id) + 1]
-            s.generated.extend(appended)
+            self._emit(s, appended)
             s.seq_len += len(appended)
             self.stats["spec_proposed"] += len(p)
             # Draft tokens actually EMITTED (EOS truncation may drop

@@ -544,6 +544,64 @@ def test_sampling_rides_spec_and_chunked_paths(params, cfg):
             assert eng.stats["spec_proposed"] == 0  # sampler: draft-less
 
 
+def test_streaming_on_token_exactly_once_in_order(params, cfg, shm_conn):
+    """on_token must deliver every output token exactly once, in order,
+    across plain decode, speculation (multi-token appends), chunked
+    prefill, and preemption/resume."""
+    from infinistore_tpu.tpu import TpuKVStore
+
+    rng = np.random.default_rng(24)
+    streamed = {}
+
+    def cb(rid, tok):
+        streamed.setdefault(rid, []).append(tok)
+
+    # Preemption-inducing config with spec enabled.
+    reqs = [
+        Request(f"r{i}", _prompt(rng, cfg, 16), max_new_tokens=24,
+                on_token=cb)
+        for i in range(2)
+    ]
+    sc = ServingConfig(max_slots=2, total_pages=8, max_pages_per_seq=8,
+                       spec_k=2)
+    eng = ServingEngine(params, cfg, sc, store=TpuKVStore(shm_conn))
+    out = eng.run(reqs)
+    assert eng.stats["preemptions"] >= 1
+    for rid, toks in out.items():
+        assert streamed[rid] == toks, rid
+
+    # Chunked prefill.
+    streamed.clear()
+    prompt = _prompt(rng, cfg, 21)
+    eng2 = ServingEngine(
+        params, cfg, ServingConfig(prefill_chunk=4)
+    )
+    out2 = eng2.run(
+        [Request("c", prompt, max_new_tokens=7, on_token=cb)]
+    )
+    assert streamed["c"] == out2["c"]
+
+    # EOS-truncating speculation: an oracle proposer drives a draft
+    # containing the EOS; post-EOS tokens must never reach the stream.
+    streamed.clear()
+    base = _prompt(rng, cfg, 9)
+    ref = ServingEngine(params, cfg).run(
+        [Request("x", base, max_new_tokens=8)]
+    )["x"]
+    eos = ref[3]
+    lookup = {}
+    toks = list(base) + ref
+    for i in range(len(base), len(toks)):
+        lookup[tuple(toks[:i])] = toks[i:]
+    eng3 = ServingEngine(
+        params, cfg, ServingConfig(spec_k=3, eos_id=eos),
+        proposer=_OracleProposer(lookup),
+    )
+    out3 = eng3.run([Request("e", base, max_new_tokens=8, on_token=cb)])
+    assert out3["e"] == ref[:4]  # truncated AT the EOS
+    assert streamed["e"] == out3["e"]  # and streamed identically
+
+
 @pytest.mark.parametrize("seed", [21, 22, 23])
 def test_engine_config_fuzz_token_parity(params, cfg, seed, shm_conn):
     """Property test: ANY engine configuration (slots, chunking,
