@@ -113,8 +113,10 @@ class ServingConfig:
     spec_k: int = 0              # speculative decoding: propose up to k
     #                              tokens per step and verify them in ONE
     #                              multi-token pass (0 = off). Greedy
-    #                              acceptance — accepted proposals just
-    #                              arrive k-at-a-time (see _spec_decode
+    #                              requests use argmax-prefix acceptance;
+    #                              sampled requests use rejection-sampling
+    #                              acceptance, which preserves their exact
+    #                              output distribution (see _spec_decode
     #                              for the kernel-numerics caveat)
     prefill_chunk: int = 0       # chunked prefill (0 = off): admission
     #                              consumes the prompt <= chunk tokens
@@ -134,7 +136,10 @@ class Request:
     top_k: int = 0            # 0 = full distribution; else top-k filter
     seed: int = 0             # per-request sampling stream (reproducible
     #                           across runs AND across preemptions — the
-    #                           RNG travels with the request's _Work)
+    #                           RNG travels with the request's _Work.
+    #                           With spec_k>0, drafts consume extra
+    #                           draws, so reproducibility under load is
+    #                           DISTRIBUTION-level, not stream-level)
     on_token: object = None   # optional callable(request_id, token):
     #                           streaming delivery, fired once per
     #                           generated token as it is produced (incl.
@@ -147,9 +152,12 @@ class _Work:
     """A request's schedulable state, surviving preemption: `prompt`
     grows by the tokens generated before each swap-out, `done`
     accumulates the request's full output across incarnations, and
-    `rng` carries the sampling stream (one draw per generated token, so
-    a preempted-and-resumed sampled run replays identically to an
-    uncontended one)."""
+    `rng` carries the sampling stream. On non-speculative engines that
+    is one draw per generated token, so a preempted-and-resumed sampled
+    run replays identically to an uncontended one; with spec_k>0,
+    rejection-sampling acceptance consumes a variable number of draws,
+    so replay under preemption is distribution-identical rather than
+    stream-identical."""
     req: Request
     prompt: list
     done: list = field(default_factory=list)
@@ -225,7 +233,8 @@ class ServingEngine:
     is greedy by default; per-request seeded temperature/top-k sampling
     via Request(temperature=..., top_k=..., seed=...) — the RNG stream
     travels with the request, so sampled output reproduces across runs
-    and across preemptions.
+    and across preemptions (with spec_k>0, reproducibility under
+    preemption is at the distribution level — see _Work).
     """
 
     def __init__(self, params, cfg: llama.LlamaConfig, sconfig=None,
@@ -267,10 +276,17 @@ class ServingEngine:
             partial(llama.prefill_with_prefix, params, cfg)
         )
         # Everything that shapes page BYTES goes into the key namespace:
-        # engines differing in any of these must never cross-hit.
+        # engines differing in any of these must never cross-hit. When
+        # the caller left model_id at its default AND a store is
+        # attached, derive a weights fingerprint so two engines with
+        # different checkpoints (but identical KV geometry) sharing one
+        # store can never silently cross-hit each other's cached KV.
+        model_id = self.sc.model_id
+        if store is not None and model_id == "default":
+            model_id = f"wf{self._weights_fingerprint()}"
         wire = "q8" if self.sc.quantized_store else cfg.dtype
         self._ns = (
-            f"{self.sc.model_id}/p{cfg.page_size}/l{cfg.n_layers}"
+            f"{model_id}/p{cfg.page_size}/l{cfg.n_layers}"
             f"/kv{cfg.n_kv_heads}x{cfg.head_dim}/{wire}"
         )
         if store is not None and self.sc.quantized_store:
@@ -279,6 +295,35 @@ class ServingEngine:
         elif store is not None:
             self._get_pages = store.get_kv_pages
             self._put_pages = store.put_kv_pages
+
+    def _weights_fingerprint(self):
+        """Cheap checkpoint identity for the store-key namespace: sha256
+        over every leaf's (shape, dtype) plus a fused POSITION-WEIGHTED
+        per-leaf float32 checksum (ONE device program + one tiny
+        transfer at engine init). The position weights matter: a plain
+        sum is permutation-invariant, so two checkpoints that are
+        element-permutations of each other (the same model exported
+        with different head/QKV layouts) would collide — exactly the
+        cross-hit this fingerprint exists to prevent. Computed only
+        when the caller left model_id at its default with a store
+        attached. Backend-specific reduction order means the same
+        checkpoint may fingerprint differently on different backends —
+        a cache MISS, never a cross-hit."""
+        leaves = jax.tree_util.tree_leaves(self.params)
+        h = hashlib.sha256()
+        for leaf in leaves:
+            h.update(str((tuple(leaf.shape), str(leaf.dtype))).encode())
+
+        def _checksum(x):
+            f = jnp.ravel(x).astype(jnp.float32)
+            w = (jnp.arange(f.shape[0], dtype=jnp.float32) % 251.0) + 1.0
+            return jnp.sum(f * w, dtype=jnp.float32)
+
+        sums = jax.jit(
+            lambda ls: jnp.stack([_checksum(x) for x in ls])
+        )(leaves)
+        h.update(np.asarray(sums, dtype=np.float32).tobytes())
+        return h.hexdigest()[:16]
 
     def _digests(self, tokens, n_pages):
         return content_page_digests(
@@ -290,6 +335,11 @@ class ServingEngine:
     def submit(self, req: Request):
         if len(req.prompt) < 1:
             raise ValueError("empty prompt")
+        if req.max_new_tokens < 1:
+            # Admission always derives one token from the prompt's last
+            # logits; a 0-token budget would still generate (and stream)
+            # it, so reject the request up front instead.
+            raise ValueError("max_new_tokens must be >= 1")
         need = -(-(len(req.prompt) + req.max_new_tokens) // self.cfg.page_size)
         if need > self.sc.max_pages_per_seq:
             raise ValueError(
@@ -469,14 +519,10 @@ class ServingEngine:
             for t in tokens:
                 cb(rid, t)
 
-    def _pick(self, work, row):
-        """Next token from one logits row: greedy by default, seeded
-        temperature/top-k sampling when the request asked for it (one
-        RNG draw per generated token — the stream is reproducible
-        across runs and across preemptions)."""
-        req = work.req
-        if req.temperature <= 0:
-            return int(np.argmax(row))
+    @staticmethod
+    def _probs(req, row):
+        """The request's sampling distribution over one logits row
+        (temperature + top-k transform, normalized float64)."""
         z = np.asarray(row, dtype=np.float64)
         # Subtract the max BEFORE dividing: z/T with a pathologically
         # tiny T overflows to inf and inf-inf = NaN probabilities; with
@@ -489,6 +535,17 @@ class ServingEngine:
             z = np.where(z >= kth, z, -np.inf)
         p = np.exp(z)
         p /= p.sum()
+        return p
+
+    def _pick(self, work, row):
+        """Next token from one logits row: greedy by default, seeded
+        temperature/top-k sampling when the request asked for it (one
+        RNG draw per token on the non-speculative paths; see _Work for
+        the spec_k reproducibility contract)."""
+        req = work.req
+        if req.temperature <= 0:
+            return int(np.argmax(row))
+        p = self._probs(req, row)
         return int(work.rng.choice(len(p), p=p))
 
     def _ensure_pages(self, slot_idx, slot, last_pos):
@@ -613,12 +670,6 @@ class ServingEngine:
         if self.sc.spec_k > 0:
             proposals = {}
             for i, s in active:
-                if s.work.req.temperature > 0:
-                    # Greedy acceptance is only sound for greedy
-                    # requests (sampled acceptance needs rejection
-                    # sampling); sampling slots ride along draft-less.
-                    proposals[i] = []
-                    continue
                 ctx = list(s.work.prompt) + s.generated
                 allowed = s.work.req.max_new_tokens - s.total_generated()
                 p = list(self.proposer(ctx, self.sc.spec_k))
@@ -757,18 +808,54 @@ class ServingEngine:
             self.stats["decode_steps"] += 1
         return len(active)
 
+    def _sample_over_draft(self, work, draft, rows):
+        """Rejection-sampling acceptance for a sampled request's draft
+        (standard speculative sampling, specialized to a DETERMINISTIC
+        proposer — a point-mass draft distribution): draft token t at
+        position j is accepted with probability p_target_j(t); on
+        rejection the replacement is drawn from the residual
+        (p_target_j with t zeroed, renormalized), which leaves every
+        emitted token exactly target-distributed — the same
+        distribution as draft-less sampling, draw by draw. If the whole
+        draft is accepted, a bonus token is sampled from the next row,
+        so accepted drafts land several-per-step just like the greedy
+        path. Returns (emitted_tokens, n_draft_accepted)."""
+        req = work.req
+        emitted = []
+        for j, t in enumerate(draft):
+            p = self._probs(req, rows[j])
+            if work.rng.random() < p[t]:
+                emitted.append(int(t))
+                continue
+            resid = p.copy()
+            resid[t] = 0.0
+            tot = resid.sum()
+            if tot <= 0.0:
+                # p was (numerically) a point mass AT the draft token;
+                # the residual is empty, so the draw IS the draft token.
+                emitted.append(int(t))
+                continue
+            resid /= tot
+            emitted.append(int(work.rng.choice(len(resid), p=resid)))
+            return emitted, j
+        p = self._probs(req, rows[len(draft)])
+        emitted.append(int(work.rng.choice(len(p), p=p)))
+        return emitted, len(draft)
+
     def _spec_decode(self, active, proposals):
         """Speculative step: verify each slot's draft (`proposals`,
         precomputed by the caller) PLUS the mandatory current token in
-        one multi-token pass, and accept the longest greedy-matching
-        prefix + the bonus token. Token-stream parity with plain
-        decoding holds up to kernel numerics: verify runs the XLA
-        multi-token attention while plain decode runs the pallas
-        flash-decode kernel, so a logit near-tie within their
-        accumulation-order difference can flip a greedy choice (same
-        caveat class as quantized_store). Accepted drafts land
-        several-per-step, amortizing the per-step weight reads that
-        bound decode on TPU (HBM-bandwidth-limited)."""
+        one multi-token pass. Greedy requests accept the longest
+        argmax-matching prefix + the bonus token; sampled requests
+        accept via rejection sampling (_sample_over_draft), so drafts
+        speed them up WITHOUT changing their output distribution.
+        Token-stream parity with plain decoding holds up to kernel
+        numerics: verify runs the XLA multi-token attention while plain
+        decode runs the pallas flash-decode kernel, so a logit near-tie
+        within their accumulation-order difference can flip a greedy
+        choice (same caveat class as quantized_store). Accepted drafts
+        land several-per-step, amortizing the per-step weight reads
+        that bound decode on TPU (HBM-bandwidth-limited)."""
         m = self.sc.spec_k + 1
         entries = {}
         props = {}
@@ -794,15 +881,15 @@ class ServingEngine:
         lhost = _LazyHost(logits)  # ONE transfer if any slot samples
         for i, s in active:
             p = props[i]
-            a = 0
-            while a < len(p) and p[a] == int(nxt[i, a]):
-                a += 1
             if s.work.req.temperature > 0:
-                # Draft-less sampling slot: one sampled token (a == 0).
-                bonus = self._pick(s.work, lhost()[i, 0])
+                appended, a = self._sample_over_draft(
+                    s.work, p, lhost()[i]
+                )
             else:
-                bonus = int(nxt[i, a])
-            appended = p[:a] + [bonus]
+                a = 0
+                while a < len(p) and p[a] == int(nxt[i, a]):
+                    a += 1
+                appended = p[:a] + [int(nxt[i, a])]
             if self.sc.eos_id >= 0 and self.sc.eos_id in appended:
                 # Nothing after the EOS may be emitted; the truncated
                 # advance keeps the seq_len/history invariant (pages
@@ -836,8 +923,20 @@ class ServingEngine:
             ):
                 # Every slot is free so the whole pool is free: the head
                 # request still not admitting means it never will.
+                work = self.queue[0]
+                if work.done:
+                    # A preempted request whose grown prompt (original
+                    # prompt + generated tokens) outgrew the pool can
+                    # never re-admit — finish it with the output it
+                    # already produced (mirroring the alone-slot early
+                    # finish) instead of losing every other request's
+                    # completed output to a RuntimeError.
+                    self.queue.pop(0)
+                    self.outputs[work.req.request_id] = list(work.done)
+                    continue
                 raise RuntimeError(
-                    f"request {self.queue[0].req.request_id} needs more pool "
-                    f"pages than exist ({self.sc.total_pages - 1} usable)"
+                    f"request {work.req.request_id} needs more pool "
+                    f"pages than exist ({self.sc.total_pages - 1} usable); "
+                    "completed outputs remain available in .outputs"
                 )
         return dict(self.outputs)
