@@ -236,10 +236,15 @@ class InfinityConnection:
         return 0
 
     def close(self):
-        if self._h:
+        # After a FAILED reconnect, self._h still points at a handle
+        # that is ALSO parked in _dead_handles (_reconnect_locked only
+        # republishes on success) — destroying it through both paths is
+        # a double free (glibc abort; hit by the sharded background
+        # redial loop when a shard stays down until close()).
+        if self._h and self._h not in self._dead_handles:
             self._lib.ist_conn_close(self._h)
             self._lib.ist_conn_destroy(self._h)
-            self._h = None
+        self._h = None
         for h in self._dead_handles:  # handles parked by reconnects
             self._lib.ist_conn_destroy(h)
         self._dead_handles = []

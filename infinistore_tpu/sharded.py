@@ -22,17 +22,48 @@ Semantics preserved across shards:
   earliest global hole. Exact same result as probing, at ~1 RTT total
   instead of log2(n) sequential round trips.
 - first-writer-wins dedup: per key, inherited from the owning shard.
+
+Shard-failure degrade (VERDICT r3 item 5; the reference has no failover
+of any kind — libinfinistore.cpp tears the whole client down): with
+``degrade_on_failure=True`` (default) a connection-class failure on one
+shard marks THAT shard down instead of failing the whole batched op, a
+background thread keeps redialing it, and until it recovers its keys
+behave as a CACHE would behave — absent:
+
+- allocate: the dead shard's keys come back as inert blocks
+  (``token == FAKE_TOKEN``, status 0) that every write path already
+  skips silently (the first-writer-wins sentinel machinery).
+- write/put: the dead shard's partition is dropped (counted in
+  ``health['lost_write_keys']``) — an at-most-once cache write, exactly
+  like the serving engine's store-less downgrade.
+- read: healthy shards complete, then the call raises
+  InfiniStoreKeyNotFound for the unreachable keys — the same exception
+  an evicted key raises, so cache-style callers (TpuKVStore restore,
+  the serving engine) treat it as a routine miss.
+- check_exist → False; get_match_last_index: the dead shard's first
+  owned key becomes the prefix hole (prefix reuse shrinks, never lies).
+- sync: barriers the healthy shards only.
+
+Consistency contract: the store is a CACHE — degrade trades durability
+for availability. Writes routed to a down shard are lost (readers see
+key-absent, never stale or partial bytes); keys on healthy shards are
+unaffected; after the background reconnect succeeds the shard rejoins
+empty-handed for the lost keys (they 404 until re-put). Callers that
+need fail-stop semantics instead pass ``degrade_on_failure=False`` and
+get the original throw-through behavior.
 """
 
 import asyncio
 import os
+import threading
+import time
 import zlib
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
-from ._native import REMOTE_BLOCK_DTYPE
-from .lib import InfinityConnection
+from ._native import INTERNAL_ERROR, REMOTE_BLOCK_DTYPE, TIMEOUT_ERR
+from .lib import InfinityConnection, InfiniStoreError, InfiniStoreKeyNotFound
 
 
 def _shard_of(key, n):
@@ -44,23 +75,63 @@ def _shard_of(key, n):
     return zlib.crc32(key.encode()) % n
 
 
+class _ShardDown(Exception):
+    """Internal marker: the shard was already known-down, no call made."""
+
+
+def _is_conn_failure(exc):
+    """Connection-class failures mark a shard down; definitive store
+    answers (KEY_NOT_FOUND, OUT_OF_MEMORY, CONFLICT, BAD_REQUEST) and
+    caller bugs (bad args) never do — a healthy server said no."""
+    if isinstance(exc, _ShardDown):
+        return True
+    if isinstance(exc, InfiniStoreKeyNotFound):
+        return False
+    if isinstance(exc, InfiniStoreError):
+        return exc.status in (TIMEOUT_ERR, INTERNAL_ERROR)
+    if isinstance(exc, (ValueError, TypeError, KeyError, IndexError)):
+        return False
+    # "Not connected", socket errors, native-handle failures.
+    return isinstance(exc, Exception)
+
+
 class ShardedConnection:
     """Same call surface as InfinityConnection, fanned over N servers.
 
     ``configs``: list of ClientConfig, one per shard (order defines the
     shard map — all clients must use the same order).
+    ``degrade_on_failure``: see the module docstring's contract.
     """
 
-    def __init__(self, configs):
+    def __init__(self, configs, degrade_on_failure=True):
         if not configs:
             raise ValueError("need at least one shard config")
         self.conns = [InfinityConnection(c) for c in configs]
         self.n = len(configs)
         self.connected = False
         self.parallel = True
+        self.degrade = degrade_on_failure
+        self.degraded = [False] * self.n
+        self.health = {
+            "shard_failures": 0,      # down transitions observed
+            "reconnects": 0,          # successful background redials
+            "skipped_alloc_keys": 0,  # allocs answered with inert blocks
+            "lost_write_keys": 0,     # writes dropped on a down shard
+            "missed_read_keys": 0,    # reads 404'd for a down shard
+            "failed_sync_shards": 0,  # barriers lost mid-flight: writes
+            #                           accepted by a shard that died
+            #                           before sync() — per-key counts
+            #                           are unknowable once the shard
+            #                           is unreachable
+        }
+        self._health_lock = threading.Lock()
+        self._reconnector = None
         self._pool = None
 
     def connect(self):
+        """Connect every shard. Strict even in degrade mode: a shard
+        that is down at STARTUP is a deployment error, not a runtime
+        failure to ride out."""
         self._pool = ThreadPoolExecutor(
             max_workers=self.n, thread_name_prefix="istpu-shard"
         )
@@ -80,12 +151,19 @@ class ShardedConnection:
         return 0
 
     def close(self):
+        self.connected = False  # stops the reconnector loop
+        # Join the reconnector BEFORE closing connections: a redial
+        # in flight while close() destroys the native handles would be
+        # a use-after-free (lib.py's handle-lifetime contract), and one
+        # completing after close() would leak a live connection.
+        rec = self._reconnector
+        if rec is not None and rec.is_alive():
+            rec.join(timeout=30)
         for c in self.conns:
             c.close()
         if self._pool is not None:
             self._pool.shutdown(wait=True)
             self._pool = None
-        self.connected = False
 
     def __enter__(self):
         self.connect()
@@ -98,29 +176,96 @@ class ShardedConnection:
     def shard_of(self, key):
         return _shard_of(key, self.n)
 
+    # -- failure handling ----------------------------------------------
+
+    def _mark_dead(self, shard):
+        with self._health_lock:
+            if self.degraded[shard]:
+                return
+            self.degraded[shard] = True
+            self.health["shard_failures"] += 1
+            if self._reconnector is None or not self._reconnector.is_alive():
+                self._reconnector = threading.Thread(
+                    target=self._reconnect_loop, daemon=True,
+                    name="istpu-shard-reconnect",
+                )
+                self._reconnector.start()
+
+    def _reconnect_loop(self):
+        """Background redial of down shards every ~0.5 s until all are
+        back (or the client closes). On success the shard rejoins with
+        its surviving keys; keys written while it was down are simply
+        absent (the documented cache contract)."""
+        while self.connected:
+            dead = [i for i in range(self.n) if self.degraded[i]]
+            if not dead:
+                return
+            for i in dead:
+                if not self.connected:
+                    return
+                try:
+                    self.conns[i].reconnect()
+                except Exception:
+                    continue
+                with self._health_lock:
+                    self.degraded[i] = False
+                    self.health["reconnects"] += 1
+            time.sleep(0.5)
+
     # -- fan-out plumbing ----------------------------------------------
 
-    def _fanout(self, calls):
-        """Run [(fn, args)] concurrently on the shard pool; returns the
-        results in call order. Runs inline when concurrency cannot help:
-        a single call, no pool yet, or `self.parallel` false (all-SHM
-        shards on a single core — see connect())."""
-        if len(calls) <= 1 or self._pool is None or not self.parallel:
-            return [fn(*args) for fn, args in calls]
-        futures = [self._pool.submit(fn, *args) for fn, args in calls]
-        # Collect everything (never orphan an in-flight native call),
-        # then surface the first error.
-        results, first_err = [], None
-        for f in futures:
-            try:
-                results.append(f.result())
-            except BaseException as e:  # noqa: BLE001 — re-raised below
-                results.append(None)
-                if first_err is None:
-                    first_err = e
+    def _run_shard_calls(self, calls):
+        """Run [(shard, fn, args)] concurrently on the shard pool;
+        returns [(ok, value_or_exc)] in call order. Known-down shards
+        are skipped up front; a connection-class failure marks its
+        shard down (degrade mode) and comes back as (False, exc) for
+        the caller to apply op semantics; anything else re-raises after
+        every in-flight call has been collected (never orphan a native
+        call)."""
+        out = [None] * len(calls)
+        live = []
+        for j, (s, fn, args) in enumerate(calls):
+            if self.degrade and self.degraded[s]:
+                out[j] = (False, _ShardDown(s))
+            else:
+                live.append((j, s, fn, args))
+        if len(live) <= 1 or self._pool is None or not self.parallel:
+            results = []
+            for j, s, fn, args in live:
+                try:
+                    results.append((j, s, True, fn(*args)))
+                except BaseException as e:  # noqa: BLE001 — sorted below
+                    results.append((j, s, False, e))
+        else:
+            futs = [
+                (j, s, self._pool.submit(fn, *args)) for j, s, fn, args in live
+            ]
+            results = []
+            for j, s, f in futs:
+                try:
+                    results.append((j, s, True, f.result()))
+                except BaseException as e:  # noqa: BLE001 — sorted below
+                    results.append((j, s, False, e))
+        first_err = None
+        for j, s, ok, v in results:
+            if not ok:
+                if self.degrade and _is_conn_failure(v):
+                    self._mark_dead(s)
+                elif first_err is None:
+                    first_err = v
+            out[j] = (ok, v)
         if first_err is not None:
             raise first_err
-        return results
+        return out
+
+    def _fanout(self, calls):
+        """Legacy all-shards helper for ops with identical semantics per
+        shard ([(fn, args)] in shard order, results in call order);
+        down shards contribute None."""
+        tagged = [(s, fn, args) for s, (fn, args) in enumerate(calls)]
+        return [
+            v if ok else None for ok, v in self._run_shard_calls(tagged)
+        ]
 
     async def _fanout_async(self, coros):
         return await asyncio.gather(*coros)
@@ -140,12 +285,19 @@ class ShardedConnection:
 
     def _allocate_parts(self, parts, nkeys, page_size_in_bytes):
         out = np.zeros(nkeys, dtype=REMOTE_BLOCK_DTYPE)
-        results = self._fanout(
-            [(self.conns[s].allocate, (ks, page_size_in_bytes))
+        results = self._run_shard_calls(
+            [(s, self.conns[s].allocate, (ks, page_size_in_bytes))
              for s, (_idxs, ks) in parts]
         )
-        for (_s, (idxs, _ks)), blocks in zip(parts, results):
-            out[np.asarray(idxs)] = blocks
+        for (_s, (idxs, ks)), (ok, blocks) in zip(parts, results):
+            if ok:
+                out[np.asarray(idxs)] = blocks
+            else:
+                # Inert rows: token == FAKE_TOKEN (0) — every write path
+                # skips them silently, so the put degrades to a no-op
+                # for exactly the unreachable keys.
+                with self._health_lock:
+                    self.health["skipped_alloc_keys"] += len(ks)
         return out
 
     def _write_parts(self, cache, offsets, page_size, remote_blocks, parts):
@@ -154,10 +306,14 @@ class ShardedConnection:
         for shard, (idxs, _ks) in parts:
             sel = np.asarray(idxs)
             calls.append(
-                (self.conns[shard].write_cache,
+                (shard, self.conns[shard].write_cache,
                  (cache, [offsets[i] for i in idxs], page_size, blocks[sel]))
             )
-        self._fanout(calls)
+        results = self._run_shard_calls(calls)
+        for (_s, (idxs, _ks)), (ok, _v) in zip(parts, results):
+            if not ok:
+                with self._health_lock:
+                    self.health["lost_write_keys"] += len(idxs)
 
     def allocate(self, keys, page_size_in_bytes):
         """Batch allocate across shards (concurrent). Returns
@@ -193,56 +349,145 @@ class ShardedConnection:
         return 0
 
     async def put_cache_async(self, cache, blocks, page_size):
-        """Async sharded put: per-shard put_cache_async concurrently."""
+        """Async sharded put: per-shard put_cache_async concurrently.
+        Down shards drop their partition (counted), like the sync path."""
         parts = {}
         for k, off in blocks:
             parts.setdefault(_shard_of(k, self.n), []).append((k, off))
-        await self._fanout_async(
-            [self.conns[s].put_cache_async(cache, pairs, page_size)
-             for s, pairs in parts.items()]
+        live = {s: p for s, p in parts.items()
+                if not (self.degrade and self.degraded[s])}
+        dropped = sum(len(p) for s, p in parts.items() if s not in live)
+        results = await asyncio.gather(
+            *[self.conns[s].put_cache_async(cache, pairs, page_size)
+              for s, pairs in live.items()],
+            return_exceptions=True,
         )
+        for (s, pairs), r in zip(live.items(), results):
+            if isinstance(r, BaseException):
+                if self.degrade and _is_conn_failure(r):
+                    self._mark_dead(s)
+                    dropped += len(pairs)
+                else:
+                    raise r
+        if dropped:
+            with self._health_lock:
+                self.health["lost_write_keys"] += dropped
         return 0
 
     def reconnect(self):
-        """Reconnect every shard (see InfinityConnection.reconnect)."""
-        self._fanout([(c.reconnect, ()) for c in self.conns])
+        """Reconnect every shard (see InfinityConnection.reconnect),
+        INCLUDING degraded ones (this is the manual redial — it must
+        not skip them); clears degraded state on success."""
+        for c in self.conns:
+            c.reconnect()
+        with self._health_lock:
+            self.degraded = [False] * self.n
         return 0
+
+    def _read_parts(self, blocks):
+        parts = {}
+        for k, off in blocks:
+            parts.setdefault(_shard_of(k, self.n), []).append((k, off))
+        return parts
+
+    def _raise_missed(self, missed):
+        with self._health_lock:
+            self.health["missed_read_keys"] += len(missed)
+        raise InfiniStoreKeyNotFound(
+            404, f"shard(s) unavailable for keys {missed[:4]}"
+            + ("..." if len(missed) > 4 else "")
+        )
 
     def read_cache(self, cache, blocks, page_size):
         """Read (key, offset) pairs from their owning shards
-        (concurrent)."""
-        parts = {}
-        for k, off in blocks:
-            parts.setdefault(_shard_of(k, self.n), []).append((k, off))
-        self._fanout(
-            [(self.conns[s].read_cache, (cache, pairs, page_size))
-             for s, pairs in parts.items()]
+        (concurrent). If a shard is down, the HEALTHY shards' pages
+        still land in ``cache``, then the call raises
+        InfiniStoreKeyNotFound for the unreachable keys — identical to
+        the evicted-key miss every cache-style caller already handles."""
+        parts = list(self._read_parts(blocks).items())
+        results = self._run_shard_calls(
+            [(s, self.conns[s].read_cache, (cache, pairs, page_size))
+             for s, pairs in parts]
         )
+        missed = [
+            k for (_s, pairs), (ok, _v) in zip(parts, results)
+            if not ok for k, _ in pairs
+        ]
+        if missed:
+            self._raise_missed(missed)
         return 0
 
     async def read_cache_async(self, cache, blocks, page_size):
-        """Async sharded read: per-shard read_cache_async concurrently."""
-        parts = {}
-        for k, off in blocks:
-            parts.setdefault(_shard_of(k, self.n), []).append((k, off))
-        await self._fanout_async(
-            [self.conns[s].read_cache_async(cache, pairs, page_size)
-             for s, pairs in parts.items()]
+        """Async sharded read; same degrade contract as read_cache."""
+        parts = list(self._read_parts(blocks).items())
+        live = [(s, p) for s, p in parts
+                if not (self.degrade and self.degraded[s])]
+        missed = [k for s, p in parts
+                  if self.degrade and self.degraded[s] for k, _ in p]
+        results = await asyncio.gather(
+            *[self.conns[s].read_cache_async(cache, pairs, page_size)
+              for s, pairs in live],
+            return_exceptions=True,
         )
+        for (s, pairs), r in zip(live, results):
+            if isinstance(r, BaseException):
+                if self.degrade and _is_conn_failure(r):
+                    self._mark_dead(s)
+                    missed.extend(k for k, _ in pairs)
+                else:
+                    raise r
+        if missed:
+            self._raise_missed(missed)
         return 0
 
     def sync(self):
-        self._fanout([(c.sync, ()) for c in self.conns])
+        """Barrier the healthy shards. A shard that dies BETWEEN
+        accepting writes and this barrier takes those in-flight writes
+        with it — counted as health['failed_sync_shards'] (per-key
+        attribution is impossible once the shard is unreachable); a
+        shard already known down was skipped at write time and counted
+        in lost_write_keys. Waiting on a dead shard would turn degrade
+        into hang, so the barrier covers exactly the reachable set."""
+        results = self._run_shard_calls(
+            [(s, c.sync, ()) for s, c in enumerate(self.conns)]
+        )
+        failed = sum(
+            1 for ok, v in results
+            if not ok and not isinstance(v, _ShardDown)
+        )
+        if failed:
+            with self._health_lock:
+                self.health["failed_sync_shards"] += failed
         return 0
 
     async def sync_async(self):
-        await self._fanout_async([c.sync_async() for c in self.conns])
+        # Snapshot (shard, conn) pairs BEFORE the await: the background
+        # reconnector mutates self.degraded concurrently, and
+        # recomputing the index list afterwards could pair a failure
+        # with the wrong shard.
+        live = [(s, c) for s, c in enumerate(self.conns)
+                if not (self.degrade and self.degraded[s])]
+        results = await asyncio.gather(
+            *[c.sync_async() for _s, c in live], return_exceptions=True
+        )
+        for (s, _c), r in zip(live, results):
+            if isinstance(r, BaseException):
+                if self.degrade and _is_conn_failure(r):
+                    self._mark_dead(s)
+                else:
+                    raise r
         return 0
 
     # -- control plane -------------------------------------------------
 
     def check_exist(self, key):
-        return self.conns[_shard_of(key, self.n)].check_exist(key)
+        """Routed to the owning shard; a down shard's keys are absent
+        (False), matching the read contract."""
+        s = _shard_of(key, self.n)
+        [(ok, v)] = self._run_shard_calls(
+            [(s, self.conns[s].check_exist, (key,))]
+        )
+        return v if ok else False
 
     def _merge_match(self, keys, parts, shard_matches):
         """Merge per-shard prefix-search results into the global longest
@@ -275,41 +520,61 @@ class ShardedConnection:
     def _match_last_index_raw(self, keys):
         """get_match_last_index returning -1 instead of raising on a
         clean miss — same contract as the InfinityConnection raw
-        variant (TpuKVStore.cached_prefix_len depends on it)."""
+        variant (TpuKVStore.cached_prefix_len depends on it). A down
+        shard reports -1 for its subsequence, so its first owned key
+        becomes the hole: prefix reuse SHRINKS under failure, it never
+        claims unreachable pages."""
         parts = list(self._partition(keys).items())
-        matches = self._fanout(
-            [(self.conns[s]._match_last_index_raw, (ks,))
+        results = self._run_shard_calls(
+            [(s, self.conns[s]._match_last_index_raw, (ks,))
              for s, (_idxs, ks) in parts]
         )
+        matches = [v if ok else -1 for ok, v in results]
         return self._merge_match(keys, parts, matches)
 
     async def get_match_last_index_async(self, keys):
+        # Default executor, NOT self._pool: the sync raw variant fans
+        # out on self._pool internally, and nesting the outer call into
+        # the same n-worker pool could deadlock it against its own
+        # per-shard submissions.
         loop = asyncio.get_running_loop()
-        parts = list(self._partition(keys).items())
-        matches = await self._fanout_async(
-            [loop.run_in_executor(
-                self._pool, self.conns[s]._match_last_index_raw, ks)
-             for s, (_idxs, ks) in parts]
+        idx = await loop.run_in_executor(
+            None, self._match_last_index_raw, keys
         )
-        idx = self._merge_match(keys, parts, matches)
         if idx < 0:
             raise Exception("can't find a match")
         return idx
 
     def purge(self):
-        return sum(self._fanout([(c.purge, ()) for c in self.conns]))
+        return sum(
+            r for r in self._fanout([(c.purge, ()) for c in self.conns])
+            if r is not None
+        )
 
     def delete_keys(self, keys):
         parts = list(self._partition(keys).items())
-        return sum(
-            self._fanout(
-                [(self.conns[s].delete_keys, (ks,))
-                 for s, (_idxs, ks) in parts]
-            )
+        results = self._run_shard_calls(
+            [(s, self.conns[s].delete_keys, (ks,))
+             for s, (_idxs, ks) in parts]
         )
+        return sum(v for ok, v in results if ok)
 
     def stats(self):
-        return self._fanout([(c.stats, ()) for c in self.conns])
+        """Per-shard native stats (down shards report {'shard_down':
+        True}) plus a 'sharded_health' summary entry with the degrade
+        counters."""
+        per = [
+            v if ok else {"shard_down": True}
+            for ok, v in self._run_shard_calls(
+                [(s, c.stats, ()) for s, c in enumerate(self.conns)]
+            )
+        ]
+        with self._health_lock:
+            summary = dict(self.health)
+            summary["degraded_shards"] = [
+                i for i in range(self.n) if self.degraded[i]
+            ]
+        return per + [{"sharded_health": summary}]
 
 
 __all__ = ["ShardedConnection"]

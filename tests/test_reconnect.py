@@ -214,3 +214,24 @@ def test_reclaim_orphans_respects_live_writers():
         live.close()
         probe.close()
         srv.stop()
+
+
+def test_failed_reconnect_then_close_no_double_free():
+    """A reconnect() that FAILS (server still down) parks the old
+    handle in _dead_handles while self._h keeps pointing at it;
+    close() must destroy it exactly once (was a glibc double-free
+    abort, hit by the sharded background redial loop — r4 review)."""
+    srv = InfiniStoreServer(
+        ServerConfig(service_port=0, prealloc_size=0.03125,
+                     minimal_allocate_size=16)
+    )
+    port = srv.start()
+    conn = InfinityConnection(
+        ClientConfig(host_addr="127.0.0.1", service_port=port)
+    )
+    conn.connect()
+    srv.stop()
+    for _ in range(2):  # repeated failed redials park the handle once
+        with pytest.raises(Exception):
+            conn.reconnect()
+    conn.close()  # must not abort the process
