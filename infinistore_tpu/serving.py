@@ -118,6 +118,18 @@ class ServingConfig:
     #                              acceptance, which preserves their exact
     #                              output distribution (see _spec_decode
     #                              for the kernel-numerics caveat)
+    host_steps: int = 1          # multi-step host scheduling (vLLM's
+    #                              --num-scheduler-steps, TPU-native):
+    #                              when every active slot is greedy and
+    #                              mid-decode, fuse up to this many
+    #                              decode steps into ONE device program
+    #                              (_decode_scan) — one dispatch + one
+    #                              tiny D2H per BURST instead of per
+    #                              token. Bit-identical tokens; trades
+    #                              per-token streaming latency for
+    #                              dispatch amortization. Bursts are
+    #                              power-of-2 bucketed so the jit cache
+    #                              stays O(log host_steps)
     prefill_chunk: int = 0       # chunked prefill (0 = off): admission
     #                              consumes the prompt <= chunk tokens
     #                              per engine step in a MIXED batch with
@@ -216,6 +228,65 @@ class _LazyHost:
         return self._host
 
 
+@partial(jax.jit, static_argnames=("cfg",))
+def _prefill_jit(params, cfg, tokens):
+    """Module-level prefill jit (static cfg): every engine with the
+    same config shares one compilation — a per-engine jax.jit(partial)
+    would silently recompile identical HLO for each new engine
+    instance (measured: ~30 s of the first run of a second engine on
+    the axon tunnel)."""
+    return llama.prefill(params, cfg, tokens)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _prefill_px_jit(params, cfg, tokens, prefix_kvs):
+    return llama.prefill_with_prefix(params, cfg, tokens, prefix_kvs)
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_steps"), donate_argnums=(4, 5))
+def _decode_scan(params, cfg, token, seq_lens, k_pages, v_pages, rows,
+                 n_steps):
+    """`n_steps` greedy decode steps fused into one device program
+    (lax.scan) — multi-step host scheduling (the vLLM
+    --num-scheduler-steps idea, TPU-native): ONE dispatch and ONE tiny
+    D2H deliver n_steps tokens per slot, amortizing host/dispatch
+    latency that would otherwise bound decode (on dispatch-expensive
+    links by ~n_steps; on local hosts it hides the Python bookkeeping).
+    Bit-identical to n_steps repeated single fused steps — the scan
+    body IS llama.decode_step."""
+    def body(carry, _):
+        token, lens, kp, vp = carry
+        logits, kp, vp = llama.decode_step(
+            params, cfg, token, lens, kp, vp, rows
+        )
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (token, lens + 1, kp, vp), token
+
+    (token, lens, kp, vp), toks = jax.lax.scan(
+        body, (token, seq_lens, k_pages, v_pages), None, length=n_steps
+    )
+    return toks.T, lens, kp, vp  # [batch, n_steps]
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(4, 5))
+def _decode_fused(params, cfg, token, seq_lens, k_pages, v_pages, rows):
+    """One fused device program per decode step: model forward + argmax
+    + seq_lens advance, with the KV pools DONATED (the functional
+    .at[].set() update aliases in place instead of copying the whole
+    pool every step — at 1B scale the pool copy would halve decode
+    throughput). Host pulls only `nxt` (4 bytes/slot) in the greedy
+    steady state; `logits` stays device-resident unless a sampling slot
+    needs it. Fusing matters twice: on real hardware it keeps the pool
+    update in-place; on dispatch-expensive links (the axon tunnel's
+    ~70 ms/call) it collapses ~6 host API calls per step into one
+    dispatch + one tiny D2H."""
+    logits, k_pages, v_pages = llama.decode_step(
+        params, cfg, token, seq_lens, k_pages, v_pages, rows
+    )
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return logits, nxt, seq_lens + 1, k_pages, v_pages
+
+
 @partial(jax.jit, donate_argnums=(0, 1))
 def _write_pages(k_pool, v_pool, ids, k_new, v_new):
     """Scatter per-layer pages into the pool at `ids` ([m] int32; entries
@@ -264,17 +335,25 @@ class ServingEngine:
             "prefill_tokens": 0, "decode_steps": 0, "decoded_tokens": 0,
             "offloaded_pages": 0, "preemptions": 0, "store_errors": 0,
             "restore_misses": 0, "spec_proposed": 0, "spec_accepted": 0,
-            "chunk_steps": 0,
+            "chunk_steps": 0, "burst_steps": 0,
         }
         # The store is an accelerator, never a dependency: after the
         # first store failure the engine downgrades itself to store-less
         # serving (full prefills, no offload) instead of failing
         # requests on a cache.
         self._store_ok = True
-        self._prefill = jax.jit(partial(llama.prefill, params, cfg))
-        self._prefill_px = jax.jit(
-            partial(llama.prefill_with_prefix, params, cfg)
-        )
+        self._prefill = partial(_prefill_jit, params, cfg)
+        self._prefill_px = partial(_prefill_px_jit, params, cfg)
+        # Steady-state decode device cache: (key, token_dev, lens_dev,
+        # rows_dev) left by the previous fused step. While the active
+        # set, page tables and emitted tokens are exactly what the
+        # device already holds (pure-greedy lockstep decode — the
+        # common serving state), the next step re-uses them and issues
+        # ONE dispatch + one tiny D2H instead of re-uploading host
+        # state. _pages_rev is bumped by every page-table mutation so
+        # staleness is structural, not heuristic.
+        self._steady = None
+        self._pages_rev = 0
         # Everything that shapes page BYTES goes into the key namespace:
         # engines differing in any of these must never cross-hit. When
         # the caller left model_id at its default AND a store is
@@ -458,6 +537,7 @@ class ServingEngine:
 
         row = np.zeros(self.sc.max_pages_per_seq, dtype=np.int32)
         row[:n_pages] = ids
+        self._pages_rev += 1  # admission rewrites this slot's row
         if self.sc.prefill_chunk > 0:
             # Chunked admission: no bulk prefill here — the prompt tail
             # is consumed <= prefill_chunk tokens per engine step in a
@@ -559,6 +639,7 @@ class ServingEngine:
                 return False
             self.page_table[slot_idx, len(slot.page_ids)] = ids[0]
             slot.page_ids.extend(ids)
+            self._pages_rev += 1
         return True
 
     def _ensure_page(self, slot_idx, slot):
@@ -612,6 +693,7 @@ class ServingEngine:
     def _release(self, slot_idx, slot):
         self.free_pages.extend(slot.page_ids)
         self.slots[slot_idx] = None
+        self._pages_rev += 1
 
     def _finish(self, slot_idx, slot):
         self.outputs[slot.work.req.request_id] = (
@@ -682,22 +764,44 @@ class ServingEngine:
             # strictly cheaper (pallas decode kernel, no (k+1)-wide
             # verify FLOPs) — the common case on non-repetitive text.
 
+        # Burst size for multi-step host scheduling: every active slot
+        # greedy and within budget for k more tokens; power-of-2
+        # bucketed so _decode_scan compiles O(log host_steps) variants.
+        greedy = all(s.work.req.temperature <= 0 for _, s in active)
+        k = 1
+        if greedy and self.sc.host_steps > 1:
+            k = min(
+                self.sc.host_steps,
+                min(s.work.req.max_new_tokens - s.total_generated()
+                    for _, s in active),
+            )
+            k = max(k, 1)
+            while k & (k - 1):
+                k &= k - 1
+
         token = np.zeros(self.sc.max_slots, dtype=np.int32)
         seq_lens = np.zeros(self.sc.max_slots, dtype=np.int32)
         rows = np.zeros_like(self.page_table)  # inactive → scratch page 0
         for i, s in active:
-            if not self._ensure_page(i, s):
-                # Pool exhausted mid-decode. If other sequences are
-                # running, swap this one out through the store and let
-                # them drain — it resumes via the prefix-HIT path when
-                # pages free up. Alone, preemption can't help (the whole
-                # pool is already ours): finish early with the tokens
-                # produced so far rather than deadlock.
-                if len(active) > 1:
-                    self._preempt(i, s)
+            if not self._ensure_pages(i, s, s.seq_len + k - 1):
+                if k > 1 and self._ensure_page(i, s):
+                    # Burst not backable but a single step is: drop the
+                    # whole batch to k=1 (pages ensured for other slots
+                    # beyond 1 step stay owned and get used later).
+                    k = 1
                 else:
-                    self._finish(i, s)
-                continue
+                    # Pool exhausted mid-decode. If other sequences are
+                    # running, swap this one out through the store and
+                    # let them drain — it resumes via the prefix-HIT
+                    # path when pages free up. Alone, preemption can't
+                    # help (the whole pool is already ours): finish
+                    # early with the tokens produced so far rather than
+                    # deadlock.
+                    if len(active) > 1:
+                        self._preempt(i, s)
+                    else:
+                        self._finish(i, s)
+                    continue
             token[i] = s.generated[-1]
             seq_lens[i] = s.seq_len
             rows[i] = self.page_table[i]
@@ -707,12 +811,62 @@ class ServingEngine:
         if not active:
             return 0
 
-        logits, self.k_pages, self.v_pages = llama.decode_step(
-            self.params, self.cfg,
-            jnp.asarray(token), jnp.asarray(seq_lens),
-            self.k_pages, self.v_pages, jnp.asarray(rows),
+        # Steady-state fast path: if the device already holds exactly
+        # this step's inputs (previous fused step's outputs, same active
+        # set, no page-table mutation, pure-greedy slots), skip the
+        # host->device uploads entirely — one dispatch + one 32-byte
+        # D2H per decode step (or per k-step burst).
+        key = (tuple(i for i, _ in active), self._pages_rev)
+        if (self._steady is not None and greedy
+                and self._steady[0] == key):
+            _, token_dev, lens_dev, rows_dev = self._steady
+        else:
+            token_dev = jnp.asarray(token)
+            lens_dev = jnp.asarray(seq_lens)
+            rows_dev = jnp.asarray(rows)
+
+        if k > 1:
+            toks_dev, lens_next, self.k_pages, self.v_pages = _decode_scan(
+                self.params, self.cfg, token_dev, lens_dev,
+                self.k_pages, self.v_pages, rows_dev, k,
+            )
+            toks = np.asarray(toks_dev)  # [B, k] — the one D2H
+            trimmed = False
+            for i, s in active:
+                burst = [int(t) for t in toks[i]]
+                if self.sc.eos_id >= 0 and self.sc.eos_id in burst:
+                    # Tokens past the EOS were computed but are never
+                    # emitted; their KV beyond seq_len is masked and
+                    # overwritten by any later occupant of the pages.
+                    burst = burst[: burst.index(self.sc.eos_id) + 1]
+                    trimmed = True
+                self._emit(s, burst)
+                s.seq_len += len(burst)
+                self.stats["decoded_tokens"] += len(burst)
+            self.stats["decode_steps"] += k
+            self.stats["burst_steps"] += 1
+            self._steady = (
+                None if trimmed else (
+                    (tuple(i for i, _ in active), self._pages_rev),
+                    toks_dev[:, -1], lens_next, rows_dev,
+                )
+            )
+            return len(active)
+
+        logits, nxt_dev, lens_next, self.k_pages, self.v_pages = (
+            _decode_fused(
+                self.params, self.cfg, token_dev, lens_dev,
+                self.k_pages, self.v_pages, rows_dev,
+            )
         )
-        nxt = np.asarray(jnp.argmax(logits, axis=-1))
+        nxt = np.asarray(nxt_dev)
+        # Reusable next step iff every emitted token is the device's
+        # argmax (greedy) — samplers/spec/finishes invalidate via key.
+        self._steady = (
+            ((tuple(i for i, _ in active), self._pages_rev),
+             nxt_dev, lens_next, rows_dev)
+            if greedy else None
+        )
         lhost = _LazyHost(logits)
         for i, s in active:
             if s.work.req.temperature > 0:
@@ -766,6 +920,7 @@ class ServingEngine:
         writes). Decode slots take single tokens here — speculation
         resumes once no slot is prefilling."""
         m = self.sc.prefill_chunk
+        self._steady = None  # multi-token advance: device state stale
         entries = {}
         for i, s in active:
             if s.pending:
@@ -857,6 +1012,7 @@ class ServingEngine:
         land several-per-step, amortizing the per-step weight reads
         that bound decode on TPU (HBM-bandwidth-limited)."""
         m = self.sc.spec_k + 1
+        self._steady = None  # multi-token advance: device state stale
         entries = {}
         props = {}
         for i, s in active:

@@ -623,6 +623,66 @@ def test_spec_sampling_distribution_parity(params, cfg):
     assert abs(n_second / n_trials - p0[draft[0]]) < 0.02
 
 
+@pytest.mark.parametrize("hs", [2, 4, 8])
+def test_multi_step_scheduling_token_parity(params, cfg, hs):
+    """host_steps>1 fuses k decode steps into one device program; the
+    token stream must be bit-identical to single-step decoding (the
+    scan body IS decode_step), across mixed prompt lengths and
+    finish-at-different-times batches."""
+    rng = np.random.default_rng(31)
+    reqs = [(_prompt(rng, cfg, n), mx)
+            for n, mx in [(9, 13), (17, 7), (5, 16)]]
+    ref_eng = ServingEngine(params, cfg, ServingConfig(max_slots=2))
+    refs = ref_eng.run(
+        [Request(f"x{i}", p, max_new_tokens=m)
+         for i, (p, m) in enumerate(reqs)]
+    )
+    eng = ServingEngine(
+        params, cfg, ServingConfig(max_slots=2, host_steps=hs)
+    )
+    out = eng.run(
+        [Request(f"x{i}", p, max_new_tokens=m)
+         for i, (p, m) in enumerate(reqs)]
+    )
+    assert out == refs
+    assert eng.stats["burst_steps"] > 0
+    assert eng.stats["decoded_tokens"] == ref_eng.stats["decoded_tokens"]
+
+
+def test_multi_step_eos_trims_burst(params, cfg):
+    """An EOS produced mid-burst must end the output AT the EOS even
+    though the device computed the full burst."""
+    rng = np.random.default_rng(32)
+    base = _prompt(rng, cfg, 9)
+    plain = ServingEngine(params, cfg)
+    ref = plain.run([Request("x", base, max_new_tokens=12)])["x"]
+    eos = ref[4]
+    want_ref = ServingEngine(
+        params, cfg, ServingConfig(eos_id=eos)
+    ).run([Request("x", base, max_new_tokens=12)])["x"]
+    eng = ServingEngine(
+        params, cfg, ServingConfig(eos_id=eos, host_steps=8)
+    )
+    out = eng.run([Request("r", base, max_new_tokens=12)])["r"]
+    assert out == want_ref
+    assert out[-1] == eos
+
+
+def test_multi_step_streams_in_order(params, cfg):
+    """on_token still fires once per token, in order, under bursts."""
+    rng = np.random.default_rng(33)
+    base = _prompt(rng, cfg, 7)
+    got = []
+    eng = ServingEngine(
+        params, cfg, ServingConfig(host_steps=4)
+    )
+    out = eng.run(
+        [Request("r", base, max_new_tokens=10,
+                 on_token=lambda rid, t: got.append(t))]
+    )
+    assert got == out["r"]
+
+
 def test_zero_token_budget_rejected_at_submit(params, cfg):
     """max_new_tokens=0 would still emit the admission token; reject it
     up front (ADVICE r3)."""
