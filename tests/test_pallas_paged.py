@@ -331,3 +331,67 @@ def test_verify_kernel_bf16():
         )
     )
     assert err < 3e-2, err
+
+
+# ---- TP shard_map: the kernel under tensor parallelism (VERDICT r3 #4)
+
+def _tp_mesh(n=8):
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:n]), ("tp",))
+
+
+def test_decode_kernel_under_tp_shard_map():
+    """paged_flash_decode inside shard_map, kv heads sharded over an
+    8-way tp axis on the virtual CPU mesh, interpret mode: the REAL
+    kernel code path in the real multi-chip serving layout, pinned
+    equal to the single-device XLA reference."""
+    from infinistore_tpu.ops.pallas_paged_attention import (
+        decode_attention_tp,
+    )
+
+    mesh = _tp_mesh()
+    q, k, v, pt, sl = _mk(4, 16, 8, 64, 33, 8, 4, seed=9)
+    ref = paged_decode_attention(q, k, v, pt, sl)
+    out = decode_attention_tp(mesh, q, k, v, pt, sl)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_decode_kernel_tp_rejects_indivisible_heads():
+    from infinistore_tpu.ops.pallas_paged_attention import (
+        decode_attention_tp,
+    )
+
+    mesh = _tp_mesh()
+    q, k, v, pt, sl = _mk(2, 12, 6, 64, 16, 8, 2)
+    with pytest.raises(ValueError):
+        decode_attention_tp(mesh, q, k, v, pt, sl)
+
+
+def test_quantized_decode_kernel_under_tp_shard_map():
+    """The fused-dequant int8 kernel under the same tp sharding, scales
+    co-sharded on the kv-head dim."""
+    from infinistore_tpu.ops import kv_quant
+    from infinistore_tpu.ops.pallas_paged_attention import (
+        decode_attention_quantized_tp,
+    )
+
+    mesh = _tp_mesh()
+    q, k, v, pt, sl = _mk(2, 16, 8, 64, 17, 8, 2, seed=11)
+    k_q, k_s = kv_quant.quantize_kv_pages(k)
+    v_q, v_s = kv_quant.quantize_kv_pages(v)
+    ref = paged_decode_attention(
+        q,
+        kv_quant.dequantize_kv_pages(k_q, k_s, q.dtype),
+        kv_quant.dequantize_kv_pages(v_q, v_s, q.dtype),
+        pt, sl,
+    )
+    out = decode_attention_quantized_tp(
+        mesh, q, k_q, k_s, v_q, v_s, pt, sl
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )

@@ -60,3 +60,40 @@ def test_tp_sharded_serving_matches_single_device(params, cfg, spec):
             [Request("x", r.prompt, r.max_new_tokens)]
         )
         assert out[r.request_id] == ref["x"], (spec, r.request_id)
+
+
+def test_tp_decode_kernel_code_path_on_mesh(cfg):
+    """The pallas decode kernel itself (interpret mode — the same code
+    path that compiles on TPU) under the serving TP layout on this
+    mesh: kv heads sharded over tp via shard_map, pinned equal to the
+    XLA path the GSPMD-jitted engine uses here (VERDICT r3 item 4 —
+    previously the mesh suite only ever ran the :481 fallback)."""
+    from jax.sharding import Mesh
+
+    from infinistore_tpu.ops.paged_attention import paged_decode_attention
+    from infinistore_tpu.ops.pallas_paged_attention import (
+        decode_attention_tp,
+    )
+
+    tp = cfg.n_kv_heads  # one kv head per device on a tp=4 sub-mesh
+    mesh = Mesh(np.array(jax.devices()[:tp]), ("tp",))
+    rng = np.random.default_rng(7)
+    batch, hd, page, n_pages, max_pages = 3, cfg.head_dim, cfg.page_size, 17, 3
+    q = np.asarray(
+        rng.standard_normal((batch, cfg.n_heads, hd)), np.float32
+    )
+    k = np.asarray(
+        rng.standard_normal((n_pages, page, cfg.n_kv_heads, hd)), np.float32
+    )
+    v = np.asarray(
+        rng.standard_normal((n_pages, page, cfg.n_kv_heads, hd)), np.float32
+    )
+    pt = rng.permutation(n_pages)[: batch * max_pages].reshape(
+        batch, max_pages
+    ).astype(np.int32)
+    sl = rng.integers(1, max_pages * page, batch).astype(np.int32)
+    ref = paged_decode_attention(q, k, v, pt, sl)
+    out = decode_attention_tp(mesh, q, k, v, pt, sl)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
