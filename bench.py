@@ -354,6 +354,89 @@ def bench_overlap(port):
         conn.close()
 
 
+# v5e peaks for MFU / HBM-utilization accounting (public spec values:
+# 197 TFLOP/s bf16, 819 GB/s HBM bandwidth per chip). Formulas are
+# published in BASELINE.md so the artifact is recomputable.
+V5E_PEAK_BF16_FLOPS = 197e12
+V5E_HBM_BPS = 819e9
+
+
+def _median(xs):
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+def _slope_time(build_fn, n_short, n_long, reps=3):
+    """Per-iteration time via two-length differencing. ``build_fn(n)``
+    returns a 0-arg callable that runs an n-iteration device program to
+    completion; each length is compiled+warmed then timed best-of-reps,
+    and the slope (t_long - t_short)/(n_long - n_short) cancels any
+    fixed per-call cost — on the axon tunnel a single timed dispatch
+    measures its ~70 ms/call latency, not the ~ms program."""
+    def best(n):
+        run = build_fn(n)
+        run()  # compile + warm
+        b = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            run()
+            t = time.perf_counter() - t0
+            b = t if b is None else min(b, t)
+        return b
+
+    t_short = best(n_short)
+    t_long = best(n_long)
+    return max((t_long - t_short) / (n_long - n_short), 1e-9)
+
+
+def _make_decode_scan(llama, cfg, page_table):
+    """n-step greedy decode scan over `llama.decode_step` (shared by
+    the 84M and 1.3B decode legs)."""
+    import jax
+    import jax.numpy as jnp
+
+    def many_steps_n(params, token, lens, kp, vp, n):
+        def body(carry, _):
+            token, lens, kp, vp = carry
+            logits, kp, vp = llama.decode_step(
+                params, cfg, token, lens, kp, vp, page_table
+            )
+            token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (token, lens + 1, kp, vp), None
+
+        (token, lens, kp, vp), _ = jax.lax.scan(
+            body, (token, lens, kp, vp), None, length=n
+        )
+        return token
+
+    return many_steps_n
+
+
+def _paired_ratio(passes, run_store, run_ctrl):
+    """Interleaved store/control passes, order ALTERNATED within pairs
+    so monotone load drift biases half the pairs up and half down, and
+    a per-pair ratio so a noise spike hits one pair, not the aggregate.
+    Returns (best_store_t, best_ctrl_t, pair_ratios) with pair_ratios[i]
+    = ctrl_time/store_time (i.e. store_rate/ctrl_rate) — the published
+    vs_ctrl is the MEDIAN of these, robust to the axon tunnel's ~2x
+    intra-run bandwidth swings that made r03's best-of/best-of ratio
+    capture 0.74 against a stable [0.85, 1.0] band."""
+    t_s = t_c = None
+    ratios = []
+    for it in range(passes):
+        if it % 2 == 0:
+            ts = run_store(it)
+            tc = run_ctrl(it)
+        else:
+            tc = run_ctrl(it)
+            ts = run_store(it)
+        ratios.append(tc / ts)
+        t_s = ts if t_s is None else min(t_s, ts)
+        t_c = tc if t_c is None else min(t_c, tc)
+    return t_s, t_c, ratios
+
+
 def _bench_decode(dev, n_steps=32, batch=8):
     """Steady-state paged-decode throughput of the flagship model on the
     attached chip. Returns {decode_tok_s, decode_step_ms, decode_params_m}."""
@@ -383,34 +466,302 @@ def _bench_decode(dev, n_steps=32, batch=8):
         token0 = jnp.zeros((batch,), jnp.int32)
         lens0 = jnp.full((batch,), 128, jnp.int32)  # mid-sequence state
 
-        def many_steps(params, token, lens, kp, vp):
-            def body(carry, _):
-                token, lens, kp, vp = carry
-                logits, kp, vp = llama.decode_step(
-                    params, cfg, token, lens, kp, vp, page_table
-                )
-                token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return (token, lens + 1, kp, vp), None
+        many_steps_n = _make_decode_scan(llama, cfg, page_table)
 
-            (token, lens, kp, vp), _ = jax.lax.scan(
-                body, (token, lens, kp, vp), None, length=n_steps
+        def build(n):
+            local = jax.jit(
+                lambda p, t, l, kp, vp: many_steps_n(p, t, l, kp, vp, n)
             )
-            return token
+            return lambda: jax.block_until_ready(
+                local(params, token0, lens0, k_pages, v_pages)
+            )
 
-        fn = jax.jit(many_steps)
-        out = fn(params, token0, lens0, k_pages, v_pages)
-        jax.block_until_ready(out)  # compile + warm
-        best = None
-        for _ in range(3):
-            t0 = time.perf_counter()
-            out = fn(params, token0, lens0, k_pages, v_pages)
-            jax.block_until_ready(out)
-            t = time.perf_counter() - t0
-            best = t if best is None else min(best, t)
+        step_s = _slope_time(build, n_steps, 96)
         return {
-            "decode_tok_s": round(n_steps * batch / best, 1),
-            "decode_step_ms": round(best / n_steps * 1e3, 3),
+            "decode_tok_s": round(batch / step_s, 1),
+            "decode_step_ms": round(step_s * 1e3, 3),
             "decode_params_m": round(n_params / 1e6, 1),
+        }
+
+
+def bench_mfu(port):
+    """Model-scale performance leg (VERDICT r3 item 1): MFU and HBM
+    utilization on an HBM-filling model, the flash-prefill kernel's MFU
+    at S=4096, and the REAL ServingEngine.run loop (host admission +
+    page bookkeeping included) next to the fused-scan decode number.
+
+    Accounting formulas (against v5e peaks 197 TFLOP/s bf16, 819 GB/s):
+      decode FLOPs/step  = 2 * matmul_params * batch + attn
+                           (attn = 4 * L * batch * seq * n_kv_used —
+                            n_kv_used counts K and V reads at hd width)
+      decode bytes/step  = 2 * n_params           (bf16 weight stream)
+                           + KV read/write bytes  (L * b * seq * kv * hd
+                                                   * 2 dtypes * 2 bytes)
+      mfu_pct            = FLOPs/step / step_s / 197e12 * 100
+      hbm_util_pct       = bytes/step / step_s / 819e9 * 100
+    Decode at batch 8 is HBM-bandwidth-bound (arithmetic intensity ~=
+    batch << the ~240 FLOP/byte ridge), so hbm_util is the number that
+    can approach 100; mfu is reported for completeness. The prefill
+    kernel at S=4096 is compute-bound and MFU is the honest metric.
+
+    Ordering: device-generated inputs only (no bulk H2D) and the engine
+    leg LAST — its per-step argmax D2H triggers the axon tunnel's
+    permanent H2D degradation (BASELINE.md), which must not poison the
+    other legs. Runs in its own subprocess for the same reason.
+    """
+    res = {}
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from infinistore_tpu.models import llama
+
+        dev = jax.devices()[0]
+
+        # ---- Leg 1: model-scale fused decode (MFU / HBM util) ----
+        try:
+            res.update(_bench_decode_1b(dev))
+        except Exception as e:
+            res["decode1b_error"] = str(e)[:200]
+
+        # ---- Leg 2: flash prefill kernel MFU at S=4096 ----
+        try:
+            res.update(_bench_prefill_kernel(dev))
+        except Exception as e:
+            res["prefill_kernel_error"] = str(e)[:200]
+
+        # ---- Host-RTT control (first D2H of the session — after the
+        # compute legs, before the engine leg it contextualizes). The
+        # engine's steady-state step is ONE dispatch + one tiny D2H, so
+        # engine_step_ms ≈ host_rtt_ms + compute on this tunnel; on a
+        # local-PCIe host the RTT term is microseconds.
+        try:
+            tiny = jax.jit(lambda x: jnp.argmax(x, axis=-1))
+            xarr = jnp.zeros((8, 256))
+            np.asarray(tiny(xarr))  # compile + first transfer
+            rtts = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                np.asarray(tiny(xarr))
+                rtts.append(time.perf_counter() - t0)
+            res["host_rtt_ms"] = round(_median(rtts) * 1e3, 1)
+        except Exception as e:
+            res["host_rtt_error"] = str(e)[:120]
+
+        # ---- Leg 3: the real engine loop (LAST: issues D2H/step) ----
+        try:
+            res.update(_bench_engine_loop(dev))
+        except Exception as e:
+            res["engine_error"] = str(e)[:200]
+        return res
+    except Exception as e:
+        res["mfu_error"] = str(e)[:200]
+        return res
+
+
+def _bench_decode_1b(dev, n_steps=16, batch=8):
+    """Fused-scan paged decode at model scale: ~1.3B bf16 params (2.7 GB
+    weights + 0.5 GB KV pool on the 16 GB chip — the weight stream per
+    step is the HBM-bandwidth story). 8 wide layers rather than many
+    thin ones: bigger matmuls tile better on the MXU and trace/compile
+    faster through the tunnel."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from infinistore_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32768, d_model=3072, n_layers=8, n_heads=24,
+        n_kv_heads=8, d_ff=12288, max_seq=512, page_size=16,
+    )
+    batch_pages = 16  # 256-token budget per sequence
+    seq0 = 192        # mid-sequence decode state
+    with jax.default_device(dev):
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        n_params = sum(
+            int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
+        )
+        kv_shape = (cfg.n_layers, batch * batch_pages, cfg.page_size,
+                    cfg.n_kv_heads, cfg.head_dim)
+        k_pages = jnp.zeros(kv_shape, dtype=cfg.jdtype)
+        v_pages = jnp.zeros_like(k_pages)
+        page_table = jnp.arange(
+            batch * batch_pages, dtype=jnp.int32
+        ).reshape(batch, batch_pages)
+        token0 = jnp.zeros((batch,), jnp.int32)
+        lens0 = jnp.full((batch,), seq0, jnp.int32)
+
+        many_steps_n = _make_decode_scan(llama, cfg, page_table)
+
+        def build(n):
+            local = jax.jit(
+                lambda p, t, l, kp, vp: many_steps_n(p, t, l, kp, vp, n)
+            )
+            return lambda: jax.block_until_ready(
+                local(params, token0, lens0, k_pages, v_pages)
+            )
+
+        step_s = _slope_time(build, n_steps, 40)
+
+        # FLOP/byte accounting (formulas in the bench_mfu docstring +
+        # BASELINE.md). Matmul params exclude the embedding lookup.
+        mm_params = n_params - cfg.vocab_size * cfg.d_model
+        s_avg = seq0 + n_steps / 2
+        attn_flops = (
+            4 * cfg.n_layers * batch * s_avg
+            * cfg.n_kv_heads * cfg.head_dim * (cfg.n_heads // cfg.n_kv_heads)
+        )
+        flops = 2 * mm_params * batch + attn_flops
+        kv_bytes = (
+            cfg.n_layers * batch * s_avg
+            * cfg.n_kv_heads * cfg.head_dim * 2 * 2  # K+V read, bf16
+        )
+        bytes_step = 2 * n_params + kv_bytes
+        return {
+            "decode1b_params_b": round(n_params / 1e9, 3),
+            "decode1b_step_ms": round(step_s * 1e3, 3),
+            "decode1b_tok_s": round(batch / step_s, 1),
+            "decode_mfu_pct": round(
+                100 * flops / step_s / V5E_PEAK_BF16_FLOPS, 2
+            ),
+            "decode_hbm_util_pct": round(
+                100 * bytes_step / step_s / V5E_HBM_BPS, 1
+            ),
+        }
+
+
+def _bench_prefill_kernel(dev, seq=4096, n_heads=16, n_kv=8, hd=128):
+    """Flash-prefill kernel MFU at S=4096 (causal, GQA 16/8). Inputs
+    are generated ON DEVICE — no bulk H2D rides the tunnel. Causal
+    attention does half the rectangle: FLOPs = 2 * S^2 * H * hd."""
+    import jax
+    import jax.numpy as jnp
+
+    from infinistore_tpu.ops.pallas_flash_attention import (
+        flash_prefill_attention,
+    )
+
+    with jax.default_device(dev):
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        q = jax.random.normal(ks[0], (1, seq, n_heads, hd), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (1, seq, n_kv, hd), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (1, seq, n_kv, hd), jnp.bfloat16)
+
+        # Chain the kernel through a scan carry (each iteration's q is
+        # the previous output, so XLA cannot hoist the loop body);
+        # _slope_time cancels the per-dispatch latency.
+        def chained(q, k, v, n):
+            def body(carry, _):
+                return flash_prefill_attention(carry, k, v), None
+
+            out, _ = jax.lax.scan(body, q, None, length=n)
+            return out
+
+        def build(n):
+            local = jax.jit(lambda q, k, v: chained(q, k, v, n))
+            return lambda: jax.block_until_ready(local(q, k, v))
+
+        per_call = _slope_time(build, 4, 20)
+        flops = 2 * seq * seq * n_heads * hd
+        return {
+            "prefill_kernel_s4096_ms": round(per_call * 1e3, 3),
+            "prefill_mfu_pct": round(
+                100 * flops / per_call / V5E_PEAK_BF16_FLOPS, 2
+            ),
+        }
+
+
+def _bench_engine_loop(dev, batch=8, prompt_len=128, new_tokens=48):
+    """The REAL ServingEngine.run loop on the same 84M flagship config
+    as _bench_decode: host-side admission, page allocation, per-step
+    token sync and sampling dispatch all included — the number to read
+    NEXT TO decode_tok_s (fused scan, no host loop). On this host every
+    step pays the axon tunnel's per-dispatch RTT (~3-4 ms) plus one
+    tiny D2H (the argmax), so the gap vs the fused number is an upper
+    bound on the engine's host overhead; on a local-PCIe host the gap
+    is the host bookkeeping alone."""
+    import jax
+    import numpy as np
+
+    from infinistore_tpu.models import llama
+    from infinistore_tpu.serving import Request, ServingConfig, ServingEngine
+
+    cfg = llama.LlamaConfig(
+        vocab_size=8192, d_model=1024, n_layers=4, n_heads=8, n_kv_heads=8,
+        d_ff=4096, max_seq=512, page_size=16,
+    )
+    with jax.default_device(dev):
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        pages_per_seq = -(-(prompt_len + new_tokens) // cfg.page_size)
+        sc = ServingConfig(
+            max_slots=batch,
+            total_pages=batch * pages_per_seq + 8,
+            max_pages_per_seq=pages_per_seq + 1,
+        )
+        rng = np.random.default_rng(5)
+
+        def reqs(tag, n_new):
+            return [
+                Request(
+                    f"{tag}{i}",
+                    [int(t) for t in rng.integers(0, cfg.vocab_size,
+                                                  prompt_len)],
+                    max_new_tokens=n_new,
+                )
+                for i in range(batch)
+            ]
+
+        warm = ServingEngine(params, cfg, sc)
+        warm.run(reqs("w", 4))  # compiles prefill bucket + fused decode
+
+        def run_timed(sconf, tag, warm_bursts=False):
+            """Drive one engine run with the admission phase timed
+            separately from steady decode (the r3 review caught
+            engine_step_ms dividing prefill time into decode steps)."""
+            if warm_bursts:
+                w = ServingEngine(params, cfg, sconf)
+                w.run(reqs(f"{tag}w", new_tokens))  # compile burst jits
+            eng = ServingEngine(params, cfg, sconf)
+            for r in reqs(tag, new_tokens):
+                eng.submit(r)
+            t0 = time.perf_counter()
+            eng.step()  # admits the whole batch (+ first decode)
+            t_admit = time.perf_counter() - t0
+            steps0 = eng.stats["decode_steps"]
+            t1 = time.perf_counter()
+            while eng.queue or any(s is not None for s in eng.slots):
+                eng.step()
+            t_dec = time.perf_counter() - t1
+            toks = eng.stats["decoded_tokens"]
+            dsteps = max(1, eng.stats["decode_steps"] - steps0)
+            return {
+                "tok_s": round(toks / (t_admit + t_dec), 1),
+                "step_ms": round(t_dec / dsteps * 1e3, 3),
+                "admit_ms": round(t_admit * 1e3, 1),
+                "decoded": toks,
+            }
+
+        single = run_timed(sc, "r")
+        burst = run_timed(
+            ServingConfig(
+                max_slots=sc.max_slots, total_pages=sc.total_pages,
+                max_pages_per_seq=sc.max_pages_per_seq, host_steps=8,
+            ),
+            "b", warm_bursts=True,
+        )
+        return {
+            "engine_tok_s": single["tok_s"],
+            "engine_step_ms": single["step_ms"],
+            "engine_admit_ms": single["admit_ms"],
+            "engine_decoded_tokens": single["decoded"],
+            "engine_batch": batch,
+            # Multi-step host scheduling (host_steps=8): one dispatch +
+            # one tiny D2H per 8-token burst — the dispatch-latency
+            # amortization story, same token stream.
+            "engine_hs8_tok_s": burst["tok_s"],
+            "engine_hs8_step_ms": burst["step_ms"],
         }
 
 
@@ -500,25 +851,30 @@ def bench_tpu(port):
                 ctypes.CDLL(None).mlock(ctypes.c_void_p(addr), nbytes) == 0
             )
 
-            # Interleaved best-of-N. Re-reading the same keys / re-putting
-            # the same numpy buffer re-transfers every pass (H2D has no
-            # host-copy caching; only D2H caches on the jax array).
-            t_res, t_h2d = None, None
-            restored = ctrl_dev = None
-            for _ in range(passes):
+            # Interleaved pairs, order alternated; median-of-pair-ratios.
+            # Re-reading the same keys / re-putting the same numpy buffer
+            # re-transfers every pass (H2D has no host-copy caching; only
+            # D2H caches on the jax array).
+            box = {}
+
+            def _res_pass(_it):
                 t0 = time.perf_counter()
-                restored = store.get_kv_pages(
+                box["restored"] = store.get_kv_pages(
                     rkeys, page, np.uint16, device=dev
                 )
-                jax.block_until_ready(restored)
-                t = time.perf_counter() - t0
-                t_res = t if t_res is None else min(t_res, t)
+                jax.block_until_ready(box["restored"])
+                return time.perf_counter() - t0
 
+            def _h2d_pass(_it):
                 t0 = time.perf_counter()
-                ctrl_dev = jax.device_put(ctrl_buf, dev)
-                jax.block_until_ready(ctrl_dev)
-                t = time.perf_counter() - t0
-                t_h2d = t if t_h2d is None else min(t_h2d, t)
+                box["ctrl_dev"] = jax.device_put(ctrl_buf, dev)
+                jax.block_until_ready(box["ctrl_dev"])
+                return time.perf_counter() - t0
+
+            t_res, t_h2d, res_ratios = _paired_ratio(
+                passes, _res_pass, _h2d_pass
+            )
+            restored, ctrl_dev = box["restored"], box["ctrl_dev"]
 
             # ---- Phase O: TPU -> store offload (D2H) ----
             # (Everything below may issue D2H — strictly after Phase R.)
@@ -538,22 +894,38 @@ def bench_tpu(port):
             wkeys = [f"tpu_warm_p{i}" for i in range(n_pages)]
             store.put_kv_pages(wkeys, pages, sync=True)
 
-            t_off, t_d2h = None, None
-            okeys = None
-            ctrl_host = None
-            for it in range(passes):
-                pages_off = jax.block_until_ready(pages + 0)  # new buffer
-                okeys = [f"tpu_offload{it}_p{i}" for i in range(n_pages)]
-                t0 = time.perf_counter()
-                store.put_kv_pages(okeys, pages_off, sync=True)
-                t = time.perf_counter() - t0
-                t_off = t if t_off is None else min(t_off, t)
+            # Copy accounting over the MEASURED offload passes: proves
+            # the put path is one D2H per put with zero staging copies
+            # (VERDICT r3 item 2 — the np.ascontiguousarray/concatenate
+            # staging copies are gone; the only host-side copy after the
+            # D2H is the native memcpy into the pool, which PJRT's lack
+            # of D2H destination control makes irreducible from Python).
+            from infinistore_tpu import tpu as tpu_mod
 
+            tpu_mod.reset_copy_counters()
+            off_passes = 5
+            obox = {}
+
+            def _off_pass(it):
+                pages_off = jax.block_until_ready(pages + 0)  # new buffer
+                obox["okeys"] = [
+                    f"tpu_offload{it}_p{i}" for i in range(n_pages)
+                ]
+                t0 = time.perf_counter()
+                store.put_kv_pages(obox["okeys"], pages_off, sync=True)
+                return time.perf_counter() - t0
+
+            def _d2h_pass(_it):
                 pages_ctrl = jax.block_until_ready(pages + 0)
                 t0 = time.perf_counter()
-                ctrl_host = np.asarray(pages_ctrl)
-                t = time.perf_counter() - t0
-                t_d2h = t if t_d2h is None else min(t_d2h, t)
+                obox["ctrl_host"] = np.asarray(pages_ctrl)
+                return time.perf_counter() - t0
+
+            t_off, t_d2h, off_ratios = _paired_ratio(
+                off_passes, _off_pass, _d2h_pass
+            )
+            okeys, ctrl_host = obox["okeys"], obox["ctrl_host"]
+            copy_stats = dict(tpu_mod.copy_counters)
 
             # Offload round-trip check, host-only (no extra device
             # transfer): what the store holds under the last pass's okeys
@@ -584,23 +956,27 @@ def bench_tpu(port):
             except Exception as e:
                 decode_res = {"decode_error": str(e)[:160]}
 
-            # Publish rounded rates; ratios recomputed from the rounded
-            # values so readers cross-checking the artifact get the same
-            # numbers (round-2 advisor finding).
-            r_res = round(gb / t_res, 3)
-            r_h2d = round(gb / t_h2d, 3)
-            r_off = round(gb / t_off, 3)
-            r_d2h = round(gb / t_d2h, 3)
+            # Publish best-pass rates plus the per-pair ratio lists; the
+            # headline vs_ctrl ratios are MEDIANS of the per-pair ratios
+            # (robust to single-pass tunnel spikes — r03's best-of/best-of
+            # estimator published 0.74 out of a stable 0.85-1.0 band).
+            # The pair lists let readers recompute the medians exactly.
             return {
                 "tpu_device": str(dev),
                 "tpu_bench_passes": passes,
+                "tpu_offload_passes": off_passes,
                 "ctrl_pinned": ctrl_pinned,
-                "tpu_restore_GBps": r_res,
-                "ctrl_h2d_GBps": r_h2d,
-                "restore_vs_ctrl": round(r_res / r_h2d, 2) if r_h2d else None,
-                "tpu_offload_GBps": r_off,
-                "ctrl_d2h_GBps": r_d2h,
-                "offload_vs_ctrl": round(r_off / r_d2h, 2) if r_d2h else None,
+                "tpu_restore_GBps": round(gb / t_res, 3),
+                "ctrl_h2d_GBps": round(gb / t_h2d, 3),
+                "restore_vs_ctrl": round(_median(res_ratios), 2),
+                "restore_pair_ratios": [round(r, 3) for r in res_ratios],
+                "tpu_offload_GBps": round(gb / t_off, 3),
+                "ctrl_d2h_GBps": round(gb / t_d2h, 3),
+                "offload_vs_ctrl": round(_median(off_ratios), 2),
+                "offload_pair_ratios": [round(r, 3) for r in off_ratios],
+                "offload_d2h_copies": copy_stats["d2h_copies"],
+                "offload_staging_copies": copy_stats["staging_copies"],
+                "offload_staging_bytes": copy_stats["staging_bytes"],
                 "tpu_verified": restore_ok and offload_ok,
                 **decode_res,
             }
@@ -643,6 +1019,10 @@ def main():
     if "--tpu-leg" in sys.argv:
         port = int(sys.argv[sys.argv.index("--tpu-leg") + 1])
         print(json.dumps(bench_tpu(port)))
+        return 0
+    if "--mfu-leg" in sys.argv:
+        port = int(sys.argv[sys.argv.index("--mfu-leg") + 1])
+        print(json.dumps(bench_mfu(port)))
         return 0
     if "--overlap-leg" in sys.argv:
         port = int(sys.argv[sys.argv.index("--overlap-leg") + 1])
@@ -709,6 +1089,13 @@ def main():
         )
         srv.purge()
         tpu_res = bench_subprocess("--tpu-leg", port, "tpu_error")
+        # Model-scale MFU/HBM-util + real-engine-loop leg: its own
+        # subprocess, AFTER the transfer legs — the engine's per-step
+        # D2H would otherwise degrade the tunnel's H2D for everything
+        # that follows (BASELINE.md).
+        mfu_res = bench_subprocess(
+            "--mfu-leg", port, "mfu_error", timeout_s=540
+        )
     finally:
         srv.stop()
     try:
@@ -727,6 +1114,7 @@ def main():
         **sharded_res,
         **overlap_res,
         **tpu_res,
+        **mfu_res,
     }
     print(json.dumps(out))
     return 0
