@@ -40,12 +40,27 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
         return OUT_OF_MEMORY;
     }
     auto block = std::make_shared<Block>(mm_, loc, size);
-    uint64_t token = next_token_++;
+    uint32_t idx;
+    if (!ifree_.empty()) {
+        idx = ifree_.back();
+        ifree_.pop_back();
+    } else {
+        idx = uint32_t(islab_.size());
+        islab_.emplace_back();
+    }
+    Inflight& s = islab_[idx];
+    if (++s.gen == 0) s.gen = 1;  // gen >= 1 keeps every token != FAKE
+    s.key = key;
+    s.block = block;
+    s.size = size;
+    s.owner = owner;
+    s.live = true;
+    inflight_live_++;
+    uint64_t token = (uint64_t(s.gen) << 32) | idx;
     Entry e;
     e.block = block;
     e.size = size;
     mit->second = std::move(e);
-    inflight_[token] = Inflight{key, block, size, owner};
     out->status = OK;
     out->pool_idx = loc.pool_idx;
     out->token = token;
@@ -56,44 +71,59 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
 
 uint8_t* KVIndex::write_dest(uint64_t token, uint32_t* size_out,
                              uint64_t owner) {
-    auto it = inflight_.find(token);
-    if (it == inflight_.end() || it->second.owner != owner) return nullptr;
-    *size_out = it->second.size;
-    return static_cast<uint8_t*>(it->second.block->loc.ptr);
+    Inflight* s = islot(token);
+    if (s == nullptr || s->owner != owner) return nullptr;
+    *size_out = s->size;
+    return static_cast<uint8_t*>(s->block->loc.ptr);
 }
 
 Status KVIndex::commit(uint64_t token, uint64_t owner) {
-    auto it = inflight_.find(token);
-    if (it == inflight_.end()) return CONFLICT;
+    Inflight* s = islot(token);
+    if (s == nullptr) return CONFLICT;
     // A forged commit must fail closed AND leave the real owner's inflight
     // entry intact so the owner's own commit still lands.
-    if (it->second.owner != owner) return CONFLICT;
-    auto mit = map_.find(it->second.key);
+    if (s->owner != owner) return CONFLICT;
+    auto mit = map_.find(s->key);
     Status rc = CONFLICT;
     // Only commit if the map still holds the exact block this token
     // allocated (a purge+reallocate between allocate and commit must not
     // make someone else's bytes visible under this key).
-    if (mit != map_.end() && mit->second.block == it->second.block) {
+    if (mit != map_.end() && mit->second.block == s->block) {
         mit->second.committed = true;
         lru_touch(mit->second, mit->first);
         rc = OK;
     }
-    inflight_.erase(it);
+    ifree(s);
     return rc;
 }
 
 void KVIndex::abort(uint64_t token, uint64_t owner) {
-    auto it = inflight_.find(token);
-    if (it == inflight_.end() || it->second.owner != owner) return;
-    auto mit = map_.find(it->second.key);
-    if (mit != map_.end() && mit->second.block == it->second.block &&
+    Inflight* s = islot(token);
+    if (s == nullptr || s->owner != owner) return;
+    auto mit = map_.find(s->key);
+    if (mit != map_.end() && mit->second.block == s->block &&
         !mit->second.committed) {
         map_.erase(mit);
     }
-    inflight_.erase(it);
+    ifree(s);
 }
 
-const Entry* KVIndex::get_committed(const std::string& key) {
+size_t KVIndex::abort_all_for_owner(uint64_t owner) {
+    size_t n = 0;
+    for (Inflight& s : islab_) {
+        if (!s.live || s.owner != owner) continue;
+        auto mit = map_.find(s.key);
+        if (mit != map_.end() && mit->second.block == s.block &&
+            !mit->second.committed) {
+            map_.erase(mit);
+        }
+        ifree(&s);
+        n++;
+    }
+    return n;
+}
+
+Entry* KVIndex::get_committed(const std::string& key) {
     auto it = map_.find(key);
     if (it == map_.end() || !it->second.committed) return nullptr;
     lru_touch(it->second, it->first);  // reads refresh recency
@@ -104,7 +134,13 @@ Status KVIndex::get_resident(const std::string& key, const Entry** out) {
     *out = nullptr;
     auto it = map_.find(key);
     if (it == map_.end() || !it->second.committed) return KEY_NOT_FOUND;
-    Entry& e = it->second;
+    Status st = ensure_resident(&it->second, it->first);
+    if (st == OK) *out = &it->second;
+    return st;
+}
+
+Status KVIndex::ensure_resident(Entry* ep, const std::string& key) {
+    Entry& e = *ep;
     if (!e.block) {
         // Spilled (disk) or in heap limbo: promote back into the pool
         // (which may itself spill or evict colder entries — this entry
@@ -161,8 +197,7 @@ Status KVIndex::get_resident(const std::string& key, const Entry** out) {
         }
         promotes_++;
     }
-    lru_touch(e, it->first);
-    *out = &e;
+    lru_touch(e, key);
     return OK;
 }
 
@@ -251,8 +286,10 @@ size_t KVIndex::purge() {
 
 size_t KVIndex::reclaim_orphans(const std::vector<std::string>& keys) {
     std::unordered_set<const Block*> live;
-    live.reserve(inflight_.size());
-    for (auto& [tok, inf] : inflight_) live.insert(inf.block.get());
+    live.reserve(inflight_live_);
+    for (const Inflight& s : islab_) {
+        if (s.live) live.insert(s.block.get());
+    }
     size_t n = 0;
     for (auto& k : keys) {
         auto it = map_.find(k);

@@ -114,6 +114,13 @@ class KVIndex {
     // connection (the forged payload lands in the sink).
     uint8_t* write_dest(uint64_t token, uint32_t* size_out, uint64_t owner);
 
+    // Abort every live inflight token owned by `owner` (dead-connection
+    // cleanup). O(slab capacity) — the slab only ever holds the peak
+    // concurrent inflight count, and connection death is rare; this
+    // replaces the per-connection open-token hash set that cost two
+    // hash ops per key on the hot allocate/commit path.
+    size_t abort_all_for_owner(uint64_t owner);
+
     // Second phase: make the entry visible. OK, or CONFLICT if the entry
     // was purged/replaced since allocation (write is discarded safely) or
     // the token belongs to another connection (the real owner's inflight
@@ -126,25 +133,36 @@ class KVIndex {
     // Committed lookup for reads (refreshes LRU recency). nullptr if
     // missing or uncommitted. May return a disk-resident entry
     // (block == nullptr) — use get_resident when the bytes are needed.
-    const Entry* get_committed(const std::string& key);
+    Entry* get_committed(const std::string& key);
     // get_committed + promote from the disk tier into the pool if
     // spilled. OK (*out set), KEY_NOT_FOUND (missing/uncommitted),
     // OUT_OF_MEMORY (present but promotion failed — retryable, the data
     // is intact), or INTERNAL_ERROR (tier IO error).
     Status get_resident(const std::string& key, const Entry** out);
+    // Residency half of get_resident for a caller that already holds
+    // the Entry* from get_committed — batched reads resolve each key's
+    // hash ONCE instead of twice (op_read is the get-side hot path).
+    // `key` is only used for LRU recency.
+    Status ensure_resident(Entry* e, const std::string& key);
     bool check_exist(const std::string& key);  // exists && committed
+    // True when pool pressure can hard-ERASE map entries (LRU eviction
+    // on): cached Entry* may dangle across any allocation-causing call,
+    // so batched readers must re-resolve keys instead of holding
+    // pointers. Spill-only/disk configurations never erase — pointers
+    // stay valid and the single-hash read path is safe.
+    bool may_erase_under_pressure() const { return eviction_; }
 
     // Reference algorithm verbatim in behavior (infinistore.cpp:1092-1108):
     // binary search assuming presence is monotone over the key list
     // (vLLM prefix pages); does NOT check committed.
     int match_last_index(const std::vector<std::string>& keys) const;
 
-    // Pre-size the index + inflight tables for `extra` upcoming
+    // Pre-size the index + inflight slab for `extra` upcoming
     // allocations (batched allocate/put ops insert thousands of keys in
     // one loop; without this the tables rehash mid-loop under store_mu_).
     void reserve(size_t extra) {
         map_.reserve(map_.size() + extra);
-        inflight_.reserve(inflight_.size() + extra);
+        islab_.reserve(islab_.size() + extra);
     }
 
     // Pin committed blocks for one-sided SHM reads; returns lease id.
@@ -183,7 +201,7 @@ class KVIndex {
     // concurrent writer's in-progress allocation is never disturbed.
     size_t reclaim_orphans(const std::vector<std::string>& keys);
     size_t size() const { return map_.size(); }
-    size_t inflight() const { return inflight_.size(); }
+    size_t inflight() const { return inflight_live_; }
     size_t leases() const { return leases_.size(); }
     uint64_t evictions() const { return evictions_; }
     uint64_t spills() const { return spills_; }
@@ -195,12 +213,37 @@ class KVIndex {
     size_t evict_lru(size_t want);
 
    private:
+    // Inflight tokens live in a SLAB, not a hash map: a token is
+    // (generation << 32) | slot, so write_dest/commit/abort — three
+    // calls per written block on the put hot path — are O(1) array
+    // indexing with a generation check instead of three hash probes.
+    // Generations keep stale/forged tokens fail-closed: a freed slot's
+    // generation advances, so an old token mismatches. The key stays a
+    // COPY (not a pointer into map_) so purge()/erase() need no slab
+    // fix-ups; commit still validates against the live map entry.
     struct Inflight {
         std::string key;
         BlockRef block;
-        uint32_t size;
-        uint64_t owner;  // connection id that allocated this token
+        uint32_t size = 0;
+        uint64_t owner = 0;  // connection id that allocated this token
+        uint32_t gen = 0;    // matches the token's high half when live
+        bool live = false;
     };
+    Inflight* islot(uint64_t token) {
+        uint32_t idx = uint32_t(token & 0xffffffffu);
+        uint32_t gen = uint32_t(token >> 32);
+        if (idx >= islab_.size()) return nullptr;
+        Inflight& s = islab_[idx];
+        if (!s.live || s.gen != gen) return nullptr;
+        return &s;
+    }
+    void ifree(Inflight* s) {
+        s->live = false;
+        s->block.reset();
+        s->key.clear();
+        ifree_.push_back(uint32_t(s - islab_.data()));
+        inflight_live_--;
+    }
 
     void lru_touch(Entry& e, const std::string& key);
     void lru_drop(Entry& e);
@@ -217,9 +260,10 @@ class KVIndex {
     uint64_t promotes_ = 0;
     std::list<std::string> lru_;  // front = most recent
     std::unordered_map<std::string, Entry> map_;
-    std::unordered_map<uint64_t, Inflight> inflight_;
+    std::vector<Inflight> islab_;
+    std::vector<uint32_t> ifree_;
+    size_t inflight_live_ = 0;
     std::unordered_map<uint64_t, std::vector<BlockRef>> leases_;
-    uint64_t next_token_ = 1;  // 0 is FAKE_TOKEN
     uint64_t next_lease_ = 1;
 };
 

@@ -455,9 +455,7 @@ void Server::close_conn(int fd) {
     // leases it still holds.
     {
         std::lock_guard<std::mutex> lk(store_mu_);
-        for (uint64_t tok : it->second->open_tokens) {
-            index_->abort(tok, it->second->id);
-        }
+        index_->abort_all_for_owner(it->second->id);
         for (auto& [lease, bytes] : it->second->open_leases) {
             index_->release(lease);
         }
@@ -846,13 +844,11 @@ void Server::begin_put(Conn& c) {
     {
         std::lock_guard<std::mutex> lk(store_mu_);
         index_->reserve(keys.size());
-        c.open_tokens.reserve(c.open_tokens.size() + keys.size());
         for (auto& k : keys) {
             RemoteBlock b;
             Status st = index_->allocate(k, block_size, &b, c.id);
             if (st == OK) {
                 c.wtokens.push_back(b.token);
-                c.open_tokens.insert(b.token);
                 uint32_t sz = 0;
                 uint8_t* dst = index_->write_dest(b.token, &sz, c.id);
                 c.wdest.emplace_back(dst, block_size);
@@ -886,7 +882,6 @@ void Server::finish_write(Conn& c) {
             // might retry wholesale.
             for (uint64_t tok : c.wtokens) {
                 index_->abort(tok, c.id);
-                c.open_tokens.erase(tok);
             }
         } else {
             // Commit everything that landed (two-phase visibility:
@@ -894,7 +889,6 @@ void Server::finish_write(Conn& c) {
             // the pool).
             for (uint64_t tok : c.wtokens) {
                 if (index_->commit(tok, c.id) == OK) committed++;
-                c.open_tokens.erase(tok);
             }
         }
     }
@@ -941,11 +935,8 @@ void Server::op_allocate(Conn& c) {
     {
         std::lock_guard<std::mutex> lk(store_mu_);
         index_->reserve(keys.size());
-        c.open_tokens.reserve(c.open_tokens.size() + keys.size());
         for (size_t i = 0; i < keys.size(); ++i) {
-            Status st = index_->allocate(keys[i], block_size, &blocks[i],
-                                         c.id);
-            if (st == OK) c.open_tokens.insert(blocks[i].token);
+            index_->allocate(keys[i], block_size, &blocks[i], c.id);
         }
         mm_->maybe_extend();
     }
@@ -974,14 +965,25 @@ void Server::op_read(Conn& c) {
         // Cheap metadata pass first: definitive answers (missing key,
         // size mismatch) must not be masked by retryable BUSY, and a
         // read that will be refused must not pay disk promotion (or
-        // churn the cache making pool room for it).
+        // churn the cache making pool room for it). The Entry* pointers
+        // are kept so the residency pass below resolves each key's hash
+        // ONCE, not twice (the get-side hot path at 4 KB blocks) — but
+        // ONLY when LRU eviction is off: ensure_resident can trigger
+        // evict_lru, which hard-erases map entries and would leave a
+        // later cached pointer dangling (use-after-free). With eviction
+        // on, the residency pass re-resolves by key (a vanished key is
+        // then a clean KEY_NOT_FOUND).
+        const bool ptrs_stable = !index_->may_erase_under_pressure();
+        std::vector<Entry*> entries;
+        entries.reserve(keys.size());
         for (auto& k : keys) {
-            const Entry* meta = index_->get_committed(k);
+            Entry* meta = index_->get_committed(k);
             if (meta == nullptr || meta->size < block_size) {
                 w.u32(KEY_NOT_FOUND);
                 respond(c, c.hdr.seq, OP_READ, std::move(body));
                 return;
             }
+            entries.push_back(meta);
         }
         // Backpressure: refuse the whole read (retryably, before any
         // pinning or disk promotion) if it would push this connection's
@@ -998,22 +1000,26 @@ void Server::op_read(Conn& c) {
             return;
         }
         uint64_t p0 = index_->promotes();
-        for (auto& k : keys) {
-            // Bounded promotion slice per request (see kMaxPromotesPerOp).
-            if (index_->promotes() - p0 >= kMaxPromotesPerOp) {
-                const Entry* meta = index_->get_committed(k);
-                if (meta != nullptr && meta->block == nullptr) {
-                    reads_busy_.fetch_add(1, std::memory_order_relaxed);
-                    w.u32(BUSY);
-                    respond(c, c.hdr.seq, OP_READ, std::move(body));
-                    return;
-                }
+        for (size_t i = 0; i < keys.size(); ++i) {
+            Entry* e = ptrs_stable ? entries[i]
+                                   : index_->get_committed(keys[i]);
+            if (e == nullptr) {  // evicted between the passes
+                w.u32(KEY_NOT_FOUND);
+                respond(c, c.hdr.seq, OP_READ, std::move(body));
+                return;
             }
-            // get_resident promotes spilled entries back into the pool.
-            // A failed promotion surfaces as its own (retryable) status,
-            // not KEY_NOT_FOUND — the data is still there.
-            const Entry* e = nullptr;
-            Status st = index_->get_resident(k, &e);
+            // Bounded promotion slice per request (see kMaxPromotesPerOp).
+            if (e->block == nullptr &&
+                index_->promotes() - p0 >= kMaxPromotesPerOp) {
+                reads_busy_.fetch_add(1, std::memory_order_relaxed);
+                w.u32(BUSY);
+                respond(c, c.hdr.seq, OP_READ, std::move(body));
+                return;
+            }
+            // ensure_resident promotes spilled entries back into the
+            // pool. A failed promotion surfaces as its own (retryable)
+            // status, not KEY_NOT_FOUND — the data is still there.
+            Status st = index_->ensure_resident(e, keys[i]);
             if (st != OK) {
                 w.u32(st);
                 respond(c, c.hdr.seq, OP_READ, std::move(body));
@@ -1046,7 +1052,6 @@ void Server::op_commit(Conn& c) {
         for (uint32_t i = 0; i < n && r.ok(); ++i) {
             uint64_t tok = r.u64();
             if (index_->commit(tok, c.id) == OK) committed++;
-            c.open_tokens.erase(tok);
         }
     }
     w.u32(r.ok() ? OK : BAD_REQUEST);
@@ -1069,7 +1074,6 @@ void Server::op_abort(Conn& c) {
         for (uint32_t i = 0; i < n && r.ok(); ++i) {
             uint64_t tok = r.u64();
             index_->abort(tok, c.id);
-            c.open_tokens.erase(tok);
         }
     }
     w.u32(r.ok() ? OK : BAD_REQUEST);

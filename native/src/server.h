@@ -139,10 +139,10 @@ class Server {
         // Per-connection sink for payload of unknown/purged tokens; sized
         // before pointer capture and never resized mid-scatter.
         std::vector<uint8_t> sink;
-        // Uncommitted tokens allocated on this connection; aborted if the
-        // connection dies (improvement over the reference, which leaks
-        // uncommitted kv_map entries on client crash).
-        std::unordered_set<uint64_t> open_tokens;
+        // Uncommitted tokens of a dead connection are aborted via
+        // KVIndex::abort_all_for_owner (slab scan) — an improvement over
+        // the reference, which leaks uncommitted kv_map entries on
+        // client crash, without paying two hash ops per key here.
         // Pin leases taken on this connection (lease id → pinned bytes);
         // released if it dies, so a crashed reader cannot pin pool blocks
         // forever. OP_RELEASE only accepts leases in this map — lease ids
