@@ -14,6 +14,14 @@ What it shows, in order:
    prefills only the new tokens, in bounded chunks.
 3. Speculation: a repetitive prompt decodes with prompt-lookup drafts
    accepted several-at-a-time.
+4. With --http-port: the engine goes ONLINE — an HTTP front end
+   (serving_http.ServingHTTPServer) serves POST /generate with
+   streamed tokens and GET /stats with per-request TTFT/tok_s. Drive
+   it with, e.g.:
+
+       curl -N -XPOST localhost:8080/generate \
+            -d '{"prompt": [1,2,3], "max_new_tokens": 8}'
+       curl localhost:8080/stats
 """
 
 import argparse
@@ -27,7 +35,7 @@ from infinistore_tpu.serving import Request, ServingConfig, ServingEngine
 from infinistore_tpu.tpu import TpuKVStore
 
 
-def run(host, port):
+def run(host, port, http_port=None, http_demo_requests=False):
     cfg = llama.LlamaConfig(
         vocab_size=256, d_model=128, n_layers=4, n_heads=4, n_kv_heads=2,
         d_ff=256, max_seq=256, page_size=16,
@@ -100,6 +108,44 @@ def run(host, port):
         f"{eng3.stats['decoded_tokens']} tokens in "
         f"{eng3.stats['decode_steps']} steps"
     )
+    # 4. Online serving: real requests over a real socket.
+    if http_port is not None:
+        from infinistore_tpu.serving_http import ServingHTTPServer
+
+        eng4 = ServingEngine(
+            params, cfg, ServingConfig(max_slots=4, total_pages=64),
+            store=store,
+        )
+        web = ServingHTTPServer(eng4, port=http_port)
+        bound = web.start()
+        if http_demo_requests:
+            import json as _json
+            import urllib.request as _rq
+
+            body = _json.dumps(
+                {"prompt": [1, 2, 3, 4], "max_new_tokens": 8,
+                 "stream": False}
+            ).encode()
+            res = _json.loads(
+                _rq.urlopen(
+                    _rq.Request(
+                        f"http://127.0.0.1:{bound}/generate", data=body,
+                        method="POST",
+                    ),
+                    timeout=60,
+                ).read()
+            )
+            print(
+                f"http: served {len(res['tokens'])} tokens, "
+                f"ttft {res['ttft_ms']} ms, {res['tok_s']} tok/s"
+            )
+            web.shutdown()
+        else:
+            print(f"http: serving on :{bound} (POST /generate, /stats)")
+            try:
+                web._http_thread.join()
+            except KeyboardInterrupt:
+                web.shutdown()
     conn.close()
 
 
@@ -107,5 +153,12 @@ if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--service-port", type=int, default=22345)
+    p.add_argument("--http-port", type=int, default=None,
+                   help="also serve the engine over HTTP on this port "
+                        "(0 = ephemeral)")
+    p.add_argument("--http-demo", action="store_true",
+                   help="with --http-port: fire one demo request and "
+                        "exit instead of serving forever")
     args = p.parse_args()
-    run(args.host, args.service_port)
+    run(args.host, args.service_port, http_port=args.http_port,
+        http_demo_requests=args.http_demo)

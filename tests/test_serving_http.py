@@ -1,0 +1,156 @@
+"""HTTP serving front end: real requests over a real socket, streamed
+tokens, continuous batching across concurrent clients, per-request
+TTFT/tok_s in /stats (VERDICT r3 item 7)."""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from infinistore_tpu.models import llama
+from infinistore_tpu.serving import Request, ServingConfig, ServingEngine
+from infinistore_tpu.serving_http import ServingHTTPServer
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return llama.LlamaConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=128, page_size=8, dtype="float32",
+    )
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return llama.init_params(jax.random.PRNGKey(0), cfg)
+
+
+@pytest.fixture
+def server(params, cfg):
+    eng = ServingEngine(
+        params, cfg, ServingConfig(max_slots=4, total_pages=64)
+    )
+    srv = ServingHTTPServer(eng, port=0)
+    port = srv.start()
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+
+
+def _post(base, body, stream):
+    req = urllib.request.Request(
+        f"{base}/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        if not stream:
+            return json.loads(r.read())
+        events = []
+        for line in r:
+            line = line.strip()
+            if line.startswith(b"data: "):
+                events.append(json.loads(line[6:]))
+        return events
+
+
+def _ref(params, cfg, prompt, n_new):
+    return ServingEngine(params, cfg).run(
+        [Request("x", prompt, max_new_tokens=n_new)]
+    )["x"]
+
+
+def test_nonstreaming_roundtrip(server, params, cfg):
+    rng = np.random.default_rng(1)
+    prompt = [int(t) for t in rng.integers(0, cfg.vocab_size, 9)]
+    res = _post(server, {"prompt": prompt, "max_new_tokens": 6,
+                         "stream": False}, stream=False)
+    assert res["tokens"] == _ref(params, cfg, prompt, 6)
+    assert res["ttft_ms"] is not None and res["ttft_ms"] >= 0
+    assert res["tok_s"] > 0
+
+
+def test_eight_concurrent_streaming_requests(server, params, cfg):
+    """8 clients stream simultaneously; every stream's per-token events
+    must concatenate to exactly that prompt's isolated greedy output
+    (continuous batching is a pure scheduling concern), and /stats must
+    report the serving metrics."""
+    rng = np.random.default_rng(2)
+    prompts = [
+        [int(t) for t in rng.integers(0, cfg.vocab_size, n)]
+        for n in (5, 8, 11, 7, 9, 6, 13, 10)
+    ]
+    n_new = 8
+    results = [None] * len(prompts)
+    errors = []
+
+    def client(i):
+        try:
+            events = _post(
+                server,
+                {"prompt": prompts[i], "max_new_tokens": n_new},
+                stream=True,
+            )
+            toks = [e["token"] for e in events if "token" in e]
+            final = [e for e in events if e.get("done")]
+            assert len(final) == 1
+            assert final[0]["tokens"] == toks
+            results[i] = toks
+        except Exception as e:  # surface in the main thread
+            errors.append((i, e))
+
+    threads = [
+        threading.Thread(target=client, args=(i,))
+        for i in range(len(prompts))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    for i, p in enumerate(prompts):
+        assert results[i] == _ref(params, cfg, p, n_new), i
+
+    stats = json.loads(
+        urllib.request.urlopen(f"{server}/stats", timeout=30).read()
+    )
+    assert stats["requests_done"] >= 8
+    assert stats["ttft_ms_mean"] > 0
+    assert stats["tok_s_mean"] > 0
+    # Each request's FIRST token comes from admission prefill logits,
+    # not a decode step.
+    assert stats["engine"]["decoded_tokens"] >= 8 * (n_new - 1)
+
+
+def test_sampled_stream_and_bad_requests(server, cfg):
+    rng = np.random.default_rng(3)
+    prompt = [int(t) for t in rng.integers(0, cfg.vocab_size, 6)]
+    events = _post(
+        server,
+        {"prompt": prompt, "max_new_tokens": 5, "temperature": 0.8,
+         "top_k": 8, "seed": 11},
+        stream=True,
+    )
+    toks = [e["token"] for e in events if "token" in e]
+    assert len(toks) == 5
+    # Bad requests answer 400, not a hung stream.
+    for body in ({"prompt": []}, {"prompt": [1], "max_new_tokens": 0},
+                 {"nope": 1}):
+        req = urllib.request.Request(
+            f"{server}/generate", data=json.dumps(body).encode(),
+            method="POST",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            assert False, "expected HTTPError"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+
+def test_health(server):
+    assert json.loads(
+        urllib.request.urlopen(f"{server}/health", timeout=10).read()
+    )["status"] == "ok"
