@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Build the docs tree: docs/*.md + README.md -> docs/_build/*.html.
+
+The reference ships a Sphinx tree + deploy workflow
+(/root/reference/docs/source/conf.py, .github/workflows/deploy-docs.yml).
+This environment has no sphinx/docutils, so the equivalent here is a
+self-contained builder over the `markdown` package (present) producing
+a navigable static site — the same artifact class (buildable, CI-able
+HTML docs), wired into .github/workflows/lint.yml.
+
+Usage: python docs/build.py [outdir]   (default docs/_build)
+Exit code is non-zero if any source fails to render — CI-fails on
+broken docs, like a sphinx build would.
+"""
+
+import pathlib
+import sys
+
+import markdown
+
+_TEMPLATE = """<!doctype html>
+<html lang="en"><head><meta charset="utf-8">
+<title>{title} — infinistore-tpu</title>
+<style>
+body {{ font: 15px/1.55 system-ui, sans-serif; max-width: 55rem;
+       margin: 2rem auto; padding: 0 1rem; color: #1a1a1a; }}
+code, pre {{ font: 13px/1.45 ui-monospace, monospace;
+             background: #f5f5f5; }}
+pre {{ padding: .8rem; overflow-x: auto; border-radius: 4px; }}
+code {{ padding: .1rem .25rem; border-radius: 3px; }}
+pre code {{ padding: 0; }}
+table {{ border-collapse: collapse; }}
+th, td {{ border: 1px solid #ccc; padding: .3rem .6rem; }}
+nav {{ border-bottom: 1px solid #ddd; padding-bottom: .5rem;
+       margin-bottom: 1.5rem; }}
+nav a {{ margin-right: 1rem; }}
+h1, h2, h3 {{ line-height: 1.25; }}
+</style></head><body>
+<nav>{nav}</nav>
+{body}
+</body></html>
+"""
+
+
+def build(outdir="docs/_build"):
+    root = pathlib.Path(__file__).resolve().parent.parent
+    out = root / outdir if not pathlib.Path(outdir).is_absolute() \
+        else pathlib.Path(outdir)
+    out.mkdir(parents=True, exist_ok=True)
+
+    sources = [("index", root / "README.md")]
+    sources += sorted(
+        (p.stem, p) for p in (root / "docs").glob("*.md")
+    )
+    nav = " ".join(
+        f'<a href="{name}.html">{name}</a>' for name, _ in sources
+    )
+
+    failures = 0
+    for name, path in sources:
+        try:
+            text = path.read_text()
+            body = markdown.markdown(
+                text, extensions=["tables", "fenced_code"]
+            )
+            title = next(
+                (ln.lstrip("# ").strip() for ln in text.splitlines()
+                 if ln.startswith("#")),
+                name,
+            )
+            (out / f"{name}.html").write_text(
+                _TEMPLATE.format(title=title, nav=nav, body=body)
+            )
+            print(f"built {name}.html ({path.relative_to(root)})")
+        except Exception as e:  # noqa: BLE001 — report and fail the build
+            print(f"FAILED {path}: {e}", file=sys.stderr)
+            failures += 1
+    return failures
+
+
+if __name__ == "__main__":
+    sys.exit(build(*sys.argv[1:]))
