@@ -69,28 +69,49 @@ def reset_copy_counters():
         copy_counters[k] = 0
 
 
+def _flatten_on_device(arr):
+    """Device-side flatten of a multi-dim jax.Array (no-op otherwise):
+    the prefetch sites and _to_host must flatten the SAME way or the
+    async D2H and the blocking one hit different arrays (a wasted
+    double transfer)."""
+    if not isinstance(arr, np.ndarray) and getattr(arr, "ndim", 1) > 1:
+        return arr.reshape(-1)
+    return arr
+
+
 def _to_host(arr):
     """Device → host as a C-contiguous numpy array, counting copies.
 
-    jax.Array: np.asarray performs (and caches) the one D2H transfer;
-    PJRT returns C-contiguous buffers (probed), so no further copy
-    happens — the bytes go from this buffer straight into the pool via
-    the native client's memcpy. A non-contiguous host input is the only
-    case that pays a staging copy, and the counter records it."""
+    jax.Array: the transfer is issued on a device-side FLATTENED view.
+    PJRT hands multi-dim TPU arrays to the host in their device (tiled)
+    layout — observed: a [64,2048,8,8] uint16 transfer arrives
+    dim-permuted (strides (262144,2,32768,4096)) — and fixing that up
+    host-side is exactly the full-size staging copy this path exists to
+    avoid. The flattening reshape is a device relayout (HBM-speed, part
+    of the transfer like the reference's cudaMemcpyAsync setup), the
+    1-D transfer lands C-contiguous, and the reshape back to the
+    caller's shape is a free view — so the bytes go from the D2H buffer
+    straight into the pool via the native client's memcpy. A
+    non-contiguous numpy input is the only case that still pays a
+    staging copy, and the counter records it."""
     if isinstance(arr, np.ndarray):
         if arr.flags["C_CONTIGUOUS"]:
             return arr
         copy_counters["staging_copies"] += 1
         copy_counters["staging_bytes"] += arr.nbytes
         return np.ascontiguousarray(arr)
-    host = np.asarray(arr)
+    if not hasattr(arr, "shape"):  # plain array-likes (lists, scalars)
+        return np.ascontiguousarray(arr)
+    shape = arr.shape
+    flat = _flatten_on_device(arr)
+    host = np.asarray(flat)
     copy_counters["d2h_copies"] += 1
     copy_counters["d2h_bytes"] += host.nbytes
-    if not host.flags["C_CONTIGUOUS"]:  # defensive: unobserved on PJRT
+    if not host.flags["C_CONTIGUOUS"]:  # defensive: 1-D should be flat
         copy_counters["staging_copies"] += 1
         copy_counters["staging_bytes"] += host.nbytes
         host = np.ascontiguousarray(host)
-    return host
+    return host.reshape(shape)
 
 
 def _device_put_owned(view, device):
@@ -428,6 +449,10 @@ class LayerStreamer:
     def submit(self, key, array):
         """Queue one array (one page) for upload under ``key``."""
         _require_jax()
+        # Flatten ON DEVICE before the async D2H so the prefetch and
+        # _to_host hit the SAME (contiguous-landing) array — see
+        # _to_host for the device-layout story.
+        array = _flatten_on_device(array)
         if hasattr(array, "copy_to_host_async"):
             array.copy_to_host_async()  # start D2H now; thread reaps it
         self._q.put((key, array, False))
@@ -439,6 +464,9 @@ class LayerStreamer:
         _require_jax()
         if len(keys) != pages.shape[0]:
             raise ValueError("len(keys) must equal pages.shape[0]")
+        if not keys:
+            return  # nothing to upload; avoid a 0-division in the worker
+        pages = _flatten_on_device(pages)  # same flatten-before-prefetch
         if hasattr(pages, "copy_to_host_async"):
             pages.copy_to_host_async()
         self._q.put((keys, pages, True))
@@ -453,8 +481,11 @@ class LayerStreamer:
                 try:
                     host = _to_host(arr)  # waits only for the async D2H
                     if batched:
-                        n = host.shape[0]
-                        page_elems = int(np.prod(host.shape[1:]))
+                        # Device inputs arrive pre-flattened (submit_pages);
+                        # numpy inputs keep their [n, ...] shape — derive
+                        # the page size from the key count either way.
+                        n = len(key)
+                        page_elems = host.size // n
                         blocks = self.conn.allocate(
                             key, page_elems * host.itemsize
                         )
