@@ -16,6 +16,35 @@ rm -rf build dist infinistore_tpu.egg-info
 python setup.py -q bdist_wheel
 echo "built: $(ls dist/*.whl)"
 
+# --- shared-library audit (the auditwheel step, sans docker) ---
+# auditwheel's job is to verify the wheel's native artifacts link only
+# against a policy whitelist. Enforce the same property directly: the
+# bundled .so may need nothing beyond glibc-family libraries +
+# libstdc++/libgcc (the reference whitelists manylinux glibc and
+# excludes libibverbs; we have no out-of-policy dependency at all).
+so_in_wheel="$(python - <<'EOF'
+import glob, sys, tempfile, zipfile
+whl = glob.glob("dist/*.whl")[0]
+tmp = tempfile.mkdtemp()
+with zipfile.ZipFile(whl) as z:
+    for n in z.namelist():
+        if n.endswith(".so"):
+            z.extract(n, tmp)
+            print(f"{tmp}/{n}")
+            sys.exit(0)
+sys.exit("no .so in wheel")
+EOF
+)"
+bad_deps="$(ldd "$so_in_wheel" | awk '{print $1}' | grep -vE \
+  '^(linux-vdso|libc\.so|libm\.so|libstdc\+\+\.so|libgcc_s\.so|librt\.so|libpthread\.so|libdl\.so|/lib|ld-linux)' \
+  || true)"
+if [ -n "$bad_deps" ]; then
+    echo "wheel audit FAILED — out-of-policy shared deps:"
+    echo "$bad_deps"
+    exit 1
+fi
+echo "wheel audit OK: $(basename "$so_in_wheel") links only glibc-family + libstdc++"
+
 # --- smoke test: install into a clean venv and run the selftest ---
 # Dependencies (numpy) come from the invoking environment via a .pth
 # bridge — there is no network in this environment; the package under

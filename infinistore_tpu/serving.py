@@ -229,17 +229,12 @@ class _LazyHost:
 
 
 @partial(jax.jit, static_argnames=("cfg",))
-def _prefill_jit(params, cfg, tokens):
-    """Module-level prefill jit (static cfg): every engine with the
-    same config shares one compilation — a per-engine jax.jit(partial)
-    would silently recompile identical HLO for each new engine
-    instance (measured: ~30 s of the first run of a second engine on
-    the axon tunnel)."""
-    return llama.prefill(params, cfg, tokens)
-
-
-@partial(jax.jit, static_argnames=("cfg",))
 def _prefill_px_jit(params, cfg, tokens, prefix_kvs):
+    """Module-level prefix-HIT prefill jit (static cfg): every engine
+    with the same config shares one compilation — a per-engine
+    jax.jit(partial) would silently recompile identical HLO for each
+    new engine instance (measured: ~30 s per instance on the axon
+    tunnel). Cold admissions use _admit_fused instead."""
     return llama.prefill_with_prefix(params, cfg, tokens, prefix_kvs)
 
 
@@ -266,6 +261,33 @@ def _decode_scan(params, cfg, token, seq_lens, k_pages, v_pages, rows,
         body, (token, seq_lens, k_pages, v_pages), None, length=n_steps
     )
     return toks.T, lens, kp, vp  # [batch, n_steps]
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3, 4))
+def _admit_fused(params, cfg, tokens, k_pages, v_pages, ids, s_real):
+    """Cold-prefill admission as ONE device program: prefill + page the
+    suffix KV + scatter it into the (donated) pool at `ids` + slice the
+    last real position's logits row. The unfused path was ~10 dispatches
+    (prefill, per-layer kv_to_pages, stacks, pads, pool write, logits
+    indexing) and pulled a full [s,vocab] row source; this is one
+    dispatch and one [vocab] row pull. Padded positions beyond s_real
+    write their (garbage) KV into the tail page's unused slots — those
+    slots are masked by seq_len, overwritten by decode before the page
+    can ever fill, and partial pages are never offloaded, so the bytes
+    are unreachable. `ids` is padded with total_pages (mode=drop).
+    tokens: [1, s_pad] (page multiple); ids: [max_pages_per_seq]."""
+    logits, kvs = llama.prefill(params, cfg, tokens)
+    page = cfg.page_size
+    n = tokens.shape[1] // page
+    k_sfx = jnp.stack([k[0] for k, _ in kvs])  # [L, s_pad, kv, hd]
+    v_sfx = jnp.stack([v[0] for _, v in kvs])
+    kp = k_sfx.reshape(cfg.n_layers, n, page, cfg.n_kv_heads, cfg.head_dim)
+    vp = v_sfx.reshape(cfg.n_layers, n, page, cfg.n_kv_heads, cfg.head_dim)
+    m = ids.shape[0]
+    pad = ((0, 0), (0, m - n), (0, 0), (0, 0), (0, 0))
+    k_pages = k_pages.at[:, ids].set(jnp.pad(kp, pad), mode="drop")
+    v_pages = v_pages.at[:, ids].set(jnp.pad(vp, pad), mode="drop")
+    return logits[0, s_real - 1], k_pages, v_pages
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(4, 5))
@@ -342,7 +364,8 @@ class ServingEngine:
         # serving (full prefills, no offload) instead of failing
         # requests on a cache.
         self._store_ok = True
-        self._prefill = partial(_prefill_jit, params, cfg)
+        # Cold admissions ride _admit_fused; the prefix-HIT suffix
+        # prefill keeps the shared module-level jit.
         self._prefill_px = partial(_prefill_px_jit, params, cfg)
         # Steady-state decode device cache: (key, token_dev, lens_dev,
         # rows_dev) left by the previous fused step. While the active
@@ -434,13 +457,22 @@ class ServingEngine:
         ids, self.free_pages = self.free_pages[:n], self.free_pages[n:]
         return ids
 
+    def _pad_ids(self, ids):
+        """Pad a page-id list to the fixed arity max_pages_per_seq with
+        the total_pages sentinel (mode=\"drop\" discards those writes) —
+        the ONE place the fixed-arity convention lives (shared by
+        _pool_write and the fused cold-admission path)."""
+        ids_p = np.full(self.sc.max_pages_per_seq, self.sc.total_pages,
+                        dtype=np.int32)
+        ids_p[:len(ids)] = ids
+        return ids_p
+
     def _pool_write(self, ids, k_new, v_new):
         """Write [L, n, page, kv, hd] pages into the pool at `ids`,
         padding to the fixed arity max_pages_per_seq."""
         m = self.sc.max_pages_per_seq
         n = len(ids)
-        ids_p = np.full(m, self.sc.total_pages, dtype=np.int32)
-        ids_p[:n] = ids
+        ids_p = self._pad_ids(ids)
         pad = [(0, 0), (0, m - n)] + [(0, 0)] * (k_new.ndim - 2)
         self.k_pages, self.v_pages = _write_pages(
             self.k_pages, self.v_pages, jnp.asarray(ids_p),
@@ -561,29 +593,33 @@ class ServingEngine:
         toks[0, :s_real] = suffix
         toks = jnp.asarray(toks)
         if prefix_kvs is None:
-            logits, kvs = self._prefill(toks)
+            # Cold admission (hit == 0): one fused device program does
+            # prefill + page-out + pool scatter + logits-row slice.
+            row_dev, self.k_pages, self.v_pages = _admit_fused(
+                self.params, cfg, toks, self.k_pages, self.v_pages,
+                jnp.asarray(self._pad_ids(ids)), jnp.asarray(s_real),
+            )
+            row_host = np.asarray(row_dev)
         else:
             logits, kvs = self._prefill_px(toks, prefix_kvs)
+            # Page out the suffix KV into the pool (real tokens only).
+            k_sfx = jnp.stack([k[:, :s_real] for k, _ in kvs])
+            v_sfx = jnp.stack([v[:, :s_real] for _, v in kvs])
+            kp_s, vp_s = [], []
+            for li in range(cfg.n_layers):
+                a, b = llama.kv_to_pages(cfg, k_sfx[li], v_sfx[li])
+                kp_s.append(a[0])
+                vp_s.append(b[0])
+            self._pool_write(ids[hit:], jnp.stack(kp_s), jnp.stack(vp_s))
+            row_host = np.asarray(logits[0, s_real - 1])
         self.stats["prefill_tokens"] += s_real
-
-        # Page out the suffix KV into the pool (real tokens only).
-        k_sfx = jnp.stack([k[:, :s_real] for k, _ in kvs])  # [L,1,s,kv,hd]
-        v_sfx = jnp.stack([v[:, :s_real] for _, v in kvs])
-        kp_s, vp_s = [], []
-        for li in range(cfg.n_layers):
-            a, b = llama.kv_to_pages(cfg, k_sfx[li], v_sfx[li])
-            kp_s.append(a[0])
-            vp_s.append(b[0])
-        self._pool_write(ids[hit:], jnp.stack(kp_s), jnp.stack(vp_s))
 
         self.page_table[slot_idx] = row
 
         slot = _Slot(
             work=work, page_ids=ids, seq_len=n_prompt, cached_pages=hit,
         )
-        self._emit(
-            slot, [self._pick(work, np.asarray(logits[0, s_real - 1]))]
-        )
+        self._emit(slot, [self._pick(work, row_host)])
         self.slots[slot_idx] = slot
 
     # ---- decode --------------------------------------------------------

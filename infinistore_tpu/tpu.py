@@ -464,7 +464,7 @@ class LayerStreamer:
         _require_jax()
         if len(keys) != pages.shape[0]:
             raise ValueError("len(keys) must equal pages.shape[0]")
-        if not keys:
+        if len(keys) == 0:  # no truthiness: keys may be a numpy array
             return  # nothing to upload; avoid a 0-division in the worker
         pages = _flatten_on_device(pages)  # same flatten-before-prefetch
         if hasattr(pages, "copy_to_host_async"):
