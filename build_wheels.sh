@@ -26,24 +26,28 @@ so_in_wheel="$(python - <<'EOF'
 import glob, sys, tempfile, zipfile
 whl = glob.glob("dist/*.whl")[0]
 tmp = tempfile.mkdtemp()
+found = []
 with zipfile.ZipFile(whl) as z:
     for n in z.namelist():
         if n.endswith(".so"):
             z.extract(n, tmp)
-            print(f"{tmp}/{n}")
-            sys.exit(0)
-sys.exit("no .so in wheel")
+            found.append(f"{tmp}/{n}")
+if not found:
+    sys.exit("no .so in wheel")
+print("\n".join(found))  # audit EVERY native artifact, not the first
 EOF
 )"
-bad_deps="$(ldd "$so_in_wheel" | awk '{print $1}' | grep -vE \
-  '^(linux-vdso|libc\.so|libm\.so|libstdc\+\+\.so|libgcc_s\.so|librt\.so|libpthread\.so|libdl\.so|/lib|ld-linux)' \
-  || true)"
-if [ -n "$bad_deps" ]; then
-    echo "wheel audit FAILED — out-of-policy shared deps:"
-    echo "$bad_deps"
-    exit 1
-fi
-echo "wheel audit OK: $(basename "$so_in_wheel") links only glibc-family + libstdc++"
+for so in $so_in_wheel; do
+    bad_deps="$(ldd "$so" | awk '{print $1}' | grep -vE \
+      '^(linux-vdso|libc\.so|libm\.so|libstdc\+\+\.so|libgcc_s\.so|librt\.so|libpthread\.so|libdl\.so|/lib|ld-linux)' \
+      || true)"
+    if [ -n "$bad_deps" ]; then
+        echo "wheel audit FAILED — $(basename "$so") has out-of-policy deps:"
+        echo "$bad_deps"
+        exit 1
+    fi
+    echo "wheel audit OK: $(basename "$so") links only glibc-family + libstdc++"
+done
 
 # --- smoke test: install into a clean venv and run the selftest ---
 # Dependencies (numpy) come from the invoking environment via a .pth

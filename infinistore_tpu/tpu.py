@@ -120,12 +120,30 @@ def _device_put_owned(view, device):
     over zero-copy; on CPU targets PJRT may alias an aligned contiguous
     host buffer (kImmutableZeroCopy), which would leave the returned
     array pointing into the server pool after its lease is released —
-    force a private copy there."""
+    force a private copy there.
+
+    Completion is proven by a tiny data-dependent read, NOT just
+    block_until_ready: the axon tunnel has an observed mode where
+    block_until_ready returns while the H2D is still in flight, and the
+    caller releases the source view's pin lease the moment we return —
+    an unproven transfer would then read pool memory the server is free
+    to reuse. The probe moves one element; on a local-PCIe host it
+    costs microseconds."""
     platform = device.platform if device is not None else jax.default_backend()
     if platform == "cpu":
         view = np.array(view, copy=True)
-    out = jax.device_put(view, device)
+    return _prove_transferred(jax.device_put(view, device), device)
+
+
+def _prove_transferred(out, device):
+    """block_until_ready + a one-element data-dependent pull on
+    accelerator targets: readiness alone can be reported early (see
+    _device_put_owned), and a timed or lease-scoped transfer must not
+    be trusted until a read depends on it."""
     out.block_until_ready()
+    platform = device.platform if device is not None else jax.default_backend()
+    if platform != "cpu" and getattr(out, "ndim", 0) > 0 and out.size > 0:
+        np.asarray(out[(0,) * out.ndim])
     return out
 
 
@@ -217,7 +235,9 @@ class TpuKVStore:
         buf = np.empty(nbytes, dtype=np.uint8)
         self.conn.read_cache(buf, [(key, 0)], nbytes)
         self.conn.sync()
-        return jax.device_put(buf.view(dtype).reshape(shape), device)
+        return _prove_transferred(
+            jax.device_put(buf.view(dtype).reshape(shape), device), device
+        )
 
     # -- paged KV --------------------------------------------------------
 
@@ -273,8 +293,9 @@ class TpuKVStore:
             buf, [(k, i * page_bytes) for i, k in enumerate(keys)], page_bytes
         )
         self.conn.sync()
-        return jax.device_put(
-            buf.view(dtype).reshape(n, *page_shape), device
+        return _prove_transferred(
+            jax.device_put(buf.view(dtype).reshape(n, *page_shape), device),
+            device,
         )
 
     def get_kv_pages_host(self, keys, page_shape, dtype):
@@ -371,8 +392,10 @@ class TpuKVStore:
             q, scales = kv_quant.unpack_pages_host(
                 buf.reshape(n, block), page_shape
             )
-            q = jax.device_put(q, device)
-            scales = jax.device_put(scales, device)
+            q = _prove_transferred(jax.device_put(q, device), device)
+            scales = _prove_transferred(
+                jax.device_put(scales, device), device
+            )
         return kv_quant.dequantize_kv_pages(q, scales, jnp.dtype(dtype))
 
     def _pool_batch_view(self, blocks, n, page_bytes, dtype, page_shape):

@@ -443,3 +443,64 @@ def test_strict_mode_throws_through():
     finally:
         conn.close()
         servers[1].stop()
+
+
+def test_async_paths_degrade_like_sync():
+    """put_cache_async / read_cache_async / sync_async under a dead
+    shard: writes drop the dead partition, reads raise KeyNotFound for
+    its keys after healthy shards land, sync barriers the rest — the
+    same contract as the sync paths."""
+    import asyncio
+
+    from infinistore_tpu.lib import InfiniStoreKeyNotFound
+
+    servers = [_mk_server() for _ in range(2)]
+    conn = ShardedConnection(
+        [ClientConfig(host_addr="127.0.0.1", service_port=s.service_port)
+         for s in servers]
+    )
+    conn.connect()
+    try:
+        block = 2048
+        src = np.random.default_rng(2).integers(0, 255, block,
+                                                dtype=np.uint8)
+        keys = [f"as_{i}" for i in range(16)]
+        dead = 1
+        dead_keys = [k for k in keys if _shard_of(k, 2) == dead]
+        live_keys = [k for k in keys if _shard_of(k, 2) != dead]
+        assert dead_keys and live_keys
+
+        async def drive():
+            # Healthy write first (all shards up).
+            await conn.put_cache_async(src, [(live_keys[0], 0)], block)
+            servers[dead].stop()
+            # Mixed-batch async put: dead partition dropped, no raise.
+            await conn.put_cache_async(
+                src, [(k, 0) for k in keys[:8]], block
+            )
+            await conn.sync_async()
+            assert conn.degraded[dead]
+            # Async read of a live key works.
+            dst = np.zeros(block, np.uint8)
+            await conn.read_cache_async(dst, [(live_keys[0], 0)], block)
+            await conn.sync_async()
+            assert np.array_equal(dst, src)
+            # Async read touching a dead-shard key: KeyNotFound.
+            try:
+                await conn.read_cache_async(
+                    dst, [(dead_keys[0], 0)], block
+                )
+                raise AssertionError("expected InfiniStoreKeyNotFound")
+            except InfiniStoreKeyNotFound:
+                pass
+            # match over both shards shrinks, async variant agrees.
+            got = await conn.get_match_last_index_async([live_keys[0]])
+            assert got == 0
+
+        asyncio.run(drive())
+        health = conn.stats()[-1]["sharded_health"]
+        assert health["lost_write_keys"] > 0
+        assert health["missed_read_keys"] > 0
+    finally:
+        conn.close()
+        servers[0].stop()
