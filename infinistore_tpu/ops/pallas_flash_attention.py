@@ -120,7 +120,19 @@ def _pad_axis(x, axis, mult):
 
 
 def _auto_block(seq_len):
-    return min(512, ((seq_len + 127) // 128) * 128)
+    # 1024x1024 blocks measured 1.7-2.2x faster than 512x512 at S=4096
+    # on v5e (0.54-0.69 ms vs 1.16 ms, 50-65% MFU vs 30% — r4 sweep;
+    # per-grid-step overhead amortizes over bigger tiles). 2048+ blocks
+    # fail to compile (VMEM), so 1024 is the ceiling. Between 512 and
+    # 1024, pick whichever pads the sequence less: fully-padded rows in
+    # the last block still run full MXU tiles, so S=1025 at block 1024
+    # would waste ~2x the compute that block 512 does.
+    full = ((seq_len + 127) // 128) * 128
+    if full <= 512:
+        return full
+    pad512 = -(-seq_len // 512) * 512
+    pad1024 = -(-seq_len // 1024) * 1024
+    return 512 if pad512 < pad1024 else 1024
 
 
 def _tile_mask(shape, q_start, k_start, q_len, kv_len, causal):
@@ -281,10 +293,12 @@ def flash_prefill_attention(q, k, v, causal=True, block_q=None, block_k=None,
     over restored-prefix + suffix KV); under `causal` the diagonal then
     shifts right by s_kv - s_q, i.e. query i sees kv j <= i + prefix_len.
 
-    block_q/block_k default to min(512, seq rounded up to 128): measured
-    on v5e, 512x512 runs ~13x faster than 128x128 at S=4096 (per-step
-    grid overhead dominates small blocks) and 4x faster than the XLA
-    path; smaller sequences shrink the block to avoid padding waste.
+    block_q/block_k default via _auto_block: up to 1024, preferring the
+    choice of {512, 1024} that pads the sequence least. Measured on
+    v5e: 512x512 runs ~13x faster than 128x128 at S=4096 (per-step
+    grid overhead dominates small blocks) and 1024x1024 another
+    1.7-2.2x faster than 512x512 (50-65% MFU); smaller sequences
+    shrink the block to avoid padding waste.
     """
     if block_q is None:
         block_q = _auto_block(q.shape[1])
