@@ -109,6 +109,11 @@ class ShardedConnection:
         self.conns = [InfinityConnection(c) for c in configs]
         self.n = len(configs)
         self.connected = False
+        # TpuKVStore compatibility: the sharded surface always moves
+        # bytes through read/write buffers (per-shard SHM is an
+        # internal detail — a cross-shard zero-copy pool view cannot
+        # exist), so accelerator-edge consumers take the staged path.
+        self.shm_connected = False
         self.parallel = True
         self.degrade = degrade_on_failure
         self.degraded = [False] * self.n
@@ -438,6 +443,26 @@ class ShardedConnection:
                     raise r
         if missed:
             self._raise_missed(missed)
+        return 0
+
+    def abort_for_keys(self, keys, blocks):
+        """Abort uncommitted allocations by (key, token) pairs — tokens
+        alone cannot route, so this is the sharded analogue of
+        InfinityConnection.abort (TpuKVStore's write-failure rollback
+        uses it; best-effort like the single-server path)."""
+        from ._native import FAKE_TOKEN, OK as _OK
+
+        parts = {}
+        for k, b in zip(keys, blocks):
+            if b["status"] == _OK and b["token"] != FAKE_TOKEN:
+                parts.setdefault(_shard_of(k, self.n), []).append(
+                    int(b["token"])
+                )
+        self._run_shard_calls(
+            [(s, self.conns[s].abort,
+              (np.asarray(toks, dtype=np.uint64),))
+             for s, toks in parts.items()]
+        )
         return 0
 
     def sync(self):

@@ -147,17 +147,25 @@ def _prove_transferred(out, device):
     return out
 
 
-def _abort_uncommitted(conn, blocks):
+def _abort_uncommitted(conn, blocks, keys=None):
     """Best-effort rollback of an allocate whose write failed: leaving
     the tokens uncommitted would dedup-poison the keys for EVERY client
     of the store (get_match_last_index counts uncommitted entries;
     re-puts silently skip; reads 404 — native/src/kv_index.h). If the
     connection itself is dead the abort can't be sent, but then the
-    server's dead-connection cleanup aborts them for us."""
+    server's dead-connection cleanup aborts them for us. A sharded
+    connection needs `keys` to route the aborts (tokens alone name no
+    shard)."""
     import numpy as _np
 
     from ._native import FAKE_TOKEN, OK as _OK
 
+    if keys is not None and hasattr(conn, "abort_for_keys"):
+        try:
+            conn.abort_for_keys(keys, blocks)
+        except Exception:
+            pass
+        return
     toks = blocks["token"][
         (blocks["status"] == _OK) & (blocks["token"] != FAKE_TOKEN)
     ]
@@ -180,6 +188,18 @@ class TpuKVStore:
 
     def __init__(self, conn: InfinityConnection):
         self.conn = conn
+        # A sharded connection routes by key, so writes must carry the
+        # key list and aborts route through abort_for_keys; everything
+        # else on the surface is signature-compatible (shm_connected is
+        # False there, selecting the staged read path).
+        self._sharded = hasattr(conn, "shard_of")
+
+    def _write(self, cache, offsets, page_size, blocks, keys):
+        if self._sharded:
+            return self.conn.write_cache(
+                cache, offsets, page_size, blocks, keys
+            )
+        return self.conn.write_cache(cache, offsets, page_size, blocks)
 
     # -- generic arrays --------------------------------------------------
 
@@ -205,13 +225,13 @@ class TpuKVStore:
             # One pipelined write per array, straight from its host
             # buffer — no concatenation staging copy (the writes share
             # the connection's IO thread, so per-call cost amortizes).
-            for i, (_k, a) in enumerate(group):
+            for i, (k, a) in enumerate(group):
                 try:
-                    self.conn.write_cache(a, [0], a.size, blocks[i:i + 1])
+                    self._write(a, [0], a.size, blocks[i:i + 1], [k])
                 except BaseException:
                     # Submitted writes ([:i]) commit via the IO thread;
                     # roll back only the blocks never written.
-                    _abort_uncommitted(self.conn, blocks[i:])
+                    _abort_uncommitted(self.conn, blocks[i:], keys[i:])
                     raise
         if sync:
             self.conn.sync()
@@ -257,11 +277,12 @@ class TpuKVStore:
         flat = host.reshape(n * page_elems)
         blocks = self.conn.allocate(keys, page_elems * host.itemsize)
         try:
-            self.conn.write_cache(
-                flat, [i * page_elems for i in range(n)], page_elems, blocks
+            self._write(
+                flat, [i * page_elems for i in range(n)], page_elems,
+                blocks, keys,
             )
         except BaseException:
-            _abort_uncommitted(self.conn, blocks)
+            _abort_uncommitted(self.conn, blocks, keys)
             raise
         if sync:
             self.conn.sync()
@@ -349,12 +370,12 @@ class TpuKVStore:
         block = kv_quant.packed_page_bytes(page_shape)
         blocks = self.conn.allocate(keys, block)
         try:
-            self.conn.write_cache(
+            self._write(
                 packed.reshape(-1), [i * block for i in range(n)], block,
-                blocks,
+                blocks, keys,
             )
         except BaseException:
-            _abort_uncommitted(self.conn, blocks)
+            _abort_uncommitted(self.conn, blocks, keys)
             raise
         if sync:
             self.conn.sync()
