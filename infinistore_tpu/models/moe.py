@@ -22,6 +22,7 @@ a load-balance auxiliary loss keeps the router spread.
 """
 
 from dataclasses import dataclass
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -109,11 +110,16 @@ def init_params(rng, cfg: MoEConfig):
     }
 
 
-def _route(layer, h, cfg: MoEConfig):
+def _route(layer, h, cfg: MoEConfig, valid=None):
     """Top-k routing → static dispatch/combine tensors + aux loss.
 
-    h: [T, d]. Returns (dispatch [T, E, C] bool-ish, combine [T, E, C]
-    fp32, aux_loss scalar).
+    h: [T, d]. `valid` ([T] bool or None): tokens marked invalid
+    (decode-batch slots with nothing in cache, ragged verify padding)
+    are excluded from routing BEFORE the capacity cumsum — otherwise
+    garbage tokens would consume expert capacity slots and could evict
+    REAL tokens' FFN computation, breaking the inherited contract that
+    padding is inert. Returns (dispatch [T, E, C] bool-ish, combine
+    [T, E, C] fp32, aux_loss scalar).
     """
     T = h.shape[0]
     E = cfg.n_experts
@@ -128,6 +134,10 @@ def _route(layer, h, cfg: MoEConfig):
     sel = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)  # [T, k, E]
     gates = jnp.einsum("tk,tke->te", top_w, sel)
     chosen = jnp.sum(sel, axis=1)  # [T, E] in {0, 1}
+    if valid is not None:
+        keep_t = valid.astype(jnp.float32)[:, None]  # [T, 1]
+        chosen = chosen * keep_t
+        gates = gates * keep_t
 
     # Position of each token within its expert's slot list — cumsum over
     # tokens (static shape; earlier tokens win slots, later ones drop).
@@ -146,12 +156,14 @@ def _route(layer, h, cfg: MoEConfig):
     return dispatch, combine, aux
 
 
-def _moe_mlp(layer, x, cfg: MoEConfig):
+def _moe_mlp(layer, x, cfg: MoEConfig, valid=None):
     """[B, S, d] → [B, S, d] through the routed expert FFN; also returns
-    the layer's aux loss."""
+    the layer's aux loss. `valid` ([B, S] bool or None) masks tokens
+    out of routing (see _route)."""
     b, s, d = x.shape
     h = rms_norm(x, layer["ln2"], cfg.norm_eps).reshape(b * s, d)
-    dispatch, combine, aux = _route(layer, h, cfg)
+    vflat = None if valid is None else valid.reshape(b * s)
+    dispatch, combine, aux = _route(layer, h, cfg, vflat)
     # Scatter to per-expert slots: ONE einsum, [E, C, d] activations.
     xe = jnp.einsum("tec,td->ecd", dispatch.astype(h.dtype), h)
     # Batched expert SwiGLU on the MXU (E stacked matmuls; sharded over
@@ -163,17 +175,29 @@ def _moe_mlp(layer, x, cfg: MoEConfig):
     return out.reshape(b, s, d), aux
 
 
-def forward_dense(params, cfg: MoEConfig, tokens):
-    """Dense causal forward. tokens: [B, S] int32 → (logits [B, S, V]
-    fp32, per-layer (k, v), total aux loss)."""
+def _forward_stack(params, cfg: MoEConfig, tokens, prefix_kvs=None):
+    """The decoder-stack loop shared by dense forward and prefix-cached
+    prefill (mirrors llama._forward_stack — same attention, routed
+    FFN): with `prefix_kvs` the positions shift by the prefix length
+    and each layer attends over prefix + suffix KV through the
+    rectangular flash kernel."""
     b, s = tokens.shape
+    prefix_len = 0 if prefix_kvs is None else prefix_kvs[0][0].shape[1]
     x = jnp.take(params["embed"], tokens, axis=0)
-    positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+    positions = jnp.broadcast_to(
+        prefix_len + jnp.arange(s)[None], (b, s)
+    )
     kvs = []
     aux_total = jnp.float32(0)
-    for layer in params["layers"]:
+    for li, layer in enumerate(params["layers"]):
         q, k, v = _llama._qkv(layer, x, cfg, positions)
-        attn = _llama.flash_prefill(q, k, v, causal=True)
+        if prefix_kvs is None:
+            k_full, v_full = k, v
+        else:
+            pk, pv = prefix_kvs[li]
+            k_full = jnp.concatenate([pk.astype(k.dtype), k], axis=1)
+            v_full = jnp.concatenate([pv.astype(v.dtype), v], axis=1)
+        attn = _llama.flash_prefill(q, k_full, v_full, causal=True)
         x = x + attn.reshape(b, s, -1) @ layer["wo"]
         moe_out, aux = _moe_mlp(layer, x, cfg)
         x = x + moe_out
@@ -184,9 +208,105 @@ def forward_dense(params, cfg: MoEConfig, tokens):
     return logits, kvs, aux_total
 
 
+def forward_dense(params, cfg: MoEConfig, tokens):
+    """Dense causal forward. tokens: [B, S] int32 → (logits [B, S, V]
+    fp32, per-layer (k, v), total aux loss)."""
+    return _forward_stack(params, cfg, tokens)
+
+
 def prefill(params, cfg: MoEConfig, tokens):
     logits, kvs, _ = forward_dense(params, cfg, tokens)
     return logits, kvs
+
+
+def prefill_with_prefix(params, cfg: MoEConfig, tokens, prefix_kvs):
+    """Suffix prefill over a cached prefix — the cache-HIT path, same
+    contract as llama.prefill_with_prefix (the serving engine calls it
+    through its model parameter)."""
+    logits, kvs, _ = _forward_stack(params, cfg, tokens, prefix_kvs)
+    return logits, kvs
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def decode_step(params, cfg: MoEConfig, token, seq_lens, k_pages, v_pages,
+                page_table):
+    """One paged decode step — llama.decode_step with the routed expert
+    FFN in place of the dense MLP (same KV page contract, so the store,
+    the pallas decode kernels and the serving engine work unchanged).
+
+    MIRROR CONTRACT: the paging/scatter/attention plumbing here and in
+    verify_step is a deliberate mirror of models/llama.py (the FFN call
+    is the only divergence) — any fix to llama's paging, scratch-page
+    or rollback logic MUST be applied here too; the MoE serving parity
+    suite (tests/test_moe.py) is the drift alarm."""
+    b = token.shape[0]
+    x = jnp.take(params["embed"], token[:, None], axis=0)  # [b, 1, d]
+    positions = seq_lens[:, None]
+    page_idx_in_seq = seq_lens // cfg.page_size
+    target_page = jnp.take_along_axis(
+        page_table, page_idx_in_seq[:, None], axis=1
+    )[:, 0]
+    slot = seq_lens % cfg.page_size
+    # Slots with an empty cache are the engine's inactive rows: keep
+    # their garbage tokens out of expert routing/capacity (best-effort
+    # — a previously-active slot's stale row may still route, but
+    # capacity() is sized for the full batch so it cannot evict real
+    # tokens unless the router is badly imbalanced).
+    valid = (seq_lens > 0)[:, None]  # [b, 1]
+
+    new_k_pages, new_v_pages = [], []
+    for li, layer in enumerate(params["layers"]):
+        q, k, v = _llama._qkv(layer, x, cfg, positions)
+        kp = _llama.scatter_kv_to_pages(k_pages[li], k, target_page, slot)
+        vp = _llama.scatter_kv_to_pages(v_pages[li], v, target_page, slot)
+        attn = _llama.paged_decode_attention(
+            q[:, 0], kp, vp, page_table, seq_lens + 1
+        )
+        x = x + attn.reshape(b, 1, -1) @ layer["wo"]
+        moe_out, _aux = _moe_mlp(layer, x, cfg, valid)
+        x = x + moe_out
+        new_k_pages.append(kp)
+        new_v_pages.append(vp)
+    x = rms_norm(x, params["final_ln"], cfg.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    return logits, jnp.stack(new_k_pages), jnp.stack(new_v_pages)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def verify_step(params, cfg: MoEConfig, tokens, seq_lens, k_pages,
+                v_pages, page_table, valid_len=None):
+    """m-token paged step (speculative verify / chunked prefill) —
+    llama.verify_step with the routed FFN; see that docstring for the
+    scratch-page and rollback contracts."""
+    b, m = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)  # [b, m, d]
+    positions = seq_lens[:, None] + jnp.arange(m)[None, :]
+    page_idx_in_seq = positions // cfg.page_size
+    target_page = jnp.take_along_axis(page_table, page_idx_in_seq, axis=1)
+    slot = positions % cfg.page_size
+    ok = None
+    if valid_len is not None:
+        ok = jnp.arange(m)[None, :] < valid_len[:, None]
+        target_page = jnp.where(ok, target_page, 0)
+        slot = jnp.where(ok, slot, jnp.arange(m)[None, :] % cfg.page_size)
+
+    new_k_pages, new_v_pages = [], []
+    for li, layer in enumerate(params["layers"]):
+        q, k, v = _llama._qkv(layer, x, cfg, positions)
+        kp = _llama.scatter_kv_multi(k_pages[li], k, target_page, slot)
+        vp = _llama.scatter_kv_multi(v_pages[li], v, target_page, slot)
+        attn = _llama.paged_verify_attention(
+            q, kp, vp, page_table, seq_lens
+        )
+        x = x + attn.reshape(b, m, -1) @ layer["wo"]
+        # Ragged padding + inactive rows stay out of expert capacity.
+        moe_out, _aux = _moe_mlp(layer, x, cfg, ok)
+        x = x + moe_out
+        new_k_pages.append(kp)
+        new_v_pages.append(vp)
+    x = rms_norm(x, params["final_ln"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, jnp.stack(new_k_pages), jnp.stack(new_v_pages)
 
 
 def loss_fn(params, cfg: MoEConfig, tokens):
@@ -241,6 +361,7 @@ def param_shardings(mesh: Mesh, params):
 
 
 __all__ = [
-    "MoEConfig", "init_params", "forward_dense", "prefill", "loss_fn",
+    "MoEConfig", "init_params", "forward_dense", "prefill",
+    "prefill_with_prefix", "decode_step", "verify_step", "loss_fn",
     "train_step", "make_ep_mesh", "param_shardings",
 ]

@@ -17,9 +17,9 @@ the store's primitives into end-to-end serving:
   hash chain over token ids, vLLM-style — see `content_page_keys`), so
   any request whose prompt extends a cached token prefix automatically
   restores those pages straight into the pool and prefills ONLY the
-  un-cached tail via the rectangular flash kernel
-  (models.llama.prefill_with_prefix) — no prefix recompute, no
-  caller-side sequence-id coordination.
+  un-cached tail via the rectangular flash kernel (the model family's
+  prefill_with_prefix) — no prefix recompute, no caller-side
+  sequence-id coordination.
 - **Offload on finish**: completed sequences' full pages go back to the
   store (first-writer-wins dedup makes repeats free), so the next request
   sharing the prompt — e.g. the next turn of the same conversation —
@@ -228,19 +228,20 @@ class _LazyHost:
         return self._host
 
 
-@partial(jax.jit, static_argnames=("cfg",))
-def _prefill_px_jit(params, cfg, tokens, prefix_kvs):
-    """Module-level prefix-HIT prefill jit (static cfg): every engine
-    with the same config shares one compilation — a per-engine
-    jax.jit(partial) would silently recompile identical HLO for each
-    new engine instance (measured: ~30 s per instance on the axon
-    tunnel). Cold admissions use _admit_fused instead."""
-    return llama.prefill_with_prefix(params, cfg, tokens, prefix_kvs)
+@partial(jax.jit, static_argnames=("cfg", "model"))
+def _prefill_px_jit(params, cfg, tokens, prefix_kvs, model=llama):
+    """Module-level prefix-HIT prefill jit (static cfg + model family):
+    every engine with the same config shares one compilation — a
+    per-engine jax.jit(partial) would silently recompile identical HLO
+    for each new engine instance (measured: ~30 s per instance on the
+    axon tunnel). Cold admissions use _admit_fused instead."""
+    return model.prefill_with_prefix(params, cfg, tokens, prefix_kvs)
 
 
-@partial(jax.jit, static_argnames=("cfg", "n_steps"), donate_argnums=(4, 5))
+@partial(jax.jit, static_argnames=("cfg", "n_steps", "model"),
+         donate_argnums=(4, 5))
 def _decode_scan(params, cfg, token, seq_lens, k_pages, v_pages, rows,
-                 n_steps):
+                 n_steps, model=llama):
     """`n_steps` greedy decode steps fused into one device program
     (lax.scan) — multi-step host scheduling (the vLLM
     --num-scheduler-steps idea, TPU-native): ONE dispatch and ONE tiny
@@ -248,10 +249,10 @@ def _decode_scan(params, cfg, token, seq_lens, k_pages, v_pages, rows,
     latency that would otherwise bound decode (on dispatch-expensive
     links by ~n_steps; on local hosts it hides the Python bookkeeping).
     Bit-identical to n_steps repeated single fused steps — the scan
-    body IS llama.decode_step."""
+    body IS the model family's decode_step."""
     def body(carry, _):
         token, lens, kp, vp = carry
-        logits, kp, vp = llama.decode_step(
+        logits, kp, vp = model.decode_step(
             params, cfg, token, lens, kp, vp, rows
         )
         token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -263,8 +264,9 @@ def _decode_scan(params, cfg, token, seq_lens, k_pages, v_pages, rows,
     return toks.T, lens, kp, vp  # [batch, n_steps]
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3, 4))
-def _admit_fused(params, cfg, tokens, k_pages, v_pages, ids, s_real):
+@partial(jax.jit, static_argnames=("cfg", "model"), donate_argnums=(3, 4))
+def _admit_fused(params, cfg, tokens, k_pages, v_pages, ids, s_real,
+                 model=llama):
     """Cold-prefill admission as ONE device program: prefill + page the
     suffix KV + scatter it into the (donated) pool at `ids` + slice the
     last real position's logits row. The unfused path was ~10 dispatches
@@ -276,7 +278,7 @@ def _admit_fused(params, cfg, tokens, k_pages, v_pages, ids, s_real):
     can ever fill, and partial pages are never offloaded, so the bytes
     are unreachable. `ids` is padded with total_pages (mode=drop).
     tokens: [1, s_pad] (page multiple); ids: [max_pages_per_seq]."""
-    logits, kvs = llama.prefill(params, cfg, tokens)
+    logits, kvs = model.prefill(params, cfg, tokens)
     page = cfg.page_size
     n = tokens.shape[1] // page
     k_sfx = jnp.stack([k[0] for k, _ in kvs])  # [L, s_pad, kv, hd]
@@ -290,8 +292,9 @@ def _admit_fused(params, cfg, tokens, k_pages, v_pages, ids, s_real):
     return logits[0, s_real - 1], k_pages, v_pages
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(4, 5))
-def _decode_fused(params, cfg, token, seq_lens, k_pages, v_pages, rows):
+@partial(jax.jit, static_argnames=("cfg", "model"), donate_argnums=(4, 5))
+def _decode_fused(params, cfg, token, seq_lens, k_pages, v_pages, rows,
+                  model=llama):
     """One fused device program per decode step: model forward + argmax
     + seq_lens advance, with the KV pools DONATED (the functional
     .at[].set() update aliases in place instead of copying the whole
@@ -302,7 +305,7 @@ def _decode_fused(params, cfg, token, seq_lens, k_pages, v_pages, rows):
     update in-place; on dispatch-expensive links (the axon tunnel's
     ~70 ms/call) it collapses ~6 host API calls per step into one
     dispatch + one tiny D2H."""
-    logits, k_pages, v_pages = llama.decode_step(
+    logits, k_pages, v_pages = model.decode_step(
         params, cfg, token, seq_lens, k_pages, v_pages, rows
     )
     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -320,7 +323,10 @@ def _write_pages(k_pool, v_pool, ids, k_new, v_new):
 
 
 class ServingEngine:
-    """Continuous-batching engine serving `models.llama` over the store.
+    """Continuous-batching engine over the store, serving any model
+    family that exposes the shared surface (models.llama, models.moe —
+    prefill / prefill_with_prefix / decode_step / verify_step over the
+    common KV page contract; pass it as `model`).
 
     `store` is a TpuKVStore (or None for store-less serving). Decoding
     is greedy by default; per-request seeded temperature/top-k sampling
@@ -331,9 +337,14 @@ class ServingEngine:
     """
 
     def __init__(self, params, cfg: llama.LlamaConfig, sconfig=None,
-                 store=None, proposer=None):
+                 store=None, proposer=None, model=llama):
         self.params = params
         self.cfg = cfg
+        # The model family: any module exposing the llama serving
+        # surface (prefill, prefill_with_prefix, decode_step,
+        # verify_step over the shared KV page contract) — models.moe
+        # is the second family. Fused jits key on it statically.
+        self.model = model
         self.sc = sconfig or ServingConfig()
         self.store = store
         self.proposer = proposer if proposer is not None \
@@ -366,7 +377,8 @@ class ServingEngine:
         self._store_ok = True
         # Cold admissions ride _admit_fused; the prefix-HIT suffix
         # prefill keeps the shared module-level jit.
-        self._prefill_px = partial(_prefill_px_jit, params, cfg)
+        self._prefill_px = partial(_prefill_px_jit, params, cfg,
+                                   model=model)
         # Steady-state decode device cache: (key, token_dev, lens_dev,
         # rows_dev) left by the previous fused step. While the active
         # set, page tables and emitted tokens are exactly what the
@@ -598,6 +610,7 @@ class ServingEngine:
             row_dev, self.k_pages, self.v_pages = _admit_fused(
                 self.params, cfg, toks, self.k_pages, self.v_pages,
                 jnp.asarray(self._pad_ids(ids)), jnp.asarray(s_real),
+                model=self.model,
             )
             row_host = np.asarray(row_dev)
         else:
@@ -865,6 +878,7 @@ class ServingEngine:
             toks_dev, lens_next, self.k_pages, self.v_pages = _decode_scan(
                 self.params, self.cfg, token_dev, lens_dev,
                 self.k_pages, self.v_pages, rows_dev, k,
+                model=self.model,
             )
             toks = np.asarray(toks_dev)  # [B, k] — the one D2H
             trimmed = False
@@ -892,7 +906,7 @@ class ServingEngine:
         logits, nxt_dev, lens_next, self.k_pages, self.v_pages = (
             _decode_fused(
                 self.params, self.cfg, token_dev, lens_dev,
-                self.k_pages, self.v_pages, rows_dev,
+                self.k_pages, self.v_pages, rows_dev, model=self.model,
             )
         )
         nxt = np.asarray(nxt_dev)
@@ -938,7 +952,7 @@ class ServingEngine:
         ]
         if not active:
             return [], None, None
-        logits, self.k_pages, self.v_pages = llama.verify_step(
+        logits, self.k_pages, self.v_pages = self.model.verify_step(
             self.params, self.cfg,
             jnp.asarray(token), jnp.asarray(seq_lens),
             self.k_pages, self.v_pages, jnp.asarray(rows),
