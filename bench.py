@@ -1,6 +1,20 @@
 """Round benchmark: KV put/get throughput through the store (+ TPU staging).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints the cumulative result JSON line after EVERY completed leg (flushed),
+so the LAST line of output is always the most complete result: {"metric",
+"value", "unit", "vs_baseline", ...}. A driver that kills this process at
+any point still finds a valid, parseable line in the tail — round 4's
+artifact was lost (rc 124, empty tail) because the single end-of-run print
+sat behind worst-case subprocess caps summing to ~2,740 s while the axon
+tunnel was wedged. Every line printed has the same schema; later lines
+strictly extend earlier ones.
+
+A global wall-clock budget (BENCH_BUDGET_S, default 1200 s — full runs
+historically finish in ~6-10 min; the driver's own cap is larger) bounds
+the whole run: once exceeded, remaining legs are skipped with
+``<leg>_skipped`` markers instead of blocking on their subprocess caps,
+and each subprocess timeout is clipped to the remaining budget. CPU legs
+run first so the primary metric never waits on the tunnel.
 
 Primary metric (BASELINE.json config 2): bulk put+get throughput of
 4 KB x 4096 keys, single client <-> CPU-hosted server over the same-host
@@ -283,6 +297,74 @@ def bench_raw_tcp(total_bytes=64 << 20, chunk=256 << 10, passes=2,
         t.join(5)
         best = dt if best is None else min(best, dt)
     return round(total_bytes / (1 << 30) / best, 3)
+
+
+def bench_stream_shaped(port, rtt_ms=4.0, bw_mib_s=256.0, nkeys=512,
+                        block_kb=64, passes=2):
+    """STREAM flow control at a real bandwidth-delay product (VERDICT r4
+    item 4). The reference's remote path is validated on real verbs
+    hardware (reference: infinistore/test_infinistore.py:65-70); this
+    host has no DCN, so a userspace shaping relay injects rtt_ms of
+    round-trip latency and a per-direction bandwidth cap between client
+    and server, and the leg reports the fraction of the shaped link the
+    windowed pipeline sustains. BDP here = 256 MiB/s * 2 ms one-way
+    ~= 0.5 MiB in flight — far below the client's 64 MiB inflight window
+    (native/src/common.h DEFAULT_WINDOW_BYTES), so a pipelined client
+    should reach ~1.0 of the cap while a stop-and-wait design would get
+    total/(batches*RTT). 64 KiB blocks are the realistic KV-page size.
+    The cap (256 MiB/s) is set well below this 1-core host's unshaped
+    relay capacity so the shaping, not CPU contention, is the binding
+    constraint."""
+    import numpy as np
+
+    from infinistore_tpu import ClientConfig, InfinityConnection
+    from infinistore_tpu.utils.netshaper import ShapingRelay
+
+    bps = bw_mib_s * (1 << 20)
+    with ShapingRelay(port, rtt_ms=rtt_ms, bandwidth_bps=bps) as relay:
+        conn = InfinityConnection(
+            ClientConfig(host_addr="127.0.0.1", service_port=relay.port,
+                         connection_type="STREAM")
+        )
+        conn.connect()
+        try:
+            block_bytes = block_kb << 10
+            total = nkeys * block_bytes
+            src = np.random.default_rng(9).integers(
+                0, 255, total, dtype=np.uint8
+            )
+            dst = np.zeros_like(src)
+            t_put = t_get = None
+            for it in range(passes):
+                keys = [f"shaped{it}_{i}" for i in range(nkeys)]
+                offs = [i * block_bytes for i in range(nkeys)]
+                pairs = list(zip(keys, offs))
+                t0 = time.perf_counter()
+                blocks = conn.allocate(keys, block_bytes)
+                conn.write_cache(src, offs, block_bytes, blocks)
+                conn.sync()
+                t = time.perf_counter() - t0
+                t_put = t if t_put is None else min(t_put, t)
+                dst[:] = 0
+                t0 = time.perf_counter()
+                conn.read_cache(dst, pairs, block_bytes)
+                conn.sync()
+                t = time.perf_counter() - t0
+                t_get = t if t_get is None else min(t_get, t)
+                assert np.array_equal(src, dst), "shaped verification failed"
+            link_gbps = bps / (1 << 30)
+            put_gbps = total / (1 << 30) / t_put
+            get_gbps = total / (1 << 30) / t_get
+            return {
+                "stream_rtt_ms": rtt_ms,
+                "stream_rtt_cap_GBps": round(link_gbps, 3),
+                "stream_rtt_put_GBps": round(put_gbps, 3),
+                "stream_rtt_get_GBps": round(get_gbps, 3),
+                "stream_rtt_put_frac": round(put_gbps / link_gbps, 2),
+                "stream_rtt_get_frac": round(get_gbps / link_gbps, 2),
+            }
+        finally:
+            conn.close()
 
 
 def bench_overlap(port):
@@ -1122,6 +1204,42 @@ def main():
             print(json.dumps({"overlap_error": str(e)[:200]}))
         return 0
 
+    import os
+
+    # Global wall-clock budget: the run must finish (or degrade to
+    # *_skipped markers) well inside the driver's external timeout. Full
+    # healthy runs take ~6-10 min; 1200 s absorbs a slow-compile window
+    # without ever letting worst-case subprocess caps stack up to the
+    # 2,740 s that zeroed BENCH_r04.
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "1200"))
+    t_start = time.monotonic()
+
+    def remaining():
+        return budget_s - (time.monotonic() - t_start)
+
+    out = {
+        "metric": "kv_put_get_4KBx4096_agg_throughput",
+        "value": 0.0,
+        "unit": "GB/s",
+        "vs_baseline": 0.0,  # nominal 1 GB/s target; see module docstring
+    }
+
+    def publish():
+        # Cumulative line after every leg: the tail of stdout is always
+        # a complete, parseable artifact even if the process is killed.
+        print(json.dumps(out), flush=True)
+
+    def gated_leg(flag, err_key, cap):
+        """Budget-aware subprocess leg: skip (with a marker) when the
+        budget is nearly gone, else clip the cap to what remains."""
+        rem = remaining()
+        leg = err_key.rsplit("_", 1)[0]
+        if rem < 90:
+            return {f"{leg}_skipped": f"budget exhausted ({rem:.0f}s left)"}
+        return bench_subprocess(
+            flag, port, err_key, timeout_s=min(cap, max(60, rem - 15))
+        )
+
     # 4 KB pool blocks match the 4 KB page workload: batch allocations
     # land contiguously (iovec merges on STREAM, single zero-copy pool
     # views on SHM — measured +7% STREAM agg vs 16 KB blocks) and pool
@@ -1139,11 +1257,18 @@ def main():
     )
     port = srv.start()
     try:
-        store_res = bench_store(port, block_kb=4, nkeys=4096)
+        try:
+            store_res = bench_store(port, block_kb=4, nkeys=4096)
+            out["value"] = out["vs_baseline"] = store_res["agg_GBps"]
+            out.update(store_res)
+        except Exception as e:
+            out["store_error"] = str(e)[:200]
+        publish()
         srv.purge()
         # DCN stand-in numbers: the same workload forced over the framed
         # TCP path (what cross-host clients use). Secondary leg — a
         # failure here must not discard the primary metric.
+        stream_res = {}
         try:
             stream_res = bench_store(
                 port, block_kb=4, nkeys=4096, ctype="STREAM"
@@ -1177,49 +1302,48 @@ def main():
                 )
         except Exception as e:
             stream_res["raw_tcp_error"] = str(e)[:200]
-        srv.purge()
-        overlap_res = bench_subprocess(
-            "--overlap-leg", port, "overlap_error", timeout_s=240
+        out.update(
+            {f"stream_{k}": v for k, v in stream_res.items() if k != "path"}
         )
+        publish()
         srv.purge()
-        tpu_res = bench_subprocess(
-            "--tpu-leg", port, "tpu_error", timeout_s=900
-        )
+        # STREAM through a latency/bandwidth-shaping relay: flow-control
+        # proof at a real bandwidth-delay product (CPU-only, cheap).
+        try:
+            out.update(bench_stream_shaped(port))
+        except Exception as e:
+            out["stream_rtt_error"] = str(e)[:200]
+        publish()
+        srv.purge()
+        # Sharded leg is CPU-only: run it BEFORE any tunnel-bound leg so
+        # a wedged tunnel can never cost it (it boots its own servers;
+        # the idle primary server costs nothing meanwhile).
+        try:
+            out.update(bench_sharded())
+        except Exception as e:
+            out["sharded_error"] = str(e)[:200]
+        publish()
+        out.update(gated_leg("--overlap-leg", "overlap_error", 240))
+        publish()
+        srv.purge()
+        # Per-leg caps stay GENEROUS (a leg was once lost to a 480 s cap
+        # in a slow-compile window); the global budget, not the caps,
+        # bounds the worst-case total — gated_leg clips each cap to the
+        # remaining budget, so wide caps can no longer stack up to the
+        # 2,740 s that zeroed BENCH_r04.
+        out.update(gated_leg("--tpu-leg", "tpu_error", 900))
+        publish()
         # Model-scale MFU/HBM-util + real-engine-loop legs: separate
         # subprocesses, AFTER the transfer legs — the engine's per-step
         # D2H would otherwise degrade the tunnel's H2D for everything
         # that follows (BASELINE.md), and the engine leg is the most
         # compile-heavy so its timeout must not cost the MFU numbers.
-        # Generous timeouts: the tunnel has slow-compile windows where
-        # an entire leg lost to a 480s cap (observed in one full run).
-        mfu_res = bench_subprocess(
-            "--mfu-leg", port, "mfu_error", timeout_s=900
-        )
-        engine_res = bench_subprocess(
-            "--engine-leg", port, "engine_error", timeout_s=700
-        )
+        out.update(gated_leg("--mfu-leg", "mfu_error", 900))
+        publish()
+        out.update(gated_leg("--engine-leg", "engine_error", 700))
     finally:
         srv.stop()
-    try:
-        sharded_res = bench_sharded()
-    except Exception as e:
-        sharded_res = {"sharded_error": str(e)[:200]}
-
-    value = store_res["agg_GBps"]
-    out = {
-        "metric": "kv_put_get_4KBx4096_agg_throughput",
-        "value": value,
-        "unit": "GB/s",
-        "vs_baseline": value,  # nominal 1 GB/s target; see module docstring
-        **store_res,
-        **{f"stream_{k}": v for k, v in stream_res.items() if k != "path"},
-        **sharded_res,
-        **overlap_res,
-        **tpu_res,
-        **mfu_res,
-        **engine_res,
-    }
-    print(json.dumps(out))
+    publish()
     return 0
 
 

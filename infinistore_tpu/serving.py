@@ -256,7 +256,12 @@ def _decode_scan(params, cfg, token, seq_lens, k_pages, v_pages, rows,
             params, cfg, token, lens, kp, vp, rows
         )
         token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return (token, lens + 1, kp, vp), token
+        # Advance only live rows: inactive slots (lens == 0) must stay
+        # at 0 across steady-state cache reuse, or MoE decode_step's
+        # validity mask (models/moe.py `valid = seq_lens > 0`) stops
+        # excluding them and garbage rows can evict real tokens from
+        # expert capacity (round-4 advisor finding).
+        return (token, lens + (lens > 0), kp, vp), token
 
     (token, lens, kp, vp), toks = jax.lax.scan(
         body, (token, seq_lens, k_pages, v_pages), None, length=n_steps
@@ -309,7 +314,8 @@ def _decode_fused(params, cfg, token, seq_lens, k_pages, v_pages, rows,
         params, cfg, token, seq_lens, k_pages, v_pages, rows
     )
     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return logits, nxt, seq_lens + 1, k_pages, v_pages
+    # Live-rows-only advance — see _decode_scan's body comment.
+    return logits, nxt, seq_lens + (seq_lens > 0), k_pages, v_pages
 
 
 @partial(jax.jit, donate_argnums=(0, 1))

@@ -120,7 +120,7 @@ class ServingHTTPServer:
                     return
                 if not stream:
                     while True:
-                        item = st.queue.get()
+                        item = outer._next_item(rid, st)
                         if item is _DONE:
                             break
                     self._json(200, outer._result(rid, st))
@@ -139,7 +139,7 @@ class ServingHTTPServer:
                     self.wfile.flush()
 
                 while True:
-                    item = st.queue.get()
+                    item = outer._next_item(rid, st)
                     if item is _DONE:
                         break
                     chunk({"token": item})
@@ -162,12 +162,8 @@ class ServingHTTPServer:
             raise ValueError("empty prompt")
         if kw.get("max_new_tokens", 16) < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if self._broken:
-            raise ValueError("engine is down")
         rid = uuid.uuid4().hex[:16]
         st = _ReqState()
-        with self._lock:
-            self._reqs[rid] = st
 
         def on_token(_rid, tok):
             if st.first_t is None:
@@ -175,9 +171,41 @@ class ServingHTTPServer:
             st.n_tokens += 1
             st.queue.put(int(tok))
 
-        self._submit.put((rid, Request(rid, prompt, on_token=on_token,
-                                       **kw)))
+        req = Request(rid, prompt, on_token=on_token, **kw)
+        # Register and enqueue under ONE lock hold, with the _broken
+        # check inside it: the engine's failure path flips _broken and
+        # snapshots _reqs under the same lock, so every request is
+        # either (a) registered before the flip — in the snapshot, gets
+        # failed — or (b) sees _broken and is rejected here. Without
+        # this a request registering between the flip and the snapshot
+        # would hang its handler forever (round-4 advisor finding).
+        with self._lock:
+            if self._broken:
+                raise ValueError("engine is down")
+            self._reqs[rid] = st
+            self._submit.put((rid, req))
         return rid, st
+
+    def _next_item(self, rid, st):
+        """Handler-side dequeue with a liveness backstop: if the engine
+        died (or the server is shutting down) and this request somehow
+        missed its failure delivery, bail out as done instead of
+        blocking the HTTP thread forever. The bail path also retires
+        the request from the in-flight map — _finish_req never ran for
+        it, and a leaked entry would inflate requests_inflight forever
+        (the map's documented O(in-flight) contract)."""
+        while True:
+            try:
+                return st.queue.get(timeout=1.0)
+            except queue.Empty:
+                if self._broken or self._stop.is_set():
+                    if st.done_t is None:
+                        st.done_t = time.perf_counter()
+                    if st.tokens is None:
+                        st.tokens = []
+                    with self._lock:
+                        self._reqs.pop(rid, None)
+                    return _DONE
 
     def _result(self, rid, st):
         ttft = (st.first_t - st.submit_t) * 1e3 if st.first_t else None
@@ -260,9 +288,20 @@ class ServingHTTPServer:
                     # cleanly — fail every waiting client instead of
                     # leaving them blocked on silent queues, and refuse
                     # new work (/stats reports engine_ok: false).
-                    self._broken = True
+                    # _broken flips under the SAME lock submit_request
+                    # registers under, so the pending snapshot is
+                    # complete: late submitters see _broken and get a
+                    # 400; everyone else is in the snapshot. The _submit
+                    # queue is then drained for hygiene — every entry in
+                    # it is also in the snapshot.
                     with self._lock:
+                        self._broken = True
                         pending = list(self._reqs.items())
+                    while True:
+                        try:
+                            self._submit.get_nowait()
+                        except queue.Empty:
+                            break
                     for rid, st in pending:
                         self._finish_req(rid, st, [])
                     return

@@ -207,13 +207,22 @@ class TpuKVStore:
         """Store [(key, array)] pairs. Arrays may be jax.Arrays (device)
         or numpy arrays (host); each array becomes one page.
 
-        Writes are pipelined straight from each array's host buffer
-        (no staging copy): with ``sync=False`` do NOT mutate a numpy
-        input until :meth:`InfinityConnection.sync` — the same
-        post-until-sync contract as ``write_cache``."""
+        Aliasing: callers may mutate their input arrays as soon as this
+        returns. Device arrays write from the fresh D2H buffer; a numpy
+        input on the ``sync=False`` path is privately copied first —
+        this convenience surface keeps the historical copy semantics
+        rather than silently adopting write_cache's post-until-sync
+        contract (round-4 advisor finding). The zero-staging-copy
+        offload path is :meth:`put_kv_pages`, whose pipelined contract
+        is documented there."""
         if not items:
             return
-        host = [(k, _to_host(a)) for k, a in items]
+        host = []
+        for k, a in items:
+            h = _to_host(a)
+            if not sync and h is a:
+                h = h.copy()  # caller-owned numpy buffer: detach from it
+            host.append((k, h))
         # Group by nbytes so each allocate/write batch has a uniform page
         # size (protocol pages are uniform per request).
         by_size = {}
@@ -268,6 +277,12 @@ class TpuKVStore:
         stored under keys[i]. One allocate + one write round-trip for the
         whole batch (the reference's batched multi-block op,
         lib.py:439-475).
+
+        Aliasing (the zero-staging-copy offload path): a device input
+        writes from its fresh D2H buffer; a NUMPY input is written
+        in-place, pipelined — with ``sync=False`` do not mutate it until
+        :meth:`InfinityConnection.sync`, the same post-until-sync
+        contract as ``write_cache``.
         """
         host = _to_host(pages)
         n = host.shape[0]
