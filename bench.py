@@ -691,6 +691,192 @@ def bench_mfu(port):
         return res
 
 
+def bench_big(port):
+    """HBM-filling flagship leg (VERDICT r4 item 3): decode + the REAL
+    serving engine at ~6.4B bf16 params — ~12.7 GB of weights on the
+    16 GB v5e, the regime the store exists for, instead of the 1.3 B
+    (16% of the chip) continuity config. Llama-3-8B itself cannot fit:
+    8.03 B params x 2 B = 16.06 GB > the chip's 16 GB before KV pool or
+    XLA workspace — the honest ceiling for a bf16 single-chip flagship
+    is ~6.5 B (BASELINE.md configs 3-4 discussion).
+
+    Runs in its own subprocess (it owns nearly all of HBM while alive);
+    ordering puts it before the 1.3 B continuity leg so a shrinking
+    budget drops the old numbers before the headline ones."""
+    res = {}
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        try:
+            res.update(_bench_decode_big(dev))
+        except Exception as e:
+            res["decode7b_error"] = str(e)[:200]
+        try:
+            res.update(_bench_engine_big(dev, port))
+        except Exception as e:
+            res["engine7b_error"] = str(e)[:200]
+        return res
+    except Exception as e:
+        res["big_error"] = str(e)[:200]
+        return res
+
+
+def _big_cfg():
+    from infinistore_tpu.models import llama
+
+    # Llama-3-8B geometry (d_model 4096, GQA 32/8, d_ff 14336) at 28
+    # layers instead of 32: 28 x 218.1M + 2 x 134.2M = 6.37 B params =
+    # 12.75 GB bf16 — the largest of this family that leaves room for a
+    # KV pool + XLA workspace on 16 GB (32 layers = 7.25 B = 14.5 GB
+    # weights would leave < 1.5 GB for everything else; full Llama-3-8B
+    # adds untied embeddings and does not fit at all).
+    return llama.LlamaConfig(
+        vocab_size=32768, d_model=4096, n_layers=28, n_heads=32,
+        n_kv_heads=8, d_ff=14336, max_seq=512, page_size=16,
+    )
+
+
+def _bench_decode_big(dev, batch=8, max_pages=12, seq0=160):
+    """Fused-scan paged decode with the weight stream filling HBM:
+    bytes/step ~= 12.7 GB, so step time directly measures achieved HBM
+    bandwidth (same accounting formulas as _bench_decode_1b)."""
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from infinistore_tpu.models import llama
+
+    cfg = _big_cfg()
+    with jax.default_device(dev):
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        n_params = sum(
+            int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
+        )
+        kv_shape = (cfg.n_layers, batch * max_pages, cfg.page_size,
+                    cfg.n_kv_heads, cfg.head_dim)
+        k_pages = jnp.zeros(kv_shape, dtype=cfg.jdtype)
+        v_pages = jnp.zeros_like(k_pages)
+        page_table = jnp.arange(
+            batch * max_pages, dtype=jnp.int32
+        ).reshape(batch, max_pages)
+        token0 = jnp.zeros((batch,), jnp.int32)
+        lens0 = jnp.full((batch,), seq0, jnp.int32)
+
+        many_steps_n = _make_decode_scan(llama, cfg, page_table)
+
+        def build(n):
+            local = jax.jit(
+                lambda p, t, l, kp, vp: many_steps_n(p, t, l, kp, vp, n)
+            )
+            return lambda: np.asarray(
+                local(params, token0, lens0, k_pages, v_pages)
+            )
+
+        n_short, n_long = 8, 24
+        step_s = _slope_time(build, n_short, n_long, reps=2)
+
+        mm_params = n_params - cfg.vocab_size * cfg.d_model
+        s_avg = seq0 + n_short / 2
+        attn_flops = (
+            4 * cfg.n_layers * batch * s_avg
+            * cfg.n_kv_heads * cfg.head_dim * (cfg.n_heads // cfg.n_kv_heads)
+        )
+        flops = 2 * mm_params * batch + attn_flops
+        kv_bytes = (
+            cfg.n_layers * batch * s_avg
+            * cfg.n_kv_heads * cfg.head_dim * 2 * 2
+        )
+        bytes_step = 2 * n_params + kv_bytes
+        out = {
+            "decode7b_params_b": round(n_params / 1e9, 3),
+            "decode7b_step_ms": round(step_s * 1e3, 3),
+            "decode7b_tok_s": round(batch / step_s, 1),
+            "decode7b_mfu_pct": round(
+                100 * flops / step_s / V5E_PEAK_BF16_FLOPS, 2
+            ),
+            "decode7b_hbm_util_pct": round(
+                100 * bytes_step / step_s / V5E_HBM_BPS, 1
+            ),
+        }
+        # Free the KV pools + params before the engine leg re-allocates
+        # at the same scale (two 12.7 GB weight sets cannot coexist).
+        del k_pages, v_pages, params, token0, lens0, page_table
+        gc.collect()
+        return out
+
+
+def _bench_engine_big(dev, port, n_reqs=6, prompt_len=64, new_tokens=24):
+    """The REAL ServingEngine at the HBM-filling scale, under genuine
+    page-pool pressure: total_pages holds ~half the working set, so the
+    run exercises admission, page growth, PREEMPTION and store offload/
+    restore (through the attached store server) at 6.4 B — the engine
+    behaviors the store exists for, which the 84M loop (bench_engine)
+    can only exercise kinematically."""
+    import gc
+
+    import jax
+    import numpy as np
+
+    from infinistore_tpu import ClientConfig, InfinityConnection
+    from infinistore_tpu.models import llama
+    from infinistore_tpu.serving import Request, ServingConfig, ServingEngine
+    from infinistore_tpu.tpu import TpuKVStore
+
+    cfg = _big_cfg()
+    conn = InfinityConnection(
+        ClientConfig(host_addr="127.0.0.1", service_port=port)
+    )
+    conn.connect()
+    try:
+        with jax.default_device(dev):
+            params = llama.init_params(jax.random.PRNGKey(0), cfg)
+            pages_per_seq = -(-(prompt_len + new_tokens) // cfg.page_size)
+            sc = ServingConfig(
+                max_slots=4,
+                # ~half the total working set: forces preemption +
+                # store offload while still letting slots make progress.
+                total_pages=(n_reqs * pages_per_seq) // 2,
+                max_pages_per_seq=pages_per_seq + 1,
+            )
+            store = TpuKVStore(conn)
+            eng = ServingEngine(params, cfg, sc, store=store)
+            rng = np.random.default_rng(11)
+            for i in range(n_reqs):
+                eng.submit(Request(
+                    f"big{i}",
+                    [int(t) for t in rng.integers(0, cfg.vocab_size,
+                                                  prompt_len)],
+                    max_new_tokens=new_tokens,
+                ))
+            t0 = time.perf_counter()
+            eng.step()  # admission wave (+ first decode) — compiles here
+            t_admit = time.perf_counter() - t0
+            steps0 = eng.stats["decode_steps"]
+            t1 = time.perf_counter()
+            while eng.queue or any(s is not None for s in eng.slots):
+                eng.step()
+            t_dec = time.perf_counter() - t1
+            toks = eng.stats["decoded_tokens"]
+            dsteps = max(1, eng.stats["decode_steps"] - steps0)
+            out = {
+                "engine7b_tok_s": round(toks / (t_admit + t_dec), 1),
+                "engine7b_step_ms": round(t_dec / dsteps * 1e3, 3),
+                "engine7b_decoded": toks,
+                "engine7b_preemptions": eng.stats["preemptions"],
+                "engine7b_offloaded_pages": eng.stats["offloaded_pages"],
+                "engine7b_restored_pages": eng.stats["restored_pages"],
+                "engine7b_store_errors": eng.stats["store_errors"],
+            }
+            del eng, params, store
+            gc.collect()
+            return out
+    finally:
+        conn.close()
+
+
 def bench_engine(port):
     """The real-engine-loop leg, in ITS OWN subprocess: it is the most
     compile-heavy leg (three engine instances), and the tunnel has slow
@@ -1192,6 +1378,10 @@ def main():
         port = int(sys.argv[sys.argv.index("--mfu-leg") + 1])
         print(json.dumps(bench_mfu(port)))
         return 0
+    if "--big-leg" in sys.argv:
+        port = int(sys.argv[sys.argv.index("--big-leg") + 1])
+        print(json.dumps(bench_big(port)))
+        return 0
     if "--engine-leg" in sys.argv:
         port = int(sys.argv[sys.argv.index("--engine-leg") + 1])
         print(json.dumps(bench_engine(port)))
@@ -1236,8 +1426,9 @@ def main():
         leg = err_key.rsplit("_", 1)[0]
         if rem < 90:
             return {f"{leg}_skipped": f"budget exhausted ({rem:.0f}s left)"}
+        # rem >= 90 here, so every dispatched leg gets at least 75 s.
         return bench_subprocess(
-            flag, port, err_key, timeout_s=min(cap, max(60, rem - 15))
+            flag, port, err_key, timeout_s=min(cap, rem - 15)
         )
 
     # 4 KB pool blocks match the 4 KB page workload: batch allocations
@@ -1332,6 +1523,11 @@ def main():
         # remaining budget, so wide caps can no longer stack up to the
         # 2,740 s that zeroed BENCH_r04.
         out.update(gated_leg("--tpu-leg", "tpu_error", 900))
+        publish()
+        # HBM-filling flagship (6.4 B decode + engine-under-pressure):
+        # the round-5 headline — it runs BEFORE the 1.3 B continuity
+        # legs so a shrinking budget drops old numbers, not new ones.
+        out.update(gated_leg("--big-leg", "big_error", 900))
         publish()
         # Model-scale MFU/HBM-util + real-engine-loop legs: separate
         # subprocesses, AFTER the transfer legs — the engine's per-step

@@ -190,10 +190,10 @@ class ServingHTTPServer:
         """Handler-side dequeue with a liveness backstop: if the engine
         died (or the server is shutting down) and this request somehow
         missed its failure delivery, bail out as done instead of
-        blocking the HTTP thread forever. The bail path also retires
-        the request from the in-flight map — _finish_req never ran for
-        it, and a leaked entry would inflate requests_inflight forever
-        (the map's documented O(in-flight) contract)."""
+        blocking the HTTP thread forever. The bail path retires the
+        request from the in-flight map AND folds it into the served
+        aggregates — _finish_req never ran for it, and a request must
+        not vanish from both requests_inflight and requests_done."""
         while True:
             try:
                 return st.queue.get(timeout=1.0)
@@ -204,7 +204,8 @@ class ServingHTTPServer:
                     if st.tokens is None:
                         st.tokens = []
                     with self._lock:
-                        self._reqs.pop(rid, None)
+                        if self._reqs.pop(rid, None) is not None:
+                            self._fold_locked(st)
                     return _DONE
 
     def _result(self, rid, st):
@@ -217,6 +218,19 @@ class ServingHTTPServer:
             "tok_s": round(st.n_tokens / dur, 1) if dur > 0 else None,
         }
 
+    def _fold_locked(self, st):
+        """Fold one finished request into the running aggregates.
+        Caller holds self._lock and has already popped it from _reqs."""
+        a = self._agg
+        a["done"] += 1
+        if st.first_t is not None:
+            ttft = (st.first_t - st.submit_t) * 1e3
+            a["ttft_sum"] += ttft
+            a["ttft_max"] = max(a["ttft_max"], ttft)
+        if st.done_t > st.submit_t:
+            a["tok_s_sum"] += st.n_tokens / (st.done_t - st.submit_t)
+            a["tok_s_n"] += 1
+
     def _finish_req(self, rid, st, tokens):
         """Deliver a completion and fold its metrics into the running
         aggregates; the _ReqState leaves _reqs so server memory and
@@ -224,16 +238,8 @@ class ServingHTTPServer:
         st.tokens = tokens
         st.done_t = time.perf_counter()
         with self._lock:
-            self._reqs.pop(rid, None)
-            a = self._agg
-            a["done"] += 1
-            if st.first_t is not None:
-                ttft = (st.first_t - st.submit_t) * 1e3
-                a["ttft_sum"] += ttft
-                a["ttft_max"] = max(a["ttft_max"], ttft)
-            if st.done_t > st.submit_t:
-                a["tok_s_sum"] += st.n_tokens / (st.done_t - st.submit_t)
-                a["tok_s_n"] += 1
+            if self._reqs.pop(rid, None) is not None:
+                self._fold_locked(st)
         st.queue.put(_DONE)
 
     def stats(self):

@@ -132,6 +132,7 @@ class ShapingRelay:
         self._lsock = None
         self._accept_thread = None
         self._conns = []
+        self._conns_lock = threading.Lock()
         self._stop = threading.Event()
 
     def start(self) -> int:
@@ -165,13 +166,22 @@ class ShapingRelay:
                 continue
             for s in (cli, up):
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # Register BEFORE starting the pipes, under the lock stop()
+            # iterates with: a connection accepted concurrently with
+            # stop() must either be closed here or be visible to
+            # stop()'s close loop — never survive it.
+            with self._conns_lock:
+                if self._stop.is_set():
+                    cli.close()
+                    up.close()
+                    continue
+                self._conns.append((cli, up))
             pipes = (
                 _Pipe(cli, up, self.delay_s, self.bps, self.buf_bytes),
                 _Pipe(up, cli, self.delay_s, self.bps, self.buf_bytes),
             )
             for p in pipes:
                 p.start()
-            self._conns.append((cli, up))
 
     def stop(self):
         self._stop.set()
@@ -180,7 +190,9 @@ class ShapingRelay:
                 self._lsock.close()
             except OSError:
                 pass
-        for cli, up in self._conns:
+        with self._conns_lock:
+            conns, self._conns = self._conns, []
+        for cli, up in conns:
             for s in (cli, up):
                 try:
                     s.close()

@@ -1,0 +1,155 @@
+"""Endurance soak (VERDICT r4 item 7): 10k+ requests through the HTTP
+edge, across several engine generations, with memory ceilings asserted.
+
+Functional tests prove behavior once; this proves NOTHING LEAKS when the
+same machinery runs for a long time — slot/page bookkeeping in the
+engine, _ReqState retirement in the HTTP layer (its documented
+O(in-flight) contract), KV-index entries + pool bytes + lease counts in
+the native store (reference analogue: the store is long-lived by design,
+SURVEY.md §5 — but the reference suite has no endurance test at all).
+
+Flatness is asserted on counters that must NOT grow with request count:
+  - process RSS (warm watermark vs end-of-soak, generous slack for
+    allocator jitter),
+  - store kvmap_len / used_bytes (the prompt set is fixed, so
+    first-writer-wins dedup makes steady-state storage constant),
+  - store leases/inflight (must return to zero),
+  - HTTP requests_inflight (must return to zero every generation).
+
+Marked `soak`: deselect with `-m "not soak"` for a quick loop; the full
+suite runs it.
+"""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from infinistore_tpu.models import llama
+from infinistore_tpu.serving import ServingConfig, ServingEngine
+from infinistore_tpu.serving_http import ServingHTTPServer
+from infinistore_tpu.tpu import TpuKVStore
+
+N_GENERATIONS = 3
+REQS_PER_GEN = 3400          # 3 x 3400 = 10,200 total
+CLIENTS = 8
+PROMPT_POOL = 32             # fixed prompt set -> dedup'd store keys
+NEW_TOKENS = 4
+
+
+def _rss_kb():
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1])
+    raise RuntimeError("no VmRSS")
+
+
+def _post(base, body):
+    req = urllib.request.Request(
+        f"{base}/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return json.loads(r.read())
+
+
+@pytest.mark.soak
+def test_http_soak_10k_requests_memory_flat(shm_conn):
+    cfg = llama.LlamaConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=128, page_size=8, dtype="float32",
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    store = TpuKVStore(shm_conn)
+    rng = np.random.default_rng(7)
+    prompts = [
+        [int(t) for t in rng.integers(0, cfg.vocab_size, 12)]
+        for _ in range(PROMPT_POOL)
+    ]
+
+    totals = {"done": 0, "errors": 0}
+    totals_lock = threading.Lock()
+
+    def drive(base, n_requests):
+        def worker(wid, n):
+            my_rng = np.random.default_rng(wid)
+            for _ in range(n):
+                p = prompts[int(my_rng.integers(0, PROMPT_POOL))]
+                try:
+                    res = _post(base, {
+                        "prompt": p, "max_new_tokens": NEW_TOKENS,
+                        "stream": False,
+                    })
+                    ok = len(res["tokens"]) == NEW_TOKENS
+                except Exception:
+                    ok = False
+                with totals_lock:
+                    totals["done" if ok else "errors"] += 1
+
+        share = n_requests // CLIENTS
+        threads = [
+            threading.Thread(target=worker, args=(w, share), daemon=True)
+            for w in range(CLIENTS)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+            assert not t.is_alive(), "soak client wedged"
+
+    rss_marks, store_marks = [], []
+    for gen in range(N_GENERATIONS):
+        # Fresh engine + HTTP server each generation: generation
+        # turnover itself must not leak (jits are module-level and
+        # shared; engine pools are per-instance and must be collected).
+        eng = ServingEngine(
+            params, cfg,
+            ServingConfig(max_slots=CLIENTS, total_pages=64),
+            store=store,
+        )
+        srv = ServingHTTPServer(eng, port=0)
+        port = srv.start()
+        base = f"http://127.0.0.1:{port}"
+        drive(base, REQS_PER_GEN)
+        stats = srv.stats()
+        assert stats["requests_inflight"] == 0
+        assert stats["engine_ok"], "engine broke during soak"
+        srv.shutdown()
+        del eng, srv
+        rss_marks.append(_rss_kb())
+        s = shm_conn.stats()
+        store_marks.append(
+            {k: s[k] for k in
+             ("kvmap_len", "used_bytes", "leases", "inflight")}
+        )
+
+    assert totals["errors"] == 0, totals
+    assert totals["done"] >= (REQS_PER_GEN // CLIENTS) * CLIENTS * 3
+
+    # Store flatness: the fixed prompt set means generation 1 populates
+    # every reachable key; later generations must add nothing.
+    assert store_marks[-1]["kvmap_len"] == store_marks[0]["kvmap_len"], (
+        store_marks
+    )
+    assert store_marks[-1]["used_bytes"] == store_marks[0]["used_bytes"], (
+        store_marks
+    )
+    for m in store_marks:
+        assert m["leases"] == 0 and m["inflight"] == 0, store_marks
+
+    # RSS flatness: everything is warm after generation 1 (compile
+    # caches, allocator arenas); the remaining 2/3 of the soak must not
+    # drift more than allocator noise. 32 MiB of slack is ~3 KiB per
+    # request — a real per-request leak (one _ReqState + one token list
+    # per request is already more) would blow through it.
+    growth_kb = rss_marks[-1] - rss_marks[0]
+    assert growth_kb < 32 * 1024, (
+        f"RSS grew {growth_kb} KiB across {2 * REQS_PER_GEN} warm "
+        f"requests: {rss_marks}"
+    )
