@@ -66,8 +66,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *rest,
     offset = kv_len - q_len
     live = (k_start <= q_start + bq - 1 + offset) if causal else (ki >= 0)
 
-    @pl.when(live)
-    def _step():
+    def _attend(masked):
         q = q_ref[0]  # [BQ, D]
         k = k_ref[0]  # [BK, D]
         v = v_ref[0]
@@ -77,9 +76,10 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *rest,
             preferred_element_type=jnp.float32,
             precision=precision,
         ) * scale  # [BQ, BK] f32
-        mask = _tile_mask(logits.shape, q_start, k_start, q_len, kv_len,
-                          causal)
-        logits = jnp.where(mask, logits, _NEG_INF)
+        if masked:
+            mask = _tile_mask(logits.shape, q_start, k_start, q_len,
+                              kv_len, causal)
+            logits = jnp.where(mask, logits, _NEG_INF)
 
         m_prev = m_ref[...]  # [BQ, 1]
         l_prev = l_ref[...]
@@ -96,6 +96,17 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         acc_ref[...] = acc_ref[...] * alpha + pv
         m_ref[...] = m_new
         l_ref[...] = l_prev * alpha + l_cur
+
+    # Interior tiles have an all-true mask: building it anyway costs
+    # ~6 VPU ops/element (two iotas, compares, and, where) on a tile
+    # whose MXU work it rivals (flash attention on TPU is VPU-bound at
+    # hd=128). Skip the mask there; only boundary/diagonal tiles pay it.
+    # At S=4096 with 1024-blocks, 6 of the 10 live tiles are interior.
+    _masked_dispatch(
+        live,
+        _interior_tile(q_start, k_start, bq, bk, q_len, kv_len, causal),
+        _attend,
+    )
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -153,16 +164,20 @@ def _tile_mask(shape, q_start, k_start, q_len, kv_len, causal):
 
 
 def _bwd_tile(q, k, v, do, lse, dvec, q_start, k_start, q_len, kv_len,
-              scale, causal):
+              scale, causal, masked=True):
     """Shared backward tile recompute: probabilities p from q/k + saved
-    lse, and dS = P * (dP - D) * scale. Returns (p, ds, precision)."""
+    lse, and dS = P * (dP - D) * scale. Returns (p, ds, precision).
+    ``masked=False`` skips the mask build for interior tiles (all-true
+    mask — see _interior_tile)."""
     precision = xla_ref.matmul_precision(q.dtype)
     logits = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32, precision=precision,
     ) * scale
-    mask = _tile_mask(logits.shape, q_start, k_start, q_len, kv_len, causal)
-    logits = jnp.where(mask, logits, _NEG_INF)
+    if masked:
+        mask = _tile_mask(logits.shape, q_start, k_start, q_len, kv_len,
+                          causal)
+        logits = jnp.where(mask, logits, _NEG_INF)
     p = jnp.exp(logits - lse)  # the forward's exact probabilities
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
@@ -170,6 +185,35 @@ def _bwd_tile(q, k, v, do, lse, dvec, q_start, k_start, q_len, kv_len,
     )
     ds = p * (dp - dvec) * scale
     return p, ds, precision
+
+
+def _interior_tile(q_start, k_start, bq, bk, q_len, kv_len, causal):
+    """True for tiles whose validity mask is all-true — fully inside the
+    q/kv bounds and (if causal) fully below the shifted diagonal: the
+    mask build (~6 VPU ops/element) is pure waste there. Shared by the
+    forward and both backward kernels so the skip condition can never
+    diverge from _tile_mask's semantics."""
+    in_bounds = jnp.logical_and(k_start + bk <= kv_len,
+                                q_start + bq <= q_len)
+    if not causal:
+        return in_bounds
+    offset = kv_len - q_len
+    return jnp.logical_and(in_bounds,
+                           k_start + bk - 1 <= q_start + offset)
+
+
+def _masked_dispatch(live, interior, attend):
+    """ONE dispatch structure for every kernel: live interior tiles run
+    ``attend(masked=False)`` (no mask build), live boundary/diagonal
+    tiles run ``attend(masked=True)``. Shared so the forward and both
+    backward kernels can never diverge in how they apply the skip."""
+    @pl.when(jnp.logical_and(live, interior))
+    def _step_interior():
+        attend(False)
+
+    @pl.when(jnp.logical_and(live, jnp.logical_not(interior)))
+    def _step_masked():
+        attend(True)
 
 
 def _make_row_maps(n_heads, n_kv, group, block_q, block_k, causal,
@@ -344,18 +388,23 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
     offset = kv_len - q_len
     live = (k_start <= q_start + bq - 1 + offset) if causal else (ki >= 0)
 
-    @pl.when(live)
-    def _step():
+    def _accum(masked):
         k = k_ref[0]
         _, ds, precision = _bwd_tile(
             q_ref[0], k, v_ref[0], do_ref[0],
             lse_ref[0][:, :1], d_ref[0][:, :1],  # lane-replicated tiles
-            q_start, k_start, q_len, kv_len, scale, causal,
+            q_start, k_start, q_len, kv_len, scale, causal, masked=masked,
         )
         dq_acc[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
         )
+
+    _masked_dispatch(
+        live,
+        _interior_tile(q_start, k_start, bq, bk, q_len, kv_len, causal),
+        _accum,
+    )
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -379,14 +428,13 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, d_ref, k_ref, v_ref,
     offset = kv_len - q_len
     live = (q_start + bq - 1 + offset >= k_start) if causal else (qi >= 0)
 
-    @pl.when(live)
-    def _step():
+    def _accum(masked):
         q = q_ref[0]
         do = do_ref[0]
         p, ds, precision = _bwd_tile(
             q, k_ref[0], v_ref[0], do,
             lse_ref[0][:, :1], d_ref[0][:, :1],
-            q_start, k_start, q_len, kv_len, scale, causal,
+            q_start, k_start, q_len, kv_len, scale, causal, masked=masked,
         )
         # dV += P^T @ dO — contract the BQ axis of both (no transpose).
         dv_acc[...] += jax.lax.dot_general(
@@ -397,6 +445,12 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, d_ref, k_ref, v_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
         )
+
+    _masked_dispatch(
+        live,
+        _interior_tile(q_start, k_start, bq, bk, q_len, kv_len, causal),
+        _accum,
+    )
 
     @pl.when(qi == nq - 1)
     def _finish():
