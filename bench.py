@@ -1518,6 +1518,19 @@ def main():
         port = int(sys.argv[sys.argv.index("--big-leg") + 1])
         print(json.dumps(bench_big(port)))
         return 0
+    if "--probe-leg" in sys.argv:
+        # Cheap tunnel-health probe: device init + a 1 KB round trip.
+        try:
+            import jax
+            import numpy as np
+
+            dev = jax.devices()[0]
+            x = jax.device_put(np.ones(256, np.float32), dev)
+            ok = float(jax.numpy.sum(x)) == 256.0
+            print(json.dumps({"probe_device": str(dev), "probe_ok": ok}))
+        except Exception as e:
+            print(json.dumps({"probe_error": str(e)[:200]}))
+        return 0
     if "--engine-leg" in sys.argv:
         port = int(sys.argv[sys.argv.index("--engine-leg") + 1])
         print(json.dumps(bench_engine(port)))
@@ -1663,26 +1676,46 @@ def main():
         out.update(gated_leg("--sched-leg", "sched_error", 240))
         publish()
         srv.purge()
-        # Per-leg caps stay GENEROUS (a leg was once lost to a 480 s cap
-        # in a slow-compile window); the global budget, not the caps,
-        # bounds the worst-case total — gated_leg clips each cap to the
-        # remaining budget, so wide caps can no longer stack up to the
-        # 2,740 s that zeroed BENCH_r04.
-        out.update(gated_leg("--tpu-leg", "tpu_error", 900))
+        # Tunnel-health probe before any device leg: when the axon
+        # tunnel is WEDGED (observed: device init alone > 420 s), every
+        # device leg would burn its full cap discovering the same fact.
+        # A failed probe skips them all with an explicit marker — the
+        # artifact then says "tunnel down", not four timeouts.
+        probe = gated_leg("--probe-leg", "probe_error", 180)
+        out.update(probe)
         publish()
-        # HBM-filling flagship (6.4 B decode + engine-under-pressure):
-        # the round-5 headline — it runs BEFORE the 1.3 B continuity
-        # legs so a shrinking budget drops old numbers, not new ones.
-        out.update(gated_leg("--big-leg", "big_error", 900))
-        publish()
-        # Model-scale MFU/HBM-util + real-engine-loop legs: separate
-        # subprocesses, AFTER the transfer legs — the engine's per-step
-        # D2H would otherwise degrade the tunnel's H2D for everything
-        # that follows (BASELINE.md), and the engine leg is the most
-        # compile-heavy so its timeout must not cost the MFU numbers.
-        out.update(gated_leg("--mfu-leg", "mfu_error", 900))
-        publish()
-        out.update(gated_leg("--engine-leg", "engine_error", 700))
+        if probe.get("probe_ok"):
+            # Per-leg caps stay GENEROUS (a leg was once lost to a
+            # 480 s cap in a slow-compile window); the global budget,
+            # not the caps, bounds the worst-case total — gated_leg
+            # clips each cap to the remaining budget, so wide caps can
+            # no longer stack up to the 2,740 s that zeroed BENCH_r04.
+            out.update(gated_leg("--tpu-leg", "tpu_error", 900))
+            publish()
+            # HBM-filling flagship (6.4 B decode + engine-under-
+            # pressure): the round-5 headline — it runs BEFORE the
+            # 1.3 B continuity legs so a shrinking budget drops old
+            # numbers, not new ones.
+            out.update(gated_leg("--big-leg", "big_error", 900))
+            publish()
+            # Model-scale MFU/HBM-util + real-engine-loop legs:
+            # separate subprocesses, AFTER the transfer legs — the
+            # engine's per-step D2H would otherwise degrade the
+            # tunnel's H2D for everything that follows (BASELINE.md),
+            # and the engine leg is the most compile-heavy so its
+            # timeout must not cost the MFU numbers.
+            out.update(gated_leg("--mfu-leg", "mfu_error", 900))
+            publish()
+            out.update(gated_leg("--engine-leg", "engine_error", 700))
+        else:
+            # Carry the probe's ACTUAL outcome into the skip markers —
+            # "timed out" (wedged tunnel), an init error, or "budget
+            # exhausted" are different diagnoses and the artifact must
+            # not conflate them.
+            why = (probe.get("probe_error")
+                   or probe.get("probe_skipped") or "probe not ok")
+            for leg in ("tpu", "big", "mfu", "engine"):
+                out[f"{leg}_skipped"] = f"device probe: {why}"[:120]
     finally:
         srv.stop()
     publish()
