@@ -796,12 +796,37 @@ def bench_big(port):
 
         from infinistore_tpu.models import llama
 
+        import dataclasses
+
         dev = jax.devices()[0]
         cfg = _big_cfg()
-        with jax.default_device(dev):
-            # One 12.7 GB weight init shared by both sub-legs (the
-            # decode leg frees only its KV pools afterwards).
-            params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        params = None
+        for n_layers in (cfg.n_layers, 24):
+            try_cfg = dataclasses.replace(cfg, n_layers=n_layers)
+            try:
+                with jax.default_device(dev):
+                    # One ~12.7 GB weight init shared by both sub-legs
+                    # (the decode leg frees only its KV pools after).
+                    params = llama.init_params(
+                        jax.random.PRNGKey(0), try_cfg
+                    )
+                    # The WHOLE tree: dispatch is async and an OOM in a
+                    # later layer's weights surfaces on consumption —
+                    # blocking on one leaf would let the error escape
+                    # to the sub-legs and defeat the fallback.
+                    jax.block_until_ready(params)
+                cfg = try_cfg
+                break
+            except Exception as e:
+                # 28 layers leaves ~2.8 GB of headroom on a 16 GB v5e;
+                # if the runtime's reserved fraction eats that, retry
+                # once at 24 layers (5.5 B = 11 GB) rather than losing
+                # the whole flagship leg — the config actually used is
+                # published in decode7b_params_b.
+                params = None
+                res["big_init_error_l%d" % n_layers] = str(e)[:160]
+        if params is None:
+            return res
         try:
             res.update(_bench_decode_big(dev, cfg, params))
         except Exception as e:

@@ -1,0 +1,86 @@
+"""The bench artifact must be un-killable (VERDICT r4 item 1).
+
+BENCH_r04.json was `{"rc": 124, "tail": ""}` — the driver's timeout
+killed bench.py before its single end-of-run print, zeroing a round's
+perf evidence. These tests pin the two properties that make that
+impossible now:
+
+  1. under a tight wall-clock budget the run still exits quickly with a
+     complete, parseable artifact whose device legs carry explicit
+     *_skipped markers;
+  2. a SIGKILL mid-run (the driver-timeout failure mode, un-catchable
+     by python) leaves a tail whose last line is already a complete,
+     parseable artifact carrying the primary metric.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+
+
+def _env(budget):
+    env = dict(os.environ)
+    env["BENCH_BUDGET_S"] = str(budget)
+    # The CPU legs must not touch a TPU; keep the subprocess hermetic.
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _parse_last_json(stdout):
+    lines = [ln for ln in stdout.splitlines() if ln.startswith("{")]
+    assert lines, f"no JSON lines in bench output: {stdout[-400:]!r}"
+    return json.loads(lines[-1]), len(lines)
+
+
+def test_tiny_budget_run_completes_with_markers():
+    r = subprocess.run(
+        [sys.executable, BENCH], env=_env(30), capture_output=True,
+        text=True, timeout=420,
+    )
+    assert r.returncode == 0, r.stderr[-400:]
+    out, n_lines = _parse_last_json(r.stdout)
+    assert n_lines >= 3, "cumulative line must be printed per leg"
+    # Primary metric present and sane.
+    assert out["metric"] == "kv_put_get_4KBx4096_agg_throughput"
+    assert out["value"] > 0
+    # Over-budget legs degrade to explicit markers, never hang.
+    assert any(k.endswith("_skipped") for k in out), sorted(out)
+
+
+def test_sigkill_mid_run_leaves_valid_artifact():
+    import threading
+
+    # Own session so the kill takes the whole process GROUP: at kill
+    # time bench may have live children (sharded-leg servers, gated_leg
+    # subprocesses) that must not outlive the test.
+    p = subprocess.Popen(
+        [sys.executable, BENCH], env=_env(3600),
+        stdout=subprocess.PIPE, text=True, start_new_session=True,
+    )
+    # Read until two cumulative lines land (mid-run state), then KILL —
+    # the exact driver-timeout shape. The reader runs on a thread so a
+    # wedged bench that never prints a second line cannot hang the
+    # suite: the join timeout fires and the kill proceeds regardless.
+    lines = []
+
+    def reader():
+        for ln in p.stdout:
+            if ln.startswith("{"):
+                lines.append(ln)
+                if len(lines) >= 2:
+                    return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    t.join(timeout=300)
+    os.killpg(p.pid, signal.SIGKILL)
+    rest, _ = p.communicate(timeout=60)
+    lines += [ln for ln in rest.splitlines() if ln.startswith("{")]
+    assert lines, "bench printed nothing before the kill"
+    out = json.loads(lines[-1])
+    assert out["metric"] == "kv_put_get_4KBx4096_agg_throughput"
+    assert out["value"] > 0  # primary metric survived the kill
