@@ -822,9 +822,16 @@ def bench_big(port):
                 # if the runtime's reserved fraction eats that, retry
                 # once at 24 layers (5.5 B = 11 GB) rather than losing
                 # the whole flagship leg — the config actually used is
-                # published in decode7b_params_b.
+                # published in decode7b_params_b. ONLY an OOM-shaped
+                # failure earns the retry: any other error (wedged
+                # tunnel, bad config) would just burn the leg's clipped
+                # cap twice reproducing itself.
                 params = None
                 res["big_init_error_l%d" % n_layers] = str(e)[:160]
+                msg = str(e).lower()
+                if not ("resource_exhausted" in msg
+                        or "out of memory" in msg or "oom" in msg):
+                    break
         if params is None:
             return res
         try:

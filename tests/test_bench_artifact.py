@@ -11,6 +11,11 @@ impossible now:
   2. a SIGKILL mid-run (the driver-timeout failure mode, un-catchable
      by python) leaves a tail whose last line is already a complete,
      parseable artifact carrying the primary metric.
+
+Both bench subprocesses run in their own process GROUP and are
+group-killed on every exit path: at kill time bench may have live
+children (sharded-leg servers, gated_leg subprocesses) that must not
+outlive the test.
 """
 
 import json
@@ -18,6 +23,8 @@ import os
 import signal
 import subprocess
 import sys
+import threading
+
 
 BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
 
@@ -30,20 +37,41 @@ def _env(budget):
     return env
 
 
-def _parse_last_json(stdout):
-    lines = [ln for ln in stdout.splitlines() if ln.startswith("{")]
-    assert lines, f"no JSON lines in bench output: {stdout[-400:]!r}"
-    return json.loads(lines[-1]), len(lines)
+def _killpg(p):
+    try:
+        os.killpg(p.pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass  # already exited (group reaped)
+
+
+def _parse_artifacts(lines):
+    """JSON-parse every candidate line, keeping the parseable ones —
+    the line the kill interrupted may be a fragment."""
+    outs = []
+    for ln in lines:
+        try:
+            outs.append(json.loads(ln))
+        except ValueError:
+            pass
+    return outs
 
 
 def test_tiny_budget_run_completes_with_markers():
-    r = subprocess.run(
-        [sys.executable, BENCH], env=_env(30), capture_output=True,
-        text=True, timeout=420,
+    p = subprocess.Popen(
+        [sys.executable, BENCH], env=_env(30), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, start_new_session=True,
     )
-    assert r.returncode == 0, r.stderr[-400:]
-    out, n_lines = _parse_last_json(r.stdout)
-    assert n_lines >= 3, "cumulative line must be printed per leg"
+    try:
+        stdout, stderr = p.communicate(timeout=420)
+    except subprocess.TimeoutExpired:
+        _killpg(p)
+        raise
+    assert p.returncode == 0, stderr[-400:]
+    outs = _parse_artifacts(
+        [ln for ln in stdout.splitlines() if ln.startswith("{")]
+    )
+    assert len(outs) >= 3, "cumulative line must be printed per leg"
+    out = outs[-1]
     # Primary metric present and sane.
     assert out["metric"] == "kv_put_get_4KBx4096_agg_throughput"
     assert out["value"] > 0
@@ -52,35 +80,33 @@ def test_tiny_budget_run_completes_with_markers():
 
 
 def test_sigkill_mid_run_leaves_valid_artifact():
-    import threading
-
-    # Own session so the kill takes the whole process GROUP: at kill
-    # time bench may have live children (sharded-leg servers, gated_leg
-    # subprocesses) that must not outlive the test.
     p = subprocess.Popen(
         [sys.executable, BENCH], env=_env(3600),
         stdout=subprocess.PIPE, text=True, start_new_session=True,
     )
-    # Read until two cumulative lines land (mid-run state), then KILL —
-    # the exact driver-timeout shape. The reader runs on a thread so a
-    # wedged bench that never prints a second line cannot hang the
-    # suite: the join timeout fires and the kill proceeds regardless.
+    # ONE reader owns p.stdout for its whole life (a second reader —
+    # e.g. communicate() — would race the iterator's readahead buffer):
+    # it collects every JSON line until EOF and flags when two
+    # cumulative lines have landed, which is the mid-run moment we
+    # KILL — the exact driver-timeout shape.
     lines = []
+    two_seen = threading.Event()
 
     def reader():
         for ln in p.stdout:
             if ln.startswith("{"):
                 lines.append(ln)
                 if len(lines) >= 2:
-                    return
+                    two_seen.set()
 
     t = threading.Thread(target=reader, daemon=True)
     t.start()
-    t.join(timeout=300)
-    os.killpg(p.pid, signal.SIGKILL)
-    rest, _ = p.communicate(timeout=60)
-    lines += [ln for ln in rest.splitlines() if ln.startswith("{")]
-    assert lines, "bench printed nothing before the kill"
-    out = json.loads(lines[-1])
+    two_seen.wait(timeout=300)  # wedge-proof: kill fires regardless
+    _killpg(p)
+    t.join(timeout=60)  # EOF after the group kill ends the reader
+    p.wait(timeout=60)
+    outs = _parse_artifacts(lines)
+    assert outs, "bench printed no parseable artifact before the kill"
+    out = outs[-1]
     assert out["metric"] == "kv_put_get_4KBx4096_agg_throughput"
     assert out["value"] > 0  # primary metric survived the kill

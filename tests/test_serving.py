@@ -893,3 +893,33 @@ def test_content_keys_diverge_with_any_token():
     assert a[1] != b[1]          # second diverges
     c = content_page_keys([9, 2, 3, 4, 5, 6, 7, 8], 4, 2, 0, "k")
     assert a[0] != c[0] and a[1] != c[1]  # chain: early change poisons all
+
+
+def test_steady_cache_keeps_inactive_rows_zero(params, cfg):
+    """Round-4 advisor regression: the steady-state device cache stored
+    lens that advanced EVERY row, so after the first reuse inactive
+    slots carried seq_lens > 0 — defeating the MoE validity mask
+    (models/moe.py: valid = seq_lens > 0) that keeps garbage rows out
+    of expert capacity. Live rows advance, idle rows must stay 0."""
+    eng = ServingEngine(
+        params, cfg, ServingConfig(max_slots=4, total_pages=64)
+    )
+    rng = np.random.default_rng(17)
+    for i in range(2):  # 2 of 4 slots active
+        eng.submit(Request(
+            f"zi{i}",
+            [int(t) for t in rng.integers(0, cfg.vocab_size, 9)],
+            max_new_tokens=12,
+        ))
+    eng.step()  # admission
+    for _ in range(5):  # steady decode with cache reuse
+        eng.step()
+    assert eng._steady is not None, "steady cache should be engaged"
+    lens = np.asarray(eng._steady[2])
+    active = {i for i, s in enumerate(eng.slots) if s is not None}
+    assert active and len(active) < 4
+    for i in range(4):
+        if i in active:
+            assert lens[i] > 0
+        else:
+            assert lens[i] == 0, (i, lens)
