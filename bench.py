@@ -829,8 +829,11 @@ def bench_big(port):
                 params = None
                 res["big_init_error_l%d" % n_layers] = str(e)[:160]
                 msg = str(e).lower()
+                # Bare "oom" would substring-match words like
+                # "headroom"; RESOURCE_EXHAUSTED / "out of memory"
+                # cover XLA's actual allocator failures.
                 if not ("resource_exhausted" in msg
-                        or "out of memory" in msg or "oom" in msg):
+                        or "out of memory" in msg):
                     break
         if params is None:
             return res

@@ -63,9 +63,8 @@ def test_tiny_budget_run_completes_with_markers():
     )
     try:
         stdout, stderr = p.communicate(timeout=420)
-    except subprocess.TimeoutExpired:
-        _killpg(p)
-        raise
+    finally:
+        _killpg(p)  # reap surviving children on EVERY exit path
     assert p.returncode == 0, stderr[-400:]
     outs = _parse_artifacts(
         [ln for ln in stdout.splitlines() if ln.startswith("{")]

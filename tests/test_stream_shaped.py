@@ -123,3 +123,107 @@ def test_shaped_small_ops_pay_rtt_not_serialize(server, rng):
     finally:
         conn.close()
         relay.stop()
+
+
+def _echo_server():
+    """Plain TCP echo upstream for relay-calibration tests."""
+    import socket
+    import threading
+
+    ls = socket.socket()
+    ls.bind(("127.0.0.1", 0))
+    ls.listen(1)
+
+    def serve():
+        try:
+            c, _ = ls.accept()
+        except OSError:
+            return
+        while True:
+            try:
+                d = c.recv(65536)
+            except OSError:
+                break
+            if not d:
+                break
+            c.sendall(d)
+        c.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    return ls, ls.getsockname()[1]
+
+
+def test_relay_enforces_bandwidth_cap():
+    """The relay's pacer must actually hold the cap — if it under-shapes,
+    every stream_rtt_* fraction in the bench flatters the client. One
+    direction, 8 MiB at 64 MiB/s: expected ~0.125 s; measured rate must
+    land within [0.75, 1.25] of the cap (sleep granularity + 1-core
+    scheduling jitter)."""
+    import socket
+    import time as _t
+
+    ls, port = _echo_server()
+    cap = 64 * (1 << 20)
+    relay = ShapingRelay(port, rtt_ms=0.0, bandwidth_bps=cap)
+    relay.start()
+    try:
+        c = socket.create_connection(("127.0.0.1", relay.port))
+        total = 8 << 20
+        payload = bytes(64 << 10)
+        got = bytearray()
+        c.settimeout(30)
+        t0 = _t.perf_counter()
+        sent = 0
+        # Each direction is paced independently and the two pipeline,
+        # so the echo round trip sustains ~cap end-to-end once the pipe
+        # fills (it is NOT cap/2).
+        while sent < total:
+            c.sendall(payload)
+            sent += len(payload)
+        c.shutdown(socket.SHUT_WR)
+        while len(got) < total:
+            d = c.recv(65536)
+            if not d:
+                break
+            got += d
+        dt = _t.perf_counter() - t0
+        c.close()
+        assert len(got) == total
+        rate = total / dt
+        assert 0.75 * cap <= rate <= 1.25 * cap, (
+            f"shaped echo rate {rate / 2**20:.1f} MiB/s vs cap "
+            f"{cap / 2**20:.0f} MiB/s"
+        )
+    finally:
+        relay.stop()
+        ls.close()
+
+
+def test_relay_injects_rtt():
+    """A 1-byte ping-pong through the relay must pay >= the configured
+    RTT (delay is one-way per direction), and without shaping it's sub-
+    millisecond — the difference proves the delay injection works."""
+    import socket
+    import time as _t
+
+    ls, port = _echo_server()
+    relay = ShapingRelay(port, rtt_ms=30.0, bandwidth_bps=None)
+    relay.start()
+    try:
+        c = socket.create_connection(("127.0.0.1", relay.port))
+        c.settimeout(10)
+        # Warm the path (connection setup, thread spin-up).
+        c.sendall(b"x")
+        assert c.recv(1) == b"x"
+        t0 = _t.perf_counter()
+        for _ in range(3):
+            c.sendall(b"y")
+            assert c.recv(1) == b"y"
+        per_rt = (_t.perf_counter() - t0) / 3
+        c.close()
+        assert per_rt >= 0.028, f"round trip {per_rt * 1e3:.1f} ms < RTT"
+        assert per_rt < 0.3, f"round trip {per_rt * 1e3:.1f} ms absurd"
+    finally:
+        relay.stop()
+        ls.close()
