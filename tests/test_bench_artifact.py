@@ -33,7 +33,12 @@ def _env(budget):
     env = dict(os.environ)
     env["BENCH_BUDGET_S"] = str(budget)
     # The CPU legs must not touch a TPU; keep the subprocess hermetic.
+    # Clearing PALLAS_AXON_POOL_IPS makes the axon sitecustomize skip
+    # backend registration entirely — with it set, the site hook
+    # re-points JAX_PLATFORMS at the tunnel and a wedged tunnel would
+    # hang even "cpu" runs at backend init (observed in r05).
     env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
     return env
 
 
@@ -76,6 +81,36 @@ def test_tiny_budget_run_completes_with_markers():
     assert out["value"] > 0
     # Over-budget legs degrade to explicit markers, never hang.
     assert any(k.endswith("_skipped") for k in out), sorted(out)
+
+
+def test_leg_timeout_salvages_partial_output(tmp_path, monkeypatch):
+    """A leg that wedges mid-phase still contributes its completed
+    phases: bench_subprocess must salvage the last JSON line the killed
+    child printed and merge it with the timeout marker (r05 lesson —
+    the transfer leg burned 900 s and lost its finished restore
+    numbers)."""
+    sys.path.insert(0, os.path.dirname(BENCH))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    stub = tmp_path / "stub.py"
+    stub.write_text(
+        "import json, time\n"
+        "print(json.dumps({'phase1_GBps': 1.5}), flush=True)\n"
+        "time.sleep(120)\n"
+    )
+    wrapper = tmp_path / "fakepython"
+    wrapper.write_text(
+        f"#!/bin/sh\nexec {sys.executable} {stub} \"$@\"\n"
+    )
+    wrapper.chmod(0o755)
+    monkeypatch.setattr(bench.sys, "executable", str(wrapper))
+    res = bench.bench_subprocess("--any-leg", 0, "tpu_error", timeout_s=5)
+    assert res["phase1_GBps"] == 1.5  # salvaged
+    assert "timed out" in res["tpu_error"]
+    assert res["tpu_error_partial"] is True
 
 
 def test_sigkill_mid_run_leaves_valid_artifact():
