@@ -3,7 +3,11 @@
 A user coming from the reference stack serves HF checkpoints; this
 module loads a ``transformers`` Llama (model object or state dict) into
 the JAX model in models/llama.py, so the same weights drive the paged-KV
-engine, the store demos and the benchmarks. The conversion is pure
+engine, the store demos and the benchmarks. Covered checkpoint features:
+GQA, tied embeddings, llama3-type ``rope_scaling`` (the Llama-3.1/3.2
+long-context recipe) and ``attention_bias`` q/k/v/o biases (the Qwen2-
+family geometry); unsupported rope types (yarn/linear/dynamic) hard-
+error rather than silently diverging. The conversion is pure
 layout work: torch ``nn.Linear`` stores [out, in] and computes
 ``x @ W.T``, our params store [in, out] and compute ``x @ W`` — so every
 projection transposes; head layouts, the half-split RoPE convention
@@ -24,17 +28,26 @@ def config_from_hf(hf_cfg, page_size=16, dtype="float32"):
     silently dropping them would load without error and diverge from
     the parity the bridge promises."""
     scaling = getattr(hf_cfg, "rope_scaling", None)
+    rope_scaling = ()
     if scaling:
-        raise NotImplementedError(
-            f"rope_scaling={scaling!r} is not supported: our rope() uses "
-            "unscaled theta frequencies, so a Llama-3.1-style scaled "
-            "checkpoint would produce wrong logits at every position"
-        )
-    if getattr(hf_cfg, "attention_bias", False):
-        raise NotImplementedError(
-            "attention_bias=True checkpoints carry q/k/v/o biases the "
-            "JAX model has no slots for"
-        )
+        rope_type = scaling.get("rope_type", scaling.get("type", ""))
+        if rope_type == "llama3":
+            # Llama-3.1/3.2 long-context checkpoints; applied in
+            # llama.rope via _llama3_scale_freqs, parity-pinned
+            # against transformers in tests/test_hf_bridge.py.
+            rope_scaling = (
+                float(scaling["factor"]),
+                float(scaling["low_freq_factor"]),
+                float(scaling["high_freq_factor"]),
+                float(scaling["original_max_position_embeddings"]),
+            )
+        elif rope_type != "default":
+            raise NotImplementedError(
+                f"rope_scaling type {rope_type!r} is not supported "
+                "(implemented: 'llama3', 'default'); a linear/yarn/"
+                "dynamic checkpoint would produce wrong logits at "
+                "every position"
+            )
     return LlamaConfig(
         vocab_size=hf_cfg.vocab_size,
         d_model=hf_cfg.hidden_size,
@@ -45,6 +58,7 @@ def config_from_hf(hf_cfg, page_size=16, dtype="float32"):
         max_seq=hf_cfg.max_position_embeddings,
         page_size=page_size,
         rope_theta=float(hf_cfg.rope_theta),
+        rope_scaling=rope_scaling,
         norm_eps=float(hf_cfg.rms_norm_eps),
         dtype=dtype,
     )
@@ -69,19 +83,35 @@ def params_from_hf(model_or_state_dict, cfg: LlamaConfig):
     layers = []
     for li in range(cfg.n_layers):
         p = f"model.layers.{li}."
-        layers.append(
-            {
-                "ln1": _t(sd, p + "input_layernorm.weight", dt),
-                "wq": _t(sd, p + "self_attn.q_proj.weight", dt).T,
-                "wk": _t(sd, p + "self_attn.k_proj.weight", dt).T,
-                "wv": _t(sd, p + "self_attn.v_proj.weight", dt).T,
-                "wo": _t(sd, p + "self_attn.o_proj.weight", dt).T,
-                "ln2": _t(sd, p + "post_attention_layernorm.weight", dt),
-                "w_gate": _t(sd, p + "mlp.gate_proj.weight", dt).T,
-                "w_up": _t(sd, p + "mlp.up_proj.weight", dt).T,
-                "w_down": _t(sd, p + "mlp.down_proj.weight", dt).T,
-            }
-        )
+        layer = {
+            "ln1": _t(sd, p + "input_layernorm.weight", dt),
+            "wq": _t(sd, p + "self_attn.q_proj.weight", dt).T,
+            "wk": _t(sd, p + "self_attn.k_proj.weight", dt).T,
+            "wv": _t(sd, p + "self_attn.v_proj.weight", dt).T,
+            "wo": _t(sd, p + "self_attn.o_proj.weight", dt).T,
+            "ln2": _t(sd, p + "post_attention_layernorm.weight", dt),
+            "w_gate": _t(sd, p + "mlp.gate_proj.weight", dt).T,
+            "w_up": _t(sd, p + "mlp.up_proj.weight", dt).T,
+            "w_down": _t(sd, p + "mlp.down_proj.weight", dt).T,
+        }
+        # attention_bias=True checkpoints (HF Llama with biases; the
+        # Qwen2 family geometry) carry per-projection biases — map
+        # whichever are present (Qwen2 has q/k/v but no o bias).
+        for ours, theirs in (("bq", "q_proj"), ("bk", "k_proj"),
+                             ("bv", "v_proj"), ("bo", "o_proj")):
+            name = p + f"self_attn.{theirs}.bias"
+            if name in sd:
+                layer[ours] = _t(sd, name, dt)
+        # mlp_bias=True checkpoints carry gate/up/down biases the JAX
+        # MLP has no slots for — hard-error rather than loading a model
+        # that silently diverges (the bridge's contract).
+        for theirs in ("gate_proj", "up_proj", "down_proj"):
+            if p + f"mlp.{theirs}.bias" in sd:
+                raise NotImplementedError(
+                    "mlp_bias=True checkpoints are not supported: "
+                    f"{p}mlp.{theirs}.bias has no parameter slot"
+                )
+        layers.append(layer)
     embed = _t(sd, "model.embed_tokens.weight", dt)
     if "lm_head.weight" in sd:
         lm_head = _t(sd, "lm_head.weight", dt).T

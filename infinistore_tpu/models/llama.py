@@ -45,6 +45,13 @@ class LlamaConfig:
     max_seq: int = 256
     page_size: int = 16  # tokens per KV page (the store's transfer unit)
     rope_theta: float = 10000.0
+    # Llama-3.1-style frequency-dependent RoPE scaling, as a hashable
+    # tuple (factor, low_freq_factor, high_freq_factor,
+    # original_max_position_embeddings); () = unscaled. Matches HF's
+    # rope_scaling={"rope_type": "llama3", ...} (the long-context
+    # Llama-3.1/3.2 checkpoints), numerically pinned by
+    # tests/test_hf_bridge.py against transformers itself.
+    rope_scaling: tuple = ()
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
 
@@ -105,13 +112,33 @@ def rms_norm(x, w, eps=1e-5):
     return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * w
 
 
-def rope(x, positions, theta):
+def _llama3_scale_freqs(freqs, scaling):
+    """Frequency-dependent RoPE rescale (Llama-3.1 "llama3" rope_type):
+    long-wavelength (low-frequency) components are slowed by `factor`,
+    short wavelengths kept, and the band between low/high_freq_factor
+    interpolated — the published recipe that lets 8k-trained weights
+    address 128k positions. Mirrors HF `_compute_llama3_parameters`."""
+    factor, low_f, high_f, orig_max = scaling
+    wavelen = 2.0 * jnp.pi / freqs
+    low_wl = orig_max / low_f
+    high_wl = orig_max / high_f
+    smooth = (orig_max / wavelen - low_f) / (high_f - low_f)
+    mid = (1.0 - smooth) * freqs / factor + smooth * freqs
+    return jnp.where(
+        wavelen > low_wl, freqs / factor,
+        jnp.where(wavelen < high_wl, freqs, mid),
+    )
+
+
+def rope(x, positions, theta, scaling=()):
     """x: [..., seq, heads, hd]; positions broadcastable to [..., seq]."""
     hd = x.shape[-1]
     half = hd // 2
     freqs = jnp.exp(
         -jnp.log(theta) * jnp.arange(0, half, dtype=jnp.float32) / half
     )
+    if scaling:
+        freqs = _llama3_scale_freqs(freqs, scaling)
     angles = positions[..., None].astype(jnp.float32) * freqs  # [..., s, half]
     cos = jnp.cos(angles)[..., None, :].astype(x.dtype)
     sin = jnp.sin(angles)[..., None, :].astype(x.dtype)
@@ -119,16 +146,32 @@ def rope(x, positions, theta):
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 
+def _proj(h, layer, w, b_, shape=None):
+    """x @ W with an optional bias leaf (absent in native checkpoints;
+    the HF bridge adds bq/bk/bv/bo for attention_bias=True families
+    like Qwen2 — pytree structure is static under jit either way)."""
+    out = h @ layer[w]
+    bias = layer.get(b_)
+    if bias is not None:
+        out = out + bias
+    return out if shape is None else out.reshape(shape)
+
+
 def _qkv(layer, x, cfg, positions):
     b = x.shape[0]
     s = x.shape[1]
     h = rms_norm(x, layer["ln1"], cfg.norm_eps)
-    q = (h @ layer["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
-    k = (h @ layer["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-    v = (h @ layer["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-    q = rope(q, positions, cfg.rope_theta)
-    k = rope(k, positions, cfg.rope_theta)
+    q = _proj(h, layer, "wq", "bq", (b, s, cfg.n_heads, cfg.head_dim))
+    k = _proj(h, layer, "wk", "bk", (b, s, cfg.n_kv_heads, cfg.head_dim))
+    v = _proj(h, layer, "wv", "bv", (b, s, cfg.n_kv_heads, cfg.head_dim))
+    q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+    k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
     return q, k, v
+
+
+def _attn_out(layer, attn_flat):
+    """attn @ Wo (+ optional bo) — the attention output projection."""
+    return _proj(attn_flat, layer, "wo", "bo")
 
 
 def _mlp(layer, x, eps=1e-5):
@@ -164,7 +207,7 @@ def _forward_stack(params, cfg: LlamaConfig, tokens, prefix_kvs=None):
         # XLA path at S=4096 on v5e), XLA path elsewhere. kv may be
         # longer than q — the causal diagonal shifts by the prefix.
         attn = flash_prefill(q, k_full, v_full, causal=True)
-        x = x + attn.reshape(b, s, -1) @ layer["wo"]
+        x = x + _attn_out(layer, attn.reshape(b, s, -1))
         x = x + _mlp(layer, x, cfg.norm_eps)
         kvs.append((k, v))
     x = rms_norm(x, params["final_ln"], cfg.norm_eps)
@@ -237,7 +280,7 @@ def decode_step(params, cfg: LlamaConfig, token, seq_lens, k_pages, v_pages,
         attn = paged_decode_attention(
             q[:, 0], kp, vp, page_table, seq_lens + 1
         )
-        x = x + attn.reshape(b, 1, -1) @ layer["wo"]
+        x = x + _attn_out(layer, attn.reshape(b, 1, -1))
         x = x + _mlp(layer, x, cfg.norm_eps)
         new_k_pages.append(kp)
         new_v_pages.append(vp)
@@ -294,7 +337,7 @@ def verify_step(params, cfg: LlamaConfig, tokens, seq_lens, k_pages,
         # Pallas streaming kernel on TPU (pages HBM->VMEM, nothing
         # gathered), XLA gather path elsewhere.
         attn = paged_verify_attention(q, kp, vp, page_table, seq_lens)
-        x = x + attn.reshape(b, m, -1) @ layer["wo"]
+        x = x + _attn_out(layer, attn.reshape(b, m, -1))
         x = x + _mlp(layer, x, cfg.norm_eps)
         new_k_pages.append(kp)
         new_v_pages.append(vp)

@@ -47,6 +47,7 @@ class MoEConfig:
     max_seq: int = 256
     page_size: int = 16
     rope_theta: float = 10000.0
+    rope_scaling: tuple = ()  # see LlamaConfig.rope_scaling
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     aux_loss_weight: float = 0.01
@@ -198,7 +199,7 @@ def _forward_stack(params, cfg: MoEConfig, tokens, prefix_kvs=None):
             k_full = jnp.concatenate([pk.astype(k.dtype), k], axis=1)
             v_full = jnp.concatenate([pv.astype(v.dtype), v], axis=1)
         attn = _llama.flash_prefill(q, k_full, v_full, causal=True)
-        x = x + attn.reshape(b, s, -1) @ layer["wo"]
+        x = x + _llama._attn_out(layer, attn.reshape(b, s, -1))
         moe_out, aux = _moe_mlp(layer, x, cfg)
         x = x + moe_out
         kvs.append((k, v))
@@ -262,7 +263,7 @@ def decode_step(params, cfg: MoEConfig, token, seq_lens, k_pages, v_pages,
         attn = _llama.paged_decode_attention(
             q[:, 0], kp, vp, page_table, seq_lens + 1
         )
-        x = x + attn.reshape(b, 1, -1) @ layer["wo"]
+        x = x + _llama._attn_out(layer, attn.reshape(b, 1, -1))
         moe_out, _aux = _moe_mlp(layer, x, cfg, valid)
         x = x + moe_out
         new_k_pages.append(kp)
@@ -298,7 +299,7 @@ def verify_step(params, cfg: MoEConfig, tokens, seq_lens, k_pages,
         attn = _llama.paged_verify_attention(
             q, kp, vp, page_table, seq_lens
         )
-        x = x + attn.reshape(b, m, -1) @ layer["wo"]
+        x = x + _llama._attn_out(layer, attn.reshape(b, m, -1))
         # Ragged padding + inactive rows stay out of expert capacity.
         moe_out, _aux = _moe_mlp(layer, x, cfg, ok)
         x = x + moe_out

@@ -116,3 +116,114 @@ def test_tied_embeddings_fallback():
     np.testing.assert_array_equal(
         np.asarray(params["lm_head"]), np.asarray(params["embed"]).T
     )
+
+
+@pytest.fixture(scope="module")
+def hf_model_31():
+    """Llama-3.1-style checkpoint: llama3 rope_scaling + attention
+    biases (the Qwen2-family geometry) — the two features real served
+    checkpoints carry that plain Llama-3 does not."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=160,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 8.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 64,
+        },
+        attention_bias=True,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(3)
+    return transformers.LlamaForCausalLM(cfg).eval()
+
+
+def test_rope_scaling_config_mapping(hf_model_31):
+    cfg = hf.config_from_hf(hf_model_31.config, page_size=8)
+    assert cfg.rope_scaling == (8.0, 1.0, 4.0, 64.0)
+
+
+def test_rope_scaling_unsupported_type_raises():
+    cfg = transformers.LlamaConfig(
+        rope_scaling={"rope_type": "yarn", "factor": 4.0}
+    )
+    with pytest.raises(NotImplementedError):
+        hf.config_from_hf(cfg)
+
+
+def test_mlp_bias_checkpoint_raises():
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2,
+        num_key_value_heads=2, mlp_bias=True,
+    )
+    torch.manual_seed(6)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    with pytest.raises(NotImplementedError, match="mlp_bias"):
+        hf.load_hf(model, page_size=8, dtype="float32")
+
+
+def test_llama31_prefill_logits_match_transformers(hf_model_31):
+    """Parity BEYOND the original context window (positions > 64, where
+    unscaled frequencies would diverge hard) — proves the llama3
+    frequency rescale AND the q/k/v/o biases, end to end."""
+    cfg, params = hf.load_hf(hf_model_31, page_size=8, dtype="float32")
+    assert "bq" in params["layers"][0] and "bo" in params["layers"][0]
+    rng = np.random.default_rng(4)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 96), dtype=np.int64)
+
+    with torch.no_grad():
+        ref = hf_model_31(torch.from_numpy(tokens)).logits.numpy()
+
+    ours, _ = llama.prefill(params, cfg, jnp.asarray(tokens, jnp.int32))
+    ours = np.asarray(ours)
+    err = np.abs(ours - ref).max()
+    assert err < 2e-4, err
+    assert np.array_equal(ours.argmax(-1), ref.argmax(-1))
+
+
+def test_llama31_paged_decode_matches_transformers(hf_model_31):
+    """The paged decode path with scaled rope + biases: prefill, page
+    out/in, decode one token past the original context window."""
+    cfg, params = hf.load_hf(hf_model_31, page_size=8, dtype="float32")
+    rng = np.random.default_rng(5)
+    seq = 80  # ten pages, beyond original_max_position_embeddings=64
+    tokens = rng.integers(0, cfg.vocab_size, (1, seq + 1), dtype=np.int64)
+
+    with torch.no_grad():
+        ref = hf_model_31(torch.from_numpy(tokens)).logits.numpy()[0, -1]
+
+    _, kvs = llama.prefill(
+        params, cfg, jnp.asarray(tokens[:, :seq], jnp.int32)
+    )
+    n_pages = seq // cfg.page_size
+    max_pages = n_pages + 1
+    k_pages = jnp.zeros(
+        (cfg.n_layers, max_pages, cfg.page_size, cfg.n_kv_heads,
+         cfg.head_dim), dtype=cfg.jdtype,
+    )
+    v_pages = jnp.zeros_like(k_pages)
+    for li, (k, v) in enumerate(kvs):
+        kp, vp = llama.kv_to_pages(cfg, k, v)
+        k_pages = k_pages.at[li, :n_pages].set(kp[0])
+        v_pages = v_pages.at[li, :n_pages].set(vp[0])
+    page_table = jnp.arange(max_pages, dtype=jnp.int32)[None]
+    logits, _, _ = llama.decode_step(
+        params, cfg,
+        jnp.asarray(tokens[:, seq], jnp.int32).reshape(1),
+        jnp.asarray([seq], jnp.int32),
+        k_pages, v_pages, page_table,
+    )
+    ours = np.asarray(logits[0])
+    err = np.abs(ours - ref).max()
+    assert err < 2e-4, err
+    assert int(ours.argmax()) == int(ref.argmax())
