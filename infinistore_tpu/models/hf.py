@@ -5,9 +5,11 @@ module loads a ``transformers`` Llama (model object or state dict) into
 the JAX model in models/llama.py, so the same weights drive the paged-KV
 engine, the store demos and the benchmarks. Covered checkpoint features:
 GQA, tied embeddings, llama3-type ``rope_scaling`` (the Llama-3.1/3.2
-long-context recipe) and ``attention_bias`` q/k/v/o biases (the Qwen2-
-family geometry); unsupported rope types (yarn/linear/dynamic) hard-
-error rather than silently diverging. The conversion is pure
+long-context recipe) and per-projection attention biases — which makes
+``Qwen2ForCausalLM`` checkpoints load directly (same state-dict naming,
+q/k/v biases, no o bias; parity-tested). Unsupported features
+(yarn/linear/dynamic rope, ``mlp_bias``, Qwen2 ``use_sliding_window``)
+hard-error rather than silently diverging. The conversion is pure
 layout work: torch ``nn.Linear`` stores [out, in] and computes
 ``x @ W.T``, our params store [in, out] and compute ``x @ W`` — so every
 projection transposes; head layouts, the half-split RoPE convention
@@ -48,6 +50,18 @@ def config_from_hf(hf_cfg, page_size=16, dtype="float32"):
                 "dynamic checkpoint would produce wrong logits at "
                 "every position"
             )
+    if getattr(hf_cfg, "use_sliding_window", False):
+        raise NotImplementedError(
+            "use_sliding_window=True (Qwen2 long-context mode) needs "
+            "windowed attention the JAX model does not implement"
+        )
+    hd = getattr(hf_cfg, "head_dim", None)
+    if hd is not None and hd != hf_cfg.hidden_size // hf_cfg.num_attention_heads:
+        raise NotImplementedError(
+            f"explicit head_dim={hd} != hidden_size//num_attention_heads="
+            f"{hf_cfg.hidden_size // hf_cfg.num_attention_heads}: the JAX "
+            "model derives head_dim and would reshape wrongly at inference"
+        )
     return LlamaConfig(
         vocab_size=hf_cfg.vocab_size,
         d_model=hf_cfg.hidden_size,

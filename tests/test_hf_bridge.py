@@ -227,3 +227,45 @@ def test_llama31_paged_decode_matches_transformers(hf_model_31):
     err = np.abs(ours - ref).max()
     assert err < 2e-4, err
     assert int(ours.argmax()) == int(ref.argmax())
+
+
+def test_qwen2_checkpoint_loads_and_matches():
+    """An actual transformers Qwen2ForCausalLM (not a biased Llama
+    stand-in): same state-dict naming, q/k/v biases without o bias —
+    the bridge loads it directly and matches logits."""
+    cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5,
+        rope_theta=10000.0, use_sliding_window=False,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(7)
+    model = transformers.Qwen2ForCausalLM(cfg).eval()
+    jcfg, params = hf.load_hf(model, page_size=8, dtype="float32")
+    assert sorted(
+        k for k in params["layers"][0] if k.startswith("b")
+    ) == ["bk", "bq", "bv"]  # Qwen2: no o_proj bias
+
+    rng = np.random.default_rng(8)
+    tokens = rng.integers(0, jcfg.vocab_size, (2, 24), dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours, _ = llama.prefill(params, jcfg, jnp.asarray(tokens, jnp.int32))
+    ours = np.asarray(ours)
+    assert np.abs(ours - ref).max() < 2e-4
+    assert np.array_equal(ours.argmax(-1), ref.argmax(-1))
+
+
+def test_qwen2_sliding_window_raises():
+    cfg = transformers.Qwen2Config(use_sliding_window=True)
+    with pytest.raises(NotImplementedError, match="sliding_window"):
+        hf.config_from_hf(cfg)
+
+
+def test_explicit_head_dim_mismatch_raises():
+    cfg = transformers.LlamaConfig(
+        hidden_size=64, num_attention_heads=4, head_dim=32
+    )
+    with pytest.raises(NotImplementedError, match="head_dim"):
+        hf.config_from_hf(cfg)
