@@ -6,10 +6,10 @@ the JAX model in models/llama.py, so the same weights drive the paged-KV
 engine, the store demos and the benchmarks. Covered checkpoint features:
 GQA, tied embeddings, llama3-type ``rope_scaling`` (the Llama-3.1/3.2
 long-context recipe) and per-projection attention biases — which makes
-``Qwen2ForCausalLM`` checkpoints load directly (same state-dict naming,
-q/k/v biases, no o bias; parity-tested). Unsupported features
-(yarn/linear/dynamic rope, ``mlp_bias``, Qwen2 ``use_sliding_window``)
-hard-error rather than silently diverging. The conversion is pure
+``Qwen2ForCausalLM`` and ``MistralForCausalLM`` checkpoints load
+directly (parity-tested). Unsupported features (yarn/linear/dynamic
+rope, ``mlp_bias``, active sliding-window attention) hard-error rather
+than silently diverging. The conversion is pure
 layout work: torch ``nn.Linear`` stores [out, in] and computes
 ``x @ W.T``, our params store [in, out] and compute ``x @ W`` — so every
 projection transposes; head layouts, the half-split RoPE convention
@@ -50,10 +50,20 @@ def config_from_hf(hf_cfg, page_size=16, dtype="float32"):
                 "dynamic checkpoint would produce wrong logits at "
                 "every position"
             )
-    if getattr(hf_cfg, "use_sliding_window", False):
+    # Sliding-window attention is signalled differently per family:
+    # Qwen2 carries sliding_window=4096 but gates it behind
+    # use_sliding_window (False = ignore); Mistral's window is active
+    # whenever sliding_window is not None. Either way the JAX model has
+    # no windowed attention — reject active windows at load.
+    if hasattr(hf_cfg, "use_sliding_window"):
+        window_active = bool(hf_cfg.use_sliding_window)
+    else:
+        window_active = getattr(hf_cfg, "sliding_window", None) is not None
+    if window_active:
         raise NotImplementedError(
-            "use_sliding_window=True (Qwen2 long-context mode) needs "
-            "windowed attention the JAX model does not implement"
+            "sliding-window attention (Qwen2 use_sliding_window=True / "
+            "Mistral sliding_window set) is not implemented by the JAX "
+            "model"
         )
     hd = getattr(hf_cfg, "head_dim", None)
     if hd is not None and hd != hf_cfg.hidden_size // hf_cfg.num_attention_heads:

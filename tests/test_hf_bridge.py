@@ -269,3 +269,31 @@ def test_explicit_head_dim_mismatch_raises():
     )
     with pytest.raises(NotImplementedError, match="head_dim"):
         hf.config_from_hf(cfg)
+
+
+def test_mistral_checkpoint_loads_and_matches():
+    """MistralForCausalLM with the window disabled is llama-geometry;
+    the bridge loads it directly and matches logits."""
+    cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5,
+        rope_theta=10000.0, sliding_window=None, tie_word_embeddings=False,
+    )
+    torch.manual_seed(11)
+    model = transformers.MistralForCausalLM(cfg).eval()
+    jcfg, params = hf.load_hf(model, page_size=8, dtype="float32")
+    rng = np.random.default_rng(12)
+    tokens = rng.integers(0, jcfg.vocab_size, (2, 24), dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours, _ = llama.prefill(params, jcfg, jnp.asarray(tokens, jnp.int32))
+    ours = np.asarray(ours)
+    assert np.abs(ours - ref).max() < 2e-4
+    assert np.array_equal(ours.argmax(-1), ref.argmax(-1))
+
+
+def test_mistral_active_sliding_window_raises():
+    cfg = transformers.MistralConfig(sliding_window=64)
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        hf.config_from_hf(cfg)
