@@ -184,7 +184,7 @@ def _forward_stack(params, cfg: MoEConfig, tokens, prefix_kvs=None):
     rectangular flash kernel."""
     b, s = tokens.shape
     prefix_len = 0 if prefix_kvs is None else prefix_kvs[0][0].shape[1]
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = _llama._embed(params, tokens)
     positions = jnp.broadcast_to(
         prefix_len + jnp.arange(s)[None], (b, s)
     )
@@ -205,7 +205,7 @@ def _forward_stack(params, cfg: MoEConfig, tokens, prefix_kvs=None):
         kvs.append((k, v))
         aux_total = aux_total + aux
     x = rms_norm(x, params["final_ln"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = _llama._logits(params, x)
     return logits, kvs, aux_total
 
 
@@ -241,7 +241,7 @@ def decode_step(params, cfg: MoEConfig, token, seq_lens, k_pages, v_pages,
     or rollback logic MUST be applied here too; the MoE serving parity
     suite (tests/test_moe.py) is the drift alarm."""
     b = token.shape[0]
-    x = jnp.take(params["embed"], token[:, None], axis=0)  # [b, 1, d]
+    x = _llama._embed(params, token[:, None])  # [b, 1, d]
     positions = seq_lens[:, None]
     page_idx_in_seq = seq_lens // cfg.page_size
     target_page = jnp.take_along_axis(
@@ -269,7 +269,7 @@ def decode_step(params, cfg: MoEConfig, token, seq_lens, k_pages, v_pages,
         new_k_pages.append(kp)
         new_v_pages.append(vp)
     x = rms_norm(x, params["final_ln"], cfg.norm_eps)
-    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    logits = _llama._logits(params, x[:, 0])
     return logits, jnp.stack(new_k_pages), jnp.stack(new_v_pages)
 
 
@@ -280,7 +280,7 @@ def verify_step(params, cfg: MoEConfig, tokens, seq_lens, k_pages,
     llama.verify_step with the routed FFN; see that docstring for the
     scratch-page and rollback contracts."""
     b, m = tokens.shape
-    x = jnp.take(params["embed"], tokens, axis=0)  # [b, m, d]
+    x = _llama._embed(params, tokens)  # [b, m, d]
     positions = seq_lens[:, None] + jnp.arange(m)[None, :]
     page_idx_in_seq = positions // cfg.page_size
     target_page = jnp.take_along_axis(page_table, page_idx_in_seq, axis=1)
@@ -306,7 +306,7 @@ def verify_step(params, cfg: MoEConfig, tokens, seq_lens, k_pages,
         new_k_pages.append(kp)
         new_v_pages.append(vp)
     x = rms_norm(x, params["final_ln"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = _llama._logits(params, x)
     return logits, jnp.stack(new_k_pages), jnp.stack(new_v_pages)
 
 
