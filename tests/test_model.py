@@ -306,6 +306,49 @@ def test_train_step_sharded_mesh(cfg):
     assert "tp" in (wq_shard.spec[1],)
 
 
+def test_train_step_fsdp_matches_replicated(cfg):
+    """FSDP/ZeRO placement (weights + Adam moments 1/dp per rank,
+    collectives inserted by XLA) computes the identical loss to the
+    megatron tp/dp placement — same math, different sharding."""
+    import optax
+
+    from infinistore_tpu.parallel import mesh as pmesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = pmesh.make_mesh(pmesh.MeshConfig(dp=2, tp=4), jax.devices()[:8])
+    host_params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    optimizer = optax.adamw(1e-3)
+    tokens = jax.device_put(
+        jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 32)),
+            dtype=jnp.int32,
+        ),
+        NamedSharding(mesh, P("dp")),
+    )
+
+    def step(p, o, t):
+        return llama.train_step(p, o, cfg, t, optimizer)
+
+    losses = {}
+    for name, sh in (
+        ("tp", pmesh.param_shardings(mesh, host_params)),
+        ("fsdp", pmesh.fsdp_param_shardings(mesh, host_params)),
+    ):
+        p = jax.device_put(host_params, sh)
+        o = optimizer.init(p)
+        p2, o2, loss = jax.jit(step)(p, o, tokens)
+        jax.block_until_ready(loss)
+        losses[name] = float(loss)
+        if name == "fsdp":
+            # Every weight matrix (and its Adam moments, via
+            # init-on-sharded) carries a dp-sharded axis.
+            wq_spec = p2["layers"][0]["wq"].sharding.spec
+            assert "dp" in tuple(wq_spec), wq_spec
+            mu_spec = o2[0].mu["layers"][0]["wq"].sharding.spec
+            assert "dp" in tuple(mu_spec), mu_spec
+    assert abs(losses["fsdp"] - losses["tp"]) < 1e-3, losses
+
+
 def test_graft_entry():
     import __graft_entry__ as g
 
