@@ -7,8 +7,11 @@ engine, the store demos and the benchmarks. Covered checkpoint features:
 GQA, tied embeddings, llama3-type ``rope_scaling`` (the Llama-3.1/3.2
 long-context recipe) and per-projection attention biases — which makes
 ``Qwen2ForCausalLM`` and ``MistralForCausalLM`` checkpoints load
-directly (parity-tested). Unsupported features (yarn/linear/dynamic
-rope, ``mlp_bias``, active sliding-window attention) hard-error rather
+directly (parity-tested), and sliding-window attention maps onto
+``LlamaConfig.window`` (banded masks in every attention path — a real
+windowed Mistral matches transformers on prefill, paged decode, and
+the engine's greedy stream). Unsupported features (yarn/linear/dynamic
+rope, ``mlp_bias``, Qwen2 MIXED per-layer windowing) hard-error rather
 than silently diverging. The conversion is pure
 layout work: torch ``nn.Linear`` stores [out, in] and computes
 ``x @ W.T``, our params store [in, out] and compute ``x @ W`` — so every
@@ -50,21 +53,35 @@ def config_from_hf(hf_cfg, page_size=16, dtype="float32"):
                 "dynamic checkpoint would produce wrong logits at "
                 "every position"
             )
-    # Sliding-window attention is signalled differently per family:
-    # Qwen2 carries sliding_window=4096 but gates it behind
-    # use_sliding_window (False = ignore); Mistral's window is active
-    # whenever sliding_window is not None. Either way the JAX model has
-    # no windowed attention — reject active windows at load.
+    # Sliding-window attention maps onto LlamaConfig.window (a single
+    # global band width; llama.py applies it in every attention path).
+    # The signalling differs per family: Qwen2 carries
+    # sliding_window=4096 gated behind use_sliding_window, with
+    # max_window_layers giving the count of BOTTOM layers that keep
+    # full attention (mixed per-layer windowing has no slot here and
+    # hard-errors); Mistral's window is active whenever sliding_window
+    # is not None, on every layer.
+    window = 0
     if hasattr(hf_cfg, "use_sliding_window"):
-        window_active = bool(hf_cfg.use_sliding_window)
+        # transformers itself additionally gates SWA on sliding_window
+        # being set: use_sliding_window=True with sliding_window=None
+        # runs full attention there, so it must here too.
+        if hf_cfg.use_sliding_window and hf_cfg.sliding_window is not None:
+            mwl = int(getattr(hf_cfg, "max_window_layers", 0))
+            if mwl >= hf_cfg.num_hidden_layers:
+                window = 0  # every layer below the SWA cutoff: all full
+            elif mwl == 0:
+                window = int(hf_cfg.sliding_window)
+            else:
+                raise NotImplementedError(
+                    f"mixed per-layer sliding window (max_window_layers="
+                    f"{mwl} of {hf_cfg.num_hidden_layers}) — the JAX "
+                    "model has one global window"
+                )
     else:
-        window_active = getattr(hf_cfg, "sliding_window", None) is not None
-    if window_active:
-        raise NotImplementedError(
-            "sliding-window attention (Qwen2 use_sliding_window=True / "
-            "Mistral sliding_window set) is not implemented by the JAX "
-            "model"
-        )
+        sw = getattr(hf_cfg, "sliding_window", None)
+        if sw is not None:
+            window = int(sw)
     hd = getattr(hf_cfg, "head_dim", None)
     if hd is not None and hd != hf_cfg.hidden_size // hf_cfg.num_attention_heads:
         raise NotImplementedError(
@@ -83,6 +100,7 @@ def config_from_hf(hf_cfg, page_size=16, dtype="float32"):
         page_size=page_size,
         rope_theta=float(hf_cfg.rope_theta),
         rope_scaling=rope_scaling,
+        window=window,
         norm_eps=float(hf_cfg.rms_norm_eps),
         dtype=dtype,
     )

@@ -52,6 +52,12 @@ class LlamaConfig:
     # Llama-3.1/3.2 checkpoints), numerically pinned by
     # tests/test_hf_bridge.py against transformers itself.
     rope_scaling: tuple = ()
+    # Sliding-window attention width (Mistral / Qwen2 long-context):
+    # each query sees at most the last `window` positions (including
+    # itself). 0 = full causal attention. Applied identically in dense
+    # prefill, prefix-cached prefill, paged decode and multi-token
+    # verify (parity vs transformers pinned in tests/test_hf_bridge).
+    window: int = 0
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
 
@@ -356,7 +362,8 @@ def _forward_stack(params, cfg: LlamaConfig, tokens, prefix_kvs=None):
         # Pallas flash kernel on TPU (O(S) memory, ~4x faster than the
         # XLA path at S=4096 on v5e), XLA path elsewhere. kv may be
         # longer than q — the causal diagonal shifts by the prefix.
-        attn = flash_prefill(q, k_full, v_full, causal=True)
+        attn = flash_prefill(q, k_full, v_full, causal=True,
+                             window=cfg.window)
         x = x + _attn_out(layer, attn.reshape(b, s, -1))
         x = x + _mlp(layer, x, cfg.norm_eps)
         kvs.append((k, v))
@@ -428,7 +435,7 @@ def decode_step(params, cfg: LlamaConfig, token, seq_lens, k_pages, v_pages,
         kp = scatter_kv_to_pages(k_pages[li], k, target_page, slot)
         vp = scatter_kv_to_pages(v_pages[li], v, target_page, slot)
         attn = paged_decode_attention(
-            q[:, 0], kp, vp, page_table, seq_lens + 1
+            q[:, 0], kp, vp, page_table, seq_lens + 1, window=cfg.window
         )
         x = x + _attn_out(layer, attn.reshape(b, 1, -1))
         x = x + _mlp(layer, x, cfg.norm_eps)
@@ -486,7 +493,8 @@ def verify_step(params, cfg: LlamaConfig, tokens, seq_lens, k_pages,
         vp = scatter_kv_multi(v_pages[li], v, target_page, slot)
         # Pallas streaming kernel on TPU (pages HBM->VMEM, nothing
         # gathered), XLA gather path elsewhere.
-        attn = paged_verify_attention(q, kp, vp, page_table, seq_lens)
+        attn = paged_verify_attention(q, kp, vp, page_table, seq_lens,
+                                      window=cfg.window)
         x = x + _attn_out(layer, attn.reshape(b, m, -1))
         x = x + _mlp(layer, x, cfg.norm_eps)
         new_k_pages.append(kp)

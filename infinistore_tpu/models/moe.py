@@ -48,6 +48,7 @@ class MoEConfig:
     page_size: int = 16
     rope_theta: float = 10000.0
     rope_scaling: tuple = ()  # see LlamaConfig.rope_scaling
+    window: int = 0           # see LlamaConfig.window
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     aux_loss_weight: float = 0.01
@@ -198,7 +199,8 @@ def _forward_stack(params, cfg: MoEConfig, tokens, prefix_kvs=None):
             pk, pv = prefix_kvs[li]
             k_full = jnp.concatenate([pk.astype(k.dtype), k], axis=1)
             v_full = jnp.concatenate([pv.astype(v.dtype), v], axis=1)
-        attn = _llama.flash_prefill(q, k_full, v_full, causal=True)
+        attn = _llama.flash_prefill(q, k_full, v_full, causal=True,
+                                    window=cfg.window)
         x = x + _llama._attn_out(layer, attn.reshape(b, s, -1))
         moe_out, aux = _moe_mlp(layer, x, cfg)
         x = x + moe_out
@@ -261,7 +263,7 @@ def decode_step(params, cfg: MoEConfig, token, seq_lens, k_pages, v_pages,
         kp = _llama.scatter_kv_to_pages(k_pages[li], k, target_page, slot)
         vp = _llama.scatter_kv_to_pages(v_pages[li], v, target_page, slot)
         attn = _llama.paged_decode_attention(
-            q[:, 0], kp, vp, page_table, seq_lens + 1
+            q[:, 0], kp, vp, page_table, seq_lens + 1, window=cfg.window
         )
         x = x + _llama._attn_out(layer, attn.reshape(b, 1, -1))
         moe_out, _aux = _moe_mlp(layer, x, cfg, valid)
@@ -297,7 +299,7 @@ def verify_step(params, cfg: MoEConfig, tokens, seq_lens, k_pages,
         kp = _llama.scatter_kv_multi(k_pages[li], k, target_page, slot)
         vp = _llama.scatter_kv_multi(v_pages[li], v, target_page, slot)
         attn = _llama.paged_verify_attention(
-            q, kp, vp, page_table, seq_lens
+            q, kp, vp, page_table, seq_lens, window=cfg.window
         )
         x = x + _llama._attn_out(layer, attn.reshape(b, m, -1))
         # Ragged padding + inactive rows stay out of expert capacity.

@@ -69,13 +69,16 @@ def _repeat_kv(x, n_rep):
     return jnp.repeat(x, n_rep, axis=-2)
 
 
-def prefill_attention(q, k, v, causal=True):
+def prefill_attention(q, k, v, causal=True, window=0):
     """Dense causal attention for prefill.
 
     q: [batch, s_q, heads, hd]; k/v: [batch, s_kv, kv_heads, hd] (GQA).
     s_kv may exceed s_q — prefix-cached prefill, where suffix queries
     attend over restored-prefix + suffix KV; the causal diagonal shifts
     right by s_kv - s_q (query i sees kv j <= i + prefix_len).
+    window > 0 adds the sliding-window band (Mistral/Qwen2 semantics:
+    query i also needs kv j > i + prefix_len - window, i.e. each query
+    sees at most the last `window` positions including itself).
     Returns [batch, s_q, heads, hd]. fp32 softmax accumulation.
     """
     if causal and k.shape[1] < q.shape[1]:
@@ -99,12 +102,15 @@ def prefill_attention(q, k, v, causal=True):
         pos_q = jnp.arange(s_q)[:, None]
         pos_k = jnp.arange(s_kv)[None, :]
         mask = pos_k <= pos_q + (s_kv - s_q)
+        if window:
+            mask &= pos_k > pos_q + (s_kv - s_q) - window
         logits = jnp.where(mask[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v, precision=precision)
 
 
-def multi_token_paged_attention(q, k_pages, v_pages, page_table, seq_lens):
+def multi_token_paged_attention(q, k_pages, v_pages, page_table, seq_lens,
+                                window=0):
     """m-token decode attention over paged KV — the verify step of
     speculative decoding and the inner op of chunked prefill.
 
@@ -143,12 +149,15 @@ def multi_token_paged_attention(q, k_pages, v_pages, page_table, seq_lens):
     t_pos = jnp.arange(max_pages * page)[None, None, :]  # [1, 1, T]
     limit = (seq_lens[:, None] + jnp.arange(m)[None, :] + 1)[..., None]
     valid = t_pos < limit  # [b, m, T]
+    if window:  # sliding band: token at position p sees t > p - window
+        valid &= t_pos >= limit - window
     logits = jnp.where(valid[:, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhmt,bthd->bmhd", probs, v, precision=precision)
 
 
-def paged_decode_attention(q, k_pages, v_pages, page_table, seq_lens):
+def paged_decode_attention(q, k_pages, v_pages, page_table, seq_lens,
+                           window=0):
     """Single-token decode attention over paged KV.
 
     q:            [batch, n_heads, hd] (current-step queries)
@@ -180,6 +189,8 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, seq_lens):
     ) * scale
     positions = jnp.arange(max_pages * page)[None, :]  # [1, T]
     valid = positions < seq_lens[:, None]  # [b, T]
+    if window:  # current token is at seq_lens - 1: band floor
+        valid &= positions >= seq_lens[:, None] - window
     logits = jnp.where(valid[:, None, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bht,bthd->bhd", probs, v, precision=precision)

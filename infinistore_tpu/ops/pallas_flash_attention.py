@@ -42,7 +42,8 @@ _NEG_INF = -1e30
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, *rest,
-            bq, bk, q_len, kv_len, scale, causal, with_lse=False):
+            bq, bk, q_len, kv_len, scale, causal, window=0,
+            with_lse=False):
     if with_lse:  # extra lse output slot before the scratch refs
         lse_ref, acc_ref, m_ref, l_ref = rest
     else:
@@ -65,6 +66,12 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *rest,
     # the prefix length: query row i may see kv columns <= i + offset.
     offset = kv_len - q_len
     live = (k_start <= q_start + bq - 1 + offset) if causal else (ki >= 0)
+    if causal and window:
+        # ...and a kv block entirely below every query's band floor is
+        # equally dead (least-strict row is the tile's FIRST query).
+        live = jnp.logical_and(
+            live, k_start + bk - 1 > q_start + offset - window
+        )
 
     def _attend(masked):
         q = q_ref[0]  # [BQ, D]
@@ -78,7 +85,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         ) * scale  # [BQ, BK] f32
         if masked:
             mask = _tile_mask(logits.shape, q_start, k_start, q_len,
-                              kv_len, causal)
+                              kv_len, causal, window)
             logits = jnp.where(mask, logits, _NEG_INF)
 
         m_prev = m_ref[...]  # [BQ, 1]
@@ -104,7 +111,8 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *rest,
     # At S=4096 with 1024-blocks, 6 of the 10 live tiles are interior.
     _masked_dispatch(
         live,
-        _interior_tile(q_start, k_start, bq, bk, q_len, kv_len, causal),
+        _interior_tile(q_start, k_start, bq, bk, q_len, kv_len, causal,
+                       window),
         _attend,
     )
 
@@ -146,11 +154,13 @@ def _auto_block(seq_len):
     return 512 if pad512 < pad1024 else 1024
 
 
-def _tile_mask(shape, q_start, k_start, q_len, kv_len, causal):
+def _tile_mask(shape, q_start, k_start, q_len, kv_len, causal, window=0):
     """Validity mask for one [BQ, BK] logits tile: padded query and key
-    positions are dead, plus the causal triangle. ONE definition shared
-    by the forward and both backward kernels — forward/backward masks
-    must never diverge.
+    positions are dead, plus the causal triangle (and, with window > 0,
+    the sliding band's floor: query i also needs
+    pos_k > i + offset - window). ONE definition shared by the forward
+    and both backward kernels — forward/backward masks must never
+    diverge.
 
     kv_len may exceed q_len (prefix-cached prefill: suffix queries over
     prefix + suffix KV); the causal diagonal then shifts right by the
@@ -160,11 +170,15 @@ def _tile_mask(shape, q_start, k_start, q_len, kv_len, causal):
     mask = jnp.logical_and(pos_k < kv_len, pos_q < q_len)
     if causal:
         mask = jnp.logical_and(mask, pos_k <= pos_q + (kv_len - q_len))
+        if window:
+            mask = jnp.logical_and(
+                mask, pos_k > pos_q + (kv_len - q_len) - window
+            )
     return mask
 
 
 def _bwd_tile(q, k, v, do, lse, dvec, q_start, k_start, q_len, kv_len,
-              scale, causal, masked=True):
+              scale, causal, window=0, masked=True):
     """Shared backward tile recompute: probabilities p from q/k + saved
     lse, and dS = P * (dP - D) * scale. Returns (p, ds, precision).
     ``masked=False`` skips the mask build for interior tiles (all-true
@@ -176,7 +190,7 @@ def _bwd_tile(q, k, v, do, lse, dvec, q_start, k_start, q_len, kv_len,
     ) * scale
     if masked:
         mask = _tile_mask(logits.shape, q_start, k_start, q_len, kv_len,
-                          causal)
+                          causal, window)
         logits = jnp.where(mask, logits, _NEG_INF)
     p = jnp.exp(logits - lse)  # the forward's exact probabilities
     dp = jax.lax.dot_general(
@@ -187,19 +201,29 @@ def _bwd_tile(q, k, v, do, lse, dvec, q_start, k_start, q_len, kv_len,
     return p, ds, precision
 
 
-def _interior_tile(q_start, k_start, bq, bk, q_len, kv_len, causal):
+def _interior_tile(q_start, k_start, bq, bk, q_len, kv_len, causal,
+                   window=0):
     """True for tiles whose validity mask is all-true — fully inside the
-    q/kv bounds and (if causal) fully below the shifted diagonal: the
-    mask build (~6 VPU ops/element) is pure waste there. Shared by the
-    forward and both backward kernels so the skip condition can never
-    diverge from _tile_mask's semantics."""
+    q/kv bounds, (if causal) fully below the shifted diagonal, and (if
+    windowed) fully above the band floor: the mask build (~6 VPU
+    ops/element) is pure waste there. Shared by the forward and both
+    backward kernels so the skip condition can never diverge from
+    _tile_mask's semantics."""
     in_bounds = jnp.logical_and(k_start + bk <= kv_len,
                                 q_start + bq <= q_len)
     if not causal:
         return in_bounds
     offset = kv_len - q_len
-    return jnp.logical_and(in_bounds,
-                           k_start + bk - 1 <= q_start + offset)
+    interior = jnp.logical_and(in_bounds,
+                               k_start + bk - 1 <= q_start + offset)
+    if window:
+        # Strictest row is the tile's LAST query (largest band floor):
+        # every k in the tile must satisfy k > q + offset - window.
+        interior = jnp.logical_and(
+            interior,
+            k_start > q_start + bq - 1 + offset - window,
+        )
+    return interior
 
 
 def _masked_dispatch(live, interior, attend):
@@ -257,7 +281,8 @@ def _layout_rows(x, heads, block):
         x.transpose(0, 2, 1, 3).reshape(b * h, s, hd), 1, block), 2, 128)
 
 
-def _forward_impl(q, k, v, causal, block_q, block_k, interpret, with_lse):
+def _forward_impl(q, k, v, causal, block_q, block_k, interpret, with_lse,
+                  window=0):
     batch, q_len, n_heads, hd = q.shape
     kv_len = k.shape[1]
     n_kv = k.shape[2]
@@ -294,7 +319,7 @@ def _forward_impl(q, k, v, causal, block_q, block_k, interpret, with_lse):
     res = pl.pallas_call(
         functools.partial(
             _kernel, bq=block_q, bk=block_k, q_len=q_len, kv_len=kv_len,
-            scale=scale, causal=causal, with_lse=with_lse,
+            scale=scale, causal=causal, window=window, with_lse=with_lse,
         ),
         out_shape=out_shapes,
         grid=(batch * n_heads, nq, nk),
@@ -324,10 +349,11 @@ def _forward_impl(q, k, v, causal, block_q, block_k, interpret, with_lse):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret", "window"),
 )
 def flash_prefill_attention(q, k, v, causal=True, block_q=None, block_k=None,
-                            interpret=False):
+                            interpret=False, window=0):
     """Flash prefill attention (same contract as
     paged_attention.prefill_attention).
 
@@ -349,7 +375,8 @@ def flash_prefill_attention(q, k, v, causal=True, block_q=None, block_k=None,
     if block_k is None:
         block_k = _auto_block(k.shape[1])
     return _forward_impl(
-        q, k, v, causal, block_q, block_k, interpret, with_lse=False
+        q, k, v, causal, block_q, block_k, interpret, with_lse=False,
+        window=window,
     )
 
 
@@ -374,7 +401,8 @@ def flash_prefill_attention(q, k, v, causal=True, block_q=None, block_k=None,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
-                   dq_acc, *, bq, bk, q_len, kv_len, scale, causal):
+                   dq_acc, *, bq, bk, q_len, kv_len, scale, causal,
+                   window=0):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -387,13 +415,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
     k_start = ki * bk
     offset = kv_len - q_len
     live = (k_start <= q_start + bq - 1 + offset) if causal else (ki >= 0)
+    if causal and window:
+        live = jnp.logical_and(
+            live, k_start + bk - 1 > q_start + offset - window
+        )
 
     def _accum(masked):
         k = k_ref[0]
         _, ds, precision = _bwd_tile(
             q_ref[0], k, v_ref[0], do_ref[0],
             lse_ref[0][:, :1], d_ref[0][:, :1],  # lane-replicated tiles
-            q_start, k_start, q_len, kv_len, scale, causal, masked=masked,
+            q_start, k_start, q_len, kv_len, scale, causal, window,
+            masked=masked,
         )
         dq_acc[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -402,7 +435,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
 
     _masked_dispatch(
         live,
-        _interior_tile(q_start, k_start, bq, bk, q_len, kv_len, causal),
+        _interior_tile(q_start, k_start, bq, bk, q_len, kv_len, causal,
+                       window),
         _accum,
     )
 
@@ -413,7 +447,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, d_ref, k_ref, v_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    bq, bk, q_len, kv_len, scale, causal):
+                    bq, bk, q_len, kv_len, scale, causal, window=0):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -427,6 +461,12 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, d_ref, k_ref, v_ref,
     k_start = ki * bk
     offset = kv_len - q_len
     live = (q_start + bq - 1 + offset >= k_start) if causal else (qi >= 0)
+    if causal and window:
+        # A q block whose every row's band floor is above this k block
+        # contributes nothing (least-strict row: the tile's FIRST q).
+        live = jnp.logical_and(
+            live, q_start <= k_start + bk - 1 - offset + window - 1
+        )
 
     def _accum(masked):
         q = q_ref[0]
@@ -434,7 +474,8 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, d_ref, k_ref, v_ref,
         p, ds, precision = _bwd_tile(
             q, k_ref[0], v_ref[0], do,
             lse_ref[0][:, :1], d_ref[0][:, :1],
-            q_start, k_start, q_len, kv_len, scale, causal, masked=masked,
+            q_start, k_start, q_len, kv_len, scale, causal, window,
+            masked=masked,
         )
         # dV += P^T @ dO — contract the BQ axis of both (no transpose).
         dv_acc[...] += jax.lax.dot_general(
@@ -448,7 +489,8 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, d_ref, k_ref, v_ref,
 
     _masked_dispatch(
         live,
-        _interior_tile(q_start, k_start, bq, bk, q_len, kv_len, causal),
+        _interior_tile(q_start, k_start, bq, bk, q_len, kv_len, causal,
+                       window),
         _accum,
     )
 
@@ -459,7 +501,7 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, d_ref, k_ref, v_ref,
 
 
 def _flash_backward(q, k, v, o, lse, g, causal, interpret,
-                    block_q=None, block_k=None):
+                    block_q=None, block_k=None, window=0):
     """O(S)-memory gradients from the saved residuals. Returns
     (dq, dk, dv) with the input shapes/dtypes."""
     batch, q_len, n_heads, hd = q.shape
@@ -507,7 +549,7 @@ def _flash_backward(q, k, v, o, lse, g, causal, interpret,
     dqf = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, bq=block_q, bk=block_k, q_len=q_len,
-            kv_len=kv_len, scale=scale, causal=causal,
+            kv_len=kv_len, scale=scale, causal=causal, window=window,
         ),
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
         grid=(bh, nq, nk),
@@ -536,7 +578,7 @@ def _flash_backward(q, k, v, o, lse, g, causal, interpret,
     dkf, dvf = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, bq=block_q, bk=block_k, q_len=q_len,
-            kv_len=kv_len, scale=scale, causal=causal,
+            kv_len=kv_len, scale=scale, causal=causal, window=window,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk_p, hd_p), k.dtype),
@@ -569,32 +611,33 @@ def _flash_backward(q, k, v, o, lse, g, causal, interpret,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_with_vjp(q, k, v, causal, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_with_vjp(q, k, v, causal, interpret, window):
     return flash_prefill_attention(q, k, v, causal=causal,
-                                   interpret=interpret)
+                                   interpret=interpret, window=window)
 
 
-def _flash_fwd(q, k, v, causal, interpret):
+def _flash_fwd(q, k, v, causal, interpret, window):
     out, lse = _forward_impl(
         q, k, v, causal, _auto_block(q.shape[1]), _auto_block(k.shape[1]),
-        interpret, with_lse=True,
+        interpret, with_lse=True, window=window,
     )
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, interpret, residuals, g):
+def _flash_bwd(causal, interpret, window, residuals, g):
     q, k, v, o, lse = residuals
-    return _flash_backward(q, k, v, o, lse, g, causal, interpret)
+    return _flash_backward(q, k, v, o, lse, g, causal, interpret,
+                           window=window)
 
 
 _flash_with_vjp.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_prefill(q, k, v, causal=True):
+def flash_prefill(q, k, v, causal=True, window=0):
     """Prefill attention with automatic backend choice: the pallas flash
     kernel on TPU (differentiable — see _flash_with_vjp), the XLA path
-    elsewhere."""
+    elsewhere. window > 0 = sliding-window band (Mistral/Qwen2)."""
     if jax.default_backend() == "tpu":
-        return _flash_with_vjp(q, k, v, causal, False)
-    return xla_ref.prefill_attention(q, k, v, causal=causal)
+        return _flash_with_vjp(q, k, v, causal, False, window)
+    return xla_ref.prefill_attention(q, k, v, causal=causal, window=window)

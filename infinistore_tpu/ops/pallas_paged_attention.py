@@ -32,7 +32,8 @@ from . import paged_attention as xla_ref
 
 
 def _kernel(page_tbl_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
-            acc_ref, m_ref, l_ref, *, page_size, n_kv, hd, n_heads, scale):
+            acc_ref, m_ref, l_ref, *, page_size, n_kv, hd, n_heads, scale,
+            window=0):
     b = pl.program_id(0)
     j = pl.program_id(1)
     n_pages = pl.num_programs(1)
@@ -45,14 +46,20 @@ def _kernel(page_tbl_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
 
     seq_len = seq_lens_ref[b]
     start = j * page_size
+    # Sliding window: the band floor (current token is seq_len - 1);
+    # pages wholly below it are skipped for compute.
+    low = jnp.maximum(seq_len - window, 0) if window else None
+    live = start < seq_len
+    if window:
+        live = jnp.logical_and(live, start + page_size > low)
 
-    @pl.when(start < seq_len)
+    @pl.when(live)
     def _step():
         _attend(q_ref[0],
                 k_ref[0].reshape(page_size, n_kv, hd),
                 v_ref[0].reshape(page_size, n_kv, hd),
                 acc_ref, m_ref, l_ref, n_kv=n_kv, n_heads=n_heads,
-                scale=scale, start=start, seq_len=seq_len)
+                scale=scale, start=start, seq_len=seq_len, low=low)
 
     @pl.when(j == n_pages - 1)
     def _finish():
@@ -61,7 +68,7 @@ def _kernel(page_tbl_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
 
 def _kernel_q(page_tbl_ref, seq_lens_ref, q_ref, kq_ref, ks_ref, vq_ref,
               vs_ref, o_ref, acc_ref, m_ref, l_ref, *,
-              page_size, n_kv, hd, n_heads, scale):
+              page_size, n_kv, hd, n_heads, scale, window=0):
     """Decode attention over INT8 pages: dequantize in VMEM right after
     the page DMA — HBM traffic per page is half the bf16 kernel's (int8
     values + per-token-per-head f32 scales ≈ 0.53x bf16 bytes)."""
@@ -77,8 +84,12 @@ def _kernel_q(page_tbl_ref, seq_lens_ref, q_ref, kq_ref, ks_ref, vq_ref,
 
     seq_len = seq_lens_ref[b]
     start = j * page_size
+    low = jnp.maximum(seq_len - window, 0) if window else None
+    live = start < seq_len
+    if window:
+        live = jnp.logical_and(live, start + page_size > low)
 
-    @pl.when(start < seq_len)
+    @pl.when(live)
     def _step():
         kq = kq_ref[0].reshape(page_size, n_kv, hd)  # int8
         vq = vq_ref[0].reshape(page_size, n_kv, hd)
@@ -88,7 +99,7 @@ def _kernel_q(page_tbl_ref, seq_lens_ref, q_ref, kq_ref, ks_ref, vq_ref,
         vv = vq.astype(jnp.float32) * vs[..., None]
         _attend(q_ref[0].astype(jnp.float32), kv, vv,
                 acc_ref, m_ref, l_ref, n_kv=n_kv, n_heads=n_heads,
-                scale=scale, start=start, seq_len=seq_len)
+                scale=scale, start=start, seq_len=seq_len, low=low)
 
     @pl.when(j == n_pages - 1)
     def _finish():
@@ -96,14 +107,16 @@ def _kernel_q(page_tbl_ref, seq_lens_ref, q_ref, kq_ref, ks_ref, vq_ref,
 
 
 def _attend(q, kv, vv, acc_ref, m_ref, l_ref, *, n_kv, n_heads, scale,
-            start, seq_len, rows_per_kv=None, limit=None):
+            start, seq_len, rows_per_kv=None, limit=None, low=None):
     """One page's online-softmax fold, shared by ALL paged kernels.
 
     q: [rows, D] with `rows_per_kv` consecutive query rows per kv head
     (decode: the GQA group; verify: m_tok * group — the m-token fold);
     kv/vv: [P, n_kv, D] (already dequantized if the pages are int8).
     `limit` masks position pos < limit; a scalar (decode: seq_len) or a
-    [rows, 1] column (verify: per-token causal limits)."""
+    [rows, 1] column (verify: per-token causal limits). `low`, when
+    given (sliding-window attention), additionally masks pos < low —
+    same scalar/column shapes as limit."""
     if rows_per_kv is None:
         rows_per_kv = n_heads // n_kv
     if limit is None:
@@ -133,7 +146,10 @@ def _attend(q, kv, vv, acc_ref, m_ref, l_ref, *, n_kv, n_heads, scale,
     logits = jnp.concatenate(logit_blocks, axis=0)  # [rows, P]
     logits = logits * scale  # true (unpadded) head-dim scale
     pos = start + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-    logits = jnp.where(pos < limit, logits, -1e30)
+    valid = pos < limit
+    if low is not None:
+        valid = jnp.logical_and(valid, pos >= low)
+    logits = jnp.where(valid, logits, -1e30)
 
     m_prev = m_ref[...]  # [rows, 1]
     l_prev = l_ref[...]
@@ -198,9 +214,9 @@ def _make_page_idx(page_size, n_pages, tok_offset=0):
     return _page_idx
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "window"))
 def paged_flash_decode(q, k_pages, v_pages, page_table, seq_lens,
-                       interpret=False):
+                       interpret=False, window=0):
     """Flash-decode attention over paged KV (same contract as
     paged_attention.paged_decode_attention).
 
@@ -256,6 +272,7 @@ def paged_flash_decode(q, k_pages, v_pages, page_table, seq_lens,
         n_kv=n_kv_p,
         hd=hd_p,
         n_heads=n_heads_p,
+        window=window,
         scale=hd ** -0.5,  # NOT hd_p: zero-padded lanes add nothing, but
                            # the softmax temperature is the real head dim
     )
@@ -268,9 +285,9 @@ def paged_flash_decode(q, k_pages, v_pages, page_table, seq_lens,
     return out[:, :n_heads, :hd]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "window"))
 def paged_flash_decode_quantized(q, k_q, k_s, v_q, v_s, page_table,
-                                 seq_lens, interpret=False):
+                                 seq_lens, interpret=False, window=0):
     """Flash-decode attention DIRECTLY over int8-quantized KV pages
     (ops/kv_quant.py format): pages stay int8 in HBM — the decode cache
     holds 2x the tokens — and each page's DMA moves ~0.53x the bf16
@@ -332,6 +349,7 @@ def paged_flash_decode_quantized(q, k_q, k_s, v_q, v_s, page_table,
         n_kv=n_kv_p,
         hd=hd_p,
         n_heads=n_heads_p,
+        window=window,
         scale=hd ** -0.5,
     )
     out = pl.pallas_call(
@@ -345,7 +363,7 @@ def paged_flash_decode_quantized(q, k_q, k_s, v_q, v_s, page_table,
 
 def _kernel_multi(page_tbl_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
                   acc_ref, m_ref, l_ref, *, page_size, n_kv, hd, group,
-                  m_tok, scale):
+                  m_tok, scale, window=0):
     """m-token verify attention over paged KV (speculative verify /
     chunked prefill). Query rows are laid out kv-head-major —
     row = h * (m_tok * group) + j * group + g for token j, query head
@@ -365,19 +383,28 @@ def _kernel_multi(page_tbl_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
 
     seq_len = seq_lens_ref[b]
     start = j * page_size
+    live = start < seq_len + m_tok
+    if window:
+        # A page wholly below the LOWEST band floor (token 0's:
+        # seq_len + 1 - window) is dead for every row.
+        live = jnp.logical_and(
+            live, start + page_size > seq_len + 1 - window
+        )
 
-    @pl.when(start < seq_len + m_tok)
+    @pl.when(live)
     def _step():
         rows_per_kv = m_tok * group
         rows = n_kv * rows_per_kv
         row = jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
         tok = (row % rows_per_kv) // group  # token index per query row
+        limit = seq_len + tok + 1
+        low = jnp.maximum(limit - window, 0) if window else None
         _attend(q_ref[0],
                 k_ref[0].reshape(page_size, n_kv, hd),
                 v_ref[0].reshape(page_size, n_kv, hd),
                 acc_ref, m_ref, l_ref, n_kv=n_kv, n_heads=rows,
                 scale=scale, start=start, seq_len=seq_len,
-                rows_per_kv=rows_per_kv, limit=seq_len + tok + 1)
+                rows_per_kv=rows_per_kv, limit=limit, low=low)
 
     @pl.when(j == n_pages - 1)
     def _finish():
@@ -387,9 +414,9 @@ def _kernel_multi(page_tbl_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "window"))
 def paged_flash_verify(q, k_pages, v_pages, page_table, seq_lens,
-                       interpret=False):
+                       interpret=False, window=0):
     """m-token flash verify over paged KV (same contract as
     paged_attention.multi_token_paged_attention): q [batch, m, n_heads,
     hd]; token j's KV must already be scattered at position
@@ -449,6 +476,7 @@ def paged_flash_verify(q, k_pages, v_pages, page_table, seq_lens,
         hd=hd_p,
         group=group,
         m_tok=m_tok,
+        window=window,
         scale=hd ** -0.5,
     )
     out = pl.pallas_call(
@@ -465,28 +493,30 @@ def paged_flash_verify(q, k_pages, v_pages, page_table, seq_lens,
     return out[:, :, :n_heads, :hd]
 
 
-def verify_attention(q, k_pages, v_pages, page_table, seq_lens):
+def verify_attention(q, k_pages, v_pages, page_table, seq_lens, window=0):
     """m-token paged verify attention with automatic backend choice:
     the pallas streaming kernel on TPU, the XLA gather path elsewhere."""
     if jax.default_backend() == "tpu":
-        return paged_flash_verify(q, k_pages, v_pages, page_table, seq_lens)
+        return paged_flash_verify(q, k_pages, v_pages, page_table, seq_lens,
+                                  window=window)
     return xla_ref.multi_token_paged_attention(
-        q, k_pages, v_pages, page_table, seq_lens
+        q, k_pages, v_pages, page_table, seq_lens, window=window
     )
 
 
-def decode_attention(q, k_pages, v_pages, page_table, seq_lens):
+def decode_attention(q, k_pages, v_pages, page_table, seq_lens, window=0):
     """Paged decode attention with automatic backend choice: the pallas
     flash kernel on TPU, the XLA gather path elsewhere."""
     if jax.default_backend() == "tpu":
-        return paged_flash_decode(q, k_pages, v_pages, page_table, seq_lens)
+        return paged_flash_decode(q, k_pages, v_pages, page_table, seq_lens,
+                                  window=window)
     return xla_ref.paged_decode_attention(
-        q, k_pages, v_pages, page_table, seq_lens
+        q, k_pages, v_pages, page_table, seq_lens, window=window
     )
 
 
 def decode_attention_tp(mesh, q, k_pages, v_pages, page_table, seq_lens,
-                        axis="tp", interpret=None):
+                        axis="tp", interpret=None, window=0):
     """paged_flash_decode under tensor parallelism: kv heads sharded
     over the mesh's `axis`, q heads co-sharded (each device keeps its
     kv heads' whole GQA group), page pool replicated batch-wise but
@@ -514,8 +544,9 @@ def decode_attention_tp(mesh, q, k_pages, v_pages, page_table, seq_lens,
     if n_kv % tp:
         raise ValueError(f"n_kv_heads {n_kv} not divisible by {axis}={tp}")
 
-    def local(q, kp, vp, pt, sl):
-        return paged_flash_decode(q, kp, vp, pt, sl, interpret=interpret)
+    def local(q, kp, vp, pt, sl):  # window closes over statically
+        return paged_flash_decode(q, kp, vp, pt, sl, interpret=interpret,
+                                  window=window)
 
     return shard_map(
         local, mesh=mesh,
@@ -532,7 +563,8 @@ def decode_attention_tp(mesh, q, k_pages, v_pages, page_table, seq_lens,
 
 
 def decode_attention_quantized_tp(mesh, q, k_q, k_s, v_q, v_s, page_table,
-                                  seq_lens, axis="tp", interpret=None):
+                                  seq_lens, axis="tp", interpret=None,
+                                  window=0):
     """Int8 variant of :func:`decode_attention_tp`: quantized pages and
     their per-token-per-head scales both shard on the kv-head dim; the
     fused dequant-in-kernel path runs per device on local heads."""
@@ -549,7 +581,7 @@ def decode_attention_quantized_tp(mesh, q, k_q, k_s, v_q, v_s, page_table,
 
     def local(q, kq, ks, vq, vs, pt, sl):
         return paged_flash_decode_quantized(
-            q, kq, ks, vq, vs, pt, sl, interpret=interpret
+            q, kq, ks, vq, vs, pt, sl, interpret=interpret, window=window
         )
 
     return shard_map(
@@ -568,7 +600,8 @@ def decode_attention_quantized_tp(mesh, q, k_q, k_s, v_q, v_s, page_table,
     )(q, k_q, k_s, v_q, v_s, page_table, seq_lens)
 
 
-def decode_attention_quantized(q, k_q, k_s, v_q, v_s, page_table, seq_lens):
+def decode_attention_quantized(q, k_q, k_s, v_q, v_s, page_table, seq_lens,
+                               window=0):
     """Decode over int8 pages with automatic backend choice: fused
     dequant-in-kernel on TPU; gather-then-dequantize + the XLA path
     elsewhere (gathering FIRST keeps the fallback's footprint at the
@@ -576,7 +609,7 @@ def decode_attention_quantized(q, k_q, k_s, v_q, v_s, page_table, seq_lens):
     quantization buys must survive the fallback)."""
     if jax.default_backend() == "tpu":
         return paged_flash_decode_quantized(
-            q, k_q, k_s, v_q, v_s, page_table, seq_lens
+            q, k_q, k_s, v_q, v_s, page_table, seq_lens, window=window
         )
     from . import kv_quant
 
@@ -595,4 +628,5 @@ def decode_attention_quantized(q, k_q, k_s, v_q, v_s, page_table, seq_lens):
     ident = jnp.arange(batch * max_pages, dtype=jnp.int32).reshape(
         batch, max_pages
     )
-    return xla_ref.paged_decode_attention(q, kg, vg, ident, seq_lens)
+    return xla_ref.paged_decode_attention(q, kg, vg, ident, seq_lens,
+                                          window=window)

@@ -257,10 +257,28 @@ def test_qwen2_checkpoint_loads_and_matches():
     assert np.array_equal(ours.argmax(-1), ref.argmax(-1))
 
 
-def test_qwen2_sliding_window_raises():
-    cfg = transformers.Qwen2Config(use_sliding_window=True)
-    with pytest.raises(NotImplementedError, match="sliding_window"):
-        hf.config_from_hf(cfg)
+def test_qwen2_swa_flag_without_width_is_full_attention():
+    # transformers gates SWA on sliding_window being set; the flag
+    # alone must not activate (or crash) the band.
+    cfg = transformers.Qwen2Config(
+        num_hidden_layers=4, use_sliding_window=True,
+        sliding_window=None, max_window_layers=0,
+    )
+    assert hf.config_from_hf(cfg).window == 0
+
+
+def test_qwen2_all_swa_layers_maps_window():
+    cfg = transformers.Qwen2Config(
+        num_hidden_layers=4, use_sliding_window=True,
+        sliding_window=64, max_window_layers=0,
+    )
+    assert hf.config_from_hf(cfg).window == 64
+    # max_window_layers >= n_layers: every layer keeps full attention.
+    cfg2 = transformers.Qwen2Config(
+        num_hidden_layers=4, use_sliding_window=True,
+        sliding_window=64, max_window_layers=4,
+    )
+    assert hf.config_from_hf(cfg2).window == 0
 
 
 def test_explicit_head_dim_mismatch_raises():
@@ -293,7 +311,110 @@ def test_mistral_checkpoint_loads_and_matches():
     assert np.array_equal(ours.argmax(-1), ref.argmax(-1))
 
 
-def test_mistral_active_sliding_window_raises():
-    cfg = transformers.MistralConfig(sliding_window=64)
-    with pytest.raises(NotImplementedError, match="sliding-window"):
+def test_mistral_sliding_window_prefill_matches_transformers():
+    """A REAL windowed Mistral (sliding_window < seq): the JAX model's
+    banded attention must match transformers' SWA masks exactly."""
+    cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5,
+        rope_theta=10000.0, sliding_window=16, tie_word_embeddings=False,
+    )
+    torch.manual_seed(21)
+    model = transformers.MistralForCausalLM(cfg).eval()
+    jcfg, params = hf.load_hf(model, page_size=8, dtype="float32")
+    assert jcfg.window == 16
+    rng = np.random.default_rng(22)
+    tokens = rng.integers(0, jcfg.vocab_size, (2, 48), dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours, _ = llama.prefill(params, jcfg, jnp.asarray(tokens, jnp.int32))
+    ours = np.asarray(ours)
+    assert np.abs(ours - ref).max() < 2e-4, np.abs(ours - ref).max()
+    assert np.array_equal(ours.argmax(-1), ref.argmax(-1))
+
+
+def test_mistral_sliding_window_paged_decode_matches_transformers():
+    """Windowed paged decode: prefill 40 tokens (2.5 windows), page the
+    KV out/in, decode token 41 — band floor well inside the cache."""
+    cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5,
+        rope_theta=10000.0, sliding_window=16, tie_word_embeddings=False,
+    )
+    torch.manual_seed(23)
+    model = transformers.MistralForCausalLM(cfg).eval()
+    jcfg, params = hf.load_hf(model, page_size=8, dtype="float32")
+    rng = np.random.default_rng(24)
+    seq = 40
+    tokens = rng.integers(0, jcfg.vocab_size, (1, seq + 1), dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()[0, -1]
+    _, kvs = llama.prefill(
+        params, jcfg, jnp.asarray(tokens[:, :seq], jnp.int32)
+    )
+    n_pages = seq // jcfg.page_size
+    max_pages = n_pages + 1
+    k_pages = jnp.zeros(
+        (jcfg.n_layers, max_pages, jcfg.page_size, jcfg.n_kv_heads,
+         jcfg.head_dim), dtype=jcfg.jdtype,
+    )
+    v_pages = jnp.zeros_like(k_pages)
+    for li, (k, v) in enumerate(kvs):
+        kp, vp = llama.kv_to_pages(jcfg, k, v)
+        k_pages = k_pages.at[li, :n_pages].set(kp[0])
+        v_pages = v_pages.at[li, :n_pages].set(vp[0])
+    page_table = jnp.arange(max_pages, dtype=jnp.int32)[None]
+    logits, _, _ = llama.decode_step(
+        params, jcfg,
+        jnp.asarray(tokens[:, seq], jnp.int32).reshape(1),
+        jnp.asarray([seq], jnp.int32),
+        k_pages, v_pages, page_table,
+    )
+    ours = np.asarray(logits[0])
+    assert np.abs(ours - ref).max() < 2e-4, np.abs(ours - ref).max()
+    assert int(ours.argmax()) == int(ref.argmax())
+
+
+def test_qwen2_mixed_window_layers_raises():
+    cfg = transformers.Qwen2Config(
+        num_hidden_layers=8, use_sliding_window=True,
+        sliding_window=64, max_window_layers=4,
+    )
+    with pytest.raises(NotImplementedError, match="mixed per-layer"):
         hf.config_from_hf(cfg)
+
+
+def test_windowed_mistral_serves_through_engine():
+    """End-to-end: a sliding-window checkpoint generates through the
+    real ServingEngine (admission prefill + fused paged decode, both
+    windowed)."""
+    from infinistore_tpu.serving import Request, ServingConfig, ServingEngine
+
+    cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, sliding_window=16,
+    )
+    torch.manual_seed(25)
+    jcfg, params = hf.load_hf(
+        transformers.MistralForCausalLM(cfg).eval(), page_size=8,
+        dtype="float32",
+    )
+    eng = ServingEngine(params, jcfg, ServingConfig(
+        max_slots=2, total_pages=32, max_pages_per_seq=12))
+    toks = []
+    eng.submit(Request("w1", list(range(24)), max_new_tokens=6,
+                       on_token=lambda r, t: toks.append(int(t))))
+    eng.run([])
+    assert len(toks) == 6
+
+    # The engine's windowed token stream matches transformers' greedy
+    # continuation (window genuinely active: prompt 24 > window 16).
+    ids = torch.arange(24)[None]
+    with torch.no_grad():
+        torch.manual_seed(25)  # same weights load_hf consumed
+        model = transformers.MistralForCausalLM(cfg).eval()
+        out = model.generate(ids, max_new_tokens=6, do_sample=False)
+    assert toks == [int(t) for t in out[0, 24:]]
