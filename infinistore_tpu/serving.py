@@ -186,6 +186,13 @@ class _Slot:
     page_ids: list            # pool pages owned, in sequence order
     seq_len: int              # tokens whose KV is in pages
     cached_pages: int = 0     # pages restored from the store at admission
+    released: int = 0         # leading pages returned to the pool (their
+    #                           positions fell wholly below the sliding-
+    #                           window band floor; see _release_windowed)
+    digests: list = field(default_factory=list)  # content-digest chain,
+    digest_h: object = None   # + its hash state — extended incrementally
+    #                           (one sha256 update per page per slot; see
+    #                           _slot_digests)
     generated: list = field(default_factory=list)
     pending: list = field(default_factory=list)  # prompt tokens not yet
     #                                              prefilled (chunked
@@ -450,6 +457,23 @@ class ServingEngine:
             tokens, self.cfg.page_size, n_pages, namespace=self._ns
         )
 
+    def _slot_digests(self, slot, tokens, n_pages):
+        """content_page_digests, amortized per slot: the chain only ever
+        APPENDS as generation grows (page i's digest depends only on
+        tokens < (i+1)*page_size), so each page is hashed once per slot
+        instead of restarting the sha chain at token 0 on every offload
+        — windowed release fires every page_size tokens, which would
+        otherwise make cumulative digest work O(seq^2)."""
+        if slot.digest_h is None:
+            slot.digest_h = hashlib.sha256(self._ns.encode())
+        ps = self.cfg.page_size
+        while len(slot.digests) < n_pages:
+            i = len(slot.digests)
+            chunk = np.asarray(tokens[i * ps:(i + 1) * ps], dtype=np.int32)
+            slot.digest_h.update(chunk.tobytes())
+            slot.digests.append(slot.digest_h.hexdigest()[:32])
+        return slot.digests[:n_pages]
+
     # ---- admission -----------------------------------------------------
 
     def submit(self, req: Request):
@@ -600,6 +624,7 @@ class ServingEngine:
                 cached_pages=hit, generated=[],
                 pending=list(work.prompt[hit * page:]),
             )
+            self._release_windowed(self.slots[slot_idx])
             return
 
         # Suffix prefill, bucketed to a page multiple (causal attention
@@ -640,6 +665,15 @@ class ServingEngine:
         )
         self._emit(slot, [self._pick(work, row_host)])
         self.slots[slot_idx] = slot
+        # Windowed models: restored/prefilled pages wholly below the
+        # band floor go straight back to the pool — they were needed as
+        # the contiguous prefix during the suffix prefill (absolute
+        # rope positions), but no later step can attend them. (The
+        # restore TRANSFER for a long windowed re-admission is still
+        # O(prompt): the content chain is a prefix chain, so skipping
+        # sub-floor pages would break cached_prefix_len — a known,
+        # documented trade.)
+        self._release_windowed(slot)
 
     # ---- decode --------------------------------------------------------
 
@@ -701,23 +735,28 @@ class ServingEngine:
         """The KV being appended this step lands at position seq_len."""
         return self._ensure_pages(slot_idx, slot, slot.seq_len)
 
-    def _offload_full_pages(self, slot):
-        """Persist the slot's NEW full pages to the store (shared by
-        finish and preemption). Offloads FULL pages only — partial tail
-        pages would poison page-granular prefix matching — and skips
-        [0:cached_pages) which the store already holds
-        (first-writer-wins makes re-putting them wasted transfer). Keys
-        hash prompt + generated tokens, so a future request whose prompt
+    def _offload_full_pages(self, slot, hi=None):
+        """Persist the slot's NEW full pages [lo, hi) to the store
+        (shared by finish, preemption and windowed release). Offloads
+        FULL pages only — partial tail pages would poison page-granular
+        prefix matching — and skips [0:cached_pages) which the store
+        already holds (first-writer-wins makes re-putting them wasted
+        transfer) plus [0:released) which was offloaded when the pages
+        left the window. Keys hash prompt + generated tokens (page i's
+        key depends only on tokens < (i+1)*page_size, so release-time
+        and finish-time keys agree), so a future request whose prompt
         extends this sequence hits these pages."""
         if (self.store is None or not self._store_ok
                 or not slot.work.req.cache):
             return
         n_full = slot.seq_len // self.cfg.page_size
-        lo = slot.cached_pages
+        if hi is not None:
+            n_full = min(n_full, hi)
+        lo = max(slot.cached_pages, slot.released)
         if n_full <= lo:
             return
         toks = list(slot.work.prompt) + slot.generated
-        digests = self._digests(toks, n_full)
+        digests = self._slot_digests(slot, toks, n_full)
         try:
             for li in range(self.cfg.n_layers):
                 sel = jnp.asarray(
@@ -746,9 +785,36 @@ class ServingEngine:
         self.stats["offloaded_pages"] += n_full - lo
 
     def _release(self, slot_idx, slot):
-        self.free_pages.extend(slot.page_ids)
+        # [0:released) already went back to the pool when those pages
+        # left the sliding window — freeing them twice would hand the
+        # same pool page to two slots.
+        self.free_pages.extend(slot.page_ids[slot.released:])
         self.slots[slot_idx] = None
         self._pages_rev += 1
+
+    def _release_windowed(self, slot):
+        """Sliding-window KV bound (the rolling-buffer property): pages
+        whose every position is below the band floor (seq_len - window)
+        can never be attended again — decode, verify and suffix prefill
+        all mask below the floor — so their pool pages go back to the
+        free list and live KV stays O(window) per slot however long the
+        generation runs. The page-table ENTRIES keep pointing at the
+        freed (possibly reused) pages: the attention kernels skip
+        sub-floor pages for compute, and the XLA fallbacks mask their
+        logits before the softmax, so reused contents are never
+        observable. Each page is offloaded to the store first (content
+        keys are stable as generation grows), keeping the prefix-hash
+        chain intact for future cache hits and for preemption
+        re-admission."""
+        window = getattr(self.cfg, "window", 0)
+        if not window:
+            return
+        dead = (slot.seq_len - window) // self.cfg.page_size
+        if dead <= slot.released:
+            return
+        self._offload_full_pages(slot, hi=dead)  # best-effort
+        self.free_pages.extend(slot.page_ids[slot.released:dead])
+        slot.released = dead
 
     def _finish(self, slot_idx, slot):
         self.outputs[slot.work.req.request_id] = (
@@ -903,6 +969,7 @@ class ServingEngine:
                     trimmed = True
                 self._emit(s, burst)
                 s.seq_len += len(burst)
+                self._release_windowed(s)
                 self.stats["decoded_tokens"] += len(burst)
             self.stats["decode_steps"] += k
             self.stats["burst_steps"] += 1
@@ -935,6 +1002,7 @@ class ServingEngine:
                 tok = int(nxt[i])
             self._emit(s, [tok])
             s.seq_len += 1
+            self._release_windowed(s)
             self.stats["decoded_tokens"] += 1
         self.stats["decode_steps"] += 1
         return len(active)
@@ -1004,6 +1072,7 @@ class ServingEngine:
             if s.pending:
                 s.pending = s.pending[t:]
                 s.seq_len += t
+                self._release_windowed(s)
                 self.stats["prefill_tokens"] += t
                 if not s.pending:
                     # Prompt fully consumed: the last position's logits
@@ -1016,6 +1085,7 @@ class ServingEngine:
                        if sampler else int(nxt[i, 0]))
                 self._emit(s, [tok])
                 s.seq_len += 1
+                self._release_windowed(s)
                 self.stats["decoded_tokens"] += 1
                 decoded = True
         self.stats["chunk_steps"] += 1
@@ -1114,6 +1184,7 @@ class ServingEngine:
                 appended = appended[: appended.index(self.sc.eos_id) + 1]
             self._emit(s, appended)
             s.seq_len += len(appended)
+            self._release_windowed(s)
             self.stats["spec_proposed"] += len(p)
             # Draft tokens actually EMITTED (EOS truncation may drop
             # matched drafts; if the bonus was cut, every emitted token

@@ -923,3 +923,107 @@ def test_steady_cache_keeps_inactive_rows_zero(params, cfg):
             assert lens[i] > 0
         else:
             assert lens[i] == 0, (i, lens)
+
+
+# ---- sliding-window KV bound (rolling-buffer property) ----
+
+
+@pytest.fixture(scope="module")
+def wcfg(cfg):
+    import dataclasses
+
+    return dataclasses.replace(cfg, window=16)
+
+
+@pytest.fixture(scope="module")
+def wparams(wcfg):
+    return llama.init_params(jax.random.PRNGKey(0), wcfg)
+
+
+def test_windowed_release_bounds_live_pages(wparams, wcfg):
+    """A windowed model's live KV stays O(window) per slot however long
+    the generation runs: pages below the band floor return to the pool
+    mid-generation."""
+    rng = np.random.default_rng(51)
+    sc = ServingConfig(max_slots=1, total_pages=32, max_pages_per_seq=16)
+    eng = ServingEngine(wparams, wcfg, sc)
+    eng.submit(Request("w", _prompt(rng, wcfg, 8), max_new_tokens=64))
+    eng.step()  # admission
+    max_used = 0
+    while eng.queue or any(s is not None for s in eng.slots):
+        used = (sc.total_pages - 1) - len(eng.free_pages)
+        max_used = max(max_used, used)
+        eng.step()
+    # 72 tokens at page 8 = 9 pages without release; the window (16
+    # tokens = 2 pages) plus the partial tail and one in-flight page
+    # bound the live set far below that.
+    assert max_used <= 4, max_used
+    slot_out = eng.outputs["w"]
+    assert len(slot_out) == 64
+
+
+def test_windowed_release_stream_identical_to_no_release(wparams, wcfg):
+    """Freeing sub-floor pages (and letting the pool reuse them while
+    stale table entries still point there) must never change a single
+    token: the band mask makes freed positions unobservable."""
+    rng = np.random.default_rng(53)
+    prompt_a = _prompt(rng, wcfg, 8)
+    prompt_b = _prompt(rng, wcfg, 12)
+    sc = ServingConfig(max_slots=2, total_pages=64, max_pages_per_seq=16)
+
+    eng = ServingEngine(wparams, wcfg, sc)
+    out = eng.run([
+        Request("a", prompt_a, max_new_tokens=48),
+        Request("b", prompt_b, max_new_tokens=48),
+    ])
+
+    ref_eng = ServingEngine(wparams, wcfg, sc)
+    ref_eng._release_windowed = lambda slot: None  # release disabled
+    ref = ref_eng.run([
+        Request("a", prompt_a, max_new_tokens=48),
+        Request("b", prompt_b, max_new_tokens=48),
+    ])
+    assert out["a"] == ref["a"]
+    assert out["b"] == ref["b"]
+
+
+def test_windowed_release_keeps_store_chain(wparams, wcfg, shm_conn):
+    """Pages are offloaded to the store BEFORE leaving the pool, so the
+    content-key chain stays intact and a repeat of the same prompt
+    still prefix-hits."""
+    from infinistore_tpu.tpu import TpuKVStore
+
+    rng = np.random.default_rng(55)
+    prompt = _prompt(rng, wcfg, 24)
+    store = TpuKVStore(shm_conn)
+    sc = ServingConfig(max_slots=1, total_pages=32, max_pages_per_seq=16,
+                       model_id="winchain")
+    eng = ServingEngine(wparams, wcfg, sc, store=store)
+    out1 = eng.run([Request("c1", prompt, max_new_tokens=40)])
+    assert eng.stats["offloaded_pages"] > 0
+
+    eng2 = ServingEngine(wparams, wcfg, sc, store=store)
+    out2 = eng2.run([Request("c2", prompt, max_new_tokens=40)])
+    assert eng2.stats["prefix_hit_pages"] > 0  # chain intact
+    assert out1["c1"] == out2["c2"]
+
+
+def test_windowed_release_stream_identical_spec_and_chunked(wparams, wcfg):
+    """The speculative-verify and chunked-prefill release sites must be
+    as unobservable as the plain-decode one: stream parity vs a
+    release-disabled engine under spec_k>0 and prefill_chunk>0."""
+    rng = np.random.default_rng(57)
+    prompt = _prompt(rng, wcfg, 20)
+    for sc in (
+        ServingConfig(max_slots=2, total_pages=64, max_pages_per_seq=16,
+                      spec_k=3),
+        ServingConfig(max_slots=2, total_pages=64, max_pages_per_seq=16,
+                      prefill_chunk=8),
+    ):
+        eng = ServingEngine(wparams, wcfg, sc)
+        out = eng.run([Request("s", prompt, max_new_tokens=40)])
+        ref_eng = ServingEngine(wparams, wcfg, sc)
+        ref_eng._release_windowed = lambda slot: None
+        ref = ref_eng.run([Request("s", prompt, max_new_tokens=40)])
+        assert out["s"] == ref["s"], sc
+        assert len(out["s"]) == 40
