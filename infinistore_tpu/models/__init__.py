@@ -2,7 +2,10 @@ from .llama import (  # noqa: F401
     LlamaConfig,
     decode_step,
     init_params,
+    init_params_quantized,
     prefill,
     prefill_with_prefix,
+    quantize_params,
     train_step,
 )
+from .hf import load_hf  # noqa: F401

@@ -337,18 +337,26 @@ def _logits(params, x):
     return _matmul(x, params["lm_head"]).astype(jnp.float32)
 
 
-def _forward_stack(params, cfg: LlamaConfig, tokens, prefix_kvs=None):
+def _forward_stack(params, cfg: LlamaConfig, tokens, prefix_kvs=None,
+                   pos0=0):
     """The ONE decoder-stack loop shared by dense forward and
     prefix-cached prefill (the cache-hit identity depends on these two
     paths never diverging). With `prefix_kvs` (per-layer (k, v) of shape
     [batch, P, n_kv, hd], post-RoPE), positions shift by P and each
     layer attends over prefix + suffix KV through the rectangular flash
-    kernel; with None this reduces exactly to the dense causal forward."""
+    kernel; with None this reduces exactly to the dense causal forward.
+
+    `pos0` shifts every ABSOLUTE rope position (prefix starts at pos0,
+    suffix at pos0 + P): a sliding-window engine trims the restored
+    prefix to the in-window tail pages, whose KV was roped at absolute
+    positions — the band mask itself needs no shift because it depends
+    only on RELATIVE (query - key) distance, which local indices
+    preserve."""
     b, s = tokens.shape
     prefix_len = 0 if prefix_kvs is None else prefix_kvs[0][0].shape[1]
     x = _embed(params, tokens)
     positions = jnp.broadcast_to(
-        prefix_len + jnp.arange(s)[None], (b, s)
+        pos0 + prefix_len + jnp.arange(s)[None], (b, s)
     )
     kvs = []
     for li, layer in enumerate(params["layers"]):
@@ -384,7 +392,8 @@ def prefill(params, cfg: LlamaConfig, tokens):
     return forward_dense(params, cfg, tokens)
 
 
-def prefill_with_prefix(params, cfg: LlamaConfig, tokens, prefix_kvs):
+def prefill_with_prefix(params, cfg: LlamaConfig, tokens, prefix_kvs,
+                        pos0=0):
     """Suffix prefill over a cached prefix — the store's cache-HIT path.
 
     This is what a prefix-cache hit buys (reference design.rst:54-63:
@@ -403,8 +412,11 @@ def prefill_with_prefix(params, cfg: LlamaConfig, tokens, prefix_kvs):
 
     Returns (logits [batch, s_new, vocab] fp32, per-layer suffix (k, v)
     [batch, s_new, n_kv, hd] — the new pages to put to the store).
+    `pos0`: absolute position of the prefix's first token (see
+    _forward_stack — used by the windowed engine's trimmed-prefix
+    admission).
     """
-    return _forward_stack(params, cfg, tokens, prefix_kvs)
+    return _forward_stack(params, cfg, tokens, prefix_kvs, pos0=pos0)
 
 
 @partial(jax.jit, static_argnames=("cfg",))

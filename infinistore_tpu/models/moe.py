@@ -177,7 +177,8 @@ def _moe_mlp(layer, x, cfg: MoEConfig, valid=None):
     return out.reshape(b, s, d), aux
 
 
-def _forward_stack(params, cfg: MoEConfig, tokens, prefix_kvs=None):
+def _forward_stack(params, cfg: MoEConfig, tokens, prefix_kvs=None,
+                   pos0=0):
     """The decoder-stack loop shared by dense forward and prefix-cached
     prefill (mirrors llama._forward_stack — same attention, routed
     FFN): with `prefix_kvs` the positions shift by the prefix length
@@ -187,7 +188,7 @@ def _forward_stack(params, cfg: MoEConfig, tokens, prefix_kvs=None):
     prefix_len = 0 if prefix_kvs is None else prefix_kvs[0][0].shape[1]
     x = _llama._embed(params, tokens)
     positions = jnp.broadcast_to(
-        prefix_len + jnp.arange(s)[None], (b, s)
+        pos0 + prefix_len + jnp.arange(s)[None], (b, s)
     )
     kvs = []
     aux_total = jnp.float32(0)
@@ -222,11 +223,13 @@ def prefill(params, cfg: MoEConfig, tokens):
     return logits, kvs
 
 
-def prefill_with_prefix(params, cfg: MoEConfig, tokens, prefix_kvs):
+def prefill_with_prefix(params, cfg: MoEConfig, tokens, prefix_kvs,
+                        pos0=0):
     """Suffix prefill over a cached prefix — the cache-HIT path, same
     contract as llama.prefill_with_prefix (the serving engine calls it
     through its model parameter)."""
-    logits, kvs, _ = _forward_stack(params, cfg, tokens, prefix_kvs)
+    logits, kvs, _ = _forward_stack(params, cfg, tokens, prefix_kvs,
+                                    pos0=pos0)
     return logits, kvs
 
 

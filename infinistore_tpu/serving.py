@@ -76,13 +76,24 @@ def content_page_digests(tokens, page_size, n_pages, namespace=""):
     and format the per-(layer, kind) keys with `content_page_keys`."""
     digests = []
     h = hashlib.sha256(namespace.encode())
-    for i in range(n_pages):
-        chunk = np.asarray(
-            tokens[i * page_size:(i + 1) * page_size], dtype=np.int32
-        )
+    _extend_digest_chain(
+        h, digests,
+        lambda i: tokens[i * page_size:(i + 1) * page_size], n_pages,
+    )
+    return digests
+
+
+def _extend_digest_chain(h, digests, get_chunk, n_pages):
+    """Append pages [len(digests), n_pages) to a digest chain in place —
+    the ONE definition of the per-page hash step (dtype, framing,
+    truncation), shared by content_page_digests and the engine's
+    per-slot incremental chain so the two can never drift (a drift
+    would turn every prefix probe into a silent miss). `get_chunk(i)`
+    returns page i's token slice."""
+    for i in range(len(digests), n_pages):
+        chunk = np.asarray(get_chunk(i), dtype=np.int32)
         h.update(chunk.tobytes())
         digests.append(h.hexdigest()[:32])
-    return digests
 
 
 def content_page_keys(tokens, page_size, n_pages, layer, kind,
@@ -236,13 +247,14 @@ class _LazyHost:
 
 
 @partial(jax.jit, static_argnames=("cfg", "model"))
-def _prefill_px_jit(params, cfg, tokens, prefix_kvs, model=llama):
+def _prefill_px_jit(params, cfg, tokens, prefix_kvs, pos0=0, model=llama):
     """Module-level prefix-HIT prefill jit (static cfg + model family):
     every engine with the same config shares one compilation — a
     per-engine jax.jit(partial) would silently recompile identical HLO
     for each new engine instance (measured: ~30 s per instance on the
     axon tunnel). Cold admissions use _admit_fused instead."""
-    return model.prefill_with_prefix(params, cfg, tokens, prefix_kvs)
+    return model.prefill_with_prefix(params, cfg, tokens, prefix_kvs,
+                                     pos0=pos0)
 
 
 @partial(jax.jit, static_argnames=("cfg", "n_steps", "model"),
@@ -457,21 +469,34 @@ class ServingEngine:
             tokens, self.cfg.page_size, n_pages, namespace=self._ns
         )
 
-    def _slot_digests(self, slot, tokens, n_pages):
+    def _slot_digests(self, slot, n_pages):
         """content_page_digests, amortized per slot: the chain only ever
         APPENDS as generation grows (page i's digest depends only on
         tokens < (i+1)*page_size), so each page is hashed once per slot
         instead of restarting the sha chain at token 0 on every offload
         — windowed release fires every page_size tokens, which would
-        otherwise make cumulative digest work O(seq^2)."""
+        otherwise make cumulative digest work O(seq^2). Token chunks
+        come straight from prompt/generated slices (no O(seq) list
+        concatenation per call)."""
+        if len(slot.digests) >= n_pages:
+            return slot.digests[:n_pages]
         if slot.digest_h is None:
             slot.digest_h = hashlib.sha256(self._ns.encode())
         ps = self.cfg.page_size
-        while len(slot.digests) < n_pages:
-            i = len(slot.digests)
-            chunk = np.asarray(tokens[i * ps:(i + 1) * ps], dtype=np.int32)
-            slot.digest_h.update(chunk.tobytes())
-            slot.digests.append(slot.digest_h.hexdigest()[:32])
+        prompt = slot.work.prompt
+        n_p = len(prompt)
+
+        def tok_slice(a, b):
+            if b <= n_p:
+                return prompt[a:b]
+            if a >= n_p:
+                return slot.generated[a - n_p:b - n_p]
+            return list(prompt[a:]) + list(slot.generated[:b - n_p])
+
+        _extend_digest_chain(
+            slot.digest_h, slot.digests,
+            lambda i: tok_slice(i * ps, (i + 1) * ps), n_pages,
+        )
         return slot.digests[:n_pages]
 
     # ---- admission -----------------------------------------------------
@@ -555,26 +580,63 @@ class ServingEngine:
     def _admit(self, slot_idx, work):
         n_prompt = len(work.prompt)
         n_pages = -(-n_prompt // self.cfg.page_size)
-        ids = self._alloc(n_pages)
-        if ids is None:
-            return False  # pool pressure: stay queued
-        try:
-            self._do_admit(slot_idx, work, ids, n_prompt, n_pages)
-        except BaseException:
-            # Restore/prefill failed (store eviction race, connection
-            # loss): the pages must go back or the pool leaks.
-            self.free_pages.extend(ids)
-            raise
-        return True
+        return self._do_admit(slot_idx, work, n_prompt, n_pages)
 
-    def _do_admit(self, slot_idx, work, ids, n_prompt, n_pages):
+    def _do_admit(self, slot_idx, work, n_prompt, n_pages):
         cfg = self.cfg
         page = cfg.page_size
+        window = cfg.window
         hit, digests = self._probe_hit(work)
+        # Windowed admission floors. Three distinct boundaries:
+        #   first_live — earliest page the SUFFIX PREFILL can attend
+        #     (the first suffix query sits at hit*page; its band floor
+        #     is hit*page - window + 1), so restore transfers only
+        #     [first_live, hit);
+        #   p0 — earliest page anything can attend AFTER admission
+        #     (floor of the last prompt position), so the one-shot path
+        #     allocates pool pages only for [p0, n_pages) — this is
+        #     what makes preemption re-admission of an over-pool grown
+        #     prompt possible at all: the pool cost is O(window), not
+        #     O(prompt);
+        #   the chunked path allocates from first_live instead (its
+        #     chunk queries attend POOL pages, and its floor rises as
+        #     chunks consume the prompt — _release_windowed frees on
+        #     the way).
+        first_live = max(0, hit * page - window + 1) // page if window \
+            else 0
+        p0 = max(0, n_prompt - window) // page if window else 0
+        # How many leading pages never get a pool page:
+        #   - with a store (and caching on), only pages the store
+        #     ALREADY holds ([0, first_live) ⊆ the hit) can be skipped
+        #     — un-cached sub-floor pages must be materialized once so
+        #     release can offload them and keep the prefix chain
+        #     gap-free for future hits;
+        #   - store-less (or cache=False), nothing is ever offloaded,
+        #     so every page below the post-admission floor (p0) is
+        #     droppable outright;
+        #   - the chunked path always needs pool pages from first_live
+        #     (its chunk queries attend POOL pages, floor rising as
+        #     chunks consume the prompt).
+        store_chain = (self.store is not None and self._store_ok
+                       and work.req.cache)
+        if self.sc.prefill_chunk > 0 or store_chain:
+            skip = min(first_live, hit)
+        else:
+            skip = p0
+        # Allocate BEFORE restoring: under pool pressure a queued
+        # request retries admission every step, and paying the store
+        # transfer just to throw it away on a failed _alloc (and
+        # inflating the hit/restore stats each retry) would make
+        # waiting quadratically expensive. skip depends only on the
+        # probe, never on the restore.
+        ids = self._alloc(n_pages - skip)
+        if ids is None:
+            return False  # pool pressure: stay queued
         prefix_kvs = None
+        kp = vp = None
         if hit > 0:
-            # Restore hit pages once: page form goes into the pool,
-            # contiguous form feeds the suffix prefill. Digests are
+            # Restore the in-window hit pages once (into HBM tensors;
+            # pool placement follows in _do_admit_paged). Digests are
             # layer/kind-independent and come from the probe — the
             # prompt is hashed ONCE per admission.
             try:
@@ -582,8 +644,8 @@ class ServingEngine:
                     self.store, cfg,
                     lambda li, kind: content_page_keys(
                         work.prompt, page, hit, li, kind, digests=digests
-                    ),
-                    hit,
+                    )[first_live:],
+                    hit - first_live,
                     getter=self._get_pages,
                 )
             except InfiniStoreKeyNotFound:
@@ -597,20 +659,63 @@ class ServingEngine:
                 self._store_failed("restore", e)
                 hit = 0
             else:
-                self._pool_write(ids[:hit], kp, vp)
                 if self.sc.prefill_chunk == 0:
                     # Contiguous form for the one-shot suffix prefill;
                     # the chunked path attends straight over the pages.
                     prefix_kvs = [
                         llama.pages_to_kv(cfg, kp[li][None], vp[li][None],
-                                          hit * page)
+                                          (hit - first_live) * page)
                         for li in range(cfg.n_layers)
                     ]
                 self.stats["prefix_hit_pages"] += hit
-                self.stats["restored_pages"] += hit * cfg.n_layers * 2
+                self.stats["restored_pages"] += (
+                    (hit - first_live) * cfg.n_layers * 2
+                )
+            if hit == 0 and skip > 0:
+                # Restore failed after a skip-trimmed allocation: the
+                # cold path needs the skipped pages after all. Top up
+                # or put everything back and stay queued.
+                extra = self._alloc(skip)
+                if extra is None:
+                    self.free_pages.extend(ids)
+                    return False
+                ids = extra + ids
+                first_live = 0
+                skip = 0
+        try:
+            self._do_admit_paged(
+                slot_idx, work, ids, n_prompt, n_pages, hit, skip,
+                first_live, prefix_kvs, kp, vp,
+            )
+        except BaseException:
+            # Restore/prefill failed (connection loss mid-admission):
+            # the pages must go back or the pool leaks.
+            self.free_pages.extend(ids)
+            raise
+        return True
+
+    def _do_admit_paged(self, slot_idx, work, ids, n_prompt, n_pages,
+                        hit, skip, first_live, prefix_kvs, kp, vp):
+        cfg = self.cfg
+        page = cfg.page_size
+        # page_ids[i] for i < skip are dead placeholders (page 0, the
+        # scratch page): nothing after admission can attend positions
+        # below the band floor, and _release/_offload honor
+        # slot.released = skip so they are never freed or offloaded.
+        full_ids = [0] * skip + ids
+        if hit > skip and kp is not None:
+            # Pool placement for restored pages the FUTURE (decode)
+            # needs: [skip, hit) — restored tensors cover
+            # [first_live, hit).
+            lo = skip - first_live
+            self._pool_write(
+                ids[: hit - skip],
+                kp[:, lo: hit - first_live],
+                vp[:, lo: hit - first_live],
+            )
 
         row = np.zeros(self.sc.max_pages_per_seq, dtype=np.int32)
-        row[:n_pages] = ids
+        row[skip:n_pages] = ids
         self._pages_rev += 1  # admission rewrites this slot's row
         if self.sc.prefill_chunk > 0:
             # Chunked admission: no bulk prefill here — the prompt tail
@@ -620,8 +725,8 @@ class ServingEngine:
             # runs straight over the pages.
             self.page_table[slot_idx] = row
             self.slots[slot_idx] = _Slot(
-                work=work, page_ids=ids, seq_len=hit * page,
-                cached_pages=hit, generated=[],
+                work=work, page_ids=full_ids, seq_len=hit * page,
+                cached_pages=hit, released=skip, generated=[],
                 pending=list(work.prompt[hit * page:]),
             )
             self._release_windowed(self.slots[slot_idx])
@@ -638,15 +743,27 @@ class ServingEngine:
         if prefix_kvs is None:
             # Cold admission (hit == 0): one fused device program does
             # prefill + page-out + pool scatter + logits-row slice.
+            # Dead prompt pages [0, skip) scatter to the drop sentinel:
+            # no pool page was allocated for them.
+            ids_p = np.full(self.sc.max_pages_per_seq,
+                            self.sc.total_pages, dtype=np.int32)
+            ids_p[skip:n_pages] = ids
             row_dev, self.k_pages, self.v_pages = _admit_fused(
                 self.params, cfg, toks, self.k_pages, self.v_pages,
-                jnp.asarray(self._pad_ids(ids)), jnp.asarray(s_real),
+                jnp.asarray(ids_p), jnp.asarray(s_real),
                 model=self.model,
             )
             row_host = np.asarray(row_dev)
         else:
-            logits, kvs = self._prefill_px(toks, prefix_kvs)
-            # Page out the suffix KV into the pool (real tokens only).
+            # pos0 anchors the trimmed prefix's absolute rope
+            # positions; the band mask is relative, so local indices
+            # inside the kernel stay correct (llama._forward_stack).
+            logits, kvs = self._prefill_px(
+                toks, prefix_kvs, jnp.int32(first_live * page)
+            )
+            # Page out the suffix KV into the pool (real tokens only;
+            # suffix pages below the post-admission floor are dead and
+            # get no pool page — dropped here).
             k_sfx = jnp.stack([k[:, :s_real] for k, _ in kvs])
             v_sfx = jnp.stack([v[:, :s_real] for _, v in kvs])
             kp_s, vp_s = [], []
@@ -654,25 +771,27 @@ class ServingEngine:
                 a, b = llama.kv_to_pages(cfg, k_sfx[li], v_sfx[li])
                 kp_s.append(a[0])
                 vp_s.append(b[0])
-            self._pool_write(ids[hit:], jnp.stack(kp_s), jnp.stack(vp_s))
+            off = max(0, skip - hit)
+            tgt = ids[max(0, hit - skip):]
+            self._pool_write(tgt, jnp.stack(kp_s)[:, off:],
+                             jnp.stack(vp_s)[:, off:])
             row_host = np.asarray(logits[0, s_real - 1])
         self.stats["prefill_tokens"] += s_real
 
         self.page_table[slot_idx] = row
 
         slot = _Slot(
-            work=work, page_ids=ids, seq_len=n_prompt, cached_pages=hit,
+            work=work, page_ids=full_ids, seq_len=n_prompt,
+            cached_pages=hit, released=skip,
         )
         self._emit(slot, [self._pick(work, row_host)])
         self.slots[slot_idx] = slot
-        # Windowed models: restored/prefilled pages wholly below the
-        # band floor go straight back to the pool — they were needed as
-        # the contiguous prefix during the suffix prefill (absolute
-        # rope positions), but no later step can attend them. (The
-        # restore TRANSFER for a long windowed re-admission is still
-        # O(prompt): the content chain is a prefix chain, so skipping
-        # sub-floor pages would break cached_prefix_len — a known,
-        # documented trade.)
+        # Windowed models: any remaining pages wholly below the band
+        # floor go straight back to the pool (with a store, un-cached
+        # ones were materialized so this release can offload them and
+        # keep the prefix chain gap-free; the restore TRANSFER was
+        # already trimmed to [first_live, hit) — only the PROBE's key
+        # list stays O(prompt), it is hash-only).
         self._release_windowed(slot)
 
     # ---- decode --------------------------------------------------------
@@ -755,26 +874,28 @@ class ServingEngine:
         lo = max(slot.cached_pages, slot.released)
         if n_full <= lo:
             return
-        toks = list(slot.work.prompt) + slot.generated
-        digests = self._slot_digests(slot, toks, n_full)
+        # Digests come from the slot's incremental chain and only the
+        # [lo, n_full) keys are ever formatted — windowed release calls
+        # this every page_size tokens, so per-call work must stay
+        # O(pages released), not O(seq). (The sync below is one
+        # loopback RTT per released page — page contents must be
+        # durable in the store BEFORE the pool page is freed for
+        # reuse.)
+        new_digests = self._slot_digests(slot, n_full)[lo:]
         try:
             for li in range(self.cfg.n_layers):
                 sel = jnp.asarray(
                     np.asarray(slot.page_ids[lo:n_full], np.int32)
                 )
-                k_keys = content_page_keys(
-                    toks, self.cfg.page_size, n_full, li, "k",
-                    digests=digests,
-                )
-                v_keys = content_page_keys(
-                    toks, self.cfg.page_size, n_full, li, "v",
-                    digests=digests,
+                self._put_pages(
+                    content_page_keys([], 0, 0, li, "k",
+                                      digests=new_digests),
+                    jnp.take(self.k_pages[li], sel, axis=0),
                 )
                 self._put_pages(
-                    k_keys[lo:], jnp.take(self.k_pages[li], sel, axis=0),
-                )
-                self._put_pages(
-                    v_keys[lo:], jnp.take(self.v_pages[li], sel, axis=0),
+                    content_page_keys([], 0, 0, li, "v",
+                                      digests=new_digests),
+                    jnp.take(self.v_pages[li], sel, axis=0),
                 )
             self.store.conn.sync()
         except Exception as e:

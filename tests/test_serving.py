@@ -1027,3 +1027,89 @@ def test_windowed_release_stream_identical_spec_and_chunked(wparams, wcfg):
         ref = ref_eng.run([Request("s", prompt, max_new_tokens=40)])
         assert out["s"] == ref["s"], sc
         assert len(out["s"]) == 40
+
+
+def test_windowed_preemption_readmits_beyond_pool(wparams, wcfg, shm_conn):
+    """The capability windowed admission exists for: a sequence whose
+    GROWN length exceeds the whole pool must still re-admit after
+    preemption — sub-floor pages are already in the store, so
+    re-admission allocates only O(window) pool pages — and finish its
+    FULL requested length (no silent truncation)."""
+    from infinistore_tpu.tpu import TpuKVStore
+
+    rng = np.random.default_rng(61)
+    store = TpuKVStore(shm_conn)
+    # 7 usable pages; each request grows to 8+72=80 tokens = 10 pages.
+    sc = ServingConfig(max_slots=2, total_pages=8, max_pages_per_seq=16,
+                       model_id="winpool")
+    eng = ServingEngine(wparams, wcfg, sc, store=store)
+    reqs = [Request(f"g{i}", _prompt(rng, wcfg, 8), max_new_tokens=72)
+            for i in range(2)]
+    out = eng.run([Request(r.request_id, r.prompt, r.max_new_tokens)
+                   for r in reqs])
+    for r in reqs:
+        assert len(out[r.request_id]) == 72, (
+            r.request_id, len(out[r.request_id])
+        )
+        big = ServingEngine(wparams, wcfg, ServingConfig(
+            max_slots=1, total_pages=32, max_pages_per_seq=16))
+        ref = big.run([Request("x", r.prompt, max_new_tokens=72)])
+        assert out[r.request_id] == ref["x"], r.request_id
+
+
+def test_windowed_release_poisoned_reuse_parity(wparams, wcfg):
+    """The reuse-safety claim, made falsifiable: freed pages are
+    POISONED with a huge finite value while stale page-table entries
+    still point at them — if any attention path attended one sub-floor
+    position, the poisoned logits would dominate the softmax and the
+    stream would diverge. (Finite, not NaN: masked positions contribute
+    probability-zero times the value, and 0 * NaN = NaN would trip the
+    test on the mask itself — production reuse writes finite floats.)"""
+    rng = np.random.default_rng(63)
+    prompt = _prompt(rng, wcfg, 8)
+    sc = ServingConfig(max_slots=1, total_pages=32, max_pages_per_seq=16)
+
+    ref_eng = ServingEngine(wparams, wcfg, sc)
+    ref_eng._release_windowed = lambda slot: None
+    ref = ref_eng.run([Request("p", prompt, max_new_tokens=48)])
+
+    eng = ServingEngine(wparams, wcfg, sc)
+    eng.submit(Request("p", prompt, max_new_tokens=48))
+    eng.step()  # admission
+    while eng.queue or any(s is not None for s in eng.slots):
+        freed = [p for p in eng.free_pages if p != 0]
+        if freed:
+            sel = jnp.asarray(np.asarray(freed, np.int32))
+            eng.k_pages = eng.k_pages.at[:, sel].set(1e4)
+            eng.v_pages = eng.v_pages.at[:, sel].set(1e4)
+        eng.step()
+    assert eng.outputs["p"] == ref["p"]
+
+
+def test_windowed_release_chunked_with_store(wparams, wcfg, shm_conn):
+    """Chunked-prefill release sites under a store: a prompt much
+    longer than the window frees pages DURING chunk consumption and at
+    chunked admission on the repeat (hit path), with stream parity vs
+    a release-disabled engine and an intact store chain."""
+    from infinistore_tpu.tpu import TpuKVStore
+
+    rng = np.random.default_rng(65)
+    prompt = _prompt(rng, wcfg, 40)  # 5 pages, window 16 = 2 pages
+    store = TpuKVStore(shm_conn)
+    sc = ServingConfig(max_slots=2, total_pages=64, max_pages_per_seq=16,
+                       prefill_chunk=8, model_id="winchunk")
+    eng = ServingEngine(wparams, wcfg, sc, store=store)
+    out1 = eng.run([Request("k1", prompt, max_new_tokens=24)])
+
+    ref_eng = ServingEngine(wparams, wcfg, ServingConfig(
+        max_slots=2, total_pages=64, max_pages_per_seq=16,
+        prefill_chunk=8))
+    ref_eng._release_windowed = lambda slot: None
+    ref = ref_eng.run([Request("k1", prompt, max_new_tokens=24)])
+    assert out1["k1"] == ref["k1"]
+
+    # Repeat: chunked admission takes the hit path with trimmed alloc.
+    eng2 = ServingEngine(wparams, wcfg, sc, store=store)
+    out2 = eng2.run([Request("k2", prompt, max_new_tokens=24)])
+    assert eng2.stats["prefix_hit_pages"] > 0
+    assert out2["k2"] == out1["k1"]
