@@ -185,10 +185,22 @@ class _Work:
     prompt: list
     done: list = field(default_factory=list)
     rng: object = None
+    probe: tuple = None   # cached (hit, digests) from _probe_hit — a
+    #                       queued request retries admission every step
+    #                       under pool pressure, and re-hashing the
+    #                       prompt + re-RPCing the store per retry
+    #                       would throttle the running slots' decode
+    #                       (invalidated whenever prompt changes:
+    #                       preemption)
 
     def __post_init__(self):
         if self.req.temperature > 0 and self.rng is None:
             self.rng = np.random.default_rng(self.req.seed)
+
+
+class _AdmitPagesRefunded(Exception):
+    """Internal: admission already returned its pages to the pool and
+    the request should simply stay queued (not an error)."""
 
 
 @dataclass
@@ -524,14 +536,17 @@ class ServingEngine:
         ids, self.free_pages = self.free_pages[:n], self.free_pages[n:]
         return ids
 
-    def _pad_ids(self, ids):
+    def _pad_ids(self, ids, offset=0):
         """Pad a page-id list to the fixed arity max_pages_per_seq with
         the total_pages sentinel (mode=\"drop\" discards those writes) —
         the ONE place the fixed-arity convention lives (shared by
-        _pool_write and the fused cold-admission path)."""
+        _pool_write and the fused cold-admission path). `offset` places
+        the ids at [offset, offset+len): the windowed cold path drops
+        its dead leading pages by leaving [0, offset) at the
+        sentinel."""
         ids_p = np.full(self.sc.max_pages_per_seq, self.sc.total_pages,
                         dtype=np.int32)
-        ids_p[:len(ids)] = ids
+        ids_p[offset:offset + len(ids)] = ids
         return ids_p
 
     def _pool_write(self, ids, k_new, v_new):
@@ -586,7 +601,9 @@ class ServingEngine:
         cfg = self.cfg
         page = cfg.page_size
         window = cfg.window
-        hit, digests = self._probe_hit(work)
+        if work.probe is None:
+            work.probe = self._probe_hit(work)
+        hit, digests = work.probe
         # Windowed admission floors. Three distinct boundaries:
         #   first_live — earliest page the SUFFIX PREFILL can attend
         #     (the first suffix query sits at hit*page; its band floor
@@ -632,6 +649,35 @@ class ServingEngine:
         ids = self._alloc(n_pages - skip)
         if ids is None:
             return False  # pool pressure: stay queued
+        return self._admit_with_pages(
+            slot_idx, work, ids, n_prompt, n_pages, hit, digests,
+            skip, first_live,
+        )
+
+    def _admit_with_pages(self, slot_idx, work, ids, n_prompt, n_pages,
+                          hit, digests, skip, first_live):
+        """Everything after a successful allocation, wrapped so that
+        ANY escaping exception (restore-side OOM building prefix_kvs,
+        prefill failure, connection loss) refunds the pages — `ids`
+        may be rebound by the restore-failure top-up, and the handler
+        sees the latest binding."""
+        try:
+            return self._admit_restore_and_prefill(
+                slot_idx, work, ids, n_prompt, n_pages, hit, digests,
+                skip, first_live,
+            )
+        except _AdmitPagesRefunded:
+            return False
+        except BaseException:
+            self.free_pages.extend(self._admit_ids_view)
+            raise
+
+    def _admit_restore_and_prefill(self, slot_idx, work, ids, n_prompt,
+                                   n_pages, hit, digests, skip,
+                                   first_live):
+        cfg = self.cfg
+        page = cfg.page_size
+        self._admit_ids_view = ids
         prefix_kvs = None
         kp = vp = None
         if hit > 0:
@@ -678,20 +724,16 @@ class ServingEngine:
                 extra = self._alloc(skip)
                 if extra is None:
                     self.free_pages.extend(ids)
-                    return False
+                    raise _AdmitPagesRefunded()
                 ids = extra + ids
+                self._admit_ids_view = ids
                 first_live = 0
                 skip = 0
-        try:
-            self._do_admit_paged(
-                slot_idx, work, ids, n_prompt, n_pages, hit, skip,
-                first_live, prefix_kvs, kp, vp,
-            )
-        except BaseException:
-            # Restore/prefill failed (connection loss mid-admission):
-            # the pages must go back or the pool leaks.
-            self.free_pages.extend(ids)
-            raise
+        self._do_admit_paged(
+            slot_idx, work, ids, n_prompt, n_pages, hit, skip,
+            first_live, prefix_kvs, kp, vp,
+        )
+        work.probe = None  # consumed; a future re-admission re-probes
         return True
 
     def _do_admit_paged(self, slot_idx, work, ids, n_prompt, n_pages,
@@ -704,14 +746,15 @@ class ServingEngine:
         # slot.released = skip so they are never freed or offloaded.
         full_ids = [0] * skip + ids
         if hit > skip and kp is not None:
-            # Pool placement for restored pages the FUTURE (decode)
-            # needs: [skip, hit) — restored tensors cover
-            # [first_live, hit).
-            lo = skip - first_live
+            # Pool placement for the restored pages. A hit implies the
+            # store_chain branch chose skip = first_live, so the
+            # restored tensors ([first_live, hit)) and the pool targets
+            # ([skip, hit)) line up exactly.
+            assert skip == first_live, (skip, first_live)
             self._pool_write(
                 ids[: hit - skip],
-                kp[:, lo: hit - first_live],
-                vp[:, lo: hit - first_live],
+                kp[:, : hit - first_live],
+                vp[:, : hit - first_live],
             )
 
         row = np.zeros(self.sc.max_pages_per_seq, dtype=np.int32)
@@ -745,12 +788,10 @@ class ServingEngine:
             # prefill + page-out + pool scatter + logits-row slice.
             # Dead prompt pages [0, skip) scatter to the drop sentinel:
             # no pool page was allocated for them.
-            ids_p = np.full(self.sc.max_pages_per_seq,
-                            self.sc.total_pages, dtype=np.int32)
-            ids_p[skip:n_pages] = ids
             row_dev, self.k_pages, self.v_pages = _admit_fused(
                 self.params, cfg, toks, self.k_pages, self.v_pages,
-                jnp.asarray(ids_p), jnp.asarray(s_real),
+                jnp.asarray(self._pad_ids(ids, offset=skip)),
+                jnp.asarray(s_real),
                 model=self.model,
             )
             row_host = np.asarray(row_dev)
@@ -761,9 +802,12 @@ class ServingEngine:
             logits, kvs = self._prefill_px(
                 toks, prefix_kvs, jnp.int32(first_live * page)
             )
-            # Page out the suffix KV into the pool (real tokens only;
-            # suffix pages below the post-admission floor are dead and
-            # get no pool page — dropped here).
+            # Page out the suffix KV into the pool (real tokens
+            # only). A hit implies skip = first_live <= hit, so every
+            # suffix page has a pool id; sub-floor suffix pages (if
+            # any are below the post-admission floor) are materialized
+            # here and freed by the _release_windowed below, AFTER
+            # offloading — keeping the prefix chain gap-free.
             k_sfx = jnp.stack([k[:, :s_real] for k, _ in kvs])
             v_sfx = jnp.stack([v[:, :s_real] for _, v in kvs])
             kp_s, vp_s = [], []
@@ -771,10 +815,8 @@ class ServingEngine:
                 a, b = llama.kv_to_pages(cfg, k_sfx[li], v_sfx[li])
                 kp_s.append(a[0])
                 vp_s.append(b[0])
-            off = max(0, skip - hit)
-            tgt = ids[max(0, hit - skip):]
-            self._pool_write(tgt, jnp.stack(kp_s)[:, off:],
-                             jnp.stack(vp_s)[:, off:])
+            self._pool_write(ids[hit - skip:], jnp.stack(kp_s),
+                             jnp.stack(vp_s))
             row_host = np.asarray(logits[0, s_real - 1])
         self.stats["prefill_tokens"] += s_real
 
@@ -955,6 +997,7 @@ class ServingEngine:
         work = slot.work
         work.done.extend(slot.generated)
         work.prompt = list(work.prompt) + slot.generated
+        work.probe = None  # prompt changed: stale probe
         self._release(slot_idx, slot)
         self.queue.insert(0, work)
         self.stats["preemptions"] += 1

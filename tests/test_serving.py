@@ -1113,3 +1113,54 @@ def test_windowed_release_chunked_with_store(wparams, wcfg, shm_conn):
     out2 = eng2.run([Request("k2", prompt, max_new_tokens=24)])
     assert eng2.stats["prefix_hit_pages"] > 0
     assert out2["k2"] == out1["k1"]
+
+
+@pytest.mark.parametrize("seed", [71, 72, 73, 74])
+def test_engine_config_fuzz_window_and_quantized(cfg, seed, shm_conn):
+    """Cross-feature fuzz over the round-5 additions: sliding window x
+    int8 weight quantization x chunking x speculation x store x pool
+    pressure. Every configuration must emit each request's token
+    stream from a plain engine with the SAME model variant (windowed
+    masks and quantized weights change the math, so the oracle shares
+    them — the property is that scheduling features stay pure)."""
+    import dataclasses
+
+    from infinistore_tpu.tpu import TpuKVStore
+
+    rng = np.random.default_rng(seed)
+    window = int(rng.choice([0, 16]))
+    vcfg = dataclasses.replace(cfg, window=window)
+    params = llama.init_params(jax.random.PRNGKey(0), vcfg)
+    if rng.random() < 0.5:
+        params = llama.quantize_params(params, vcfg)
+
+    n_req = int(rng.integers(2, 4))
+    reqs = [
+        Request(
+            f"r{i}",
+            _prompt(rng, vcfg, int(rng.integers(3, 30))),
+            max_new_tokens=int(rng.integers(1, 40)),
+        )
+        for i in range(n_req)
+    ]
+    sc = ServingConfig(
+        max_slots=int(rng.integers(1, 4)),
+        total_pages=int(rng.integers(12, 48)),
+        prefill_chunk=int(rng.choice([0, 3, 8])),
+        spec_k=int(rng.choice([0, 2])),
+        host_steps=int(rng.choice([1, 4])),
+    )
+    store = TpuKVStore(shm_conn) if rng.random() < 0.5 else None
+    eng = ServingEngine(params, vcfg, sc, store=store)
+    out = eng.run(
+        [Request(r.request_id, r.prompt, r.max_new_tokens) for r in reqs]
+    )
+    for r in reqs:
+        ref = ServingEngine(params, vcfg).run(
+            [Request("x", r.prompt, r.max_new_tokens)]
+        )
+        assert out[r.request_id] == ref["x"], (seed, window, sc,
+                                               r.request_id)
+    # No leaked pages whatever combination ran (windowed release must
+    # hand everything back too).
+    assert sorted(eng.free_pages) == list(range(1, sc.total_pages)), seed
