@@ -6,9 +6,11 @@ the JAX model in models/llama.py, so the same weights drive the paged-KV
 engine, the store demos and the benchmarks. Covered checkpoint features:
 GQA, tied embeddings, llama3-type ``rope_scaling`` (the Llama-3.1/3.2
 long-context recipe) and per-projection attention biases — which makes
-``Qwen2ForCausalLM`` and ``MistralForCausalLM`` checkpoints load
-directly (parity-tested), and sliding-window attention maps onto
-``LlamaConfig.window`` (banded masks in every attention path — a real
+``Qwen2ForCausalLM``, ``MistralForCausalLM`` and ``GemmaForCausalLM``
+checkpoints load directly (parity-tested — Gemma brings MQA, GeGLU,
+zero-centered (1+w) RMSNorm, sqrt(d_model)-scaled embeddings and a
+decoupled head_dim, which also unlocks Mistral-NeMo geometry), and
+sliding-window attention maps onto ``LlamaConfig.window`` (banded masks in every attention path — a real
 windowed Mistral matches transformers on prefill, paged decode, and
 the engine's greedy stream). Unsupported features (yarn/linear/dynamic
 rope, ``mlp_bias``, Qwen2 MIXED per-layer windowing) hard-error rather
@@ -82,13 +84,29 @@ def config_from_hf(hf_cfg, page_size=16, dtype="float32"):
         sw = getattr(hf_cfg, "sliding_window", None)
         if sw is not None:
             window = int(sw)
+    # Decoupled head_dim (Gemma, Mistral-NeMo): carried as an override
+    # so q/k/v/o shapes and the attention scale follow the checkpoint.
     hd = getattr(hf_cfg, "head_dim", None)
-    if hd is not None and hd != hf_cfg.hidden_size // hf_cfg.num_attention_heads:
+    derived = hf_cfg.hidden_size // hf_cfg.num_attention_heads
+    head_dim_override = hd if (hd is not None and hd != derived) else 0
+    # Activation: Llama/Qwen2/Mistral are SwiGLU (silu); Gemma is GeGLU
+    # (gelu_pytorch_tanh == jax.nn.gelu approximate).
+    hidden_act = getattr(hf_cfg, "hidden_act",
+                         getattr(hf_cfg, "hidden_activation", None)) \
+        or "silu"
+    if hidden_act in ("silu", "swish"):
+        act = "silu"
+    elif hidden_act in ("gelu_pytorch_tanh", "gelu_new", "gelu_fast"):
+        act = "gelu"          # tanh approximation
+    elif hidden_act == "gelu":
+        act = "gelu_exact"    # erf form — a distinct function
+    else:
         raise NotImplementedError(
-            f"explicit head_dim={hd} != hidden_size//num_attention_heads="
-            f"{hf_cfg.hidden_size // hf_cfg.num_attention_heads}: the JAX "
-            "model derives head_dim and would reshape wrongly at inference"
+            f"hidden_act {hidden_act!r} has no JAX mapping"
         )
+    # Gemma conventions: zero-centered RMSNorm weights applied as
+    # (1 + w), and embeddings scaled by sqrt(hidden_size).
+    is_gemma = getattr(hf_cfg, "model_type", "") == "gemma"
     return LlamaConfig(
         vocab_size=hf_cfg.vocab_size,
         d_model=hf_cfg.hidden_size,
@@ -101,6 +119,10 @@ def config_from_hf(hf_cfg, page_size=16, dtype="float32"):
         rope_theta=float(hf_cfg.rope_theta),
         rope_scaling=rope_scaling,
         window=window,
+        act=act,
+        norm_plus_one=is_gemma,
+        embed_scale=float(hf_cfg.hidden_size) ** 0.5 if is_gemma else 1.0,
+        head_dim_override=head_dim_override,
         norm_eps=float(hf_cfg.rms_norm_eps),
         dtype=dtype,
     )

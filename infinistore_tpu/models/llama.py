@@ -59,11 +59,20 @@ class LlamaConfig:
     # verify (parity vs transformers pinned in tests/test_hf_bridge).
     window: int = 0
     norm_eps: float = 1e-5
+    # Family knobs beyond the Llama defaults (the Gemma-1 geometry:
+    # GeGLU activation, zero-centered RMSNorm weights applied as
+    # (1 + w), sqrt(d_model)-scaled embeddings, and a head_dim that
+    # does not equal d_model // n_heads — also used by Mistral-NeMo):
+    act: str = "silu"           # "silu" (SwiGLU) | "gelu" (tanh-approx
+    #                             GeGLU) | "gelu_exact" (erf GELU)
+    norm_plus_one: bool = False  # rms_norm multiplies by (1 + w)
+    embed_scale: float = 1.0     # embedding output multiplier
+    head_dim_override: int = 0   # 0 = d_model // n_heads
     dtype: str = "bfloat16"
 
     @property
     def head_dim(self):
-        return self.d_model // self.n_heads
+        return self.head_dim_override or self.d_model // self.n_heads
 
     @property
     def jdtype(self):
@@ -227,9 +236,12 @@ def param_bytes(params):
     )
 
 
-def rms_norm(x, w, eps=1e-5):
+def rms_norm(x, w, eps=1e-5, plus_one=False):
+    """plus_one: Gemma convention — stored weights are zero-centered
+    and applied as (1 + w)."""
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
-    return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * w
+    xn = x * jax.lax.rsqrt(var + eps).astype(x.dtype)
+    return xn * (1.0 + w) if plus_one else xn * w
 
 
 def _llama3_scale_freqs(freqs, scaling):
@@ -296,7 +308,7 @@ def _proj(h, layer, w, b_, shape=None):
 def _qkv(layer, x, cfg, positions):
     b = x.shape[0]
     s = x.shape[1]
-    h = rms_norm(x, layer["ln1"], cfg.norm_eps)
+    h = rms_norm(x, layer["ln1"], cfg.norm_eps, cfg.norm_plus_one)
     q = _proj(h, layer, "wq", "bq", (b, s, cfg.n_heads, cfg.head_dim))
     k = _proj(h, layer, "wk", "bk", (b, s, cfg.n_kv_heads, cfg.head_dim))
     v = _proj(h, layer, "wv", "bv", (b, s, cfg.n_kv_heads, cfg.head_dim))
@@ -310,15 +322,26 @@ def _attn_out(layer, attn_flat):
     return _proj(attn_flat, layer, "wo", "bo")
 
 
-def _mlp(layer, x, eps=1e-5):
-    h = rms_norm(x, layer["ln2"], eps)
-    gated = jax.nn.silu(_matmul(h, layer["w_gate"])) * _matmul(
+def _act(cfg):
+    # HF "gelu_pytorch_tanh"/"gelu_new" are jax.nn.gelu's tanh
+    # approximation; plain "gelu" is the exact erf form — they differ
+    # by up to ~1e-3 per activation, so the bridge maps them apart.
+    if cfg.act == "silu":
+        return jax.nn.silu
+    if cfg.act == "gelu_exact":
+        return lambda x: jax.nn.gelu(x, approximate=False)
+    return lambda x: jax.nn.gelu(x, approximate=True)
+
+
+def _mlp(layer, x, cfg):
+    h = rms_norm(x, layer["ln2"], cfg.norm_eps, cfg.norm_plus_one)
+    gated = _act(cfg)(_matmul(h, layer["w_gate"])) * _matmul(
         h, layer["w_up"]
     )
     return _matmul(gated, layer["w_down"])
 
 
-def _embed(params, tokens):
+def _embed(params, tokens, cfg=None):
     """Token embedding gather; int8-quantized embeds gather int8 rows
     and their PER-ROW scales (shape [vocab] — each token's row is its
     own quantization unit) — HBM reads stay int8. The scale leaf
@@ -328,8 +351,12 @@ def _embed(params, tokens):
     if isinstance(e, dict):
         rows = jnp.take(e["int8"], tokens, axis=0)
         row_scale = jnp.take(e["scale"], tokens, axis=0)
-        return rows.astype(row_scale.dtype) * row_scale[..., None]
-    return jnp.take(e, tokens, axis=0)
+        out = rows.astype(row_scale.dtype) * row_scale[..., None]
+    else:
+        out = jnp.take(e, tokens, axis=0)
+    if cfg is not None and cfg.embed_scale != 1.0:
+        out = out * jnp.asarray(cfg.embed_scale, out.dtype)
+    return out
 
 
 def _logits(params, x):
@@ -354,7 +381,7 @@ def _forward_stack(params, cfg: LlamaConfig, tokens, prefix_kvs=None,
     preserve."""
     b, s = tokens.shape
     prefix_len = 0 if prefix_kvs is None else prefix_kvs[0][0].shape[1]
-    x = _embed(params, tokens)
+    x = _embed(params, tokens, cfg)
     positions = jnp.broadcast_to(
         pos0 + prefix_len + jnp.arange(s)[None], (b, s)
     )
@@ -373,9 +400,9 @@ def _forward_stack(params, cfg: LlamaConfig, tokens, prefix_kvs=None,
         attn = flash_prefill(q, k_full, v_full, causal=True,
                              window=cfg.window)
         x = x + _attn_out(layer, attn.reshape(b, s, -1))
-        x = x + _mlp(layer, x, cfg.norm_eps)
+        x = x + _mlp(layer, x, cfg)
         kvs.append((k, v))
-    x = rms_norm(x, params["final_ln"], cfg.norm_eps)
+    x = rms_norm(x, params["final_ln"], cfg.norm_eps, cfg.norm_plus_one)
     logits = _logits(params, x)
     return logits, kvs
 
@@ -433,7 +460,7 @@ def decode_step(params, cfg: LlamaConfig, token, seq_lens, k_pages, v_pages,
     new token's KV is scattered into the page at seq_lens position.
     """
     b = token.shape[0]
-    x = _embed(params, token[:, None])  # [b, 1, d]
+    x = _embed(params, token[:, None], cfg)  # [b, 1, d]
     positions = seq_lens[:, None]  # current position
     page_idx_in_seq = seq_lens // cfg.page_size
     target_page = jnp.take_along_axis(
@@ -450,10 +477,10 @@ def decode_step(params, cfg: LlamaConfig, token, seq_lens, k_pages, v_pages,
             q[:, 0], kp, vp, page_table, seq_lens + 1, window=cfg.window
         )
         x = x + _attn_out(layer, attn.reshape(b, 1, -1))
-        x = x + _mlp(layer, x, cfg.norm_eps)
+        x = x + _mlp(layer, x, cfg)
         new_k_pages.append(kp)
         new_v_pages.append(vp)
-    x = rms_norm(x, params["final_ln"], cfg.norm_eps)
+    x = rms_norm(x, params["final_ln"], cfg.norm_eps, cfg.norm_plus_one)
     logits = _logits(params, x[:, 0])
     return logits, jnp.stack(new_k_pages), jnp.stack(new_v_pages)
 
@@ -488,7 +515,7 @@ def verify_step(params, cfg: LlamaConfig, tokens, seq_lens, k_pages,
     before attending (attention is masked by per-token length).
     """
     b, m = tokens.shape
-    x = _embed(params, tokens)  # [b, m, d]
+    x = _embed(params, tokens, cfg)  # [b, m, d]
     positions = seq_lens[:, None] + jnp.arange(m)[None, :]
     page_idx_in_seq = positions // cfg.page_size  # [b, m]
     target_page = jnp.take_along_axis(page_table, page_idx_in_seq, axis=1)
@@ -508,10 +535,10 @@ def verify_step(params, cfg: LlamaConfig, tokens, seq_lens, k_pages,
         attn = paged_verify_attention(q, kp, vp, page_table, seq_lens,
                                       window=cfg.window)
         x = x + _attn_out(layer, attn.reshape(b, m, -1))
-        x = x + _mlp(layer, x, cfg.norm_eps)
+        x = x + _mlp(layer, x, cfg)
         new_k_pages.append(kp)
         new_v_pages.append(vp)
-    x = rms_norm(x, params["final_ln"], cfg.norm_eps)
+    x = rms_norm(x, params["final_ln"], cfg.norm_eps, cfg.norm_plus_one)
     logits = _logits(params, x)
     return logits, jnp.stack(new_k_pages), jnp.stack(new_v_pages)
 

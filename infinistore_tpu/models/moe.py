@@ -49,13 +49,16 @@ class MoEConfig:
     rope_theta: float = 10000.0
     rope_scaling: tuple = ()  # see LlamaConfig.rope_scaling
     window: int = 0           # see LlamaConfig.window
+    norm_plus_one: bool = False  # mirror of LlamaConfig's family knobs
+    embed_scale: float = 1.0     # (the expert FFN itself stays SwiGLU)
+    head_dim_override: int = 0
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     aux_loss_weight: float = 0.01
 
     @property
     def head_dim(self):
-        return self.d_model // self.n_heads
+        return self.head_dim_override or self.d_model // self.n_heads
 
     @property
     def jdtype(self):
@@ -163,7 +166,8 @@ def _moe_mlp(layer, x, cfg: MoEConfig, valid=None):
     the layer's aux loss. `valid` ([B, S] bool or None) masks tokens
     out of routing (see _route)."""
     b, s, d = x.shape
-    h = rms_norm(x, layer["ln2"], cfg.norm_eps).reshape(b * s, d)
+    h = rms_norm(x, layer["ln2"], cfg.norm_eps,
+                 cfg.norm_plus_one).reshape(b * s, d)
     vflat = None if valid is None else valid.reshape(b * s)
     dispatch, combine, aux = _route(layer, h, cfg, vflat)
     # Scatter to per-expert slots: ONE einsum, [E, C, d] activations.
@@ -186,7 +190,7 @@ def _forward_stack(params, cfg: MoEConfig, tokens, prefix_kvs=None,
     rectangular flash kernel."""
     b, s = tokens.shape
     prefix_len = 0 if prefix_kvs is None else prefix_kvs[0][0].shape[1]
-    x = _llama._embed(params, tokens)
+    x = _llama._embed(params, tokens, cfg)
     positions = jnp.broadcast_to(
         pos0 + prefix_len + jnp.arange(s)[None], (b, s)
     )
@@ -207,7 +211,7 @@ def _forward_stack(params, cfg: MoEConfig, tokens, prefix_kvs=None,
         x = x + moe_out
         kvs.append((k, v))
         aux_total = aux_total + aux
-    x = rms_norm(x, params["final_ln"], cfg.norm_eps)
+    x = rms_norm(x, params["final_ln"], cfg.norm_eps, cfg.norm_plus_one)
     logits = _llama._logits(params, x)
     return logits, kvs, aux_total
 
@@ -246,7 +250,7 @@ def decode_step(params, cfg: MoEConfig, token, seq_lens, k_pages, v_pages,
     or rollback logic MUST be applied here too; the MoE serving parity
     suite (tests/test_moe.py) is the drift alarm."""
     b = token.shape[0]
-    x = _llama._embed(params, token[:, None])  # [b, 1, d]
+    x = _llama._embed(params, token[:, None], cfg)  # [b, 1, d]
     positions = seq_lens[:, None]
     page_idx_in_seq = seq_lens // cfg.page_size
     target_page = jnp.take_along_axis(
@@ -273,7 +277,7 @@ def decode_step(params, cfg: MoEConfig, token, seq_lens, k_pages, v_pages,
         x = x + moe_out
         new_k_pages.append(kp)
         new_v_pages.append(vp)
-    x = rms_norm(x, params["final_ln"], cfg.norm_eps)
+    x = rms_norm(x, params["final_ln"], cfg.norm_eps, cfg.norm_plus_one)
     logits = _llama._logits(params, x[:, 0])
     return logits, jnp.stack(new_k_pages), jnp.stack(new_v_pages)
 
@@ -285,7 +289,7 @@ def verify_step(params, cfg: MoEConfig, tokens, seq_lens, k_pages,
     llama.verify_step with the routed FFN; see that docstring for the
     scratch-page and rollback contracts."""
     b, m = tokens.shape
-    x = _llama._embed(params, tokens)  # [b, m, d]
+    x = _llama._embed(params, tokens, cfg)  # [b, m, d]
     positions = seq_lens[:, None] + jnp.arange(m)[None, :]
     page_idx_in_seq = positions // cfg.page_size
     target_page = jnp.take_along_axis(page_table, page_idx_in_seq, axis=1)
@@ -310,7 +314,7 @@ def verify_step(params, cfg: MoEConfig, tokens, seq_lens, k_pages,
         x = x + moe_out
         new_k_pages.append(kp)
         new_v_pages.append(vp)
-    x = rms_norm(x, params["final_ln"], cfg.norm_eps)
+    x = rms_norm(x, params["final_ln"], cfg.norm_eps, cfg.norm_plus_one)
     logits = _llama._logits(params, x)
     return logits, jnp.stack(new_k_pages), jnp.stack(new_v_pages)
 

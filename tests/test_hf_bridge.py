@@ -281,12 +281,28 @@ def test_qwen2_all_swa_layers_maps_window():
     assert hf.config_from_hf(cfg2).window == 0
 
 
-def test_explicit_head_dim_mismatch_raises():
+def test_explicit_head_dim_loads_and_matches():
+    """Decoupled head_dim (Mistral-NeMo style): head_dim=32 with
+    hidden_size//heads=16 — projection shapes and the attention scale
+    follow the checkpoint."""
     cfg = transformers.LlamaConfig(
-        hidden_size=64, num_attention_heads=4, head_dim=32
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=32,
+        max_position_embeddings=128, tie_word_embeddings=False,
     )
-    with pytest.raises(NotImplementedError, match="head_dim"):
-        hf.config_from_hf(cfg)
+    torch.manual_seed(55)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    jcfg, params = hf.load_hf(model, page_size=8, dtype="float32")
+    assert jcfg.head_dim == 32
+    rng = np.random.default_rng(56)
+    tokens = rng.integers(0, 128, (2, 24), dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours, _ = llama.prefill(params, jcfg, jnp.asarray(tokens, jnp.int32))
+    ours = np.asarray(ours)
+    assert np.abs(ours - ref).max() < 2e-4
+    assert np.array_equal(ours.argmax(-1), ref.argmax(-1))
 
 
 def test_mistral_checkpoint_loads_and_matches():
@@ -418,3 +434,94 @@ def test_windowed_mistral_serves_through_engine():
         model = transformers.MistralForCausalLM(cfg).eval()
         out = model.generate(ids, max_new_tokens=6, do_sample=False)
     assert toks == [int(t) for t in out[0, 24:]]
+
+
+def test_gemma_checkpoint_loads_and_matches():
+    """GemmaForCausalLM: MQA (n_kv=1), decoupled head_dim, GeGLU,
+    zero-centered (1+w) RMSNorm, sqrt(d_model)-scaled embeddings, tied
+    head — the bridge maps every convention and matches logits."""
+    cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+        head_dim=32, max_position_embeddings=128, rms_norm_eps=1e-6,
+        hidden_act="gelu_pytorch_tanh", rope_theta=10000.0,
+    )
+    torch.manual_seed(51)
+    model = transformers.GemmaForCausalLM(cfg).eval()
+    jcfg, params = hf.load_hf(model, page_size=8, dtype="float32")
+    assert jcfg.head_dim == 32 and jcfg.act == "gelu"
+    assert jcfg.norm_plus_one and jcfg.embed_scale == 8.0
+    rng = np.random.default_rng(52)
+    tokens = rng.integers(0, 128, (2, 24), dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours, _ = llama.prefill(params, jcfg, jnp.asarray(tokens, jnp.int32))
+    ours = np.asarray(ours)
+    assert np.abs(ours - ref).max() < 2e-4
+    assert np.array_equal(ours.argmax(-1), ref.argmax(-1))
+
+
+def test_gemma_paged_decode_matches_transformers():
+    """Gemma through the paged decode path (page out/in, one decode
+    step) — MQA + decoupled head_dim flow through the pool layout."""
+    cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+        head_dim=32, max_position_embeddings=128, rms_norm_eps=1e-6,
+        hidden_act="gelu_pytorch_tanh",
+    )
+    torch.manual_seed(53)
+    model = transformers.GemmaForCausalLM(cfg).eval()
+    jcfg, params = hf.load_hf(model, page_size=8, dtype="float32")
+    rng = np.random.default_rng(54)
+    seq = 16
+    tokens = rng.integers(0, 128, (1, seq + 1), dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()[0, -1]
+    _, kvs = llama.prefill(
+        params, jcfg, jnp.asarray(tokens[:, :seq], jnp.int32)
+    )
+    n_pages = seq // jcfg.page_size
+    max_pages = n_pages + 1
+    k_pages = jnp.zeros(
+        (jcfg.n_layers, max_pages, jcfg.page_size, jcfg.n_kv_heads,
+         jcfg.head_dim), dtype=jcfg.jdtype,
+    )
+    v_pages = jnp.zeros_like(k_pages)
+    for li, (k, v) in enumerate(kvs):
+        kp, vp = llama.kv_to_pages(jcfg, k, v)
+        k_pages = k_pages.at[li, :n_pages].set(kp[0])
+        v_pages = v_pages.at[li, :n_pages].set(vp[0])
+    page_table = jnp.arange(max_pages, dtype=jnp.int32)[None]
+    logits, _, _ = llama.decode_step(
+        params, jcfg,
+        jnp.asarray(tokens[:, seq], jnp.int32).reshape(1),
+        jnp.asarray([seq], jnp.int32),
+        k_pages, v_pages, page_table,
+    )
+    ours = np.asarray(logits[0])
+    assert np.abs(ours - ref).max() < 2e-4
+    assert int(ours.argmax()) == int(ref.argmax())
+
+
+def test_exact_gelu_checkpoint_matches():
+    """hidden_act="gelu" is HF's exact erf GELU, distinct from the tanh
+    approximation — the bridge must map it to the erf form, not
+    silently approximate."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, hidden_act="gelu",
+        max_position_embeddings=128, tie_word_embeddings=False,
+    )
+    torch.manual_seed(59)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    jcfg, params = hf.load_hf(model, page_size=8, dtype="float32")
+    assert jcfg.act == "gelu_exact"
+    rng = np.random.default_rng(60)
+    tokens = rng.integers(0, 128, (2, 24), dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours, _ = llama.prefill(params, jcfg, jnp.asarray(tokens, jnp.int32))
+    ours = np.asarray(ours)
+    assert np.abs(ours - ref).max() < 2e-4
