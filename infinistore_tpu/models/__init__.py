@@ -8,4 +8,4 @@ from .llama import (  # noqa: F401
     quantize_params,
     train_step,
 )
-from .hf import load_hf  # noqa: F401
+from .hf import load_hf, load_hf_moe  # noqa: F401

@@ -105,8 +105,18 @@ def config_from_hf(hf_cfg, page_size=16, dtype="float32"):
             f"hidden_act {hidden_act!r} has no JAX mapping"
         )
     # Gemma conventions: zero-centered RMSNorm weights applied as
-    # (1 + w), and embeddings scaled by sqrt(hidden_size).
-    is_gemma = getattr(hf_cfg, "model_type", "") == "gemma"
+    # (1 + w), and embeddings scaled by sqrt(hidden_size). Gemma-2/3
+    # add logit softcapping, pre/post-FFN norms and per-layer
+    # windowing the JAX model has no slots for — loading them through
+    # the gemma-1 mapping would silently diverge, so they hard-error.
+    model_type = getattr(hf_cfg, "model_type", "")
+    if model_type.startswith("gemma") and model_type != "gemma":
+        raise NotImplementedError(
+            f"{model_type} checkpoints carry logit softcapping and "
+            "extra per-layer norms the JAX model does not implement "
+            "(gemma-1 is supported)"
+        )
+    is_gemma = model_type == "gemma"
     return LlamaConfig(
         vocab_size=hf_cfg.vocab_size,
         d_model=hf_cfg.hidden_size,
@@ -199,4 +209,107 @@ def load_hf(model_or_state_dict, hf_cfg=None, page_size=16,
     return cfg, params_from_hf(model_or_state_dict, cfg)
 
 
-__all__ = ["config_from_hf", "params_from_hf", "load_hf"]
+__all__ = ["config_from_hf", "params_from_hf", "load_hf",
+           "moe_config_from_hf", "moe_params_from_hf", "load_hf_moe"]
+
+
+def moe_config_from_hf(hf_cfg, page_size=16, dtype="float32"):
+    """Map a ``transformers.MixtralConfig`` onto :class:`MoEConfig`.
+
+    capacity_factor is set to n_experts / top_k so per-expert capacity
+    equals the token count — NO token is ever dropped, which is the
+    condition for exact routing parity with HF's dense top-k (GShard
+    capacity is this implementation's scaling knob, not Mixtral's
+    semantics; production serving can lower it and accept drops)."""
+    from .moe import MoEConfig
+
+    if getattr(hf_cfg, "sliding_window", None) is not None:
+        raise NotImplementedError(
+            "Mixtral sliding_window set: the MoE family does not route "
+            "windowed attention configs yet"
+        )
+    if getattr(hf_cfg, "hidden_act", "silu") not in ("silu", "swish"):
+        raise NotImplementedError(
+            f"MoE expert activation {hf_cfg.hidden_act!r}: the expert "
+            "FFN hardcodes SwiGLU (silu)"
+        )
+    hd = getattr(hf_cfg, "head_dim", None)
+    derived = hf_cfg.hidden_size // hf_cfg.num_attention_heads
+    return MoEConfig(
+        head_dim_override=(
+            hd if (hd is not None and hd != derived) else 0
+        ),
+        vocab_size=hf_cfg.vocab_size,
+        d_model=hf_cfg.hidden_size,
+        n_layers=hf_cfg.num_hidden_layers,
+        n_heads=hf_cfg.num_attention_heads,
+        n_kv_heads=hf_cfg.num_key_value_heads,
+        d_ff=hf_cfg.intermediate_size,
+        n_experts=hf_cfg.num_local_experts,
+        top_k=hf_cfg.num_experts_per_tok,
+        capacity_factor=float(hf_cfg.num_local_experts)
+        / hf_cfg.num_experts_per_tok,
+        max_seq=hf_cfg.max_position_embeddings,
+        page_size=page_size,
+        rope_theta=float(hf_cfg.rope_theta),
+        norm_eps=float(hf_cfg.rms_norm_eps),
+        dtype=dtype,
+    )
+
+
+def moe_params_from_hf(model_or_state_dict, cfg):
+    """Build the models/moe.py parameter pytree from a HF Mixtral model
+    (``MixtralForCausalLM``) or its state dict: per-expert w1/w3/w2
+    ([out, in] each) stack onto the leading E axis as e_gate/e_up/e_down
+    ([E, in, out]); the router gate transposes like every projection."""
+    import jax.numpy as jnp
+
+    sd = model_or_state_dict
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    dt = cfg.jdtype
+    layers = []
+    for li in range(cfg.n_layers):
+        p = f"model.layers.{li}."
+        m = p + "block_sparse_moe."
+        layers.append({
+            "ln1": _t(sd, p + "input_layernorm.weight", dt),
+            "wq": _t(sd, p + "self_attn.q_proj.weight", dt).T,
+            "wk": _t(sd, p + "self_attn.k_proj.weight", dt).T,
+            "wv": _t(sd, p + "self_attn.v_proj.weight", dt).T,
+            "wo": _t(sd, p + "self_attn.o_proj.weight", dt).T,
+            "ln2": _t(sd, p + "post_attention_layernorm.weight", dt),
+            "router": _t(sd, m + "gate.weight", "float32").T,
+            "e_gate": jnp.stack([
+                _t(sd, m + f"experts.{e}.w1.weight", dt).T
+                for e in range(cfg.n_experts)
+            ]),
+            "e_up": jnp.stack([
+                _t(sd, m + f"experts.{e}.w3.weight", dt).T
+                for e in range(cfg.n_experts)
+            ]),
+            "e_down": jnp.stack([
+                _t(sd, m + f"experts.{e}.w2.weight", dt).T
+                for e in range(cfg.n_experts)
+            ]),
+        })
+    embed = _t(sd, "model.embed_tokens.weight", dt)
+    if "lm_head.weight" in sd:
+        lm_head = _t(sd, "lm_head.weight", dt).T
+    else:
+        lm_head = embed.T
+    return {
+        "embed": embed,
+        "layers": layers,
+        "final_ln": _t(sd, "model.norm.weight", dt),
+        "lm_head": lm_head,
+    }
+
+
+def load_hf_moe(model_or_state_dict, hf_cfg=None, page_size=16,
+                dtype="float32"):
+    """One-call Mixtral bridge: returns (cfg, params)."""
+    if hf_cfg is None:
+        hf_cfg = model_or_state_dict.config
+    cfg = moe_config_from_hf(hf_cfg, page_size=page_size, dtype=dtype)
+    return cfg, moe_params_from_hf(model_or_state_dict, cfg)

@@ -525,3 +525,101 @@ def test_exact_gelu_checkpoint_matches():
     ours, _ = llama.prefill(params, jcfg, jnp.asarray(tokens, jnp.int32))
     ours = np.asarray(ours)
     assert np.abs(ours - ref).max() < 2e-4
+
+
+def _tiny_mixtral():
+    cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5,
+        sliding_window=None, tie_word_embeddings=False,
+    )
+    torch.manual_seed(61)
+    return transformers.MixtralForCausalLM(cfg).eval()
+
+
+def test_mixtral_checkpoint_loads_and_matches():
+    """MixtralForCausalLM into the MoE family: per-expert w1/w3/w2
+    stack onto the E axis, router transposes, and the no-drop capacity
+    (capacity_factor = E/top_k) makes GShard dense-dispatch routing
+    exactly reproduce HF's top-k — logits parity to 2e-4."""
+    from infinistore_tpu.models import moe
+
+    model = _tiny_mixtral()
+    jcfg, params = hf.load_hf_moe(model, page_size=8, dtype="float32")
+    assert jcfg.n_experts == 4 and jcfg.top_k == 2
+    assert jcfg.capacity_factor == 2.0  # E / top_k: no token dropped
+    rng = np.random.default_rng(62)
+    tokens = rng.integers(0, 128, (2, 24), dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours, _, _ = moe.forward_dense(
+        params, jcfg, jnp.asarray(tokens, jnp.int32)
+    )
+    ours = np.asarray(ours)
+    assert np.abs(ours - ref).max() < 2e-4
+    assert np.array_equal(ours.argmax(-1), ref.argmax(-1))
+
+
+def test_mixtral_paged_decode_matches_transformers():
+    """Mixtral through the MoE paged decode path: prefill, page
+    out/in, one decode step vs the HF full forward."""
+    from infinistore_tpu.models import moe
+
+    model = _tiny_mixtral()
+    jcfg, params = hf.load_hf_moe(model, page_size=8, dtype="float32")
+    rng = np.random.default_rng(64)
+    seq = 16
+    tokens = rng.integers(0, 128, (1, seq + 1), dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()[0, -1]
+    _, kvs, _ = moe.forward_dense(
+        params, jcfg, jnp.asarray(tokens[:, :seq], jnp.int32)
+    )
+    n_pages = seq // jcfg.page_size
+    max_pages = n_pages + 1
+    k_pages = jnp.zeros(
+        (jcfg.n_layers, max_pages, jcfg.page_size, jcfg.n_kv_heads,
+         jcfg.head_dim), dtype=jcfg.jdtype,
+    )
+    v_pages = jnp.zeros_like(k_pages)
+    for li, (k, v) in enumerate(kvs):
+        kp, vp = llama.kv_to_pages(jcfg, k, v)
+        k_pages = k_pages.at[li, :n_pages].set(kp[0])
+        v_pages = v_pages.at[li, :n_pages].set(vp[0])
+    page_table = jnp.arange(max_pages, dtype=jnp.int32)[None]
+    logits, _, _ = moe.decode_step(
+        params, jcfg,
+        jnp.asarray(tokens[:, seq], jnp.int32).reshape(1),
+        jnp.asarray([seq], jnp.int32),
+        k_pages, v_pages, page_table,
+    )
+    ours = np.asarray(logits[0])
+    assert np.abs(ours - ref).max() < 2e-4
+    assert int(ours.argmax()) == int(ref.argmax())
+
+
+def test_gemma2_rejected():
+    cfg = transformers.Gemma2Config(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=1,
+    )
+    with pytest.raises(NotImplementedError, match="gemma2"):
+        hf.config_from_hf(cfg)
+
+
+def test_mixtral_non_silu_activation_rejected():
+    cfg = transformers.MixtralConfig(
+        hidden_act="gelu_pytorch_tanh", sliding_window=None
+    )
+    with pytest.raises(NotImplementedError, match="activation"):
+        hf.moe_config_from_hf(cfg)
+
+
+def test_mixtral_explicit_head_dim_maps():
+    cfg = transformers.MixtralConfig(
+        hidden_size=64, num_attention_heads=4, head_dim=32,
+        sliding_window=None,
+    )
+    assert hf.moe_config_from_hf(cfg).head_dim == 32
