@@ -28,19 +28,58 @@ using namespace istpu;
 
 namespace {
 
-// Keys arrive from Python pre-packed in wire layout ([u32 len + bytes]*n,
-// via pack_keys) — exactly the serialization BufWriter::keys would emit
-// after its u32 count. Append the section directly: decoding 4096-key
-// batches into std::strings and re-serializing cost ~0.5 ms per rpc on
-// the 1-core bench host. Malformed blobs fail server-side (BAD_REQUEST
-// via BufReader bounds-latching).
-std::vector<uint8_t> keys_body(const uint8_t* blob, uint64_t blob_len,
-                               uint32_t nkeys) {
-    std::vector<uint8_t> body;
+// Key blobs arrive from Python in one of two formats:
+//   wire form:  [u32 len][utf8 bytes]* — passed through unchanged;
+//   NUL form:   [u32 0xFFFFFFFF][u32 nkeys][key\0key\0...key] — built
+//               by a single str.join on the Python side (~20x cheaper
+//               than the per-key length-prefix loop; measured 35 us vs
+//               720 us for 4096 keys) and expanded to the wire form
+//               HERE in one memchr pass. Python falls back to the wire
+//               form when any key embeds a NUL.
+// Appends to `out` WITHOUT clearing it (callers carry headers already);
+// returns false on a malformed NUL blob (count mismatch).
+bool expand_keys(const uint8_t* blob, uint64_t blob_len, uint32_t nkeys,
+                 std::vector<uint8_t>& out) {
+    constexpr uint32_t kNulMarker = 0xFFFFFFFFu;
+    uint32_t first = 0;
+    if (blob_len >= 8) memcpy(&first, blob, 4);
+    if (blob_len < 8 || first != kNulMarker) {
+        if (blob_len) out.insert(out.end(), blob, blob + size_t(blob_len));
+        return true;
+    }
+    uint32_t n = 0;
+    memcpy(&n, blob + 4, 4);
+    if (n != nkeys) return false;
+    const uint8_t* p = blob + 8;
+    const uint8_t* end = blob + blob_len;
+    out.reserve(out.size() + size_t(end - p) + 4u * nkeys);
+    auto append = [&out](const void* q, size_t len) {
+        size_t off = out.size();
+        out.resize(off + len);
+        memcpy(out.data() + off, q, len);
+    };
+    for (uint32_t i = 0; i < nkeys; i++) {
+        const uint8_t* sep =
+            (i + 1 == nkeys)
+                ? end
+                : static_cast<const uint8_t*>(
+                      memchr(p, 0, size_t(end - p)));
+        if (sep == nullptr) return false;
+        uint32_t klen = uint32_t(sep - p);
+        append(&klen, 4);
+        append(p, size_t(klen));
+        p = sep + 1;
+    }
+    return true;
+}
+
+// Builds [u32 nkeys][wire keys] into `body`; false = malformed blob
+// (reject locally with BAD_REQUEST — never spend an rpc on it).
+bool keys_body(const uint8_t* blob, uint64_t blob_len, uint32_t nkeys,
+               std::vector<uint8_t>& body) {
     BufWriter w(body);
     w.u32(nkeys);
-    if (blob_len) w.bytes(blob, size_t(blob_len));
-    return body;
+    return expand_keys(blob, blob_len, nkeys, body);
 }
 
 // Callback ABI for async completions: cb(status, user_data).
@@ -56,6 +95,11 @@ DoneFn wrap_cb(ist_callback cb, void* ud) {
 extern "C" {
 
 // ---- logging ----------------------------------------------------------
+
+// Bumped whenever the Python<->C contract changes (v2: NUL-form key
+// blobs). _native.py probes this at load so a stale prebuilt library
+// fails loudly instead of feeding unparseable blobs to the server.
+uint32_t ist_abi_version(void) { return 2; }
 
 void ist_set_log_level(int level) { set_log_level(level); }
 void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }
@@ -190,7 +234,7 @@ uint32_t ist_allocate(void* h, const uint8_t* keys_blob, uint64_t blob_len,
     BufWriter w(body);
     w.u32(block_size);
     w.u32(nkeys);
-    if (blob_len) w.bytes(keys_blob, size_t(blob_len));
+    if (!expand_keys(keys_blob, blob_len, nkeys, body)) return BAD_REQUEST;
     std::vector<uint8_t> resp;
     uint32_t st = c->rpc(OP_ALLOCATE, std::move(body), &resp);
     if (st != OK) return st;
@@ -217,7 +261,7 @@ uint32_t ist_allocate_async(void* h, const uint8_t* keys_blob,
     BufWriter w(body);
     w.u32(block_size);
     w.u32(nkeys);
-    if (blob_len) w.bytes(keys_blob, size_t(blob_len));
+    if (!expand_keys(keys_blob, blob_len, nkeys, body)) return BAD_REQUEST;
     c->rpc_async(OP_ALLOCATE, std::move(body),
                  [out, nkeys, cb, ud](uint32_t st, std::vector<uint8_t> resp) {
                      if (st == OK) {
@@ -265,8 +309,10 @@ uint32_t ist_put_async(void* h, uint32_t block_size,
     auto* c = static_cast<Connection*>(h);
     if (c == nullptr) return INTERNAL_ERROR;
     std::vector<const void*> sp(srcs, srcs + nkeys);
-    c->put_async(block_size, keys_body(keys_blob, blob_len, nkeys),
-                 std::move(sp), wrap_cb(cb, ud));
+    std::vector<uint8_t> kb;
+    if (!keys_body(keys_blob, blob_len, nkeys, kb)) return BAD_REQUEST;
+    c->put_async(block_size, std::move(kb), std::move(sp),
+                 wrap_cb(cb, ud));
     return OK;
 }
 
@@ -276,8 +322,10 @@ uint32_t ist_read_async(void* h, uint32_t block_size, const uint8_t* keys_blob,
     auto* c = static_cast<Connection*>(h);
     if (c == nullptr) return INTERNAL_ERROR;
     std::vector<void*> dp(dsts, dsts + nkeys);
-    c->read_async(block_size, keys_body(keys_blob, blob_len, nkeys),
-                  std::move(dp), wrap_cb(cb, ud));
+    std::vector<uint8_t> kb;
+    if (!keys_body(keys_blob, blob_len, nkeys, kb)) return BAD_REQUEST;
+    c->read_async(block_size, std::move(kb), std::move(dp),
+                  wrap_cb(cb, ud));
     return OK;
 }
 
@@ -301,8 +349,10 @@ uint32_t ist_shm_read_async(void* h, uint32_t block_size,
     auto* c = static_cast<Connection*>(h);
     if (c == nullptr) return INTERNAL_ERROR;
     std::vector<void*> dp(dsts, dsts + nkeys);
-    c->shm_read_async(block_size, keys_body(keys_blob, blob_len, nkeys),
-                      std::move(dp), wrap_cb(cb, ud));
+    std::vector<uint8_t> kb;
+    if (!keys_body(keys_blob, blob_len, nkeys, kb)) return BAD_REQUEST;
+    c->shm_read_async(block_size, std::move(kb), std::move(dp),
+                      wrap_cb(cb, ud));
     return OK;
 }
 
@@ -322,7 +372,8 @@ uint32_t ist_read(void* h, uint32_t block_size, const uint8_t* keys_blob,
     auto* c = static_cast<Connection*>(h);
     if (c == nullptr) return INTERNAL_ERROR;
     std::vector<void*> dp(dsts, dsts + nkeys);
-    std::vector<uint8_t> kb = keys_body(keys_blob, blob_len, nkeys);
+    std::vector<uint8_t> kb;
+    if (!keys_body(keys_blob, blob_len, nkeys, kb)) return BAD_REQUEST;
     // Hybrid dispatch on SHM connections: the one-sided pool path pays a
     // fixed PIN+RELEASE round trip that dominates SMALL reads (measured
     // p50 of a single 4 KB read: ~47 us via pin+memcpy vs ~33 us via the
@@ -431,7 +482,9 @@ uint32_t ist_pin(void* h, const uint8_t* keys_blob, uint64_t blob_len,
     auto* c = static_cast<Connection*>(h);
     if (c == nullptr) return INTERNAL_ERROR;
     std::vector<uint8_t> resp;
-    uint32_t st = c->rpc(OP_PIN, keys_body(keys_blob, blob_len, nkeys),
+    std::vector<uint8_t> kb;
+    if (!keys_body(keys_blob, blob_len, nkeys, kb)) return BAD_REQUEST;
+    uint32_t st = c->rpc(OP_PIN, std::move(kb),
                          &resp);
     if (st != OK) return st;
     BufReader r(resp.data(), resp.size());
@@ -489,8 +542,9 @@ uint32_t ist_get_match_last_index(void* h, const uint8_t* keys_blob,
     auto* c = static_cast<Connection*>(h);
     if (c == nullptr) return INTERNAL_ERROR;
     std::vector<uint8_t> resp;
-    uint32_t st = c->rpc(OP_GET_MATCH_LAST_IDX,
-                         keys_body(keys_blob, blob_len, nkeys), &resp);
+    std::vector<uint8_t> kb;
+    if (!keys_body(keys_blob, blob_len, nkeys, kb)) return BAD_REQUEST;
+    uint32_t st = c->rpc(OP_GET_MATCH_LAST_IDX, std::move(kb), &resp);
     if (st != OK) return st;
     BufReader r(resp.data(), resp.size());
     *index = r.i32();
@@ -514,7 +568,9 @@ uint32_t ist_delete_keys(void* h, const uint8_t* keys_blob, uint64_t blob_len,
     auto* c = static_cast<Connection*>(h);
     if (c == nullptr) return INTERNAL_ERROR;
     std::vector<uint8_t> resp;
-    uint32_t st = c->rpc(OP_DELETE, keys_body(keys_blob, blob_len, nkeys),
+    std::vector<uint8_t> kb;
+    if (!keys_body(keys_blob, blob_len, nkeys, kb)) return BAD_REQUEST;
+    uint32_t st = c->rpc(OP_DELETE, std::move(kb),
                          &resp);
     if (st == OK && count) {
         BufReader r(resp.data(), resp.size());
@@ -531,7 +587,9 @@ uint32_t ist_reclaim_orphans(void* h, const uint8_t* keys_blob,
     auto* c = static_cast<Connection*>(h);
     if (c == nullptr) return INTERNAL_ERROR;
     std::vector<uint8_t> resp;
-    uint32_t st = c->rpc(OP_RECLAIM, keys_body(keys_blob, blob_len, nkeys),
+    std::vector<uint8_t> kb;
+    if (!keys_body(keys_blob, blob_len, nkeys, kb)) return BAD_REQUEST;
+    uint32_t st = c->rpc(OP_RECLAIM, std::move(kb),
                          &resp);
     if (st == OK && count) {
         BufReader r(resp.data(), resp.size());

@@ -79,3 +79,62 @@ def test_op_stats_exposed(conn, rng):
     ), s["op_stats"]
     for entry in s["op_stats"].values():
         assert entry["count"] > 0 and entry["total_us"] >= 0
+
+
+# ---- key-blob marshalling (wire vs NUL fast path) ----
+
+
+def test_pack_keys_formats():
+    """pack_keys picks the NUL fast path for plain keys and falls back
+    to the wire form for keys embedding NULs, bytes keys, and empty
+    lists — the exact dual contract capi.cc expand_keys parses."""
+    from infinistore_tpu._native import _NUL_MARKER, pack_keys
+
+    # Fast path: marker + count + NUL-joined.
+    blob = pack_keys(["ab", "c", ""])
+    assert blob.startswith(_NUL_MARKER)
+    assert blob[4:8] == (3).to_bytes(4, "little")
+    assert blob[8:] == b"ab\x00c\x00"
+
+    # Embedded NUL: wire form.
+    blob = pack_keys(["a\x00b", "c"])
+    assert not blob.startswith(_NUL_MARKER)
+    assert blob == (
+        (3).to_bytes(4, "little") + b"a\x00b"
+        + (1).to_bytes(4, "little") + b"c"
+    )
+
+    # Bytes keys: wire form.
+    blob = pack_keys([b"xy"])
+    assert blob == (2).to_bytes(4, "little") + b"xy"
+
+    # Empty list / generators.
+    assert pack_keys([]) == b""
+    assert pack_keys(k for k in ["a", "b"]).startswith(_NUL_MARKER)
+
+
+def test_nul_and_unicode_keys_roundtrip(conn):
+    """Keys that force the wire-form fallback (embedded NUL) and
+    non-ASCII keys (NUL fast path, multibyte utf-8) all round-trip
+    through a live server — both C parse paths end at the same wire
+    bytes."""
+    import numpy as np
+
+    keys = ["plain", "unié中", "nul\x00key", ""]
+    # Empty keys are legal wire-wise but useless; keep them non-empty
+    # for the data round trip.
+    keys = [k for k in keys if k]
+    block = 512
+    src = np.random.default_rng(0).integers(
+        0, 255, block * len(keys), dtype=np.uint8
+    )
+    blocks = conn.allocate(keys, block)
+    conn.write_cache(src, [i * block for i in range(len(keys))], block,
+                     blocks)
+    conn.sync()
+    dst = np.zeros_like(src)
+    conn.read_cache(dst, [(k, i * block) for i, k in enumerate(keys)],
+                    block)
+    conn.sync()
+    assert np.array_equal(src, dst)
+    assert conn.get_match_last_index(keys) == len(keys) - 1
