@@ -59,6 +59,39 @@ def _post(base, body):
         return json.loads(r.read())
 
 
+def _drive_pool(base, n_requests, prompts, new_tokens, totals,
+                totals_lock, wedge_msg="soak client wedged"):
+    """Shared soak driver: CLIENTS concurrent workers each firing
+    n_requests/CLIENTS requests from a fixed prompt pool, tallying into
+    `totals` — the ONE place the join-timeout and error accounting
+    live, used by every soak variant."""
+    def worker(wid, n):
+        my_rng = np.random.default_rng(wid)
+        for _ in range(n):
+            p = prompts[int(my_rng.integers(0, PROMPT_POOL))]
+            try:
+                res = _post(base, {
+                    "prompt": p, "max_new_tokens": new_tokens,
+                    "stream": False,
+                })
+                ok = len(res["tokens"]) == new_tokens
+            except Exception:
+                ok = False
+            with totals_lock:
+                totals["done" if ok else "errors"] += 1
+
+    share = n_requests // CLIENTS
+    threads = [
+        threading.Thread(target=worker, args=(w, share), daemon=True)
+        for w in range(CLIENTS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+        assert not t.is_alive(), wedge_msg
+
+
 @pytest.mark.soak
 def test_http_soak_10k_requests_memory_flat(shm_conn):
     cfg = llama.LlamaConfig(
@@ -77,31 +110,8 @@ def test_http_soak_10k_requests_memory_flat(shm_conn):
     totals_lock = threading.Lock()
 
     def drive(base, n_requests):
-        def worker(wid, n):
-            my_rng = np.random.default_rng(wid)
-            for _ in range(n):
-                p = prompts[int(my_rng.integers(0, PROMPT_POOL))]
-                try:
-                    res = _post(base, {
-                        "prompt": p, "max_new_tokens": NEW_TOKENS,
-                        "stream": False,
-                    })
-                    ok = len(res["tokens"]) == NEW_TOKENS
-                except Exception:
-                    ok = False
-                with totals_lock:
-                    totals["done" if ok else "errors"] += 1
-
-        share = n_requests // CLIENTS
-        threads = [
-            threading.Thread(target=worker, args=(w, share), daemon=True)
-            for w in range(CLIENTS)
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=600)
-            assert not t.is_alive(), "soak client wedged"
+        _drive_pool(base, n_requests, prompts, NEW_TOKENS, totals,
+                    totals_lock)
 
     rss_marks, store_marks = [], []
     for gen in range(N_GENERATIONS):
@@ -153,3 +163,86 @@ def test_http_soak_10k_requests_memory_flat(shm_conn):
         f"RSS grew {growth_kb} KiB across {2 * REQS_PER_GEN} warm "
         f"requests: {rss_marks}"
     )
+
+
+@pytest.mark.soak
+def test_http_soak_windowed_release_memory_flat(shm_conn):
+    """Endurance for the sliding-window rolling buffer: every request
+    releases pages mid-generation (prompt 12 + 24 new tokens >> window
+    16), each release offloading to the store first. The release/
+    re-allocate churn must leave the pool, store and RSS exactly as
+    flat as the plain soak — a leaked page or lease per release would
+    compound across thousands of requests."""
+    cfg = llama.LlamaConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=128, page_size=8, dtype="float32", window=16,
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    store = TpuKVStore(shm_conn)
+    rng = np.random.default_rng(11)
+    prompts = [
+        [int(t) for t in rng.integers(0, cfg.vocab_size, 12)]
+        for _ in range(PROMPT_POOL)
+    ]
+    new_tokens = 24  # 36 positions = 4.5 pages; floor frees 2+ per req
+
+    totals = {"done": 0, "errors": 0}
+    totals_lock = threading.Lock()
+
+    def drive(base, n_requests):
+        _drive_pool(base, n_requests, prompts, new_tokens, totals,
+                    totals_lock, wedge_msg="windowed soak client wedged")
+
+    baseline_kvmap = shm_conn.stats()["kvmap_len"]
+    store_marks = []
+    pool_marks = []
+    rss_marks = []
+    for gen in range(2):
+        eng = ServingEngine(
+            params, cfg,
+            ServingConfig(max_slots=CLIENTS, total_pages=64,
+                          model_id="soakwin"),
+            store=store,
+        )
+        srv = ServingHTTPServer(eng, port=0)
+        port = srv.start()
+        drive(f"http://127.0.0.1:{port}", 1200)
+        stats = srv.stats()
+        assert stats["requests_inflight"] == 0
+        assert stats["engine_ok"], "engine broke during windowed soak"
+        # Pool fully reclaimed: windowed release + finish must hand
+        # every page back exactly once.
+        pool_marks.append(sorted(eng.free_pages))
+        srv.shutdown()
+        del eng, srv
+        rss_marks.append(_rss_kb())
+        s = shm_conn.stats()
+        store_marks.append(
+            {k: s[k] for k in
+             ("kvmap_len", "used_bytes", "leases", "inflight")}
+        )
+
+    assert totals["errors"] == 0, totals
+    for pm in pool_marks:
+        assert pm == list(range(1, 64)), pm[:8]
+    # Deterministic greedy outputs over a fixed prompt set: generation
+    # 1 populates every reachable key (incl. release-time offloads);
+    # generation 2 must add nothing.
+    assert store_marks[-1]["kvmap_len"] == store_marks[0]["kvmap_len"], (
+        store_marks
+    )
+    assert store_marks[-1]["used_bytes"] == store_marks[0]["used_bytes"], (
+        store_marks
+    )
+    for m in store_marks:
+        assert m["leases"] == 0 and m["inflight"] == 0, store_marks
+    # The offloads genuinely happened: release-time offload populates
+    # content keys the baseline store did not hold (a regression that
+    # skipped the offload step would leave kvmap flat at baseline and
+    # the generation-equality checks above would pass vacuously).
+    assert store_marks[0]["kvmap_len"] > baseline_kvmap, (
+        baseline_kvmap, store_marks
+    )
+    # RSS flat after the warm generation (same 32 MiB slack rationale
+    # as the plain soak).
+    assert rss_marks[-1] - rss_marks[0] < 32 * 1024, rss_marks
