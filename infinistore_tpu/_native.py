@@ -99,7 +99,8 @@ def _decls(lib):
         (
             "ist_conn_create",
             c.c_void_p,
-            [c.c_char_p, c.c_uint16, c.c_int, c.c_uint64, c.c_int],
+            [c.c_char_p, c.c_uint16, c.c_int, c.c_uint64, c.c_int,
+             c.c_int, c.c_uint32, c.c_uint64],
         ),
         ("ist_conn_connect", c.c_int, [c.c_void_p]),
         ("ist_conn_close", None, [c.c_void_p]),
@@ -164,6 +165,15 @@ def _decls(lib):
              c.POINTER(c.c_void_p), c.c_int],
         ),
         ("ist_sync", c.c_uint32, [c.c_void_p, c.c_int]),
+        # lease fast path (zero-RTT puts, deferred batched commit)
+        (
+            "ist_lease_put",
+            c.c_uint32,
+            [c.c_void_p, c.c_uint32, c.c_char_p, c.c_uint64, c.c_uint32,
+             c.POINTER(c.c_void_p)],
+        ),
+        ("ist_lease_flush", c.c_uint32, [c.c_void_p]),
+        ("ist_lease_take_error", c.c_uint32, [c.c_void_p]),
         ("ist_commit", c.c_uint32, [c.c_void_p, c.POINTER(c.c_uint64), c.c_uint32]),
         (
             "ist_pin",
@@ -209,19 +219,19 @@ def _decls(lib):
         ("ist_mm_total_bytes", c.c_uint64, [c.c_void_p]),
         ("ist_mm_num_pools", c.c_uint64, [c.c_void_p]),
     ]
-    # ABI probe FIRST: pack_keys emits the v2 NUL-form blob, which a
-    # stale prebuilt library would forward to the server unparsed —
-    # every batched op would then fail with an unexplained BAD_REQUEST.
-    # A missing or old-version symbol fails loudly here instead.
+    # ABI probe FIRST: a stale prebuilt library would misparse the
+    # v3 ist_conn_create argument list (lease knobs) or lack the lease
+    # entry points entirely. A missing or old-version symbol fails
+    # loudly here instead.
     try:
         lib.ist_abi_version.restype = ct.c_uint32
         lib.ist_abi_version.argtypes = []
         ver = int(lib.ist_abi_version())
     except AttributeError:
         ver = 1
-    if ver < 2:
+    if ver < 3:
         raise RuntimeError(
-            f"stale native library at {_LIB_PATH} (ABI v{ver} < v2): "
+            f"stale native library at {_LIB_PATH} (ABI v{ver} < v3): "
             "rebuild with `make -C native` (or delete the .so to let "
             "the import auto-build)"
         )

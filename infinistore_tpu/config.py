@@ -45,6 +45,19 @@ class ClientConfig:
         # connection-level failure (timeout teardown / broken socket).
         # Beyond reference parity: the reference has no client reconnect.
         self.auto_reconnect = kwargs.get("auto_reconnect", False)
+        # Lease mode (SHM path only): put_cache carves destinations out
+        # of a server-granted block lease with zero round trips, commits
+        # ride one batched deferred OP_COMMIT_BATCH (flushed by sync(),
+        # the flush_size watermark or lease pressure), and reads of
+        # known locations skip the OP_PIN round trip via an
+        # epoch-validated pin cache. The SHM analogue of the reference's
+        # client-side MR cache. Off by default: leased put_cache is
+        # pipelined (visible after sync()), not synchronous.
+        self.use_lease = kwargs.get("use_lease", False)
+        # Pool blocks per OP_LEASE acquire (one RTT buys this many
+        # future allocations) and the deferred-commit flush watermark.
+        self.lease_blocks = kwargs.get("lease_blocks", 4096)
+        self.flush_size = kwargs.get("flush_size", 16 << 20)  # bytes
         if "INFINISTORE_LOG_LEVEL" in os.environ:
             self.log_level = os.environ["INFINISTORE_LOG_LEVEL"].lower()
         else:
@@ -70,6 +83,10 @@ class ClientConfig:
             raise Exception("log level should be error, debug, info or warning")
         if self.window_bytes <= 0:
             raise Exception("window_bytes must be positive")
+        if self.lease_blocks <= 0:
+            raise Exception("lease_blocks must be positive")
+        if self.flush_size <= 0:
+            raise Exception("flush_size must be positive")
 
 
 class ServerConfig:

@@ -211,6 +211,9 @@ class InfinityConnection:
             1 if want_shm else 0,
             self.config.window_bytes,
             self.config.timeout_ms,
+            1 if self.config.use_lease else 0,
+            self.config.lease_blocks,
+            self.config.flush_size,
         )
         if not h:
             raise Exception("Failed to create connection")
@@ -242,6 +245,28 @@ class InfinityConnection:
         # a double free (glibc abort; hit by the sharded background
         # redial loop when a shard stays down until close()).
         if self._h and self._h not in self._dead_handles:
+            if self.config.use_lease and self.connected:
+                # Best-effort: commit the pending deferred batch before
+                # teardown, bounded so close() can never hang on a dead
+                # server — put_cache(); close() without a sync() then
+                # stays loss-free on a healthy one (the pre-lease
+                # synchronous-put behavior).
+                try:
+                    self._lib.ist_lease_flush(self._h)
+                    st = self._lib.ist_sync(
+                        self._h, min(self.config.timeout_ms, 2000)
+                    )
+                    lerr = self._lib.ist_lease_take_error(self._h)
+                    if st != OK or lerr:
+                        # close() must not raise, but a lost tail batch
+                        # must not vanish silently either.
+                        Logger.warning(
+                            "close: deferred leased commit may be lost "
+                            f"(sync={status_name(st)}, "
+                            f"err={status_name(lerr) if lerr else 'none'})"
+                        )
+                except Exception:
+                    pass
             self._lib.ist_conn_close(self._h)
             self._lib.ist_conn_destroy(self._h)
         self._h = None
@@ -303,6 +328,17 @@ class InfinityConnection:
         # otherwise double-destroy it.
         if self._h and self._h not in self._dead_handles:
             self._lib.ist_conn_close(self._h)
+            if self.config.use_lease:
+                # Deferred-commit failures latch on the NATIVE handle
+                # (in-flight OP_COMMIT_BATCHes failed by the teardown,
+                # un-flushed pend batches wiped by close): harvest them
+                # into the Python-side error list — which survives the
+                # handle swap — or the next sync() would report success
+                # for leased puts that never committed.
+                lerr = self._lib.ist_lease_take_error(self._h)
+                if lerr:
+                    with self._async_errors_lock:
+                        self._async_errors.append(lerr)
             self._dead_handles.append(self._h)
             # Leave self._h pointing at the closed handle until connect()
             # swaps in the new one: a concurrent thread mid-call fails
@@ -629,6 +665,17 @@ class InfinityConnection:
         esize = arr.itemsize
         page_bytes = page_size * esize
         keys = [k for k, _ in blocks]
+        if self.shm_connected and self.config.use_lease:
+            # Lease fast path: zero-RTT carve + one-sided copy; the
+            # commit is DEFERRED into the connection's pending batch
+            # (sync() barriers it; failures surface there, like
+            # pipelined writes). PARTIAL means the lease machinery
+            # cannot serve this shape (no ctl page, fragmented grant,
+            # page larger than any lease) — fall through to the legacy
+            # allocate+write+commit path below.
+            if self._lease_put_native(arr, blocks, page_bytes, keys):
+                cb(OK)
+                return
         if self.shm_connected:
             # allocate + one-sided memcpy + commit; _write_async_native
             # does the offset validation.
@@ -657,8 +704,44 @@ class InfinityConnection:
             self._drop_keep(ka.kid)
             raise InfiniStoreError(st, "put submit failed")
 
+    def _lease_put_native(self, arr, blocks, page_bytes, keys):
+        """Blocking native leased put (carve + copy + deferred commit).
+        Returns True when the lease path handled the batch, False when
+        the caller should fall back to the legacy path."""
+        esize = arr.itemsize
+        base = arr.ctypes.data
+        nbytes = arr.nbytes
+        byte_offs = (
+            np.asarray([off for _, off in blocks], dtype=np.int64) * esize
+        )
+        if len(byte_offs) and (
+            int(byte_offs.min()) < 0
+            or int(byte_offs.max()) + page_bytes > nbytes
+        ):
+            raise ValueError("offset out of tensor bounds")
+        srcs = np.uint64(base) + byte_offs.astype(np.uint64)
+        src_arr = np.ascontiguousarray(srcs, dtype=np.uint64)
+        blob = pack_keys(keys)
+        st = self._lib.ist_lease_put(
+            self._h, page_bytes, blob, len(blob), len(keys),
+            src_arr.ctypes.data_as(ct.POINTER(ct.c_void_p)),
+        )
+        if st == OK:
+            return True
+        if st == _native.PARTIAL:
+            return False  # lease path unfit for this shape
+        raise InfiniStoreError(st, "leased put failed")
+
     def put_cache(self, cache, blocks, page_size):
-        """Synchronous one-call put of (key, offset) pairs."""
+        """Synchronous one-call put of (key, offset) pairs. In lease
+        mode (``ClientConfig(use_lease=True)``, SHM path) the commit is
+        deferred and batched: the data is visible to readers only after
+        the next :meth:`sync` (or an internal watermark flush) — the
+        same pipelined contract as :meth:`write_cache`. On a lease-mode
+        error (e.g. OUT_OF_MEMORY mid-batch) a PREFIX of the batch may
+        already be committed — like any watermark-flushed earlier
+        batch; retrying the whole put is safe (committed keys dedup
+        against identical content)."""
         self._check()
         return self._run_reconnecting(
             lambda: self._put_cache_once(cache, blocks, page_size),
@@ -682,6 +765,21 @@ class InfinityConnection:
 
     async def put_cache_async(self, cache, blocks, page_size):
         self._check()
+        if self.shm_connected and self.config.use_lease:
+            # Lease fast path, same as the sync put_cache: the native
+            # call blocks on carve+copy (and occasionally an OP_LEASE
+            # rpc), so it runs off the event loop; the deferred commit
+            # is barriered by sync_async like every pipelined write.
+            arr = _as_src_array(cache)
+            keys = [k for k, _ in blocks]
+            handled = await asyncio.get_running_loop().run_in_executor(
+                None, self._lease_put_native, arr, blocks,
+                page_size * arr.itemsize, keys,
+            )
+            if handled:
+                return 0
+            # PARTIAL (lease path unfit): fall through to the legacy
+            # allocate + one-sided write below.
         if self.shm_connected:
             # The SHM put needs a blocking allocate rpc first — run it off
             # the event loop, then the async one-sided write.
@@ -829,18 +927,31 @@ class InfinityConnection:
         """Barrier: wait until all async ops on this connection completed
         and are visible to every other connection (reference sync_rdma /
         sync_local; the visibility guarantee is stronger here — see
-        native/src/server.h commit-race note)."""
+        native/src/server.h commit-race note). In lease mode this also
+        flushes the pending deferred-commit batch first, so leased puts
+        are committed and visible once sync returns."""
         self._check()
+        if self.config.use_lease:
+            self._lib.ist_lease_flush(self._h)
         st = self._lib.ist_sync(self._h, self.config.timeout_ms)
         if st != OK:
             raise InfiniStoreError(st, "sync failed")
+        self._raise_async_errors()
+        return 0
+
+    def _raise_async_errors(self):
+        if self.config.use_lease:
+            lerr = self._lib.ist_lease_take_error(self._h)
+            if lerr:
+                raise InfiniStoreError(
+                    lerr, "deferred leased commit failed"
+                )
         with self._async_errors_lock:
             errs, self._async_errors = self._async_errors, []
         if errs:
             raise InfiniStoreError(
                 errs[0], f"{len(errs)} pipelined write(s) failed"
             )
-        return 0
 
     async def sync_async(self):
         """Native async barrier: completes when the connection's inflight
@@ -848,6 +959,15 @@ class InfinityConnection:
         executor hop)."""
         self._check()
         loop = asyncio.get_running_loop()
+        if self.config.use_lease:
+            # Off-loop: the flush itself only enqueues the pending
+            # commit batch, but it takes lease_mu_, which a concurrent
+            # put_cache_async executor thread may hold across a whole
+            # carve+copy (or a blocking OP_LEASE rpc) — waiting for
+            # that on the event loop would freeze every coroutine.
+            await loop.run_in_executor(
+                None, self._lib.ist_lease_flush, self._h
+            )
         future = loop.create_future()
 
         def cb(status):
@@ -862,12 +982,7 @@ class InfinityConnection:
             await asyncio.wait_for(future, self.config.timeout_ms / 1000)
         except asyncio.TimeoutError:
             raise InfiniStoreError(TIMEOUT_ERR, "sync timed out") from None
-        with self._async_errors_lock:
-            errs, self._async_errors = self._async_errors, []
-        if errs:
-            raise InfiniStoreError(
-                errs[0], f"{len(errs)} pipelined write(s) failed"
-            )
+        self._raise_async_errors()
         return 0
 
     def check_exist(self, key):

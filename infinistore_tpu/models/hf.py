@@ -228,6 +228,19 @@ def moe_config_from_hf(hf_cfg, page_size=16, dtype="float32"):
             "Mixtral sliding_window set: the MoE family does not route "
             "windowed attention configs yet"
         )
+    # Never silently diverge (the dense bridge's contract): the MoE
+    # attention stack has no rope-scaling slot at all, so ANY scaling —
+    # including 'llama3', which the dense bridge wires through — would
+    # load and then produce wrong logits at every position.
+    scaling = getattr(hf_cfg, "rope_scaling", None)
+    if scaling:
+        rope_type = scaling.get("rope_type", scaling.get("type", ""))
+        if rope_type != "default":
+            raise NotImplementedError(
+                f"rope_scaling type {rope_type!r} is not supported by "
+                "the MoE bridge (the MoE attention stack applies "
+                "unscaled RoPE only)"
+            )
     if getattr(hf_cfg, "hidden_act", "silu") not in ("silu", "swish"):
         raise NotImplementedError(
             f"MoE expert activation {hf_cfg.hidden_act!r}: the expert "
@@ -272,6 +285,17 @@ def moe_params_from_hf(model_or_state_dict, cfg):
     for li in range(cfg.n_layers):
         p = f"model.layers.{li}."
         m = p + "block_sparse_moe."
+        # attention_bias=True checkpoints carry per-projection biases the
+        # MoE attention has no parameter slots for — hard-error rather
+        # than dropping them (the dense bridge maps these; here they
+        # would silently vanish and shift every attention output).
+        for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            if p + f"self_attn.{proj}.bias" in sd:
+                raise NotImplementedError(
+                    "attention_bias=True checkpoints are not supported "
+                    f"by the MoE bridge: {p}self_attn.{proj}.bias has "
+                    "no parameter slot"
+                )
         layers.append({
             "ln1": _t(sd, p + "input_layernorm.weight", dt),
             "wq": _t(sd, p + "self_attn.q_proj.weight", dt).T,

@@ -604,6 +604,19 @@ class ServingEngine:
         if work.probe is None:
             work.probe = self._probe_hit(work)
         hit, digests = work.probe
+        store_chain = (self.store is not None and self._store_ok
+                       and work.req.cache)
+        if not store_chain and hit:
+            # The probe is cached on work while the request waits under
+            # pool pressure, so it can OUTLIVE the store: another slot's
+            # store failure latching _store_ok=False between the probe
+            # and this (re)admission would otherwise leave hit > 0 while
+            # skip is computed store-less (skip = p0 != first_live) —
+            # the restore would still run and trip the pool-placement
+            # `assert skip == first_live` (under -O, silently misplace
+            # suffix pages). A dead store chain means a cache MISS, not
+            # a smaller hit.
+            hit, digests = 0, []
         # Windowed admission floors. Three distinct boundaries:
         #   first_live — earliest page the SUFFIX PREFILL can attend
         #     (the first suffix query sits at hit*page; its band floor
@@ -634,8 +647,6 @@ class ServingEngine:
         #   - the chunked path always needs pool pages from first_live
         #     (its chunk queries attend POOL pages, floor rising as
         #     chunks consume the prompt).
-        store_chain = (self.store is not None and self._store_ok
-                       and work.req.cache)
         if self.sc.prefill_chunk > 0 or store_chain:
             skip = min(first_live, hit)
         else:

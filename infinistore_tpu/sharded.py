@@ -402,7 +402,37 @@ class ShardedConnection:
         return rb
 
     def put_cache(self, cache, blocks, page_size):
-        """InfinityConnection-compatible name: sharded put + barrier."""
+        """InfinityConnection-compatible name: sharded put + barrier.
+
+        When a shard's ClientConfig enables ``use_lease``, that shard's
+        partition rides its connection's zero-RTT leased put (each
+        per-shard connection holds and REUSES its own block lease and
+        pin cache across batches); the final sync() fans out and flushes
+        every shard's deferred commit batch. Lease-less shards take the
+        classic allocate+write path unchanged."""
+        if any(c.config.use_lease for c in self.conns):
+            parts = {}
+            for k, off in blocks:
+                parts.setdefault(_shard_of(k, self.n), []).append((k, off))
+            parts = list(parts.items())
+            results = self._run_shard_calls(
+                [(s, self.conns[s].put_cache, (cache, pairs, page_size))
+                 for s, pairs in parts]
+            )
+            # A down shard drops its whole partition into
+            # lost_write_keys — the fused-put convention put_cache_async
+            # already documents (allocate and write fuse inside the
+            # per-shard call, so the sync path's skipped-alloc/
+            # lost-write split does not apply here either).
+            dropped = sum(
+                len(pairs) for (_s, pairs), (ok, _v) in zip(parts, results)
+                if not ok
+            )
+            if dropped:
+                with self._health_lock:
+                    self.health["lost_write_keys"] += dropped
+            self.sync()
+            return 0
         self.put(cache, blocks, page_size)
         self.sync()
         return 0

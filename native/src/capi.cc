@@ -97,9 +97,10 @@ extern "C" {
 // ---- logging ----------------------------------------------------------
 
 // Bumped whenever the Python<->C contract changes (v2: NUL-form key
-// blobs). _native.py probes this at load so a stale prebuilt library
+// blobs; v3: lease-mode ist_conn_create signature + lease entry
+// points). _native.py probes this at load so a stale prebuilt library
 // fails loudly instead of feeding unparseable blobs to the server.
-uint32_t ist_abi_version(void) { return 2; }
+uint32_t ist_abi_version(void) { return 3; }
 
 void ist_set_log_level(int level) { set_log_level(level); }
 void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }
@@ -185,13 +186,17 @@ int ist_server_shm_prefix(void* h, char* buf, int cap) {
 // ---- client -----------------------------------------------------------
 
 void* ist_conn_create(const char* host, uint16_t port, int use_shm,
-                      uint64_t window_bytes, int timeout_ms) {
+                      uint64_t window_bytes, int timeout_ms, int use_lease,
+                      uint32_t lease_blocks, uint64_t flush_bytes) {
     ClientConfig cfg;
     cfg.host = host ? host : "127.0.0.1";
     cfg.port = port;
     cfg.use_shm = use_shm != 0;
     if (window_bytes) cfg.window_bytes = window_bytes;
     if (timeout_ms) cfg.timeout_ms = timeout_ms;
+    cfg.use_lease = use_lease != 0;
+    if (lease_blocks) cfg.lease_blocks = lease_blocks;
+    if (flush_bytes) cfg.flush_bytes = flush_bytes;
     return new Connection(cfg);
 }
 
@@ -374,19 +379,39 @@ uint32_t ist_read(void* h, uint32_t block_size, const uint8_t* keys_blob,
     std::vector<void*> dp(dsts, dsts + nkeys);
     std::vector<uint8_t> kb;
     if (!keys_body(keys_blob, blob_len, nkeys, kb)) return BAD_REQUEST;
+    // Lease mode: try the pin cache first — a full hit is a pure
+    // epoch-validated memcpy, ZERO round trips (hot repeated gets drop
+    // from ~47 us to the copy cost). On a miss the PIN below seeds the
+    // cache for next time. The per-key parse here is a deliberate cost
+    // even for workloads that never re-read a key: it is what makes
+    // seeding (and hence every future hit) possible, and it is ~10% of
+    // a bulk read's copy time.
+    std::vector<std::string> keys;
+    const bool lease_mode = c->lease_ready() && c->shm_active();
+    if (lease_mode) {
+        BufReader r(kb.data(), kb.size());
+        if (!r.keys(&keys) || keys.size() != nkeys) {
+            keys.clear();
+        } else if (c->cached_read(block_size, keys, dp)) {
+            return OK;
+        }
+    }
     // Hybrid dispatch on SHM connections: the one-sided pool path pays a
     // fixed PIN+RELEASE round trip that dominates SMALL reads (measured
     // p50 of a single 4 KB read: ~47 us via pin+memcpy vs ~33 us via the
     // socket's server-push OP_READ), while its memcpy bandwidth wins for
     // BULK reads (3.9 vs 1.9 GB/s). Crossover is where the ~15 us fixed
     // cost equals the socket's extra per-byte cost (~0.27 ns/B) ≈ 55 KB;
-    // 32 KB keeps a safety margin.
+    // 32 KB keeps a safety margin. Lease mode always takes the PIN path:
+    // only it populates the cache that makes the NEXT read free.
     constexpr uint64_t kSmallReadBytes = 32u << 10;
     uint64_t total = uint64_t(block_size) * nkeys;
-    if (c->shm_active() && total > kSmallReadBytes) {
+    if (c->shm_active() &&
+        (total > kSmallReadBytes || (lease_mode && !keys.empty()))) {
         // Fully inline: PIN rpc + caller-thread copies + async RELEASE.
         return c->shm_read_blocking(block_size, std::move(kb),
-                                    std::move(dp));
+                                    std::move(dp),
+                                    keys.empty() ? nullptr : &keys);
     }
     // ONE waiter serves both socket branches; `buf` non-empty selects
     // the bounce-buffer mode (scatter into owned memory, copy out to
@@ -456,6 +481,39 @@ uint32_t ist_read(void* h, uint32_t block_size, const uint8_t* keys_blob,
         return TIMEOUT_ERR;
     }
     return w->st;
+}
+
+// ---- lease fast path ---------------------------------------------------
+
+// Zero-RTT leased put: carve destinations from the connection's block
+// lease locally, copy (parallel engine above the size threshold, GIL
+// already released by ctypes) and defer the commit into the pending
+// batch (flushed by watermark, lease pressure or ist_lease_flush).
+// Returns OK / OUT_OF_MEMORY / PARTIAL (lease path unfit — caller
+// should fall back to allocate+write+commit).
+uint32_t ist_lease_put(void* h, uint32_t block_size,
+                       const uint8_t* keys_blob, uint64_t blob_len,
+                       uint32_t nkeys, const void* const* srcs) {
+    auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
+    std::vector<uint8_t> kb;
+    if (!keys_body(keys_blob, blob_len, nkeys, kb)) return BAD_REQUEST;
+    std::vector<const void*> sp(srcs, srcs + nkeys);
+    return c->lease_put(block_size, std::move(kb), nkeys, std::move(sp));
+}
+
+// Flush the pending deferred-commit batch (async; sync() barriers it).
+uint32_t ist_lease_flush(void* h) {
+    auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
+    return c->lease_flush();
+}
+
+// First failing deferred-commit status since the last call (0 = none).
+uint32_t ist_lease_take_error(void* h) {
+    auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
+    return c->lease_take_error();
 }
 
 // Commit previously allocated tokens (used by the zero-copy Python path

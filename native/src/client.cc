@@ -13,6 +13,7 @@
 #include <sys/uio.h>
 #include <unistd.h>
 
+#include <cstdlib>
 #include <cstring>
 
 #include "log.h"
@@ -81,6 +82,142 @@ bool recv_exact(int fd, void* p, size_t n) {
 
 }  // namespace
 
+// ---------------------------------------------------------------------------
+// CopyPool — parallel memcpy engine for the lease fast path
+// ---------------------------------------------------------------------------
+
+namespace {
+// Below this total the handoff costs more than the copy saves.
+constexpr size_t kParallelCopyBytes = 1u << 20;
+// Workers pull pieces of at most this size (large coalesced runs are
+// split so the tail of one huge seg cannot serialize the batch).
+constexpr size_t kCopyChunkBytes = 512u << 10;
+}  // namespace
+
+CopyPool& CopyPool::inst() {
+    static CopyPool pool;
+    return pool;
+}
+
+CopyPool::CopyPool() {
+    // Workers only help when there are spare cores BEYOND the caller,
+    // the server loop and the client IO thread: on a 1-2 core host the
+    // handoff turns into pure context-switch overhead and a descheduled
+    // worker holding the last chunk serializes the whole batch
+    // (measured ~2x slower than inline memcpy on the 2-core CI VM).
+    // ISTPU_COPY_THREADS overrides the heuristic (0 forces inline).
+    unsigned n;
+    const char* env = getenv("ISTPU_COPY_THREADS");
+    if (env != nullptr) {
+        long v = atol(env);
+        n = v > 0 ? unsigned(v) : 0;
+    } else {
+        unsigned hw = std::thread::hardware_concurrency();
+        n = hw >= 4 ? hw - 2 : 0;
+    }
+    if (n > 4) n = 4;
+    for (unsigned i = 0; i < n; ++i) {
+        threads_.emplace_back([this] { worker(); });
+    }
+}
+
+CopyPool::~CopyPool() {
+    {
+        std::lock_guard<std::mutex> lk(mu_);
+        stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : threads_) t.join();
+}
+
+void CopyPool::add_seg(std::vector<Seg>& segs, uint8_t* dst,
+                       const uint8_t* src, size_t len) {
+    if (len == 0) return;
+    if (!segs.empty() && segs.back().dst + segs.back().len == dst &&
+        segs.back().src + segs.back().len == src) {
+        segs.back().len += len;  // coalesce adjacent runs
+        return;
+    }
+    segs.push_back(Seg{dst, src, len});
+}
+
+void CopyPool::worker() {
+    uint64_t seen = 0;
+    while (true) {
+        std::shared_ptr<Round> round;
+        {
+            std::unique_lock<std::mutex> lk(mu_);
+            cv_.wait(lk, [&] { return stop_ || (round_ && gen_ != seen); });
+            if (stop_) return;
+            seen = gen_;
+            round = round_;
+        }
+        const size_t n = round->segs.size();
+        size_t i;
+        size_t local = 0;
+        while ((i = round->next.fetch_add(1, std::memory_order_relaxed)) <
+               n) {
+            const Seg& s = round->segs[i];
+            memcpy(s.dst, s.src, s.len);
+            local++;
+        }
+        if (local &&
+            round->done.fetch_add(local, std::memory_order_acq_rel) +
+                    local ==
+                n) {
+            std::lock_guard<std::mutex> lk(mu_);
+            done_cv_.notify_all();
+        }
+    }
+}
+
+void CopyPool::run(std::vector<Seg> segs) {
+    if (segs.empty()) return;
+    size_t total = 0;
+    for (const Seg& s : segs) total += s.len;
+    if (threads_.empty() || total < kParallelCopyBytes) {
+        for (const Seg& s : segs) memcpy(s.dst, s.src, s.len);
+        return;
+    }
+    // Split big runs so every thread gets work.
+    std::vector<Seg> chunks;
+    chunks.reserve(segs.size() + total / kCopyChunkBytes + 1);
+    for (const Seg& s : segs) {
+        size_t off = 0;
+        while (off < s.len) {
+            size_t take = std::min(kCopyChunkBytes, s.len - off);
+            chunks.push_back(Seg{s.dst + off, s.src + off, take});
+            off += take;
+        }
+    }
+    std::lock_guard<std::mutex> rlk(run_mu_);  // one batch at a time
+    auto round = std::make_shared<Round>();
+    round->segs = std::move(chunks);
+    const size_t n = round->segs.size();
+    {
+        std::lock_guard<std::mutex> lk(mu_);
+        round_ = round;
+        gen_++;
+    }
+    cv_.notify_all();
+    // The caller is a worker too.
+    size_t i;
+    size_t local = 0;
+    while ((i = round->next.fetch_add(1, std::memory_order_relaxed)) < n) {
+        const Seg& s = round->segs[i];
+        memcpy(s.dst, s.src, s.len);
+        local++;
+    }
+    round->done.fetch_add(local, std::memory_order_acq_rel);
+    {
+        std::unique_lock<std::mutex> lk(mu_);
+        done_cv_.wait(lk, [&] {
+            return round->done.load(std::memory_order_acquire) == n;
+        });
+        round_.reset();  // stragglers hold their own shared_ptr
+    }
+}
+
 Connection::Connection(const ClientConfig& cfg) : cfg_(cfg) {
     rdrain_.resize(1 << 20);
 }
@@ -111,6 +248,34 @@ int Connection::connect_server() {
             if (map_pools_locked(r) == 0 && !pools_.empty()) {
                 shm_active_ = true;
             }
+        }
+    }
+    // Trailing lease-protocol fields (absent from older servers: the
+    // reader just latches !ok and lease mode stays off). The ctl page
+    // carries the live store epoch; mapping it is what makes zero-RTT
+    // pin-cache validation possible.
+    if (cfg_.use_lease && shm_active_) {
+        uint32_t has_ctl = r.u32();
+        std::string ctl_name = r.str();
+        if (r.ok() && has_ctl && !ctl_name.empty()) {
+            int cfd = shm_open(("/" + ctl_name).c_str(), O_RDONLY, 0);
+            if (cfd >= 0) {
+                void* mem = mmap(nullptr, CTL_PAGE_BYTES, PROT_READ,
+                                 MAP_SHARED, cfd, 0);
+                close(cfd);
+                if (mem != MAP_FAILED) {
+                    auto* page = static_cast<CtlPage*>(mem);
+                    if (page->magic == CTL_MAGIC) {
+                        ctl_map_ = page;
+                    } else {
+                        munmap(mem, CTL_PAGE_BYTES);
+                    }
+                }
+            }
+        }
+        if (ctl_map_ == nullptr) {
+            IST_DEBUG("lease mode requested but ctl page unavailable; "
+                      "falling back to legacy ops");
         }
     }
 
@@ -182,10 +347,42 @@ void Connection::close_conn() {
     if (epoll_fd_ >= 0) close(epoll_fd_);
     if (wake_fd_ >= 0) close(wake_fd_);
     fd_ = epoll_fd_ = wake_fd_ = -1;
+    {
+        // Lease/pin state dies with the connection (the server reclaims
+        // the lease blocks when it sees the close). Un-flushed deferred
+        // puts are LOST — latch that as an error so a caller that
+        // reconnects and syncs learns about it (lib.py harvests the old
+        // handle's latch on reconnect), mirroring how in-flight legacy
+        // writes fail loudly through their completion callbacks.
+        std::lock_guard<std::mutex> llk(lease_mu_);
+        if (pend_nkeys_ != 0) {
+            uint32_t expected = 0;
+            lease_err_.compare_exchange_strong(expected, INTERNAL_ERROR);
+        }
+        lease_valid_ = false;
+        lease_runs_.clear();
+        pend_blob_.clear();
+        pend_locs_.clear();
+        pend_nkeys_ = 0;
+        pend_bytes_ = 0;
+    }
+    {
+        std::lock_guard<std::mutex> clk(cache_mu_);
+        pin_cache_.clear();
+    }
+    // Unmap pools AND the ctl page under pools_mu_: cached_read holds
+    // that mutex across its pool copies and epoch loads, so a reader
+    // mid-copy on another thread excludes this teardown (the same
+    // protection the legacy shm copy paths get from their pools_mu_
+    // hold).
     std::lock_guard<std::mutex> lk(pools_mu_);
     for (auto& p : pools_) munmap(p.base, p.size);
     pools_.clear();
     shm_active_ = false;
+    if (ctl_map_ != nullptr) {
+        munmap(ctl_map_, CTL_PAGE_BYTES);
+        ctl_map_ = nullptr;
+    }
 }
 
 void Connection::wake() {
@@ -493,7 +690,9 @@ void Connection::shm_write_async(uint32_t block_size,
 
 uint32_t Connection::shm_read_blocking(uint32_t block_size,
                                        std::vector<uint8_t> keys_body,
-                                       std::vector<void*> dsts) {
+                                       std::vector<void*> dsts,
+                                       const std::vector<std::string>*
+                                           cache_keys) {
     if (broken_.load() || !running_.load()) return INTERNAL_ERROR;
     std::vector<uint8_t> body(std::move(keys_body));
     // PIN with an abandonment-aware wait: if the caller times out before
@@ -542,6 +741,11 @@ uint32_t Connection::shm_read_blocking(uint32_t block_size,
     uint64_t lease = r.u64();
     uint32_t n = r.u32();
     const uint8_t* raw = r.raw(size_t(n) * sizeof(RemoteBlock));
+    // Trailing store epoch (for pin-cache population; 0 from servers
+    // that predate the lease protocol — entries then never validate,
+    // which is the safe direction).
+    uint64_t srv_epoch = 0;
+    if (raw != nullptr && r.remaining() >= 8) srv_epoch = r.u64();
     uint32_t rc = OK;
     if (raw == nullptr || n != dsts.size()) {
         rc = INTERNAL_ERROR;
@@ -604,6 +808,12 @@ uint32_t Connection::shm_read_blocking(uint32_t block_size,
             memcpy(dsts[i], pools_[blk.pool_idx].base + blk.offset,
                    (j - i) * size_t(block_size));
             i = j;
+        }
+        // Seed the pin cache from this PIN's locations so the next read
+        // of these keys skips the rpc entirely (validated against the
+        // shared epoch at read time).
+        if (rc == OK && cache_keys != nullptr) {
+            cache_pins(*cache_keys, blks.data(), n, srv_epoch);
         }
     }
     // Fire-and-forget release; the lease served its purpose.
@@ -719,6 +929,381 @@ void Connection::shm_read_async(uint32_t block_size,
         submits_.push_back(std::move(s));
     }
     wake();
+}
+
+// ---------------------------------------------------------------------------
+// Lease fast path: zero-RTT puts + batched deferred commit + pin cache
+// ---------------------------------------------------------------------------
+
+void Connection::commit_batch_async(std::vector<uint8_t> body, DoneFn done) {
+    // Like rpc_async but inflight-accounted: sync() must barrier the
+    // deferred commits or a caller could observe its own put missing.
+    inflight_++;
+    if (broken_.load() || !running_.load()) {
+        if (done) done(INTERNAL_ERROR, {});
+        finish_op();
+        return;
+    }
+    auto body_p = std::make_shared<std::vector<uint8_t>>(std::move(body));
+    Submit s;
+    s.fn = [this, body_p, done = std::move(done)]() mutable {
+        Pending p;
+        p.op = OP_COMMIT_BATCH;
+        p.done = [this, done = std::move(done)](uint32_t st,
+                                                std::vector<uint8_t> b) {
+            if (done) done(st, std::move(b));
+            finish_op();
+        };
+        enqueue_msg(OP_COMMIT_BATCH, std::move(*body_p), {}, std::move(p));
+    };
+    {
+        std::lock_guard<std::mutex> lk(submit_mu_);
+        submits_.push_back(std::move(s));
+    }
+    wake();
+}
+
+uint32_t Connection::acquire_lease_locked(uint32_t min_blocks) {
+    if (lease_valid_) {
+        // Return the old lease's unconsumed remainder. Fire-and-forget,
+        // but ordered AFTER any commit batch already submitted for it
+        // (both ride the same FIFO submit queue and socket).
+        std::vector<uint8_t> rb;
+        BufWriter rw(rb);
+        rw.u64(lease_id_);
+        rpc_async(OP_LEASE_REVOKE, std::move(rb), {});
+        lease_valid_ = false;
+    }
+    uint64_t want = std::max<uint64_t>(min_blocks, cfg_.lease_blocks);
+    if (want > MAX_LEASE_BLOCKS) want = MAX_LEASE_BLOCKS;
+    if (want < min_blocks) return PARTIAL;  // key bigger than any lease
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    w.u32(uint32_t(want));
+    std::vector<uint8_t> resp;
+    uint32_t st = rpc(OP_LEASE, std::move(body), &resp);
+    // BUSY = per-connection grant cap (we hold too many unconsumed
+    // blocks): let the caller fall back to the legacy path, which the
+    // cap does not gate, instead of surfacing a hard error.
+    if (st == BUSY) return PARTIAL;
+    if (st != OK) return st;
+    BufReader r(resp.data(), resp.size());
+    uint64_t id = r.u64();
+    r.u64();  // epoch snapshot; the live word is in the ctl page
+    uint32_t nruns = r.u32();
+    if (!r.ok() || nruns == 0 || nruns > 64) return INTERNAL_ERROR;
+    std::vector<ClientRun> runs(nruns);
+    uint32_t max_pool = 0;
+    for (auto& run : runs) {
+        run.pool_idx = r.u32();
+        run.offset = r.u64();
+        run.nblocks = r.u32();
+        if (run.pool_idx > max_pool) max_pool = run.pool_idx;
+    }
+    if (!r.ok()) return INTERNAL_ERROR;
+    bool mapped;
+    {
+        std::lock_guard<std::mutex> plk(pools_mu_);
+        mapped = max_pool < pools_.size();
+    }
+    if (!mapped) {
+        // Granted out of a pool the server auto-extended after our
+        // HELLO: map it before carving (never write blind).
+        refresh_pools();
+        std::lock_guard<std::mutex> plk(pools_mu_);
+        mapped = max_pool < pools_.size();
+    }
+    if (!mapped) {
+        std::vector<uint8_t> rb;
+        BufWriter rw(rb);
+        rw.u64(id);
+        rpc_async(OP_LEASE_REVOKE, std::move(rb), {});
+        return PARTIAL;
+    }
+    lease_id_ = id;
+    lease_runs_ = std::move(runs);
+    lease_run_idx_ = 0;
+    lease_block_off_ = 0;
+    lease_valid_ = true;
+    return OK;
+}
+
+void Connection::post_task(std::function<void()> fn) {
+    {
+        std::lock_guard<std::mutex> lk(submit_mu_);
+        Submit s;
+        s.fn = std::move(fn);
+        submits_.push_back(std::move(s));
+    }
+    wake();
+}
+
+void Connection::flush_locked() {
+    if (pend_nkeys_ == 0) return;
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    w.u64(lease_id_);
+    w.u32(pend_bsize_);
+    w.u32(pend_nkeys_);
+    w.bytes(pend_blob_.data(), pend_blob_.size());
+    auto blob =
+        std::make_shared<std::vector<uint8_t>>(std::move(pend_blob_));
+    auto locs =
+        std::make_shared<std::vector<CachedLoc>>(std::move(pend_locs_));
+    const uint32_t nkeys = pend_nkeys_;
+    pend_blob_.clear();
+    pend_locs_.clear();
+    pend_nkeys_ = 0;
+    pend_bytes_ = 0;
+    commit_batch_async(
+        std::move(body),
+        [this, blob, locs, nkeys](uint32_t st, std::vector<uint8_t> b) {
+            if (st != OK) {
+                // Latch the FIRST failure; surfaced at the next sync()
+                // exactly like pipelined write errors.
+                uint32_t expected = 0;
+                lease_err_.compare_exchange_strong(expected, st);
+                return;
+            }
+            BufReader r(b.data(), b.size());
+            r.u32();  // committed count
+            uint64_t epoch = r.u64();
+            uint32_t nd = r.u32();
+            auto dedup = std::make_shared<std::vector<bool>>(nkeys, false);
+            for (uint32_t i = 0; i < nd && r.ok(); ++i) {
+                uint32_t idx = r.u32();
+                if (idx < nkeys) (*dedup)[idx] = true;
+            }
+            if (!r.ok()) return;
+            // Seed the pin cache OFF the sync() critical path: this
+            // completion holds up the caller's barrier, so the per-key
+            // parse + inserts run as a follow-up IO-thread task (a read
+            // racing the seeding just misses and takes the PIN path).
+            post_task([this, blob, locs, dedup, nkeys, epoch] {
+                BufReader kr(blob->data(), blob->size());
+                std::lock_guard<std::mutex> clk(cache_mu_);
+                for (uint32_t i = 0; i < nkeys; ++i) {
+                    std::string key = kr.str();
+                    if (!kr.ok()) return;
+                    // Dedup'd keys live at ANOTHER writer's location,
+                    // which we do not know — skip them.
+                    if ((*dedup)[i]) continue;
+                    CachedLoc loc = (*locs)[i];
+                    loc.epoch = epoch;
+                    cache_insert_locked(std::move(key), loc);
+                }
+            });
+        });
+}
+
+uint32_t Connection::lease_put(uint32_t block_size,
+                               std::vector<uint8_t> keys_wire,
+                               uint32_t nkeys,
+                               std::vector<const void*> srcs) {
+    if (broken_.load() || !running_.load()) return INTERNAL_ERROR;
+    if (!lease_ready() || !shm_active_ || server_block_size_ == 0 ||
+        block_size == 0 || keys_wire.size() < 4 || nkeys != srcs.size()) {
+        return PARTIAL;  // caller falls back to the legacy path
+    }
+    uint32_t wire_count = 0;
+    memcpy(&wire_count, keys_wire.data(), 4);
+    if (wire_count != nkeys) return BAD_REQUEST;
+    // Structural pre-scan (u32 reads only, no allocation): the per-key
+    // append below must never run off a malformed blob, and pend_blob_/
+    // pend_locs_/pend_nkeys_ must stay in lockstep even across the
+    // mid-loop flushes a lease transition triggers.
+    {
+        size_t pos = 4;
+        for (uint32_t i = 0; i < nkeys; ++i) {
+            if (pos + 4 > keys_wire.size()) return BAD_REQUEST;
+            uint32_t len = 0;
+            memcpy(&len, keys_wire.data() + pos, 4);
+            pos += 4 + size_t(len);
+            if (pos > keys_wire.size()) return BAD_REQUEST;
+        }
+        if (pos != keys_wire.size()) return BAD_REQUEST;
+    }
+    size_t kpos = 4;  // cursor over the wire entries
+    const uint32_t bs = server_block_size_;
+    const uint32_t nb = uint32_t((uint64_t(block_size) + bs - 1) / bs);
+    std::vector<CopyPool::Seg> segs;
+    segs.reserve(nkeys);
+    std::lock_guard<std::mutex> lk(lease_mu_);
+    // Bytes must be IN the pool before their commit batch is on the
+    // wire (a reader may see the entry the instant the server applies
+    // the commit), so drain pending copies ahead of every flush.
+    auto drain = [&] {
+        if (!segs.empty()) {
+            CopyPool::inst().run(std::move(segs));
+            segs.clear();
+        }
+    };
+    if (pend_nkeys_ != 0 && pend_bsize_ != block_size) {
+        drain();
+        flush_locked();
+    }
+    for (size_t i = 0; i < nkeys; ++i) {
+        // Mirror carve (server replays this exactly): skip run
+        // remainders too small for one key, consume nb blocks.
+        bool carved = false;
+        for (int attempt = 0; attempt < 2 && !carved; ++attempt) {
+            if (lease_valid_) {
+                while (lease_run_idx_ < lease_runs_.size() &&
+                       lease_runs_[lease_run_idx_].nblocks -
+                               lease_block_off_ <
+                           nb) {
+                    lease_run_idx_++;
+                    lease_block_off_ = 0;
+                }
+                if (lease_run_idx_ < lease_runs_.size()) {
+                    carved = true;
+                    break;
+                }
+            }
+            if (attempt == 1) break;
+            // Lease exhausted: flush what pends (it belongs to the old
+            // lease), then buy the next N allocations with one RTT.
+            drain();
+            flush_locked();
+            uint32_t st = acquire_lease_locked(nb);
+            if (st != OK) {
+                drain();
+                return st;
+            }
+        }
+        if (!carved) {  // fragmented grant: fall back
+            drain();
+            return PARTIAL;
+        }
+        const ClientRun& run = lease_runs_[lease_run_idx_];
+        CachedLoc loc;
+        loc.pool_idx = run.pool_idx;
+        loc.offset = run.offset + uint64_t(lease_block_off_) * bs;
+        loc.size = block_size;
+        loc.epoch = 0;  // stamped by the commit response
+        lease_block_off_ += nb;
+        if (lease_block_off_ == run.nblocks) {
+            lease_run_idx_++;
+            lease_block_off_ = 0;
+        }
+        {
+            std::lock_guard<std::mutex> plk(pools_mu_);
+            if (!(loc.pool_idx < pools_.size() &&
+                  loc.offset + block_size <=
+                      pools_[loc.pool_idx].size)) {
+                // Cannot happen (the grant was mapped at acquire) — but
+                // if it ever does, the carve cursor above already moved
+                // while the server's mirror will not: drop the lease so
+                // the next put re-acquires instead of committing every
+                // later key at a shifted location.
+                lease_valid_ = false;
+                drain();
+                return INTERNAL_ERROR;
+            }
+            CopyPool::add_seg(
+                segs, pools_[loc.pool_idx].base + loc.offset,
+                static_cast<const uint8_t*>(srcs[i]), block_size);
+        }
+        // Append this key's raw wire entry (validated by the pre-scan) —
+        // no per-key parse on this path; the server decodes once.
+        uint32_t klen = 0;
+        memcpy(&klen, keys_wire.data() + kpos, 4);
+        pend_blob_.insert(pend_blob_.end(), keys_wire.begin() + kpos,
+                          keys_wire.begin() + kpos + 4 + klen);
+        kpos += 4 + size_t(klen);
+        pend_locs_.push_back(loc);
+        pend_nkeys_++;
+        pend_bsize_ = block_size;
+        pend_bytes_ += block_size;
+    }
+    drain();
+    if (pend_bytes_ >= cfg_.flush_bytes) flush_locked();
+    return OK;
+}
+
+uint32_t Connection::lease_flush() {
+    std::lock_guard<std::mutex> lk(lease_mu_);
+    flush_locked();
+    return OK;
+}
+
+uint32_t Connection::lease_take_error() { return lease_err_.exchange(0); }
+
+void Connection::cache_insert_locked(std::string key,
+                                     const CachedLoc& loc) {
+    // Crude-but-bounded: a full cache is cleared wholesale (correctness
+    // is epoch-guarded either way; this only trades hit rate).
+    if (pin_cache_.size() >= kPinCacheCap) pin_cache_.clear();
+    pin_cache_[std::move(key)] = loc;
+}
+
+void Connection::cache_pins(const std::vector<std::string>& keys,
+                            const RemoteBlock* blocks, size_t n,
+                            uint64_t epoch) {
+    if (!lease_ready() || n != keys.size()) return;
+    std::lock_guard<std::mutex> clk(cache_mu_);
+    for (size_t i = 0; i < n; ++i) {
+        CachedLoc loc;
+        loc.pool_idx = blocks[i].pool_idx;
+        loc.offset = blocks[i].offset;
+        loc.size = blocks[i].size;
+        loc.epoch = epoch;
+        cache_insert_locked(keys[i], loc);
+    }
+}
+
+bool Connection::cached_read(uint32_t block_size,
+                             const std::vector<std::string>& keys,
+                             const std::vector<void*>& dsts) {
+    // A broken connection must MISS, not serve: the mappings outlive the
+    // socket, and a dead server's orphaned pool pages would otherwise
+    // keep validating against the frozen epoch word forever — hiding
+    // the failure from the reconnect machinery.
+    if (broken_.load() || !running_.load()) return false;
+    if (!lease_ready() || !shm_active_ || keys.empty() ||
+        keys.size() != dsts.size()) {
+        return false;
+    }
+    // Optimistic one-sided read: epoch before, copy, epoch after. Any
+    // evict/spill/delete/purge between the two loads bumps the shared
+    // word (release store under the server's store lock), so equality
+    // proves every cached location stayed valid for the whole copy.
+    //
+    // pools_mu_ is held across the WHOLE sequence — lookup, copy and
+    // both epoch loads — because close_conn/reconnect on another thread
+    // munmaps the pools and the ctl page under the same mutex: a
+    // concurrent close must fail this read safely, never let it copy
+    // from (or validate against) unmapped memory. The legacy shm copy
+    // paths hold pools_mu_ across their memcpys for the same reason.
+    std::lock_guard<std::mutex> plk(pools_mu_);
+    if (ctl_map_ == nullptr) return false;  // torn down under us
+    const uint64_t e1 = ctl_epoch(std::memory_order_acquire);
+    std::vector<CopyPool::Seg> segs;
+    segs.reserve(keys.size());
+    {
+        // Lock order pools_mu_ -> cache_mu_ everywhere (shm_read_blocking
+        // seeds the cache while holding pools_mu_).
+        std::lock_guard<std::mutex> clk(cache_mu_);
+        for (size_t i = 0; i < keys.size(); ++i) {
+            auto it = pin_cache_.find(keys[i]);
+            if (it == pin_cache_.end()) return false;
+            const CachedLoc& loc = it->second;
+            if (loc.epoch != e1 || loc.size < block_size ||
+                loc.pool_idx >= pools_.size() ||
+                loc.offset + block_size > pools_[loc.pool_idx].size) {
+                return false;
+            }
+            CopyPool::add_seg(segs, static_cast<uint8_t*>(dsts[i]),
+                              pools_[loc.pool_idx].base + loc.offset,
+                              block_size);
+        }
+    }
+    CopyPool::inst().run(std::move(segs));
+    // Acquire fence: the e2 load must not be ordered before the copy's
+    // reads (an ARM host could otherwise validate against a pre-copy
+    // epoch while the bytes raced an eviction).
+    std::atomic_thread_fence(std::memory_order_acquire);
+    return ctl_epoch(std::memory_order_acquire) == e1;
 }
 
 void Connection::hard_fail() {

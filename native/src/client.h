@@ -55,6 +55,53 @@ struct ClientConfig {
     bool use_shm = true;  // try the SHM path (falls back to STREAM)
     uint64_t window_bytes = DEFAULT_WINDOW_BYTES;
     int timeout_ms = 10000;  // reference sync timeout (10 s)
+    // Lease mode (SHM only): puts carve destinations out of a
+    // server-granted block lease with zero RTTs and commit via batched,
+    // deferred OP_COMMIT_BATCH; reads of cached locations skip the
+    // OP_PIN round trip, validated against the shared store epoch.
+    bool use_lease = false;
+    uint32_t lease_blocks = 4096;      // blocks per OP_LEASE acquire
+    uint64_t flush_bytes = 16u << 20;  // deferred-commit watermark
+};
+
+// Process-wide parallel memcpy engine: min(4, cores-2) workers plus the
+// calling thread chew through a segment list (multi-MB runs are split
+// into ~512 KB pieces). On a 1-core host it degrades to inline
+// memcpy — no threads, no handoff cost. Each batch gets its own
+// heap-held Round so a straggler worker from a finished batch can never
+// touch (or steal indices from) the next one.
+class CopyPool {
+   public:
+    struct Seg {
+        uint8_t* dst;
+        const uint8_t* src;
+        size_t len;
+    };
+    static CopyPool& inst();
+    // Copies every segment; parallel when workers exist and the batch is
+    // big enough, inline otherwise. Blocks until all bytes are copied.
+    void run(std::vector<Seg> segs);
+    size_t workers() const { return threads_.size(); }
+    // Append a segment, splitting it for the workers when they exist.
+    static void add_seg(std::vector<Seg>& segs, uint8_t* dst,
+                        const uint8_t* src, size_t len);
+
+   private:
+    CopyPool();
+    ~CopyPool();
+    void worker();
+    struct Round {
+        std::vector<Seg> segs;
+        std::atomic<size_t> next{0};
+        std::atomic<size_t> done{0};
+    };
+    std::mutex run_mu_;  // one batch at a time
+    std::mutex mu_;
+    std::condition_variable cv_, done_cv_;
+    std::shared_ptr<Round> round_;  // guarded by mu_
+    uint64_t gen_ = 0;              // guarded by mu_
+    bool stop_ = false;
+    std::vector<std::thread> threads_;
 };
 
 using DoneFn = std::function<void(uint32_t status, std::vector<uint8_t> body)>;
@@ -111,11 +158,49 @@ class Connection {
     // copies run inline (the Python caller holds no GIL), then an async
     // RELEASE. On a single-core host this halves the context switches of
     // the submit->IO-thread-copy->callback path.
+    // `cache_keys` (optional): key strings matching the body, used to
+    // populate the pin cache from the PIN response in lease mode.
     uint32_t shm_read_blocking(uint32_t block_size,
                                std::vector<uint8_t> keys_body,
-                               std::vector<void*> dsts);
+                               std::vector<void*> dsts,
+                               const std::vector<std::string>* cache_keys =
+                                   nullptr);
     void shm_read_async(uint32_t block_size, std::vector<uint8_t> keys_body,
                         std::vector<void*> dsts, DoneFn done);
+
+    // --- lease fast path (use_lease; SHM only) ---
+    // Zero-RTT put: carve destinations from the connection's block
+    // lease locally, memcpy (parallel engine above the size threshold)
+    // and defer the commit into the pending batch. Blocking only when a
+    // fresh OP_LEASE is needed. Returns OK (committed later — failures
+    // latch into lease_take_error and surface at sync), OUT_OF_MEMORY
+    // (server could grant no blocks), or PARTIAL when a key cannot fit
+    // any grantable run (fragmentation) — the caller should fall back
+    // to the legacy allocate+write+commit path.
+    // `keys_wire` is the serialized key list (u32 count + wire entries)
+    // — kept opaque on this hot path (no per-key string churn; the
+    // server parses once, and pin-cache seeding parses lazily on the IO
+    // thread after the commit acks).
+    uint32_t lease_put(uint32_t block_size, std::vector<uint8_t> keys_wire,
+                       uint32_t nkeys, std::vector<const void*> srcs);
+    // Flush the pending batch as one async OP_COMMIT_BATCH (inflight-
+    // accounted, so sync() barriers it). OK even when nothing pends.
+    uint32_t lease_flush();
+    // First failing deferred-commit status since the last call (0=none).
+    uint32_t lease_take_error();
+
+    // Zero-RTT cached read: serve every key from the pin cache when all
+    // locations are cached at the CURRENT store epoch, re-checking the
+    // epoch after the copy (optimistic one-sided read — a concurrent
+    // evict/delete/purge is detected and the caller falls back to the
+    // pinned rpc path). Returns true when fully served.
+    bool cached_read(uint32_t block_size,
+                     const std::vector<std::string>& keys,
+                     const std::vector<void*>& dsts);
+    // Populate the pin cache from an OP_PIN response.
+    void cache_pins(const std::vector<std::string>& keys,
+                    const RemoteBlock* blocks, size_t n, uint64_t epoch);
+    bool lease_ready() const { return cfg_.use_lease && ctl_map_ != nullptr; }
 
     // Pool mapping access for the zero-copy Python path.
     size_t pool_count();
@@ -232,6 +317,58 @@ class Connection {
     std::vector<PoolMap> pools_;
     bool shm_active_ = false;
     uint32_t server_block_size_ = 0;
+
+    // --- lease state (lease_mu_) ---
+    struct ClientRun {
+        uint32_t pool_idx;
+        uint64_t offset;
+        uint32_t nblocks;
+    };
+    struct CachedLoc {
+        uint32_t pool_idx;
+        uint64_t offset;
+        uint64_t size;
+        uint64_t epoch;  // store epoch the location was learned at
+    };
+    uint32_t acquire_lease_locked(uint32_t min_blocks);
+    void flush_locked();
+    // The async-op half of flush: OP_COMMIT_BATCH with inflight
+    // accounting (rpc_async does not barrier under sync()).
+    void commit_batch_async(std::vector<uint8_t> body, DoneFn done);
+    // Run `fn` on the IO thread on its next drain cycle — AFTER any
+    // completion that is currently unwinding (used to push pin-cache
+    // seeding out of the sync() critical path).
+    void post_task(std::function<void()> fn);
+    uint64_t ctl_epoch(std::memory_order order) const {
+        return reinterpret_cast<const std::atomic<uint64_t>*>(
+                   &ctl_map_->epoch)
+            ->load(order);
+    }
+    void cache_insert_locked(std::string key, const CachedLoc& loc);
+
+    std::mutex lease_mu_;
+    bool lease_valid_ = false;
+    uint64_t lease_id_ = 0;
+    std::vector<ClientRun> lease_runs_;
+    size_t lease_run_idx_ = 0;    // carve cursor, mirrored by the server
+    uint32_t lease_block_off_ = 0;
+    // Deferred commit batch: raw wire key entries (no leading count —
+    // that is written at flush) + the locations we carved for them, all
+    // within the current lease, all the same block_size.
+    std::vector<uint8_t> pend_blob_;
+    std::vector<CachedLoc> pend_locs_;
+    uint32_t pend_nkeys_ = 0;
+    uint32_t pend_bsize_ = 0;
+    uint64_t pend_bytes_ = 0;
+    std::atomic<uint32_t> lease_err_{0};
+
+    // --- pin cache (cache_mu_) ---
+    std::mutex cache_mu_;
+    std::unordered_map<std::string, CachedLoc> pin_cache_;
+    static constexpr size_t kPinCacheCap = 1u << 17;
+
+    // Mapped server ctl page (read-only): the store epoch word.
+    CtlPage* ctl_map_ = nullptr;
 };
 
 }  // namespace istpu

@@ -63,6 +63,12 @@ enum Op : uint8_t {
     OP_RECLAIM = 16,         // erase ORPHANED uncommitted entries (keys
                              // whose writer died before commit); entries
                              // with a live inflight token are untouched
+    // Block-lease protocol (the SHM analogue of the reference's
+    // client-side MR/registration cache: one RTT buys N future
+    // allocations, the data path stays one-sided).
+    OP_LEASE = 17,           // grant a batch of raw pool blocks
+    OP_COMMIT_BATCH = 18,    // commit keys carved out of a lease
+    OP_LEASE_REVOKE = 19,    // return a lease's unconsumed blocks
 };
 
 // ---------------------------------------------------------------------------
@@ -98,6 +104,27 @@ constexpr uint32_t MAX_KEYS_PER_OP = 1u << 20;
 // writing payload for these. Reference: FAKE_REMOTE_BLOCK rkey/addr sentinel
 // (src/protocol.h:108-109, src/protocol.cpp:33-35).
 constexpr uint64_t FAKE_TOKEN = 0;
+
+// Cap on blocks a single OP_LEASE may grant: bounds both the response
+// body and how much pool one rpc can take off the free list.
+constexpr uint32_t MAX_LEASE_BLOCKS = 1u << 18;  // 256K blocks
+
+// Control page shared between server and SHM clients ("<prefix>_ctl"):
+// holds the store epoch, bumped by the server whenever a committed
+// block may stop being valid at its cached location (evict / spill /
+// delete / purge / entry relocation). Clients validate pin-cache reads
+// against it with two plain loads around the copy — the one-sided
+// version check of NP-RDMA-style optimistic reads. The u64 is accessed
+// as a lock-free std::atomic from both processes (address-free per the
+// C++ memory model on the LP64 hosts we target).
+constexpr uint64_t CTL_MAGIC = 0x4c54435550545349ULL;  // "ISTPUCTL"
+#pragma pack(push, 1)
+struct CtlPage {
+    uint64_t magic;
+    uint64_t epoch;
+};
+#pragma pack(pop)
+constexpr size_t CTL_PAGE_BYTES = 4096;
 
 // A block location the server hands out on allocate. `token` addresses the
 // uncommitted entry for WRITE/COMMIT; (pool_idx, offset) lets a same-host

@@ -277,10 +277,24 @@ Status KVIndex::insert_committed(const std::string& key, const uint8_t* data,
     return OK;
 }
 
+Status KVIndex::insert_leased(const std::string& key, const PoolLoc& loc,
+                              uint32_t size) {
+    auto [mit, inserted] = map_.try_emplace(key);
+    if (!inserted) return CONFLICT;  // first-writer-wins
+    Entry e;
+    e.block = std::make_shared<Block>(mm_, loc, size);
+    e.size = size;
+    e.committed = true;
+    mit->second = std::move(e);
+    if (track_lru()) lru_touch(mit->second, mit->first);
+    return OK;
+}
+
 size_t KVIndex::purge() {
     size_t n = map_.size();
     map_.clear();
     lru_.clear();
+    if (n) bump_epoch();
     return n;
 }
 
@@ -304,13 +318,18 @@ size_t KVIndex::reclaim_orphans(const std::vector<std::string>& keys) {
 
 size_t KVIndex::erase(const std::vector<std::string>& keys) {
     size_t n = 0;
+    bool committed_gone = false;
     for (auto& k : keys) {
         auto it = map_.find(k);
         if (it == map_.end()) continue;
+        committed_gone |= it->second.committed;
         lru_drop(it->second);
         map_.erase(it);
         n++;
     }
+    // Only committed entries can live in a client pin cache; deleting
+    // uncommitted ones never invalidates a cached location.
+    if (committed_gone) bump_epoch();
     return n;
 }
 
@@ -334,6 +353,11 @@ void KVIndex::lru_drop(Entry& e) {
 size_t KVIndex::evict_lru(size_t want) {
     size_t victims = 0;
     size_t freed = 0;
+    // Every victim (spilled OR hard-evicted) loses its pool blocks, so a
+    // single bump up front covers the whole pass; the release store is
+    // ordered before any reallocation of the freed blocks (all under the
+    // owner's store lock).
+    bool bumped = false;
     // Smallest size the tier refused this pass: a failed 4-block store
     // must not stop 1-block victims from spilling into remaining space.
     uint32_t disk_min_fail = UINT32_MAX;
@@ -376,6 +400,10 @@ size_t KVIndex::evict_lru(size_t want) {
         // Count the block-granular pool footprint, not the logical size —
         // a 4 KB value in a 64 KB-block pool frees a whole block.
         freed += (size_t(e.size) + bs - 1) / bs * bs;
+        if (!bumped) {
+            bump_epoch();
+            bumped = true;
+        }
         // Remove the victim from the LRU in place and keep walking
         // coldward from the same position (restarting at rbegin would
         // re-scan every pinned cold entry per eviction, O(pinned x

@@ -24,6 +24,7 @@
 //     path performs implicitly inside the server.
 #pragma once
 
+#include <atomic>
 #include <cstdint>
 #include <functional>
 #include <list>
@@ -94,8 +95,18 @@ class KVIndex {
     // design.rst:36). With disk but eviction=false, no committed entry
     // is ever lost (first-writer-wins preserved); with both, disk-full
     // falls back to hard eviction.
-    explicit KVIndex(MM* mm, bool eviction = false, DiskTier* disk = nullptr)
-        : mm_(mm), eviction_(eviction), disk_(disk) {}
+    // epoch (optional) points at the store epoch word (the server's
+    // shared CtlPage): bumped whenever a committed entry's pool blocks
+    // may stop being valid at their last-advertised location (evict,
+    // spill, delete, purge). SHM clients validate their pin cache
+    // against it without a round trip.
+    explicit KVIndex(MM* mm, bool eviction = false, DiskTier* disk = nullptr,
+                     std::atomic<uint64_t>* epoch = nullptr)
+        : mm_(mm), eviction_(eviction), disk_(disk), epoch_(epoch) {}
+
+    uint64_t epoch() const {
+        return epoch_ ? epoch_->load(std::memory_order_relaxed) : 0;
+    }
 
     // Reserve an uncommitted block for `key`, owned by connection `owner`.
     // Tokens are usable only by their owning connection (the reference
@@ -193,6 +204,15 @@ class KVIndex {
     Status insert_committed(const std::string& key, const uint8_t* data,
                             uint32_t size);
 
+    // Commit a key whose pool blocks were carved from a block lease and
+    // written one-sided by the client: the entry ADOPTS the
+    // already-allocated range at `loc` (no copy, no token) and becomes
+    // visible immediately. CONFLICT when the key already exists
+    // (committed OR inflight — first-writer-wins; the caller frees the
+    // leased blocks). This is the second phase of OP_COMMIT_BATCH.
+    Status insert_leased(const std::string& key, const PoolLoc& loc,
+                         uint32_t size);
+
     size_t purge();  // drops all entries; inflight tokens survive harmlessly
     size_t erase(const std::vector<std::string>& keys);
     // Erase only ORPHANED entries among `keys`: uncommitted AND not backed
@@ -247,6 +267,12 @@ class KVIndex {
 
     void lru_touch(Entry& e, const std::string& key);
     void lru_drop(Entry& e);
+    // Invalidate every client's pin cache (release store so a client
+    // observing the new value also observes any writes that preceded
+    // the bump, across the shared mapping).
+    void bump_epoch() {
+        if (epoch_) epoch_->fetch_add(1, std::memory_order_release);
+    }
 
     // LRU bookkeeping is needed for eviction and for spill-victim
     // selection alike.
@@ -255,6 +281,7 @@ class KVIndex {
     MM* mm_;
     bool eviction_ = false;
     DiskTier* disk_ = nullptr;
+    std::atomic<uint64_t>* epoch_ = nullptr;
     uint64_t evictions_ = 0;
     uint64_t spills_ = 0;
     uint64_t promotes_ = 0;

@@ -25,6 +25,9 @@ const char* op_name(uint8_t op) {
         case OP_ABORT: return "ABORT";
         case OP_PUT: return "PUT";
         case OP_RECLAIM: return "RECLAIM";
+        case OP_LEASE: return "LEASE";
+        case OP_COMMIT_BATCH: return "COMMIT_BATCH";
+        case OP_LEASE_REVOKE: return "LEASE_REVOKE";
         default: return "UNKNOWN";
     }
 }

@@ -7,6 +7,7 @@
 #include <netinet/tcp.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/uio.h>
 #include <unistd.h>
@@ -54,7 +55,20 @@ Server::Server(const ServerConfig& cfg) : cfg_(cfg) {
     }
 }
 
-Server::~Server() { stop(); }
+Server::~Server() {
+    stop();
+    // start() may have failed after creating the ctl page but before
+    // running_ flipped (stop() then early-returns): release it here.
+    if (ctl_ != nullptr) {
+        if (ctl_is_shm_) {
+            munmap(ctl_, CTL_PAGE_BYTES);
+            shm_unlink(("/" + ctl_name_).c_str());
+        } else {
+            delete ctl_;
+        }
+        ctl_ = nullptr;
+    }
+}
 
 bool Server::start() {
     install_crash_handler();
@@ -81,8 +95,40 @@ bool Server::start() {
             disk_.reset();
         }
     }
+    // Store-epoch control page: shared with same-host clients so their
+    // pin caches validate reads with two local loads instead of an rpc.
+    // Falls back to private heap memory if the shm object cannot be
+    // created (epoch then travels only in responses — still correct,
+    // clients just cannot take the zero-RTT cached-read path).
+    if (cfg_.enable_shm) {
+        ctl_name_ = cfg_.shm_prefix + "_ctl";
+        std::string path = "/" + ctl_name_;
+        int fd = shm_open(path.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+        if (fd < 0 && errno == EEXIST && shm_owner_dead(ctl_name_)) {
+            shm_unlink(path.c_str());
+            fd = shm_open(path.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+        }
+        if (fd >= 0 && ftruncate(fd, (off_t)CTL_PAGE_BYTES) == 0) {
+            void* mem = mmap(nullptr, CTL_PAGE_BYTES, PROT_READ | PROT_WRITE,
+                             MAP_SHARED, fd, 0);
+            if (mem != MAP_FAILED) {
+                ctl_ = static_cast<CtlPage*>(mem);
+                ctl_is_shm_ = true;
+            }
+        }
+        if (fd >= 0) close(fd);
+        if (!ctl_is_shm_) {
+            shm_unlink(path.c_str());
+            ctl_name_.clear();
+            IST_WARN("ctl page shm unavailable; pin-cache epoch degrades "
+                     "to response-carried only");
+        }
+    }
+    if (ctl_ == nullptr) ctl_ = new CtlPage{};
+    ctl_->magic = CTL_MAGIC;
+    ctl_->epoch = 0;
     index_ = std::make_unique<KVIndex>(mm_.get(), cfg_.enable_eviction,
-                                       disk_.get());
+                                       disk_.get(), epoch_word());
 
     listen_fd_ = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
     if (listen_fd_ < 0) return false;
@@ -152,6 +198,16 @@ void Server::stop() {
         index_.reset();
         disk_.reset();
         mm_.reset();
+        if (ctl_ != nullptr) {
+            if (ctl_is_shm_) {
+                munmap(ctl_, CTL_PAGE_BYTES);
+                shm_unlink(("/" + ctl_name_).c_str());
+            } else {
+                delete ctl_;
+            }
+            ctl_ = nullptr;
+            ctl_is_shm_ = false;
+        }
     }
 }
 
@@ -348,6 +404,8 @@ std::string Server::stats_json() {
         "\"promotes\": %llu, \"disk_bytes\": %llu, \"disk_used\": %llu, "
         "\"outq_bytes\": %llu, \"outq_cap\": %llu, \"reads_busy\": %llu, "
         "\"lease_bytes\": %llu, \"pins_busy\": %llu, "
+        "\"lease_blocks_out\": %llu, \"leases_oom\": %llu, "
+        "\"leases_busy\": %llu, \"epoch\": %llu, "
         "\"op_stats\": {",
         index_ ? index_->size() : 0, index_ ? index_->inflight() : 0,
         index_ ? index_->leases() : 0, mm_ ? mm_->num_pools() : 0,
@@ -364,7 +422,11 @@ std::string Server::stats_json() {
         (unsigned long long)cfg_.max_outq_bytes,
         (unsigned long long)reads_busy_.load(std::memory_order_relaxed),
         (unsigned long long)lease_total_.load(std::memory_order_relaxed),
-        (unsigned long long)pins_busy_.load(std::memory_order_relaxed));
+        (unsigned long long)pins_busy_.load(std::memory_order_relaxed),
+        (unsigned long long)lease_blocks_out_.load(std::memory_order_relaxed),
+        (unsigned long long)leases_oom_.load(std::memory_order_relaxed),
+        (unsigned long long)leases_busy_.load(std::memory_order_relaxed),
+        (unsigned long long)(index_ ? index_->epoch() : 0));
     std::string out = head;
     // Per-op handler-time table with histogram percentiles (the reference
     // logs per-op latency ad hoc, infinistore.cpp:1114,1162-1166; here it
@@ -451,14 +513,20 @@ void Server::accept_ready() {
 void Server::close_conn(int fd) {
     auto it = conns_.find(fd);
     if (it == conns_.end()) return;
-    // Abort allocations this client never committed and drop any pin
-    // leases it still holds.
+    // Abort allocations this client never committed, drop any pin
+    // leases it still holds, and return its block leases' unconsumed
+    // blocks to the pool (a dead client's leased blocks are reclaimed
+    // exactly like its uncommitted allocations).
     {
         std::lock_guard<std::mutex> lk(store_mu_);
         index_->abort_all_for_owner(it->second->id);
         for (auto& [lease, bytes] : it->second->open_leases) {
             index_->release(lease);
         }
+        for (auto& [lease, bl] : it->second->block_leases) {
+            free_lease_remainder(bl);
+        }
+        it->second->block_leases.clear();
     }
     outq_total_.fetch_sub(it->second->outq_bytes, std::memory_order_relaxed);
     lease_total_.fetch_sub(it->second->lease_bytes, std::memory_order_relaxed);
@@ -759,6 +827,9 @@ void Server::handle_message(Conn& c) {
     switch (op) {
         case OP_HELLO: op_hello(c); break;
         case OP_ALLOCATE: op_allocate(c); break;
+        case OP_LEASE: op_lease(c); break;
+        case OP_COMMIT_BATCH: op_commit_batch(c); break;
+        case OP_LEASE_REVOKE: op_lease_revoke(c); break;
         case OP_READ: op_read(c); break;
         case OP_COMMIT: op_commit(c); break;
         case OP_PIN: op_pin(c); break;
@@ -916,7 +987,258 @@ void Server::op_hello(Conn& c) {
         w.str(mm_->pool(i).shm_name());
         w.u64(mm_->pool(i).pool_size());
     }
+    // Trailing lease-protocol fields (older readers simply stop before
+    // them): the ctl shm object carrying the store epoch, if shared.
+    w.u32(ctl_is_shm_ ? 1 : 0);
+    w.str(ctl_name_);
+    w.u64(index_->epoch());
     respond(c, c.hdr.seq, OP_HELLO, std::move(body));
+}
+
+uint64_t Server::free_lease_remainder(Conn::BlockLease& l) {
+    const size_t bs = mm_->block_size();
+    uint64_t freed = 0;
+    for (size_t ri = l.run_idx; ri < l.runs.size(); ++ri) {
+        const Conn::LeaseRun& run = l.runs[ri];
+        uint32_t off_blocks = (ri == l.run_idx) ? l.block_off : 0;
+        if (off_blocks >= run.nblocks) continue;
+        uint32_t n = run.nblocks - off_blocks;
+        PoolLoc loc;
+        loc.pool_idx = run.pool_idx;
+        loc.offset = run.offset + uint64_t(off_blocks) * bs;
+        loc.ptr = mm_->pool(run.pool_idx).base() + loc.offset;
+        mm_->deallocate(loc, size_t(n) * bs);
+        freed += n;
+    }
+    l.run_idx = l.runs.size();
+    l.block_off = 0;
+    lease_blocks_out_.fetch_sub(l.blocks_left, std::memory_order_relaxed);
+    l.blocks_left = 0;
+    return freed;
+}
+
+void Server::op_lease(Conn& c) {
+    // Body: u32 nblocks wanted (granularity = the pool block size the
+    // client learned from HELLO). Grants up to nblocks as few contiguous
+    // runs; a short grant (pool pressure) is OK — the client re-leases
+    // when its cursor runs out. One RTT here buys the client N future
+    // allocations carved locally with zero RTTs.
+    BufReader r(c.body.data(), c.body.size());
+    uint32_t nblocks = r.u32();
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    if (!r.ok() || nblocks == 0 || nblocks > MAX_LEASE_BLOCKS) {
+        w.u32(BAD_REQUEST);
+        respond(c, c.hdr.seq, OP_LEASE, std::move(body));
+        return;
+    }
+    // Per-connection grant backpressure, mirroring the pin-lease cap: a
+    // client's granted-but-unconsumed blocks are bounded by
+    // max_outq_bytes, so leasing-without-committing cannot take the
+    // whole pool off the free list (server.h's "cannot pin the whole
+    // pool" property extends to block leases). Requests are clamped to
+    // the remaining allowance; at the cap they get BUSY — retryable
+    // once the client commits or revokes.
+    {
+        uint64_t held = 0;
+        for (const auto& [lid, bl] : c.block_leases) held += bl.blocks_left;
+        uint64_t cap_blocks = cfg_.max_outq_bytes / mm_->block_size();
+        if (cap_blocks == 0) cap_blocks = 1;
+        if (held >= cap_blocks) {
+            leases_busy_.fetch_add(1, std::memory_order_relaxed);
+            w.u32(BUSY);
+            respond(c, c.hdr.seq, OP_LEASE, std::move(body));
+            return;
+        }
+        if (uint64_t(nblocks) > cap_blocks - held) {
+            nblocks = uint32_t(cap_blocks - held);
+        }
+    }
+    constexpr size_t kMaxLeaseRuns = 64;
+    std::vector<Conn::LeaseRun> runs;
+    uint64_t granted = 0;
+    uint64_t epoch = 0;
+    {
+        std::lock_guard<std::mutex> lk(store_mu_);
+        const size_t bs = mm_->block_size();
+        uint64_t want = nblocks;
+        bool evicted_once = false;
+        while (want > 0 && runs.size() < kMaxLeaseRuns) {
+            uint64_t try_blocks = want;
+            PoolLoc loc;
+            bool got = false;
+            while (try_blocks > 0) {
+                if (mm_->allocate(size_t(try_blocks) * bs, &loc)) {
+                    got = true;
+                    break;
+                }
+                try_blocks >>= 1;
+            }
+            if (!got) {
+                // Pool exhausted (not even one block): make room from
+                // the cold end once, like op_allocate does.
+                if (!evicted_once && runs.empty()) {
+                    evicted_once = true;
+                    if (index_->evict_lru(size_t(want) * bs) > 0) continue;
+                }
+                break;
+            }
+            runs.push_back(Conn::LeaseRun{loc.pool_idx, loc.offset,
+                                          uint32_t(try_blocks)});
+            granted += try_blocks;
+            want -= try_blocks;
+        }
+        mm_->maybe_extend();
+        epoch = index_->epoch();
+        if (granted > 0) {
+            uint64_t id = next_block_lease_++;
+            Conn::BlockLease& bl = c.block_leases[id];
+            bl.runs = runs;
+            bl.blocks_left = granted;
+            lease_blocks_out_.fetch_add(granted, std::memory_order_relaxed);
+            w.u32(OK);
+            w.u64(id);
+            w.u64(epoch);
+            w.u32(uint32_t(runs.size()));
+            for (const auto& run : runs) {
+                w.u32(run.pool_idx);
+                w.u64(run.offset);
+                w.u32(run.nblocks);
+            }
+        }
+    }
+    if (granted == 0) {
+        leases_oom_.fetch_add(1, std::memory_order_relaxed);
+        w.u32(OUT_OF_MEMORY);
+    }
+    respond(c, c.hdr.seq, OP_LEASE, std::move(body));
+}
+
+void Server::op_commit_batch(Conn& c) {
+    // Body: u64 lease_id, u32 block_size (payload bytes per key), keys.
+    // The server carves destinations from the lease with EXACTLY the
+    // client's deterministic rule (sequential, skipping run remainders
+    // too small for one key), so the wire never carries offsets — a
+    // client cannot point a commit at memory it was not leased. Entries
+    // become visible here, after the client's one-sided writes: the
+    // two-phase contract is unchanged, with the lease cursor playing
+    // the role of the inflight token.
+    BufReader r(c.body.data(), c.body.size());
+    uint64_t lease_id = r.u64();
+    uint32_t block_size = r.u32();
+    std::vector<std::string> keys;
+    r.keys(&keys);
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    if (!r.ok() || block_size == 0) {
+        w.u32(BAD_REQUEST);
+        respond(c, c.hdr.seq, OP_COMMIT_BATCH, std::move(body));
+        return;
+    }
+    auto lit = c.block_leases.find(lease_id);
+    if (lit == c.block_leases.end()) {
+        // Unknown, fully-consumed or revoked lease (replay): fail closed
+        // — nothing is committed and no pool memory is touched.
+        w.u32(CONFLICT);
+        respond(c, c.hdr.seq, OP_COMMIT_BATCH, std::move(body));
+        return;
+    }
+    Conn::BlockLease& bl = lit->second;
+    uint32_t committed = 0;
+    std::vector<uint32_t> dedup;
+    bool overrun = false;
+    uint64_t epoch = 0;
+    {
+        std::lock_guard<std::mutex> lk(store_mu_);
+        const size_t bs = mm_->block_size();
+        const uint32_t nb = uint32_t((uint64_t(block_size) + bs - 1) / bs);
+        index_->reserve(keys.size());
+        for (size_t i = 0; i < keys.size(); ++i) {
+            // Mirror carve: skip (and free) run remainders < nb.
+            while (bl.run_idx < bl.runs.size() &&
+                   bl.runs[bl.run_idx].nblocks - bl.block_off < nb) {
+                uint32_t rem = bl.runs[bl.run_idx].nblocks - bl.block_off;
+                if (rem > 0) {
+                    PoolLoc loc;
+                    loc.pool_idx = bl.runs[bl.run_idx].pool_idx;
+                    loc.offset = bl.runs[bl.run_idx].offset +
+                                 uint64_t(bl.block_off) * bs;
+                    loc.ptr = mm_->pool(loc.pool_idx).base() + loc.offset;
+                    mm_->deallocate(loc, size_t(rem) * bs);
+                    bl.blocks_left -= rem;
+                    lease_blocks_out_.fetch_sub(rem,
+                                                std::memory_order_relaxed);
+                }
+                bl.run_idx++;
+                bl.block_off = 0;
+            }
+            if (bl.run_idx >= bl.runs.size()) {
+                // More keys than the lease can hold: a client never does
+                // this (it tracks the same cursor), so fail closed. Keys
+                // already committed this message stay committed — the
+                // client sees the error at its sync barrier.
+                overrun = true;
+                break;
+            }
+            const Conn::LeaseRun& run = bl.runs[bl.run_idx];
+            PoolLoc loc;
+            loc.pool_idx = run.pool_idx;
+            loc.offset = run.offset + uint64_t(bl.block_off) * bs;
+            loc.ptr = mm_->pool(run.pool_idx).base() + loc.offset;
+            bl.block_off += nb;
+            bl.blocks_left -= nb;
+            lease_blocks_out_.fetch_sub(nb, std::memory_order_relaxed);
+            if (bl.block_off == run.nblocks) {
+                bl.run_idx++;
+                bl.block_off = 0;
+            }
+            Status st = index_->insert_leased(keys[i], loc, block_size);
+            if (st == OK) {
+                committed++;
+            } else {
+                // First-writer-wins dedup: the existing entry stands, the
+                // client's bytes in its own leased blocks are discarded
+                // and the blocks return to the pool.
+                mm_->deallocate(loc, block_size);
+                dedup.push_back(uint32_t(i));
+            }
+        }
+        epoch = index_->epoch();
+        if (bl.blocks_left == 0) c.block_leases.erase(lit);
+    }
+    w.u32(overrun ? BAD_REQUEST : OK);
+    w.u32(committed);
+    w.u64(epoch);
+    w.u32(uint32_t(dedup.size()));
+    for (uint32_t d : dedup) w.u32(d);
+    respond(c, c.hdr.seq, OP_COMMIT_BATCH, std::move(body));
+}
+
+void Server::op_lease_revoke(Conn& c) {
+    BufReader r(c.body.data(), c.body.size());
+    uint64_t lease_id = r.u64();
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    if (!r.ok()) {
+        w.u32(BAD_REQUEST);
+        respond(c, c.hdr.seq, OP_LEASE_REVOKE, std::move(body));
+        return;
+    }
+    auto lit = c.block_leases.find(lease_id);
+    if (lit == c.block_leases.end()) {
+        w.u32(CONFLICT);  // unknown/already revoked: nothing to free
+        w.u64(0);
+    } else {
+        uint64_t freed;
+        {
+            std::lock_guard<std::mutex> lk(store_mu_);
+            freed = free_lease_remainder(lit->second);
+        }
+        c.block_leases.erase(lit);
+        w.u32(OK);
+        w.u64(freed);
+    }
+    respond(c, c.hdr.seq, OP_LEASE_REVOKE, std::move(body));
 }
 
 void Server::op_allocate(Conn& c) {
@@ -1154,6 +1476,9 @@ void Server::op_pin(Conn& c) {
         w.u64(lease);
         w.u32(uint32_t(blocks.size()));
         w.bytes(blocks.data(), blocks.size() * sizeof(RemoteBlock));
+        // Trailing store epoch (older readers stop before it): lets the
+        // client cache these locations for future zero-RTT reads.
+        w.u64(index_->epoch());
     }
     respond(c, c.hdr.seq, OP_PIN, std::move(body));
 }

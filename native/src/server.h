@@ -154,6 +154,25 @@ class Server {
         // cfg_.max_outq_bytes gets BUSY like over-cap OP_READs, so an SHM
         // client that never releases cannot pin the whole pool either.
         uint64_t lease_bytes = 0;
+        // Block leases (OP_LEASE): raw pool blocks granted to this
+        // connection for zero-RTT client-side allocation. Blocks are
+        // consumed by OP_COMMIT_BATCH carving (mirrored deterministically
+        // client-side, so the wire never carries offsets a client could
+        // forge); unconsumed blocks return to the pool on
+        // OP_LEASE_REVOKE or when the connection dies — exactly the
+        // uncommitted-alloc cleanup contract.
+        struct LeaseRun {
+            uint32_t pool_idx;
+            uint64_t offset;   // bytes from the pool base
+            uint32_t nblocks;
+        };
+        struct BlockLease {
+            std::vector<LeaseRun> runs;
+            size_t run_idx = 0;     // carve cursor: current run...
+            uint32_t block_off = 0; // ...and blocks consumed within it
+            uint64_t blocks_left = 0;  // unconsumed blocks, all runs
+        };
+        std::unordered_map<uint64_t, BlockLease> block_leases;
     };
 
     void loop();
@@ -172,9 +191,15 @@ class Server {
                  std::vector<std::pair<const uint8_t*, size_t>> segs = {},
                  std::vector<BlockRef> refs = {});
 
+    // Return a lease's unconsumed blocks to the pool (store_mu_ held).
+    uint64_t free_lease_remainder(Conn::BlockLease& l);
+
     // op handlers (body parsed under store_mu_)
     void op_hello(Conn& c);
     void op_allocate(Conn& c);
+    void op_lease(Conn& c);
+    void op_commit_batch(Conn& c);
+    void op_lease_revoke(Conn& c);
     void op_read(Conn& c);
     void op_commit(Conn& c);
     void op_abort(Conn& c);
@@ -207,6 +232,17 @@ class Server {
     std::unique_ptr<DiskTier> disk_;
     std::unique_ptr<KVIndex> index_;
 
+    // Store-epoch control page. With SHM enabled it lives in a shared
+    // "<prefix>_ctl" object that clients map and poll locally (zero-RTT
+    // pin-cache validation); otherwise it is private heap memory and
+    // only travels in responses.
+    CtlPage* ctl_ = nullptr;
+    bool ctl_is_shm_ = false;
+    std::string ctl_name_;
+    std::atomic<uint64_t>* epoch_word() {
+        return reinterpret_cast<std::atomic<uint64_t>*>(&ctl_->epoch);
+    }
+
     std::unordered_map<int, std::unique_ptr<Conn>> conns_;
     std::atomic<uint64_t> n_conns_{0};  // stats-safe connection count
 
@@ -227,6 +263,13 @@ class Server {
     std::atomic<uint64_t> reads_busy_{0};
     std::atomic<uint64_t> lease_total_{0};
     std::atomic<uint64_t> pins_busy_{0};
+    // Block-lease accounting: blocks currently granted-but-unconsumed
+    // across all connections, grants refused for pool pressure, and
+    // grants refused for the per-connection cap.
+    std::atomic<uint64_t> lease_blocks_out_{0};
+    std::atomic<uint64_t> leases_oom_{0};
+    std::atomic<uint64_t> leases_busy_{0};
+    uint64_t next_block_lease_ = 1;  // loop thread only
     std::atomic<uint64_t> op_count_[kMaxOp] = {};
     std::atomic<uint64_t> op_us_[kMaxOp] = {};
     std::atomic<uint64_t> op_hist_[kMaxOp][kNumBuckets] = {};

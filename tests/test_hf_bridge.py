@@ -623,3 +623,34 @@ def test_mixtral_explicit_head_dim_maps():
         sliding_window=None,
     )
     assert hf.moe_config_from_hf(cfg).head_dim == 32
+
+
+def test_mixtral_rope_scaling_rejected():
+    """The MoE attention stack has no rope-scaling slot: a Mixtral
+    derivative carrying one (even 'llama3', which the DENSE bridge
+    wires through) must hard-error, not load and diverge at every
+    position (never-silently-diverge contract)."""
+    cfg = transformers.MixtralConfig(
+        sliding_window=None,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 8.0,
+            "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 64,
+        },
+    )
+    with pytest.raises(NotImplementedError, match="rope_scaling"):
+        hf.moe_config_from_hf(cfg)
+
+
+def test_mixtral_attention_bias_rejected():
+    """self_attn.*.bias tensors have no slot in the MoE attention —
+    dropping them silently would shift every attention output, so the
+    bridge must refuse the checkpoint. (The bias probe runs before any
+    weight is read, so a bare state dict keeps this test cheap — no
+    model construction.)"""
+    jcfg = hf.moe_config_from_hf(
+        transformers.MixtralConfig(sliding_window=None)
+    )
+    sd = {"model.layers.0.self_attn.v_proj.bias": torch.zeros(8)}
+    with pytest.raises(NotImplementedError, match="attention_bias"):
+        hf.moe_params_from_hf(sd, jcfg)

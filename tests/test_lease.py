@@ -1,0 +1,371 @@
+"""Block-lease protocol tests (OP_LEASE / OP_COMMIT_BATCH /
+OP_LEASE_REVOKE + the client pin cache).
+
+The lease is the SHM analogue of the reference's client-side MR cache:
+one RTT buys N future allocations, puts carve destinations locally and
+commit via batched deferred OP_COMMIT_BATCH, and repeat reads of known
+locations skip the OP_PIN round trip behind an epoch-validated
+optimistic read. These tests pin the SAFETY half of that design: epoch
+bumps make stale reads impossible, first-writer-wins dedup survives the
+new write path, and every fallback degrades to the legacy protocol.
+(Lease reclamation on disconnect lives in test_reconnect.py; hostile
+frames in test_protocol_fuzz.py.)
+"""
+
+import numpy as np
+import pytest
+
+from infinistore_tpu import (
+    ClientConfig,
+    InfiniStoreKeyNotFound,
+    InfiniStoreServer,
+    InfinityConnection,
+    ServerConfig,
+    TYPE_SHM,
+    TYPE_STREAM,
+)
+
+BLOCK = 16 << 10
+
+
+@pytest.fixture
+def server():
+    srv = InfiniStoreServer(
+        ServerConfig(
+            service_port=0,
+            prealloc_size=0.03125,  # 32 MB
+            minimal_allocate_size=16,
+        )
+    )
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _connect(server, ctype=TYPE_SHM, lease=True, **kw):
+    conn = InfinityConnection(
+        ClientConfig(
+            host_addr="127.0.0.1",
+            service_port=server.service_port,
+            connection_type=ctype,
+            use_lease=lease,
+            timeout_ms=5000,
+            **kw,
+        )
+    )
+    conn.connect()
+    return conn
+
+
+def _page(rng):
+    return rng.integers(0, 255, BLOCK, dtype=np.uint8)
+
+
+def test_leased_put_visible_after_sync_and_interops(server, rng):
+    """Leased puts commit at the sync barrier and are readable by a
+    plain (lease-less) client over BOTH paths — the lease changes the
+    allocation protocol, not the store's contents."""
+    w = _connect(server)
+    src = _page(rng)
+    w.put_cache(src, [("lk0", 0)], BLOCK)
+    w.sync()
+    for ctype in (TYPE_SHM, TYPE_STREAM):
+        r = _connect(server, ctype, lease=False)
+        dst = np.zeros_like(src)
+        r.read_cache(dst, [("lk0", 0)], BLOCK)
+        r.sync()
+        assert np.array_equal(dst, src), ctype
+        r.close()
+    w.close()
+
+
+def test_epoch_bump_invalidates_pin_cache(server, rng):
+    """Stale read impossible: after a delete+re-put by ANOTHER client,
+    the leaseholder's cached location must not serve the old bytes —
+    the epoch bump forces it back through OP_PIN to the new location."""
+    w = _connect(server)
+    old = _page(rng)
+    w.put_cache(old, [("ek", 0)], BLOCK)
+    w.sync()
+    dst = np.zeros_like(old)
+    w.read_cache(dst, [("ek", 0)], BLOCK)  # seeds the pin cache
+    assert np.array_equal(dst, old)
+
+    other = _connect(server, lease=False)
+    assert other.delete_keys(["ek"]) == 1
+    # The deleted key must 404, never serve cached stale bytes.
+    with pytest.raises(InfiniStoreKeyNotFound):
+        w.read_cache(dst, [("ek", 0)], BLOCK)
+    # Re-put DIFFERENT content from the other client (likely reusing
+    # the freed blocks): the leaseholder must observe the new bytes.
+    new = _page(rng)
+    other.put_cache(new, [("ek", 0)], BLOCK)
+    other.sync()
+    w.read_cache(dst, [("ek", 0)], BLOCK)
+    assert np.array_equal(dst, new)
+    other.close()
+    w.close()
+
+
+def test_purge_invalidates_pin_cache(server, rng):
+    w = _connect(server)
+    src = _page(rng)
+    w.put_cache(src, [("pk", 0)], BLOCK)
+    w.sync()
+    dst = np.zeros_like(src)
+    w.read_cache(dst, [("pk", 0)], BLOCK)
+    other = _connect(server, lease=False)
+    other.purge()
+    with pytest.raises(InfiniStoreKeyNotFound):
+        w.read_cache(dst, [("pk", 0)], BLOCK)
+    other.close()
+    w.close()
+
+
+def test_first_writer_wins_under_lease(server, rng):
+    """A leased put of an existing key dedups: the first writer's bytes
+    stand, the lease blocks return to the pool, and the loser's
+    subsequent read serves the WINNER's content (its own leased bytes
+    must never be cached for a dedup'd key)."""
+    legacy = _connect(server, lease=False)
+    first = _page(rng)
+    legacy.put_cache(first, [("fw", 0)], BLOCK)
+    legacy.sync()
+
+    w = _connect(server)
+    evil = np.ones(BLOCK, dtype=np.uint8)
+    w.put_cache(evil, [("fw", 0)], BLOCK)
+    w.sync()  # dedup: no error, first writer wins
+    dst = np.zeros_like(first)
+    w.read_cache(dst, [("fw", 0)], BLOCK)
+    assert np.array_equal(dst, first)
+    # And both directions: leased writer first, legacy second.
+    w.put_cache(first, [("fw2", 0)], BLOCK)
+    w.sync()
+    legacy.put_cache(evil, [("fw2", 0)], BLOCK)
+    legacy.sync()
+    legacy.read_cache(dst, [("fw2", 0)], BLOCK)
+    legacy.sync()
+    assert np.array_equal(dst, first)
+    legacy.close()
+    w.close()
+
+
+def test_watermark_flush_without_sync(server, rng):
+    """The deferred batch flushes on the byte watermark, not only at
+    sync(): a reader eventually sees the data with NO sync call from
+    the writer."""
+    import time
+
+    w = _connect(server, flush_size=4 * BLOCK, lease_blocks=32)
+    src = rng.integers(0, 255, 8 * BLOCK, dtype=np.uint8)
+    pairs = [(f"wm{i}", i * BLOCK) for i in range(8)]
+    w.put_cache(src, pairs, BLOCK)  # 8 pages >= watermark: auto-flush
+    reader = _connect(server, lease=False)
+    deadline = time.time() + 5
+    while time.time() < deadline and not reader.check_exist("wm0"):
+        time.sleep(0.02)
+    assert reader.check_exist("wm0")
+    reader.close()
+    w.close()
+
+
+def test_multi_block_pages_and_lease_rollover(server, rng):
+    """Pages larger than the pool block (multi-block carve) and more
+    pages than one lease holds (lease rollover mid-batch) both land
+    intact."""
+    w = _connect(server, lease_blocks=8)  # tiny lease: forces rollover
+    big = 48 << 10  # 3 pool blocks per page
+    n = 16          # 48 blocks total over 8-block leases
+    src = rng.integers(0, 255, n * big, dtype=np.uint8)
+    pairs = [(f"mb{i}", i * big) for i in range(n)]
+    w.put_cache(src, pairs, big)
+    w.sync()
+    dst = np.zeros_like(src)
+    w.read_cache(dst, pairs, big)
+    w.sync()
+    assert np.array_equal(dst, src)
+    w.close()
+
+
+def test_stream_connection_falls_back(server, rng):
+    """use_lease on a STREAM connection must transparently fall back to
+    the legacy put path (leases are an SHM-only construct)."""
+    w = _connect(server, ctype=TYPE_STREAM)
+    assert not w.shm_connected
+    src = _page(rng)
+    w.put_cache(src, [("sf", 0)], BLOCK)
+    w.sync()
+    dst = np.zeros_like(src)
+    w.read_cache(dst, [("sf", 0)], BLOCK)
+    w.sync()
+    assert np.array_equal(dst, src)
+    w.close()
+
+
+def test_sharded_per_shard_lease_reuse(rng):
+    """ShardedConnection with lease-enabled shard configs: each shard's
+    partition rides that connection's leased put (lease + pin cache
+    reused across batches), and the data round-trips intact."""
+    from infinistore_tpu.sharded import ShardedConnection
+
+    servers = []
+    for _ in range(2):
+        s = InfiniStoreServer(
+            ServerConfig(service_port=0, prealloc_size=0.03125,
+                         minimal_allocate_size=16)
+        )
+        s.start()
+        servers.append(s)
+    conn = ShardedConnection([
+        ClientConfig(host_addr="127.0.0.1", service_port=s.service_port,
+                     connection_type=TYPE_SHM, use_lease=True,
+                     lease_blocks=64)
+        for s in servers
+    ])
+    conn.connect()
+    try:
+        n = 32
+        src = rng.integers(0, 255, n * BLOCK, dtype=np.uint8)
+        for it in range(2):  # second batch reuses each shard's lease
+            pairs = [(f"sl{it}_{i}", i * BLOCK) for i in range(n)]
+            conn.put_cache(src, pairs, BLOCK)
+            dst = np.zeros_like(src)
+            conn.read_cache(dst, pairs, BLOCK)
+            conn.sync()
+            assert np.array_equal(dst, src), it
+        # Both shards actually served leases.
+        for st in conn.stats()[:-1]:
+            if "shard_down" not in st:
+                assert "COMMIT_BATCH" in st["op_stats"], st["op_stats"]
+    finally:
+        conn.close()
+        for s in servers:
+            s.stop()
+
+
+def test_no_stale_cached_reads_after_server_death(rng):
+    """A dead server's pool mappings outlive the socket client-side; the
+    pin cache must MISS once the connection is broken (frozen epoch word
+    or not) so reads surface the failure and ride auto_reconnect to the
+    new server instead of serving orphaned memory forever."""
+    import time
+
+    srv = InfiniStoreServer(
+        ServerConfig(service_port=0, prealloc_size=0.03125,
+                     minimal_allocate_size=16)
+    )
+    port = srv.start()
+    conn = InfinityConnection(
+        ClientConfig(host_addr="127.0.0.1", service_port=port,
+                     connection_type=TYPE_SHM, use_lease=True,
+                     auto_reconnect=True, timeout_ms=3000)
+    )
+    conn.connect()
+    srv2 = None
+    try:
+        src = _page(rng)
+        conn.put_cache(src, [("dk", 0)], BLOCK)
+        conn.sync()
+        dst = np.zeros_like(src)
+        conn.read_cache(dst, [("dk", 0)], BLOCK)  # hot: cached
+        assert np.array_equal(dst, src)
+
+        srv.stop()
+        time.sleep(0.3)  # let the IO thread latch broken_
+        srv2 = InfiniStoreServer(
+            ServerConfig(service_port=port, prealloc_size=0.03125,
+                         minimal_allocate_size=16)
+        )
+        srv2.start()  # fresh EMPTY store on the same port
+        # The cached location still exists in this process's mappings —
+        # serving it would be a stale read. It must 404 via the retry
+        # against the new server instead.
+        with pytest.raises(InfiniStoreKeyNotFound):
+            conn.read_cache(dst, [("dk", 0)], BLOCK)
+    finally:
+        conn.close()
+        srv.stop()
+        if srv2 is not None:
+            srv2.stop()
+
+
+def test_async_put_rides_the_lease(server, rng):
+    """put_cache_async must take the same lease fast path as the sync
+    put (same config flag, same visibility contract via sync_async)."""
+    import asyncio
+
+    w = _connect(server)
+    src = rng.integers(0, 255, 4 * BLOCK, dtype=np.uint8)
+    pairs = [(f"ap{i}", i * BLOCK) for i in range(4)]
+
+    async def go():
+        await w.put_cache_async(src, pairs, BLOCK)
+        await w.sync_async()
+
+    asyncio.run(go())
+    dst = np.zeros_like(src)
+    w.read_cache(dst, pairs, BLOCK)
+    w.sync()
+    assert np.array_equal(dst, src)
+    # Proof it rode the lease: the server handled an OP_COMMIT_BATCH
+    # and no legacy OP_ALLOCATE for these keys.
+    ops = w.stats()["op_stats"]
+    assert "COMMIT_BATCH" in ops
+    assert "ALLOCATE" not in ops
+    w.close()
+
+
+def test_lease_grants_bounded_per_connection():
+    """A client that leases without ever committing or revoking is
+    capped at max_outq_size of granted-but-unconsumed blocks (the pin
+    backpressure property extended to block leases): requests are
+    clamped to the allowance and refused with BUSY at the cap, so one
+    connection cannot take the whole pool off the free list."""
+    import socket
+    import struct
+
+    from test_protocol_fuzz import OP_LEASE, _rpc_raw
+
+    srv = InfiniStoreServer(
+        ServerConfig(service_port=0, prealloc_size=0.03125,
+                     minimal_allocate_size=16, max_outq_size=1)  # 64 blk
+    )
+    srv.start()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.service_port),
+                                     timeout=5)
+        s.settimeout(5)
+        try:
+            # Ask for far more than the 1 MB cap: clamped to 64 blocks.
+            st, body = _rpc_raw(s, OP_LEASE, struct.pack("<I", 1024))
+            assert st == 200
+            nruns = struct.unpack("<I", body[16:20])[0]
+            granted = sum(
+                struct.unpack_from("<IQI", body, 20 + 16 * i)[2]
+                for i in range(nruns)
+            )
+            assert granted == 64, granted
+            # At the cap: BUSY, nothing more leaves the free list.
+            st, _ = _rpc_raw(s, OP_LEASE, struct.pack("<I", 1), seq=2)
+            assert st == 429
+        finally:
+            s.close()
+    finally:
+        srv.stop()
+
+
+def test_graceful_close_commits_pending(server, rng):
+    """put_cache(); close() with no sync(): the graceful close's
+    best-effort flush commits the pending batch (the pre-lease
+    synchronous-put behavior), so nothing is silently lost."""
+    w = _connect(server)
+    src = _page(rng)
+    w.put_cache(src, [("gc", 0)], BLOCK)
+    w.close()
+    r = _connect(server, lease=False)
+    dst = np.zeros_like(src)
+    r.read_cache(dst, [("gc", 0)], BLOCK)
+    r.sync()
+    assert np.array_equal(dst, src)
+    r.close()

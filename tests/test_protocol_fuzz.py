@@ -73,6 +73,121 @@ def test_random_garbage_streams(server, rng):
         conn.close()
 
 
+def _rpc_raw(sock, op, body, seq=1):
+    """One framed request/response on a raw socket; returns (status,
+    body_rest) or (None, b"") if the server closed on us."""
+    hdr = struct.pack(HDR, MAGIC, 1, op, 0, seq, len(body), 0)
+    sock.sendall(hdr + body)
+    try:
+        rh = b""
+        while len(rh) < 28:
+            chunk = sock.recv(28 - len(rh))
+            if not chunk:
+                return None, b""
+            rh += chunk
+        _m, _v, _op, _f, _seq, blen, _plen = struct.unpack(HDR, rh)
+        rb = b""
+        while len(rb) < blen:
+            chunk = sock.recv(blen - len(rb))
+            if not chunk:
+                return None, b""
+            rb += chunk
+        status = struct.unpack("<I", rb[:4])[0] if len(rb) >= 4 else None
+        return status, rb[4:]
+    except (socket.timeout, ConnectionError):
+        return None, b""
+
+
+OP_LEASE, OP_COMMIT_BATCH, OP_LEASE_REVOKE = 17, 18, 19
+
+
+def test_lease_ops_malformed_bodies(server, rng):
+    """Hostile OP_LEASE / OP_COMMIT_BATCH / OP_LEASE_REVOKE frames:
+    zero/absurd block counts, unknown lease ids, garbage key lists and
+    truncated bodies must all fail closed — no crash, no wedge, no
+    committed data corrupted."""
+    conn, key, data = _store_sentinel(server, rng)
+    try:
+        s = _raw_socket(server)
+        try:
+            # nblocks = 0 and nblocks far past MAX_LEASE_BLOCKS.
+            st, _ = _rpc_raw(s, OP_LEASE, struct.pack("<I", 0))
+            assert st == 400
+            st, _ = _rpc_raw(s, OP_LEASE, struct.pack("<I", 0xFFFFFFFF))
+            assert st == 400
+            # Truncated OP_LEASE body (3 of 4 bytes).
+            st, _ = _rpc_raw(s, OP_LEASE, b"\x01\x00\x00")
+            assert st == 400
+            # COMMIT_BATCH against a lease this connection never held.
+            cb = struct.pack("<QII", 0xDEAD, 4096, 0)
+            st, _ = _rpc_raw(s, OP_COMMIT_BATCH, cb)
+            assert st == 409  # CONFLICT: fail closed, nothing committed
+            # COMMIT_BATCH with a garbage key list on a real lease.
+            st, body = _rpc_raw(s, OP_LEASE, struct.pack("<I", 4))
+            assert st == 200
+            lease_id = struct.unpack("<Q", body[:8])[0]
+            bad = struct.pack("<QII", lease_id, 4096, 3) + b"\xff" * 7
+            st, _ = _rpc_raw(s, OP_COMMIT_BATCH, bad)
+            assert st == 400
+            # Over-consume: more keys than the 4-block lease can hold.
+            keys = b"".join(
+                struct.pack("<I", 2) + b"k%d" % i for i in range(8)
+            )
+            over = struct.pack("<QII", lease_id, 4096, 8) + keys
+            st, _ = _rpc_raw(s, OP_COMMIT_BATCH, over)
+            assert st == 400  # overrun fails closed
+            # Truncated LEASE_REVOKE.
+            st, _ = _rpc_raw(s, OP_LEASE_REVOKE, b"\x01\x02")
+            assert st == 400
+        finally:
+            s.close()
+        # Mid-body disconnects on the new ops.
+        for op in (OP_LEASE, OP_COMMIT_BATCH, OP_LEASE_REVOKE):
+            s = _raw_socket(server)
+            try:
+                s.sendall(struct.pack(HDR, MAGIC, 1, op, 0, 5, 64, 0))
+                s.sendall(b"\x00" * 10)  # then vanish mid-body
+            finally:
+                s.close()
+        assert _sentinel_intact(conn, key, data)
+    finally:
+        conn.close()
+
+
+def test_revoked_lease_replay_fails_closed(server, rng):
+    """A revoked (or double-revoked) lease must be dead: committing
+    against it or revoking it again fails closed, and blocks freed by
+    the revoke are not freed twice."""
+    conn, key, data = _store_sentinel(server, rng)
+    try:
+        s = _raw_socket(server)
+        try:
+            st, body = _rpc_raw(s, OP_LEASE, struct.pack("<I", 8))
+            assert st == 200
+            lease_id = struct.unpack("<Q", body[:8])[0]
+            st, body = _rpc_raw(
+                s, OP_LEASE_REVOKE, struct.pack("<Q", lease_id)
+            )
+            assert st == 200
+            freed = struct.unpack("<Q", body[:8])[0]
+            assert freed == 8  # every granted block came back
+            # Replay the revoke: nothing left to free.
+            st, _ = _rpc_raw(
+                s, OP_LEASE_REVOKE, struct.pack("<Q", lease_id)
+            )
+            assert st == 409
+            # Commit against the revoked lease: fail closed.
+            cb = (struct.pack("<QII", lease_id, 4096, 1)
+                  + struct.pack("<I", 1) + b"x")
+            st, _ = _rpc_raw(s, OP_COMMIT_BATCH, cb)
+            assert st == 409
+        finally:
+            s.close()
+        assert _sentinel_intact(conn, key, data)
+    finally:
+        conn.close()
+
+
 def test_adversarial_headers(server, rng):
     """Well-formed header frames with hostile fields: huge body/payload
     lengths, unknown ops, zero-length bodies for ops that need them."""

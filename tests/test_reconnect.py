@@ -235,3 +235,62 @@ def test_failed_reconnect_then_close_no_double_free():
         with pytest.raises(Exception):
             conn.reconnect()
     conn.close()  # must not abort the process
+
+
+def test_lease_blocks_reclaimed_on_disconnect():
+    """A dead client's block lease is reclaimed exactly like its
+    uncommitted allocations: the granted-but-uncommitted pool blocks
+    return to the free list, and puts whose deferred commit never
+    flushed are NOT visible (two-phase contract) — while data committed
+    before the disconnect survives."""
+    import time
+
+    import numpy as np
+
+    srv = start_server()
+    port = srv.service_port
+    probe = connect(port, TYPE_STREAM)
+    try:
+        base_used = probe.stats()["used_bytes"]
+
+        holder = InfinityConnection(
+            ClientConfig(
+                host_addr="127.0.0.1", service_port=port,
+                connection_type=TYPE_SHM, use_lease=True,
+                lease_blocks=64, timeout_ms=3000,
+            )
+        )
+        holder.connect()
+        src = np.arange(BLOCK, dtype=np.uint8) % 251
+        # Committed half: flushed by sync — must survive the disconnect.
+        holder.put_cache(src, [("lease_committed", 0)], BLOCK)
+        holder.sync()
+        # Uncommitted half: written into leased blocks, commit pending.
+        holder.put_cache(src, [("lease_pending", 0)], BLOCK)
+        st = probe.stats()
+        assert st["lease_blocks_out"] > 0  # the lease holds pool blocks
+        assert st["used_bytes"] > base_used
+
+        # Simulate a CRASHED client: suppress the graceful close()'s
+        # best-effort flush (a real death never sends one), so the
+        # socket just drops with the commit batch un-sent.
+        holder.connected = False
+        holder.close()
+
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            st = probe.stats()
+            if st["lease_blocks_out"] == 0:
+                break
+            time.sleep(0.05)
+        assert st["lease_blocks_out"] == 0, st
+        # The pending put never became visible; the synced one did.
+        assert probe.check_exist("lease_committed")
+        assert not probe.check_exist("lease_pending")
+        # Pool back to committed-data-only footprint (one entry).
+        import math
+        entry = math.ceil(BLOCK / (16 << 10)) * (16 << 10)
+        assert probe.stats()["used_bytes"] == base_used + entry
+    finally:
+        probe.close()
+        srv.stop()

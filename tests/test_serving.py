@@ -1164,3 +1164,41 @@ def test_engine_config_fuzz_window_and_quantized(cfg, seed, shm_conn):
     # No leaked pages whatever combination ran (windowed release must
     # hand everything back too).
     assert sorted(eng.free_pages) == list(range(1, sc.total_pages)), seed
+
+
+def test_admission_survives_store_death_after_cached_probe(
+    params, cfg, shm_conn
+):
+    """ADVICE r5 regression: the probe is cached on _Work while a
+    request waits under pool pressure, so it can outlive the store —
+    another slot's failure latches _store_ok=False between the probe
+    and (re)admission. The windowed one-shot path then computes
+    skip = p0 while the cached hit still points at the restore, which
+    used to trip `assert skip == first_live` (and under -O, silently
+    misplace suffix pages). A dead store chain must read as a MISS."""
+    import dataclasses
+
+    from infinistore_tpu.tpu import TpuKVStore
+
+    # Geometry chosen so the store-less floor and the hit floor differ
+    # (p0 = (53-20)//8 = 4, first_live = (6*8-19)//8 = 3): the old code
+    # then asserted 4 == 3.
+    wcfg = dataclasses.replace(cfg, window=20)
+    rng = np.random.default_rng(17)
+    prompt = _prompt(rng, wcfg, 53)
+    store = TpuKVStore(shm_conn)
+    eng1 = ServingEngine(params, wcfg, store=store)
+    eng1.run([Request("warm", prompt, max_new_tokens=1)])
+    assert eng1.stats["offloaded_pages"] > 0
+
+    eng2 = ServingEngine(params, wcfg, store=store)
+    eng2.submit(Request("r", prompt, max_new_tokens=3))
+    work = eng2.queue[0]
+    work.probe = eng2._probe_hit(work)
+    assert work.probe[0] > 0  # a real cached hit
+    eng2._store_ok = False  # another slot's store op failed meanwhile
+    out = eng2.run()  # must not assert / attempt the restore
+    assert eng2.stats["restored_pages"] == 0
+    cold = ServingEngine(params, wcfg)
+    ref = cold.run([Request("x", prompt, max_new_tokens=3)])
+    assert out["r"] == ref["x"]
