@@ -51,7 +51,7 @@ import time
 
 
 def bench_store(port, size_mb=64, block_kb=4, nkeys=None, ctype="AUTO",
-                batch=4096):
+                batch=4096, passes=3):
     import numpy as np
 
     from infinistore_tpu import ClientConfig, InfinityConnection
@@ -76,7 +76,7 @@ def bench_store(port, size_mb=64, block_kb=4, nkeys=None, ctype="AUTO",
         # passes keeps pool usage clear of the 50% auto-extend trigger,
         # whose mlock+populate would land inside a measured phase.
         t_put, t_get = None, None
-        for it in range(3):
+        for it in range(passes):
             if it:
                 conn.purge()
             keys = [f"bench{it}_{i}" for i in range(n)]
@@ -220,13 +220,19 @@ def bench_lease_ab(port, nkeys=4096, block_kb=4, batch=256):
     return out
 
 
-def bench_sharded(n_shards=4, nkeys=4096, block_kb=4):
+def bench_sharded(n_shards=4, nkeys=4096, block_kb=4, workers=1,
+                  io_threads=None, passes=2):
     """Sharded-store leg (BASELINE config 5 scaled to one host): the same
     bulk workload fanned over N shard servers through ShardedConnection.
     With concurrent per-shard fan-out the batch latency should be ~1
     shard's worth, not N (VERDICT round-1 item 6) — on this 1-core host
     that reads as agg within the same ballpark as the single-server leg,
-    plus a single-probe-latency get_match_last_index."""
+    plus a single-probe-latency get_match_last_index.
+
+    ``workers``/``io_threads`` drive the worker-scaling leg: each shard
+    server runs that many data-plane epoll workers, and the client pool
+    is widened so the shards can actually be saturated (None = the
+    auto heuristic in ShardedConnection)."""
     import numpy as np
 
     from infinistore_tpu import ClientConfig, InfiniStoreServer, ServerConfig
@@ -240,13 +246,14 @@ def bench_sharded(n_shards=4, nkeys=4096, block_kb=4):
         s = InfiniStoreServer(
             ServerConfig(service_port=0, prealloc_size=0.0625,
                          minimal_allocate_size=4, auto_increase=True,
-                         extend_size=0.0625)
+                         extend_size=0.0625, workers=workers)
         )
         s.start()
         servers.append(s)
     conn = ShardedConnection(
         [ClientConfig(host_addr="127.0.0.1", service_port=s.service_port)
-         for s in servers]
+         for s in servers],
+        io_threads=io_threads,
     )
     conn.connect()
     try:
@@ -254,7 +261,7 @@ def bench_sharded(n_shards=4, nkeys=4096, block_kb=4):
         total = nkeys * block_bytes
         src = np.random.default_rng(3).integers(0, 255, total, dtype=np.uint8)
         t_put = t_get = None
-        for it in range(2):  # best-of-2 like the single-server legs
+        for it in range(passes):  # best-of like the single-server legs
             if it:
                 conn.purge()
             keys = [f"sh{it}_{i}" for i in range(nkeys)]
@@ -296,6 +303,57 @@ def bench_sharded(n_shards=4, nkeys=4096, block_kb=4):
         conn.close()
         for s in servers:
             s.stop()
+
+
+def bench_workers(shm_agg=None, nkeys=4096, block_kb=4):
+    """Worker-scaling leg (ISSUE 2): the 4 KB x 4096 STREAM shape and
+    the 4-shard sharded shape, each at server workers=1/2/4. The
+    single-loop reference design caps the stream path at ~one core of
+    parse+memcpy (BENCH_r05: 1.49 GB/s, only 1.07x raw TCP) and the
+    4-shard aggregate BELOW single-connection SHM; with the multi-worker
+    data plane both should scale with cores. Publishes per-setting
+    aggregates plus two ratios: workers_stream_scaling (workers=4 vs
+    workers=1 stream agg — acceptance target >= 1.3 on a multi-core
+    host) and workers4_sharded_vs_shm (4-shard agg at workers=4 vs the
+    primary single-connection SHM agg — acceptance target >= 1.0).
+    Scaling is core-bound: on a <= 2-core CI container the ratios land
+    near 1.0 by construction (nothing to parallelize onto), which the
+    artifact records honestly via workers_host_cores."""
+    import os
+
+    from infinistore_tpu import InfiniStoreServer, ServerConfig
+
+    out = {"workers_host_cores": os.cpu_count() or 1}
+    for wn in (1, 2, 4):
+        srv = InfiniStoreServer(
+            ServerConfig(service_port=0, prealloc_size=0.375,
+                         minimal_allocate_size=4, auto_increase=True,
+                         extend_size=0.125, workers=wn)
+        )
+        port = srv.start()
+        try:
+            r = bench_store(port, block_kb=block_kb, nkeys=nkeys,
+                            ctype="STREAM", passes=2)
+            out[f"workers{wn}_stream_agg_GBps"] = r["agg_GBps"]
+        finally:
+            srv.stop()
+        # io_threads=None: ShardedConnection's auto heuristic widens the
+        # client pool to 2x shards exactly when the servers are
+        # multi-worker AND the host has spare cores (forcing 2x on a
+        # 2-core CI box measured ~40% slower — pure oversubscription).
+        sh = bench_sharded(n_shards=4, nkeys=nkeys, block_kb=block_kb,
+                           workers=wn, io_threads=None)
+        out[f"workers{wn}_sharded_agg_GBps"] = sh["sharded_agg_GBps"]
+    if out.get("workers1_stream_agg_GBps"):
+        out["workers_stream_scaling"] = round(
+            out["workers4_stream_agg_GBps"]
+            / out["workers1_stream_agg_GBps"], 2
+        )
+    if shm_agg:
+        out["workers4_sharded_vs_shm"] = round(
+            out["workers4_sharded_agg_GBps"] / shm_agg, 2
+        )
+    return out
 
 
 def bench_raw_tcp(total_bytes=64 << 20, chunk=256 << 10, passes=2,
@@ -685,6 +743,27 @@ def _median(xs):
     xs = sorted(xs)
     n = len(xs)
     return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+_PROBE_CACHE = None
+
+
+def run_probe_once(runner):
+    """Device-probe leg, at most ONCE per run. BENCH_r05's wedged probe
+    burned its whole 180 s cap and the error then stamped the artifact
+    repeatedly; now the result is cached for every later consumer, the
+    cap honors ISTPU_PROBE_TIMEOUT (default 60 s — a healthy probe
+    finishes in single-digit seconds, so a wedged tunnel should cost
+    one minute of budget, not three), and the full error text appears
+    exactly once (per-leg skip markers reference it instead of
+    duplicating it)."""
+    global _PROBE_CACHE
+    if _PROBE_CACHE is None:
+        import os
+
+        cap = float(os.environ.get("ISTPU_PROBE_TIMEOUT", "60"))
+        _PROBE_CACHE = runner("--probe-leg", "probe_error", cap)
+    return _PROBE_CACHE
 
 
 def _slope_time(build_fn, n_short, n_long, reps=3):
@@ -2041,6 +2120,20 @@ def main():
         except Exception as e:
             out["sharded_error"] = str(e)[:200]
         publish()
+        # Worker-scaling leg (ISSUE 2 acceptance): stream + sharded
+        # shapes at server workers=1/2/4. CPU-only and inline, but
+        # budget-guarded — three extra servers x two passes each cost
+        # real wall clock the tiny-budget artifact path must not pay.
+        if remaining() > 300:
+            try:
+                out.update(bench_workers(shm_agg=out.get("agg_GBps")))
+            except Exception as e:
+                out["workers_error"] = str(e)[:200]
+        else:
+            out["workers_skipped"] = (
+                f"budget exhausted ({remaining():.0f}s left)"
+            )
+        publish()
         out.update(gated_leg("--overlap-leg", "overlap_error", 240))
         publish()
         # CPU-backend scheduler-overhead leg (no tunnel dependence).
@@ -2051,8 +2144,10 @@ def main():
         # tunnel is WEDGED (observed: device init alone > 420 s), every
         # device leg would burn its full cap discovering the same fact.
         # A failed probe skips them all with an explicit marker — the
-        # artifact then says "tunnel down", not four timeouts.
-        probe = gated_leg("--probe-leg", "probe_error", 180)
+        # artifact then says "tunnel down", not four timeouts. Probed
+        # at most once per run with an ISTPU_PROBE_TIMEOUT-bounded cap
+        # (see run_probe_once).
+        probe = run_probe_once(gated_leg)
         out.update(probe)
         publish()
         if probe.get("probe_ok"):
@@ -2101,14 +2196,15 @@ def main():
             publish()
             out.update(gated_leg("--engine-leg", "engine_error", 700))
         else:
-            # Carry the probe's ACTUAL outcome into the skip markers —
-            # "timed out" (wedged tunnel), an init error, or "budget
-            # exhausted" are different diagnoses and the artifact must
-            # not conflate them.
-            why = (probe.get("probe_error")
-                   or probe.get("probe_skipped") or "probe not ok")
+            # The probe's ACTUAL outcome ("timed out" = wedged tunnel,
+            # an init error, "budget exhausted" — different diagnoses)
+            # already sits in the artifact exactly once, under
+            # probe_error / probe_skipped; the per-leg markers point at
+            # it instead of stamping the same text four more times.
             for leg in ("tpu", "big", "mfu", "engine"):
-                out[f"{leg}_skipped"] = f"device probe: {why}"[:120]
+                out[f"{leg}_skipped"] = (
+                    "device probe failed (see probe_error/probe_skipped)"
+                )
     finally:
         srv.stop()
     publish()

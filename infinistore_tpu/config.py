@@ -129,6 +129,14 @@ class ServerConfig:
         # the reference's bounded push window (signal/32, window 4096 WRs,
         # src/libinfinistore.cpp:898-987), denominated in bytes.
         self.max_outq_size = kwargs.get("max_outq_size", 64)  # MB
+        # Data-plane worker loops (deviation from the reference's single
+        # uvloop — see docs/design.md "Threading model"). 1 (default)
+        # keeps the historical single-epoll behavior, byte-compatible
+        # with every existing client and the right choice for
+        # control-plane-only deployments. 0 = auto-size to
+        # min(4, cores - 2). The ISTPU_SERVER_WORKERS env var overrides
+        # either setting at server start.
+        self.workers = kwargs.get("workers", 1)
         # Accepted for reference CLI compatibility; unused on TPU hosts.
         self.dev_name = kwargs.get("dev_name", "")
         self.link_type = kwargs.get("link_type", "")
@@ -170,3 +178,5 @@ class ServerConfig:
             raise Exception("ssd_path required when ssd_size > 0")
         if self.max_outq_size <= 0:
             raise Exception("max_outq_size must be positive (MB)")
+        if self.workers < 0 or self.workers > 64:
+            raise Exception("workers must be in [0, 64] (0 = auto)")

@@ -55,6 +55,7 @@ class InfiniStoreServer:
             cfg.ssd_path.encode(),
             int(cfg.ssd_size * (1 << 30)),
             int(cfg.max_outq_size * (1 << 20)),
+            int(cfg.workers),
         )
         port = self._lib.ist_server_start(self._h)
         if port < 0:
@@ -151,6 +152,7 @@ def _prometheus_metrics(stats):
         ("pool_bytes", "pool_bytes", "total DRAM pool capacity"),
         ("used_bytes", "pool_used_bytes", "allocated DRAM pool bytes"),
         ("connections", "connections", "open client connections"),
+        ("workers", "workers", "data-plane epoll worker threads"),
         ("disk_bytes", "disk_tier_bytes", "disk spill tier capacity"),
         ("disk_used", "disk_tier_used_bytes", "disk spill tier usage"),
     ]
@@ -306,6 +308,13 @@ def parse_args(argv=None):
                    help="per-connection cap in MB on bytes queued to a "
                         "slow reader; reads past the cap fail with BUSY "
                         "(retryable)")
+    p.add_argument("--workers", type=int, default=1,
+                   help="data-plane epoll worker threads; connections are "
+                        "assigned to the least-loaded worker so "
+                        "socket<->pool copies run in parallel across "
+                        "cores. 1 (default) = the classic single loop, "
+                        "0 = auto (min(4, cores-2)); the "
+                        "ISTPU_SERVER_WORKERS env var overrides")
     p.add_argument("--warmup", action="store_true",
                    help="run a warmup round-trip after startup")
     p.add_argument("--snapshot-path", default="",
@@ -351,6 +360,7 @@ def main(argv=None):
         ssd_path=args.ssd_path,
         ssd_size=args.ssd_size,
         max_outq_size=args.max_outq_size,
+        workers=args.workers,
     )
     server = InfiniStoreServer(config)
     server.start()

@@ -34,7 +34,7 @@ echo "=== TSAN: $TESTS ==="
 # suppressions: the Python runtime itself is uninstrumented; TSAN only
 # sees our .so, so reports name istpu symbols when real.
 if ! LD_PRELOAD="$TSAN_RT" \
-   TSAN_OPTIONS="halt_on_error=0 exitcode=66 suppressions=$PWD/native/tsan.supp" \
+   TSAN_OPTIONS="halt_on_error=0 exitcode=66 detect_deadlocks=0 suppressions=$PWD/native/tsan.supp" \
    INFINISTORE_TPU_NATIVE_LIB="$PWD/native/build/libinfinistore_tpu_tsan.so" \
    python -m pytest $TESTS -x -q; then
     echo "TSAN RUN FAILED"

@@ -98,9 +98,11 @@ extern "C" {
 
 // Bumped whenever the Python<->C contract changes (v2: NUL-form key
 // blobs; v3: lease-mode ist_conn_create signature + lease entry
-// points). _native.py probes this at load so a stale prebuilt library
-// fails loudly instead of feeding unparseable blobs to the server.
-uint32_t ist_abi_version(void) { return 3; }
+// points; v4: multi-worker ist_server_create signature — trailing
+// `workers` argument). _native.py probes this at load so a stale
+// prebuilt library fails loudly instead of feeding unparseable blobs
+// to the server.
+uint32_t ist_abi_version(void) { return 4; }
 
 void ist_set_log_level(int level) { set_log_level(level); }
 void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }
@@ -112,7 +114,7 @@ void* ist_server_create(const char* host, uint16_t port,
                         int auto_extend, uint64_t extend_bytes, int enable_shm,
                         const char* shm_prefix, int enable_eviction,
                         const char* ssd_path, uint64_t ssd_bytes,
-                        uint64_t max_outq_bytes) {
+                        uint64_t max_outq_bytes, uint32_t workers) {
     ServerConfig cfg;
     cfg.host = host ? host : "0.0.0.0";
     cfg.port = port;
@@ -126,6 +128,9 @@ void* ist_server_create(const char* host, uint16_t port,
     if (ssd_path && ssd_path[0]) cfg.ssd_path = ssd_path;
     cfg.ssd_bytes = ssd_bytes;
     if (max_outq_bytes) cfg.max_outq_bytes = max_outq_bytes;
+    // 0 = auto-size (min(4, cores-2)); ISTPU_SERVER_WORKERS still
+    // overrides at start() either way.
+    cfg.workers = workers;
     return new Server(cfg);
 }
 

@@ -89,9 +89,22 @@ int64_t DiskTier::find_first_fit(uint64_t count) const {
 int64_t DiskTier::store(const void* src, uint32_t size) {
     if (fd_ < 0 || size == 0) return -1;
     uint64_t count = (uint64_t(size) + block_size_ - 1) / block_size_;
-    if (used_blocks_ + count > total_blocks_) return -1;
-    int64_t start = find_first_fit(count);
-    if (start < 0) return -1;
+    int64_t start;
+    {
+        // Reserve the extent under the lock, write outside it (pwrite is
+        // offset-addressed, so concurrent stores to disjoint extents are
+        // safe); a failed write rolls the reservation back.
+        std::lock_guard<std::mutex> lk(mu_);
+        if (used_blocks_.load(std::memory_order_relaxed) + count >
+            total_blocks_) {
+            return -1;
+        }
+        start = find_first_fit(count);
+        if (start < 0) return -1;
+        set_range(uint64_t(start), count, true);
+        used_blocks_.fetch_add(count, std::memory_order_relaxed);
+        search_hint_ = (uint64_t(start) + count) % total_blocks_;
+    }
     int64_t off = start * int64_t(block_size_);
     const uint8_t* p = static_cast<const uint8_t*>(src);
     uint64_t left = size;
@@ -101,15 +114,15 @@ int64_t DiskTier::store(const void* src, uint32_t size) {
         if (w <= 0) {
             if (w < 0 && errno == EINTR) continue;
             IST_ERROR("disk tier pwrite failed: %s", strerror(errno));
+            std::lock_guard<std::mutex> lk(mu_);
+            set_range(uint64_t(start), count, false);
+            used_blocks_.fetch_sub(count, std::memory_order_relaxed);
             return -1;
         }
         p += w;
         woff += w;
         left -= uint64_t(w);
     }
-    set_range(uint64_t(start), count, true);
-    used_blocks_ += count;
-    search_hint_ = (uint64_t(start) + count) % total_blocks_;
     return off;
 }
 
@@ -137,8 +150,11 @@ void DiskTier::release(int64_t off, uint32_t size) {
     uint64_t start = uint64_t(off) / block_size_;
     uint64_t count = (uint64_t(size) + block_size_ - 1) / block_size_;
     if (start + count > total_blocks_) return;
-    set_range(start, count, false);
-    used_blocks_ -= count;
+    {
+        std::lock_guard<std::mutex> lk(mu_);
+        set_range(start, count, false);
+        used_blocks_.fetch_sub(count, std::memory_order_relaxed);
+    }
     // Return the physical space to the filesystem right away.
 #ifdef FALLOC_FL_PUNCH_HOLE
     fallocate(fd_, FALLOC_FL_PUNCH_HOLE | FALLOC_FL_KEEP_SIZE, off_t(off),

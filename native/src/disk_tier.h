@@ -10,11 +10,18 @@
 // the same allocator shape as the DRAM pool (mempool.h), so fragmentation
 // behavior matches. The file is unlinked immediately after creation; a
 // crashed server can never leak disk space. IO is plain pread/pwrite on
-// the server loop: a 64 KB transfer is tens of µs on NVMe, the same order
-// as the reference's cudaMemcpyAsync local path it stands in for.
+// the calling worker: a 64 KB transfer is tens of µs on NVMe, the same
+// order as the reference's cudaMemcpyAsync local path it stands in for.
+//
+// Thread safety (multi-worker data plane): bitmap bookkeeping is guarded
+// by an internal mutex; the IO itself runs outside it (store reserves the
+// extent first and rolls the reservation back on a failed pwrite;
+// pread/pwrite are fd-position-free and safe concurrently).
 #pragma once
 
+#include <atomic>
 #include <cstdint>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -40,7 +47,9 @@ class DiskTier {
     void release(int64_t off, uint32_t size);
 
     uint64_t capacity_bytes() const { return capacity_; }
-    uint64_t used_bytes() const { return used_blocks_ * block_size_; }
+    uint64_t used_bytes() const {
+        return used_blocks_.load(std::memory_order_relaxed) * block_size_;
+    }
 
    private:
     bool bit(uint64_t idx) const {
@@ -53,8 +62,9 @@ class DiskTier {
     uint64_t capacity_ = 0;
     uint64_t block_size_ = 0;
     uint64_t total_blocks_ = 0;
-    uint64_t used_blocks_ = 0;
-    uint64_t search_hint_ = 0;
+    std::atomic<uint64_t> used_blocks_{0};
+    uint64_t search_hint_ = 0;       // guarded by mu_
+    std::mutex mu_;                  // guards bitmap_ + search_hint_
     std::vector<uint64_t> bitmap_;
 };
 

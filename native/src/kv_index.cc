@@ -9,10 +9,13 @@ namespace istpu {
 
 Status KVIndex::allocate(const std::string& key, uint32_t size,
                          RemoteBlock* out, uint64_t owner) {
+    uint32_t si = stripe_of(key);
+    Stripe& st = stripes_[si];
+    std::lock_guard<std::mutex> lk(st.mu);
     // Single hash probe: try_emplace both answers the dedup check and
     // reserves the slot (allocate is the server's hottest op — 4096
     // keys per benchmark batch).
-    auto [mit, inserted] = map_.try_emplace(key);
+    auto [mit, inserted] = st.map.try_emplace(key);
     if (!inserted) {
         out->status = CONFLICT;
         out->pool_idx = 0;
@@ -26,12 +29,12 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
     if (!got && track_lru()) {
         // Make room from the cold end of the cache (spill to the disk
         // tier when present, hard-evict otherwise), then retry once.
-        // (evict_lru cannot invalidate mit: it only touches committed
+        // (Eviction cannot invalidate mit: it only touches committed
         // entries, and this one is uncommitted and not in the LRU.)
-        if (evict_lru(size) > 0) got = mm_->allocate(size, &loc);
+        if (evict_internal(size, int(si)) > 0) got = mm_->allocate(size, &loc);
     }
     if (!got) {
-        map_.erase(mit);
+        st.map.erase(mit);
         out->status = OUT_OF_MEMORY;
         out->pool_idx = 0;
         out->token = FAKE_TOKEN;
@@ -41,22 +44,23 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
     }
     auto block = std::make_shared<Block>(mm_, loc, size);
     uint32_t idx;
-    if (!ifree_.empty()) {
-        idx = ifree_.back();
-        ifree_.pop_back();
+    if (!st.ifree.empty()) {
+        idx = st.ifree.back();
+        st.ifree.pop_back();
     } else {
-        idx = uint32_t(islab_.size());
-        islab_.emplace_back();
+        idx = uint32_t(st.islab.size());
+        st.islab.emplace_back();
     }
-    Inflight& s = islab_[idx];
+    Inflight& s = st.islab[idx];
     if (++s.gen == 0) s.gen = 1;  // gen >= 1 keeps every token != FAKE
     s.key = key;
     s.block = block;
     s.size = size;
     s.owner = owner;
     s.live = true;
-    inflight_live_++;
-    uint64_t token = (uint64_t(s.gen) << 32) | idx;
+    st.inflight_live++;
+    uint64_t token =
+        (uint64_t(s.gen) << 32) | (uint64_t(si) << kSlotBits) | idx;
     Entry e;
     e.block = block;
     e.size = size;
@@ -71,76 +75,100 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
 
 uint8_t* KVIndex::write_dest(uint64_t token, uint32_t* size_out,
                              uint64_t owner) {
-    Inflight* s = islot(token);
+    Stripe& st = stripes_[stripe_of_token(token)];
+    std::lock_guard<std::mutex> lk(st.mu);
+    Inflight* s = islot(st, token);
     if (s == nullptr || s->owner != owner) return nullptr;
     *size_out = s->size;
+    // Valid after unlock: the inflight entry pins the Block, and only the
+    // owning connection (serialized on its worker) can release the token.
     return static_cast<uint8_t*>(s->block->loc.ptr);
 }
 
 Status KVIndex::commit(uint64_t token, uint64_t owner) {
-    Inflight* s = islot(token);
+    Stripe& st = stripes_[stripe_of_token(token)];
+    std::lock_guard<std::mutex> lk(st.mu);
+    Inflight* s = islot(st, token);
     if (s == nullptr) return CONFLICT;
     // A forged commit must fail closed AND leave the real owner's inflight
     // entry intact so the owner's own commit still lands.
     if (s->owner != owner) return CONFLICT;
-    auto mit = map_.find(s->key);
+    auto mit = st.map.find(s->key);
     Status rc = CONFLICT;
     // Only commit if the map still holds the exact block this token
     // allocated (a purge+reallocate between allocate and commit must not
     // make someone else's bytes visible under this key).
-    if (mit != map_.end() && mit->second.block == s->block) {
+    if (mit != st.map.end() && mit->second.block == s->block) {
         mit->second.committed = true;
         lru_touch(mit->second, mit->first);
         rc = OK;
     }
-    ifree(s);
+    ifree(st, s);
     return rc;
 }
 
 void KVIndex::abort(uint64_t token, uint64_t owner) {
-    Inflight* s = islot(token);
+    Stripe& st = stripes_[stripe_of_token(token)];
+    std::lock_guard<std::mutex> lk(st.mu);
+    Inflight* s = islot(st, token);
     if (s == nullptr || s->owner != owner) return;
-    auto mit = map_.find(s->key);
-    if (mit != map_.end() && mit->second.block == s->block &&
+    auto mit = st.map.find(s->key);
+    if (mit != st.map.end() && mit->second.block == s->block &&
         !mit->second.committed) {
-        map_.erase(mit);
+        st.map.erase(mit);
     }
-    ifree(s);
+    ifree(st, s);
 }
 
 size_t KVIndex::abort_all_for_owner(uint64_t owner) {
     size_t n = 0;
-    for (Inflight& s : islab_) {
-        if (!s.live || s.owner != owner) continue;
-        auto mit = map_.find(s.key);
-        if (mit != map_.end() && mit->second.block == s.block &&
-            !mit->second.committed) {
-            map_.erase(mit);
+    for (Stripe& st : stripes_) {
+        std::lock_guard<std::mutex> lk(st.mu);
+        for (Inflight& s : st.islab) {
+            if (!s.live || s.owner != owner) continue;
+            auto mit = st.map.find(s.key);
+            if (mit != st.map.end() && mit->second.block == s.block &&
+                !mit->second.committed) {
+                st.map.erase(mit);
+            }
+            ifree(st, &s);
+            n++;
         }
-        ifree(&s);
-        n++;
     }
     return n;
 }
 
-Entry* KVIndex::get_committed(const std::string& key) {
-    auto it = map_.find(key);
-    if (it == map_.end() || !it->second.committed) return nullptr;
+bool KVIndex::peek_committed(const std::string& key, uint32_t* size_out) {
+    Stripe& st = stripes_[stripe_of(key)];
+    std::lock_guard<std::mutex> lk(st.mu);
+    auto it = st.map.find(key);
+    if (it == st.map.end() || !it->second.committed) return false;
     lru_touch(it->second, it->first);  // reads refresh recency
-    return &it->second;
+    if (size_out) *size_out = it->second.size;
+    return true;
 }
 
-Status KVIndex::get_resident(const std::string& key, const Entry** out) {
-    *out = nullptr;
-    auto it = map_.find(key);
-    if (it == map_.end() || !it->second.committed) return KEY_NOT_FOUND;
-    Status st = ensure_resident(&it->second, it->first);
-    if (st == OK) *out = &it->second;
-    return st;
+Status KVIndex::acquire_block(const std::string& key, bool allow_promote,
+                              BlockRef* out, uint32_t* size_out,
+                              bool* promoted_out) {
+    uint32_t si = stripe_of(key);
+    Stripe& st = stripes_[si];
+    std::lock_guard<std::mutex> lk(st.mu);
+    auto it = st.map.find(key);
+    if (it == st.map.end() || !it->second.committed) return KEY_NOT_FOUND;
+    Entry& e = it->second;
+    const bool nonresident = !e.block;
+    if (nonresident && !allow_promote) return BUSY;  // budget spent
+    Status rc = ensure_resident(si, e, it->first);
+    if (rc != OK) return rc;
+    if (promoted_out) *promoted_out = nonresident;
+    *out = e.block;
+    if (size_out) *size_out = e.size;
+    return OK;
 }
 
-Status KVIndex::ensure_resident(Entry* ep, const std::string& key) {
-    Entry& e = *ep;
+Status KVIndex::ensure_resident(uint32_t stripe_idx, Entry& e,
+                                const std::string& key) {
     if (!e.block) {
         // Spilled (disk) or in heap limbo: promote back into the pool
         // (which may itself spill or evict colder entries — this entry
@@ -148,7 +176,9 @@ Status KVIndex::ensure_resident(Entry* ep, const std::string& key) {
         // own victim).
         PoolLoc loc;
         bool got = mm_->allocate(e.size, &loc);
-        if (!got && evict_lru(e.size) > 0) got = mm_->allocate(e.size, &loc);
+        if (!got && evict_internal(e.size, int(stripe_idx)) > 0) {
+            got = mm_->allocate(e.size, &loc);
+        }
         if (got) {
             auto block = std::make_shared<Block>(mm_, loc, e.size);
             if (e.heap) {
@@ -174,7 +204,9 @@ Status KVIndex::ensure_resident(Entry* ep, const std::string& key) {
                 return INTERNAL_ERROR;
             }
             e.disk.reset();
-            if (evict_lru(e.size) > 0) got = mm_->allocate(e.size, &loc);
+            if (evict_internal(e.size, int(stripe_idx)) > 0) {
+                got = mm_->allocate(e.size, &loc);
+            }
             if (!got) {
                 // Could not land in the pool (everything pinned, or the
                 // freed blocks are not contiguous). Park the bytes back:
@@ -195,17 +227,25 @@ Status KVIndex::ensure_resident(Entry* ep, const std::string& key) {
         } else {
             return INTERNAL_ERROR;  // no location at all: cannot happen
         }
-        promotes_++;
+        promotes_.fetch_add(1, std::memory_order_relaxed);
     }
     lru_touch(e, key);
     return OK;
 }
 
 bool KVIndex::check_exist(const std::string& key) {
-    return get_committed(key) != nullptr;
+    return peek_committed(key, nullptr);
 }
 
 int KVIndex::match_last_index(const std::vector<std::string>& keys) const {
+    // Cross-stripe read: take every stripe lock in index order so the
+    // probe sequence sees one consistent cut of the store.
+    std::vector<std::unique_lock<std::mutex>> locks;
+    locks.reserve(kStripes);
+    for (const Stripe& st : stripes_) locks.emplace_back(st.mu);
+    auto present = [this](const std::string& k) {
+        return stripes_[stripe_of(k)].map.count(k) > 0;
+    };
     if (eviction_) {
         // LRU eviction can remove any key, so presence is no longer
         // monotone over the chain and a binary search could report a
@@ -214,7 +254,7 @@ int KVIndex::match_last_index(const std::vector<std::string>& keys) const {
         // probe is one hash lookup.
         int last = -1;
         for (size_t i = 0; i < keys.size(); ++i) {
-            if (map_.count(keys[i]) == 0) break;
+            if (!present(keys[i])) break;
             last = int(i);
         }
         return last;
@@ -225,7 +265,7 @@ int KVIndex::match_last_index(const std::vector<std::string>& keys) const {
     int left = 0, right = int(keys.size());
     while (left < right) {
         int mid = left + (right - left) / 2;
-        if (map_.count(keys[size_t(mid)]) > 0) {
+        if (present(keys[size_t(mid)])) {
             left = mid + 1;
         } else {
             right = mid;
@@ -234,37 +274,57 @@ int KVIndex::match_last_index(const std::vector<std::string>& keys) const {
     return left - 1;
 }
 
+void KVIndex::reserve(size_t extra) {
+    size_t per = extra / kStripes + 1;
+    for (Stripe& st : stripes_) {
+        std::lock_guard<std::mutex> lk(st.mu);
+        st.map.reserve(st.map.size() + per);
+        st.islab.reserve(st.islab.size() + per);
+    }
+}
+
 uint64_t KVIndex::pin(std::vector<BlockRef> blocks) {
+    std::lock_guard<std::mutex> lk(leases_mu_);
     uint64_t id = next_lease_++;
     leases_[id] = std::move(blocks);
     return id;
 }
 
-bool KVIndex::release(uint64_t lease_id) { return leases_.erase(lease_id) > 0; }
+bool KVIndex::release(uint64_t lease_id) {
+    std::lock_guard<std::mutex> lk(leases_mu_);
+    return leases_.erase(lease_id) > 0;
+}
 
 std::vector<KVIndex::SnapshotItem> KVIndex::snapshot_items() const {
+    std::vector<std::unique_lock<std::mutex>> locks;
+    locks.reserve(kStripes);
+    for (const Stripe& st : stripes_) locks.emplace_back(st.mu);
     std::vector<SnapshotItem> out;
-    out.reserve(map_.size());
-    for (const auto& [key, e] : map_) {
-        if (!e.committed) continue;
-        SnapshotItem it;
-        it.key = key;
-        it.block = e.block;
-        it.disk = e.disk;
-        it.heap = e.heap;
-        it.size = e.size;
-        if (it.block || it.disk || it.heap) out.push_back(std::move(it));
+    for (const Stripe& st : stripes_) {
+        out.reserve(out.size() + st.map.size());
+        for (const auto& [key, e] : st.map) {
+            if (!e.committed) continue;
+            SnapshotItem it;
+            it.key = key;
+            it.block = e.block;
+            it.disk = e.disk;
+            it.heap = e.heap;
+            it.size = e.size;
+            if (it.block || it.disk || it.heap) out.push_back(std::move(it));
+        }
     }
     return out;
 }
 
 Status KVIndex::insert_committed(const std::string& key, const uint8_t* data,
                                  uint32_t size) {
-    auto [mit, inserted] = map_.try_emplace(key);
+    Stripe& st = stripes_[stripe_of(key)];
+    std::lock_guard<std::mutex> lk(st.mu);
+    auto [mit, inserted] = st.map.try_emplace(key);
     if (!inserted) return CONFLICT;  // live data beats snapshot data
     PoolLoc loc;
     if (!mm_->allocate(size, &loc)) {  // no evict_lru: see header contract
-        map_.erase(mit);
+        st.map.erase(mit);
         return OUT_OF_MEMORY;
     }
     memcpy(loc.ptr, data, size);
@@ -279,7 +339,9 @@ Status KVIndex::insert_committed(const std::string& key, const uint8_t* data,
 
 Status KVIndex::insert_leased(const std::string& key, const PoolLoc& loc,
                               uint32_t size) {
-    auto [mit, inserted] = map_.try_emplace(key);
+    Stripe& st = stripes_[stripe_of(key)];
+    std::lock_guard<std::mutex> lk(st.mu);
+    auto [mit, inserted] = st.map.try_emplace(key);
     if (!inserted) return CONFLICT;  // first-writer-wins
     Entry e;
     e.block = std::make_shared<Block>(mm_, loc, size);
@@ -291,52 +353,106 @@ Status KVIndex::insert_leased(const std::string& key, const PoolLoc& loc,
 }
 
 size_t KVIndex::purge() {
-    size_t n = map_.size();
-    map_.clear();
-    lru_.clear();
+    // Cross-stripe write: all stripe locks in index order, then the LRU.
+    std::vector<std::unique_lock<std::mutex>> locks;
+    locks.reserve(kStripes);
+    for (Stripe& st : stripes_) locks.emplace_back(st.mu);
+    size_t n = 0;
+    for (Stripe& st : stripes_) {
+        n += st.map.size();
+        st.map.clear();
+    }
+    {
+        std::lock_guard<std::mutex> lk(lru_mu_);
+        lru_.clear();
+    }
     if (n) bump_epoch();
     return n;
 }
 
 size_t KVIndex::reclaim_orphans(const std::vector<std::string>& keys) {
-    std::unordered_set<const Block*> live;
-    live.reserve(inflight_live_);
-    for (const Inflight& s : islab_) {
-        if (s.live) live.insert(s.block.get());
-    }
+    // Group per stripe: a key's inflight token always lives in the key's
+    // own stripe, so each stripe's live-block set is built once under
+    // that stripe's lock and consulted only for its own keys.
+    std::vector<const std::string*> per_stripe[kStripes];
+    for (const auto& k : keys) per_stripe[stripe_of(k)].push_back(&k);
     size_t n = 0;
-    for (auto& k : keys) {
-        auto it = map_.find(k);
-        if (it == map_.end() || it->second.committed) continue;
-        if (it->second.block && live.count(it->second.block.get())) continue;
-        lru_drop(it->second);
-        map_.erase(it);
-        n++;
+    for (uint32_t si = 0; si < kStripes; ++si) {
+        if (per_stripe[si].empty()) continue;
+        Stripe& st = stripes_[si];
+        std::lock_guard<std::mutex> lk(st.mu);
+        std::unordered_set<const Block*> live;
+        live.reserve(st.inflight_live);
+        for (const Inflight& s : st.islab) {
+            if (s.live) live.insert(s.block.get());
+        }
+        for (const std::string* k : per_stripe[si]) {
+            auto it = st.map.find(*k);
+            if (it == st.map.end() || it->second.committed) continue;
+            if (it->second.block && live.count(it->second.block.get())) {
+                continue;
+            }
+            lru_drop(it->second);
+            st.map.erase(it);
+            n++;
+        }
     }
     return n;
 }
 
 size_t KVIndex::erase(const std::vector<std::string>& keys) {
     size_t n = 0;
-    bool committed_gone = false;
     for (auto& k : keys) {
-        auto it = map_.find(k);
-        if (it == map_.end()) continue;
-        committed_gone |= it->second.committed;
+        Stripe& st = stripes_[stripe_of(k)];
+        std::lock_guard<std::mutex> lk(st.mu);
+        auto it = st.map.find(k);
+        if (it == st.map.end()) continue;
+        // Bump BEFORE the entry's blocks are freed, once PER committed
+        // entry: with per-stripe locking another worker can reallocate
+        // the blocks the instant the erase drops the BlockRef, and a
+        // pin-cache client validating against a not-yet-bumped epoch
+        // would accept a stale read — including a client that cached a
+        // LATER key of this same batch after an earlier bump. (Only
+        // committed entries can live in a pin cache; deleting
+        // uncommitted ones never invalidates a cached location. Under
+        // the old single store lock this ordering came for free —
+        // reallocation needed the same lock.)
+        if (it->second.committed) bump_epoch();
         lru_drop(it->second);
-        map_.erase(it);
+        st.map.erase(it);
         n++;
     }
-    // Only committed entries can live in a client pin cache; deleting
-    // uncommitted ones never invalidates a cached location.
-    if (committed_gone) bump_epoch();
     return n;
+}
+
+size_t KVIndex::size() const {
+    size_t n = 0;
+    for (const Stripe& st : stripes_) {
+        std::lock_guard<std::mutex> lk(st.mu);
+        n += st.map.size();
+    }
+    return n;
+}
+
+size_t KVIndex::inflight() const {
+    size_t n = 0;
+    for (const Stripe& st : stripes_) {
+        std::lock_guard<std::mutex> lk(st.mu);
+        n += st.inflight_live;
+    }
+    return n;
+}
+
+size_t KVIndex::leases() const {
+    std::lock_guard<std::mutex> lk(leases_mu_);
+    return leases_.size();
 }
 
 void KVIndex::lru_touch(Entry& e, const std::string& key) {
     // Disk-resident entries stay out of the LRU: there is nothing to
     // evict or spill until a read promotes them back.
     if (!track_lru() || !e.block) return;
+    std::lock_guard<std::mutex> lk(lru_mu_);
     if (e.in_lru) lru_.erase(e.lru_it);
     lru_.push_front(key);
     e.lru_it = lru_.begin();
@@ -344,28 +460,41 @@ void KVIndex::lru_touch(Entry& e, const std::string& key) {
 }
 
 void KVIndex::lru_drop(Entry& e) {
+    if (!track_lru()) return;
+    std::lock_guard<std::mutex> lk(lru_mu_);
     if (e.in_lru) {
         lru_.erase(e.lru_it);
         e.in_lru = false;
     }
 }
 
-size_t KVIndex::evict_lru(size_t want) {
+size_t KVIndex::evict_internal(size_t want, int held_stripe) {
     size_t victims = 0;
     size_t freed = 0;
-    // Every victim (spilled OR hard-evicted) loses its pool blocks, so a
-    // single bump up front covers the whole pass; the release store is
-    // ordered before any reallocation of the freed blocks (all under the
-    // owner's store lock).
-    bool bumped = false;
     // Smallest size the tier refused this pass: a failed 4-block store
     // must not stop 1-block victims from spilling into remaining space.
     uint32_t disk_min_fail = UINT32_MAX;
     const size_t bs = mm_->block_size();
+    // The LRU walk holds lru_mu_ throughout and acquires victims' stripe
+    // locks in REVERSE of the normal stripe→lru order — so those are
+    // TRY-locks, and a busy stripe's victims are skipped this pass (with
+    // one worker the try always succeeds → victim order identical to the
+    // single-threaded walk).
+    std::lock_guard<std::mutex> llk(lru_mu_);
     auto it = lru_.rbegin();
     while (it != lru_.rend() && freed < want) {
-        auto mit = map_.find(*it);
-        if (mit == map_.end() || !mit->second.block) {
+        uint32_t si = stripe_of(*it);
+        Stripe& st = stripes_[si];
+        std::unique_lock<std::mutex> slk;
+        if (int(si) != held_stripe) {
+            slk = std::unique_lock<std::mutex>(st.mu, std::try_to_lock);
+            if (!slk.owns_lock()) {
+                ++it;
+                continue;
+            }
+        }
+        auto mit = st.map.find(*it);
+        if (mit == st.map.end() || !mit->second.block) {
             it = std::reverse_iterator(lru_.erase(std::next(it).base()));
             continue;
         }
@@ -378,14 +507,22 @@ size_t KVIndex::evict_lru(size_t want) {
         }
         // Spill to the disk tier first; hard-evict only when there is no
         // tier or this victim cannot be stored (full/fragmented/EIO).
+        // Epoch ordering, both branches: bump BEFORE this victim's pool
+        // blocks are released, once PER victim — another worker's
+        // allocate can reuse the blocks the instant they free (arena
+        // locks are independent of the lru/stripe locks held here), and
+        // a pin-cache client that cached a later victim between two
+        // releases of this same pass would otherwise validate a stale
+        // read against the earlier bump.
         bool spilled = false;
         if (disk_ != nullptr && e.size < disk_min_fail) {
             int64_t off = disk_->store(e.block->loc.ptr, e.size);
             if (off >= 0) {
                 e.disk = std::make_shared<DiskSpan>(disk_, off, e.size);
+                bump_epoch();     // before the blocks return to the pool
                 e.block.reset();  // frees the pool blocks
                 spilled = true;
-                spills_++;
+                spills_.fetch_add(1, std::memory_order_relaxed);
             } else {
                 disk_min_fail = e.size;
             }
@@ -400,10 +537,6 @@ size_t KVIndex::evict_lru(size_t want) {
         // Count the block-granular pool footprint, not the logical size —
         // a 4 KB value in a 64 KB-block pool frees a whole block.
         freed += (size_t(e.size) + bs - 1) / bs * bs;
-        if (!bumped) {
-            bump_epoch();
-            bumped = true;
-        }
         // Remove the victim from the LRU in place and keep walking
         // coldward from the same position (restarting at rbegin would
         // re-scan every pinned cold entry per eviction, O(pinned x
@@ -411,8 +544,9 @@ size_t KVIndex::evict_lru(size_t want) {
         auto fwd = std::next(it).base();
         e.in_lru = false;
         if (!spilled) {
-            map_.erase(mit);
-            evictions_++;
+            bump_epoch();  // before map.erase drops the blocks
+            st.map.erase(mit);
+            evictions_.fetch_add(1, std::memory_order_relaxed);
         }
         it = std::reverse_iterator(lru_.erase(fwd));
         victims++;

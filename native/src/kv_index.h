@@ -22,6 +22,27 @@
 //     BlockRefs; for one-sided SHM reads clients take an explicit pin
 //     lease (OP_PIN/OP_RELEASE) — a primitive the reference's CUDA-IPC
 //     path performs implicitly inside the server.
+//
+// Thread safety (multi-worker data plane): the index is LOCK-STRIPED.
+// Keys hash to one of kStripes stripes; each stripe owns its own
+// unordered_map, inflight slab and mutex, so workers touching different
+// keys never contend. Inflight tokens embed their stripe
+// ([gen:32][stripe:4][slot:28]) so token-addressed ops (write_dest /
+// commit / abort — the put hot path) lock exactly one stripe. Rules:
+//   - Entry fields are guarded by their stripe's mutex.
+//   - The global LRU list (eviction/spill victim order must stay globally
+//     accurate — per-stripe LRUs would evict hot keys) is guarded by
+//     lru_mu_, taken AFTER a stripe mutex. Eviction walks the LRU under
+//     lru_mu_ and try-locks victims' stripes (skipping busy ones) so the
+//     reverse-order acquisition can never deadlock; with one worker the
+//     try-lock always succeeds and victim selection is identical to the
+//     single-threaded behavior.
+//   - Cross-stripe ops (purge, snapshot_items, match_last_index, reserve)
+//     take stripe locks in INDEX ORDER.
+//   - Pool-arena locks (mempool.h) are leaves, taken after any stripe
+//     lock; pin leases live under their own leases_mu_ leaf.
+// All public methods lock internally; none return raw Entry pointers
+// (BlockRefs keep bytes alive after the stripe lock drops).
 #pragma once
 
 #include <atomic>
@@ -29,6 +50,7 @@
 #include <functional>
 #include <list>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -76,14 +98,18 @@ struct Entry {
     std::shared_ptr<std::vector<uint8_t>> heap;
     uint32_t size = 0;
     bool committed = false;
-    // Position in the LRU list (valid when committed and resident).
+    // Position in the LRU list (valid when committed and resident;
+    // guarded by lru_mu_ together with the stripe mutex).
     std::list<std::string>::iterator lru_it{};
     bool in_lru = false;
 };
 
-// Not thread-safe by itself; the owner (Server) serializes access.
 class KVIndex {
    public:
+    static constexpr uint32_t kStripeBits = 4;
+    static constexpr uint32_t kStripes = 1u << kStripeBits;
+    static constexpr uint32_t kSlotBits = 32 - kStripeBits;  // 28
+
     // eviction=true enables LRU eviction of committed, unpinned entries
     // when the pool is exhausted (beyond reference parity: the reference
     // simply returns OOM forever once full — SURVEY.md §5 notes its only
@@ -122,14 +148,16 @@ class KVIndex {
 
     // Destination for an inflight token's payload (OP_WRITE scatter).
     // Returns nullptr if the token is unknown or owned by another
-    // connection (the forged payload lands in the sink).
+    // connection (the forged payload lands in the sink). The returned
+    // pointer stays valid while the token is live: the inflight entry
+    // pins the Block, and only the owning connection — whose ops are
+    // serialized on its worker — can commit/abort the token.
     uint8_t* write_dest(uint64_t token, uint32_t* size_out, uint64_t owner);
 
     // Abort every live inflight token owned by `owner` (dead-connection
-    // cleanup). O(slab capacity) — the slab only ever holds the peak
-    // concurrent inflight count, and connection death is rare; this
-    // replaces the per-connection open-token hash set that cost two
-    // hash ops per key on the hot allocate/commit path.
+    // cleanup). O(slab capacity) summed over stripes — the slabs only
+    // ever hold the peak concurrent inflight count, and connection death
+    // is rare.
     size_t abort_all_for_owner(uint64_t owner);
 
     // Second phase: make the entry visible. OK, or CONFLICT if the entry
@@ -141,40 +169,38 @@ class KVIndex {
     // another connection's token.
     void abort(uint64_t token, uint64_t owner);
 
-    // Committed lookup for reads (refreshes LRU recency). nullptr if
-    // missing or uncommitted. May return a disk-resident entry
-    // (block == nullptr) — use get_resident when the bytes are needed.
-    Entry* get_committed(const std::string& key);
-    // get_committed + promote from the disk tier into the pool if
-    // spilled. OK (*out set), KEY_NOT_FOUND (missing/uncommitted),
-    // OUT_OF_MEMORY (present but promotion failed — retryable, the data
-    // is intact), or INTERNAL_ERROR (tier IO error).
-    Status get_resident(const std::string& key, const Entry** out);
-    // Residency half of get_resident for a caller that already holds
-    // the Entry* from get_committed — batched reads resolve each key's
-    // hash ONCE instead of twice (op_read is the get-side hot path).
-    // `key` is only used for LRU recency.
-    Status ensure_resident(Entry* e, const std::string& key);
+    // Committed-size probe for read/pin admission passes: true (and
+    // *size_out set) iff the key exists and is committed. Refreshes LRU
+    // recency like a read.
+    bool peek_committed(const std::string& key, uint32_t* size_out);
+
+    // Acquire a pinned, RESIDENT block reference for a committed key —
+    // the whole get path (lookup + disk promotion + pin) under one
+    // stripe lock, returning a BlockRef that stays valid after the lock
+    // drops. allow_promote=false makes a non-resident entry answer BUSY
+    // instead of paying tier IO; promoted_out (optional) is set to true
+    // iff THIS call paid a promotion — per-op promotion budgets must
+    // count their own promotions, not the global counter, which other
+    // workers advance concurrently. Returns OK / KEY_NOT_FOUND / BUSY /
+    // OUT_OF_MEMORY (promotion failed, retryable) / INTERNAL_ERROR
+    // (tier IO error).
+    Status acquire_block(const std::string& key, bool allow_promote,
+                         BlockRef* out, uint32_t* size_out,
+                         bool* promoted_out = nullptr);
+
     bool check_exist(const std::string& key);  // exists && committed
-    // True when pool pressure can hard-ERASE map entries (LRU eviction
-    // on): cached Entry* may dangle across any allocation-causing call,
-    // so batched readers must re-resolve keys instead of holding
-    // pointers. Spill-only/disk configurations never erase — pointers
-    // stay valid and the single-hash read path is safe.
-    bool may_erase_under_pressure() const { return eviction_; }
 
     // Reference algorithm verbatim in behavior (infinistore.cpp:1092-1108):
     // binary search assuming presence is monotone over the key list
-    // (vLLM prefix pages); does NOT check committed.
+    // (vLLM prefix pages); does NOT check committed. Takes every stripe
+    // lock in index order for a consistent cut.
     int match_last_index(const std::vector<std::string>& keys) const;
 
-    // Pre-size the index + inflight slab for `extra` upcoming
+    // Pre-size the index + inflight slabs for `extra` upcoming
     // allocations (batched allocate/put ops insert thousands of keys in
-    // one loop; without this the tables rehash mid-loop under store_mu_).
-    void reserve(size_t extra) {
-        map_.reserve(map_.size() + extra);
-        islab_.reserve(islab_.size() + extra);
-    }
+    // one loop; without this the tables rehash mid-loop under the stripe
+    // locks). Locks stripes one at a time.
+    void reserve(size_t extra);
 
     // Pin committed blocks for one-sided SHM reads; returns lease id.
     uint64_t pin(std::vector<BlockRef> blocks);
@@ -182,7 +208,7 @@ class KVIndex {
 
     // One committed entry's refcounted byte handle — snapshot support.
     // Exactly one of block/heap/disk is set; the shared_ptrs keep the
-    // bytes alive after the store lock is released, so serialization
+    // bytes alive after the stripe locks are released, so serialization
     // never stalls the data plane.
     struct SnapshotItem {
         std::string key;
@@ -191,8 +217,8 @@ class KVIndex {
         std::shared_ptr<std::vector<uint8_t>> heap;
         uint32_t size = 0;
     };
-    // Collect handles to every committed entry (cheap: refs only; call
-    // under the store lock, serialize afterwards without it).
+    // Collect handles to every committed entry (cheap: refs only; locks
+    // all stripes in index order, serialize afterwards without them).
     std::vector<SnapshotItem> snapshot_items() const;
 
     // Directly insert a COMMITTED entry (snapshot restore): pool
@@ -220,27 +246,34 @@ class KVIndex {
     // allocate and commit, before the server processed the close). A
     // concurrent writer's in-progress allocation is never disturbed.
     size_t reclaim_orphans(const std::vector<std::string>& keys);
-    size_t size() const { return map_.size(); }
-    size_t inflight() const { return inflight_live_; }
-    size_t leases() const { return leases_.size(); }
-    uint64_t evictions() const { return evictions_; }
-    uint64_t spills() const { return spills_; }
-    uint64_t promotes() const { return promotes_; }
+    size_t size() const;
+    size_t inflight() const;
+    size_t leases() const;
+    uint64_t evictions() const {
+        return evictions_.load(std::memory_order_relaxed);
+    }
+    uint64_t spills() const { return spills_.load(std::memory_order_relaxed); }
+    uint64_t promotes() const {
+        return promotes_.load(std::memory_order_relaxed);
+    }
 
     // Evict least-recently-used committed entries whose blocks are not
     // pinned (use_count()==1) until `want` bytes could plausibly be
     // freed or nothing evictable remains. Returns entries evicted.
-    size_t evict_lru(size_t want);
+    size_t evict_lru(size_t want) { return evict_internal(want, -1); }
 
    private:
-    // Inflight tokens live in a SLAB, not a hash map: a token is
-    // (generation << 32) | slot, so write_dest/commit/abort — three
-    // calls per written block on the put hot path — are O(1) array
-    // indexing with a generation check instead of three hash probes.
-    // Generations keep stale/forged tokens fail-closed: a freed slot's
-    // generation advances, so an old token mismatches. The key stays a
-    // COPY (not a pointer into map_) so purge()/erase() need no slab
-    // fix-ups; commit still validates against the live map entry.
+    // Inflight tokens live in per-stripe SLABS, not hash maps: a token is
+    // (generation << 32) | (stripe << kSlotBits) | slot, so
+    // write_dest/commit/abort — three calls per written block on the put
+    // hot path — are O(1) array indexing with a generation check, under
+    // exactly one stripe lock, instead of hash probes. Generations keep
+    // stale/forged tokens fail-closed: a freed slot's generation
+    // advances, so an old token mismatches. The key stays a COPY (not a
+    // pointer into the map) so purge()/erase() need no slab fix-ups;
+    // commit still validates against the live map entry. A key's token
+    // always lives in the key's own stripe (allocate creates both
+    // together), so token ops see the map entry under the same lock.
     struct Inflight {
         std::string key;
         BlockRef block;
@@ -249,24 +282,50 @@ class KVIndex {
         uint32_t gen = 0;    // matches the token's high half when live
         bool live = false;
     };
-    Inflight* islot(uint64_t token) {
-        uint32_t idx = uint32_t(token & 0xffffffffu);
+
+    struct Stripe {
+        mutable std::mutex mu;
+        std::unordered_map<std::string, Entry> map;
+        std::vector<Inflight> islab;
+        std::vector<uint32_t> ifree;
+        size_t inflight_live = 0;
+    };
+
+    static uint32_t stripe_of(const std::string& key) {
+        return uint32_t(std::hash<std::string>{}(key)) & (kStripes - 1);
+    }
+    // Decode a token; returns nullptr unless live with matching gen.
+    // Caller must hold the token's stripe mutex (stripe_of_token).
+    static uint32_t stripe_of_token(uint64_t token) {
+        return uint32_t(token >> kSlotBits) & (kStripes - 1);
+    }
+    Inflight* islot(Stripe& st, uint64_t token) {
+        uint32_t idx = uint32_t(token) & ((1u << kSlotBits) - 1);
         uint32_t gen = uint32_t(token >> 32);
-        if (idx >= islab_.size()) return nullptr;
-        Inflight& s = islab_[idx];
+        if (idx >= st.islab.size()) return nullptr;
+        Inflight& s = st.islab[idx];
         if (!s.live || s.gen != gen) return nullptr;
         return &s;
     }
-    void ifree(Inflight* s) {
+    void ifree(Stripe& st, Inflight* s) {
         s->live = false;
         s->block.reset();
         s->key.clear();
-        ifree_.push_back(uint32_t(s - islab_.data()));
-        inflight_live_--;
+        st.ifree.push_back(uint32_t(s - st.islab.data()));
+        st.inflight_live--;
     }
 
+    // Both require the entry's stripe mutex held; take lru_mu_ inside.
     void lru_touch(Entry& e, const std::string& key);
     void lru_drop(Entry& e);
+    // Promote a non-resident entry back into the pool. Requires the
+    // entry's stripe mutex held (stripe index passed for eviction).
+    Status ensure_resident(uint32_t stripe_idx, Entry& e,
+                           const std::string& key);
+    // Eviction/spill walk. held_stripe >= 0 names a stripe mutex the
+    // CALLER already holds (victims there are evicted directly); other
+    // stripes are try-locked, busy ones skipped.
+    size_t evict_internal(size_t want, int held_stripe);
     // Invalidate every client's pin cache (release store so a client
     // observing the new value also observes any writes that preceded
     // the bump, across the shared mapping).
@@ -282,16 +341,19 @@ class KVIndex {
     bool eviction_ = false;
     DiskTier* disk_ = nullptr;
     std::atomic<uint64_t>* epoch_ = nullptr;
-    uint64_t evictions_ = 0;
-    uint64_t spills_ = 0;
-    uint64_t promotes_ = 0;
-    std::list<std::string> lru_;  // front = most recent
-    std::unordered_map<std::string, Entry> map_;
-    std::vector<Inflight> islab_;
-    std::vector<uint32_t> ifree_;
-    size_t inflight_live_ = 0;
+    std::atomic<uint64_t> evictions_{0};
+    std::atomic<uint64_t> spills_{0};
+    std::atomic<uint64_t> promotes_{0};
+    Stripe stripes_[kStripes];
+    // Global LRU (front = most recent), guarded by lru_mu_ (taken after
+    // a stripe mutex — see the threading rules in the header comment).
+    mutable std::mutex lru_mu_;
+    std::list<std::string> lru_;
+    // Pin leases: own leaf mutex (never nested inside a stripe lock by
+    // callers; the server gathers refs first, then pins).
+    mutable std::mutex leases_mu_;
     std::unordered_map<uint64_t, std::vector<BlockRef>> leases_;
-    uint64_t next_lease_ = 1;
+    uint64_t next_lease_ = 1;  // guarded by leases_mu_
 };
 
 }  // namespace istpu

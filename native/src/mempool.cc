@@ -55,6 +55,28 @@ MemoryPool::MemoryPool(size_t pool_size, size_t block_size,
     pool_size_ = total_blocks_ * block_size;
     bitmap_.assign((total_blocks_ + 63) / 64, 0);
 
+    // Carve the block range into arenas. Boundaries are 64-block aligned
+    // so concurrent arenas never share a bitmap word; small pools keep a
+    // single arena (placement identical to the historical allocator).
+    size_t n_arenas = 1;
+    if (total_blocks_ >= 2 * kMinBlocksPerArena) {
+        n_arenas = total_blocks_ / kMinBlocksPerArena;
+        if (n_arenas > kMaxArenas) n_arenas = kMaxArenas;
+    }
+    size_t per = ((total_blocks_ / n_arenas) + 63) & ~size_t(63);
+    size_t begin = 0;
+    for (size_t i = 0; i < n_arenas && begin < total_blocks_; ++i) {
+        auto a = std::make_unique<Arena>();
+        a->begin = begin;
+        a->end = (i + 1 == n_arenas) ? total_blocks_
+                                     : std::min(begin + per, total_blocks_);
+        a->hint = a->begin;
+        begin = a->end;
+        arenas_.push_back(std::move(a));
+    }
+    // Rounding may leave a tail after the nominal last arena: extend it.
+    arenas_.back()->end = total_blocks_;
+
     if (!shm_name_.empty()) {
         std::string path = "/" + shm_name_;
         shm_fd_ = shm_open(path.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
@@ -118,8 +140,9 @@ MemoryPool::MemoryPool(size_t pool_size, size_t block_size,
 #endif
         }
     }
-    IST_INFO("pool ready: %zu MB, block %zu KB, shm=%s", pool_size_ >> 20,
-             block_size_ >> 10, shm_name_.empty() ? "<anon>" : shm_name_.c_str());
+    IST_INFO("pool ready: %zu MB, block %zu KB, %zu arena(s), shm=%s",
+             pool_size_ >> 20, block_size_ >> 10, arenas_.size(),
+             shm_name_.empty() ? "<anon>" : shm_name_.c_str());
 }
 
 MemoryPool::~MemoryPool() {
@@ -140,16 +163,19 @@ void MemoryPool::set_range(size_t start, size_t count, bool value) {
     }
 }
 
-size_t MemoryPool::find_first_fit(size_t count) const {
-    if (count > total_blocks_) return SIZE_MAX;
-    // Two passes: from the rolling hint to the end, then from 0. The hint
-    // keeps scans O(1) amortized for the allocate-heavy steady state.
+size_t MemoryPool::find_first_fit(size_t count, size_t begin, size_t end,
+                                  size_t hint) const {
+    if (count > end - begin) return SIZE_MAX;
+    if (hint < begin || hint >= end) hint = begin;
+    // Two passes: from the rolling hint to the end, then from the arena
+    // start. The hint keeps scans O(1) amortized for the allocate-heavy
+    // steady state.
     for (int pass = 0; pass < 2; ++pass) {
-        size_t begin = pass == 0 ? search_hint_ : 0;
-        size_t end = pass == 0 ? total_blocks_ : search_hint_ + count;
-        if (end > total_blocks_) end = total_blocks_;
+        size_t from = pass == 0 ? hint : begin;
+        size_t to = pass == 0 ? end : hint + count;
+        if (to > end) to = end;
         size_t run = 0;
-        for (size_t i = begin; i < end; ++i) {
+        for (size_t i = from; i < to; ++i) {
             if ((i & 63) == 0 && run == 0 && bitmap_[i >> 6] == ~0ull) {
                 i += 63;  // word fully used, skip
                 continue;
@@ -164,16 +190,58 @@ size_t MemoryPool::find_first_fit(size_t count) const {
     return SIZE_MAX;
 }
 
+size_t MemoryPool::preferred_arena() const {
+    // Sticky per-thread arena: round-robin assignment on a thread's first
+    // allocation ever, then reused for every pool. One worker's batch
+    // allocations stay contiguous inside its arena; distinct workers get
+    // distinct arenas and never contend.
+    static std::atomic<uint32_t> next_seat{0};
+    thread_local uint32_t seat = next_seat.fetch_add(1);
+    return seat % arenas_.size();
+}
+
+void* MemoryPool::alloc_in_arena(Arena& a, size_t count) {
+    std::lock_guard<std::mutex> lk(a.mu);
+    size_t start = find_first_fit(count, a.begin, a.end, a.hint);
+    if (start == SIZE_MAX) return nullptr;
+    set_range(start, count, true);
+    used_blocks_.fetch_add(count, std::memory_order_relaxed);
+    a.hint = start + count;
+    if (a.hint >= a.end) a.hint = a.begin;
+    return base_ + start * block_size_;
+}
+
+void* MemoryPool::alloc_spanning(size_t count) {
+    // Larger than any single arena: take every arena lock in index order
+    // (the process-wide stripe-then-arena lock order; arenas among
+    // themselves are always index-ordered) and scan the whole bitmap.
+    std::vector<std::unique_lock<std::mutex>> locks;
+    locks.reserve(arenas_.size());
+    for (auto& a : arenas_) locks.emplace_back(a->mu);
+    size_t start = find_first_fit(count, 0, total_blocks_, 0);
+    if (start == SIZE_MAX) return nullptr;
+    set_range(start, count, true);
+    used_blocks_.fetch_add(count, std::memory_order_relaxed);
+    return base_ + start * block_size_;
+}
+
 void* MemoryPool::allocate(size_t size) {
     if (size == 0) return nullptr;
     size_t count = (size + block_size_ - 1) / block_size_;
-    size_t start = find_first_fit(count);
-    if (start == SIZE_MAX) return nullptr;
-    set_range(start, count, true);
-    used_blocks_ += count;
-    search_hint_ = start + count;
-    if (search_hint_ >= total_blocks_) search_hint_ = 0;
-    return base_ + start * block_size_;
+    size_t n = arenas_.size();
+    size_t span = arenas_[0]->end - arenas_[0]->begin;
+    if (n == 1) {
+        return alloc_in_arena(*arenas_[0], count);
+    }
+    if (count > span) return alloc_spanning(count);
+    size_t first = preferred_arena();
+    for (size_t i = 0; i < n; ++i) {
+        void* p = alloc_in_arena(*arenas_[(first + i) % n], count);
+        if (p != nullptr) return p;
+    }
+    // Per-arena free space may be fragmented across boundaries; one last
+    // whole-pool scan before reporting OOM.
+    return alloc_spanning(count);
 }
 
 bool MemoryPool::deallocate(void* ptr, size_t size) {
@@ -193,6 +261,13 @@ bool MemoryPool::deallocate(void* ptr, size_t size) {
         IST_ERROR("deallocate: range exceeds pool");
         return false;
     }
+    // Lock every arena the range touches, in index order.
+    std::vector<std::unique_lock<std::mutex>> locks;
+    for (auto& a : arenas_) {
+        if (a->begin < start + count && start < a->end) {
+            locks.emplace_back(a->mu);
+        }
+    }
     // Double-free detection (reference mempool.cpp:139-148).
     for (size_t i = start; i < start + count; ++i) {
         if (!bit(i)) {
@@ -201,8 +276,15 @@ bool MemoryPool::deallocate(void* ptr, size_t size) {
         }
     }
     set_range(start, count, false);
-    used_blocks_ -= count;
-    search_hint_ = start;
+    used_blocks_.fetch_sub(count, std::memory_order_relaxed);
+    // Pull the owning arena's hint back so the freed hole is found first
+    // (the historical search_hint_ = start behavior).
+    for (auto& a : arenas_) {
+        if (start >= a->begin && start < a->end) {
+            a->hint = start;
+            break;
+        }
+    }
     return true;
 }
 
@@ -212,14 +294,19 @@ MM::MM(size_t initial_size, size_t block_size, const std::string& shm_prefix,
       shm_prefix_(shm_prefix),
       auto_extend_(auto_extend),
       extend_size_(extend_size ? extend_size : initial_size) {
+    // Append-only, never reallocated: readers index pools_ concurrently
+    // with extension, so the unique_ptr slots must stay in place.
+    pools_.reserve(kMaxPools);
     std::string name =
         shm_prefix_.empty() ? std::string() : shm_prefix_ + "_0";
     pools_.emplace_back(std::make_unique<MemoryPool>(
         initial_size, block_size_, name, /*prefault=*/true));
+    num_pools_.store(1, std::memory_order_release);
 }
 
 bool MM::allocate(size_t size, PoolLoc* out) {
-    for (uint32_t i = 0; i < pools_.size(); ++i) {
+    size_t n = num_pools();
+    for (uint32_t i = 0; i < n; ++i) {
         void* p = pools_[i]->allocate(size);
         if (p != nullptr) {
             out->ptr = p;
@@ -230,10 +317,23 @@ bool MM::allocate(size_t size, PoolLoc* out) {
     }
     if (auto_extend_) {
         // Nothing fit anywhere: force a new pool (at least large enough for
-        // this request) regardless of the usage threshold.
+        // this request) regardless of the usage threshold. Serialized on
+        // extend_mu_; a racing thread that extended first is discovered by
+        // retrying the pools that appeared since our scan.
+        std::lock_guard<std::mutex> lk(extend_mu_);
+        for (uint32_t i = uint32_t(n); i < num_pools(); ++i) {
+            void* p = pools_[i]->allocate(size);
+            if (p != nullptr) {
+                out->ptr = p;
+                out->pool_idx = i;
+                out->offset =
+                    uint64_t(static_cast<uint8_t*>(p) - pools_[i]->base());
+                return true;
+            }
+        }
         size_t want = extend_size_ > size ? extend_size_ : size;
         if (!add_pool(want)) return false;
-        uint32_t i = uint32_t(pools_.size() - 1);
+        uint32_t i = uint32_t(num_pools() - 1);
         void* p = pools_[i]->allocate(size);
         if (p != nullptr) {
             out->ptr = p;
@@ -246,15 +346,20 @@ bool MM::allocate(size_t size, PoolLoc* out) {
 }
 
 bool MM::add_pool(size_t size) {
+    if (pools_.size() >= kMaxPools) {
+        IST_WARN("pool extension refused: kMaxPools reached");
+        return false;
+    }
     std::string name = shm_prefix_.empty()
                            ? std::string()
                            : shm_prefix_ + "_" + std::to_string(pools_.size());
     try {
-        // No prefault: extensions are built on the serving path (under the
-        // server's store mutex); spreading the fault cost over writes
-        // beats stalling every client for the zero-fill.
+        // No prefault: extensions are built on the serving path; spreading
+        // the fault cost over writes beats stalling every client for the
+        // zero-fill.
         pools_.emplace_back(std::make_unique<MemoryPool>(
             size, block_size_, name, /*prefault=*/false));
+        num_pools_.store(pools_.size(), std::memory_order_release);
         IST_INFO("extended to %zu pools (%zu MB total)", pools_.size(),
                  total_bytes() >> 20);
         return true;
@@ -265,26 +370,34 @@ bool MM::add_pool(size_t size) {
 }
 
 bool MM::deallocate(const PoolLoc& loc, size_t size) {
-    if (loc.pool_idx >= pools_.size()) return false;
+    if (loc.pool_idx >= num_pools()) return false;
     return pools_[loc.pool_idx]->deallocate(loc.ptr, size);
 }
 
 void MM::maybe_extend() {
     if (!auto_extend_) return;
-    if (pools_.back()->usage() <= kExtendThreshold) return;
+    size_t n = num_pools();
+    if (pools_[n - 1]->usage() <= kExtendThreshold) return;
+    std::lock_guard<std::mutex> lk(extend_mu_);
+    // Recheck under the lock: another thread may have extended already.
+    if (num_pools() != n) return;
     add_pool(extend_size_);
 }
 
 size_t MM::total_bytes() const {
-    size_t n = 0;
-    for (auto& p : pools_) n += p->pool_size();
-    return n;
+    size_t total = 0;
+    size_t n = num_pools();
+    for (size_t i = 0; i < n; ++i) total += pools_[i]->pool_size();
+    return total;
 }
 
 size_t MM::used_bytes() const {
-    size_t n = 0;
-    for (auto& p : pools_) n += p->used_blocks() * p->block_size();
-    return n;
+    size_t total = 0;
+    size_t n = num_pools();
+    for (size_t i = 0; i < n; ++i) {
+        total += pools_[i]->used_blocks() * pools_[i]->block_size();
+    }
+    return total;
 }
 
 }  // namespace istpu

@@ -12,11 +12,26 @@
 // a POSIX shared-memory object (shm_open + mmap) that any local client —
 // including the JAX host runtime staging TPU HBM transfers — can map
 // directly. `mlock` is attempted (best-effort) as the pinning analogue.
+//
+// Thread safety (multi-worker data plane): the pool is carved into up to
+// kMaxArenas contiguous, 64-block-aligned ARENAS, each with its own mutex
+// and rolling first-fit hint. A thread's allocations prefer one arena
+// (assigned round-robin on first use), so concurrent server workers
+// allocate out of disjoint address ranges without convoying on a single
+// lock — and a single worker's batch allocations stay contiguous (the
+// iovec-merge / zero-copy-view property the 4 KB-page benchmarks depend
+// on). Allocations larger than one arena take every arena lock in index
+// order and scan the whole bitmap. Pools smaller than
+// 2 * kMinBlocksPerArena keep ONE arena, making the allocator's placement
+// byte-identical to the pre-striping behavior for every small-pool test
+// and for workers=1 deployments with modest pools.
 #pragma once
 
+#include <atomic>
 #include <cstddef>
 #include <cstdint>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -42,10 +57,11 @@ class MemoryPool {
 
     // First-fit contiguous allocation of ceil(size/block_size) blocks.
     // Returns nullptr if no contiguous run fits (reference
-    // mempool.cpp:57-114).
+    // mempool.cpp:57-114). Thread-safe (per-arena locking).
     void* allocate(size_t size);
     // Frees a previously allocated range; aborts the call (returns false)
     // on double-free or unaligned pointer (reference mempool.cpp:116-150).
+    // Thread-safe.
     bool deallocate(void* ptr, size_t size);
 
     bool contains(const void* ptr) const {
@@ -55,28 +71,50 @@ class MemoryPool {
     size_t pool_size() const { return pool_size_; }
     size_t block_size() const { return block_size_; }
     size_t total_blocks() const { return total_blocks_; }
-    size_t used_blocks() const { return used_blocks_; }
+    size_t used_blocks() const {
+        return used_blocks_.load(std::memory_order_relaxed);
+    }
     double usage() const {
-        return total_blocks_ ? double(used_blocks_) / double(total_blocks_) : 0.0;
+        return total_blocks_ ? double(used_blocks()) / double(total_blocks_)
+                             : 0.0;
     }
     const std::string& shm_name() const { return shm_name_; }
 
+    static constexpr size_t kMaxArenas = 8;
+    // Below 2x this many blocks the pool stays single-arena (placement
+    // identical to the historical global first-fit).
+    static constexpr size_t kMinBlocksPerArena = 2048;
+
    private:
+    struct Arena {
+        std::mutex mu;
+        size_t begin = 0;  // first block index (64-aligned)
+        size_t end = 0;    // one past the last block index
+        size_t hint = 0;   // rolling start for first-fit scan (absolute)
+    };
+
     bool bit(size_t idx) const {
         return bitmap_[idx >> 6] & (1ull << (idx & 63));
     }
     void set_range(size_t start, size_t count, bool value);
-    size_t find_first_fit(size_t count) const;
+    // First-fit scan restricted to [begin, end); `hint` rolls inside it.
+    size_t find_first_fit(size_t count, size_t begin, size_t end,
+                          size_t hint) const;
+    // The arena a thread's allocations prefer (sticky per thread so one
+    // worker's batch stays contiguous; different workers land apart).
+    size_t preferred_arena() const;
+    void* alloc_in_arena(Arena& a, size_t count);
+    void* alloc_spanning(size_t count);  // > one arena: all locks, in order
 
     uint8_t* base_ = nullptr;
     size_t pool_size_ = 0;
     size_t block_size_ = 0;
     size_t total_blocks_ = 0;
-    size_t used_blocks_ = 0;
-    size_t search_hint_ = 0;  // rolling start for first-fit scan
+    std::atomic<size_t> used_blocks_{0};
     std::string shm_name_;
     int shm_fd_ = -1;
     std::vector<uint64_t> bitmap_;
+    std::vector<std::unique_ptr<Arena>> arenas_;
 };
 
 // Location of an allocation inside the multi-pool (what crosses the wire as
@@ -90,6 +128,11 @@ struct PoolLoc {
 // Multi-pool manager (reference `MM`, mempool.cpp:152-188): allocations go
 // to the first pool with room; when the newest pool crosses
 // `extend_threshold` usage another pool of `extend_size` is appended.
+//
+// Thread safety: the pools_ vector is append-only with capacity reserved
+// up front (entries are unique_ptrs, so MemoryPool addresses are stable),
+// readers iterate up to the atomic num_pools_, and extension serializes on
+// extend_mu_. Individual pool allocate/deallocate are internally locked.
 class MM {
    public:
     // shm_prefix empty => anonymous pools (tests). Otherwise pools are shm
@@ -102,20 +145,25 @@ class MM {
     // Maybe append a pool; called after allocations (cheap no-op usually).
     void maybe_extend();
 
-    size_t num_pools() const { return pools_.size(); }
+    size_t num_pools() const {
+        return num_pools_.load(std::memory_order_acquire);
+    }
     const MemoryPool& pool(size_t i) const { return *pools_[i]; }
     size_t total_bytes() const;
     size_t used_bytes() const;
     size_t block_size() const { return block_size_; }
 
     static constexpr double kExtendThreshold = 0.5;  // mempool.h:13
+    static constexpr size_t kMaxPools = 256;  // append-only capacity bound
 
    private:
-    bool add_pool(size_t size);
+    bool add_pool(size_t size);  // extend_mu_ held by caller
     size_t block_size_;
     std::string shm_prefix_;
     bool auto_extend_;
     size_t extend_size_;
+    std::mutex extend_mu_;
+    std::atomic<size_t> num_pools_{0};
     std::vector<std::unique_ptr<MemoryPool>> pools_;
 };
 

@@ -12,6 +12,7 @@
 #include <sys/uio.h>
 #include <unistd.h>
 
+#include <cstdlib>
 #include <cstring>
 
 #include "log.h"
@@ -22,11 +23,11 @@ namespace istpu {
 namespace {
 
 // Cap on disk-tier promotions a single OP_READ/OP_PIN may trigger: tier
-// IO runs synchronously on the event loop under store_mu_, so a batched
-// request over thousands of spilled keys would head-of-line block every
-// other connection for hundreds of ms. Past the cap the op fails with
-// BUSY; promoted entries stay resident, so the client's retry makes
-// monotonic progress in bounded slices.
+// IO runs synchronously on the owning worker (under the key's stripe
+// lock), so a batched request over thousands of spilled keys would
+// head-of-line block that worker's other connections for hundreds of ms.
+// Past the cap the op fails with BUSY; promoted entries stay resident, so
+// the client's retry makes monotonic progress in bounded slices.
 constexpr uint64_t kMaxPromotesPerOp = 64;
 
 void set_nonblock(int fd) {
@@ -40,6 +41,29 @@ void tune_socket(int fd) {
     int buf = int(SOCK_BUF_BYTES);
     setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
     setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+}
+
+uint32_t resolve_workers(uint32_t configured) {
+    // ISTPU_SERVER_WORKERS overrides the config (operator escape hatch,
+    // same spirit as INFINISTORE_LOG_LEVEL). Unparseable values are
+    // IGNORED with a warning — a typo must not silently switch a
+    // workers=1 deployment into auto multi-worker mode.
+    if (const char* env = getenv("ISTPU_SERVER_WORKERS")) {
+        char* end = nullptr;
+        long v = strtol(env, &end, 10);
+        if (end != env && *end == '\0' && v >= 0) {
+            configured = uint32_t(v);  // 0 = explicit auto
+        } else if (env[0] != '\0') {
+            IST_WARN("ignoring unparseable ISTPU_SERVER_WORKERS='%s'", env);
+        }
+    }
+    if (configured == 0) {
+        unsigned hw = std::thread::hardware_concurrency();
+        configured = hw > 2 ? (hw - 2 < 4 ? hw - 2 : 4) : 1;
+    }
+    if (configured < 1) configured = 1;
+    if (configured > 64) configured = 64;
+    return configured;
 }
 
 }  // namespace
@@ -157,37 +181,63 @@ bool Server::start() {
     }
     set_nonblock(listen_fd_);
 
-    epoll_fd_ = epoll_create1(EPOLL_CLOEXEC);
-    wake_fd_ = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
-    epoll_event ev{};
-    ev.events = EPOLLIN;
-    ev.data.fd = listen_fd_;
-    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, listen_fd_, &ev);
-    ev.data.fd = wake_fd_;
-    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, wake_fd_, &ev);
+    uint32_t nworkers = resolve_workers(cfg_.workers);
+    cfg_.workers = nworkers;
+    workers_.clear();
+    for (uint32_t i = 0; i < nworkers; ++i) {
+        auto w = std::make_unique<Worker>();
+        w->idx = int(i);
+        w->epoll_fd = epoll_create1(EPOLL_CLOEXEC);
+        w->wake_fd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+        epoll_event ev{};
+        ev.events = EPOLLIN;
+        ev.data.fd = w->wake_fd;
+        epoll_ctl(w->epoll_fd, EPOLL_CTL_ADD, w->wake_fd, &ev);
+        if (i == 0) {
+            // Worker 0 doubles as the acceptor; assigned connections are
+            // handed to the least-loaded worker.
+            ev.data.fd = listen_fd_;
+            epoll_ctl(w->epoll_fd, EPOLL_CTL_ADD, listen_fd_, &ev);
+        }
+        workers_.push_back(std::move(w));
+    }
 
     running_.store(true);
-    thread_ = std::thread([this] { loop(); });
-    IST_INFO("server listening on %s:%u (pool %llu MB, block %llu KB, shm=%s)",
+    for (auto& w : workers_) {
+        Worker* wp = w.get();
+        wp->thread = std::thread([this, wp] { loop(*wp); });
+    }
+    IST_INFO("server listening on %s:%u (pool %llu MB, block %llu KB, "
+             "shm=%s, workers=%u)",
              cfg_.host.c_str(), bound_port_,
              (unsigned long long)(cfg_.prealloc_bytes >> 20),
              (unsigned long long)(cfg_.block_size >> 10),
-             cfg_.enable_shm ? cfg_.shm_prefix.c_str() : "off");
+             cfg_.enable_shm ? cfg_.shm_prefix.c_str() : "off", nworkers);
     return true;
 }
 
 void Server::stop() {
     if (!running_.exchange(false)) return;
-    uint64_t one = 1;
-    ssize_t n = write(wake_fd_, &one, sizeof(one));
-    (void)n;
-    if (thread_.joinable()) thread_.join();
-    for (auto& [fd, c] : conns_) close(fd);
-    conns_.clear();
+    for (auto& w : workers_) {
+        uint64_t one = 1;
+        ssize_t n = write(w->wake_fd, &one, sizeof(one));
+        (void)n;
+    }
+    for (auto& w : workers_) {
+        if (w->thread.joinable()) w->thread.join();
+    }
+    for (auto& w : workers_) {
+        for (auto& [fd, c] : w->conns) close(fd);
+        w->conns.clear();
+        // Handed-off connections never adopted before shutdown.
+        for (auto& c : w->pending) close(c->fd);
+        w->pending.clear();
+        if (w->epoll_fd >= 0) close(w->epoll_fd);
+        if (w->wake_fd >= 0) close(w->wake_fd);
+    }
+    workers_.clear();
     if (listen_fd_ >= 0) close(listen_fd_);
-    if (epoll_fd_ >= 0) close(epoll_fd_);
-    if (wake_fd_ >= 0) close(wake_fd_);
-    listen_fd_ = epoll_fd_ = wake_fd_ = -1;
+    listen_fd_ = -1;
     {
         // Control-plane threads may still be inside kvmap_len/stats or a
         // snapshot (whose BlockRefs deallocate into mm_); serialize
@@ -231,87 +281,78 @@ static constexpr uint32_t SNAP_VERSION = 1;
 long long Server::snapshot(const std::string& path) {
     // snap_mu_ serializes concurrent snapshots (a shared tmp would let
     // two writers publish an interleaved file) and blocks stop()'s
-    // teardown while the collected refs below are alive.
+    // teardown while the collected refs below are alive (their
+    // destructors deallocate into mm_, which must still exist; the
+    // deallocation itself is thread-safe against the data plane).
     std::lock_guard<std::mutex> snap_lk(snap_mu_);
     std::vector<KVIndex::SnapshotItem> items;
     {
-        // Under the store lock: refs only. The file IO below runs
-        // lock-free — the data plane never stalls behind a store-sized
-        // write; the shared_ptrs pin blocks/extents instead.
+        // store_mu_ only pins the index_ pointer against stop();
+        // snapshot_items() takes the stripe locks itself and returns
+        // refs, so serialization below runs without stalling the
+        // data plane.
         std::lock_guard<std::mutex> lk(store_mu_);
         if (!index_) return -1;
         items = index_->snapshot_items();
     }
-    long long result = [&]() -> long long {
-        std::string tmp = path + ".tmp." + std::to_string(getpid());
-        FILE* f = fopen(tmp.c_str(), "wb");
-        if (f == nullptr) {
-            IST_WARN("snapshot: cannot open %s: %s", tmp.c_str(),
-                     strerror(errno));
-            return -1;
-        }
-        uint64_t count = uint64_t(items.size());
-        fwrite(&SNAP_MAGIC, sizeof(SNAP_MAGIC), 1, f);
-        fwrite(&SNAP_VERSION, sizeof(SNAP_VERSION), 1, f);
-        fwrite(&count, sizeof(count), 1, f);
-        std::vector<uint8_t> tmpbuf;
-        bool ok = true;
-        for (const auto& it : items) {
-            const uint8_t* p = nullptr;
-            if (it.block) {
-                p = static_cast<const uint8_t*>(it.block->loc.ptr);
-            } else if (it.heap) {
-                p = it.heap->data();
-            } else {  // disk-resident: read back through the tier (pread
-                      // — safe alongside the loop's bitmap mutations)
-                tmpbuf.resize(it.size);
-                if (!disk_ || !disk_->load(it.disk->off, tmpbuf.data(),
-                                           it.size)) {
-                    ok = false;
-                    break;
-                }
-                p = tmpbuf.data();
-            }
-            uint32_t klen = uint32_t(it.key.size());
-            fwrite(&klen, sizeof(klen), 1, f);
-            fwrite(it.key.data(), 1, klen, f);
-            fwrite(&it.size, sizeof(it.size), 1, f);
-            fwrite(p, 1, it.size, f);
-            if (ferror(f) != 0) {
+    std::string tmp = path + ".tmp." + std::to_string(getpid());
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (f == nullptr) {
+        IST_WARN("snapshot: cannot open %s: %s", tmp.c_str(),
+                 strerror(errno));
+        return -1;
+    }
+    uint64_t count = uint64_t(items.size());
+    fwrite(&SNAP_MAGIC, sizeof(SNAP_MAGIC), 1, f);
+    fwrite(&SNAP_VERSION, sizeof(SNAP_VERSION), 1, f);
+    fwrite(&count, sizeof(count), 1, f);
+    std::vector<uint8_t> tmpbuf;
+    bool ok = true;
+    for (const auto& it : items) {
+        const uint8_t* p = nullptr;
+        if (it.block) {
+            p = static_cast<const uint8_t*>(it.block->loc.ptr);
+        } else if (it.heap) {
+            p = it.heap->data();
+        } else {  // disk-resident: read back through the tier (pread —
+                  // safe alongside the workers' bitmap mutations)
+            tmpbuf.resize(it.size);
+            if (!disk_ || !disk_->load(it.disk->off, tmpbuf.data(),
+                                       it.size)) {
                 ok = false;
                 break;
             }
+            p = tmpbuf.data();
         }
-        // Crash-durable atomic replace: flush to the kernel AND the
-        // device before the rename publishes the file, then persist the
-        // directory entry — fclose alone only reaches the page cache.
-        if (ok) ok = fflush(f) == 0 && fsync(fileno(f)) == 0;
-        if (fclose(f) != 0) ok = false;
-        if (!ok || rename(tmp.c_str(), path.c_str()) != 0) {
-            remove(tmp.c_str());
-            IST_WARN("snapshot to %s failed", path.c_str());
-            return -1;
+        uint32_t klen = uint32_t(it.key.size());
+        fwrite(&klen, sizeof(klen), 1, f);
+        fwrite(it.key.data(), 1, klen, f);
+        fwrite(&it.size, sizeof(it.size), 1, f);
+        fwrite(p, 1, it.size, f);
+        if (ferror(f) != 0) {
+            ok = false;
+            break;
         }
-        std::string dir = path;
-        size_t slash = dir.find_last_of('/');
-        dir = slash == std::string::npos ? "." : dir.substr(0, slash);
-        int dfd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
-        if (dfd >= 0) {
-            fsync(dfd);
-            close(dfd);
-        }
-        return (long long)count;
-    }();
-    {
-        // Drop the collected refs back under the store lock: a ref that
-        // became the LAST owner during the lock-free IO (purge/eviction
-        // raced it) would otherwise run ~Block/~DiskSpan — which mutate
-        // the UNSYNCHRONIZED pool/tier bitmaps — concurrently with the
-        // event loop's allocations.
-        std::lock_guard<std::mutex> lk(store_mu_);
-        items.clear();
     }
-    return result;
+    // Crash-durable atomic replace: flush to the kernel AND the
+    // device before the rename publishes the file, then persist the
+    // directory entry — fclose alone only reaches the page cache.
+    if (ok) ok = fflush(f) == 0 && fsync(fileno(f)) == 0;
+    if (fclose(f) != 0) ok = false;
+    if (!ok || rename(tmp.c_str(), path.c_str()) != 0) {
+        remove(tmp.c_str());
+        IST_WARN("snapshot to %s failed", path.c_str());
+        return -1;
+    }
+    std::string dir = path;
+    size_t slash = dir.find_last_of('/');
+    dir = slash == std::string::npos ? "." : dir.substr(0, slash);
+    int dfd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+    if (dfd >= 0) {
+        fsync(dfd);
+        close(dfd);
+    }
+    return (long long)count;
 }
 
 long long Server::restore(const std::string& path) {
@@ -400,7 +441,8 @@ std::string Server::stats_json() {
         "{\"kvmap_len\": %zu, \"inflight\": %zu, \"leases\": %zu, "
         "\"pools\": %zu, \"pool_bytes\": %zu, \"used_bytes\": %zu, "
         "\"ops\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu, "
-        "\"connections\": %zu, \"evictions\": %llu, \"spills\": %llu, "
+        "\"connections\": %zu, \"workers\": %zu, \"evictions\": %llu, "
+        "\"spills\": %llu, "
         "\"promotes\": %llu, \"disk_bytes\": %llu, \"disk_used\": %llu, "
         "\"outq_bytes\": %llu, \"outq_cap\": %llu, \"reads_busy\": %llu, "
         "\"lease_bytes\": %llu, \"pins_busy\": %llu, "
@@ -413,6 +455,7 @@ std::string Server::stats_json() {
         (unsigned long long)ops_.load(),
         (unsigned long long)bytes_in_.load(),
         (unsigned long long)bytes_out_.load(), size_t(n_conns_.load()),
+        size_t(cfg_.workers),
         (unsigned long long)(index_ ? index_->evictions() : 0),
         (unsigned long long)(index_ ? index_->spills() : 0),
         (unsigned long long)(index_ ? index_->promotes() : 0),
@@ -452,11 +495,11 @@ std::string Server::stats_json() {
     return out;
 }
 
-void Server::loop() {
+void Server::loop(Worker& w) {
     constexpr int kMaxEvents = 64;
     epoll_event events[kMaxEvents];
     while (running_.load()) {
-        int n = epoll_wait(epoll_fd_, events, kMaxEvents, 500);
+        int n = epoll_wait(w.epoll_fd, events, kMaxEvents, 500);
         if (n < 0) {
             if (errno == EINTR) continue;
             IST_ERROR("epoll_wait: %s", strerror(errno));
@@ -465,74 +508,114 @@ void Server::loop() {
         for (int i = 0; i < n; ++i) {
             int fd = events[i].data.fd;
             uint32_t evs = events[i].events;
-            if (fd == wake_fd_) {
+            if (fd == w.wake_fd) {
                 uint64_t v;
-                ssize_t r = read(wake_fd_, &v, sizeof(v));
+                ssize_t r = read(w.wake_fd, &v, sizeof(v));
                 (void)r;
+                adopt_pending(w);
                 continue;
             }
-            if (fd == listen_fd_) {
+            if (fd == listen_fd_) {  // worker 0 only
                 accept_ready();
                 continue;
             }
-            auto it = conns_.find(fd);
-            if (it == conns_.end()) continue;
+            auto it = w.conns.find(fd);
+            if (it == w.conns.end()) continue;
             Conn& c = *it->second;
             if (evs & (EPOLLHUP | EPOLLERR)) {
-                close_conn(fd);
+                close_conn(w, fd);
                 continue;
             }
             if (evs & EPOLLIN) {
                 conn_readable(c);
-                if (conns_.find(fd) == conns_.end()) continue;
+                if (w.conns.find(fd) == w.conns.end()) continue;
             }
             if (evs & EPOLLOUT) conn_writable(c);
         }
     }
 }
 
+void Server::adopt_pending(Worker& w) {
+    std::vector<std::unique_ptr<Conn>> adopted;
+    {
+        std::lock_guard<std::mutex> lk(w.pending_mu);
+        adopted.swap(w.pending);
+    }
+    for (auto& c : adopted) {
+        epoll_event ev{};
+        ev.events = EPOLLIN;
+        ev.data.fd = c->fd;
+        epoll_ctl(w.epoll_fd, EPOLL_CTL_ADD, c->fd, &ev);
+        int fd = c->fd;
+        w.conns[fd] = std::move(c);
+        IST_DEBUG("worker %d adopted fd=%d", w.idx, fd);
+    }
+}
+
 void Server::accept_ready() {
+    // Runs on worker 0 (the only epoll watching listen_fd_).
     while (true) {
         int fd = accept4(listen_fd_, nullptr, nullptr,
                          SOCK_NONBLOCK | SOCK_CLOEXEC);
         if (fd < 0) return;
         tune_socket(fd);
+        // Least-loaded assignment by live connection count; ties go to
+        // the lowest index, so workers=1 puts everything on worker 0
+        // exactly like the historical single loop.
+        Worker* target = workers_[0].get();
+        for (auto& w : workers_) {
+            if (w->nconns.load(std::memory_order_relaxed) <
+                target->nconns.load(std::memory_order_relaxed)) {
+                target = w.get();
+            }
+        }
         auto c = std::make_unique<Conn>();
         c->fd = fd;
-        c->id = next_conn_id_++;
-        epoll_event ev{};
-        ev.events = EPOLLIN;
-        ev.data.fd = fd;
-        epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
-        conns_[fd] = std::move(c);
+        c->id = next_conn_id_.fetch_add(1, std::memory_order_relaxed);
+        c->w = target;
+        target->nconns.fetch_add(1, std::memory_order_relaxed);
         n_conns_++;
-        IST_DEBUG("accepted fd=%d", fd);
+        IST_DEBUG("accepted fd=%d -> worker %d", fd, target->idx);
+        if (target == workers_[0].get()) {
+            epoll_event ev{};
+            ev.events = EPOLLIN;
+            ev.data.fd = fd;
+            epoll_ctl(target->epoll_fd, EPOLL_CTL_ADD, fd, &ev);
+            target->conns[fd] = std::move(c);
+        } else {
+            {
+                std::lock_guard<std::mutex> lk(target->pending_mu);
+                target->pending.push_back(std::move(c));
+            }
+            uint64_t one = 1;
+            ssize_t r = write(target->wake_fd, &one, sizeof(one));
+            (void)r;
+        }
     }
 }
 
-void Server::close_conn(int fd) {
-    auto it = conns_.find(fd);
-    if (it == conns_.end()) return;
+void Server::close_conn(Worker& w, int fd) {
+    auto it = w.conns.find(fd);
+    if (it == w.conns.end()) return;
     // Abort allocations this client never committed, drop any pin
     // leases it still holds, and return its block leases' unconsumed
     // blocks to the pool (a dead client's leased blocks are reclaimed
-    // exactly like its uncommitted allocations).
-    {
-        std::lock_guard<std::mutex> lk(store_mu_);
-        index_->abort_all_for_owner(it->second->id);
-        for (auto& [lease, bytes] : it->second->open_leases) {
-            index_->release(lease);
-        }
-        for (auto& [lease, bl] : it->second->block_leases) {
-            free_lease_remainder(bl);
-        }
-        it->second->block_leases.clear();
+    // exactly like its uncommitted allocations). All of it goes through
+    // the internally locked index/pool — safe alongside other workers.
+    index_->abort_all_for_owner(it->second->id);
+    for (auto& [lease, bytes] : it->second->open_leases) {
+        index_->release(lease);
     }
+    for (auto& [lease, bl] : it->second->block_leases) {
+        free_lease_remainder(bl);
+    }
+    it->second->block_leases.clear();
     outq_total_.fetch_sub(it->second->outq_bytes, std::memory_order_relaxed);
     lease_total_.fetch_sub(it->second->lease_bytes, std::memory_order_relaxed);
-    epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, fd, nullptr);
+    epoll_ctl(w.epoll_fd, EPOLL_CTL_DEL, fd, nullptr);
     close(fd);
-    conns_.erase(it);
+    w.conns.erase(it);
+    w.nconns.fetch_sub(1, std::memory_order_relaxed);
     n_conns_--;
     IST_DEBUG("closed fd=%d", fd);
 }
@@ -544,7 +627,7 @@ void Server::update_epoll(Conn& c) {
     epoll_event ev{};
     ev.events = EPOLLIN | (want ? uint32_t(EPOLLOUT) : 0u);
     ev.data.fd = c.fd;
-    epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, c.fd, &ev);
+    epoll_ctl(c.w->epoll_fd, EPOLL_CTL_MOD, c.fd, &ev);
 }
 
 void Server::conn_readable(Conn& c) {
@@ -552,39 +635,39 @@ void Server::conn_readable(Conn& c) {
         if (c.state == RState::HDR) {
             ssize_t r = recv(c.fd, reinterpret_cast<uint8_t*>(&c.hdr) + c.hdr_got,
                              sizeof(WireHeader) - c.hdr_got, 0);
-            if (r == 0) return close_conn(c.fd);
+            if (r == 0) return close_conn(*c.w, c.fd);
             if (r < 0) {
                 if (errno == EAGAIN || errno == EWOULDBLOCK) return;
-                return close_conn(c.fd);
+                return close_conn(*c.w, c.fd);
             }
             bytes_in_ += uint64_t(r);
             c.hdr_got += size_t(r);
             if (c.hdr_got < sizeof(WireHeader)) continue;
             if (!header_valid(c.hdr)) {
                 IST_WARN("bad header from fd=%d, closing", c.fd);
-                return close_conn(c.fd);
+                return close_conn(*c.w, c.fd);
             }
             c.body.resize(c.hdr.body_len);
             c.body_got = 0;
             c.state = RState::BODY;
             if (c.hdr.body_len == 0) {
                 handle_message(c);
-                if (c.dead) return close_conn(c.fd);
+                if (c.dead) return close_conn(*c.w, c.fd);
                 continue;
             }
         } else if (c.state == RState::BODY) {
             ssize_t r = recv(c.fd, c.body.data() + c.body_got,
                              c.body.size() - c.body_got, 0);
-            if (r == 0) return close_conn(c.fd);
+            if (r == 0) return close_conn(*c.w, c.fd);
             if (r < 0) {
                 if (errno == EAGAIN || errno == EWOULDBLOCK) return;
-                return close_conn(c.fd);
+                return close_conn(*c.w, c.fd);
             }
             bytes_in_ += uint64_t(r);
             c.body_got += size_t(r);
             if (c.body_got < c.body.size()) continue;
             handle_message(c);
-            if (c.dead) return close_conn(c.fd);
+            if (c.dead) return close_conn(*c.w, c.fd);
         } else if (c.state == RState::PAYLOAD) {
             // Scatter OP_WRITE payload straight into pool blocks — the TCP
             // analogue of one-sided RDMA WRITE landing in the pool. One
@@ -625,10 +708,10 @@ void Server::conn_readable(Conn& c) {
                     niov = 1;
                 }
                 ssize_t r = readv(c.fd, iov, niov);
-                if (r == 0) return close_conn(c.fd);
+                if (r == 0) return close_conn(*c.w, c.fd);
                 if (r < 0) {
                     if (errno == EAGAIN || errno == EWOULDBLOCK) return;
-                    return close_conn(c.fd);
+                    return close_conn(*c.w, c.fd);
                 }
                 bytes_in_ += uint64_t(r);
                 c.payload_left -= uint64_t(r);
@@ -645,17 +728,17 @@ void Server::conn_readable(Conn& c) {
                 }
             }
             finish_write(c);
-            if (c.dead) return close_conn(c.fd);
+            if (c.dead) return close_conn(*c.w, c.fd);
         } else {  // DRAIN
             if (c.sink.size() < (1u << 16)) c.sink.resize(1u << 16);
             while (c.payload_left > 0) {
                 size_t room = c.sink.size();
                 if (room > c.payload_left) room = size_t(c.payload_left);
                 ssize_t r = recv(c.fd, c.sink.data(), room, 0);
-                if (r == 0) return close_conn(c.fd);
+                if (r == 0) return close_conn(*c.w, c.fd);
                 if (r < 0) {
                     if (errno == EAGAIN || errno == EWOULDBLOCK) return;
-                    return close_conn(c.fd);
+                    return close_conn(*c.w, c.fd);
                 }
                 c.payload_left -= uint64_t(r);
             }
@@ -667,7 +750,7 @@ void Server::conn_readable(Conn& c) {
 
 void Server::conn_writable(Conn& c) {
     if (!flush_out(c)) {
-        close_conn(c.fd);
+        close_conn(*c.w, c.fd);
         return;
     }
     update_epoll(c);
@@ -788,11 +871,14 @@ void Server::handle_message(Conn& c) {
             // Size the per-connection sink FIRST: pointers captured below
             // must stay stable for the whole payload scatter.
             if (c.sink.size() < block_size) c.sink.resize(block_size);
-            std::lock_guard<std::mutex> lk(store_mu_);
             for (uint32_t i = 0; i < n; ++i) {
                 uint64_t tok = r.u64();
                 c.wtokens.push_back(tok);
                 uint32_t sz = 0;
+                // Stripe-locked inside; the returned pointer stays valid
+                // across the scatter because the inflight entry pins the
+                // block and only this (worker-serialized) connection can
+                // release the token.
                 uint8_t* dst = index_->write_dest(tok, &sz, c.id);
                 if (dst != nullptr && sz >= block_size) {
                     c.wdest.emplace_back(dst, block_size);
@@ -912,28 +998,26 @@ void Server::begin_put(Conn& c) {
     }
     if (c.sink.size() < block_size) c.sink.resize(block_size);
     c.wput_oom = false;
-    {
-        std::lock_guard<std::mutex> lk(store_mu_);
-        index_->reserve(keys.size());
-        for (auto& k : keys) {
-            RemoteBlock b;
-            Status st = index_->allocate(k, block_size, &b, c.id);
-            if (st == OK) {
-                c.wtokens.push_back(b.token);
-                uint32_t sz = 0;
-                uint8_t* dst = index_->write_dest(b.token, &sz, c.id);
-                c.wdest.emplace_back(dst, block_size);
-            } else {
-                // Dedup (CONFLICT): sink this key's slice, first writer
-                // wins. OOM: sink too, but fail the whole op below so the
-                // client sees the loss (all-or-nothing like the
-                // allocate+write path).
-                if (st == OUT_OF_MEMORY) c.wput_oom = true;
-                c.wdest.emplace_back(c.sink.data(), block_size);
-            }
+    index_->reserve(keys.size());
+    for (auto& k : keys) {
+        RemoteBlock b;
+        Status st = index_->allocate(k, block_size, &b, c.id);
+        if (st == OK) {
+            c.wtokens.push_back(b.token);
+            // The scatter destination is derivable from the allocation
+            // itself — no second stripe-locked lookup on the hot path.
+            uint8_t* dst = mm_->pool(b.pool_idx).base() + b.offset;
+            c.wdest.emplace_back(dst, block_size);
+        } else {
+            // Dedup (CONFLICT): sink this key's slice, first writer
+            // wins. OOM: sink too, but fail the whole op below so the
+            // client sees the loss (all-or-nothing like the
+            // allocate+write path).
+            if (st == OUT_OF_MEMORY) c.wput_oom = true;
+            c.wdest.emplace_back(c.sink.data(), block_size);
         }
-        mm_->maybe_extend();
     }
+    mm_->maybe_extend();
     c.payload_left = c.hdr.payload_len;
     c.wseg = 0;
     c.wseg_off = 0;
@@ -944,23 +1028,21 @@ void Server::begin_put(Conn& c) {
 void Server::finish_write(Conn& c) {
     uint32_t committed = 0;
     bool fail_oom = c.hdr.op == OP_PUT && c.wput_oom;
-    {
-        std::lock_guard<std::mutex> lk(store_mu_);
-        if (fail_oom) {
-            // All-or-nothing: some keys of this PUT could not be
-            // allocated, so abort the ones that could — a partial commit
-            // would be invisible data loss behind an error the caller
-            // might retry wholesale.
-            for (uint64_t tok : c.wtokens) {
-                index_->abort(tok, c.id);
-            }
-        } else {
-            // Commit everything that landed (two-phase visibility:
-            // entries become readable only now, after the bytes are in
-            // the pool).
-            for (uint64_t tok : c.wtokens) {
-                if (index_->commit(tok, c.id) == OK) committed++;
-            }
+    if (fail_oom) {
+        // All-or-nothing: some keys of this PUT could not be
+        // allocated, so abort the ones that could — a partial commit
+        // would be invisible data loss behind an error the caller
+        // might retry wholesale.
+        for (uint64_t tok : c.wtokens) {
+            index_->abort(tok, c.id);
+        }
+    } else {
+        // Commit everything that landed (two-phase visibility:
+        // entries become readable only now, after the bytes are in
+        // the pool; each commit publishes under its key's stripe
+        // lock, so the ack below orders before any reader's lookup).
+        for (uint64_t tok : c.wtokens) {
+            if (index_->commit(tok, c.id) == OK) committed++;
         }
     }
     std::vector<uint8_t> body;
@@ -978,7 +1060,6 @@ void Server::finish_write(Conn& c) {
 void Server::op_hello(Conn& c) {
     std::vector<uint8_t> body;
     BufWriter w(body);
-    std::lock_guard<std::mutex> lk(store_mu_);
     w.u32(OK);
     w.u32(uint32_t(mm_->block_size()));
     w.u32(cfg_.enable_shm ? 1 : 0);
@@ -1059,7 +1140,6 @@ void Server::op_lease(Conn& c) {
     uint64_t granted = 0;
     uint64_t epoch = 0;
     {
-        std::lock_guard<std::mutex> lk(store_mu_);
         const size_t bs = mm_->block_size();
         uint64_t want = nblocks;
         bool evicted_once = false;
@@ -1091,7 +1171,8 @@ void Server::op_lease(Conn& c) {
         mm_->maybe_extend();
         epoch = index_->epoch();
         if (granted > 0) {
-            uint64_t id = next_block_lease_++;
+            uint64_t id =
+                next_block_lease_.fetch_add(1, std::memory_order_relaxed);
             Conn::BlockLease& bl = c.block_leases[id];
             bl.runs = runs;
             bl.blocks_left = granted;
@@ -1122,7 +1203,9 @@ void Server::op_commit_batch(Conn& c) {
     // client cannot point a commit at memory it was not leased. Entries
     // become visible here, after the client's one-sided writes: the
     // two-phase contract is unchanged, with the lease cursor playing
-    // the role of the inflight token.
+    // the role of the inflight token. The lease cursor is connection
+    // state (this worker's), so only insert_leased and the pool frees
+    // below touch shared state — both internally locked.
     BufReader r(c.body.data(), c.body.size());
     uint64_t lease_id = r.u64();
     uint32_t block_size = r.u32();
@@ -1149,7 +1232,6 @@ void Server::op_commit_batch(Conn& c) {
     bool overrun = false;
     uint64_t epoch = 0;
     {
-        std::lock_guard<std::mutex> lk(store_mu_);
         const size_t bs = mm_->block_size();
         const uint32_t nb = uint32_t((uint64_t(block_size) + bs - 1) / bs);
         index_->reserve(keys.size());
@@ -1229,11 +1311,7 @@ void Server::op_lease_revoke(Conn& c) {
         w.u32(CONFLICT);  // unknown/already revoked: nothing to free
         w.u64(0);
     } else {
-        uint64_t freed;
-        {
-            std::lock_guard<std::mutex> lk(store_mu_);
-            freed = free_lease_remainder(lit->second);
-        }
+        uint64_t freed = free_lease_remainder(lit->second);
         c.block_leases.erase(lit);
         w.u32(OK);
         w.u64(freed);
@@ -1254,14 +1332,11 @@ void Server::op_allocate(Conn& c) {
         return;
     }
     std::vector<RemoteBlock> blocks(keys.size());
-    {
-        std::lock_guard<std::mutex> lk(store_mu_);
-        index_->reserve(keys.size());
-        for (size_t i = 0; i < keys.size(); ++i) {
-            index_->allocate(keys[i], block_size, &blocks[i], c.id);
-        }
-        mm_->maybe_extend();
+    index_->reserve(keys.size());
+    for (size_t i = 0; i < keys.size(); ++i) {
+        index_->allocate(keys[i], block_size, &blocks[i], c.id);
     }
+    mm_->maybe_extend();
     w.u32(OK);
     w.u32(uint32_t(blocks.size()));
     w.bytes(blocks.data(), blocks.size() * sizeof(RemoteBlock));
@@ -1280,77 +1355,75 @@ void Server::op_read(Conn& c) {
         respond(c, c.hdr.seq, OP_READ, std::move(body));
         return;
     }
+    // Cheap metadata pass first: definitive answers (missing key, size
+    // mismatch) must not be masked by retryable BUSY, and a read that
+    // will be refused must not pay disk promotion (or churn the cache
+    // making pool room for it). Under multi-worker concurrency a key can
+    // still vanish between the passes; the acquire pass below then
+    // answers KEY_NOT_FOUND — the same answer a pre-op delete gives.
+    for (auto& k : keys) {
+        uint32_t sz = 0;
+        if (!index_->peek_committed(k, &sz) || sz < block_size) {
+            w.u32(KEY_NOT_FOUND);
+            respond(c, c.hdr.seq, OP_READ, std::move(body));
+            return;
+        }
+    }
+    // Backpressure: refuse the whole read (retryably, before any
+    // pinning or disk promotion) if it would push this connection's
+    // queued bytes past the cap. A single over-cap read against an
+    // empty queue is still admitted so progress is always possible;
+    // the queue then being non-empty blocks further reads, so
+    // per-connection pinned memory is bounded by cap + one op.
+    uint64_t planned = uint64_t(keys.size()) * block_size;
+    if (c.outq_bytes > 0 &&
+        c.outq_bytes + planned > cfg_.max_outq_bytes) {
+        reads_busy_.fetch_add(1, std::memory_order_relaxed);
+        w.u32(BUSY);
+        respond(c, c.hdr.seq, OP_READ, std::move(body));
+        return;
+    }
     std::vector<std::pair<const uint8_t*, size_t>> segs;
     std::vector<BlockRef> refs;
-    {
-        std::lock_guard<std::mutex> lk(store_mu_);
-        // Cheap metadata pass first: definitive answers (missing key,
-        // size mismatch) must not be masked by retryable BUSY, and a
-        // read that will be refused must not pay disk promotion (or
-        // churn the cache making pool room for it). The Entry* pointers
-        // are kept so the residency pass below resolves each key's hash
-        // ONCE, not twice (the get-side hot path at 4 KB blocks) — but
-        // ONLY when LRU eviction is off: ensure_resident can trigger
-        // evict_lru, which hard-erases map entries and would leave a
-        // later cached pointer dangling (use-after-free). With eviction
-        // on, the residency pass re-resolves by key (a vanished key is
-        // then a clean KEY_NOT_FOUND).
-        const bool ptrs_stable = !index_->may_erase_under_pressure();
-        std::vector<Entry*> entries;
-        entries.reserve(keys.size());
-        for (auto& k : keys) {
-            Entry* meta = index_->get_committed(k);
-            if (meta == nullptr || meta->size < block_size) {
-                w.u32(KEY_NOT_FOUND);
-                respond(c, c.hdr.seq, OP_READ, std::move(body));
-                return;
-            }
-            entries.push_back(meta);
-        }
-        // Backpressure: refuse the whole read (retryably, before any
-        // pinning or disk promotion) if it would push this connection's
-        // queued bytes past the cap. A single over-cap read against an
-        // empty queue is still admitted so progress is always possible;
-        // the queue then being non-empty blocks further reads, so
-        // per-connection pinned memory is bounded by cap + one op.
-        uint64_t planned = uint64_t(keys.size()) * block_size;
-        if (c.outq_bytes > 0 &&
-            c.outq_bytes + planned > cfg_.max_outq_bytes) {
+    segs.reserve(keys.size());
+    refs.reserve(keys.size());
+    uint64_t promoted = 0;
+    for (auto& k : keys) {
+        // Bounded promotion slice per request (see kMaxPromotesPerOp):
+        // once the budget is spent, a non-resident entry answers BUSY
+        // instead of paying more tier IO. The budget counts THIS op's
+        // promotions (acquire_block reports them) — a global-counter
+        // delta would let other workers' concurrent promotions starve
+        // this op with perpetual BUSY. A failed promotion surfaces as
+        // its own (retryable) status, not KEY_NOT_FOUND — the data is
+        // still there. The returned BlockRef pins the blocks until the
+        // response bytes are on the wire.
+        BlockRef b;
+        uint32_t sz = 0;
+        bool did_promote = false;
+        Status st = index_->acquire_block(k, promoted < kMaxPromotesPerOp,
+                                          &b, &sz, &did_promote);
+        if (did_promote) promoted++;
+        if (st == BUSY) {
             reads_busy_.fetch_add(1, std::memory_order_relaxed);
             w.u32(BUSY);
             respond(c, c.hdr.seq, OP_READ, std::move(body));
             return;
         }
-        uint64_t p0 = index_->promotes();
-        for (size_t i = 0; i < keys.size(); ++i) {
-            Entry* e = ptrs_stable ? entries[i]
-                                   : index_->get_committed(keys[i]);
-            if (e == nullptr) {  // evicted between the passes
-                w.u32(KEY_NOT_FOUND);
-                respond(c, c.hdr.seq, OP_READ, std::move(body));
-                return;
-            }
-            // Bounded promotion slice per request (see kMaxPromotesPerOp).
-            if (e->block == nullptr &&
-                index_->promotes() - p0 >= kMaxPromotesPerOp) {
-                reads_busy_.fetch_add(1, std::memory_order_relaxed);
-                w.u32(BUSY);
-                respond(c, c.hdr.seq, OP_READ, std::move(body));
-                return;
-            }
-            // ensure_resident promotes spilled entries back into the
-            // pool. A failed promotion surfaces as its own (retryable)
-            // status, not KEY_NOT_FOUND — the data is still there.
-            Status st = index_->ensure_resident(e, keys[i]);
-            if (st != OK) {
-                w.u32(st);
-                respond(c, c.hdr.seq, OP_READ, std::move(body));
-                return;
-            }
-            segs.emplace_back(static_cast<const uint8_t*>(e->block->loc.ptr),
-                              size_t(block_size));
-            refs.push_back(e->block);  // pin until sent
+        // Re-validate the size from the acquire itself: between the
+        // metadata pass and here another worker may have deleted K and
+        // re-put it SMALLER — gathering block_size bytes from the new
+        // (shorter) block would leak adjacent pool memory onto the
+        // wire. A shrunk entry answers like the vanished entry it is.
+        if (st == OK && sz < block_size) st = KEY_NOT_FOUND;
+        if (st != OK) {
+            w.u32(st);
+            respond(c, c.hdr.seq, OP_READ, std::move(body));
+            return;
         }
+        segs.emplace_back(static_cast<const uint8_t*>(b->loc.ptr),
+                          size_t(block_size));
+        refs.push_back(std::move(b));  // pin until sent
     }
     w.u32(OK);
     w.u32(uint32_t(keys.size()));
@@ -1369,12 +1442,9 @@ void Server::op_commit(Conn& c) {
         return;
     }
     uint32_t committed = 0;
-    {
-        std::lock_guard<std::mutex> lk(store_mu_);
-        for (uint32_t i = 0; i < n && r.ok(); ++i) {
-            uint64_t tok = r.u64();
-            if (index_->commit(tok, c.id) == OK) committed++;
-        }
+    for (uint32_t i = 0; i < n && r.ok(); ++i) {
+        uint64_t tok = r.u64();
+        if (index_->commit(tok, c.id) == OK) committed++;
     }
     w.u32(r.ok() ? OK : BAD_REQUEST);
     w.u32(committed);
@@ -1391,12 +1461,9 @@ void Server::op_abort(Conn& c) {
         respond(c, c.hdr.seq, OP_ABORT, std::move(body));
         return;
     }
-    {
-        std::lock_guard<std::mutex> lk(store_mu_);
-        for (uint32_t i = 0; i < n && r.ok(); ++i) {
-            uint64_t tok = r.u64();
-            index_->abort(tok, c.id);
-        }
+    for (uint32_t i = 0; i < n && r.ok(); ++i) {
+        uint64_t tok = r.u64();
+        index_->abort(tok, c.id);
     }
     w.u32(r.ok() ? OK : BAD_REQUEST);
     respond(c, c.hdr.seq, OP_ABORT, std::move(body));
@@ -1413,73 +1480,76 @@ void Server::op_pin(Conn& c) {
         respond(c, c.hdr.seq, OP_PIN, std::move(body));
         return;
     }
+    // Backpressure, mirroring op_read: bound the bytes a connection can
+    // hold pinned via leases. Metadata pre-pass so an over-cap pin is
+    // refused before paying disk promotion; a single over-cap pin
+    // against zero held leases is admitted (progress guarantee).
+    uint64_t planned = 0;
+    for (auto& k : keys) {
+        uint32_t sz = 0;
+        if (!index_->peek_committed(k, &sz)) {
+            w.u32(KEY_NOT_FOUND);
+            respond(c, c.hdr.seq, OP_PIN, std::move(body));
+            return;
+        }
+        planned += sz;
+    }
+    if (c.lease_bytes > 0 &&
+        c.lease_bytes + planned > cfg_.max_outq_bytes) {
+        pins_busy_.fetch_add(1, std::memory_order_relaxed);
+        w.u32(BUSY);
+        respond(c, c.hdr.seq, OP_PIN, std::move(body));
+        return;
+    }
     std::vector<BlockRef> refs;
     std::vector<RemoteBlock> blocks;
-    {
-        std::lock_guard<std::mutex> lk(store_mu_);
-        // Backpressure, mirroring op_read: bound the bytes a connection
-        // can hold pinned via leases. Metadata pre-pass so an over-cap
-        // pin is refused before paying disk promotion; a single over-cap
-        // pin against zero held leases is admitted (progress guarantee).
-        uint64_t planned = 0;
-        for (auto& k : keys) {
-            const Entry* meta = index_->get_committed(k);
-            if (meta == nullptr) {
-                w.u32(KEY_NOT_FOUND);
-                respond(c, c.hdr.seq, OP_PIN, std::move(body));
-                return;
-            }
-            planned += meta->size;
-        }
-        if (c.lease_bytes > 0 &&
-            c.lease_bytes + planned > cfg_.max_outq_bytes) {
+    refs.reserve(keys.size());
+    blocks.reserve(keys.size());
+    uint64_t promoted = 0;
+    for (auto& k : keys) {
+        // Bounded promotion slice per request (see kMaxPromotesPerOp),
+        // counting THIS op's promotions (a global-counter delta would
+        // let other workers starve this op — see op_read); failed
+        // promotion is a retryable status, not KEY_NOT_FOUND.
+        BlockRef bref;
+        uint32_t sz = 0;
+        bool did_promote = false;
+        Status st = index_->acquire_block(k, promoted < kMaxPromotesPerOp,
+                                          &bref, &sz, &did_promote);
+        if (did_promote) promoted++;
+        if (st == BUSY) {
             pins_busy_.fetch_add(1, std::memory_order_relaxed);
             w.u32(BUSY);
             respond(c, c.hdr.seq, OP_PIN, std::move(body));
             return;
         }
-        uint64_t p0 = index_->promotes();
-        for (auto& k : keys) {
-            // Bounded promotion slice per request (see kMaxPromotesPerOp).
-            if (index_->promotes() - p0 >= kMaxPromotesPerOp) {
-                const Entry* meta = index_->get_committed(k);
-                if (meta != nullptr && meta->block == nullptr) {
-                    pins_busy_.fetch_add(1, std::memory_order_relaxed);
-                    w.u32(BUSY);
-                    respond(c, c.hdr.seq, OP_PIN, std::move(body));
-                    return;
-                }
-            }
-            // get_resident promotes spilled entries back into the pool;
-            // failed promotion is a retryable status, not KEY_NOT_FOUND.
-            const Entry* e = nullptr;
-            Status st = index_->get_resident(k, &e);
-            if (st != OK) {
-                w.u32(st);
-                respond(c, c.hdr.seq, OP_PIN, std::move(body));
-                return;
-            }
-            RemoteBlock b;
-            b.status = OK;
-            b.pool_idx = e->block->loc.pool_idx;
-            b.token = 0;
-            b.offset = e->block->loc.offset;
-            b.size = e->size;
-            blocks.push_back(b);
-            refs.push_back(e->block);
+        if (st != OK) {
+            w.u32(st);
+            respond(c, c.hdr.seq, OP_PIN, std::move(body));
+            return;
         }
-        uint64_t lease = index_->pin(std::move(refs));
-        c.open_leases[lease] = planned;
-        c.lease_bytes += planned;
-        lease_total_.fetch_add(planned, std::memory_order_relaxed);
-        w.u32(OK);
-        w.u64(lease);
-        w.u32(uint32_t(blocks.size()));
-        w.bytes(blocks.data(), blocks.size() * sizeof(RemoteBlock));
-        // Trailing store epoch (older readers stop before it): lets the
-        // client cache these locations for future zero-RTT reads.
-        w.u64(index_->epoch());
+        RemoteBlock b;
+        b.status = OK;
+        b.pool_idx = bref->loc.pool_idx;
+        b.token = 0;
+        b.offset = bref->loc.offset;
+        b.size = sz;
+        blocks.push_back(b);
+        refs.push_back(std::move(bref));
     }
+    // The refs were gathered under their stripe locks (now released);
+    // the pin itself lives under the index's lease mutex.
+    uint64_t lease = index_->pin(std::move(refs));
+    c.open_leases[lease] = planned;
+    c.lease_bytes += planned;
+    lease_total_.fetch_add(planned, std::memory_order_relaxed);
+    w.u32(OK);
+    w.u64(lease);
+    w.u32(uint32_t(blocks.size()));
+    w.bytes(blocks.data(), blocks.size() * sizeof(RemoteBlock));
+    // Trailing store epoch (older readers stop before it): lets the
+    // client cache these locations for future zero-RTT reads.
+    w.u64(index_->epoch());
     respond(c, c.hdr.seq, OP_PIN, std::move(body));
 }
 
@@ -1494,10 +1564,7 @@ void Server::op_release(Conn& c) {
     auto lit = c.open_leases.find(lease);
     bool ok = false;
     if (lit != c.open_leases.end()) {
-        {
-            std::lock_guard<std::mutex> lk(store_mu_);
-            ok = index_->release(lease);
-        }
+        ok = index_->release(lease);
         c.lease_bytes -= lit->second;
         lease_total_.fetch_sub(lit->second, std::memory_order_relaxed);
         c.open_leases.erase(lit);
@@ -1511,11 +1578,7 @@ void Server::op_check_exist(Conn& c) {
     std::string key = r.str();
     std::vector<uint8_t> body;
     BufWriter w(body);
-    bool exists;
-    {
-        std::lock_guard<std::mutex> lk(store_mu_);
-        exists = r.ok() && index_->check_exist(key);
-    }
+    bool exists = r.ok() && index_->check_exist(key);
     w.u32(exists ? OK : KEY_NOT_FOUND);
     respond(c, c.hdr.seq, OP_CHECK_EXIST, std::move(body));
 }
@@ -1530,7 +1593,6 @@ void Server::op_match(Conn& c) {
         w.u32(BAD_REQUEST);
         w.i32(-1);
     } else {
-        std::lock_guard<std::mutex> lk(store_mu_);
         w.u32(OK);
         w.i32(index_->match_last_index(keys));
     }
@@ -1542,19 +1604,16 @@ void Server::op_simple(Conn& c) {
     BufWriter w(body);
     switch (c.hdr.op) {
         case OP_SYNC:
-            // The loop is serial per connection: by the time SYNC is
-            // handled, every earlier op on this connection has been applied
-            // (and, because writes commit before their ack, is visible to
-            // all connections). Reference analogue: sync_stream remain
-            // count polling (infinistore.cpp:1070-1075).
+            // The owning worker is serial per connection: by the time
+            // SYNC is handled, every earlier op on this connection has
+            // been applied (and, because writes commit under their stripe
+            // lock before their ack, is visible to every worker's
+            // connections). Reference analogue: sync_stream remain count
+            // polling (infinistore.cpp:1070-1075).
             w.u32(OK);
             break;
         case OP_PURGE: {
-            size_t n;
-            {
-                std::lock_guard<std::mutex> lk(store_mu_);
-                n = index_->purge();
-            }
+            size_t n = index_->purge();
             w.u32(OK);
             w.u64(n);
             break;
@@ -1572,7 +1631,6 @@ void Server::op_simple(Conn& c) {
             r.keys(&keys);
             size_t n = 0;
             if (r.ok()) {
-                std::lock_guard<std::mutex> lk(store_mu_);
                 n = c.hdr.op == OP_DELETE ? index_->erase(keys)
                                           : index_->reclaim_orphans(keys);
             }

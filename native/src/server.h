@@ -7,11 +7,21 @@
 // channel polled on the same loop for the RDMA path (:1040-1046) and (b) a
 // CUDA-IPC + cudaMemcpyAsync worker for the same-host GPU path (:570-804).
 //
-// TPU-native design: one epoll loop on a dedicated thread serves both data
-// paths —
+// TPU-native design — MULTI-WORKER data plane (deviation from the
+// reference's single uvloop; see docs/design.md "Threading model" and
+// PARITY.md): N epoll worker loops on dedicated threads serve both data
+// paths. Worker 0 owns the listen socket and assigns each accepted
+// connection to the least-loaded worker; a connection then lives its
+// whole life on that worker, so per-connection parsing stays serial (the
+// property every ack/ordering guarantee below relies on) while different
+// connections' socket↔pool byte movement runs in parallel across cores.
+// Shared state is thread-safe underneath: the KV index is lock-striped
+// (kv_index.h), the pool allocator is arena-sharded (mempool.h), and the
+// disk tier locks internally. workers=1 (the default) degrades to exactly
+// the historical single-loop behavior.
 //   - STREAM path (DCN stand-in for RDMA): OP_WRITE payload bytes are
-//     scattered by the loop directly from the socket into pool blocks
-//     (no staging buffer), and OP_READ responses are gathered with
+//     scattered by the owning worker directly from the socket into pool
+//     blocks (no staging buffer), and OP_READ responses are gathered with
 //     writev straight out of pool blocks, with BlockRefs held by the send
 //     queue until the bytes are on the wire — the moral equivalent of the
 //     reference pinning blocks in wr_id during server-push RDMA WRITE
@@ -20,16 +30,16 @@
 //     memory and copy one-sided; the server only runs the
 //     allocate → (client memcpy) → commit visibility protocol and the
 //     pin/release lease protocol for reads.
-// The loop never blocks on bulk data for the SHM path, so the per-layer
+// The workers never block on bulk data for the SHM path, so the per-layer
 // overlap property (design.rst:56-59) is preserved: clients stream layer k
 // while computing layer k+1.
 //
 // Commit-race fix: the reference documents a cross-connection race where a
 // client counts a write complete when the commit message is *posted*, not
 // applied (libinfinistore.cpp:403-410). Here a write/commit is acked only
-// after the loop has applied it, and the loop linearizes all connections,
-// so a reader that starts after a writer's ack always sees the committed
-// entry.
+// after the owning worker has applied it under the key's stripe lock, so
+// a reader that starts after a writer's ack always observes the committed
+// entry (the stripe mutex orders the commit before the read).
 #pragma once
 
 #include <atomic>
@@ -73,6 +83,11 @@ struct ServerConfig {
     // push path with signal/32, window 4096 WRs
     // (libinfinistore.cpp:898-987); this is the byte-denominated analogue.
     uint64_t max_outq_bytes = 64ull << 20;
+    // Data-plane worker loops. 1 (default) = the historical single epoll
+    // loop, byte-compatible with every prior client. 0 = auto-size to
+    // min(4, cores - 2), floored at 1. The ISTPU_SERVER_WORKERS env var
+    // overrides whatever is configured here (operator escape hatch).
+    uint32_t workers = 1;
 };
 
 class Server {
@@ -80,7 +95,7 @@ class Server {
     explicit Server(const ServerConfig& cfg);
     ~Server();
 
-    // Binds + spawns the loop thread. Returns false on bind failure.
+    // Binds + spawns the worker threads. Returns false on bind failure.
     bool start();
     void stop();
 
@@ -100,6 +115,7 @@ class Server {
 
     uint16_t bound_port() const { return bound_port_; }
     const std::string& shm_prefix() const { return cfg_.shm_prefix; }
+    uint32_t workers() const { return uint32_t(workers_.size()); }
 
    private:
     enum class RState { HDR, BODY, PAYLOAD, DRAIN };
@@ -115,9 +131,12 @@ class Server {
         size_t total = 0;  // meta + payload bytes, for outq accounting
     };
 
+    struct Worker;
+
     struct Conn {
         int fd = -1;
         uint64_t id = 0;  // unique per accepted connection; owns its tokens
+        Worker* w = nullptr;  // owning worker (fixed for the conn's life)
         uint64_t outq_bytes = 0;  // bytes queued in outq (backpressure cap)
         RState state = RState::HDR;
         WireHeader hdr{};
@@ -160,7 +179,11 @@ class Server {
         // client-side, so the wire never carries offsets a client could
         // forge); unconsumed blocks return to the pool on
         // OP_LEASE_REVOKE or when the connection dies — exactly the
-        // uncommitted-alloc cleanup contract.
+        // uncommitted-alloc cleanup contract. Lease state is CONNECTION-
+        // local (never shared across workers): a client's second
+        // connection, even when assigned to a different worker, can
+        // neither commit into nor revoke this lease, and reclaim on
+        // death runs on the owning worker against the thread-safe pool.
         struct LeaseRun {
             uint32_t pool_idx;
             uint64_t offset;   // bytes from the pool base
@@ -175,12 +198,27 @@ class Server {
         std::unordered_map<uint64_t, BlockLease> block_leases;
     };
 
-    void loop();
-    void accept_ready();
+    // One epoll loop + thread. Connections are owned by exactly one
+    // worker; the only cross-thread touch is the acceptor's handoff
+    // through pending (mutex + eventfd wake).
+    struct Worker {
+        int idx = 0;
+        int epoll_fd = -1;
+        int wake_fd = -1;
+        std::thread thread;
+        std::unordered_map<int, std::unique_ptr<Conn>> conns;  // loop only
+        std::mutex pending_mu;
+        std::vector<std::unique_ptr<Conn>> pending;  // acceptor → worker
+        std::atomic<uint32_t> nconns{0};  // load metric for assignment
+    };
+
+    void loop(Worker& w);
+    void adopt_pending(Worker& w);
+    void accept_ready();  // worker 0 only
     void conn_readable(Conn& c);
     void conn_writable(Conn& c);
     bool flush_out(Conn& c);  // false => fatal error, close
-    void close_conn(int fd);
+    void close_conn(Worker& w, int fd);
     void handle_message(Conn& c);  // full header+body (non-WRITE) received
     void finish_write(Conn& c);    // WRITE/PUT payload fully scattered
     void begin_put(Conn& c);       // parse OP_PUT body, build scatter plan
@@ -191,10 +229,12 @@ class Server {
                  std::vector<std::pair<const uint8_t*, size_t>> segs = {},
                  std::vector<BlockRef> refs = {});
 
-    // Return a lease's unconsumed blocks to the pool (store_mu_ held).
+    // Return a lease's unconsumed blocks to the pool (pool locks only —
+    // MM is thread-safe).
     uint64_t free_lease_remainder(Conn::BlockLease& l);
 
-    // op handlers (body parsed under store_mu_)
+    // op handlers — shared store access goes through the internally
+    // locked KVIndex/MM; no server-level store mutex on the data plane.
     void op_hello(Conn& c);
     void op_allocate(Conn& c);
     void op_lease(Conn& c);
@@ -212,16 +252,14 @@ class Server {
     ServerConfig cfg_;
     uint16_t bound_port_ = 0;
     int listen_fd_ = -1;
-    int epoll_fd_ = -1;
-    int wake_fd_ = -1;
-    std::thread thread_;
     std::atomic<bool> running_{false};
+    std::vector<std::unique_ptr<Worker>> workers_;
 
-    // store_mu_ guards mm_/index_ so the Python control plane can call in
-    // from other threads; the loop takes it per message (the reference
-    // instead funnels everything through one uvloop thread,
-    // infinistore.cpp:1 comment — with a 1-core host the mutex costs
-    // nothing and removes the shared-loop coupling).
+    // store_mu_ guards the LIFETIME of mm_/index_/disk_ for control-plane
+    // entry points (kvmap_len / purge / stats / snapshot / restore) racing
+    // stop(); the data-plane workers never take it — they are joined
+    // before teardown, and all shared-store mutation is synchronized
+    // inside KVIndex (stripe locks) and MM (arena locks).
     std::mutex store_mu_;
     // Serializes snapshot() calls against each other (two writers would
     // corrupt the tmp file) and against stop() (a snapshot in flight
@@ -243,7 +281,6 @@ class Server {
         return reinterpret_cast<std::atomic<uint64_t>*>(&ctl_->epoch);
     }
 
-    std::unordered_map<int, std::unique_ptr<Conn>> conns_;
     std::atomic<uint64_t> n_conns_{0};  // stats-safe connection count
 
     // stats
@@ -256,7 +293,7 @@ class Server {
     void account_op(uint8_t op, long long us);
     uint64_t op_percentile_us(int op, double q) const;
     std::atomic<uint64_t> ops_{0}, bytes_in_{0}, bytes_out_{0};
-    uint64_t next_conn_id_ = 1;  // loop thread only
+    std::atomic<uint64_t> next_conn_id_{1};
     // Aggregate outq bytes across connections + reads refused for
     // backpressure; atomics so stats_json (control-plane thread) can read.
     std::atomic<uint64_t> outq_total_{0};
@@ -269,7 +306,7 @@ class Server {
     std::atomic<uint64_t> lease_blocks_out_{0};
     std::atomic<uint64_t> leases_oom_{0};
     std::atomic<uint64_t> leases_busy_{0};
-    uint64_t next_block_lease_ = 1;  // loop thread only
+    std::atomic<uint64_t> next_block_lease_{1};
     std::atomic<uint64_t> op_count_[kMaxOp] = {};
     std::atomic<uint64_t> op_us_[kMaxOp] = {};
     std::atomic<uint64_t> op_hist_[kMaxOp][kNumBuckets] = {};

@@ -3,7 +3,46 @@
 # Builds the native library and runs the full hardware-free suite —
 # loopback servers on ephemeral ports, both data paths, and jax pinned
 # to a virtual 8-device CPU mesh by tests/conftest.py.
+#
+# ISTPU_TSAN=1 switches to the ThreadSanitizer mode: the native core is
+# rebuilt with -fsanitize=thread (make -C native tsan) and the
+# concurrency smoke suite — the densest multi-worker/client
+# interleavings in the repo — runs against that library with the TSAN
+# runtime preloaded (the Python binary is uninstrumented, so the
+# runtime must initialize before dlopen). Pass extra pytest args/paths
+# to widen the sanitized selection; native/run_sanitizers.sh remains
+# the full TSAN+ASAN sweep.
 set -e
 cd "$(dirname "$0")"
+
+if [ "${ISTPU_TSAN:-0}" = "1" ]; then
+    make -C native tsan
+    TSAN_RT="$(gcc -print-file-name=libtsan.so)"
+    for cand in "$TSAN_RT" \
+        "$(gcc -print-file-name=libtsan.so.2)" \
+        "$(gcc -print-file-name=libtsan.so.0)" \
+        /lib/x86_64-linux-gnu/libtsan.so.2 \
+        /lib/x86_64-linux-gnu/libtsan.so.0; do
+        if [ -f "$cand" ]; then
+            TSAN_RT="$cand"
+            break
+        fi
+    done
+    [ -f "$TSAN_RT" ] || { echo "libtsan runtime not found" >&2; exit 1; }
+    SMOKE="${ISTPU_TSAN_TESTS:-tests/test_concurrency.py}"
+    # detect_deadlocks=0: TSAN's lock-order detector keeps a 64-entry
+    # held-locks table per thread and CHECK-fails (FATAL) on the index's
+    # cross-stripe ops, which legitimately hold 16 ordered stripe locks
+    # at once alongside CPython's own mutexes. Ordering safety is by
+    # construction (stripes in index order, try-locks on the reverse
+    # path — kv_index.h); the RACE detector stays fully on.
+    exec env \
+        LD_PRELOAD="$TSAN_RT" \
+        TSAN_OPTIONS="halt_on_error=0 exitcode=66 detect_deadlocks=0 suppressions=$PWD/native/tsan.supp" \
+        INFINISTORE_TPU_NATIVE_LIB="$PWD/native/build/libinfinistore_tpu_tsan.so" \
+        JAX_PLATFORMS=cpu \
+        python -m pytest $SMOKE -q "$@"
+fi
+
 make -C native
 exec python -m pytest tests/ -q "$@"

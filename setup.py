@@ -6,6 +6,7 @@ script (:68-71). Here the native library is built by `make -C native` into
 infinistore_tpu/_native/ and shipped as package data.
 """
 
+import os
 import subprocess
 from pathlib import Path
 
@@ -29,6 +30,14 @@ class BuildWithNative(build_py):
     def run(self):
         native = Path(__file__).parent / "native"
         subprocess.run(["make", "-C", str(native)], check=True)
+        if os.environ.get("ISTPU_TSAN") == "1":
+            # Developer convenience: also produce the ThreadSanitizer
+            # build (native/build/libinfinistore_tpu_tsan.so, loaded via
+            # INFINISTORE_TPU_NATIVE_LIB — see run_test.sh). The wheel
+            # still ships only the regular library: package_data globs
+            # infinistore_tpu/_native/*.so and the sanitizer .so lives
+            # outside the package tree by design.
+            subprocess.run(["make", "-C", str(native), "tsan"], check=True)
         super().run()
 
 

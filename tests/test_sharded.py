@@ -658,3 +658,86 @@ def test_startup_all_dead_refuses():
     with pytest.raises(Exception):
         conn.connect()
     assert not conn.connected
+
+
+# ---------------------------------------------------------------------------
+# io_threads: client-side concurrency knob for multi-worker servers
+# ---------------------------------------------------------------------------
+
+
+def test_io_threads_default_one_per_shard(sconn):
+    """Historical default against workers=1 servers: one fan-out thread
+    per shard, no sub-call splitting."""
+    assert sconn._io == sconn.n
+    pairs = [(f"k{i}", 0) for i in range(16)]
+    assert sconn._read_chunks(pairs) == [pairs]
+
+
+def test_io_threads_explicit_splits_reads(shard_servers, rng):
+    """io_threads > n_shards: batched reads fan each shard's partition
+    into concurrent sub-calls, and the data still round-trips intact."""
+    conn = ShardedConnection(
+        [ClientConfig(host_addr="127.0.0.1", service_port=s.service_port)
+         for s in shard_servers],
+        io_threads=9,
+    )
+    conn.connect()
+    try:
+        assert conn._io == 9
+        chunks = conn._read_chunks([(f"k{i}", 0) for i in range(30)])
+        assert len(chunks) == 3  # 9 threads / 3 shards
+        assert sum(len(ch) for ch in chunks) == 30
+        page = 1024
+        n = 48
+        src = rng.random(page * n).astype(np.float32)
+        keys = [key() for _ in range(n)]
+        offsets = [i * page for i in range(n)]
+        conn.put(src, list(zip(keys, offsets)), page)
+        conn.sync()
+        dst = np.zeros_like(src)
+        conn.read_cache(dst, list(zip(keys, offsets)), page)
+        conn.sync()
+        assert np.array_equal(src, dst)
+    finally:
+        conn.close()
+
+
+def test_io_threads_auto_upgrades_on_multiworker_server(rng, monkeypatch):
+    """Auto mode (io_threads=None) reads the server's worker count from
+    stats and doubles the per-shard thread budget when workers > 1 —
+    one client thread per shard cannot saturate a multi-worker server.
+    The upgrade is gated on spare cores; pin cpu_count above n_shards
+    so the test is host-independent."""
+    import infinistore_tpu.sharded as sharded_mod
+
+    monkeypatch.setattr(sharded_mod.os, "cpu_count", lambda: 8)
+    servers = []
+    for _ in range(2):
+        s = InfiniStoreServer(
+            ServerConfig(service_port=0, prealloc_size=0.03125,
+                         minimal_allocate_size=16, workers=2)
+        )
+        s.start()
+        servers.append(s)
+    conn = ShardedConnection(
+        [ClientConfig(host_addr="127.0.0.1", service_port=s.service_port)
+         for s in servers]
+    )
+    conn.connect()
+    try:
+        assert conn._io == 2 * conn.n
+        page = 512
+        src = rng.random(page * 8).astype(np.float32)
+        keys = [key() for _ in range(8)]
+        conn.put(src, [(k, i * page) for i, k in enumerate(keys)], page)
+        conn.sync()
+        dst = np.zeros_like(src)
+        conn.read_cache(
+            dst, [(k, i * page) for i, k in enumerate(keys)], page
+        )
+        conn.sync()
+        assert np.array_equal(src, dst)
+    finally:
+        conn.close()
+        for s in servers:
+            s.stop()
