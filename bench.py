@@ -220,6 +220,116 @@ def bench_lease_ab(port, nkeys=4096, block_kb=4, batch=256):
     return out
 
 
+def bench_evict(nkeys=None, block_kb=4, batch=16):
+    """Eviction-pressure leg (ISSUE 3 exit criterion): put latency with
+    a working set 2x the pool, versus the same puts with no pressure.
+
+    Before the background reclaim pipeline, every put past pool
+    capacity paid eviction INLINE on the allocation path (one global
+    LRU walk + the spill/evict work, under the put's stripe lock);
+    with the watermark reclaimer the put path normally just finds free
+    blocks the reclaimer freed ahead of it, and only the counted
+    "hard stalls" still pay inline. Emits:
+      evict_put_p50_us        per-op put p50 under pressure
+                              (steady state: pool already full)
+      evict_nopress_put_p50_us  the same call shape, pool 2x the set
+      evict_put_p50_ratio     pressure / no-pressure
+      evict_hard_stalls       inline-reclaim count from server stats
+      evict_reclaim_runs      background reclaim passes
+    Small batches (16 x 4 KB per put_cache+sync) keep the metric
+    latency-shaped — the serving engine's page-append call shape —
+    rather than throughput-shaped."""
+    import os
+
+    import numpy as np
+
+    from infinistore_tpu import (
+        ClientConfig,
+        InfiniStoreServer,
+        InfinityConnection,
+        ServerConfig,
+    )
+
+    if nkeys is None:
+        nkeys = int(os.environ.get("ISTPU_EVICT_KEYS", "2048"))
+    block_bytes = block_kb << 10
+    ws_bytes = nkeys * block_bytes  # working set
+
+    # Measure the SAME batch indices on both legs (the tail past the
+    # pressure leg's pool-filling prefix) so the ratio compares
+    # identical call shapes, with reclaim the only difference.
+    measured_from = (nkeys // 2) // batch + 1
+
+    def run_leg(pool_bytes, eviction, passes=2):
+        srv = InfiniStoreServer(
+            ServerConfig(
+                service_port=0,
+                prealloc_size=pool_bytes / (1 << 30),
+                minimal_allocate_size=block_kb,
+                enable_eviction=eviction,
+            )
+        )
+        port = srv.start()
+        try:
+            conn = InfinityConnection(
+                ClientConfig(
+                    host_addr="127.0.0.1", service_port=port,
+                    connection_type="SHM",
+                )
+            )
+            conn.connect()
+            try:
+                src = np.random.default_rng(3).integers(
+                    0, 255, batch * block_bytes, dtype=np.uint8
+                )
+                # Best-of-passes p50: the CI container's background
+                # daemons add ~2x run-to-run noise that would otherwise
+                # swamp the pressure/no-pressure ratio.
+                p50 = None
+                for it in range(passes):
+                    if it:
+                        conn.purge()
+                    lats = []
+                    for i, s in enumerate(range(0, nkeys, batch)):
+                        pairs = [
+                            (f"evb{it}_{s + j}", j * block_bytes)
+                            for j in range(min(batch, nkeys - s))
+                        ]
+                        t0 = time.perf_counter()
+                        conn.put_cache(src, pairs, block_bytes)
+                        conn.sync()
+                        t = time.perf_counter() - t0
+                        # Steady state only: the pool-filling prefix
+                        # pays no reclaim on either leg and would
+                        # dilute the p50.
+                        if i >= measured_from:
+                            lats.append(t)
+                    p = float(np.percentile(np.array(lats) * 1e6, 50))
+                    p50 = p if p50 is None else min(p50, p)
+                return p50, srv.stats()
+            finally:
+                conn.close()
+        finally:
+            srv.stop()
+
+    # No-pressure: pool comfortably holds the whole working set.
+    nopress_p50, _ = run_leg(2 * ws_bytes, eviction=False)
+    # Pressure: working set 2x the pool, eviction + watermark reclaim on.
+    press_p50, stats = run_leg(ws_bytes // 2, eviction=True)
+    return {
+        "evict_nkeys": nkeys,
+        "evict_block_kb": block_kb,
+        "evict_batch": batch,
+        "evict_put_p50_us": round(press_p50, 1),
+        "evict_nopress_put_p50_us": round(nopress_p50, 1),
+        "evict_put_p50_ratio": round(press_p50 / nopress_p50, 2)
+        if nopress_p50 else 0.0,
+        "evict_hard_stalls": int(stats.get("hard_stalls", 0)),
+        "evict_reclaim_runs": int(stats.get("reclaim_runs", 0)),
+        "hard_stalls": int(stats.get("hard_stalls", 0)),
+    }
+
+
 def bench_sharded(n_shards=4, nkeys=4096, block_kb=4, workers=1,
                   io_threads=None, passes=2):
     """Sharded-store leg (BASELINE config 5 scaled to one host): the same
@@ -747,22 +857,86 @@ def _median(xs):
 
 _PROBE_CACHE = None
 
+# Cross-RUN probe-failure cache (BENCH_r05 satellite): a wedged tunnel
+# fails the probe identically run after run, and each run burned the
+# full probe timeout (180 s in r05) rediscovering it. A failed probe's
+# result is persisted here; the next run within the TTL skips the probe
+# subprocess entirely, marks the device legs skipped from the cached
+# diagnosis, and stamps probe_skip_cached: true in the artifact. A
+# SUCCESSFUL probe deletes the cache, so a healed tunnel re-probes at
+# most TTL seconds late. ISTPU_PROBE_FORCE=1 bypasses the cache;
+# ISTPU_PROBE_CACHE_TTL (seconds, default 6 h) bounds its age.
+_PROBE_CACHE_FILE = ".probe_cache.json"
+
+
+def _probe_cache_path():
+    import os
+
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        _PROBE_CACHE_FILE)
+
+
+def _probe_failed(res):
+    # A REAL failed outcome only: a budget-skipped probe (probe_skipped
+    # marker, no outcome at all) is not a diagnosis and must neither be
+    # cached nor clear an existing cache.
+    return bool(res.get("probe_error")) or res.get("probe_ok") is False
+
+
+def _load_cached_probe_failure():
+    import os
+
+    if os.environ.get("ISTPU_PROBE_FORCE", "0") == "1":
+        return None
+    ttl = float(os.environ.get("ISTPU_PROBE_CACHE_TTL", "21600"))
+    try:
+        with open(_probe_cache_path()) as f:
+            cached = json.load(f)
+        if time.time() - float(cached.get("ts", 0)) > ttl:
+            return None
+        res = cached.get("result")
+        return res if isinstance(res, dict) and _probe_failed(res) else None
+    except (OSError, ValueError):
+        return None
+
+
+def _store_probe_result(res):
+    import os
+
+    path = _probe_cache_path()
+    try:
+        if _probe_failed(res):
+            with open(path, "w") as f:
+                json.dump({"ts": time.time(), "result": res}, f)
+        elif res.get("probe_ok") and os.path.exists(path):
+            os.remove(path)  # healed tunnel: forget the failure
+    except OSError:
+        pass  # best-effort: a read-only checkout just re-probes
+
 
 def run_probe_once(runner):
-    """Device-probe leg, at most ONCE per run. BENCH_r05's wedged probe
-    burned its whole 180 s cap and the error then stamped the artifact
-    repeatedly; now the result is cached for every later consumer, the
-    cap honors ISTPU_PROBE_TIMEOUT (default 60 s — a healthy probe
-    finishes in single-digit seconds, so a wedged tunnel should cost
-    one minute of budget, not three), and the full error text appears
-    exactly once (per-leg skip markers reference it instead of
-    duplicating it)."""
+    """Device-probe leg, at most ONCE per run — and at most once per
+    cache TTL across runs when it FAILS. BENCH_r05's wedged probe burned
+    its whole 180 s cap (and the error then stamped the artifact
+    repeatedly); now the result is cached for every later consumer
+    in-run, a cached cross-run failure skips the subprocess entirely
+    (probe_skip_cached: true), the cap honors ISTPU_PROBE_TIMEOUT
+    (default 60 s — a healthy probe finishes in single-digit seconds),
+    and the full error text appears exactly once (per-leg skip markers
+    reference it instead of duplicating it)."""
     global _PROBE_CACHE
     if _PROBE_CACHE is None:
         import os
 
+        cached = _load_cached_probe_failure()
+        if cached is not None:
+            cached = dict(cached)
+            cached["probe_skip_cached"] = True
+            _PROBE_CACHE = cached
+            return _PROBE_CACHE
         cap = float(os.environ.get("ISTPU_PROBE_TIMEOUT", "60"))
         _PROBE_CACHE = runner("--probe-leg", "probe_error", cap)
+        _store_probe_result(_PROBE_CACHE)
     return _PROBE_CACHE
 
 
@@ -1989,6 +2163,14 @@ def main():
         except Exception as e:
             print(json.dumps({"sched_error": str(e)[:200]}))
         return 0
+    if "--evict-leg" in sys.argv:
+        # Boots its own two servers (pressure / no-pressure); the port
+        # argument other legs carry is accepted but unused.
+        try:
+            print(json.dumps(bench_evict()))
+        except Exception as e:
+            print(json.dumps({"evict_error": str(e)[:200]}))
+        return 0
 
     import os
 
@@ -2119,6 +2301,14 @@ def main():
             out.update(bench_sharded())
         except Exception as e:
             out["sharded_error"] = str(e)[:200]
+        publish()
+        # Eviction-pressure leg (ISSUE 3 exit criterion): put p50 with a
+        # working set 2x the pool vs no pressure. CPU-only, boots its
+        # own small servers; cheap enough to run inline.
+        try:
+            out.update(bench_evict())
+        except Exception as e:
+            out["evict_error"] = str(e)[:200]
         publish()
         # Worker-scaling leg (ISSUE 2 acceptance): stream + sharded
         # shapes at server workers=1/2/4. CPU-only and inline, but

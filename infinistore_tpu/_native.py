@@ -84,7 +84,7 @@ def _decls(lib):
             c.c_void_p,
             [c.c_char_p, c.c_uint16, c.c_uint64, c.c_uint64, c.c_int,
              c.c_uint64, c.c_int, c.c_char_p, c.c_int, c.c_char_p,
-             c.c_uint64, c.c_uint64, c.c_uint32],
+             c.c_uint64, c.c_uint64, c.c_uint32, c.c_double, c.c_double],
         ),
         ("ist_server_start", c.c_int, [c.c_void_p]),
         ("ist_server_stop", None, [c.c_void_p]),
@@ -220,18 +220,19 @@ def _decls(lib):
         ("ist_mm_num_pools", c.c_uint64, [c.c_void_p]),
     ]
     # ABI probe FIRST: a stale prebuilt library would misparse the
-    # v4 ist_server_create argument list (multi-worker knob) or the v3
-    # ist_conn_create lease knobs, or lack those entry points entirely.
-    # A missing or old-version symbol fails loudly here instead.
+    # v5 ist_server_create argument list (reclaim watermarks), the v4
+    # multi-worker knob or the v3 ist_conn_create lease knobs, or lack
+    # those entry points entirely. A missing or old-version symbol
+    # fails loudly here instead.
     try:
         lib.ist_abi_version.restype = ct.c_uint32
         lib.ist_abi_version.argtypes = []
         ver = int(lib.ist_abi_version())
     except AttributeError:
         ver = 1
-    if ver < 4:
+    if ver < 5:
         raise RuntimeError(
-            f"stale native library at {_LIB_PATH} (ABI v{ver} < v4): "
+            f"stale native library at {_LIB_PATH} (ABI v{ver} < v5): "
             "rebuild with `make -C native` (or delete the .so to let "
             "the import auto-build)"
         )

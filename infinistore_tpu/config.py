@@ -137,6 +137,17 @@ class ServerConfig:
         # min(4, cores - 2). The ISTPU_SERVER_WORKERS env var overrides
         # either setting at server start.
         self.workers = kwargs.get("workers", 1)
+        # Background reclaim watermarks (fractions of pool bytes; see
+        # docs/design.md "Reclaim pipeline"). With eviction and/or the
+        # disk tier enabled, a reclaimer thread wakes when occupancy
+        # crosses reclaim_high and evicts/spills down to reclaim_low in
+        # batches off the hot path; puts then normally find free blocks
+        # without paying reclaim inline (the inline path survives as the
+        # counted last resort — the "hard_stalls" stat). reclaim_high
+        # >= 1.0 (or <= 0) disables the background reclaimer and keeps
+        # the historical inline-only behavior.
+        self.reclaim_high = kwargs.get("reclaim_high", 0.95)
+        self.reclaim_low = kwargs.get("reclaim_low", 0.85)
         # Accepted for reference CLI compatibility; unused on TPU hosts.
         self.dev_name = kwargs.get("dev_name", "")
         self.link_type = kwargs.get("link_type", "")
@@ -180,3 +191,8 @@ class ServerConfig:
             raise Exception("max_outq_size must be positive (MB)")
         if self.workers < 0 or self.workers > 64:
             raise Exception("workers must be in [0, 64] (0 = auto)")
+        if 0.0 < self.reclaim_high < 1.0:
+            if not (0.0 <= self.reclaim_low <= self.reclaim_high):
+                raise Exception(
+                    "reclaim_low must be in [0, reclaim_high]"
+                )

@@ -405,18 +405,25 @@ class InfinityConnection:
                 self._reclaim_orphans(keys)
 
     def _retry_busy(self, attempt):
-        """Run ``attempt(remaining_ms)`` retrying BUSY (server-side
-        backpressure: this connection has too many response bytes queued
-        or lease bytes pinned) with exponential backoff until
-        ``config.timeout_ms`` elapses. The remaining budget is handed to
-        each attempt so native waits never extend the caller's total
-        bound past the configured timeout. Returns the final status."""
+        """Run ``attempt(remaining_ms)`` retrying the read path's two
+        RETRYABLE statuses with exponential backoff until
+        ``config.timeout_ms`` elapses: BUSY (server-side backpressure —
+        this connection has too many response bytes queued or lease
+        bytes pinned) and OUT_OF_MEMORY (disk-tier promotion found no
+        free pool blocks RIGHT NOW — documented retryable, never a data
+        loss; under a saturated pool the background reclaimer / spill
+        writer frees blocks within milliseconds, e.g. when a concurrent
+        spill transiently claimed the space a bounce-swap expected).
+        The remaining budget is handed to each attempt so native waits
+        never extend the caller's total bound past the configured
+        timeout. Returns the final status."""
         deadline = time.monotonic() + self.config.timeout_ms / 1000.0
         delay = 0.001
+        retryable = (_native.BUSY, _native.OUT_OF_MEMORY)
         while True:
             remaining_ms = int(max(1, (deadline - time.monotonic()) * 1000))
             st = attempt(remaining_ms)
-            if st != _native.BUSY or time.monotonic() >= deadline:
+            if st not in retryable or time.monotonic() >= deadline:
                 return st
             time.sleep(delay)
             delay = min(delay * 2, 0.05)
@@ -899,9 +906,12 @@ class InfinityConnection:
         # Deep pipelining is exactly how a healthy client can trip the
         # server's per-connection outq cap, so BUSY here is expected
         # steady-state behavior under load: back off and resubmit until
-        # the timeout rather than failing the read.
+        # the timeout rather than failing the read. OUT_OF_MEMORY is the
+        # read path's other retryable status (disk-tier promotion found
+        # no free pool blocks right now — see _retry_busy).
         deadline = time.monotonic() + self.config.timeout_ms / 1000.0
         delay = 0.001
+        retryable = (_native.BUSY, _native.OUT_OF_MEMORY)
         while True:
             future = loop.create_future()
 
@@ -914,7 +924,8 @@ class InfinityConnection:
             try:
                 return await future
             except InfiniStoreError as e:
-                if e.status != _native.BUSY or time.monotonic() >= deadline:
+                if (e.status not in retryable
+                        or time.monotonic() >= deadline):
                     raise
             await asyncio.sleep(delay)
             delay = min(delay * 2, 0.05)
@@ -1051,7 +1062,9 @@ class InfinityConnection:
 
     def stats(self):
         self._check()
-        buf = ct.create_string_buffer(16384)
+        # 64 KB: per_worker (up to 64 workers) + op_stats must never
+        # truncate into unparseable JSON.
+        buf = ct.create_string_buffer(65536)
         st = self._lib.ist_client_stats(self._h, buf, len(buf))
         if st != OK:
             raise InfiniStoreError(st, "stats failed")

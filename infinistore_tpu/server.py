@@ -56,6 +56,8 @@ class InfiniStoreServer:
             int(cfg.ssd_size * (1 << 30)),
             int(cfg.max_outq_size * (1 << 20)),
             int(cfg.workers),
+            ct.c_double(cfg.reclaim_high),
+            ct.c_double(cfg.reclaim_low),
         )
         port = self._lib.ist_server_start(self._h)
         if port < 0:
@@ -78,7 +80,9 @@ class InfiniStoreServer:
         return int(self._lib.ist_server_purge(self._h))
 
     def stats(self):
-        buf = ct.create_string_buffer(16384)
+        # 64 KB: the per_worker array (up to 64 workers) plus op_stats
+        # must never truncate into unparseable JSON.
+        buf = ct.create_string_buffer(65536)
         self._lib.ist_server_stats(self._h, buf, len(buf))
         return json.loads(buf.value.decode())
 
@@ -156,6 +160,10 @@ def _prometheus_metrics(stats):
         ("disk_bytes", "disk_tier_bytes", "disk spill tier capacity"),
         ("disk_used", "disk_tier_used_bytes", "disk spill tier usage"),
     ]
+    g = g + [
+        ("spill_queue_depth", "spill_queue_depth",
+         "entries queued to the async spill writer"),
+    ]
     c = [
         ("ops", "ops", "requests handled"),
         ("bytes_in", "bytes_in", "payload+metadata bytes received"),
@@ -163,6 +171,12 @@ def _prometheus_metrics(stats):
         ("evictions", "evictions", "entries hard-evicted under pressure"),
         ("spills", "spills", "entries spilled to the disk tier"),
         ("promotes", "promotes", "entries promoted back from disk"),
+        ("reclaim_runs", "reclaim_runs",
+         "background watermark-reclaim passes"),
+        ("hard_stalls", "hard_stalls",
+         "allocations that paid inline reclaim (reclaimer behind)"),
+        ("spills_cancelled", "spills_cancelled",
+         "async spills abandoned (read-cancelled, raced or tier-full)"),
     ]
     lines = []
     for key, name, help_ in g:
@@ -173,6 +187,25 @@ def _prometheus_metrics(stats):
         lines.append(f"# HELP infinistore_{name}_total {help_}")
         lines.append(f"# TYPE infinistore_{name}_total counter")
         lines.append(f"infinistore_{name}_total {stats.get(key, 0)}")
+    # Per-worker breakdown (one contiguous group per metric): load
+    # imbalance — one hot connection pinning one worker — is visible
+    # here instead of hiding in the aggregates.
+    per_worker = stats.get("per_worker", [])
+    pw = [
+        ("connections", "gauge", "open connections owned by the worker"),
+        ("ops", "counter", "requests handled by the worker"),
+        ("bytes_in", "counter", "bytes received by the worker"),
+        ("bytes_out", "counter", "bytes sent by the worker"),
+    ]
+    for key, kind, help_ in pw:
+        suffix = "_total" if kind == "counter" else ""
+        lines.append(f"# HELP infinistore_worker_{key}{suffix} {help_}")
+        lines.append(f"# TYPE infinistore_worker_{key}{suffix} {kind}")
+        for w in per_worker:
+            lines.append(
+                f'infinistore_worker_{key}{suffix}'
+                f'{{worker="{w.get("worker", 0)}"}} {w.get(key, 0)}'
+            )
     # One contiguous group per metric (exposition-format requirement).
     op_stats = stats.get("op_stats", {})
     lines.append("# HELP infinistore_op_count_total per-op request count")
@@ -309,12 +342,20 @@ def parse_args(argv=None):
                         "slow reader; reads past the cap fail with BUSY "
                         "(retryable)")
     p.add_argument("--workers", type=int, default=1,
-                   help="data-plane epoll worker threads; connections are "
-                        "assigned to the least-loaded worker so "
-                        "socket<->pool copies run in parallel across "
+                   help="data-plane epoll worker threads; each worker "
+                        "accepts on its own SO_REUSEPORT socket (kernel "
+                        "load-spreading; least-loaded handoff fallback) "
+                        "so socket<->pool copies run in parallel across "
                         "cores. 1 (default) = the classic single loop, "
                         "0 = auto (min(4, cores-2)); the "
                         "ISTPU_SERVER_WORKERS env var overrides")
+    p.add_argument("--reclaim-high", type=float, default=0.95,
+                   help="pool-occupancy fraction that wakes the "
+                        "background reclaimer (evict/spill off the hot "
+                        "path); >= 1.0 disables it (inline-only reclaim)")
+    p.add_argument("--reclaim-low", type=float, default=0.85,
+                   help="occupancy fraction the background reclaimer "
+                        "drives the pool down to per pass")
     p.add_argument("--warmup", action="store_true",
                    help="run a warmup round-trip after startup")
     p.add_argument("--snapshot-path", default="",
@@ -361,6 +402,8 @@ def main(argv=None):
         ssd_size=args.ssd_size,
         max_outq_size=args.max_outq_size,
         workers=args.workers,
+        reclaim_high=args.reclaim_high,
+        reclaim_low=args.reclaim_low,
     )
     server = InfiniStoreServer(config)
     server.start()

@@ -99,10 +99,11 @@ extern "C" {
 // Bumped whenever the Python<->C contract changes (v2: NUL-form key
 // blobs; v3: lease-mode ist_conn_create signature + lease entry
 // points; v4: multi-worker ist_server_create signature — trailing
-// `workers` argument). _native.py probes this at load so a stale
-// prebuilt library fails loudly instead of feeding unparseable blobs
-// to the server.
-uint32_t ist_abi_version(void) { return 4; }
+// `workers` argument; v5: background-reclaim watermarks — trailing
+// `reclaim_high`/`reclaim_low` doubles on ist_server_create).
+// _native.py probes this at load so a stale prebuilt library fails
+// loudly instead of feeding unparseable blobs to the server.
+uint32_t ist_abi_version(void) { return 5; }
 
 void ist_set_log_level(int level) { set_log_level(level); }
 void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }
@@ -114,7 +115,8 @@ void* ist_server_create(const char* host, uint16_t port,
                         int auto_extend, uint64_t extend_bytes, int enable_shm,
                         const char* shm_prefix, int enable_eviction,
                         const char* ssd_path, uint64_t ssd_bytes,
-                        uint64_t max_outq_bytes, uint32_t workers) {
+                        uint64_t max_outq_bytes, uint32_t workers,
+                        double reclaim_high, double reclaim_low) {
     ServerConfig cfg;
     cfg.host = host ? host : "0.0.0.0";
     cfg.port = port;
@@ -131,6 +133,10 @@ void* ist_server_create(const char* host, uint16_t port,
     // 0 = auto-size (min(4, cores-2)); ISTPU_SERVER_WORKERS still
     // overrides at start() either way.
     cfg.workers = workers;
+    // Background reclaim watermarks; >= 1.0 (or <= 0) disables the
+    // reclaimer thread (inline-only reclaim, the historical behavior).
+    cfg.reclaim_high = reclaim_high;
+    cfg.reclaim_low = reclaim_low;
     return new Server(cfg);
 }
 

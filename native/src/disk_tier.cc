@@ -126,6 +126,31 @@ int64_t DiskTier::store(const void* src, uint32_t size) {
     return off;
 }
 
+int64_t DiskTier::store_batch(const void* src, const uint32_t* sizes,
+                              uint32_t n, int64_t* offs) {
+    if (n == 0) return -1;
+    if (n == 1) {
+        offs[0] = store(src, sizes[0]);
+        return offs[0];
+    }
+    uint64_t total = 0;
+    for (uint32_t i = 0; i < n; ++i) {
+        // Alignment invariant: an unaligned payload anywhere but the
+        // tail would shift every later carve off a block boundary.
+        if (i + 1 < n && sizes[i] % block_size_ != 0) return -1;
+        total += sizes[i];
+    }
+    if (total > UINT32_MAX) return -1;  // store() is u32-sized
+    int64_t base = store(src, uint32_t(total));
+    if (base < 0) return -1;
+    uint64_t run = 0;
+    for (uint32_t i = 0; i < n; ++i) {
+        offs[i] = base + int64_t(run);
+        run += sizes[i];
+    }
+    return base;
+}
+
 bool DiskTier::load(int64_t off, void* dst, uint32_t size) {
     if (fd_ < 0) return false;
     uint8_t* p = static_cast<uint8_t*>(dst);

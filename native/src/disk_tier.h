@@ -41,6 +41,17 @@ class DiskTier {
     // Writes `size` bytes; returns the byte offset of the stored extent,
     // or -1 when the tier is full or the write failed.
     int64_t store(const void* src, uint32_t size);
+    // Batched store for the async spill writer: n back-to-back payloads
+    // read from ONE contiguous source buffer land in a single reserved
+    // extent with ONE pwrite, and offs[i] receives each payload's own
+    // extent offset (independently usable with load()/release() — the
+    // per-payload sub-extents partition the combined one). Every size
+    // except the last MUST be a multiple of the tier block size, so the
+    // carved offsets stay block-aligned; violations (and full/failed
+    // tiers) return -1 with nothing reserved — callers fall back to
+    // per-payload store().
+    int64_t store_batch(const void* src, const uint32_t* sizes, uint32_t n,
+                        int64_t* offs);
     // Reads back a stored extent. False on IO error.
     bool load(int64_t off, void* dst, uint32_t size);
     // Frees a stored extent.

@@ -1,11 +1,26 @@
 #include "kv_index.h"
 
+#include <algorithm>
+#include <chrono>
+#include <cstdlib>
 #include <cstring>
 #include <unordered_set>
 
 #include "log.h"
 
 namespace istpu {
+
+KVIndex::KVIndex(MM* mm, bool eviction, DiskTier* disk,
+                 std::atomic<uint64_t>* epoch)
+    : mm_(mm), eviction_(eviction), disk_(disk), epoch_(epoch) {
+    // ISTPU_EXACT_LRU=1: exact global victim order even under pins
+    // (per-victim eligibility walks) — the escape hatch for tests and
+    // deployments that need the pre-segmentation semantics verbatim.
+    const char* env = getenv("ISTPU_EXACT_LRU");
+    exact_lru_ = env != nullptr && env[0] == '1';
+}
+
+KVIndex::~KVIndex() { stop_background(); }
 
 Status KVIndex::allocate(const std::string& key, uint32_t size,
                          RemoteBlock* out, uint64_t owner) {
@@ -27,11 +42,19 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
     PoolLoc loc;
     bool got = mm_->allocate(size, &loc);
     if (!got && track_lru()) {
-        // Make room from the cold end of the cache (spill to the disk
-        // tier when present, hard-evict otherwise), then retry once.
-        // (Eviction cannot invalidate mit: it only touches committed
-        // entries, and this one is uncommitted and not in the LRU.)
-        if (evict_internal(size, int(si)) > 0) got = mm_->allocate(size, &loc);
+        // LAST-RESORT inline reclaim: the background reclaimer normally
+        // keeps free blocks ahead of the put path (watermark eviction),
+        // so landing here means it could not keep up — count the hard
+        // stall, kick it, and make room synchronously from the cold end
+        // (spill to the disk tier when present, hard-evict otherwise),
+        // then retry once. (Eviction cannot invalidate mit: it only
+        // touches committed entries, and this one is uncommitted and
+        // not in the LRU.)
+        hard_stalls_.fetch_add(1, std::memory_order_relaxed);
+        kick_reclaimer();
+        if (evict_internal(size, int(si), false) > 0) {
+            got = mm_->allocate(size, &loc);
+        }
     }
     if (!got) {
         st.map.erase(mit);
@@ -70,6 +93,9 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
     out->token = token;
     out->offset = loc.offset;
     out->size = size;
+    // Watermark check AFTER a successful allocation: wake the reclaimer
+    // so the NEXT put finds free blocks without ever touching reclaim.
+    maybe_wake_reclaimer();
     return OK;
 }
 
@@ -100,7 +126,7 @@ Status KVIndex::commit(uint64_t token, uint64_t owner) {
     // make someone else's bytes visible under this key).
     if (mit != st.map.end() && mit->second.block == s->block) {
         mit->second.committed = true;
-        lru_touch(mit->second, mit->first);
+        lru_touch(st, mit->second, mit->first);
         rc = OK;
     }
     ifree(st, s);
@@ -143,7 +169,9 @@ bool KVIndex::peek_committed(const std::string& key, uint32_t* size_out) {
     std::lock_guard<std::mutex> lk(st.mu);
     auto it = st.map.find(key);
     if (it == st.map.end() || !it->second.committed) return false;
-    lru_touch(it->second, it->first);  // reads refresh recency
+    // Reads refresh recency (and cancel an in-flight spill — the touch
+    // proves the entry hot, so the writer abandons it at completion).
+    lru_touch(st, it->second, it->first);
     if (size_out) *size_out = it->second.size;
     return true;
 }
@@ -176,8 +204,14 @@ Status KVIndex::ensure_resident(uint32_t stripe_idx, Entry& e,
         // own victim).
         PoolLoc loc;
         bool got = mm_->allocate(e.size, &loc);
-        if (!got && evict_internal(e.size, int(stripe_idx)) > 0) {
-            got = mm_->allocate(e.size, &loc);
+        if (!got) {
+            // Promotion found no free blocks: another hard stall the
+            // watermark reclaimer should have prevented.
+            hard_stalls_.fetch_add(1, std::memory_order_relaxed);
+            kick_reclaimer();
+            if (evict_internal(e.size, int(stripe_idx), false) > 0) {
+                got = mm_->allocate(e.size, &loc);
+            }
         }
         if (got) {
             auto block = std::make_shared<Block>(mm_, loc, e.size);
@@ -204,7 +238,7 @@ Status KVIndex::ensure_resident(uint32_t stripe_idx, Entry& e,
                 return INTERNAL_ERROR;
             }
             e.disk.reset();
-            if (evict_internal(e.size, int(stripe_idx)) > 0) {
+            if (evict_internal(e.size, int(stripe_idx), false) > 0) {
                 got = mm_->allocate(e.size, &loc);
             }
             if (!got) {
@@ -229,7 +263,7 @@ Status KVIndex::ensure_resident(uint32_t stripe_idx, Entry& e,
         }
         promotes_.fetch_add(1, std::memory_order_relaxed);
     }
-    lru_touch(e, key);
+    lru_touch(stripes_[stripe_idx], e, key);
     return OK;
 }
 
@@ -333,7 +367,7 @@ Status KVIndex::insert_committed(const std::string& key, const uint8_t* data,
     e.size = size;
     e.committed = true;
     mit->second = std::move(e);
-    if (track_lru()) lru_touch(mit->second, key);
+    if (track_lru()) lru_touch(st, mit->second, mit->first);
     return OK;
 }
 
@@ -348,24 +382,31 @@ Status KVIndex::insert_leased(const std::string& key, const PoolLoc& loc,
     e.size = size;
     e.committed = true;
     mit->second = std::move(e);
-    if (track_lru()) lru_touch(mit->second, mit->first);
+    if (track_lru()) lru_touch(st, mit->second, mit->first);
     return OK;
 }
 
 size_t KVIndex::purge() {
-    // Cross-stripe write: all stripe locks in index order, then the LRU.
-    std::vector<std::unique_lock<std::mutex>> locks;
-    locks.reserve(kStripes);
-    for (Stripe& st : stripes_) locks.emplace_back(st.mu);
     size_t n = 0;
-    for (Stripe& st : stripes_) {
-        n += st.map.size();
-        st.map.clear();
-    }
     {
-        std::lock_guard<std::mutex> lk(lru_mu_);
-        lru_.clear();
+        // Cross-stripe write: all stripe locks in index order; each
+        // stripe's LRU segment clears with its map.
+        std::vector<std::unique_lock<std::mutex>> locks;
+        locks.reserve(kStripes);
+        for (Stripe& st : stripes_) locks.emplace_back(st.mu);
+        for (Stripe& st : stripes_) {
+            n += st.map.size();
+            st.map.clear();
+            st.lru.clear();
+            st.tail_age.store(UINT64_MAX, std::memory_order_relaxed);
+        }
     }
+    // Determinism barrier, after the stripe locks drop (the writer
+    // needs them): queued spills of now-purged entries are dropped and
+    // the writer's in-flight batch finishes, so when purge returns no
+    // writer ref keeps purged pool blocks (or disk extents) alive —
+    // used_bytes/disk_used read 0 immediately after a purge.
+    cancel_queued_spills();
     if (n) bump_epoch();
     return n;
 }
@@ -392,7 +433,7 @@ size_t KVIndex::reclaim_orphans(const std::vector<std::string>& keys) {
             if (it->second.block && live.count(it->second.block.get())) {
                 continue;
             }
-            lru_drop(it->second);
+            lru_drop(st, it->second);
             st.map.erase(it);
             n++;
         }
@@ -418,7 +459,7 @@ size_t KVIndex::erase(const std::vector<std::string>& keys) {
         // the old single store lock this ordering came for free —
         // reallocation needed the same lock.)
         if (it->second.committed) bump_epoch();
-        lru_drop(it->second);
+        lru_drop(st, it->second);
         st.map.erase(it);
         n++;
     }
@@ -448,83 +489,138 @@ size_t KVIndex::leases() const {
     return leases_.size();
 }
 
-void KVIndex::lru_touch(Entry& e, const std::string& key) {
+void KVIndex::lru_touch(Stripe& st, Entry& e, const std::string& key) {
     // Disk-resident entries stay out of the LRU: there is nothing to
     // evict or spill until a read promotes them back.
     if (!track_lru() || !e.block) return;
-    std::lock_guard<std::mutex> lk(lru_mu_);
-    if (e.in_lru) lru_.erase(e.lru_it);
-    lru_.push_front(key);
-    e.lru_it = lru_.begin();
-    e.in_lru = true;
-}
-
-void KVIndex::lru_drop(Entry& e) {
-    if (!track_lru()) return;
-    std::lock_guard<std::mutex> lk(lru_mu_);
+    // A touch proves the entry hot: cancel any in-flight spill (the
+    // writer abandons it at its completion check and releases the
+    // extent) — a get on a SPILLING key reads the still-resident block.
+    e.spilling = false;
+    uint64_t age = lru_clock_.fetch_add(1, std::memory_order_relaxed);
     if (e.in_lru) {
-        lru_.erase(e.lru_it);
-        e.in_lru = false;
+        // splice: move the node in place, no allocation on the hot path.
+        st.lru.splice(st.lru.begin(), st.lru, e.lru_it);
+        e.lru_it->age = age;
+    } else {
+        st.lru.push_front(LruNode{key, age});
+        e.lru_it = st.lru.begin();
+        e.in_lru = true;
     }
+    st.tail_age.store(st.lru.back().age, std::memory_order_relaxed);
 }
 
-size_t KVIndex::evict_internal(size_t want, int held_stripe) {
-    size_t victims = 0;
-    size_t freed = 0;
-    // Smallest size the tier refused this pass: a failed 4-block store
-    // must not stop 1-block victims from spilling into remaining space.
-    uint32_t disk_min_fail = UINT32_MAX;
-    const size_t bs = mm_->block_size();
-    // The LRU walk holds lru_mu_ throughout and acquires victims' stripe
-    // locks in REVERSE of the normal stripe→lru order — so those are
-    // TRY-locks, and a busy stripe's victims are skipped this pass (with
-    // one worker the try always succeeds → victim order identical to the
-    // single-threaded walk).
-    std::lock_guard<std::mutex> llk(lru_mu_);
-    auto it = lru_.rbegin();
-    while (it != lru_.rend() && freed < want) {
-        uint32_t si = stripe_of(*it);
-        Stripe& st = stripes_[si];
-        std::unique_lock<std::mutex> slk;
-        if (int(si) != held_stripe) {
-            slk = std::unique_lock<std::mutex>(st.mu, std::try_to_lock);
-            if (!slk.owns_lock()) {
-                ++it;
-                continue;
-            }
+void KVIndex::lru_drop(Stripe& st, Entry& e) {
+    if (!track_lru() || !e.in_lru) return;
+    st.lru.erase(e.lru_it);
+    e.in_lru = false;
+    st.tail_age.store(st.lru.empty() ? UINT64_MAX : st.lru.back().age,
+                      std::memory_order_relaxed);
+}
+
+uint64_t KVIndex::oldest_eligible_age(uint32_t si, bool held,
+                                      uint32_t disk_min_fail) {
+    Stripe& st = stripes_[si];
+    std::unique_lock<std::mutex> slk;
+    if (!held) {
+        slk = std::unique_lock<std::mutex>(st.mu, std::try_to_lock);
+        if (!slk.owns_lock()) return UINT64_MAX;  // busy: skip this pass
+    }
+    for (auto it = st.lru.rbegin(); it != st.lru.rend(); ++it) {
+        auto mit = st.map.find(it->key);
+        if (mit == st.map.end() || !mit->second.block) continue;
+        const Entry& e = mit->second;
+        if (e.block.use_count() > 1) continue;  // pinned / queued spill
+        if (!eviction_ && !(disk_ != nullptr && e.size < disk_min_fail)) {
+            continue;  // spill-only mode and the tier refused this size
         }
-        auto mit = st.map.find(*it);
-        if (mit == st.map.end() || !mit->second.block) {
-            it = std::reverse_iterator(lru_.erase(std::next(it).base()));
+        return it->age;
+    }
+    return UINT64_MAX;
+}
+
+size_t KVIndex::evict_from_stripe(uint32_t si, bool held, size_t want,
+                                  uint64_t age_limit, size_t max_victims,
+                                  uint32_t* disk_min_fail, bool async_spill,
+                                  size_t* victims) {
+    Stripe& st = stripes_[si];
+    std::unique_lock<std::mutex> slk;
+    if (!held) {
+        slk = std::unique_lock<std::mutex>(st.mu, std::try_to_lock);
+        if (!slk.owns_lock()) return 0;  // busy: skipped this pass
+    }
+    const size_t bs = mm_->block_size();
+    const bool use_async =
+        async_spill && disk_ != nullptr && spill_thread_.joinable();
+    size_t freed = 0;
+    size_t local_victims = 0;
+    auto it = st.lru.rbegin();
+    while (it != st.lru.rend() && freed < want &&
+           local_victims < max_victims && it->age <= age_limit) {
+        auto mit = st.map.find(it->key);
+        if (mit == st.map.end() || !mit->second.block ||
+            !mit->second.in_lru) {
+            // Defensive only: every erase/spill drops its node in place.
+            if (mit != st.map.end() && mit->second.in_lru) {
+                mit->second.in_lru = false;  // node dies below
+            }
+            it = std::reverse_iterator(st.lru.erase(std::next(it).base()));
             continue;
         }
         Entry& e = mit->second;
-        // Skip entries whose blocks are pinned (reads in flight hold
-        // extra refs) — their memory would not return to the pool yet.
+        // Skip entries whose blocks are pinned (reads in flight — or a
+        // queued spill — hold extra refs): their memory would not
+        // return to the pool yet.
         if (e.block.use_count() > 1) {
             ++it;
             continue;
         }
+        // use_count()==1 with the flag still set means the writer
+        // dropped the item (shutdown) or completion raced a cancel:
+        // stale — this is a normal victim again.
+        e.spilling = false;
         // Spill to the disk tier first; hard-evict only when there is no
         // tier or this victim cannot be stored (full/fragmented/EIO).
         // Epoch ordering, both branches: bump BEFORE this victim's pool
         // blocks are released, once PER victim — another worker's
-        // allocate can reuse the blocks the instant they free (arena
-        // locks are independent of the lru/stripe locks held here), and
-        // a pin-cache client that cached a later victim between two
+        // allocate can reuse the blocks the instant they free, and a
+        // pin-cache client that cached a later victim between two
         // releases of this same pass would otherwise validate a stale
         // read against the earlier bump.
         bool spilled = false;
-        if (disk_ != nullptr && e.size < disk_min_fail) {
-            int64_t off = disk_->store(e.block->loc.ptr, e.size);
-            if (off >= 0) {
-                e.disk = std::make_shared<DiskSpan>(disk_, off, e.size);
-                bump_epoch();     // before the blocks return to the pool
-                e.block.reset();  // frees the pool blocks
-                spilled = true;
-                spills_.fetch_add(1, std::memory_order_relaxed);
+        if (disk_ != nullptr && e.size < *disk_min_fail) {
+            if (use_async && spill_may_fit(e.size)) {
+                // SPILLING: the entry stays readable (block still set);
+                // the writer pays the IO outside all index locks and
+                // frees the pool blocks at completion. It stays in the
+                // LRU so a failed/cancelled spill remains evictable;
+                // later selection passes skip it via the queue's ref.
+                e.spilling = true;
+                enqueue_spill(it->key, e.block, e.size, si);
+                freed += (size_t(e.size) + bs - 1) / bs * bs;
+                local_victims++;
+                ++it;
+                continue;
+            }
+            if (use_async) {
+                // Tier known-full for this size since the last release:
+                // skip the futile queue round trip — treat exactly like
+                // a failed synchronous store below.
+                *disk_min_fail = e.size;
             } else {
-                disk_min_fail = e.size;
+                int64_t off = disk_->store(e.block->loc.ptr, e.size);
+                if (off >= 0) {
+                    e.disk = std::make_shared<DiskSpan>(disk_, off, e.size);
+                    bump_epoch();  // before the blocks return to the pool
+                    e.block.reset();  // frees the pool blocks
+                    spilled = true;
+                    spills_.fetch_add(1, std::memory_order_relaxed);
+                } else {
+                    // Smallest size the tier refused this pass: a failed
+                    // 4-block store must not stop 1-block victims from
+                    // spilling into remaining space.
+                    *disk_min_fail = e.size;
+                }
             }
         }
         if (!spilled && !eviction_) {
@@ -539,8 +635,7 @@ size_t KVIndex::evict_internal(size_t want, int held_stripe) {
         freed += (size_t(e.size) + bs - 1) / bs * bs;
         // Remove the victim from the LRU in place and keep walking
         // coldward from the same position (restarting at rbegin would
-        // re-scan every pinned cold entry per eviction, O(pinned x
-        // evicted) under the lock).
+        // re-scan every pinned cold entry per eviction).
         auto fwd = std::next(it).base();
         e.in_lru = false;
         if (!spilled) {
@@ -548,10 +643,410 @@ size_t KVIndex::evict_internal(size_t want, int held_stripe) {
             st.map.erase(mit);
             evictions_.fetch_add(1, std::memory_order_relaxed);
         }
-        it = std::reverse_iterator(lru_.erase(fwd));
-        victims++;
+        it = std::reverse_iterator(st.lru.erase(fwd));
+        local_victims++;
+    }
+    st.tail_age.store(st.lru.empty() ? UINT64_MAX : st.lru.back().age,
+                      std::memory_order_relaxed);
+    *victims += local_victims;
+    return freed;
+}
+
+size_t KVIndex::evict_internal(size_t want, int held_stripe,
+                               bool async_spill) {
+    size_t victims = 0;
+    size_t freed = 0;
+    uint32_t disk_min_fail = UINT32_MAX;
+    if (exact_lru_) {
+        // Exact global order (ISTPU_EXACT_LRU=1): re-pick the globally
+        // oldest ELIGIBLE entry for every single victim. Each pick walks
+        // the stripes' cold ends under their locks — O(stripes + pinned)
+        // per victim, the price of exactness.
+        int stale = 0;
+        while (freed < want) {
+            int best = -1;
+            uint64_t best_age = UINT64_MAX;
+            for (uint32_t si = 0; si < kStripes; ++si) {
+                uint64_t age = oldest_eligible_age(
+                    si, int(si) == held_stripe, disk_min_fail);
+                if (age < best_age) {
+                    best_age = age;
+                    best = int(si);
+                }
+            }
+            if (best < 0) break;
+            uint32_t prev_fail = disk_min_fail;
+            size_t got = evict_from_stripe(
+                uint32_t(best), best == held_stripe, want - freed, best_age,
+                1, &disk_min_fail, async_spill, &victims);
+            freed += got;
+            if (got == 0 && disk_min_fail == prev_fail) {
+                // The candidate raced away between the eligibility scan
+                // and the evict re-lock (another worker touched it, or
+                // grabbed the stripe). Other stripes still hold eligible
+                // victims — re-scan, bounded so a persistently busy
+                // stripe cannot spin this pass forever.
+                if (++stale > int(kStripes) * 4) break;
+                continue;
+            }
+            stale = 0;
+        }
+        return victims;
+    }
+    // Approximate (default): the lock-free per-stripe tail-age counters
+    // pick the stripe whose coldest entry is globally oldest; victims
+    // then drain from that stripe's cold end while still older than
+    // every OTHER stripe's tail. With no pinned entries and no try-lock
+    // skips this equals exact global order (each drained victim is
+    // older than everything in every other stripe); pinned cold tails
+    // are where it deviates — they can hide younger evictables, and a
+    // busy stripe's victims wait for the next pass.
+    bool exhausted[kStripes] = {};
+    while (freed < want) {
+        int best = -1;
+        uint64_t best_age = UINT64_MAX;
+        uint64_t second = UINT64_MAX;
+        for (uint32_t si = 0; si < kStripes; ++si) {
+            if (exhausted[si]) continue;
+            uint64_t age =
+                stripes_[si].tail_age.load(std::memory_order_relaxed);
+            if (age == UINT64_MAX) {
+                exhausted[si] = true;
+                continue;
+            }
+            if (age < best_age) {
+                second = best_age;
+                best_age = age;
+                best = int(si);
+            } else if (age < second) {
+                second = age;
+            }
+        }
+        if (best < 0) break;
+        uint32_t prev_fail = disk_min_fail;
+        size_t got = evict_from_stripe(
+            uint32_t(best), best == held_stripe, want - freed, second,
+            SIZE_MAX, &disk_min_fail, async_spill, &victims);
+        freed += got;
+        if (got == 0 && disk_min_fail == prev_fail) exhausted[best] = true;
+    }
+    if (freed < want) {
+        // Relaxed pass: the strict walk's age limits come from raw tail
+        // ages, and a cold tail that is PINNED (in-flight read, or a
+        // victim the reclaimer already queued to the spill writer)
+        // satisfies the limit while hiding evictable entries behind it —
+        // the strict pass can then report "nothing evictable" with the
+        // pool full of ordinary cold data. For the last-resort path,
+        // progress beats strict order: sweep the stripes again with no
+        // age limit (still coldest-first within each stripe; exact mode
+        // never needs this — its selection is eligibility-aware).
+        for (uint32_t si = 0; si < kStripes && freed < want; ++si) {
+            freed += evict_from_stripe(si, int(si) == held_stripe,
+                                       want - freed, UINT64_MAX, SIZE_MAX,
+                                       &disk_min_fail, async_spill,
+                                       &victims);
+        }
     }
     return victims;
+}
+
+// --- background reclaim pipeline ---------------------------------------
+
+void KVIndex::start_background(double high, double low) {
+    if (!track_lru() || !(high > 0.0 && high < 1.0)) return;
+    if (bg_running_.load(std::memory_order_relaxed)) return;
+    high_ = high;
+    low_ = low;
+    if (low_ > high_) low_ = high_;
+    if (low_ < 0.0) low_ = 0.0;
+    bg_stop_.store(false, std::memory_order_relaxed);
+    bg_running_.store(true, std::memory_order_relaxed);
+    reclaim_thread_ = std::thread([this] { reclaim_loop(); });
+    if (disk_ != nullptr) {
+        spill_thread_ = std::thread([this] { spill_loop(); });
+    }
+}
+
+void KVIndex::stop_background() {
+    bg_running_.store(false, std::memory_order_relaxed);
+    bg_stop_.store(true, std::memory_order_relaxed);
+    // Lock-then-notify so a thread between its predicate check and its
+    // wait cannot miss the wake.
+    {
+        std::lock_guard<std::mutex> lk(reclaim_mu_);
+    }
+    reclaim_cv_.notify_all();
+    {
+        std::lock_guard<std::mutex> lk(spill_mu_);
+    }
+    spill_cv_.notify_all();
+    if (reclaim_thread_.joinable()) reclaim_thread_.join();
+    if (spill_thread_.joinable()) spill_thread_.join();
+    // Drop leftover queued spills: their entries simply stay resident
+    // (a stale SPILLING flag is cleared at the entry's next touch or
+    // eviction pass).
+    std::deque<SpillItem> dropped;
+    {
+        std::lock_guard<std::mutex> lk(spill_mu_);
+        dropped.swap(spill_q_);
+    }
+    const size_t bs = mm_->block_size();
+    for (SpillItem& item : dropped) {
+        spill_queue_depth_.fetch_sub(1, std::memory_order_relaxed);
+        spill_inflight_bytes_.fetch_sub(
+            (size_t(item.size) + bs - 1) / bs * bs,
+            std::memory_order_relaxed);
+    }
+}
+
+void KVIndex::maybe_wake_reclaimer() {
+    if (!bg_running_.load(std::memory_order_relaxed)) return;
+    size_t total = mm_->total_bytes();
+    if (total == 0) return;
+    if (double(mm_->used_bytes()) < high_ * double(total)) return;
+    kick_reclaimer();
+}
+
+void KVIndex::kick_reclaimer() {
+    if (!bg_running_.load(std::memory_order_relaxed)) return;
+    // Exchange dedupes the notify: under sustained pressure the put
+    // path sets the flag once per reclaimer wake, not once per key.
+    if (reclaim_kick_.exchange(true, std::memory_order_relaxed)) return;
+    {
+        std::lock_guard<std::mutex> lk(reclaim_mu_);
+    }
+    reclaim_cv_.notify_one();
+}
+
+void KVIndex::reclaim_loop() {
+    // Evict in bounded batches so stop() stays responsive and the
+    // stripe try-locks are released between rounds.
+    const size_t batch_bytes = 64 * mm_->block_size();
+    std::unique_lock<std::mutex> lk(reclaim_mu_);
+    while (!bg_stop_.load(std::memory_order_relaxed)) {
+        reclaim_cv_.wait_for(lk, std::chrono::milliseconds(200), [this] {
+            return bg_stop_.load(std::memory_order_relaxed) ||
+                   reclaim_kick_.load(std::memory_order_relaxed);
+        });
+        reclaim_kick_.store(false, std::memory_order_relaxed);
+        if (bg_stop_.load(std::memory_order_relaxed)) break;
+        lk.unlock();
+        size_t total = mm_->total_bytes();
+        if (total != 0 &&
+            double(mm_->used_bytes()) >= high_ * double(total)) {
+            reclaim_runs_.fetch_add(1, std::memory_order_relaxed);
+            size_t floor_bytes = size_t(low_ * double(total));
+            while (!bg_stop_.load(std::memory_order_relaxed)) {
+                size_t used = mm_->used_bytes();
+                // Bytes already queued to the writer are on their way
+                // back to the pool — selecting more victims for them
+                // would overshoot the low watermark.
+                size_t inflight =
+                    spill_inflight_bytes_.load(std::memory_order_relaxed);
+                if (used <= floor_bytes + inflight) break;
+                size_t want = used - floor_bytes - inflight;
+                if (want > batch_bytes) want = batch_bytes;
+                if (evict_internal(want, -1, true) == 0) break;
+            }
+        }
+        lk.lock();
+    }
+}
+
+void KVIndex::enqueue_spill(const std::string& key, const BlockRef& block,
+                            uint32_t size, uint32_t si) {
+    const size_t bs = mm_->block_size();
+    spill_queue_depth_.fetch_add(1, std::memory_order_relaxed);
+    spill_inflight_bytes_.fetch_add((size_t(size) + bs - 1) / bs * bs,
+                                    std::memory_order_relaxed);
+    {
+        std::lock_guard<std::mutex> lk(spill_mu_);
+        spill_q_.push_back(SpillItem{key, block, size, si});
+    }
+    spill_cv_.notify_one();
+}
+
+void KVIndex::spill_loop() {
+    constexpr size_t kSpillBatch = 64;
+    std::unique_lock<std::mutex> lk(spill_mu_);
+    while (true) {
+        spill_cv_.wait(lk, [this] {
+            return bg_stop_.load(std::memory_order_relaxed) ||
+                   !spill_q_.empty();
+        });
+        if (bg_stop_.load(std::memory_order_relaxed)) break;
+        std::vector<SpillItem> batch;
+        size_t take = spill_q_.size();
+        if (take > kSpillBatch) take = kSpillBatch;
+        batch.reserve(take);
+        for (size_t i = 0; i < take; ++i) {
+            batch.push_back(std::move(spill_q_.front()));
+            spill_q_.pop_front();
+        }
+        spill_busy_ = true;
+        lk.unlock();
+        process_spill_batch(batch);
+        batch.clear();
+        lk.lock();
+        spill_busy_ = false;
+        spill_batch_gen_++;  // cancel_queued_spills' bounded barrier
+        spill_cv_.notify_all();
+    }
+}
+
+void KVIndex::process_spill_batch(std::vector<SpillItem>& batch) {
+    const size_t bs = mm_->block_size();
+    // The LRU's cold end is often a contiguous put batch: sort by pool
+    // address and merge adjacent victims into ONE reserve + pwrite
+    // (store_batch carves per-victim extents out of the combined one).
+    // Only block-aligned sizes may join a group — an unaligned payload
+    // would shift the carved offsets off block boundaries.
+    std::sort(batch.begin(), batch.end(),
+              [](const SpillItem& a, const SpillItem& b) {
+                  return a.block->loc.ptr < b.block->loc.ptr;
+              });
+    constexpr uint64_t kMaxGroupBytes = 64ull << 20;  // store() is u32
+    std::vector<int64_t> offs(batch.size(), -1);
+    size_t i = 0;
+    while (i < batch.size()) {
+        size_t j = i;
+        uint64_t total = batch[i].size;
+        while (j + 1 < batch.size() && batch[j].size % bs == 0 &&
+               static_cast<uint8_t*>(batch[j].block->loc.ptr) +
+                       batch[j].size ==
+                   batch[j + 1].block->loc.ptr &&
+               total + batch[j + 1].size <= kMaxGroupBytes) {
+            ++j;
+            total += batch[j].size;
+        }
+        bool stored = false;
+        if (j > i) {
+            std::vector<uint32_t> sizes;
+            sizes.reserve(j - i + 1);
+            for (size_t k = i; k <= j; ++k) sizes.push_back(batch[k].size);
+            std::vector<int64_t> sub(sizes.size(), -1);
+            if (disk_->store_batch(batch[i].block->loc.ptr, sizes.data(),
+                                   uint32_t(sizes.size()),
+                                   sub.data()) >= 0) {
+                for (size_t k = i; k <= j; ++k) offs[k] = sub[k - i];
+                stored = true;
+            }
+        }
+        if (!stored) {  // single victim, or no contiguous combined fit
+            for (size_t k = i; k <= j; ++k) {
+                offs[k] = disk_->store(batch[k].block->loc.ptr,
+                                       batch[k].size);
+            }
+        }
+        i = j + 1;
+    }
+    for (size_t k = 0; k < batch.size(); ++k) finish_spill(batch[k], offs[k]);
+}
+
+void KVIndex::finish_spill(SpillItem& item, int64_t off) {
+    const size_t bs = mm_->block_size();
+    // Declared before the stripe lock so a cancelled spill's extent is
+    // released (DiskSpan RAII) after the lock drops.
+    DiskRef span;
+    if (off >= 0) {
+        span = std::make_shared<DiskSpan>(disk_, off, item.size);
+    } else {
+        // Remember the refusal so async selection stops queueing sizes
+        // the tier cannot hold until its usage drops (see spill_may_fit).
+        uint32_t cur = spill_fail_min_.load(std::memory_order_relaxed);
+        if (item.size < cur) {
+            spill_fail_min_.store(item.size, std::memory_order_relaxed);
+        }
+        spill_fail_used_.store(disk_->used_bytes(),
+                               std::memory_order_relaxed);
+    }
+    {
+        Stripe& st = stripes_[item.stripe];
+        std::lock_guard<std::mutex> lk(st.mu);
+        auto mit = st.map.find(item.key);
+        // Adopt the extent only if this is still the same entry (same
+        // Block), still SPILLING (no read touched it since selection)
+        // and unpinned (use_count 2 = the entry's ref + ours). Anything
+        // else — erased, re-put, read-cancelled, newly pinned — keeps
+        // the entry resident and the extent is released.
+        if (mit != st.map.end() && mit->second.block == item.block) {
+            Entry& e = mit->second;
+            if (span && e.spilling && e.committed &&
+                e.block.use_count() == 2) {
+                bump_epoch();  // before the blocks can return to the pool
+                lru_drop(st, e);
+                e.disk = std::move(span);
+                e.spilling = false;
+                e.block.reset();  // our item.block still pins the bytes
+                spills_.fetch_add(1, std::memory_order_relaxed);
+                spill_fail_min_.store(UINT32_MAX,
+                                      std::memory_order_relaxed);
+            } else {
+                e.spilling = false;
+                spills_cancelled_.fetch_add(1, std::memory_order_relaxed);
+            }
+        }
+    }
+    item.block.reset();  // pool blocks actually free here (epoch already bumped)
+    spill_inflight_bytes_.fetch_sub(
+        (size_t(item.size) + bs - 1) / bs * bs, std::memory_order_relaxed);
+    spill_queue_depth_.fetch_sub(1, std::memory_order_relaxed);
+}
+
+bool KVIndex::spill_may_fit(uint32_t size) {
+    // Admission by actual tier room FIRST: queued-but-unwritten spills
+    // (spill_inflight_bytes_) already claim part of the free space, and
+    // over-queueing would pin every resident entry's block behind a
+    // doomed write — a read promotion in that window would find nothing
+    // evictable and fail OOM.
+    const size_t bs = mm_->block_size();
+    uint64_t rounded = (uint64_t(size) + bs - 1) / bs * bs;
+    uint64_t used = disk_->used_bytes();
+    uint64_t cap = disk_->capacity_bytes();
+    uint64_t claimed =
+        spill_inflight_bytes_.load(std::memory_order_relaxed);
+    if (cap < used + claimed + rounded) return false;
+    uint32_t fmin = spill_fail_min_.load(std::memory_order_relaxed);
+    if (size < fmin) return true;
+    if (used < spill_fail_used_.load(std::memory_order_relaxed)) {
+        // Something was released since the failure: forget it and retry.
+        spill_fail_min_.store(UINT32_MAX, std::memory_order_relaxed);
+        return true;
+    }
+    return false;
+}
+
+void KVIndex::cancel_queued_spills() {
+    if (!spill_thread_.joinable()) return;
+    std::deque<SpillItem> dropped;
+    {
+        std::unique_lock<std::mutex> lk(spill_mu_);
+        dropped.swap(spill_q_);
+        const size_t bs = mm_->block_size();
+        for (SpillItem& item : dropped) {
+            spill_queue_depth_.fetch_sub(1, std::memory_order_relaxed);
+            spill_inflight_bytes_.fetch_sub(
+                (size_t(item.size) + bs - 1) / bs * bs,
+                std::memory_order_relaxed);
+            spills_cancelled_.fetch_add(1, std::memory_order_relaxed);
+        }
+        // Wait out the writer's in-flight batch — AT MOST one: under
+        // sustained pressure concurrent puts refill the queue the
+        // moment we cleared it, and the writer grabs the next batch
+        // (flipping spill_busy_ back on) without ever dropping
+        // spill_mu_ in between, so "wait until idle" could starve
+        // forever. The batch GENERATION bounds the wait to the batch
+        // that was in flight at entry; items queued after our clear
+        // belong to post-purge entries and are not our concern. The
+        // writer needs stripe locks (finish_spill) and spill_mu_ (to
+        // bump the generation) — the caller holds neither while
+        // waiting here.
+        uint64_t gen = spill_batch_gen_;
+        spill_cv_.wait(lk, [this, gen] {
+            return !spill_busy_ || spill_batch_gen_ != gen;
+        });
+    }
+    dropped.clear();  // refs drop outside spill_mu_
 }
 
 }  // namespace istpu

@@ -30,28 +30,53 @@
 // ([gen:32][stripe:4][slot:28]) so token-addressed ops (write_dest /
 // commit / abort — the put hot path) lock exactly one stripe. Rules:
 //   - Entry fields are guarded by their stripe's mutex.
-//   - The global LRU list (eviction/spill victim order must stay globally
-//     accurate — per-stripe LRUs would evict hot keys) is guarded by
-//     lru_mu_, taken AFTER a stripe mutex. Eviction walks the LRU under
-//     lru_mu_ and try-locks victims' stripes (skipping busy ones) so the
-//     reverse-order acquisition can never deadlock; with one worker the
-//     try-lock always succeeds and victim selection is identical to the
-//     single-threaded behavior.
+//   - The LRU is SEGMENTED: each stripe keeps its own recency list under
+//     the stripe's own mutex, so lru_touch on the get/put hot path locks
+//     nothing beyond the already-held stripe lock (PR 2's single global
+//     list serialized every recency update on one lru_mu_). Every touch
+//     stamps a global monotonically increasing age; a per-stripe atomic
+//     tail-age mirrors the age of the stripe's coldest entry so victim
+//     selection can pre-filter stripes without locks. Eviction picks the
+//     stripe whose tail is globally oldest and drains victims whose age
+//     stays below every other stripe's tail — exact global LRU order
+//     whenever no entries are pinned and no stripe is try-lock busy,
+//     an approximation otherwise (pinned tails hide younger evictables
+//     behind them). ISTPU_EXACT_LRU=1 restores exact order under pins
+//     too (per-victim eligibility walks; eviction tests assert order).
+//     Victim stripes are TRY-locked (a busy stripe's victims are skipped
+//     for the pass) so no lock-order cycle exists; with one worker the
+//     try always succeeds.
 //   - Cross-stripe ops (purge, snapshot_items, match_last_index, reserve)
 //     take stripe locks in INDEX ORDER.
 //   - Pool-arena locks (mempool.h) are leaves, taken after any stripe
-//     lock; pin leases live under their own leases_mu_ leaf.
+//     lock; pin leases live under their own leases_mu_ leaf; the spill
+//     queue's spill_mu_ is a leaf taken after a stripe lock (the writer
+//     thread takes spill_mu_ and stripe locks strictly in sequence,
+//     never nested).
 // All public methods lock internally; none return raw Entry pointers
 // (BlockRefs keep bytes alive after the stripe lock drops).
+//
+// Background reclaim pipeline (PR 3): with eviction and/or a disk tier,
+// reclaim is normally NOT paid on the put path. A reclaimer thread wakes
+// when pool occupancy crosses a high watermark and evicts/spills down to
+// a low watermark in batches; spill victims move through a SPILLING state
+// and are queued to an async writer that performs the DiskTier IO outside
+// all index locks (a get on a SPILLING key reads the still-resident block
+// and cancels the spill). The inline evict path in allocate/promote
+// survives only as the last-resort slow path when the reclaimer cannot
+// keep up; those "hard stalls" are counted.
 #pragma once
 
 #include <atomic>
+#include <condition_variable>
 #include <cstdint>
+#include <deque>
 #include <functional>
 #include <list>
 #include <memory>
 #include <mutex>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -89,6 +114,13 @@ struct DiskSpan {
 };
 using DiskRef = std::shared_ptr<DiskSpan>;
 
+// One node of a stripe's segmented-LRU list: the key plus the global
+// age stamped at the entry's last touch (front = most recent).
+struct LruNode {
+    std::string key;
+    uint64_t age = 0;
+};
+
 struct Entry {
     BlockRef block;  // set while resident in the DRAM pool
     DiskRef disk;    // set while spilled to the disk tier
@@ -98,9 +130,14 @@ struct Entry {
     std::shared_ptr<std::vector<uint8_t>> heap;
     uint32_t size = 0;
     bool committed = false;
-    // Position in the LRU list (valid when committed and resident;
-    // guarded by lru_mu_ together with the stripe mutex).
-    std::list<std::string>::iterator lru_it{};
+    // SPILLING: the async writer holds a BlockRef and is copying the
+    // bytes to the disk tier. The entry stays fully readable (block is
+    // still set); a read clears the flag, cancelling the spill at the
+    // writer's completion check. Guarded by the stripe mutex.
+    bool spilling = false;
+    // Position in the stripe's LRU list (valid when committed and
+    // resident; guarded by the stripe mutex).
+    std::list<LruNode>::iterator lru_it{};
     bool in_lru = false;
 };
 
@@ -127,8 +164,20 @@ class KVIndex {
     // spill, delete, purge). SHM clients validate their pin cache
     // against it without a round trip.
     explicit KVIndex(MM* mm, bool eviction = false, DiskTier* disk = nullptr,
-                     std::atomic<uint64_t>* epoch = nullptr)
-        : mm_(mm), eviction_(eviction), disk_(disk), epoch_(epoch) {}
+                     std::atomic<uint64_t>* epoch = nullptr);
+    ~KVIndex();
+
+    // Start the background reclaim pipeline: a reclaimer thread that
+    // wakes when pool occupancy crosses `high` (fraction of pool bytes)
+    // and evicts/spills down to `low`, plus — when a disk tier is
+    // present — an async spill writer that performs the tier IO outside
+    // all index locks. No-op unless eviction/spill is configured and
+    // 0 < high < 1 (high >= 1 or <= 0 disables background reclaim; the
+    // inline last-resort path still works).
+    void start_background(double high, double low);
+    // Stop + join the background threads; queued spills are dropped
+    // (their entries simply stay resident). Idempotent.
+    void stop_background();
 
     uint64_t epoch() const {
         return epoch_ ? epoch_->load(std::memory_order_relaxed) : 0;
@@ -256,11 +305,35 @@ class KVIndex {
     uint64_t promotes() const {
         return promotes_.load(std::memory_order_relaxed);
     }
+    uint64_t reclaim_runs() const {
+        return reclaim_runs_.load(std::memory_order_relaxed);
+    }
+    uint64_t hard_stalls() const {
+        return hard_stalls_.load(std::memory_order_relaxed);
+    }
+    uint64_t spill_queue_depth() const {
+        return spill_queue_depth_.load(std::memory_order_relaxed);
+    }
+    uint64_t spills_cancelled() const {
+        return spills_cancelled_.load(std::memory_order_relaxed);
+    }
 
     // Evict least-recently-used committed entries whose blocks are not
     // pinned (use_count()==1) until `want` bytes could plausibly be
     // freed or nothing evictable remains. Returns entries evicted.
-    size_t evict_lru(size_t want) { return evict_internal(want, -1); }
+    // This is the INLINE (synchronous) path — a caller needing pool
+    // space NOW (op_lease's last resort); it counts as a hard stall.
+    size_t evict_lru(size_t want) {
+        hard_stalls_.fetch_add(1, std::memory_order_relaxed);
+        kick_reclaimer();
+        return evict_internal(want, -1, false);
+    }
+
+    // Cheap occupancy probe: kicks the reclaimer when pool usage is at
+    // or above the high watermark. Called by the server after bulk
+    // allocations (op_lease grants) — KVIndex::allocate checks
+    // internally.
+    void maybe_wake_reclaimer();
 
    private:
     // Inflight tokens live in per-stripe SLABS, not hash maps: a token is
@@ -289,6 +362,12 @@ class KVIndex {
         std::vector<Inflight> islab;
         std::vector<uint32_t> ifree;
         size_t inflight_live = 0;
+        // Segmented LRU (front = most recent), guarded by mu — recency
+        // updates on the hot path lock nothing beyond the stripe.
+        std::list<LruNode> lru;
+        // Age of lru.back() (UINT64_MAX when empty): the lock-free
+        // victim-selection pre-filter. Written under mu, read anywhere.
+        std::atomic<uint64_t> tail_age{UINT64_MAX};
     };
 
     static uint32_t stripe_of(const std::string& key) {
@@ -315,17 +394,57 @@ class KVIndex {
         st.inflight_live--;
     }
 
-    // Both require the entry's stripe mutex held; take lru_mu_ inside.
-    void lru_touch(Entry& e, const std::string& key);
-    void lru_drop(Entry& e);
+    // Both require the entry's stripe mutex held; touch the stripe's
+    // own LRU list only (no further locks).
+    void lru_touch(Stripe& st, Entry& e, const std::string& key);
+    void lru_drop(Stripe& st, Entry& e);
     // Promote a non-resident entry back into the pool. Requires the
     // entry's stripe mutex held (stripe index passed for eviction).
     Status ensure_resident(uint32_t stripe_idx, Entry& e,
                            const std::string& key);
-    // Eviction/spill walk. held_stripe >= 0 names a stripe mutex the
-    // CALLER already holds (victims there are evicted directly); other
-    // stripes are try-locked, busy ones skipped.
-    size_t evict_internal(size_t want, int held_stripe);
+    // Eviction/spill victim selection over the segmented LRU.
+    // held_stripe >= 0 names a stripe mutex the CALLER already holds
+    // (victims there are evicted directly); other stripes are
+    // try-locked, busy ones skipped for the pass. async_spill=true
+    // (reclaimer only) queues spill victims to the writer instead of
+    // paying the tier IO inline.
+    size_t evict_internal(size_t want, int held_stripe, bool async_spill);
+    // Drain victims from one stripe's cold end: entries whose age is
+    // <= age_limit, up to want bytes / max_victims. Returns
+    // block-rounded bytes freed (or queued). 0 with *progress=false
+    // means the stripe holds nothing evictable right now.
+    size_t evict_from_stripe(uint32_t si, bool held, size_t want,
+                             uint64_t age_limit, size_t max_victims,
+                             uint32_t* disk_min_fail, bool async_spill,
+                             size_t* victims);
+    // Exact-mode helper: age of the stripe's oldest ELIGIBLE entry
+    // (unpinned, resident, spillable/evictable), UINT64_MAX when none
+    // or the stripe is try-lock busy.
+    uint64_t oldest_eligible_age(uint32_t si, bool held,
+                                 uint32_t disk_min_fail);
+
+    // --- background reclaim pipeline ---------------------------------
+    void kick_reclaimer();
+    void reclaim_loop();
+    void spill_loop();
+    struct SpillItem {
+        std::string key;
+        BlockRef block;  // pins the bytes for the out-of-lock IO
+        uint32_t size = 0;
+        uint32_t stripe = 0;
+    };
+    // Requires the victim's stripe mutex held (spill_mu_ is a leaf).
+    void enqueue_spill(const std::string& key, const BlockRef& block,
+                       uint32_t size, uint32_t si);
+    void process_spill_batch(std::vector<SpillItem>& batch);
+    // Re-locks the item's stripe and either adopts the stored extent
+    // (entry still SPILLING and unpinned) or cancels (extent released
+    // by DiskSpan RAII). off < 0 = the store itself failed.
+    void finish_spill(SpillItem& item, int64_t off);
+    // Drop every queued-but-unstarted spill and wait for the writer's
+    // in-flight batch to finish (purge's determinism barrier: after
+    // purge returns, no writer ref keeps purged pool blocks alive).
+    void cancel_queued_spills();
     // Invalidate every client's pin cache (release store so a client
     // observing the new value also observes any writes that preceded
     // the bump, across the shared mapping).
@@ -341,19 +460,56 @@ class KVIndex {
     bool eviction_ = false;
     DiskTier* disk_ = nullptr;
     std::atomic<uint64_t>* epoch_ = nullptr;
+    // ISTPU_EXACT_LRU=1 (read once at construction): per-victim global
+    // eligibility scans restore exact global LRU order even under pins.
+    bool exact_lru_ = false;
     std::atomic<uint64_t> evictions_{0};
     std::atomic<uint64_t> spills_{0};
     std::atomic<uint64_t> promotes_{0};
+    std::atomic<uint64_t> reclaim_runs_{0};
+    std::atomic<uint64_t> hard_stalls_{0};
+    std::atomic<uint64_t> spills_cancelled_{0};
+    // Global age clock for the segmented LRU (every touch stamps one).
+    std::atomic<uint64_t> lru_clock_{1};
     Stripe stripes_[kStripes];
-    // Global LRU (front = most recent), guarded by lru_mu_ (taken after
-    // a stripe mutex — see the threading rules in the header comment).
-    mutable std::mutex lru_mu_;
-    std::list<std::string> lru_;
     // Pin leases: own leaf mutex (never nested inside a stripe lock by
     // callers; the server gathers refs first, then pins).
     mutable std::mutex leases_mu_;
     std::unordered_map<uint64_t, std::vector<BlockRef>> leases_;
     uint64_t next_lease_ = 1;  // guarded by leases_mu_
+
+    // Background reclaim pipeline state.
+    std::atomic<bool> bg_running_{false};
+    std::atomic<bool> bg_stop_{false};
+    double high_ = 0.0, low_ = 0.0;
+    std::thread reclaim_thread_;
+    std::mutex reclaim_mu_;
+    std::condition_variable reclaim_cv_;
+    std::atomic<bool> reclaim_kick_{false};
+    // Spill writer: queue under its own leaf mutex (taken after a
+    // stripe lock on enqueue; the writer takes spill_mu_ and stripe
+    // locks strictly in sequence).
+    std::thread spill_thread_;
+    std::mutex spill_mu_;
+    std::condition_variable spill_cv_;
+    std::deque<SpillItem> spill_q_;   // guarded by spill_mu_
+    bool spill_busy_ = false;         // guarded by spill_mu_
+    uint64_t spill_batch_gen_ = 0;    // guarded by spill_mu_; bumped per
+                                      // finished batch (cancel barrier)
+    std::atomic<uint64_t> spill_queue_depth_{0};
+    // Block-rounded bytes queued/being written: the reclaimer subtracts
+    // these from its deficit so it does not over-select victims whose
+    // memory is already on its way back to the pool.
+    std::atomic<uint64_t> spill_inflight_bytes_{0};
+    // Tier-full memory for ASYNC selection: the writer discovers store
+    // failures after the victim was queued, so without this the
+    // reclaimer would re-queue the same victims forever against a full
+    // tier. Sizes >= spill_fail_min_ are skipped until the tier's
+    // usage drops below what it was at the failure (something freed) or
+    // a store succeeds.
+    std::atomic<uint32_t> spill_fail_min_{UINT32_MAX};
+    std::atomic<uint64_t> spill_fail_used_{0};
+    bool spill_may_fit(uint32_t size);
 };
 
 }  // namespace istpu

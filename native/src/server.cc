@@ -153,36 +153,72 @@ bool Server::start() {
     ctl_->epoch = 0;
     index_ = std::make_unique<KVIndex>(mm_.get(), cfg_.enable_eviction,
                                        disk_.get(), epoch_word());
+    // Background reclaim pipeline (no-op unless eviction/spill is
+    // configured and the watermarks enable it): puts should normally
+    // find free blocks without ever paying reclaim inline.
+    index_->start_background(cfg_.reclaim_high, cfg_.reclaim_low);
 
-    listen_fd_ = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
-    if (listen_fd_ < 0) return false;
-    int one = 1;
-    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    uint32_t nworkers = resolve_workers(cfg_.workers);
+    cfg_.workers = nworkers;
+    // SO_REUSEPORT acceptors: with several workers, each gets its own
+    // listen socket bound to the same port so the KERNEL spreads
+    // accepts and a new connection lands directly on its owning worker
+    // (no worker-0 pending-queue + eventfd handoff hop). Fallback to
+    // the classic single-acceptor handoff when the socket option is
+    // unavailable or ISTPU_NO_REUSEPORT=1 (operator escape hatch /
+    // fallback-path testing).
+    bool want_reuseport = nworkers > 1;
+    if (const char* env = getenv("ISTPU_NO_REUSEPORT")) {
+        if (env[0] == '1') want_reuseport = false;
+    }
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons(cfg_.port);
     if (inet_pton(AF_INET, cfg_.host.c_str(), &addr.sin_addr) != 1) {
         addr.sin_addr.s_addr = INADDR_ANY;
     }
-    if (bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    auto make_listener = [&](bool reuseport) -> int {
+        int fd = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+        if (fd < 0) return -1;
+        int one = 1;
+        setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+        if (reuseport &&
+            setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one)) !=
+                0) {
+            close(fd);
+            return -1;
+        }
+        if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+            listen(fd, 128) != 0) {
+            close(fd);
+            return -1;
+        }
+        set_nonblock(fd);
+        return fd;
+    };
+    reuseport_ = false;
+    if (want_reuseport) {
+        listen_fd_ = make_listener(true);
+        if (listen_fd_ >= 0) {
+            reuseport_ = true;
+        } else {
+            IST_WARN("SO_REUSEPORT unavailable; falling back to "
+                     "single-acceptor handoff");
+        }
+    }
+    if (listen_fd_ < 0) listen_fd_ = make_listener(false);
+    if (listen_fd_ < 0) {
         IST_ERROR("bind %s:%u failed: %s", cfg_.host.c_str(), cfg_.port,
                   strerror(errno));
-        close(listen_fd_);
-        listen_fd_ = -1;
         return false;
     }
     socklen_t alen = sizeof(addr);
     getsockname(listen_fd_, (sockaddr*)&addr, &alen);
     bound_port_ = ntohs(addr.sin_port);
-    if (listen(listen_fd_, 128) != 0) {
-        close(listen_fd_);
-        listen_fd_ = -1;
-        return false;
-    }
-    set_nonblock(listen_fd_);
+    // Ephemeral-port case: the extra listeners must bind the SAME port
+    // the first socket got.
+    addr.sin_port = htons(bound_port_);
 
-    uint32_t nworkers = resolve_workers(cfg_.workers);
-    cfg_.workers = nworkers;
     workers_.clear();
     for (uint32_t i = 0; i < nworkers; ++i) {
         auto w = std::make_unique<Worker>();
@@ -194,10 +230,21 @@ bool Server::start() {
         ev.data.fd = w->wake_fd;
         epoll_ctl(w->epoll_fd, EPOLL_CTL_ADD, w->wake_fd, &ev);
         if (i == 0) {
-            // Worker 0 doubles as the acceptor; assigned connections are
-            // handed to the least-loaded worker.
-            ev.data.fd = listen_fd_;
-            epoll_ctl(w->epoll_fd, EPOLL_CTL_ADD, listen_fd_, &ev);
+            // Worker 0 watches the first listener either way.
+            w->listen_fd = listen_fd_;
+        } else if (reuseport_) {
+            w->listen_fd = make_listener(true);
+            if (w->listen_fd < 0) {
+                // Mid-setup failure (port raced away?): this worker
+                // simply accepts nothing; worker 0's socket still
+                // serves every connection.
+                IST_WARN("worker %u SO_REUSEPORT listener failed: %s", i,
+                         strerror(errno));
+            }
+        }
+        if (w->listen_fd >= 0) {
+            ev.data.fd = w->listen_fd;
+            epoll_ctl(w->epoll_fd, EPOLL_CTL_ADD, w->listen_fd, &ev);
         }
         workers_.push_back(std::move(w));
     }
@@ -208,11 +255,12 @@ bool Server::start() {
         wp->thread = std::thread([this, wp] { loop(*wp); });
     }
     IST_INFO("server listening on %s:%u (pool %llu MB, block %llu KB, "
-             "shm=%s, workers=%u)",
+             "shm=%s, workers=%u, reuseport=%d)",
              cfg_.host.c_str(), bound_port_,
              (unsigned long long)(cfg_.prealloc_bytes >> 20),
              (unsigned long long)(cfg_.block_size >> 10),
-             cfg_.enable_shm ? cfg_.shm_prefix.c_str() : "off", nworkers);
+             cfg_.enable_shm ? cfg_.shm_prefix.c_str() : "off", nworkers,
+             reuseport_ ? 1 : 0);
     return true;
 }
 
@@ -234,8 +282,12 @@ void Server::stop() {
         w->pending.clear();
         if (w->epoll_fd >= 0) close(w->epoll_fd);
         if (w->wake_fd >= 0) close(w->wake_fd);
+        // Per-worker SO_REUSEPORT listeners (worker 0 aliases
+        // listen_fd_, closed below).
+        if (w->listen_fd >= 0 && w->listen_fd != listen_fd_) {
+            close(w->listen_fd);
+        }
     }
-    workers_.clear();
     if (listen_fd_ >= 0) close(listen_fd_);
     listen_fd_ = -1;
     {
@@ -243,8 +295,14 @@ void Server::stop() {
         // snapshot (whose BlockRefs deallocate into mm_); serialize
         // teardown with both. Order matters: entries reference the disk
         // tier (DiskSpan) and the pool (Block), so the index goes first.
+        // workers_ clears under store_mu_ too — stats_json reads the
+        // per-worker counters through it.
         std::lock_guard<std::mutex> slk(snap_mu_);
         std::lock_guard<std::mutex> lk(store_mu_);
+        workers_.clear();
+        // Join the reclaimer/spill threads (they reference mm_/disk_)
+        // before any of those die.
+        if (index_) index_->stop_background();
         index_.reset();
         disk_.reset();
         mm_.reset();
@@ -435,15 +493,17 @@ long long Server::restore(const std::string& path) {
 
 std::string Server::stats_json() {
     std::lock_guard<std::mutex> lk(store_mu_);
-    char head[1024];
+    char head[2048];
     snprintf(
         head, sizeof(head),
         "{\"kvmap_len\": %zu, \"inflight\": %zu, \"leases\": %zu, "
         "\"pools\": %zu, \"pool_bytes\": %zu, \"used_bytes\": %zu, "
         "\"ops\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu, "
-        "\"connections\": %zu, \"workers\": %zu, \"evictions\": %llu, "
-        "\"spills\": %llu, "
+        "\"connections\": %zu, \"workers\": %zu, \"reuseport\": %d, "
+        "\"evictions\": %llu, \"spills\": %llu, "
         "\"promotes\": %llu, \"disk_bytes\": %llu, \"disk_used\": %llu, "
+        "\"reclaim_runs\": %llu, \"hard_stalls\": %llu, "
+        "\"spill_queue_depth\": %llu, \"spills_cancelled\": %llu, "
         "\"outq_bytes\": %llu, \"outq_cap\": %llu, \"reads_busy\": %llu, "
         "\"lease_bytes\": %llu, \"pins_busy\": %llu, "
         "\"lease_blocks_out\": %llu, \"leases_oom\": %llu, "
@@ -455,12 +515,16 @@ std::string Server::stats_json() {
         (unsigned long long)ops_.load(),
         (unsigned long long)bytes_in_.load(),
         (unsigned long long)bytes_out_.load(), size_t(n_conns_.load()),
-        size_t(cfg_.workers),
+        size_t(cfg_.workers), reuseport_ ? 1 : 0,
         (unsigned long long)(index_ ? index_->evictions() : 0),
         (unsigned long long)(index_ ? index_->spills() : 0),
         (unsigned long long)(index_ ? index_->promotes() : 0),
         (unsigned long long)(disk_ ? disk_->capacity_bytes() : 0),
         (unsigned long long)(disk_ ? disk_->used_bytes() : 0),
+        (unsigned long long)(index_ ? index_->reclaim_runs() : 0),
+        (unsigned long long)(index_ ? index_->hard_stalls() : 0),
+        (unsigned long long)(index_ ? index_->spill_queue_depth() : 0),
+        (unsigned long long)(index_ ? index_->spills_cancelled() : 0),
         (unsigned long long)outq_total_.load(std::memory_order_relaxed),
         (unsigned long long)cfg_.max_outq_bytes,
         (unsigned long long)reads_busy_.load(std::memory_order_relaxed),
@@ -491,7 +555,26 @@ std::string Server::stats_json() {
         out += entry;
         first = false;
     }
-    out += "}}";
+    out += "}, \"per_worker\": [";
+    // Per-worker traffic (ROADMAP item): one hot connection pinning one
+    // worker shows up here instead of hiding in the aggregates. Safe
+    // under store_mu_ — stop() clears workers_ under the same lock.
+    for (size_t i = 0; i < workers_.size(); ++i) {
+        const Worker& w = *workers_[i];
+        char entry[192];
+        snprintf(entry, sizeof(entry),
+                 "%s{\"worker\": %zu, \"connections\": %u, "
+                 "\"ops\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu}",
+                 i ? ", " : "", i,
+                 w.nconns.load(std::memory_order_relaxed),
+                 (unsigned long long)w.ops.load(std::memory_order_relaxed),
+                 (unsigned long long)w.bytes_in.load(
+                     std::memory_order_relaxed),
+                 (unsigned long long)w.bytes_out.load(
+                     std::memory_order_relaxed));
+        out += entry;
+    }
+    out += "]}";
     return out;
 }
 
@@ -515,8 +598,8 @@ void Server::loop(Worker& w) {
                 adopt_pending(w);
                 continue;
             }
-            if (fd == listen_fd_) {  // worker 0 only
-                accept_ready();
+            if (fd == w.listen_fd) {  // this worker's own acceptor
+                accept_ready(w, fd);
                 continue;
             }
             auto it = w.conns.find(fd);
@@ -552,21 +635,26 @@ void Server::adopt_pending(Worker& w) {
     }
 }
 
-void Server::accept_ready() {
-    // Runs on worker 0 (the only epoll watching listen_fd_).
+void Server::accept_ready(Worker& w, int ready_fd) {
     while (true) {
-        int fd = accept4(listen_fd_, nullptr, nullptr,
+        int fd = accept4(ready_fd, nullptr, nullptr,
                          SOCK_NONBLOCK | SOCK_CLOEXEC);
         if (fd < 0) return;
         tune_socket(fd);
-        // Least-loaded assignment by live connection count; ties go to
-        // the lowest index, so workers=1 puts everything on worker 0
+        // SO_REUSEPORT mode: the kernel already spread this connection
+        // to THIS worker's socket — adopt it locally, zero cross-thread
+        // hops. Fallback mode (worker 0 accepts everything): least-
+        // loaded assignment by live connection count; ties go to the
+        // lowest index, so workers=1 puts everything on worker 0
         // exactly like the historical single loop.
-        Worker* target = workers_[0].get();
-        for (auto& w : workers_) {
-            if (w->nconns.load(std::memory_order_relaxed) <
-                target->nconns.load(std::memory_order_relaxed)) {
-                target = w.get();
+        Worker* target = &w;
+        if (!reuseport_) {
+            target = workers_[0].get();
+            for (auto& wk : workers_) {
+                if (wk->nconns.load(std::memory_order_relaxed) <
+                    target->nconns.load(std::memory_order_relaxed)) {
+                    target = wk.get();
+                }
             }
         }
         auto c = std::make_unique<Conn>();
@@ -576,7 +664,7 @@ void Server::accept_ready() {
         target->nconns.fetch_add(1, std::memory_order_relaxed);
         n_conns_++;
         IST_DEBUG("accepted fd=%d -> worker %d", fd, target->idx);
-        if (target == workers_[0].get()) {
+        if (target == &w) {
             epoll_event ev{};
             ev.events = EPOLLIN;
             ev.data.fd = fd;
@@ -641,6 +729,7 @@ void Server::conn_readable(Conn& c) {
                 return close_conn(*c.w, c.fd);
             }
             bytes_in_ += uint64_t(r);
+            c.w->bytes_in.fetch_add(uint64_t(r), std::memory_order_relaxed);
             c.hdr_got += size_t(r);
             if (c.hdr_got < sizeof(WireHeader)) continue;
             if (!header_valid(c.hdr)) {
@@ -664,6 +753,7 @@ void Server::conn_readable(Conn& c) {
                 return close_conn(*c.w, c.fd);
             }
             bytes_in_ += uint64_t(r);
+            c.w->bytes_in.fetch_add(uint64_t(r), std::memory_order_relaxed);
             c.body_got += size_t(r);
             if (c.body_got < c.body.size()) continue;
             handle_message(c);
@@ -714,6 +804,8 @@ void Server::conn_readable(Conn& c) {
                     return close_conn(*c.w, c.fd);
                 }
                 bytes_in_ += uint64_t(r);
+                c.w->bytes_in.fetch_add(uint64_t(r),
+                                        std::memory_order_relaxed);
                 c.payload_left -= uint64_t(r);
                 size_t left = size_t(r);
                 while (left > 0 && c.wseg < c.wdest.size()) {
@@ -778,6 +870,7 @@ bool Server::flush_out(Conn& c) {
             return false;
         }
         bytes_out_ += uint64_t(w);
+        c.w->bytes_out.fetch_add(uint64_t(w), std::memory_order_relaxed);
         size_t left = size_t(w);
         // Advance cursors.
         if (!m.meta_done) {
@@ -850,6 +943,7 @@ void Server::respond(Conn& c, uint64_t seq, uint8_t op,
 
 void Server::handle_message(Conn& c) {
     ops_++;
+    c.w->ops.fetch_add(1, std::memory_order_relaxed);
     long long t0 = now_us();
     c.op_t0 = t0;
     uint8_t op = c.hdr.op;
@@ -1169,6 +1263,9 @@ void Server::op_lease(Conn& c) {
             want -= try_blocks;
         }
         mm_->maybe_extend();
+        // Lease grants consume pool blocks without passing through
+        // KVIndex::allocate — run the watermark check here.
+        index_->maybe_wake_reclaimer();
         epoch = index_->epoch();
         if (granted > 0) {
             uint64_t id =

@@ -88,6 +88,15 @@ struct ServerConfig {
     // min(4, cores - 2), floored at 1. The ISTPU_SERVER_WORKERS env var
     // overrides whatever is configured here (operator escape hatch).
     uint32_t workers = 1;
+    // Background reclaim watermarks (fractions of pool bytes): with
+    // eviction and/or a disk tier configured, a reclaimer thread wakes
+    // when occupancy crosses reclaim_high and evicts/spills down to
+    // reclaim_low, so puts normally find free blocks without paying
+    // reclaim inline (the inline path survives as the last resort and
+    // is counted as hard_stalls). reclaim_high >= 1.0 or <= 0 disables
+    // the background reclaimer (inline-only, the historical behavior).
+    double reclaim_high = 0.95;
+    double reclaim_low = 0.85;
 };
 
 class Server {
@@ -199,22 +208,39 @@ class Server {
     };
 
     // One epoll loop + thread. Connections are owned by exactly one
-    // worker; the only cross-thread touch is the acceptor's handoff
-    // through pending (mutex + eventfd wake).
+    // worker. With SO_REUSEPORT (the default for workers > 1) every
+    // worker owns its own listen socket bound to the same port and the
+    // KERNEL spreads accepts — a new connection is adopted by its
+    // accepting worker with no cross-thread hop at all. Where
+    // SO_REUSEPORT is unavailable (or ISTPU_NO_REUSEPORT=1), worker 0
+    // accepts and hands off through pending (mutex + eventfd wake) to
+    // the least-loaded worker — the historical path.
     struct Worker {
         int idx = 0;
         int epoll_fd = -1;
         int wake_fd = -1;
+        // This worker's own SO_REUSEPORT listen socket (-1 in fallback
+        // mode for workers > 0; worker 0 always watches listen_fd_).
+        int listen_fd = -1;
         std::thread thread;
         std::unordered_map<int, std::unique_ptr<Conn>> conns;  // loop only
         std::mutex pending_mu;
         std::vector<std::unique_ptr<Conn>> pending;  // acceptor → worker
         std::atomic<uint32_t> nconns{0};  // load metric for assignment
+        // Per-worker traffic counters (stats_json "per_worker"): makes
+        // load imbalance — one hot connection pinning one worker —
+        // visible to operators.
+        std::atomic<uint64_t> ops{0};
+        std::atomic<uint64_t> bytes_in{0};
+        std::atomic<uint64_t> bytes_out{0};
     };
 
     void loop(Worker& w);
     void adopt_pending(Worker& w);
-    void accept_ready();  // worker 0 only
+    // Accept on `w`'s ready listen socket: its own SO_REUSEPORT socket
+    // (adopt locally), or — fallback mode, worker 0 only — the shared
+    // listen_fd_ with least-loaded handoff.
+    void accept_ready(Worker& w, int ready_fd);
     void conn_readable(Conn& c);
     void conn_writable(Conn& c);
     bool flush_out(Conn& c);  // false => fatal error, close
@@ -252,6 +278,7 @@ class Server {
     ServerConfig cfg_;
     uint16_t bound_port_ = 0;
     int listen_fd_ = -1;
+    bool reuseport_ = false;  // per-worker SO_REUSEPORT acceptors active
     std::atomic<bool> running_{false};
     std::vector<std::unique_ptr<Worker>> workers_;
 

@@ -7,7 +7,9 @@
 # ISTPU_TSAN=1 switches to the ThreadSanitizer mode: the native core is
 # rebuilt with -fsanitize=thread (make -C native tsan) and the
 # concurrency smoke suite — the densest multi-worker/client
-# interleavings in the repo — runs against that library with the TSAN
+# interleavings in the repo, including the eviction/spill hammer that
+# drives the background reclaimer + async spill writer under
+# concurrent put/get/delete — runs against that library with the TSAN
 # runtime preloaded (the Python binary is uninstrumented, so the
 # runtime must initialize before dlopen). Pass extra pytest args/paths
 # to widen the sanitized selection; native/run_sanitizers.sh remains

@@ -113,6 +113,93 @@ def test_leg_timeout_salvages_partial_output(tmp_path, monkeypatch):
     assert res["tpu_error_partial"] is True
 
 
+def test_evict_leg_emits_pressure_keys():
+    """The eviction-pressure leg (ISSUE 3) must land its keys in the
+    artifact: put p50 under 2x-pool pressure, the ratio against the
+    no-pressure p50, and the hard-stall counter that shows whether the
+    background reclaimer kept reclaim off the put path."""
+    env = _env(600)
+    env["ISTPU_EVICT_KEYS"] = "256"  # small: keep the test fast
+    p = subprocess.run(
+        [sys.executable, BENCH, "--evict-leg", "0"], env=env,
+        capture_output=True, text=True, timeout=180,
+    )
+    assert p.returncode == 0, p.stderr[-400:]
+    outs = _parse_artifacts(
+        [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+    )
+    assert outs, p.stdout[-400:]
+    out = outs[-1]
+    assert out["evict_put_p50_us"] > 0
+    assert out["evict_nopress_put_p50_us"] > 0
+    assert out["evict_put_p50_ratio"] > 0
+    assert "hard_stalls" in out
+    assert "evict_reclaim_runs" in out
+
+
+def test_probe_failure_cached_across_runs(tmp_path, monkeypatch):
+    """A failed probe is persisted; the next run (within the TTL) skips
+    the probe subprocess entirely — no 180 s re-burn (the BENCH_r05
+    failure mode) — marks probe_skip_cached, and a SUCCESSFUL probe
+    clears the cache so a healed tunnel re-probes."""
+    sys.path.insert(0, os.path.dirname(BENCH))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    cache = tmp_path / ".probe_cache.json"
+    monkeypatch.setattr(bench, "_probe_cache_path", lambda: str(cache))
+    monkeypatch.delenv("ISTPU_PROBE_FORCE", raising=False)
+
+    # Run 1: the probe fails (wedged tunnel) -> failure persisted.
+    bench._PROBE_CACHE = None
+    calls = []
+
+    def failing_runner(flag, err_key, cap):
+        calls.append(flag)
+        return {err_key: "leg timed out after 180s"}
+
+    res = bench.run_probe_once(failing_runner)
+    assert res["probe_error"] == "leg timed out after 180s"
+    assert calls == ["--probe-leg"]
+    assert cache.exists()
+
+    # Run 2 (fresh process simulated by clearing the in-run cache): the
+    # cached failure short-circuits — the runner must NOT be invoked.
+    bench._PROBE_CACHE = None
+
+    def must_not_run(flag, err_key, cap):  # pragma: no cover
+        raise AssertionError("probe re-ran despite cached failure")
+
+    res2 = bench.run_probe_once(must_not_run)
+    assert res2["probe_skip_cached"] is True
+    assert res2["probe_error"] == "leg timed out after 180s"
+
+    # Expired cache re-probes.
+    bench._PROBE_CACHE = None
+    monkeypatch.setenv("ISTPU_PROBE_CACHE_TTL", "0")
+    calls.clear()
+    bench.run_probe_once(failing_runner)
+    assert calls == ["--probe-leg"]
+    monkeypatch.delenv("ISTPU_PROBE_CACHE_TTL")
+
+    # A successful probe clears the cache. (The TTL=0 step just re-
+    # cached a fresh failure; ISTPU_PROBE_FORCE=1 is the operator's
+    # bypass for exactly this "try again NOW" case.)
+    bench._PROBE_CACHE = None
+    monkeypatch.setenv("ISTPU_PROBE_FORCE", "1")
+
+    def healthy_runner(flag, err_key, cap):
+        return {"probe_ok": True, "probe_h2d_MBps": 100.0}
+
+    res3 = bench.run_probe_once(healthy_runner)
+    assert res3.get("probe_ok") is True
+    assert "probe_skip_cached" not in res3
+    assert not cache.exists()
+    bench._PROBE_CACHE = None  # leave no state for other tests
+
+
 def test_sigkill_mid_run_leaves_valid_artifact():
     p = subprocess.Popen(
         [sys.executable, BENCH], env=_env(3600),

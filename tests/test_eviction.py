@@ -1,5 +1,16 @@
 """LRU eviction (beyond reference parity): a full pool evicts cold
-committed entries instead of failing allocations forever."""
+committed entries instead of failing allocations forever.
+
+These tests assert exact victim ORDER and exact victim COUNTS, so they
+pin down the deterministic configuration of the reclaim pipeline:
+ISTPU_EXACT_LRU=1 makes the segmented LRU's victim selection exactly
+global (per-victim eligibility re-scan — the documented escape hatch
+for the default tail-age approximation), and reclaim_high=1.0 disables
+the background watermark reclaimer, whose asynchronous evictions would
+otherwise race the asserted counts on these 4-block pools.
+"""
+
+import os
 
 import numpy as np
 import pytest
@@ -16,6 +27,15 @@ from infinistore_tpu import (
 PAGE = 16 << 10  # one 16 KB block per key
 
 
+@pytest.fixture(autouse=True)
+def exact_lru():
+    """The env var is read at server start (KVIndex construction), so
+    setting it around each test covers every server the test boots."""
+    os.environ["ISTPU_EXACT_LRU"] = "1"
+    yield
+    os.environ.pop("ISTPU_EXACT_LRU", None)
+
+
 @pytest.fixture
 def evict_server():
     srv = InfiniStoreServer(
@@ -24,6 +44,7 @@ def evict_server():
             prealloc_size=(64 << 10) / (1 << 30),  # 4 blocks of 16 KB
             minimal_allocate_size=16,
             enable_eviction=True,
+            reclaim_high=1.0,  # deterministic: inline eviction only
         )
     )
     srv.start()
@@ -116,6 +137,7 @@ def test_small_values_evict_minimally(rng):
             prealloc_size=(64 << 10) / (1 << 30),  # 4 blocks of 16 KB
             minimal_allocate_size=16,
             enable_eviction=True,
+            reclaim_high=1.0,  # exact count asserted below
         )
     )
     srv.start()
