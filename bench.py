@@ -330,6 +330,109 @@ def bench_evict(nkeys=None, block_kb=4, batch=16):
     }
 
 
+def bench_trace_overhead(nkeys=None, block_kb=4, passes=3):
+    """Tracing-overhead leg (ISSUE 4 acceptance: ratio <= 1.05 on CI).
+
+    The stream shape (framed TCP, the DCN stand-in) with tracing ON
+    versus OFF, measured as single-key read p50 — the op where the
+    per-op cost (span record + trace-id strip) is largest relative to
+    the work. Tracing is flipped through ServerConfig.trace, the exact
+    switch ISTPU_TRACE=1 sets (the env var merely overrides the config
+    at Server::start, so this measures the identical code path without
+    leaking a process-global env into the other legs), and the client
+    stamps per-op trace ids so every frame pays the full traced path.
+    Emits:
+      trace_p50_read_us      traced single-key read p50
+      notrace_p50_read_us    untraced, same call shape
+      trace_overhead_p50_ratio  traced / untraced (best-of-passes)
+      trace_spans            spans recorded during the traced leg
+    """
+    import os
+
+    import numpy as np
+
+    from infinistore_tpu import (
+        ClientConfig,
+        InfiniStoreServer,
+        InfinityConnection,
+        ServerConfig,
+    )
+
+    if nkeys is None:
+        nkeys = int(os.environ.get("ISTPU_TRACE_KEYS", "512"))
+    block_bytes = block_kb << 10
+
+    def run_leg(trace, passes=passes):
+        # Pin the env to the leg's setting: ISTPU_TRACE overrides the
+        # config at Server::start, so an inherited ISTPU_TRACE=1 (an
+        # operator benchmarking a traced deployment) would otherwise
+        # make BOTH legs traced and the ratio vacuously ~1.0 (and =0
+        # would zero the traced leg's spans).
+        saved = os.environ.get("ISTPU_TRACE")
+        os.environ["ISTPU_TRACE"] = "1" if trace else "0"
+        try:
+            srv = InfiniStoreServer(
+                ServerConfig(
+                    service_port=0,
+                    prealloc_size=max(2 * nkeys * block_bytes, 1 << 20)
+                    / (1 << 30),
+                    minimal_allocate_size=block_kb,
+                    trace=trace,
+                )
+            )
+            # The native server resolves the env when start() creates
+            # it, so the pin must cover the start call.
+            port = srv.start()
+        finally:
+            if saved is None:
+                os.environ.pop("ISTPU_TRACE", None)
+            else:
+                os.environ["ISTPU_TRACE"] = saved
+        try:
+            conn = InfinityConnection(
+                ClientConfig(
+                    host_addr="127.0.0.1", service_port=port,
+                    connection_type="STREAM", trace=trace,
+                )
+            )
+            conn.connect()
+            try:
+                src = np.random.default_rng(5).integers(
+                    0, 255, block_bytes, dtype=np.uint8
+                )
+                for i in range(nkeys):
+                    conn.put_cache(src, [(f"tr{i}", 0)], block_bytes)
+                conn.sync()
+                dst = np.zeros(block_bytes, dtype=np.uint8)
+                # Best-of-passes p50 over single-key reads: CI noise is
+                # ~2x run to run, far above the <=5%% budget under test.
+                p50 = None
+                for _ in range(passes):
+                    lats = []
+                    for i in range(nkeys):
+                        t0 = time.perf_counter()
+                        conn.read_cache(dst, [(f"tr{i}", 0)], block_bytes)
+                        lats.append(time.perf_counter() - t0)
+                    p = float(np.percentile(np.array(lats) * 1e6, 50))
+                    p50 = p if p50 is None else min(p50, p)
+                return p50, srv.stats()
+            finally:
+                conn.close()
+        finally:
+            srv.stop()
+
+    notrace_p50, _ = run_leg(False)
+    trace_p50, stats = run_leg(True)
+    return {
+        "trace_nkeys": nkeys,
+        "trace_p50_read_us": round(trace_p50, 1),
+        "notrace_p50_read_us": round(notrace_p50, 1),
+        "trace_overhead_p50_ratio": round(trace_p50 / notrace_p50, 3)
+        if notrace_p50 else 0.0,
+        "trace_spans": int(stats.get("trace", {}).get("spans", 0)),
+    }
+
+
 def bench_sharded(n_shards=4, nkeys=4096, block_kb=4, workers=1,
                   io_threads=None, passes=2):
     """Sharded-store leg (BASELINE config 5 scaled to one host): the same
@@ -2171,6 +2274,14 @@ def main():
         except Exception as e:
             print(json.dumps({"evict_error": str(e)[:200]}))
         return 0
+    if "--trace-leg" in sys.argv:
+        # Tracing-overhead A/B; boots its own two servers (trace
+        # on/off), port argument accepted but unused.
+        try:
+            print(json.dumps(bench_trace_overhead()))
+        except Exception as e:
+            print(json.dumps({"trace_overhead_error": str(e)[:200]}))
+        return 0
 
     import os
 
@@ -2294,6 +2405,15 @@ def main():
             out["stream_rtt_error"] = str(e)[:200]
         publish()
         srv.purge()
+        # Tracing-overhead leg (ISSUE 4 acceptance: <= 1.05): stream
+        # shape with span rings on vs off; boots its own two small
+        # servers so the trace flag never touches the primary metric's
+        # server.
+        try:
+            out.update(bench_trace_overhead())
+        except Exception as e:
+            out["trace_overhead_error"] = str(e)[:200]
+        publish()
         # Sharded leg is CPU-only: run it BEFORE any tunnel-bound leg so
         # a wedged tunnel can never cost it (it boots its own servers;
         # the idle primary server costs nothing meanwhile).

@@ -84,7 +84,8 @@ def _decls(lib):
             c.c_void_p,
             [c.c_char_p, c.c_uint16, c.c_uint64, c.c_uint64, c.c_int,
              c.c_uint64, c.c_int, c.c_char_p, c.c_int, c.c_char_p,
-             c.c_uint64, c.c_uint64, c.c_uint32, c.c_double, c.c_double],
+             c.c_uint64, c.c_uint64, c.c_uint32, c.c_double, c.c_double,
+             c.c_int],
         ),
         ("ist_server_start", c.c_int, [c.c_void_p]),
         ("ist_server_stop", None, [c.c_void_p]),
@@ -92,6 +93,11 @@ def _decls(lib):
         ("ist_server_kvmap_len", c.c_uint64, [c.c_void_p]),
         ("ist_server_purge", c.c_uint64, [c.c_void_p]),
         ("ist_server_stats", c.c_int, [c.c_void_p, c.c_char_p, c.c_int]),
+        (
+            "ist_server_trace",
+            c.c_longlong,
+            [c.c_void_p, c.c_char_p, c.c_longlong],
+        ),
         ("ist_server_snapshot", c.c_longlong, [c.c_void_p, c.c_char_p]),
         ("ist_server_restore", c.c_longlong, [c.c_void_p, c.c_char_p]),
         ("ist_server_shm_prefix", c.c_int, [c.c_void_p, c.c_char_p, c.c_int]),
@@ -106,6 +112,7 @@ def _decls(lib):
         ("ist_conn_close", None, [c.c_void_p]),
         ("ist_conn_destroy", None, [c.c_void_p]),
         ("ist_conn_shm_active", c.c_int, [c.c_void_p]),
+        ("ist_conn_set_trace", None, [c.c_void_p, c.c_uint64]),
         ("ist_conn_broken", c.c_int, [c.c_void_p]),
         (
             "ist_reclaim_orphans",
@@ -220,9 +227,10 @@ def _decls(lib):
         ("ist_mm_num_pools", c.c_uint64, [c.c_void_p]),
     ]
     # ABI probe FIRST: a stale prebuilt library would misparse the
-    # v5 ist_server_create argument list (reclaim watermarks), the v4
-    # multi-worker knob or the v3 ist_conn_create lease knobs, or lack
-    # those entry points entirely. A missing or old-version symbol
+    # v6 ist_server_create argument list (trace flag), the v5 reclaim
+    # watermarks, the v4 multi-worker knob or the v3 ist_conn_create
+    # lease knobs, or lack those entry points (ist_server_trace,
+    # ist_conn_set_trace) entirely. A missing or old-version symbol
     # fails loudly here instead.
     try:
         lib.ist_abi_version.restype = ct.c_uint32
@@ -230,9 +238,9 @@ def _decls(lib):
         ver = int(lib.ist_abi_version())
     except AttributeError:
         ver = 1
-    if ver < 5:
+    if ver < 6:
         raise RuntimeError(
-            f"stale native library at {_LIB_PATH} (ABI v{ver} < v5): "
+            f"stale native library at {_LIB_PATH} (ABI v{ver} < v6): "
             "rebuild with `make -C native` (or delete the .so to let "
             "the import auto-build)"
         )

@@ -58,6 +58,14 @@ class ClientConfig:
         # future allocations) and the deferred-commit flush watermark.
         self.lease_blocks = kwargs.get("lease_blocks", 4096)
         self.flush_size = kwargs.get("flush_size", 16 << 20)  # bytes
+        # Request tracing: when True, each logical op (put_cache /
+        # read_cache / allocate batch) stamps a fresh 8-byte trace id
+        # onto its wire frames, so the server's span rings (/trace,
+        # server-side --trace required) stitch one client call across
+        # lease commits, sharded sub-calls and server-side sub-spans.
+        # Off by default: one extra ctypes call per op when on, zero
+        # cost when off. Old servers ignore the flagged frames.
+        self.trace = kwargs.get("trace", False)
         if "INFINISTORE_LOG_LEVEL" in os.environ:
             self.log_level = os.environ["INFINISTORE_LOG_LEVEL"].lower()
         else:
@@ -148,6 +156,13 @@ class ServerConfig:
         # the historical inline-only behavior.
         self.reclaim_high = kwargs.get("reclaim_high", 0.95)
         self.reclaim_low = kwargs.get("reclaim_low", 0.85)
+        # Request tracing (--trace / ISTPU_TRACE=1 env override): native
+        # per-worker span rings recording each op's lifecycle (parse,
+        # stripe-lock wait, copy, disk IO, commit) plus reclaim/spill
+        # tracks; drained as Perfetto-loadable Chrome trace JSON via
+        # GET /trace. Compiled in but off by default — the rings record
+        # nothing and allocate nothing when disabled.
+        self.trace = kwargs.get("trace", False)
         # Accepted for reference CLI compatibility; unused on TPU hosts.
         self.dev_name = kwargs.get("dev_name", "")
         self.link_type = kwargs.get("link_type", "")

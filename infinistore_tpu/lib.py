@@ -186,6 +186,17 @@ class InfinityConnection:
         self._conn_gen = 0
         self._dead_handles = []
         self._ever_connected = False
+        # Request tracing (config.trace): each logical op stamps a
+        # fresh 8-byte id onto its wire frames so the server's span
+        # rings stitch the op's sub-rpcs together. Random base so two
+        # clients' ids cannot collide; last_trace_id is what tests (and
+        # humans grepping a Perfetto export) look for.
+        import os as _os
+
+        self._trace_base = int.from_bytes(_os.urandom(8), "little")
+        self._trace_ctr = 0
+        self._trace_pinned = False  # externally set id (sharded fan-out)
+        self.last_trace_id = 0
 
     # ------------------------------------------------------------------
     # connection lifecycle
@@ -428,6 +439,35 @@ class InfinityConnection:
             time.sleep(delay)
             delay = min(delay * 2, 0.05)
 
+    def _stamp_trace(self):
+        """Stamp a fresh per-logical-op trace id onto the native
+        connection (no-op unless ``config.trace``). Every wire frame
+        sent until the next stamp carries this id — including a
+        deferred lease-commit flush triggered by this op."""
+        if not self.config.trace or not self._h:
+            return 0
+        if self._trace_pinned:
+            # A caller spanning one logical op across connections (the
+            # sharded client) owns the id; per-op stamping stands down.
+            return self.last_trace_id
+        self._trace_ctr += 1
+        tid = (self._trace_base + self._trace_ctr) & ((1 << 64) - 1)
+        if tid == 0:
+            tid = 1
+        self.last_trace_id = tid
+        self._lib.ist_conn_set_trace(self._h, tid)
+        return tid
+
+    def set_trace_id(self, trace_id):
+        """Set (or clear, with 0) the trace id carried by outgoing
+        frames — for callers that span one logical op across several
+        connections (the sharded client fans one id out per shard).
+        While set, per-op auto-stamping stands down; 0 re-enables it."""
+        self._check()
+        self._trace_pinned = trace_id != 0
+        self.last_trace_id = trace_id
+        self._lib.ist_conn_set_trace(self._h, trace_id)
+
     def _reclaim_orphans(self, keys):
         # One batched rpc; the server erases only entries that are
         # uncommitted AND have no live inflight token (their writer died
@@ -452,6 +492,7 @@ class InfinityConnection:
         skipped on write (first-writer-wins dedup, reference
         infinistore.cpp:353-359)."""
         self._check()
+        self._stamp_trace()
         return self._run_reconnecting(
             lambda: self._allocate_once(keys, page_size_in_bytes),
             keys=keys,
@@ -750,6 +791,7 @@ class InfinityConnection:
         batch; retrying the whole put is safe (committed keys dedup
         against identical content)."""
         self._check()
+        self._stamp_trace()
         return self._run_reconnecting(
             lambda: self._put_cache_once(cache, blocks, page_size),
             keys=[k for k, _ in blocks],
@@ -772,6 +814,7 @@ class InfinityConnection:
 
     async def put_cache_async(self, cache, blocks, page_size):
         self._check()
+        self._stamp_trace()
         if self.shm_connected and self.config.use_lease:
             # Lease fast path, same as the sync put_cache: the native
             # call blocks on carve+copy (and occasionally an OP_LEASE
@@ -863,6 +906,7 @@ class InfinityConnection:
         :class:`InfiniStoreKeyNotFound` (reference returns KEY_NOT_FOUND,
         infinistore.cpp:607)."""
         self._check()
+        self._stamp_trace()
         return self._run_reconnecting(
             lambda: self._read_cache_once(cache, blocks, page_size)
         )
@@ -902,6 +946,7 @@ class InfinityConnection:
 
     async def read_cache_async(self, cache, blocks, page_size):
         self._check()
+        self._stamp_trace()
         loop = asyncio.get_running_loop()
         # Deep pipelining is exactly how a healthy client can trip the
         # server's per-connection outq cap, so BUSY here is expected
@@ -1062,15 +1107,22 @@ class InfinityConnection:
 
     def stats(self):
         self._check()
-        # 64 KB: per_worker (up to 64 workers) + op_stats must never
-        # truncate into unparseable JSON.
-        buf = ct.create_string_buffer(65536)
-        st = self._lib.ist_client_stats(self._h, buf, len(buf))
-        if st != OK:
-            raise InfiniStoreError(st, "stats failed")
         import json
 
-        return json.loads(buf.value.decode())
+        # Grow-on-truncation: the rpc returns the full JSON blob but
+        # the C layer clips it to the caller's buffer (NUL-terminated),
+        # so a value that exactly fills cap-1 bytes means truncation —
+        # retry larger instead of handing json.loads a clipped blob as
+        # workers x ops x histogram buckets grow.
+        cap = 65536
+        while True:
+            buf = ct.create_string_buffer(cap)
+            st = self._lib.ist_client_stats(self._h, buf, cap)
+            if st != OK:
+                raise InfiniStoreError(st, "stats failed")
+            if len(buf.value) < cap - 1:
+                return json.loads(buf.value.decode())
+            cap *= 4
 
     # ------------------------------------------------------------------
     # zero-copy pool access (used by infinistore_tpu.tpu)

@@ -58,6 +58,7 @@ class InfiniStoreServer:
             int(cfg.workers),
             ct.c_double(cfg.reclaim_high),
             ct.c_double(cfg.reclaim_low),
+            1 if cfg.trace else 0,
         )
         port = self._lib.ist_server_start(self._h)
         if port < 0:
@@ -79,12 +80,34 @@ class InfiniStoreServer:
     def purge(self):
         return int(self._lib.ist_server_purge(self._h))
 
+    def _read_blob(self, fn, initial=65536):
+        """Call a snprintf-style native getter (returns the REQUIRED
+        length; copies at most cap-1 bytes) and regrow until the whole
+        blob fits — the stats JSON (histogram buckets x ops x workers)
+        and especially the trace export outgrow any fixed buffer."""
+        cap = initial
+        while True:
+            buf = ct.create_string_buffer(cap)
+            n = int(fn(self._h, buf, cap))
+            if n < 0:
+                raise Exception("native blob read failed")
+            if n < cap:
+                return buf.value.decode()
+            cap = n + 1
+
     def stats(self):
-        # 64 KB: the per_worker array (up to 64 workers) plus op_stats
-        # must never truncate into unparseable JSON.
-        buf = ct.create_string_buffer(65536)
-        self._lib.ist_server_stats(self._h, buf, len(buf))
-        return json.loads(buf.value.decode())
+        return json.loads(self._read_blob(self._lib.ist_server_stats))
+
+    def trace_json(self):
+        """Drain the span rings as Chrome trace-event JSON text
+        (Perfetto-loadable; served raw by ``GET /trace``). With tracing
+        off (no ``trace=True`` / ``--trace`` / ``ISTPU_TRACE=1``) the
+        event list is empty."""
+        return self._read_blob(self._lib.ist_server_trace, initial=1 << 20)
+
+    def trace(self):
+        """``trace_json`` parsed into a dict ({"traceEvents": [...]})."""
+        return json.loads(self.trace_json())
 
     def snapshot(self, path):
         """Write every committed entry to ``path`` (atomic tmp+rename).
@@ -214,17 +237,91 @@ def _prometheus_metrics(stats):
         lines.append(
             f'infinistore_op_count_total{{op="{op}"}} {s.get("count", 0)}'
         )
-    lines.append(
-        "# HELP infinistore_op_latency_us per-op handler latency "
-        "(us, histogram percentiles)"
+
+    def render_histogram(name, help_, series):
+        """True Prometheus histogram from the native power-of-two
+        buckets: bucket b counts integer-microsecond observations in
+        [2^b, 2^(b+1)), whose INCLUSIVE upper bound — Prometheus
+        defines bucket{le=X} as count(obs <= X) — is 2^(b+1)-1 (an op
+        of exactly 4 us lives in [4,8) and must be counted under
+        le="7", not appear only at le="8"); the last native bucket
+        absorbs everything slower and maps to +Inf. series:
+        [(labels, entry)] where entry is a stats hist dict
+        ({hist, total_us, count})."""
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} histogram")
+        rendered = []
+        for labels, s in series:
+            hist = s.get("hist") or []
+            sep = "," if labels else ""
+            cum = 0
+            for b, n in enumerate(hist):
+                cum += n
+                le = (
+                    "+Inf"
+                    if b == len(hist) - 1
+                    else str((1 << (b + 1)) - 1)
+                )
+                lines.append(
+                    f'{name}_bucket{{{labels}{sep}le="{le}"}} {cum}'
+                )
+            rendered.append((labels, s, cum))
+        # _sum / _count after every _bucket line: the exposition format
+        # wants each sample name's lines contiguous.
+        for labels, s, _ in rendered:
+            brace = f"{{{labels}}}" if labels else ""
+            lines.append(f'{name}_sum{brace} {s.get("total_us", 0)}')
+        for labels, s, cum in rendered:
+            brace = f"{{{labels}}}" if labels else ""
+            lines.append(f'{name}_count{brace} {s.get("count", cum)}')
+
+    render_histogram(
+        "infinistore_op_latency_us",
+        "per-op handler latency (us; power-of-two buckets)",
+        [(f'op="{op}"', s) for op, s in op_stats.items()],
     )
-    lines.append("# TYPE infinistore_op_latency_us gauge")
+    # p50/p99 convenience gauges (bucket midpoints) under their own
+    # metric name — the same family name cannot be both a histogram and
+    # a gauge in the exposition format.
+    lines.append(
+        "# HELP infinistore_op_latency_quantile_us per-op handler "
+        "latency (us, histogram-midpoint percentiles)"
+    )
+    lines.append("# TYPE infinistore_op_latency_quantile_us gauge")
     for op, s in op_stats.items():
         for q, label in (("p50_us", "0.5"), ("p99_us", "0.99")):
             lines.append(
-                f'infinistore_op_latency_us{{op="{op}",'
+                f'infinistore_op_latency_quantile_us{{op="{op}",'
                 f'quantile="{label}"}} {s.get(q, 0)}'
             )
+    # Always-on wait histograms: where an op's time went while it was
+    # NOT running — contended stripe-lock acquisition and the acceptor
+    # handoff queue.
+    waits = stats.get("wait_stats", {})
+    render_histogram(
+        "infinistore_stripe_lock_wait_us",
+        "contended stripe-lock wait on the data plane (us)",
+        [("", waits.get("stripe_lock_wait", {}))],
+    )
+    render_histogram(
+        "infinistore_handoff_queue_wait_us",
+        "accept-handoff queue wait, enqueue to adoption (us)",
+        [("", waits.get("handoff_queue_wait", {}))],
+    )
+    trace = stats.get("trace", {})
+    lines.append(
+        "# HELP infinistore_trace_enabled request tracing active (0/1)"
+    )
+    lines.append("# TYPE infinistore_trace_enabled gauge")
+    lines.append(f'infinistore_trace_enabled {trace.get("enabled", 0)}')
+    lines.append(
+        "# HELP infinistore_trace_spans_total spans recorded to the "
+        "trace rings"
+    )
+    lines.append("# TYPE infinistore_trace_spans_total counter")
+    lines.append(
+        f'infinistore_trace_spans_total {trace.get("spans", 0)}'
+    )
     return "\n".join(lines) + "\n"
 
 
@@ -255,6 +352,17 @@ def make_control_plane(server: InfiniStoreServer, snapshot_path=None):
                 self._send(200, server.stats())
             elif self.path == "/metrics":
                 self._send_text(200, _prometheus_metrics(server.stats()))
+            elif self.path == "/trace":
+                # Chrome trace-event JSON, already serialized natively:
+                # save the body to a file and load it in Perfetto
+                # (ui.perfetto.dev) or chrome://tracing. Empty event
+                # list unless the server runs with --trace/ISTPU_TRACE=1.
+                body = server.trace_json().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             elif self.path == "/health":
                 self._send(200, {"status": "ok"})
             else:
@@ -356,6 +464,12 @@ def parse_args(argv=None):
     p.add_argument("--reclaim-low", type=float, default=0.85,
                    help="occupancy fraction the background reclaimer "
                         "drives the pool down to per pass")
+    p.add_argument("--trace", action="store_true",
+                   help="record per-worker request-lifecycle span rings "
+                        "(parse, stripe-lock wait, copy, disk IO, "
+                        "commit, reclaim/spill tracks); drain as "
+                        "Perfetto-loadable JSON via GET /trace. "
+                        "ISTPU_TRACE=1/0 overrides")
     p.add_argument("--warmup", action="store_true",
                    help="run a warmup round-trip after startup")
     p.add_argument("--snapshot-path", default="",
@@ -404,6 +518,7 @@ def main(argv=None):
         workers=args.workers,
         reclaim_high=args.reclaim_high,
         reclaim_low=args.reclaim_low,
+        trace=args.trace,
     )
     server = InfiniStoreServer(config)
     server.start()

@@ -151,6 +151,14 @@ class ShardedConnection:
         self._health_lock = threading.Lock()
         self._reconnector = None
         self._pool = None
+        # Request tracing: ONE id per logical sharded op, pinned onto
+        # every shard connection so the per-shard sub-calls stitch to a
+        # single track group in each server's /trace export. Enabled
+        # when any shard's ClientConfig sets trace=True.
+        self._trace = any(getattr(c, "trace", False) for c in configs)
+        self._trace_base = int.from_bytes(os.urandom(8), "little")
+        self._trace_ctr = 0
+        self.last_trace_id = 0
 
     def connect(self):
         """Connect every shard. In degrade mode a shard that is down at
@@ -261,6 +269,25 @@ class ShardedConnection:
 
     def shard_of(self, key):
         return _shard_of(key, self.n)
+
+    def set_trace_id(self, trace_id):
+        """Pin ``trace_id`` onto every healthy shard connection (0
+        clears and re-enables per-connection auto-stamping)."""
+        self.last_trace_id = trace_id
+        for s, c in enumerate(self.conns):
+            if c.connected and not self.degraded[s]:
+                try:
+                    c.set_trace_id(trace_id)
+                except Exception:
+                    pass  # a dying shard must not fail the fan-out
+        return trace_id
+
+    def _stamp_trace(self):
+        if not self._trace:
+            return 0
+        self._trace_ctr += 1
+        tid = (self._trace_base + self._trace_ctr) & ((1 << 64) - 1)
+        return self.set_trace_id(tid or 1)
 
     # -- failure handling ----------------------------------------------
 
@@ -455,6 +482,7 @@ class ShardedConnection:
         pin cache across batches); the final sync() fans out and flushes
         every shard's deferred commit batch. Lease-less shards take the
         classic allocate+write path unchanged."""
+        self._stamp_trace()
         if any(c.config.use_lease for c in self.conns):
             parts = {}
             for k, off in blocks:
@@ -552,6 +580,7 @@ class ShardedConnection:
         still land in ``cache``, then the call raises
         InfiniStoreKeyNotFound for the unreachable keys — identical to
         the evicted-key miss every cache-style caller already handles."""
+        self._stamp_trace()
         parts = list(self._read_parts(blocks).items())
         calls, tags = [], []
         for s, pairs in parts:

@@ -1,22 +1,46 @@
 """Tracing/profiling helpers joining the two observability planes.
 
-The store side already publishes native per-op latency histograms
-(/stats, /metrics — beyond the reference, which has only ad-hoc chrono
-logs, SURVEY.md §5); the engine side has jax's profiler. This module
-glues them for one workload window:
+The store side publishes native per-op latency histograms (/stats,
+/metrics) AND — with ``ServerConfig(trace=True)`` / ``--trace`` /
+``ISTPU_TRACE=1`` — per-worker span rings drained as Chrome trace-event
+JSON (/trace; beyond the reference, which has only ad-hoc chrono logs,
+``infinistore.cpp:1114``); the engine side has jax's profiler. This
+module glues them for one workload window:
 
-    with profile_window(conn, trace_dir="/tmp/tb") as w:
+    with profile_window(server, trace_dir="/tmp/tb", trace=True) as w:
         run_workload()
-    print(w.op_deltas)      # store ops attributable to the window
-    # trace_dir holds the XLA/device trace, viewable in TensorBoard /
-    # Perfetto.
+    print(w.op_deltas)      # store ops (and reclaim runs) in the window
+    print(w.trace_path)     # ONE Perfetto file: store spans + XLA trace
 
-`op_deltas` subtracts the server's cumulative per-op counters across
-the window, so a workload's store traffic is separable from everything
-else the server has served.
+``op_deltas`` subtracts the server's cumulative per-op counters across
+the window — including the reclaim pipeline gauges (``reclaim_runs``,
+``hard_stalls``, ``spills_cancelled``), so a window shows whether
+background reclaim ran inside it. ``trace=True`` additionally drains
+the store-side span rings at window close, clips them to the window
+(both sides of the native plane share CLOCK_MONOTONIC) and merges them
+with the jax profiler timeline into a single Perfetto-loadable file.
 """
 
+import glob
+import gzip
+import json
+import os
+import time
 from contextlib import contextmanager
+
+# Cumulative top-level stats counters worth windowing alongside the
+# per-op table: traffic, and the PR-3 reclaim pipeline gauges (a window
+# with nonzero reclaim_runs/hard_stalls explains its own tail).
+_WINDOW_COUNTERS = (
+    "bytes_in",
+    "bytes_out",
+    "reclaim_runs",
+    "hard_stalls",
+    "spills_cancelled",
+    "evictions",
+    "spills",
+    "promotes",
+)
 
 
 def _op_counts(stats):
@@ -29,9 +53,12 @@ def _op_counts(stats):
     out = {}
     for op, s in (stats.get("op_stats") or {}).items():
         out[op] = int(s.get("count", 0))
-    out["bytes_in"] = int(stats.get("bytes_in", 0))
-    out["bytes_out"] = int(stats.get("bytes_out", 0))
+    for key in _WINDOW_COUNTERS:
+        out[key] = int(stats.get(key, 0))
     return out
+
+
+_MERGED_NAME = "merged.trace.json.gz"
 
 
 class ProfileWindow:
@@ -39,21 +66,92 @@ class ProfileWindow:
         self.op_deltas = {}
         self.stats_before = {}
         self.stats_after = {}
+        # trace=True outputs
+        self.store_trace = None  # dict: {"traceEvents": [...]}
+        self.trace_path = None   # merged Perfetto file on disk
+
+
+def _store_trace_source(obj):
+    """Find a store-side trace getter on ``obj`` (InfiniStoreServer
+    exposes ``trace()``; anything duck-typed alike works)."""
+    fn = getattr(obj, "trace", None)
+    return fn if callable(fn) else None
+
+
+def _merge_perfetto(trace_dir, store_events):
+    """Merge the store spans into the newest jax profiler trace under
+    ``trace_dir`` (TensorBoard layout: plugins/profile/*/
+    *.trace.json.gz); fall back to a store-only file when jax wrote
+    nothing. Returns the merged file's path.
+
+    Timebase note: XLA events carry their own clock offsets, so the two
+    planes land as separate process groups in Perfetto rather than one
+    aligned axis — within the store group, worker/reclaim/spill tracks
+    DO share one monotonic clock and overlap faithfully.
+    """
+    merged = {"traceEvents": []}
+    base = None
+    # Exclude our own output: a later window against the same trace_dir
+    # must not pick a previous merged file as its "jax" base and
+    # re-accumulate the earlier window's store spans.
+    candidates = sorted(
+        (
+            p
+            for p in glob.glob(
+                os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                recursive=True,
+            )
+            if os.path.basename(p) != _MERGED_NAME
+        ),
+        key=os.path.getmtime,
+    )
+    if candidates:
+        base = candidates[-1]
+        with gzip.open(base, "rt") as f:
+            merged = json.load(f)
+        if not isinstance(merged.get("traceEvents"), list):
+            merged["traceEvents"] = []
+    merged["traceEvents"].extend(store_events)
+    out_path = os.path.join(trace_dir, _MERGED_NAME)
+    with gzip.open(out_path, "wt") as f:
+        json.dump(merged, f)
+    return out_path
 
 
 @contextmanager
-def profile_window(conn_or_server=None, trace_dir=None):
+def profile_window(conn_or_server=None, trace_dir=None, trace=False):
     """Profile one workload window.
 
-    conn_or_server: anything with ``.stats()`` (InfinityConnection or
-        InfiniStoreServer) — per-op counter deltas land in
-        ``window.op_deltas``. Optional.
+    conn_or_server: anything with ``.stats()`` (InfinityConnection,
+        ShardedConnection or InfiniStoreServer) — per-op counter deltas
+        land in ``window.op_deltas``. Optional.
     trace_dir: when set, wraps the window in ``jax.profiler`` so the
         device/XLA timeline lands there (TensorBoard/Perfetto format).
+    trace: when True, also drain the STORE-side span rings at window
+        close (requires ``conn_or_server`` to expose ``.trace()`` — an
+        ``InfiniStoreServer`` whose config enables tracing; the rings
+        live server-side, so a plain client cannot drain them) and
+        merge them with the jax trace into ``window.trace_path``
+        (``<trace_dir>/merged.trace.json.gz``; store-only file when jax
+        wrote no timeline; ``window.store_trace`` always gets the
+        span dict, even without a trace_dir).
     """
     w = ProfileWindow()
+    trace_fn = None
+    if trace:
+        trace_fn = _store_trace_source(conn_or_server)
+        if trace_fn is None:
+            raise ValueError(
+                "profile_window(trace=True) needs an object with a "
+                ".trace() method (InfiniStoreServer); clients cannot "
+                "drain the server-side span rings"
+            )
     if conn_or_server is not None:
         w.stats_before = conn_or_server.stats()
+    # Window start on the native spans' clock (CLOCK_MONOTONIC µs —
+    # utils.cc now_us): ring entries from before the window are clipped
+    # out of the merged export.
+    t0_us = time.clock_gettime(time.CLOCK_MONOTONIC) * 1e6
     tracing = False
     if trace_dir is not None:
         import jax
@@ -76,6 +174,17 @@ def profile_window(conn_or_server=None, trace_dir=None):
                 for k in after
                 if after.get(k, 0) != before.get(k, 0)
             }
+        if trace_fn is not None:
+            full = trace_fn()
+            events = [
+                ev
+                for ev in full.get("traceEvents", [])
+                if ev.get("ph") == "M"
+                or ev.get("ts", 0) + ev.get("dur", 0) >= t0_us
+            ]
+            w.store_trace = {"traceEvents": events}
+            if trace_dir is not None:
+                w.trace_path = _merge_perfetto(str(trace_dir), events)
 
 
 __all__ = ["profile_window", "ProfileWindow"]

@@ -100,10 +100,14 @@ extern "C" {
 // blobs; v3: lease-mode ist_conn_create signature + lease entry
 // points; v4: multi-worker ist_server_create signature — trailing
 // `workers` argument; v5: background-reclaim watermarks — trailing
-// `reclaim_high`/`reclaim_low` doubles on ist_server_create).
+// `reclaim_high`/`reclaim_low` doubles on ist_server_create; v6:
+// request tracing — trailing `trace` int on ist_server_create,
+// ist_server_trace / ist_conn_set_trace entry points, and
+// ist_server_stats now returns the REQUIRED size instead of the
+// truncated count when the caller's buffer is too small).
 // _native.py probes this at load so a stale prebuilt library fails
 // loudly instead of feeding unparseable blobs to the server.
-uint32_t ist_abi_version(void) { return 5; }
+uint32_t ist_abi_version(void) { return 6; }
 
 void ist_set_log_level(int level) { set_log_level(level); }
 void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }
@@ -116,7 +120,7 @@ void* ist_server_create(const char* host, uint16_t port,
                         const char* shm_prefix, int enable_eviction,
                         const char* ssd_path, uint64_t ssd_bytes,
                         uint64_t max_outq_bytes, uint32_t workers,
-                        double reclaim_high, double reclaim_low) {
+                        double reclaim_high, double reclaim_low, int trace) {
     ServerConfig cfg;
     cfg.host = host ? host : "0.0.0.0";
     cfg.port = port;
@@ -137,6 +141,9 @@ void* ist_server_create(const char* host, uint16_t port,
     // reclaimer thread (inline-only reclaim, the historical behavior).
     cfg.reclaim_high = reclaim_high;
     cfg.reclaim_low = reclaim_low;
+    // Request tracing (span rings + /trace export); ISTPU_TRACE=1/0
+    // still overrides at start().
+    cfg.trace = trace != 0;
     return new Server(cfg);
 }
 
@@ -156,13 +163,32 @@ uint64_t ist_server_kvmap_len(void* h) {
 
 uint64_t ist_server_purge(void* h) { return static_cast<Server*>(h)->purge(); }
 
-int ist_server_stats(void* h, char* buf, int cap) {
-    std::string s = static_cast<Server*>(h)->stats_json();
-    int n = int(s.size());
-    if (n >= cap) n = cap - 1;
-    memcpy(buf, s.data(), size_t(n));
-    buf[n] = 0;
+// snprintf contract: copies at most cap-1 bytes (+ NUL) and ALWAYS
+// returns the blob's full length, so a caller whose buffer was too
+// small (return >= cap) can retry with a grown buffer instead of
+// silently losing the clipped tail as workers/ops/histograms grow.
+static long long copy_blob(const std::string& s, char* buf, long long cap) {
+    long long n = (long long)s.size();
+    long long c = n >= cap ? cap - 1 : n;
+    if (c < 0) c = 0;
+    if (buf != nullptr && cap > 0) {
+        memcpy(buf, s.data(), size_t(c));
+        buf[c] = 0;
+    }
     return n;
+}
+
+int ist_server_stats(void* h, char* buf, int cap) {
+    return int(copy_blob(static_cast<Server*>(h)->stats_json(), buf, cap));
+}
+
+// Drain the span rings as Chrome trace-event JSON (Perfetto-loadable).
+// Same snprintf contract as ist_server_stats — the trace blob can run
+// to megabytes (kCap spans x tracks), so the retry-with-grown-buffer
+// path is the COMMON one here.
+long long ist_server_trace(void* h, char* buf, long long cap) {
+    if (h == nullptr) return -1;
+    return copy_blob(static_cast<Server*>(h)->trace_json(), buf, cap);
 }
 
 // Snapshot / restore the committed store (warm restarts — the
@@ -224,6 +250,13 @@ void ist_conn_destroy(void* h) { delete static_cast<Connection*>(h); }
 int ist_conn_shm_active(void* h) {
     if (h == nullptr) return 0;
     return static_cast<Connection*>(h)->shm_active() ? 1 : 0;
+}
+
+// Set (or clear, with 0) the connection's trace id: while set, every
+// outgoing frame carries it as a FLAG_TRACE body suffix, stitching the
+// wire ops to one logical client op in the server's span rings.
+void ist_conn_set_trace(void* h, uint64_t trace_id) {
+    if (h != nullptr) static_cast<Connection*>(h)->set_trace_id(trace_id);
 }
 
 int ist_conn_broken(void* h) {

@@ -1381,6 +1381,16 @@ void Connection::enqueue_msg(uint8_t op, std::vector<uint8_t> body,
         return;
     }
     uint64_t seq = next_seq_++;
+    // Tracing: append the current trace id as the body's last 8 bytes
+    // and flag it, so the server can stitch this frame to the client's
+    // logical op. flags == 0 frames (id unset / old builds) are
+    // byte-identical to the historical wire format.
+    uint64_t trace_id = trace_id_.load(std::memory_order_relaxed);
+    if (trace_id != 0) {
+        size_t off = body.size();
+        body.resize(off + 8);
+        memcpy(body.data() + off, &trace_id, 8);
+    }
     uint64_t payload = 0;
     for (auto& s : segs) payload += s.second;
     // Merge contiguous gather segments: batched put sources are slices of
@@ -1399,6 +1409,7 @@ void Connection::enqueue_msg(uint8_t op, std::vector<uint8_t> body,
     OutMsg m;
     m.meta.resize(sizeof(WireHeader) + body.size());
     WireHeader h = make_header(op, seq, uint32_t(body.size()), payload);
+    if (trace_id != 0) h.flags |= FLAG_TRACE;
     memcpy(m.meta.data(), &h, sizeof(h));
     if (!body.empty()) memcpy(m.meta.data() + sizeof(h), body.data(), body.size());
     m.segs = std::move(segs);

@@ -224,6 +224,17 @@ class Connection {
     // scatter): without it a late response would land in freed memory.
     void hard_fail();
 
+    // Request tracing: while non-zero, every outgoing frame carries the
+    // id as a FLAG_TRACE body suffix, so the server's span rings stitch
+    // this connection's wire ops (including deferred lease commits and
+    // sharded sub-calls issued under the same id) to one logical client
+    // op. Read at frame-build time on the IO thread; a submitted op
+    // that is still queued when the id changes carries the newer id —
+    // acceptable skew for a debug plane. Old servers ignore the flag.
+    void set_trace_id(uint64_t id) {
+        trace_id_.store(id, std::memory_order_relaxed);
+    }
+
     uint64_t inflight() const { return inflight_.load(); }
 
    private:
@@ -266,6 +277,7 @@ class Connection {
     int map_pools_locked(BufReader& r);
 
     ClientConfig cfg_;
+    std::atomic<uint64_t> trace_id_{0};
     int fd_ = -1;
     int wake_fd_ = -1;
     int epoll_fd_ = -1;

@@ -81,6 +81,15 @@ enum Op : uint8_t {
 constexpr uint32_t MAGIC = 0x49535450;  // "ISTP"
 constexpr uint8_t WIRE_VERSION = 1;
 
+// Header flag bits. FLAG_TRACE: the last 8 body bytes are a
+// client-generated trace id (stripped before the op body is parsed),
+// stitching one logical client op across its wire sub-ops in the
+// server's span rings (trace.h). Old clients send flags == 0 and new
+// servers treat their frames exactly as before — byte-compatible both
+// ways (a flagged frame to an old server is ignored there too: flags
+// were always transmitted, never read).
+constexpr uint16_t FLAG_TRACE = 0x1;
+
 #pragma pack(push, 1)
 struct WireHeader {
     uint32_t magic;

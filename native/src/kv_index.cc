@@ -7,12 +7,14 @@
 #include <unordered_set>
 
 #include "log.h"
+#include "utils.h"
 
 namespace istpu {
 
 KVIndex::KVIndex(MM* mm, bool eviction, DiskTier* disk,
-                 std::atomic<uint64_t>* epoch)
-    : mm_(mm), eviction_(eviction), disk_(disk), epoch_(epoch) {
+                 std::atomic<uint64_t>* epoch, Tracer* tracer)
+    : mm_(mm), eviction_(eviction), disk_(disk), epoch_(epoch),
+      tracer_(tracer) {
     // ISTPU_EXACT_LRU=1: exact global victim order even under pins
     // (per-victim eligibility walks) — the escape hatch for tests and
     // deployments that need the pre-segmentation semantics verbatim.
@@ -22,11 +24,26 @@ KVIndex::KVIndex(MM* mm, bool eviction, DiskTier* disk,
 
 KVIndex::~KVIndex() { stop_background(); }
 
+std::unique_lock<std::mutex> KVIndex::lock_stripe(Stripe& st) {
+    std::unique_lock<std::mutex> lk(st.mu, std::try_to_lock);
+    if (!lk.owns_lock()) {
+        // Contended: time the wait. The uncontended path above reads
+        // no clock and records nothing — the instrumentation's cost
+        // lives entirely on the path it exists to measure.
+        long long t0 = now_us();
+        lk.lock();
+        if (tracer_ != nullptr) {
+            tracer_->lock_wait(uint64_t(t0), uint64_t(now_us() - t0));
+        }
+    }
+    return lk;
+}
+
 Status KVIndex::allocate(const std::string& key, uint32_t size,
                          RemoteBlock* out, uint64_t owner) {
     uint32_t si = stripe_of(key);
     Stripe& st = stripes_[si];
-    std::lock_guard<std::mutex> lk(st.mu);
+    auto lk = lock_stripe(st);
     // Single hash probe: try_emplace both answers the dedup check and
     // reserves the slot (allocate is the server's hottest op — 4096
     // keys per benchmark batch).
@@ -102,7 +119,7 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
 uint8_t* KVIndex::write_dest(uint64_t token, uint32_t* size_out,
                              uint64_t owner) {
     Stripe& st = stripes_[stripe_of_token(token)];
-    std::lock_guard<std::mutex> lk(st.mu);
+    auto lk = lock_stripe(st);
     Inflight* s = islot(st, token);
     if (s == nullptr || s->owner != owner) return nullptr;
     *size_out = s->size;
@@ -113,7 +130,7 @@ uint8_t* KVIndex::write_dest(uint64_t token, uint32_t* size_out,
 
 Status KVIndex::commit(uint64_t token, uint64_t owner) {
     Stripe& st = stripes_[stripe_of_token(token)];
-    std::lock_guard<std::mutex> lk(st.mu);
+    auto lk = lock_stripe(st);
     Inflight* s = islot(st, token);
     if (s == nullptr) return CONFLICT;
     // A forged commit must fail closed AND leave the real owner's inflight
@@ -135,7 +152,7 @@ Status KVIndex::commit(uint64_t token, uint64_t owner) {
 
 void KVIndex::abort(uint64_t token, uint64_t owner) {
     Stripe& st = stripes_[stripe_of_token(token)];
-    std::lock_guard<std::mutex> lk(st.mu);
+    auto lk = lock_stripe(st);
     Inflight* s = islot(st, token);
     if (s == nullptr || s->owner != owner) return;
     auto mit = st.map.find(s->key);
@@ -166,7 +183,7 @@ size_t KVIndex::abort_all_for_owner(uint64_t owner) {
 
 bool KVIndex::peek_committed(const std::string& key, uint32_t* size_out) {
     Stripe& st = stripes_[stripe_of(key)];
-    std::lock_guard<std::mutex> lk(st.mu);
+    auto lk = lock_stripe(st);
     auto it = st.map.find(key);
     if (it == st.map.end() || !it->second.committed) return false;
     // Reads refresh recency (and cancel an in-flight spill — the touch
@@ -181,7 +198,7 @@ Status KVIndex::acquire_block(const std::string& key, bool allow_promote,
                               bool* promoted_out) {
     uint32_t si = stripe_of(key);
     Stripe& st = stripes_[si];
-    std::lock_guard<std::mutex> lk(st.mu);
+    auto lk = lock_stripe(st);
     auto it = st.map.find(key);
     if (it == st.map.end() || !it->second.committed) return KEY_NOT_FOUND;
     Entry& e = it->second;
@@ -198,6 +215,15 @@ Status KVIndex::acquire_block(const std::string& key, bool allow_promote,
 Status KVIndex::ensure_resident(uint32_t stripe_idx, Entry& e,
                                 const std::string& key) {
     if (!e.block) {
+        // PROMOTE span: the whole disk->pool promotion (pool alloc +
+        // tier IO + adoption), recorded on the calling WORKER's ring —
+        // this runs inline on the reading worker under the stripe
+        // lock, which is exactly the cold-read tail the ROADMAP's
+        // async-promotion item wants made visible. The clock reads are
+        // gated: a promotion is already tier-IO-slow, but the
+        // tracing-off path stays byte-identical to before.
+        const bool trace = tracer_ != nullptr && tracer_->enabled();
+        long long tp0 = trace ? now_us() : 0;
         // Spilled (disk) or in heap limbo: promote back into the pool
         // (which may itself spill or evict colder entries — this entry
         // is not in the LRU while non-resident, so it cannot become its
@@ -218,9 +244,18 @@ Status KVIndex::ensure_resident(uint32_t stripe_idx, Entry& e,
             if (e.heap) {
                 memcpy(loc.ptr, e.heap->data(), e.size);
                 e.heap.reset();
-            } else if (!e.disk ||
-                       !e.disk->tier->load(e.disk->off, loc.ptr, e.size)) {
-                return INTERNAL_ERROR;  // IO error; block freed by RAII
+            } else {
+                long long tio = trace ? now_us() : 0;
+                bool io_ok = e.disk != nullptr &&
+                             e.disk->tier->load(e.disk->off, loc.ptr,
+                                                e.size);
+                if (trace) {
+                    tracer_->record(SPAN_DISK_IO, 0, uint64_t(tio),
+                                    uint64_t(now_us() - tio));
+                }
+                if (!io_ok) {
+                    return INTERNAL_ERROR;  // IO error; block freed by RAII
+                }
             }
             e.block = std::move(block);
             e.disk.reset();  // frees the disk extent
@@ -262,6 +297,10 @@ Status KVIndex::ensure_resident(uint32_t stripe_idx, Entry& e,
             return INTERNAL_ERROR;  // no location at all: cannot happen
         }
         promotes_.fetch_add(1, std::memory_order_relaxed);
+        if (trace) {
+            tracer_->record(SPAN_PROMOTE, 0, uint64_t(tp0),
+                            uint64_t(now_us() - tp0));
+        }
     }
     lru_touch(stripes_[stripe_idx], e, key);
     return OK;
@@ -374,7 +413,7 @@ Status KVIndex::insert_committed(const std::string& key, const uint8_t* data,
 Status KVIndex::insert_leased(const std::string& key, const PoolLoc& loc,
                               uint32_t size) {
     Stripe& st = stripes_[stripe_of(key)];
-    std::lock_guard<std::mutex> lk(st.mu);
+    auto lk = lock_stripe(st);
     auto [mit, inserted] = st.map.try_emplace(key);
     if (!inserted) return CONFLICT;  // first-writer-wins
     Entry e;
@@ -445,7 +484,7 @@ size_t KVIndex::erase(const std::vector<std::string>& keys) {
     size_t n = 0;
     for (auto& k : keys) {
         Stripe& st = stripes_[stripe_of(k)];
-        std::lock_guard<std::mutex> lk(st.mu);
+        auto lk = lock_stripe(st);
         auto it = st.map.find(k);
         if (it == st.map.end()) continue;
         // Bump BEFORE the entry's blocks are freed, once PER committed
@@ -761,6 +800,14 @@ void KVIndex::start_background(double high, double low) {
     if (low_ < 0.0) low_ = 0.0;
     bg_stop_.store(false, std::memory_order_relaxed);
     bg_running_.store(true, std::memory_order_relaxed);
+    // Background tracks, created BEFORE the threads spawn (thread
+    // creation orders the ring pointers for the loops' bind calls).
+    if (tracer_ != nullptr && tracer_->enabled()) {
+        reclaim_ring_ = tracer_->add_track("reclaim");
+        if (disk_ != nullptr) {
+            spill_ring_ = tracer_->add_track("spill-writer");
+        }
+    }
     reclaim_thread_ = std::thread([this] { reclaim_loop(); });
     if (disk_ != nullptr) {
         spill_thread_ = std::thread([this] { spill_loop(); });
@@ -819,6 +866,8 @@ void KVIndex::kick_reclaimer() {
 }
 
 void KVIndex::reclaim_loop() {
+    Tracer::bind_thread(reclaim_ring_);
+    const bool trace = reclaim_ring_ != nullptr;
     // Evict in bounded batches so stop() stays responsive and the
     // stripe try-locks are released between rounds.
     const size_t batch_bytes = 64 * mm_->block_size();
@@ -835,6 +884,13 @@ void KVIndex::reclaim_loop() {
         if (total != 0 &&
             double(mm_->used_bytes()) >= high_ * double(total)) {
             reclaim_runs_.fetch_add(1, std::memory_order_relaxed);
+            // RECLAIM_PASS span: watermark wake -> pool back under the
+            // low watermark (or nothing evictable); VICTIM_SCAN spans
+            // nest inside it, one per bounded evict_internal batch, so
+            // a foreground op's stall lines up with exactly the scan
+            // that caused it.
+            long long tpass = trace ? now_us() : 0;
+            size_t pass_victims = 0;
             size_t floor_bytes = size_t(low_ * double(total));
             while (!bg_stop_.load(std::memory_order_relaxed)) {
                 size_t used = mm_->used_bytes();
@@ -846,7 +902,23 @@ void KVIndex::reclaim_loop() {
                 if (used <= floor_bytes + inflight) break;
                 size_t want = used - floor_bytes - inflight;
                 if (want > batch_bytes) want = batch_bytes;
-                if (evict_internal(want, -1, true) == 0) break;
+                long long tscan = trace ? now_us() : 0;
+                size_t victims = evict_internal(want, -1, true);
+                if (trace) {
+                    tracer_->record(
+                        SPAN_VICTIM_SCAN, 0, uint64_t(tscan),
+                        uint64_t(now_us() - tscan),
+                        uint16_t(victims > 0xFFFF ? 0xFFFF : victims));
+                }
+                pass_victims += victims;
+                if (victims == 0) break;
+            }
+            if (trace) {
+                tracer_->record(SPAN_RECLAIM_PASS, 0, uint64_t(tpass),
+                                uint64_t(now_us() - tpass),
+                                uint16_t(pass_victims > 0xFFFF
+                                             ? 0xFFFF
+                                             : pass_victims));
             }
         }
         lk.lock();
@@ -867,6 +939,7 @@ void KVIndex::enqueue_spill(const std::string& key, const BlockRef& block,
 }
 
 void KVIndex::spill_loop() {
+    Tracer::bind_thread(spill_ring_);
     constexpr size_t kSpillBatch = 64;
     std::unique_lock<std::mutex> lk(spill_mu_);
     while (true) {
@@ -885,7 +958,17 @@ void KVIndex::spill_loop() {
         }
         spill_busy_ = true;
         lk.unlock();
-        process_spill_batch(batch);
+        {
+            const bool trace = spill_ring_ != nullptr;
+            long long tb0 = trace ? now_us() : 0;
+            size_t n = batch.size();
+            process_spill_batch(batch);
+            if (trace) {
+                tracer_->record(SPAN_SPILL_BATCH, 0, uint64_t(tb0),
+                                uint64_t(now_us() - tb0),
+                                uint16_t(n > 0xFFFF ? 0xFFFF : n));
+            }
+        }
         batch.clear();
         lk.lock();
         spill_busy_ = false;
@@ -919,6 +1002,10 @@ void KVIndex::process_spill_batch(std::vector<SpillItem>& batch) {
             ++j;
             total += batch[j].size;
         }
+        // SPILL_WRITE span: the DiskTier store IO alone (the batch span
+        // around this also covers sorting + adoption re-locks).
+        const bool trace = spill_ring_ != nullptr;
+        long long tw0 = trace ? now_us() : 0;
         bool stored = false;
         if (j > i) {
             std::vector<uint32_t> sizes;
@@ -937,6 +1024,11 @@ void KVIndex::process_spill_batch(std::vector<SpillItem>& batch) {
                 offs[k] = disk_->store(batch[k].block->loc.ptr,
                                        batch[k].size);
             }
+        }
+        if (trace) {
+            tracer_->record(SPAN_SPILL_WRITE, 0, uint64_t(tw0),
+                            uint64_t(now_us() - tw0),
+                            uint16_t(j - i + 1));
         }
         i = j + 1;
     }

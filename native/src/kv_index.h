@@ -83,6 +83,7 @@
 #include "common.h"
 #include "disk_tier.h"
 #include "mempool.h"
+#include "trace.h"
 
 namespace istpu {
 
@@ -163,8 +164,15 @@ class KVIndex {
     // may stop being valid at their last-advertised location (evict,
     // spill, delete, purge). SHM clients validate their pin cache
     // against it without a round trip.
+    // tracer (optional) wires the observability plane in (trace.h):
+    // contended stripe-lock acquisitions feed its always-on wait
+    // histogram (and, when tracing is enabled, lock-wait spans on the
+    // acquiring worker's ring); the reclaimer and spill writer get
+    // their own span tracks so reclaim interference with foreground
+    // ops is attributable.
     explicit KVIndex(MM* mm, bool eviction = false, DiskTier* disk = nullptr,
-                     std::atomic<uint64_t>* epoch = nullptr);
+                     std::atomic<uint64_t>* epoch = nullptr,
+                     Tracer* tracer = nullptr);
     ~KVIndex();
 
     // Start the background reclaim pipeline: a reclaimer thread that
@@ -373,6 +381,12 @@ class KVIndex {
     static uint32_t stripe_of(const std::string& key) {
         return uint32_t(std::hash<std::string>{}(key)) & (kStripes - 1);
     }
+    // Stripe-lock acquisition with contention accounting: an
+    // UNCONTENDED acquisition is a plain try_lock (no clock read, no
+    // record); only the contended path pays two clock reads and feeds
+    // the always-on stripe-lock-wait histogram (+ a span when tracing
+    // is on). Used on the data-plane hot sites.
+    std::unique_lock<std::mutex> lock_stripe(Stripe& st);
     // Decode a token; returns nullptr unless live with matching gen.
     // Caller must hold the token's stripe mutex (stripe_of_token).
     static uint32_t stripe_of_token(uint64_t token) {
@@ -460,6 +474,11 @@ class KVIndex {
     bool eviction_ = false;
     DiskTier* disk_ = nullptr;
     std::atomic<uint64_t>* epoch_ = nullptr;
+    Tracer* tracer_ = nullptr;
+    // Background-thread span tracks (created in start_background when
+    // tracing is enabled; the threads bind them at loop entry).
+    TraceRing* reclaim_ring_ = nullptr;
+    TraceRing* spill_ring_ = nullptr;
     // ISTPU_EXACT_LRU=1 (read once at construction): per-victim global
     // eligibility scans restore exact global LRU order even under pins.
     bool exact_lru_ = false;

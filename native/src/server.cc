@@ -77,6 +77,22 @@ Server::Server(const ServerConfig& cfg) : cfg_(cfg) {
                           std::to_string(cfg_.port) + "_" +
                           std::to_string(serial.fetch_add(1));
     }
+    // Tracing: compiled in, off by default; ISTPU_TRACE=1/0 overrides
+    // the config (operator escape hatch, same spirit as
+    // ISTPU_SERVER_WORKERS). Constructed HERE — not in start() — so
+    // every control-plane entry point (stats_json on a never-started
+    // server included) can rely on tracer_ being non-null, like the
+    // cfg_ fields. The Tracer is always built: the stripe-lock and
+    // handoff-queue wait histograms it owns are always-on stats; span
+    // rings exist (and record) only when tracing is enabled.
+    {
+        bool trace_on = cfg_.trace;
+        if (const char* env = getenv("ISTPU_TRACE")) {
+            trace_on = env[0] == '1';
+        }
+        cfg_.trace = trace_on;
+        tracer_ = std::make_unique<Tracer>(trace_on);
+    }
 }
 
 Server::~Server() {
@@ -152,7 +168,8 @@ bool Server::start() {
     ctl_->magic = CTL_MAGIC;
     ctl_->epoch = 0;
     index_ = std::make_unique<KVIndex>(mm_.get(), cfg_.enable_eviction,
-                                       disk_.get(), epoch_word());
+                                       disk_.get(), epoch_word(),
+                                       tracer_.get());
     // Background reclaim pipeline (no-op unless eviction/spill is
     // configured and the watermarks enable it): puts should normally
     // find free blocks without ever paying reclaim inline.
@@ -223,6 +240,9 @@ bool Server::start() {
     for (uint32_t i = 0; i < nworkers; ++i) {
         auto w = std::make_unique<Worker>();
         w->idx = int(i);
+        if (cfg_.trace) {
+            w->ring = tracer_->add_track("worker " + std::to_string(i));
+        }
         w->epoll_fd = epoll_create1(EPOLL_CLOEXEC);
         w->wake_fd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
         epoll_event ev{};
@@ -535,24 +555,37 @@ std::string Server::stats_json() {
         (unsigned long long)leases_busy_.load(std::memory_order_relaxed),
         (unsigned long long)(index_ ? index_->epoch() : 0));
     std::string out = head;
+    // One LatHist as JSON: percentiles for humans, raw power-of-two
+    // buckets for /metrics' true Prometheus histograms (bucket b
+    // covers [2^b, 2^(b+1)) µs).
+    auto hist_entry = [](const LatHist& h) {
+        char tmp[160];
+        snprintf(tmp, sizeof(tmp),
+                 "{\"count\": %llu, \"total_us\": %llu, "
+                 "\"p50_us\": %llu, \"p99_us\": %llu, \"hist\": [",
+                 (unsigned long long)h.count(),
+                 (unsigned long long)h.total_us(),
+                 (unsigned long long)h.percentile_us(0.50),
+                 (unsigned long long)h.percentile_us(0.99));
+        std::string s = tmp;
+        for (int b = 0; b < LatHist::kBuckets; ++b) {
+            snprintf(tmp, sizeof(tmp), "%s%llu", b ? ", " : "",
+                     (unsigned long long)h.bucket(b));
+            s += tmp;
+        }
+        s += "]}";
+        return s;
+    };
     // Per-op handler-time table with histogram percentiles (the reference
     // logs per-op latency ad hoc, infinistore.cpp:1114,1162-1166; here it
     // is queryable).
     bool first = true;
     for (int op = 1; op < kMaxOp; ++op) {
-        uint64_t n = op_count_[op].load(std::memory_order_relaxed);
-        if (n == 0) continue;
-        char entry[192];
-        snprintf(entry, sizeof(entry),
-                 "%s\"%s\": {\"count\": %llu, \"total_us\": %llu, "
-                 "\"p50_us\": %llu, \"p99_us\": %llu}",
-                 first ? "" : ", ", op_name(uint8_t(op)),
-                 (unsigned long long)n,
-                 (unsigned long long)op_us_[op].load(
-                     std::memory_order_relaxed),
-                 (unsigned long long)op_percentile_us(op, 0.50),
-                 (unsigned long long)op_percentile_us(op, 0.99));
-        out += entry;
+        if (op_lat_[op].count() == 0) continue;
+        out += first ? "\"" : ", \"";
+        out += op_name(uint8_t(op));
+        out += "\": ";
+        out += hist_entry(op_lat_[op]);
         first = false;
     }
     out += "}, \"per_worker\": [";
@@ -574,11 +607,47 @@ std::string Server::stats_json() {
                      std::memory_order_relaxed));
         out += entry;
     }
-    out += "]}";
+    out += "]";
+    // Always-on wait histograms (same LatHist shape as op_stats):
+    // stripe-lock wait is recorded only on CONTENDED acquisitions of
+    // the data-plane stripe locks; handoff-queue wait only for
+    // connections that actually rode the acceptor handoff queue.
+    out += ", \"wait_stats\": {\"stripe_lock_wait\": ";
+    out += hist_entry(tracer_->lock_wait_hist());
+    out += ", \"handoff_queue_wait\": ";
+    out += hist_entry(tracer_->queue_wait_hist());
+    out += "}";
+    {
+        // Tracing state: with tracing off, `spans` MUST stay 0 across
+        // any workload (the zero-overhead contract tests pin).
+        char entry[160];
+        snprintf(entry, sizeof(entry),
+                 ", \"trace\": {\"enabled\": %d, \"spans\": %llu, "
+                 "\"dropped\": %llu, \"ring_capacity\": %zu}",
+                 cfg_.trace ? 1 : 0,
+                 (unsigned long long)tracer_->spans_recorded(),
+                 (unsigned long long)tracer_->spans_dropped(),
+                 TraceRing::kCap);
+        out += entry;
+    }
+    out += "}";
     return out;
 }
 
+std::string Server::trace_json() {
+    // The tracer outlives stop() (member teardown order), so the drain
+    // is safe against shutdown; store_mu_ only orders it with the
+    // final destructor.
+    std::lock_guard<std::mutex> lk(store_mu_);
+    if (!tracer_) return "{\"traceEvents\": []}";
+    return tracer_->to_chrome_json();
+}
+
 void Server::loop(Worker& w) {
+    // Bind this thread to its span ring once; every span recorded on
+    // this worker (op lifecycles, stripe-lock waits, foreground disk
+    // promotions) lands there with zero lookup cost.
+    Tracer::bind_thread(w.ring);
     constexpr int kMaxEvents = 64;
     epoll_event events[kMaxEvents];
     while (running_.load()) {
@@ -625,6 +694,16 @@ void Server::adopt_pending(Worker& w) {
         adopted.swap(w.pending);
     }
     for (auto& c : adopted) {
+        // Handoff-queue wait: enqueue (acceptor) -> adoption (here).
+        // Only handed-off connections are measured — the SO_REUSEPORT
+        // zero-hop path never queues, and counting its zeros would
+        // bury the histogram the wait exists to expose.
+        if (c->handoff_t0 != 0) {
+            long long t1 = now_us();
+            tracer_->queue_wait(uint64_t(c->handoff_t0),
+                                uint64_t(t1 - c->handoff_t0));
+            c->handoff_t0 = 0;
+        }
         epoll_event ev{};
         ev.events = EPOLLIN;
         ev.data.fd = c->fd;
@@ -671,6 +750,7 @@ void Server::accept_ready(Worker& w, int ready_fd) {
             epoll_ctl(target->epoll_fd, EPOLL_CTL_ADD, fd, &ev);
             target->conns[fd] = std::move(c);
         } else {
+            c->handoff_t0 = now_us();
             {
                 std::lock_guard<std::mutex> lk(target->pending_mu);
                 target->pending.push_back(std::move(c));
@@ -947,6 +1027,17 @@ void Server::handle_message(Conn& c) {
     long long t0 = now_us();
     c.op_t0 = t0;
     uint8_t op = c.hdr.op;
+    // FLAG_TRACE: the body's last 8 bytes are the client's trace id.
+    // Strip them BEFORE any handler parses, so handlers see exactly the
+    // historical body layout; old clients (flags == 0) take neither
+    // branch. The id rides thread-local state so sub-spans recorded
+    // inside the index (lock waits, promotions) stitch to this op.
+    c.trace_id = 0;
+    if ((c.hdr.flags & FLAG_TRACE) != 0 && c.body.size() >= 8) {
+        memcpy(&c.trace_id, c.body.data() + c.body.size() - 8, 8);
+        c.body.resize(c.body.size() - 8);
+    }
+    Tracer::set_thread_trace_id(c.trace_id);
     if (op == OP_PUT) {
         begin_put(c);
         return;
@@ -999,6 +1090,10 @@ void Server::handle_message(Conn& c) {
         c.payload_left = c.hdr.payload_len;
         c.wseg = 0;
         c.wseg_off = 0;
+        // Gated clock read: the tracing-off put path must stay
+        // byte-identical to before (the documented zero-overhead
+        // contract), not just span-free.
+        c.payload_t0 = tracer_->enabled() ? now_us() : 0;
         c.state = RState::PAYLOAD;
         if (c.payload_left == 0) finish_write(c);
         return;
@@ -1029,41 +1124,25 @@ void Server::handle_message(Conn& c) {
             respond(c, c.hdr.seq, op, std::move(body));
         }
     }
-    account_op(op, now_us() - t0);
+    finish_op_stats(c, op);
     c.state = RState::HDR;
     c.hdr_got = 0;
 }
 
 void Server::account_op(uint8_t op, long long us) {
     if (op >= kMaxOp) return;
-    op_count_[op].fetch_add(1, std::memory_order_relaxed);
-    op_us_[op].fetch_add(uint64_t(us), std::memory_order_relaxed);
-    int b = 0;
-    uint64_t v = us > 0 ? uint64_t(us) : 0;
-    while (v > 1 && b < kNumBuckets - 1) {
-        v >>= 1;
-        b++;
-    }
-    op_hist_[op][b].fetch_add(1, std::memory_order_relaxed);
+    op_lat_[op].record(us > 0 ? uint64_t(us) : 0);
 }
 
-uint64_t Server::op_percentile_us(int op, double q) const {
-    uint64_t total = 0;
-    for (int b = 0; b < kNumBuckets; ++b) {
-        total += op_hist_[op][b].load(std::memory_order_relaxed);
-    }
-    if (total == 0) return 0;
-    uint64_t rank = uint64_t(q * double(total - 1)) + 1;
-    uint64_t seen = 0;
-    for (int b = 0; b < kNumBuckets; ++b) {
-        seen += op_hist_[op][b].load(std::memory_order_relaxed);
-        // Bucket b covers [2^b, 2^(b+1)) µs; report the midpoint rather
-        // than the upper bound (which biased every percentile up to 2x
-        // high and made the floor 2 µs — /metrics exposes these as
-        // exact-looking quantiles).
-        if (seen >= rank) return (1ull << b) + (1ull << b) / 2;
-    }
-    return 1ull << kNumBuckets;
+void Server::finish_op_stats(Conn& c, uint8_t op) {
+    long long t1 = now_us();
+    account_op(op, t1 - c.op_t0);
+    // Whole-op span (handler time, same quantity as the histogram),
+    // tagged with the client's trace id. One predicted branch when
+    // tracing is off.
+    tracer_->record(SPAN_OP, op, uint64_t(c.op_t0),
+                    uint64_t(t1 - c.op_t0));
+    Tracer::set_thread_trace_id(0);
 }
 
 void Server::begin_put(Conn& c) {
@@ -1115,11 +1194,26 @@ void Server::begin_put(Conn& c) {
     c.payload_left = c.hdr.payload_len;
     c.wseg = 0;
     c.wseg_off = 0;
+    c.payload_t0 = tracer_->enabled() ? now_us() : 0;
     c.state = RState::PAYLOAD;
     if (c.payload_left == 0) finish_write(c);
 }
 
 void Server::finish_write(Conn& c) {
+    // Re-arm the thread's trace id: the payload scatter spans epoll
+    // wakeups, and other connections' ops on this worker ran (and
+    // cleared the TLS id) in between.
+    Tracer::set_thread_trace_id(c.trace_id);
+    const bool trace = tracer_->enabled();  // gates the clock reads too
+    long long tcommit = trace ? now_us() : 0;
+    // COPY sub-span: first payload byte -> fully scattered into pool
+    // blocks (wall time, including socket waits — that IS the
+    // socket->pool copy phase a tail-latency hunt needs to see).
+    if (trace && c.hdr.payload_len > 0 && c.payload_t0 != 0) {
+        tracer_->record(SPAN_COPY, c.hdr.op, uint64_t(c.payload_t0),
+                        uint64_t(tcommit - c.payload_t0));
+    }
+    c.payload_t0 = 0;
     uint32_t committed = 0;
     bool fail_oom = c.hdr.op == OP_PUT && c.wput_oom;
     if (fail_oom) {
@@ -1139,6 +1233,12 @@ void Server::finish_write(Conn& c) {
             if (index_->commit(tok, c.id) == OK) committed++;
         }
     }
+    // COMMIT sub-span: the two-phase publication loop alone.
+    if (trace && !c.wtokens.empty()) {
+        tracer_->record(SPAN_COMMIT, c.hdr.op, uint64_t(tcommit),
+                        uint64_t(now_us() - tcommit),
+                        uint16_t(committed > 0xFFFF ? 0xFFFF : committed));
+    }
     std::vector<uint8_t> body;
     BufWriter w(body);
     w.u32(fail_oom ? OUT_OF_MEMORY : OK);
@@ -1146,7 +1246,7 @@ void Server::finish_write(Conn& c) {
     respond(c, c.hdr.seq, c.hdr.op, std::move(body));
     // Handler time spans parse + allocate + payload scatter + commit
     // (op_t0 stashed when the message header was handled).
-    account_op(c.hdr.op, now_us() - c.op_t0);
+    finish_op_stats(c, c.hdr.op);
     c.state = RState::HDR;
     c.hdr_got = 0;
 }
@@ -1328,6 +1428,8 @@ void Server::op_commit_batch(Conn& c) {
     std::vector<uint32_t> dedup;
     bool overrun = false;
     uint64_t epoch = 0;
+    const bool trace = tracer_->enabled();  // gates the clock reads too
+    long long tcommit = trace ? now_us() : 0;
     {
         const size_t bs = mm_->block_size();
         const uint32_t nb = uint32_t((uint64_t(block_size) + bs - 1) / bs);
@@ -1384,6 +1486,13 @@ void Server::op_commit_batch(Conn& c) {
         }
         epoch = index_->epoch();
         if (bl.blocks_left == 0) c.block_leases.erase(lit);
+    }
+    // COMMIT sub-span: the lease-carve + insert_leased loop — where a
+    // deferred leased put's data actually becomes visible.
+    if (trace) {
+        tracer_->record(SPAN_COMMIT, OP_COMMIT_BATCH, uint64_t(tcommit),
+                        uint64_t(now_us() - tcommit),
+                        uint16_t(committed > 0xFFFF ? 0xFFFF : committed));
     }
     w.u32(overrun ? BAD_REQUEST : OK);
     w.u32(committed);

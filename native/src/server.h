@@ -57,6 +57,7 @@
 #include "kv_index.h"
 #include "mempool.h"
 #include "protocol.h"
+#include "trace.h"
 
 namespace istpu {
 
@@ -97,6 +98,12 @@ struct ServerConfig {
     // the background reclaimer (inline-only, the historical behavior).
     double reclaim_high = 0.95;
     double reclaim_low = 0.85;
+    // Request tracing (trace.h): per-worker span rings recording each
+    // op's lifecycle (parse, stripe-lock wait, copy, disk IO, commit)
+    // plus reclaim/spill tracks, drained as Chrome trace-event JSON by
+    // ist_server_trace / GET /trace. Compiled in, OFF by default; the
+    // ISTPU_TRACE env var (1/0) overrides this flag at start().
+    bool trace = false;
 };
 
 class Server {
@@ -113,6 +120,9 @@ class Server {
     size_t kvmap_len();
     size_t purge();
     std::string stats_json();
+    // Drain the span rings as Chrome trace-event JSON (Perfetto-
+    // loadable); empty-event JSON when tracing is off.
+    std::string trace_json();
 
     // Snapshot every committed entry to `path` (atomic tmp+rename) /
     // load a snapshot back (existing keys win; stops at pool-full).
@@ -164,6 +174,15 @@ class Server {
         bool dead = false;  // fatal error; closed after unwinding
         bool wput_oom = false;  // OP_PUT hit OOM: fail all-or-nothing
         long long op_t0 = 0;    // message arrival time (op_stats)
+        // Tracing: the current op's client trace id (FLAG_TRACE frames;
+        // 0 = untraced) and the payload scatter's start time (the COPY
+        // sub-span for OP_WRITE/OP_PUT).
+        uint64_t trace_id = 0;
+        long long payload_t0 = 0;
+        // Handoff-queue wait accounting: stamped when the acceptor
+        // queues this connection to another worker (0 = adopted
+        // locally, SO_REUSEPORT zero-hop path).
+        long long handoff_t0 = 0;
         // Per-connection sink for payload of unknown/purged tokens; sized
         // before pointer capture and never resized mid-scatter.
         std::vector<uint8_t> sink;
@@ -233,6 +252,8 @@ class Server {
         std::atomic<uint64_t> ops{0};
         std::atomic<uint64_t> bytes_in{0};
         std::atomic<uint64_t> bytes_out{0};
+        // This worker's span ring (bound to its thread in loop()).
+        TraceRing* ring = nullptr;
     };
 
     void loop(Worker& w);
@@ -312,13 +333,17 @@ class Server {
 
     // stats
     static constexpr int kMaxOp = 32;
-    // Power-of-two latency buckets: bucket i counts handler times in
-    // [2^i, 2^(i+1)) µs; the last bucket absorbs everything slower
-    // (~0.5 s+). Queryable percentiles beat the reference's ad-hoc
-    // per-request latency logging (infinistore.cpp:1114,1162-1166).
-    static constexpr int kNumBuckets = 20;
+    // Per-op latency histograms (LatHist: power-of-two buckets, bucket
+    // i counts handler times in [2^i, 2^(i+1)) µs, last bucket absorbs
+    // everything slower, ~0.5 s+). Queryable percentiles AND raw
+    // buckets (true Prometheus histograms via /metrics) beat the
+    // reference's ad-hoc per-request latency logging
+    // (infinistore.cpp:1114,1162-1166).
+    static constexpr int kNumBuckets = LatHist::kBuckets;
     void account_op(uint8_t op, long long us);
-    uint64_t op_percentile_us(int op, double q) const;
+    // Record the whole-op span (+ histogram) for the op `c` is
+    // finishing; no-ops beyond the histogram when tracing is off.
+    void finish_op_stats(Conn& c, uint8_t op);
     std::atomic<uint64_t> ops_{0}, bytes_in_{0}, bytes_out_{0};
     std::atomic<uint64_t> next_conn_id_{1};
     // Aggregate outq bytes across connections + reads refused for
@@ -334,9 +359,11 @@ class Server {
     std::atomic<uint64_t> leases_oom_{0};
     std::atomic<uint64_t> leases_busy_{0};
     std::atomic<uint64_t> next_block_lease_{1};
-    std::atomic<uint64_t> op_count_[kMaxOp] = {};
-    std::atomic<uint64_t> op_us_[kMaxOp] = {};
-    std::atomic<uint64_t> op_hist_[kMaxOp][kNumBuckets] = {};
+    LatHist op_lat_[kMaxOp];
+
+    // Request tracing (trace.h): always constructed (the wait
+    // histograms are always on), rings record only when enabled.
+    std::unique_ptr<Tracer> tracer_;
 };
 
 }  // namespace istpu

@@ -1,0 +1,185 @@
+#include "trace.h"
+
+#include <cinttypes>
+#include <cstdio>
+
+#include "protocol.h"
+#include "utils.h"
+
+namespace istpu {
+
+namespace {
+
+// Thread -> ring binding. One word per thread: a server's worker,
+// reclaimer and spill threads each bind exactly one ring for their
+// lifetime; with several servers in one process each thread still
+// belongs to exactly one of them.
+thread_local TraceRing* tls_ring = nullptr;
+thread_local uint64_t tls_trace_id = 0;
+
+}  // namespace
+
+const char* span_kind_name(uint8_t kind) {
+    switch (kind) {
+        case SPAN_OP: return "op";
+        case SPAN_COPY: return "copy";
+        case SPAN_COMMIT: return "commit";
+        case SPAN_LOCK_WAIT: return "stripe_lock_wait";
+        case SPAN_DISK_IO: return "disk_io";
+        case SPAN_PROMOTE: return "promote";
+        case SPAN_QUEUE_WAIT: return "handoff_queue_wait";
+        case SPAN_RECLAIM_PASS: return "reclaim_pass";
+        case SPAN_VICTIM_SCAN: return "victim_scan";
+        case SPAN_SPILL_BATCH: return "spill_batch";
+        case SPAN_SPILL_WRITE: return "spill_write";
+        default: return "span";
+    }
+}
+
+uint64_t LatHist::percentile_us(double q) const {
+    uint64_t total = 0;
+    for (int b = 0; b < kBuckets; ++b) total += bucket(b);
+    if (total == 0) return 0;
+    uint64_t rank = uint64_t(q * double(total - 1)) + 1;
+    uint64_t seen = 0;
+    for (int b = 0; b < kBuckets; ++b) {
+        seen += bucket(b);
+        if (seen >= rank) return (1ull << b) + (1ull << b) / 2;
+    }
+    return 1ull << kBuckets;
+}
+
+void TraceRing::drain(std::vector<Span>& out) const {
+    uint64_t head = head_.load(std::memory_order_acquire);
+    uint64_t n = head < kCap ? head : kCap;
+    uint64_t start = head - n;
+    out.reserve(out.size() + size_t(n));
+    for (uint64_t i = start; i < head; ++i) {
+        const Slot& s = slots_[i % kCap];
+        uint64_t gen = s.gen.load(std::memory_order_acquire);
+        if (gen == 0) continue;
+        Span sp;
+        sp.t0_us = s.t0.load(std::memory_order_relaxed);
+        uint64_t meta = s.meta.load(std::memory_order_relaxed);
+        sp.trace_id = s.tid.load(std::memory_order_relaxed);
+        // Seqlock reader re-check (acquire fence keeps the payload
+        // loads above it, pairing with the writer's release fence): a
+        // gen that moved means the writer lapped us mid-slot and the
+        // payload words may be torn — skip it.
+        std::atomic_thread_fence(std::memory_order_acquire);
+        if (s.gen.load(std::memory_order_relaxed) != gen) continue;
+        // A slot can also have been REWRITTEN completely (gen from a
+        // later lap): still a valid, consistent span — just newer.
+        sp.dur_us = uint32_t(meta & 0xFFFFFFFFull);
+        sp.kind = uint8_t((meta >> 32) & 0xFF);
+        sp.op = uint8_t((meta >> 40) & 0xFF);
+        sp.arg = uint16_t(meta >> 48);
+        out.push_back(sp);
+    }
+}
+
+TraceRing* Tracer::add_track(const std::string& name) {
+    std::lock_guard<std::mutex> lk(tracks_mu_);
+    tracks_.push_back(std::make_unique<TraceRing>(name));
+    return tracks_.back().get();
+}
+
+void Tracer::bind_thread(TraceRing* ring) { tls_ring = ring; }
+
+void Tracer::set_thread_trace_id(uint64_t tid) { tls_trace_id = tid; }
+
+uint64_t Tracer::thread_trace_id() { return tls_trace_id; }
+
+void Tracer::record(SpanKind kind, uint8_t op, uint64_t t0_us,
+                    uint64_t dur_us, uint16_t arg) {
+    if (!enabled_) return;
+    TraceRing* r = tls_ring;
+    if (r == nullptr) {
+        dropped_.fetch_add(1, std::memory_order_relaxed);
+        return;
+    }
+    r->record(kind, op, t0_us, dur_us, tls_trace_id, arg);
+}
+
+void Tracer::lock_wait(uint64_t t0_us, uint64_t us) {
+    lock_wait_hist_.record(us);
+    if (us > 0) record(SPAN_LOCK_WAIT, 0, t0_us, us);
+}
+
+void Tracer::queue_wait(uint64_t t0_us, uint64_t us) {
+    queue_wait_hist_.record(us);
+    if (us > 0) record(SPAN_QUEUE_WAIT, 0, t0_us, us);
+}
+
+std::vector<TraceRing*> Tracer::snapshot_tracks() const {
+    // tracks_ only grows, at startup; snapshotting the raw pointers
+    // lets the expensive consumers (multi-MB /trace serialization)
+    // run WITHOUT tracks_mu_, so a concurrent stats_json on a worker
+    // thread (spans_recorded) never blocks behind a drain.
+    std::lock_guard<std::mutex> lk(tracks_mu_);
+    std::vector<TraceRing*> out;
+    out.reserve(tracks_.size());
+    for (const auto& t : tracks_) out.push_back(t.get());
+    return out;
+}
+
+uint64_t Tracer::spans_recorded() const {
+    uint64_t n = 0;
+    for (TraceRing* t : snapshot_tracks()) n += t->recorded();
+    return n;
+}
+
+std::string Tracer::to_chrome_json(uint64_t clip_before_us) const {
+    // Chrome trace-event "JSON Object Format": Perfetto and
+    // chrome://tracing both load it. One pid for the store, one tid per
+    // ring; complete ("X") events carry ts/dur in microseconds on the
+    // native CLOCK_MONOTONIC timebase (now_us), so spans from all rings
+    // — and a same-host reader sampling the same clock — line up.
+    std::string out = "{\"displayTimeUnit\": \"ms\", \"traceEvents\": [";
+    char buf[256];
+    bool first = true;
+    std::vector<TraceRing*> tracks = snapshot_tracks();
+    for (size_t ti = 0; ti < tracks.size(); ++ti) {
+        snprintf(buf, sizeof(buf),
+                 "%s{\"ph\": \"M\", \"pid\": 1, \"tid\": %zu, "
+                 "\"name\": \"thread_name\", \"args\": {\"name\": \"%s\"}}",
+                 first ? "" : ", ", ti, tracks[ti]->name().c_str());
+        out += buf;
+        first = false;
+    }
+    std::vector<Span> spans;
+    for (size_t ti = 0; ti < tracks.size(); ++ti) {
+        spans.clear();
+        tracks[ti]->drain(spans);
+        for (const Span& sp : spans) {
+            if (clip_before_us != 0 &&
+                sp.t0_us + sp.dur_us < clip_before_us) {
+                continue;
+            }
+            const char* name = sp.kind == SPAN_OP ? op_name(sp.op)
+                                                  : span_kind_name(sp.kind);
+            int n = snprintf(
+                buf, sizeof(buf),
+                "%s{\"ph\": \"X\", \"pid\": 1, \"tid\": %zu, "
+                "\"name\": \"%s\", \"cat\": \"%s\", \"ts\": %" PRIu64
+                ", \"dur\": %u",
+                first ? "" : ", ", ti, name,
+                sp.kind == SPAN_OP ? "op" : span_kind_name(sp.kind),
+                sp.t0_us, sp.dur_us);
+            out.append(buf, size_t(n));
+            if (sp.trace_id != 0 || sp.arg != 0) {
+                n = snprintf(buf, sizeof(buf),
+                             ", \"args\": {\"trace_id\": \"0x%" PRIx64
+                             "\", \"arg\": %u}",
+                             sp.trace_id, unsigned(sp.arg));
+                out.append(buf, size_t(n));
+            }
+            out += "}";
+            first = false;
+        }
+    }
+    out += "]}";
+    return out;
+}
+
+}  // namespace istpu

@@ -1,0 +1,224 @@
+// trace.h — end-to-end request tracing for the native data plane.
+//
+// The /stats per-op percentiles (server.h) say THAT an op was slow;
+// this subsystem says WHERE: each worker thread owns a fixed-size,
+// overwrite-oldest SPAN RING it alone writes (single-writer, so
+// recording is a handful of relaxed atomic stores — zero allocation,
+// zero locks, zero syscalls beyond the clock read the op path already
+// pays). The background reclaimer and the async spill writer get their
+// own rings, so reclaim interference with foreground ops is visible as
+// overlapping tracks instead of an unexplained tail. "RPC Considered
+// Harmful" (PAPERS.md) argues transfer-level visibility — not endpoint
+// counters — is what attributes tail latency in RDMA-class data paths;
+// rings + wire-propagated trace ids are that layer for this store.
+//
+// Concurrency contract (checked under TSAN by the ISTPU_TSAN=1 trace
+// smoke): every slot field is a relaxed std::atomic word guarded by a
+// per-slot GENERATION: the writer invalidates (gen=0, relaxed), writes
+// the payload words (relaxed), then publishes gen = head+1 (release).
+// A drain reads gen (acquire), the payload, then gen again — a
+// mismatch means the ring lapped the reader mid-slot and the span is
+// skipped. Readers never block writers; writers never wait for
+// anything.
+//
+// Tracing is COMPILED IN but off by default (ServerConfig.trace /
+// --trace / ISTPU_TRACE=1): when off, record() is one predicted branch
+// and the op path allocates and stores nothing new. The two WAIT
+// HISTOGRAMS (stripe-lock wait, accept-handoff queue wait) are always
+// on — their cost is confined to the CONTENDED path (an uncontended
+// try_lock records nothing and reads no clock).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace istpu {
+
+// ---------------------------------------------------------------------------
+// Span taxonomy. Foreground kinds ride the worker rings (tagged with
+// the op's trace id); reclaim-side kinds ride the reclaim/spill rings
+// so interference with foreground ops is attributable by overlap.
+// ---------------------------------------------------------------------------
+enum SpanKind : uint8_t {
+    SPAN_OP = 1,        // whole handler: dequeue->parse->...->respond
+    SPAN_COPY = 2,      // payload scatter between socket and pool blocks
+    SPAN_COMMIT = 3,    // two-phase commit loop (incl. lease-batch insert)
+    SPAN_LOCK_WAIT = 4,   // contended stripe-lock acquisition
+    SPAN_DISK_IO = 5,     // DiskTier load on the foreground path (promote)
+    SPAN_PROMOTE = 6,     // whole disk->pool promotion (alloc+IO+adopt)
+    SPAN_QUEUE_WAIT = 7,  // accept handoff: pending-queue enqueue->adopt
+    SPAN_RECLAIM_PASS = 8,  // watermark wake -> pool back under low
+    SPAN_VICTIM_SCAN = 9,   // one evict_internal batch inside a pass
+    SPAN_SPILL_BATCH = 10,  // spill writer: whole dequeued batch
+    SPAN_SPILL_WRITE = 11,  // spill writer: the DiskTier store IO alone
+};
+
+const char* span_kind_name(uint8_t kind);
+
+// ---------------------------------------------------------------------------
+// Always-on latency histogram: power-of-two buckets, same geometry as
+// the per-op table (bucket b counts [2^b, 2^(b+1)) µs; the last bucket
+// absorbs everything slower). Relaxed atomics throughout — increments
+// race only with stats reads.
+// ---------------------------------------------------------------------------
+struct LatHist {
+    static constexpr int kBuckets = 20;
+
+    void record(uint64_t us) {
+        count_.fetch_add(1, std::memory_order_relaxed);
+        total_us_.fetch_add(us, std::memory_order_relaxed);
+        int b = 0;
+        uint64_t v = us;
+        while (v > 1 && b < kBuckets - 1) {
+            v >>= 1;
+            b++;
+        }
+        buckets_[b].fetch_add(1, std::memory_order_relaxed);
+    }
+    uint64_t count() const {
+        return count_.load(std::memory_order_relaxed);
+    }
+    uint64_t total_us() const {
+        return total_us_.load(std::memory_order_relaxed);
+    }
+    uint64_t bucket(int b) const {
+        return buckets_[b].load(std::memory_order_relaxed);
+    }
+    // Midpoint-of-bucket percentile (same convention as the per-op
+    // table: upper bounds would bias every quantile up to 2x high).
+    uint64_t percentile_us(double q) const;
+
+    std::atomic<uint64_t> count_{0};
+    std::atomic<uint64_t> total_us_{0};
+    std::atomic<uint64_t> buckets_[kBuckets] = {};
+};
+
+// A drained span (stable copy of one ring slot).
+struct Span {
+    uint64_t t0_us;
+    uint32_t dur_us;
+    uint8_t kind;
+    uint8_t op;      // Op code for SPAN_OP; 0 otherwise
+    uint16_t arg;    // kind-specific small payload (e.g. victims)
+    uint64_t trace_id;
+};
+
+// ---------------------------------------------------------------------------
+// One track's ring. SINGLE-WRITER: only the owning thread records.
+// ---------------------------------------------------------------------------
+class TraceRing {
+   public:
+    static constexpr size_t kCap = 4096;  // spans kept per track
+
+    explicit TraceRing(std::string name) : name_(std::move(name)) {}
+
+    const std::string& name() const { return name_; }
+
+    void record(SpanKind kind, uint8_t op, uint64_t t0_us, uint64_t dur_us,
+                uint64_t trace_id, uint16_t arg = 0) {
+        uint64_t h = head_.fetch_add(1, std::memory_order_relaxed);
+        Slot& s = slots_[h % kCap];
+        // Seqlock writer (Boehm, "Can seqlocks get along with
+        // programming language memory models?"): invalidate, RELEASE
+        // FENCE, payload, publish-with-release. The fence orders the
+        // gen=0 store before the payload stores as observed through
+        // the drain's acquire fence — without it a weakly-ordered CPU
+        // could make new payload words visible while gen still reads
+        // as the OLD generation, and the drain's re-check would accept
+        // a torn span.
+        s.gen.store(0, std::memory_order_relaxed);
+        std::atomic_thread_fence(std::memory_order_release);
+        s.t0.store(t0_us, std::memory_order_relaxed);
+        uint64_t meta = (dur_us > 0xFFFFFFFFull ? 0xFFFFFFFFull : dur_us) |
+                        (uint64_t(kind) << 32) | (uint64_t(op) << 40) |
+                        (uint64_t(arg) << 48);
+        s.meta.store(meta, std::memory_order_relaxed);
+        s.tid.store(trace_id, std::memory_order_relaxed);
+        s.gen.store(h + 1, std::memory_order_release);
+    }
+
+    uint64_t recorded() const {
+        return head_.load(std::memory_order_relaxed);
+    }
+
+    // Copy out every stable span, oldest first. Slots the writer laps
+    // mid-read fail the gen re-check and are skipped (rare; the drain
+    // is a control-plane debug path).
+    void drain(std::vector<Span>& out) const;
+
+   private:
+    struct Slot {
+        std::atomic<uint64_t> gen{0};  // 0 = empty; else head+1 at write
+        std::atomic<uint64_t> t0{0};
+        std::atomic<uint64_t> meta{0};  // dur:32 | kind:8 | op:8 | arg:16
+        std::atomic<uint64_t> tid{0};
+    };
+
+    std::string name_;
+    std::atomic<uint64_t> head_{0};
+    Slot slots_[kCap];
+};
+
+// ---------------------------------------------------------------------------
+// Tracer: the per-server registry of tracks + the always-on wait
+// histograms. Threads bind themselves to a track once at startup
+// (thread_local ring pointer); record() on an unbound thread (e.g. a
+// control-plane snapshot) only counts a drop.
+// ---------------------------------------------------------------------------
+class Tracer {
+   public:
+    explicit Tracer(bool enabled) : enabled_(enabled) {}
+
+    bool enabled() const { return enabled_; }
+
+    // Create a track (startup only; heap allocation is fine here).
+    TraceRing* add_track(const std::string& name);
+
+    // Bind the CALLING thread to `ring` (or unbind with nullptr).
+    static void bind_thread(TraceRing* ring);
+    // The calling thread's current foreground trace id (0 = untraced).
+    static void set_thread_trace_id(uint64_t tid);
+    static uint64_t thread_trace_id();
+
+    // Record on the calling thread's bound ring; no-op (plus a drop
+    // count for unbound threads) when tracing is off.
+    void record(SpanKind kind, uint8_t op, uint64_t t0_us, uint64_t dur_us,
+                uint16_t arg = 0);
+
+    // Always-on wait accounting. `span` additionally records a span
+    // when tracing is on and the wait is non-zero.
+    void lock_wait(uint64_t t0_us, uint64_t us);
+    void queue_wait(uint64_t t0_us, uint64_t us);
+
+    const LatHist& lock_wait_hist() const { return lock_wait_hist_; }
+    const LatHist& queue_wait_hist() const { return queue_wait_hist_; }
+
+    uint64_t spans_recorded() const;
+    uint64_t spans_dropped() const {
+        return dropped_.load(std::memory_order_relaxed);
+    }
+
+    // Chrome trace-event JSON (Perfetto-loadable): one thread track per
+    // ring plus thread_name metadata. `clip_before_us` drops spans that
+    // ENDED before the given CLOCK_MONOTONIC microsecond stamp (0 = all).
+    std::string to_chrome_json(uint64_t clip_before_us = 0) const;
+
+   private:
+    // Raw track pointers without holding tracks_mu_ afterwards (the
+    // vector only grows, at startup; rings are never destroyed before
+    // the Tracer) — expensive consumers serialize outside the lock.
+    std::vector<TraceRing*> snapshot_tracks() const;
+
+    bool enabled_;
+    mutable std::mutex tracks_mu_;  // guards tracks_ growth (startup)
+    std::vector<std::unique_ptr<TraceRing>> tracks_;
+    std::atomic<uint64_t> dropped_{0};
+    LatHist lock_wait_hist_;
+    LatHist queue_wait_hist_;
+};
+
+}  // namespace istpu

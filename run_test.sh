@@ -31,7 +31,11 @@ if [ "${ISTPU_TSAN:-0}" = "1" ]; then
         fi
     done
     [ -f "$TSAN_RT" ] || { echo "libtsan runtime not found" >&2; exit 1; }
-    SMOKE="${ISTPU_TSAN_TESTS:-tests/test_concurrency.py}"
+    # test_trace.py rides along: the span rings' lock-free single-
+    # writer/racy-reader claims (trace.h) are checked by the race
+    # detector under a real multi-worker traced workload, not just
+    # asserted in comments.
+    SMOKE="${ISTPU_TSAN_TESTS:-tests/test_concurrency.py tests/test_trace.py}"
     # detect_deadlocks=0: TSAN's lock-order detector keeps a 64-entry
     # held-locks table per thread and CHECK-fails (FATAL) on the index's
     # cross-stripe ops, which legitimately hold 16 ordered stripe locks

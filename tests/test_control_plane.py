@@ -99,7 +99,14 @@ def test_prometheus_metrics(plane):
     assert "infinistore_keys 20" in text
     assert "# TYPE infinistore_ops_total counter" in text
     assert 'infinistore_op_count_total{op="READ"} 20' in text
-    assert 'infinistore_op_latency_us{op="PUT",quantile="0.5"}' in text
+    # Latency is a TRUE Prometheus histogram now (op/le buckets +
+    # _sum/_count — deeper coverage in tests/test_trace.py); the
+    # midpoint percentiles live under their own gauge name.
+    assert "# TYPE infinistore_op_latency_us histogram" in text
+    assert 'infinistore_op_latency_us_bucket{op="PUT",le="+Inf"} 20' in text
+    assert 'infinistore_op_latency_us_count{op="PUT"} 20' in text
+    assert ('infinistore_op_latency_quantile_us{op="PUT",quantile="0.5"}'
+            in text)
     # Exposition format: all samples of one metric form a contiguous group.
     names = [
         line.split("{", 1)[0].split(" ", 1)[0]
@@ -118,6 +125,53 @@ def test_prometheus_metrics(plane):
             continue
         name, value = line.rsplit(" ", 1)
         float(value)
+
+
+def test_profile_window_deltas_reclaim_gauges():
+    """profile_window.op_deltas includes the PR-3 reclaim pipeline
+    gauges: a window containing pool pressure shows reclaim_runs > 0,
+    and an idle window deltas nothing (changed-keys-only contract)."""
+    from infinistore_tpu.utils.profiling import profile_window
+
+    srv = InfiniStoreServer(
+        ServerConfig(
+            service_port=0,
+            prealloc_size=1.0 / 1024,  # 1 MB pool
+            minimal_allocate_size=16,
+            enable_eviction=True,
+        )
+    )
+    srv.start()
+    conn = InfinityConnection(
+        ClientConfig(
+            host_addr="127.0.0.1",
+            service_port=srv.service_port,
+            connection_type=TYPE_STREAM,
+        )
+    )
+    conn.connect()
+    try:
+        with profile_window(srv) as idle:
+            pass
+        assert "reclaim_runs" not in idle.op_deltas
+        with profile_window(srv) as w:
+            blk = 16384
+            for i in range(160):  # working set ~2.5x the pool
+                conn.put_cache(
+                    np.zeros(blk, dtype=np.uint8), [(f"rw{i}", 0)], blk
+                )
+            conn.sync()
+        assert w.op_deltas.get("PUT", 0) == 160
+        assert w.op_deltas.get("reclaim_runs", 0) > 0
+        # The other reclaim gauges are windowed too (present iff they
+        # moved; a hard stall may or may not occur — just check the
+        # delta machinery accepts them).
+        for key in ("hard_stalls", "spills_cancelled", "evictions"):
+            assert w.op_deltas.get(key, 0) >= 0
+        assert w.op_deltas.get("evictions", 0) > 0
+    finally:
+        conn.close()
+        srv.stop()
 
 
 def test_selftest_and_purge(plane):

@@ -1,0 +1,476 @@
+"""End-to-end request tracing (ISSUE 4): native per-worker span rings,
+wire-propagated client trace ids, Perfetto-loadable /trace export,
+lock/reclaim wait histograms, true Prometheus latency histograms, and
+the tracing-off zero-overhead contract.
+
+The reference has only ad-hoc chrono logging (infinistore.cpp:1114);
+everything here is beyond parity. Also runs as the ISTPU_TSAN=1 trace
+smoke (run_test.sh) so the ring's lock-free claims are checked by the
+race detector, not just asserted in comments.
+"""
+
+import ctypes as ct
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from infinistore_tpu import (
+    ClientConfig,
+    InfiniStoreServer,
+    InfinityConnection,
+    ServerConfig,
+    TYPE_SHM,
+    TYPE_STREAM,
+)
+from infinistore_tpu.server import make_control_plane
+
+
+@pytest.fixture(scope="module")
+def traced():
+    """A workers=2 server with tracing ON, its HTTP control plane, and
+    a traced STREAM client that ran a known put+get workload."""
+    srv = InfiniStoreServer(
+        ServerConfig(
+            service_port=0,
+            manage_port=1,  # placeholder; rebound to ephemeral below
+            prealloc_size=0.01,
+            minimal_allocate_size=16,
+            workers=2,
+            trace=True,
+        )
+    )
+    srv.start()
+    srv.config.manage_port = 0
+    httpd = make_control_plane(srv)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    conn = InfinityConnection(
+        ClientConfig(
+            host_addr="127.0.0.1",
+            service_port=srv.service_port,
+            connection_type=TYPE_STREAM,
+            trace=True,
+        )
+    )
+    conn.connect()
+    trace_ids = []
+    for i in range(12):
+        conn.put_cache(
+            np.full(16384, i, dtype=np.uint8), [(f"tr{i}", 0)], 16384
+        )
+        trace_ids.append(conn.last_trace_id)
+        conn.sync()
+        dst = np.zeros(16384, dtype=np.uint8)
+        conn.read_cache(dst, [(f"tr{i}", 0)], 16384)
+        trace_ids.append(conn.last_trace_id)
+        conn.sync()
+        assert dst[0] == i
+
+    yield base, srv, conn, trace_ids
+    conn.close()
+    httpd.shutdown()
+    srv.stop()
+
+
+def get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as r:
+        return r.read().decode(), r.headers
+
+
+# ---------------------------------------------------------------------------
+# /trace round trip
+# ---------------------------------------------------------------------------
+
+
+def test_trace_roundtrip_valid_chrome_json(traced):
+    base, srv, _conn, _ids = traced
+    text, headers = get(base, "/trace")
+    assert headers["Content-Type"] == "application/json"
+    doc = json.loads(text)
+    evs = doc["traceEvents"]
+    assert evs, "traced workload must produce spans"
+    # Track metadata: one thread_name per worker ring (workers=2).
+    tracks = [
+        e["args"]["name"]
+        for e in evs
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    ]
+    assert "worker 0" in tracks and "worker 1" in tracks
+    # Every span event is a complete ("X") event with a monotonic ts/dur.
+    spans = [e for e in evs if e.get("ph") == "X"]
+    assert spans
+    for e in spans:
+        assert e["ts"] > 0 and e["dur"] >= 0
+        assert isinstance(e["name"], str) and e["name"]
+        assert e["pid"] == 1 and isinstance(e["tid"], int)
+
+
+def test_trace_spans_nest_and_cover_lifecycle(traced):
+    base, _srv, _conn, _ids = traced
+    doc = json.loads(get(base, "/trace")[0])
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    puts = [e for e in spans if e["name"] == "PUT"]
+    copies = [e for e in spans if e["cat"] == "copy"]
+    commits = [e for e in spans if e["cat"] == "commit"]
+    assert puts and copies and commits
+    # Sub-spans nest inside their op span on the same track: for each
+    # copy/commit there is a PUT on the same tid whose [ts, ts+dur]
+    # (with 1µs rounding slack) contains it.
+    for sub in copies + commits:
+        parents = [
+            p
+            for p in puts
+            if p["tid"] == sub["tid"]
+            and p["ts"] - 1 <= sub["ts"]
+            and sub["ts"] + sub["dur"] <= p["ts"] + p["dur"] + 2
+        ]
+        assert parents, f"sub-span {sub} has no enclosing PUT span"
+
+
+def test_client_trace_ids_appear_in_export(traced):
+    base, _srv, conn, trace_ids = traced
+    assert len(set(trace_ids)) == len(trace_ids)  # fresh id per op
+    doc = json.loads(get(base, "/trace")[0])
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    exported = {
+        e.get("args", {}).get("trace_id") for e in spans if "args" in e
+    }
+    # Every logical client op's id made it into the export (ring cap is
+    # far above this workload's span count, so nothing was overwritten).
+    for tid in trace_ids:
+        assert f"0x{tid:x}" in exported
+    # And the op spans carrying an id match the ops the client ran.
+    id_ops = {
+        e["name"]
+        for e in spans
+        if e.get("args", {}).get("trace_id") in exported and e["cat"] == "op"
+    }
+    assert {"PUT", "READ"} <= id_ops
+
+
+def test_wait_histograms_in_stats(traced):
+    _base, srv, _conn, _ids = traced
+    stats = srv.stats()
+    waits = stats["wait_stats"]
+    for key in ("stripe_lock_wait", "handoff_queue_wait"):
+        h = waits[key]
+        assert len(h["hist"]) == 20
+        assert h["count"] == sum(h["hist"])
+        assert h["p50_us"] <= h["p99_us"]
+    tr = stats["trace"]
+    assert tr["enabled"] == 1
+    assert tr["spans"] > 0
+    assert tr["ring_capacity"] == 4096
+
+
+# ---------------------------------------------------------------------------
+# /metrics: true Prometheus histograms + per-worker series (workers=2)
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_prometheus_histograms(traced):
+    base, srv, _conn, _ids = traced
+    text, headers = get(base, "/metrics")
+    assert headers["Content-Type"].startswith("text/plain; version=0.0.4")
+    assert "# TYPE infinistore_op_latency_us histogram" in text
+    put_count = srv.stats()["op_stats"]["PUT"]["count"]
+    # Cumulative buckets: the +Inf bucket equals _count equals the
+    # op_stats count, and the le series is monotone nondecreasing.
+    buckets = []
+    for line in text.splitlines():
+        if line.startswith('infinistore_op_latency_us_bucket{op="PUT"'):
+            le = line.split('le="')[1].split('"')[0]
+            buckets.append((le, int(line.rsplit(" ", 1)[1])))
+    assert buckets and buckets[-1][0] == "+Inf"
+    values = [v for _le, v in buckets]
+    assert values == sorted(values)
+    assert values[-1] == put_count
+    # Finite le bounds are the INCLUSIVE upper bounds of the native
+    # power-of-two buckets: 2^(b+1)-1 for bucket b (integer-us data).
+    for le, _v in buckets[:-1]:
+        assert (int(le) + 1) & int(le) == 0 and int(le) >= 1
+    assert f'infinistore_op_latency_us_count{{op="PUT"}} {put_count}' in text
+    assert 'infinistore_op_latency_us_sum{op="PUT"}' in text
+    # Wait histograms render as their own histogram families.
+    assert "# TYPE infinistore_stripe_lock_wait_us histogram" in text
+    assert 'infinistore_stripe_lock_wait_us_bucket{le="+Inf"}' in text
+    assert "# TYPE infinistore_handoff_queue_wait_us histogram" in text
+    assert "infinistore_trace_enabled 1" in text
+
+
+def test_metrics_per_worker_series_workers2(traced):
+    base, srv, _conn, _ids = traced
+    assert srv.stats()["workers"] == 2
+    text, _ = get(base, "/metrics")
+    for w in (0, 1):
+        assert f'infinistore_worker_ops_total{{worker="{w}"}}' in text
+        assert f'infinistore_worker_connections{{worker="{w}"}}' in text
+    # Exposition-format sanity on the whole (histogram-bearing) payload:
+    # every sample line parses, every metric forms one contiguous group.
+    names = []
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        name_part, value = line.rsplit(" ", 1)
+        float(value)
+        names.append(name_part.split("{", 1)[0])
+    seen, prev = set(), None
+    for n in names:
+        if n != prev:
+            assert n not in seen, f"metric {n} split into multiple groups"
+            seen.add(n)
+        prev = n
+
+
+# ---------------------------------------------------------------------------
+# tracing OFF: zero spans, protocol byte-compat, stats truncation guard
+# ---------------------------------------------------------------------------
+
+
+def test_tracing_off_records_nothing(server):
+    """With tracing off (the module-default server fixture), a real
+    workload — including a TRACED client's flagged frames — must leave
+    the span counter at exactly zero: the off path does no ring work."""
+    before = server.stats()["trace"]
+    assert before["enabled"] == 0
+    tconn = InfinityConnection(
+        ClientConfig(
+            host_addr="127.0.0.1",
+            service_port=server.service_port,
+            connection_type=TYPE_SHM,
+            trace=True,  # flagged frames against an untraced server
+        )
+    )
+    tconn.connect()
+    try:
+        for i in range(8):
+            tconn.put_cache(
+                np.zeros(4096, dtype=np.uint8), [(f"off{i}", 0)], 4096
+            )
+            tconn.sync()
+            dst = np.zeros(4096, dtype=np.uint8)
+            tconn.read_cache(dst, [(f"off{i}", 0)], 4096)
+        after = server.stats()["trace"]
+        assert after["spans"] == 0 and after["dropped"] == 0
+        assert server.trace()["traceEvents"] == []
+        # The flagged (FLAG_TRACE) frames were served normally.
+        assert tconn.last_trace_id != 0
+    finally:
+        tconn.close()
+
+
+def test_istpu_trace_env_overrides_config(monkeypatch):
+    """ISTPU_TRACE=1 flips tracing on over a trace=False config (and
+    "0" would force it off) — the operator escape hatch the bench leg
+    and ops runbooks rely on."""
+    monkeypatch.setenv("ISTPU_TRACE", "1")
+    srv = InfiniStoreServer(
+        ServerConfig(
+            service_port=0, prealloc_size=0.01, minimal_allocate_size=16
+        )
+    )
+    srv.start()
+    try:
+        assert srv.stats()["trace"]["enabled"] == 1
+    finally:
+        srv.stop()
+
+
+def test_stats_truncation_guard(server):
+    """ist_server_stats returns the REQUIRED size when the buffer is
+    too small (snprintf contract) and the Python wrapper regrows until
+    the blob fits — the 64 KB clip could silently corrupt the JSON as
+    workers x ops x histogram buckets grow."""
+    lib = server._lib
+    full = json.dumps(server.stats())  # wrapper output parses => intact
+    need = int(lib.ist_server_stats(server._h, None, 0))
+    assert need > 128
+    # A deliberately tiny buffer: NUL-terminated prefix, same required
+    # size returned.
+    buf = ct.create_string_buffer(64)
+    n = int(lib.ist_server_stats(server._h, buf, len(buf)))
+    assert n >= need - 64  # stats can grow slightly between calls
+    assert len(buf.value) == 63
+    assert full.startswith(buf.value.decode()[:32])
+    # The wrapper's regrow loop returns the whole blob.
+    assert len(full) >= need - 64
+
+
+def test_trace_blob_truncation_guard(traced):
+    _base, srv, _conn, _ids = traced
+    lib = srv._lib
+    need = int(lib.ist_server_trace(srv._h, None, 0))
+    assert need > 0
+    buf = ct.create_string_buffer(32)
+    n = int(lib.ist_server_trace(srv._h, buf, len(buf)))
+    assert n >= need  # ring only grows between the two calls
+    assert len(buf.value) == 31
+    # The wrapper regrows and yields parseable JSON.
+    assert isinstance(srv.trace()["traceEvents"], list)
+
+
+# ---------------------------------------------------------------------------
+# reclaim-side tracks
+# ---------------------------------------------------------------------------
+
+
+def test_profile_window_trace_merge(traced, tmp_path, monkeypatch):
+    """profile_window(trace=True) drains the store-side rings, clips
+    them to the window, and merges them with the (newest) jax profiler
+    trace file under trace_dir into one Perfetto-loadable gzip file.
+
+    The jax timeline is a pre-written synthetic *.trace.json.gz in the
+    TensorBoard layout — invoking the real profiler costs ~15 s on CPU
+    for the identical merge code path (the live-profiler loop was
+    validated once by hand; this pins the clip + merge semantics)."""
+    import gzip
+    import os
+
+    from infinistore_tpu.utils.profiling import profile_window
+
+    _base, srv, conn, _ids = traced
+    # Synthetic jax profiler output in the TensorBoard nesting.
+    prof_dir = tmp_path / "plugins" / "profile" / "2026_08_03"
+    prof_dir.mkdir(parents=True)
+    xla_events = [
+        {"ph": "X", "pid": 7, "tid": 0, "name": "fusion.1", "ts": 1,
+         "dur": 5}
+    ]
+    with gzip.open(prof_dir / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": list(xla_events)}, f)
+    # Stub the profiler itself (its CPU start/stop costs ~15 s and its
+    # output is the synthetic file above).
+    import jax
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    with profile_window(srv, trace_dir=None, trace=True) as w0:
+        pass  # pre-window spans must be clipped out of the NEXT window
+    assert w0.store_trace is not None
+    with profile_window(srv, trace=True) as wclip:
+        conn.put_cache(
+            np.zeros(16384, dtype=np.uint8), [("pwm0", 0)], 16384
+        )
+        conn.sync()
+        win_id = conn.last_trace_id
+    # The window's op made it into the clipped store trace, and spans
+    # that ENDED before the window are gone.
+    span_ids = {
+        e.get("args", {}).get("trace_id")
+        for e in wclip.store_trace["traceEvents"]
+        if e.get("ph") == "X"
+    }
+    assert f"0x{win_id:x}" in span_ids
+    full_spans = sum(
+        1 for e in srv.trace()["traceEvents"] if e.get("ph") == "X"
+    )
+    clipped = [
+        e for e in wclip.store_trace["traceEvents"] if e.get("ph") == "X"
+    ]
+    assert 0 < len(clipped) < full_spans
+    assert wclip.op_deltas.get("PUT", 0) == 1
+    assert wclip.trace_path is None  # no trace_dir: nothing written
+    # Now the merge: a window WITH trace_dir lands both planes in one
+    # gzip Perfetto file.
+    with profile_window(srv, trace_dir=str(tmp_path), trace=True) as w:
+        conn.put_cache(
+            np.zeros(16384, dtype=np.uint8), [("pwm1", 0)], 16384
+        )
+        conn.sync()
+    assert w.trace_path and w.trace_path.endswith(".trace.json.gz")
+    assert os.path.exists(w.trace_path)
+    with gzip.open(w.trace_path, "rt") as f:
+        merged = json.load(f)
+    store_spans = [
+        e
+        for e in merged["traceEvents"]
+        if e.get("pid") == 1 and e.get("ph") == "X"
+    ]
+    assert store_spans
+    assert any(
+        e.get("name") == "fusion.1" for e in merged["traceEvents"]
+    ), "jax timeline events survive the merge"
+
+
+def test_profile_window_trace_requires_server():
+    from infinistore_tpu.utils.profiling import profile_window
+
+    class NoTrace:
+        def stats(self):
+            return {}
+
+    with pytest.raises(ValueError):
+        with profile_window(NoTrace(), trace=True):
+            pass
+
+
+def test_reclaim_and_spill_tracks(tmp_path):
+    """Under pool pressure with a disk tier, the reclaim pipeline's
+    spans land on their own tracks so interference with foreground ops
+    is attributable."""
+    srv = InfiniStoreServer(
+        ServerConfig(
+            service_port=0,
+            prealloc_size=1.0 / 1024,  # 1 MB pool
+            minimal_allocate_size=16,
+            enable_eviction=True,
+            ssd_path=str(tmp_path),
+            ssd_size=1.0 / 256,  # 4 MB tier
+            trace=True,
+        )
+    )
+    srv.start()
+    conn = InfinityConnection(
+        ClientConfig(
+            host_addr="127.0.0.1",
+            service_port=srv.service_port,
+            connection_type=TYPE_SHM,
+            trace=True,
+        )
+    )
+    conn.connect()
+    try:
+        blk = 16384
+        # Working set ~3x the pool: the watermark reclaimer must run.
+        for i in range(192):
+            conn.put_cache(
+                np.full(blk, i % 251, dtype=np.uint8),
+                [(f"pressure{i}", 0)],
+                blk,
+            )
+        conn.sync()
+        # Read back a cold key: promotion spans on the worker track.
+        dst = np.zeros(blk, dtype=np.uint8)
+        conn.read_cache(dst, [("pressure0", 0)], blk)
+        # The spill writer is asynchronous: give its in-flight batch a
+        # bounded moment to complete before draining the rings.
+        import time as _time
+
+        for _ in range(100):
+            if srv.stats()["spills"] > 0:
+                break
+            _time.sleep(0.02)
+        stats = srv.stats()
+        assert stats["reclaim_runs"] > 0
+        doc = srv.trace()
+        tracks = {
+            e["args"]["name"]
+            for e in doc["traceEvents"]
+            if e.get("ph") == "M"
+        }
+        assert "reclaim" in tracks and "spill-writer" in tracks
+        cats = {
+            e["cat"] for e in doc["traceEvents"] if e.get("ph") == "X"
+        }
+        assert "reclaim_pass" in cats and "victim_scan" in cats
+        assert "spill_batch" in cats and "spill_write" in cats
+        # Foreground promotion of the cold read.
+        assert "promote" in cats
+    finally:
+        conn.close()
+        srv.stop()
