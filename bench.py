@@ -330,6 +330,174 @@ def bench_evict(nkeys=None, block_kb=4, batch=16):
     }
 
 
+def bench_cold(nkeys=None, block_kb=4, passes=2):
+    """Cold-read leg (ISSUE 5 acceptance): disk-resident working set 2x
+    the pool, single-key read latency with the async read pipeline ON
+    (default) versus OFF (`ServerConfig(promote=False)` — the
+    historical inline promotion under the stripe lock). Reads are
+    SHUFFLED (the same permutation on both legs: sequential order lets
+    the inline leg ride extent-reuse page-cache locality that no real
+    workload has) and each leg takes the best of `passes` fresh-server
+    runs (the CI container's IO jitter is ~2x run-to-run). Emits:
+      cold_get_p99_us         cold-read p99, pipeline ON (disk-served
+                              gets: one out-of-lock pread, no pool
+                              churn)
+      cold_get_p99_off_us     cold-read p99, inline promotion (every
+                              cold read allocates + promotes + churns
+                              under the stripe lock)
+      cold_get_p99_ratio      ON / OFF (< 1 expected)
+      prefetch_hit_rate       after prefetching a headroom-fitting
+                              subset to residency, the fraction of its
+                              reads served WITHOUT a disk read
+                              (acceptance: ~1.0 — disk_reads_inline
+                              stops growing after warmup)
+      cold_warm_get_p50_us    post-prefetch read p50 over that subset
+      cold_resident_get_p50_us  control: p50 over never-spilled keys
+      cold_warm_vs_resident_p50 warm/resident p50 ratio (acceptance:
+                              ~1.0 — a promoted key reads like a
+                              pool-resident one)
+      cold_disk_reads_inline / cold_promotes_async  pipeline counters
+    """
+    import os
+
+    import numpy as np
+
+    from infinistore_tpu import (
+        ClientConfig,
+        InfiniStoreServer,
+        InfinityConnection,
+        ServerConfig,
+    )
+
+    if nkeys is None:
+        nkeys = int(os.environ.get("ISTPU_COLD_KEYS", "512"))
+    block_bytes = block_kb << 10
+    pool_bytes = nkeys * block_bytes // 2  # working set 2x the pool
+    ssd_bytes = max(4 * nkeys * block_bytes, 4 << 20)
+    order = np.arange(nkeys)
+    np.random.default_rng(9).shuffle(order)
+
+    def run_leg(promote, warm):
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="istpu_cold_") as td:
+            srv = InfiniStoreServer(
+                ServerConfig(
+                    service_port=0,
+                    prealloc_size=pool_bytes / (1 << 30),
+                    minimal_allocate_size=block_kb,
+                    ssd_path=td,
+                    ssd_size=ssd_bytes / (1 << 30),
+                    promote=promote,
+                )
+            )
+            port = srv.start()
+            try:
+                conn = InfinityConnection(
+                    ClientConfig(
+                        host_addr="127.0.0.1", service_port=port,
+                        connection_type="SHM",
+                    )
+                )
+                conn.connect()
+                try:
+                    src = np.random.default_rng(5).integers(
+                        0, 255, block_bytes, dtype=np.uint8
+                    )
+                    for i in range(nkeys):
+                        conn.put_cache(src, [(f"cold{i}", 0)], block_bytes)
+                        if i % 64 == 63:
+                            conn.sync()
+                    conn.sync()
+                    # Cold pass: every key once, shuffled (first touch —
+                    # the pipeline serves from disk, the inline leg
+                    # promotes each one).
+                    dst = np.zeros(block_bytes, dtype=np.uint8)
+                    lats = []
+                    for i in order:
+                        t0 = time.perf_counter()
+                        conn.read_cache(dst, [(f"cold{i}", 0)],
+                                        block_bytes)
+                        lats.append(time.perf_counter() - t0)
+                    p99 = float(np.percentile(np.array(lats) * 1e6, 99))
+                    extra = {}
+                    if warm:
+                        extra = warm_phase(srv, conn, dst)
+                    return p99, extra
+                finally:
+                    conn.close()
+            finally:
+                srv.stop()
+
+    def warm_phase(srv, conn, dst):
+        # Prefetch a headroom-FITTING subset to residency: repeated
+        # rounds let promotion-pressure reclaim open (high - low)
+        # headroom per pass (see docs/design.md "Read pipeline").
+        subset = [f"cold{i}" for i in range(nkeys // 4)]
+        for _ in range(8):
+            res = conn.prefetch(subset, wait=True)
+            deadline = time.monotonic() + 10
+            while (time.monotonic() < deadline and
+                   srv.stats()["promote_queue_depth"] > 0):
+                time.sleep(0.005)
+            if res["skipped"] == 0:
+                break
+            time.sleep(0.05)  # pressure pass frees toward low
+        dri0 = srv.stats()["disk_reads_inline"]
+        wlats = []
+        for k in subset:
+            t0 = time.perf_counter()
+            conn.read_cache(dst, [(k, 0)], block_bytes)
+            wlats.append(time.perf_counter() - t0)
+        grew = srv.stats()["disk_reads_inline"] - dri0
+        # Control: the same subset again — now certainly resident (the
+        # warm pass touched everything) — is the pool-resident p50 the
+        # acceptance compares against.
+        rlats = []
+        for k in subset:
+            t0 = time.perf_counter()
+            conn.read_cache(dst, [(k, 0)], block_bytes)
+            rlats.append(time.perf_counter() - t0)
+        stats = srv.stats()
+        return {
+            "warm_p50_us": float(np.percentile(
+                np.array(wlats) * 1e6, 50)),
+            "resident_p50_us": float(np.percentile(
+                np.array(rlats) * 1e6, 50)),
+            "hit_rate": round(1.0 - grew / len(subset), 3),
+            "disk_reads_inline": int(stats["disk_reads_inline"]),
+            "promotes_async": int(stats["promotes_async"]),
+        }
+
+    p99_on, extra = None, {}
+    p99_off = None
+    for it in range(passes):
+        p, e = run_leg(True, warm=(it == 0))
+        if p99_on is None or p < p99_on:
+            p99_on = p
+        if e:
+            extra = e
+        p, _ = run_leg(False, warm=False)
+        if p99_off is None or p < p99_off:
+            p99_off = p
+    warm = extra.get("warm_p50_us", 0.0)
+    res = extra.get("resident_p50_us", 0.0)
+    return {
+        "cold_nkeys": nkeys,
+        "cold_block_kb": block_kb,
+        "cold_get_p99_us": round(p99_on, 1),
+        "cold_get_p99_off_us": round(p99_off, 1),
+        "cold_get_p99_ratio": round(p99_on / p99_off, 2)
+        if p99_off else 0.0,
+        "cold_warm_get_p50_us": round(warm, 1),
+        "cold_resident_get_p50_us": round(res, 1),
+        "cold_warm_vs_resident_p50": round(warm / res, 2) if res else 0.0,
+        "prefetch_hit_rate": extra.get("hit_rate", 0.0),
+        "cold_disk_reads_inline": extra.get("disk_reads_inline", 0),
+        "cold_promotes_async": extra.get("promotes_async", 0),
+    }
+
+
 def bench_trace_overhead(nkeys=None, block_kb=4, passes=3):
     """Tracing-overhead leg (ISSUE 4 acceptance: ratio <= 1.05 on CI).
 
@@ -2274,6 +2442,14 @@ def main():
         except Exception as e:
             print(json.dumps({"evict_error": str(e)[:200]}))
         return 0
+    if "--cold-leg" in sys.argv:
+        # Cold-read / prefetch A/B; boots its own two servers (promote
+        # on/off), port argument accepted but unused.
+        try:
+            print(json.dumps(bench_cold()))
+        except Exception as e:
+            print(json.dumps({"cold_error": str(e)[:200]}))
+        return 0
     if "--trace-leg" in sys.argv:
         # Tracing-overhead A/B; boots its own two servers (trace
         # on/off), port argument accepted but unused.
@@ -2429,6 +2605,14 @@ def main():
             out.update(bench_evict())
         except Exception as e:
             out["evict_error"] = str(e)[:200]
+        publish()
+        # Cold-read leg (ISSUE 5 acceptance): disk-resident working set
+        # 2x the pool, read tail with the async read pipeline on vs off
+        # + post-prefetch hit rate. CPU-only, boots its own servers.
+        try:
+            out.update(bench_cold())
+        except Exception as e:
+            out["cold_error"] = str(e)[:200]
         publish()
         # Worker-scaling leg (ISSUE 2 acceptance): stream + sharded
         # shapes at server workers=1/2/4. CPU-only and inline, but

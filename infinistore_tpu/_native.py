@@ -85,7 +85,7 @@ def _decls(lib):
             [c.c_char_p, c.c_uint16, c.c_uint64, c.c_uint64, c.c_int,
              c.c_uint64, c.c_int, c.c_char_p, c.c_int, c.c_char_p,
              c.c_uint64, c.c_uint64, c.c_uint32, c.c_double, c.c_double,
-             c.c_int],
+             c.c_int, c.c_int],
         ),
         ("ist_server_start", c.c_int, [c.c_void_p]),
         ("ist_server_stop", None, [c.c_void_p]),
@@ -189,6 +189,12 @@ def _decls(lib):
              c.POINTER(c.c_uint64)],
         ),
         ("ist_release", c.c_uint32, [c.c_void_p, c.c_uint64]),
+        (
+            "ist_prefetch",
+            c.c_uint32,
+            [c.c_void_p, c.c_char_p, c.c_uint64, c.c_uint32,
+             c.POINTER(c.c_uint64), c.c_int],
+        ),
         ("ist_abort", c.c_uint32, [c.c_void_p, c.POINTER(c.c_uint64), c.c_uint32]),
         ("ist_check_exist", c.c_int, [c.c_void_p, c.c_char_p, c.c_uint32]),
         (
@@ -227,20 +233,20 @@ def _decls(lib):
         ("ist_mm_num_pools", c.c_uint64, [c.c_void_p]),
     ]
     # ABI probe FIRST: a stale prebuilt library would misparse the
-    # v6 ist_server_create argument list (trace flag), the v5 reclaim
-    # watermarks, the v4 multi-worker knob or the v3 ist_conn_create
-    # lease knobs, or lack those entry points (ist_server_trace,
-    # ist_conn_set_trace) entirely. A missing or old-version symbol
-    # fails loudly here instead.
+    # v7 ist_server_create argument list (promote flag), the v6 trace
+    # flag, the v5 reclaim watermarks, the v4 multi-worker knob or the
+    # v3 ist_conn_create lease knobs, or lack the newer entry points
+    # (ist_prefetch, ist_server_trace, ist_conn_set_trace) entirely. A
+    # missing or old-version symbol fails loudly here instead.
     try:
         lib.ist_abi_version.restype = ct.c_uint32
         lib.ist_abi_version.argtypes = []
         ver = int(lib.ist_abi_version())
     except AttributeError:
         ver = 1
-    if ver < 6:
+    if ver < 7:
         raise RuntimeError(
-            f"stale native library at {_LIB_PATH} (ABI v{ver} < v6): "
+            f"stale native library at {_LIB_PATH} (ABI v{ver} < v7): "
             "rebuild with `make -C native` (or delete the .so to let "
             "the import auto-build)"
         )

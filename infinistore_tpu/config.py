@@ -58,6 +58,15 @@ class ClientConfig:
         # future allocations) and the deferred-commit flush watermark.
         self.lease_blocks = kwargs.get("lease_blocks", 4096)
         self.flush_size = kwargs.get("flush_size", 16 << 20)  # bytes
+        # Engine-issued prefetch (OP_PREFETCH, the async read
+        # pipeline): when True (default), consumers that know future
+        # reads — the serving engine's admission prefix probe — may
+        # fire InfinityConnection.prefetch() so disk-resident pages are
+        # pool-resident before the restore asks for them. False makes
+        # prefetch() a no-op (the explicit opt-out for workloads whose
+        # probes do NOT predict reads; the server-side pipeline itself
+        # is governed by ServerConfig.promote).
+        self.prefetch = kwargs.get("prefetch", True)
         # Request tracing: when True, each logical op (put_cache /
         # read_cache / allocate batch) stamps a fresh 8-byte trace id
         # onto its wire frames, so the server's span rings (/trace,
@@ -156,6 +165,16 @@ class ServerConfig:
         # the historical inline-only behavior.
         self.reclaim_high = kwargs.get("reclaim_high", 0.95)
         self.reclaim_low = kwargs.get("reclaim_low", 0.85)
+        # Async read pipeline (--no-promote / ISTPU_PROMOTE=0 to
+        # disable): with the disk tier and the background reclaimer
+        # active, gets serve disk-resident keys straight from their
+        # extents (first touch) and disk→pool promotion runs on a
+        # dedicated worker thread — promote-on-second-touch, with
+        # OP_PREFETCH/OP_PIN queueing immediately and admission bounded
+        # by reclaim_high so promotion never fights the reclaimer.
+        # False = the historical inline promotion on the reading
+        # worker, under the stripe lock.
+        self.promote = kwargs.get("promote", True)
         # Request tracing (--trace / ISTPU_TRACE=1 env override): native
         # per-worker span rings recording each op's lifecycle (parse,
         # stripe-lock wait, copy, disk IO, commit) plus reclaim/spill

@@ -1168,6 +1168,43 @@ class InfinityConnection:
         if st != OK:
             raise InfiniStoreError(st, "release failed")
 
+    def prefetch(self, keys, wait=False):
+        """Kick server-side disk→pool promotion for ``keys``
+        (OP_PREFETCH, the async read pipeline): by the time the pages
+        are actually read they are pool-resident, and the reading
+        worker never pays the tier IO. Advisory and fire-and-forget by
+        default — returns ``None`` immediately; the server replies
+        per-key but nothing waits on the promotion itself. With
+        ``wait=True`` the (immediate) reply is collected and a
+        ``{"resident", "queued", "missing", "skipped"}`` count dict
+        returned — "skipped" keys are disk-resident but were not
+        queued (pool at the reclaim watermark, or the server runs with
+        promote disabled); reads still serve them straight from disk.
+        A no-op (returns ``None``) when ``ClientConfig.prefetch`` is
+        False."""
+        self._check()
+        if not self.config.prefetch or not keys:
+            return None
+        self._stamp_trace()
+        blob = pack_keys(keys)
+        if not wait:
+            self._lib.ist_prefetch(
+                self._h, blob, len(blob), len(keys), None, 0
+            )
+            return None
+        counts = (ct.c_uint64 * 4)()
+        st = self._lib.ist_prefetch(
+            self._h, blob, len(blob), len(keys), counts, 1
+        )
+        if st != OK:
+            raise InfiniStoreError(st, "prefetch failed")
+        return {
+            "resident": int(counts[0]),
+            "queued": int(counts[1]),
+            "missing": int(counts[2]),
+            "skipped": int(counts[3]),
+        }
+
     def commit(self, tokens):
         """Commit tokens after writing pool memory directly (zero-copy
         path). FAKE tokens are filtered natively."""

@@ -59,6 +59,7 @@ class InfiniStoreServer:
             ct.c_double(cfg.reclaim_high),
             ct.c_double(cfg.reclaim_low),
             1 if cfg.trace else 0,
+            1 if cfg.promote else 0,
         )
         port = self._lib.ist_server_start(self._h)
         if port < 0:
@@ -186,6 +187,8 @@ def _prometheus_metrics(stats):
     g = g + [
         ("spill_queue_depth", "spill_queue_depth",
          "entries queued to the async spill writer"),
+        ("promote_queue_depth", "promote_queue_depth",
+         "entries queued to the async promotion worker"),
     ]
     c = [
         ("ops", "ops", "requests handled"),
@@ -200,6 +203,14 @@ def _prometheus_metrics(stats):
          "allocations that paid inline reclaim (reclaimer behind)"),
         ("spills_cancelled", "spills_cancelled",
          "async spills abandoned (read-cancelled, raced or tier-full)"),
+        ("promotes_async", "promotes_async",
+         "disk entries promoted by the async promotion worker"),
+        ("promotes_cancelled", "promotes_cancelled",
+         "async promotions abandoned (raced by delete/re-put/spill, "
+         "or pool full)"),
+        ("disk_reads_inline", "disk_reads_inline",
+         "disk reads paid on the data plane (cold gets served from "
+         "their extents + inline promotions)"),
     ]
     lines = []
     for key, name, help_ in g:
@@ -464,6 +475,11 @@ def parse_args(argv=None):
     p.add_argument("--reclaim-low", type=float, default=0.85,
                    help="occupancy fraction the background reclaimer "
                         "drives the pool down to per pass")
+    p.add_argument("--no-promote", action="store_true",
+                   help="disable the async read pipeline (promotion "
+                        "worker + disk-served cold gets); disk-resident "
+                        "keys then promote inline on the reading worker "
+                        "as before. ISTPU_PROMOTE=1/0 overrides")
     p.add_argument("--trace", action="store_true",
                    help="record per-worker request-lifecycle span rings "
                         "(parse, stripe-lock wait, copy, disk IO, "
@@ -518,6 +534,7 @@ def main(argv=None):
         workers=args.workers,
         reclaim_high=args.reclaim_high,
         reclaim_low=args.reclaim_low,
+        promote=not args.no_promote,
         trace=args.trace,
     )
     server = InfiniStoreServer(config)

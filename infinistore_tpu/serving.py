@@ -405,7 +405,7 @@ class ServingEngine:
             "prefill_tokens": 0, "decode_steps": 0, "decoded_tokens": 0,
             "offloaded_pages": 0, "preemptions": 0, "store_errors": 0,
             "restore_misses": 0, "spec_proposed": 0, "spec_accepted": 0,
-            "chunk_steps": 0, "burst_steps": 0,
+            "chunk_steps": 0, "burst_steps": 0, "prefetched_pages": 0,
         }
         # The store is an accelerator, never a dependency: after the
         # first store failure the engine downgrades itself to store-less
@@ -590,7 +590,36 @@ class ServingEngine:
             self._store_failed("probe", e)
             return 0, []
         hit = min(hit, cap)
+        if hit > 0:
+            self._prefetch_chain(work.prompt, hit, digests[:hit])
         return hit, digests[:hit]
+
+    def _prefetch_chain(self, prompt, hit, digests):
+        """Fire-and-forget OP_PREFETCH for the matched page chain —
+        every (layer, kind) page the restore will read. The probe just
+        told us the engine's exact future reads; the store's async read
+        pipeline promotes any disk-resident pages on ITS worker thread,
+        so by the time prefill_with_prefix (or a preemption resume,
+        which re-admits through this same probe path) pins the pages
+        they are pool-resident and the restore pays zero inline disk
+        reads. Purely advisory: failures are swallowed — a broken hint
+        must never fail (or even slow) an admission."""
+        fn = getattr(self.store, "prefetch", None)
+        if fn is None:
+            return
+        cfg = self.cfg
+        try:
+            keys = []
+            for li in range(cfg.n_layers):
+                for kind in ("k", "v"):
+                    keys.extend(content_page_keys(
+                        prompt, cfg.page_size, hit, li, kind,
+                        digests=digests,
+                    ))
+            if fn(keys):
+                self.stats["prefetched_pages"] += len(keys)
+        except Exception:
+            pass
 
     def _admit(self, slot_idx, work):
         n_prompt = len(work.prompt)

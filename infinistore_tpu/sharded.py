@@ -746,6 +746,31 @@ class ShardedConnection:
             raise Exception("can't find a match")
         return idx
 
+    def prefetch(self, keys, wait=False):
+        """Sharded OP_PREFETCH: each shard's owned keys ride one rpc to
+        that shard (concurrent fan-out). Advisory like the single-server
+        call — a down shard's partition is silently skipped (its keys
+        would miss on read anyway, the documented degrade contract).
+        ``wait=True`` merges the per-shard count dicts."""
+        self._stamp_trace()
+        parts = list(self._partition(keys).items())
+        results = self._run_shard_calls(
+            [(s, self.conns[s].prefetch, (ks, wait))
+             for s, (_idxs, ks) in parts]
+        )
+        if not wait:
+            return None
+        merged = {"resident": 0, "queued": 0, "missing": 0, "skipped": 0}
+        for (_s, (_idxs, ks)), (ok, v) in zip(parts, results):
+            if ok and isinstance(v, dict):
+                for k in merged:
+                    merged[k] += v.get(k, 0)
+            else:
+                # Down shard (or prefetch disabled on that conn): its
+                # keys are unreachable/unqueued, never resident.
+                merged["missing"] += len(ks)
+        return merged
+
     def purge(self):
         return sum(
             r for r in self._fanout([(c.purge, ()) for c in self.conns])

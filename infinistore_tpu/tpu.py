@@ -459,6 +459,24 @@ class TpuKVStore:
             )
         return np.stack(views)
 
+    def prefetch(self, keys):
+        """Advisory fire-and-forget promotion kick (OP_PREFETCH) for
+        pages a caller KNOWS it will read soon — the serving engine
+        fires this for the matched prefix chain right after its
+        admission probe, so disk-resident pages are pool-resident by
+        the time the restore asks for them. Returns True when the kick
+        was issued, False when the connection does not support it (or
+        has it disabled); never raises — a failed hint must not fail
+        the read that follows."""
+        fn = getattr(self.conn, "prefetch", None)
+        if fn is None or not keys:
+            return False
+        try:
+            fn(keys)
+            return True
+        except Exception:
+            return False
+
     def cached_prefix_len(self, keys):
         """How many leading pages of ``keys`` are already cached
         (get_match_last_index + 1; 0 if none). Uses the raw variant —

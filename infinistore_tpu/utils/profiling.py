@@ -12,10 +12,14 @@ module glues them for one workload window:
     print(w.op_deltas)      # store ops (and reclaim runs) in the window
     print(w.trace_path)     # ONE Perfetto file: store spans + XLA trace
 
-``op_deltas`` subtracts the server's cumulative per-op counters across
-the window — including the reclaim pipeline gauges (``reclaim_runs``,
-``hard_stalls``, ``spills_cancelled``), so a window shows whether
-background reclaim ran inside it. ``trace=True`` additionally drains
+``op_deltas`` subtracts the server's cumulative per-op COUNTERS across
+the window — including the reclaim/read pipeline counters
+(``reclaim_runs``, ``hard_stalls``, ``spills_cancelled``,
+``promotes_async``, ``disk_reads_inline``), so a window shows whether
+background reclaim or promotion ran inside it. Queue-depth GAUGES
+(``spill_queue_depth``, ``promote_queue_depth``) are levels, not
+counters — they land in ``window.gauges`` as (open, close) snapshots
+instead of meaningless deltas. ``trace=True`` additionally drains
 the store-side span rings at window close, clips them to the window
 (both sides of the native plane share CLOCK_MONOTONIC) and merges them
 with the jax profiler timeline into a single Perfetto-loadable file.
@@ -28,9 +32,10 @@ import os
 import time
 from contextlib import contextmanager
 
-# Cumulative top-level stats counters worth windowing alongside the
-# per-op table: traffic, and the PR-3 reclaim pipeline gauges (a window
-# with nonzero reclaim_runs/hard_stalls explains its own tail).
+# Cumulative top-level stats COUNTERS worth windowing alongside the
+# per-op table: traffic, the PR-3 reclaim pipeline counters and the
+# PR-5 read pipeline counters (a window with nonzero reclaim_runs /
+# disk_reads_inline explains its own tail).
 _WINDOW_COUNTERS = (
     "bytes_in",
     "bytes_out",
@@ -40,6 +45,19 @@ _WINDOW_COUNTERS = (
     "evictions",
     "spills",
     "promotes",
+    "promotes_async",
+    "promotes_cancelled",
+    "disk_reads_inline",
+)
+
+# Queue-depth GAUGES are LEVELS, not counters: deltaing them across the
+# window (after - before) would report e.g. "-3 spills queued" when a
+# busy queue drained, and 0 when a window entered and left equally
+# backlogged — both meaningless. They are SNAPSHOT at both edges
+# instead and land in ``window.gauges`` as (before, after) pairs.
+_WINDOW_GAUGES = (
+    "spill_queue_depth",
+    "promote_queue_depth",
 )
 
 
@@ -58,12 +76,32 @@ def _op_counts(stats):
     return out
 
 
+def _gauge_levels(stats):
+    """Current LEVEL of each windowed gauge (summed across shards for a
+    ShardedConnection stats list)."""
+    if isinstance(stats, list):
+        merged = {}
+        for shard in stats:
+            for k, v in _gauge_levels(shard).items():
+                merged[k] = merged.get(k, 0) + v
+        return merged
+    return {
+        key: int(stats.get(key, 0))
+        for key in _WINDOW_GAUGES
+        if key in stats
+    }
+
+
 _MERGED_NAME = "merged.trace.json.gz"
 
 
 class ProfileWindow:
     def __init__(self):
         self.op_deltas = {}
+        # Queue-depth gauges, snapshot at both window edges:
+        # {name: (level_at_open, level_at_close)} — levels, never
+        # deltas (see _WINDOW_GAUGES).
+        self.gauges = {}
         self.stats_before = {}
         self.stats_after = {}
         # trace=True outputs
@@ -173,6 +211,12 @@ def profile_window(conn_or_server=None, trace_dir=None, trace=False):
                 k: after.get(k, 0) - before.get(k, 0)
                 for k in after
                 if after.get(k, 0) != before.get(k, 0)
+            }
+            g0 = _gauge_levels(w.stats_before)
+            g1 = _gauge_levels(w.stats_after)
+            w.gauges = {
+                k: (g0.get(k, 0), g1.get(k, 0))
+                for k in sorted(set(g0) | set(g1))
             }
         if trace_fn is not None:
             full = trace_fn()

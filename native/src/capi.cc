@@ -104,10 +104,12 @@ extern "C" {
 // request tracing — trailing `trace` int on ist_server_create,
 // ist_server_trace / ist_conn_set_trace entry points, and
 // ist_server_stats now returns the REQUIRED size instead of the
-// truncated count when the caller's buffer is too small).
+// truncated count when the caller's buffer is too small; v7: async
+// read pipeline — trailing `promote` int on ist_server_create and the
+// ist_prefetch entry point).
 // _native.py probes this at load so a stale prebuilt library fails
 // loudly instead of feeding unparseable blobs to the server.
-uint32_t ist_abi_version(void) { return 6; }
+uint32_t ist_abi_version(void) { return 7; }
 
 void ist_set_log_level(int level) { set_log_level(level); }
 void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }
@@ -120,7 +122,8 @@ void* ist_server_create(const char* host, uint16_t port,
                         const char* shm_prefix, int enable_eviction,
                         const char* ssd_path, uint64_t ssd_bytes,
                         uint64_t max_outq_bytes, uint32_t workers,
-                        double reclaim_high, double reclaim_low, int trace) {
+                        double reclaim_high, double reclaim_low, int trace,
+                        int promote) {
     ServerConfig cfg;
     cfg.host = host ? host : "0.0.0.0";
     cfg.port = port;
@@ -144,6 +147,9 @@ void* ist_server_create(const char* host, uint16_t port,
     // Request tracing (span rings + /trace export); ISTPU_TRACE=1/0
     // still overrides at start().
     cfg.trace = trace != 0;
+    // Async read pipeline (promotion worker + disk-served cold gets);
+    // ISTPU_PROMOTE=1/0 still overrides.
+    cfg.promote = promote != 0;
     return new Server(cfg);
 }
 
@@ -595,6 +601,43 @@ uint32_t ist_pin(void* h, const uint8_t* keys_blob, uint64_t blob_len,
     const uint8_t* raw = r.raw(size_t(n) * sizeof(RemoteBlock));
     if (raw == nullptr || n != nkeys) return INTERNAL_ERROR;
     memcpy(out, raw, size_t(n) * sizeof(RemoteBlock));
+    return OK;
+}
+
+// OP_PREFETCH: kick disk→pool promotion for a key batch (the async
+// read pipeline, promote.h). wait == 0: fire-and-forget — the rpc
+// rides the IO thread, the (tiny) reply is discarded, and the call
+// returns OK immediately (purely advisory: not inflight-accounted, so
+// sync() does not wait on it). wait != 0: blocking rpc; counts[4]
+// (optional) receives {resident, queued, missing, skipped} tallies.
+uint32_t ist_prefetch(void* h, const uint8_t* keys_blob, uint64_t blob_len,
+                      uint32_t nkeys, uint64_t* counts, int wait) {
+    auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
+    std::vector<uint8_t> kb;
+    if (!keys_body(keys_blob, blob_len, nkeys, kb)) return BAD_REQUEST;
+    if (wait == 0) {
+        c->rpc_async(OP_PREFETCH, std::move(kb), DoneFn{});
+        return OK;
+    }
+    std::vector<uint8_t> resp;
+    uint32_t st = c->rpc(OP_PREFETCH, std::move(kb), &resp);
+    if (st != OK) return st;
+    if (counts != nullptr) {
+        counts[0] = counts[1] = counts[2] = counts[3] = 0;
+        BufReader r(resp.data(), resp.size());
+        uint32_t n = r.u32();
+        const uint8_t* raw = r.raw(n);
+        if (raw == nullptr || n != nkeys) return INTERNAL_ERROR;
+        for (uint32_t i = 0; i < n; ++i) {
+            switch (raw[i]) {
+                case 1: counts[0]++; break;  // resident
+                case 2: counts[1]++; break;  // queued
+                case 0: counts[2]++; break;  // missing
+                default: counts[3]++; break;  // skipped (disk, not queued)
+            }
+        }
+    }
     return OK;
 }
 

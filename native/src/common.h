@@ -69,6 +69,12 @@ enum Op : uint8_t {
     OP_LEASE = 17,           // grant a batch of raw pool blocks
     OP_COMMIT_BATCH = 18,    // commit keys carved out of a lease
     OP_LEASE_REVOKE = 19,    // return a lease's unconsumed blocks
+    // Async read pipeline (promote.h): kick disk→pool promotion for a
+    // key batch and reply immediately with one status byte per key
+    // (0 missing, 1 resident, 2 promotion queued, 3 on disk but not
+    // queued). Fire-and-forget from the client's perspective — the
+    // promotion itself runs on the server's worker thread.
+    OP_PREFETCH = 20,
 };
 
 // ---------------------------------------------------------------------------

@@ -5,6 +5,7 @@
 #include <string.h>
 #include <sys/stat.h>
 #include <sys/types.h>
+#include <sys/uio.h>
 #include <sys/vfs.h>
 #include <unistd.h>
 
@@ -151,6 +152,84 @@ int64_t DiskTier::store_batch(const void* src, const uint32_t* sizes,
     return base;
 }
 
+int64_t DiskTier::store_gather(const void* const* srcs,
+                               const uint32_t* sizes, uint32_t n,
+                               int64_t* offs) {
+    if (fd_ < 0 || n == 0) return -1;
+    if (n == 1) {
+        offs[0] = store(srcs[0], sizes[0]);
+        return offs[0];
+    }
+    if (n > 256) return -1;  // iovec bound (spill batches are <= 64)
+    uint64_t total = 0;
+    uint64_t blocks = 0;
+    for (uint32_t i = 0; i < n; ++i) {
+        if (sizes[i] == 0) return -1;
+        // Alignment invariant (see header): a non-tail payload that is
+        // not block-aligned would shift every later carve off a block
+        // boundary — the gap after it belongs to ITS extent's padding,
+        // which a back-to-back pwritev cannot skip.
+        if (i + 1 < n && sizes[i] % block_size_ != 0) return -1;
+        total += sizes[i];
+        blocks += (uint64_t(sizes[i]) + block_size_ - 1) / block_size_;
+    }
+    int64_t start;
+    {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (used_blocks_.load(std::memory_order_relaxed) + blocks >
+            total_blocks_) {
+            return -1;
+        }
+        start = find_first_fit(blocks);
+        if (start < 0) return -1;
+        set_range(uint64_t(start), blocks, true);
+        used_blocks_.fetch_add(blocks, std::memory_order_relaxed);
+        search_hint_ = (uint64_t(start) + blocks) % total_blocks_;
+    }
+    int64_t base = start * int64_t(block_size_);
+    // One gathered write: the scattered pool sources land back-to-back
+    // in the reserved extent (payloads are block-aligned except the
+    // tail, so the file layout IS the iovec concatenation).
+    std::vector<iovec> iov(n);
+    for (uint32_t i = 0; i < n; ++i) {
+        iov[i].iov_base = const_cast<void*>(srcs[i]);
+        iov[i].iov_len = sizes[i];
+    }
+    uint64_t written = 0;
+    size_t vi = 0;
+    while (written < total) {
+        ssize_t w = pwritev(fd_, iov.data() + vi, int(n - vi),
+                            off_t(base + int64_t(written)));
+        if (w <= 0) {
+            if (w < 0 && errno == EINTR) continue;
+            IST_ERROR("disk tier pwritev failed: %s", strerror(errno));
+            std::lock_guard<std::mutex> lk(mu_);
+            set_range(uint64_t(start), blocks, false);
+            used_blocks_.fetch_sub(blocks, std::memory_order_relaxed);
+            return -1;
+        }
+        written += uint64_t(w);
+        size_t left = size_t(w);
+        while (left > 0 && vi < n) {
+            if (left >= iov[vi].iov_len) {
+                left -= iov[vi].iov_len;
+                vi++;
+            } else {
+                iov[vi].iov_base =
+                    static_cast<uint8_t*>(iov[vi].iov_base) + left;
+                iov[vi].iov_len -= left;
+                left = 0;
+            }
+        }
+    }
+    uint64_t run = 0;
+    for (uint32_t i = 0; i < n; ++i) {
+        offs[i] = base + int64_t(run);
+        run += sizes[i];
+    }
+    return base;
+}
+
 bool DiskTier::load(int64_t off, void* dst, uint32_t size) {
     if (fd_ < 0) return false;
     uint8_t* p = static_cast<uint8_t*>(dst);
@@ -168,6 +247,26 @@ bool DiskTier::load(int64_t off, void* dst, uint32_t size) {
         left -= uint64_t(r);
     }
     return true;
+}
+
+int64_t DiskTier::load_batch(const int64_t* offs, const uint32_t* sizes,
+                             uint32_t n, void* dst) {
+    if (fd_ < 0 || n == 0) return -1;
+    // Adjacency check against BLOCK-ROUNDED spans: extent i owns
+    // ceil(size/bs) blocks, so the next extent starts exactly at the
+    // rounded end when they are back-to-back. (The read covers the
+    // padding between a short payload and the next block boundary —
+    // garbage bytes the caller's carve never looks at.)
+    for (uint32_t i = 0; i + 1 < n; ++i) {
+        uint64_t rounded =
+            (uint64_t(sizes[i]) + block_size_ - 1) / block_size_ *
+            block_size_;
+        if (offs[i] + int64_t(rounded) != offs[i + 1]) return -1;
+    }
+    int64_t span = offs[n - 1] - offs[0] + int64_t(sizes[n - 1]);
+    if (span <= 0) return -1;
+    if (!load(offs[0], dst, uint32_t(span))) return -1;
+    return span;
 }
 
 void DiskTier::release(int64_t off, uint32_t size) {

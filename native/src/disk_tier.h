@@ -52,8 +52,27 @@ class DiskTier {
     // per-payload store().
     int64_t store_batch(const void* src, const uint32_t* sizes, uint32_t n,
                         int64_t* offs);
+    // Gather-store for POOL-FRAGMENTED spill victims: reserves ONE
+    // contiguous extent sized for all n payloads and writes them with a
+    // single pwritev from the (scattered) source pointers; offs[i]
+    // receives payload i's own extent offset, independently usable with
+    // load()/release(). Same alignment contract as store_batch — every
+    // size except the last must be a block-size multiple, so the carved
+    // offsets stay block-aligned. Violations / full tier / failed
+    // writes return -1 with nothing reserved.
+    int64_t store_gather(const void* const* srcs, const uint32_t* sizes,
+                         uint32_t n, int64_t* offs);
     // Reads back a stored extent. False on IO error.
     bool load(int64_t off, void* dst, uint32_t size);
+    // Merged read for DISK-ADJACENT extents (the promotion worker's
+    // batch path): n extents whose block-rounded spans sit back-to-back
+    // on disk land in dst with ONE pread. Payload i then starts at
+    // dst + (offs[i] - offs[0]); dst must hold
+    // offs[n-1] - offs[0] + sizes[n-1] bytes. Returns that span length,
+    // or -1 when the extents are not adjacent / the read failed —
+    // callers fall back to per-extent load().
+    int64_t load_batch(const int64_t* offs, const uint32_t* sizes,
+                       uint32_t n, void* dst);
     // Frees a stored extent.
     void release(int64_t off, uint32_t size);
 

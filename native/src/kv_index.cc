@@ -20,6 +20,9 @@ KVIndex::KVIndex(MM* mm, bool eviction, DiskTier* disk,
     // deployments that need the pre-segmentation semantics verbatim.
     const char* env = getenv("ISTPU_EXACT_LRU");
     exact_lru_ = env != nullptr && env[0] == '1';
+    if (disk_ != nullptr) {
+        promoter_ = std::make_unique<Promoter>(this, mm_, disk_, tracer_);
+    }
 }
 
 KVIndex::~KVIndex() { stop_background(); }
@@ -212,6 +215,177 @@ Status KVIndex::acquire_block(const std::string& key, bool allow_promote,
     return OK;
 }
 
+Status KVIndex::acquire_read(const std::string& key, BlockRef* out,
+                             DiskRef* disk_out,
+                             std::shared_ptr<std::vector<uint8_t>>* heap_out,
+                             uint32_t* size_out) {
+    uint32_t si = stripe_of(key);
+    Stripe& st = stripes_[si];
+    auto lk = lock_stripe(st);
+    auto it = st.map.find(key);
+    if (it == st.map.end() || !it->second.committed) return KEY_NOT_FOUND;
+    Entry& e = it->second;
+    if (size_out) *size_out = e.size;
+    if (e.block) {
+        lru_touch(st, e, it->first);
+        *out = e.block;
+        return OK;
+    }
+    if (e.disk) {
+        // Serve straight from the extent, outside all locks (the
+        // DiskRef pins it against a concurrent delete/purge/release).
+        // Promote on the SECOND touch only: a one-shot scan of a cold
+        // working set must not churn hot entries out of the pool.
+        *disk_out = e.disk;
+        disk_reads_inline_.fetch_add(1, std::memory_order_relaxed);
+        if (!e.promoting) {
+            if (e.touched) {
+                maybe_enqueue_promote(e, it->first, si);
+            } else {
+                e.touched = true;
+            }
+        }
+        return OK;
+    }
+    if (e.heap) {
+        *heap_out = e.heap;
+        return OK;
+    }
+    return INTERNAL_ERROR;  // no location at all: cannot happen
+}
+
+Status KVIndex::acquire_resident(const std::string& key, BlockRef* out,
+                                 uint32_t* size_out) {
+    uint32_t si = stripe_of(key);
+    Stripe& st = stripes_[si];
+    auto lk = lock_stripe(st);
+    auto it = st.map.find(key);
+    if (it == st.map.end() || !it->second.committed) return KEY_NOT_FOUND;
+    Entry& e = it->second;
+    if (!e.block && e.disk != nullptr) {
+        // Async-promote-and-retry: a PIN is an explicit "I will read
+        // this from the pool", so it bypasses second-touch. BUSY is
+        // the client's documented retry status — by the backoff retry
+        // the worker has adopted the pool copy, and the tier IO never
+        // ran on this worker thread.
+        if (e.promoting) return BUSY;
+        if (maybe_enqueue_promote(e, it->first, si)) return BUSY;
+        if (promoter_ != nullptr && promoter_->running()) {
+            // Admission refused: the enqueue attempt above already set
+            // promotion pressure (the reclaimer frees toward LOW), so
+            // BUSY here too — the retry lands with headroom and the
+            // promote admits. Falling back to inline promotion instead
+            // would put the tier IO right back on this worker under
+            // the stripe lock, exactly what the pipeline exists to
+            // prevent. If the reclaimer truly cannot free anything
+            // (everything pinned), the client's bounded retry surfaces
+            // BUSY — retryable, never data loss.
+            return BUSY;
+        }
+        // No worker at all: inline promotion below keeps the
+        // historical progress guarantee.
+    }
+    Status rc = ensure_resident(si, e, it->first);
+    if (rc != OK) return rc;
+    *out = e.block;
+    if (size_out) *size_out = e.size;
+    return OK;
+}
+
+void KVIndex::prefetch(const std::vector<std::string>& keys, uint8_t* out) {
+    for (size_t i = 0; i < keys.size(); ++i) {
+        uint32_t si = stripe_of(keys[i]);
+        Stripe& st = stripes_[si];
+        auto lk = lock_stripe(st);
+        auto it = st.map.find(keys[i]);
+        if (it == st.map.end() || !it->second.committed) {
+            out[i] = 0;  // missing
+            continue;
+        }
+        Entry& e = it->second;
+        if (e.block) {
+            // Resident: refresh recency — the prefetch names pages the
+            // engine is about to read; letting the reclaimer evict
+            // them now would be self-defeating.
+            lru_touch(st, e, it->first);
+            out[i] = 1;
+        } else if (e.promoting) {
+            out[i] = 2;  // already on its way
+        } else if (e.disk != nullptr &&
+                   maybe_enqueue_promote(e, it->first, si)) {
+            // Explicit future-use signal: bypass second-touch.
+            out[i] = 2;
+        } else {
+            out[i] = 3;  // disk/limbo, not queued (admission/worker off)
+        }
+    }
+}
+
+bool KVIndex::maybe_enqueue_promote(Entry& e, const std::string& key,
+                                    uint32_t si) {
+    if (promoter_ == nullptr || !promoter_->running()) return false;
+    if (!e.disk || e.promoting) return false;
+    if (!promoter_->may_admit(e.size)) {
+        // PROMOTION PRESSURE: the pool rests anywhere in [low, high)
+        // between reclaim passes, so headroom to the high watermark can
+        // be ~zero indefinitely — without this kick, admission would
+        // deadlock promotion on a full-but-not-over-high pool. The flag
+        // gives the reclaimer a secondary trigger: drive down to LOW
+        // even though HIGH was never crossed, opening (high - low) of
+        // headroom for the next prefetch/touch. Still no fighting:
+        // promotion never pushes past high, the reclaimer never digs
+        // below low — the working set cycles through the pool in
+        // bounded, LRU-ordered chunks.
+        promote_pressure_.store(true, std::memory_order_relaxed);
+        kick_reclaimer();
+        return false;
+    }
+    e.promoting = true;
+    promoter_->enqueue(PromoteItem{key, e.disk, e.size, si});
+    return true;
+}
+
+bool KVIndex::finish_promote(PromoteItem& item, BlockRef block) {
+    Stripe& st = stripes_[item.stripe];
+    std::lock_guard<std::mutex> lk(st.mu);
+    auto mit = st.map.find(item.key);
+    if (mit == st.map.end()) return false;  // erased/purged: RAII frees
+    Entry& e = mit->second;
+    if (block && e.promoting && e.committed && !e.block &&
+        e.disk == item.disk) {
+        // Adopt: the bytes are already in the block (read from the
+        // queue-pinned extent outside every lock). No epoch bump —
+        // promotion never invalidates a cached pool location (the
+        // entry had none while disk-resident).
+        e.block = std::move(block);
+        e.disk.reset();  // item.disk still pins the extent until dropped
+        e.promoting = false;
+        e.touched = false;
+        promotes_.fetch_add(1, std::memory_order_relaxed);
+        lru_touch(st, e, mit->first);
+        return true;
+    }
+    // Cancelled (re-put under a new extent, inline-promoted meanwhile,
+    // alloc/IO failure): clear the flag only when it belongs to THIS
+    // promotion cycle — a newer spill cycle's queued promote owns it
+    // otherwise.
+    if (e.promoting && (e.disk == item.disk || e.disk == nullptr)) {
+        e.promoting = false;
+    }
+    return false;
+}
+
+void KVIndex::cancel_promote_flag(const PromoteItem& item) {
+    Stripe& st = stripes_[item.stripe];
+    std::lock_guard<std::mutex> lk(st.mu);
+    auto mit = st.map.find(item.key);
+    if (mit == st.map.end()) return;
+    Entry& e = mit->second;
+    if (e.promoting && (e.disk == item.disk || e.disk == nullptr)) {
+        e.promoting = false;
+    }
+}
+
 Status KVIndex::ensure_resident(uint32_t stripe_idx, Entry& e,
                                 const std::string& key) {
     if (!e.block) {
@@ -246,6 +420,7 @@ Status KVIndex::ensure_resident(uint32_t stripe_idx, Entry& e,
                 e.heap.reset();
             } else {
                 long long tio = trace ? now_us() : 0;
+                disk_reads_inline_.fetch_add(1, std::memory_order_relaxed);
                 bool io_ok = e.disk != nullptr &&
                              e.disk->tier->load(e.disk->off, loc.ptr,
                                                 e.size);
@@ -269,6 +444,7 @@ Status KVIndex::ensure_resident(uint32_t stripe_idx, Entry& e,
             // — a read must not fail just because both tiers are at
             // capacity.
             std::vector<uint8_t> tmp(e.size);
+            disk_reads_inline_.fetch_add(1, std::memory_order_relaxed);
             if (!e.disk->tier->load(e.disk->off, tmp.data(), e.size)) {
                 return INTERNAL_ERROR;
             }
@@ -297,6 +473,11 @@ Status KVIndex::ensure_resident(uint32_t stripe_idx, Entry& e,
             return INTERNAL_ERROR;  // no location at all: cannot happen
         }
         promotes_.fetch_add(1, std::memory_order_relaxed);
+        // An inline promotion supersedes any queued async one (its
+        // finish finds the entry resident and cancels); the flags
+        // restart for the next spill cycle.
+        e.promoting = false;
+        e.touched = false;
         if (trace) {
             tracer_->record(SPAN_PROMOTE, 0, uint64_t(tp0),
                             uint64_t(now_us() - tp0));
@@ -444,8 +625,12 @@ size_t KVIndex::purge() {
     // needs them): queued spills of now-purged entries are dropped and
     // the writer's in-flight batch finishes, so when purge returns no
     // writer ref keeps purged pool blocks (or disk extents) alive —
-    // used_bytes/disk_used read 0 immediately after a purge.
+    // used_bytes/disk_used read 0 immediately after a purge. The
+    // promotion queue gets the same treatment: its items pin disk
+    // extents (DiskRefs) and its in-flight batch holds fresh pool
+    // blocks.
     cancel_queued_spills();
+    if (promoter_) promoter_->cancel_queued();
     if (n) bump_epoch();
     return n;
 }
@@ -652,6 +837,7 @@ size_t KVIndex::evict_from_stripe(uint32_t si, bool held, size_t want,
                     e.disk = std::make_shared<DiskSpan>(disk_, off, e.size);
                     bump_epoch();  // before the blocks return to the pool
                     e.block.reset();  // frees the pool blocks
+                    e.touched = false;  // second-touch restarts per cycle
                     spilled = true;
                     spills_.fetch_add(1, std::memory_order_relaxed);
                 } else {
@@ -791,7 +977,7 @@ size_t KVIndex::evict_internal(size_t want, int held_stripe,
 
 // --- background reclaim pipeline ---------------------------------------
 
-void KVIndex::start_background(double high, double low) {
+void KVIndex::start_background(double high, double low, bool promote) {
     if (!track_lru() || !(high > 0.0 && high < 1.0)) return;
     if (bg_running_.load(std::memory_order_relaxed)) return;
     high_ = high;
@@ -811,10 +997,18 @@ void KVIndex::start_background(double high, double low) {
     reclaim_thread_ = std::thread([this] { reclaim_loop(); });
     if (disk_ != nullptr) {
         spill_thread_ = std::thread([this] { spill_loop(); });
+        // Async read pipeline: admission is bounded by the SAME high
+        // watermark the reclaimer defends, so queued promotions can
+        // never push occupancy into reclaim territory.
+        if (promote && promoter_) promoter_->start(high_);
     }
 }
 
 void KVIndex::stop_background() {
+    // The promoter first: it allocates pool blocks and takes stripe
+    // locks from its own thread; joining it here means nothing below
+    // races a late adoption.
+    if (promoter_) promoter_->stop();
     bg_running_.store(false, std::memory_order_relaxed);
     bg_stop_.store(true, std::memory_order_relaxed);
     // Lock-then-notify so a thread between its predicate check and its
@@ -881,8 +1075,16 @@ void KVIndex::reclaim_loop() {
         if (bg_stop_.load(std::memory_order_relaxed)) break;
         lk.unlock();
         size_t total = mm_->total_bytes();
+        // Secondary trigger: refused promotion admission (see
+        // maybe_enqueue_promote) reclaims down to LOW even when HIGH
+        // was never crossed — the pool resting just under high would
+        // otherwise starve promotion of headroom forever.
+        bool pressure =
+            promote_pressure_.exchange(false, std::memory_order_relaxed);
         if (total != 0 &&
-            double(mm_->used_bytes()) >= high_ * double(total)) {
+            (double(mm_->used_bytes()) >= high_ * double(total) ||
+             (pressure &&
+              double(mm_->used_bytes()) > low_ * double(total)))) {
             reclaim_runs_.fetch_add(1, std::memory_order_relaxed);
             // RECLAIM_PASS span: watermark wake -> pool back under the
             // low watermark (or nothing evictable); VICTIM_SCAN spans
@@ -979,56 +1181,94 @@ void KVIndex::spill_loop() {
 
 void KVIndex::process_spill_batch(std::vector<SpillItem>& batch) {
     const size_t bs = mm_->block_size();
-    // The LRU's cold end is often a contiguous put batch: sort by pool
-    // address and merge adjacent victims into ONE reserve + pwrite
-    // (store_batch carves per-victim extents out of the combined one).
-    // Only block-aligned sizes may join a group — an unaligned payload
-    // would shift the carved offsets off block boundaries.
-    std::sort(batch.begin(), batch.end(),
-              [](const SpillItem& a, const SpillItem& b) {
-                  return a.block->loc.ptr < b.block->loc.ptr;
-              });
+    // The LRU's cold end is often a contiguous put batch: the shared
+    // extent-merge helper (promote.h, also used by the promotion
+    // worker's pread batching) sorts by POOL address and groups runs
+    // of back-to-back victims into ONE reserve + pwrite (store_batch
+    // carves per-victim extents out of the combined one). Payload
+    // adjacency is exact (ptr + size == next ptr), so only
+    // block-aligned sizes ever join a run — an unaligned payload's
+    // rounding gap would shift the carved offsets off block
+    // boundaries.
+    std::vector<MergeSpan> spans;
+    spans.reserve(batch.size());
+    for (size_t k = 0; k < batch.size(); ++k) {
+        spans.push_back(MergeSpan{
+            uint64_t(reinterpret_cast<uintptr_t>(batch[k].block->loc.ptr)),
+            batch[k].size, k});
+    }
     constexpr uint64_t kMaxGroupBytes = 64ull << 20;  // store() is u32
+    auto groups = merge_adjacent(spans, kMaxGroupBytes);
     std::vector<int64_t> offs(batch.size(), -1);
-    size_t i = 0;
-    while (i < batch.size()) {
-        size_t j = i;
-        uint64_t total = batch[i].size;
-        while (j + 1 < batch.size() && batch[j].size % bs == 0 &&
-               static_cast<uint8_t*>(batch[j].block->loc.ptr) +
-                       batch[j].size ==
-                   batch[j + 1].block->loc.ptr &&
-               total + batch[j + 1].size <= kMaxGroupBytes) {
-            ++j;
-            total += batch[j].size;
+    const bool trace = spill_ring_ != nullptr;
+    // Pool-FRAGMENTED leftovers (singleton groups): gathered below into
+    // single reserved extents + one pwritev each, so fragmentation
+    // degrades to one syscall per run instead of one per victim — and
+    // the victims land DISK-adjacent, which the promotion worker's
+    // merged preads then exploit on the way back.
+    std::vector<size_t> singles;
+    for (auto [gi, gj] : groups) {
+        if (gi == gj) {
+            singles.push_back(spans[gi].idx);
+            continue;
         }
-        // SPILL_WRITE span: the DiskTier store IO alone (the batch span
-        // around this also covers sorting + adoption re-locks).
-        const bool trace = spill_ring_ != nullptr;
         long long tw0 = trace ? now_us() : 0;
-        bool stored = false;
-        if (j > i) {
-            std::vector<uint32_t> sizes;
-            sizes.reserve(j - i + 1);
-            for (size_t k = i; k <= j; ++k) sizes.push_back(batch[k].size);
-            std::vector<int64_t> sub(sizes.size(), -1);
-            if (disk_->store_batch(batch[i].block->loc.ptr, sizes.data(),
-                                   uint32_t(sizes.size()),
-                                   sub.data()) >= 0) {
-                for (size_t k = i; k <= j; ++k) offs[k] = sub[k - i];
-                stored = true;
-            }
+        uint32_t n = uint32_t(gj - gi + 1);
+        std::vector<uint32_t> sizes(n);
+        for (uint32_t k = 0; k < n; ++k) {
+            sizes[k] = batch[spans[gi + k].idx].size;
         }
-        if (!stored) {  // single victim, or no contiguous combined fit
-            for (size_t k = i; k <= j; ++k) {
-                offs[k] = disk_->store(batch[k].block->loc.ptr,
-                                       batch[k].size);
+        std::vector<int64_t> sub(n, -1);
+        const SpillItem& first = batch[spans[gi].idx];
+        if (disk_->store_batch(first.block->loc.ptr, sizes.data(), n,
+                               sub.data()) >= 0) {
+            for (uint32_t k = 0; k < n; ++k) offs[spans[gi + k].idx] = sub[k];
+        } else {  // no contiguous combined fit: per-victim fallback
+            for (uint32_t k = 0; k < n; ++k) {
+                const SpillItem& it = batch[spans[gi + k].idx];
+                offs[spans[gi + k].idx] =
+                    disk_->store(it.block->loc.ptr, it.size);
             }
         }
         if (trace) {
             tracer_->record(SPAN_SPILL_WRITE, 0, uint64_t(tw0),
-                            uint64_t(now_us() - tw0),
-                            uint16_t(j - i + 1));
+                            uint64_t(now_us() - tw0), uint16_t(n));
+        }
+    }
+    // Gather runs over the leftovers. store_gather's carve contract:
+    // every size but a run's LAST must be block-aligned, so an
+    // unaligned single always ends its run (and a run of one simply
+    // falls through to plain store()).
+    size_t i = 0;
+    while (i < singles.size()) {
+        size_t j = i;
+        uint64_t total = batch[singles[i]].size;
+        while (j + 1 < singles.size() && batch[singles[j]].size % bs == 0 &&
+               total + batch[singles[j + 1]].size <= kMaxGroupBytes) {
+            ++j;
+            total += batch[singles[j]].size;
+        }
+        long long tw0 = trace ? now_us() : 0;
+        uint32_t n = uint32_t(j - i + 1);
+        std::vector<const void*> srcs(n);
+        std::vector<uint32_t> sizes(n);
+        for (uint32_t k = 0; k < n; ++k) {
+            const SpillItem& it = batch[singles[i + k]];
+            srcs[k] = it.block->loc.ptr;
+            sizes[k] = it.size;
+        }
+        std::vector<int64_t> sub(n, -1);
+        if (disk_->store_gather(srcs.data(), sizes.data(), n,
+                                sub.data()) >= 0) {
+            for (uint32_t k = 0; k < n; ++k) offs[singles[i + k]] = sub[k];
+        } else {  // no contiguous extent that big: per-victim fallback
+            for (uint32_t k = 0; k < n; ++k) {
+                offs[singles[i + k]] = disk_->store(srcs[k], sizes[k]);
+            }
+        }
+        if (trace) {
+            tracer_->record(SPAN_SPILL_WRITE, 0, uint64_t(tw0),
+                            uint64_t(now_us() - tw0), uint16_t(n));
         }
         i = j + 1;
     }
@@ -1069,6 +1309,7 @@ void KVIndex::finish_spill(SpillItem& item, int64_t off) {
                 lru_drop(st, e);
                 e.disk = std::move(span);
                 e.spilling = false;
+                e.touched = false;  // second-touch restarts per cycle
                 e.block.reset();  // our item.block still pins the bytes
                 spills_.fetch_add(1, std::memory_order_relaxed);
                 spill_fail_min_.store(UINT32_MAX,

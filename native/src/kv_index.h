@@ -83,37 +83,10 @@
 #include "common.h"
 #include "disk_tier.h"
 #include "mempool.h"
+#include "promote.h"  // Block/BlockRef, DiskSpan/DiskRef, Promoter
 #include "trace.h"
 
 namespace istpu {
-
-// RAII pool block: deallocates on last reference drop.
-struct Block {
-    Block(MM* mm, const PoolLoc& loc, size_t size)
-        : mm(mm), loc(loc), size(size) {}
-    ~Block() { mm->deallocate(loc, size); }
-    Block(const Block&) = delete;
-    Block& operator=(const Block&) = delete;
-
-    MM* mm;
-    PoolLoc loc;
-    size_t size;
-};
-using BlockRef = std::shared_ptr<Block>;
-
-// RAII disk-tier extent: released on last reference drop.
-struct DiskSpan {
-    DiskSpan(DiskTier* tier, int64_t off, uint32_t size)
-        : tier(tier), off(off), size(size) {}
-    ~DiskSpan() { tier->release(off, size); }
-    DiskSpan(const DiskSpan&) = delete;
-    DiskSpan& operator=(const DiskSpan&) = delete;
-
-    DiskTier* tier;
-    int64_t off;
-    uint32_t size;
-};
-using DiskRef = std::shared_ptr<DiskSpan>;
 
 // One node of a stripe's segmented-LRU list: the key plus the global
 // age stamped at the entry's last touch (front = most recent).
@@ -136,6 +109,19 @@ struct Entry {
     // still set); a read clears the flag, cancelling the spill at the
     // writer's completion check. Guarded by the stripe mutex.
     bool spilling = false;
+    // PROMOTING: the async promotion worker holds a DiskRef and is
+    // reading the bytes back toward a pool block; the entry stays
+    // disk-served meanwhile. The worker revalidates (same DiskSpan,
+    // still non-resident) under the stripe mutex before adopting —
+    // erase/purge/re-put/inline-promote races cancel. Guarded by the
+    // stripe mutex.
+    bool promoting = false;
+    // Second-touch memory (meaningful only while disk-resident; reset
+    // whenever the entry goes non-resident): the FIRST cold get serves
+    // from disk without promoting — one-shot scans must not churn the
+    // pool — and the second touch queues the async promote. Guarded by
+    // the stripe mutex.
+    bool touched = false;
     // Position in the stripe's LRU list (valid when committed and
     // resident; guarded by the stripe mutex).
     std::list<LruNode>::iterator lru_it{};
@@ -181,8 +167,13 @@ class KVIndex {
     // present — an async spill writer that performs the tier IO outside
     // all index locks. No-op unless eviction/spill is configured and
     // 0 < high < 1 (high >= 1 or <= 0 disables background reclaim; the
-    // inline last-resort path still works).
-    void start_background(double high, double low);
+    // inline last-resort path still works). With a disk tier and
+    // `promote` (the async read pipeline, promote.h), a promotion
+    // worker also starts: gets serve disk-resident keys straight from
+    // their extents and promotion happens on ITS thread
+    // (promote-on-second-touch), admission-bounded by `high`.
+    // promote=false keeps the historical inline promotion.
+    void start_background(double high, double low, bool promote = true);
     // Stop + join the background threads; queued spills are dropped
     // (their entries simply stay resident). Idempotent.
     void stop_background();
@@ -244,6 +235,48 @@ class KVIndex {
     Status acquire_block(const std::string& key, bool allow_promote,
                          BlockRef* out, uint32_t* size_out,
                          bool* promoted_out = nullptr);
+
+    // True while the async promotion worker is running — the server's
+    // read/pin paths then use acquire_read/acquire_resident below
+    // instead of the inline-promoting acquire_block.
+    bool async_promote_active() const {
+        return promoter_ != nullptr && promoter_->running();
+    }
+
+    // Read-pipeline get (OP_READ, STREAM server-push): never pays tier
+    // IO or pool allocation under the stripe lock. Exactly one of the
+    // three handles is set on OK:
+    //   *out      — resident: pinned BlockRef (the fast path);
+    //   *disk_out — disk-resident: the caller serves the bytes from the
+    //               extent OUTSIDE all locks (the DiskRef pins it, so a
+    //               concurrent delete/purge cannot free it mid-read);
+    //               second-touch policy + admission decide whether this
+    //               call also queued an async promote;
+    //   *heap_out — limbo bytes (pathological both-tiers-full parking):
+    //               served directly from the heap ref.
+    // Returns OK / KEY_NOT_FOUND.
+    Status acquire_read(const std::string& key, BlockRef* out,
+                        DiskRef* disk_out,
+                        std::shared_ptr<std::vector<uint8_t>>* heap_out,
+                        uint32_t* size_out);
+
+    // Pin-path get (OP_PIN — one-sided SHM clients memcpy from the
+    // pool, so the entry MUST be pool-resident). Resident → OK.
+    // Disk-resident → queue the async promote (PIN is an explicit
+    // will-read signal, so it bypasses second-touch) and answer BUSY;
+    // the client's backoff retry lands after the worker adopts the
+    // pool copy. When admission refuses (pool at the watermark) or the
+    // worker is not running, falls back to the historical inline
+    // promotion so progress is never lost.
+    Status acquire_resident(const std::string& key, BlockRef* out,
+                            uint32_t* size_out);
+
+    // OP_PREFETCH: per-key pipeline kick, replies immediately. out[i]:
+    //   0 missing (not committed)   1 resident (recency refreshed)
+    //   2 promotion queued (or already in flight)
+    //   3 disk-resident but not queued (admission refused / worker off)
+    // — the get path still serves 3s from disk.
+    void prefetch(const std::vector<std::string>& keys, uint8_t* out);
 
     bool check_exist(const std::string& key);  // exists && committed
 
@@ -325,6 +358,22 @@ class KVIndex {
     uint64_t spills_cancelled() const {
         return spills_cancelled_.load(std::memory_order_relaxed);
     }
+    // Disk reads paid on the data plane (cold gets served from their
+    // extents + any surviving inline promotion's tier load). After
+    // warmup on a promoted working set this stops growing — the
+    // pipeline's acceptance signal.
+    uint64_t disk_reads_inline() const {
+        return disk_reads_inline_.load(std::memory_order_relaxed);
+    }
+    uint64_t promotes_async() const {
+        return promoter_ ? promoter_->promotes_async() : 0;
+    }
+    uint64_t promote_queue_depth() const {
+        return promoter_ ? promoter_->queue_depth() : 0;
+    }
+    uint64_t promotes_cancelled() const {
+        return promoter_ ? promoter_->cancelled() : 0;
+    }
 
     // Evict least-recently-used committed entries whose blocks are not
     // pinned (use_count()==1) until `want` bytes could plausibly be
@@ -344,6 +393,9 @@ class KVIndex {
     void maybe_wake_reclaimer();
 
    private:
+    friend class Promoter;  // finish_promote / cancel_promote_flag /
+                            // maybe_wake_reclaimer from the worker thread
+
     // Inflight tokens live in per-stripe SLABS, not hash maps: a token is
     // (generation << 32) | (stripe << kSlotBits) | slot, so
     // write_dest/commit/abort — three calls per written block on the put
@@ -459,6 +511,24 @@ class KVIndex {
     // in-flight batch to finish (purge's determinism barrier: after
     // purge returns, no writer ref keeps purged pool blocks alive).
     void cancel_queued_spills();
+
+    // --- async promotion pipeline (promote.{h,cc}) --------------------
+    // Queue a disk-resident entry to the promotion worker if admission
+    // (pool headroom vs the high watermark) allows. Requires the
+    // entry's stripe mutex held; the promote queue mutex is a leaf.
+    // True iff queued (the PROMOTING flag is set).
+    bool maybe_enqueue_promote(Entry& e, const std::string& key,
+                               uint32_t si);
+    // Worker-side adoption: re-locks the item's stripe and adopts
+    // `block` only if the entry is unchanged (same DiskSpan, still
+    // committed and non-resident, still PROMOTING). Everything else —
+    // erased, purged, re-put, inline-promoted, null block (alloc/IO
+    // failure) — cancels; the extent and block free by RAII. Returns
+    // true iff adopted.
+    bool finish_promote(PromoteItem& item, BlockRef block);
+    // Clear a dropped queue item's PROMOTING flag (stop/cancel paths)
+    // so the key stays promotable.
+    void cancel_promote_flag(const PromoteItem& item);
     // Invalidate every client's pin cache (release store so a client
     // observing the new value also observes any writes that preceded
     // the bump, across the shared mapping).
@@ -488,6 +558,7 @@ class KVIndex {
     std::atomic<uint64_t> reclaim_runs_{0};
     std::atomic<uint64_t> hard_stalls_{0};
     std::atomic<uint64_t> spills_cancelled_{0};
+    std::atomic<uint64_t> disk_reads_inline_{0};
     // Global age clock for the segmented LRU (every touch stamps one).
     std::atomic<uint64_t> lru_clock_{1};
     Stripe stripes_[kStripes];
@@ -505,6 +576,10 @@ class KVIndex {
     std::mutex reclaim_mu_;
     std::condition_variable reclaim_cv_;
     std::atomic<bool> reclaim_kick_{false};
+    // Promotion pressure (see maybe_enqueue_promote): a refused
+    // promotion admission asks the reclaimer for a to-LOW pass even
+    // when occupancy never crossed HIGH.
+    std::atomic<bool> promote_pressure_{false};
     // Spill writer: queue under its own leaf mutex (taken after a
     // stripe lock on enqueue; the writer takes spill_mu_ and stripe
     // locks strictly in sequence).
@@ -529,6 +604,10 @@ class KVIndex {
     std::atomic<uint32_t> spill_fail_min_{UINT32_MAX};
     std::atomic<uint64_t> spill_fail_used_{0};
     bool spill_may_fit(uint32_t size);
+
+    // Async promotion worker (promote.{h,cc}); constructed with the
+    // disk tier, started by start_background when `promote` is on.
+    std::unique_ptr<Promoter> promoter_;
 };
 
 }  // namespace istpu

@@ -1,0 +1,273 @@
+#include "promote.h"
+
+#include <algorithm>
+#include <cstring>
+
+#include "kv_index.h"
+#include "log.h"
+#include "utils.h"
+
+namespace istpu {
+
+std::vector<std::pair<size_t, size_t>> merge_adjacent(
+    std::vector<MergeSpan>& spans, uint64_t max_group_bytes) {
+    std::sort(spans.begin(), spans.end(),
+              [](const MergeSpan& a, const MergeSpan& b) {
+                  return a.addr < b.addr;
+              });
+    std::vector<std::pair<size_t, size_t>> groups;
+    size_t i = 0;
+    while (i < spans.size()) {
+        size_t j = i;
+        uint64_t total = spans[i].len;
+        while (j + 1 < spans.size() &&
+               spans[j].addr + spans[j].len == spans[j + 1].addr &&
+               total + spans[j + 1].len <= max_group_bytes) {
+            ++j;
+            total += spans[j].len;
+        }
+        groups.emplace_back(i, j);
+        i = j + 1;
+    }
+    return groups;
+}
+
+namespace {
+// Cap on one merged promotion pread (bounds the scratch buffer; also
+// the spill writer's gather cap lives in kv_index.cc at 64 MB — reads
+// stay smaller because the scratch is a second copy of the bytes).
+constexpr uint64_t kMaxPromoteGroupBytes = 16ull << 20;
+constexpr size_t kPromoteBatch = 64;
+}  // namespace
+
+Promoter::Promoter(KVIndex* index, MM* mm, DiskTier* disk, Tracer* tracer)
+    : index_(index), mm_(mm), disk_(disk), tracer_(tracer) {}
+
+Promoter::~Promoter() { stop(); }
+
+void Promoter::start(double cap_frac) {
+    if (running_.load(std::memory_order_relaxed)) return;
+    cap_frac_ = (cap_frac > 0.0 && cap_frac < 1.0) ? cap_frac : 1.0;
+    stop_.store(false, std::memory_order_relaxed);
+    // Track created BEFORE the thread spawns (thread creation orders
+    // the ring pointer for the loop's bind call).
+    if (tracer_ != nullptr && tracer_->enabled() && ring_ == nullptr) {
+        ring_ = tracer_->add_track("promote");
+    }
+    running_.store(true, std::memory_order_relaxed);
+    thread_ = std::thread([this] { loop(); });
+}
+
+void Promoter::stop() {
+    if (!running_.exchange(false)) return;
+    stop_.store(true, std::memory_order_relaxed);
+    {
+        std::lock_guard<std::mutex> lk(mu_);
+    }
+    cv_.notify_all();
+    if (thread_.joinable()) thread_.join();
+    // Drop leftovers, clearing their PROMOTING flags so the keys stay
+    // promotable if the pipeline is ever restarted.
+    std::deque<PromoteItem> dropped;
+    {
+        std::lock_guard<std::mutex> lk(mu_);
+        dropped.swap(q_);
+    }
+    for (PromoteItem& item : dropped) drop_item(item, true);
+}
+
+bool Promoter::may_admit(uint32_t size) const {
+    // Headroom against the reclaimer's high watermark: occupancy plus
+    // every byte already promised to queued promotions must stay below
+    // it, or promotion and reclaim would chase each other across the
+    // watermarks (promote → cross high → reclaimer spills the very
+    // entries being promoted).
+    const size_t bs = mm_->block_size();
+    uint64_t rounded = (uint64_t(size) + bs - 1) / bs * bs;
+    uint64_t total = mm_->total_bytes();
+    if (total == 0) return false;
+    uint64_t cap = uint64_t(cap_frac_ * double(total));
+    uint64_t claimed = inflight_bytes_.load(std::memory_order_relaxed);
+    return mm_->used_bytes() + claimed + rounded <= cap;
+}
+
+void Promoter::enqueue(PromoteItem item) {
+    const size_t bs = mm_->block_size();
+    queue_depth_.fetch_add(1, std::memory_order_relaxed);
+    inflight_bytes_.fetch_add(
+        (uint64_t(item.size) + bs - 1) / bs * bs, std::memory_order_relaxed);
+    {
+        std::lock_guard<std::mutex> lk(mu_);
+        q_.push_back(std::move(item));
+    }
+    cv_.notify_one();
+}
+
+void Promoter::drop_item(PromoteItem& item, bool clear_flag) {
+    const size_t bs = mm_->block_size();
+    if (clear_flag) index_->cancel_promote_flag(item);
+    cancelled_.fetch_add(1, std::memory_order_relaxed);
+    inflight_bytes_.fetch_sub(
+        (uint64_t(item.size) + bs - 1) / bs * bs, std::memory_order_relaxed);
+    queue_depth_.fetch_sub(1, std::memory_order_relaxed);
+    item.disk.reset();  // extent release (if the entry dropped its ref too)
+}
+
+void Promoter::cancel_queued() {
+    if (!thread_.joinable()) return;
+    std::deque<PromoteItem> dropped;
+    uint64_t gen;
+    {
+        std::unique_lock<std::mutex> lk(mu_);
+        dropped.swap(q_);
+        gen = batch_gen_;
+    }
+    // Flags cleared OUTSIDE mu_ (stripe locks nest the other way:
+    // stripe → promote queue leaf).
+    for (PromoteItem& item : dropped) drop_item(item, true);
+    {
+        // Bounded barrier, same shape as the spill writer's: wait out
+        // only the batch that was in flight at entry — items queued
+        // after our clear belong to post-purge entries.
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this, gen] {
+            return !busy_ || batch_gen_ != gen;
+        });
+    }
+}
+
+void Promoter::loop() {
+    Tracer::bind_thread(ring_);
+    std::unique_lock<std::mutex> lk(mu_);
+    while (true) {
+        cv_.wait(lk, [this] {
+            return stop_.load(std::memory_order_relaxed) || !q_.empty();
+        });
+        if (stop_.load(std::memory_order_relaxed)) break;
+        std::vector<PromoteItem> batch;
+        size_t take = q_.size();
+        if (take > kPromoteBatch) take = kPromoteBatch;
+        batch.reserve(take);
+        for (size_t i = 0; i < take; ++i) {
+            batch.push_back(std::move(q_.front()));
+            q_.pop_front();
+        }
+        busy_ = true;
+        lk.unlock();
+        {
+            const bool trace = ring_ != nullptr;
+            long long tb0 = trace ? now_us() : 0;
+            size_t n = batch.size();
+            process_batch(batch);
+            if (trace) {
+                tracer_->record(SPAN_PROMOTE_BATCH, 0, uint64_t(tb0),
+                                uint64_t(now_us() - tb0),
+                                uint16_t(n > 0xFFFF ? 0xFFFF : n));
+            }
+        }
+        batch.clear();
+        lk.lock();
+        busy_ = false;
+        batch_gen_++;  // cancel_queued's bounded barrier
+        cv_.notify_all();
+    }
+}
+
+void Promoter::process_batch(std::vector<PromoteItem>& batch) {
+    const size_t bs = mm_->block_size();
+    // Merge DISK-ADJACENT extents into single preads: spill batching
+    // writes cold runs back-to-back (store_batch / store_gather), so a
+    // prefetch of a page chain typically reads one contiguous span.
+    std::vector<MergeSpan> spans;
+    spans.reserve(batch.size());
+    for (size_t i = 0; i < batch.size(); ++i) {
+        spans.push_back(MergeSpan{
+            uint64_t(batch[i].disk->off),
+            (uint64_t(batch[i].size) + bs - 1) / bs * bs, i});
+    }
+    auto groups = merge_adjacent(spans, kMaxPromoteGroupBytes);
+    std::vector<uint8_t> scratch;
+    const bool trace = ring_ != nullptr;
+    for (auto [gi, gj] : groups) {
+        if (gi == gj) {
+            promote_one(batch[spans[gi].idx], nullptr);
+            continue;
+        }
+        // One pread covers the whole group; per-item payloads are then
+        // memcpy'd into their pool blocks (a host copy on the worker
+        // thread buys one syscall per run instead of one per extent).
+        uint32_t n = uint32_t(gj - gi + 1);
+        std::vector<int64_t> offs(n);
+        std::vector<uint32_t> sizes(n);
+        for (uint32_t k = 0; k < n; ++k) {
+            const PromoteItem& it = batch[spans[gi + k].idx];
+            offs[k] = it.disk->off;
+            sizes[k] = it.size;
+        }
+        int64_t span = 0;
+        {
+            uint64_t need = uint64_t(offs[n - 1] - offs[0]) + sizes[n - 1];
+            if (scratch.size() < need) scratch.resize(need);
+            long long tr0 = trace ? now_us() : 0;
+            span = disk_->load_batch(offs.data(), sizes.data(), n,
+                                     scratch.data());
+            if (trace) {
+                tracer_->record(SPAN_PROMOTE_READ, 0, uint64_t(tr0),
+                                uint64_t(now_us() - tr0), uint16_t(n));
+            }
+        }
+        for (uint32_t k = 0; k < n; ++k) {
+            PromoteItem& it = batch[spans[gi + k].idx];
+            promote_one(it, span >= 0
+                                ? scratch.data() + (it.disk->off - offs[0])
+                                : nullptr);
+        }
+    }
+}
+
+void Promoter::promote_one(PromoteItem& item, const uint8_t* src) {
+    if (stop_.load(std::memory_order_relaxed)) {
+        drop_item(item, true);
+        return;
+    }
+    const size_t bs = mm_->block_size();
+    PoolLoc loc;
+    BlockRef block;
+    // Allocation failure is a CANCEL, never an inline evict — making
+    // room is the reclaimer's job; a promotion that cannot find free
+    // blocks simply leaves the entry disk-resident (gets keep serving
+    // it from the extent). Admission normally prevents landing here.
+    if (mm_->allocate(item.size, &loc)) {
+        block = std::make_shared<Block>(mm_, loc, item.size);
+        bool ok;
+        if (src != nullptr) {
+            memcpy(loc.ptr, src, item.size);
+            ok = true;
+        } else {
+            const bool trace = ring_ != nullptr;
+            long long tr0 = trace ? now_us() : 0;
+            ok = disk_->load(item.disk->off, loc.ptr, item.size);
+            if (trace) {
+                tracer_->record(SPAN_PROMOTE_READ, 0, uint64_t(tr0),
+                                uint64_t(now_us() - tr0), 1);
+            }
+        }
+        if (!ok) block.reset();  // IO error: blocks freed by RAII
+    }
+    bool adopted = index_->finish_promote(item, std::move(block));
+    if (adopted) {
+        async_.fetch_add(1, std::memory_order_relaxed);
+    } else {
+        cancelled_.fetch_add(1, std::memory_order_relaxed);
+    }
+    inflight_bytes_.fetch_sub(
+        (uint64_t(item.size) + bs - 1) / bs * bs, std::memory_order_relaxed);
+    queue_depth_.fetch_sub(1, std::memory_order_relaxed);
+    item.disk.reset();
+    // Adoption added pool usage; if it (plus foreground traffic) crossed
+    // the high watermark, the reclaimer should know now, not at the
+    // next put.
+    if (adopted) index_->maybe_wake_reclaimer();
+}
+
+}  // namespace istpu

@@ -1,0 +1,197 @@
+// promote.h — the async read pipeline: disk→pool promotion off the
+// data plane.
+//
+// PR 3 moved eviction/spill off the put path; this module is the
+// mirror image for the READ path. Before it, a get that hit a
+// disk-resident key paid the DiskTier read and the pool promotion
+// INLINE on the reading worker, under the key's stripe lock — one cold
+// read stalled every hot op hashing to the same stripe. Now:
+//
+//   - A get on a disk-resident key serves the bytes STRAIGHT FROM THE
+//     DISK EXTENT, outside all index locks (the DiskRef pins the
+//     extent, so a concurrent delete/purge can never free it mid-read)
+//     — counted as disk_reads_inline.
+//   - PROMOTE-ON-SECOND-TOUCH: the first cold get only marks the entry
+//     touched (one-shot scans never churn the pool); the second touch
+//     queues the entry to the PROMOTION WORKER below. OP_PREFETCH and
+//     OP_PIN bypass the policy — both are explicit "this will be read
+//     from the pool" signals.
+//   - The promotion worker performs the tier reads on its own thread
+//     from queue-pinned DiskRefs, merging DISK-ADJACENT extents into
+//     single preads (DiskTier::load_batch; the extent-merge helper is
+//     shared with the spill writer's gather-store batching), then
+//     revalidates under the stripe lock before adopting the pool copy
+//     — a delete/purge/re-put/spill that raced the read cancels the
+//     promotion (promotes_cancelled).
+//   - ADMISSION is bounded by pool headroom against the reclaimer's
+//     HIGH watermark: queued-promotion bytes may never push occupancy
+//     across it, so promotion cannot fight the reclaimer (promote
+//     pushes above high → reclaimer spills → re-promote → thrash).
+//     Refused keys simply keep serving from disk.
+//
+// The reference has no promotion at all — a disk hit is terminal there
+// (its aspirational SSD tier ships no code, design.rst:36); "The DMA
+// Streaming Framework" (PAPERS.md) argues for exactly this shape:
+// orchestrate tier IO in a dedicated pipeline, not on request threads.
+//
+// Lock order: the promote queue mutex is a LEAF taken after a stripe
+// lock (enqueue); the worker takes the queue mutex and stripe locks
+// strictly in sequence, never nested.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "disk_tier.h"
+#include "mempool.h"
+#include "trace.h"
+
+namespace istpu {
+
+class KVIndex;
+
+// RAII pool block: deallocates on last reference drop. (Shared handle
+// types live here, below the index: both the spill writer and the
+// promotion worker pin bytes through them across lock drops.)
+struct Block {
+    Block(MM* mm, const PoolLoc& loc, size_t size)
+        : mm(mm), loc(loc), size(size) {}
+    ~Block() { mm->deallocate(loc, size); }
+    Block(const Block&) = delete;
+    Block& operator=(const Block&) = delete;
+
+    MM* mm;
+    PoolLoc loc;
+    size_t size;
+};
+using BlockRef = std::shared_ptr<Block>;
+
+// RAII disk-tier extent: released on last reference drop. A queued
+// promotion's DiskRef keeps the extent (and its bytes) valid even if
+// the entry is erased before the worker gets to it.
+struct DiskSpan {
+    DiskSpan(DiskTier* tier, int64_t off, uint32_t size)
+        : tier(tier), off(off), size(size) {}
+    ~DiskSpan() { tier->release(off, size); }
+    DiskSpan(const DiskSpan&) = delete;
+    DiskSpan& operator=(const DiskSpan&) = delete;
+
+    DiskTier* tier;
+    int64_t off;
+    uint32_t size;
+};
+using DiskRef = std::shared_ptr<DiskSpan>;
+
+// ---------------------------------------------------------------------------
+// Extent-merge helper, shared by the promotion worker (disk-adjacent
+// extents → one pread via DiskTier::load_batch) and the spill writer
+// (pool-adjacent victims → one store_batch; the leftovers gather into
+// one reserved extent + pwritev via DiskTier::store_gather).
+// ---------------------------------------------------------------------------
+struct MergeSpan {
+    uint64_t addr;  // sort key: disk offset or pool address
+    uint64_t len;   // bytes the span occupies THERE (block-rounded)
+    size_t idx;     // caller's item index
+};
+
+// Sort `spans` by addr in place and return [first, last] (inclusive)
+// index ranges into the sorted vector where consecutive spans are
+// back-to-back (prev.addr + prev.len == next.addr), each group's total
+// capped at max_group_bytes. Singletons come back as one-element
+// groups, so callers handle exactly one shape.
+std::vector<std::pair<size_t, size_t>> merge_adjacent(
+    std::vector<MergeSpan>& spans, uint64_t max_group_bytes);
+
+// ---------------------------------------------------------------------------
+// The promotion worker.
+// ---------------------------------------------------------------------------
+struct PromoteItem {
+    std::string key;
+    DiskRef disk;       // pins the extent for the out-of-lock pread
+    uint32_t size = 0;
+    uint32_t stripe = 0;
+};
+
+class Promoter {
+   public:
+    Promoter(KVIndex* index, MM* mm, DiskTier* disk, Tracer* tracer);
+    ~Promoter();
+
+    // Spawn the worker thread. cap_frac bounds admission: queued
+    // promotion bytes may never push pool occupancy past
+    // cap_frac * total (the reclaimer's HIGH watermark when background
+    // reclaim is configured, 1.0 otherwise). Creates the "promote"
+    // trace track when tracing is enabled.
+    void start(double cap_frac);
+    // Join the worker; queued items are dropped (their PROMOTING flags
+    // cleared through the index so the keys stay promotable). Idempotent.
+    void stop();
+    bool running() const {
+        return running_.load(std::memory_order_relaxed);
+    }
+
+    // Pool-headroom admission check (no locks; callable under a stripe
+    // lock).
+    bool may_admit(uint32_t size) const;
+
+    // Queue one promotion. Caller holds the item's stripe lock and has
+    // already set the entry's PROMOTING flag; the queue mutex is a leaf.
+    void enqueue(PromoteItem item);
+
+    // Drop every queued-but-unstarted promotion (flags cleared, extents
+    // released) and wait out the worker's in-flight batch — purge()'s
+    // determinism barrier: after it returns, no worker ref keeps purged
+    // disk extents or freshly allocated pool blocks alive.
+    void cancel_queued();
+
+    uint64_t promotes_async() const {
+        return async_.load(std::memory_order_relaxed);
+    }
+    uint64_t queue_depth() const {
+        return queue_depth_.load(std::memory_order_relaxed);
+    }
+    uint64_t cancelled() const {
+        return cancelled_.load(std::memory_order_relaxed);
+    }
+
+   private:
+    void loop();
+    void process_batch(std::vector<PromoteItem>& batch);
+    // One item: allocate + fill (from `src`, or the tier when null) +
+    // hand to the index for locked revalidation/adoption.
+    void promote_one(PromoteItem& item, const uint8_t* src);
+    void drop_item(PromoteItem& item, bool clear_flag);
+
+    KVIndex* index_;
+    MM* mm_;
+    DiskTier* disk_;
+    Tracer* tracer_;
+    TraceRing* ring_ = nullptr;
+    double cap_frac_ = 1.0;
+
+    std::atomic<bool> running_{false};
+    std::atomic<bool> stop_{false};
+    std::thread thread_;
+    std::mutex mu_;                 // guards q_, busy_, batch_gen_
+    std::condition_variable cv_;
+    std::deque<PromoteItem> q_;
+    bool busy_ = false;
+    uint64_t batch_gen_ = 0;
+
+    std::atomic<uint64_t> queue_depth_{0};
+    // Block-rounded bytes queued/being promoted: admission adds these
+    // to pool occupancy so a burst of prefetches cannot collectively
+    // promise more pool than the watermark allows.
+    std::atomic<uint64_t> inflight_bytes_{0};
+    std::atomic<uint64_t> async_{0};
+    std::atomic<uint64_t> cancelled_{0};
+};
+
+}  // namespace istpu

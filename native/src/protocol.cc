@@ -28,6 +28,7 @@ const char* op_name(uint8_t op) {
         case OP_LEASE: return "LEASE";
         case OP_COMMIT_BATCH: return "COMMIT_BATCH";
         case OP_LEASE_REVOKE: return "LEASE_REVOKE";
+        case OP_PREFETCH: return "PREFETCH";
         default: return "UNKNOWN";
     }
 }

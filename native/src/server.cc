@@ -93,6 +93,11 @@ Server::Server(const ServerConfig& cfg) : cfg_(cfg) {
         cfg_.trace = trace_on;
         tracer_ = std::make_unique<Tracer>(trace_on);
     }
+    // Async read pipeline: ISTPU_PROMOTE=0/1 overrides the config
+    // (operator escape hatch, same spirit as ISTPU_TRACE).
+    if (const char* env = getenv("ISTPU_PROMOTE")) {
+        cfg_.promote = env[0] == '1';
+    }
 }
 
 Server::~Server() {
@@ -172,8 +177,11 @@ bool Server::start() {
                                        tracer_.get());
     // Background reclaim pipeline (no-op unless eviction/spill is
     // configured and the watermarks enable it): puts should normally
-    // find free blocks without ever paying reclaim inline.
-    index_->start_background(cfg_.reclaim_high, cfg_.reclaim_low);
+    // find free blocks without ever paying reclaim inline. With a disk
+    // tier, cfg_.promote also starts the async promotion worker — the
+    // read-side mirror (promote.h).
+    index_->start_background(cfg_.reclaim_high, cfg_.reclaim_low,
+                             cfg_.promote);
 
     uint32_t nworkers = resolve_workers(cfg_.workers);
     cfg_.workers = nworkers;
@@ -513,7 +521,7 @@ long long Server::restore(const std::string& path) {
 
 std::string Server::stats_json() {
     std::lock_guard<std::mutex> lk(store_mu_);
-    char head[2048];
+    char head[3072];
     snprintf(
         head, sizeof(head),
         "{\"kvmap_len\": %zu, \"inflight\": %zu, \"leases\": %zu, "
@@ -524,6 +532,8 @@ std::string Server::stats_json() {
         "\"promotes\": %llu, \"disk_bytes\": %llu, \"disk_used\": %llu, "
         "\"reclaim_runs\": %llu, \"hard_stalls\": %llu, "
         "\"spill_queue_depth\": %llu, \"spills_cancelled\": %llu, "
+        "\"promotes_async\": %llu, \"promote_queue_depth\": %llu, "
+        "\"promotes_cancelled\": %llu, \"disk_reads_inline\": %llu, "
         "\"outq_bytes\": %llu, \"outq_cap\": %llu, \"reads_busy\": %llu, "
         "\"lease_bytes\": %llu, \"pins_busy\": %llu, "
         "\"lease_blocks_out\": %llu, \"leases_oom\": %llu, "
@@ -545,6 +555,10 @@ std::string Server::stats_json() {
         (unsigned long long)(index_ ? index_->hard_stalls() : 0),
         (unsigned long long)(index_ ? index_->spill_queue_depth() : 0),
         (unsigned long long)(index_ ? index_->spills_cancelled() : 0),
+        (unsigned long long)(index_ ? index_->promotes_async() : 0),
+        (unsigned long long)(index_ ? index_->promote_queue_depth() : 0),
+        (unsigned long long)(index_ ? index_->promotes_cancelled() : 0),
+        (unsigned long long)(index_ ? index_->disk_reads_inline() : 0),
         (unsigned long long)outq_total_.load(std::memory_order_relaxed),
         (unsigned long long)cfg_.max_outq_bytes,
         (unsigned long long)reads_busy_.load(std::memory_order_relaxed),
@@ -985,7 +999,8 @@ bool Server::flush_out(Conn& c) {
 void Server::respond(Conn& c, uint64_t seq, uint8_t op,
                      std::vector<uint8_t> body_bytes,
                      std::vector<std::pair<const uint8_t*, size_t>> segs,
-                     std::vector<BlockRef> refs) {
+                     std::vector<BlockRef> refs,
+                     std::vector<std::shared_ptr<const void>> hrefs) {
     uint64_t payload = 0;
     for (auto& s : segs) payload += s.second;
     // Merge runs of segments that are contiguous in memory (first-fit
@@ -1010,6 +1025,7 @@ void Server::respond(Conn& c, uint64_t seq, uint8_t op,
     }
     m.segs = std::move(segs);
     m.refs = std::move(refs);
+    m.hrefs = std::move(hrefs);
     m.total = m.meta.size() + size_t(payload);
     c.outq_bytes += m.total;
     outq_total_.fetch_add(m.total, std::memory_order_relaxed);
@@ -1109,6 +1125,7 @@ void Server::handle_message(Conn& c) {
         case OP_COMMIT: op_commit(c); break;
         case OP_PIN: op_pin(c); break;
         case OP_RELEASE: op_release(c); break;
+        case OP_PREFETCH: op_prefetch(c); break;
         case OP_CHECK_EXIST: op_check_exist(c); break;
         case OP_GET_MATCH_LAST_IDX: op_match(c); break;
         case OP_ABORT: op_abort(c); break;
@@ -1591,37 +1608,94 @@ void Server::op_read(Conn& c) {
     }
     std::vector<std::pair<const uint8_t*, size_t>> segs;
     std::vector<BlockRef> refs;
+    std::vector<std::shared_ptr<const void>> hrefs;
     segs.reserve(keys.size());
     refs.reserve(keys.size());
+    // Read pipeline ACTIVE (promotion worker running): a disk-resident
+    // key is served straight from its extent — the pread runs on this
+    // worker but OUTSIDE every index lock, from a queue-pinned DiskRef
+    // — and promotion (second-touch policy) happens on the worker
+    // thread. No pool allocation, no OOM, no promotion budget on the
+    // read path at all. Pipeline OFF: the historical bounded inline
+    // promotion below.
+    const bool pipeline = index_->async_promote_active();
     uint64_t promoted = 0;
     for (auto& k : keys) {
-        // Bounded promotion slice per request (see kMaxPromotesPerOp):
-        // once the budget is spent, a non-resident entry answers BUSY
-        // instead of paying more tier IO. The budget counts THIS op's
-        // promotions (acquire_block reports them) — a global-counter
-        // delta would let other workers' concurrent promotions starve
-        // this op with perpetual BUSY. A failed promotion surfaces as
-        // its own (retryable) status, not KEY_NOT_FOUND — the data is
-        // still there. The returned BlockRef pins the blocks until the
-        // response bytes are on the wire.
         BlockRef b;
         uint32_t sz = 0;
-        bool did_promote = false;
-        Status st = index_->acquire_block(k, promoted < kMaxPromotesPerOp,
-                                          &b, &sz, &did_promote);
-        if (did_promote) promoted++;
+        Status st;
+        if (pipeline) {
+            DiskRef d;
+            std::shared_ptr<std::vector<uint8_t>> hp;
+            st = index_->acquire_read(k, &b, &d, &hp, &sz);
+            // Shrink revalidation (same as below): a delete + smaller
+            // re-put between the passes must not leak adjacent bytes.
+            if (st == OK && sz < block_size) st = KEY_NOT_FOUND;
+            if (st == OK && !b) {
+                const uint8_t* src = nullptr;
+                std::shared_ptr<const void> own;
+                if (hp) {  // limbo bytes: serve the heap ref directly
+                    src = hp->data();
+                    own = std::move(hp);
+                } else if (d) {
+                    // Disk-served cold read: only the block_size bytes
+                    // the response carries are loaded, into an owned
+                    // UNINITIALIZED buffer (load() overwrites exactly
+                    // that span; a vector's value-init would memset
+                    // the whole payload first) the OutMsg keeps alive
+                    // until sent.
+                    std::shared_ptr<uint8_t> buf(
+                        new uint8_t[block_size],
+                        std::default_delete<uint8_t[]>());
+                    const bool trace = tracer_->enabled();
+                    long long tio = trace ? now_us() : 0;
+                    bool ok = d->tier->load(d->off, buf.get(),
+                                            block_size);
+                    if (trace) {
+                        tracer_->record(SPAN_DISK_IO, OP_READ,
+                                        uint64_t(tio),
+                                        uint64_t(now_us() - tio));
+                    }
+                    if (!ok) {
+                        st = INTERNAL_ERROR;
+                    } else {
+                        src = buf.get();
+                        own = std::move(buf);
+                    }
+                } else {
+                    st = INTERNAL_ERROR;  // contract guard
+                }
+                if (st == OK) {
+                    segs.emplace_back(src, size_t(block_size));
+                    hrefs.push_back(std::move(own));
+                    continue;
+                }
+            }
+        } else {
+            // Bounded promotion slice per request (kMaxPromotesPerOp):
+            // once the budget is spent, a non-resident entry answers
+            // BUSY instead of paying more tier IO. The budget counts
+            // THIS op's promotions — a global-counter delta would let
+            // other workers' concurrent promotions starve this op. A
+            // failed promotion surfaces as its own (retryable) status,
+            // not KEY_NOT_FOUND — the data is still there.
+            bool did_promote = false;
+            st = index_->acquire_block(k, promoted < kMaxPromotesPerOp,
+                                       &b, &sz, &did_promote);
+            if (did_promote) promoted++;
+            // Re-validate the size from the acquire itself: between
+            // the metadata pass and here another worker may have
+            // deleted K and re-put it SMALLER — gathering block_size
+            // bytes from the new (shorter) block would leak adjacent
+            // pool memory onto the wire.
+            if (st == OK && sz < block_size) st = KEY_NOT_FOUND;
+        }
         if (st == BUSY) {
             reads_busy_.fetch_add(1, std::memory_order_relaxed);
             w.u32(BUSY);
             respond(c, c.hdr.seq, OP_READ, std::move(body));
             return;
         }
-        // Re-validate the size from the acquire itself: between the
-        // metadata pass and here another worker may have deleted K and
-        // re-put it SMALLER — gathering block_size bytes from the new
-        // (shorter) block would leak adjacent pool memory onto the
-        // wire. A shrunk entry answers like the vanished entry it is.
-        if (st == OK && sz < block_size) st = KEY_NOT_FOUND;
         if (st != OK) {
             w.u32(st);
             respond(c, c.hdr.seq, OP_READ, std::move(body));
@@ -1634,7 +1708,7 @@ void Server::op_read(Conn& c) {
     w.u32(OK);
     w.u32(uint32_t(keys.size()));
     respond(c, c.hdr.seq, OP_READ, std::move(body), std::move(segs),
-            std::move(refs));
+            std::move(refs), std::move(hrefs));
 }
 
 void Server::op_commit(Conn& c) {
@@ -1711,6 +1785,12 @@ void Server::op_pin(Conn& c) {
     std::vector<RemoteBlock> blocks;
     refs.reserve(keys.size());
     blocks.reserve(keys.size());
+    // Read pipeline ACTIVE: a pin of a disk-resident key queues the
+    // async promote and answers BUSY — the client's backoff retry
+    // (lib.py _retry_busy) lands after the promotion worker adopted
+    // the pool copy, so the tier IO never runs on this worker thread.
+    // Pipeline OFF: the historical bounded inline promotion.
+    const bool pipeline = index_->async_promote_active();
     uint64_t promoted = 0;
     for (auto& k : keys) {
         // Bounded promotion slice per request (see kMaxPromotesPerOp),
@@ -1720,8 +1800,13 @@ void Server::op_pin(Conn& c) {
         BlockRef bref;
         uint32_t sz = 0;
         bool did_promote = false;
-        Status st = index_->acquire_block(k, promoted < kMaxPromotesPerOp,
-                                          &bref, &sz, &did_promote);
+        Status st;
+        if (pipeline) {
+            st = index_->acquire_resident(k, &bref, &sz);
+        } else {
+            st = index_->acquire_block(k, promoted < kMaxPromotesPerOp,
+                                       &bref, &sz, &did_promote);
+        }
         if (did_promote) promoted++;
         if (st == BUSY) {
             pins_busy_.fetch_add(1, std::memory_order_relaxed);
@@ -1757,6 +1842,32 @@ void Server::op_pin(Conn& c) {
     // client cache these locations for future zero-RTT reads.
     w.u64(index_->epoch());
     respond(c, c.hdr.seq, OP_PIN, std::move(body));
+}
+
+void Server::op_prefetch(Conn& c) {
+    // OP_PREFETCH (promote.h): kick disk→pool promotion for a key
+    // batch and reply IMMEDIATELY — one status byte per key (0 missing,
+    // 1 resident, 2 promotion queued, 3 on disk but not queued). The
+    // promotion itself runs on the worker thread; clients treat the
+    // call as fire-and-forget. Admission is bounded by pool headroom
+    // inside the index, so a hostile prefetch storm cannot promote the
+    // pool past the reclaim watermark.
+    BufReader r(c.body.data(), c.body.size());
+    std::vector<std::string> keys;
+    r.keys(&keys);
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    if (!r.ok()) {
+        w.u32(BAD_REQUEST);
+        respond(c, c.hdr.seq, OP_PREFETCH, std::move(body));
+        return;
+    }
+    std::vector<uint8_t> st(keys.size(), 0);
+    if (!keys.empty()) index_->prefetch(keys, st.data());
+    w.u32(OK);
+    w.u32(uint32_t(keys.size()));
+    w.bytes(st.data(), st.size());
+    respond(c, c.hdr.seq, OP_PREFETCH, std::move(body));
 }
 
 void Server::op_release(Conn& c) {

@@ -98,6 +98,14 @@ struct ServerConfig {
     // the background reclaimer (inline-only, the historical behavior).
     double reclaim_high = 0.95;
     double reclaim_low = 0.85;
+    // Async read pipeline (promote.h): with a disk tier and the
+    // background reclaimer running, gets serve disk-resident keys
+    // straight from their extents (first touch) and promotion happens
+    // on a dedicated worker (promote-on-second-touch; OP_PREFETCH and
+    // OP_PIN queue immediately), admission-bounded by reclaim_high.
+    // false = the historical inline promotion on the reading worker.
+    // The ISTPU_PROMOTE env var (1/0) overrides.
+    bool promote = true;
     // Request tracing (trace.h): per-worker span rings recording each
     // op's lifecycle (parse, stripe-lock wait, copy, disk IO, commit)
     // plus reclaim/spill tracks, drained as Chrome trace-event JSON by
@@ -144,6 +152,12 @@ class Server {
         // Payload segments gathered from pool blocks (reads).
         std::vector<std::pair<const uint8_t*, size_t>> segs;
         std::vector<BlockRef> refs;  // keep blocks alive until sent
+        // Heap payloads (disk-served cold reads / limbo entries): the
+        // read pipeline answers a non-resident key from owned memory
+        // the segs point into, kept alive here until the bytes are on
+        // the wire (type-erased: a raw uninitialized read buffer or a
+        // limbo entry's vector).
+        std::vector<std::shared_ptr<const void>> hrefs;
         size_t seg_idx = 0;
         size_t off = 0;  // offset within meta or segs[seg_idx]
         bool meta_done = false;
@@ -274,7 +288,8 @@ class Server {
     void respond(Conn& c, uint64_t seq, uint8_t op,
                  std::vector<uint8_t> body_bytes,
                  std::vector<std::pair<const uint8_t*, size_t>> segs = {},
-                 std::vector<BlockRef> refs = {});
+                 std::vector<BlockRef> refs = {},
+                 std::vector<std::shared_ptr<const void>> hrefs = {});
 
     // Return a lease's unconsumed blocks to the pool (pool locks only —
     // MM is thread-safe).
@@ -292,6 +307,7 @@ class Server {
     void op_abort(Conn& c);
     void op_pin(Conn& c);
     void op_release(Conn& c);
+    void op_prefetch(Conn& c);
     void op_check_exist(Conn& c);
     void op_match(Conn& c);
     void op_simple(Conn& c);  // SYNC / PURGE / STATS / DELETE

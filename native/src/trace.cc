@@ -32,6 +32,8 @@ const char* span_kind_name(uint8_t kind) {
         case SPAN_VICTIM_SCAN: return "victim_scan";
         case SPAN_SPILL_BATCH: return "spill_batch";
         case SPAN_SPILL_WRITE: return "spill_write";
+        case SPAN_PROMOTE_BATCH: return "promote_batch";
+        case SPAN_PROMOTE_READ: return "promote_read";
         default: return "span";
     }
 }

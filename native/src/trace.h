@@ -55,6 +55,8 @@ enum SpanKind : uint8_t {
     SPAN_VICTIM_SCAN = 9,   // one evict_internal batch inside a pass
     SPAN_SPILL_BATCH = 10,  // spill writer: whole dequeued batch
     SPAN_SPILL_WRITE = 11,  // spill writer: the DiskTier store IO alone
+    SPAN_PROMOTE_BATCH = 12,  // promotion worker: whole dequeued batch
+    SPAN_PROMOTE_READ = 13,   // promotion worker: one (merged) pread
 };
 
 const char* span_kind_name(uint8_t kind);

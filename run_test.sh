@@ -34,8 +34,11 @@ if [ "${ISTPU_TSAN:-0}" = "1" ]; then
     # test_trace.py rides along: the span rings' lock-free single-
     # writer/racy-reader claims (trace.h) are checked by the race
     # detector under a real multi-worker traced workload, not just
-    # asserted in comments.
-    SMOKE="${ISTPU_TSAN_TESTS:-tests/test_concurrency.py tests/test_trace.py}"
+    # asserted in comments. test_prefetch.py brings the async read
+    # pipeline's promote/get/delete hammer — the promotion worker's
+    # queue-pinned reads + locked revalidation race foreground
+    # delete/purge/re-put there.
+    SMOKE="${ISTPU_TSAN_TESTS:-tests/test_concurrency.py tests/test_trace.py tests/test_prefetch.py}"
     # detect_deadlocks=0: TSAN's lock-order detector keeps a 64-entry
     # held-locks table per thread and CHECK-fails (FATAL) on the index's
     # cross-stripe ops, which legitimately hold 16 ordered stripe locks

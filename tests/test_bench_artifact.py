@@ -137,6 +137,35 @@ def test_evict_leg_emits_pressure_keys():
     assert "evict_reclaim_runs" in out
 
 
+def test_cold_leg_emits_prefetch_keys():
+    """The cold-read leg (ISSUE 5) must land its keys in the artifact:
+    cold-read p99 with the async read pipeline on vs off, the
+    post-prefetch hit rate (acceptance: disk_reads_inline stops growing
+    after warmup) and the warm-vs-resident p50 ratio (acceptance: a
+    promoted key reads like a pool-resident one). Ratios are asserted
+    only as sane (>0) here — CI noise is checked at the acceptance
+    level, not per test run."""
+    env = _env(600)
+    env["ISTPU_COLD_KEYS"] = "256"  # small: keep the test fast
+    p = subprocess.run(
+        [sys.executable, BENCH, "--cold-leg", "0"], env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert p.returncode == 0, p.stderr[-400:]
+    outs = _parse_artifacts(
+        [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+    )
+    assert outs, p.stdout[-400:]
+    out = outs[-1]
+    assert out["cold_get_p99_us"] > 0
+    assert out["cold_get_p99_off_us"] > 0
+    assert out["cold_get_p99_ratio"] > 0
+    assert 0.0 <= out["prefetch_hit_rate"] <= 1.0
+    assert out["prefetch_hit_rate"] > 0  # prefetch actually promoted
+    assert out["cold_promotes_async"] > 0
+    assert out["cold_warm_vs_resident_p50"] > 0
+
+
 def test_trace_leg_emits_overhead_keys():
     """The tracing-overhead leg (ISSUE 4) must land its keys in the
     artifact: traced vs untraced stream-shape read p50 and the ratio

@@ -1202,3 +1202,127 @@ def test_admission_survives_store_death_after_cached_probe(
     cold = ServingEngine(params, wcfg)
     ref = cold.run([Request("x", prompt, max_new_tokens=3)])
     assert out["r"] == ref["x"]
+
+
+def test_admission_prefetch_restores_from_pool(params, cfg, tmp_path):
+    """Async read pipeline (PR 5): when the cached prefix chain has
+    been spilled to the store's disk tier, the admission probe's
+    prefetch promotes it BEFORE the restore asks — the restore then
+    pins pool-resident pages and the server pays ZERO inline disk
+    reads on the restore path (disk_reads_inline flat across turn 2),
+    while the promotion worker's counters move."""
+    from infinistore_tpu import (
+        ClientConfig,
+        InfiniStoreServer,
+        InfinityConnection,
+        ServerConfig,
+        TYPE_SHM,
+    )
+    from infinistore_tpu.tpu import TpuKVStore
+
+    import time
+
+    # Tiny pool + disk tier; wide watermark band so promotion admission
+    # has headroom for the whole prefix chain (hit*2L*2 pages of 4 KB
+    # blocks) while filler keeps the engine pages spilled.
+    srv = InfiniStoreServer(
+        ServerConfig(
+            service_port=0,
+            prealloc_size=(256 << 10) / (1 << 30),  # 64 x 4 KB blocks
+            minimal_allocate_size=4,
+            ssd_path=str(tmp_path),
+            ssd_size=(2 << 20) / (1 << 30),
+            reclaim_high=0.9,
+            reclaim_low=0.5,
+        )
+    )
+    srv.start()
+    conn = InfinityConnection(
+        ClientConfig(
+            host_addr="127.0.0.1",
+            service_port=srv.service_port,
+            connection_type=TYPE_SHM,
+        )
+    )
+    conn.connect()
+    try:
+        store = TpuKVStore(conn)
+        rng = np.random.default_rng(21)
+        turn1 = _prompt(rng, cfg, 16)  # two full pages
+        eng1 = ServingEngine(params, cfg, store=store)
+        out1 = eng1.run([Request("t1", turn1, max_new_tokens=8)])
+        assert eng1.stats["offloaded_pages"] > 0
+
+        # Push the engine's pages to DISK: filler twice the pool.
+        blk = 4096
+        filler = np.zeros(blk, dtype=np.uint8)
+        for i in range(128):
+            conn.put_cache(filler, [(f"filler{i}", 0)], blk)
+        conn.sync()
+        deadline = time.time() + 10
+        while time.time() < deadline and srv.stats()["spills"] == 0:
+            time.sleep(0.02)
+        assert srv.stats()["spills"] > 0
+
+        convo = turn1 + out1["t1"]
+        turn2 = convo[: (len(convo) // cfg.page_size) * cfg.page_size]
+        turn2 = turn2 + _prompt(rng, cfg, 5)
+        before = srv.stats()
+        eng2 = ServingEngine(params, cfg, store=store)
+        out2 = eng2.run([Request("t2", turn2, max_new_tokens=6)])
+        after = srv.stats()
+        assert eng2.stats["prefix_hit_pages"] > 0
+        assert eng2.stats["prefetched_pages"] > 0
+        assert eng2.stats["restore_misses"] == 0
+        # THE acceptance property: the restore path paid no inline
+        # disk reads — pages were pool-resident (promoted by the
+        # worker off the prefetch) or pinned through the BUSY-retry
+        # that waits for the promotion, never read inline.
+        assert after["disk_reads_inline"] == before["disk_reads_inline"], (
+            before["disk_reads_inline"], after["disk_reads_inline"],
+        )
+        cold = ServingEngine(params, cfg)
+        ref = cold.run([Request("x", turn2, max_new_tokens=6)])
+        assert out2["t2"] == ref["x"]
+    finally:
+        conn.close()
+        srv.stop()
+
+
+def test_eviction_race_during_prefetch_degrades_to_miss(
+    params, cfg, shm_conn
+):
+    """A chain evicted between the probe's prefetch and the restore is
+    a routine CACHE MISS — restore_misses counts it, the engine prefills
+    cold, tokens stay correct, and the store is NOT downgraded."""
+    from infinistore_tpu.tpu import TpuKVStore
+
+    rng = np.random.default_rng(22)
+    turn1 = _prompt(rng, cfg, 16)
+    base = TpuKVStore(shm_conn)
+    eng1 = ServingEngine(params, cfg, store=base)
+    out1 = eng1.run([Request("t1", turn1, max_new_tokens=8)])
+    assert eng1.stats["offloaded_pages"] > 0
+
+    class RacyStore(TpuKVStore):
+        """Evicts the very chain it was asked to prefetch — the
+        worst-case LRU race between probe and restore."""
+
+        def prefetch(self, keys):
+            ok = super().prefetch(keys)
+            self.conn.delete_keys(list(dict.fromkeys(keys)))
+            return ok
+
+    racy = RacyStore(shm_conn)
+    convo = turn1 + out1["t1"]
+    turn2 = convo[: (len(convo) // cfg.page_size) * cfg.page_size]
+    turn2 = turn2 + _prompt(rng, cfg, 5)
+    eng2 = ServingEngine(params, cfg, store=racy)
+    out2 = eng2.run([Request("t2", turn2, max_new_tokens=6)])
+    assert eng2.stats["prefetched_pages"] > 0  # the hint fired
+    assert eng2.stats["restore_misses"] >= 1   # ...and lost the race
+    assert eng2.stats["store_errors"] == 0     # a miss, never an error
+    assert eng2._store_ok                      # no downgrade
+    cold = ServingEngine(params, cfg)
+    ref = cold.run([Request("x", turn2, max_new_tokens=6)])
+    assert out2["t2"] == ref["x"]

@@ -444,7 +444,9 @@ def test_reclaim_and_spill_tracks(tmp_path):
                 blk,
             )
         conn.sync()
-        # Read back a cold key: promotion spans on the worker track.
+        # Read back a cold key: under the async read pipeline (PR 5)
+        # the first touch serves straight from the disk extent — a
+        # disk_io span on the worker track, NO inline promotion.
         dst = np.zeros(blk, dtype=np.uint8)
         conn.read_cache(dst, [("pressure0", 0)], blk)
         # The spill writer is asynchronous: give its in-flight batch a
@@ -455,8 +457,17 @@ def test_reclaim_and_spill_tracks(tmp_path):
             if srv.stats()["spills"] > 0:
                 break
             _time.sleep(0.02)
+        # Kick the promotion worker explicitly (prefetch bypasses
+        # second-touch) so its track carries spans.
+        conn.prefetch([f"pressure{i}" for i in range(64)])
+        for _ in range(200):
+            if srv.stats()["promotes_async"] > 0:
+                break
+            _time.sleep(0.02)
         stats = srv.stats()
         assert stats["reclaim_runs"] > 0
+        assert stats["disk_reads_inline"] > 0  # cold read was disk-served
+        assert stats["promotes_async"] > 0
         doc = srv.trace()
         tracks = {
             e["args"]["name"]
@@ -464,13 +475,16 @@ def test_reclaim_and_spill_tracks(tmp_path):
             if e.get("ph") == "M"
         }
         assert "reclaim" in tracks and "spill-writer" in tracks
+        # The promotion worker's own track (PR 5).
+        assert "promote" in tracks
         cats = {
             e["cat"] for e in doc["traceEvents"] if e.get("ph") == "X"
         }
         assert "reclaim_pass" in cats and "victim_scan" in cats
         assert "spill_batch" in cats and "spill_write" in cats
-        # Foreground promotion of the cold read.
-        assert "promote" in cats
+        # Cold read served from the extent + the worker's batch spans.
+        assert "disk_io" in cats
+        assert "promote_batch" in cats and "promote_read" in cats
     finally:
         conn.close()
         srv.stop()
