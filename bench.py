@@ -601,6 +601,107 @@ def bench_trace_overhead(nkeys=None, block_kb=4, passes=3):
     }
 
 
+def bench_chaos_overhead(nkeys=None, block_kb=4, passes=3):
+    """Failpoints-disarmed overhead leg (ISSUE 6 acceptance:
+    chaos_off_overhead_p50_ratio <= 1.02 on CI).
+
+    The failpoint subsystem is compiled into every hot path (socket
+    read/write, pool allocate, tier IO); its cost contract is ONE
+    relaxed atomic load per disarmed site. A disarmed point is
+    indistinguishable from an untouched one at the check() gate (both
+    read armed_==0), so an A/B of those two states would measure pure
+    noise. Instead leg B ARMS every hot-site point with a never-firing
+    every(2^30) policy: each check takes the slow path through the full
+    policy evaluation (atomic counter + modulo) without ever injecting
+    — a strict UPPER BOUND on the disarmed cost the contract pins, and
+    the worst steady state of a production box mid-chaos-drill. Emits:
+      chaos_off_p50_read_us        armed-but-never-firing p50
+      chaos_baseline_p50_read_us   untouched-registry p50
+      chaos_off_overhead_p50_ratio armed / baseline (best-of-passes)
+    """
+    import os
+
+    import numpy as np
+
+    from infinistore_tpu import (
+        ClientConfig,
+        InfiniStoreServer,
+        InfinityConnection,
+        ServerConfig,
+    )
+
+    if nkeys is None:
+        nkeys = int(os.environ.get("ISTPU_CHAOS_KEYS", "512"))
+    block_bytes = block_kb << 10
+
+    def run_leg(registered):
+        srv = InfiniStoreServer(
+            ServerConfig(
+                service_port=0,
+                prealloc_size=max(2 * nkeys * block_bytes, 1 << 20)
+                / (1 << 30),
+                minimal_allocate_size=block_kb,
+            )
+        )
+        port = srv.start()
+        if registered:
+            # every(2^30) never fires within the leg (~1.5k evals per
+            # site) but keeps armed_==1, so every check pays the full
+            # policy evaluation instead of the disarmed early-out.
+            n = 1 << 30
+            srv.fault(
+                f"sock.recv=every({n}):err(5);"
+                f"sock.send=every({n}):err(5);"
+                f"pool.alloc=every({n});"
+                f"disk.pwrite=every({n}):err(5);"
+                f"disk.pread=every({n}):err(5)"
+            )
+        try:
+            conn = InfinityConnection(
+                ClientConfig(
+                    host_addr="127.0.0.1", service_port=port,
+                    connection_type="STREAM",
+                )
+            )
+            conn.connect()
+            try:
+                src = np.random.default_rng(5).integers(
+                    0, 255, block_bytes, dtype=np.uint8
+                )
+                for i in range(nkeys):
+                    conn.put_cache(src, [(f"ch{i}", 0)], block_bytes)
+                conn.sync()
+                dst = np.zeros(block_bytes, dtype=np.uint8)
+                p50 = None
+                for _ in range(passes):
+                    lats = []
+                    for i in range(nkeys):
+                        t0 = time.perf_counter()
+                        conn.read_cache(dst, [(f"ch{i}", 0)], block_bytes)
+                        lats.append(time.perf_counter() - t0)
+                    p = float(np.percentile(np.array(lats) * 1e6, 50))
+                    p50 = p if p50 is None else min(p50, p)
+                return p50
+            finally:
+                conn.close()
+        finally:
+            if registered:
+                # The registry is process-global: disarm so a combined
+                # bench run doesn't carry armed points into later legs.
+                srv.fault("off")
+            srv.stop()
+
+    base_p50 = run_leg(False)
+    off_p50 = run_leg(True)
+    return {
+        "chaos_nkeys": nkeys,
+        "chaos_off_p50_read_us": round(off_p50, 1),
+        "chaos_baseline_p50_read_us": round(base_p50, 1),
+        "chaos_off_overhead_p50_ratio": round(off_p50 / base_p50, 3)
+        if base_p50 else 0.0,
+    }
+
+
 def bench_sharded(n_shards=4, nkeys=4096, block_kb=4, workers=1,
                   io_threads=None, passes=2):
     """Sharded-store leg (BASELINE config 5 scaled to one host): the same
@@ -2458,6 +2559,14 @@ def main():
         except Exception as e:
             print(json.dumps({"trace_overhead_error": str(e)[:200]}))
         return 0
+    if "--chaos-leg" in sys.argv:
+        # Failpoints-disarmed overhead A/B (ISSUE 6 acceptance <=1.02);
+        # boots its own two servers, port argument accepted but unused.
+        try:
+            print(json.dumps(bench_chaos_overhead()))
+        except Exception as e:
+            print(json.dumps({"chaos_overhead_error": str(e)[:200]}))
+        return 0
 
     import os
 
@@ -2589,6 +2698,14 @@ def main():
             out.update(bench_trace_overhead())
         except Exception as e:
             out["trace_overhead_error"] = str(e)[:200]
+        publish()
+        # Failpoints-disarmed overhead leg (ISSUE 6 acceptance: <=
+        # 1.02): the chaos subsystem's hot-path checks, registered but
+        # disarmed, vs an untouched registry. CPU-only, own servers.
+        try:
+            out.update(bench_chaos_overhead())
+        except Exception as e:
+            out["chaos_overhead_error"] = str(e)[:200]
         publish()
         # Sharded leg is CPU-only: run it BEFORE any tunnel-bound leg so
         # a wedged tunnel can never cost it (it boots its own servers;

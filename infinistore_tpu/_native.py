@@ -101,6 +101,17 @@ def _decls(lib):
         ("ist_server_snapshot", c.c_longlong, [c.c_void_p, c.c_char_p]),
         ("ist_server_restore", c.c_longlong, [c.c_void_p, c.c_char_p]),
         ("ist_server_shm_prefix", c.c_int, [c.c_void_p, c.c_char_p, c.c_int]),
+        # fault injection (failpoint subsystem, ABI v8)
+        (
+            "ist_server_fault",
+            c.c_int,
+            [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int],
+        ),
+        (
+            "ist_server_fault_list",
+            c.c_longlong,
+            [c.c_void_p, c.c_char_p, c.c_longlong],
+        ),
         # client
         (
             "ist_conn_create",
@@ -232,21 +243,23 @@ def _decls(lib):
         ("ist_mm_total_bytes", c.c_uint64, [c.c_void_p]),
         ("ist_mm_num_pools", c.c_uint64, [c.c_void_p]),
     ]
-    # ABI probe FIRST: a stale prebuilt library would misparse the
-    # v7 ist_server_create argument list (promote flag), the v6 trace
-    # flag, the v5 reclaim watermarks, the v4 multi-worker knob or the
-    # v3 ist_conn_create lease knobs, or lack the newer entry points
-    # (ist_prefetch, ist_server_trace, ist_conn_set_trace) entirely. A
-    # missing or old-version symbol fails loudly here instead.
+    # ABI probe FIRST: a stale prebuilt library would lack the v8 fault
+    # entry points (ist_server_fault / ist_server_fault_list), misparse
+    # the v7 ist_server_create argument list (promote flag), the v6
+    # trace flag, the v5 reclaim watermarks, the v4 multi-worker knob
+    # or the v3 ist_conn_create lease knobs, or lack the newer entry
+    # points (ist_prefetch, ist_server_trace, ist_conn_set_trace)
+    # entirely. A missing or old-version symbol fails loudly here
+    # instead.
     try:
         lib.ist_abi_version.restype = ct.c_uint32
         lib.ist_abi_version.argtypes = []
         ver = int(lib.ist_abi_version())
     except AttributeError:
         ver = 1
-    if ver < 7:
+    if ver < 8:
         raise RuntimeError(
-            f"stale native library at {_LIB_PATH} (ABI v{ver} < v7): "
+            f"stale native library at {_LIB_PATH} (ABI v{ver} < v8): "
             "rebuild with `make -C native` (or delete the .so to let "
             "the import auto-build)"
         )

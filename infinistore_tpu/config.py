@@ -45,6 +45,16 @@ class ClientConfig:
         # connection-level failure (timeout teardown / broken socket).
         # Beyond reference parity: the reference has no client reconnect.
         self.auto_reconnect = kwargs.get("auto_reconnect", False)
+        # Retry pacing (ISSUE 6 satellite). Base delay in ms for BOTH
+        # client-side retry loops: (a) the auto_reconnect retry sleeps
+        # a jittered, per-streak-doubled delay (bounded at 2 s) between
+        # the reconnect and the replay — a fleet of clients hammering a
+        # restarting server in lockstep is exactly the thundering herd
+        # jitter exists to break; (b) the BUSY/OOM backoff loop
+        # (server backpressure, OP_PIN-on-disk-key promotion retries)
+        # uses it as its max per-attempt delay. 0 disables the
+        # reconnect-side sleep and keeps the historical 50 ms busy cap.
+        self.retry_backoff_ms = kwargs.get("retry_backoff_ms", 50)
         # Lease mode (SHM path only): put_cache carves destinations out
         # of a server-granted block lease with zero round trips, commits
         # ride one batched deferred OP_COMMIT_BATCH (flushed by sync(),
@@ -104,6 +114,8 @@ class ClientConfig:
             raise Exception("lease_blocks must be positive")
         if self.flush_size <= 0:
             raise Exception("flush_size must be positive")
+        if self.retry_backoff_ms < 0:
+            raise Exception("retry_backoff_ms must be >= 0")
 
 
 class ServerConfig:

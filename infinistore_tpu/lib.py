@@ -19,6 +19,7 @@ lib.py:330-707). Differences, all TPU-driven:
 import asyncio
 import ctypes as ct
 import logging
+import random
 import threading
 import time
 
@@ -184,6 +185,9 @@ class InfinityConnection:
         # double-reconnects; dead handles are freed only at close().
         self._reconnect_lock = threading.Lock()
         self._conn_gen = 0
+        # Consecutive reconnect-retries without an intervening success:
+        # drives the exponential half of the retry backoff.
+        self._retry_streak = 0
         self._dead_handles = []
         self._ever_connected = False
         # Request tracing (config.trace): each logical op stamps a
@@ -383,10 +387,14 @@ class InfinityConnection:
         h0 = self._h
         gen = self._conn_gen
         try:
-            return fn()
+            out = fn()
+            self._retry_streak = 0
+            return out
         except InfiniStoreError as e:
             self._reconnect_for_retry(e, h0, gen, keys)
-            return fn()
+            out = fn()
+            self._retry_streak = 0
+            return out
 
     def _reconnect_for_retry(self, e, h0, gen, keys):
         """The recovery half of :meth:`_run_reconnecting`: decide whether
@@ -414,6 +422,17 @@ class InfinityConnection:
                 raise e
             if keys:
                 self._reclaim_orphans(keys)
+        # Bounded exponential backoff with jitter BETWEEN the reconnect
+        # and the retry (ISSUE 6 satellite — it was immediate): a
+        # restarting server greets a fleet of auto_reconnect clients
+        # all at once, and the jitter de-synchronizes their replays.
+        # Doubles per consecutive retry (streak reset on any success),
+        # bounded at 2 s; retry_backoff_ms=0 restores immediate retry.
+        base_ms = getattr(self.config, "retry_backoff_ms", 0)
+        if base_ms > 0:
+            self._retry_streak = min(self._retry_streak + 1, 6)
+            cap_ms = min(base_ms * (1 << (self._retry_streak - 1)), 2000)
+            time.sleep(random.uniform(0.5, 1.0) * cap_ms / 1000.0)
 
     def _retry_busy(self, attempt):
         """Run ``attempt(remaining_ms)`` retrying the read path's two
@@ -427,17 +446,32 @@ class InfinityConnection:
         spill transiently claimed the space a bounce-swap expected).
         The remaining budget is handed to each attempt so native waits
         never extend the caller's total bound past the configured
-        timeout. Returns the final status."""
+        timeout. Delays double per attempt with jitter, bounded by
+        ``config.retry_backoff_ms`` (the OP_PIN-on-disk-key BUSY path —
+        the promotion worker adopts within a few ms, so the cap keeps
+        the post-adoption retry prompt while the jitter keeps a fleet
+        of pinners from re-arriving in lockstep). Returns the final
+        status."""
         deadline = time.monotonic() + self.config.timeout_ms / 1000.0
         delay = 0.001
+        cap = self._busy_retry_cap_s()
         retryable = (_native.BUSY, _native.OUT_OF_MEMORY)
         while True:
             remaining_ms = int(max(1, (deadline - time.monotonic()) * 1000))
             st = attempt(remaining_ms)
             if st not in retryable or time.monotonic() >= deadline:
                 return st
-            time.sleep(delay)
-            delay = min(delay * 2, 0.05)
+            time.sleep(delay * random.uniform(0.5, 1.0))
+            delay = min(delay * 2, cap)
+
+    def _busy_retry_cap_s(self):
+        """Max per-attempt delay (seconds) for the BUSY/OOM backoff
+        loops — sync and async share this so the pacing contract lives
+        in one place. ``retry_backoff_ms=0`` disables only the
+        reconnect-side sleep; the busy loops keep the historical 50 ms
+        cap (config.py contract)."""
+        base_ms = getattr(self.config, "retry_backoff_ms", 50)
+        return (base_ms if base_ms > 0 else 50) / 1000.0
 
     def _stamp_trace(self):
         """Stamp a fresh per-logical-op trace id onto the native
@@ -956,6 +990,7 @@ class InfinityConnection:
         # no free pool blocks right now — see _retry_busy).
         deadline = time.monotonic() + self.config.timeout_ms / 1000.0
         delay = 0.001
+        cap = self._busy_retry_cap_s()  # same pacing as _retry_busy
         retryable = (_native.BUSY, _native.OUT_OF_MEMORY)
         while True:
             future = loop.create_future()
@@ -972,8 +1007,8 @@ class InfinityConnection:
                 if (e.status not in retryable
                         or time.monotonic() >= deadline):
                     raise
-            await asyncio.sleep(delay)
-            delay = min(delay * 2, 0.05)
+            await asyncio.sleep(delay * random.uniform(0.5, 1.0))
+            delay = min(delay * 2, cap)
 
     # ------------------------------------------------------------------
     # control ops

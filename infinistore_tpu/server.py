@@ -110,6 +110,33 @@ class InfiniStoreServer:
         """``trace_json`` parsed into a dict ({"traceEvents": [...]})."""
         return json.loads(self.trace_json())
 
+    def fault(self, spec):
+        """Arm/disarm failpoints from a spec string (grammar in
+        native/src/failpoint.h): ``"name=policy[:action];..."`` with
+        policies ``off | once | every(N) | prob(P) | count(K)`` and
+        actions ``err[(errno)] | short | delay(us) | kill``; the bare
+        word ``"off"`` disarms everything. Returns the number of
+        points touched; raises on a parse error (all-or-nothing —
+        nothing from a bad spec is applied). Also reachable as
+        ``POST /fault`` on the manage plane and the ``ISTPU_FAILPOINTS``
+        env var at server start."""
+        err = ct.create_string_buffer(256)
+        n = int(self._lib.ist_server_fault(
+            self._h, spec.encode(), err, len(err)))
+        if n < 0:
+            raise ValueError(
+                f"failpoint spec rejected: {err.value.decode()}"
+            )
+        return n
+
+    def faults(self):
+        """Every registered failpoint with its current arming and fire
+        count: ``{"failpoints": [{name, spec, fired}], "fired_total"}``
+        (``GET /fault`` serves the same blob)."""
+        return json.loads(
+            self._read_blob(self._lib.ist_server_fault_list, initial=8192)
+        )
+
     def snapshot(self, path):
         """Write every committed entry to ``path`` (atomic tmp+rename).
         Returns the entry count; raises on IO failure. Beyond reference
@@ -189,6 +216,15 @@ def _prometheus_metrics(stats):
          "entries queued to the async spill writer"),
         ("promote_queue_depth", "promote_queue_depth",
          "entries queued to the async promotion worker"),
+        # Failure model (ISSUE 6): every degradation an operator must
+        # see — a tier gone read-only behind its breaker, a dead
+        # background worker running in inline-fallback mode.
+        ("tier_breaker_open", "tier_breaker_open",
+         "disk-tier write circuit breaker open (1 = stores refused, "
+         "pure-pool degraded mode, backoff re-probe pending)"),
+        ("workers_dead", "workers_dead",
+         "background workers (reclaimer/spill/promote) that died; "
+         "their kick paths degrade to inline fallbacks"),
     ]
     c = [
         ("ops", "ops", "requests handled"),
@@ -211,6 +247,11 @@ def _prometheus_metrics(stats):
         ("disk_reads_inline", "disk_reads_inline",
          "disk reads paid on the data plane (cold gets served from "
          "their extents + inline promotions)"),
+        ("disk_io_errors", "disk_io_errors",
+         "disk-tier IO errors (failed pread/pwrite/pwritev, real or "
+         "injected); write errors feed the tier circuit breaker"),
+        ("failpoints_fired", "failpoints_fired",
+         "fault injections fired across all armed failpoints"),
     ]
     lines = []
     for key, name, help_ in g:
@@ -374,8 +415,30 @@ def make_control_plane(server: InfiniStoreServer, snapshot_path=None):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path == "/fault":
+                # Failpoint catalog: name, current arming, fire count.
+                self._send(200, server.faults())
             elif self.path == "/health":
-                self._send(200, {"status": "ok"})
+                # Liveness + failure-model summary: a dead background
+                # worker or an open tier breaker is DEGRADED (the store
+                # still serves — inline fallbacks / pure-pool mode),
+                # never dead.
+                st = server.stats()
+                degraded = bool(
+                    st.get("workers_dead", 0)
+                    or st.get("tier_breaker_open", 0)
+                )
+                self._send(
+                    200,
+                    {
+                        "status": "degraded" if degraded else "ok",
+                        "workers_dead": st.get("workers_dead", 0),
+                        "tier_breaker_open": st.get(
+                            "tier_breaker_open", 0
+                        ),
+                        "disk_io_errors": st.get("disk_io_errors", 0),
+                    },
+                )
             else:
                 self._send(404, {"error": "not found"})
 
@@ -383,6 +446,29 @@ def make_control_plane(server: InfiniStoreServer, snapshot_path=None):
             if self.path == "/purge":
                 n = server.purge()
                 self._send(200, {"purged": n})
+            elif self.path == "/fault":
+                # Arm/disarm failpoints at runtime. Body: either a raw
+                # spec string ("disk.pwrite=once:err(5);...") or JSON
+                # {"spec": "..."}; "off" disarms everything. Grammar in
+                # native/src/failpoint.h; catalog via GET /fault.
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                body = self.rfile.read(length).decode(errors="replace")
+                spec = body.strip()
+                if spec.startswith("{"):
+                    try:
+                        spec = json.loads(spec).get("spec", "")
+                    except ValueError:
+                        self._send(400, {"error": "bad JSON body"})
+                        return
+                    if not isinstance(spec, str):
+                        self._send(400, {"error": "spec must be a string"})
+                        return
+                try:
+                    n = server.fault(spec)
+                except ValueError as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                self._send(200, {"armed": n, "spec": spec})
             elif self.path.startswith("/selftest"):
                 parts = self.path.rstrip("/").split("/")
                 port = (

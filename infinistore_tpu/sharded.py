@@ -120,12 +120,19 @@ class ShardedConnection:
     one-sided copies across client threads).
     """
 
-    def __init__(self, configs, degrade_on_failure=True, io_threads=None):
+    def __init__(self, configs, degrade_on_failure=True, io_threads=None,
+                 recover_interval_s=0.5):
         if not configs:
             raise ValueError("need at least one shard config")
         self.conns = [InfinityConnection(c) for c in configs]
         self.n = len(configs)
         self.io_threads = io_threads
+        # Recovery prober cadence (ISSUE 6 satellite): base interval
+        # between redial passes; a pass in which NO dead shard came
+        # back doubles the wait up to 8x base (bounded backoff — a
+        # long outage must not burn a core redialing), and any
+        # successful rejoin resets it.
+        self.recover_interval_s = max(float(recover_interval_s), 0.01)
         self._io = self.n  # resolved at connect()
         self.connected = False
         # TpuKVStore compatibility: the sharded surface always moves
@@ -148,8 +155,20 @@ class ShardedConnection:
             #                           are unknowable once the shard
             #                           is unreachable
         }
+        # Per-shard failure forensics (health["per_shard"]): which
+        # shard keeps dying, and what its last failure looked like —
+        # the aggregate counters above cannot distinguish one flapping
+        # shard from N healthy ones each failing once.
+        self.shard_health = [
+            {"failures": 0, "reconnects": 0, "last_error": ""}
+            for _ in range(self.n)
+        ]
         self._health_lock = threading.Lock()
         self._reconnector = None
+        # Wakes the prober out of its backoff sleep: close() must not
+        # block behind an 8x-base wait (the join below would stall up
+        # to recover_interval_s*8 on an uninterruptible time.sleep).
+        self._recover_wake = threading.Event()
         self._pool = None
         # Request tracing: ONE id per logical sharded op, pinned onto
         # every shard connection so the per-shard sub-calls stitch to a
@@ -176,6 +195,7 @@ class ShardedConnection:
             # every shard being down — and the failure cleanup would
             # then close a perfectly healthy store.
             raise RuntimeError("already connected")
+        self._recover_wake.clear()  # re-arm the prober's backoff sleep
         self._pool = ThreadPoolExecutor(
             max_workers=self.n, thread_name_prefix="istpu-shard"
         )
@@ -188,7 +208,7 @@ class ShardedConnection:
                 except Exception as e:
                     if not (self.degrade and _is_conn_failure(e)):
                         raise
-                    dead.append(s)
+                    dead.append((s, e))
             if len(dead) == self.n:
                 raise InfiniStoreError(
                     INTERNAL_ERROR, "all shards unreachable at startup"
@@ -201,8 +221,8 @@ class ShardedConnection:
             self._pool.shutdown(wait=True)
             self._pool = None
             raise
-        for s in dead:
-            self._mark_dead(s)
+        for s, e in dead:
+            self._mark_dead(s, e)
         # Resolve the fan-out pool size. Explicit io_threads wins; the
         # auto path asks the first healthy shard how many data-plane
         # workers its server runs (stats 'workers', native stats_json)
@@ -246,6 +266,7 @@ class ShardedConnection:
 
     def close(self):
         self.connected = False  # stops the reconnector loop
+        self._recover_wake.set()  # ...and wakes it out of a backoff sleep
         # Join the reconnector BEFORE closing connections: a redial
         # in flight while close() destroys the native handles would be
         # a use-after-free (lib.py's handle-lifetime contract), and one
@@ -291,12 +312,17 @@ class ShardedConnection:
 
     # -- failure handling ----------------------------------------------
 
-    def _mark_dead(self, shard):
+    def _mark_dead(self, shard, exc=None):
         with self._health_lock:
+            if exc is not None:
+                # Recorded even for an already-degraded shard: the
+                # newest failure string is the one worth reading.
+                self.shard_health[shard]["last_error"] = repr(exc)[:200]
             if self.degraded[shard]:
                 return
             self.degraded[shard] = True
             self.health["shard_failures"] += 1
+            self.shard_health[shard]["failures"] += 1
             if self._reconnector is None or not self._reconnector.is_alive():
                 self._reconnector = threading.Thread(
                     target=self._reconnect_loop, daemon=True,
@@ -305,25 +331,43 @@ class ShardedConnection:
                 self._reconnector.start()
 
     def _reconnect_loop(self):
-        """Background redial of down shards every ~0.5 s until all are
-        back (or the client closes). On success the shard rejoins with
-        its surviving keys; keys written while it was down are simply
-        absent (the documented cache contract)."""
+        """Background redial of down shards every ~recover_interval_s
+        until all are back (or the client closes); a pass that recovers
+        nothing doubles the wait, bounded at 8x base, and any rejoin
+        resets it. On success the shard rejoins with its surviving
+        keys; keys written while it was down are simply absent (the
+        documented cache contract)."""
+        delay = self.recover_interval_s
         while self.connected:
             dead = [i for i in range(self.n) if self.degraded[i]]
             if not dead:
                 return
+            recovered = False
             for i in dead:
                 if not self.connected:
                     return
                 try:
                     self.conns[i].reconnect()
-                except Exception:
+                except Exception as e:
+                    with self._health_lock:
+                        self.shard_health[i]["last_error"] = repr(e)[:200]
                     continue
+                recovered = True
                 with self._health_lock:
                     self.degraded[i] = False
                     self.health["reconnects"] += 1
-            time.sleep(0.5)
+                    self.shard_health[i]["reconnects"] += 1
+            # Sleep the CURRENT cadence, then adjust for the next pass:
+            # the first retry after a failed pass waits 1x base (the
+            # documented cadence), consecutive failures 2x, 4x, 8x.
+            # Event.wait, not time.sleep: close() sets the event so
+            # shutdown never blocks behind a backoff window.
+            if recovered:
+                delay = self.recover_interval_s
+            if self._recover_wake.wait(delay):
+                return
+            if not recovered:
+                delay = min(delay * 2, self.recover_interval_s * 8)
 
     # -- fan-out plumbing ----------------------------------------------
 
@@ -363,7 +407,7 @@ class ShardedConnection:
         for j, s, ok, v in results:
             if not ok:
                 if self.degrade and _is_conn_failure(v):
-                    self._mark_dead(s)
+                    self._mark_dead(s, v)
                 elif first_err is None:
                     first_err = v
             out[j] = (ok, v)
@@ -530,7 +574,7 @@ class ShardedConnection:
         for (s, pairs), r in zip(live.items(), results):
             if isinstance(r, BaseException):
                 if self.degrade and _is_conn_failure(r):
-                    self._mark_dead(s)
+                    self._mark_dead(s, r)
                     dropped += len(pairs)
                 else:
                     raise r
@@ -613,7 +657,7 @@ class ShardedConnection:
         for (s, pairs), r in zip(live, results):
             if isinstance(r, BaseException):
                 if self.degrade and _is_conn_failure(r):
-                    self._mark_dead(s)
+                    self._mark_dead(s, r)
                     missed.extend(k for k, _ in pairs)
                 else:
                     raise r
@@ -674,7 +718,7 @@ class ShardedConnection:
         for (s, _c), r in zip(live, results):
             if isinstance(r, BaseException):
                 if self.degrade and _is_conn_failure(r):
-                    self._mark_dead(s)
+                    self._mark_dead(s, r)
                 else:
                     raise r
         return 0
@@ -800,6 +844,14 @@ class ShardedConnection:
             summary["degraded_shards"] = [
                 i for i in range(self.n) if self.degraded[i]
             ]
+            # Per-shard forensics: which shard is flapping, and its
+            # most recent failure (repr-clipped), plus the prober
+            # cadence in force.
+            summary["per_shard"] = [
+                dict(h, shard=i, degraded=self.degraded[i])
+                for i, h in enumerate(self.shard_health)
+            ]
+            summary["recover_interval_s"] = self.recover_interval_s
         return per + [{"sharded_health": summary}]
 
 

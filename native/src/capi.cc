@@ -21,6 +21,7 @@
 
 #include "client.h"
 #include "common.h"
+#include "failpoint.h"
 #include "log.h"
 #include "server.h"
 
@@ -106,10 +107,13 @@ extern "C" {
 // ist_server_stats now returns the REQUIRED size instead of the
 // truncated count when the caller's buffer is too small; v7: async
 // read pipeline — trailing `promote` int on ist_server_create and the
-// ist_prefetch entry point).
+// ist_prefetch entry point; v8: failpoint fault injection —
+// ist_server_fault / ist_server_fault_list entry points, stats gains
+// disk_io_errors / tier_breaker_open / workers_dead /
+// failpoints_fired).
 // _native.py probes this at load so a stale prebuilt library fails
 // loudly instead of feeding unparseable blobs to the server.
-uint32_t ist_abi_version(void) { return 7; }
+uint32_t ist_abi_version(void) { return 8; }
 
 void ist_set_log_level(int level) { set_log_level(level); }
 void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }
@@ -215,6 +219,32 @@ long long ist_server_restore(void* h, const char* path) {
     } catch (...) {
         return -1;
     }
+}
+
+// Fault injection (failpoint.h): arm/disarm named failpoints from a
+// spec string ("name=policy[:action];...", "off" clears everything —
+// grammar in failpoint.h). The registry is process-global; the server
+// handle anchors the call to a live store for API symmetry (and the
+// control plane's POST /fault). Returns the number of points touched,
+// or -1 on a parse error with the reason copied into err (snprintf
+// contract: at most errcap-1 bytes + NUL).
+int ist_server_fault(void* h, const char* spec, char* err, int errcap) {
+    if (h == nullptr || spec == nullptr) return -1;
+    std::string why;
+    int n = failpoints_arm_spec(spec, &why);
+    if (n < 0 && err != nullptr && errcap > 0) {
+        int c = int(why.size()) >= errcap ? errcap - 1 : int(why.size());
+        memcpy(err, why.data(), size_t(c));
+        err[c] = 0;
+    }
+    return n;
+}
+
+// JSON list of every registered failpoint (name, current spec, fire
+// count, fired_total). Same snprintf contract as ist_server_stats.
+long long ist_server_fault_list(void* h, char* buf, long long cap) {
+    if (h == nullptr) return -1;
+    return copy_blob(failpoints_json(), buf, cap);
 }
 
 int ist_server_shm_prefix(void* h, char* buf, int cap) {

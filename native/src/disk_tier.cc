@@ -9,9 +9,74 @@
 #include <sys/vfs.h>
 #include <unistd.h>
 
+#include "failpoint.h"
 #include "log.h"
+#include "utils.h"
 
 namespace istpu {
+
+// --- write-path circuit breaker -----------------------------------------
+//
+// Repeated consecutive write failures (EIO/ENOSPC at pwrite time — a
+// dying device, not a merely-full tier, which is refused at the
+// reservation step and never reaches here) open the breaker: stores
+// are refused up front, so the reclaimer degrades to pure-pool mode
+// (hard evict / stay resident) instead of queueing doomed IO behind a
+// broken device. One probe store per backoff window re-tests the
+// device; success closes the breaker and resets the backoff.
+
+bool DiskTier::store_likely_admitted() const {
+    if (!breaker_open_.load(std::memory_order_relaxed)) return true;
+    return now_us() >= breaker_retry_at_us_.load(std::memory_order_relaxed);
+}
+
+bool DiskTier::store_admitted() {
+    if (!breaker_open_.load(std::memory_order_relaxed)) return true;
+    long long now = now_us();
+    long long at = breaker_retry_at_us_.load(std::memory_order_relaxed);
+    if (now < at) return false;
+    // CAS the deadline forward: exactly one caller per window wins the
+    // probe; the rest stay refused until the probe's outcome lands.
+    return breaker_retry_at_us_.compare_exchange_strong(
+        at, now + breaker_backoff_us_.load(std::memory_order_relaxed),
+        std::memory_order_relaxed);
+}
+
+void DiskTier::note_write_error() {
+    io_errors_.fetch_add(1, std::memory_order_relaxed);
+    uint32_t consec =
+        consec_write_errors_.fetch_add(1, std::memory_order_relaxed) + 1;
+    if (consec < kBreakerThreshold) return;
+    long long backoff =
+        breaker_backoff_us_.load(std::memory_order_relaxed);
+    if (breaker_open_.exchange(true, std::memory_order_relaxed)) {
+        // Already open: this was a failed probe — double the backoff.
+        backoff = backoff * 2 > kBreakerMaxUs ? kBreakerMaxUs : backoff * 2;
+        breaker_backoff_us_.store(backoff, std::memory_order_relaxed);
+    } else {
+        IST_WARN("disk tier breaker OPEN after %u consecutive write "
+                 "errors: store degrades to pure-pool mode, re-probe in "
+                 "%lld ms",
+                 consec, backoff / 1000);
+    }
+    breaker_retry_at_us_.store(now_us() + backoff,
+                               std::memory_order_relaxed);
+}
+
+void DiskTier::breaker_probe_aborted() {
+    if (!breaker_open_.load(std::memory_order_relaxed)) return;
+    breaker_retry_at_us_.store(now_us(), std::memory_order_relaxed);
+}
+
+void DiskTier::note_write_ok() {
+    consec_write_errors_.store(0, std::memory_order_relaxed);
+    if (breaker_open_.exchange(false, std::memory_order_relaxed)) {
+        breaker_backoff_us_.store(kBreakerBaseUs,
+                                  std::memory_order_relaxed);
+        IST_WARN("disk tier breaker CLOSED (probe write succeeded); "
+                 "spills resume");
+    }
+}
 
 DiskTier::DiskTier(const std::string& path, uint64_t capacity,
                    uint64_t block_size)
@@ -89,6 +154,13 @@ int64_t DiskTier::find_first_fit(uint64_t count) const {
 
 int64_t DiskTier::store(const void* src, uint32_t size) {
     if (fd_ < 0 || size == 0) return -1;
+    if (!store_admitted()) return -1;  // breaker open: pure-pool mode
+    // Injected reservation refusal: the tier behaves exactly full
+    // (ENOSPC at reserve time) — no IO error, no breaker.
+    if (IST_FAILPOINT("disk.reserve")) {
+        breaker_probe_aborted();
+        return -1;
+    }
     uint64_t count = (uint64_t(size) + block_size_ - 1) / block_size_;
     int64_t start;
     {
@@ -98,10 +170,14 @@ int64_t DiskTier::store(const void* src, uint32_t size) {
         std::lock_guard<std::mutex> lk(mu_);
         if (used_blocks_.load(std::memory_order_relaxed) + count >
             total_blocks_) {
+            breaker_probe_aborted();
             return -1;
         }
         start = find_first_fit(count);
-        if (start < 0) return -1;
+        if (start < 0) {
+            breaker_probe_aborted();
+            return -1;
+        }
         set_range(uint64_t(start), count, true);
         used_blocks_.fetch_add(count, std::memory_order_relaxed);
         search_hint_ = (uint64_t(start) + count) % total_blocks_;
@@ -110,11 +186,23 @@ int64_t DiskTier::store(const void* src, uint32_t size) {
     const uint8_t* p = static_cast<const uint8_t*>(src);
     uint64_t left = size;
     int64_t woff = off;
+    // Injected write failure: FAIL_SHORT lands half the payload first
+    // (the torn-write shape — the rollback below must make the half-
+    // written extent unreachable), FAIL_ERR fails outright.
+    FailHit inject = IST_FAILPOINT("disk.pwrite");
+    if (inject && inject.action == FAIL_SHORT && left > 1) {
+        ssize_t w = pwrite(fd_, p, size_t(left / 2), off_t(woff));
+        (void)w;
+    }
     while (left > 0) {
-        ssize_t w = pwrite(fd_, p, size_t(left), off_t(woff));
+        ssize_t w = inject ? -1 : pwrite(fd_, p, size_t(left), off_t(woff));
+        if (inject) errno = inject.err;
         if (w <= 0) {
-            if (w < 0 && errno == EINTR) continue;
+            // An injected errno is terminal even when it spells EINTR —
+            // the inject flag never clears, so retrying would spin.
+            if (!inject && w < 0 && errno == EINTR) continue;
             IST_ERROR("disk tier pwrite failed: %s", strerror(errno));
+            note_write_error();
             std::lock_guard<std::mutex> lk(mu_);
             set_range(uint64_t(start), count, false);
             used_blocks_.fetch_sub(count, std::memory_order_relaxed);
@@ -124,6 +212,7 @@ int64_t DiskTier::store(const void* src, uint32_t size) {
         woff += w;
         left -= uint64_t(w);
     }
+    note_write_ok();
     return off;
 }
 
@@ -160,16 +249,26 @@ int64_t DiskTier::store_gather(const void* const* srcs,
         offs[0] = store(srcs[0], sizes[0]);
         return offs[0];
     }
-    if (n > 256) return -1;  // iovec bound (spill batches are <= 64)
+    if (!store_admitted()) return -1;  // breaker open: pure-pool mode
+    // Every pre-pwritev bail below hands a consumed probe slot back
+    // (breaker_probe_aborted): nothing was learned about the device.
+    if (IST_FAILPOINT("disk.reserve") || n > 256) {
+        // n > 256: iovec bound (spill batches are <= 64)
+        breaker_probe_aborted();
+        return -1;
+    }
     uint64_t total = 0;
     uint64_t blocks = 0;
     for (uint32_t i = 0; i < n; ++i) {
-        if (sizes[i] == 0) return -1;
         // Alignment invariant (see header): a non-tail payload that is
         // not block-aligned would shift every later carve off a block
         // boundary — the gap after it belongs to ITS extent's padding,
         // which a back-to-back pwritev cannot skip.
-        if (i + 1 < n && sizes[i] % block_size_ != 0) return -1;
+        if (sizes[i] == 0 ||
+            (i + 1 < n && sizes[i] % block_size_ != 0)) {
+            breaker_probe_aborted();
+            return -1;
+        }
         total += sizes[i];
         blocks += (uint64_t(sizes[i]) + block_size_ - 1) / block_size_;
     }
@@ -178,10 +277,14 @@ int64_t DiskTier::store_gather(const void* const* srcs,
         std::lock_guard<std::mutex> lk(mu_);
         if (used_blocks_.load(std::memory_order_relaxed) + blocks >
             total_blocks_) {
+            breaker_probe_aborted();
             return -1;
         }
         start = find_first_fit(blocks);
-        if (start < 0) return -1;
+        if (start < 0) {
+            breaker_probe_aborted();
+            return -1;
+        }
         set_range(uint64_t(start), blocks, true);
         used_blocks_.fetch_add(blocks, std::memory_order_relaxed);
         search_hint_ = (uint64_t(start) + blocks) % total_blocks_;
@@ -197,12 +300,22 @@ int64_t DiskTier::store_gather(const void* const* srcs,
     }
     uint64_t written = 0;
     size_t vi = 0;
+    // Injected vectored-write failure; FAIL_SHORT lets the first iovec
+    // land (a realistically torn gather) before the rollback.
+    FailHit inject = IST_FAILPOINT("disk.pwritev");
+    if (inject && inject.action == FAIL_SHORT) {
+        ssize_t w = pwritev(fd_, iov.data(), 1, off_t(base));
+        (void)w;
+    }
     while (written < total) {
-        ssize_t w = pwritev(fd_, iov.data() + vi, int(n - vi),
-                            off_t(base + int64_t(written)));
+        ssize_t w = inject ? -1
+                           : pwritev(fd_, iov.data() + vi, int(n - vi),
+                                     off_t(base + int64_t(written)));
+        if (inject) errno = inject.err;
         if (w <= 0) {
-            if (w < 0 && errno == EINTR) continue;
+            if (!inject && w < 0 && errno == EINTR) continue;
             IST_ERROR("disk tier pwritev failed: %s", strerror(errno));
+            note_write_error();
             std::lock_guard<std::mutex> lk(mu_);
             set_range(uint64_t(start), blocks, false);
             used_blocks_.fetch_sub(blocks, std::memory_order_relaxed);
@@ -227,6 +340,7 @@ int64_t DiskTier::store_gather(const void* const* srcs,
         offs[i] = base + int64_t(run);
         run += sizes[i];
     }
+    note_write_ok();
     return base;
 }
 
@@ -235,11 +349,23 @@ bool DiskTier::load(int64_t off, void* dst, uint32_t size) {
     uint8_t* p = static_cast<uint8_t*>(dst);
     uint64_t left = size;
     int64_t roff = off;
+    // Injected read failure. FAIL_SHORT fills half the buffer first —
+    // the torn-read shape: the `false` return is the ONLY thing
+    // standing between those bytes and the wire, so every caller must
+    // treat it as an error, never serve the buffer (test_chaos pins
+    // this with payload checksums).
+    FailHit inject = IST_FAILPOINT("disk.pread");
+    if (inject && inject.action == FAIL_SHORT && left > 1) {
+        ssize_t r = pread(fd_, p, size_t(left / 2), off_t(roff));
+        (void)r;
+    }
     while (left > 0) {
-        ssize_t r = pread(fd_, p, size_t(left), off_t(roff));
+        ssize_t r = inject ? -1 : pread(fd_, p, size_t(left), off_t(roff));
+        if (inject) errno = inject.err;
         if (r <= 0) {
-            if (r < 0 && errno == EINTR) continue;
+            if (!inject && r < 0 && errno == EINTR) continue;
             IST_ERROR("disk tier pread failed: %s", strerror(errno));
+            io_errors_.fetch_add(1, std::memory_order_relaxed);
             return false;
         }
         p += r;

@@ -17,6 +17,19 @@
 // by an internal mutex; the IO itself runs outside it (store reserves the
 // extent first and rolls the reservation back on a failed pwrite;
 // pread/pwrite are fd-position-free and safe concurrently).
+//
+// Failure model (ISSUE 6): every IO error — real or injected through
+// the disk.{pread,pwrite,pwritev,reserve} failpoints (failpoint.h) —
+// is counted (io_errors) and write failures roll the extent
+// reservation back, so a failed spill can never leak tier space.
+// Repeated CONSECUTIVE write failures trip a CIRCUIT BREAKER
+// (tier_breaker_open): stores are refused outright (the store degrades
+// to pure-pool mode — spill victims hard-evict or stay resident)
+// until a backoff timer admits ONE probe store per window; a probe
+// that succeeds closes the breaker, a failure doubles the backoff.
+// Reads are never gated — data already on the tier stays servable on
+// a best-effort basis (a failed read surfaces as an error to the
+// caller, never as torn bytes).
 #pragma once
 
 #include <atomic>
@@ -81,7 +94,44 @@ class DiskTier {
         return used_blocks_.load(std::memory_order_relaxed) * block_size_;
     }
 
+    // Failure-model observability (stats "disk_io_errors" /
+    // "tier_breaker_open"): every failed pread/pwrite/pwritev — real
+    // or injected — counts; the breaker reflects the write path only.
+    uint64_t io_errors() const {
+        return io_errors_.load(std::memory_order_relaxed);
+    }
+    bool breaker_open() const {
+        return breaker_open_.load(std::memory_order_relaxed);
+    }
+    // Non-consuming peek for spill ADMISSION: true when a store issued
+    // now would not be refused outright by the breaker (closed, or the
+    // backoff window has a probe slot due). Keeps the reclaimer from
+    // re-queueing doomed victims in a tight loop while the breaker is
+    // open, without starving the re-probe path of store attempts.
+    bool store_likely_admitted() const;
+
+    // Breaker tuning (write-error threshold and probe backoff bounds).
+    static constexpr uint32_t kBreakerThreshold = 3;
+    static constexpr long long kBreakerBaseUs = 100000;   // 100 ms
+    static constexpr long long kBreakerMaxUs = 5000000;   // 5 s
+
    private:
+    // Write-path breaker bookkeeping. store_admitted() is the gate
+    // every store takes first: true normally; with the breaker open,
+    // false until the backoff deadline, then true for exactly ONE
+    // caller per window (the re-probe).
+    bool store_admitted();
+    void note_write_error();
+    void note_write_ok();
+    // A probe-admitted store that bailed BEFORE any pwrite (reservation
+    // refused: tier full, bad batch shape, or the disk.reserve
+    // failpoint) learned nothing about the device. Hand the probe slot
+    // back by rewinding the retry deadline — otherwise a full tier
+    // burns every window's probe at the reservation step and the
+    // breaker can never close (or double its backoff) while the
+    // capacity condition lasts.
+    void breaker_probe_aborted();
+
     bool bit(uint64_t idx) const {
         return (bitmap_[idx >> 6] >> (idx & 63)) & 1;
     }
@@ -96,6 +146,12 @@ class DiskTier {
     uint64_t search_hint_ = 0;       // guarded by mu_
     std::mutex mu_;                  // guards bitmap_ + search_hint_
     std::vector<uint64_t> bitmap_;
+
+    std::atomic<uint64_t> io_errors_{0};
+    std::atomic<uint32_t> consec_write_errors_{0};
+    std::atomic<bool> breaker_open_{false};
+    std::atomic<long long> breaker_retry_at_us_{0};
+    std::atomic<long long> breaker_backoff_us_{kBreakerBaseUs};
 };
 
 }  // namespace istpu

@@ -1,0 +1,421 @@
+#include "failpoint.h"
+
+#include <errno.h>
+#include <time.h>
+
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <vector>
+
+#include "log.h"
+
+namespace istpu {
+
+namespace {
+
+// Global fire counter (the stats gauge) — separate from the per-point
+// counters so stats_json never walks the registry on the data plane.
+std::atomic<uint64_t> g_fired{0};
+
+// Registry: name -> Failpoint*, never removed (call sites hold raw
+// pointers in function-local statics). The mutex guards only
+// find/insert and the list snapshot — never the hot path.
+std::mutex& registry_mu() {
+    static std::mutex mu;
+    return mu;
+}
+std::map<std::string, Failpoint*>& registry() {
+    static std::map<std::string, Failpoint*> reg;
+    return reg;
+}
+
+uint64_t name_seed(const std::string& name) {
+    // FNV-1a: a fixed per-name PRNG seed makes prob() runs reproducible.
+    uint64_t h = 1469598103934665603ull;
+    for (char c : name) {
+        h ^= uint8_t(c);
+        h *= 1099511628211ull;
+    }
+    return h ? h : 1;
+}
+
+void sleep_us(uint64_t us) {
+    timespec ts;
+    ts.tv_sec = time_t(us / 1000000);
+    ts.tv_nsec = long(us % 1000000) * 1000;
+    nanosleep(&ts, nullptr);
+}
+
+}  // namespace
+
+void Failpoint::arm(uint8_t policy, uint64_t n, double prob, uint8_t action,
+                    int err, uint64_t arg_us) {
+    // Order: payload first, armed_ last (release) — a racing check()
+    // that observes armed_ also observes a coherent config. (Tests arm
+    // between workload phases; a torn read mid-arm would at worst fire
+    // the previous config once, which chaos semantics tolerate.)
+    policy_.store(policy, std::memory_order_relaxed);
+    action_.store(action, std::memory_order_relaxed);
+    err_.store(err, std::memory_order_relaxed);
+    n_.store(n, std::memory_order_relaxed);
+    arg_us_.store(arg_us, std::memory_order_relaxed);
+    counter_.store(0, std::memory_order_relaxed);
+    prng_.store(name_seed(name_), std::memory_order_relaxed);
+    double p = prob < 0.0 ? 0.0 : (prob > 1.0 ? 1.0 : prob);
+    prob_scaled_.store(uint32_t(p * 4294967295.0),
+                       std::memory_order_relaxed);
+    armed_.store(policy == P_OFF ? 0 : 1, std::memory_order_release);
+}
+
+void Failpoint::disarm() {
+    armed_.store(0, std::memory_order_relaxed);
+    policy_.store(P_OFF, std::memory_order_relaxed);
+}
+
+FailHit Failpoint::fire() {
+    bool hit = false;
+    switch (policy_.load(std::memory_order_relaxed)) {
+        case P_ONCE:
+            hit = counter_.fetch_add(1, std::memory_order_relaxed) == 0;
+            if (hit) armed_.store(0, std::memory_order_relaxed);
+            break;
+        case P_EVERY: {
+            uint64_t n = n_.load(std::memory_order_relaxed);
+            if (n == 0) n = 1;
+            hit = (counter_.fetch_add(1, std::memory_order_relaxed) + 1) %
+                      n ==
+                  0;
+            break;
+        }
+        case P_COUNT: {
+            uint64_t k = n_.load(std::memory_order_relaxed);
+            hit = counter_.fetch_add(1, std::memory_order_relaxed) < k;
+            if (!hit) armed_.store(0, std::memory_order_relaxed);
+            break;
+        }
+        case P_PROB: {
+            // xorshift64*: racy fetch/store is fine — interleaved
+            // updates just fork the stream, still pseudo-random.
+            uint64_t x = prng_.load(std::memory_order_relaxed);
+            x ^= x >> 12;
+            x ^= x << 25;
+            x ^= x >> 27;
+            prng_.store(x, std::memory_order_relaxed);
+            uint32_t draw = uint32_t((x * 2685821657736338717ull) >> 32);
+            hit = draw <= prob_scaled_.load(std::memory_order_relaxed);
+            break;
+        }
+        default:
+            return FailHit{};
+    }
+    if (!hit) return FailHit{};
+    fired_.fetch_add(1, std::memory_order_relaxed);
+    g_fired.fetch_add(1, std::memory_order_relaxed);
+    FailHit h;
+    h.action = action_.load(std::memory_order_relaxed);
+    h.err = err_.load(std::memory_order_relaxed);
+    h.arg_us = arg_us_.load(std::memory_order_relaxed);
+    if (h.action == FAIL_DELAY) {
+        // Absorbed here so call sites never handle it: the op proceeds
+        // normally after the injected stall.
+        sleep_us(h.arg_us);
+        return FailHit{};
+    }
+    return h;
+}
+
+std::string Failpoint::spec_string() const {
+    if (armed_.load(std::memory_order_relaxed) == 0 &&
+        policy_.load(std::memory_order_relaxed) == P_OFF) {
+        return "off";
+    }
+    char buf[96];
+    std::string s;
+    switch (policy_.load(std::memory_order_relaxed)) {
+        case P_ONCE: s = "once"; break;
+        case P_EVERY:
+            snprintf(buf, sizeof(buf), "every(%llu)",
+                     (unsigned long long)n_.load(std::memory_order_relaxed));
+            s = buf;
+            break;
+        case P_COUNT:
+            snprintf(buf, sizeof(buf), "count(%llu)",
+                     (unsigned long long)n_.load(std::memory_order_relaxed));
+            s = buf;
+            break;
+        case P_PROB:
+            snprintf(buf, sizeof(buf), "prob(%.4f)",
+                     prob_scaled_.load(std::memory_order_relaxed) /
+                         4294967295.0);
+            s = buf;
+            break;
+        default: return "off";
+    }
+    if (armed_.load(std::memory_order_relaxed) == 0) s += "[spent]";
+    switch (action_.load(std::memory_order_relaxed)) {
+        case FAIL_ERR:
+            snprintf(buf, sizeof(buf), ":err(%d)",
+                     err_.load(std::memory_order_relaxed));
+            s += buf;
+            break;
+        case FAIL_SHORT: s += ":short"; break;
+        case FAIL_DELAY:
+            snprintf(buf, sizeof(buf), ":delay(%llu)",
+                     (unsigned long long)arg_us_.load(
+                         std::memory_order_relaxed));
+            s += buf;
+            break;
+        case FAIL_KILL: s += ":kill"; break;
+    }
+    return s;
+}
+
+Failpoint* failpoint_find(const std::string& name) {
+    std::lock_guard<std::mutex> lk(registry_mu());
+    auto& reg = registry();
+    auto it = reg.find(name);
+    if (it != reg.end()) return it->second;
+    Failpoint* fp = new Failpoint(name);  // immortal by design
+    reg.emplace(name, fp);
+    return fp;
+}
+
+namespace {
+
+struct ParsedPoint {
+    std::string name;
+    uint8_t policy = Failpoint::P_OFF;
+    uint64_t n = 0;
+    double prob = 0.0;
+    uint8_t action = FAIL_ERR;
+    int err = EIO;
+    uint64_t arg_us = 0;
+};
+
+// The compiled-in catalog (mirrors failpoint.h). Specs may only name
+// these: a typo must fail the whole spec loudly (the parser's
+// all-or-nothing contract would otherwise be defeated by a point that
+// "arms" but is wired to nothing), and an arbitrary name would become
+// an immortal registry entry — an unbounded leak on an unauthenticated
+// manage port, and a JSON-injection vector through failpoints_json()
+// (names are emitted unescaped because only these can exist).
+const char* const kCatalog[] = {
+    "disk.reserve", "disk.pwrite", "disk.pwritev", "disk.pread",
+    "pool.alloc",   "worker.reclaim", "worker.spill", "worker.promote",
+    "sock.recv",    "sock.send",    "lease.commit",
+};
+
+bool in_catalog(const std::string& name) {
+    for (const char* c : kCatalog) {
+        if (name == c) return true;
+    }
+    return false;
+}
+
+// "tok(arg)" -> tok + arg string (empty when no parens). False on
+// unbalanced parens.
+bool split_call(const std::string& s, std::string* tok, std::string* arg) {
+    size_t lp = s.find('(');
+    if (lp == std::string::npos) {
+        *tok = s;
+        arg->clear();
+        return true;
+    }
+    if (s.back() != ')') return false;
+    *tok = s.substr(0, lp);
+    *arg = s.substr(lp + 1, s.size() - lp - 2);
+    return true;
+}
+
+bool parse_point(const std::string& text, ParsedPoint* out,
+                 std::string* err_out) {
+    size_t eq = text.find('=');
+    if (eq == std::string::npos || eq == 0) {
+        *err_out = "expected name=policy[:action] in '" + text + "'";
+        return false;
+    }
+    out->name = text.substr(0, eq);
+    if (!in_catalog(out->name)) {
+        *err_out = "unknown failpoint '" + out->name + "'";
+        return false;
+    }
+    // worker.* points are only consulted for FAIL_KILL (the loops test
+    // .action == FAIL_KILL and nothing else), and kill means nothing
+    // anywhere else — so default worker.* to kill and reject the
+    // mismatches, lest a drill arm a point that fires into a no-op.
+    const bool is_worker = out->name.compare(0, 7, "worker.") == 0;
+    if (is_worker) out->action = FAIL_KILL;
+    std::string rest = text.substr(eq + 1);
+    std::string policy = rest, action;
+    size_t colon = rest.find(':');
+    // ':' inside parens never occurs in the grammar, so a plain find
+    // splits policy from action.
+    if (colon != std::string::npos) {
+        policy = rest.substr(0, colon);
+        action = rest.substr(colon + 1);
+    }
+    std::string tok, arg;
+    if (!split_call(policy, &tok, &arg)) {
+        *err_out = "bad policy '" + policy + "'";
+        return false;
+    }
+    if (tok == "off") {
+        out->policy = Failpoint::P_OFF;
+    } else if (tok == "once") {
+        out->policy = Failpoint::P_ONCE;
+    } else if (tok == "every") {
+        out->policy = Failpoint::P_EVERY;
+        out->n = strtoull(arg.c_str(), nullptr, 10);
+        if (out->n == 0) {
+            *err_out = "every(N) needs N >= 1 in '" + text + "'";
+            return false;
+        }
+    } else if (tok == "count") {
+        out->policy = Failpoint::P_COUNT;
+        out->n = strtoull(arg.c_str(), nullptr, 10);
+        if (out->n == 0) {
+            *err_out = "count(K) needs K >= 1 in '" + text + "'";
+            return false;
+        }
+    } else if (tok == "prob") {
+        out->policy = Failpoint::P_PROB;
+        out->prob = atof(arg.c_str());
+        if (!(out->prob > 0.0 && out->prob <= 1.0)) {
+            *err_out = "prob(P) needs 0 < P <= 1 in '" + text + "'";
+            return false;
+        }
+    } else {
+        *err_out = "unknown policy '" + tok + "'";
+        return false;
+    }
+    if (!action.empty()) {
+        if (!split_call(action, &tok, &arg)) {
+            *err_out = "bad action '" + action + "'";
+            return false;
+        }
+        if (tok == "err") {
+            out->action = FAIL_ERR;
+            if (!arg.empty()) out->err = atoi(arg.c_str());
+            if (out->err <= 0) out->err = EIO;
+        } else if (tok == "short") {
+            out->action = FAIL_SHORT;
+        } else if (tok == "delay") {
+            out->action = FAIL_DELAY;
+            out->arg_us = strtoull(arg.c_str(), nullptr, 10);
+        } else if (tok == "kill") {
+            out->action = FAIL_KILL;
+        } else {
+            *err_out = "unknown action '" + tok + "'";
+            return false;
+        }
+        if (is_worker && out->action != FAIL_KILL &&
+            out->action != FAIL_DELAY) {
+            *err_out = "worker.* points only take kill (or delay) in '" +
+                       text + "'";
+            return false;
+        }
+        if (!is_worker && out->action == FAIL_KILL) {
+            *err_out = "kill is only valid on worker.* points in '" +
+                       text + "'";
+            return false;
+        }
+    }
+    return true;
+}
+
+}  // namespace
+
+int failpoints_arm_spec(const std::string& spec, std::string* err_out) {
+    std::string err;
+    // A clear-all token ("off"/"clear") is an ORDERED item — an empty
+    // name in the list — so "a=once;off" ends fully disarmed while
+    // "off;a=once" means "from a clean slate, arm a" (parse is still
+    // all-or-nothing: nothing applies until the whole spec is valid).
+    std::vector<ParsedPoint> points;
+    size_t start = 0;
+    while (start <= spec.size()) {
+        size_t end = spec.find_first_of(";,", start);
+        if (end == std::string::npos) end = spec.size();
+        // Trim whitespace.
+        size_t a = start, b = end;
+        while (a < b && isspace((unsigned char)spec[a])) a++;
+        while (b > a && isspace((unsigned char)spec[b - 1])) b--;
+        std::string item = spec.substr(a, b - a);
+        start = end + 1;
+        if (item.empty()) continue;
+        if (item == "off" || item == "clear") {
+            points.emplace_back();  // empty name = clear-all marker
+            continue;
+        }
+        ParsedPoint p;
+        if (!parse_point(item, &p, &err)) {
+            if (err_out) *err_out = err;
+            return -1;  // all-or-nothing: nothing applied yet
+        }
+        points.push_back(std::move(p));
+    }
+    for (const ParsedPoint& p : points) {
+        if (p.name.empty()) {
+            failpoints_disarm_all();
+            continue;
+        }
+        Failpoint* fp = failpoint_find(p.name);
+        if (p.policy == Failpoint::P_OFF) {
+            fp->disarm();
+        } else {
+            fp->arm(p.policy, p.n, p.prob, p.action, p.err, p.arg_us);
+            IST_WARN("failpoint armed: %s=%s", p.name.c_str(),
+                     fp->spec_string().c_str());
+        }
+    }
+    return int(points.size());
+}
+
+void failpoints_arm_from_env() {
+    const char* env = getenv("ISTPU_FAILPOINTS");
+    if (env == nullptr || env[0] == '\0') return;
+    std::string err;
+    if (failpoints_arm_spec(env, &err) < 0) {
+        IST_ERROR("ISTPU_FAILPOINTS parse error: %s", err.c_str());
+    }
+}
+
+void failpoints_disarm_all() {
+    std::lock_guard<std::mutex> lk(registry_mu());
+    for (auto& [name, fp] : registry()) fp->disarm();
+}
+
+uint64_t failpoints_fired_total() {
+    return g_fired.load(std::memory_order_relaxed);
+}
+
+std::string failpoints_json() {
+    // GET /fault is documented as THE catalog: pre-register every
+    // compiled-in name so an operator discovering valid points sees
+    // the full set, not just the sites that happened to execute.
+    for (const char* name : kCatalog) failpoint_find(name);
+    std::vector<std::pair<std::string, Failpoint*>> snap;
+    {
+        std::lock_guard<std::mutex> lk(registry_mu());
+        snap.assign(registry().begin(), registry().end());
+    }
+    std::string out = "{\"failpoints\": [";
+    bool first = true;
+    for (auto& [name, fp] : snap) {
+        char buf[64];
+        snprintf(buf, sizeof(buf), "\"fired\": %llu}",
+                 (unsigned long long)fp->fired());
+        out += first ? "" : ", ";
+        out += "{\"name\": \"" + name + "\", \"spec\": \"" +
+               fp->spec_string() + "\", " + buf;
+        first = false;
+    }
+    char tail[64];
+    snprintf(tail, sizeof(tail), "], \"fired_total\": %llu}",
+             (unsigned long long)failpoints_fired_total());
+    out += tail;
+    return out;
+}
+
+}  // namespace istpu

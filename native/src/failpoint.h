@@ -1,0 +1,154 @@
+// failpoint.h — deterministic fault injection for the native core.
+//
+// PRs 3–5 moved reclaim, spill and promotion onto background workers,
+// and none of those failure paths had ever been exercised: a disk-tier
+// EIO mid-spill just logged, a dead worker silently wedged its queue.
+// The reference (bd-iaas-us/infiniStore) has no fault story at all
+// beyond client auto-reconnect (SURVEY §5); fabric-lib (PAPERS.md)
+// argues link-failure handling must be designed into the transport,
+// not bolted on — this module is that design point for the store:
+// every layer that can fail in production carries a NAMED inject
+// point, compiled in always, and the failure-handling code around it
+// is tested by arming those points (tests/test_chaos.py).
+//
+// Cost contract: a DISARMED failpoint is one static-local pointer load
+// plus one relaxed atomic load and a predicted-not-taken branch —
+// pinned by the bench chaos-off leg (chaos_off_overhead_p50_ratio
+// <= 1.02). Nothing allocates, no locks are taken, no clock is read
+// until a point is actually armed.
+//
+// Spec grammar (ISTPU_FAILPOINTS env var, POST /fault body,
+// ist_server_fault):
+//
+//   spec    := point (';' point)*          (',' also accepted)
+//   point   := name '=' policy [':' action]
+//   policy  := 'off' | 'once' | 'every(N)' | 'prob(P)' | 'count(K)'
+//   action  := 'err' ['(' errno ')'] | 'short' | 'delay(USEC)' | 'kill'
+//
+// Default action is err(EIO). "name=off" disarms one point; the bare
+// words "off" / "clear" disarm everything. prob() draws from a
+// deterministic per-point xorshift stream (seeded from the point name)
+// so chaos tests are reproducible.
+//
+// Catalog of compiled-in points (the site names the failure it
+// simulates; see docs/design.md "Failure model & fault injection"):
+//   disk.reserve   extent reservation refused (tier behaves full)
+//   disk.pwrite    DiskTier::store write fails (EIO / short write)
+//   disk.pwritev   DiskTier::store_gather vectored write fails
+//   disk.pread     DiskTier::load read fails (EIO / short read)
+//   pool.alloc     MM::allocate returns no block (pool exhausted)
+//   worker.reclaim background reclaimer thread dies (kill)
+//   worker.spill   async spill-writer thread dies (kill)
+//   worker.promote async promotion-worker thread dies (kill)
+//   sock.recv      worker-side socket read fails (connection drops)
+//   sock.send      worker-side socket write fails (connection drops)
+//   lease.commit   OP_COMMIT_BATCH replay fails server-side
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+
+namespace istpu {
+
+enum FailActionKind : uint8_t {
+    FAIL_NONE = 0,
+    FAIL_ERR = 1,    // fail the operation, errno-style code in `err`
+    FAIL_SHORT = 2,  // short IO: move half the bytes, then fail
+    FAIL_DELAY = 3,  // handled inside check(): sleep arg_us, proceed
+    FAIL_KILL = 4,   // background worker loop exits (simulated death)
+};
+
+struct FailHit {
+    uint8_t action = FAIL_NONE;
+    int err = 0;         // errno for FAIL_ERR / FAIL_SHORT (default EIO)
+    uint64_t arg_us = 0; // FAIL_DELAY duration
+    explicit operator bool() const { return action != FAIL_NONE; }
+};
+
+class Failpoint {
+   public:
+    explicit Failpoint(std::string name) : name_(std::move(name)) {}
+    Failpoint(const Failpoint&) = delete;
+    Failpoint& operator=(const Failpoint&) = delete;
+
+    const std::string& name() const { return name_; }
+
+    // The hot-path gate. Disarmed: one relaxed load, nothing else.
+    // Armed: policy evaluation (atomic counters / deterministic PRNG).
+    // FAIL_DELAY is absorbed here (the sleep happens, FailHit says
+    // nothing fired) so call sites only handle ERR/SHORT/KILL.
+    FailHit check() {
+        if (armed_.load(std::memory_order_relaxed) == 0) return FailHit{};
+        return fire();
+    }
+
+    // Policy/action setters used by the spec parser (failpoint.cc).
+    void arm(uint8_t policy, uint64_t n, double prob, uint8_t action,
+             int err, uint64_t arg_us);
+    void disarm();
+    uint64_t fired() const {
+        return fired_.load(std::memory_order_relaxed);
+    }
+    std::string spec_string() const;  // current arming, for /fault GET
+
+    enum Policy : uint8_t {
+        P_OFF = 0,
+        P_ONCE = 1,
+        P_EVERY = 2,
+        P_PROB = 3,
+        P_COUNT = 4,
+    };
+
+   private:
+    FailHit fire();
+
+    std::string name_;
+    std::atomic<uint32_t> armed_{0};
+    std::atomic<uint8_t> policy_{P_OFF};
+    std::atomic<uint8_t> action_{FAIL_NONE};
+    std::atomic<int> err_{0};
+    std::atomic<uint64_t> n_{0};        // every-N period / count-K budget
+    std::atomic<uint64_t> arg_us_{0};
+    std::atomic<uint64_t> counter_{0};  // evaluations since arming
+    std::atomic<uint64_t> fired_{0};
+    std::atomic<uint64_t> prng_{0};     // per-point xorshift state
+    std::atomic<uint32_t> prob_scaled_{0};  // p * 2^32
+};
+
+// Registry lookup; creates the point on first use. Failpoints are
+// process-global (never destroyed): call sites cache the pointer in a
+// function-local static, so the registry cost is paid once per site.
+Failpoint* failpoint_find(const std::string& name);
+
+// Parse + apply a spec string (grammar above). Names must come from
+// the compiled-in catalog — an unknown name is a parse error, not a
+// silent no-op point. Returns the number of points touched, or -1 on
+// a parse error (*err_out gets the reason and NOTHING from the spec
+// is applied — arming is all-or-nothing so a typo cannot
+// half-configure a chaos run).
+int failpoints_arm_spec(const std::string& spec, std::string* err_out);
+
+// Arm from ISTPU_FAILPOINTS if set (server start; idempotent —
+// re-applying the same spec resets its counters, which is what a
+// fresh server in the same process wants).
+void failpoints_arm_from_env();
+
+void failpoints_disarm_all();
+
+// Total fires across every point since process start (stats gauge).
+uint64_t failpoints_fired_total();
+
+// JSON list of every registered point: name, armed spec, fire count.
+std::string failpoints_json();
+
+// The call-site macro: resolves the registry once per site, then the
+// disarmed cost is pointer-deref + relaxed load + predicted branch.
+#define IST_FAILPOINT(namelit)                                      \
+    ([]() -> ::istpu::FailHit {                                     \
+        static ::istpu::Failpoint* _ist_fp =                        \
+            ::istpu::failpoint_find(namelit);                       \
+        return _ist_fp->check();                                    \
+    }())
+
+}  // namespace istpu

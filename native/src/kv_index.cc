@@ -6,6 +6,7 @@
 #include <cstring>
 #include <unordered_set>
 
+#include "failpoint.h"
 #include "log.h"
 #include "utils.h"
 
@@ -268,9 +269,20 @@ Status KVIndex::acquire_resident(const std::string& key, BlockRef* out,
         // the client's documented retry status — by the backoff retry
         // the worker has adopted the pool copy, and the tier IO never
         // ran on this worker thread.
-        if (e.promoting) return BUSY;
-        if (maybe_enqueue_promote(e, it->first, si)) return BUSY;
-        if (promoter_ != nullptr && promoter_->running()) {
+        const bool worker_live =
+            promoter_ != nullptr && promoter_->running() &&
+            promoter_->alive();
+        if (e.promoting) {
+            if (worker_live) return BUSY;
+            // The worker died with this key queued (or mid-batch): a
+            // BUSY here would wedge the client's retry loop forever.
+            // Clear the stale flag and promote inline below — the
+            // degraded mode the workers_dead gauge announces.
+            e.promoting = false;
+        } else if (maybe_enqueue_promote(e, it->first, si)) {
+            return BUSY;
+        }
+        if (!e.promoting && worker_live) {
             // Admission refused: the enqueue attempt above already set
             // promotion pressure (the reclaimer frees toward LOW), so
             // BUSY here too — the retry lands with headroom and the
@@ -309,7 +321,8 @@ void KVIndex::prefetch(const std::vector<std::string>& keys, uint8_t* out) {
             // them now would be self-defeating.
             lru_touch(st, e, it->first);
             out[i] = 1;
-        } else if (e.promoting) {
+        } else if (e.promoting && promoter_ != nullptr &&
+                   promoter_->alive()) {
             out[i] = 2;  // already on its way
         } else if (e.disk != nullptr &&
                    maybe_enqueue_promote(e, it->first, si)) {
@@ -323,7 +336,12 @@ void KVIndex::prefetch(const std::vector<std::string>& keys, uint8_t* out) {
 
 bool KVIndex::maybe_enqueue_promote(Entry& e, const std::string& key,
                                     uint32_t si) {
-    if (promoter_ == nullptr || !promoter_->running()) return false;
+    // alive(): a dead worker's queue must not keep accepting items —
+    // every DiskRef queued there would pin its extent forever.
+    if (promoter_ == nullptr || !promoter_->running() ||
+        !promoter_->alive()) {
+        return false;
+    }
     if (!e.disk || e.promoting) return false;
     if (!promoter_->may_admit(e.size)) {
         // PROMOTION PRESSURE: the pool rests anywhere in [low, high)
@@ -774,8 +792,12 @@ size_t KVIndex::evict_from_stripe(uint32_t si, bool held, size_t want,
         if (!slk.owns_lock()) return 0;  // busy: skipped this pass
     }
     const size_t bs = mm_->block_size();
+    // spill_alive_ (not joinable()): a writer thread that DIED is
+    // still joinable, and queueing to it would pin victims' blocks
+    // behind a queue nothing drains.
     const bool use_async =
-        async_spill && disk_ != nullptr && spill_thread_.joinable();
+        async_spill && disk_ != nullptr &&
+        spill_alive_.load(std::memory_order_relaxed);
     size_t freed = 0;
     size_t local_victims = 0;
     auto it = st.lru.rbegin();
@@ -878,7 +900,7 @@ size_t KVIndex::evict_from_stripe(uint32_t si, bool held, size_t want,
 }
 
 size_t KVIndex::evict_internal(size_t want, int held_stripe,
-                               bool async_spill) {
+                               bool async_spill, uint64_t age_cap) {
     size_t victims = 0;
     size_t freed = 0;
     uint32_t disk_min_fail = UINT32_MAX;
@@ -899,7 +921,7 @@ size_t KVIndex::evict_internal(size_t want, int held_stripe,
                     best = int(si);
                 }
             }
-            if (best < 0) break;
+            if (best < 0 || best_age > age_cap) break;
             uint32_t prev_fail = disk_min_fail;
             size_t got = evict_from_stripe(
                 uint32_t(best), best == held_stripe, want - freed, best_age,
@@ -947,10 +969,11 @@ size_t KVIndex::evict_internal(size_t want, int held_stripe,
                 second = age;
             }
         }
-        if (best < 0) break;
+        if (best < 0 || best_age > age_cap) break;
         uint32_t prev_fail = disk_min_fail;
         size_t got = evict_from_stripe(
-            uint32_t(best), best == held_stripe, want - freed, second,
+            uint32_t(best), best == held_stripe, want - freed,
+            second < age_cap ? second : age_cap,
             SIZE_MAX, &disk_min_fail, async_spill, &victims);
         freed += got;
         if (got == 0 && disk_min_fail == prev_fail) exhausted[best] = true;
@@ -967,7 +990,7 @@ size_t KVIndex::evict_internal(size_t want, int held_stripe,
         // never needs this — its selection is eligibility-aware).
         for (uint32_t si = 0; si < kStripes && freed < want; ++si) {
             freed += evict_from_stripe(si, int(si) == held_stripe,
-                                       want - freed, UINT64_MAX, SIZE_MAX,
+                                       want - freed, age_cap, SIZE_MAX,
                                        &disk_min_fail, async_spill,
                                        &victims);
         }
@@ -986,6 +1009,12 @@ void KVIndex::start_background(double high, double low, bool promote) {
     if (low_ < 0.0) low_ = 0.0;
     bg_stop_.store(false, std::memory_order_relaxed);
     bg_running_.store(true, std::memory_order_relaxed);
+    reclaim_alive_.store(true, std::memory_order_relaxed);
+    reclaim_died_.store(false, std::memory_order_relaxed);
+    spill_alive_.store(disk_ != nullptr, std::memory_order_relaxed);
+    spill_died_.store(false, std::memory_order_relaxed);
+    reclaim_heartbeat_us_.store(now_us(), std::memory_order_relaxed);
+    spill_heartbeat_us_.store(now_us(), std::memory_order_relaxed);
     // Background tracks, created BEFORE the threads spawn (thread
     // creation orders the ring pointers for the loops' bind calls).
     if (tracer_ != nullptr && tracer_->enabled()) {
@@ -1031,12 +1060,19 @@ void KVIndex::stop_background() {
         std::lock_guard<std::mutex> lk(spill_mu_);
         dropped.swap(spill_q_);
     }
+    account_dropped_spills(dropped, /*cancelled=*/false);
+}
+
+void KVIndex::account_dropped_spills(std::deque<SpillItem>& items,
+                                     bool cancelled) {
     const size_t bs = mm_->block_size();
-    for (SpillItem& item : dropped) {
+    for (SpillItem& item : items) {
         spill_queue_depth_.fetch_sub(1, std::memory_order_relaxed);
         spill_inflight_bytes_.fetch_sub(
             (size_t(item.size) + bs - 1) / bs * bs,
             std::memory_order_relaxed);
+        if (cancelled)
+            spills_cancelled_.fetch_add(1, std::memory_order_relaxed);
     }
 }
 
@@ -1073,6 +1109,16 @@ void KVIndex::reclaim_loop() {
         });
         reclaim_kick_.store(false, std::memory_order_relaxed);
         if (bg_stop_.load(std::memory_order_relaxed)) break;
+        reclaim_heartbeat_us_.store(now_us(), std::memory_order_relaxed);
+        // Induced reclaimer death (chaos suite): allocation falls back
+        // to the inline last-resort path (counted hard_stalls), the
+        // workers_dead gauge announces the degradation.
+        if (IST_FAILPOINT("worker.reclaim").action == FAIL_KILL) {
+            reclaim_died_.store(true, std::memory_order_relaxed);
+            IST_ERROR("reclaimer killed by failpoint; eviction degrades "
+                      "to inline hard stalls");
+            break;
+        }
         lk.unlock();
         size_t total = mm_->total_bytes();
         // Secondary trigger: refused promotion admission (see
@@ -1094,6 +1140,13 @@ void KVIndex::reclaim_loop() {
             long long tpass = trace ? now_us() : 0;
             size_t pass_victims = 0;
             size_t floor_bytes = size_t(low_ * double(total));
+            // Victim-age cap for the WHOLE pass: entries touched — or
+            // promotion-adopted — after this snapshot are off-limits,
+            // so a reclaim-to-low pass can never race a fresh
+            // promotion straight back to disk (the promote→spill→
+            // promote thrash behind the prefetch_hit_rate decay).
+            uint64_t pass_cap =
+                lru_clock_.load(std::memory_order_relaxed);
             while (!bg_stop_.load(std::memory_order_relaxed)) {
                 size_t used = mm_->used_bytes();
                 // Bytes already queued to the writer are on their way
@@ -1105,7 +1158,7 @@ void KVIndex::reclaim_loop() {
                 size_t want = used - floor_bytes - inflight;
                 if (want > batch_bytes) want = batch_bytes;
                 long long tscan = trace ? now_us() : 0;
-                size_t victims = evict_internal(want, -1, true);
+                size_t victims = evict_internal(want, -1, true, pass_cap);
                 if (trace) {
                     tracer_->record(
                         SPAN_VICTIM_SCAN, 0, uint64_t(tscan),
@@ -1125,6 +1178,17 @@ void KVIndex::reclaim_loop() {
         }
         lk.lock();
     }
+    reclaim_alive_.store(false, std::memory_order_relaxed);
+}
+
+long long KVIndex::reclaim_heartbeat_age_us() const {
+    if (!reclaim_alive_.load(std::memory_order_relaxed)) return -1;
+    return now_us() - reclaim_heartbeat_us_.load(std::memory_order_relaxed);
+}
+
+long long KVIndex::spill_heartbeat_age_us() const {
+    if (!spill_alive_.load(std::memory_order_relaxed)) return -1;
+    return now_us() - spill_heartbeat_us_.load(std::memory_order_relaxed);
 }
 
 void KVIndex::enqueue_spill(const std::string& key, const BlockRef& block,
@@ -1138,6 +1202,19 @@ void KVIndex::enqueue_spill(const std::string& key, const BlockRef& block,
         spill_q_.push_back(SpillItem{key, block, size, si});
     }
     spill_cv_.notify_one();
+    // Lost race with an induced writer death (the caller's liveness
+    // check passed before the kill drained the queue): nothing will
+    // ever drain what we just queued, and each item's BlockRef would
+    // pin its victim un-evictable forever. Pull it back out here; the
+    // stale SPILLING flags clear at the entries' next touch/evict.
+    if (!spill_alive_.load(std::memory_order_relaxed)) {
+        std::deque<SpillItem> orphans;
+        {
+            std::lock_guard<std::mutex> lk(spill_mu_);
+            orphans.swap(spill_q_);
+        }
+        account_dropped_spills(orphans, /*cancelled=*/true);
+    }
 }
 
 void KVIndex::spill_loop() {
@@ -1150,6 +1227,25 @@ void KVIndex::spill_loop() {
                    !spill_q_.empty();
         });
         if (bg_stop_.load(std::memory_order_relaxed)) break;
+        spill_heartbeat_us_.store(now_us(), std::memory_order_relaxed);
+        // Induced spill-writer death: drain the queue under the lock
+        // (counters rebalance, refs drop below) so queued BlockRefs do
+        // not pin pool blocks forever; victim selection observes
+        // spill_alive_==false and degrades to the inline spill/evict
+        // path. Stale SPILLING flags clear at the next touch/evict.
+        if (IST_FAILPOINT("worker.spill").action == FAIL_KILL) {
+            std::deque<SpillItem> orphans;
+            orphans.swap(spill_q_);
+            account_dropped_spills(orphans, /*cancelled=*/true);
+            spill_died_.store(true, std::memory_order_relaxed);
+            spill_alive_.store(false, std::memory_order_relaxed);
+            IST_ERROR("spill writer killed by failpoint; reclaim "
+                      "degrades to inline spill/evict");
+            lk.unlock();
+            orphans.clear();  // refs drop outside spill_mu_
+            spill_cv_.notify_all();  // unblock a cancel barrier waiter
+            return;
+        }
         std::vector<SpillItem> batch;
         size_t take = spill_q_.size();
         if (take > kSpillBatch) take = kSpillBatch;
@@ -1177,6 +1273,7 @@ void KVIndex::spill_loop() {
         spill_batch_gen_++;  // cancel_queued_spills' bounded barrier
         spill_cv_.notify_all();
     }
+    spill_alive_.store(false, std::memory_order_relaxed);
 }
 
 void KVIndex::process_spill_batch(std::vector<SpillItem>& batch) {
@@ -1282,9 +1379,12 @@ void KVIndex::finish_spill(SpillItem& item, int64_t off) {
     DiskRef span;
     if (off >= 0) {
         span = std::make_shared<DiskSpan>(disk_, off, item.size);
-    } else {
+    } else if (!disk_->breaker_open()) {
         // Remember the refusal so async selection stops queueing sizes
         // the tier cannot hold until its usage drops (see spill_may_fit).
+        // NOT under an open breaker: that failure is the DEVICE, not
+        // capacity — recovery there is the breaker's backoff re-probe,
+        // and a fail-min poisoned by it would outlive the repair.
         uint32_t cur = spill_fail_min_.load(std::memory_order_relaxed);
         if (item.size < cur) {
             spill_fail_min_.store(item.size, std::memory_order_relaxed);
@@ -1314,6 +1414,21 @@ void KVIndex::finish_spill(SpillItem& item, int64_t off) {
                 spills_.fetch_add(1, std::memory_order_relaxed);
                 spill_fail_min_.store(UINT32_MAX,
                                       std::memory_order_relaxed);
+            } else if (!span && eviction_ && e.spilling && e.committed &&
+                       e.block.use_count() == 2) {
+                // WRITE FAILED (EIO/ENOSPC/short, extent reservation
+                // already rolled back by DiskTier) and the victim is
+                // still untouched: hard-evict it NOW instead of leaving
+                // it parked in SPILLING state for the reclaimer to
+                // re-select against a failing tier forever. Only with
+                // eviction enabled — spill-only mode never drops
+                // committed data, so there the entry simply stays
+                // resident (and evictable by a future pass).
+                bump_epoch();  // before the blocks can return to the pool
+                lru_drop(st, e);
+                st.map.erase(mit);
+                evictions_.fetch_add(1, std::memory_order_relaxed);
+                spills_cancelled_.fetch_add(1, std::memory_order_relaxed);
             } else {
                 e.spilling = false;
                 spills_cancelled_.fetch_add(1, std::memory_order_relaxed);
@@ -1333,6 +1448,10 @@ bool KVIndex::spill_may_fit(uint32_t size) {
     // doomed write — a read promotion in that window would find nothing
     // evictable and fail OOM.
     const size_t bs = mm_->block_size();
+    // Breaker-open tier: refuse queueing (the write is doomed) except
+    // when the backoff window owes a probe — that one victim carries
+    // the re-probe store that can close the breaker.
+    if (!disk_->store_likely_admitted()) return false;
     uint64_t rounded = (uint64_t(size) + bs - 1) / bs * bs;
     uint64_t used = disk_->used_bytes();
     uint64_t cap = disk_->capacity_bytes();
@@ -1355,14 +1474,7 @@ void KVIndex::cancel_queued_spills() {
     {
         std::unique_lock<std::mutex> lk(spill_mu_);
         dropped.swap(spill_q_);
-        const size_t bs = mm_->block_size();
-        for (SpillItem& item : dropped) {
-            spill_queue_depth_.fetch_sub(1, std::memory_order_relaxed);
-            spill_inflight_bytes_.fetch_sub(
-                (size_t(item.size) + bs - 1) / bs * bs,
-                std::memory_order_relaxed);
-            spills_cancelled_.fetch_add(1, std::memory_order_relaxed);
-        }
+        account_dropped_spills(dropped, /*cancelled=*/true);
         // Wait out the writer's in-flight batch — AT MOST one: under
         // sustained pressure concurrent puts refill the queue the
         // moment we cleared it, and the writer grabs the next batch

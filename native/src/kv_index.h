@@ -236,11 +236,15 @@ class KVIndex {
                          BlockRef* out, uint32_t* size_out,
                          bool* promoted_out = nullptr);
 
-    // True while the async promotion worker is running — the server's
-    // read/pin paths then use acquire_read/acquire_resident below
-    // instead of the inline-promoting acquire_block.
+    // True while the async promotion worker is running AND alive — the
+    // server's read/pin paths then use acquire_read/acquire_resident
+    // below instead of the inline-promoting acquire_block. A worker
+    // that DIED (induced by the worker.promote failpoint, or a real
+    // crash) flips this false, so reads/pins degrade to the historical
+    // inline paths instead of wedging behind a dead queue.
     bool async_promote_active() const {
-        return promoter_ != nullptr && promoter_->running();
+        return promoter_ != nullptr && promoter_->running() &&
+               promoter_->alive();
     }
 
     // Read-pipeline get (OP_READ, STREAM server-push): never pays tier
@@ -374,6 +378,22 @@ class KVIndex {
     uint64_t promotes_cancelled() const {
         return promoter_ ? promoter_->cancelled() : 0;
     }
+    // Background workers that DIED unexpectedly (induced kill via the
+    // worker.{reclaim,spill,promote} failpoints, or a real crash that
+    // unwound the loop) — never counts clean stop_background() exits.
+    // Every kick path consults the matching liveness flag and degrades
+    // to its inline fallback (inline evict / inline spill selection /
+    // inline promote or BUSY) instead of feeding a dead queue.
+    uint64_t workers_dead() const {
+        return (reclaim_died_.load(std::memory_order_relaxed) ? 1 : 0) +
+               (spill_died_.load(std::memory_order_relaxed) ? 1 : 0) +
+               (promoter_ && promoter_->died() ? 1 : 0);
+    }
+    // Heartbeat ages (µs since each worker's last loop iteration;
+    // -1 = not running). Control-plane visibility for "alive but
+    // wedged" — distinct from the died flags above.
+    long long reclaim_heartbeat_age_us() const;
+    long long spill_heartbeat_age_us() const;
 
     // Evict least-recently-used committed entries whose blocks are not
     // pinned (use_count()==1) until `want` bytes could plausibly be
@@ -473,8 +493,16 @@ class KVIndex {
     // (victims there are evicted directly); other stripes are
     // try-locked, busy ones skipped for the pass. async_spill=true
     // (reclaimer only) queues spill victims to the writer instead of
-    // paying the tier IO inline.
-    size_t evict_internal(size_t want, int held_stripe, bool async_spill);
+    // paying the tier IO inline. age_cap bounds victim ages: the
+    // reclaimer passes the LRU clock snapshot taken when its PASS
+    // began, so entries touched or promotion-adopted DURING the pass
+    // can never be selected by it — without the cap, a long
+    // reclaim-to-low pass raced freshly promoted entries right back
+    // out (the prefetch_hit_rate ~0.87 decay; ROADMAP item 5
+    // follow-on). Inline last-resort callers keep UINT64_MAX — they
+    // need progress NOW over strict ordering.
+    size_t evict_internal(size_t want, int held_stripe, bool async_spill,
+                          uint64_t age_cap = UINT64_MAX);
     // Drain victims from one stripe's cold end: entries whose age is
     // <= age_limit, up to want bytes / max_victims. Returns
     // block-rounded bytes freed (or queued). 0 with *progress=false
@@ -499,6 +527,14 @@ class KVIndex {
         uint32_t size = 0;
         uint32_t stripe = 0;
     };
+    // Rebalance the queue-depth/inflight-bytes gauges for spill items
+    // pulled off the queue without being written (clean stop, induced
+    // writer death, purge cancel). The items' BlockRefs drop when the
+    // caller's deque destructs; this only fixes the accounting, in ONE
+    // place, because the inflight-bytes rounding must match
+    // enqueue_spill's exactly or the reclaimer's overshoot guard drifts.
+    void account_dropped_spills(std::deque<SpillItem>& items,
+                                bool cancelled);
     // Requires the victim's stripe mutex held (spill_mu_ is a leaf).
     void enqueue_spill(const std::string& key, const BlockRef& block,
                        uint32_t size, uint32_t si);
@@ -571,6 +607,16 @@ class KVIndex {
     // Background reclaim pipeline state.
     std::atomic<bool> bg_running_{false};
     std::atomic<bool> bg_stop_{false};
+    // Liveness (failure model): alive_ flips false when a loop exits —
+    // cleanly OR by induced death; died_ records only unexpected
+    // exits (the workers_dead gauge). Heartbeats stamp each loop
+    // iteration so a wedged-but-alive worker is distinguishable.
+    std::atomic<bool> reclaim_alive_{false};
+    std::atomic<bool> spill_alive_{false};
+    std::atomic<bool> reclaim_died_{false};
+    std::atomic<bool> spill_died_{false};
+    std::atomic<long long> reclaim_heartbeat_us_{0};
+    std::atomic<long long> spill_heartbeat_us_{0};
     double high_ = 0.0, low_ = 0.0;
     std::thread reclaim_thread_;
     std::mutex reclaim_mu_;

@@ -12,6 +12,7 @@
 #include <cstdlib>
 #include <stdexcept>
 
+#include "failpoint.h"
 #include "log.h"
 
 namespace istpu {
@@ -305,6 +306,10 @@ MM::MM(size_t initial_size, size_t block_size, const std::string& shm_prefix,
 }
 
 bool MM::allocate(size_t size, PoolLoc* out) {
+    // Injected allocation failure (chaos suite): behaves exactly like a
+    // fully-exhausted pool — callers take their documented OOM paths
+    // (inline reclaim, retryable statuses, promotion cancel).
+    if (IST_FAILPOINT("pool.alloc")) return false;
     size_t n = num_pools();
     for (uint32_t i = 0; i < n; ++i) {
         void* p = pools_[i]->allocate(size);

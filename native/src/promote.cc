@@ -3,6 +3,7 @@
 #include <algorithm>
 #include <cstring>
 
+#include "failpoint.h"
 #include "kv_index.h"
 #include "log.h"
 #include "utils.h"
@@ -55,6 +56,8 @@ void Promoter::start(double cap_frac) {
         ring_ = tracer_->add_track("promote");
     }
     running_.store(true, std::memory_order_relaxed);
+    alive_.store(true, std::memory_order_relaxed);
+    died_.store(false, std::memory_order_relaxed);
     thread_ = std::thread([this] { loop(); });
 }
 
@@ -101,6 +104,21 @@ void Promoter::enqueue(PromoteItem item) {
         q_.push_back(std::move(item));
     }
     cv_.notify_one();
+    // Lost race with an induced worker death: nothing drains the queue
+    // anymore and each item's DiskRef would pin its extent forever.
+    // Pull the items back out and release the refs. PROMOTING flags
+    // are NOT cleared here — the caller holds the item's stripe lock
+    // (enqueue is called under it; cancel_promote_flag would deadlock)
+    // — the stale flags are handled by the dead-worker paths in
+    // acquire_resident/prefetch instead.
+    if (!alive_.load(std::memory_order_relaxed)) {
+        std::deque<PromoteItem> orphans;
+        {
+            std::lock_guard<std::mutex> lk(mu_);
+            orphans.swap(q_);
+        }
+        for (PromoteItem& it : orphans) drop_item(it, false);
+    }
 }
 
 void Promoter::drop_item(PromoteItem& item, bool clear_flag) {
@@ -138,12 +156,26 @@ void Promoter::cancel_queued() {
 
 void Promoter::loop() {
     Tracer::bind_thread(ring_);
+    std::deque<PromoteItem> orphans;  // drained on induced death
     std::unique_lock<std::mutex> lk(mu_);
     while (true) {
         cv_.wait(lk, [this] {
             return stop_.load(std::memory_order_relaxed) || !q_.empty();
         });
         if (stop_.load(std::memory_order_relaxed)) break;
+        // Induced worker death (chaos suite): take the queue with us —
+        // flags are cleared below, OUTSIDE mu_ (stripe locks nest
+        // stripe → queue leaf), so the orphaned keys stay promotable
+        // through the inline fallback and no DiskRef is leaked. The
+        // kick paths observe alive()==false and degrade (acquire_read
+        // keeps serving from the extent, OP_PIN promotes inline).
+        if (IST_FAILPOINT("worker.promote").action == FAIL_KILL) {
+            orphans.swap(q_);
+            died_.store(true, std::memory_order_relaxed);
+            IST_ERROR("promotion worker killed by failpoint; read "
+                      "pipeline degrades to inline promotion");
+            break;
+        }
         std::vector<PromoteItem> batch;
         size_t take = q_.size();
         if (take > kPromoteBatch) take = kPromoteBatch;
@@ -171,6 +203,13 @@ void Promoter::loop() {
         batch_gen_++;  // cancel_queued's bounded barrier
         cv_.notify_all();
     }
+    alive_.store(false, std::memory_order_relaxed);
+    lk.unlock();
+    for (PromoteItem& item : orphans) drop_item(item, true);
+    // A purge racing the death must not wait on a batch that will
+    // never finish: busy_ is false here, so cancel_queued's predicate
+    // is already satisfied; this wake covers a waiter mid-predicate.
+    cv_.notify_all();
 }
 
 void Promoter::process_batch(std::vector<PromoteItem>& batch) {

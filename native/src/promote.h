@@ -136,6 +136,14 @@ class Promoter {
     bool running() const {
         return running_.load(std::memory_order_relaxed);
     }
+    // Liveness (failure model): alive() flips false when the loop
+    // exits — cleanly or via the worker.promote kill failpoint; died()
+    // records only the unexpected case (the workers_dead gauge).
+    // running() stays true after an induced death so stop() still
+    // joins the exited thread (an early return there would leak a
+    // joinable std::thread straight into std::terminate).
+    bool alive() const { return alive_.load(std::memory_order_relaxed); }
+    bool died() const { return died_.load(std::memory_order_relaxed); }
 
     // Pool-headroom admission check (no locks; callable under a stripe
     // lock).
@@ -178,6 +186,8 @@ class Promoter {
 
     std::atomic<bool> running_{false};
     std::atomic<bool> stop_{false};
+    std::atomic<bool> alive_{false};
+    std::atomic<bool> died_{false};
     std::thread thread_;
     std::mutex mu_;                 // guards q_, busy_, batch_gen_
     std::condition_variable cv_;

@@ -15,6 +15,7 @@
 #include <cstdlib>
 #include <cstring>
 
+#include "failpoint.h"
 #include "log.h"
 #include "utils.h"
 
@@ -117,6 +118,11 @@ Server::~Server() {
 
 bool Server::start() {
     install_crash_handler();
+    // Fault injection (failpoint.h): arm whatever ISTPU_FAILPOINTS
+    // names before ANY subsystem is constructed, so even pool/tier
+    // bring-up runs under the chaos spec. Runtime arming goes through
+    // ist_server_fault / POST /fault.
+    failpoints_arm_from_env();
     // Crashed predecessors may have left multi-GB pools in /dev/shm.
     if (cfg_.enable_shm) reclaim_stale_pools();
     // Pool construction first — this is the slow, once-per-process part
@@ -521,7 +527,7 @@ long long Server::restore(const std::string& path) {
 
 std::string Server::stats_json() {
     std::lock_guard<std::mutex> lk(store_mu_);
-    char head[3072];
+    char head[4096];
     snprintf(
         head, sizeof(head),
         "{\"kvmap_len\": %zu, \"inflight\": %zu, \"leases\": %zu, "
@@ -534,6 +540,10 @@ std::string Server::stats_json() {
         "\"spill_queue_depth\": %llu, \"spills_cancelled\": %llu, "
         "\"promotes_async\": %llu, \"promote_queue_depth\": %llu, "
         "\"promotes_cancelled\": %llu, \"disk_reads_inline\": %llu, "
+        "\"disk_io_errors\": %llu, \"tier_breaker_open\": %d, "
+        "\"workers_dead\": %llu, \"failpoints_fired\": %llu, "
+        "\"reclaim_heartbeat_age_us\": %lld, "
+        "\"spill_heartbeat_age_us\": %lld, "
         "\"outq_bytes\": %llu, \"outq_cap\": %llu, \"reads_busy\": %llu, "
         "\"lease_bytes\": %llu, \"pins_busy\": %llu, "
         "\"lease_blocks_out\": %llu, \"leases_oom\": %llu, "
@@ -559,6 +569,12 @@ std::string Server::stats_json() {
         (unsigned long long)(index_ ? index_->promote_queue_depth() : 0),
         (unsigned long long)(index_ ? index_->promotes_cancelled() : 0),
         (unsigned long long)(index_ ? index_->disk_reads_inline() : 0),
+        (unsigned long long)(disk_ ? disk_->io_errors() : 0),
+        disk_ && disk_->breaker_open() ? 1 : 0,
+        (unsigned long long)(index_ ? index_->workers_dead() : 0),
+        (unsigned long long)failpoints_fired_total(),
+        (long long)(index_ ? index_->reclaim_heartbeat_age_us() : -1),
+        (long long)(index_ ? index_->spill_heartbeat_age_us() : -1),
         (unsigned long long)outq_total_.load(std::memory_order_relaxed),
         (unsigned long long)cfg_.max_outq_bytes,
         (unsigned long long)reads_busy_.load(std::memory_order_relaxed),
@@ -813,6 +829,14 @@ void Server::update_epoll(Conn& c) {
 }
 
 void Server::conn_readable(Conn& c) {
+    // Injected receive failure: the connection drops exactly as on a
+    // real socket error — the close path aborts the client's inflight
+    // tokens, releases its pins and reclaims its block leases, and an
+    // auto_reconnect client re-dials. One relaxed load when disarmed.
+    if (IST_FAILPOINT("sock.recv")) {
+        IST_WARN("sock.recv failpoint: dropping fd=%d", c.fd);
+        return close_conn(*c.w, c.fd);
+    }
     while (true) {
         if (c.state == RState::HDR) {
             ssize_t r = recv(c.fd, reinterpret_cast<uint8_t*>(&c.hdr) + c.hdr_got,
@@ -943,6 +967,13 @@ void Server::conn_writable(Conn& c) {
 }
 
 bool Server::flush_out(Conn& c) {
+    // Injected send failure: callers treat false as a fatal socket
+    // error and close the connection (queued OutMsgs drop their
+    // BlockRefs — pins unwind exactly like a real peer reset).
+    if (!c.outq.empty() && IST_FAILPOINT("sock.send")) {
+        IST_WARN("sock.send failpoint: dropping fd=%d", c.fd);
+        return false;
+    }
     while (!c.outq.empty()) {
         OutMsg& m = c.outq.front();
         iovec iov[64];
@@ -1445,6 +1476,15 @@ void Server::op_commit_batch(Conn& c) {
     std::vector<uint32_t> dedup;
     bool overrun = false;
     uint64_t epoch = 0;
+    // Injected commit-replay failure (lease.commit): the carve below
+    // MUST still run — client and server mirror the same deterministic
+    // cursor, and skipping it would shift every later batch's
+    // destinations onto earlier bytes (silent corruption). Instead the
+    // carved blocks are returned to the pool uncommitted: the keys
+    // never become visible, and the client sees INTERNAL_ERROR in its
+    // deferred-commit error latch (ist_lease_take_error) at the next
+    // sync — a VISIBLE loss, never a torn or wrong payload.
+    const bool inject_fail = bool(IST_FAILPOINT("lease.commit"));
     const bool trace = tracer_->enabled();  // gates the clock reads too
     long long tcommit = trace ? now_us() : 0;
     {
@@ -1490,6 +1530,10 @@ void Server::op_commit_batch(Conn& c) {
                 bl.run_idx++;
                 bl.block_off = 0;
             }
+            if (inject_fail) {
+                mm_->deallocate(loc, block_size);
+                continue;
+            }
             Status st = index_->insert_leased(keys[i], loc, block_size);
             if (st == OK) {
                 committed++;
@@ -1511,7 +1555,7 @@ void Server::op_commit_batch(Conn& c) {
                         uint64_t(now_us() - tcommit),
                         uint16_t(committed > 0xFFFF ? 0xFFFF : committed));
     }
-    w.u32(overrun ? BAD_REQUEST : OK);
+    w.u32(inject_fail ? INTERNAL_ERROR : (overrun ? BAD_REQUEST : OK));
     w.u32(committed);
     w.u64(epoch);
     w.u32(uint32_t(dedup.size()));

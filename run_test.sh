@@ -17,6 +17,19 @@
 set -e
 cd "$(dirname "$0")"
 
+# ISTPU_CHAOS=1: the fault-injection leg — build normally and run the
+# chaos suite alone (tests/test_chaos.py arms the failpoint subsystem
+# against the hammer workloads: disk EIO/ENOSPC, tier circuit breaker,
+# induced background-worker death, alloc + socket faults, server
+# restart under leased load). The same file also rides the ISTPU_TSAN=1
+# suite below — the injected paths flip breaker/liveness state exactly
+# where the race detector should be watching.
+if [ "${ISTPU_CHAOS:-0}" = "1" ] && [ "${ISTPU_TSAN:-0}" != "1" ]; then
+    make -C native
+    exec env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_chaos.py -q "$@"
+fi
+
 if [ "${ISTPU_TSAN:-0}" = "1" ]; then
     make -C native tsan
     TSAN_RT="$(gcc -print-file-name=libtsan.so)"
@@ -38,7 +51,9 @@ if [ "${ISTPU_TSAN:-0}" = "1" ]; then
     # pipeline's promote/get/delete hammer — the promotion worker's
     # queue-pinned reads + locked revalidation race foreground
     # delete/purge/re-put there.
-    SMOKE="${ISTPU_TSAN_TESTS:-tests/test_concurrency.py tests/test_trace.py tests/test_prefetch.py}"
+    # test_chaos.py rides along: induced worker death, breaker flips
+    # and the inline fallbacks race the data plane under TSAN.
+    SMOKE="${ISTPU_TSAN_TESTS:-tests/test_concurrency.py tests/test_trace.py tests/test_prefetch.py tests/test_chaos.py}"
     # detect_deadlocks=0: TSAN's lock-order detector keeps a 64-entry
     # held-locks table per thread and CHECK-fails (FATAL) on the index's
     # cross-stripe ops, which legitimately hold 16 ordered stripe locks

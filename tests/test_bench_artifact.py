@@ -190,6 +190,30 @@ def test_trace_leg_emits_overhead_keys():
     assert out["trace_spans"] > 0  # the traced leg actually traced
 
 
+def test_chaos_leg_emits_overhead_keys():
+    """The failpoints-disarmed overhead leg (ISSUE 6) must land its
+    keys in the artifact: read p50 with the failpoint registry
+    populated-but-disarmed vs untouched, and the ratio the <=1.02
+    acceptance gate reads. The ratio itself is asserted only as sane
+    (>0) here — CI noise is checked at the acceptance level, not per
+    test run."""
+    env = _env(600)
+    env["ISTPU_CHAOS_KEYS"] = "128"  # small: keep the test fast
+    p = subprocess.run(
+        [sys.executable, BENCH, "--chaos-leg", "0"], env=env,
+        capture_output=True, text=True, timeout=180,
+    )
+    assert p.returncode == 0, p.stderr[-400:]
+    outs = _parse_artifacts(
+        [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+    )
+    assert outs, p.stdout[-400:]
+    out = outs[-1]
+    assert out["chaos_off_p50_read_us"] > 0
+    assert out["chaos_baseline_p50_read_us"] > 0
+    assert out["chaos_off_overhead_p50_ratio"] > 0
+
+
 def test_probe_failure_cached_across_runs(tmp_path, monkeypatch):
     """A failed probe is persisted; the next run (within the TTL) skips
     the probe subprocess entirely — no 180 s re-burn (the BENCH_r05

@@ -1,0 +1,754 @@
+"""Chaos suite: the failpoint subsystem (native/src/failpoint.h) armed
+against the hammer shapes of test_concurrency, asserting the ISSUE 6
+invariants:
+
+  - the server process NEVER dies under injected faults;
+  - no committed key is ever lost silently or served torn (every
+    payload is key-derived, so a readback is its own checksum);
+  - conservation holds (purge drains pool + tier to zero even after
+    injected failures);
+  - every degradation is visible: disk_io_errors, tier_breaker_open,
+    workers_dead, failpoints_fired in /stats, /metrics and /health.
+
+Failpoints are PROCESS-GLOBAL (call sites cache registry pointers), so
+every test disarms in finally AND an autouse fixture disarms again —
+an assert mid-chaos must not leak armed points into the next test.
+
+Runs in the regular suite and as the ``ISTPU_CHAOS=1 ./run_test.sh``
+leg (also under ISTPU_TSAN=1: the injected paths — breaker flips,
+worker-death drains, inline fallbacks — race the data plane exactly
+where TSAN should be watching).
+"""
+
+import ctypes as ct
+import json
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from infinistore_tpu import (
+    ClientConfig,
+    InfiniStoreError,
+    InfiniStoreKeyNotFound,
+    InfiniStoreServer,
+    InfinityConnection,
+    ServerConfig,
+    TYPE_SHM,
+    TYPE_STREAM,
+)
+from infinistore_tpu import _native
+
+BLOCK = 4 << 10  # 4 KB pages, the vLLM-style unit
+
+
+def _disarm_all():
+    # ist_server_fault only anchors the handle (never dereferenced);
+    # the registry is process-global, so any non-null pointer works —
+    # this must run even when no server is alive anymore.
+    _native.get_lib().ist_server_fault(ct.c_void_p(1), b"off", None, 0)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_hygiene():
+    yield
+    _disarm_all()
+
+
+def payload(key):
+    """Key-derived page: a readback that equals payload(key) proves the
+    bytes are neither torn nor another key's."""
+    seed = zlib.crc32(key.encode())
+    return (np.arange(BLOCK, dtype=np.uint32) * 2654435761 + seed).astype(
+        np.uint8
+    )
+
+
+def start_server(port=0, pool_mb=2, ssd_mb=16, eviction=False,
+                 high=0.95, low=0.85, workers=1, tmpdir=None):
+    cfg = ServerConfig(
+        service_port=port,
+        prealloc_size=pool_mb / 1024,
+        minimal_allocate_size=4,
+        enable_eviction=eviction,
+        reclaim_high=high,
+        reclaim_low=low,
+        workers=workers,
+    )
+    if ssd_mb:
+        assert tmpdir is not None
+        cfg.ssd_path = str(tmpdir)
+        cfg.ssd_size = ssd_mb / 1024
+    srv = InfiniStoreServer(cfg)
+    srv.start()
+    return srv
+
+
+def connect(port, ctype=TYPE_STREAM, **kw):
+    c = InfinityConnection(
+        ClientConfig(
+            host_addr="127.0.0.1", service_port=port,
+            connection_type=ctype, timeout_ms=5000, **kw,
+        )
+    )
+    c.connect()
+    return c
+
+
+def put_keys(conn, keys):
+    for i, k in enumerate(keys):
+        conn.put_cache(payload(k), [(k, 0)], BLOCK)
+        if i % 32 == 31:
+            conn.sync()
+    conn.sync()
+
+
+def verify_keys(conn, keys, allow_missing=False):
+    """Every key is either absent (only when allow_missing — eviction
+    is a legal degradation) or byte-exact. Torn/foreign bytes fail."""
+    dst = np.zeros(BLOCK, dtype=np.uint8)
+    present = 0
+    for k in keys:
+        try:
+            conn.read_cache(dst, [(k, 0)], BLOCK)
+        except InfiniStoreKeyNotFound:
+            assert allow_missing, f"committed key {k} lost"
+            continue
+        assert np.array_equal(dst, payload(k)), f"key {k} served torn"
+        present += 1
+    return present
+
+
+def wait_stat(srv, pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = srv.stats()
+        if pred(st):
+            return st
+        time.sleep(0.02)
+    return srv.stats()
+
+
+# ---------------------------------------------------------------------------
+# Subsystem basics: arming surface, zero-cost contract, catalog.
+# ---------------------------------------------------------------------------
+
+
+def test_fault_api_arm_list_disarm(tmp_path):
+    srv = start_server(ssd_mb=0)
+    try:
+        assert srv.faults()["fired_total"] >= 0
+        assert srv.fault("disk.pwrite=every(4):err(5);pool.alloc=off") == 2
+        specs = {
+            f["name"]: f["spec"] for f in srv.faults()["failpoints"]
+        }
+        assert specs["disk.pwrite"].startswith("every(4)")
+        assert specs["pool.alloc"] == "off"
+        with pytest.raises(ValueError):
+            srv.fault("nonsense")
+        with pytest.raises(ValueError):
+            srv.fault("disk.pwrite=prob(7)")
+        # Names outside the compiled-in catalog are parse errors: a
+        # typo must fail loudly, never arm a point wired to nothing.
+        with pytest.raises(ValueError):
+            srv.fault("disk.pwrit=once")
+        # A rejected spec is all-or-nothing: nothing changed above.
+        assert srv.fault("off") >= 1
+        assert all(
+            f["spec"] == "off" for f in srv.faults()["failpoints"]
+        )
+    finally:
+        srv.fault("off")
+        srv.stop()
+
+
+def test_disarmed_failpoints_do_not_fire(tmp_path):
+    srv = start_server(ssd_mb=4, tmpdir=tmp_path)
+    port = srv.service_port
+    conn = connect(port)
+    try:
+        # failpoints_fired is process-global (never reset): assert a
+        # zero DELTA across this workload, not an absolute zero — an
+        # earlier chaos test in the same process may have fired points.
+        fired0 = srv.stats()["failpoints_fired"]
+        keys = [f"idle{i}" for i in range(64)]
+        put_keys(conn, keys)
+        assert verify_keys(conn, keys) == 64
+        st = srv.stats()
+        assert st["failpoints_fired"] == fired0
+        assert st["disk_io_errors"] == 0
+        assert st["tier_breaker_open"] == 0
+        assert st["workers_dead"] == 0
+        # Heartbeats: the background workers are alive and beating.
+        assert st["reclaim_heartbeat_age_us"] >= 0
+        assert st["spill_heartbeat_age_us"] >= 0
+    finally:
+        conn.close()
+        srv.fault("off")
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Disk-tier faults: EIO / ENOSPC / short writes under spill load.
+# ---------------------------------------------------------------------------
+
+
+def test_disk_write_errors_never_lose_committed_keys(tmp_path):
+    """Spill-only mode (no eviction): every 3rd tier write fails with
+    EIO (and one armed short-write runs the torn-write rollback). The
+    pool is sized to hold the full working set, with low watermarks so
+    spill traffic is constant — failed spills must leave their victims
+    resident and readable, never lost, never torn."""
+    srv = start_server(pool_mb=4, ssd_mb=16, eviction=False,
+                       high=0.3, low=0.2, workers=2, tmpdir=tmp_path)
+    port = srv.service_port
+    conn = connect(port)
+    try:
+        srv.fault("disk.pwrite=every(3):err(5);disk.pwritev=every(2):short")
+        keys = [f"eio{i}" for i in range(320)]
+        put_keys(conn, keys)
+        # Let the reclaimer/spill writer churn against the failing tier.
+        st = wait_stat(srv, lambda s: s["disk_io_errors"] > 0)
+        assert st["disk_io_errors"] > 0
+        assert st["failpoints_fired"] > 0
+        srv.fault("off")
+        # Spill-only: every committed key must still be byte-exact
+        # (from the pool or a successfully written extent).
+        assert verify_keys(conn, keys) == len(keys)
+        assert srv.kvmap_len() == len(keys)
+        # Conservation after injected failures: purge drains both tiers
+        # (a leaked extent reservation would leave disk_used != 0).
+        conn.purge()
+        st = wait_stat(srv, lambda s: s["disk_used"] == 0
+                       and s["used_bytes"] == 0)
+        assert st["disk_used"] == 0, st
+        assert st["used_bytes"] == 0, st
+    finally:
+        conn.close()
+        srv.fault("off")
+        srv.stop()
+
+
+def test_enospc_reservation_refusal_is_not_an_io_error(tmp_path):
+    """disk.reserve models a FULL tier (ENOSPC at reservation): spills
+    are refused with no io_errors counted and no breaker trip — the
+    capacity path, not the device-failure path."""
+    srv = start_server(pool_mb=2, ssd_mb=16, eviction=True,
+                       high=0.3, low=0.2, tmpdir=tmp_path)
+    port = srv.service_port
+    conn = connect(port)
+    try:
+        srv.fault("disk.reserve=count(10000):err(28)")
+        keys = [f"nospc{i}" for i in range(256)]
+        put_keys(conn, keys)
+        st = wait_stat(srv, lambda s: s["evictions"] > 0)
+        # Tier refused every store: pressure degraded to hard eviction.
+        assert st["evictions"] > 0
+        assert st["spills"] == 0
+        assert st["disk_io_errors"] == 0
+        assert st["tier_breaker_open"] == 0
+        srv.fault("off")
+        verify_keys(conn, keys, allow_missing=True)  # evicted or exact
+    finally:
+        conn.close()
+        srv.fault("off")
+        srv.stop()
+
+
+def test_tier_breaker_opens_and_reprobes_closed(tmp_path):
+    """Persistent write EIO trips the circuit breaker (visible in
+    stats + /health); spills degrade to hard evicts; after the fault
+    clears, the backoff re-probe closes the breaker and spilling
+    resumes."""
+    srv = start_server(pool_mb=2, ssd_mb=16, eviction=True,
+                       high=0.3, low=0.2, tmpdir=tmp_path)
+    port = srv.service_port
+    conn = connect(port)
+    try:
+        srv.fault("disk.pwrite=count(100000):err(5);"
+                  "disk.pwritev=count(100000):err(5)")
+        keys = [f"brk{i}" for i in range(256)]
+        put_keys(conn, keys)
+        st = wait_stat(srv, lambda s: s["tier_breaker_open"] == 1)
+        assert st["tier_breaker_open"] == 1, st
+        assert st["disk_io_errors"] >= 3
+        # Degraded, not dead: pure-pool mode keeps absorbing puts via
+        # hard eviction, and the payloads that remain are exact.
+        put_keys(conn, [f"brk_extra{i}" for i in range(64)])
+        st = srv.stats()
+        assert st["evictions"] > 0
+        verify_keys(conn, keys, allow_missing=True)
+        # Fault repaired: keep load flowing until a probe store lands.
+        # Patient deadlines: failed probes doubled the backoff (up to
+        # 5 s), and under TSAN every iteration is several times slower.
+        srv.fault("off")
+        deadline = time.monotonic() + 40
+        i = 0
+        while (time.monotonic() < deadline
+               and srv.stats()["tier_breaker_open"] == 1):
+            put_keys(conn, [f"brk_heal{i}_{j}" for j in range(64)])
+            i += 1
+            time.sleep(0.05)
+        st = wait_stat(srv, lambda s: s["tier_breaker_open"] == 0,
+                       timeout=20)
+        assert st["tier_breaker_open"] == 0, st
+        st = wait_stat(srv, lambda s: s["spills"] > 0, timeout=20)
+        assert st["spills"] > 0, st  # spilling resumed after the close
+    finally:
+        conn.close()
+        srv.fault("off")
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Background-worker death: detect, degrade to inline, never wedge.
+# ---------------------------------------------------------------------------
+
+
+def test_worker_deaths_degrade_to_inline_paths(tmp_path):
+    """Kill the promotion worker, the spill writer and the reclaimer
+    one at a time under load. Each death must be detected
+    (workers_dead, /health 'degraded'), the matching kick path must
+    fall back inline (disk keys stay readable, puts keep landing via
+    hard stalls), and nothing wedges."""
+    srv = start_server(pool_mb=2, ssd_mb=16, eviction=True,
+                       high=0.3, low=0.2, workers=2, tmpdir=tmp_path)
+    port = srv.service_port
+    conn = connect(port)
+    try:
+        keys = [f"wd{i}" for i in range(256)]
+        put_keys(conn, keys)
+        # Wait for spill traffic so some keys are disk-resident.
+        st = wait_stat(srv, lambda s: s["spills"] > 0)
+        assert st["spills"] > 0
+
+        # 1) Promotion worker: killed on its next wakeup (prefetch).
+        srv.fault("worker.promote=once:kill")
+        conn.prefetch(keys[:64], wait=True)
+        st = wait_stat(srv, lambda s: s["workers_dead"] >= 1)
+        assert st["workers_dead"] == 1, st
+        # Disk-resident keys still serve (extent reads + inline
+        # promotion fallback), byte-exact.
+        assert verify_keys(conn, keys, allow_missing=True) > 0
+        # A prefetch now reports skipped (3), never queues to the dead
+        # worker, and never wedges the caller.
+        res = conn.prefetch(keys[:32], wait=True)
+        assert res["queued"] == 0
+
+        # 2) Spill writer: killed when the reclaimer next feeds it.
+        srv.fault("worker.spill=once:kill")
+        put_keys(conn, [f"wd_b{i}" for i in range(128)])
+        st = wait_stat(srv, lambda s: s["workers_dead"] >= 2)
+        assert st["workers_dead"] == 2, st
+
+        # 3) Reclaimer: dies on its next tick; puts then pay inline
+        # reclaim (hard stalls) but keep landing.
+        srv.fault("worker.reclaim=once:kill")
+        st = wait_stat(srv, lambda s: s["workers_dead"] >= 3)
+        assert st["workers_dead"] == 3, st
+        hard0 = st["hard_stalls"]
+        # Enough keys to EXHAUST the pool (512 blocks): with every
+        # background worker dead, only the inline last-resort reclaim
+        # can make room now.
+        put_keys(conn, [f"wd_c{i}" for i in range(600)])
+        st = srv.stats()
+        assert st["hard_stalls"] > hard0  # inline fallback carried it
+        # Dead workers report no heartbeat.
+        assert st["reclaim_heartbeat_age_us"] == -1
+        assert st["spill_heartbeat_age_us"] == -1
+        verify_keys(conn, [f"wd_c{i}" for i in range(600)],
+                    allow_missing=True)
+    finally:
+        conn.close()
+        srv.fault("off")
+        srv.stop()
+
+
+def test_promote_read_eio_cancels_clean(tmp_path):
+    """EIO on the promotion worker's preads: promotions cancel
+    (promotes_cancelled), the entry keeps serving from its extent or
+    the op errors — a torn payload is never produced."""
+    srv = start_server(pool_mb=2, ssd_mb=16, eviction=False,
+                       high=0.3, low=0.2, tmpdir=tmp_path)
+    port = srv.service_port
+    conn = connect(port)
+    try:
+        keys = [f"pr{i}" for i in range(256)]
+        put_keys(conn, keys)
+        wait_stat(srv, lambda s: s["spills"] > 0)
+        # every(1): the worker's merged preads coalesce a whole batch
+        # into very few load calls, so EVERY one must fail to make the
+        # cancel path deterministic.
+        srv.fault("disk.pread=every(1):short")
+        cancelled0 = srv.stats()["promotes_cancelled"]
+        res = conn.prefetch(keys, wait=True)
+        assert res["queued"] > 0  # admission let some promotions in
+        wait_stat(srv, lambda s: s["promote_queue_depth"] == 0)
+        st = srv.stats()
+        # Reads hit the failpoint: every failed pread cancelled its
+        # promotion instead of adopting garbage bytes.
+        assert st["disk_io_errors"] > 0
+        srv.fault("off")
+        assert st["promotes_cancelled"] > cancelled0
+        # With the fault cleared every key reads back exact (spill-only
+        # mode: nothing was lost meanwhile).
+        assert verify_keys(conn, keys) == len(keys)
+    finally:
+        conn.close()
+        srv.fault("off")
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Allocation + socket faults at hammer load.
+# ---------------------------------------------------------------------------
+
+
+def test_alloc_failures_are_retryable_not_fatal(tmp_path):
+    """pool.alloc firing 30% of the time: puts fail with retryable OOM
+    (all-or-nothing, no partial commit), a bounded retry loop lands
+    every key, and readbacks are exact."""
+    srv = start_server(pool_mb=4, ssd_mb=0)
+    port = srv.service_port
+    conn = connect(port)
+    try:
+        srv.fault("pool.alloc=prob(0.3)")
+        keys = [f"oom{i}" for i in range(128)]
+        for k in keys:
+            for _ in range(40):
+                try:
+                    conn.put_cache(payload(k), [(k, 0)], BLOCK)
+                    break
+                except InfiniStoreError as e:
+                    assert e.status == _native.OUT_OF_MEMORY
+            else:
+                pytest.fail(f"put {k} never landed under 30% alloc loss")
+        conn.sync()
+        srv.fault("off")
+        assert verify_keys(conn, keys) == len(keys)
+        assert srv.stats()["failpoints_fired"] > 0
+    finally:
+        conn.close()
+        srv.fault("off")
+        srv.stop()
+
+
+def test_socket_faults_hammer_with_reconnect(tmp_path):
+    """Random injected recv/send failures drop connections mid-op
+    while auto_reconnect clients hammer puts/gets from threads. The
+    server must survive, reconnects must happen, and every key whose
+    put SYNCED must read back exact after the fault clears."""
+    srv = start_server(pool_mb=8, ssd_mb=0, workers=2)
+    port = srv.service_port
+    try:
+        srv.fault("sock.recv=prob(0.01):err(104);"
+                  "sock.send=prob(0.01):err(32)")
+        committed = [set() for _ in range(4)]
+        errs = []
+
+        def hammer(t):
+            # The injected recv fault can drop the HELLO itself.
+            for attempt in range(10):
+                try:
+                    conn = connect(port, auto_reconnect=True,
+                                   retry_backoff_ms=5)
+                    break
+                except Exception:
+                    if attempt == 9:
+                        raise
+                    time.sleep(0.02)
+            dst = np.zeros(BLOCK, dtype=np.uint8)
+            try:
+                for i in range(80):
+                    k = f"sock{t}_{i}"
+                    try:
+                        conn.put_cache(payload(k), [(k, 0)], BLOCK)
+                        conn.sync()
+                        committed[t].add(k)
+                    except Exception:
+                        continue  # dropped mid-op: retried next key
+                    try:
+                        conn.read_cache(dst, [(k, 0)], BLOCK)
+                        if not np.array_equal(dst, payload(k)):
+                            errs.append(f"torn {k}")
+                    except Exception:
+                        pass  # connection drop on the read: fine
+            finally:
+                conn.close()
+
+        threads = [
+            threading.Thread(target=hammer, args=(t,)) for t in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+            assert not t.is_alive(), "hammer wedged under socket faults"
+        assert not errs, errs
+        st = srv.stats()
+        assert st["failpoints_fired"] > 0
+        srv.fault("off")
+        # Post-fault verification on a clean connection: synced puts
+        # survived every injected connection drop.
+        conn = connect(port)
+        try:
+            total = 0
+            for t in range(4):
+                total += verify_keys(conn, sorted(committed[t]))
+            assert total == sum(len(c) for c in committed)
+            assert total > 0  # the hammer made progress under faults
+        finally:
+            conn.close()
+    finally:
+        srv.fault("off")
+        srv.stop()
+
+
+def test_lease_commit_replay_failure_is_visible_loss(tmp_path):
+    """lease.commit=once: the server carves the batch (cursors stay
+    mirrored — no silent corruption) but commits nothing; the client's
+    next sync() raises the latched deferred-commit error, the keys are
+    NOT visible, and later leased puts commit normally."""
+    srv = start_server(pool_mb=4, ssd_mb=0)
+    port = srv.service_port
+    conn = connect(port, ctype=TYPE_SHM, use_lease=True, lease_blocks=64)
+    try:
+        put_keys(conn, [f"lc_ok{i}" for i in range(8)])
+        srv.fault("lease.commit=once")
+        lost = [f"lc_lost{i}" for i in range(8)]
+        for k in lost:
+            conn.put_cache(payload(k), [(k, 0)], BLOCK)
+        with pytest.raises(InfiniStoreError):
+            conn.sync()
+        srv.fault("off")
+        # Visible loss, never a torn commit: the keys simply absent.
+        for k in lost:
+            assert not conn.check_exist(k)
+        # The lease path recovers: the same keys re-put fine.
+        put_keys(conn, lost)
+        assert verify_keys(conn, lost) == len(lost)
+        assert verify_keys(conn, [f"lc_ok{i}" for i in range(8)]) == 8
+    finally:
+        conn.close()
+        srv.fault("off")
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Client retry pacing (ISSUE 6 satellites).
+# ---------------------------------------------------------------------------
+
+
+def test_pin_busy_retry_backoff_promotes_disk_key(tmp_path):
+    """OP_PIN of a disk-resident key answers BUSY (async promote
+    queued); the client's _retry_busy loop — capped by the new
+    ClientConfig.retry_backoff_ms — retries until the worker adopts
+    the pool copy and the bulk SHM read completes exact."""
+    srv = start_server(pool_mb=2, ssd_mb=16, eviction=False,
+                       high=0.3, low=0.2, tmpdir=tmp_path)
+    port = srv.service_port
+    conn = connect(port, ctype=TYPE_SHM, retry_backoff_ms=10)
+    try:
+        keys = [f"pin{i}" for i in range(256)]
+        put_keys(conn, keys)
+        wait_stat(srv, lambda s: s["spills"] > 32)
+        # A >32 KB batched read takes the PIN path; cold keys answer
+        # BUSY until promoted.
+        batch = keys[:16]  # 16 x 4 KB = 64 KB > the 32 KB crossover
+        dst = np.zeros(16 * BLOCK, dtype=np.uint8)
+        conn.read_cache(
+            dst, [(k, j * BLOCK) for j, k in enumerate(batch)], BLOCK
+        )
+        for j, k in enumerate(batch):
+            assert np.array_equal(
+                dst[j * BLOCK:(j + 1) * BLOCK], payload(k)
+            ), f"{k} torn through the pin retry path"
+    finally:
+        conn.close()
+        srv.fault("off")
+        srv.stop()
+
+
+def test_reconnect_retry_backoff_bounds(tmp_path, monkeypatch):
+    """The auto_reconnect retry sleeps a jittered, bounded backoff
+    between reconnect and replay (was immediate), and the streak
+    resets on success."""
+    import infinistore_tpu.lib as libmod
+
+    srv = start_server(pool_mb=1, ssd_mb=0)
+    port = srv.service_port
+    conn = connect(port, auto_reconnect=True, retry_backoff_ms=40)
+    sleeps = []
+    real_sleep = time.sleep
+    monkeypatch.setattr(
+        libmod.time, "sleep",
+        lambda s: (sleeps.append(s), real_sleep(min(s, 0.01)))[1],
+    )
+    try:
+        put_keys(conn, ["rb0"])
+        srv.stop()
+        srv = start_server(port=port, pool_mb=1, ssd_mb=0)
+        conn.put_cache(payload("rb1"), [("rb1", 0)], BLOCK)
+        conn.sync()
+        backoffs = [s for s in sleeps if 0.015 <= s <= 0.08]
+        assert backoffs, f"no bounded backoff slept: {sleeps}"
+        assert conn._retry_streak == 0  # reset by the successful retry
+    finally:
+        conn.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Control plane: POST /fault + degradation in /health and /metrics.
+# ---------------------------------------------------------------------------
+
+
+def test_fault_endpoint_and_degraded_health(tmp_path):
+    import urllib.request
+
+    from infinistore_tpu.server import make_control_plane
+
+    srv = start_server(pool_mb=2, ssd_mb=16, eviction=True,
+                       high=0.3, low=0.2, tmpdir=tmp_path)
+    srv.config.manage_port = 0
+    httpd = make_control_plane(srv)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    conn = connect(srv.service_port)
+    try:
+        def post(path, body):
+            req = urllib.request.Request(
+                base + path, data=body.encode(), method="POST"
+            )
+            with urllib.request.urlopen(req) as r:
+                return json.loads(r.read().decode())
+
+        def get(path):
+            with urllib.request.urlopen(base + path) as r:
+                return r.read().decode()
+
+        # Arm over HTTP (JSON body), see it in the catalog, fire it.
+        out = post("/fault", json.dumps(
+            {"spec": "worker.reclaim=once:kill"}))
+        assert out["armed"] == 1
+        cat = json.loads(get("/fault"))
+        assert any(
+            f["name"] == "worker.reclaim" and f["spec"] != "off"
+            for f in cat["failpoints"]
+        )
+        # The reclaimer ticks every 200 ms: it dies without any load.
+        wait_stat(srv, lambda s: s["workers_dead"] >= 1)
+        health = json.loads(get("/health"))
+        assert health["status"] == "degraded"
+        assert health["workers_dead"] == 1
+        # /metrics exposes the failure-model families.
+        metrics = get("/metrics")
+        assert "infinistore_workers_dead 1" in metrics
+        assert "infinistore_tier_breaker_open 0" in metrics
+        assert "infinistore_disk_io_errors_total" in metrics
+        assert "infinistore_failpoints_fired_total" in metrics
+        # Bad spec → 400 with the parse reason.
+        req = urllib.request.Request(
+            base + "/fault", data=b"garbage", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 400
+        # Raw-text spec body disarms.
+        assert post("/fault", "off")["armed"] >= 1
+    finally:
+        conn.close()
+        httpd.shutdown()
+        httpd.server_close()
+        srv.fault("off")
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Server restart under leased/pinned load (ISSUE 6 satellite).
+# ---------------------------------------------------------------------------
+
+
+def test_restart_under_lease_and_pin_cache_load(tmp_path):
+    """Kill + restart the server while auto_reconnect lease clients
+    hold block leases and warmed pin caches. Clients must recover with
+    no wedged handles, deferred commits lost to the restart must
+    surface as errors (never silent), and no stale pin-cache read may
+    survive the restart (fresh store ⇒ KeyNotFound, not old bytes)."""
+    srv = start_server(pool_mb=4, ssd_mb=0)
+    port = srv.service_port
+    conns = [
+        connect(port, ctype=TYPE_SHM, use_lease=True, lease_blocks=64,
+                auto_reconnect=True, retry_backoff_ms=10)
+        for _ in range(3)
+    ]
+    try:
+        # Warm: committed keys + hot pin caches (two reads each).
+        dst = np.zeros(BLOCK, dtype=np.uint8)
+        for t, conn in enumerate(conns):
+            put_keys(conn, [f"rs{t}_{i}" for i in range(8)])
+            for i in range(8):
+                conn.read_cache(dst, [(f"rs{t}_{i}", 0)], BLOCK)
+                conn.read_cache(dst, [(f"rs{t}_{i}", 0)], BLOCK)
+        # Deferred, un-flushed leased puts ride into the restart.
+        for t, conn in enumerate(conns):
+            conn.put_cache(payload(f"pend{t}"), [(f"pend{t}", 0)], BLOCK)
+
+        srv.stop()
+        srv = start_server(port=port, pool_mb=4, ssd_mb=0)
+
+        stuck = []
+
+        def recover(t):
+            conn = conns[t]
+            # The lost deferred commit must surface on some op — sync
+            # raises the latched error exactly once, then ops flow.
+            saw_error = False
+            for _ in range(3):
+                try:
+                    conn.sync()
+                    break
+                except Exception:
+                    saw_error = True
+            # Old keys: gone (volatile store) — and NEVER served stale
+            # from the pin cache across the restart.
+            try:
+                conn.read_cache(dst, [(f"rs{t}_0", 0)], BLOCK)
+                stuck.append(f"client {t}: stale pin-cache read")
+            except InfiniStoreKeyNotFound:
+                pass
+            except Exception as e:
+                stuck.append(f"client {t}: {e!r}")
+            # Fresh leased puts work end to end. A straggler error from
+            # an in-flight pre-restart commit batch can latch while the
+            # new puts flow — drain it (bounded) and re-put; only a
+            # persistent failure is a wedge.
+            for _ in range(4):
+                try:
+                    put_keys(conn, [f"rs2_{t}_{i}" for i in range(8)])
+                    break
+                except InfiniStoreError:
+                    saw_error = True
+            got = verify_keys(conn, [f"rs2_{t}_{i}" for i in range(8)])
+            if got != 8:
+                stuck.append(f"client {t}: post-restart puts lost")
+            if not (saw_error or not conn.check_exist(f"pend{t}")):
+                stuck.append(f"client {t}: pending put vanished silently")
+
+        threads = [
+            threading.Thread(target=recover, args=(t,))
+            for t in range(len(conns))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+            assert not t.is_alive(), "client wedged across restart"
+        assert not stuck, stuck
+    finally:
+        for conn in conns:
+            conn.close()
+        srv.stop()

@@ -74,7 +74,12 @@ def post(base, path):
 
 def test_health_and_kvmap_len(plane):
     base, srv, _ = plane
-    assert json.loads(get(base, "/health")) == {"status": "ok"}
+    health = json.loads(get(base, "/health"))
+    # Failure-model summary rides /health (ISSUE 6): a dead background
+    # worker or open tier breaker reports "degraded", never dead.
+    assert health["status"] == "ok"
+    assert health["workers_dead"] == 0
+    assert health["tier_breaker_open"] == 0
     assert json.loads(get(base, "/kvmap_len")) == srv.kvmap_len() == 20
 
 
