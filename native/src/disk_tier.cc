@@ -167,7 +167,7 @@ int64_t DiskTier::store(const void* src, uint32_t size) {
         // Reserve the extent under the lock, write outside it (pwrite is
         // offset-addressed, so concurrent stores to disjoint extents are
         // safe); a failed write rolls the reservation back.
-        std::lock_guard<std::mutex> lk(mu_);
+        ScopedLock lk(mu_);
         if (used_blocks_.load(std::memory_order_relaxed) + count >
             total_blocks_) {
             breaker_probe_aborted();
@@ -203,7 +203,7 @@ int64_t DiskTier::store(const void* src, uint32_t size) {
             if (!inject && w < 0 && errno == EINTR) continue;
             IST_ERROR("disk tier pwrite failed: %s", strerror(errno));
             note_write_error();
-            std::lock_guard<std::mutex> lk(mu_);
+            ScopedLock lk(mu_);
             set_range(uint64_t(start), count, false);
             used_blocks_.fetch_sub(count, std::memory_order_relaxed);
             return -1;
@@ -274,7 +274,7 @@ int64_t DiskTier::store_gather(const void* const* srcs,
     }
     int64_t start;
     {
-        std::lock_guard<std::mutex> lk(mu_);
+        ScopedLock lk(mu_);
         if (used_blocks_.load(std::memory_order_relaxed) + blocks >
             total_blocks_) {
             breaker_probe_aborted();
@@ -316,7 +316,7 @@ int64_t DiskTier::store_gather(const void* const* srcs,
             if (!inject && w < 0 && errno == EINTR) continue;
             IST_ERROR("disk tier pwritev failed: %s", strerror(errno));
             note_write_error();
-            std::lock_guard<std::mutex> lk(mu_);
+            ScopedLock lk(mu_);
             set_range(uint64_t(start), blocks, false);
             used_blocks_.fetch_sub(blocks, std::memory_order_relaxed);
             return -1;
@@ -401,7 +401,7 @@ void DiskTier::release(int64_t off, uint32_t size) {
     uint64_t count = (uint64_t(size) + block_size_ - 1) / block_size_;
     if (start + count > total_blocks_) return;
     {
-        std::lock_guard<std::mutex> lk(mu_);
+        ScopedLock lk(mu_);
         set_range(start, count, false);
         used_blocks_.fetch_sub(count, std::memory_order_relaxed);
     }

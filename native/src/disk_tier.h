@@ -34,9 +34,11 @@
 
 #include <atomic>
 #include <cstdint>
-#include <mutex>
 #include <string>
 #include <vector>
+
+#include "lock_rank.h"
+#include "thread_annotations.h"
 
 namespace istpu {
 
@@ -132,20 +134,26 @@ class DiskTier {
     // capacity condition lasts.
     void breaker_probe_aborted();
 
-    bool bit(uint64_t idx) const {
+    bool bit(uint64_t idx) const REQUIRES(mu_) {
         return (bitmap_[idx >> 6] >> (idx & 63)) & 1;
     }
-    void set_range(uint64_t start, uint64_t count, bool value);
-    int64_t find_first_fit(uint64_t count) const;
+    void set_range(uint64_t start, uint64_t count, bool value)
+        REQUIRES(mu_);
+    int64_t find_first_fit(uint64_t count) const REQUIRES(mu_);
 
     int fd_ = -1;
     uint64_t capacity_ = 0;
     uint64_t block_size_ = 0;
     uint64_t total_blocks_ = 0;
     std::atomic<uint64_t> used_blocks_{0};
-    uint64_t search_hint_ = 0;       // guarded by mu_
-    std::mutex mu_;                  // guards bitmap_ + search_hint_
-    std::vector<uint64_t> bitmap_;
+    // Bitmap bookkeeping under mu_; the IO runs OUTSIDE it (reserve →
+    // pwrite outside → rollback on failure). mu_ is a LEAF in the lock
+    // order (lock_rank.h): taken under a stripe lock on the inline
+    // spill/promote paths and under the queue leaves when a DiskRef
+    // drops, never the other way.
+    Mutex mu_{kRankDiskBitmap};
+    uint64_t search_hint_ GUARDED_BY(mu_) = 0;
+    std::vector<uint64_t> bitmap_ GUARDED_BY(mu_);
 
     std::atomic<uint64_t> io_errors_{0};
     std::atomic<uint32_t> consec_write_errors_{0};

@@ -21,6 +21,11 @@ KVIndex::KVIndex(MM* mm, bool eviction, DiskTier* disk,
     // deployments that need the pre-segmentation semantics verbatim.
     const char* env = getenv("ISTPU_EXACT_LRU");
     exact_lru_ = env != nullptr && env[0] == '1';
+    // Per-index stripe ranks (single-threaded here): cross-stripe ops
+    // lock in index order = ascending rank for the runtime checker.
+    for (uint32_t i = 0; i < kStripes; ++i) {
+        stripes_[i].mu.set_rank(int(kRankStripeBase + i));
+    }
     if (disk_ != nullptr) {
         promoter_ = std::make_unique<Promoter>(this, mm_, disk_, tracer_);
     }
@@ -28,8 +33,11 @@ KVIndex::KVIndex(MM* mm, bool eviction, DiskTier* disk,
 
 KVIndex::~KVIndex() { stop_background(); }
 
-std::unique_lock<std::mutex> KVIndex::lock_stripe(Stripe& st) {
-    std::unique_lock<std::mutex> lk(st.mu, std::try_to_lock);
+// NO_THREAD_SAFETY_ANALYSIS inside: the try-then-block shape (the
+// uncontended path must not read a clock) confuses the analysis; the
+// ACQUIRE(st.mu) contract on the declaration is what call sites check.
+UniqueLock KVIndex::lock_stripe(Stripe& st) NO_THREAD_SAFETY_ANALYSIS {
+    UniqueLock lk(st.mu, std::try_to_lock);
     if (!lk.owns_lock()) {
         // Contended: time the wait. The uncontended path above reads
         // no clock and records nothing — the instrumentation's cost
@@ -170,7 +178,7 @@ void KVIndex::abort(uint64_t token, uint64_t owner) {
 size_t KVIndex::abort_all_for_owner(uint64_t owner) {
     size_t n = 0;
     for (Stripe& st : stripes_) {
-        std::lock_guard<std::mutex> lk(st.mu);
+        ScopedLock lk(st.mu);
         for (Inflight& s : st.islab) {
             if (!s.live || s.owner != owner) continue;
             auto mit = st.map.find(s.key);
@@ -208,7 +216,7 @@ Status KVIndex::acquire_block(const std::string& key, bool allow_promote,
     Entry& e = it->second;
     const bool nonresident = !e.block;
     if (nonresident && !allow_promote) return BUSY;  // budget spent
-    Status rc = ensure_resident(si, e, it->first);
+    Status rc = ensure_resident(st, si, e, it->first);
     if (rc != OK) return rc;
     if (promoted_out) *promoted_out = nonresident;
     *out = e.block;
@@ -241,7 +249,7 @@ Status KVIndex::acquire_read(const std::string& key, BlockRef* out,
         disk_reads_inline_.fetch_add(1, std::memory_order_relaxed);
         if (!e.promoting) {
             if (e.touched) {
-                maybe_enqueue_promote(e, it->first, si);
+                maybe_enqueue_promote(st, e, it->first, si);
             } else {
                 e.touched = true;
             }
@@ -279,7 +287,7 @@ Status KVIndex::acquire_resident(const std::string& key, BlockRef* out,
             // Clear the stale flag and promote inline below — the
             // degraded mode the workers_dead gauge announces.
             e.promoting = false;
-        } else if (maybe_enqueue_promote(e, it->first, si)) {
+        } else if (maybe_enqueue_promote(st, e, it->first, si)) {
             return BUSY;
         }
         if (!e.promoting && worker_live) {
@@ -297,7 +305,7 @@ Status KVIndex::acquire_resident(const std::string& key, BlockRef* out,
         // No worker at all: inline promotion below keeps the
         // historical progress guarantee.
     }
-    Status rc = ensure_resident(si, e, it->first);
+    Status rc = ensure_resident(st, si, e, it->first);
     if (rc != OK) return rc;
     *out = e.block;
     if (size_out) *size_out = e.size;
@@ -325,7 +333,7 @@ void KVIndex::prefetch(const std::vector<std::string>& keys, uint8_t* out) {
                    promoter_->alive()) {
             out[i] = 2;  // already on its way
         } else if (e.disk != nullptr &&
-                   maybe_enqueue_promote(e, it->first, si)) {
+                   maybe_enqueue_promote(st, e, it->first, si)) {
             // Explicit future-use signal: bypass second-touch.
             out[i] = 2;
         } else {
@@ -334,8 +342,9 @@ void KVIndex::prefetch(const std::vector<std::string>& keys, uint8_t* out) {
     }
 }
 
-bool KVIndex::maybe_enqueue_promote(Entry& e, const std::string& key,
-                                    uint32_t si) {
+bool KVIndex::maybe_enqueue_promote(Stripe& st, Entry& e,
+                                    const std::string& key, uint32_t si) {
+    (void)st;  // the lock fact (REQUIRES(st.mu)) is the parameter's job
     // alive(): a dead worker's queue must not keep accepting items —
     // every DiskRef queued there would pin its extent forever.
     if (promoter_ == nullptr || !promoter_->running() ||
@@ -365,7 +374,7 @@ bool KVIndex::maybe_enqueue_promote(Entry& e, const std::string& key,
 
 bool KVIndex::finish_promote(PromoteItem& item, BlockRef block) {
     Stripe& st = stripes_[item.stripe];
-    std::lock_guard<std::mutex> lk(st.mu);
+    ScopedLock lk(st.mu);
     auto mit = st.map.find(item.key);
     if (mit == st.map.end()) return false;  // erased/purged: RAII frees
     Entry& e = mit->second;
@@ -395,7 +404,7 @@ bool KVIndex::finish_promote(PromoteItem& item, BlockRef block) {
 
 void KVIndex::cancel_promote_flag(const PromoteItem& item) {
     Stripe& st = stripes_[item.stripe];
-    std::lock_guard<std::mutex> lk(st.mu);
+    ScopedLock lk(st.mu);
     auto mit = st.map.find(item.key);
     if (mit == st.map.end()) return;
     Entry& e = mit->second;
@@ -404,7 +413,7 @@ void KVIndex::cancel_promote_flag(const PromoteItem& item) {
     }
 }
 
-Status KVIndex::ensure_resident(uint32_t stripe_idx, Entry& e,
+Status KVIndex::ensure_resident(Stripe& st, uint32_t stripe_idx, Entry& e,
                                 const std::string& key) {
     if (!e.block) {
         // PROMOTE span: the whole disk->pool promotion (pool alloc +
@@ -501,7 +510,7 @@ Status KVIndex::ensure_resident(uint32_t stripe_idx, Entry& e,
                             uint64_t(now_us() - tp0));
         }
     }
-    lru_touch(stripes_[stripe_idx], e, key);
+    lru_touch(st, e, key);
     return OK;
 }
 
@@ -512,7 +521,7 @@ bool KVIndex::check_exist(const std::string& key) {
 int KVIndex::match_last_index(const std::vector<std::string>& keys) const {
     // Cross-stripe read: take every stripe lock in index order so the
     // probe sequence sees one consistent cut of the store.
-    std::vector<std::unique_lock<std::mutex>> locks;
+    std::vector<UniqueLock> locks;
     locks.reserve(kStripes);
     for (const Stripe& st : stripes_) locks.emplace_back(st.mu);
     auto present = [this](const std::string& k) {
@@ -549,26 +558,26 @@ int KVIndex::match_last_index(const std::vector<std::string>& keys) const {
 void KVIndex::reserve(size_t extra) {
     size_t per = extra / kStripes + 1;
     for (Stripe& st : stripes_) {
-        std::lock_guard<std::mutex> lk(st.mu);
+        ScopedLock lk(st.mu);
         st.map.reserve(st.map.size() + per);
         st.islab.reserve(st.islab.size() + per);
     }
 }
 
 uint64_t KVIndex::pin(std::vector<BlockRef> blocks) {
-    std::lock_guard<std::mutex> lk(leases_mu_);
+    ScopedLock lk(leases_mu_);
     uint64_t id = next_lease_++;
     leases_[id] = std::move(blocks);
     return id;
 }
 
 bool KVIndex::release(uint64_t lease_id) {
-    std::lock_guard<std::mutex> lk(leases_mu_);
+    ScopedLock lk(leases_mu_);
     return leases_.erase(lease_id) > 0;
 }
 
 std::vector<KVIndex::SnapshotItem> KVIndex::snapshot_items() const {
-    std::vector<std::unique_lock<std::mutex>> locks;
+    std::vector<UniqueLock> locks;
     locks.reserve(kStripes);
     for (const Stripe& st : stripes_) locks.emplace_back(st.mu);
     std::vector<SnapshotItem> out;
@@ -591,7 +600,7 @@ std::vector<KVIndex::SnapshotItem> KVIndex::snapshot_items() const {
 Status KVIndex::insert_committed(const std::string& key, const uint8_t* data,
                                  uint32_t size) {
     Stripe& st = stripes_[stripe_of(key)];
-    std::lock_guard<std::mutex> lk(st.mu);
+    ScopedLock lk(st.mu);
     auto [mit, inserted] = st.map.try_emplace(key);
     if (!inserted) return CONFLICT;  // live data beats snapshot data
     PoolLoc loc;
@@ -629,7 +638,7 @@ size_t KVIndex::purge() {
     {
         // Cross-stripe write: all stripe locks in index order; each
         // stripe's LRU segment clears with its map.
-        std::vector<std::unique_lock<std::mutex>> locks;
+        std::vector<UniqueLock> locks;
         locks.reserve(kStripes);
         for (Stripe& st : stripes_) locks.emplace_back(st.mu);
         for (Stripe& st : stripes_) {
@@ -663,7 +672,7 @@ size_t KVIndex::reclaim_orphans(const std::vector<std::string>& keys) {
     for (uint32_t si = 0; si < kStripes; ++si) {
         if (per_stripe[si].empty()) continue;
         Stripe& st = stripes_[si];
-        std::lock_guard<std::mutex> lk(st.mu);
+        ScopedLock lk(st.mu);
         std::unordered_set<const Block*> live;
         live.reserve(st.inflight_live);
         for (const Inflight& s : st.islab) {
@@ -711,7 +720,7 @@ size_t KVIndex::erase(const std::vector<std::string>& keys) {
 size_t KVIndex::size() const {
     size_t n = 0;
     for (const Stripe& st : stripes_) {
-        std::lock_guard<std::mutex> lk(st.mu);
+        ScopedLock lk(st.mu);
         n += st.map.size();
     }
     return n;
@@ -720,14 +729,14 @@ size_t KVIndex::size() const {
 size_t KVIndex::inflight() const {
     size_t n = 0;
     for (const Stripe& st : stripes_) {
-        std::lock_guard<std::mutex> lk(st.mu);
+        ScopedLock lk(st.mu);
         n += st.inflight_live;
     }
     return n;
 }
 
 size_t KVIndex::leases() const {
-    std::lock_guard<std::mutex> lk(leases_mu_);
+    ScopedLock lk(leases_mu_);
     return leases_.size();
 }
 
@@ -763,9 +772,9 @@ void KVIndex::lru_drop(Stripe& st, Entry& e) {
 uint64_t KVIndex::oldest_eligible_age(uint32_t si, bool held,
                                       uint32_t disk_min_fail) {
     Stripe& st = stripes_[si];
-    std::unique_lock<std::mutex> slk;
+    UniqueLock slk;
     if (!held) {
-        slk = std::unique_lock<std::mutex>(st.mu, std::try_to_lock);
+        slk = UniqueLock(st.mu, std::try_to_lock);
         if (!slk.owns_lock()) return UINT64_MAX;  // busy: skip this pass
     }
     for (auto it = st.lru.rbegin(); it != st.lru.rend(); ++it) {
@@ -786,9 +795,9 @@ size_t KVIndex::evict_from_stripe(uint32_t si, bool held, size_t want,
                                   uint32_t* disk_min_fail, bool async_spill,
                                   size_t* victims) {
     Stripe& st = stripes_[si];
-    std::unique_lock<std::mutex> slk;
+    UniqueLock slk;
     if (!held) {
-        slk = std::unique_lock<std::mutex>(st.mu, std::try_to_lock);
+        slk = UniqueLock(st.mu, std::try_to_lock);
         if (!slk.owns_lock()) return 0;  // busy: skipped this pass
     }
     const size_t bs = mm_->block_size();
@@ -1043,11 +1052,11 @@ void KVIndex::stop_background() {
     // Lock-then-notify so a thread between its predicate check and its
     // wait cannot miss the wake.
     {
-        std::lock_guard<std::mutex> lk(reclaim_mu_);
+        ScopedLock lk(reclaim_mu_);
     }
     reclaim_cv_.notify_all();
     {
-        std::lock_guard<std::mutex> lk(spill_mu_);
+        ScopedLock lk(spill_mu_);
     }
     spill_cv_.notify_all();
     if (reclaim_thread_.joinable()) reclaim_thread_.join();
@@ -1057,7 +1066,7 @@ void KVIndex::stop_background() {
     // eviction pass).
     std::deque<SpillItem> dropped;
     {
-        std::lock_guard<std::mutex> lk(spill_mu_);
+        ScopedLock lk(spill_mu_);
         dropped.swap(spill_q_);
     }
     account_dropped_spills(dropped, /*cancelled=*/false);
@@ -1090,7 +1099,7 @@ void KVIndex::kick_reclaimer() {
     // path sets the flag once per reclaimer wake, not once per key.
     if (reclaim_kick_.exchange(true, std::memory_order_relaxed)) return;
     {
-        std::lock_guard<std::mutex> lk(reclaim_mu_);
+        ScopedLock lk(reclaim_mu_);
     }
     reclaim_cv_.notify_one();
 }
@@ -1101,7 +1110,7 @@ void KVIndex::reclaim_loop() {
     // Evict in bounded batches so stop() stays responsive and the
     // stripe try-locks are released between rounds.
     const size_t batch_bytes = 64 * mm_->block_size();
-    std::unique_lock<std::mutex> lk(reclaim_mu_);
+    UniqueLock lk(reclaim_mu_);
     while (!bg_stop_.load(std::memory_order_relaxed)) {
         reclaim_cv_.wait_for(lk, std::chrono::milliseconds(200), [this] {
             return bg_stop_.load(std::memory_order_relaxed) ||
@@ -1198,7 +1207,7 @@ void KVIndex::enqueue_spill(const std::string& key, const BlockRef& block,
     spill_inflight_bytes_.fetch_add((size_t(size) + bs - 1) / bs * bs,
                                     std::memory_order_relaxed);
     {
-        std::lock_guard<std::mutex> lk(spill_mu_);
+        ScopedLock lk(spill_mu_);
         spill_q_.push_back(SpillItem{key, block, size, si});
     }
     spill_cv_.notify_one();
@@ -1210,7 +1219,7 @@ void KVIndex::enqueue_spill(const std::string& key, const BlockRef& block,
     if (!spill_alive_.load(std::memory_order_relaxed)) {
         std::deque<SpillItem> orphans;
         {
-            std::lock_guard<std::mutex> lk(spill_mu_);
+            ScopedLock lk(spill_mu_);
             orphans.swap(spill_q_);
         }
         account_dropped_spills(orphans, /*cancelled=*/true);
@@ -1220,7 +1229,7 @@ void KVIndex::enqueue_spill(const std::string& key, const BlockRef& block,
 void KVIndex::spill_loop() {
     Tracer::bind_thread(spill_ring_);
     constexpr size_t kSpillBatch = 64;
-    std::unique_lock<std::mutex> lk(spill_mu_);
+    UniqueLock lk(spill_mu_);
     while (true) {
         spill_cv_.wait(lk, [this] {
             return bg_stop_.load(std::memory_order_relaxed) ||
@@ -1394,7 +1403,7 @@ void KVIndex::finish_spill(SpillItem& item, int64_t off) {
     }
     {
         Stripe& st = stripes_[item.stripe];
-        std::lock_guard<std::mutex> lk(st.mu);
+        ScopedLock lk(st.mu);
         auto mit = st.map.find(item.key);
         // Adopt the extent only if this is still the same entry (same
         // Block), still SPILLING (no read touched it since selection)
@@ -1472,7 +1481,7 @@ void KVIndex::cancel_queued_spills() {
     if (!spill_thread_.joinable()) return;
     std::deque<SpillItem> dropped;
     {
-        std::unique_lock<std::mutex> lk(spill_mu_);
+        UniqueLock lk(spill_mu_);
         dropped.swap(spill_q_);
         account_dropped_spills(dropped, /*cancelled=*/true);
         // Wait out the writer's in-flight batch — AT MOST one: under

@@ -68,7 +68,6 @@
 #pragma once
 
 #include <atomic>
-#include <condition_variable>
 #include <cstdint>
 #include <deque>
 #include <functional>
@@ -82,8 +81,10 @@
 
 #include "common.h"
 #include "disk_tier.h"
+#include "lock_rank.h"
 #include "mempool.h"
 #include "promote.h"  // Block/BlockRef, DiskSpan/DiskRef, Promoter
+#include "thread_annotations.h"
 #include "trace.h"
 
 namespace istpu {
@@ -287,8 +288,10 @@ class KVIndex {
     // Reference algorithm verbatim in behavior (infinistore.cpp:1092-1108):
     // binary search assuming presence is monotone over the key list
     // (vLLM prefix pages); does NOT check committed. Takes every stripe
-    // lock in index order for a consistent cut.
-    int match_last_index(const std::vector<std::string>& keys) const;
+    // lock in index order for a consistent cut — a vector-held lock set
+    // outside the static lattice (runtime rank checker covers it).
+    int match_last_index(const std::vector<std::string>& keys) const
+        NO_THREAD_SAFETY_ANALYSIS;
 
     // Pre-size the index + inflight slabs for `extra` upcoming
     // allocations (batched allocate/put ops insert thousands of keys in
@@ -312,8 +315,10 @@ class KVIndex {
         uint32_t size = 0;
     };
     // Collect handles to every committed entry (cheap: refs only; locks
-    // all stripes in index order, serialize afterwards without them).
-    std::vector<SnapshotItem> snapshot_items() const;
+    // all stripes in index order — a vector-held lock set outside the
+    // static lattice — serialize afterwards without them).
+    std::vector<SnapshotItem> snapshot_items() const
+        NO_THREAD_SAFETY_ANALYSIS;
 
     // Directly insert a COMMITTED entry (snapshot restore): pool
     // allocate + copy + visible immediately, no token round-trip.
@@ -333,7 +338,9 @@ class KVIndex {
     Status insert_leased(const std::string& key, const PoolLoc& loc,
                          uint32_t size);
 
-    size_t purge();  // drops all entries; inflight tokens survive harmlessly
+    // Drops all entries; inflight tokens survive harmlessly. All-stripe
+    // vector-held lock set (see match_last_index).
+    size_t purge() NO_THREAD_SAFETY_ANALYSIS;
     size_t erase(const std::vector<std::string>& keys);
     // Erase only ORPHANED entries among `keys`: uncommitted AND not backed
     // by any live inflight token (their writer's connection died between
@@ -437,14 +444,18 @@ class KVIndex {
     };
 
     struct Stripe {
-        mutable std::mutex mu;
-        std::unordered_map<std::string, Entry> map;
-        std::vector<Inflight> islab;
-        std::vector<uint32_t> ifree;
-        size_t inflight_live = 0;
+        // Rank stamped per index at construction (kRankStripeBase + s):
+        // cross-stripe ops lock in index order, which the lock-rank
+        // checker (lock_rank.h) verifies as ascending ranks; the
+        // reverse-order victim paths only ever TRY-lock.
+        mutable Mutex mu{kRankStripeBase};
+        std::unordered_map<std::string, Entry> map GUARDED_BY(mu);
+        std::vector<Inflight> islab GUARDED_BY(mu);
+        std::vector<uint32_t> ifree GUARDED_BY(mu);
+        size_t inflight_live GUARDED_BY(mu) = 0;
         // Segmented LRU (front = most recent), guarded by mu — recency
         // updates on the hot path lock nothing beyond the stripe.
-        std::list<LruNode> lru;
+        std::list<LruNode> lru GUARDED_BY(mu);
         // Age of lru.back() (UINT64_MAX when empty): the lock-free
         // victim-selection pre-filter. Written under mu, read anywhere.
         std::atomic<uint64_t> tail_age{UINT64_MAX};
@@ -458,13 +469,13 @@ class KVIndex {
     // record); only the contended path pays two clock reads and feeds
     // the always-on stripe-lock-wait histogram (+ a span when tracing
     // is on). Used on the data-plane hot sites.
-    std::unique_lock<std::mutex> lock_stripe(Stripe& st);
+    UniqueLock lock_stripe(Stripe& st) ACQUIRE(st.mu);
     // Decode a token; returns nullptr unless live with matching gen.
     // Caller must hold the token's stripe mutex (stripe_of_token).
     static uint32_t stripe_of_token(uint64_t token) {
         return uint32_t(token >> kSlotBits) & (kStripes - 1);
     }
-    Inflight* islot(Stripe& st, uint64_t token) {
+    Inflight* islot(Stripe& st, uint64_t token) REQUIRES(st.mu) {
         uint32_t idx = uint32_t(token) & ((1u << kSlotBits) - 1);
         uint32_t gen = uint32_t(token >> 32);
         if (idx >= st.islab.size()) return nullptr;
@@ -472,7 +483,7 @@ class KVIndex {
         if (!s.live || s.gen != gen) return nullptr;
         return &s;
     }
-    void ifree(Stripe& st, Inflight* s) {
+    void ifree(Stripe& st, Inflight* s) REQUIRES(st.mu) {
         s->live = false;
         s->block.reset();
         s->key.clear();
@@ -482,12 +493,15 @@ class KVIndex {
 
     // Both require the entry's stripe mutex held; touch the stripe's
     // own LRU list only (no further locks).
-    void lru_touch(Stripe& st, Entry& e, const std::string& key);
-    void lru_drop(Stripe& st, Entry& e);
-    // Promote a non-resident entry back into the pool. Requires the
-    // entry's stripe mutex held (stripe index passed for eviction).
-    Status ensure_resident(uint32_t stripe_idx, Entry& e,
-                           const std::string& key);
+    void lru_touch(Stripe& st, Entry& e, const std::string& key)
+        REQUIRES(st.mu);
+    void lru_drop(Stripe& st, Entry& e) REQUIRES(st.mu);
+    // Promote a non-resident entry back into the pool, under the
+    // entry's stripe mutex (`st` IS stripes_[stripe_idx]; both are
+    // passed so the lock fact stays statically provable while the
+    // eviction fallback keeps its held-stripe index).
+    Status ensure_resident(Stripe& st, uint32_t stripe_idx, Entry& e,
+                           const std::string& key) REQUIRES(st.mu);
     // Eviction/spill victim selection over the segmented LRU.
     // held_stripe >= 0 names a stripe mutex the CALLER already holds
     // (victims there are evicted directly); other stripes are
@@ -501,8 +515,15 @@ class KVIndex {
     // out (the prefetch_hit_rate ~0.87 decay; ROADMAP item 5
     // follow-on). Inline last-resort callers keep UINT64_MAX — they
     // need progress NOW over strict ordering.
+    // NO_THREAD_SAFETY_ANALYSIS (here and on the two helpers below):
+    // victim selection holds a DYNAMIC stripe set — the caller's
+    // already-held stripe plus try-locked others — which the static
+    // lattice cannot express; deadlock-freedom is by construction
+    // (try-locks only on the out-of-order path) and enforced at
+    // runtime by the lock-rank checker in the sanitizer builds.
     size_t evict_internal(size_t want, int held_stripe, bool async_spill,
-                          uint64_t age_cap = UINT64_MAX);
+                          uint64_t age_cap = UINT64_MAX)
+        NO_THREAD_SAFETY_ANALYSIS;
     // Drain victims from one stripe's cold end: entries whose age is
     // <= age_limit, up to want bytes / max_victims. Returns
     // block-rounded bytes freed (or queued). 0 with *progress=false
@@ -510,12 +531,13 @@ class KVIndex {
     size_t evict_from_stripe(uint32_t si, bool held, size_t want,
                              uint64_t age_limit, size_t max_victims,
                              uint32_t* disk_min_fail, bool async_spill,
-                             size_t* victims);
+                             size_t* victims) NO_THREAD_SAFETY_ANALYSIS;
     // Exact-mode helper: age of the stripe's oldest ELIGIBLE entry
     // (unpinned, resident, spillable/evictable), UINT64_MAX when none
     // or the stripe is try-lock busy.
     uint64_t oldest_eligible_age(uint32_t si, bool held,
-                                 uint32_t disk_min_fail);
+                                 uint32_t disk_min_fail)
+        NO_THREAD_SAFETY_ANALYSIS;
 
     // --- background reclaim pipeline ---------------------------------
     void kick_reclaimer();
@@ -535,7 +557,9 @@ class KVIndex {
     // enqueue_spill's exactly or the reclaimer's overshoot guard drifts.
     void account_dropped_spills(std::deque<SpillItem>& items,
                                 bool cancelled);
-    // Requires the victim's stripe mutex held (spill_mu_ is a leaf).
+    // Requires the victim's stripe mutex held — a dynamic fact the
+    // victim-scan callers cannot expose statically; spill_mu_ is a
+    // leaf ranked above every stripe (lock_rank.h).
     void enqueue_spill(const std::string& key, const BlockRef& block,
                        uint32_t size, uint32_t si);
     void process_spill_batch(std::vector<SpillItem>& batch);
@@ -550,11 +574,12 @@ class KVIndex {
 
     // --- async promotion pipeline (promote.{h,cc}) --------------------
     // Queue a disk-resident entry to the promotion worker if admission
-    // (pool headroom vs the high watermark) allows. Requires the
-    // entry's stripe mutex held; the promote queue mutex is a leaf.
+    // (pool headroom vs the high watermark) allows. `st` is the
+    // entry's stripe, held; the promote queue mutex is a leaf.
     // True iff queued (the PROMOTING flag is set).
-    bool maybe_enqueue_promote(Entry& e, const std::string& key,
-                               uint32_t si);
+    bool maybe_enqueue_promote(Stripe& st, Entry& e,
+                               const std::string& key, uint32_t si)
+        REQUIRES(st.mu);
     // Worker-side adoption: re-locks the item's stripe and adopts
     // `block` only if the entry is unchanged (same DiskSpan, still
     // committed and non-resident, still PROMOTING). Everything else —
@@ -600,9 +625,10 @@ class KVIndex {
     Stripe stripes_[kStripes];
     // Pin leases: own leaf mutex (never nested inside a stripe lock by
     // callers; the server gathers refs first, then pins).
-    mutable std::mutex leases_mu_;
-    std::unordered_map<uint64_t, std::vector<BlockRef>> leases_;
-    uint64_t next_lease_ = 1;  // guarded by leases_mu_
+    mutable Mutex leases_mu_{kRankPinLeases};
+    std::unordered_map<uint64_t, std::vector<BlockRef>> leases_
+        GUARDED_BY(leases_mu_);
+    uint64_t next_lease_ GUARDED_BY(leases_mu_) = 1;
 
     // Background reclaim pipeline state.
     std::atomic<bool> bg_running_{false};
@@ -619,8 +645,8 @@ class KVIndex {
     std::atomic<long long> spill_heartbeat_us_{0};
     double high_ = 0.0, low_ = 0.0;
     std::thread reclaim_thread_;
-    std::mutex reclaim_mu_;
-    std::condition_variable reclaim_cv_;
+    Mutex reclaim_mu_{kRankReclaim};
+    CondVar reclaim_cv_;
     std::atomic<bool> reclaim_kick_{false};
     // Promotion pressure (see maybe_enqueue_promote): a refused
     // promotion admission asks the reclaimer for a to-LOW pass even
@@ -630,12 +656,12 @@ class KVIndex {
     // stripe lock on enqueue; the writer takes spill_mu_ and stripe
     // locks strictly in sequence).
     std::thread spill_thread_;
-    std::mutex spill_mu_;
-    std::condition_variable spill_cv_;
-    std::deque<SpillItem> spill_q_;   // guarded by spill_mu_
-    bool spill_busy_ = false;         // guarded by spill_mu_
-    uint64_t spill_batch_gen_ = 0;    // guarded by spill_mu_; bumped per
-                                      // finished batch (cancel barrier)
+    Mutex spill_mu_{kRankSpillQueue};
+    CondVar spill_cv_;
+    std::deque<SpillItem> spill_q_ GUARDED_BY(spill_mu_);
+    bool spill_busy_ GUARDED_BY(spill_mu_) = false;
+    // Bumped per finished batch (cancel barrier).
+    uint64_t spill_batch_gen_ GUARDED_BY(spill_mu_) = 0;
     std::atomic<uint64_t> spill_queue_depth_{0};
     // Block-rounded bytes queued/being written: the reclaimer subtracts
     // these from its deficit so it does not over-select victims whose

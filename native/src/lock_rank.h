@@ -1,0 +1,319 @@
+// lock_rank.h — the native locking layer: an annotated Mutex wrapper
+// (compile-time thread-safety proofs, thread_annotations.h) plus a
+// runtime LOCK-RANK checker (debug/sanitizer builds only).
+//
+// Why a runtime checker when TSAN exists: TSAN's deadlock detector
+// keeps a 64-entry per-thread held-locks table and CHECK-fails on the
+// index's cross-stripe ops, which legitimately hold 16 ordered stripe
+// locks at once alongside CPython's own mutexes — so the suite runs
+// with detect_deadlocks=0 (run_test.sh) and had NO deadlock coverage
+// at all. This checker restores it, tuned to this codebase's actual
+// discipline: every mutex carries a RANK, and a thread may only
+// BLOCK-acquire a mutex whose rank is strictly greater than every
+// rank it already holds through a blocking acquisition. Stripe locks
+// rank by stripe index, so "stripes in index order" is the same rule;
+// try_lock acquisitions are exempt from the ordering assert (a try
+// can never contribute a blocking edge to a cycle) but are still
+// tracked, so re-locking a mutex the thread already holds is always
+// fatal. Violations abort with both ranks named — under the
+// ISTPU_TSAN=1 suite (which defines ISTPU_LOCK_RANK) that is a test
+// failure at the exact acquisition site.
+//
+// Cost contract: without ISTPU_LOCK_RANK (every release build) Mutex
+// is a zero-overhead inline shell over std::mutex — same size, same
+// codegen on the lock/unlock fast path — and the rank argument
+// evaporates. The checker is compiled ONLY into the sanitizer builds
+// (`make -C native tsan|asan`, which pass -DISTPU_LOCK_RANK).
+//
+// THE RANK TABLE (one row per mutex class; docs/design.md
+// "Correctness tooling" renders the same table). A blocking acquire
+// must move strictly DOWN this table (higher rank):
+//
+//   rank  mutex                          taken while holding
+//   ----  -----------------------------  -------------------------------
+//    10   Server::snap_mu_               (outermost; serializes snapshot)
+//    20   Server::store_mu_              snap_mu_
+//    30   Server::Worker::pending_mu     (acceptor handoff; nothing)
+//   100+s KVIndex stripe s (s < 16)      store_mu_ (control plane);
+//                                        lower-ranked stripes, in index
+//                                        order (cross-stripe ops)
+//   200   KVIndex::reclaim_mu_           a stripe (allocate's kick)
+//   210   KVIndex::spill_mu_             a stripe (enqueue_spill)
+//   220   Promoter::mu_                  a stripe (maybe_enqueue_promote)
+//   230   KVIndex::leases_mu_            store_mu_ (never a stripe: the
+//                                        server gathers refs first)
+//   290   MM::extend_mu_                 nothing ranked (extension holds
+//                                        it WHILE allocating from the
+//                                        appended pool's arenas, so it
+//                                        ranks below them)
+//   300+a MemoryPool arena a (a < 8)     a stripe (allocate/evict), any
+//                                        queue leaf (BlockRef release),
+//                                        leases_mu_ (pin drop),
+//                                        extend_mu_ (extension retry);
+//                                        lower arenas in order
+//                                        (alloc_spanning)
+//   320   DiskTier::mu_                  a stripe (inline spill/promote
+//                                        reserve), any queue leaf
+//                                        (DiskRef release)
+//   340   Tracer::tracks_mu_             (track creation, startup)
+//
+// Client-side mutexes (client.h) and the log/failpoint registry
+// mutexes stay plain std::mutex: they are terminal leaves that never
+// acquire a ranked mutex underneath, so they can neither create nor
+// mask an ordering violation in the store's lock graph.
+#pragma once
+
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <mutex>
+
+#include "thread_annotations.h"
+
+namespace istpu {
+
+enum LockRank : int {
+    kRankSnapshot = 10,      // Server::snap_mu_
+    kRankStoreLifetime = 20, // Server::store_mu_
+    kRankWorkerPending = 30, // Server::Worker::pending_mu
+    kRankStripeBase = 100,   // KVIndex stripe s -> kRankStripeBase + s
+    kRankReclaim = 200,      // KVIndex::reclaim_mu_
+    kRankSpillQueue = 210,   // KVIndex::spill_mu_
+    kRankPromoteQueue = 220, // Promoter::mu_
+    kRankPinLeases = 230,    // KVIndex::leases_mu_
+    kRankPoolExtend = 290,   // MM::extend_mu_ (held across arena locks)
+    kRankPoolArenaBase = 300,  // MemoryPool arena a -> base + a (a < 8)
+    kRankDiskBitmap = 320,   // DiskTier::mu_
+    kRankTraceTracks = 340,  // Tracer::tracks_mu_
+};
+
+#ifdef ISTPU_LOCK_RANK
+
+namespace lockrank {
+
+inline const char* rank_name(int r) {
+    if (r >= kRankStripeBase && r < kRankStripeBase + 16) return "kv-stripe";
+    if (r >= kRankPoolArenaBase && r < kRankPoolArenaBase + 8)
+        return "pool-arena";
+    switch (r) {
+        case kRankSnapshot: return "server-snapshot";
+        case kRankStoreLifetime: return "server-store-lifetime";
+        case kRankWorkerPending: return "worker-pending";
+        case kRankReclaim: return "reclaim-kick";
+        case kRankSpillQueue: return "spill-queue";
+        case kRankPromoteQueue: return "promote-queue";
+        case kRankPinLeases: return "pin-leases";
+        case kRankPoolExtend: return "pool-extend";
+        case kRankDiskBitmap: return "disk-bitmap";
+        case kRankTraceTracks: return "trace-tracks";
+        default: return "?";
+    }
+}
+
+struct Held {
+    const void* addr;
+    int rank;
+    bool blocking;  // false: acquired via try_lock (no ordering edge)
+};
+
+struct Stack {
+    // 16 stripes + 8 arenas + every leaf class fits comfortably.
+    static constexpr int kCap = 64;
+    Held v[kCap];
+    int n = 0;
+};
+
+inline Stack& tls() {
+    thread_local Stack s;
+    return s;
+}
+
+[[noreturn]] inline void die(const char* what, int want_rank,
+                             const Held* held) {
+    // Raw stderr on purpose: the logger takes its own mutex and this
+    // thread's lock state is exactly what is being reported.
+    if (held) {
+        std::fprintf(
+            stderr,
+            "istpu lock-rank violation: %s rank %d (%s) while holding "
+            "rank %d (%s, %s-acquired)\n",
+            what, want_rank, rank_name(want_rank), held->rank,
+            rank_name(held->rank), held->blocking ? "block" : "try");
+    } else {
+        std::fprintf(stderr, "istpu lock-rank violation: %s rank %d (%s)\n",
+                     what, want_rank, rank_name(want_rank));
+    }
+    std::fflush(stderr);
+    std::abort();
+}
+
+// Before a BLOCKING acquire: the new rank must exceed every
+// blocking-held rank (try-held locks contribute no blocking edge to a
+// cycle, so they are exempt from the ordering assert), and the mutex
+// itself must not already be held at all (std::mutex self-relock is
+// a guaranteed deadlock regardless of rank).
+inline void check_blocking_acquire(const void* addr, int rank) {
+    Stack& s = tls();
+    const Held* worst = nullptr;
+    for (int i = 0; i < s.n; i++) {
+        const Held& h = s.v[i];
+        if (h.addr == addr) die("relock of already-held mutex,", rank, &h);
+        if (h.blocking && (!worst || h.rank > worst->rank)) worst = &h;
+    }
+    if (worst && rank <= worst->rank)
+        die("blocking acquire of", rank, worst);
+}
+
+// A successful try_lock still may not re-take a held mutex.
+inline void check_try_acquire(const void* addr, int rank) {
+    Stack& s = tls();
+    for (int i = 0; i < s.n; i++)
+        if (s.v[i].addr == addr)
+            die("try-relock of already-held mutex,", rank, &s.v[i]);
+}
+
+inline void on_acquired(const void* addr, int rank, bool blocking) {
+    Stack& s = tls();
+    if (s.n >= Stack::kCap) die("held-lock stack overflow at", rank, nullptr);
+    s.v[s.n++] = Held{addr, rank, blocking};
+}
+
+inline void on_release(const void* addr, int rank) {
+    Stack& s = tls();
+    for (int i = s.n - 1; i >= 0; i--) {
+        if (s.v[i].addr == addr) {
+            // Releases need not be LIFO (UniqueLock, cv waits).
+            for (int j = i; j < s.n - 1; j++) s.v[j] = s.v[j + 1];
+            s.n--;
+            return;
+        }
+    }
+    die("release of untracked mutex,", rank, nullptr);
+}
+
+}  // namespace lockrank
+
+#endif  // ISTPU_LOCK_RANK
+
+// ---------------------------------------------------------------------------
+// Mutex: std::mutex + a rank + clang capability annotations. Satisfies
+// Lockable, so std::unique_lock<Mutex> and std::condition_variable_any
+// compose (the scoped holders below are what annotated code uses).
+// ---------------------------------------------------------------------------
+class CAPABILITY("mutex") Mutex {
+   public:
+    explicit Mutex(int rank) noexcept { set_rank(rank); }
+
+    Mutex(const Mutex&) = delete;
+    Mutex& operator=(const Mutex&) = delete;
+
+#ifdef ISTPU_LOCK_RANK
+    // Per-index ranks for mutex arrays (stripes, arenas) are stamped
+    // right after construction, before any concurrency exists.
+    void set_rank(int rank) noexcept { rank_ = rank; }
+
+    void lock() ACQUIRE() {
+        lockrank::check_blocking_acquire(this, rank_);
+        mu_.lock();
+        lockrank::on_acquired(this, rank_, /*blocking=*/true);
+    }
+    void unlock() RELEASE() {
+        lockrank::on_release(this, rank_);
+        mu_.unlock();
+    }
+    bool try_lock() TRY_ACQUIRE(true) {
+        lockrank::check_try_acquire(this, rank_);
+        if (!mu_.try_lock()) return false;
+        lockrank::on_acquired(this, rank_, /*blocking=*/false);
+        return true;
+    }
+
+   private:
+    std::mutex mu_;
+    int rank_;
+#else
+    void set_rank(int) noexcept {}
+    void lock() ACQUIRE() { mu_.lock(); }
+    void unlock() RELEASE() { mu_.unlock(); }
+    bool try_lock() TRY_ACQUIRE(true) { return mu_.try_lock(); }
+
+   private:
+    std::mutex mu_;
+#endif
+};
+
+// Scoped lock_guard equivalent the analysis understands.
+class SCOPED_CAPABILITY ScopedLock {
+   public:
+    explicit ScopedLock(Mutex& mu) ACQUIRE(mu) : mu_(mu) { mu_.lock(); }
+    ~ScopedLock() RELEASE() { mu_.unlock(); }
+    ScopedLock(const ScopedLock&) = delete;
+    ScopedLock& operator=(const ScopedLock&) = delete;
+
+   private:
+    Mutex& mu_;
+};
+
+// Movable unique_lock equivalent: cv waits, early unlock/relock, and
+// scoped-capability returns (KVIndex::lock_stripe). The analysis
+// tracks the common shapes (ctor-acquire, lock/unlock members,
+// destructor release); functions juggling VECTORS of these (the
+// cross-stripe ops) are beyond the static lattice and rely on the
+// runtime rank checker instead.
+class SCOPED_CAPABILITY UniqueLock {
+   public:
+    UniqueLock() noexcept = default;
+    explicit UniqueLock(Mutex& mu) ACQUIRE(mu) : mu_(&mu), owned_(true) {
+        mu.lock();
+    }
+    UniqueLock(Mutex& mu, std::try_to_lock_t) : mu_(&mu) {
+        owned_ = mu.try_lock();
+    }
+    UniqueLock(Mutex& mu, std::defer_lock_t) noexcept : mu_(&mu) {}
+
+    UniqueLock(UniqueLock&& o) noexcept : mu_(o.mu_), owned_(o.owned_) {
+        o.mu_ = nullptr;
+        o.owned_ = false;
+    }
+    UniqueLock& operator=(UniqueLock&& o) noexcept {
+        if (this != &o) {
+            if (owned_) mu_->unlock();
+            mu_ = o.mu_;
+            owned_ = o.owned_;
+            o.mu_ = nullptr;
+            o.owned_ = false;
+        }
+        return *this;
+    }
+    UniqueLock(const UniqueLock&) = delete;
+    UniqueLock& operator=(const UniqueLock&) = delete;
+
+    ~UniqueLock() RELEASE_GENERIC() {
+        if (owned_) mu_->unlock();
+    }
+
+    void lock() ACQUIRE() {
+        mu_->lock();
+        owned_ = true;
+    }
+    void unlock() RELEASE() {
+        mu_->unlock();
+        owned_ = false;
+    }
+    bool owns_lock() const noexcept { return owned_; }
+    explicit operator bool() const noexcept { return owned_; }
+    Mutex* mutex() const noexcept { return mu_; }
+
+   private:
+    Mutex* mu_ = nullptr;
+    bool owned_ = false;
+};
+
+// Condition variable for Mutex-guarded state. condition_variable_any
+// costs one extra internal mutex per wait versus the std::mutex
+// specialization — acceptable: every CondVar in the tree waits on a
+// BACKGROUND worker queue (reclaimer, spill writer, promoter), never
+// on the data plane.
+using CondVar = std::condition_variable_any;
+
+}  // namespace istpu

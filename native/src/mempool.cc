@@ -68,6 +68,9 @@ MemoryPool::MemoryPool(size_t pool_size, size_t block_size,
     size_t begin = 0;
     for (size_t i = 0; i < n_arenas && begin < total_blocks_; ++i) {
         auto a = std::make_unique<Arena>();
+        // Per-index rank: multi-arena lockers go in index order, which
+        // the lock-rank checker (lock_rank.h) sees as ascending ranks.
+        a->mu.set_rank(int(kRankPoolArenaBase + i));
         a->begin = begin;
         a->end = (i + 1 == n_arenas) ? total_blocks_
                                      : std::min(begin + per, total_blocks_);
@@ -202,7 +205,7 @@ size_t MemoryPool::preferred_arena() const {
 }
 
 void* MemoryPool::alloc_in_arena(Arena& a, size_t count) {
-    std::lock_guard<std::mutex> lk(a.mu);
+    ScopedLock lk(a.mu);
     size_t start = find_first_fit(count, a.begin, a.end, a.hint);
     if (start == SIZE_MAX) return nullptr;
     set_range(start, count, true);
@@ -216,7 +219,7 @@ void* MemoryPool::alloc_spanning(size_t count) {
     // Larger than any single arena: take every arena lock in index order
     // (the process-wide stripe-then-arena lock order; arenas among
     // themselves are always index-ordered) and scan the whole bitmap.
-    std::vector<std::unique_lock<std::mutex>> locks;
+    std::vector<UniqueLock> locks;
     locks.reserve(arenas_.size());
     for (auto& a : arenas_) locks.emplace_back(a->mu);
     size_t start = find_first_fit(count, 0, total_blocks_, 0);
@@ -263,7 +266,7 @@ bool MemoryPool::deallocate(void* ptr, size_t size) {
         return false;
     }
     // Lock every arena the range touches, in index order.
-    std::vector<std::unique_lock<std::mutex>> locks;
+    std::vector<UniqueLock> locks;
     for (auto& a : arenas_) {
         if (a->begin < start + count && start < a->end) {
             locks.emplace_back(a->mu);
@@ -325,7 +328,7 @@ bool MM::allocate(size_t size, PoolLoc* out) {
         // this request) regardless of the usage threshold. Serialized on
         // extend_mu_; a racing thread that extended first is discovered by
         // retrying the pools that appeared since our scan.
-        std::lock_guard<std::mutex> lk(extend_mu_);
+        ScopedLock lk(extend_mu_);
         for (uint32_t i = uint32_t(n); i < num_pools(); ++i) {
             void* p = pools_[i]->allocate(size);
             if (p != nullptr) {
@@ -383,7 +386,7 @@ void MM::maybe_extend() {
     if (!auto_extend_) return;
     size_t n = num_pools();
     if (pools_[n - 1]->usage() <= kExtendThreshold) return;
-    std::lock_guard<std::mutex> lk(extend_mu_);
+    ScopedLock lk(extend_mu_);
     // Recheck under the lock: another thread may have extended already.
     if (num_pools() != n) return;
     add_pool(extend_size_);

@@ -31,9 +31,11 @@
 #include <cstddef>
 #include <cstdint>
 #include <memory>
-#include <mutex>
 #include <string>
 #include <vector>
+
+#include "lock_rank.h"
+#include "thread_annotations.h"
 
 namespace istpu {
 
@@ -87,13 +89,23 @@ class MemoryPool {
 
    private:
     struct Arena {
-        std::mutex mu;
+        // Rank stamped per index at construction (kRankPoolArenaBase+i):
+        // alloc_spanning/deallocate take multiple arena locks in index
+        // order, which the lock-rank checker verifies as ascending ranks.
+        Mutex mu{kRankPoolArenaBase};
         size_t begin = 0;  // first block index (64-aligned)
         size_t end = 0;    // one past the last block index
-        size_t hint = 0;   // rolling start for first-fit scan (absolute)
+        // Rolling start for first-fit scan (absolute index).
+        size_t hint GUARDED_BY(mu) = 0;
     };
 
-    bool bit(size_t idx) const {
+    // bitmap_ (and these helpers over it) is PARTITIONED, not singly
+    // guarded: arena a's mutex guards words [a.begin, a.end) and the
+    // boundaries are 64-block aligned so arenas never share a word.
+    // That sharding is outside the static lattice (no one capability
+    // guards the vector); single-arena callers hold the covering lock
+    // (alloc_in_arena), multi-arena callers hold the full ordered set.
+    bool bit(size_t idx) const NO_THREAD_SAFETY_ANALYSIS {
         return bitmap_[idx >> 6] & (1ull << (idx & 63));
     }
     void set_range(size_t start, size_t count, bool value);
@@ -157,13 +169,18 @@ class MM {
     static constexpr size_t kMaxPools = 256;  // append-only capacity bound
 
    private:
-    bool add_pool(size_t size);  // extend_mu_ held by caller
+    bool add_pool(size_t size) REQUIRES(extend_mu_);
     size_t block_size_;
     std::string shm_prefix_;
     bool auto_extend_;
     size_t extend_size_;
-    std::mutex extend_mu_;
+    // Extension serializer. Ranked BELOW the arena locks: the extend
+    // path allocates from freshly appended pools (arena locks) while
+    // holding it; no path takes extend_mu_ with an arena lock held.
+    Mutex extend_mu_{kRankPoolExtend};
     std::atomic<size_t> num_pools_{0};
+    // Append-only; guarded by extend_mu_ for writers, readers iterate
+    // up to the acquire-loaded num_pools_ (slots are stable).
     std::vector<std::unique_ptr<MemoryPool>> pools_;
 };
 

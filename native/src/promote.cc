@@ -65,7 +65,7 @@ void Promoter::stop() {
     if (!running_.exchange(false)) return;
     stop_.store(true, std::memory_order_relaxed);
     {
-        std::lock_guard<std::mutex> lk(mu_);
+        ScopedLock lk(mu_);
     }
     cv_.notify_all();
     if (thread_.joinable()) thread_.join();
@@ -73,7 +73,7 @@ void Promoter::stop() {
     // promotable if the pipeline is ever restarted.
     std::deque<PromoteItem> dropped;
     {
-        std::lock_guard<std::mutex> lk(mu_);
+        ScopedLock lk(mu_);
         dropped.swap(q_);
     }
     for (PromoteItem& item : dropped) drop_item(item, true);
@@ -100,7 +100,7 @@ void Promoter::enqueue(PromoteItem item) {
     inflight_bytes_.fetch_add(
         (uint64_t(item.size) + bs - 1) / bs * bs, std::memory_order_relaxed);
     {
-        std::lock_guard<std::mutex> lk(mu_);
+        ScopedLock lk(mu_);
         q_.push_back(std::move(item));
     }
     cv_.notify_one();
@@ -114,7 +114,7 @@ void Promoter::enqueue(PromoteItem item) {
     if (!alive_.load(std::memory_order_relaxed)) {
         std::deque<PromoteItem> orphans;
         {
-            std::lock_guard<std::mutex> lk(mu_);
+            ScopedLock lk(mu_);
             orphans.swap(q_);
         }
         for (PromoteItem& it : orphans) drop_item(it, false);
@@ -136,7 +136,7 @@ void Promoter::cancel_queued() {
     std::deque<PromoteItem> dropped;
     uint64_t gen;
     {
-        std::unique_lock<std::mutex> lk(mu_);
+        UniqueLock lk(mu_);
         dropped.swap(q_);
         gen = batch_gen_;
     }
@@ -147,7 +147,7 @@ void Promoter::cancel_queued() {
         // Bounded barrier, same shape as the spill writer's: wait out
         // only the batch that was in flight at entry — items queued
         // after our clear belong to post-purge entries.
-        std::unique_lock<std::mutex> lk(mu_);
+        UniqueLock lk(mu_);
         cv_.wait(lk, [this, gen] {
             return !busy_ || batch_gen_ != gen;
         });
@@ -157,7 +157,7 @@ void Promoter::cancel_queued() {
 void Promoter::loop() {
     Tracer::bind_thread(ring_);
     std::deque<PromoteItem> orphans;  // drained on induced death
-    std::unique_lock<std::mutex> lk(mu_);
+    UniqueLock lk(mu_);
     while (true) {
         cv_.wait(lk, [this] {
             return stop_.load(std::memory_order_relaxed) || !q_.empty();

@@ -40,17 +40,17 @@
 #pragma once
 
 #include <atomic>
-#include <condition_variable>
 #include <cstdint>
 #include <deque>
 #include <memory>
-#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
 
 #include "disk_tier.h"
+#include "lock_rank.h"
 #include "mempool.h"
+#include "thread_annotations.h"
 #include "trace.h"
 
 namespace istpu {
@@ -189,11 +189,14 @@ class Promoter {
     std::atomic<bool> alive_{false};
     std::atomic<bool> died_{false};
     std::thread thread_;
-    std::mutex mu_;                 // guards q_, busy_, batch_gen_
-    std::condition_variable cv_;
-    std::deque<PromoteItem> q_;
-    bool busy_ = false;
-    uint64_t batch_gen_ = 0;
+    // Queue leaf in the lock order: taken AFTER a stripe lock on
+    // enqueue; the worker takes mu_ and stripe locks strictly in
+    // sequence, never nested (lock_rank.h).
+    Mutex mu_{kRankPromoteQueue};
+    CondVar cv_;
+    std::deque<PromoteItem> q_ GUARDED_BY(mu_);
+    bool busy_ GUARDED_BY(mu_) = false;
+    uint64_t batch_gen_ GUARDED_BY(mu_) = 0;
 
     std::atomic<uint64_t> queue_depth_{0};
     // Block-rounded bytes queued/being promoted: admission adds these

@@ -331,8 +331,8 @@ void Server::stop() {
         // tier (DiskSpan) and the pool (Block), so the index goes first.
         // workers_ clears under store_mu_ too — stats_json reads the
         // per-worker counters through it.
-        std::lock_guard<std::mutex> slk(snap_mu_);
-        std::lock_guard<std::mutex> lk(store_mu_);
+        ScopedLock slk(snap_mu_);
+        ScopedLock lk(store_mu_);
         workers_.clear();
         // Join the reclaimer/spill threads (they reference mm_/disk_)
         // before any of those die.
@@ -354,12 +354,12 @@ void Server::stop() {
 }
 
 size_t Server::kvmap_len() {
-    std::lock_guard<std::mutex> lk(store_mu_);
+    ScopedLock lk(store_mu_);
     return index_ ? index_->size() : 0;
 }
 
 size_t Server::purge() {
-    std::lock_guard<std::mutex> lk(store_mu_);
+    ScopedLock lk(store_mu_);
     return index_ ? index_->purge() : 0;
 }
 
@@ -376,14 +376,14 @@ long long Server::snapshot(const std::string& path) {
     // teardown while the collected refs below are alive (their
     // destructors deallocate into mm_, which must still exist; the
     // deallocation itself is thread-safe against the data plane).
-    std::lock_guard<std::mutex> snap_lk(snap_mu_);
+    ScopedLock snap_lk(snap_mu_);
     std::vector<KVIndex::SnapshotItem> items;
     {
         // store_mu_ only pins the index_ pointer against stop();
         // snapshot_items() takes the stripe locks itself and returns
         // refs, so serialization below runs without stalling the
         // data plane.
-        std::lock_guard<std::mutex> lk(store_mu_);
+        ScopedLock lk(store_mu_);
         if (!index_) return -1;
         items = index_->snapshot_items();
     }
@@ -471,7 +471,7 @@ long long Server::restore(const std::string& path) {
         std::string key;
         std::vector<uint8_t> data;
         {
-            std::lock_guard<std::mutex> lk(store_mu_);
+            ScopedLock lk(store_mu_);
             if (index_) index_->reserve(size_t(count));
         }
         for (uint64_t i = 0; i < count; ++i) {
@@ -507,7 +507,7 @@ long long Server::restore(const std::string& path) {
             }
             Status st;
             {
-                std::lock_guard<std::mutex> lk(store_mu_);
+                ScopedLock lk(store_mu_);
                 if (!index_) break;
                 st = index_->insert_committed(key, data.data(), size);
             }
@@ -526,7 +526,7 @@ long long Server::restore(const std::string& path) {
 }
 
 std::string Server::stats_json() {
-    std::lock_guard<std::mutex> lk(store_mu_);
+    ScopedLock lk(store_mu_);
     char head[4096];
     snprintf(
         head, sizeof(head),
@@ -668,7 +668,7 @@ std::string Server::trace_json() {
     // The tracer outlives stop() (member teardown order), so the drain
     // is safe against shutdown; store_mu_ only orders it with the
     // final destructor.
-    std::lock_guard<std::mutex> lk(store_mu_);
+    ScopedLock lk(store_mu_);
     if (!tracer_) return "{\"traceEvents\": []}";
     return tracer_->to_chrome_json();
 }
@@ -720,7 +720,7 @@ void Server::loop(Worker& w) {
 void Server::adopt_pending(Worker& w) {
     std::vector<std::unique_ptr<Conn>> adopted;
     {
-        std::lock_guard<std::mutex> lk(w.pending_mu);
+        ScopedLock lk(w.pending_mu);
         adopted.swap(w.pending);
     }
     for (auto& c : adopted) {
@@ -782,7 +782,7 @@ void Server::accept_ready(Worker& w, int ready_fd) {
         } else {
             c->handoff_t0 = now_us();
             {
-                std::lock_guard<std::mutex> lk(target->pending_mu);
+                ScopedLock lk(target->pending_mu);
                 target->pending.push_back(std::move(c));
             }
             uint64_t one = 1;

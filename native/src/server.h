@@ -55,8 +55,10 @@
 
 #include "common.h"
 #include "kv_index.h"
+#include "lock_rank.h"
 #include "mempool.h"
 #include "protocol.h"
+#include "thread_annotations.h"
 #include "trace.h"
 
 namespace istpu {
@@ -257,8 +259,9 @@ class Server {
         int listen_fd = -1;
         std::thread thread;
         std::unordered_map<int, std::unique_ptr<Conn>> conns;  // loop only
-        std::mutex pending_mu;
-        std::vector<std::unique_ptr<Conn>> pending;  // acceptor → worker
+        Mutex pending_mu{kRankWorkerPending};
+        // Acceptor → worker handoff queue.
+        std::vector<std::unique_ptr<Conn>> pending GUARDED_BY(pending_mu);
         std::atomic<uint32_t> nconns{0};  // load metric for assignment
         // Per-worker traffic counters (stats_json "per_worker"): makes
         // load imbalance — one hot connection pinning one worker —
@@ -324,12 +327,13 @@ class Server {
     // stop(); the data-plane workers never take it — they are joined
     // before teardown, and all shared-store mutation is synchronized
     // inside KVIndex (stripe locks) and MM (arena locks).
-    std::mutex store_mu_;
+    Mutex store_mu_{kRankStoreLifetime};
     // Serializes snapshot() calls against each other (two writers would
     // corrupt the tmp file) and against stop() (a snapshot in flight
     // holds BlockRefs whose destructors call into mm_; teardown must
-    // wait). Taken BEFORE store_mu_ everywhere.
-    std::mutex snap_mu_;
+    // wait). Taken BEFORE store_mu_ everywhere — rank 10 vs 20
+    // (lock_rank.h), which the runtime checker enforces.
+    Mutex snap_mu_{kRankSnapshot};
     std::unique_ptr<MM> mm_;
     std::unique_ptr<DiskTier> disk_;
     std::unique_ptr<KVIndex> index_;

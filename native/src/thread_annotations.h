@@ -1,0 +1,70 @@
+// thread_annotations.h — Clang thread-safety analysis macros.
+//
+// Compile-time proofs for the locking invariants PRs 2-6 established by
+// convention: stripe mutexes guard their stripe's map/LRU/inflight slab
+// (kv_index.h), arena mutexes guard their bitmap range (mempool.h), the
+// DiskTier bitmap mutex guards bitmap_/search_hint_ with the IO outside
+// it (disk_tier.h), and the background queues are leaves under their own
+// mutexes (promote.h, kv_index.h). `make -C native analyze` compiles the
+// tree with `clang++ -Wthread-safety -Werror`, turning those conventions
+// into build failures; under GCC (the normal build) every macro expands
+// to nothing, so the release artifact is unchanged.
+//
+// The macro set mirrors the canonical Clang/abseil layer
+// (clang.llvm.org/docs/ThreadSafetyAnalysis.html). Only the subset the
+// codebase uses is defined; add alongside when new idioms appear.
+#pragma once
+
+#if defined(__clang__) && defined(__has_attribute)
+#if __has_attribute(capability)
+#define ISTPU_TSA(x) __attribute__((x))
+#endif
+#endif
+#ifndef ISTPU_TSA
+#define ISTPU_TSA(x)  // no-op: GCC / old clang
+#endif
+
+// A type that models a lock (mutexes, and scoped RAII holders).
+#define CAPABILITY(x) ISTPU_TSA(capability(x))
+#define SCOPED_CAPABILITY ISTPU_TSA(scoped_lockable)
+
+// Data members: which lock protects them.
+#define GUARDED_BY(x) ISTPU_TSA(guarded_by(x))
+#define PT_GUARDED_BY(x) ISTPU_TSA(pt_guarded_by(x))
+
+// Lock ordering documentation (checked when both ends are annotated).
+#define ACQUIRED_BEFORE(...) ISTPU_TSA(acquired_before(__VA_ARGS__))
+#define ACQUIRED_AFTER(...) ISTPU_TSA(acquired_after(__VA_ARGS__))
+
+// Function contracts: the caller must hold / must not hold these locks.
+#define REQUIRES(...) ISTPU_TSA(requires_capability(__VA_ARGS__))
+#define REQUIRES_SHARED(...) ISTPU_TSA(requires_shared_capability(__VA_ARGS__))
+#define EXCLUDES(...) ISTPU_TSA(locks_excluded(__VA_ARGS__))
+
+// Lock/unlock primitives (on Mutex and on scoped holders).
+#define ACQUIRE(...) ISTPU_TSA(acquire_capability(__VA_ARGS__))
+#define ACQUIRE_SHARED(...) ISTPU_TSA(acquire_shared_capability(__VA_ARGS__))
+#define RELEASE(...) ISTPU_TSA(release_capability(__VA_ARGS__))
+#define RELEASE_SHARED(...) ISTPU_TSA(release_shared_capability(__VA_ARGS__))
+#define RELEASE_GENERIC(...) ISTPU_TSA(release_generic_capability(__VA_ARGS__))
+#define TRY_ACQUIRE(...) ISTPU_TSA(try_acquire_capability(__VA_ARGS__))
+#define TRY_ACQUIRE_SHARED(...) \
+    ISTPU_TSA(try_acquire_shared_capability(__VA_ARGS__))
+
+// Runtime-checked assertion that a lock is held (fact injection for
+// paths the static analysis cannot follow — e.g. a lock held through a
+// vector of scoped holders).
+#define ASSERT_CAPABILITY(x) ISTPU_TSA(assert_capability(x))
+#define ASSERT_SHARED_CAPABILITY(x) ISTPU_TSA(assert_shared_capability(x))
+
+#define RETURN_CAPABILITY(x) ISTPU_TSA(lock_returned(x))
+
+// Escape hatch. Policy (docs/design.md "Correctness tooling"): FORBIDDEN
+// on the single-stripe data-plane paths (allocate / write_dest / commit /
+// acquire_read / acquire_resident / pin / release and everything they
+// call); permitted, each use with a justifying comment, only where the
+// lock set is dynamic — cross-stripe ops holding a vector of ordered
+// stripe locks, and try-lock victim scans — which the static lattice
+// cannot express and the runtime lock-rank checker (lock_rank.h) covers
+// instead.
+#define NO_THREAD_SAFETY_ANALYSIS ISTPU_TSA(no_thread_safety_analysis)

@@ -81,7 +81,7 @@ void TraceRing::drain(std::vector<Span>& out) const {
 }
 
 TraceRing* Tracer::add_track(const std::string& name) {
-    std::lock_guard<std::mutex> lk(tracks_mu_);
+    ScopedLock lk(tracks_mu_);
     tracks_.push_back(std::make_unique<TraceRing>(name));
     return tracks_.back().get();
 }
@@ -118,7 +118,7 @@ std::vector<TraceRing*> Tracer::snapshot_tracks() const {
     // lets the expensive consumers (multi-MB /trace serialization)
     // run WITHOUT tracks_mu_, so a concurrent stats_json on a worker
     // thread (spans_recorded) never blocks behind a drain.
-    std::lock_guard<std::mutex> lk(tracks_mu_);
+    ScopedLock lk(tracks_mu_);
     std::vector<TraceRing*> out;
     out.reserve(tracks_.size());
     for (const auto& t : tracks_) out.push_back(t.get());

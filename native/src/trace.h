@@ -32,9 +32,11 @@
 #include <atomic>
 #include <cstdint>
 #include <memory>
-#include <mutex>
 #include <string>
 #include <vector>
+
+#include "lock_rank.h"
+#include "thread_annotations.h"
 
 namespace istpu {
 
@@ -216,8 +218,12 @@ class Tracer {
     std::vector<TraceRing*> snapshot_tracks() const;
 
     bool enabled_;
-    mutable std::mutex tracks_mu_;  // guards tracks_ growth (startup)
-    std::vector<std::unique_ptr<TraceRing>> tracks_;
+    // Guards tracks_ growth (startup only). A leaf: nothing ranked is
+    // ever acquired under it; the span writers never take it at all
+    // (thread-local ring pointers, the trace ring writer contract is
+    // lock-free seqlock publication — see TraceRing::record above).
+    mutable Mutex tracks_mu_{kRankTraceTracks};
+    std::vector<std::unique_ptr<TraceRing>> tracks_ GUARDED_BY(tracks_mu_);
     std::atomic<uint64_t> dropped_{0};
     LatHist lock_wait_hist_;
     LatHist queue_wait_hist_;

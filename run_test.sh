@@ -14,8 +14,22 @@
 # runtime must initialize before dlopen). Pass extra pytest args/paths
 # to widen the sanitized selection; native/run_sanitizers.sh remains
 # the full TSAN+ASAN sweep.
+#
+# ISTPU_ASAN=1 is the AddressSanitizer mirror of the TSAN mode: the
+# same smoke suite against the ASAN+UBSAN combined build
+# (-fsanitize=address,undefined, `make -C native asan`). Both
+# sanitizer builds also compile the runtime LOCK-RANK checker in
+# (-DISTPU_LOCK_RANK, native/src/lock_rank.h): a lock-order violation
+# aborts at the acquisition site — the deadlock coverage TSAN's own
+# detector cannot provide here (detect_deadlocks=0 below).
 set -e
 cd "$(dirname "$0")"
+
+# Cross-surface invariant lint (tools/check_invariants.py): enum/ABI/
+# failpoint/metric/doc drift fails fast, before any build. The same
+# check runs inside tier-1 (tests/test_static_analysis.py); here it
+# guards every mode, sanitizer legs included.
+python tools/check_invariants.py
 
 # ISTPU_CHAOS=1: the fault-injection leg — build normally and run the
 # chaos suite alone (tests/test_chaos.py arms the failpoint subsystem
@@ -28,6 +42,35 @@ if [ "${ISTPU_CHAOS:-0}" = "1" ] && [ "${ISTPU_TSAN:-0}" != "1" ]; then
     make -C native
     exec env JAX_PLATFORMS=cpu \
         python -m pytest tests/test_chaos.py -q "$@"
+fi
+
+if [ "${ISTPU_ASAN:-0}" = "1" ] && [ "${ISTPU_TSAN:-0}" != "1" ]; then
+    make -C native asan
+    ASAN_RT="$(gcc -print-file-name=libasan.so)"
+    for cand in "$ASAN_RT" \
+        "$(gcc -print-file-name=libasan.so.8)" \
+        "$(gcc -print-file-name=libasan.so.6)" \
+        /lib/x86_64-linux-gnu/libasan.so.8 \
+        /lib/x86_64-linux-gnu/libasan.so.6; do
+        if [ -f "$cand" ]; then
+            ASAN_RT="$cand"
+            break
+        fi
+    done
+    [ -f "$ASAN_RT" ] || { echo "libasan runtime not found" >&2; exit 1; }
+    # Same smoke selection as the TSAN leg: the densest native
+    # interleavings, now checked for heap/stack/UB instead of races.
+    # libubsan is linked into the .so itself (DT_NEEDED), so only the
+    # ASAN runtime needs preloading. detect_leaks=0: CPython
+    # intentionally leaks interned objects at exit.
+    SMOKE="${ISTPU_ASAN_TESTS:-tests/test_concurrency.py tests/test_trace.py tests/test_prefetch.py tests/test_chaos.py}"
+    exec env \
+        LD_PRELOAD="$ASAN_RT" \
+        ASAN_OPTIONS="detect_leaks=0 abort_on_error=1" \
+        UBSAN_OPTIONS="print_stacktrace=1 halt_on_error=1" \
+        INFINISTORE_TPU_NATIVE_LIB="$PWD/native/build/libinfinistore_tpu_asan.so" \
+        JAX_PLATFORMS=cpu \
+        python -m pytest $SMOKE -q "$@"
 fi
 
 if [ "${ISTPU_TSAN:-0}" = "1" ]; then

@@ -1,0 +1,233 @@
+"""Tier-1 coverage for the static-analysis layer (ISSUE 7).
+
+Runs the cross-surface invariant linter (tools/check_invariants.py)
+against the real tree — so any enum/ABI/failpoint/metric/doc drift
+fails the ordinary pytest suite, not just run_test.sh — and proves the
+linter actually BITES: each seeded mutation below (remove an op from
+one side, rename a metric, grow the ABI surface without updating the
+golden, add an undocumented failpoint, break a status mirror, strip a
+tsan.supp citation) must flip its exit code to non-zero with the
+matching violation named.
+
+The mutation tests copy the parsed surfaces into a tmp tree and run the
+linter with --root there; the real tree is never touched.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINTER = os.path.join(REPO, "tools", "check_invariants.py")
+
+# Everything the linter parses, relative to the root it is given.
+SURFACE_FILES = [
+    "native/tsan.supp",
+    "infinistore_tpu/_native.py",
+    "infinistore_tpu/server.py",
+    "docs/api.md",
+    "docs/design.md",
+    "tools/abi_surface.json",
+]
+
+
+def run_linter(root=None):
+    cmd = [sys.executable, LINTER]
+    if root:
+        cmd += ["--root", root]
+    return subprocess.run(cmd, capture_output=True, text=True)
+
+
+@pytest.fixture()
+def tree(tmp_path):
+    """A minimal copy of every linted surface, safe to mutate."""
+    root = tmp_path / "tree"
+    src = root / "native" / "src"
+    src.mkdir(parents=True)
+    for fn in os.listdir(os.path.join(REPO, "native", "src")):
+        if fn.endswith((".cc", ".h")):
+            shutil.copy(os.path.join(REPO, "native", "src", fn), src / fn)
+    for rel in SURFACE_FILES:
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), dst)
+    return root
+
+
+def mutate(root, rel, old, new, count=1):
+    p = os.path.join(root, rel)
+    with open(p, encoding="utf-8") as f:
+        text = f.read()
+    assert old in text, f"mutation anchor {old!r} missing from {rel}"
+    with open(p, "w", encoding="utf-8") as f:
+        f.write(text.replace(old, new, count))
+
+
+def test_linter_clean_on_tree():
+    r = run_linter()
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "check_invariants: OK" in r.stdout
+
+
+def test_linter_clean_on_copied_tree(tree):
+    # The fixture copy itself must lint clean, or every mutation test
+    # below would be asserting against pre-existing noise.
+    r = run_linter(str(tree))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_removed_op_fails(tree):
+    # Remove OP_PREFETCH from common.h only: the wire surface no longer
+    # matches the pinned golden.
+    mutate(tree, "native/src/common.h", "    OP_PREFETCH = 20,", "")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "'ops' drifted" in r.stderr
+
+
+def test_renamed_metric_fails(tree):
+    # Rename a stats key in the native emitter only: the Prometheus
+    # renderer still reads the old name.
+    mutate(tree, "native/src/server.cc", '\\"hard_stalls\\":',
+           '\\"hard_stallz\\":')
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "metrics:" in r.stderr and "hard_stalls" in r.stderr
+
+
+def test_new_export_without_abi_bump_fails(tree):
+    # Grow the C ABI on both language sides but skip the golden update
+    # and the ist_abi_version() bump — exactly the "silent surface
+    # growth" the golden exists to catch.
+    mutate(tree, "native/src/capi.cc", 'extern "C" {',
+           'extern "C" {\nuint32_t ist_totally_new(void* h) {\n'
+           '    (void)h;\n    return 0;\n}\n')
+    mutate(tree, "infinistore_tpu/_native.py",
+           '("ist_abi_version", c.c_uint32, []),',
+           '("ist_abi_version", c.c_uint32, []),\n'
+           '        ("ist_totally_new", c.c_uint32, [c.c_void_p]),')
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "'exports' drifted" in r.stderr
+    assert "bump ist_abi_version" in r.stderr
+
+
+def test_undeclared_export_fails(tree):
+    # Export with no ctypes declaration: dead (or worse, untested) ABI.
+    mutate(tree, "native/src/capi.cc", 'extern "C" {',
+           'extern "C" {\nuint32_t ist_totally_new(void* h) {\n'
+           '    (void)h;\n    return 0;\n}\n')
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "no ctypes declaration" in r.stderr
+
+
+def test_status_value_mismatch_fails(tree):
+    mutate(tree, "infinistore_tpu/_native.py", "BUSY = 429", "BUSY = 430")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "status-mirror" in r.stderr and "BUSY" in r.stderr
+
+
+def test_undocumented_failpoint_fails(tree):
+    # Compile in a new inject point without cataloging/documenting it.
+    mutate(tree, "native/src/disk_tier.cc",
+           'IST_FAILPOINT("disk.reserve")',
+           '(IST_FAILPOINT("disk.fsync"), IST_FAILPOINT("disk.reserve"))',
+           count=1)
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "disk.fsync" in r.stderr
+    assert "catalog" in r.stderr or "undocumented" in r.stderr
+
+
+def test_uncited_suppression_fails(tree):
+    # Every tsan.supp entry must carry a live `# cite: file:line`.
+    mutate(tree, "native/tsan.supp",
+           "# cite: native/src/client.cc:1560 "
+           "(handle_readable: rpc-response fill)\n", "")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "tsan-supp" in r.stderr and "cite" in r.stderr
+
+
+def test_appended_uncited_suppression_fails(tree):
+    # Cites must not leak across block boundaries: a new family
+    # appended after a blank line + its own (cite-less) header comment
+    # must fail even though earlier blocks are fully cited.
+    p = os.path.join(tree, "native/tsan.supp")
+    with open(p, "a", encoding="utf-8") as f:
+        f.write("\n# a new FP family, not yet anchored\n"
+                "mutex:istpu::Server::stop\n")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "tsan-supp" in r.stderr and "cite" in r.stderr
+
+
+def test_removed_op_doc_row_fails(tree):
+    # OP_COMMIT's doc row must be required even though OP_COMMIT_BATCH
+    # (a superstring) stays documented — word-boundary, not substring.
+    mutate(tree, "docs/api.md", "| `OP_COMMIT` | 5 |", "| (redacted) | 5 |")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "OP_COMMIT" in r.stderr and "wire table" in r.stderr
+
+
+def test_unreachable_suppression_fails(tree):
+    # A suppression whose symbol vanished from native/src must be pruned.
+    mutate(tree, "native/tsan.supp",
+           "race:istpu::Connection::handle_readable",
+           "race:istpu::Connection::handle_readable_gone")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "prune" in r.stderr
+
+
+def test_undocumented_endpoint_fails(tree):
+    # A control-plane endpoint the docs do not mention.
+    mutate(tree, "infinistore_tpu/server.py",
+           'self.path == "/kvmap_len"',
+           'self.path == "/kvmap_len_v2"')
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "/kvmap_len_v2" in r.stderr
+
+
+def test_make_analyze_exits_zero():
+    # With clang installed this is the -Wthread-safety -Werror proof
+    # pass; without it the target reports the skip and still exits 0 —
+    # either way `make analyze` must never break a checkout.
+    r = subprocess.run(
+        ["make", "-C", os.path.join(REPO, "native"), "analyze"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_annotation_macros_are_noops_under_gcc():
+    # The annotation layer must vanish under non-clang compilers: the
+    # release .so is built by g++ and must not change shape. Pin the
+    # guard so a future edit cannot accidentally make the macros
+    # unconditional.
+    path = os.path.join(REPO, "native", "src", "thread_annotations.h")
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    assert "__clang__" in text
+    assert "#define ISTPU_TSA(x)  // no-op" in text
+
+
+def test_lock_rank_gated_to_sanitizer_builds():
+    # The runtime checker must stay out of release builds (hot path is
+    # contractually byte-identical): the Makefile compiles it only via
+    # SAN_FLAGS, and lock_rank.h compiles to the thin shell without it.
+    mk = open(os.path.join(REPO, "native", "Makefile"),
+              encoding="utf-8").read()
+    assert "-DISTPU_LOCK_RANK" in mk
+    assert "-DISTPU_LOCK_RANK" in [
+        line for line in mk.splitlines() if "SAN_FLAGS" in line and
+        ":=" in line][0]
+    cxxflags = [line for line in mk.splitlines()
+                if line.startswith("CXXFLAGS")][0]
+    assert "ISTPU_LOCK_RANK" not in cxxflags

@@ -1,0 +1,446 @@
+#!/usr/bin/env python3
+"""Cross-surface invariant linter for infinistore-tpu.
+
+The native core, the ctypes binding layer, the docs and the CI suppression
+files each carry a hand-mirrored copy of the same facts: the Op/Status
+enums and wire constants (native/src/common.h), the exported C ABI
+(native/src/capi.cc vs infinistore_tpu/_native.py), the failpoint catalog
+(IST_FAILPOINT call sites vs failpoint.h vs docs/design.md), the
+stats/metrics key families (native/src/server.cc stats_json vs the
+Prometheus renderer in infinistore_tpu/server.py), the HTTP control-plane
+endpoints (server.py vs docs/api.md), and the TSAN suppression citations
+(native/tsan.supp). Nothing used to fail the build when one side moved.
+
+This linter parses every surface and cross-checks them, plus a checked-in
+golden (tools/abi_surface.json) that pins the wire-visible ABI: any
+one-sided drift — a new op, a renamed metric, an undocumented failpoint,
+an export missing a ctypes declaration, an ABI surface change without a
+golden update + version bump — exits non-zero with the exact violations.
+
+Run from anywhere:  python tools/check_invariants.py [--root DIR]
+Wired into run_test.sh, tests/test_static_analysis.py (tier-1) and the
+CI `analyze` job. `--write-golden` regenerates tools/abi_surface.json
+after an INTENTIONAL surface change (bump ist_abi_version() and the
+_native.py floor in the same commit — the linter checks they agree).
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+# --------------------------------------------------------------------------
+# parsers
+# --------------------------------------------------------------------------
+
+
+def _read(root, rel):
+    path = os.path.join(root, rel)
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def parse_common_h(root):
+    """Op/Status enums + wire constants from native/src/common.h."""
+    text = _read(root, "native/src/common.h")
+    out = {"ops": {}, "statuses": {}}
+
+    def enum_body(name):
+        m = re.search(r"enum\s+%s\b[^{]*\{(.*?)\};" % name, text, re.S)
+        if not m:
+            raise ValueError(f"common.h: enum {name} not found")
+        return m.group(1)
+
+    for m in re.finditer(r"^\s*(OP_[A-Z_]+)\s*=\s*(\d+)", enum_body("Op"),
+                         re.M):
+        out["ops"][m.group(1)] = int(m.group(2))
+    for m in re.finditer(r"^\s*([A-Z_]+)\s*=\s*(\d+)", enum_body("Status"),
+                         re.M):
+        out["statuses"][m.group(1)] = int(m.group(2))
+
+    m = re.search(r"constexpr uint32_t MAGIC = (0x[0-9A-Fa-f]+)", text)
+    out["magic"] = int(m.group(1), 16) if m else None
+    m = re.search(r"constexpr uint8_t WIRE_VERSION = (\d+)", text)
+    out["wire_version"] = int(m.group(1)) if m else None
+    m = re.search(r"static_assert\(sizeof\(WireHeader\) == (\d+)", text)
+    out["header_bytes"] = int(m.group(1)) if m else None
+    return out
+
+
+def parse_capi(root):
+    """ABI version + exported ist_* symbols from native/src/capi.cc."""
+    text = _read(root, "native/src/capi.cc")
+    m = re.search(r"ist_abi_version\(void\)\s*\{\s*return\s+(\d+)\s*;", text)
+    abi = int(m.group(1)) if m else None
+    # Definitions start at column 0 inside the extern "C" block:
+    #   uint32_t ist_allocate(void* h, ...
+    exports = set()
+    for m in re.finditer(
+            r"^[A-Za-z_][A-Za-z0-9_ :<>,*&]*?[ *](ist_[a-z0-9_]+)\(", text,
+            re.M):
+        exports.add(m.group(1))
+    return abi, exports
+
+
+def parse_native_py(root):
+    """ctypes declarations, Status mirror + ABI floor from _native.py."""
+    text = _read(root, "infinistore_tpu/_native.py")
+    decls = set(re.findall(r'"(ist_[a-z0-9_]+)"', text))
+    m = re.search(r"if ver < (\d+):", text)
+    abi_floor = int(m.group(1)) if m else None
+    statuses = {}
+    # Module-level UPPER_CASE integer constants (the Status mirror).
+    for m in re.finditer(r"^([A-Z][A-Z_]+) = (\d+)$", text, re.M):
+        statuses[m.group(1)] = int(m.group(2))
+    named = set(re.findall(r"^\s+([A-Z][A-Z_]+): \"", text, re.M))
+    return decls, abi_floor, statuses, named
+
+
+def parse_failpoint_sites(root):
+    """Compiled-in failpoints: every IST_FAILPOINT("...") call site."""
+    sites = set()
+    src = os.path.join(root, "native", "src")
+    for fn in sorted(os.listdir(src)):
+        if not fn.endswith((".cc", ".h")):
+            continue
+        with open(os.path.join(src, fn), encoding="utf-8") as f:
+            sites |= set(re.findall(r'IST_FAILPOINT\("([a-z.]+)"\)',
+                                    f.read()))
+    return sites
+
+
+def parse_failpoint_catalog(root):
+    """The documented catalog block in native/src/failpoint.h."""
+    text = _read(root, "native/src/failpoint.h")
+    m = re.search(r"Catalog of compiled-in points.*?(?=#pragma|\Z)", text,
+                  re.S)
+    if not m:
+        return set()
+    return set(re.findall(r"^//\s+([a-z]+\.[a-z]+)\s", m.group(0), re.M))
+
+
+def expand_brace_names(text):
+    """All failpoint-style names in prose, expanding a.{b,c} groups."""
+    names = set(re.findall(r"\b([a-z]+\.[a-z]+)\b", text))
+    for m in re.finditer(r"\b([a-z]+)\.\{([a-z,]+)\}", text):
+        for part in m.group(2).split(","):
+            names.add(f"{m.group(1)}.{part}")
+    return names
+
+
+def parse_stats_keys(root):
+    """Every JSON key stats_json() emits (native/src/server.cc)."""
+    text = _read(root, "native/src/server.cc")
+    return set(re.findall(r'\\"([a-z_0-9]+)\\":', text))
+
+
+def parse_metrics_refs(root):
+    """Stats keys the Prometheus renderer reads (infinistore_tpu/server.py).
+
+    The renderer's gauge/counter tables are ("stat key", "metric name",
+    "help") tuples; per-worker/op/wait/trace families read nested keys
+    handled separately below.
+    """
+    text = _read(root, "infinistore_tpu/server.py")
+    m = re.search(r"def render_metrics.*?(?=\ndef )", text, re.S)
+    block = m.group(0) if m else text
+    refs = set(re.findall(r'\(\s*"([a-z_0-9]+)",\s*"[a-z_0-9]+",', block))
+    nested = set(re.findall(r'stats\.get\("([a-z_0-9]+)"', block))
+    families = set(re.findall(r"\b(infinistore_[a-z_0-9]+)", block))
+    return refs | nested, families
+
+
+def parse_endpoints(root):
+    """HTTP control-plane endpoints from infinistore_tpu/server.py."""
+    text = _read(root, "infinistore_tpu/server.py")
+    eps = set(re.findall(r'self\.path == "(/[a-z_0-9]+)"', text))
+    eps |= set(re.findall(r'self\.path\.startswith\("(/[a-z_0-9]+)"\)',
+                          text))
+    return eps
+
+
+# --------------------------------------------------------------------------
+# checks
+# --------------------------------------------------------------------------
+
+
+def check_status_mirror(common, py_statuses, py_named):
+    errs = []
+    for name, val in common["statuses"].items():
+        if name not in py_statuses:
+            errs.append(
+                f"status-mirror: {name} ({val}) in common.h has no "
+                f"constant in infinistore_tpu/_native.py")
+        elif py_statuses[name] != val:
+            errs.append(
+                f"status-mirror: {name} is {val} in common.h but "
+                f"{py_statuses[name]} in _native.py")
+        if name not in py_named:
+            errs.append(
+                f"status-mirror: {name} missing from _native.status_name()")
+    for name, val in py_statuses.items():
+        if name in ("FAKE_TOKEN",):
+            continue
+        if name not in common["statuses"]:
+            errs.append(
+                f"status-mirror: _native.py defines {name}={val} with no "
+                f"counterpart in common.h")
+    return errs
+
+
+def check_exports(exports, decls):
+    errs = []
+    for sym in sorted(decls - exports):
+        errs.append(
+            f"abi-exports: _native.py declares {sym} but capi.cc does not "
+            f"export it")
+    for sym in sorted(exports - decls):
+        errs.append(
+            f"abi-exports: capi.cc exports {sym} with no ctypes "
+            f"declaration in _native.py (add it, or the symbol is dead "
+            f"surface)")
+    return errs
+
+
+def check_failpoints(root, sites, catalog):
+    errs = []
+    design = _read(root, "docs/design.md")
+    documented = expand_brace_names(design)
+    for name in sorted(sites - catalog):
+        errs.append(
+            f"failpoints: {name} is compiled in (IST_FAILPOINT site) but "
+            f"missing from the failpoint.h catalog comment")
+    for name in sorted(catalog - sites):
+        errs.append(
+            f"failpoints: {name} is in the failpoint.h catalog but no "
+            f"IST_FAILPOINT call site compiles it in (stale catalog row)")
+    for name in sorted(sites - documented):
+        errs.append(
+            f"failpoints: {name} is undocumented in docs/design.md "
+            f"(Failure model section)")
+    return errs
+
+
+def check_metrics(stats_keys, metric_refs):
+    errs = []
+    for key in sorted(metric_refs - stats_keys):
+        errs.append(
+            f"metrics: infinistore_tpu/server.py renders stats key "
+            f"'{key}' which native server.cc stats_json() does not emit "
+            f"(renamed or removed on one side)")
+    return errs
+
+
+def check_ops_documented(root, common):
+    # Word-boundary match, not substring: OP_COMMIT must not count as
+    # documented just because the OP_COMMIT_BATCH row survives (same
+    # for OP_LEASE vs OP_LEASE_REVOKE, /fault vs /faults, ...).
+    errs = []
+    api = _read(root, "docs/api.md")
+    for op in sorted(common["ops"]):
+        if not re.search(r"\b%s\b" % re.escape(op), api):
+            errs.append(
+                f"docs: {op} (op {common['ops'][op]}) missing from the "
+                f"docs/api.md wire table")
+    return errs
+
+
+def check_endpoints_documented(root, endpoints):
+    errs = []
+    api = _read(root, "docs/api.md")
+    for ep in sorted(endpoints):
+        if not re.search(r"%s\b" % re.escape(ep), api):
+            errs.append(
+                f"docs: control-plane endpoint {ep} (server.py) is "
+                f"undocumented in docs/api.md")
+    return errs
+
+
+def check_tsan_supp(root):
+    """Every suppression needs a live `# cite: file:line` justification."""
+    errs = []
+    text = _read(root, "native/tsan.supp")
+    lines = text.splitlines()
+    block_cites = []  # cites seen in the comment block above the current line
+    src_cache = {}
+
+    def src_text(rel):
+        if rel not in src_cache:
+            p = os.path.join(root, rel)
+            src_cache[rel] = (open(p, encoding="utf-8").read()
+                              if os.path.exists(p) else None)
+        return src_cache[rel]
+
+    all_native = None
+
+    def native_corpus():
+        nonlocal all_native
+        if all_native is None:
+            parts = []
+            src = os.path.join(root, "native", "src")
+            for fn in os.listdir(src):
+                if fn.endswith((".cc", ".h")):
+                    parts.append(open(os.path.join(src, fn),
+                                      encoding="utf-8").read())
+            all_native = "\n".join(parts)
+        return all_native
+
+    # A suppression is covered only by cites collected since the last
+    # block boundary: a blank line, or the first comment line after a
+    # suppression (the next family's header). Stale cites must never
+    # leak forward — an appended, uncited block at end-of-file has to
+    # fail, not coast on the previous block's citation.
+    supp_since_cites = False
+    for i, line in enumerate(lines, 1):
+        s = line.strip()
+        if not s:
+            block_cites = []
+            supp_since_cites = False
+            continue
+        if s.startswith("#"):
+            if supp_since_cites:
+                block_cites = []
+                supp_since_cites = False
+            for m in re.finditer(r"cite:\s*([\w/.\-]+):(\d+)", s):
+                block_cites.append((m.group(1), int(m.group(2)), i))
+            continue
+        m = re.match(r"(\w+):(.+)", s)
+        if not m:
+            errs.append(f"tsan-supp:{i}: unparseable suppression '{s}'")
+            continue
+        supp_since_cites = True
+        if not block_cites:
+            errs.append(
+                f"tsan-supp:{i}: suppression '{s}' has no '# cite: "
+                f"file:line' comment naming the FP family it covers")
+        pattern = m.group(2)
+        sym = re.split(r"::", pattern)[-1]
+        if re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", sym):
+            if sym not in native_corpus():
+                errs.append(
+                    f"tsan-supp:{i}: suppression targets '{pattern}' but "
+                    f"'{sym}' no longer exists in native/src — prune it")
+    for rel, ln, at in {(c[0], c[1], c[2]) for c in _collect_cites(lines)}:
+        src = src_text(rel)
+        if src is None:
+            errs.append(f"tsan-supp:{at}: cite names missing file {rel}")
+        elif ln > len(src.splitlines()):
+            errs.append(
+                f"tsan-supp:{at}: cite {rel}:{ln} is past the end of the "
+                f"file ({len(src.splitlines())} lines) — refresh it")
+    return errs
+
+
+def _collect_cites(lines):
+    out = []
+    for i, line in enumerate(lines, 1):
+        for m in re.finditer(r"cite:\s*([\w/.\-]+):(\d+)", line):
+            out.append((m.group(1), int(m.group(2)), i))
+    return out
+
+
+def build_surface(common, abi, exports, failpoints):
+    return {
+        "abi_version": abi,
+        "wire": {
+            "magic": common["magic"],
+            "wire_version": common["wire_version"],
+            "header_bytes": common["header_bytes"],
+        },
+        "ops": dict(sorted(common["ops"].items(), key=lambda kv: kv[1])),
+        "statuses": dict(
+            sorted(common["statuses"].items(), key=lambda kv: kv[1])),
+        "exports": sorted(exports),
+        "failpoints": sorted(failpoints),
+    }
+
+
+def check_golden(root, surface, abi_floor):
+    errs = []
+    path = os.path.join(root, "tools", "abi_surface.json")
+    if not os.path.exists(path):
+        errs.append(
+            "golden: tools/abi_surface.json is missing (regenerate with "
+            "tools/check_invariants.py --write-golden)")
+        return errs
+    with open(path, encoding="utf-8") as f:
+        golden = json.load(f)
+    for section in ("wire", "ops", "statuses", "exports", "failpoints"):
+        if golden.get(section) != surface[section]:
+            errs.append(
+                f"golden: '{section}' drifted from tools/abi_surface.json "
+                f"— the wire-visible surface changed; update the golden "
+                f"AND bump ist_abi_version() (capi.cc) + the _native.py "
+                f"ABI floor in the same change")
+    if golden.get("abi_version") != surface["abi_version"]:
+        errs.append(
+            f"golden: ist_abi_version()={surface['abi_version']} but "
+            f"abi_surface.json pins {golden.get('abi_version')} — surface "
+            f"changes require the golden update and the ABI bump together")
+    if abi_floor != surface["abi_version"]:
+        errs.append(
+            f"abi: _native.py rejects < v{abi_floor} but capi.cc reports "
+            f"v{surface['abi_version']} — the stale-library probe and the "
+            f"ABI must move together")
+    return errs
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    default_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    ap.add_argument("--root", default=default_root,
+                    help="repo root (default: the tree this script is in)")
+    ap.add_argument("--write-golden", action="store_true",
+                    help="regenerate tools/abi_surface.json from the tree "
+                         "(after an intentional ABI surface change)")
+    args = ap.parse_args(argv)
+    root = args.root
+
+    common = parse_common_h(root)
+    abi, exports = parse_capi(root)
+    decls, abi_floor, py_statuses, py_named = parse_native_py(root)
+    sites = parse_failpoint_sites(root)
+    catalog = parse_failpoint_catalog(root)
+    stats_keys = parse_stats_keys(root)
+    metric_refs, _families = parse_metrics_refs(root)
+    endpoints = parse_endpoints(root)
+    surface = build_surface(common, abi, exports, sites)
+
+    if args.write_golden:
+        path = os.path.join(root, "tools", "abi_surface.json")
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(surface, f, indent=2, sort_keys=False)
+            f.write("\n")
+        print(f"wrote {path} (abi v{abi}, {len(surface['ops'])} ops, "
+              f"{len(surface['exports'])} exports, "
+              f"{len(surface['failpoints'])} failpoints)")
+        return 0
+
+    errs = []
+    errs += check_status_mirror(common, py_statuses, py_named)
+    errs += check_exports(exports, decls)
+    errs += check_failpoints(root, sites, catalog)
+    errs += check_metrics(stats_keys, metric_refs)
+    errs += check_ops_documented(root, common)
+    errs += check_endpoints_documented(root, endpoints)
+    errs += check_tsan_supp(root)
+    errs += check_golden(root, surface, abi_floor)
+
+    if errs:
+        print(f"check_invariants: {len(errs)} violation(s)",
+              file=sys.stderr)
+        for e in errs:
+            print(f"  {e}", file=sys.stderr)
+        return 1
+    print(f"check_invariants: OK (abi v{abi}, {len(surface['ops'])} ops, "
+          f"{len(surface['statuses'])} statuses, "
+          f"{len(surface['exports'])} exports, "
+          f"{len(surface['failpoints'])} failpoints, "
+          f"{len(stats_keys)} stats keys, {len(endpoints)} endpoints)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
