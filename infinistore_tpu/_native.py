@@ -85,7 +85,7 @@ def _decls(lib):
             [c.c_char_p, c.c_uint16, c.c_uint64, c.c_uint64, c.c_int,
              c.c_uint64, c.c_int, c.c_char_p, c.c_int, c.c_char_p,
              c.c_uint64, c.c_uint64, c.c_uint32, c.c_double, c.c_double,
-             c.c_int, c.c_int],
+             c.c_int, c.c_int, c.c_char_p],
         ),
         ("ist_server_start", c.c_int, [c.c_void_p]),
         ("ist_server_stop", None, [c.c_void_p]),
@@ -243,9 +243,10 @@ def _decls(lib):
         ("ist_mm_total_bytes", c.c_uint64, [c.c_void_p]),
         ("ist_mm_num_pools", c.c_uint64, [c.c_void_p]),
     ]
-    # ABI probe FIRST: a stale prebuilt library would lack the v8 fault
-    # entry points (ist_server_fault / ist_server_fault_list), misparse
-    # the v7 ist_server_create argument list (promote flag), the v6
+    # ABI probe FIRST: a stale prebuilt library would misparse the v9
+    # ist_server_create argument list (trailing engine string), lack
+    # the v8 fault entry points (ist_server_fault /
+    # ist_server_fault_list), misparse the v7 promote flag, the v6
     # trace flag, the v5 reclaim watermarks, the v4 multi-worker knob
     # or the v3 ist_conn_create lease knobs, or lack the newer entry
     # points (ist_prefetch, ist_server_trace, ist_conn_set_trace)
@@ -257,9 +258,9 @@ def _decls(lib):
         ver = int(lib.ist_abi_version())
     except AttributeError:
         ver = 1
-    if ver < 8:
+    if ver < 9:
         raise RuntimeError(
-            f"stale native library at {_LIB_PATH} (ABI v{ver} < v8): "
+            f"stale native library at {_LIB_PATH} (ABI v{ver} < v9): "
             "rebuild with `make -C native` (or delete the .so to let "
             "the import auto-build)"
         )

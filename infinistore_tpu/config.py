@@ -194,6 +194,17 @@ class ServerConfig:
         # GET /trace. Compiled in but off by default — the rings record
         # nothing and allocate nothing when disabled.
         self.trace = kwargs.get("trace", False)
+        # Transport engine for the worker IO loops (--engine /
+        # ISTPU_ENGINE env override; docs/design.md "Transport
+        # engine"): "epoll" = the portable readiness loop (historical
+        # behavior), "uring" = io_uring completion loop — pool arenas
+        # registered as fixed kernel buffers, zero-copy sends for
+        # OP_READ responses, multishot recv for header traffic,
+        # optional SQPOLL — failing loudly at start() on kernels
+        # without io_uring; "auto" (default) probes at startup and
+        # falls back to epoll with one log line (the stats blob's
+        # "engine" key reports what was selected).
+        self.engine = kwargs.get("engine", "auto")
         # Accepted for reference CLI compatibility; unused on TPU hosts.
         self.dev_name = kwargs.get("dev_name", "")
         self.link_type = kwargs.get("link_type", "")
@@ -237,6 +248,8 @@ class ServerConfig:
             raise Exception("max_outq_size must be positive (MB)")
         if self.workers < 0 or self.workers > 64:
             raise Exception("workers must be in [0, 64] (0 = auto)")
+        if self.engine not in ("auto", "epoll", "uring"):
+            raise Exception("engine must be auto, epoll or uring")
         if 0.0 < self.reclaim_high < 1.0:
             if not (0.0 <= self.reclaim_low <= self.reclaim_high):
                 raise Exception(

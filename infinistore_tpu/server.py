@@ -60,12 +60,17 @@ class InfiniStoreServer:
             ct.c_double(cfg.reclaim_low),
             1 if cfg.trace else 0,
             1 if cfg.promote else 0,
+            cfg.engine.encode(),
         )
         port = self._lib.ist_server_start(self._h)
         if port < 0:
             self._lib.ist_server_destroy(self._h)
             self._h = None
-            raise Exception("failed to start server (bind error?)")
+            raise Exception(
+                "failed to start server (bind error, or engine="
+                f"{cfg.engine!r} unsupported on this kernel — see the "
+                "native log)"
+            )
         self.service_port = port
         return port
 
@@ -207,7 +212,7 @@ def _prometheus_metrics(stats):
         ("pool_bytes", "pool_bytes", "total DRAM pool capacity"),
         ("used_bytes", "pool_used_bytes", "allocated DRAM pool bytes"),
         ("connections", "connections", "open client connections"),
-        ("workers", "workers", "data-plane epoll worker threads"),
+        ("workers", "workers", "data-plane worker threads"),
         ("disk_bytes", "disk_tier_bytes", "disk spill tier capacity"),
         ("disk_used", "disk_tier_used_bytes", "disk spill tier usage"),
     ]
@@ -252,8 +257,25 @@ def _prometheus_metrics(stats):
          "injected); write errors feed the tier circuit breaker"),
         ("failpoints_fired", "failpoints_fired",
          "fault injections fired across all armed failpoints"),
+        # Transport engine (ISSUE 8): all three are 0 under epoll.
+        ("uring_sqes", "uring_sqes",
+         "io_uring submission queue entries issued by the workers"),
+        ("uring_zc_sends", "uring_zc_sends",
+         "zero-copy sends (SEND_ZC/SENDMSG_ZC) issued for responses"),
+        ("uring_copies_avoided", "uring_copies_avoided",
+         "payload bytes moved without a kernel bounce copy (direct "
+         "pool reads + zero-copy sends)"),
     ]
     lines = []
+    # Selected transport engine as an info-style gauge: the engine name
+    # rides a label so dashboards can alert on an unexpected fallback.
+    engine = stats.get("engine", "epoll")
+    lines.append(
+        "# HELP infinistore_engine transport engine selected at start "
+        "(1 for the active one)"
+    )
+    lines.append("# TYPE infinistore_engine gauge")
+    lines.append(f'infinistore_engine{{engine="{engine}"}} 1')
     for key, name, help_ in g:
         lines.append(f"# HELP infinistore_{name} {help_}")
         lines.append(f"# TYPE infinistore_{name} gauge")
@@ -271,6 +293,12 @@ def _prometheus_metrics(stats):
         ("ops", "counter", "requests handled by the worker"),
         ("bytes_in", "counter", "bytes received by the worker"),
         ("bytes_out", "counter", "bytes sent by the worker"),
+        ("uring_sqes", "counter",
+         "io_uring SQEs submitted by the worker (0 under epoll)"),
+        ("uring_zc_sends", "counter",
+         "zero-copy sends issued by the worker (0 under epoll)"),
+        ("uring_copies_avoided", "counter",
+         "payload bytes the worker moved with no bounce copy"),
     ]
     for key, kind, help_ in pw:
         suffix = "_total" if kind == "counter" else ""
@@ -572,6 +600,16 @@ def parse_args(argv=None):
                         "commit, reclaim/spill tracks); drain as "
                         "Perfetto-loadable JSON via GET /trace. "
                         "ISTPU_TRACE=1/0 overrides")
+    p.add_argument("--engine", default="auto",
+                   choices=["auto", "epoll", "uring"],
+                   help="transport engine for the worker IO loops: "
+                        "epoll (readiness loop, portable), uring "
+                        "(io_uring: registered pool buffers, zero-copy "
+                        "sends, multishot recv; fails at startup on "
+                        "kernels without io_uring), or auto (probe and "
+                        "fall back to epoll, logged once; the /stats "
+                        "'engine' key reports the selection). The "
+                        "ISTPU_ENGINE env var overrides")
     p.add_argument("--warmup", action="store_true",
                    help="run a warmup round-trip after startup")
     p.add_argument("--snapshot-path", default="",
@@ -622,6 +660,7 @@ def main(argv=None):
         reclaim_low=args.reclaim_low,
         promote=not args.no_promote,
         trace=args.trace,
+        engine=args.engine,
     )
     server = InfiniStoreServer(config)
     server.start()

@@ -110,10 +110,14 @@ extern "C" {
 // ist_prefetch entry point; v8: failpoint fault injection —
 // ist_server_fault / ist_server_fault_list entry points, stats gains
 // disk_io_errors / tier_breaker_open / workers_dead /
-// failpoints_fired).
+// failpoints_fired; v9: pluggable transport engine — trailing
+// `engine` string on ist_server_create ("auto"/"epoll"/"uring"),
+// stats gains engine / uring_sqes / uring_zc_sends /
+// uring_copies_avoided plus the per-worker engine breakdown, new
+// engine.uring_setup failpoint).
 // _native.py probes this at load so a stale prebuilt library fails
 // loudly instead of feeding unparseable blobs to the server.
-uint32_t ist_abi_version(void) { return 8; }
+uint32_t ist_abi_version(void) { return 9; }
 
 void ist_set_log_level(int level) { set_log_level(level); }
 void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }
@@ -127,7 +131,7 @@ void* ist_server_create(const char* host, uint16_t port,
                         const char* ssd_path, uint64_t ssd_bytes,
                         uint64_t max_outq_bytes, uint32_t workers,
                         double reclaim_high, double reclaim_low, int trace,
-                        int promote) {
+                        int promote, const char* engine) {
     ServerConfig cfg;
     cfg.host = host ? host : "0.0.0.0";
     cfg.port = port;
@@ -154,6 +158,9 @@ void* ist_server_create(const char* host, uint16_t port,
     // Async read pipeline (promotion worker + disk-served cold gets);
     // ISTPU_PROMOTE=1/0 still overrides.
     cfg.promote = promote != 0;
+    // Transport engine ("auto"/"epoll"/"uring"; engine.h). NULL/empty
+    // keeps the auto probe; ISTPU_ENGINE still overrides at start().
+    if (engine && engine[0]) cfg.engine = engine;
     return new Server(cfg);
 }
 

@@ -1,0 +1,112 @@
+// engine.h — the pluggable transport engine behind the worker IO loops.
+//
+// PRs 2-6 built the multi-worker data plane on one hard-wired readiness
+// loop: epoll_wait + recv/readv/writev, one syscall per socket event and
+// one kernel-buffer copy per payload byte. BENCH_r05 shows that loop —
+// not the store — as the cross-host bottleneck (stream_vs_raw 1.07 at
+// 4 KB blocks). This interface extracts the loop and the per-connection
+// IO submission points so the SAME protocol state machine (server.cc:
+// header/body parse, payload scatter plan, OutMsg gather queue, all op
+// handlers, tracing, failpoints) can run over two transports:
+//
+//   EngineEpoll  (engine_epoll.cc)  the historical readiness loop,
+//                byte-for-byte the PR-2 behavior. Portable everywhere;
+//                the "auto" fallback and the reference point every
+//                parity test pins against.
+//   EngineUring  (engine_uring.cc)  an io_uring completion loop:
+//                the pool arenas registered as fixed buffers once at
+//                startup (the TCP analogue of ibv_reg_mr — the
+//                MR-registration argument NP-RDMA/fabric-lib make:
+//                register once, then hot-path IO carries no per-op
+//                pin/translate cost), OP_PUT payloads landing via
+//                READ_FIXED/READV straight into the carved pool blocks,
+//                OP_READ responses leaving via SEND_ZC/SENDMSG_ZC with
+//                the block pins held until the kernel's zero-copy
+//                NOTIFICATION (not just the data CQE), multishot recv
+//                for header traffic, and optional SQPOLL
+//                (ISTPU_URING_SQPOLL=1) so a saturated worker issues
+//                no syscalls at all.
+//
+// Selection (ServerConfig.engine / --engine / ISTPU_ENGINE): "epoll",
+// "uring", or "auto". Auto probes io_uring support once at start()
+// (kernel may lack the syscall, seccomp may block it — common in CI
+// containers) and falls back to epoll with one log line; engine=uring
+// on an unsupported host fails start() loudly, never mid-op. The
+// `engine.uring_setup` failpoint forces the probe to fail so the
+// fallback path is testable anywhere.
+//
+// This seam — not io_uring itself — is the structural unlock: a future
+// real-RDMA or ICI backend is a third Engine implementation, not
+// another rewrite of server.cc.
+//
+// Threading contract: one Engine instance per Worker, owned by it.
+// init() runs on the starting thread before the worker thread spawns;
+// poll()/conn_added()/conn_closing()/output_ready() run ONLY on the
+// owning worker thread (connections live their whole life on one
+// worker — the PR-2 serialization property engines inherit for free,
+// which is why no Engine state needs a lock or a rank). shutdown()
+// runs after the worker thread joined.
+#pragma once
+
+#include <memory>
+#include <string>
+
+namespace istpu {
+
+class Server;
+struct Conn;
+struct Worker;
+
+class Engine {
+   public:
+    virtual ~Engine() = default;
+
+    // "epoll" / "uring" — surfaced per worker in stats_json.
+    virtual const char* name() const = 0;
+
+    // Engine-private setup (event fd registration, ring + fixed-buffer
+    // setup). false = this engine cannot run here; the caller falls
+    // back (auto) or fails the server start (forced).
+    virtual bool init() = 0;
+
+    // Release engine resources (idempotent; also called from the
+    // destructor). Runs after the worker thread joined and before the
+    // store tears down, so pins still release into a live pool.
+    virtual void shutdown() = 0;
+
+    // One wait-and-dispatch iteration (bounded at ~500 ms so the
+    // worker loop re-checks running_). Dispatches accepts, handoff
+    // wakeups, and per-connection IO through the Server callbacks.
+    virtual void poll() = 0;
+
+    // A connection was just adopted by this worker (fields set, in
+    // w.conns): start its read pump / register it for readiness.
+    virtual void conn_added(Conn& c) = 0;
+
+    // The server is closing this connection (still in w.conns, fd
+    // still open): cancel/unregister in-flight IO. In-flight zero-copy
+    // sends keep their block pins until the kernel notification drains.
+    virtual void conn_closing(Conn& c) = 0;
+
+    // A response was queued on c.outq: start/continue transmitting.
+    // On a fatal transport error the engine marks c.dead (caller
+    // closes) or closes the connection itself from poll context.
+    virtual void output_ready(Conn& c) = 0;
+};
+
+enum class EngineKind { kAuto, kEpoll, kUring };
+
+// Parse "auto"/"epoll"/"uring" (exact, lowercase). false = unknown.
+bool parse_engine_kind(const std::string& s, EngineKind* out);
+
+// One-shot runtime probe: can io_uring be set up here at all? Consults
+// the `engine.uring_setup` failpoint first (forced-fallback testing),
+// then attempts a minimal io_uring_setup. On false, *why names the
+// reason (ENOSYS kernel, seccomp EPERM, failpoint, built without
+// headers) for the one startup log line.
+bool uring_runtime_supported(std::string* why);
+
+std::unique_ptr<Engine> make_engine_epoll(Server& srv, Worker& w);
+std::unique_ptr<Engine> make_engine_uring(Server& srv, Worker& w);
+
+}  // namespace istpu

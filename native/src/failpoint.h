@@ -43,6 +43,9 @@
 //   sock.recv      worker-side socket read fails (connection drops)
 //   sock.send      worker-side socket write fails (connection drops)
 //   lease.commit   OP_COMMIT_BATCH replay fails server-side
+//   engine.uring_setup  io_uring probe fails at server start: forces
+//                  engine=auto onto the epoll fallback (and a forced
+//                  engine=uring start to fail loudly) on any host
 #pragma once
 
 #include <atomic>

@@ -165,6 +165,23 @@ class MM {
     size_t used_bytes() const;
     size_t block_size() const { return block_size_; }
 
+    // Arena export for transport-engine buffer registration
+    // (engine_uring.cc: IORING_REGISTER_BUFFERS over these spans — the
+    // ibv_reg_mr analogue; register once at startup, zero per-op page
+    // pinning after). Snapshot of the pools present NOW: pools appended
+    // later by auto-extend are simply not registered (engines fall back
+    // to unregistered submissions for blocks inside them). Mapping
+    // addresses are stable for the MM's lifetime (append-only pools_).
+    std::vector<std::pair<uint8_t*, size_t>> pool_spans() const {
+        std::vector<std::pair<uint8_t*, size_t>> out;
+        size_t n = num_pools();
+        out.reserve(n);
+        for (size_t i = 0; i < n; ++i) {
+            out.emplace_back(pools_[i]->base(), pools_[i]->pool_size());
+        }
+        return out;
+    }
+
     static constexpr double kExtendThreshold = 0.5;  // mempool.h:13
     static constexpr size_t kMaxPools = 256;  // append-only capacity bound
 

@@ -5,7 +5,6 @@
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
-#include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/mman.h>
 #include <sys/socket.h>
@@ -15,6 +14,7 @@
 #include <cstdlib>
 #include <cstring>
 
+#include "engine.h"
 #include "failpoint.h"
 #include "log.h"
 #include "utils.h"
@@ -65,6 +65,28 @@ uint32_t resolve_workers(uint32_t configured) {
     if (configured < 1) configured = 1;
     if (configured > 64) configured = 64;
     return configured;
+}
+
+// Resolve the transport-engine request (ServerConfig.engine overridden
+// by ISTPU_ENGINE). An unknown value falls back to auto WITH a warning
+// — a typo must not silently force (or forbid) io_uring; `forced` is
+// true only for an explicit "uring", which must then fail loudly when
+// the probe says no.
+EngineKind resolve_engine_kind(const std::string& configured,
+                               bool* forced) {
+    std::string want = configured;
+    if (const char* env = getenv("ISTPU_ENGINE")) {
+        if (env[0] != '\0') want = env;
+    }
+    EngineKind kind = EngineKind::kAuto;
+    if (!parse_engine_kind(want, &kind)) {
+        IST_WARN("ignoring unknown engine '%s' (auto|epoll|uring); "
+                 "probing as auto",
+                 want.c_str());
+        kind = EngineKind::kAuto;
+    }
+    *forced = kind == EngineKind::kUring;
+    return kind;
 }
 
 }  // namespace
@@ -250,6 +272,50 @@ bool Server::start() {
     // the first socket got.
     addr.sin_port = htons(bound_port_);
 
+    // Transport engine (engine.h): resolved ONCE, for every worker.
+    // auto = probe io_uring support (kernel/seccomp and the
+    // engine.uring_setup failpoint) and fall back to epoll with one
+    // log line; a forced engine=uring on an unsupported host fails
+    // start() here — loudly, never mid-op.
+    bool force_uring = false;
+    EngineKind ekind = resolve_engine_kind(cfg_.engine, &force_uring);
+    if (ekind != EngineKind::kEpoll) {
+        std::string why;
+        if (uring_runtime_supported(&why)) {
+            ekind = EngineKind::kUring;
+        } else if (force_uring) {
+            IST_ERROR("engine=uring requested but io_uring is "
+                      "unavailable here: %s (use engine=auto for the "
+                      "epoll fallback)",
+                      why.c_str());
+            close(listen_fd_);
+            listen_fd_ = -1;
+            return false;
+        } else {
+            IST_INFO("engine=auto: io_uring unavailable (%s); using "
+                     "epoll",
+                     why.c_str());
+            ekind = EngineKind::kEpoll;
+        }
+    }
+    engine_name_ = ekind == EngineKind::kUring ? "uring" : "epoll";
+
+    // Tears down the half-built worker set on an engine-init failure so
+    // a failed start() leaks no fds (the caller may retry with another
+    // config in the same process).
+    auto teardown_workers = [&]() {
+        for (auto& w : workers_) {
+            if (w->engine) w->engine->shutdown();
+            if (w->wake_fd >= 0) close(w->wake_fd);
+            if (w->listen_fd >= 0 && w->listen_fd != listen_fd_) {
+                close(w->listen_fd);
+            }
+        }
+        workers_.clear();
+        close(listen_fd_);
+        listen_fd_ = -1;
+    };
+
     workers_.clear();
     for (uint32_t i = 0; i < nworkers; ++i) {
         auto w = std::make_unique<Worker>();
@@ -257,12 +323,7 @@ bool Server::start() {
         if (cfg_.trace) {
             w->ring = tracer_->add_track("worker " + std::to_string(i));
         }
-        w->epoll_fd = epoll_create1(EPOLL_CLOEXEC);
         w->wake_fd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
-        epoll_event ev{};
-        ev.events = EPOLLIN;
-        ev.data.fd = w->wake_fd;
-        epoll_ctl(w->epoll_fd, EPOLL_CTL_ADD, w->wake_fd, &ev);
         if (i == 0) {
             // Worker 0 watches the first listener either way.
             w->listen_fd = listen_fd_;
@@ -276,11 +337,38 @@ bool Server::start() {
                          strerror(errno));
             }
         }
-        if (w->listen_fd >= 0) {
-            ev.data.fd = w->listen_fd;
-            epoll_ctl(w->epoll_fd, EPOLL_CTL_ADD, w->listen_fd, &ev);
-        }
         workers_.push_back(std::move(w));
+    }
+    // Engines second (all fds exist): if any worker's ring setup fails
+    // under auto — probe passed but full init did not, e.g. a memlock
+    // limit — EVERY worker drops to epoll together, so the selected
+    // engine is one fact, not a per-worker lottery.
+    for (uint32_t pass = 0; pass < 2; ++pass) {
+        bool ok = true;
+        for (auto& w : workers_) {
+            w->engine = ekind == EngineKind::kUring
+                            ? make_engine_uring(*this, *w)
+                            : make_engine_epoll(*this, *w);
+            if (!w->engine || !w->engine->init()) {
+                ok = false;
+                break;
+            }
+        }
+        if (ok) break;
+        for (auto& w : workers_) {
+            if (w->engine) w->engine->shutdown();
+            w->engine.reset();
+        }
+        if (ekind == EngineKind::kUring && !force_uring) {
+            IST_WARN("io_uring engine init failed; falling back to "
+                     "epoll");
+            ekind = EngineKind::kEpoll;
+            engine_name_ = "epoll";
+            continue;  // second pass builds epoll engines
+        }
+        IST_ERROR("transport engine '%s' init failed", engine_name_.c_str());
+        teardown_workers();
+        return false;
     }
 
     running_.store(true);
@@ -289,12 +377,12 @@ bool Server::start() {
         wp->thread = std::thread([this, wp] { loop(*wp); });
     }
     IST_INFO("server listening on %s:%u (pool %llu MB, block %llu KB, "
-             "shm=%s, workers=%u, reuseport=%d)",
+             "shm=%s, workers=%u, reuseport=%d, engine=%s)",
              cfg_.host.c_str(), bound_port_,
              (unsigned long long)(cfg_.prealloc_bytes >> 20),
              (unsigned long long)(cfg_.block_size >> 10),
              cfg_.enable_shm ? cfg_.shm_prefix.c_str() : "off", nworkers,
-             reuseport_ ? 1 : 0);
+             reuseport_ ? 1 : 0, engine_name_.c_str());
     return true;
 }
 
@@ -314,7 +402,11 @@ void Server::stop() {
         // Handed-off connections never adopted before shutdown.
         for (auto& c : w->pending) close(c->fd);
         w->pending.clear();
-        if (w->epoll_fd >= 0) close(w->epoll_fd);
+        // Engine resources (epoll fd / io_uring ring + registered
+        // buffers + any zero-copy pins awaiting notification) go now,
+        // BEFORE the store teardown below: dropped OutMsgs release
+        // BlockRefs into a pool that must still exist.
+        if (w->engine) w->engine->shutdown();
         if (w->wake_fd >= 0) close(w->wake_fd);
         // Per-worker SO_REUSEPORT listeners (worker 0 aliases
         // listen_fd_, closed below).
@@ -527,6 +619,15 @@ long long Server::restore(const std::string& path) {
 
 std::string Server::stats_json() {
     ScopedLock lk(store_mu_);
+    // Transport-engine counters aggregated across workers (per-worker
+    // breakdown below): SQEs submitted, zero-copy sends, payload bytes
+    // moved with no bounce copy. All zero under epoll.
+    uint64_t eng_sqes = 0, eng_zc = 0, eng_nocopy = 0;
+    for (const auto& w : workers_) {
+        eng_sqes += w->eng_sqes.load(std::memory_order_relaxed);
+        eng_zc += w->eng_zc_sends.load(std::memory_order_relaxed);
+        eng_nocopy += w->eng_copies_avoided.load(std::memory_order_relaxed);
+    }
     char head[4096];
     snprintf(
         head, sizeof(head),
@@ -534,6 +635,8 @@ std::string Server::stats_json() {
         "\"pools\": %zu, \"pool_bytes\": %zu, \"used_bytes\": %zu, "
         "\"ops\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu, "
         "\"connections\": %zu, \"workers\": %zu, \"reuseport\": %d, "
+        "\"engine\": \"%s\", \"uring_sqes\": %llu, "
+        "\"uring_zc_sends\": %llu, \"uring_copies_avoided\": %llu, "
         "\"evictions\": %llu, \"spills\": %llu, "
         "\"promotes\": %llu, \"disk_bytes\": %llu, \"disk_used\": %llu, "
         "\"reclaim_runs\": %llu, \"hard_stalls\": %llu, "
@@ -555,7 +658,9 @@ std::string Server::stats_json() {
         (unsigned long long)ops_.load(),
         (unsigned long long)bytes_in_.load(),
         (unsigned long long)bytes_out_.load(), size_t(n_conns_.load()),
-        size_t(cfg_.workers), reuseport_ ? 1 : 0,
+        size_t(cfg_.workers), reuseport_ ? 1 : 0, engine_name_.c_str(),
+        (unsigned long long)eng_sqes, (unsigned long long)eng_zc,
+        (unsigned long long)eng_nocopy,
         (unsigned long long)(index_ ? index_->evictions() : 0),
         (unsigned long long)(index_ ? index_->spills() : 0),
         (unsigned long long)(index_ ? index_->promotes() : 0),
@@ -624,16 +729,26 @@ std::string Server::stats_json() {
     // under store_mu_ — stop() clears workers_ under the same lock.
     for (size_t i = 0; i < workers_.size(); ++i) {
         const Worker& w = *workers_[i];
-        char entry[192];
+        char entry[320];
         snprintf(entry, sizeof(entry),
                  "%s{\"worker\": %zu, \"connections\": %u, "
-                 "\"ops\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu}",
+                 "\"ops\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu, "
+                 "\"engine\": \"%s\", \"uring_sqes\": %llu, "
+                 "\"uring_zc_sends\": %llu, "
+                 "\"uring_copies_avoided\": %llu}",
                  i ? ", " : "", i,
                  w.nconns.load(std::memory_order_relaxed),
                  (unsigned long long)w.ops.load(std::memory_order_relaxed),
                  (unsigned long long)w.bytes_in.load(
                      std::memory_order_relaxed),
                  (unsigned long long)w.bytes_out.load(
+                     std::memory_order_relaxed),
+                 w.engine ? w.engine->name() : "epoll",
+                 (unsigned long long)w.eng_sqes.load(
+                     std::memory_order_relaxed),
+                 (unsigned long long)w.eng_zc_sends.load(
+                     std::memory_order_relaxed),
+                 (unsigned long long)w.eng_copies_avoided.load(
                      std::memory_order_relaxed));
         out += entry;
     }
@@ -676,44 +791,13 @@ std::string Server::trace_json() {
 void Server::loop(Worker& w) {
     // Bind this thread to its span ring once; every span recorded on
     // this worker (op lifecycles, stripe-lock waits, foreground disk
-    // promotions) lands there with zero lookup cost.
+    // promotions) lands there with zero lookup cost. The transport
+    // engine owns the event loop itself (readiness dispatch or
+    // completion reaping — engine.h); each poll() is bounded so
+    // running_ is re-checked at least twice a second.
     Tracer::bind_thread(w.ring);
-    constexpr int kMaxEvents = 64;
-    epoll_event events[kMaxEvents];
     while (running_.load()) {
-        int n = epoll_wait(w.epoll_fd, events, kMaxEvents, 500);
-        if (n < 0) {
-            if (errno == EINTR) continue;
-            IST_ERROR("epoll_wait: %s", strerror(errno));
-            break;
-        }
-        for (int i = 0; i < n; ++i) {
-            int fd = events[i].data.fd;
-            uint32_t evs = events[i].events;
-            if (fd == w.wake_fd) {
-                uint64_t v;
-                ssize_t r = read(w.wake_fd, &v, sizeof(v));
-                (void)r;
-                adopt_pending(w);
-                continue;
-            }
-            if (fd == w.listen_fd) {  // this worker's own acceptor
-                accept_ready(w, fd);
-                continue;
-            }
-            auto it = w.conns.find(fd);
-            if (it == w.conns.end()) continue;
-            Conn& c = *it->second;
-            if (evs & (EPOLLHUP | EPOLLERR)) {
-                close_conn(w, fd);
-                continue;
-            }
-            if (evs & EPOLLIN) {
-                conn_readable(c);
-                if (w.conns.find(fd) == w.conns.end()) continue;
-            }
-            if (evs & EPOLLOUT) conn_writable(c);
-        }
+        w.engine->poll();
     }
 }
 
@@ -734,12 +818,10 @@ void Server::adopt_pending(Worker& w) {
                                 uint64_t(t1 - c->handoff_t0));
             c->handoff_t0 = 0;
         }
-        epoll_event ev{};
-        ev.events = EPOLLIN;
-        ev.data.fd = c->fd;
-        epoll_ctl(w.epoll_fd, EPOLL_CTL_ADD, c->fd, &ev);
         int fd = c->fd;
+        Conn& ref = *c;
         w.conns[fd] = std::move(c);
+        w.engine->conn_added(ref);
         IST_DEBUG("worker %d adopted fd=%d", w.idx, fd);
     }
 }
@@ -774,11 +856,9 @@ void Server::accept_ready(Worker& w, int ready_fd) {
         n_conns_++;
         IST_DEBUG("accepted fd=%d -> worker %d", fd, target->idx);
         if (target == &w) {
-            epoll_event ev{};
-            ev.events = EPOLLIN;
-            ev.data.fd = fd;
-            epoll_ctl(target->epoll_fd, EPOLL_CTL_ADD, fd, &ev);
+            Conn& ref = *c;
             target->conns[fd] = std::move(c);
+            target->engine->conn_added(ref);
         } else {
             c->handoff_t0 = now_us();
             {
@@ -810,7 +890,10 @@ void Server::close_conn(Worker& w, int fd) {
     it->second->block_leases.clear();
     outq_total_.fetch_sub(it->second->outq_bytes, std::memory_order_relaxed);
     lease_total_.fetch_sub(it->second->lease_bytes, std::memory_order_relaxed);
-    epoll_ctl(w.epoll_fd, EPOLL_CTL_DEL, fd, nullptr);
+    // Engine teardown before the fd closes: epoll unregisters; uring
+    // cancels in-flight submissions and keeps any zero-copy pins alive
+    // until their kernel notifications drain.
+    w.engine->conn_closing(*it->second);
     close(fd);
     w.conns.erase(it);
     w.nconns.fetch_sub(1, std::memory_order_relaxed);
@@ -818,210 +901,141 @@ void Server::close_conn(Worker& w, int fd) {
     IST_DEBUG("closed fd=%d", fd);
 }
 
-void Server::update_epoll(Conn& c) {
-    bool want = !c.outq.empty();
-    if (want == c.want_write) return;
-    c.want_write = want;
-    epoll_event ev{};
-    ev.events = EPOLLIN | (want ? uint32_t(EPOLLOUT) : 0u);
-    ev.data.fd = c.fd;
-    epoll_ctl(c.w->epoll_fd, EPOLL_CTL_MOD, c.fd, &ev);
+// ---------------------------------------------------------------------------
+// Engine-shared RX state machine (engine.h). The epoll engine pulls
+// through payload_iov/payload_advance synchronously; the io_uring
+// engine submits payload_iov plans as READV/READ_FIXED SQEs and pushes
+// staged header bytes through ingest_bytes. Exactly one state machine,
+// two transports — the parity suite (tests/test_engine.py) pins the
+// wire behavior as byte-identical.
+// ---------------------------------------------------------------------------
+
+int Server::payload_iov(Conn& c, struct iovec* iov, int max) {
+    // DRAIN (malformed WRITE/PUT whose declared payload must be
+    // consumed) always reads into the sink; PAYLOAD scatters into the
+    // planned pool-block runs and falls back to the sink once the plan
+    // is exhausted (excess payload beyond the plan).
+    if (c.state == RState::PAYLOAD) {
+        int niov = 0;
+        uint64_t planned = 0;
+        size_t seg = c.wseg, seg_off = c.wseg_off;
+        while (niov < max && seg < c.wdest.size() &&
+               planned < c.payload_left) {
+            uint8_t* p = c.wdest[seg].first + seg_off;
+            size_t room = c.wdest[seg].second - seg_off;
+            if (room > c.payload_left - planned) {
+                room = size_t(c.payload_left - planned);
+            }
+            if (niov > 0 &&
+                static_cast<uint8_t*>(iov[niov - 1].iov_base) +
+                        iov[niov - 1].iov_len == p) {
+                iov[niov - 1].iov_len += room;
+            } else {
+                iov[niov].iov_base = p;
+                iov[niov].iov_len = room;
+                niov++;
+            }
+            planned += room;
+            seg++;
+            seg_off = 0;
+        }
+        if (niov > 0) return niov;
+    }
+    // Sink path (DRAIN, or PAYLOAD past the plan): bounded buffer,
+    // sized before any pointer capture and never resized mid-scatter.
+    if (c.sink.size() < (1u << 16)) c.sink.resize(1u << 16);
+    iov[0].iov_base = c.sink.data();
+    iov[0].iov_len = c.sink.size() > c.payload_left
+                         ? size_t(c.payload_left)
+                         : c.sink.size();
+    return 1;
 }
 
-void Server::conn_readable(Conn& c) {
-    // Injected receive failure: the connection drops exactly as on a
-    // real socket error — the close path aborts the client's inflight
-    // tokens, releases its pins and reclaims its block leases, and an
-    // auto_reconnect client re-dials. One relaxed load when disarmed.
-    if (IST_FAILPOINT("sock.recv")) {
-        IST_WARN("sock.recv failpoint: dropping fd=%d", c.fd);
-        return close_conn(*c.w, c.fd);
+void Server::payload_advance(Conn& c, size_t n) {
+    c.payload_left -= uint64_t(n);
+    if (c.state != RState::PAYLOAD) return;  // DRAIN: nothing planned
+    size_t left = n;
+    while (left > 0 && c.wseg < c.wdest.size()) {
+        size_t take = c.wdest[c.wseg].second - c.wseg_off;
+        if (take > left) take = left;
+        c.wseg_off += take;
+        left -= take;
+        if (c.wseg_off == c.wdest[c.wseg].second) {
+            c.wseg++;
+            c.wseg_off = 0;
+        }
     }
-    while (true) {
+}
+
+bool Server::ingest_bytes(Conn& c, const uint8_t* p, size_t n) {
+    while (n > 0) {
         if (c.state == RState::HDR) {
-            ssize_t r = recv(c.fd, reinterpret_cast<uint8_t*>(&c.hdr) + c.hdr_got,
-                             sizeof(WireHeader) - c.hdr_got, 0);
-            if (r == 0) return close_conn(*c.w, c.fd);
-            if (r < 0) {
-                if (errno == EAGAIN || errno == EWOULDBLOCK) return;
-                return close_conn(*c.w, c.fd);
-            }
-            bytes_in_ += uint64_t(r);
-            c.w->bytes_in.fetch_add(uint64_t(r), std::memory_order_relaxed);
-            c.hdr_got += size_t(r);
-            if (c.hdr_got < sizeof(WireHeader)) continue;
+            size_t take = sizeof(WireHeader) - c.hdr_got;
+            if (take > n) take = n;
+            memcpy(reinterpret_cast<uint8_t*>(&c.hdr) + c.hdr_got, p,
+                   take);
+            c.hdr_got += take;
+            p += take;
+            n -= take;
+            if (c.hdr_got < sizeof(WireHeader)) return true;
             if (!header_valid(c.hdr)) {
                 IST_WARN("bad header from fd=%d, closing", c.fd);
-                return close_conn(*c.w, c.fd);
+                return false;
             }
             c.body.resize(c.hdr.body_len);
             c.body_got = 0;
             c.state = RState::BODY;
             if (c.hdr.body_len == 0) {
                 handle_message(c);
-                if (c.dead) return close_conn(*c.w, c.fd);
-                continue;
+                if (c.dead) return false;
             }
         } else if (c.state == RState::BODY) {
-            ssize_t r = recv(c.fd, c.body.data() + c.body_got,
-                             c.body.size() - c.body_got, 0);
-            if (r == 0) return close_conn(*c.w, c.fd);
-            if (r < 0) {
-                if (errno == EAGAIN || errno == EWOULDBLOCK) return;
-                return close_conn(*c.w, c.fd);
-            }
-            bytes_in_ += uint64_t(r);
-            c.w->bytes_in.fetch_add(uint64_t(r), std::memory_order_relaxed);
-            c.body_got += size_t(r);
-            if (c.body_got < c.body.size()) continue;
+            size_t take = c.body.size() - c.body_got;
+            if (take > n) take = n;
+            memcpy(c.body.data() + c.body_got, p, take);
+            c.body_got += take;
+            p += take;
+            n -= take;
+            if (c.body_got < c.body.size()) return true;
             handle_message(c);
-            if (c.dead) return close_conn(*c.w, c.fd);
-        } else if (c.state == RState::PAYLOAD) {
-            // Scatter OP_WRITE payload straight into pool blocks — the TCP
-            // analogue of one-sided RDMA WRITE landing in the pool. One
-            // readv covers up to 64 destination runs (adjacent pool blocks
-            // merge into one iovec), so a 64-block batch costs one syscall
-            // instead of 64.
-            while (c.payload_left > 0) {
-                iovec iov[64];
-                int niov = 0;
-                uint64_t planned = 0;
-                size_t seg = c.wseg, seg_off = c.wseg_off;
-                while (niov < 64 && seg < c.wdest.size() &&
-                       planned < c.payload_left) {
-                    uint8_t* p = c.wdest[seg].first + seg_off;
-                    size_t room = c.wdest[seg].second - seg_off;
-                    if (room > c.payload_left - planned) {
-                        room = size_t(c.payload_left - planned);
-                    }
-                    if (niov > 0 &&
-                        static_cast<uint8_t*>(iov[niov - 1].iov_base) +
-                                iov[niov - 1].iov_len == p) {
-                        iov[niov - 1].iov_len += room;
-                    } else {
-                        iov[niov].iov_base = p;
-                        iov[niov].iov_len = room;
-                        niov++;
-                    }
-                    planned += room;
-                    seg++;
-                    seg_off = 0;
-                }
-                if (niov == 0) {  // excess payload beyond the plan: sink it
-                    if (c.sink.size() < (1u << 16)) c.sink.resize(1u << 16);
-                    iov[0].iov_base = c.sink.data();
-                    iov[0].iov_len = c.sink.size() > c.payload_left
-                                         ? size_t(c.payload_left)
-                                         : c.sink.size();
-                    niov = 1;
-                }
-                ssize_t r = readv(c.fd, iov, niov);
-                if (r == 0) return close_conn(*c.w, c.fd);
-                if (r < 0) {
-                    if (errno == EAGAIN || errno == EWOULDBLOCK) return;
-                    return close_conn(*c.w, c.fd);
-                }
-                bytes_in_ += uint64_t(r);
-                c.w->bytes_in.fetch_add(uint64_t(r),
-                                        std::memory_order_relaxed);
-                c.payload_left -= uint64_t(r);
-                size_t left = size_t(r);
-                while (left > 0 && c.wseg < c.wdest.size()) {
-                    size_t take = c.wdest[c.wseg].second - c.wseg_off;
-                    if (take > left) take = left;
-                    c.wseg_off += take;
-                    left -= take;
+            if (c.dead) return false;
+        } else {
+            // PAYLOAD/DRAIN bytes that already landed in a staging or
+            // provided buffer: the copied slow path (bounded by the
+            // engine's staging size — the engine switches to direct
+            // pool reads for the remainder). Scatter through the same
+            // cursor walk the direct path uses; bytes past the plan
+            // (or all of DRAIN) are simply dropped, matching the sink.
+            size_t take = c.payload_left < n ? size_t(c.payload_left) : n;
+            size_t done = 0;
+            if (c.state == RState::PAYLOAD) {
+                while (done < take && c.wseg < c.wdest.size()) {
+                    size_t room = c.wdest[c.wseg].second - c.wseg_off;
+                    size_t m = take - done < room ? take - done : room;
+                    memcpy(c.wdest[c.wseg].first + c.wseg_off, p + done,
+                           m);
+                    c.wseg_off += m;
+                    done += m;
                     if (c.wseg_off == c.wdest[c.wseg].second) {
                         c.wseg++;
                         c.wseg_off = 0;
                     }
                 }
             }
-            finish_write(c);
-            if (c.dead) return close_conn(*c.w, c.fd);
-        } else {  // DRAIN
-            if (c.sink.size() < (1u << 16)) c.sink.resize(1u << 16);
-            while (c.payload_left > 0) {
-                size_t room = c.sink.size();
-                if (room > c.payload_left) room = size_t(c.payload_left);
-                ssize_t r = recv(c.fd, c.sink.data(), room, 0);
-                if (r == 0) return close_conn(*c.w, c.fd);
-                if (r < 0) {
-                    if (errno == EAGAIN || errno == EWOULDBLOCK) return;
-                    return close_conn(*c.w, c.fd);
+            c.payload_left -= uint64_t(take);
+            p += take;
+            n -= take;
+            if (c.payload_left == 0) {
+                if (c.state == RState::PAYLOAD) {
+                    finish_write(c);
+                    if (c.dead) return false;
+                } else {
+                    c.state = RState::HDR;
+                    c.hdr_got = 0;
                 }
-                c.payload_left -= uint64_t(r);
+            } else {
+                return true;  // engine reads the rest directly
             }
-            c.state = RState::HDR;
-            c.hdr_got = 0;
-        }
-    }
-}
-
-void Server::conn_writable(Conn& c) {
-    if (!flush_out(c)) {
-        close_conn(*c.w, c.fd);
-        return;
-    }
-    update_epoll(c);
-}
-
-bool Server::flush_out(Conn& c) {
-    // Injected send failure: callers treat false as a fatal socket
-    // error and close the connection (queued OutMsgs drop their
-    // BlockRefs — pins unwind exactly like a real peer reset).
-    if (!c.outq.empty() && IST_FAILPOINT("sock.send")) {
-        IST_WARN("sock.send failpoint: dropping fd=%d", c.fd);
-        return false;
-    }
-    while (!c.outq.empty()) {
-        OutMsg& m = c.outq.front();
-        iovec iov[64];
-        int niov = 0;
-        if (!m.meta_done) {
-            iov[niov].iov_base = m.meta.data() + m.off;
-            iov[niov].iov_len = m.meta.size() - m.off;
-            niov++;
-        }
-        for (size_t s = m.seg_idx; s < m.segs.size() && niov < 64; ++s) {
-            size_t skip = (s == m.seg_idx && m.meta_done) ? m.off : 0;
-            iov[niov].iov_base = const_cast<uint8_t*>(m.segs[s].first) + skip;
-            iov[niov].iov_len = m.segs[s].second - skip;
-            niov++;
-        }
-        ssize_t w = writev(c.fd, iov, niov);
-        if (w < 0) {
-            if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
-            return false;
-        }
-        bytes_out_ += uint64_t(w);
-        c.w->bytes_out.fetch_add(uint64_t(w), std::memory_order_relaxed);
-        size_t left = size_t(w);
-        // Advance cursors.
-        if (!m.meta_done) {
-            size_t take = std::min(left, m.meta.size() - m.off);
-            m.off += take;
-            left -= take;
-            if (m.off == m.meta.size()) {
-                m.meta_done = true;
-                m.off = 0;
-            }
-        }
-        while (left > 0 && m.seg_idx < m.segs.size()) {
-            size_t take = std::min(left, m.segs[m.seg_idx].second - m.off);
-            m.off += take;
-            left -= take;
-            if (m.off == m.segs[m.seg_idx].second) {
-                m.seg_idx++;
-                m.off = 0;
-            }
-        }
-        if (m.meta_done && m.seg_idx == m.segs.size()) {
-            c.outq_bytes -= m.total;
-            outq_total_.fetch_sub(m.total, std::memory_order_relaxed);
-            c.outq.pop_front();  // drops BlockRefs → unpins
-        } else if (w == 0) {
-            return true;
         }
     }
     return true;
@@ -1061,11 +1075,11 @@ void Server::respond(Conn& c, uint64_t seq, uint8_t op,
     c.outq_bytes += m.total;
     outq_total_.fetch_add(m.total, std::memory_order_relaxed);
     c.outq.push_back(std::move(m));
-    if (!flush_out(c)) {
-        c.dead = true;
-        return;
-    }
-    update_epoll(c);
+    // Transmission belongs to the transport engine: epoll flushes
+    // opportunistically inline (and arms EPOLLOUT for the rest), uring
+    // submits a send SQE. A fatal transport error surfaces as c.dead
+    // and the caller's close path unwinds the pins.
+    c.w->engine->output_ready(c);
 }
 
 void Server::handle_message(Conn& c) {

@@ -9,22 +9,27 @@
 //
 // TPU-native design — MULTI-WORKER data plane (deviation from the
 // reference's single uvloop; see docs/design.md "Threading model" and
-// PARITY.md): N epoll worker loops on dedicated threads serve both data
+// PARITY.md): N worker loops on dedicated threads serve both data
 // paths. Worker 0 owns the listen socket and assigns each accepted
 // connection to the least-loaded worker; a connection then lives its
 // whole life on that worker, so per-connection parsing stays serial (the
 // property every ack/ordering guarantee below relies on) while different
 // connections' socket↔pool byte movement runs in parallel across cores.
-// Shared state is thread-safe underneath: the KV index is lock-striped
-// (kv_index.h), the pool allocator is arena-sharded (mempool.h), and the
-// disk tier locks internally. workers=1 (the default) degrades to exactly
-// the historical single-loop behavior.
+// Each worker's event loop and socket IO ride a pluggable TRANSPORT
+// ENGINE (engine.h): epoll readiness (the portable default fallback) or
+// io_uring completions with registered pool buffers and zero-copy sends
+// (docs/design.md "Transport engine"). Shared state is thread-safe
+// underneath: the KV index is lock-striped (kv_index.h), the pool
+// allocator is arena-sharded (mempool.h), and the disk tier locks
+// internally. workers=1 (the default) degrades to exactly the
+// historical single-loop behavior.
 //   - STREAM path (DCN stand-in for RDMA): OP_WRITE payload bytes are
 //     scattered by the owning worker directly from the socket into pool
-//     blocks (no staging buffer), and OP_READ responses are gathered with
-//     writev straight out of pool blocks, with BlockRefs held by the send
-//     queue until the bytes are on the wire — the moral equivalent of the
-//     reference pinning blocks in wr_id during server-push RDMA WRITE
+//     blocks (no staging buffer), and OP_READ responses are gathered
+//     straight out of pool blocks (writev on epoll; SEND_ZC on uring),
+//     with BlockRefs held by the send queue until the bytes are on the
+//     wire — the moral equivalent of the reference pinning blocks in
+//     wr_id during server-push RDMA WRITE
 //     (infinistore.cpp:432,492,320-324).
 //   - SHM path (CUDA-IPC stand-in): clients map the pool's POSIX shared
 //     memory and copy one-sided; the server only runs the
@@ -54,12 +59,15 @@
 #include <vector>
 
 #include "common.h"
+#include "engine.h"
 #include "kv_index.h"
 #include "lock_rank.h"
 #include "mempool.h"
 #include "protocol.h"
 #include "thread_annotations.h"
 #include "trace.h"
+
+struct iovec;  // <sys/uio.h>; engines pass scatter plans through it
 
 namespace istpu {
 
@@ -86,7 +94,7 @@ struct ServerConfig {
     // push path with signal/32, window 4096 WRs
     // (libinfinistore.cpp:898-987); this is the byte-denominated analogue.
     uint64_t max_outq_bytes = 64ull << 20;
-    // Data-plane worker loops. 1 (default) = the historical single epoll
+    // Data-plane worker loops. 1 (default) = the historical single
     // loop, byte-compatible with every prior client. 0 = auto-size to
     // min(4, cores - 2), floored at 1. The ISTPU_SERVER_WORKERS env var
     // overrides whatever is configured here (operator escape hatch).
@@ -114,6 +122,158 @@ struct ServerConfig {
     // ist_server_trace / GET /trace. Compiled in, OFF by default; the
     // ISTPU_TRACE env var (1/0) overrides this flag at start().
     bool trace = false;
+    // Transport engine for the worker IO loops (engine.h): "epoll"
+    // (readiness loop, portable), "uring" (io_uring completion loop:
+    // pool arenas registered as fixed buffers, zero-copy sends,
+    // multishot recv, optional SQPOLL), or "auto" (probe io_uring at
+    // start, fall back to epoll with one log line). The ISTPU_ENGINE
+    // env var overrides; "uring" on an unsupported kernel fails
+    // start() loudly instead of degrading mid-op.
+    std::string engine = "auto";
+};
+
+// ---------------------------------------------------------------------------
+// Per-connection protocol state. Engine-agnostic: both transport
+// engines drive exactly this state machine (engine.h) — epoll pulls
+// bytes through it synchronously, io_uring pushes completion buffers
+// through Server::ingest_bytes / payload_iov / payload_advance.
+// ---------------------------------------------------------------------------
+enum class RState { HDR, BODY, PAYLOAD, DRAIN };
+
+struct Worker;
+
+struct OutMsg {
+    std::vector<uint8_t> meta;  // header + body
+    // Payload segments gathered from pool blocks (reads).
+    std::vector<std::pair<const uint8_t*, size_t>> segs;
+    std::vector<BlockRef> refs;  // keep blocks alive until sent
+    // Heap payloads (disk-served cold reads / limbo entries): the
+    // read pipeline answers a non-resident key from owned memory
+    // the segs point into, kept alive here until the bytes are on
+    // the wire (type-erased: a raw uninitialized read buffer or a
+    // limbo entry's vector).
+    std::vector<std::shared_ptr<const void>> hrefs;
+    size_t seg_idx = 0;
+    size_t off = 0;  // offset within meta or segs[seg_idx]
+    bool meta_done = false;
+    size_t total = 0;  // meta + payload bytes, for outq accounting
+};
+
+struct Conn {
+    int fd = -1;
+    uint64_t id = 0;  // unique per accepted connection; owns its tokens
+    Worker* w = nullptr;  // owning worker (fixed for the conn's life)
+    // Engine-private per-connection state (io_uring submission
+    // bookkeeping); owned by the engine, which may keep it alive past
+    // close until in-flight completions drain. Null under epoll.
+    void* eng = nullptr;
+    uint64_t outq_bytes = 0;  // bytes queued in outq (backpressure cap)
+    RState state = RState::HDR;
+    WireHeader hdr{};
+    size_t hdr_got = 0;
+    std::vector<uint8_t> body;
+    size_t body_got = 0;
+    // OP_WRITE / OP_PUT scatter plan.
+    std::vector<std::pair<uint8_t*, uint32_t>> wdest;  // (ptr,size)
+    std::vector<uint64_t> wtokens;
+    uint32_t wblock_size = 0;
+    size_t wseg = 0;
+    size_t wseg_off = 0;
+    uint64_t payload_left = 0;
+    std::deque<OutMsg> outq;
+    bool want_write = false;  // epoll engine: EPOLLOUT currently armed
+    bool dead = false;  // fatal error; closed after unwinding
+    bool wput_oom = false;  // OP_PUT hit OOM: fail all-or-nothing
+    long long op_t0 = 0;    // message arrival time (op_stats)
+    // Tracing: the current op's client trace id (FLAG_TRACE frames;
+    // 0 = untraced) and the payload scatter's start time (the COPY
+    // sub-span for OP_WRITE/OP_PUT).
+    uint64_t trace_id = 0;
+    long long payload_t0 = 0;
+    // Handoff-queue wait accounting: stamped when the acceptor
+    // queues this connection to another worker (0 = adopted
+    // locally, SO_REUSEPORT zero-hop path).
+    long long handoff_t0 = 0;
+    // Per-connection sink for payload of unknown/purged tokens; sized
+    // before pointer capture and never resized mid-scatter.
+    std::vector<uint8_t> sink;
+    // Uncommitted tokens of a dead connection are aborted via
+    // KVIndex::abort_all_for_owner (slab scan) — an improvement over
+    // the reference, which leaks uncommitted kv_map entries on
+    // client crash, without paying two hash ops per key here.
+    // Pin leases taken on this connection (lease id → pinned bytes);
+    // released if it dies, so a crashed reader cannot pin pool blocks
+    // forever. OP_RELEASE only accepts leases in this map — lease ids
+    // are sequential, so without the owner check any client could
+    // guess and release another reader's lease mid-copy (the same
+    // forgery class as foreign write tokens).
+    std::unordered_map<uint64_t, uint64_t> open_leases;
+    // Bytes currently pinned by this connection's leases; OP_PIN past
+    // cfg_.max_outq_bytes gets BUSY like over-cap OP_READs, so an SHM
+    // client that never releases cannot pin the whole pool either.
+    uint64_t lease_bytes = 0;
+    // Block leases (OP_LEASE): raw pool blocks granted to this
+    // connection for zero-RTT client-side allocation. Blocks are
+    // consumed by OP_COMMIT_BATCH carving (mirrored deterministically
+    // client-side, so the wire never carries offsets a client could
+    // forge); unconsumed blocks return to the pool on
+    // OP_LEASE_REVOKE or when the connection dies — exactly the
+    // uncommitted-alloc cleanup contract. Lease state is CONNECTION-
+    // local (never shared across workers): a client's second
+    // connection, even when assigned to a different worker, can
+    // neither commit into nor revoke this lease, and reclaim on
+    // death runs on the owning worker against the thread-safe pool.
+    struct LeaseRun {
+        uint32_t pool_idx;
+        uint64_t offset;   // bytes from the pool base
+        uint32_t nblocks;
+    };
+    struct BlockLease {
+        std::vector<LeaseRun> runs;
+        size_t run_idx = 0;     // carve cursor: current run...
+        uint32_t block_off = 0; // ...and blocks consumed within it
+        uint64_t blocks_left = 0;  // unconsumed blocks, all runs
+    };
+    std::unordered_map<uint64_t, BlockLease> block_leases;
+};
+
+// One worker loop + thread. Connections are owned by exactly one
+// worker. With SO_REUSEPORT (the default for workers > 1) every
+// worker owns its own listen socket bound to the same port and the
+// KERNEL spreads accepts — a new connection is adopted by its
+// accepting worker with no cross-thread hop at all. Where
+// SO_REUSEPORT is unavailable (or ISTPU_NO_REUSEPORT=1), worker 0
+// accepts and hands off through pending (mutex + eventfd wake) to
+// the least-loaded worker — the historical path. The event loop and
+// socket IO themselves belong to `engine` (engine.h).
+struct Worker {
+    int idx = 0;
+    int wake_fd = -1;
+    // This worker's own SO_REUSEPORT listen socket (-1 in fallback
+    // mode for workers > 0; worker 0 always watches listen_fd_).
+    int listen_fd = -1;
+    // Transport engine (epoll or io_uring) driving this worker's loop.
+    std::unique_ptr<Engine> engine;
+    std::thread thread;
+    std::unordered_map<int, std::unique_ptr<Conn>> conns;  // loop only
+    Mutex pending_mu{kRankWorkerPending};
+    // Acceptor → worker handoff queue.
+    std::vector<std::unique_ptr<Conn>> pending GUARDED_BY(pending_mu);
+    std::atomic<uint32_t> nconns{0};  // load metric for assignment
+    // Per-worker traffic counters (stats_json "per_worker"): makes
+    // load imbalance — one hot connection pinning one worker —
+    // visible to operators.
+    std::atomic<uint64_t> ops{0};
+    std::atomic<uint64_t> bytes_in{0};
+    std::atomic<uint64_t> bytes_out{0};
+    // Transport-engine counters (uring engine only; epoll leaves them
+    // 0): SQEs submitted, zero-copy sends issued, payload bytes moved
+    // without a bounce copy (direct pool readv/read_fixed + ZC sends).
+    std::atomic<uint64_t> eng_sqes{0};
+    std::atomic<uint64_t> eng_zc_sends{0};
+    std::atomic<uint64_t> eng_copies_avoided{0};
+    // This worker's span ring (bound to its thread in loop()).
+    TraceRing* ring = nullptr;
 };
 
 class Server {
@@ -121,7 +281,8 @@ class Server {
     explicit Server(const ServerConfig& cfg);
     ~Server();
 
-    // Binds + spawns the worker threads. Returns false on bind failure.
+    // Binds + spawns the worker threads. Returns false on bind failure
+    // (or engine=uring forced on a host without io_uring support).
     bool start();
     void stop();
 
@@ -145,133 +306,18 @@ class Server {
     uint16_t bound_port() const { return bound_port_; }
     const std::string& shm_prefix() const { return cfg_.shm_prefix; }
     uint32_t workers() const { return uint32_t(workers_.size()); }
+    // The transport engine actually selected at start() ("epoll" until
+    // then; "uring" only after a successful probe + ring setup).
+    const std::string& engine_name() const { return engine_name_; }
 
    private:
-    enum class RState { HDR, BODY, PAYLOAD, DRAIN };
-
-    struct OutMsg {
-        std::vector<uint8_t> meta;  // header + body
-        // Payload segments gathered from pool blocks (reads).
-        std::vector<std::pair<const uint8_t*, size_t>> segs;
-        std::vector<BlockRef> refs;  // keep blocks alive until sent
-        // Heap payloads (disk-served cold reads / limbo entries): the
-        // read pipeline answers a non-resident key from owned memory
-        // the segs point into, kept alive here until the bytes are on
-        // the wire (type-erased: a raw uninitialized read buffer or a
-        // limbo entry's vector).
-        std::vector<std::shared_ptr<const void>> hrefs;
-        size_t seg_idx = 0;
-        size_t off = 0;  // offset within meta or segs[seg_idx]
-        bool meta_done = false;
-        size_t total = 0;  // meta + payload bytes, for outq accounting
-    };
-
-    struct Worker;
-
-    struct Conn {
-        int fd = -1;
-        uint64_t id = 0;  // unique per accepted connection; owns its tokens
-        Worker* w = nullptr;  // owning worker (fixed for the conn's life)
-        uint64_t outq_bytes = 0;  // bytes queued in outq (backpressure cap)
-        RState state = RState::HDR;
-        WireHeader hdr{};
-        size_t hdr_got = 0;
-        std::vector<uint8_t> body;
-        size_t body_got = 0;
-        // OP_WRITE / OP_PUT scatter plan.
-        std::vector<std::pair<uint8_t*, uint32_t>> wdest;  // (ptr,size)
-        std::vector<uint64_t> wtokens;
-        uint32_t wblock_size = 0;
-        size_t wseg = 0;
-        size_t wseg_off = 0;
-        uint64_t payload_left = 0;
-        std::deque<OutMsg> outq;
-        bool want_write = false;
-        bool dead = false;  // fatal error; closed after unwinding
-        bool wput_oom = false;  // OP_PUT hit OOM: fail all-or-nothing
-        long long op_t0 = 0;    // message arrival time (op_stats)
-        // Tracing: the current op's client trace id (FLAG_TRACE frames;
-        // 0 = untraced) and the payload scatter's start time (the COPY
-        // sub-span for OP_WRITE/OP_PUT).
-        uint64_t trace_id = 0;
-        long long payload_t0 = 0;
-        // Handoff-queue wait accounting: stamped when the acceptor
-        // queues this connection to another worker (0 = adopted
-        // locally, SO_REUSEPORT zero-hop path).
-        long long handoff_t0 = 0;
-        // Per-connection sink for payload of unknown/purged tokens; sized
-        // before pointer capture and never resized mid-scatter.
-        std::vector<uint8_t> sink;
-        // Uncommitted tokens of a dead connection are aborted via
-        // KVIndex::abort_all_for_owner (slab scan) — an improvement over
-        // the reference, which leaks uncommitted kv_map entries on
-        // client crash, without paying two hash ops per key here.
-        // Pin leases taken on this connection (lease id → pinned bytes);
-        // released if it dies, so a crashed reader cannot pin pool blocks
-        // forever. OP_RELEASE only accepts leases in this map — lease ids
-        // are sequential, so without the owner check any client could
-        // guess and release another reader's lease mid-copy (the same
-        // forgery class as foreign write tokens).
-        std::unordered_map<uint64_t, uint64_t> open_leases;
-        // Bytes currently pinned by this connection's leases; OP_PIN past
-        // cfg_.max_outq_bytes gets BUSY like over-cap OP_READs, so an SHM
-        // client that never releases cannot pin the whole pool either.
-        uint64_t lease_bytes = 0;
-        // Block leases (OP_LEASE): raw pool blocks granted to this
-        // connection for zero-RTT client-side allocation. Blocks are
-        // consumed by OP_COMMIT_BATCH carving (mirrored deterministically
-        // client-side, so the wire never carries offsets a client could
-        // forge); unconsumed blocks return to the pool on
-        // OP_LEASE_REVOKE or when the connection dies — exactly the
-        // uncommitted-alloc cleanup contract. Lease state is CONNECTION-
-        // local (never shared across workers): a client's second
-        // connection, even when assigned to a different worker, can
-        // neither commit into nor revoke this lease, and reclaim on
-        // death runs on the owning worker against the thread-safe pool.
-        struct LeaseRun {
-            uint32_t pool_idx;
-            uint64_t offset;   // bytes from the pool base
-            uint32_t nblocks;
-        };
-        struct BlockLease {
-            std::vector<LeaseRun> runs;
-            size_t run_idx = 0;     // carve cursor: current run...
-            uint32_t block_off = 0; // ...and blocks consumed within it
-            uint64_t blocks_left = 0;  // unconsumed blocks, all runs
-        };
-        std::unordered_map<uint64_t, BlockLease> block_leases;
-    };
-
-    // One epoll loop + thread. Connections are owned by exactly one
-    // worker. With SO_REUSEPORT (the default for workers > 1) every
-    // worker owns its own listen socket bound to the same port and the
-    // KERNEL spreads accepts — a new connection is adopted by its
-    // accepting worker with no cross-thread hop at all. Where
-    // SO_REUSEPORT is unavailable (or ISTPU_NO_REUSEPORT=1), worker 0
-    // accepts and hands off through pending (mutex + eventfd wake) to
-    // the least-loaded worker — the historical path.
-    struct Worker {
-        int idx = 0;
-        int epoll_fd = -1;
-        int wake_fd = -1;
-        // This worker's own SO_REUSEPORT listen socket (-1 in fallback
-        // mode for workers > 0; worker 0 always watches listen_fd_).
-        int listen_fd = -1;
-        std::thread thread;
-        std::unordered_map<int, std::unique_ptr<Conn>> conns;  // loop only
-        Mutex pending_mu{kRankWorkerPending};
-        // Acceptor → worker handoff queue.
-        std::vector<std::unique_ptr<Conn>> pending GUARDED_BY(pending_mu);
-        std::atomic<uint32_t> nconns{0};  // load metric for assignment
-        // Per-worker traffic counters (stats_json "per_worker"): makes
-        // load imbalance — one hot connection pinning one worker —
-        // visible to operators.
-        std::atomic<uint64_t> ops{0};
-        std::atomic<uint64_t> bytes_in{0};
-        std::atomic<uint64_t> bytes_out{0};
-        // This worker's span ring (bound to its thread in loop()).
-        TraceRing* ring = nullptr;
-    };
+    // The transport engines drive the protocol state machine through
+    // the private helpers below (ingest_bytes / payload_iov /
+    // payload_advance / handle_message / finish_write / close_conn)
+    // and the per-worker bookkeeping; they are the only other writers
+    // of connection state, always on the owning worker thread.
+    friend class EngineEpoll;
+    friend class EngineUring;
 
     void loop(Worker& w);
     void adopt_pending(Worker& w);
@@ -279,14 +325,28 @@ class Server {
     // (adopt locally), or — fallback mode, worker 0 only — the shared
     // listen_fd_ with least-loaded handoff.
     void accept_ready(Worker& w, int ready_fd);
-    void conn_readable(Conn& c);
-    void conn_writable(Conn& c);
-    bool flush_out(Conn& c);  // false => fatal error, close
     void close_conn(Worker& w, int fd);
     void handle_message(Conn& c);  // full header+body (non-WRITE) received
     void finish_write(Conn& c);    // WRITE/PUT payload fully scattered
     void begin_put(Conn& c);       // parse OP_PUT body, build scatter plan
-    void update_epoll(Conn& c);
+
+    // --- engine-shared RX state machine -------------------------------
+    // Build the next read-scatter plan for a PAYLOAD/DRAIN connection:
+    // up to `max` iovecs over the remaining OP_WRITE/OP_PUT block
+    // destinations (adjacent pool runs merged), the per-connection
+    // sink when the plan is exhausted or the state is DRAIN. Never
+    // returns 0 while payload_left > 0.
+    int payload_iov(Conn& c, struct iovec* iov, int max);
+    // Consume `n` bytes read INTO the current plan (cursor walk +
+    // payload_left). Does not finish the op — callers check
+    // payload_left afterwards (engines differ in where that happens).
+    void payload_advance(Conn& c, size_t n);
+    // Push-mode byte feed (io_uring staged/multishot recv buffers):
+    // runs header parse, body assembly, message dispatch and the
+    // copied-payload slow path across as many messages as `n` covers.
+    // Returns false when the connection must be closed (protocol
+    // error or a handler marked it dead).
+    bool ingest_bytes(Conn& c, const uint8_t* p, size_t n);
 
     void respond(Conn& c, uint64_t seq, uint8_t op,
                  std::vector<uint8_t> body_bytes,
@@ -319,6 +379,7 @@ class Server {
     uint16_t bound_port_ = 0;
     int listen_fd_ = -1;
     bool reuseport_ = false;  // per-worker SO_REUSEPORT acceptors active
+    std::string engine_name_ = "epoll";  // resolved at start()
     std::atomic<bool> running_{false};
     std::vector<std::unique_ptr<Worker>> workers_;
 

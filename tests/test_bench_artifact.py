@@ -190,6 +190,38 @@ def test_trace_leg_emits_overhead_keys():
     assert out["trace_spans"] > 0  # the traced leg actually traced
 
 
+def test_engine_ab_leg_emits_keys():
+    """The transport-engine A/B leg (ISSUE 8) must land its keys in
+    the artifact: the epoll aggregates + raw denominator always, and
+    either the uring side (uring_stream_agg_GBps / uring_vs_epoll /
+    recomputed *_vs_raw) or an explicit uring_skipped reason on hosts
+    without io_uring — never an error, never silence."""
+    env = _env(600)
+    env["ISTPU_ENGINE_AB_KEYS"] = "512"  # small: keep the test fast
+    p = subprocess.run(
+        [sys.executable, BENCH, "--engine-ab-leg", "0"], env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert p.returncode == 0, p.stderr[-400:]
+    outs = _parse_artifacts(
+        [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+    )
+    assert outs, p.stdout[-400:]
+    out = outs[-1]
+    assert "engine_ab_error" not in out, out
+    assert out["epoll_stream_agg_GBps"] > 0
+    assert out["epoll_stream_64k_agg_GBps"] > 0
+    assert out["engine_raw_tcp_GBps"] > 0
+    if "uring_skipped" in out:
+        assert "io_uring" in out["uring_skipped"] or "selected" in (
+            out["uring_skipped"]
+        )
+    else:
+        assert out["uring_stream_agg_GBps"] > 0
+        assert out["uring_vs_epoll"] > 0
+        assert out["uring_stream_vs_raw"] > 0
+
+
 def test_chaos_leg_emits_overhead_keys():
     """The failpoints-disarmed overhead leg (ISSUE 6) must land its
     keys in the artifact: read p50 with the failpoint registry

@@ -144,6 +144,29 @@ def test_undocumented_failpoint_fails(tree):
     assert "catalog" in r.stderr or "undocumented" in r.stderr
 
 
+def test_engine_stat_rename_fails(tree):
+    # Engine-knob drift (ISSUE 8): rename the uring counter in the
+    # native emitter only (both the aggregate and the per-worker
+    # entry); the Prometheus renderer still reads uring_zc_sends.
+    mutate(tree, "native/src/server.cc", '\\"uring_zc_sends\\":',
+           '\\"uring_zc_send_ops\\":', count=8)
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "metrics:" in r.stderr and "uring_zc_sends" in r.stderr
+
+
+def test_engine_failpoint_catalog_drift_fails(tree):
+    # The engine.uring_setup probe failpoint stays compiled in
+    # (engine_uring.cc) while its catalog row is renamed away: the
+    # linter must flag the missing catalog entry.
+    mutate(tree, "native/src/failpoint.h", "//   engine.uring_setup",
+           "//   engine.uring_probe")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "engine.uring_setup" in r.stderr
+    assert "catalog" in r.stderr
+
+
 def test_uncited_suppression_fails(tree):
     # Every tsan.supp entry must carry a live `# cite: file:line`.
     mutate(tree, "native/tsan.supp",

@@ -105,7 +105,7 @@ def parse_failpoint_sites(root):
         if not fn.endswith((".cc", ".h")):
             continue
         with open(os.path.join(src, fn), encoding="utf-8") as f:
-            sites |= set(re.findall(r'IST_FAILPOINT\("([a-z.]+)"\)',
+            sites |= set(re.findall(r'IST_FAILPOINT\("([a-z_.]+)"\)',
                                     f.read()))
     return sites
 
@@ -117,13 +117,14 @@ def parse_failpoint_catalog(root):
                   re.S)
     if not m:
         return set()
-    return set(re.findall(r"^//\s+([a-z]+\.[a-z]+)\s", m.group(0), re.M))
+    return set(re.findall(r"^//\s+([a-z_]+\.[a-z_]+)\s", m.group(0),
+                          re.M))
 
 
 def expand_brace_names(text):
     """All failpoint-style names in prose, expanding a.{b,c} groups."""
-    names = set(re.findall(r"\b([a-z]+\.[a-z]+)\b", text))
-    for m in re.finditer(r"\b([a-z]+)\.\{([a-z,]+)\}", text):
+    names = set(re.findall(r"\b([a-z_]+\.[a-z_]+)\b", text))
+    for m in re.finditer(r"\b([a-z_]+)\.\{([a-z_,]+)\}", text):
         for part in m.group(2).split(","):
             names.add(f"{m.group(1)}.{part}")
     return names
