@@ -134,6 +134,14 @@ constexpr uint32_t kCqeFNotif = 1u << 3;        // IORING_CQE_F_NOTIF
 constexpr int kCqeBufferShift = 16;             // IORING_CQE_BUFFER_SHIFT
 constexpr unsigned kRegisterPbufRing = 22;      // (5.19)
 constexpr unsigned kUnregisterPbufRing = 23;
+// IORING_FEAT_SQPOLL_NONFIXED (5.11) — may be absent from the build
+// header; the value is frozen uapi like the opcodes above
+// (POLL_32BITS holds 1u<<6; NONFIXED is the next bit up).
+#ifdef IORING_FEAT_SQPOLL_NONFIXED
+constexpr uint32_t kFeatSqpollNonfixed = IORING_FEAT_SQPOLL_NONFIXED;
+#else
+constexpr uint32_t kFeatSqpollNonfixed = 1u << 7;
+#endif
 
 struct PbufRingReg {  // struct io_uring_buf_reg (5.19 uapi)
     uint64_t ring_addr;
@@ -166,6 +174,14 @@ int sys_uring_register(int fd, unsigned opcode, const void* arg,
 // Minimal liburing-free ring: setup + the three mmaps, a shadow SQ
 // tail, release/acquire publication exactly as the io_uring ABI
 // specifies. Single-threaded by construction (worker-owned).
+//
+// SQE allocation follows liburing's model: get_sqe() only advances the
+// PRIVATE local_tail; the shared *sq_tail is published in submit(),
+// after the caller has finished writing every allocated SQE. Under
+// SQPOLL the kernel poller consumes entries the instant the shared
+// tail moves, so publishing at allocation would let it read a zeroed
+// or half-written SQE (a dropped NOP at best, IO against the wrong
+// fd/addr at worst).
 struct RawRing {
     int fd = -1;
     io_uring_params p{};
@@ -190,18 +206,30 @@ struct RawRing {
     bool wedged = false;      // unrecoverable enter failure
 
     bool open(unsigned entries, bool sqpoll, std::string* why) {
-        memset(&p, 0, sizeof(p));
         if (sqpoll) {
+            memset(&p, 0, sizeof(p));
             p.flags |= IORING_SETUP_SQPOLL;
             p.sq_thread_idle = 2000;  // ms before the poller naps
+            fd = sys_uring_setup(entries, &p);
+            if (fd >= 0 && (p.features & kFeatSqpollNonfixed) == 0) {
+                // Pre-5.11 SQPOLL only accepts IOSQE_FIXED_FILE
+                // (registered) fds; this engine submits plain socket
+                // fds, so every recv/send would EBADF. Setup succeeds
+                // there for privileged processes, so the feature bit —
+                // not the setup result — is the gate.
+                IST_WARN("io_uring SQPOLL lacks SQPOLL_NONFIXED "
+                         "(pre-5.11 kernel); using the plain ring");
+                close(fd);
+                fd = -1;
+            } else if (fd < 0) {
+                // SQPOLL needs privileges on pre-5.13 kernels: degrade
+                // to the plain ring rather than refusing the engine.
+                IST_WARN("io_uring SQPOLL setup failed (%s); retrying "
+                         "without SQPOLL",
+                         strerror(errno));
+            }
         }
-        fd = sys_uring_setup(entries, &p);
-        if (fd < 0 && sqpoll) {
-            // SQPOLL needs privileges on pre-5.13 kernels: degrade to
-            // the plain ring rather than refusing the engine.
-            IST_WARN("io_uring SQPOLL setup failed (%s); retrying "
-                     "without SQPOLL",
-                     strerror(errno));
+        if (fd < 0) {
             memset(&p, 0, sizeof(p));
             fd = sys_uring_setup(entries, &p);
         }
@@ -260,7 +288,10 @@ struct RawRing {
 
     // Submit what is pending; wait_nr > 0 additionally blocks for
     // completions (bounded by the engine's persistent TIMEOUT SQE).
+    // This is the single publication point for the shared SQ tail —
+    // every SQE up to local_tail is fully written by now.
     bool submit(unsigned wait_nr) {
+        __atomic_store_n(sq_tail, local_tail, __ATOMIC_RELEASE);
         while (true) {
             unsigned flags = 0;
             unsigned to_submit = pending;
@@ -300,8 +331,10 @@ struct RawRing {
             if (local_tail - head < p.sq_entries) {
                 io_uring_sqe* e = &sqes[local_tail & *sq_mask];
                 memset(e, 0, sizeof(*e));
+                // Shadow-tail only: the entry is not visible to the
+                // kernel until submit() publishes *sq_tail, after the
+                // caller has filled it in.
                 local_tail++;
-                __atomic_store_n(sq_tail, local_tail, __ATOMIC_RELEASE);
                 pending++;
                 return e;
             }
@@ -314,13 +347,17 @@ struct RawRing {
 
     template <typename Fn>
     void reap(Fn&& fn) {
-        unsigned head = *cq_head;
+        // *cq_head is re-read every iteration rather than shadowed in
+        // a local: fn can reap again underneath us (flush_for_close
+        // drains the CQ mid-dispatch when a close hits CQ
+        // backpressure), and a stale local head would re-deliver
+        // entries the nested reap already consumed.
         while (true) {
+            unsigned head = *cq_head;
             unsigned tail = __atomic_load_n(cq_tail, __ATOMIC_ACQUIRE);
             if (head == tail) break;
             io_uring_cqe cqe = cqes[head & *cq_mask];
-            head++;
-            __atomic_store_n(cq_head, head, __ATOMIC_RELEASE);
+            __atomic_store_n(cq_head, head + 1, __ATOMIC_RELEASE);
             fn(cqe);
         }
     }
@@ -405,6 +442,11 @@ class EngineUring final : public Engine {
         bool used = false;
         bool data_done = false;
         bool notif_done = false;
+        // Count this send's bytes into uring_copies_avoided at the
+        // data CQE (from cqe.res, the bytes actually transmitted) —
+        // counting at submission would tally the full remainder again
+        // on every partial-send resubmit.
+        bool count_copies = false;
         uint64_t conn_id = 0;
         std::shared_ptr<OutMsg> msg;
     };
@@ -489,6 +531,7 @@ class EngineUring final : public Engine {
     void on_zc(uint32_t slot, const io_uring_cqe& cqe);
 
     void dispatch(const io_uring_cqe& cqe);
+    void flush_for_close();
 
     Server& s_;
     Worker& w_;
@@ -512,6 +555,11 @@ class EngineUring final : public Engine {
     uint16_t pbuf_tail_ = 0;
     std::vector<uint8_t> pbuf_mem_;
     std::unordered_map<uint64_t, std::unique_ptr<UConn>> conns_;
+    // CQEs reaped inside flush_for_close (which can run inside
+    // dispatch) are parked here and dispatched at the top of the next
+    // poll() — dispatching them in place would re-enter the connection
+    // handlers mid-frame.
+    std::vector<io_uring_cqe> deferred_;
     std::vector<ZcSlot> zc_slots_;
     std::vector<uint32_t> zc_free_;
     struct __kernel_timespec ts_ {};
@@ -658,6 +706,7 @@ void EngineUring::shutdown() {
     // state. The ring fd is closed, so the kernel no longer touches
     // the pages.
     conns_.clear();
+    deferred_.clear();  // parked CQEs index state that just died
     zc_slots_.clear();
     zc_free_.clear();
     regbufs_.clear();
@@ -676,9 +725,55 @@ void EngineUring::poll() {
         nanosleep(&ts, nullptr);
         return;
     }
+    if (!deferred_.empty()) {
+        // CQEs parked by flush_for_close; dispatching can park more
+        // (a handler closing another connection under backpressure),
+        // so swap the batch out first.
+        std::vector<io_uring_cqe> batch;
+        batch.swap(deferred_);
+        for (const io_uring_cqe& cqe : batch) dispatch(cqe);
+    }
     if (!timeout_armed_) arm_timeout();
-    if (!r_.submit(1)) return;
+    // Don't block waiting for a fresh completion if dispatching the
+    // batch above parked MORE CQEs (a handler closed a connection
+    // under backpressure): they are already-completed work and must
+    // not sit behind a GETEVENTS wait for up to the 500ms timeout.
+    if (!r_.submit(deferred_.empty() ? 1u : 0u)) return;
     r_.reap([this](const io_uring_cqe& cqe) { dispatch(cqe); });
+}
+
+// Hand every written SQE to the kernel before the caller closes an fd
+// they may reference. submit() alone is not enough: EBUSY/EAGAIN from
+// io_uring_enter (CQ backpressure) returns without submitting, and
+// under SQPOLL the poller consumes the published tail asynchronously —
+// either way an unsubmitted recv/send/cancel could survive the close,
+// get picked up after the fd number is reused by a new accept, and
+// silently consume the new connection's bytes. Loop until the kernel
+// owns everything: drain the CQ (into deferred_, never dispatched
+// here — this runs inside dispatch()) to relieve backpressure, and
+// for SQPOLL wait for sq_head to reach the published tail.
+void EngineUring::flush_for_close() {
+    for (int spins = 0; !r_.wedged; ++spins) {
+        if (!r_.submit(0)) return;  // wedged: the ring is dead
+        bool drained =
+            r_.sqpoll() ? __atomic_load_n(r_.sq_head, __ATOMIC_ACQUIRE) ==
+                              r_.local_tail
+                        : r_.pending == 0;
+        if (drained) return;
+        r_.reap(
+            [this](const io_uring_cqe& cqe) { deferred_.push_back(cqe); });
+        if (spins >= 10000) {
+            // ~1s of refusal (dead SQPOLL poller?): give up loudly
+            // rather than hang the worker; the close may now race an
+            // unsubmitted SQE, but a wedged ring is already fatal.
+            IST_ERROR("io_uring pre-close flush did not drain");
+            return;
+        }
+        if (spins >= 100) {
+            struct timespec ts {0, 100 * 1000};
+            nanosleep(&ts, nullptr);
+        }
+    }
 }
 
 void EngineUring::dispatch(const io_uring_cqe& cqe) {
@@ -772,8 +867,10 @@ void EngineUring::conn_closing(Conn& c) {
     // connection's socket and silently consume its bytes. Once
     // submitted, the kernel holds the file (not the fd), stale CQEs
     // drop on the conn-id lookup, and the queued cancels unblock any
-    // parked read so the file reference drains.
-    r_.submit(0);
+    // parked read so the file reference drains. flush_for_close (not
+    // a bare submit) because CQ backpressure and the SQPOLL poller
+    // both let a plain submit return with SQEs still unowned.
+    flush_for_close();
     if (u->outstanding == 0) conns_.erase(it);
 }
 
@@ -967,9 +1064,17 @@ void EngineUring::on_rx(UConn& u, const io_uring_cqe& cqe,
         // state machine (header parse, dispatch, bounded payload
         // copies; the direct path takes over below for the rest).
         const uint8_t* ptr = have_buf ? pbuf_ptr(bid) : u.stage.data();
-        s_.bytes_in_ += uint64_t(res);
-        w_.bytes_in.fetch_add(uint64_t(res), std::memory_order_relaxed);
-        bool ok = s_.ingest_bytes(*c, ptr, size_t(res));
+        size_t drained = 0;
+        bool ok = s_.ingest_bytes(*c, ptr, size_t(res), &drained);
+        // DRAIN-state bytes are excluded to match the epoll engine
+        // (and the direct path above), which only count live protocol
+        // bytes — stats parity between engines is part of the A/B
+        // contract.
+        uint64_t counted = uint64_t(res) - uint64_t(drained);
+        if (counted > 0) {
+            s_.bytes_in_ += counted;
+            w_.bytes_in.fetch_add(counted, std::memory_order_relaxed);
+        }
         if (have_buf) pbuf_recycle(bid);
         if (!ok) {
             s_.close_conn(w_, c->fd);
@@ -1003,6 +1108,7 @@ uint32_t EngineUring::alloc_zc_slot(UConn& u) {
     s.used = true;
     s.data_done = false;
     s.notif_done = false;
+    s.count_copies = false;
     s.conn_id = u.id;
     s.msg = u.sending;
     return idx;
@@ -1116,8 +1222,7 @@ void EngineUring::start_tx(UConn& u) {
             e->msg_flags = MSG_NOSIGNAL;
             e->buf_index = uint16_t(rb);
             w_.eng_zc_sends.fetch_add(1, std::memory_order_relaxed);
-            w_.eng_copies_avoided.fetch_add(slen,
-                                            std::memory_order_relaxed);
+            zc_slots_[slot].count_copies = true;
         } else if (zc_eligible && zc_msg_ok_ && m.segs.size() > 1) {
             // Scattered runs: vectored zero-copy.
             int n = build_seg_iov(m, u.siov, 64);
@@ -1148,8 +1253,7 @@ void EngineUring::start_tx(UConn& u) {
             e->len = uint32_t(slen);
             e->msg_flags = MSG_NOSIGNAL;
             w_.eng_zc_sends.fetch_add(1, std::memory_order_relaxed);
-            w_.eng_copies_avoided.fetch_add(slen,
-                                            std::memory_order_relaxed);
+            zc_slots_[slot].count_copies = true;
         } else {
             int n = build_seg_iov(m, u.siov, 64);
             memset(&u.smsg, 0, sizeof(u.smsg));
@@ -1248,6 +1352,10 @@ void EngineUring::on_zc(uint32_t slot, const io_uring_cqe& cqe) {
     uint64_t conn_id = zc_slots_[slot].conn_id;
     zc_slots_[slot].data_done = true;
     if ((cqe.flags & kCqeFMore) == 0) zc_slots_[slot].notif_done = true;
+    if (cqe.res > 0 && zc_slots_[slot].count_copies) {
+        w_.eng_copies_avoided.fetch_add(uint64_t(cqe.res),
+                                        std::memory_order_relaxed);
+    }
     UConn* u = find(conn_id);
     if (u != nullptr) {
         u->outstanding--;

@@ -967,7 +967,8 @@ void Server::payload_advance(Conn& c, size_t n) {
     }
 }
 
-bool Server::ingest_bytes(Conn& c, const uint8_t* p, size_t n) {
+bool Server::ingest_bytes(Conn& c, const uint8_t* p, size_t n,
+                          size_t* drained) {
     while (n > 0) {
         if (c.state == RState::HDR) {
             size_t take = sizeof(WireHeader) - c.hdr_got;
@@ -1008,6 +1009,9 @@ bool Server::ingest_bytes(Conn& c, const uint8_t* p, size_t n) {
             // (or all of DRAIN) are simply dropped, matching the sink.
             size_t take = c.payload_left < n ? size_t(c.payload_left) : n;
             size_t done = 0;
+            if (c.state == RState::DRAIN && drained != nullptr) {
+                *drained += take;
+            }
             if (c.state == RState::PAYLOAD) {
                 while (done < take && c.wseg < c.wdest.size()) {
                     size_t room = c.wdest[c.wseg].second - c.wseg_off;

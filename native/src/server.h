@@ -345,8 +345,12 @@ class Server {
     // runs header parse, body assembly, message dispatch and the
     // copied-payload slow path across as many messages as `n` covers.
     // Returns false when the connection must be closed (protocol
-    // error or a handler marked it dead).
-    bool ingest_bytes(Conn& c, const uint8_t* p, size_t n);
+    // error or a handler marked it dead). `drained`, when non-null,
+    // accumulates the bytes consumed in DRAIN state — the epoll
+    // engine excludes those from bytes_in, so the push-mode caller
+    // needs the split to keep the two engines' stats identical.
+    bool ingest_bytes(Conn& c, const uint8_t* p, size_t n,
+                      size_t* drained = nullptr);
 
     void respond(Conn& c, uint64_t seq, uint8_t op,
                  std::vector<uint8_t> body_bytes,
