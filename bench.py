@@ -702,6 +702,104 @@ def bench_chaos_overhead(nkeys=None, block_kb=4, passes=3):
     }
 
 
+def bench_events_overhead(nkeys=None, block_kb=4, passes=3):
+    """Always-on flight-recorder overhead leg (ISSUE 10 acceptance:
+    events_overhead_p50_ratio <= 1.02 on CI).
+
+    The flight recorder (native/src/events.h) is ON by default and has
+    no per-op emit sites — its catalog is state transitions only — so
+    the expected cost on a read loop is zero beyond noise. This leg
+    pins that claim with the PR-6 chaos-off methodology: leg A runs
+    with ISTPU_EVENTS=0 (the kill switch that exists ONLY for this
+    denominator; re-read per server start) and leg B with the recorder
+    on (default), same read workload, best-of-passes p50 each. Emits:
+      events_on_p50_read_us        recorder-on p50
+      events_off_p50_read_us       recorder-off p50
+      events_overhead_p50_ratio    on / off (best-of-passes)
+      events_recorded              events the on-leg actually recorded
+    """
+    import os
+
+    import numpy as np
+
+    from infinistore_tpu import (
+        ClientConfig,
+        InfiniStoreServer,
+        InfinityConnection,
+        ServerConfig,
+    )
+
+    if nkeys is None:
+        nkeys = int(os.environ.get("ISTPU_EVENTS_KEYS", "512"))
+    block_bytes = block_kb << 10
+
+    def run_leg(enabled):
+        os.environ["ISTPU_EVENTS"] = "1" if enabled else "0"
+        try:
+            srv = InfiniStoreServer(
+                ServerConfig(
+                    service_port=0,
+                    prealloc_size=max(2 * nkeys * block_bytes, 1 << 20)
+                    / (1 << 30),
+                    minimal_allocate_size=block_kb,
+                )
+            )
+            port = srv.start()
+            try:
+                conn = InfinityConnection(
+                    ClientConfig(
+                        host_addr="127.0.0.1", service_port=port,
+                        connection_type="STREAM",
+                    )
+                )
+                conn.connect()
+                try:
+                    src = np.random.default_rng(7).integers(
+                        0, 255, block_bytes, dtype=np.uint8
+                    )
+                    for i in range(nkeys):
+                        conn.put_cache(src, [(f"ev{i}", 0)], block_bytes)
+                    conn.sync()
+                    dst = np.zeros(block_bytes, dtype=np.uint8)
+                    p50 = None
+                    for _ in range(passes):
+                        lats = []
+                        for i in range(nkeys):
+                            t0 = time.perf_counter()
+                            conn.read_cache(
+                                dst, [(f"ev{i}", 0)], block_bytes
+                            )
+                            lats.append(time.perf_counter() - t0)
+                        p = float(
+                            np.percentile(np.array(lats) * 1e6, 50)
+                        )
+                        p50 = p if p50 is None else min(p50, p)
+                    recorded = int(
+                        srv.stats().get("events", {}).get("recorded", 0)
+                    )
+                    return p50, recorded
+                finally:
+                    conn.close()
+            finally:
+                srv.stop()
+        finally:
+            # The flag is process-global and re-read per start: never
+            # leak a disabled recorder into later legs (or the user's
+            # session — always-on is the product contract).
+            os.environ.pop("ISTPU_EVENTS", None)
+
+    off_p50, _ = run_leg(False)
+    on_p50, recorded = run_leg(True)
+    return {
+        "events_nkeys": nkeys,
+        "events_on_p50_read_us": round(on_p50, 1),
+        "events_off_p50_read_us": round(off_p50, 1),
+        "events_overhead_p50_ratio": round(on_p50 / off_p50, 3)
+        if off_p50 else 0.0,
+        "events_recorded": recorded,
+    }
+
+
 def bench_sharded(n_shards=4, nkeys=4096, block_kb=4, workers=1,
                   io_threads=None, passes=2):
     """Sharded-store leg (BASELINE config 5 scaled to one host): the same
@@ -2631,6 +2729,15 @@ def main():
         except Exception as e:
             print(json.dumps({"chaos_overhead_error": str(e)[:200]}))
         return 0
+    if "--events-leg" in sys.argv:
+        # Always-on flight-recorder overhead A/B (ISSUE 10 acceptance
+        # <= 1.02); boots its own two servers, port argument accepted
+        # but unused.
+        try:
+            print(json.dumps(bench_events_overhead()))
+        except Exception as e:
+            print(json.dumps({"events_overhead_error": str(e)[:200]}))
+        return 0
     if "--engine-ab-leg" in sys.argv:
         # Transport-engine epoll vs uring A/B (ISSUE 8; distinct from
         # --engine-leg, the TPU serving-engine leg). Boots its own
@@ -2796,6 +2903,14 @@ def main():
             out.update(bench_chaos_overhead())
         except Exception as e:
             out["chaos_overhead_error"] = str(e)[:200]
+        publish()
+        # Always-on flight-recorder overhead leg (ISSUE 10 acceptance:
+        # <= 1.02): recorder on (default) vs ISTPU_EVENTS=0, CPU-only,
+        # own servers.
+        try:
+            out.update(bench_events_overhead())
+        except Exception as e:
+            out["events_overhead_error"] = str(e)[:200]
         publish()
         # Sharded leg is CPU-only: run it BEFORE any tunnel-bound leg so
         # a wedged tunnel can never cost it (it boots its own servers;

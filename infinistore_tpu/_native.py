@@ -85,7 +85,8 @@ def _decls(lib):
             [c.c_char_p, c.c_uint16, c.c_uint64, c.c_uint64, c.c_int,
              c.c_uint64, c.c_int, c.c_char_p, c.c_int, c.c_char_p,
              c.c_uint64, c.c_uint64, c.c_uint32, c.c_double, c.c_double,
-             c.c_int, c.c_int, c.c_char_p],
+             c.c_int, c.c_int, c.c_char_p, c.c_int, c.c_char_p,
+             c.c_uint32],
         ),
         ("ist_server_start", c.c_int, [c.c_void_p]),
         ("ist_server_stop", None, [c.c_void_p]),
@@ -95,6 +96,17 @@ def _decls(lib):
         ("ist_server_stats", c.c_int, [c.c_void_p, c.c_char_p, c.c_int]),
         (
             "ist_server_trace",
+            c.c_longlong,
+            [c.c_void_p, c.c_char_p, c.c_longlong],
+        ),
+        # flight recorder + deep-state introspection (ABI v10)
+        (
+            "ist_server_events",
+            c.c_longlong,
+            [c.c_void_p, c.c_uint64, c.c_char_p, c.c_longlong],
+        ),
+        (
+            "ist_server_debug_state",
             c.c_longlong,
             [c.c_void_p, c.c_char_p, c.c_longlong],
         ),
@@ -243,8 +255,11 @@ def _decls(lib):
         ("ist_mm_total_bytes", c.c_uint64, [c.c_void_p]),
         ("ist_mm_num_pools", c.c_uint64, [c.c_void_p]),
     ]
-    # ABI probe FIRST: a stale prebuilt library would misparse the v9
-    # ist_server_create argument list (trailing engine string), lack
+    # ABI probe FIRST: a stale prebuilt library would misparse the
+    # v10 ist_server_create argument list (trailing watchdog/
+    # bundle_dir/bundle_keep), lack the v10 flight-recorder entry
+    # points (ist_server_events / ist_server_debug_state), misparse
+    # the v9 trailing engine string, lack
     # the v8 fault entry points (ist_server_fault /
     # ist_server_fault_list), misparse the v7 promote flag, the v6
     # trace flag, the v5 reclaim watermarks, the v4 multi-worker knob
@@ -258,9 +273,9 @@ def _decls(lib):
         ver = int(lib.ist_abi_version())
     except AttributeError:
         ver = 1
-    if ver < 9:
+    if ver < 10:
         raise RuntimeError(
-            f"stale native library at {_LIB_PATH} (ABI v{ver} < v9): "
+            f"stale native library at {_LIB_PATH} (ABI v{ver} < v10): "
             "rebuild with `make -C native` (or delete the .so to let "
             "the import auto-build)"
         )

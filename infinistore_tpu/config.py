@@ -205,6 +205,23 @@ class ServerConfig:
         # falls back to epoll with one log line (the stats blob's
         # "engine" key reports what was selected).
         self.engine = kwargs.get("engine", "auto")
+        # Anomaly watchdog + diagnostic bundles (docs/design.md "Flight
+        # recorder & watchdog"; ISTPU_WATCHDOG=0/1 overrides). A native
+        # thread samples worker/background heartbeats, queue gauges and
+        # per-op latency histogram deltas each watchdog_interval_ms; a
+        # verdict — stalled worker, p99-deadline violation, queue
+        # growth without drain — emits a watchdog.* flight-recorder
+        # event and, with bundle_dir set, captures a diagnostic bundle
+        # (stats + events + trace + deep state + manifest) into a
+        # keep-last-bundle_keep directory. bundle_dir also pre-opens
+        # the crash fd the fatal-signal handler dumps the raw event
+        # rings to (ISTPU_BUNDLE_DIR supplies a DEFAULT when unset — CI
+        # points every test server at one dir and ships it as a
+        # failure artifact; an explicit bundle_dir always wins). Thresholds ride
+        # ISTPU_WATCHDOG_{INTERVAL_MS,STALL_US,P99_US,COOLDOWN_MS}.
+        self.watchdog = kwargs.get("watchdog", True)
+        self.bundle_dir = kwargs.get("bundle_dir", "")
+        self.bundle_keep = kwargs.get("bundle_keep", 4)
         # Accepted for reference CLI compatibility; unused on TPU hosts.
         self.dev_name = kwargs.get("dev_name", "")
         self.link_type = kwargs.get("link_type", "")
@@ -250,6 +267,8 @@ class ServerConfig:
             raise Exception("workers must be in [0, 64] (0 = auto)")
         if self.engine not in ("auto", "epoll", "uring"):
             raise Exception("engine must be auto, epoll or uring")
+        if self.bundle_keep < 1:
+            raise Exception("bundle_keep must be >= 1")
         if 0.0 < self.reclaim_high < 1.0:
             if not (0.0 <= self.reclaim_low <= self.reclaim_high):
                 raise Exception(

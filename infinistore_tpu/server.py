@@ -61,6 +61,9 @@ class InfiniStoreServer:
             1 if cfg.trace else 0,
             1 if cfg.promote else 0,
             cfg.engine.encode(),
+            1 if cfg.watchdog else 0,
+            cfg.bundle_dir.encode(),
+            int(cfg.bundle_keep),
         )
         port = self._lib.ist_server_start(self._h)
         if port < 0:
@@ -114,6 +117,28 @@ class InfiniStoreServer:
     def trace(self):
         """``trace_json`` parsed into a dict ({"traceEvents": [...]})."""
         return json.loads(self.trace_json())
+
+    def events(self, since_seq=0):
+        """Drain the always-on flight recorder (native/src/events.h) as
+        a dict: ``{"events": [{seq, t_us, track, name, severity, a0,
+        a1}...], "recorded", "overwritten", "capacity", "enabled"}``.
+        ``since_seq`` filters to events newer than a previously
+        observed high-water mark (``stats()["events"]["recorded"]``).
+        Served raw by ``GET /events``."""
+        return json.loads(self._read_blob(
+            lambda h, buf, cap: self._lib.ist_server_events(
+                h, int(since_seq), buf, cap)))
+
+    def debug_state(self):
+        """Deep-state introspection (``GET /debug/state``): per-
+        connection protocol phase / in-flight bytes / current op,
+        per-worker queue depth + heartbeat + uring slot occupancy,
+        per-stripe entry/byte counts with LRU-age histograms and
+        pool/disk/limbo location mix, per-arena pool fragmentation,
+        and the spill/promote queue summaries."""
+        return json.loads(
+            self._read_blob(self._lib.ist_server_debug_state)
+        )
 
     def fault(self, spec):
         """Arm/disarm failpoints from a spec string (grammar in
@@ -402,6 +427,56 @@ def _prometheus_metrics(stats):
     lines.append(
         f'infinistore_trace_spans_total {trace.get("spans", 0)}'
     )
+    # Flight recorder + anomaly watchdog (always on): the alerting
+    # surface for "the store detected its own anomaly" — dashboards
+    # page on watchdog_stalled / watchdog_trips_total movement and
+    # read the bundle on disk for the forensics.
+    wd = stats.get("watchdog", {})
+    ev = stats.get("events", {})
+    lines.append(
+        "# HELP infinistore_watchdog_stalled current stall verdict "
+        "(worker/background heartbeat over threshold, or a worker "
+        "died)"
+    )
+    lines.append("# TYPE infinistore_watchdog_stalled gauge")
+    lines.append(f'infinistore_watchdog_stalled {wd.get("stalled", 0)}')
+    lines.append(
+        "# HELP infinistore_watchdog_trips_total watchdog triggers "
+        "by kind"
+    )
+    lines.append("# TYPE infinistore_watchdog_trips_total counter")
+    for kind, key in (("stall", "stall_trips"),
+                      ("slow_op", "slow_op_trips"),
+                      ("queue_growth", "queue_trips")):
+        lines.append(
+            f'infinistore_watchdog_trips_total{{kind="{kind}"}} '
+            f'{wd.get(key, 0)}'
+        )
+    lines.append(
+        "# HELP infinistore_watchdog_bundles_total diagnostic "
+        "bundles captured"
+    )
+    lines.append("# TYPE infinistore_watchdog_bundles_total counter")
+    lines.append(
+        f'infinistore_watchdog_bundles_total {wd.get("bundles", 0)}'
+    )
+    lines.append(
+        "# HELP infinistore_events_recorded_total flight-recorder "
+        "events recorded since process start"
+    )
+    lines.append("# TYPE infinistore_events_recorded_total counter")
+    lines.append(
+        f'infinistore_events_recorded_total {ev.get("recorded", 0)}'
+    )
+    lines.append(
+        "# HELP infinistore_events_last_age_us age of the newest "
+        "flight-recorder event (-1 = none)"
+    )
+    lines.append("# TYPE infinistore_events_last_age_us gauge")
+    lines.append(
+        f'infinistore_events_last_age_us '
+        f'{ev.get("last_event_age_us", -1)}'
+    )
     return "\n".join(lines) + "\n"
 
 
@@ -446,15 +521,40 @@ def make_control_plane(server: InfiniStoreServer, snapshot_path=None):
             elif self.path == "/fault":
                 # Failpoint catalog: name, current arming, fire count.
                 self._send(200, server.faults())
+            elif self.path.startswith("/events"):
+                # Flight-recorder drain (always on). ?since=SEQ
+                # filters to events newer than a previously observed
+                # high-water mark.
+                since = 0
+                if "?" in self.path:
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        since = int(q.get("since", ["0"])[0])
+                    except ValueError:
+                        since = 0
+                self._send(200, server.events(since_seq=since))
+            elif self.path == "/debug/state":
+                # Deep-state introspection: per-connection /
+                # per-worker / per-stripe / per-arena internals that
+                # previously needed a debugger attach.
+                self._send(200, server.debug_state())
             elif self.path == "/health":
                 # Liveness + failure-model summary: a dead background
-                # worker or an open tier breaker is DEGRADED (the store
-                # still serves — inline fallbacks / pure-pool mode),
-                # never dead.
+                # worker, an open tier breaker or a CURRENT watchdog
+                # stall verdict is DEGRADED (the store still serves —
+                # inline fallbacks / pure-pool mode), never dead.
+                # Before the watchdog fields, a silently stalled
+                # worker read "ok" here until heartbeats were
+                # correlated by hand.
                 st = server.stats()
+                wd = st.get("watchdog", {})
+                ev = st.get("events", {})
                 degraded = bool(
                     st.get("workers_dead", 0)
                     or st.get("tier_breaker_open", 0)
+                    or wd.get("stalled", 0)
                 )
                 self._send(
                     200,
@@ -465,6 +565,21 @@ def make_control_plane(server: InfiniStoreServer, snapshot_path=None):
                             "tier_breaker_open", 0
                         ),
                         "disk_io_errors": st.get("disk_io_errors", 0),
+                        # Watchdog verdicts: `stalled` is the CURRENT
+                        # sample's verdict (drives `degraded`); trips/
+                        # last_trigger summarize history for operators.
+                        "watchdog": {
+                            "stalled": wd.get("stalled", 0),
+                            "trips": wd.get("trips", 0),
+                            "last_trigger": wd.get("last_trigger", ""),
+                            "bundles": wd.get("bundles", 0),
+                        },
+                        # Age of the newest flight-recorder event: a
+                        # black box that stopped recording is itself an
+                        # anomaly worth alerting on.
+                        "last_event_age_us": ev.get(
+                            "last_event_age_us", -1
+                        ),
                     },
                 )
             else:
@@ -610,6 +725,20 @@ def parse_args(argv=None):
                         "fall back to epoll, logged once; the /stats "
                         "'engine' key reports the selection). The "
                         "ISTPU_ENGINE env var overrides")
+    p.add_argument("--no-watchdog", action="store_true",
+                   help="disable the anomaly watchdog thread (stall / "
+                        "slow-op / queue-growth verdicts + diagnostic "
+                        "bundles). ISTPU_WATCHDOG=1/0 overrides")
+    p.add_argument("--bundle-dir", default="",
+                   help="directory for watchdog diagnostic bundles "
+                        "(stats + events + trace + deep state per "
+                        "trigger, keep-last---bundle-keep) and the "
+                        "crash-dump fd the fatal-signal handler writes "
+                        "the raw event rings to; empty = no bundles. "
+                        "ISTPU_BUNDLE_DIR overrides")
+    p.add_argument("--bundle-keep", type=int, default=4,
+                   help="diagnostic bundles retained in --bundle-dir "
+                        "(oldest pruned first)")
     p.add_argument("--warmup", action="store_true",
                    help="run a warmup round-trip after startup")
     p.add_argument("--snapshot-path", default="",
@@ -661,6 +790,9 @@ def main(argv=None):
         promote=not args.no_promote,
         trace=args.trace,
         engine=args.engine,
+        watchdog=not args.no_watchdog,
+        bundle_dir=args.bundle_dir,
+        bundle_keep=args.bundle_keep,
     )
     server = InfiniStoreServer(config)
     server.start()

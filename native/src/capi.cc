@@ -21,6 +21,7 @@
 
 #include "client.h"
 #include "common.h"
+#include "events.h"
 #include "failpoint.h"
 #include "log.h"
 #include "server.h"
@@ -114,10 +115,15 @@ extern "C" {
 // `engine` string on ist_server_create ("auto"/"epoll"/"uring"),
 // stats gains engine / uring_sqes / uring_zc_sends /
 // uring_copies_avoided plus the per-worker engine breakdown, new
-// engine.uring_setup failpoint).
+// engine.uring_setup failpoint; v10: always-on flight recorder +
+// anomaly watchdog + deep-state introspection — trailing `watchdog`
+// int, `bundle_dir` string and `bundle_keep` u32 on
+// ist_server_create, new ist_server_events / ist_server_debug_state
+// entry points, stats gains the events/watchdog sections and
+// promote_heartbeat_age_us).
 // _native.py probes this at load so a stale prebuilt library fails
 // loudly instead of feeding unparseable blobs to the server.
-uint32_t ist_abi_version(void) { return 9; }
+uint32_t ist_abi_version(void) { return 10; }
 
 void ist_set_log_level(int level) { set_log_level(level); }
 void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }
@@ -131,7 +137,8 @@ void* ist_server_create(const char* host, uint16_t port,
                         const char* ssd_path, uint64_t ssd_bytes,
                         uint64_t max_outq_bytes, uint32_t workers,
                         double reclaim_high, double reclaim_low, int trace,
-                        int promote, const char* engine) {
+                        int promote, const char* engine, int watchdog,
+                        const char* bundle_dir, uint32_t bundle_keep) {
     ServerConfig cfg;
     cfg.host = host ? host : "0.0.0.0";
     cfg.port = port;
@@ -161,6 +168,11 @@ void* ist_server_create(const char* host, uint16_t port,
     // Transport engine ("auto"/"epoll"/"uring"; engine.h). NULL/empty
     // keeps the auto probe; ISTPU_ENGINE still overrides at start().
     if (engine && engine[0]) cfg.engine = engine;
+    // Anomaly watchdog + diagnostic bundles (flight recorder, v10);
+    // ISTPU_WATCHDOG / ISTPU_BUNDLE_DIR still override at start().
+    cfg.watchdog = watchdog != 0;
+    if (bundle_dir && bundle_dir[0]) cfg.bundle_dir = bundle_dir;
+    if (bundle_keep) cfg.bundle_keep = bundle_keep;
     return new Server(cfg);
 }
 
@@ -226,6 +238,28 @@ long long ist_server_restore(void* h, const char* path) {
     } catch (...) {
         return -1;
     }
+}
+
+// Drain the flight recorder (events.h) as JSON: every stable event
+// across all tracks with seq > since_seq, plus recorded/overwritten
+// counters. Same snprintf contract as ist_server_stats. The recorder
+// is process-global; the handle anchors the call to a live store for
+// API symmetry (GET /events on the manage plane).
+long long ist_server_events(void* h, uint64_t since_seq, char* buf,
+                            long long cap) {
+    if (h == nullptr) return -1;
+    return copy_blob(events_json(since_seq), buf, cap);
+}
+
+// Deep-state introspection (GET /debug/state): per-connection
+// protocol phase / bytes in flight, per-worker queue depth +
+// heartbeat + engine slot occupancy, per-stripe entry/byte/location
+// mix with LRU-age histograms, pool-arena fragmentation and the
+// spill/promote queue summaries. Same snprintf contract.
+long long ist_server_debug_state(void* h, char* buf, long long cap) {
+    if (h == nullptr) return -1;
+    return copy_blob(static_cast<Server*>(h)->debug_state_json(), buf,
+                     cap);
 }
 
 // Fault injection (failpoint.h): arm/disarm named failpoints from a

@@ -227,7 +227,8 @@ Connection::~Connection() { close_conn(); }
 int Connection::connect_server() {
     fd_ = connect_tcp(cfg_.host, cfg_.port, cfg_.timeout_ms);
     if (fd_ < 0) {
-        IST_ERROR("connect to %s:%u failed", cfg_.host.c_str(), cfg_.port);
+        IST_ERROR("connect to %s:%u failed: %s", cfg_.host.c_str(),
+                  cfg_.port, strerror(errno));
         return -1;
     }
     // Bootstrap HELLO on the still-blocking socket.

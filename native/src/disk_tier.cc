@@ -9,6 +9,7 @@
 #include <sys/vfs.h>
 #include <unistd.h>
 
+#include "events.h"
 #include "failpoint.h"
 #include "log.h"
 #include "utils.h"
@@ -43,7 +44,10 @@ bool DiskTier::store_admitted() {
 }
 
 void DiskTier::note_write_error() {
-    io_errors_.fetch_add(1, std::memory_order_relaxed);
+    last_store_err_io_.store(true, std::memory_order_relaxed);
+    uint64_t total =
+        io_errors_.fetch_add(1, std::memory_order_relaxed) + 1;
+    events_emit(EV_DISK_IO_ERROR, total, /*write=*/1);
     uint32_t consec =
         consec_write_errors_.fetch_add(1, std::memory_order_relaxed) + 1;
     if (consec < kBreakerThreshold) return;
@@ -54,6 +58,7 @@ void DiskTier::note_write_error() {
         backoff = backoff * 2 > kBreakerMaxUs ? kBreakerMaxUs : backoff * 2;
         breaker_backoff_us_.store(backoff, std::memory_order_relaxed);
     } else {
+        events_emit(EV_BREAKER_OPEN, consec, uint64_t(backoff));
         IST_WARN("disk tier breaker OPEN after %u consecutive write "
                  "errors: store degrades to pure-pool mode, re-probe in "
                  "%lld ms",
@@ -64,6 +69,10 @@ void DiskTier::note_write_error() {
 }
 
 void DiskTier::breaker_probe_aborted() {
+    // Every capacity-shaped refusal routes through here (reserve
+    // refused, alignment bail) — stamp the failure class for the
+    // spill admission's fail-min memory before the breaker early-out.
+    last_store_err_io_.store(false, std::memory_order_relaxed);
     if (!breaker_open_.load(std::memory_order_relaxed)) return;
     breaker_retry_at_us_.store(now_us(), std::memory_order_relaxed);
 }
@@ -73,6 +82,8 @@ void DiskTier::note_write_ok() {
     if (breaker_open_.exchange(false, std::memory_order_relaxed)) {
         breaker_backoff_us_.store(kBreakerBaseUs,
                                   std::memory_order_relaxed);
+        events_emit(EV_BREAKER_CLOSE,
+                    io_errors_.load(std::memory_order_relaxed), 0);
         IST_WARN("disk tier breaker CLOSED (probe write succeeded); "
                  "spills resume");
     }
@@ -365,7 +376,10 @@ bool DiskTier::load(int64_t off, void* dst, uint32_t size) {
         if (r <= 0) {
             if (!inject && r < 0 && errno == EINTR) continue;
             IST_ERROR("disk tier pread failed: %s", strerror(errno));
-            io_errors_.fetch_add(1, std::memory_order_relaxed);
+            events_emit(
+                EV_DISK_IO_ERROR,
+                io_errors_.fetch_add(1, std::memory_order_relaxed) + 1,
+                /*write=*/0);
             return false;
         }
         p += r;

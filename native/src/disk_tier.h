@@ -105,6 +105,14 @@ class DiskTier {
     bool breaker_open() const {
         return breaker_open_.load(std::memory_order_relaxed);
     }
+    // Failure-class stamp of the most recent failed store: true = the
+    // DEVICE errored mid-write (the breaker's territory — consecutive
+    // errors open it), false = a CAPACITY refusal (reserve/alignment —
+    // the spill admission's fail-min territory). Advisory (racy across
+    // concurrent stores), read only by spill admission heuristics.
+    bool last_store_failure_was_io() const {
+        return last_store_err_io_.load(std::memory_order_relaxed);
+    }
     // Non-consuming peek for spill ADMISSION: true when a store issued
     // now would not be refused outright by the breaker (closed, or the
     // backoff window has a probe slot due). Keeps the reclaimer from
@@ -156,6 +164,7 @@ class DiskTier {
     std::vector<uint64_t> bitmap_ GUARDED_BY(mu_);
 
     std::atomic<uint64_t> io_errors_{0};
+    std::atomic<bool> last_store_err_io_{false};
     std::atomic<uint32_t> consec_write_errors_{0};
     std::atomic<bool> breaker_open_{false};
     std::atomic<long long> breaker_retry_at_us_{0};

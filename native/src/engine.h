@@ -92,6 +92,18 @@ class Engine {
     // On a fatal transport error the engine marks c.dead (caller
     // closes) or closes the connection itself from poll context.
     virtual void output_ready(Conn& c) = 0;
+
+    // Deep-state introspection (GET /debug/state): engine-private
+    // in-flight slot occupancy — for the uring engine, zero-copy send
+    // slots whose block pins await the kernel's NOTIF CQE. Thread-safe
+    // (atomic counter); 0 for engines without a slot table (epoll).
+    virtual size_t inflight_slots() const { return 0; }
+
+    // False when the engine is permanently wedged (the uring engine's
+    // unrecoverable-enter state: its poll() only sleeps). The worker
+    // loop then stops stamping its heartbeat so the watchdog's stall
+    // verdict names the wedge instead of a fresh-looking dead worker.
+    virtual bool healthy() const { return true; }
 };
 
 enum class EngineKind { kAuto, kEpoll, kUring };

@@ -530,6 +530,14 @@ class EngineUring final : public Engine {
     void on_tx(UConn& u, const io_uring_cqe& cqe);
     void on_zc(uint32_t slot, const io_uring_cqe& cqe);
 
+   public:
+    size_t inflight_slots() const override {
+        return zc_live_.load(std::memory_order_relaxed);
+    }
+    bool healthy() const override { return !r_.wedged; }
+
+   private:
+
     void dispatch(const io_uring_cqe& cqe);
     void flush_for_close();
 
@@ -537,6 +545,7 @@ class EngineUring final : public Engine {
     Worker& w_;
     RawRing r_;
     bool inited_ = false;
+    bool armed_initial_ = false;  // first-poll arming (worker thread)
     bool timeout_armed_ = false;
     bool sq_wedged_logged_ = false;
     // Runtime feature set (probed in init(); each degrades alone).
@@ -562,6 +571,10 @@ class EngineUring final : public Engine {
     std::vector<io_uring_cqe> deferred_;
     std::vector<ZcSlot> zc_slots_;
     std::vector<uint32_t> zc_free_;
+    // Live zc-slot count, mirrored atomically so the deep-state
+    // endpoint can read occupancy from the control plane while the
+    // worker churns the table.
+    std::atomic<size_t> zc_live_{0};
     struct __kernel_timespec ts_ {};
 };
 
@@ -607,10 +620,16 @@ bool EngineUring::init() {
     // the pbuf-ring registration succeeding (5.19+) AND the ZC probe
     // (6.0+) so a 5.19-6.0 kernel never sees an EINVAL storm.
     ms_ok_ = want_ms && zc_ok_ && setup_pbuf_ring();
-    arm_poll(w_.wake_fd, make_ud(kTagWake, 0));
-    if (w_.listen_fd >= 0) arm_poll(w_.listen_fd, make_ud(kTagListen, 0));
-    arm_timeout();
-    r_.submit(0);
+    // NOTE: no SQE is armed (and nothing is submitted) here. init()
+    // runs on the STARTING thread, and io_uring binds each request's
+    // completion task-work to the task that submitted it — arming the
+    // wake/listen polls from here hands their (and their accepted
+    // connections') task-work to the embedding process's main thread,
+    // which modern kernels interrupt with TWA_SIGNAL: every blocking
+    // syscall on that thread — a same-process native client's
+    // connect(), a Python control-plane read — starts failing EINTR
+    // for the ring's whole lifetime. The first poll() on the OWNING
+    // worker thread arms them instead (arm_initial below).
     IST_INFO("worker %d io_uring engine: sqpoll=%d fixed_bufs=%zu "
              "send_zc=%d sendmsg_zc=%d multishot=%d",
              w_.idx, r_.sqpoll() ? 1 : 0, regbufs_.size(), zc_ok_ ? 1 : 0,
@@ -709,6 +728,7 @@ void EngineUring::shutdown() {
     deferred_.clear();  // parked CQEs index state that just died
     zc_slots_.clear();
     zc_free_.clear();
+    zc_live_.store(0, std::memory_order_relaxed);
     regbufs_.clear();
     pbuf_mem_.clear();
 }
@@ -718,6 +738,19 @@ void EngineUring::shutdown() {
 // ---------------------------------------------------------------------------
 
 void EngineUring::poll() {
+    if (!armed_initial_) {
+        // First poll() on the owning worker thread: arm the wake and
+        // listen polls HERE so their completion task-work targets this
+        // thread, never the thread that ran init() (see the init()
+        // note — arming there EINTR-storms the embedder's main
+        // thread on TWA_SIGNAL kernels).
+        armed_initial_ = true;
+        arm_poll(w_.wake_fd, make_ud(kTagWake, 0));
+        if (w_.listen_fd >= 0) {
+            arm_poll(w_.listen_fd, make_ud(kTagListen, 0));
+        }
+        arm_timeout();
+    }
     if (r_.wedged) {
         // Unrecoverable enter failure: behave like a stalled loop (the
         // outer loop still re-checks running_ for shutdown).
@@ -1104,6 +1137,7 @@ uint32_t EngineUring::alloc_zc_slot(UConn& u) {
         idx = uint32_t(zc_slots_.size());
         zc_slots_.emplace_back();
     }
+    zc_live_.fetch_add(1, std::memory_order_relaxed);
     ZcSlot& s = zc_slots_[idx];
     s.used = true;
     s.data_done = false;
@@ -1121,6 +1155,7 @@ void EngineUring::finish_zc_slot(uint32_t idx) {
     s.used = false;
     s.conn_id = 0;
     zc_free_.push_back(idx);
+    zc_live_.fetch_sub(1, std::memory_order_relaxed);
 }
 
 namespace {
@@ -1280,6 +1315,7 @@ void EngineUring::finish_zc_slot_on_abort(uint32_t idx) {
     s.used = false;
     s.conn_id = 0;
     zc_free_.push_back(idx);
+    zc_live_.fetch_sub(1, std::memory_order_relaxed);
 }
 
 void EngineUring::advance_tx(UConn& u, size_t n) {

@@ -1,0 +1,381 @@
+#include "events.h"
+
+#include <string.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <mutex>
+#include <vector>
+
+#include "utils.h"
+
+namespace istpu {
+
+namespace {
+
+struct CatalogRow {
+    const char* name;
+    uint8_t sev;
+};
+
+const CatalogRow kCatalog[] = {
+#define X(id, name, sev) {name, sev},
+    IST_EVENT_CATALOG(X)
+#undef X
+};
+
+const char* kSevNames[] = {"debug", "info", "warn", "error"};
+
+// One track's ring. Multi-writer safe: head fetch_add assigns slots,
+// the per-slot generation seqlock (trace.h technique) lets the drain
+// skip anything torn by a concurrent writer or a lap.
+struct EventRing {
+    static constexpr size_t kCap = 4096;
+
+    struct Slot {
+        std::atomic<uint64_t> gen{0};  // 0 = empty; else head+1 at write
+        std::atomic<uint64_t> seq{0};  // process-wide monotonic
+        std::atomic<uint64_t> t0{0};   // CLOCK_MONOTONIC µs
+        std::atomic<uint64_t> id{0};   // catalog EventId
+        std::atomic<uint64_t> a0{0};
+        std::atomic<uint64_t> a1{0};
+    };
+
+    char name[24] = {};
+    std::atomic<uint64_t> head{0};
+    Slot slots[kCap];
+
+    void record(uint64_t seq, uint64_t t_us, uint16_t eid, uint64_t a0,
+                uint64_t a1) {
+        uint64_t h = head.fetch_add(1, std::memory_order_relaxed);
+        Slot& s = slots[h % kCap];
+        s.gen.store(0, std::memory_order_relaxed);
+        std::atomic_thread_fence(std::memory_order_release);
+        s.seq.store(seq, std::memory_order_relaxed);
+        s.t0.store(t_us, std::memory_order_relaxed);
+        s.id.store(eid, std::memory_order_relaxed);
+        s.a0.store(a0, std::memory_order_relaxed);
+        s.a1.store(a1, std::memory_order_relaxed);
+        s.gen.store(h + 1, std::memory_order_release);
+    }
+};
+
+// The process-global recorder (failpoint-registry precedent: the black
+// box belongs to the process; multiple in-process servers — tests,
+// sharded deployments — share it and filter on seq). Track slots are
+// created on first bind and NEVER destroyed, so emit needs no lock
+// and the crash handler can walk them without synchronization.
+struct EventLog {
+    static constexpr size_t kMaxTracks = 12;
+
+    std::atomic<bool> enabled{true};
+    std::atomic<uint64_t> seq{0};
+    std::atomic<long long> last_us{0};
+    std::atomic<size_t> ntracks{0};
+    EventRing* tracks[kMaxTracks] = {};
+    // Track creation only (startup); a plain leaf like the log and
+    // failpoint registry mutexes — never acquires a ranked mutex.
+    std::mutex mu;
+
+    EventLog() {
+        tracks[0] = new EventRing();
+        snprintf(tracks[0]->name, sizeof(tracks[0]->name), "main");
+        ntracks.store(1, std::memory_order_release);
+    }
+
+    EventRing* find_or_create(const char* name) {
+        std::lock_guard<std::mutex> lk(mu);
+        size_t n = ntracks.load(std::memory_order_relaxed);
+        for (size_t i = 0; i < n; ++i) {
+            if (strncmp(tracks[i]->name, name,
+                        sizeof(tracks[i]->name)) == 0) {
+                return tracks[i];
+            }
+        }
+        if (n >= kMaxTracks) return tracks[0];  // overflow shares main
+        auto* r = new EventRing();
+        snprintf(r->name, sizeof(r->name), "%s", name);
+        tracks[n] = r;
+        ntracks.store(n + 1, std::memory_order_release);
+        return r;
+    }
+};
+
+EventLog& log() {
+    // Leaked singleton: the crash handler may run at any point of
+    // process teardown and must never touch a destroyed ring.
+    static EventLog* g = new EventLog();
+    return *g;
+}
+
+thread_local EventRing* tls_ring = nullptr;
+
+std::atomic<int> crash_fd{-1};
+
+void crash_hook(int) { events_crash_dump(crash_fd.load()); }
+
+}  // namespace
+
+const char* event_name(uint16_t id) {
+    return id < EV_COUNT ? kCatalog[id].name : "?";
+}
+
+uint8_t event_severity(uint16_t id) {
+    return id < EV_COUNT ? kCatalog[id].sev : uint8_t(SEV_DEBUG);
+}
+
+const char* severity_name(uint8_t sev) {
+    return sev < 4 ? kSevNames[sev] : "?";
+}
+
+void events_emit(EventId id, uint64_t a0, uint64_t a1) {
+    EventLog& l = log();
+    if (!l.enabled.load(std::memory_order_relaxed)) return;
+    uint64_t s = l.seq.fetch_add(1, std::memory_order_relaxed) + 1;
+    long long t = now_us();
+    l.last_us.store(t, std::memory_order_relaxed);
+    EventRing* r = tls_ring != nullptr ? tls_ring : l.tracks[0];
+    r->record(s, uint64_t(t), uint16_t(id), a0, a1);
+}
+
+void events_bind_thread(const char* track_name) {
+    tls_ring = track_name != nullptr ? log().find_or_create(track_name)
+                                     : nullptr;
+}
+
+void events_arm_from_env() {
+    // Absent (or empty) env = the documented ALWAYS-ON default. Re-
+    // asserting it here matters: the flag is process-global, so a
+    // bench leg that set ISTPU_EVENTS=0 and then unset the variable
+    // must not leave every later server in the process recording
+    // nothing.
+    const char* env = getenv("ISTPU_EVENTS");
+    events_set_enabled(env == nullptr || env[0] == '\0' ||
+                       env[0] != '0');
+}
+
+void events_set_enabled(bool on) {
+    log().enabled.store(on, std::memory_order_relaxed);
+}
+
+bool events_enabled() {
+    return log().enabled.load(std::memory_order_relaxed);
+}
+
+uint64_t events_seq() {
+    return log().seq.load(std::memory_order_relaxed);
+}
+
+uint64_t events_recorded_total() { return events_seq(); }
+
+uint64_t events_overwritten_total() {
+    EventLog& l = log();
+    uint64_t over = 0;
+    size_t n = l.ntracks.load(std::memory_order_acquire);
+    for (size_t i = 0; i < n; ++i) {
+        uint64_t h = l.tracks[i]->head.load(std::memory_order_relaxed);
+        if (h > EventRing::kCap) over += h - EventRing::kCap;
+    }
+    return over;
+}
+
+long long events_last_us() {
+    return log().last_us.load(std::memory_order_relaxed);
+}
+
+uint64_t events_pack_tag(const char* s) {
+    uint64_t v = 0;
+    if (s != nullptr) {
+        size_t n = strnlen(s, 8);
+        memcpy(&v, s, n);  // little-endian: first char = low byte
+    }
+    return v;
+}
+
+namespace {
+
+struct Drained {
+    uint64_t seq, t0, a0, a1;
+    uint16_t id;
+    const char* track;
+};
+
+// JSON string escape for the (rare) tag bytes; catalog names are
+// clean by construction.
+void append_escaped(std::string& out, const char* s, size_t n) {
+    for (size_t i = 0; i < n; ++i) {
+        unsigned char c = (unsigned char)s[i];
+        if (c == '"' || c == '\\') {
+            out += '\\';
+            out += char(c);
+        } else if (c >= 0x20 && c < 0x7f) {
+            out += char(c);
+        }  // non-printable: drop
+    }
+}
+
+}  // namespace
+
+std::string events_json(uint64_t since_seq) {
+    EventLog& l = log();
+    std::vector<Drained> ev;
+    size_t n = l.ntracks.load(std::memory_order_acquire);
+    for (size_t i = 0; i < n; ++i) {
+        EventRing& r = *l.tracks[i];
+        uint64_t head = r.head.load(std::memory_order_acquire);
+        uint64_t cap = EventRing::kCap;
+        uint64_t start = head > cap ? head - cap : 0;
+        for (uint64_t h = start; h < head; ++h) {
+            const EventRing::Slot& s = r.slots[h % cap];
+            uint64_t g = s.gen.load(std::memory_order_acquire);
+            if (g != h + 1) continue;  // overwritten or mid-write
+            Drained d;
+            d.seq = s.seq.load(std::memory_order_relaxed);
+            d.t0 = s.t0.load(std::memory_order_relaxed);
+            d.id = uint16_t(s.id.load(std::memory_order_relaxed));
+            d.a0 = s.a0.load(std::memory_order_relaxed);
+            d.a1 = s.a1.load(std::memory_order_relaxed);
+            d.track = r.name;
+            std::atomic_thread_fence(std::memory_order_acquire);
+            if (s.gen.load(std::memory_order_relaxed) != h + 1) {
+                continue;  // torn by a concurrent lap
+            }
+            if (d.seq > since_seq) ev.push_back(d);
+        }
+    }
+    std::sort(ev.begin(), ev.end(),
+              [](const Drained& a, const Drained& b) {
+                  return a.seq < b.seq;
+              });
+    std::string out = "{\"events\": [";
+    char buf[256];
+    for (size_t i = 0; i < ev.size(); ++i) {
+        const Drained& d = ev[i];
+        snprintf(buf, sizeof(buf),
+                 "%s{\"seq\": %llu, \"t_us\": %llu, \"track\": \"%s\", "
+                 "\"name\": \"%s\", \"severity\": \"%s\", "
+                 "\"a0\": %llu, \"a1\": %llu",
+                 i ? ", " : "", (unsigned long long)d.seq,
+                 (unsigned long long)d.t0, d.track, event_name(d.id),
+                 severity_name(event_severity(d.id)),
+                 (unsigned long long)d.a0, (unsigned long long)d.a1);
+        out += buf;
+        if (d.id == EV_FAILPOINT_FIRE) {
+            // a0 carries a packed 8-char name tag (events_pack_tag).
+            char tag[9] = {};
+            memcpy(tag, &d.a0, 8);
+            out += ", \"tag\": \"";
+            append_escaped(out, tag, strnlen(tag, 8));
+            out += "\"";
+        }
+        out += "}";
+    }
+    snprintf(buf, sizeof(buf),
+             "], \"recorded\": %llu, \"overwritten\": %llu, "
+             "\"capacity\": %zu, \"enabled\": %d}",
+             (unsigned long long)events_recorded_total(),
+             (unsigned long long)events_overwritten_total(),
+             EventRing::kCap, events_enabled() ? 1 : 0);
+    out += buf;
+    return out;
+}
+
+void events_set_crash_fd(int fd) {
+    int old = crash_fd.exchange(fd);
+    if (old >= 0) close(old);
+    if (fd >= 0) install_crash_hook(crash_hook);
+}
+
+void events_clear_crash_fd(int fd) {
+    // Owner-checked unregister: several in-process servers may share a
+    // bundle dir (CI's ISTPU_BUNDLE_DIR default), and a later start
+    // already replaced-and-closed this fd — blindly clearing would
+    // close the LIVE owner's fd and silently disarm its black box.
+    int cur = fd;
+    if (fd >= 0 && crash_fd.compare_exchange_strong(cur, -1)) {
+        close(fd);
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Raw crash dump. Async-signal-safe: write() of preformatted buffers
+// only — no allocation, no locks, no formatting beyond memcpy. The
+// dump is self-describing (the catalog table travels in it) so the
+// decoder needs no version-matched binary.
+//
+// Layout (little-endian):
+//   u64 magic "ISTPUEVT", u32 version=1, u32 ncatalog, u32 ntracks,
+//   u32 ring_cap
+//   ncatalog × { u16 id, u8 sev, u8 pad, char name[28] }
+//   ntracks  × { char name[24], u64 head,
+//                ring_cap × { u64 seq, t0, id, a0, a1 } }
+// Slots with seq == 0 are empty; torn slots may appear — the decoder
+// sorts by seq and drops zeros, which is all the fidelity a black box
+// after SIGSEGV can promise.
+// ---------------------------------------------------------------------------
+void events_crash_dump(int fd) {
+    if (fd < 0) return;
+    EventLog& l = log();
+    size_t ntracks = l.ntracks.load(std::memory_order_acquire);
+
+    auto put = [fd](const void* p, size_t n) {
+        const char* c = static_cast<const char*>(p);
+        while (n > 0) {
+            ssize_t w = write(fd, c, n);
+            if (w <= 0) return;
+            c += w;
+            n -= size_t(w);
+        }
+    };
+
+    struct Header {
+        uint64_t magic;
+        uint32_t version, ncatalog, ntracks, ring_cap;
+    } hdr;
+    hdr.magic = 0x545645555054'5349ULL;  // "ISTPUEVT" little-endian
+    hdr.version = 1;
+    hdr.ncatalog = uint32_t(EV_COUNT);
+    hdr.ntracks = uint32_t(ntracks);
+    hdr.ring_cap = uint32_t(EventRing::kCap);
+    put(&hdr, sizeof(hdr));
+
+    for (uint16_t id = 0; id < EV_COUNT; ++id) {
+        struct Row {
+            uint16_t id;
+            uint8_t sev, pad;
+            char name[28];
+        } row = {};
+        row.id = id;
+        row.sev = kCatalog[id].sev;
+        strncpy(row.name, kCatalog[id].name, sizeof(row.name) - 1);
+        put(&row, sizeof(row));
+    }
+
+    for (size_t t = 0; t < ntracks; ++t) {
+        EventRing& r = *l.tracks[t];
+        put(r.name, sizeof(r.name));
+        uint64_t head = r.head.load(std::memory_order_acquire);
+        put(&head, sizeof(head));
+        // Batch slots through a stack buffer: 32 slots per write keeps
+        // the handler to ~128 writes per ring.
+        uint64_t batch[32][5];
+        size_t nb = 0;
+        for (size_t i = 0; i < EventRing::kCap; ++i) {
+            const EventRing::Slot& s = r.slots[i];
+            batch[nb][0] = s.seq.load(std::memory_order_relaxed);
+            batch[nb][1] = s.t0.load(std::memory_order_relaxed);
+            batch[nb][2] = s.id.load(std::memory_order_relaxed);
+            batch[nb][3] = s.a0.load(std::memory_order_relaxed);
+            batch[nb][4] = s.a1.load(std::memory_order_relaxed);
+            if (++nb == 32) {
+                put(batch, sizeof(batch));
+                nb = 0;
+            }
+        }
+        if (nb > 0) put(batch, nb * 5 * sizeof(uint64_t));
+    }
+}
+
+}  // namespace istpu

@@ -1,0 +1,154 @@
+// events.h — the always-on flight recorder: lock-free overwrite-oldest
+// STATE-TRANSITION rings for the whole native core.
+//
+// PR 4's trace rings answer "where did this op's microseconds go", but
+// they are off by default and record per-op spans — after a 3am
+// incident (a breaker trip, a worker death, an engine fallback) they
+// hold nothing. This module is the black box that is ALWAYS on: every
+// state transition that matters operationally — breaker open/close,
+// worker death, engine selection/fallback, reclaim passes, watermark
+// crossings, lease revokes, promotion/spill cancels, connection
+// accept/close, failpoint fires, watchdog verdicts — lands in a
+// fixed-size ring with a severity, a monotonic timestamp, its catalog
+// id and two u64 arguments. The rings are drained as JSON by
+// ist_server_events / GET /events, folded into every watchdog
+// diagnostic bundle, and dumped RAW to a pre-opened fd from the fatal-
+// signal handler so even a SIGSEGV leaves the same black box.
+//
+// Ring mechanics reuse the PR-4 slot/generation seqlock (trace.h): the
+// writer claims a slot with a relaxed fetch_add on the ring head,
+// invalidates the slot's generation, release-fences, writes the
+// payload words relaxed, and publishes gen = head+1 with release; a
+// drain acquire-reads gen, copies the payload, re-checks gen, and
+// skips torn slots. Unlike the single-writer trace rings, the
+// fetch_add makes these rings MULTI-writer safe: two writers can touch
+// the same slot only when the ring laps itself within one writer of
+// another, and then the later generation simply wins — exactly the
+// overwrite-oldest semantics the recorder wants. Threads bind a track
+// (per worker, plus reclaim/spill/promote/watchdog); unbound threads
+// (control plane) record to the shared "main" track.
+//
+// Cost contract: events are STATE TRANSITIONS, not per-op records —
+// nothing on the put/get hot path emits. One emit is a fetch_add plus
+// five relaxed stores; the bench events leg pins the end-to-end cost
+// (events_overhead_p50_ratio <= 1.02, ISTPU_EVENTS=0 as the
+// denominator — the kill switch exists ONLY for that measurement).
+//
+// The registry (like the failpoint registry, failpoint.h) is
+// process-global: the flight recorder is the black box for the
+// PROCESS, drained through any live server handle. Events carry a
+// process-wide monotonic `seq`, so a consumer that cares about one
+// window (tests, the watchdog) records the high-water mark first and
+// filters on it.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+
+namespace istpu {
+
+// ---------------------------------------------------------------------------
+// Compiled-in event catalog. One X row per event: enum id, dotted name
+// (the same namespace style as the failpoint catalog), severity.
+// tools/check_invariants.py parses these rows and cross-checks them
+// against every events_emit() call site in native/src — an emit with
+// no catalog row, or a catalog row with no emit site, fails the lint.
+// The a0/a1 argument meaning is per-event and documented in
+// docs/design.md "Flight recorder & watchdog".
+// ---------------------------------------------------------------------------
+#define IST_EVENT_CATALOG(X)                                        \
+    X(EV_SERVER_START, "server.start", SEV_INFO)                    \
+    X(EV_SERVER_STOP, "server.stop", SEV_INFO)                      \
+    X(EV_ENGINE_SELECTED, "engine.selected", SEV_INFO)              \
+    X(EV_ENGINE_FALLBACK, "engine.fallback", SEV_WARN)              \
+    X(EV_CONN_ACCEPT, "conn.accept", SEV_DEBUG)                     \
+    X(EV_CONN_CLOSE, "conn.close", SEV_DEBUG)                       \
+    X(EV_BREAKER_OPEN, "tier.breaker_open", SEV_ERROR)              \
+    X(EV_BREAKER_CLOSE, "tier.breaker_close", SEV_INFO)             \
+    X(EV_DISK_IO_ERROR, "tier.io_error", SEV_ERROR)                 \
+    X(EV_WORKER_DEATH, "worker.death", SEV_ERROR)                   \
+    X(EV_RECLAIM_PASS_BEGIN, "reclaim.pass_begin", SEV_DEBUG)       \
+    X(EV_RECLAIM_PASS_END, "reclaim.pass_end", SEV_DEBUG)           \
+    X(EV_WATERMARK_HIGH, "pool.watermark_high", SEV_WARN)           \
+    X(EV_WATERMARK_LOW, "pool.watermark_low", SEV_INFO)             \
+    X(EV_HARD_STALL, "pool.hard_stall", SEV_WARN)                   \
+    X(EV_LEASE_REVOKE, "lease.revoke", SEV_DEBUG)                   \
+    X(EV_PROMOTE_CANCEL, "promote.cancel", SEV_DEBUG)               \
+    X(EV_SPILL_CANCEL, "spill.cancel", SEV_DEBUG)                   \
+    X(EV_FAILPOINT_FIRE, "failpoint.fire", SEV_WARN)                \
+    X(EV_WATCHDOG_STALL, "watchdog.stall", SEV_ERROR)               \
+    X(EV_WATCHDOG_SLOW_OP, "watchdog.slow_op", SEV_ERROR)           \
+    X(EV_WATCHDOG_QUEUE_GROWTH, "watchdog.queue_growth", SEV_ERROR) \
+    X(EV_BUNDLE_CAPTURED, "watchdog.bundle", SEV_INFO)
+
+enum EventSeverity : uint8_t {
+    SEV_DEBUG = 0,
+    SEV_INFO = 1,
+    SEV_WARN = 2,
+    SEV_ERROR = 3,
+};
+
+enum EventId : uint16_t {
+#define X(id, name, sev) id,
+    IST_EVENT_CATALOG(X)
+#undef X
+        EV_COUNT
+};
+
+const char* event_name(uint16_t id);          // "?" past EV_COUNT
+uint8_t event_severity(uint16_t id);          // SEV_DEBUG past EV_COUNT
+const char* severity_name(uint8_t sev);
+
+// ---------------------------------------------------------------------------
+// Recording. events_emit is the one entry point every subsystem uses;
+// the calling thread's bound track receives the event (the shared
+// "main" track when unbound). Always on; ISTPU_EVENTS=0 (re-read at
+// each server start via events_arm_from_env) disables recording for
+// the bench overhead denominator only.
+// ---------------------------------------------------------------------------
+void events_emit(EventId id, uint64_t a0 = 0, uint64_t a1 = 0);
+
+// Bind the CALLING thread to the named track, creating it on first
+// use (startup only; track slots are capped, overflow shares "main").
+void events_bind_thread(const char* track_name);
+
+void events_arm_from_env();            // ISTPU_EVENTS=0 disables
+void events_set_enabled(bool on);
+bool events_enabled();
+
+uint64_t events_seq();                 // high-water mark (0 = none yet)
+uint64_t events_recorded_total();
+uint64_t events_overwritten_total();   // lapped ring slots
+long long events_last_us();            // CLOCK_MONOTONIC of last emit
+
+// Pack up to 8 chars of `s` into a u64 (little-endian, NUL-padded) —
+// the a0 tag convention for events whose subject is a NAME the two
+// u64 args cannot otherwise carry (failpoint.fire). The JSON drain
+// renders the tag back as a string for those events.
+uint64_t events_pack_tag(const char* s);
+
+// Drain every stable event with seq > since_seq across all tracks,
+// oldest first, as one JSON object:
+//   {"events": [{"seq", "t_us", "track", "name", "severity",
+//                "a0", "a1"[, "tag"]}...],
+//    "recorded": N, "overwritten": D, "capacity": C, "enabled": 0/1}
+std::string events_json(uint64_t since_seq = 0);
+
+// Fatal-signal black box: register `fd` (pre-opened, e.g.
+// <bundle_dir>/crash_events.bin at server start) as the crash-dump
+// target and hook the utils.cc crash handler. On SIGSEGV/SIGBUS/
+// SIGABRT the handler writes a self-describing raw dump — catalog
+// table + every ring's slots — using only async-signal-safe write().
+// tools/istpu_top.py --decode-crash renders it. fd < 0 unregisters.
+void events_set_crash_fd(int fd);
+// Unregister (and close) `fd` ONLY if it is still the registered
+// crash target — a later server's registration already owns the slot
+// (and closed this fd), and clearing blindly would disarm ITS black
+// box. The per-server stop() path uses this, never set(-1).
+void events_clear_crash_fd(int fd);
+
+// The raw-dump writer itself (async-signal-safe; also used by tests).
+void events_crash_dump(int fd);
+
+}  // namespace istpu

@@ -8,6 +8,7 @@
 #include <mutex>
 #include <vector>
 
+#include "events.h"
 #include "log.h"
 
 namespace istpu {
@@ -112,6 +113,12 @@ FailHit Failpoint::fire() {
     if (!hit) return FailHit{};
     fired_.fetch_add(1, std::memory_order_relaxed);
     g_fired.fetch_add(1, std::memory_order_relaxed);
+    // Flight recorder: each actual injection is a state transition
+    // worth post-mortem evidence (a0 = packed point-name tag, a1 =
+    // this point's fire count) — a 3am "why did the breaker trip"
+    // reads the injected EIOs right next to it.
+    events_emit(EV_FAILPOINT_FIRE, events_pack_tag(name_.c_str()),
+                fired_.load(std::memory_order_relaxed));
     FailHit h;
     h.action = action_.load(std::memory_order_relaxed);
     h.err = err_.load(std::memory_order_relaxed);

@@ -6,6 +6,7 @@
 #include <cstring>
 #include <unordered_set>
 
+#include "events.h"
 #include "failpoint.h"
 #include "log.h"
 #include "utils.h"
@@ -80,6 +81,7 @@ Status KVIndex::allocate(const std::string& key, uint32_t size,
         // touches committed entries, and this one is uncommitted and
         // not in the LRU.)
         hard_stalls_.fetch_add(1, std::memory_order_relaxed);
+        events_emit(EV_HARD_STALL, size, /*promote=*/0);
         kick_reclaimer();
         if (evict_internal(size, int(si), false) > 0) {
             got = mm_->allocate(size, &loc);
@@ -435,6 +437,7 @@ Status KVIndex::ensure_resident(Stripe& st, uint32_t stripe_idx, Entry& e,
             // Promotion found no free blocks: another hard stall the
             // watermark reclaimer should have prevented.
             hard_stalls_.fetch_add(1, std::memory_order_relaxed);
+            events_emit(EV_HARD_STALL, e.size, /*promote=*/1);
             kick_reclaimer();
             if (evict_internal(e.size, int(stripe_idx), false) > 0) {
                 got = mm_->allocate(e.size, &loc);
@@ -1098,6 +1101,9 @@ void KVIndex::kick_reclaimer() {
     // Exchange dedupes the notify: under sustained pressure the put
     // path sets the flag once per reclaimer wake, not once per key.
     if (reclaim_kick_.exchange(true, std::memory_order_relaxed)) return;
+    // One flight-recorder mark per wake (the same dedup): occupancy at
+    // the moment the watermark (or promotion pressure) asked for a pass.
+    events_emit(EV_WATERMARK_HIGH, mm_->used_bytes(), mm_->total_bytes());
     {
         ScopedLock lk(reclaim_mu_);
     }
@@ -1106,6 +1112,7 @@ void KVIndex::kick_reclaimer() {
 
 void KVIndex::reclaim_loop() {
     Tracer::bind_thread(reclaim_ring_);
+    events_bind_thread("reclaim");
     const bool trace = reclaim_ring_ != nullptr;
     // Evict in bounded batches so stop() stays responsive and the
     // stripe try-locks are released between rounds.
@@ -1124,6 +1131,7 @@ void KVIndex::reclaim_loop() {
         // workers_dead gauge announces the degradation.
         if (IST_FAILPOINT("worker.reclaim").action == FAIL_KILL) {
             reclaim_died_.store(true, std::memory_order_relaxed);
+            events_emit(EV_WORKER_DEATH, /*kind=*/0, 0);
             IST_ERROR("reclaimer killed by failpoint; eviction degrades "
                       "to inline hard stalls");
             break;
@@ -1149,6 +1157,7 @@ void KVIndex::reclaim_loop() {
             long long tpass = trace ? now_us() : 0;
             size_t pass_victims = 0;
             size_t floor_bytes = size_t(low_ * double(total));
+            events_emit(EV_RECLAIM_PASS_BEGIN, mm_->used_bytes(), total);
             // Victim-age cap for the WHOLE pass: entries touched — or
             // promotion-adopted — after this snapshot are off-limits,
             // so a reclaim-to-low pass can never race a fresh
@@ -1183,6 +1192,11 @@ void KVIndex::reclaim_loop() {
                                 uint16_t(pass_victims > 0xFFFF
                                              ? 0xFFFF
                                              : pass_victims));
+            }
+            size_t used_after = mm_->used_bytes();
+            events_emit(EV_RECLAIM_PASS_END, pass_victims, used_after);
+            if (used_after <= floor_bytes) {
+                events_emit(EV_WATERMARK_LOW, used_after, total);
             }
         }
         lk.lock();
@@ -1228,6 +1242,7 @@ void KVIndex::enqueue_spill(const std::string& key, const BlockRef& block,
 
 void KVIndex::spill_loop() {
     Tracer::bind_thread(spill_ring_);
+    events_bind_thread("spill");
     constexpr size_t kSpillBatch = 64;
     UniqueLock lk(spill_mu_);
     while (true) {
@@ -1248,6 +1263,7 @@ void KVIndex::spill_loop() {
             account_dropped_spills(orphans, /*cancelled=*/true);
             spill_died_.store(true, std::memory_order_relaxed);
             spill_alive_.store(false, std::memory_order_relaxed);
+            events_emit(EV_WORKER_DEATH, /*kind=*/1, orphans.size());
             IST_ERROR("spill writer killed by failpoint; reclaim "
                       "degrades to inline spill/evict");
             lk.unlock();
@@ -1388,18 +1404,29 @@ void KVIndex::finish_spill(SpillItem& item, int64_t off) {
     DiskRef span;
     if (off >= 0) {
         span = std::make_shared<DiskSpan>(disk_, off, item.size);
-    } else if (!disk_->breaker_open()) {
-        // Remember the refusal so async selection stops queueing sizes
-        // the tier cannot hold until its usage drops (see spill_may_fit).
-        // NOT under an open breaker: that failure is the DEVICE, not
-        // capacity — recovery there is the breaker's backoff re-probe,
-        // and a fail-min poisoned by it would outlive the repair.
+    } else if (!disk_->breaker_open() &&
+               !disk_->last_store_failure_was_io()) {
+        // Remember a CAPACITY refusal so async selection stops queueing
+        // sizes the tier cannot hold until its usage drops (see
+        // spill_may_fit). NOT for device write errors (even below the
+        // breaker's 3-consecutive threshold) and NOT under an open
+        // breaker: those failures are the DEVICE's, recovery is the
+        // breaker's consecutive-error count + backoff re-probe, and a
+        // fail-min poisoned by them would suppress the very writes the
+        // breaker needs to observe (1-2 transient EIOs against an
+        // empty tier used to wedge spilling forever — the fail-min
+        // recovery conditions were unreachable there).
         uint32_t cur = spill_fail_min_.load(std::memory_order_relaxed);
         if (item.size < cur) {
             spill_fail_min_.store(item.size, std::memory_order_relaxed);
         }
         spill_fail_used_.store(disk_->used_bytes(),
                                std::memory_order_relaxed);
+        // Arm the fail-min re-probe window (spill_may_fit): the next
+        // retry attempt waits out the backoff instead of storming, but
+        // DOES eventually happen even against an empty tier.
+        spill_fail_retry_at_us_.store(now_us() + kSpillFailRetryUs,
+                                      std::memory_order_relaxed);
     }
     {
         Stripe& st = stripes_[item.stripe];
@@ -1438,9 +1465,11 @@ void KVIndex::finish_spill(SpillItem& item, int64_t off) {
                 st.map.erase(mit);
                 evictions_.fetch_add(1, std::memory_order_relaxed);
                 spills_cancelled_.fetch_add(1, std::memory_order_relaxed);
+                events_emit(EV_SPILL_CANCEL, item.size, /*evicted=*/1);
             } else {
                 e.spilling = false;
                 spills_cancelled_.fetch_add(1, std::memory_order_relaxed);
+                events_emit(EV_SPILL_CANCEL, item.size, /*evicted=*/0);
             }
         }
     }
@@ -1474,7 +1503,21 @@ bool KVIndex::spill_may_fit(uint32_t size) {
         spill_fail_min_.store(UINT32_MAX, std::memory_order_relaxed);
         return true;
     }
-    return false;
+    // Backoff re-probe (PR 10): the two recovery conditions above are
+    // unreachable when the failure happened against an EMPTY tier —
+    // usage cannot drop below 0 and no store is ever attempted once
+    // fmin blocks everything — so one or two transient write errors
+    // (below the breaker's threshold of 3) would wedge spilling
+    // FOREVER. Mirror the breaker's probe: admit ONE victim per
+    // backoff window (CAS moves the deadline, so exactly one caller
+    // per window wins); its store either succeeds (clearing fmin) or
+    // feeds the consecutive-error count toward the breaker, whose own
+    // backoff then takes over.
+    long long now = now_us();
+    long long at = spill_fail_retry_at_us_.load(std::memory_order_relaxed);
+    if (now < at) return false;
+    return spill_fail_retry_at_us_.compare_exchange_strong(
+        at, now + kSpillFailRetryUs, std::memory_order_relaxed);
 }
 
 void KVIndex::cancel_queued_spills() {
@@ -1501,6 +1544,85 @@ void KVIndex::cancel_queued_spills() {
         });
     }
     dropped.clear();  // refs drop outside spill_mu_
+}
+
+void KVIndex::debug_json(std::string& out) const {
+    // One stripe at a time: a debug snapshot must never assemble the
+    // cross-stripe lock set (that is reserved for ops that need a
+    // consistent cut); a slightly skewed view is the right trade for a
+    // data plane that never notices the introspection.
+    constexpr int kAgeBuckets = 16;
+    uint64_t clock = lru_clock_.load(std::memory_order_relaxed);
+    char buf[256];
+    out += "\"stripes\": [";
+    for (uint32_t si = 0; si < kStripes; ++si) {
+        const Stripe& st = stripes_[si];
+        size_t entries = 0, resident = 0, on_disk = 0, limbo = 0;
+        size_t spilling = 0, promoting = 0, uncommitted = 0, inflight = 0;
+        uint64_t bytes = 0;
+        uint64_t age_hist[kAgeBuckets] = {};
+        size_t lru_len = 0;
+        {
+            ScopedLock lk(st.mu);
+            entries = st.map.size();
+            inflight = st.inflight_live;
+            for (const auto& [key, e] : st.map) {
+                (void)key;
+                bytes += e.size;
+                if (!e.committed) uncommitted++;
+                if (e.block) {
+                    resident++;
+                } else if (e.disk) {
+                    on_disk++;
+                } else if (e.heap) {
+                    limbo++;
+                }
+                if (e.spilling) spilling++;
+                if (e.promoting) promoting++;
+            }
+            lru_len = st.lru.size();
+            for (const auto& node : st.lru) {
+                uint64_t age =
+                    clock > node.age ? clock - node.age : 0;
+                int b = 0;
+                while (age > 1 && b < kAgeBuckets - 1) {
+                    age >>= 1;
+                    b++;
+                }
+                age_hist[b]++;
+            }
+        }
+        snprintf(buf, sizeof(buf),
+                 "%s{\"stripe\": %u, \"entries\": %zu, \"bytes\": %llu, "
+                 "\"resident\": %zu, \"disk\": %zu, \"limbo\": %zu, "
+                 "\"spilling\": %zu, \"promoting\": %zu, "
+                 "\"uncommitted\": %zu, \"inflight\": %zu, "
+                 "\"lru_len\": %zu, \"lru_age_hist\": [",
+                 si ? ", " : "", si, entries, (unsigned long long)bytes,
+                 resident, on_disk, limbo, spilling, promoting,
+                 uncommitted, inflight, lru_len);
+        out += buf;
+        for (int b = 0; b < kAgeBuckets; ++b) {
+            snprintf(buf, sizeof(buf), "%s%llu", b ? ", " : "",
+                     (unsigned long long)age_hist[b]);
+            out += buf;
+        }
+        out += "]}";
+    }
+    snprintf(buf, sizeof(buf),
+             "], \"lru_clock\": %llu, \"queues\": {\"spill\": "
+             "{\"depth\": %llu, \"inflight_bytes\": %llu, "
+             "\"heartbeat_age_us\": %lld}, \"promote\": {\"depth\": "
+             "%llu, \"inflight_bytes\": %llu, \"heartbeat_age_us\": "
+             "%lld}}",
+             (unsigned long long)clock,
+             (unsigned long long)spill_queue_depth(),
+             (unsigned long long)spill_inflight_bytes(),
+             spill_heartbeat_age_us(),
+             (unsigned long long)promote_queue_depth(),
+             (unsigned long long)promote_inflight_bytes(),
+             promote_heartbeat_age_us());
+    out += buf;
 }
 
 }  // namespace istpu

@@ -81,6 +81,7 @@
 
 #include "common.h"
 #include "disk_tier.h"
+#include "events.h"
 #include "lock_rank.h"
 #include "mempool.h"
 #include "promote.h"  // Block/BlockRef, DiskSpan/DiskRef, Promoter
@@ -398,9 +399,29 @@ class KVIndex {
     }
     // Heartbeat ages (µs since each worker's last loop iteration;
     // -1 = not running). Control-plane visibility for "alive but
-    // wedged" — distinct from the died flags above.
+    // wedged" — distinct from the died flags above. The anomaly
+    // watchdog (server.cc) samples all three.
     long long reclaim_heartbeat_age_us() const;
     long long spill_heartbeat_age_us() const;
+    long long promote_heartbeat_age_us() const {
+        return promoter_ ? promoter_->heartbeat_age_us() : -1;
+    }
+    uint64_t spill_inflight_bytes() const {
+        return spill_inflight_bytes_.load(std::memory_order_relaxed);
+    }
+    uint64_t promote_inflight_bytes() const {
+        return promoter_ ? promoter_->inflight_bytes() : 0;
+    }
+
+    // Deep-state introspection (GET /debug/state): append per-stripe
+    // entry/byte counts, location mix (pool/disk/limbo + transitional
+    // SPILLING/PROMOTING flags), inflight-token counts and an LRU-age
+    // histogram (power-of-two buckets over the logical age clock), plus
+    // the spill/promote queue summaries, as JSON object members. Locks
+    // stripes ONE AT A TIME (never a cross-stripe set): the view may be
+    // a non-atomic cut across stripes, which a debug endpoint prefers
+    // over stalling the data plane for a consistent one.
+    void debug_json(std::string& out) const;
 
     // Evict least-recently-used committed entries whose blocks are not
     // pinned (use_count()==1) until `want` bytes could plausibly be
@@ -409,6 +430,7 @@ class KVIndex {
     // space NOW (op_lease's last resort); it counts as a hard stall.
     size_t evict_lru(size_t want) {
         hard_stalls_.fetch_add(1, std::memory_order_relaxed);
+        events_emit(EV_HARD_STALL, want, /*promote=*/2);
         kick_reclaimer();
         return evict_internal(want, -1, false);
     }
@@ -675,6 +697,11 @@ class KVIndex {
     // a store succeeds.
     std::atomic<uint32_t> spill_fail_min_{UINT32_MAX};
     std::atomic<uint64_t> spill_fail_used_{0};
+    // Fail-min backoff re-probe (see spill_may_fit): one victim per
+    // window retries the tier so a transient error below the
+    // breaker's threshold cannot suppress spilling forever.
+    static constexpr long long kSpillFailRetryUs = 500 * 1000;
+    std::atomic<long long> spill_fail_retry_at_us_{0};
     bool spill_may_fit(uint32_t size);
 
     // Async promotion worker (promote.{h,cc}); constructed with the

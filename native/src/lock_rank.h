@@ -32,8 +32,12 @@
 //   rank  mutex                          taken while holding
 //   ----  -----------------------------  -------------------------------
 //    10   Server::snap_mu_               (outermost; serializes snapshot)
+//    15   Server::wd_mu_                 (watchdog sleep/wake only;
+//                                        released before any sampling)
 //    20   Server::store_mu_              snap_mu_
 //    30   Server::Worker::pending_mu     (acceptor handoff; nothing)
+//    40   Server::Worker::conns_mu       store_mu_ (debug iteration);
+//                                        nothing on the owner thread
 //   100+s KVIndex stripe s (s < 16)      store_mu_ (control plane);
 //                                        lower-ranked stripes, in index
 //                                        order (cross-stripe ops)
@@ -57,10 +61,10 @@
 //                                        (DiskRef release)
 //   340   Tracer::tracks_mu_             (track creation, startup)
 //
-// Client-side mutexes (client.h) and the log/failpoint registry
-// mutexes stay plain std::mutex: they are terminal leaves that never
-// acquire a ranked mutex underneath, so they can neither create nor
-// mask an ordering violation in the store's lock graph.
+// Client-side mutexes (client.h) and the log/failpoint/event-track
+// registry mutexes stay plain std::mutex: they are terminal leaves
+// that never acquire a ranked mutex underneath, so they can neither
+// create nor mask an ordering violation in the store's lock graph.
 #pragma once
 
 #include <condition_variable>
@@ -74,8 +78,14 @@ namespace istpu {
 
 enum LockRank : int {
     kRankSnapshot = 10,      // Server::snap_mu_
+    kRankWatchdog = 15,      // Server::wd_mu_ (sleep/wake only; never
+                             // held across any other acquisition —
+                             // the watchdog samples unlocked)
     kRankStoreLifetime = 20, // Server::store_mu_
     kRankWorkerPending = 30, // Server::Worker::pending_mu
+    kRankWorkerConns = 40,   // Server::Worker::conns_mu (owner-thread
+                             // map mutation + control-plane debug
+                             // iteration; taken after store_mu_)
     kRankStripeBase = 100,   // KVIndex stripe s -> kRankStripeBase + s
     kRankReclaim = 200,      // KVIndex::reclaim_mu_
     kRankSpillQueue = 210,   // KVIndex::spill_mu_
@@ -97,8 +107,10 @@ inline const char* rank_name(int r) {
         return "pool-arena";
     switch (r) {
         case kRankSnapshot: return "server-snapshot";
+        case kRankWatchdog: return "server-watchdog";
         case kRankStoreLifetime: return "server-store-lifetime";
         case kRankWorkerPending: return "worker-pending";
+        case kRankWorkerConns: return "worker-conns";
         case kRankReclaim: return "reclaim-kick";
         case kRankSpillQueue: return "spill-queue";
         case kRankPromoteQueue: return "promote-queue";

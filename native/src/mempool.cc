@@ -408,4 +408,54 @@ size_t MM::used_bytes() const {
     return total;
 }
 
+void MemoryPool::debug_json(std::string& out) {
+    char buf[192];
+    out += "[";
+    for (size_t ai = 0; ai < arenas_.size(); ++ai) {
+        Arena& a = *arenas_[ai];
+        size_t free_blocks = 0, free_runs = 0, largest_run = 0, run = 0;
+        {
+            // One arena at a time; bit() reads are covered by a.mu for
+            // this arena's word range (the partitioned-bitmap contract).
+            ScopedLock lk(a.mu);
+            for (size_t i = a.begin; i < a.end; ++i) {
+                if (!bit(i)) {
+                    free_blocks++;
+                    run++;
+                    if (run > largest_run) largest_run = run;
+                } else {
+                    if (run > 0) free_runs++;
+                    run = 0;
+                }
+            }
+            if (run > 0) free_runs++;
+        }
+        snprintf(buf, sizeof(buf),
+                 "%s{\"arena\": %zu, \"blocks\": %zu, \"free_blocks\": "
+                 "%zu, \"free_runs\": %zu, \"largest_free_run\": %zu}",
+                 ai ? ", " : "", ai, arenas_[ai]->end - arenas_[ai]->begin,
+                 free_blocks, free_runs, largest_run);
+        out += buf;
+    }
+    out += "]";
+}
+
+void MM::debug_json(std::string& out) {
+    char buf[192];
+    out += "\"pools\": [";
+    size_t n = num_pools();
+    for (size_t i = 0; i < n; ++i) {
+        MemoryPool& p = *pools_[i];
+        snprintf(buf, sizeof(buf),
+                 "%s{\"pool\": %zu, \"bytes\": %zu, \"used_bytes\": %zu, "
+                 "\"block_size\": %zu, \"arenas\": ",
+                 i ? ", " : "", i, p.pool_size(),
+                 p.used_blocks() * p.block_size(), p.block_size());
+        out += buf;
+        p.debug_json(out);
+        out += "}";
+    }
+    out += "]";
+}
+
 }  // namespace istpu

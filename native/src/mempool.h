@@ -82,6 +82,14 @@ class MemoryPool {
     }
     const std::string& shm_name() const { return shm_name_; }
 
+    // Deep-state fragmentation probe (GET /debug/state): appends one
+    // JSON array element per arena with its free-block count, number
+    // of free runs and largest contiguous free run — the allocator-
+    // health numbers an operator needs to tell "pool full" from "pool
+    // fragmented". Scans under ONE arena lock at a time (a skewed cut
+    // beats stalling the allocator).
+    void debug_json(std::string& out);
+
     static constexpr size_t kMaxArenas = 8;
     // Below 2x this many blocks the pool stays single-arena (placement
     // identical to the historical global first-fit).
@@ -181,6 +189,11 @@ class MM {
         }
         return out;
     }
+
+    // Deep-state introspection (GET /debug/state): appends a "pools"
+    // JSON array — per pool: capacity/used bytes plus the per-arena
+    // fragmentation probe above.
+    void debug_json(std::string& out);
 
     static constexpr double kExtendThreshold = 0.5;  // mempool.h:13
     static constexpr size_t kMaxPools = 256;  // append-only capacity bound

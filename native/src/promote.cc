@@ -3,6 +3,7 @@
 #include <algorithm>
 #include <cstring>
 
+#include "events.h"
 #include "failpoint.h"
 #include "kv_index.h"
 #include "log.h"
@@ -58,6 +59,7 @@ void Promoter::start(double cap_frac) {
     running_.store(true, std::memory_order_relaxed);
     alive_.store(true, std::memory_order_relaxed);
     died_.store(false, std::memory_order_relaxed);
+    heartbeat_us_.store(now_us(), std::memory_order_relaxed);
     thread_ = std::thread([this] { loop(); });
 }
 
@@ -121,10 +123,16 @@ void Promoter::enqueue(PromoteItem item) {
     }
 }
 
+long long Promoter::heartbeat_age_us() const {
+    if (!alive_.load(std::memory_order_relaxed)) return -1;
+    return now_us() - heartbeat_us_.load(std::memory_order_relaxed);
+}
+
 void Promoter::drop_item(PromoteItem& item, bool clear_flag) {
     const size_t bs = mm_->block_size();
     if (clear_flag) index_->cancel_promote_flag(item);
     cancelled_.fetch_add(1, std::memory_order_relaxed);
+    events_emit(EV_PROMOTE_CANCEL, item.size, /*raced=*/0);
     inflight_bytes_.fetch_sub(
         (uint64_t(item.size) + bs - 1) / bs * bs, std::memory_order_relaxed);
     queue_depth_.fetch_sub(1, std::memory_order_relaxed);
@@ -156,6 +164,7 @@ void Promoter::cancel_queued() {
 
 void Promoter::loop() {
     Tracer::bind_thread(ring_);
+    events_bind_thread("promote");
     std::deque<PromoteItem> orphans;  // drained on induced death
     UniqueLock lk(mu_);
     while (true) {
@@ -163,6 +172,7 @@ void Promoter::loop() {
             return stop_.load(std::memory_order_relaxed) || !q_.empty();
         });
         if (stop_.load(std::memory_order_relaxed)) break;
+        heartbeat_us_.store(now_us(), std::memory_order_relaxed);
         // Induced worker death (chaos suite): take the queue with us —
         // flags are cleared below, OUTSIDE mu_ (stripe locks nest
         // stripe → queue leaf), so the orphaned keys stay promotable
@@ -172,6 +182,7 @@ void Promoter::loop() {
         if (IST_FAILPOINT("worker.promote").action == FAIL_KILL) {
             orphans.swap(q_);
             died_.store(true, std::memory_order_relaxed);
+            events_emit(EV_WORKER_DEATH, /*kind=*/2, q_.size());
             IST_ERROR("promotion worker killed by failpoint; read "
                       "pipeline degrades to inline promotion");
             break;
@@ -298,6 +309,7 @@ void Promoter::promote_one(PromoteItem& item, const uint8_t* src) {
         async_.fetch_add(1, std::memory_order_relaxed);
     } else {
         cancelled_.fetch_add(1, std::memory_order_relaxed);
+        events_emit(EV_PROMOTE_CANCEL, item.size, /*raced=*/1);
     }
     inflight_bytes_.fetch_sub(
         (uint64_t(item.size) + bs - 1) / bs * bs, std::memory_order_relaxed);

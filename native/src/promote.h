@@ -168,6 +168,14 @@ class Promoter {
     uint64_t cancelled() const {
         return cancelled_.load(std::memory_order_relaxed);
     }
+    // Block-rounded bytes queued/being promoted (deep-state endpoint).
+    uint64_t inflight_bytes() const {
+        return inflight_bytes_.load(std::memory_order_relaxed);
+    }
+    // µs since the worker's last loop iteration; -1 when not alive —
+    // the promote-side mirror of the PR-6 reclaim/spill heartbeats the
+    // anomaly watchdog samples.
+    long long heartbeat_age_us() const;
 
    private:
     void loop();
@@ -188,6 +196,7 @@ class Promoter {
     std::atomic<bool> stop_{false};
     std::atomic<bool> alive_{false};
     std::atomic<bool> died_{false};
+    std::atomic<long long> heartbeat_us_{0};
     std::thread thread_;
     // Queue leaf in the lock order: taken AFTER a stripe lock on
     // enqueue; the worker takes mu_ and stripe locks strictly in

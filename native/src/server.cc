@@ -1,6 +1,7 @@
 #include "server.h"
 
 #include <arpa/inet.h>
+#include <dirent.h>
 #include <errno.h>
 #include <fcntl.h>
 #include <netinet/in.h>
@@ -8,13 +9,17 @@
 #include <sys/eventfd.h>
 #include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <sys/uio.h>
 #include <unistd.h>
 
+#include <algorithm>
+#include <chrono>
 #include <cstdlib>
 #include <cstring>
 
 #include "engine.h"
+#include "events.h"
 #include "failpoint.h"
 #include "log.h"
 #include "utils.h"
@@ -89,6 +94,79 @@ EngineKind resolve_engine_kind(const std::string& configured,
     return kind;
 }
 
+uint64_t env_u64(const char* name, uint64_t dflt) {
+    const char* env = getenv(name);
+    if (env == nullptr || env[0] == '\0') return dflt;
+    char* end = nullptr;
+    unsigned long long v = strtoull(env, &end, 10);
+    if (end == env || *end != '\0') {
+        IST_WARN("ignoring unparseable %s='%s'", name, env);
+        return dflt;
+    }
+    return uint64_t(v);
+}
+
+bool write_text_file(const std::string& path, const std::string& body) {
+    FILE* f = fopen(path.c_str(), "wb");
+    if (f == nullptr) return false;
+    bool ok = body.empty() ||
+              fwrite(body.data(), 1, body.size(), f) == body.size();
+    if (fclose(f) != 0) ok = false;
+    return ok;
+}
+
+// Bundle directory naming: bundle-<%08u seq>-<kind>. Zero-padded so
+// lexicographic order IS age order — the keep-last-K prune and the
+// restart seq scan both lean on it.
+uint64_t bundle_name_seq(const char* name) {
+    if (strncmp(name, "bundle-", 7) != 0) return 0;
+    return strtoull(name + 7, nullptr, 10);
+}
+
+std::vector<std::string> list_bundles(const std::string& dir) {
+    std::vector<std::string> out;
+    DIR* d = opendir(dir.c_str());
+    if (d == nullptr) return out;
+    while (struct dirent* e = readdir(d)) {
+        if (strncmp(e->d_name, "bundle-", 7) == 0) {
+            out.push_back(e->d_name);
+        }
+    }
+    closedir(d);
+    std::sort(out.begin(), out.end());
+    return out;
+}
+
+void remove_bundle_dir(const std::string& path) {
+    DIR* d = opendir(path.c_str());
+    if (d != nullptr) {
+        while (struct dirent* e = readdir(d)) {
+            if (strcmp(e->d_name, ".") == 0 || strcmp(e->d_name, "..") == 0) {
+                continue;
+            }
+            unlink((path + "/" + e->d_name).c_str());
+        }
+        closedir(d);
+    }
+    rmdir(path.c_str());
+}
+
+// Minimal JSON string escape for watchdog manifest details.
+std::string json_escape(const std::string& in) {
+    std::string out;
+    out.reserve(in.size());
+    for (char ch : in) {
+        unsigned char c = (unsigned char)ch;
+        if (c == '"' || c == '\\') {
+            out += '\\';
+            out += char(c);
+        } else if (c >= 0x20 && c < 0x7f) {
+            out += char(c);
+        }
+    }
+    return out;
+}
+
 }  // namespace
 
 Server::Server(const ServerConfig& cfg) : cfg_(cfg) {
@@ -145,6 +223,10 @@ bool Server::start() {
     // bring-up runs under the chaos spec. Runtime arming goes through
     // ist_server_fault / POST /fault.
     failpoints_arm_from_env();
+    // Flight recorder (events.h): always on; ISTPU_EVENTS=0 exists
+    // only for the bench overhead denominator, re-read per start so
+    // an A/B bench in one process measures what it thinks it does.
+    events_arm_from_env();
     // Crashed predecessors may have left multi-GB pools in /dev/shm.
     if (cfg_.enable_shm) reclaim_stale_pools();
     // Pool construction first — this is the slow, once-per-process part
@@ -292,6 +374,7 @@ bool Server::start() {
             listen_fd_ = -1;
             return false;
         } else {
+            events_emit(EV_ENGINE_FALLBACK, /*phase=probe*/ 0, 0);
             IST_INFO("engine=auto: io_uring unavailable (%s); using "
                      "epoll",
                      why.c_str());
@@ -360,6 +443,7 @@ bool Server::start() {
             w->engine.reset();
         }
         if (ekind == EngineKind::kUring && !force_uring) {
+            events_emit(EV_ENGINE_FALLBACK, /*phase=init*/ 1, 0);
             IST_WARN("io_uring engine init failed; falling back to "
                      "epoll");
             ekind = EngineKind::kEpoll;
@@ -372,10 +456,67 @@ bool Server::start() {
     }
 
     running_.store(true);
+    start_us_ = now_us();
     for (auto& w : workers_) {
         Worker* wp = w.get();
+        wp->heartbeat_us.store(start_us_, std::memory_order_relaxed);
         wp->thread = std::thread([this, wp] { loop(*wp); });
     }
+    // Anomaly watchdog + diagnostic bundles (server.h knobs; env
+    // overrides are the operator/test escape hatch). The crash fd is
+    // pre-opened NOW so a later SIGSEGV needs no allocation or path
+    // resolution inside the signal handler.
+    wd_enabled_ = cfg_.watchdog;
+    if (const char* env = getenv("ISTPU_WATCHDOG")) {
+        if (env[0] != '\0') wd_enabled_ = env[0] == '1';
+    }
+    bundle_dir_ = cfg_.bundle_dir;
+    if (bundle_dir_.empty()) {
+        // Default, not override: an explicitly configured bundle_dir
+        // (tests, operators) wins; the env var exists so CI can point
+        // EVERY server of a whole test job at one well-known
+        // directory and upload it on failure.
+        if (const char* env = getenv("ISTPU_BUNDLE_DIR")) {
+            if (env[0] != '\0') bundle_dir_ = env;
+        }
+    }
+    bundle_keep_ = cfg_.bundle_keep > 0 ? cfg_.bundle_keep : 1;
+    wd_interval_us_ =
+        env_u64("ISTPU_WATCHDOG_INTERVAL_MS", cfg_.watchdog_interval_ms) *
+        1000;
+    if (wd_interval_us_ < 10000) wd_interval_us_ = 10000;
+    wd_stall_us_ = env_u64("ISTPU_WATCHDOG_STALL_US",
+                           cfg_.watchdog_stall_us);
+    wd_p99_us_ = env_u64("ISTPU_WATCHDOG_P99_US", cfg_.watchdog_p99_us);
+    wd_cooldown_us_ =
+        env_u64("ISTPU_WATCHDOG_COOLDOWN_MS", cfg_.watchdog_cooldown_ms) *
+        1000;
+    if (!bundle_dir_.empty()) {
+        mkdir(bundle_dir_.c_str(), 0755);  // EEXIST is fine
+        for (const std::string& b : list_bundles(bundle_dir_)) {
+            uint64_t q = bundle_name_seq(b.c_str());
+            if (q > wd_bundle_seq_) wd_bundle_seq_ = q;
+        }
+        std::string crash = bundle_dir_ + "/crash_events.bin";
+        int fd = open(crash.c_str(),
+                      O_CREAT | O_TRUNC | O_WRONLY | O_CLOEXEC, 0644);
+        if (fd >= 0) {
+            crash_fd_ = fd;
+            events_set_crash_fd(fd);
+        } else {
+            IST_WARN("cannot open crash dump %s: %s", crash.c_str(),
+                     strerror(errno));
+        }
+    }
+    wd_stop_.store(false, std::memory_order_relaxed);
+    wd_prev_ = WdPrev{};
+    wd_queue_streak_ = 0;
+    if (wd_enabled_) {
+        wd_thread_ = std::thread([this] { watchdog_loop(); });
+    }
+    events_emit(EV_ENGINE_SELECTED,
+                ekind == EngineKind::kUring ? 1 : 0, nworkers);
+    events_emit(EV_SERVER_START, bound_port_, nworkers);
     IST_INFO("server listening on %s:%u (pool %llu MB, block %llu KB, "
              "shm=%s, workers=%u, reuseport=%d, engine=%s)",
              cfg_.host.c_str(), bound_port_,
@@ -388,6 +529,22 @@ bool Server::start() {
 
 void Server::stop() {
     if (!running_.exchange(false)) return;
+    events_emit(EV_SERVER_STOP, bound_port_, 0);
+    // Watchdog first: it samples through the store getters and must
+    // not race the teardown below (joined before store_mu_ is taken).
+    wd_stop_.store(true, std::memory_order_relaxed);
+    {
+        ScopedLock lk(wd_mu_);
+    }
+    wd_cv_.notify_all();
+    if (wd_thread_.joinable()) wd_thread_.join();
+    if (crash_fd_ >= 0) {
+        // Owner-checked unregister: another in-process server sharing
+        // the bundle dir may have registered (and closed ours) since —
+        // its live fd must survive this stop().
+        events_clear_crash_fd(crash_fd_);
+        crash_fd_ = -1;
+    }
     for (auto& w : workers_) {
         uint64_t one = 1;
         ssize_t n = write(w->wake_fd, &one, sizeof(one));
@@ -397,8 +554,12 @@ void Server::stop() {
         if (w->thread.joinable()) w->thread.join();
     }
     for (auto& w : workers_) {
-        for (auto& [fd, c] : w->conns) close(fd);
-        w->conns.clear();
+        {
+            // conns_mu: a concurrent /debug/state may be iterating.
+            ScopedLock clk(w->conns_mu);
+            for (auto& [fd, c] : w->conns) close(fd);
+            w->conns.clear();
+        }
         // Handed-off connections never adopted before shutdown.
         for (auto& c : w->pending) close(c->fd);
         w->pending.clear();
@@ -647,6 +808,7 @@ std::string Server::stats_json() {
         "\"workers_dead\": %llu, \"failpoints_fired\": %llu, "
         "\"reclaim_heartbeat_age_us\": %lld, "
         "\"spill_heartbeat_age_us\": %lld, "
+        "\"promote_heartbeat_age_us\": %lld, "
         "\"outq_bytes\": %llu, \"outq_cap\": %llu, \"reads_busy\": %llu, "
         "\"lease_bytes\": %llu, \"pins_busy\": %llu, "
         "\"lease_blocks_out\": %llu, \"leases_oom\": %llu, "
@@ -680,6 +842,7 @@ std::string Server::stats_json() {
         (unsigned long long)failpoints_fired_total(),
         (long long)(index_ ? index_->reclaim_heartbeat_age_us() : -1),
         (long long)(index_ ? index_->spill_heartbeat_age_us() : -1),
+        (long long)(index_ ? index_->promote_heartbeat_age_us() : -1),
         (unsigned long long)outq_total_.load(std::memory_order_relaxed),
         (unsigned long long)cfg_.max_outq_bytes,
         (unsigned long long)reads_busy_.load(std::memory_order_relaxed),
@@ -729,13 +892,15 @@ std::string Server::stats_json() {
     // under store_mu_ — stop() clears workers_ under the same lock.
     for (size_t i = 0; i < workers_.size(); ++i) {
         const Worker& w = *workers_[i];
-        char entry[320];
+        long long hb = w.heartbeat_us.load(std::memory_order_relaxed);
+        char entry[384];
         snprintf(entry, sizeof(entry),
                  "%s{\"worker\": %zu, \"connections\": %u, "
                  "\"ops\": %llu, \"bytes_in\": %llu, \"bytes_out\": %llu, "
                  "\"engine\": \"%s\", \"uring_sqes\": %llu, "
                  "\"uring_zc_sends\": %llu, "
-                 "\"uring_copies_avoided\": %llu}",
+                 "\"uring_copies_avoided\": %llu, "
+                 "\"heartbeat_age_us\": %lld}",
                  i ? ", " : "", i,
                  w.nconns.load(std::memory_order_relaxed),
                  (unsigned long long)w.ops.load(std::memory_order_relaxed),
@@ -749,7 +914,8 @@ std::string Server::stats_json() {
                  (unsigned long long)w.eng_zc_sends.load(
                      std::memory_order_relaxed),
                  (unsigned long long)w.eng_copies_avoided.load(
-                     std::memory_order_relaxed));
+                     std::memory_order_relaxed),
+                 hb > 0 ? now_us() - hb : -1);
         out += entry;
     }
     out += "]";
@@ -775,6 +941,47 @@ std::string Server::stats_json() {
                  TraceRing::kCap);
         out += entry;
     }
+    {
+        // Flight recorder + anomaly watchdog (events.h; docs/design.md
+        // "Flight recorder & watchdog"). last_event_age_us lets /health
+        // age the black box without draining it.
+        long long last = events_last_us();
+        static const char* kKindNames[] = {"stall", "slow_op",
+                                           "queue_growth"};
+        int lk = wd_last_kind_.load(std::memory_order_relaxed);
+        long long lt = wd_last_trip_us_.load(std::memory_order_relaxed);
+        uint64_t trips = 0;
+        for (int i = 0; i < 3; ++i) {
+            trips += wd_trips_[i].load(std::memory_order_relaxed);
+        }
+        char entry[512];
+        snprintf(
+            entry, sizeof(entry),
+            ", \"events\": {\"recorded\": %llu, \"overwritten\": %llu, "
+            "\"enabled\": %d, \"last_event_age_us\": %lld}"
+            ", \"watchdog\": {\"enabled\": %d, \"stalled\": %d, "
+            "\"trips\": %llu, \"stall_trips\": %llu, "
+            "\"slow_op_trips\": %llu, \"queue_trips\": %llu, "
+            "\"bundles\": %llu, \"last_trigger\": \"%s\", "
+            "\"last_trip_age_us\": %lld}",
+            (unsigned long long)events_recorded_total(),
+            (unsigned long long)events_overwritten_total(),
+            events_enabled() ? 1 : 0,
+            last > 0 ? now_us() - last : -1, wd_enabled_ ? 1 : 0,
+            wd_stalled_.load(std::memory_order_relaxed) ? 1 : 0,
+            (unsigned long long)trips,
+            (unsigned long long)wd_trips_[kWdStall].load(
+                std::memory_order_relaxed),
+            (unsigned long long)wd_trips_[kWdSlowOp].load(
+                std::memory_order_relaxed),
+            (unsigned long long)wd_trips_[kWdQueue].load(
+                std::memory_order_relaxed),
+            (unsigned long long)wd_bundles_.load(
+                std::memory_order_relaxed),
+            (lk >= 0 && lk < 3) ? kKindNames[lk] : "",
+            lt > 0 ? now_us() - lt : -1);
+        out += entry;
+    }
     out += "}";
     return out;
 }
@@ -796,7 +1003,18 @@ void Server::loop(Worker& w) {
     // completion reaping — engine.h); each poll() is bounded so
     // running_ is re-checked at least twice a second.
     Tracer::bind_thread(w.ring);
+    events_bind_thread(("worker " + std::to_string(w.idx)).c_str());
     while (running_.load()) {
+        // Heartbeat BEFORE the poll: a handler wedged inside dispatch
+        // leaves a stale stamp for the watchdog's stall verdict; the
+        // bounded poll itself (<= ~500 ms) keeps an idle worker fresh.
+        // A WEDGED engine (unrecoverable ring failure — its poll only
+        // sleeps) must NOT stay fresh: every connection on it is dead,
+        // which is exactly the silent wedge the stall verdict exists
+        // to name.
+        if (w.engine->healthy()) {
+            w.heartbeat_us.store(now_us(), std::memory_order_relaxed);
+        }
         w.engine->poll();
     }
 }
@@ -820,7 +1038,10 @@ void Server::adopt_pending(Worker& w) {
         }
         int fd = c->fd;
         Conn& ref = *c;
-        w.conns[fd] = std::move(c);
+        {
+            ScopedLock clk(w.conns_mu);
+            w.conns[fd] = std::move(c);
+        }
         w.engine->conn_added(ref);
         IST_DEBUG("worker %d adopted fd=%d", w.idx, fd);
     }
@@ -854,10 +1075,14 @@ void Server::accept_ready(Worker& w, int ready_fd) {
         c->w = target;
         target->nconns.fetch_add(1, std::memory_order_relaxed);
         n_conns_++;
+        events_emit(EV_CONN_ACCEPT, c->id, uint64_t(target->idx));
         IST_DEBUG("accepted fd=%d -> worker %d", fd, target->idx);
         if (target == &w) {
             Conn& ref = *c;
-            target->conns[fd] = std::move(c);
+            {
+                ScopedLock clk(target->conns_mu);
+                target->conns[fd] = std::move(c);
+            }
             target->engine->conn_added(ref);
         } else {
             c->handoff_t0 = now_us();
@@ -895,7 +1120,14 @@ void Server::close_conn(Worker& w, int fd) {
     // until their kernel notifications drain.
     w.engine->conn_closing(*it->second);
     close(fd);
-    w.conns.erase(it);
+    // close-with-reason: 0 = clean EOF, 1 = protocol/transport error
+    // (the handler or engine marked the connection dead).
+    events_emit(EV_CONN_CLOSE, it->second->id,
+                it->second->dead ? 1 : 0);
+    {
+        ScopedLock clk(w.conns_mu);
+        w.conns.erase(it);
+    }
     w.nconns.fetch_sub(1, std::memory_order_relaxed);
     n_conns_--;
     IST_DEBUG("closed fd=%d", fd);
@@ -1092,6 +1324,7 @@ void Server::handle_message(Conn& c) {
     long long t0 = now_us();
     c.op_t0 = t0;
     uint8_t op = c.hdr.op;
+    c.dbg_op = op;  // deep-state mirror (hdr is not readable cross-thread)
     // FLAG_TRACE: the body's last 8 bytes are the client's trace id.
     // Strip them BEFORE any handler parses, so handlers see exactly the
     // historical body layout; old clients (flags == 0) take neither
@@ -1598,6 +1831,7 @@ void Server::op_lease_revoke(Conn& c) {
     } else {
         uint64_t freed = free_lease_remainder(lit->second);
         c.block_leases.erase(lit);
+        events_emit(EV_LEASE_REVOKE, lease_id, freed);
         w.u32(OK);
         w.u64(freed);
     }
@@ -2019,6 +2253,330 @@ void Server::op_simple(Conn& c) {
         }
     }
     respond(c, c.hdr.seq, c.hdr.op, std::move(body));
+}
+
+
+// ---------------------------------------------------------------------------
+// Deep-state introspection (GET /debug/state). Everything here reads
+// relaxed mirrors (RelaxedCell, atomic gauges) or takes short
+// per-structure locks one at a time — the data plane never waits on a
+// debugger-shaped consumer.
+// ---------------------------------------------------------------------------
+
+std::string Server::debug_state_json() {
+    ScopedLock lk(store_mu_);
+    std::string out = "{";
+    char buf[512];
+    snprintf(buf, sizeof(buf),
+             "\"engine\": \"%s\", \"workers\": %zu, "
+             "\"uptime_us\": %lld, \"connections\": [",
+             engine_name_.c_str(), workers_.size(),
+             start_us_ > 0 ? now_us() - start_us_ : 0);
+    out += buf;
+    bool first = true;
+    for (const auto& w : workers_) {
+        ScopedLock clk(w->conns_mu);
+        for (const auto& [fd, c] : w->conns) {
+            const char* phase = "hdr";
+            switch (RState(c->state)) {
+                case RState::HDR: phase = "hdr"; break;
+                case RState::BODY: phase = "body"; break;
+                case RState::PAYLOAD: phase = "payload"; break;
+                case RState::DRAIN: phase = "drain"; break;
+            }
+            uint8_t op = uint8_t(c->dbg_op);
+            snprintf(buf, sizeof(buf),
+                     "%s{\"id\": %llu, \"fd\": %d, \"worker\": %d, "
+                     "\"phase\": \"%s\", \"op\": \"%s\", "
+                     "\"payload_left\": %llu, \"outq_bytes\": %llu, "
+                     "\"lease_bytes\": %llu}",
+                     first ? "" : ", ", (unsigned long long)c->id, fd,
+                     w->idx, phase, op != 0 ? op_name(op) : "-",
+                     (unsigned long long)uint64_t(c->payload_left),
+                     (unsigned long long)uint64_t(c->outq_bytes),
+                     (unsigned long long)uint64_t(c->lease_bytes));
+            out += buf;
+            first = false;
+        }
+    }
+    out += "], \"worker_state\": [";
+    for (size_t i = 0; i < workers_.size(); ++i) {
+        Worker& w = *workers_[i];
+        size_t pending = 0;
+        {
+            ScopedLock plk(w.pending_mu);
+            pending = w.pending.size();
+        }
+        long long hb = w.heartbeat_us.load(std::memory_order_relaxed);
+        snprintf(buf, sizeof(buf),
+                 "%s{\"worker\": %zu, \"engine\": \"%s\", "
+                 "\"connections\": %u, \"pending\": %zu, "
+                 "\"heartbeat_age_us\": %lld, "
+                 "\"uring_inflight_slots\": %zu}",
+                 i ? ", " : "", i, w.engine ? w.engine->name() : "epoll",
+                 w.nconns.load(std::memory_order_relaxed), pending,
+                 hb > 0 ? now_us() - hb : -1,
+                 w.engine ? w.engine->inflight_slots() : 0);
+        out += buf;
+    }
+    out += "], ";
+    if (index_ != nullptr) {
+        index_->debug_json(out);
+    } else {
+        out += "\"stripes\": []";
+    }
+    out += ", ";
+    if (mm_ != nullptr) {
+        mm_->debug_json(out);
+    } else {
+        out += "\"pools\": []";
+    }
+    snprintf(buf, sizeof(buf),
+             ", \"disk\": {\"bytes\": %llu, \"used_bytes\": %llu, "
+             "\"io_errors\": %llu, \"breaker_open\": %d}",
+             (unsigned long long)(disk_ ? disk_->capacity_bytes() : 0),
+             (unsigned long long)(disk_ ? disk_->used_bytes() : 0),
+             (unsigned long long)(disk_ ? disk_->io_errors() : 0),
+             disk_ && disk_->breaker_open() ? 1 : 0);
+    out += buf;
+    out += "}";
+    return out;
+}
+
+// ---------------------------------------------------------------------------
+// Anomaly watchdog. One native thread, one sample per interval; the
+// verdicts and their thresholds are deliberately simple — the value is
+// the BUNDLE captured at the moment of anomaly, not a clever detector.
+// ---------------------------------------------------------------------------
+
+void Server::watchdog_loop() {
+    events_bind_thread("watchdog");
+    UniqueLock lk(wd_mu_);
+    while (!wd_stop_.load(std::memory_order_relaxed)) {
+        wd_cv_.wait_for(lk, std::chrono::microseconds(wd_interval_us_),
+                        [this] {
+                            return wd_stop_.load(
+                                std::memory_order_relaxed);
+                        });
+        if (wd_stop_.load(std::memory_order_relaxed)) break;
+        // Sample OUTSIDE wd_mu_ (rank 15): the getters below take
+        // store_mu_ (rank 20) and the per-structure locks themselves.
+        lk.unlock();
+        watchdog_sample();
+        lk.lock();
+    }
+}
+
+void Server::watchdog_sample() {
+    long long now = now_us();
+    std::string detail;
+
+    // ---- stall: IO-worker + background heartbeats, worker deaths.
+    bool stalled = false;
+    uint64_t dead = 0;
+    uint64_t spill_q = 0, promote_q = 0, spills = 0, promotes = 0;
+    {
+        ScopedLock lk(store_mu_);  // pins workers_/index_ against stop()
+        for (const auto& w : workers_) {
+            long long hb = w->heartbeat_us.load(std::memory_order_relaxed);
+            if (hb > 0 && now - hb > (long long)wd_stall_us_) {
+                stalled = true;
+                detail = "worker " + std::to_string(w->idx) +
+                         " heartbeat age " +
+                         std::to_string(now - hb) + " us";
+                break;
+            }
+        }
+        if (index_ != nullptr) {
+            dead = index_->workers_dead();
+            spill_q = index_->spill_queue_depth();
+            promote_q = index_->promote_queue_depth();
+            spills = index_->spills() + index_->evictions();
+            promotes = index_->promotes_async() + index_->promotes();
+            // The spill/promote loops stamp their heartbeat only when
+            // WOKEN (their cv waits are untimed), so an idle worker's
+            // age grows without bound — a stale heartbeat is a stall
+            // verdict only when the worker has work it is not doing.
+            // The reclaimer's wait is a 200 ms tick, so it stamps
+            // continuously while alive (backlog 1 = always eligible).
+            struct {
+                const char* who;
+                long long age;
+                uint64_t backlog;
+            } bg[] = {
+                {"reclaim", index_->reclaim_heartbeat_age_us(), 1},
+                {"spill", index_->spill_heartbeat_age_us(), spill_q},
+                {"promote", index_->promote_heartbeat_age_us(),
+                 promote_q},
+            };
+            for (const auto& b : bg) {
+                if (!stalled && b.backlog > 0 &&
+                    b.age > (long long)wd_stall_us_) {
+                    stalled = true;
+                    detail = std::string(b.who) +
+                             " worker heartbeat age " +
+                             std::to_string(b.age) + " us with " +
+                             std::to_string(b.backlog) +
+                             " queued items";
+                }
+            }
+        }
+    }
+    // A dead background worker's heartbeat reads -1 (not running), so
+    // the age checks above can never see it — the death itself is the
+    // stall. The TRIP fires on the transition (against a zero baseline
+    // before the first sample, so a death during startup still trips);
+    // the CURRENT verdict gauge stays raised while any worker is dead.
+    uint64_t prev_dead = wd_prev_.valid ? wd_prev_.workers_dead : 0;
+    bool stall_trip = stalled;
+    if (!stall_trip && dead > prev_dead) {
+        stall_trip = true;
+        detail = "background worker died (workers_dead " +
+                 std::to_string(prev_dead) + " -> " +
+                 std::to_string(dead) + ")";
+    }
+    wd_stalled_.store(stalled || dead > 0, std::memory_order_relaxed);
+
+    // ---- slow op: p99 of the per-op histogram DELTA since the last
+    // sample (all ops aggregated; the bundle's stats.json has the
+    // per-op split). Midpoint convention matches LatHist.
+    uint64_t cur[kNumBuckets] = {};
+    uint64_t cur_count = 0;
+    for (int op = 1; op < kMaxOp; ++op) {
+        for (int b = 0; b < kNumBuckets; ++b) {
+            cur[b] += op_lat_[op].bucket(b);
+        }
+    }
+    for (int b = 0; b < kNumBuckets; ++b) cur_count += cur[b];
+    uint64_t delta_p99 = 0, delta_count = 0;
+    if (wd_prev_.valid && cur_count > wd_prev_.op_count) {
+        uint64_t delta[kNumBuckets];
+        for (int b = 0; b < kNumBuckets; ++b) {
+            delta[b] = cur[b] - wd_prev_.op_buckets[b];
+            delta_count += delta[b];
+        }
+        uint64_t rank = uint64_t(0.99 * double(delta_count - 1)) + 1;
+        uint64_t seen = 0;
+        for (int b = 0; b < kNumBuckets; ++b) {
+            seen += delta[b];
+            if (seen >= rank) {
+                delta_p99 = (1ull << b) + (1ull << b) / 2;
+                break;
+            }
+        }
+    }
+    constexpr uint64_t kMinSlowOpSamples = 8;
+    bool slow = wd_p99_us_ > 0 && delta_count >= kMinSlowOpSamples &&
+                delta_p99 > wd_p99_us_;
+
+    // ---- queue growth without drain: a background queue that stays
+    // populated (or grows) across consecutive samples while its drain
+    // counters stand still is wedged, whatever its thread state says.
+    constexpr uint64_t kQueueFloor = 4;
+    constexpr int kQueueStreak = 3;
+    bool queue_suspect = false;
+    if (wd_prev_.valid) {
+        bool spill_wedged = spill_q >= kQueueFloor &&
+                            spill_q >= wd_prev_.spill_q &&
+                            spills == wd_prev_.spills;
+        bool promote_wedged = promote_q >= kQueueFloor &&
+                              promote_q >= wd_prev_.promote_q &&
+                              promotes == wd_prev_.promotes;
+        queue_suspect = spill_wedged || promote_wedged;
+    }
+    wd_queue_streak_ = queue_suspect ? wd_queue_streak_ + 1 : 0;
+    bool queue_growth = wd_queue_streak_ >= kQueueStreak;
+
+    wd_prev_.valid = true;
+    wd_prev_.op_count = cur_count;
+    memcpy(wd_prev_.op_buckets, cur, sizeof(cur));
+    wd_prev_.spill_q = spill_q;
+    wd_prev_.promote_q = promote_q;
+    wd_prev_.spills = spills;
+    wd_prev_.promotes = promotes;
+    wd_prev_.workers_dead = dead;
+
+    // Per-kind cooldown gates BOTH the event and the bundle: a
+    // persistent stall must not burn a bundle per interval. The
+    // events_emit calls stay LITERAL per kind (not routed through the
+    // helper) so the invariant linter can pin each watchdog.* catalog
+    // row to its real emit site.
+    auto cooled = [&](WdKind kind) {
+        return now - wd_last_per_kind_[kind] >= (long long)wd_cooldown_us_;
+    };
+    // fire() runs AFTER the kind's events_emit so the captured
+    // bundle's events.json contains the verdict event itself.
+    auto fire = [&](WdKind kind, const char* kind_name,
+                    const std::string& det) {
+        wd_last_per_kind_[kind] = now;
+        wd_trips_[kind].fetch_add(1, std::memory_order_relaxed);
+        wd_last_kind_.store(int(kind), std::memory_order_relaxed);
+        wd_last_trip_us_.store(now, std::memory_order_relaxed);
+        IST_WARN("watchdog %s: %s", kind_name, det.c_str());
+        if (!bundle_dir_.empty()) capture_bundle(kind_name, det);
+    };
+    if (stall_trip && cooled(kWdStall)) {
+        events_emit(EV_WATCHDOG_STALL, dead, 0);
+        fire(kWdStall, "stall", detail);
+    }
+    if (slow && cooled(kWdSlowOp)) {
+        events_emit(EV_WATCHDOG_SLOW_OP, delta_p99, delta_count);
+        fire(kWdSlowOp, "slow_op",
+             "op p99 delta " + std::to_string(delta_p99) + " us over " +
+                 std::to_string(delta_count) + " ops (deadline " +
+                 std::to_string(wd_p99_us_) + " us)");
+    }
+    if (queue_growth) {
+        wd_queue_streak_ = 0;  // re-arm after the trigger
+        if (cooled(kWdQueue)) {
+            events_emit(EV_WATCHDOG_QUEUE_GROWTH, spill_q, promote_q);
+            fire(kWdQueue, "queue_growth",
+                 "spill_q " + std::to_string(spill_q) + " promote_q " +
+                     std::to_string(promote_q) +
+                     " held without drain progress");
+        }
+    }
+}
+
+void Server::capture_bundle(const char* kind, const std::string& detail) {
+    char name[96];
+    snprintf(name, sizeof(name), "bundle-%08llu-%s",
+             (unsigned long long)(++wd_bundle_seq_), kind);
+    std::string dir = bundle_dir_ + "/" + name;
+    if (mkdir(dir.c_str(), 0755) != 0 && errno != EEXIST) {
+        IST_WARN("watchdog: cannot create bundle dir %s: %s",
+                 dir.c_str(), strerror(errno));
+        return;
+    }
+    long long t0 = now_us();
+    bool ok = write_text_file(dir + "/stats.json", stats_json());
+    ok &= write_text_file(dir + "/events.json", events_json());
+    ok &= write_text_file(dir + "/trace.json", trace_json());
+    ok &= write_text_file(dir + "/debug_state.json", debug_state_json());
+    char manifest[512];
+    snprintf(manifest, sizeof(manifest),
+             "{\"trigger\": \"%s\", \"detail\": \"%s\", "
+             "\"captured_at_us\": %lld, \"capture_us\": %lld, "
+             "\"seq\": %llu, \"files\": [\"stats.json\", "
+             "\"events.json\", \"trace.json\", "
+             "\"debug_state.json\"]}",
+             kind, json_escape(detail).c_str(), t0, now_us() - t0,
+             (unsigned long long)wd_bundle_seq_);
+    ok &= write_text_file(dir + "/manifest.json", manifest);
+    if (!ok) {
+        IST_WARN("watchdog: bundle %s incomplete (disk?)", dir.c_str());
+    }
+    wd_bundles_.fetch_add(1, std::memory_order_relaxed);
+    events_emit(EV_BUNDLE_CAPTURED, wd_bundle_seq_, 0);
+    IST_WARN("watchdog: diagnostic bundle captured at %s (%s)",
+             dir.c_str(), kind);
+    // Keep-last-K: bounded evidence, not a disk leak. Lexicographic
+    // order is age order (zero-padded seq).
+    std::vector<std::string> bundles = list_bundles(bundle_dir_);
+    while (bundles.size() > bundle_keep_) {
+        remove_bundle_dir(bundle_dir_ + "/" + bundles.front());
+        bundles.erase(bundles.begin());
+    }
 }
 
 }  // namespace istpu

@@ -60,6 +60,7 @@
 
 #include "common.h"
 #include "engine.h"
+#include "events.h"
 #include "kv_index.h"
 #include "lock_rank.h"
 #include "mempool.h"
@@ -130,6 +131,59 @@ struct ServerConfig {
     // env var overrides; "uring" on an unsupported kernel fails
     // start() loudly instead of degrading mid-op.
     std::string engine = "auto";
+    // Anomaly watchdog (docs/design.md "Flight recorder & watchdog"):
+    // a native thread samples the worker/background heartbeats, the
+    // spill/promote queue gauges and the per-op latency histogram
+    // DELTAS once per interval, and on a verdict — stalled worker,
+    // p99-deadline violation, queue growth without drain — emits a
+    // watchdog.* flight-recorder event and (with bundle_dir set)
+    // captures a diagnostic bundle. ISTPU_WATCHDOG=0/1 overrides; the
+    // thresholds below ride ISTPU_WATCHDOG_{INTERVAL_MS,STALL_US,
+    // P99_US,COOLDOWN_MS} env overrides (operator/test escape
+    // hatches, same spirit as ISTPU_TRACE).
+    bool watchdog = true;
+    // Diagnostic-bundle directory (empty = no bundles; verdicts still
+    // emit events). Each trigger captures stats + events + trace +
+    // deep state + a manifest into a keep-last-K subdirectory, and a
+    // pre-opened crash fd in the same directory receives the raw
+    // event rings from the fatal-signal handler. The ISTPU_BUNDLE_DIR
+    // env var supplies a DEFAULT when this is unset (CI points every
+    // test server at one directory and ships it on failure); an
+    // explicitly configured dir always wins.
+    std::string bundle_dir;
+    uint32_t bundle_keep = 4;       // keep-last-K bundles
+    uint64_t watchdog_interval_ms = 1000;
+    uint64_t watchdog_stall_us = 5000000;    // heartbeat-age verdict
+    uint64_t watchdog_p99_us = 1000000;      // op-delta p99 deadline
+    uint64_t watchdog_cooldown_ms = 10000;   // per-kind re-trigger gap
+};
+
+// ---------------------------------------------------------------------------
+// RelaxedCell: a plain-looking field whose reads/writes are relaxed
+// atomics, so the deep-state snapshot (GET /debug/state, the watchdog
+// bundle) may observe a connection's protocol phase and byte cursors
+// from the control plane while the owning worker mutates them — no
+// torn reads, no TSAN findings, and on x86 the same codegen as a raw
+// field for loads/stores. Only the operators the data plane actually
+// uses are provided.
+// ---------------------------------------------------------------------------
+template <typename T>
+struct RelaxedCell {
+    std::atomic<T> v;
+    RelaxedCell(T init = T{}) : v(init) {}  // NOLINT(runtime/explicit)
+    operator T() const { return v.load(std::memory_order_relaxed); }
+    RelaxedCell& operator=(T x) {
+        v.store(x, std::memory_order_relaxed);
+        return *this;
+    }
+    RelaxedCell& operator+=(T x) {
+        v.fetch_add(x, std::memory_order_relaxed);
+        return *this;
+    }
+    RelaxedCell& operator-=(T x) {
+        v.fetch_sub(x, std::memory_order_relaxed);
+        return *this;
+    }
 };
 
 // ---------------------------------------------------------------------------
@@ -167,8 +221,14 @@ struct Conn {
     // bookkeeping); owned by the engine, which may keep it alive past
     // close until in-flight completions drain. Null under epoll.
     void* eng = nullptr;
-    uint64_t outq_bytes = 0;  // bytes queued in outq (backpressure cap)
-    RState state = RState::HDR;
+    // Deep-state-visible cursors (RelaxedCell: the control-plane
+    // debug snapshot reads them while the owning worker writes).
+    RelaxedCell<uint64_t> outq_bytes{0};  // bytes queued (backpressure)
+    RelaxedCell<RState> state{RState::HDR};
+    // The op currently being handled (mirror of hdr.op, stamped once
+    // per message — hdr itself is assembled byte-wise and must not be
+    // read cross-thread).
+    RelaxedCell<uint8_t> dbg_op{0};
     WireHeader hdr{};
     size_t hdr_got = 0;
     std::vector<uint8_t> body;
@@ -179,7 +239,7 @@ struct Conn {
     uint32_t wblock_size = 0;
     size_t wseg = 0;
     size_t wseg_off = 0;
-    uint64_t payload_left = 0;
+    RelaxedCell<uint64_t> payload_left{0};
     std::deque<OutMsg> outq;
     bool want_write = false;  // epoll engine: EPOLLOUT currently armed
     bool dead = false;  // fatal error; closed after unwinding
@@ -211,7 +271,7 @@ struct Conn {
     // Bytes currently pinned by this connection's leases; OP_PIN past
     // cfg_.max_outq_bytes gets BUSY like over-cap OP_READs, so an SHM
     // client that never releases cannot pin the whole pool either.
-    uint64_t lease_bytes = 0;
+    RelaxedCell<uint64_t> lease_bytes{0};
     // Block leases (OP_LEASE): raw pool blocks granted to this
     // connection for zero-RTT client-side allocation. Blocks are
     // consumed by OP_COMMIT_BATCH carving (mirrored deterministically
@@ -255,7 +315,12 @@ struct Worker {
     // Transport engine (epoll or io_uring) driving this worker's loop.
     std::unique_ptr<Engine> engine;
     std::thread thread;
-    std::unordered_map<int, std::unique_ptr<Conn>> conns;  // loop only
+    // Owned by the worker loop. NOT annotated GUARDED_BY: the owner
+    // thread reads it lock-free (all mutation is its own), but every
+    // INSERT/ERASE takes conns_mu so the control-plane deep-state
+    // snapshot can iterate safely (lock_rank.h rank 40).
+    std::unordered_map<int, std::unique_ptr<Conn>> conns;
+    Mutex conns_mu{kRankWorkerConns};
     Mutex pending_mu{kRankWorkerPending};
     // Acceptor → worker handoff queue.
     std::vector<std::unique_ptr<Conn>> pending GUARDED_BY(pending_mu);
@@ -274,6 +339,11 @@ struct Worker {
     std::atomic<uint64_t> eng_copies_avoided{0};
     // This worker's span ring (bound to its thread in loop()).
     TraceRing* ring = nullptr;
+    // Liveness heartbeat, stamped once per engine poll() iteration
+    // (the IO-worker mirror of the PR-6 background-worker heartbeats;
+    // a handler wedged on injected or real slow IO stops stamping and
+    // the watchdog's stall verdict names this worker).
+    std::atomic<long long> heartbeat_us{0};
 };
 
 class Server {
@@ -294,6 +364,15 @@ class Server {
     // Drain the span rings as Chrome trace-event JSON (Perfetto-
     // loadable); empty-event JSON when tracing is off.
     std::string trace_json();
+    // Deep-state introspection (GET /debug/state): per-connection
+    // protocol phase / in-flight bytes / current op, per-worker queue
+    // depth + heartbeat + engine slot occupancy, per-stripe entry and
+    // byte counts with LRU-age histograms and tier-location mix,
+    // per-arena pool fragmentation, and the spill/promote queue
+    // summaries — the whole picture a debugger attach used to be the
+    // only way to see. Thread-safe; racy-by-design relaxed snapshots
+    // where exactness would stall the data plane.
+    std::string debug_state_json();
 
     // Snapshot every committed entry to `path` (atomic tmp+rename) /
     // load a snapshot back (existing keys win; stops at pool-full).
@@ -449,6 +528,52 @@ class Server {
     // Request tracing (trace.h): always constructed (the wait
     // histograms are always on), rings record only when enabled.
     std::unique_ptr<Tracer> tracer_;
+
+    // --- anomaly watchdog (docs/design.md "Flight recorder &
+    // watchdog"). The thread samples OUTSIDE wd_mu_ (the mutex only
+    // paces the sleep — lock_rank.h rank 15) and never holds any lock
+    // while calling the stats/trace/debug getters, which lock
+    // internally.
+    void watchdog_loop();
+    // One sampling pass: returns after emitting verdict events and
+    // (bundle_dir set, cooldown passed) capturing bundles.
+    void watchdog_sample();
+    // Write stats/events/trace/debug-state/manifest into a fresh
+    // keep-last-K bundle directory. `kind` is the trigger name.
+    void capture_bundle(const char* kind, const std::string& detail);
+    long long start_us_ = 0;      // server start stamp (uptime)
+    std::thread wd_thread_;
+    Mutex wd_mu_{kRankWatchdog};
+    CondVar wd_cv_;
+    std::atomic<bool> wd_stop_{false};
+    // Resolved knobs (config + env overrides, fixed at start()).
+    bool wd_enabled_ = true;
+    std::string bundle_dir_;
+    uint32_t bundle_keep_ = 4;
+    uint64_t wd_interval_us_ = 1000000;
+    uint64_t wd_stall_us_ = 5000000;
+    uint64_t wd_p99_us_ = 1000000;
+    uint64_t wd_cooldown_us_ = 10000000;
+    int crash_fd_ = -1;
+    // Verdict state the control plane reads (stats_json, /health).
+    enum WdKind { kWdStall = 0, kWdSlowOp = 1, kWdQueue = 2 };
+    std::atomic<uint64_t> wd_trips_[3] = {};
+    std::atomic<int> wd_last_kind_{-1};
+    std::atomic<long long> wd_last_trip_us_{0};
+    std::atomic<bool> wd_stalled_{false};  // CURRENT stall verdict
+    std::atomic<uint64_t> wd_bundles_{0};
+    // Watchdog-thread-only sampling memory.
+    struct WdPrev {
+        uint64_t op_buckets[LatHist::kBuckets] = {};
+        uint64_t op_count = 0;
+        uint64_t spill_q = 0, promote_q = 0;
+        uint64_t spills = 0, promotes = 0;
+        uint64_t workers_dead = 0;
+        bool valid = false;
+    } wd_prev_;
+    int wd_queue_streak_ = 0;
+    uint64_t wd_bundle_seq_ = 0;
+    long long wd_last_per_kind_[3] = {};
 };
 
 }  // namespace istpu

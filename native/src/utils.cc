@@ -13,7 +13,13 @@ namespace istpu {
 
 namespace {
 
+std::atomic<CrashHook> crash_hook{nullptr};
+
 void crash_handler(int sig) {
+    // Flight-recorder dump first: the rings are the evidence that
+    // explains the backtrace below (events.h contract).
+    CrashHook hook = crash_hook.load(std::memory_order_relaxed);
+    if (hook != nullptr) hook(sig);
     // async-signal-safe-ish: write + backtrace_symbols_fd only.
     const char msg[] = "\n=== infinistore-tpu crash backtrace ===\n";
     ssize_t r = write(STDERR_FILENO, msg, sizeof(msg) - 1);
@@ -43,6 +49,10 @@ void install_crash_handler() {
         sa.sa_flags = SA_RESETHAND;
         sigaction(sig, &sa, nullptr);
     }
+}
+
+void install_crash_hook(CrashHook fn) {
+    crash_hook.store(fn, std::memory_order_relaxed);
 }
 
 long long now_us() {

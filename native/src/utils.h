@@ -15,6 +15,14 @@ namespace istpu {
 // core dumps behave normally). Idempotent.
 void install_crash_handler();
 
+// Register an async-signal-safe hook the crash handler invokes BEFORE
+// the backtrace (single slot, last registration wins; nullptr clears).
+// The flight recorder (events.h) uses it to dump its raw rings to a
+// pre-opened fd so a SIGSEGV leaves the same black box a watchdog
+// bundle would.
+using CrashHook = void (*)(int sig);
+void install_crash_hook(CrashHook fn);
+
 // Monotonic microseconds (per-op latency accounting).
 long long now_us();
 

@@ -246,6 +246,32 @@ def test_chaos_leg_emits_overhead_keys():
     assert out["chaos_off_overhead_p50_ratio"] > 0
 
 
+def test_events_leg_emits_overhead_keys():
+    """The always-on flight-recorder overhead leg (ISSUE 10) must land
+    its keys in the artifact: read p50 with the recorder on (default)
+    vs ISTPU_EVENTS=0, plus the <=1.02 acceptance ratio. The ratio is
+    asserted only as sane (>0) here — CI noise is checked at the
+    acceptance level, not per test run."""
+    env = _env(600)
+    env["ISTPU_EVENTS_KEYS"] = "128"  # small: keep the test fast
+    p = subprocess.run(
+        [sys.executable, BENCH, "--events-leg", "0"], env=env,
+        capture_output=True, text=True, timeout=180,
+    )
+    assert p.returncode == 0, p.stderr[-400:]
+    outs = _parse_artifacts(
+        [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+    )
+    assert outs, p.stdout[-400:]
+    out = outs[-1]
+    assert out["events_on_p50_read_us"] > 0
+    assert out["events_off_p50_read_us"] > 0
+    assert out["events_overhead_p50_ratio"] > 0
+    # The on-leg really recorded (always-on contract): at least the
+    # server.start / engine.selected / conn.accept transitions.
+    assert out["events_recorded"] >= 3
+
+
 def test_probe_failure_cached_across_runs(tmp_path, monkeypatch):
     """A failed probe is persisted; the next run (within the TTL) skips
     the probe subprocess entirely — no 180 s re-burn (the BENCH_r05

@@ -209,6 +209,33 @@ def test_unreachable_suppression_fails(tree):
     assert "prune" in r.stderr
 
 
+def test_uncataloged_event_emit_fails(tree):
+    # Flight-recorder drift, side 1 (ISSUE 10): an events_emit call
+    # site whose id has no IST_EVENT_CATALOG row — an event the drain
+    # would render as "?" and the docs never explain.
+    mutate(tree, "native/src/server.cc", "namespace istpu {",
+           "namespace istpu {\n"
+           "static inline void _bogus_emit() {\n"
+           "    events_emit(EV_BOGUS_EVENT, 0, 0);\n"
+           "}\n", count=1)
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "events:" in r.stderr and "EV_BOGUS_EVENT" in r.stderr
+    assert "no\n" not in r.stdout  # sanity: failure came from stderr
+
+
+def test_stale_event_catalog_row_fails(tree):
+    # Flight-recorder drift, side 2: a catalog row with no emit site —
+    # dead surface that would rot in the docs and the golden.
+    mutate(tree, "native/src/events.h",
+           'X(EV_BUNDLE_CAPTURED, "watchdog.bundle", SEV_INFO)',
+           'X(EV_BUNDLE_CAPTURED, "watchdog.bundle", SEV_INFO) \\\n'
+           '    X(EV_GHOST_ROW, "ghost.row", SEV_INFO)')
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "stale catalog row" in r.stderr and "EV_GHOST_ROW" in r.stderr
+
+
 def test_undocumented_endpoint_fails(tree):
     # A control-plane endpoint the docs do not mention.
     mutate(tree, "infinistore_tpu/server.py",

@@ -156,23 +156,26 @@ def _echo_server():
 
 def test_relay_enforces_bandwidth_cap():
     """The relay's pacer must actually hold the cap — if it under-shapes,
-    every stream_rtt_* fraction in the bench flatters the client. One
-    direction, 8 MiB at 64 MiB/s: expected ~0.125 s; measured rate must
-    land within [0.75, 1.25] of the cap (sleep granularity + 1-core
-    scheduling jitter)."""
+    every stream_rtt_* fraction in the bench flatters the client.
+
+    DEFLAKED (ISSUE 10 satellite, PR-8 review note): the old assertion
+    demanded the measured rate land within [0.75, 1.25] of the cap,
+    but on a loaded CI box wall-clock stretches push the measured rate
+    BELOW 0.75x — a scheduling artifact, not an under-shaping bug. The
+    real regression this test exists to catch is one-sided: the pacer
+    letting bytes through FASTER than the cap. So the upper bound
+    stays tight (rate <= 1.25x cap), and the lower side asserts on the
+    paced-vs-unpaced RATIO instead of wall-clock: the same transfer
+    through an unshaped relay must be measurably faster than the
+    shaped one (>= 2x), proving the pacer actually bit."""
     import socket
     import time as _t
 
-    ls, port = _echo_server()
-    cap = 64 * (1 << 20)
-    relay = ShapingRelay(port, rtt_ms=0.0, bandwidth_bps=cap)
-    relay.start()
-    try:
-        c = socket.create_connection(("127.0.0.1", relay.port))
-        total = 8 << 20
+    def echo_through(relay_port, total):
         payload = bytes(64 << 10)
-        got = bytearray()
+        c = socket.create_connection(("127.0.0.1", relay_port))
         c.settimeout(30)
+        got = bytearray()
         t0 = _t.perf_counter()
         sent = 0
         # Each direction is paced independently and the two pipeline,
@@ -190,14 +193,36 @@ def test_relay_enforces_bandwidth_cap():
         dt = _t.perf_counter() - t0
         c.close()
         assert len(got) == total
-        rate = total / dt
-        assert 0.75 * cap <= rate <= 1.25 * cap, (
-            f"shaped echo rate {rate / 2**20:.1f} MiB/s vs cap "
-            f"{cap / 2**20:.0f} MiB/s"
-        )
+        return dt
+
+    cap = 64 * (1 << 20)
+    total = 8 << 20
+    # One echo upstream per leg: _echo_server serves a single accept.
+    ls, port = _echo_server()
+    shaped = ShapingRelay(port, rtt_ms=0.0, bandwidth_bps=cap)
+    shaped.start()
+    try:
+        dt_shaped = echo_through(shaped.port, total)
     finally:
-        relay.stop()
+        shaped.stop()
         ls.close()
+    ls2, port2 = _echo_server()
+    unshaped = ShapingRelay(port2, rtt_ms=0.0, bandwidth_bps=None)
+    unshaped.start()
+    try:
+        dt_unshaped = echo_through(unshaped.port, total)
+    finally:
+        unshaped.stop()
+        ls2.close()
+    rate = total / dt_shaped
+    assert rate <= 1.25 * cap, (
+        f"pacer under-shapes: {rate / 2**20:.1f} MiB/s through a "
+        f"{cap / 2**20:.0f} MiB/s cap"
+    )
+    assert dt_shaped >= 2.0 * dt_unshaped, (
+        f"pacer did not bite: shaped {dt_shaped * 1e3:.0f} ms vs "
+        f"unshaped {dt_unshaped * 1e3:.0f} ms for {total >> 20} MiB"
+    )
 
 
 def test_relay_injects_rtt():

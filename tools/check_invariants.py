@@ -130,6 +130,30 @@ def expand_brace_names(text):
     return names
 
 
+def parse_event_catalog(root):
+    """The flight-recorder catalog: IST_EVENT_CATALOG X rows in
+    native/src/events.h -> {enum id: dotted name}."""
+    text = _read(root, "native/src/events.h")
+    rows = re.findall(
+        r'^\s*X\((EV_[A-Z0-9_]+),\s*"([a-z_.]+)",\s*SEV_[A-Z]+\)', text,
+        re.M)
+    return dict(rows)
+
+
+def parse_event_sites(root):
+    """Every events_emit(EV_...) call site across native/src (the
+    compiled-in emitters the catalog must mirror)."""
+    sites = set()
+    src = os.path.join(root, "native", "src")
+    for fn in sorted(os.listdir(src)):
+        if not fn.endswith((".cc", ".h")) or fn.startswith("events."):
+            continue
+        with open(os.path.join(src, fn), encoding="utf-8") as f:
+            sites |= set(re.findall(r"events_emit\(\s*(EV_[A-Z0-9_]+)",
+                                    f.read()))
+    return sites
+
+
 def parse_stats_keys(root):
     """Every JSON key stats_json() emits (native/src/server.cc)."""
     text = _read(root, "native/src/server.cc")
@@ -155,8 +179,8 @@ def parse_metrics_refs(root):
 def parse_endpoints(root):
     """HTTP control-plane endpoints from infinistore_tpu/server.py."""
     text = _read(root, "infinistore_tpu/server.py")
-    eps = set(re.findall(r'self\.path == "(/[a-z_0-9]+)"', text))
-    eps |= set(re.findall(r'self\.path\.startswith\("(/[a-z_0-9]+)"\)',
+    eps = set(re.findall(r'self\.path == "(/[a-z_0-9/]+)"', text))
+    eps |= set(re.findall(r'self\.path\.startswith\("(/[a-z_0-9/]+)"\)',
                           text))
     return eps
 
@@ -220,6 +244,30 @@ def check_failpoints(root, sites, catalog):
         errs.append(
             f"failpoints: {name} is undocumented in docs/design.md "
             f"(Failure model section)")
+    return errs
+
+
+def check_events(root, catalog, sites):
+    """Flight-recorder drift: every emit site needs a catalog row,
+    every catalog row needs a live emit site, and every event name
+    must be documented in docs/design.md (Flight recorder section) —
+    the same three-way pin the failpoint catalog gets."""
+    errs = []
+    design = _read(root, "docs/design.md")
+    documented = expand_brace_names(design)
+    for eid in sorted(sites - set(catalog)):
+        errs.append(
+            f"events: {eid} is emitted (events_emit site) but has no "
+            f"IST_EVENT_CATALOG row in native/src/events.h")
+    for eid in sorted(set(catalog) - sites):
+        errs.append(
+            f"events: catalog row {eid} (\"{catalog[eid]}\") has no "
+            f"events_emit call site (stale catalog row)")
+    for eid, name in sorted(catalog.items()):
+        if eid in sites and name not in documented:
+            errs.append(
+                f"events: {name} ({eid}) is undocumented in "
+                f"docs/design.md (Flight recorder section)")
     return errs
 
 
@@ -341,7 +389,7 @@ def _collect_cites(lines):
     return out
 
 
-def build_surface(common, abi, exports, failpoints):
+def build_surface(common, abi, exports, failpoints, events):
     return {
         "abi_version": abi,
         "wire": {
@@ -354,6 +402,7 @@ def build_surface(common, abi, exports, failpoints):
             sorted(common["statuses"].items(), key=lambda kv: kv[1])),
         "exports": sorted(exports),
         "failpoints": sorted(failpoints),
+        "events": sorted(events),
     }
 
 
@@ -367,7 +416,8 @@ def check_golden(root, surface, abi_floor):
         return errs
     with open(path, encoding="utf-8") as f:
         golden = json.load(f)
-    for section in ("wire", "ops", "statuses", "exports", "failpoints"):
+    for section in ("wire", "ops", "statuses", "exports", "failpoints",
+                    "events"):
         if golden.get(section) != surface[section]:
             errs.append(
                 f"golden: '{section}' drifted from tools/abi_surface.json "
@@ -404,10 +454,13 @@ def main(argv=None):
     decls, abi_floor, py_statuses, py_named = parse_native_py(root)
     sites = parse_failpoint_sites(root)
     catalog = parse_failpoint_catalog(root)
+    ev_catalog = parse_event_catalog(root)
+    ev_sites = parse_event_sites(root)
     stats_keys = parse_stats_keys(root)
     metric_refs, _families = parse_metrics_refs(root)
     endpoints = parse_endpoints(root)
-    surface = build_surface(common, abi, exports, sites)
+    surface = build_surface(common, abi, exports, sites,
+                            ev_catalog.values())
 
     if args.write_golden:
         path = os.path.join(root, "tools", "abi_surface.json")
@@ -423,6 +476,7 @@ def main(argv=None):
     errs += check_status_mirror(common, py_statuses, py_named)
     errs += check_exports(exports, decls)
     errs += check_failpoints(root, sites, catalog)
+    errs += check_events(root, ev_catalog, ev_sites)
     errs += check_metrics(stats_keys, metric_refs)
     errs += check_ops_documented(root, common)
     errs += check_endpoints_documented(root, endpoints)
@@ -439,6 +493,7 @@ def main(argv=None):
           f"{len(surface['statuses'])} statuses, "
           f"{len(surface['exports'])} exports, "
           f"{len(surface['failpoints'])} failpoints, "
+          f"{len(surface['events'])} events, "
           f"{len(stats_keys)} stats keys, {len(endpoints)} endpoints)")
     return 0
 

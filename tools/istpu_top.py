@@ -1,0 +1,324 @@
+#!/usr/bin/env python3
+"""istpu_top — live terminal dashboard for an infinistore-tpu server.
+
+Polls the manage plane (``GET /stats`` + ``GET /debug/state`` +
+``GET /events``) and renders one screenful per interval: throughput
+(bytes in/out per second from counter deltas), per-op p50/p99, pool and
+disk occupancy, per-worker connection/queue/heartbeat state, breaker /
+engine / watchdog status, and the flight recorder's recent-events tail.
+Plain ANSI repaint — no curses dependency, works over any ssh tty.
+
+Offline modes make the same renderer the reader for the black boxes the
+watchdog and the crash handler leave behind:
+
+  istpu_top.py --host H --port MANAGE_PORT      live dashboard
+  istpu_top.py --once                           one frame, no repaint
+  istpu_top.py --bundle DIR                     render a watchdog
+      diagnostic bundle (manifest + stats + debug_state + events tail)
+  istpu_top.py --decode-crash FILE              decode the raw event
+      rings the fatal-signal handler dumped (crash_events.bin)
+
+Run from anywhere; stdlib only.
+"""
+
+import argparse
+import json
+import struct
+import sys
+import time
+import urllib.request
+
+CRASH_MAGIC = 0x5456455550545349  # "ISTPUEVT" little-endian
+
+
+def _get_json(base, path, timeout=2.0):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _fmt_bytes(n):
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n < 1024.0 or unit == "TB":
+            return f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}TB"
+
+
+def _fmt_rate(n):
+    return _fmt_bytes(n) + "/s"
+
+
+def _bar(frac, width=24):
+    frac = max(0.0, min(1.0, frac))
+    full = int(frac * width)
+    return "[" + "#" * full + "." * (width - full) + f"] {frac * 100:5.1f}%"
+
+
+def _fmt_age(us):
+    if us is None or us < 0:
+        return "-"
+    if us < 1000:
+        return f"{us}us"
+    if us < 1_000_000:
+        return f"{us / 1000:.0f}ms"
+    return f"{us / 1e6:.1f}s"
+
+
+def render_frame(stats, debug, events, prev=None, dt=None, tail=10):
+    """Render one dashboard frame from the three JSON blobs. ``prev``
+    (the previous stats blob) + ``dt`` enable the throughput deltas;
+    without them the counters are shown as absolutes (bundle mode)."""
+    lines = []
+    eng = stats.get("engine", "?")
+    wd = stats.get("watchdog", {})
+    ev_meta = stats.get("events", {})
+    breaker = stats.get("tier_breaker_open", 0)
+    dead = stats.get("workers_dead", 0)
+    health = "DEGRADED" if (dead or breaker or wd.get("stalled")) else "ok"
+    lines.append(
+        f"istpu-top  engine={eng}  workers={stats.get('workers', '?')}  "
+        f"conns={stats.get('connections', 0)}  health={health}"
+    )
+    flags = []
+    if breaker:
+        flags.append("TIER-BREAKER-OPEN")
+    if dead:
+        flags.append(f"WORKERS-DEAD={dead}")
+    if wd.get("stalled"):
+        flags.append("WATCHDOG-STALL")
+    lines.append(
+        f"watchdog: trips={wd.get('trips', 0)} "
+        f"(stall={wd.get('stall_trips', 0)} "
+        f"slow_op={wd.get('slow_op_trips', 0)} "
+        f"queue={wd.get('queue_trips', 0)}) "
+        f"bundles={wd.get('bundles', 0)} "
+        f"last={wd.get('last_trigger') or '-'}"
+        + ("  " + " ".join(flags) if flags else "")
+    )
+
+    # Throughput: deltas against the previous poll when live.
+    if prev is not None and dt and dt > 0:
+        din = (stats.get("bytes_in", 0) - prev.get("bytes_in", 0)) / dt
+        dout = (stats.get("bytes_out", 0) - prev.get("bytes_out", 0)) / dt
+        dops = (stats.get("ops", 0) - prev.get("ops", 0)) / dt
+        lines.append(
+            f"throughput: in {_fmt_rate(din)}  out {_fmt_rate(dout)}  "
+            f"{dops:.0f} ops/s"
+        )
+    else:
+        lines.append(
+            f"totals: in {_fmt_bytes(stats.get('bytes_in', 0))}  "
+            f"out {_fmt_bytes(stats.get('bytes_out', 0))}  "
+            f"{stats.get('ops', 0)} ops"
+        )
+
+    pool_b = stats.get("pool_bytes", 0) or 1
+    disk_b = stats.get("disk_bytes", 0)
+    lines.append(
+        f"pool {_bar(stats.get('used_bytes', 0) / pool_b)} "
+        f"{_fmt_bytes(stats.get('used_bytes', 0))}/"
+        f"{_fmt_bytes(pool_b)}  keys={stats.get('kvmap_len', 0)}"
+    )
+    if disk_b:
+        lines.append(
+            f"disk {_bar(stats.get('disk_used', 0) / disk_b)} "
+            f"{_fmt_bytes(stats.get('disk_used', 0))}/{_fmt_bytes(disk_b)}"
+            f"  io_errors={stats.get('disk_io_errors', 0)}"
+        )
+    lines.append(
+        f"queues: spill={stats.get('spill_queue_depth', 0)} "
+        f"promote={stats.get('promote_queue_depth', 0)}  "
+        f"hard_stalls={stats.get('hard_stalls', 0)}  "
+        f"reclaim_runs={stats.get('reclaim_runs', 0)}  "
+        f"heartbeats r/s/p="
+        f"{_fmt_age(stats.get('reclaim_heartbeat_age_us', -1))}/"
+        f"{_fmt_age(stats.get('spill_heartbeat_age_us', -1))}/"
+        f"{_fmt_age(stats.get('promote_heartbeat_age_us', -1))}"
+    )
+
+    # Per-op latency table.
+    op_stats = stats.get("op_stats", {})
+    if op_stats:
+        lines.append("")
+        lines.append(f"{'op':<22}{'count':>10}{'p50':>10}{'p99':>10}")
+        for op, s in sorted(op_stats.items(),
+                            key=lambda kv: -kv[1].get("count", 0)):
+            lines.append(
+                f"{op:<22}{s.get('count', 0):>10}"
+                f"{_fmt_age(s.get('p50_us', 0)):>10}"
+                f"{_fmt_age(s.get('p99_us', 0)):>10}"
+            )
+
+    # Per-worker state (debug plane).
+    ws = (debug or {}).get("worker_state", [])
+    if ws:
+        lines.append("")
+        lines.append(
+            f"{'worker':<8}{'engine':<8}{'conns':>6}{'pending':>8}"
+            f"{'hb':>8}{'zc-slots':>9}"
+        )
+        for w in ws:
+            lines.append(
+                f"{w.get('worker', '?'):<8}{w.get('engine', '?'):<8}"
+                f"{w.get('connections', 0):>6}{w.get('pending', 0):>8}"
+                f"{_fmt_age(w.get('heartbeat_age_us', -1)):>8}"
+                f"{w.get('uring_inflight_slots', 0):>9}"
+            )
+    conns = (debug or {}).get("connections", [])
+    active = [c for c in conns if c.get("phase") != "hdr"
+              or c.get("outq_bytes", 0) > 0]
+    if conns:
+        lines.append(
+            f"connections: {len(conns)} open, {len(active)} mid-op"
+        )
+        for c in active[:8]:
+            lines.append(
+                f"  conn {c.get('id')} w{c.get('worker')} "
+                f"{c.get('phase')}/{c.get('op')} "
+                f"in-flight {_fmt_bytes(c.get('payload_left', 0))} "
+                f"outq {_fmt_bytes(c.get('outq_bytes', 0))}"
+            )
+
+    # Recent events tail.
+    evs = (events or {}).get("events", [])
+    lines.append("")
+    lines.append(
+        f"events (recorded={ev_meta.get('recorded', len(evs))}, "
+        f"last {_fmt_age(ev_meta.get('last_event_age_us', -1))} ago):"
+    )
+    for e in evs[-tail:]:
+        tag = f" {e['tag']}" if "tag" in e else ""
+        lines.append(
+            f"  #{e.get('seq'):<8} {e.get('severity', '?'):<6}"
+            f"{e.get('name'):<24}{tag} [{e.get('track')}] "
+            f"a0={e.get('a0')} a1={e.get('a1')}"
+        )
+    return "\n".join(lines)
+
+
+def run_live(args):
+    base = f"http://{args.host}:{args.port}"
+    prev = None
+    prev_t = None
+    while True:
+        try:
+            stats = _get_json(base, "/stats")
+            debug = _get_json(base, "/debug/state")
+            events = _get_json(base, "/events")
+        except Exception as e:  # noqa: BLE001 — keep polling
+            print(f"istpu_top: poll failed: {e}", file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        now = time.monotonic()
+        frame = render_frame(stats, debug, events, prev=prev,
+                             dt=(now - prev_t) if prev_t else None,
+                             tail=args.tail)
+        if not args.once:
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+        print(frame)
+        if args.once:
+            return 0
+        prev, prev_t = stats, now
+        time.sleep(args.interval)
+
+
+def run_bundle(args):
+    """Render a watchdog diagnostic bundle directory offline."""
+    import os
+
+    d = args.bundle
+
+    def load(name):
+        p = os.path.join(d, name)
+        if not os.path.exists(p):
+            return {}
+        with open(p, encoding="utf-8") as f:
+            return json.load(f)
+
+    manifest = load("manifest.json")
+    if manifest:
+        print(
+            f"bundle: trigger={manifest.get('trigger', '?')}  "
+            f"seq={manifest.get('seq', '?')}  "
+            f"captured_at_us={manifest.get('captured_at_us', '?')}"
+        )
+        print(f"detail: {manifest.get('detail', '')}")
+        print()
+    print(render_frame(load("stats.json"), load("debug_state.json"),
+                       load("events.json"), tail=args.tail))
+    return 0
+
+
+def decode_crash(path, out=sys.stdout):
+    """Decode the raw event-ring dump the fatal-signal handler wrote
+    (events.cc events_crash_dump layout; self-describing — the catalog
+    table travels in the file)."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    off = 0
+    magic, version, ncat, ntracks, cap = struct.unpack_from(
+        "<QIIII", blob, off)
+    off += 24
+    if magic != CRASH_MAGIC:
+        raise ValueError(f"{path}: not an istpu crash event dump")
+    catalog = {}
+    for _ in range(ncat):
+        eid, sev = struct.unpack_from("<HB", blob, off)
+        name = blob[off + 4:off + 32].split(b"\0", 1)[0].decode()
+        catalog[eid] = (name, sev)
+        off += 32
+    sev_names = {0: "debug", 1: "info", 2: "warn", 3: "error"}
+    events = []
+    for _ in range(ntracks):
+        tname = blob[off:off + 24].split(b"\0", 1)[0].decode()
+        off += 24
+        (head,) = struct.unpack_from("<Q", blob, off)
+        off += 8
+        for _ in range(cap):
+            seq, t0, eid, a0, a1 = struct.unpack_from("<QQQQQ", blob, off)
+            off += 40
+            if seq != 0:
+                events.append((seq, t0, tname, int(eid), a0, a1))
+    events.sort()
+    print(f"crash dump {path}: version {version}, {ntracks} tracks, "
+          f"{len(events)} events", file=out)
+    for seq, t0, tname, eid, a0, a1 in events:
+        name, sev = catalog.get(eid, (f"id{eid}", 0))
+        print(f"  #{seq:<8} t={t0:<16} {sev_names.get(sev, '?'):<6}"
+              f"{name:<24} [{tname}] a0={a0} a1={a1}", file=out)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0], prog="istpu_top")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=18080,
+                    help="manage-plane port (ServerConfig.manage_port)")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--tail", type=int, default=10,
+                    help="recent flight-recorder events shown")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no repaint)")
+    ap.add_argument("--bundle", default="",
+                    help="render a watchdog diagnostic bundle directory "
+                         "instead of polling a live server")
+    ap.add_argument("--decode-crash", default="",
+                    help="decode a raw crash event dump "
+                         "(bundle_dir/crash_events.bin)")
+    args = ap.parse_args(argv)
+    if args.decode_crash:
+        return decode_crash(args.decode_crash)
+    if args.bundle:
+        return run_bundle(args)
+    try:
+        return run_live(args)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
