@@ -110,6 +110,23 @@ def _decls(lib):
             c.c_longlong,
             [c.c_void_p, c.c_char_p, c.c_longlong],
         ),
+        # metrics-history ring + SLO burn verdict + client telemetry
+        # (ABI v11)
+        (
+            "ist_server_history",
+            c.c_longlong,
+            [c.c_void_p, c.c_char_p, c.c_longlong],
+        ),
+        (
+            "ist_server_slo_trip",
+            c.c_int,
+            [c.c_void_p, c.c_char_p, c.c_uint64, c.c_uint64],
+        ),
+        (
+            "ist_conn_telemetry",
+            None,
+            [c.c_void_p, c.POINTER(c.c_uint64), c.POINTER(c.c_uint64)],
+        ),
         ("ist_server_snapshot", c.c_longlong, [c.c_void_p, c.c_char_p]),
         ("ist_server_restore", c.c_longlong, [c.c_void_p, c.c_char_p]),
         ("ist_server_shm_prefix", c.c_int, [c.c_void_p, c.c_char_p, c.c_int]),
@@ -255,8 +272,10 @@ def _decls(lib):
         ("ist_mm_total_bytes", c.c_uint64, [c.c_void_p]),
         ("ist_mm_num_pools", c.c_uint64, [c.c_void_p]),
     ]
-    # ABI probe FIRST: a stale prebuilt library would misparse the
-    # v10 ist_server_create argument list (trailing watchdog/
+    # ABI probe FIRST: a stale prebuilt library would lack the v11
+    # observability entry points (ist_server_history /
+    # ist_server_slo_trip / ist_conn_telemetry), misparse the v10
+    # ist_server_create argument list (trailing watchdog/
     # bundle_dir/bundle_keep), lack the v10 flight-recorder entry
     # points (ist_server_events / ist_server_debug_state), misparse
     # the v9 trailing engine string, lack
@@ -273,9 +292,9 @@ def _decls(lib):
         ver = int(lib.ist_abi_version())
     except AttributeError:
         ver = 1
-    if ver < 10:
+    if ver < 11:
         raise RuntimeError(
-            f"stale native library at {_LIB_PATH} (ABI v{ver} < v10): "
+            f"stale native library at {_LIB_PATH} (ABI v{ver} < v11): "
             "rebuild with `make -C native` (or delete the .so to let "
             "the import auto-build)"
         )

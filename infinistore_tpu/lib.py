@@ -17,8 +17,11 @@ lib.py:330-707). Differences, all TPU-driven:
 """
 
 import asyncio
+import collections
 import ctypes as ct
+import json
 import logging
+import os
 import random
 import threading
 import time
@@ -52,12 +55,38 @@ class InfiniStoreKeyNotFound(InfiniStoreError):
     pass
 
 
+# Thread-local active trace id (ISSUE 11): _stamp_trace/set_trace_id
+# publish the id of the op currently running on this thread, so the
+# structured-JSON log mode below can correlate every client log line
+# with the merged trace (tools/istpu_trace.py) without the caller
+# threading ids through by hand.
+_log_tls = threading.local()
+
+
+def _active_trace_id():
+    return getattr(_log_tls, "trace_id", 0)
+
+
 class Logger:
     """Routes Python-side logs into the native logger so both languages
-    share one sink/format (reference ``log_msg`` bridge, lib.py:131-150)."""
+    share one sink/format (reference ``log_msg`` bridge, lib.py:131-150).
+
+    ``ISTPU_LOG_JSON=1`` (read per call — tests flip it) switches every
+    client log line to one structured-JSON object carrying the active
+    trace id, a wall-clock stamp and the level, so ``grep trace_id``
+    joins client logs against a merged Perfetto timeline."""
+
+    _LEVEL_NAMES = ("debug", "info", "warning", "error")
 
     @staticmethod
     def _emit(level, msg):
+        if os.environ.get("ISTPU_LOG_JSON") == "1":
+            msg = json.dumps({
+                "ts": round(time.time(), 6),
+                "level": Logger._LEVEL_NAMES[min(level, 3)],
+                "msg": str(msg),
+                "trace_id": "0x%x" % _active_trace_id(),
+            })
         try:
             _native.get_lib().ist_log_msg(level, str(msg).encode())
         except Exception:
@@ -153,6 +182,116 @@ def _as_dst_array(cache):
     return arr
 
 
+def _hist_percentile_us(buckets, q):
+    """Midpoint-of-bucket percentile over power-of-two buckets — the
+    exact convention of the server's LatHist (trace.h), so client and
+    server numbers are comparable bucket for bucket."""
+    total = sum(buckets)
+    if total == 0:
+        return 0
+    rank = int(q * (total - 1)) + 1
+    seen = 0
+    for b, n in enumerate(buckets):
+        seen += n
+        if seen >= rank:
+            return (1 << b) + (1 << b) // 2
+    return 1 << len(buckets)
+
+
+class _ClientTelemetry:
+    """Client-side op telemetry (ISSUE 11): per-op latency histograms in
+    the SAME power-of-two bucket geometry as the server's LatHist
+    (bucket b counts [2^b, 2^(b+1)) µs), plus counters for every retry/
+    backoff/reconnect event the connection machinery performs silently.
+    With server time on the op reply path (/stats op_stats) this
+    decomposes client-visible latency into client+wire vs server time.
+
+    ``ISTPU_CLIENT_STATS=0`` (read at connection construction) disables
+    recording — the kill switch exists ONLY as the bench --obs-leg
+    overhead denominator (client_telemetry_overhead_p50_ratio <= 1.02).
+
+    When the connection traces (``ClientConfig.trace``), each recorded
+    op also lands in a bounded span ring (CLOCK_MONOTONIC timebase via
+    time.monotonic_ns — the same clock the server's span rings use, so
+    same-host client and server spans align with zero skew) for
+    tools/istpu_trace.py's merged timeline."""
+
+    BUCKETS = 20  # LatHist::kBuckets
+
+    def __init__(self, trace_spans=False):
+        self.enabled = os.environ.get("ISTPU_CLIENT_STATS", "1") != "0"
+        self._lock = threading.Lock()
+        self._ops = {}       # name -> [count, total_us, bucket list]
+        self._counters = {}
+        self._spans = (
+            collections.deque(maxlen=4096) if trace_spans else None
+        )
+
+    def record(self, op, t0_us, dur_us, trace_id=0):
+        if not self.enabled:
+            return
+        us = int(dur_us)
+        # bit_length is the C-speed form of the LatHist bucket loop
+        # (us in [2^b, 2^(b+1)) -> b), clamped to the last bucket.
+        b = us.bit_length() - 1
+        if b < 0:
+            b = 0
+        elif b >= self.BUCKETS:
+            b = self.BUCKETS - 1
+        # GIL-relaxed increments (the Python analogue of the native
+        # relaxed atomics): the lock guards only dict INSERTION and
+        # the stats() copy — a cross-thread increment race can lose a
+        # count, never corrupt, and the hot path stays under the 1.02
+        # overhead budget the bench obs leg pins.
+        try:
+            h = self._ops[op]
+        except KeyError:
+            with self._lock:
+                h = self._ops.setdefault(op, [0, 0, [0] * self.BUCKETS])
+        h[0] += 1
+        h[1] += us
+        h[2][b] += 1
+        if self._spans is not None:
+            self._spans.append((op, int(t0_us), us, int(trace_id)))
+
+    def bump(self, counter, n=1):
+        if not self.enabled:
+            return
+        with self._lock:
+            self._counters[counter] = self._counters.get(counter, 0) + n
+
+    def stats(self):
+        with self._lock:
+            ops = {
+                op: {
+                    "count": c,
+                    "total_us": t,
+                    "p50_us": _hist_percentile_us(h, 0.50),
+                    "p99_us": _hist_percentile_us(h, 0.99),
+                    "hist": list(h),
+                }
+                for op, (c, t, h) in self._ops.items()
+            }
+            counters = dict(self._counters)
+        return {"enabled": self.enabled, "ops": ops,
+                "counters": counters}
+
+    def trace_events(self, pid=0, label="client"):
+        """Chrome trace-event dicts for the recorded client spans (one
+        'client' thread track; ts/dur in CLOCK_MONOTONIC µs)."""
+        evts = [{
+            "ph": "M", "pid": pid, "tid": 0, "name": "thread_name",
+            "args": {"name": label},
+        }]
+        for op, t0_us, dur_us, tid in list(self._spans or ()):
+            e = {"ph": "X", "pid": pid, "tid": 0, "name": op,
+                 "cat": "client", "ts": t0_us, "dur": dur_us}
+            if tid:
+                e["args"] = {"trace_id": "0x%x" % tid}
+            evts.append(e)
+        return evts
+
+
 class InfinityConnection:
     """A connection to one infinistore-tpu server.
 
@@ -195,12 +334,20 @@ class InfinityConnection:
         # rings stitch the op's sub-rpcs together. Random base so two
         # clients' ids cannot collide; last_trace_id is what tests (and
         # humans grepping a Perfetto export) look for.
-        import os as _os
-
-        self._trace_base = int.from_bytes(_os.urandom(8), "little")
+        self._trace_base = int.from_bytes(os.urandom(8), "little")
         self._trace_ctr = 0
         self._trace_pinned = False  # externally set id (sharded fan-out)
         self.last_trace_id = 0
+        # Client-side telemetry (client_stats()): per-op latency
+        # histograms + retry/backoff/reconnect counters; span ring for
+        # istpu_trace when tracing is on. ISTPU_CLIENT_STATS=0 (read
+        # here, once) disables — the bench overhead denominator only.
+        self._telemetry = _ClientTelemetry(trace_spans=config.trace)
+        self._tel_record = self._telemetry.record  # hot-path binding
+        # Pin-cache tallies harvested from RETIRED native handles
+        # (close/reconnect) — the counters live on the handle, and
+        # client_stats() promises the final totals even after close.
+        self._pin_cache_base = [0, 0]
 
     # ------------------------------------------------------------------
     # connection lifecycle
@@ -254,12 +401,22 @@ class InfinityConnection:
         return 0
 
     def close(self):
+        # Under _reconnect_lock: close() DESTROYS native handles, and
+        # both the reconnect machinery and client_stats() (documented
+        # for exactly the poll-from-another-thread pattern) read
+        # self._h under the same lock — without it a concurrent
+        # telemetry read could dereference a freed Connection*.
+        with self._reconnect_lock:
+            self._close_locked()
+
+    def _close_locked(self):
         # After a FAILED reconnect, self._h still points at a handle
         # that is ALSO parked in _dead_handles (_reconnect_locked only
         # republishes on success) — destroying it through both paths is
         # a double free (glibc abort; hit by the sharded background
         # redial loop when a shard stays down until close()).
         if self._h and self._h not in self._dead_handles:
+            self._harvest_pin_counts(self._h)
             if self.config.use_lease and self.connected:
                 # Best-effort: commit the pending deferred batch before
                 # teardown, bounded so close() can never hang on a dead
@@ -342,6 +499,9 @@ class InfinityConnection:
         # so guard against parking the same handle twice — close() would
         # otherwise double-destroy it.
         if self._h and self._h not in self._dead_handles:
+            # Fold the retiring handle's pin-cache tallies into the
+            # Python-side base — the replacement handle restarts at 0.
+            self._harvest_pin_counts(self._h)
             self._lib.ist_conn_close(self._h)
             if self.config.use_lease:
                 # Deferred-commit failures latch on the NATIVE handle
@@ -364,6 +524,7 @@ class InfinityConnection:
         self.stream_connected = False
         self.connect()
         self._conn_gen += 1
+        self._telemetry.bump("reconnects")
 
     # Connection-level statuses worth a reconnect+retry. Definitive store
     # answers (KEY_NOT_FOUND, CONFLICT, OUT_OF_MEMORY, BAD_REQUEST) are
@@ -428,10 +589,12 @@ class InfinityConnection:
         # all at once, and the jitter de-synchronizes their replays.
         # Doubles per consecutive retry (streak reset on any success),
         # bounded at 2 s; retry_backoff_ms=0 restores immediate retry.
+        self._telemetry.bump("retries")
         base_ms = getattr(self.config, "retry_backoff_ms", 0)
         if base_ms > 0:
             self._retry_streak = min(self._retry_streak + 1, 6)
             cap_ms = min(base_ms * (1 << (self._retry_streak - 1)), 2000)
+            self._telemetry.bump("backoff_sleeps")
             time.sleep(random.uniform(0.5, 1.0) * cap_ms / 1000.0)
 
     def _retry_busy(self, attempt):
@@ -461,6 +624,7 @@ class InfinityConnection:
             st = attempt(remaining_ms)
             if st not in retryable or time.monotonic() >= deadline:
                 return st
+            self._telemetry.bump("busy_retries")
             time.sleep(delay * random.uniform(0.5, 1.0))
             delay = min(delay * 2, cap)
 
@@ -489,8 +653,79 @@ class InfinityConnection:
         if tid == 0:
             tid = 1
         self.last_trace_id = tid
+        _log_tls.trace_id = tid  # log-line correlation (ISTPU_LOG_JSON)
         self._lib.ist_conn_set_trace(self._h, tid)
         return tid
+
+    def _record_op(self, op, t0, tid=0):
+        """Telemetry tail of a public op: one histogram record (and, in
+        trace mode, one client span) covering the WHOLE client-visible
+        call — retries, backoff sleeps and reconnects included, which
+        is exactly the latency the caller experienced. ``t0`` is a
+        ``time.perf_counter()`` stamp — CLOCK_MONOTONIC on Linux, the
+        exact clock the native span rings read, in float seconds (the
+        float math keeps the hot path under the 1.02 overhead gate;
+        float64 µs precision is sub-µs for any realistic uptime)."""
+        self._tel_record(
+            op, t0 * 1e6, (time.perf_counter() - t0) * 1e6, tid
+        )
+        # The op is over: retire ITS id from the log-correlation slot
+        # (ISTPU_LOG_JSON lines after this point must not claim a
+        # finished op). Conditional — a nested op (put_cache's inner
+        # allocate) or a newer stamp owns the slot by now and must not
+        # be clobbered.
+        if tid and getattr(_log_tls, "trace_id", 0) == tid:
+            _log_tls.trace_id = 0
+
+    def _harvest_pin_counts(self, h):
+        """Fold a retiring handle's native pin-cache tallies into
+        the Python-side base (the counters die with the handle)."""
+        hits = ct.c_uint64(0)
+        misses = ct.c_uint64(0)
+        self._lib.ist_conn_telemetry(h, ct.byref(hits), ct.byref(misses))
+        self._pin_cache_base[0] += int(hits.value)
+        self._pin_cache_base[1] += int(misses.value)
+
+    def client_stats(self):
+        """Client-side telemetry: per-op latency histograms (power-of-
+        two buckets, the server's LatHist geometry) and the counters
+        for everything the connection machinery does silently —
+        retries, backoff sleeps, reconnects, BUSY-loop retries, lease
+        flushes, pin-cache hits/misses (native, lease-mode SHM reads).
+        Works on a closed connection (the final tallies: retired
+        handles' pin-cache counts are harvested at close/reconnect)."""
+        out = self._telemetry.stats()
+        hits = ct.c_uint64(0)
+        misses = ct.c_uint64(0)
+        # Under _reconnect_lock: close() destroys handles under the
+        # same lock, so the handle read here can never race into a
+        # freed Connection*. Parked (already-harvested) handles are
+        # skipped — their counts live in the base; reading them again
+        # would double count.
+        with self._reconnect_lock:
+            if self._h and self._h not in self._dead_handles:
+                self._lib.ist_conn_telemetry(
+                    self._h, ct.byref(hits), ct.byref(misses)
+                )
+            out["counters"]["pin_cache_hits"] = (
+                self._pin_cache_base[0] + int(hits.value)
+            )
+            out["counters"]["pin_cache_misses"] = (
+                self._pin_cache_base[1] + int(misses.value)
+            )
+        return out
+
+    def client_trace_events(self, pid=0, label="client"):
+        """Chrome trace-event dicts for the client-side op spans (empty
+        unless ``config.trace``); tools/istpu_trace.py merges them with
+        the per-shard server /trace exports into one timeline."""
+        return self._telemetry.trace_events(pid=pid, label=label)
+
+    def client_trace_json(self):
+        return json.dumps({
+            "displayTimeUnit": "ms",
+            "traceEvents": self.client_trace_events(),
+        })
 
     def set_trace_id(self, trace_id):
         """Set (or clear, with 0) the trace id carried by outgoing
@@ -500,6 +735,7 @@ class InfinityConnection:
         self._check()
         self._trace_pinned = trace_id != 0
         self.last_trace_id = trace_id
+        _log_tls.trace_id = trace_id
         self._lib.ist_conn_set_trace(self._h, trace_id)
 
     def _reclaim_orphans(self, keys):
@@ -526,11 +762,15 @@ class InfinityConnection:
         skipped on write (first-writer-wins dedup, reference
         infinistore.cpp:353-359)."""
         self._check()
-        self._stamp_trace()
-        return self._run_reconnecting(
-            lambda: self._allocate_once(keys, page_size_in_bytes),
-            keys=keys,
-        )
+        tid = self._stamp_trace()
+        t0 = time.perf_counter()
+        try:
+            return self._run_reconnecting(
+                lambda: self._allocate_once(keys, page_size_in_bytes),
+                keys=keys,
+            )
+        finally:
+            self._record_op("allocate", t0, tid)
 
     def _allocate_once(self, keys, page_size_in_bytes):
         blob = pack_keys(keys)
@@ -825,11 +1065,15 @@ class InfinityConnection:
         batch; retrying the whole put is safe (committed keys dedup
         against identical content)."""
         self._check()
-        self._stamp_trace()
-        return self._run_reconnecting(
-            lambda: self._put_cache_once(cache, blocks, page_size),
-            keys=[k for k, _ in blocks],
-        )
+        tid = self._stamp_trace()
+        t0 = time.perf_counter()
+        try:
+            return self._run_reconnecting(
+                lambda: self._put_cache_once(cache, blocks, page_size),
+                keys=[k for k, _ in blocks],
+            )
+        finally:
+            self._record_op("put_cache", t0, tid)
 
     def _put_cache_once(self, cache, blocks, page_size):
         done = threading.Event()
@@ -848,7 +1092,16 @@ class InfinityConnection:
 
     async def put_cache_async(self, cache, blocks, page_size):
         self._check()
-        self._stamp_trace()
+        tid = self._stamp_trace()
+        t0 = time.perf_counter()
+        try:
+            return await self._put_cache_async_inner(
+                cache, blocks, page_size
+            )
+        finally:
+            self._record_op("put_cache", t0, tid)
+
+    async def _put_cache_async_inner(self, cache, blocks, page_size):
         if self.shm_connected and self.config.use_lease:
             # Lease fast path, same as the sync put_cache: the native
             # call blocks on carve+copy (and occasionally an OP_LEASE
@@ -940,10 +1193,14 @@ class InfinityConnection:
         :class:`InfiniStoreKeyNotFound` (reference returns KEY_NOT_FOUND,
         infinistore.cpp:607)."""
         self._check()
-        self._stamp_trace()
-        return self._run_reconnecting(
-            lambda: self._read_cache_once(cache, blocks, page_size)
-        )
+        tid = self._stamp_trace()
+        t0 = time.perf_counter()
+        try:
+            return self._run_reconnecting(
+                lambda: self._read_cache_once(cache, blocks, page_size)
+            )
+        finally:
+            self._record_op("read_cache", t0, tid)
 
     def _read_cache_once(self, cache, blocks, page_size):
         arr, page_bytes, blob, dst_np = self._prep_read(
@@ -980,7 +1237,16 @@ class InfinityConnection:
 
     async def read_cache_async(self, cache, blocks, page_size):
         self._check()
-        self._stamp_trace()
+        tid = self._stamp_trace()
+        t0 = time.perf_counter()
+        try:
+            return await self._read_cache_async_inner(
+                cache, blocks, page_size
+            )
+        finally:
+            self._record_op("read_cache", t0, tid)
+
+    async def _read_cache_async_inner(self, cache, blocks, page_size):
         loop = asyncio.get_running_loop()
         # Deep pipelining is exactly how a healthy client can trip the
         # server's per-connection outq cap, so BUSY here is expected
@@ -1007,6 +1273,7 @@ class InfinityConnection:
                 if (e.status not in retryable
                         or time.monotonic() >= deadline):
                     raise
+            self._telemetry.bump("busy_retries")
             await asyncio.sleep(delay * random.uniform(0.5, 1.0))
             delay = min(delay * 2, cap)
 
@@ -1022,13 +1289,18 @@ class InfinityConnection:
         flushes the pending deferred-commit batch first, so leased puts
         are committed and visible once sync returns."""
         self._check()
-        if self.config.use_lease:
-            self._lib.ist_lease_flush(self._h)
-        st = self._lib.ist_sync(self._h, self.config.timeout_ms)
-        if st != OK:
-            raise InfiniStoreError(st, "sync failed")
-        self._raise_async_errors()
-        return 0
+        t0 = time.perf_counter()
+        try:
+            if self.config.use_lease:
+                self._telemetry.bump("lease_flushes")
+                self._lib.ist_lease_flush(self._h)
+            st = self._lib.ist_sync(self._h, self.config.timeout_ms)
+            if st != OK:
+                raise InfiniStoreError(st, "sync failed")
+            self._raise_async_errors()
+            return 0
+        finally:
+            self._record_op("sync", t0, self.last_trace_id)
 
     def _raise_async_errors(self):
         if self.config.use_lease:
@@ -1051,6 +1323,7 @@ class InfinityConnection:
         self._check()
         loop = asyncio.get_running_loop()
         if self.config.use_lease:
+            self._telemetry.bump("lease_flushes")
             # Off-loop: the flush itself only enqueues the pending
             # commit batch, but it takes lease_mu_, which a concurrent
             # put_cache_async executor thread may hold across a whole
@@ -1086,7 +1359,11 @@ class InfinityConnection:
                 raise InfiniStoreError(-ret, "check_exist failed")
             return ret == 1
 
-        return self._run_reconnecting(once)
+        t0 = time.perf_counter()
+        try:
+            return self._run_reconnecting(once)
+        finally:
+            self._record_op("check_exist", t0, self.last_trace_id)
 
     def get_match_last_index(self, keys):
         """Longest cached prefix of the key list — THE prefix-cache-hit
@@ -1113,7 +1390,11 @@ class InfinityConnection:
                 raise InfiniStoreError(st, "get_match_last_index failed")
             return idx.value
 
-        return self._run_reconnecting(once)
+        t0 = time.perf_counter()
+        try:
+            return self._run_reconnecting(once)
+        finally:
+            self._record_op("match", t0, self.last_trace_id)
 
     def register_mr(self, cache):
         """No-op for API compatibility (no MR registration on TCP/SHM)."""
@@ -1133,12 +1414,16 @@ class InfinityConnection:
         self._check()
         blob = pack_keys(keys)
         count = ct.c_uint64(0)
-        st = self._lib.ist_delete_keys(
-            self._h, blob, len(blob), len(keys), ct.byref(count)
-        )
-        if st != OK:
-            raise InfiniStoreError(st, "delete failed")
-        return count.value
+        t0 = time.perf_counter()
+        try:
+            st = self._lib.ist_delete_keys(
+                self._h, blob, len(blob), len(keys), ct.byref(count)
+            )
+            if st != OK:
+                raise InfiniStoreError(st, "delete failed")
+            return count.value
+        finally:
+            self._record_op("delete", t0, self.last_trace_id)
 
     def stats(self):
         self._check()
@@ -1185,17 +1470,21 @@ class InfinityConnection:
         blob = pack_keys(keys)
         out = np.zeros(len(keys), dtype=REMOTE_BLOCK_DTYPE)
         lease = ct.c_uint64(0)
-        st = self._retry_busy(
-            lambda _remaining_ms: self._lib.ist_pin(
-                self._h, blob, len(blob), len(keys),
-                out.ctypes.data_as(ct.c_void_p), ct.byref(lease),
+        t0 = time.perf_counter()
+        try:
+            st = self._retry_busy(
+                lambda _remaining_ms: self._lib.ist_pin(
+                    self._h, blob, len(blob), len(keys),
+                    out.ctypes.data_as(ct.c_void_p), ct.byref(lease),
+                )
             )
-        )
-        if st == KEY_NOT_FOUND:
-            raise InfiniStoreKeyNotFound(st, "pin: key not found")
-        if st != OK:
-            raise InfiniStoreError(st, "pin failed")
-        return lease.value, out
+            if st == KEY_NOT_FOUND:
+                raise InfiniStoreKeyNotFound(st, "pin: key not found")
+            if st != OK:
+                raise InfiniStoreError(st, "pin failed")
+            return lease.value, out
+        finally:
+            self._record_op("pin", t0, self.last_trace_id)
 
     def release(self, lease_id):
         self._check()
@@ -1220,7 +1509,14 @@ class InfinityConnection:
         self._check()
         if not self.config.prefetch or not keys:
             return None
-        self._stamp_trace()
+        tid = self._stamp_trace()
+        t0 = time.perf_counter()
+        try:
+            return self._prefetch_once(keys, wait)
+        finally:
+            self._record_op("prefetch", t0, tid)
+
+    def _prefetch_once(self, keys, wait):
         blob = pack_keys(keys)
         if not wait:
             self._lib.ist_prefetch(

@@ -19,6 +19,7 @@ import json
 import signal
 import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from . import _native
@@ -140,6 +141,30 @@ class InfiniStoreServer:
             self._read_blob(self._lib.ist_server_debug_state)
         )
 
+    def history(self):
+        """Metrics-history ring (``GET /history``): the overwrite-
+        oldest ring of ~1 Hz stats snapshots (occupancy, queue depths,
+        counter + latency-histogram deltas, breaker/degraded flags),
+        oldest first — sampled on the native watchdog thread every
+        ``watchdog_interval_ms``, included in every watchdog bundle as
+        ``history.json``, rendered as sparklines by tools/istpu_top.py
+        and consumed by :class:`SLOTracker` for burn rates. Survives
+        ``purge()`` (gauges reset in later samples; the ring itself is
+        never cleared)."""
+        return json.loads(
+            self._read_blob(self._lib.ist_server_history)
+        )
+
+    def slo_trip(self, detail, a0=0, a1=0):
+        """Fire the ``slo_burn`` watchdog verdict (the SLO tracker's
+        trigger): emits the ``watchdog.slo_burn`` catalog event, counts
+        the trip and captures a diagnostic bundle like the native
+        verdict kinds. Returns True when the verdict fired, False while
+        the per-kind cooldown holds."""
+        return int(self._lib.ist_server_slo_trip(
+            self._h, str(detail).encode(), int(a0), int(a1)
+        )) == 1
+
     def fault(self, spec):
         """Arm/disarm failpoints from a spec string (grammar in
         native/src/failpoint.h): ``"name=policy[:action];..."`` with
@@ -196,6 +221,198 @@ class InfiniStoreServer:
         return False
 
 
+class SLOTracker:
+    """Multi-window burn-rate SLO tracker over the metrics-history ring
+    (ISSUE 11; Google SRE-workbook shape scaled to this store's time
+    base). Objectives:
+
+    - **latency**: a fraction ``latency_objective`` of ops must finish
+      under ``latency_threshold_ms``. Per window, "bad" ops are counted
+      from the ring's aggregate latency-histogram deltas — every op in
+      a power-of-two bucket whose lower bound is >= the threshold
+      (conservative: the threshold's own bucket is not counted).
+    - **availability** (store-health proxy): ``disk_io_errors_delta``
+      per op must stay under ``1 - availability_objective``. The
+      counter covers EVERY tier IO error — foreground reads AND
+      background spill/promote writes (a failed background spill is
+      absorbed without failing any client op) — so this objective
+      burns on store health, not strictly on client-visible failures;
+      a flaky tier under spill pressure pages here even while reads
+      are 100% healthy, which is the early warning it exists to give.
+
+    Burn rate per window = (bad fraction) / (1 - objective); 1.0 means
+    the error budget burns exactly at the sustainable rate. The verdict
+    requires BOTH windows (short AND long) over ``burn_threshold`` —
+    the standard multi-window guard: the long window proves it is not a
+    blip, the short window proves it is still happening.
+
+    ``status()`` computes on demand (``GET /slo``); ``start()`` spawns
+    the polling thread that calls :meth:`InfiniStoreServer.slo_trip`
+    when burning — the native side emits the ``watchdog.slo_burn``
+    event and captures the bundle (with the ring as ``history.json``),
+    under the native per-kind cooldown."""
+
+    _LAT_BUCKETS = 20  # LatHist::kBuckets (the ring's lat_delta width)
+
+    def __init__(self, server, latency_threshold_ms=100.0,
+                 latency_objective=0.999, availability_objective=0.999,
+                 short_window_s=60.0, long_window_s=300.0,
+                 burn_threshold=2.0, interval_s=1.0):
+        if not (0.0 < latency_objective < 1.0):
+            raise ValueError("latency_objective must be in (0, 1)")
+        if not (0.0 < availability_objective < 1.0):
+            raise ValueError("availability_objective must be in (0, 1)")
+        if short_window_s > long_window_s:
+            raise ValueError("short window must be <= long window")
+        self.server = server
+        self.latency_threshold_us = int(latency_threshold_ms * 1000)
+        self.latency_objective = float(latency_objective)
+        self.availability_objective = float(availability_objective)
+        self.short_window_s = float(short_window_s)
+        self.long_window_s = float(long_window_s)
+        self.burn_threshold = float(burn_threshold)
+        self.interval_s = max(float(interval_s), 0.01)
+        self.trips = 0
+        self._stop = threading.Event()
+        self._thread = None
+        # Live-status cache (interval_s TTL): a /metrics scrape, a
+        # GET /slo and the verdict thread would otherwise each drain
+        # and re-parse the whole 512-sample ring — once per interval
+        # is all the signal changes.
+        self._cache = None
+        self._cache_t = 0.0
+        # Smallest bucket counted "bad": lower bound 2^b >= threshold,
+        # clamped to the LAST bucket — it is open-ended ([2^19, inf)),
+        # so a threshold beyond the histogram range degrades to "ops
+        # slower than ~0.52 s count bad" (over-alerting) instead of
+        # silently never counting anything (lat_delta[20:] is empty).
+        b = 0
+        while ((1 << b) < self.latency_threshold_us
+               and b < self._LAT_BUCKETS - 1):
+            b += 1
+        self._bad_bucket = b
+
+    # -- burn-rate math (pure; testable without a server) --------------
+
+    def _window(self, samples, now_us, window_s):
+        cut = now_us - int(window_s * 1e6)
+        total = bad = errs = 0
+        for s in samples:
+            if s.get("t_us", 0) < cut:
+                continue
+            total += s.get("ops_delta", 0)
+            errs += s.get("disk_io_errors_delta", 0)
+            lat = s.get("lat_delta", [])
+            bad += sum(lat[self._bad_bucket:])
+        lat_burn = (
+            (bad / total) / (1.0 - self.latency_objective)
+            if total else 0.0
+        )
+        avail_burn = (
+            (errs / total) / (1.0 - self.availability_objective)
+            if total else 0.0
+        )
+        return {
+            "window_s": window_s,
+            "ops": total,
+            "bad": bad,
+            "errors": errs,
+            "latency_burn_rate": round(lat_burn, 3),
+            "availability_burn_rate": round(avail_burn, 3),
+        }
+
+    def status(self, history=None):
+        """The ``GET /slo`` blob: objectives + per-window burn rates +
+        the current verdict. ``history`` (a pre-fetched ring blob) is
+        for tests; normally the live ring is drained — at most once
+        per ``interval_s`` (TTL cache shared by the verdict thread,
+        /slo and the /metrics families)."""
+        if history is None:
+            now = time.monotonic()
+            if (self._cache is not None
+                    and now - self._cache_t < self.interval_s):
+                return self._cache
+        h = history if history is not None else self.server.history()
+        samples = h.get("history", [])
+        now_us = h.get("now_us", 0)
+        short = self._window(samples, now_us, self.short_window_s)
+        long_ = self._window(samples, now_us, self.long_window_s)
+        lat_burning = (
+            short["latency_burn_rate"] >= self.burn_threshold
+            and long_["latency_burn_rate"] >= self.burn_threshold
+        )
+        avail_burning = (
+            short["availability_burn_rate"] >= self.burn_threshold
+            and long_["availability_burn_rate"] >= self.burn_threshold
+        )
+        st = {
+            "enabled": bool(h.get("enabled", 0)),
+            "latency": {
+                "threshold_us": self.latency_threshold_us,
+                "objective": self.latency_objective,
+            },
+            "availability": {
+                "objective": self.availability_objective,
+            },
+            "burn_threshold": self.burn_threshold,
+            "short": short,
+            "long": long_,
+            "burning": lat_burning or avail_burning,
+            "latency_burning": lat_burning,
+            "availability_burning": avail_burning,
+            "trips": self.trips,
+        }
+        if history is None:
+            self._cache = st
+            self._cache_t = time.monotonic()
+        return st
+
+    # -- verdict thread ------------------------------------------------
+
+    def poll_once(self):
+        """One tracker pass; returns the status blob. Fires the native
+        slo_burn verdict (event + bundle, native cooldown) when both
+        windows burn over threshold."""
+        st = self.status()
+        if st["burning"]:
+            kind = ("latency" if st["latency_burning"]
+                    else "availability")
+            burn = st["short"][f"{kind}_burn_rate"]
+            detail = (
+                f"{kind} burn rate {burn}x over budget in both windows "
+                f"({self.short_window_s:.0f}s/{self.long_window_s:.0f}s,"
+                f" threshold {self.burn_threshold}x)"
+            )
+            if self.server.slo_trip(detail, int(burn * 1000),
+                                    int(self.short_window_s)):
+                self.trips += 1
+                Logger.warning(f"slo_burn verdict: {detail}")
+        return st
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="istpu-slo"
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — keep polling
+                Logger.debug(f"slo tracker poll failed: {e}")
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+
+
 def _selftest(service_port):
     """RDMA-loopback self-test analogue (reference server.py:41-91):
     write/read/verify a small payload through the real data path."""
@@ -225,10 +442,11 @@ def _selftest(service_port):
         conn.close()
 
 
-def _prometheus_metrics(stats):
+def _prometheus_metrics(stats, slo=None):
     """Render the native stats blob in Prometheus text format
     (observability beyond the reference, which exposes only
-    /kvmap_len + /purge + /selftest — reference server.py:29-96)."""
+    /kvmap_len + /purge + /selftest — reference server.py:29-96).
+    ``slo`` (an :class:`SLOTracker`) adds the burn-rate families."""
     g = [  # (stat key, metric name, help)
         ("kvmap_len", "keys", "committed + inflight keys in the index"),
         ("inflight", "inflight_writes", "uncommitted allocations"),
@@ -301,6 +519,26 @@ def _prometheus_metrics(stats):
     )
     lines.append("# TYPE infinistore_engine gauge")
     lines.append(f'infinistore_engine{{engine="{engine}"}} 1')
+    # Build-info gauge (ISSUE 11 satellite): the facts dashboards used
+    # to scrape out of /stats prose — ABI version, selected engine,
+    # kernel release, data-plane worker count — as labels on a constant
+    # 1 (the Prometheus info-metric idiom).
+    import platform
+
+    try:
+        abi = int(_native.get_lib().ist_abi_version())
+    except Exception:
+        abi = 0
+    lines.append(
+        "# HELP infinistore_build_info build/runtime identity "
+        "(constant 1; the facts ride the labels)"
+    )
+    lines.append("# TYPE infinistore_build_info gauge")
+    lines.append(
+        f'infinistore_build_info{{abi_version="{abi}",'
+        f'engine="{engine}",kernel="{platform.release()}",'
+        f'workers="{stats.get("workers", 0)}"}} 1'
+    )
     for key, name, help_ in g:
         lines.append(f"# HELP infinistore_{name} {help_}")
         lines.append(f"# TYPE infinistore_{name} gauge")
@@ -447,7 +685,8 @@ def _prometheus_metrics(stats):
     lines.append("# TYPE infinistore_watchdog_trips_total counter")
     for kind, key in (("stall", "stall_trips"),
                       ("slow_op", "slow_op_trips"),
-                      ("queue_growth", "queue_trips")):
+                      ("queue_growth", "queue_trips"),
+                      ("slo_burn", "slo_trips")):
         lines.append(
             f'infinistore_watchdog_trips_total{{kind="{kind}"}} '
             f'{wd.get(key, 0)}'
@@ -477,10 +716,58 @@ def _prometheus_metrics(stats):
         f'infinistore_events_last_age_us '
         f'{ev.get("last_event_age_us", -1)}'
     )
+    # Metrics-history ring meta (the ring itself is GET /history).
+    hist = stats.get("history", {})
+    lines.append(
+        "# HELP infinistore_history_samples_total metrics-history "
+        "ring samples recorded since start"
+    )
+    lines.append("# TYPE infinistore_history_samples_total counter")
+    lines.append(
+        f'infinistore_history_samples_total {hist.get("recorded", 0)}'
+    )
+    # SLO burn rates (multi-window, computed by the tracker over the
+    # history ring; GET /slo has the full blob).
+    if slo is not None:
+        try:
+            st = slo.status()
+        except Exception:
+            st = None
+        if st is not None:
+            lines.append(
+                "# HELP infinistore_slo_burn_rate error-budget burn "
+                "rate per objective and window (1.0 = sustainable)"
+            )
+            lines.append("# TYPE infinistore_slo_burn_rate gauge")
+            for window in ("short", "long"):
+                w = st.get(window, {})
+                for obj in ("latency", "availability"):
+                    lines.append(
+                        f'infinistore_slo_burn_rate{{slo="{obj}",'
+                        f'window="{window}"}} '
+                        f'{w.get(f"{obj}_burn_rate", 0)}'
+                    )
+            lines.append(
+                "# HELP infinistore_slo_burning both burn-rate "
+                "windows over threshold (the slo_burn verdict "
+                "condition)"
+            )
+            lines.append("# TYPE infinistore_slo_burning gauge")
+            lines.append(
+                f'infinistore_slo_burning '
+                f'{1 if st.get("burning") else 0}'
+            )
     return "\n".join(lines) + "\n"
 
 
-def make_control_plane(server: InfiniStoreServer, snapshot_path=None):
+def make_control_plane(server: InfiniStoreServer, snapshot_path=None,
+                       slo=None):
+    # GET /slo always answers: without an explicitly configured tracker
+    # (programmatic users, tests) a default-objective tracker computes
+    # on demand — only main() starts the verdict THREAD.
+    if slo is None:
+        slo = SLOTracker(server)
+
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code, payload):
             body = json.dumps(payload).encode()
@@ -506,7 +793,19 @@ def make_control_plane(server: InfiniStoreServer, snapshot_path=None):
             elif self.path == "/stats":
                 self._send(200, server.stats())
             elif self.path == "/metrics":
-                self._send_text(200, _prometheus_metrics(server.stats()))
+                self._send_text(
+                    200, _prometheus_metrics(server.stats(), slo=slo)
+                )
+            elif self.path == "/history":
+                # Metrics-history ring: ~1 Hz snapshots with counter/
+                # latency-histogram deltas, oldest first. Survives
+                # purge (ring never cleared); sparklines via
+                # tools/istpu_top.py.
+                self._send(200, server.history())
+            elif self.path == "/slo":
+                # Multi-window burn-rate status over the history ring
+                # (objectives, per-window burn rates, verdict state).
+                self._send(200, slo.status())
             elif self.path == "/trace":
                 # Chrome trace-event JSON, already serialized natively:
                 # save the body to a file and load it in Perfetto
@@ -739,6 +1038,31 @@ def parse_args(argv=None):
     p.add_argument("--bundle-keep", type=int, default=4,
                    help="diagnostic bundles retained in --bundle-dir "
                         "(oldest pruned first)")
+    p.add_argument("--no-slo", action="store_true",
+                   help="disable the SLO burn-rate tracker thread "
+                        "(GET /slo still computes on demand)")
+    p.add_argument("--slo-latency-ms", type=float, default=100.0,
+                   help="latency SLO threshold: ops slower than this "
+                        "count against the error budget")
+    p.add_argument("--slo-latency-objective", type=float, default=0.999,
+                   help="fraction of ops that must finish under "
+                        "--slo-latency-ms (error budget = 1 - this)")
+    p.add_argument("--slo-availability-objective", type=float,
+                   default=0.999,
+                   help="store-health objective: tier IO errors "
+                        "(foreground reads AND absorbed background "
+                        "spill/promote writes) per op must stay under "
+                        "1 - this")
+    p.add_argument("--slo-short-window-s", type=float, default=60,
+                   help="short burn-rate window (seconds); the verdict "
+                        "needs BOTH windows over --slo-burn-threshold")
+    p.add_argument("--slo-long-window-s", type=float, default=300,
+                   help="long burn-rate window (seconds)")
+    p.add_argument("--slo-burn-threshold", type=float, default=2.0,
+                   help="burn-rate multiple (1.0 = budget burns exactly "
+                        "at the sustainable rate) that, sustained in "
+                        "both windows, fires the slo_burn watchdog "
+                        "verdict (event + diagnostic bundle)")
     p.add_argument("--warmup", action="store_true",
                    help="run a warmup round-trip after startup")
     p.add_argument("--snapshot-path", default="",
@@ -825,7 +1149,19 @@ def main(argv=None):
              "--service-port", str(server.service_port)]
         )
 
-    httpd = make_control_plane(server, snapshot_path=args.snapshot_path)
+    slo = SLOTracker(
+        server,
+        latency_threshold_ms=args.slo_latency_ms,
+        latency_objective=args.slo_latency_objective,
+        availability_objective=args.slo_availability_objective,
+        short_window_s=args.slo_short_window_s,
+        long_window_s=args.slo_long_window_s,
+        burn_threshold=args.slo_burn_threshold,
+    )
+    if not args.no_slo:
+        slo.start()
+    httpd = make_control_plane(server, snapshot_path=args.snapshot_path,
+                               slo=slo)
     Logger.info(f"manage plane on :{config.manage_port}")
 
     stop = threading.Event()
@@ -840,6 +1176,7 @@ def main(argv=None):
         httpd.serve_forever()
     finally:
         httpd.server_close()
+        slo.stop()
         if args.snapshot_path:
             try:
                 n = server.snapshot(args.snapshot_path)

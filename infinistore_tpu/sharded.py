@@ -829,6 +829,67 @@ class ShardedConnection:
         )
         return sum(v for ok, v in results if ok)
 
+    def client_stats(self):
+        """Client-side telemetry aggregated across shards (ISSUE 11):
+        ``per_shard`` carries each connection's
+        :meth:`InfinityConnection.client_stats` verbatim, and the top
+        level merges them — counters summed, per-op histograms added
+        bucket-wise (same power-of-two geometry, so addition is exact)
+        with the percentiles recomputed over the merged buckets. Local
+        — never touches the wire, safe with shards down."""
+        from .lib import _hist_percentile_us
+
+        per = [c.client_stats() for c in self.conns]
+        ops = {}
+        counters = {}
+        for ps in per:
+            for op, s in ps.get("ops", {}).items():
+                m = ops.get(op)
+                if m is None:
+                    m = ops[op] = {
+                        "count": 0, "total_us": 0,
+                        "hist": [0] * len(s.get("hist", [])),
+                    }
+                m["count"] += s.get("count", 0)
+                m["total_us"] += s.get("total_us", 0)
+                h = s.get("hist", [])
+                if len(h) > len(m["hist"]):
+                    m["hist"] += [0] * (len(h) - len(m["hist"]))
+                for b, n in enumerate(h):
+                    m["hist"][b] += n
+            for k, v in ps.get("counters", {}).items():
+                counters[k] = counters.get(k, 0) + v
+        for s in ops.values():
+            s["p50_us"] = _hist_percentile_us(s["hist"], 0.50)
+            s["p99_us"] = _hist_percentile_us(s["hist"], 0.99)
+        return {
+            "enabled": any(ps.get("enabled") for ps in per),
+            "ops": ops,
+            "counters": counters,
+            "per_shard": per,
+        }
+
+    def client_trace_events(self):
+        """Client-side spans from every shard connection, one Chrome
+        thread track per shard (pid 0 = the client process), for
+        tools/istpu_trace.py's merged timeline."""
+        evts = []
+        for s, c in enumerate(self.conns):
+            for e in c.client_trace_events(pid=0,
+                                           label=f"client shard{s}"):
+                e = dict(e)
+                e["tid"] = s
+                evts.append(e)
+        return evts
+
+    def client_trace_json(self):
+        import json as _json
+
+        return _json.dumps({
+            "displayTimeUnit": "ms",
+            "traceEvents": self.client_trace_events(),
+        })
+
     def stats(self):
         """Per-shard native stats (down shards report {'shard_down':
         True}) plus a 'sharded_health' summary entry with the degrade

@@ -120,10 +120,15 @@ extern "C" {
 // int, `bundle_dir` string and `bundle_keep` u32 on
 // ist_server_create, new ist_server_events / ist_server_debug_state
 // entry points, stats gains the events/watchdog sections and
-// promote_heartbeat_age_us).
+// promote_heartbeat_age_us; v11: end-to-end observability — new
+// ist_server_history (metrics-history ring drain), ist_server_slo_trip
+// (control-plane SLO burn verdict: watchdog.slo_burn event + bundle)
+// and ist_conn_telemetry (client pin-cache hit/miss) entry points,
+// stats gains the history section and watchdog.slo_trips, the
+// spill/promote cancel events carry key hashes in a0).
 // _native.py probes this at load so a stale prebuilt library fails
 // loudly instead of feeding unparseable blobs to the server.
-uint32_t ist_abi_version(void) { return 10; }
+uint32_t ist_abi_version(void) { return 11; }
 
 void ist_set_log_level(int level) { set_log_level(level); }
 void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }
@@ -262,6 +267,30 @@ long long ist_server_debug_state(void* h, char* buf, long long cap) {
                      cap);
 }
 
+// Metrics-history ring (GET /history): the overwrite-oldest ~1 Hz
+// stats-snapshot ring, oldest first, with per-sample counter and
+// latency-histogram deltas. Same snprintf contract. purge() resets
+// gauges but never clears the ring.
+long long ist_server_history(void* h, char* buf, long long cap) {
+    if (h == nullptr) return -1;
+    return copy_blob(static_cast<Server*>(h)->history_json(), buf, cap);
+}
+
+// SLO burn-rate verdict (the Python SLO tracker's trigger): emits the
+// watchdog.slo_burn catalog event (a0/a1 = caller-supplied, by
+// convention burn-rate millis and window seconds), counts the trip and
+// captures a diagnostic bundle like the native verdict kinds. Returns
+// 1 when the verdict fired, 0 while the per-kind cooldown holds, -1 on
+// a null handle.
+int ist_server_slo_trip(void* h, const char* detail, uint64_t a0,
+                        uint64_t a1) {
+    if (h == nullptr) return -1;
+    return static_cast<Server*>(h)->slo_trip(
+               detail != nullptr ? detail : "", a0, a1)
+               ? 1
+               : 0;
+}
+
 // Fault injection (failpoint.h): arm/disarm named failpoints from a
 // spec string ("name=policy[:action];...", "off" clears everything —
 // grammar in failpoint.h). The registry is process-global; the server
@@ -349,6 +378,19 @@ uint32_t ist_conn_block_size(void* h) {
 uint64_t ist_conn_inflight(void* h) {
     if (h == nullptr) return 0;
     return static_cast<Connection*>(h)->inflight();
+}
+
+// Client-side native telemetry (client_stats()): pin-cache hit/miss
+// counts (one per cached-read CALL; lease-mode SHM reads only — both
+// stay 0 otherwise).
+void ist_conn_telemetry(void* h, uint64_t* pin_cache_hits,
+                        uint64_t* pin_cache_misses) {
+    uint64_t hits = 0, misses = 0;
+    if (h != nullptr) {
+        static_cast<Connection*>(h)->pin_cache_stats(&hits, &misses);
+    }
+    if (pin_cache_hits != nullptr) *pin_cache_hits = hits;
+    if (pin_cache_misses != nullptr) *pin_cache_misses = misses;
 }
 
 // Allocate: fills out[nkeys]; returns rpc status.

@@ -1256,6 +1256,18 @@ void Connection::cache_pins(const std::vector<std::string>& keys,
 bool Connection::cached_read(uint32_t block_size,
                              const std::vector<std::string>& keys,
                              const std::vector<void*>& dsts) {
+    // Telemetry wrapper: one hit/miss per read CALL (not per key) —
+    // the ratio is what client_stats() reports, and a partial batch
+    // miss falls back to the pinned rpc path for the whole call anyway.
+    bool ok = cached_read_impl(block_size, keys, dsts);
+    (ok ? pin_cache_hits_ : pin_cache_misses_)
+        .fetch_add(1, std::memory_order_relaxed);
+    return ok;
+}
+
+bool Connection::cached_read_impl(uint32_t block_size,
+                                  const std::vector<std::string>& keys,
+                                  const std::vector<void*>& dsts) {
     // A broken connection must MISS, not serve: the mappings outlive the
     // socket, and a dead server's orphaned pool pages would otherwise
     // keep validating against the frozen epoch word forever — hiding

@@ -201,6 +201,12 @@ class Connection {
     void cache_pins(const std::vector<std::string>& keys,
                     const RemoteBlock* blocks, size_t n, uint64_t epoch);
     bool lease_ready() const { return cfg_.use_lease && ctl_map_ != nullptr; }
+    // Client telemetry (ist_conn_telemetry → client_stats()): pin-cache
+    // hit/miss counts, one per cached_read CALL.
+    void pin_cache_stats(uint64_t* hits, uint64_t* misses) const {
+        *hits = pin_cache_hits_.load(std::memory_order_relaxed);
+        *misses = pin_cache_misses_.load(std::memory_order_relaxed);
+    }
 
     // Pool mapping access for the zero-copy Python path.
     size_t pool_count();
@@ -375,9 +381,14 @@ class Connection {
     std::atomic<uint32_t> lease_err_{0};
 
     // --- pin cache (cache_mu_) ---
+    bool cached_read_impl(uint32_t block_size,
+                          const std::vector<std::string>& keys,
+                          const std::vector<void*>& dsts);
     std::mutex cache_mu_;
     std::unordered_map<std::string, CachedLoc> pin_cache_;
     static constexpr size_t kPinCacheCap = 1u << 17;
+    std::atomic<uint64_t> pin_cache_hits_{0};
+    std::atomic<uint64_t> pin_cache_misses_{0};
 
     // Mapped server ctl page (read-only): the store epoch word.
     CtlPage* ctl_map_ = nullptr;

@@ -370,7 +370,9 @@ bool KVIndex::maybe_enqueue_promote(Stripe& st, Entry& e,
         return false;
     }
     e.promoting = true;
-    promoter_->enqueue(PromoteItem{key, e.disk, e.size, si});
+    promoter_->enqueue(PromoteItem{key, e.disk, e.size, si,
+                                   Tracer::thread_trace_id(),
+                                   uint64_t(std::hash<std::string>{}(key))});
     return true;
 }
 
@@ -1098,6 +1100,18 @@ void KVIndex::maybe_wake_reclaimer() {
 
 void KVIndex::kick_reclaimer() {
     if (!bg_running_.load(std::memory_order_relaxed)) return;
+    // Attribution BEFORE the flag: the reclaimer may consume the flag
+    // the instant it is set (its 200 ms poll races this call), and a
+    // store published after the exchange could be read as 0 by the
+    // pass it woke — then leak onto a later unrelated pass. Storing
+    // first means any kick pending at pass start has its id in place;
+    // among concurrent traced kicks the last writer wins, and all of
+    // them are true causes of the pass. Untraced kicks (id 0) never
+    // erase a pending traced attribution.
+    uint64_t kick_tid = Tracer::thread_trace_id();
+    if (kick_tid != 0) {
+        reclaim_kick_trace_.store(kick_tid, std::memory_order_relaxed);
+    }
     // Exchange dedupes the notify: under sustained pressure the put
     // path sets the flag once per reclaimer wake, not once per key.
     if (reclaim_kick_.exchange(true, std::memory_order_relaxed)) return;
@@ -1124,6 +1138,12 @@ void KVIndex::reclaim_loop() {
                    reclaim_kick_.load(std::memory_order_relaxed);
         });
         reclaim_kick_.store(false, std::memory_order_relaxed);
+        // Consume the kick's attribution TOGETHER with the kick flag:
+        // a traced kick whose pass is then skipped (usage already back
+        // under HIGH) must not leak its id onto a later unrelated
+        // pass. 0 on timer/pressure wakes with no pending traced kick.
+        uint64_t pass_tid = reclaim_kick_trace_.exchange(
+            0, std::memory_order_relaxed);
         if (bg_stop_.load(std::memory_order_relaxed)) break;
         reclaim_heartbeat_us_.store(now_us(), std::memory_order_relaxed);
         // Induced reclaimer death (chaos suite): allocation falls back
@@ -1157,6 +1177,11 @@ void KVIndex::reclaim_loop() {
             long long tpass = trace ? now_us() : 0;
             size_t pass_victims = 0;
             size_t floor_bytes = size_t(low_ * double(total));
+            // Thread-bind the kick's id (consumed at wake, above):
+            // spill items the pass enqueues (enqueue_spill reads the
+            // thread id) inherit it, so the whole kick → scan → spill
+            // chain carries one trace id.
+            Tracer::set_thread_trace_id(pass_tid);
             events_emit(EV_RECLAIM_PASS_BEGIN, mm_->used_bytes(), total);
             // Victim-age cap for the WHOLE pass: entries touched — or
             // promotion-adopted — after this snapshot are off-limits,
@@ -1178,22 +1203,23 @@ void KVIndex::reclaim_loop() {
                 long long tscan = trace ? now_us() : 0;
                 size_t victims = evict_internal(want, -1, true, pass_cap);
                 if (trace) {
-                    tracer_->record(
+                    tracer_->record_id(
                         SPAN_VICTIM_SCAN, 0, uint64_t(tscan),
-                        uint64_t(now_us() - tscan),
+                        uint64_t(now_us() - tscan), pass_tid,
                         uint16_t(victims > 0xFFFF ? 0xFFFF : victims));
                 }
                 pass_victims += victims;
                 if (victims == 0) break;
             }
             if (trace) {
-                tracer_->record(SPAN_RECLAIM_PASS, 0, uint64_t(tpass),
-                                uint64_t(now_us() - tpass),
-                                uint16_t(pass_victims > 0xFFFF
-                                             ? 0xFFFF
-                                             : pass_victims));
+                tracer_->record_id(SPAN_RECLAIM_PASS, 0, uint64_t(tpass),
+                                   uint64_t(now_us() - tpass), pass_tid,
+                                   uint16_t(pass_victims > 0xFFFF
+                                                ? 0xFFFF
+                                                : pass_victims));
             }
             size_t used_after = mm_->used_bytes();
+            Tracer::set_thread_trace_id(0);
             events_emit(EV_RECLAIM_PASS_END, pass_victims, used_after);
             if (used_after <= floor_bytes) {
                 events_emit(EV_WATERMARK_LOW, used_after, total);
@@ -1222,7 +1248,13 @@ void KVIndex::enqueue_spill(const std::string& key, const BlockRef& block,
                                     std::memory_order_relaxed);
     {
         ScopedLock lk(spill_mu_);
-        spill_q_.push_back(SpillItem{key, block, size, si});
+        // Attribution tags: the enqueuing thread's trace id (a
+        // foreground op on the inline path; the reclaim pass's kick id
+        // on the async path — the reclaimer thread-binds it for the
+        // pass) and the victim key's hash for the cancel event.
+        spill_q_.push_back(SpillItem{
+            key, block, size, si, Tracer::thread_trace_id(),
+            uint64_t(std::hash<std::string>{}(key))});
     }
     spill_cv_.notify_one();
     // Lost race with an induced writer death (the caller's liveness
@@ -1285,11 +1317,16 @@ void KVIndex::spill_loop() {
             const bool trace = spill_ring_ != nullptr;
             long long tb0 = trace ? now_us() : 0;
             size_t n = batch.size();
+            // Attribution: the batch span carries the first item's
+            // foreground trace id (a reclaim pass enqueues its whole
+            // batch under one id; mixed inline items still get the
+            // per-write spans below under their own ids).
+            uint64_t btid = n ? batch[0].trace_id : 0;
             process_spill_batch(batch);
             if (trace) {
-                tracer_->record(SPAN_SPILL_BATCH, 0, uint64_t(tb0),
-                                uint64_t(now_us() - tb0),
-                                uint16_t(n > 0xFFFF ? 0xFFFF : n));
+                tracer_->record_id(SPAN_SPILL_BATCH, 0, uint64_t(tb0),
+                                   uint64_t(now_us() - tb0), btid,
+                                   uint16_t(n > 0xFFFF ? 0xFFFF : n));
             }
         }
         batch.clear();
@@ -1353,8 +1390,9 @@ void KVIndex::process_spill_batch(std::vector<SpillItem>& batch) {
             }
         }
         if (trace) {
-            tracer_->record(SPAN_SPILL_WRITE, 0, uint64_t(tw0),
-                            uint64_t(now_us() - tw0), uint16_t(n));
+            tracer_->record_id(SPAN_SPILL_WRITE, 0, uint64_t(tw0),
+                               uint64_t(now_us() - tw0),
+                               first.trace_id, uint16_t(n));
         }
     }
     // Gather runs over the leftovers. store_gather's carve contract:
@@ -1389,8 +1427,9 @@ void KVIndex::process_spill_batch(std::vector<SpillItem>& batch) {
             }
         }
         if (trace) {
-            tracer_->record(SPAN_SPILL_WRITE, 0, uint64_t(tw0),
-                            uint64_t(now_us() - tw0), uint16_t(n));
+            tracer_->record_id(SPAN_SPILL_WRITE, 0, uint64_t(tw0),
+                               uint64_t(now_us() - tw0),
+                               batch[singles[i]].trace_id, uint16_t(n));
         }
         i = j + 1;
     }
@@ -1465,11 +1504,14 @@ void KVIndex::finish_spill(SpillItem& item, int64_t off) {
                 st.map.erase(mit);
                 evictions_.fetch_add(1, std::memory_order_relaxed);
                 spills_cancelled_.fetch_add(1, std::memory_order_relaxed);
-                events_emit(EV_SPILL_CANCEL, item.size, /*evicted=*/1);
+                // a0 = the victim key's hash (attribution: grep the
+                // same hash out of a client log / merged trace),
+                // a1 = evicted flag.
+                events_emit(EV_SPILL_CANCEL, item.key_hash, /*evicted=*/1);
             } else {
                 e.spilling = false;
                 spills_cancelled_.fetch_add(1, std::memory_order_relaxed);
-                events_emit(EV_SPILL_CANCEL, item.size, /*evicted=*/0);
+                events_emit(EV_SPILL_CANCEL, item.key_hash, /*evicted=*/0);
             }
         }
     }

@@ -570,6 +570,15 @@ class KVIndex {
         BlockRef block;  // pins the bytes for the out-of-lock IO
         uint32_t size = 0;
         uint32_t stripe = 0;
+        // Causal attribution (ISSUE 11): the trace id of the FOREGROUND
+        // op whose thread enqueued this item, and the key's hash —
+        // spill_batch/spill_write spans record under the id, and the
+        // spill.cancel catalog event carries the hash, so "this put's
+        // latency paid for spilling key H" reads straight off the
+        // merged timeline. Tag lifetime: enqueue → finish_spill; a
+        // re-queued victim gets the NEW trigger's id.
+        uint64_t trace_id = 0;
+        uint64_t key_hash = 0;
     };
     // Rebalance the queue-depth/inflight-bytes gauges for spill items
     // pulled off the queue without being written (clean stop, induced
@@ -670,6 +679,12 @@ class KVIndex {
     Mutex reclaim_mu_{kRankReclaim};
     CondVar reclaim_cv_;
     std::atomic<bool> reclaim_kick_{false};
+    // Trace id of the foreground op whose kick won the dedup exchange
+    // (0 = untraced/idle wake): the next reclaim pass records its
+    // reclaim_pass/victim_scan spans under it, so the pass is
+    // attributable to the put that crossed the watermark. Consumed
+    // (reset to 0) at pass start.
+    std::atomic<uint64_t> reclaim_kick_trace_{0};
     // Promotion pressure (see maybe_enqueue_promote): a refused
     // promotion admission asks the reclaimer for a to-LOW pass even
     // when occupancy never crossed HIGH.

@@ -81,6 +81,11 @@ enum LockRank : int {
     kRankWatchdog = 15,      // Server::wd_mu_ (sleep/wake only; never
                              // held across any other acquisition —
                              // the watchdog samples unlocked)
+    kRankBundle = 17,        // Server::bundle_mu_ (serializes bundle
+                             // capture across the watchdog thread and
+                             // the control-plane slo_trip; held across
+                             // the stats/trace/debug getters, which
+                             // take store_mu_ — hence < 20)
     kRankStoreLifetime = 20, // Server::store_mu_
     kRankWorkerPending = 30, // Server::Worker::pending_mu
     kRankWorkerConns = 40,   // Server::Worker::conns_mu (owner-thread
@@ -95,6 +100,9 @@ enum LockRank : int {
     kRankPoolArenaBase = 300,  // MemoryPool arena a -> base + a (a < 8)
     kRankDiskBitmap = 320,   // DiskTier::mu_
     kRankTraceTracks = 340,  // Tracer::tracks_mu_
+    kRankHistory = 350,      // Server::hist_mu_ (metrics-history ring;
+                             // leaf — the sampler gathers its inputs
+                             // BEFORE taking it, drains hold nothing)
 };
 
 #ifdef ISTPU_LOCK_RANK
@@ -108,6 +116,7 @@ inline const char* rank_name(int r) {
     switch (r) {
         case kRankSnapshot: return "server-snapshot";
         case kRankWatchdog: return "server-watchdog";
+        case kRankBundle: return "server-bundle";
         case kRankStoreLifetime: return "server-store-lifetime";
         case kRankWorkerPending: return "worker-pending";
         case kRankWorkerConns: return "worker-conns";
@@ -118,6 +127,7 @@ inline const char* rank_name(int r) {
         case kRankPoolExtend: return "pool-extend";
         case kRankDiskBitmap: return "disk-bitmap";
         case kRankTraceTracks: return "trace-tracks";
+        case kRankHistory: return "server-history";
         default: return "?";
     }
 }

@@ -132,7 +132,8 @@ void Promoter::drop_item(PromoteItem& item, bool clear_flag) {
     const size_t bs = mm_->block_size();
     if (clear_flag) index_->cancel_promote_flag(item);
     cancelled_.fetch_add(1, std::memory_order_relaxed);
-    events_emit(EV_PROMOTE_CANCEL, item.size, /*raced=*/0);
+    // a0 = key hash (attribution), a1 = raced flag (0 = dropped).
+    events_emit(EV_PROMOTE_CANCEL, item.key_hash, /*raced=*/0);
     inflight_bytes_.fetch_sub(
         (uint64_t(item.size) + bs - 1) / bs * bs, std::memory_order_relaxed);
     queue_depth_.fetch_sub(1, std::memory_order_relaxed);
@@ -201,11 +202,14 @@ void Promoter::loop() {
             const bool trace = ring_ != nullptr;
             long long tb0 = trace ? now_us() : 0;
             size_t n = batch.size();
+            // Attribution: the first item's foreground trace id labels
+            // the batch; per-read spans below carry their own.
+            uint64_t btid = n ? batch[0].trace_id : 0;
             process_batch(batch);
             if (trace) {
-                tracer_->record(SPAN_PROMOTE_BATCH, 0, uint64_t(tb0),
-                                uint64_t(now_us() - tb0),
-                                uint16_t(n > 0xFFFF ? 0xFFFF : n));
+                tracer_->record_id(SPAN_PROMOTE_BATCH, 0, uint64_t(tb0),
+                                   uint64_t(now_us() - tb0), btid,
+                                   uint16_t(n > 0xFFFF ? 0xFFFF : n));
             }
         }
         batch.clear();
@@ -262,8 +266,10 @@ void Promoter::process_batch(std::vector<PromoteItem>& batch) {
             span = disk_->load_batch(offs.data(), sizes.data(), n,
                                      scratch.data());
             if (trace) {
-                tracer_->record(SPAN_PROMOTE_READ, 0, uint64_t(tr0),
-                                uint64_t(now_us() - tr0), uint16_t(n));
+                tracer_->record_id(SPAN_PROMOTE_READ, 0, uint64_t(tr0),
+                                   uint64_t(now_us() - tr0),
+                                   batch[spans[gi].idx].trace_id,
+                                   uint16_t(n));
             }
         }
         for (uint32_t k = 0; k < n; ++k) {
@@ -298,8 +304,9 @@ void Promoter::promote_one(PromoteItem& item, const uint8_t* src) {
             long long tr0 = trace ? now_us() : 0;
             ok = disk_->load(item.disk->off, loc.ptr, item.size);
             if (trace) {
-                tracer_->record(SPAN_PROMOTE_READ, 0, uint64_t(tr0),
-                                uint64_t(now_us() - tr0), 1);
+                tracer_->record_id(SPAN_PROMOTE_READ, 0, uint64_t(tr0),
+                                   uint64_t(now_us() - tr0),
+                                   item.trace_id, 1);
             }
         }
         if (!ok) block.reset();  // IO error: blocks freed by RAII
@@ -309,7 +316,7 @@ void Promoter::promote_one(PromoteItem& item, const uint8_t* src) {
         async_.fetch_add(1, std::memory_order_relaxed);
     } else {
         cancelled_.fetch_add(1, std::memory_order_relaxed);
-        events_emit(EV_PROMOTE_CANCEL, item.size, /*raced=*/1);
+        events_emit(EV_PROMOTE_CANCEL, item.key_hash, /*raced=*/1);
     }
     inflight_bytes_.fetch_sub(
         (uint64_t(item.size) + bs - 1) / bs * bs, std::memory_order_relaxed);

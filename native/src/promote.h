@@ -117,6 +117,13 @@ struct PromoteItem {
     DiskRef disk;       // pins the extent for the out-of-lock pread
     uint32_t size = 0;
     uint32_t stripe = 0;
+    // Causal attribution (ISSUE 11): the trace id of the foreground op
+    // (a second-touch get, OP_PREFETCH, OP_PIN) whose thread queued the
+    // promotion, and the key's hash. promote_batch/promote_read spans
+    // record under the id; the promote.cancel event carries the hash.
+    // Tag lifetime: enqueue → finish_promote/drop (re-queues re-stamp).
+    uint64_t trace_id = 0;
+    uint64_t key_hash = 0;
 };
 
 class Promoter {

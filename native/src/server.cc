@@ -493,9 +493,14 @@ bool Server::start() {
         1000;
     if (!bundle_dir_.empty()) {
         mkdir(bundle_dir_.c_str(), 0755);  // EEXIST is fine
-        for (const std::string& b : list_bundles(bundle_dir_)) {
-            uint64_t q = bundle_name_seq(b.c_str());
-            if (q > wd_bundle_seq_) wd_bundle_seq_ = q;
+        {
+            // Pre-thread, but the seq is bundle_mu_-guarded now that
+            // slo_trip can capture from the control plane.
+            ScopedLock blk(bundle_mu_);
+            for (const std::string& b : list_bundles(bundle_dir_)) {
+                uint64_t q = bundle_name_seq(b.c_str());
+                if (q > wd_bundle_seq_) wd_bundle_seq_ = q;
+            }
         }
         std::string crash = bundle_dir_ + "/crash_events.bin";
         int fd = open(crash.c_str(),
@@ -511,7 +516,29 @@ bool Server::start() {
     wd_stop_.store(false, std::memory_order_relaxed);
     wd_prev_ = WdPrev{};
     wd_queue_streak_ = 0;
-    if (wd_enabled_) {
+    slo_last_trip_us_.store(0, std::memory_order_relaxed);
+    // Metrics-history ring: on by default; ISTPU_HISTORY=0 (re-read
+    // per start, like ISTPU_EVENTS) exists ONLY as the bench --obs-leg
+    // overhead denominator. The sampler rides the watchdog thread, so
+    // that thread now runs whenever history OR verdicts are wanted.
+    hist_enabled_ = true;
+    if (const char* env = getenv("ISTPU_HISTORY")) {
+        if (env[0] != '\0') hist_enabled_ = env[0] == '1';
+    }
+    {
+        ScopedLock hlk(hist_mu_);
+        hist_ring_.clear();
+        hist_ring_.reserve(kHistCap);
+        hist_recorded_ = 0;
+    }
+    hist_prev_ = HistPrev{};
+    if (hist_enabled_) {
+        // Baseline sample at t=start (all counters zero): the first
+        // TIMED sample then carries real deltas for the startup
+        // window instead of silently swallowing it into the baseline.
+        history_sample();
+    }
+    if (wd_enabled_ || hist_enabled_) {
         wd_thread_ = std::thread([this] { watchdog_loop(); });
     }
     events_emit(EV_ENGINE_SELECTED,
@@ -947,27 +974,38 @@ std::string Server::stats_json() {
         // age the black box without draining it.
         long long last = events_last_us();
         static const char* kKindNames[] = {"stall", "slow_op",
-                                           "queue_growth"};
+                                           "queue_growth", "slo_burn"};
         int lk = wd_last_kind_.load(std::memory_order_relaxed);
         long long lt = wd_last_trip_us_.load(std::memory_order_relaxed);
         uint64_t trips = 0;
-        for (int i = 0; i < 3; ++i) {
+        for (int i = 0; i < kWdKinds; ++i) {
             trips += wd_trips_[i].load(std::memory_order_relaxed);
         }
-        char entry[512];
+        uint64_t hist_rec = 0;
+        {
+            ScopedLock hlk(hist_mu_);
+            hist_rec = hist_recorded_;
+        }
+        char entry[768];
         snprintf(
             entry, sizeof(entry),
             ", \"events\": {\"recorded\": %llu, \"overwritten\": %llu, "
             "\"enabled\": %d, \"last_event_age_us\": %lld}"
+            ", \"history\": {\"enabled\": %d, \"recorded\": %llu, "
+            "\"capacity\": %zu, \"interval_ms\": %llu}"
             ", \"watchdog\": {\"enabled\": %d, \"stalled\": %d, "
             "\"trips\": %llu, \"stall_trips\": %llu, "
             "\"slow_op_trips\": %llu, \"queue_trips\": %llu, "
+            "\"slo_trips\": %llu, "
             "\"bundles\": %llu, \"last_trigger\": \"%s\", "
             "\"last_trip_age_us\": %lld}",
             (unsigned long long)events_recorded_total(),
             (unsigned long long)events_overwritten_total(),
             events_enabled() ? 1 : 0,
-            last > 0 ? now_us() - last : -1, wd_enabled_ ? 1 : 0,
+            last > 0 ? now_us() - last : -1, hist_enabled_ ? 1 : 0,
+            (unsigned long long)hist_rec, kHistCap,
+            (unsigned long long)(wd_interval_us_ / 1000),
+            wd_enabled_ ? 1 : 0,
             wd_stalled_.load(std::memory_order_relaxed) ? 1 : 0,
             (unsigned long long)trips,
             (unsigned long long)wd_trips_[kWdStall].load(
@@ -976,9 +1014,11 @@ std::string Server::stats_json() {
                 std::memory_order_relaxed),
             (unsigned long long)wd_trips_[kWdQueue].load(
                 std::memory_order_relaxed),
+            (unsigned long long)wd_trips_[kWdSlo].load(
+                std::memory_order_relaxed),
             (unsigned long long)wd_bundles_.load(
                 std::memory_order_relaxed),
-            (lk >= 0 && lk < 3) ? kKindNames[lk] : "",
+            (lk >= 0 && lk < kWdKinds) ? kKindNames[lk] : "",
             lt > 0 ? now_us() - lt : -1);
         out += entry;
     }
@@ -2361,10 +2401,198 @@ void Server::watchdog_loop() {
         if (wd_stop_.load(std::memory_order_relaxed)) break;
         // Sample OUTSIDE wd_mu_ (rank 15): the getters below take
         // store_mu_ (rank 20) and the per-structure locks themselves.
+        // History first, so a verdict's bundle capture already sees
+        // the tick's sample in history.json.
         lk.unlock();
-        watchdog_sample();
+        if (hist_enabled_) history_sample();
+        if (wd_enabled_) watchdog_sample();
         lk.lock();
     }
+}
+
+void Server::history_sample() {
+    HistSample s;
+    s.t_us = now_us();
+    {
+        ScopedLock lk(store_mu_);  // pins index_/mm_/workers_ vs stop()
+        s.used_bytes = mm_ ? mm_->used_bytes() : 0;
+        s.pool_bytes = mm_ ? mm_->total_bytes() : 0;
+        s.kvmap = index_ ? index_->size() : 0;
+        s.conns = n_conns_.load(std::memory_order_relaxed);
+        if (index_ != nullptr) {
+            s.spill_q = index_->spill_queue_depth();
+            s.promote_q = index_->promote_queue_depth();
+            s.workers_dead = uint32_t(index_->workers_dead());
+        }
+        s.breaker = disk_ && disk_->breaker_open() ? 1 : 0;
+        uint64_t sqes = 0;
+        for (const auto& w : workers_) {
+            sqes += w->eng_sqes.load(std::memory_order_relaxed);
+        }
+        // Cumulative counters → deltas against the sampler's memory.
+        uint64_t ops = ops_.load(std::memory_order_relaxed);
+        uint64_t bin = bytes_in_.load(std::memory_order_relaxed);
+        uint64_t bout = bytes_out_.load(std::memory_order_relaxed);
+        uint64_t busy = reads_busy_.load(std::memory_order_relaxed);
+        uint64_t ioerr = disk_ ? disk_->io_errors() : 0;
+        uint64_t hs = index_ ? index_->hard_stalls() : 0;
+        uint64_t ev = index_ ? index_->evictions() : 0;
+        uint64_t sp = index_ ? index_->spills() : 0;
+        uint64_t pr = index_ ? (index_->promotes() +
+                                index_->promotes_async()) : 0;
+        uint64_t lat[LatHist::kBuckets] = {};
+        uint64_t opc[kMaxOp] = {};
+        for (int op = 1; op < kMaxOp; ++op) {
+            opc[op] = op_lat_[op].count();
+            for (int b = 0; b < kNumBuckets; ++b) {
+                lat[b] += op_lat_[op].bucket(b);
+            }
+        }
+        if (hist_prev_.valid) {
+            s.ops_delta = ops - hist_prev_.ops;
+            s.bytes_in_delta = bin - hist_prev_.bytes_in;
+            s.bytes_out_delta = bout - hist_prev_.bytes_out;
+            s.reads_busy_delta = busy - hist_prev_.reads_busy;
+            s.disk_io_errors_delta = ioerr - hist_prev_.disk_io_errors;
+            s.hard_stalls_delta = hs - hist_prev_.hard_stalls;
+            s.evictions_delta = ev - hist_prev_.evictions;
+            s.spills_delta = sp - hist_prev_.spills;
+            s.promotes_delta = pr - hist_prev_.promotes;
+            s.uring_sqes_delta = sqes - hist_prev_.uring_sqes;
+            for (int b = 0; b < kNumBuckets; ++b) {
+                s.lat_delta[b] = lat[b] - hist_prev_.lat[b];
+            }
+            for (int op = 0; op < kMaxOp; ++op) {
+                s.op_count_delta[op] = opc[op] - hist_prev_.op_count[op];
+            }
+        }
+        hist_prev_.ops = ops;
+        hist_prev_.bytes_in = bin;
+        hist_prev_.bytes_out = bout;
+        hist_prev_.reads_busy = busy;
+        hist_prev_.disk_io_errors = ioerr;
+        hist_prev_.hard_stalls = hs;
+        hist_prev_.evictions = ev;
+        hist_prev_.spills = sp;
+        hist_prev_.promotes = pr;
+        hist_prev_.uring_sqes = sqes;
+        memcpy(hist_prev_.lat, lat, sizeof(lat));
+        memcpy(hist_prev_.op_count, opc, sizeof(opc));
+        hist_prev_.valid = true;
+    }
+    s.stalled = wd_stalled_.load(std::memory_order_relaxed) ? 1 : 0;
+    ScopedLock lk(hist_mu_);
+    if (hist_ring_.size() < kHistCap) {
+        hist_ring_.push_back(s);
+    } else {
+        hist_ring_[size_t(hist_recorded_ % kHistCap)] = s;
+    }
+    hist_recorded_++;
+}
+
+std::string Server::history_json() {
+    // Oldest-first drain of the overwrite-oldest ring, one JSON object
+    // per sample. Latency buckets serialize in full (burn-rate math
+    // needs the distribution); per-op count deltas only for ops that
+    // actually moved, to keep 512-sample blobs small.
+    std::string out;
+    // Sized for the worst case: the per-sample format literal is
+    // ~520 bytes and its 17 integer fields are u64s (<= 20 digits
+    // each), so a sample can legitimately exceed 512 bytes on a
+    // long-uptime host with a TB-scale pool — a truncated object
+    // would corrupt the whole JSON blob. The append below also uses
+    // snprintf's return value, never strlen of a clipped buffer.
+    char buf[1536];
+    int m = snprintf(buf, sizeof(buf),
+                     "{\"enabled\": %d, \"capacity\": %zu, "
+                     "\"interval_ms\": %llu, \"now_us\": %lld, "
+                     "\"buckets\": %d, \"history\": [",
+                     hist_enabled_ ? 1 : 0, kHistCap,
+                     (unsigned long long)(wd_interval_us_ / 1000),
+                     now_us(), LatHist::kBuckets);
+    out.append(buf, size_t(m));
+    ScopedLock lk(hist_mu_);
+    size_t n = hist_ring_.size();
+    size_t start = hist_recorded_ > kHistCap
+                       ? size_t(hist_recorded_ % kHistCap)
+                       : 0;
+    for (size_t i = 0; i < n; ++i) {
+        const HistSample& s = hist_ring_[(start + i) % n];
+        m = snprintf(
+            buf, sizeof(buf),
+            "%s{\"t_us\": %lld, \"used_bytes\": %llu, "
+            "\"pool_bytes\": %llu, \"kvmap_len\": %llu, "
+            "\"connections\": %llu, \"spill_queue_depth\": %llu, "
+            "\"promote_queue_depth\": %llu, \"ops_delta\": %llu, "
+            "\"bytes_in_delta\": %llu, \"bytes_out_delta\": %llu, "
+            "\"reads_busy_delta\": %llu, "
+            "\"disk_io_errors_delta\": %llu, "
+            "\"hard_stalls_delta\": %llu, \"evictions_delta\": %llu, "
+            "\"spills_delta\": %llu, \"promotes_delta\": %llu, "
+            "\"uring_sqes_delta\": %llu, \"workers_dead\": %u, "
+            "\"tier_breaker_open\": %u, \"stalled\": %u, "
+            "\"lat_delta\": [",
+            i ? ", " : "", s.t_us, (unsigned long long)s.used_bytes,
+            (unsigned long long)s.pool_bytes,
+            (unsigned long long)s.kvmap, (unsigned long long)s.conns,
+            (unsigned long long)s.spill_q,
+            (unsigned long long)s.promote_q,
+            (unsigned long long)s.ops_delta,
+            (unsigned long long)s.bytes_in_delta,
+            (unsigned long long)s.bytes_out_delta,
+            (unsigned long long)s.reads_busy_delta,
+            (unsigned long long)s.disk_io_errors_delta,
+            (unsigned long long)s.hard_stalls_delta,
+            (unsigned long long)s.evictions_delta,
+            (unsigned long long)s.spills_delta,
+            (unsigned long long)s.promotes_delta,
+            (unsigned long long)s.uring_sqes_delta, s.workers_dead,
+            unsigned(s.breaker), unsigned(s.stalled));
+        out.append(buf, size_t(m));
+        for (int b = 0; b < LatHist::kBuckets; ++b) {
+            m = snprintf(buf, sizeof(buf), "%s%llu", b ? ", " : "",
+                         (unsigned long long)s.lat_delta[b]);
+            out.append(buf, size_t(m));
+        }
+        out += "], \"op_deltas\": {";
+        bool first = true;
+        for (int op = 1; op < kMaxOp; ++op) {
+            if (s.op_count_delta[op] == 0) continue;
+            m = snprintf(buf, sizeof(buf), "%s\"%s\": %llu",
+                         first ? "" : ", ", op_name(uint8_t(op)),
+                         (unsigned long long)s.op_count_delta[op]);
+            out.append(buf, size_t(m));
+            first = false;
+        }
+        out += "}}";
+    }
+    m = snprintf(buf, sizeof(buf), "], \"recorded\": %llu}",
+                 (unsigned long long)hist_recorded_);
+    out.append(buf, size_t(m));
+    return out;
+}
+
+bool Server::slo_trip(const std::string& detail, uint64_t a0,
+                      uint64_t a1) {
+    // Control-plane entry (the Python SLO tracker's burn-rate verdict).
+    // Cooldown via CAS on an atomic stamp — kWdSlo never rides the
+    // watchdog thread's plain cooldown array.
+    long long now = now_us();
+    long long prev = slo_last_trip_us_.load(std::memory_order_relaxed);
+    if (prev != 0 && now - prev < (long long)wd_cooldown_us_) {
+        return false;
+    }
+    if (!slo_last_trip_us_.compare_exchange_strong(
+            prev, now, std::memory_order_relaxed)) {
+        return false;  // a concurrent tracker call won the trip
+    }
+    events_emit(EV_SLO_BURN, a0, a1);
+    wd_trips_[kWdSlo].fetch_add(1, std::memory_order_relaxed);
+    wd_last_kind_.store(int(kWdSlo), std::memory_order_relaxed);
+    wd_last_trip_us_.store(now, std::memory_order_relaxed);
+    IST_WARN("watchdog slo_burn: %s", detail.c_str());
+    if (!bundle_dir_.empty()) capture_bundle("slo_burn", detail);
+    return true;
 }
 
 void Server::watchdog_sample() {
@@ -2539,6 +2767,11 @@ void Server::watchdog_sample() {
 }
 
 void Server::capture_bundle(const char* kind, const std::string& detail) {
+    // bundle_mu_ (rank 17, below the store getters' store_mu_):
+    // the watchdog thread and a control-plane slo_trip may capture
+    // concurrently, and wd_bundle_seq_/keep-last-K pruning need one
+    // writer at a time.
+    ScopedLock blk(bundle_mu_);
     char name[96];
     snprintf(name, sizeof(name), "bundle-%08llu-%s",
              (unsigned long long)(++wd_bundle_seq_), kind);
@@ -2553,13 +2786,16 @@ void Server::capture_bundle(const char* kind, const std::string& detail) {
     ok &= write_text_file(dir + "/events.json", events_json());
     ok &= write_text_file(dir + "/trace.json", trace_json());
     ok &= write_text_file(dir + "/debug_state.json", debug_state_json());
+    // The metrics-history ring: the bundle now shows the minutes of
+    // LEAD-UP to the trigger, not just the captured instant.
+    ok &= write_text_file(dir + "/history.json", history_json());
     char manifest[512];
     snprintf(manifest, sizeof(manifest),
              "{\"trigger\": \"%s\", \"detail\": \"%s\", "
              "\"captured_at_us\": %lld, \"capture_us\": %lld, "
              "\"seq\": %llu, \"files\": [\"stats.json\", "
              "\"events.json\", \"trace.json\", "
-             "\"debug_state.json\"]}",
+             "\"debug_state.json\", \"history.json\"]}",
              kind, json_escape(detail).c_str(), t0, now_us() - t0,
              (unsigned long long)wd_bundle_seq_);
     ok &= write_text_file(dir + "/manifest.json", manifest);

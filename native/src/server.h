@@ -374,6 +374,29 @@ class Server {
     // where exactness would stall the data plane.
     std::string debug_state_json();
 
+    // Metrics-history ring (GET /history; docs/design.md "Client
+    // telemetry, history & SLO"): a fixed overwrite-oldest ring of
+    // ~1 Hz stats snapshots — occupancy, queue depths, counter and
+    // latency-histogram DELTAS, breaker/degraded flags — sampled on
+    // the watchdog thread every watchdog_interval_ms. Every watchdog
+    // bundle includes it as history.json, so a bundle shows the
+    // minutes of lead-up to an anomaly, not just the instant; the SLO
+    // tracker (server.py) computes burn rates over the same samples.
+    // ISTPU_HISTORY=0 (re-read per start) disables recording — the
+    // bench --obs-leg denominator only. purge() never clears the ring.
+    std::string history_json();
+
+    // SLO burn-rate verdict hook (the control plane's SLO tracker
+    // calls this when the multi-window burn rate crosses its
+    // threshold): emits the watchdog.slo_burn catalog event, counts a
+    // kWdSlo trip and — with a bundle dir configured — captures a
+    // diagnostic bundle exactly like the native verdict kinds. The
+    // per-kind cooldown applies; returns false when still cooling.
+    // a0/a1 ride the event's argument words (the tracker passes the
+    // short-window burn rate in millis and the window seconds).
+    bool slo_trip(const std::string& detail, uint64_t a0 = 0,
+                  uint64_t a1 = 0);
+
     // Snapshot every committed entry to `path` (atomic tmp+rename) /
     // load a snapshot back (existing keys win; stops at pool-full).
     // Returns entries written/loaded, -1 on IO/format error. Beyond
@@ -538,8 +561,12 @@ class Server {
     // One sampling pass: returns after emitting verdict events and
     // (bundle_dir set, cooldown passed) capturing bundles.
     void watchdog_sample();
-    // Write stats/events/trace/debug-state/manifest into a fresh
-    // keep-last-K bundle directory. `kind` is the trigger name.
+    // Append one metrics-history sample (watchdog thread, ~1 Hz).
+    void history_sample();
+    // Write stats/events/trace/debug-state/history/manifest into a
+    // fresh keep-last-K bundle directory. `kind` is the trigger name.
+    // Serialized by bundle_mu_ (the watchdog thread and a control-
+    // plane slo_trip may both capture).
     void capture_bundle(const char* kind, const std::string& detail);
     long long start_us_ = 0;      // server start stamp (uptime)
     std::thread wd_thread_;
@@ -556,12 +583,17 @@ class Server {
     uint64_t wd_cooldown_us_ = 10000000;
     int crash_fd_ = -1;
     // Verdict state the control plane reads (stats_json, /health).
-    enum WdKind { kWdStall = 0, kWdSlowOp = 1, kWdQueue = 2 };
-    std::atomic<uint64_t> wd_trips_[3] = {};
+    // kWdSlo is tripped from the CONTROL PLANE (slo_trip) — the SLO
+    // tracker computes burn rates in Python over the history ring and
+    // calls down; the other three come from the native sampler.
+    enum WdKind { kWdStall = 0, kWdSlowOp = 1, kWdQueue = 2, kWdSlo = 3 };
+    static constexpr int kWdKinds = 4;
+    std::atomic<uint64_t> wd_trips_[kWdKinds] = {};
     std::atomic<int> wd_last_kind_{-1};
     std::atomic<long long> wd_last_trip_us_{0};
     std::atomic<bool> wd_stalled_{false};  // CURRENT stall verdict
     std::atomic<uint64_t> wd_bundles_{0};
+    Mutex bundle_mu_{kRankBundle};  // serializes capture_bundle callers
     // Watchdog-thread-only sampling memory.
     struct WdPrev {
         uint64_t op_buckets[LatHist::kBuckets] = {};
@@ -572,8 +604,49 @@ class Server {
         bool valid = false;
     } wd_prev_;
     int wd_queue_streak_ = 0;
-    uint64_t wd_bundle_seq_ = 0;
+    uint64_t wd_bundle_seq_ GUARDED_BY(bundle_mu_) = 0;
+    // Per-kind cooldown stamps. Kinds 0-2 are watchdog-thread-only;
+    // kWdSlo is atomic-CAS'd by slo_trip (control-plane callers).
     long long wd_last_per_kind_[3] = {};
+    std::atomic<long long> slo_last_trip_us_{0};
+
+    // --- metrics-history ring (GET /history). Sampled on the watchdog
+    // thread (which now runs whenever history OR verdicts are enabled);
+    // hist_mu_ is a leaf (kRankHistory) — the sampler gathers its
+    // inputs from the lock-free counters FIRST, then appends.
+    struct HistSample {
+        long long t_us = 0;          // CLOCK_MONOTONIC at capture
+        uint64_t used_bytes = 0, pool_bytes = 0;
+        uint64_t kvmap = 0, conns = 0;
+        uint64_t spill_q = 0, promote_q = 0;
+        uint64_t ops_delta = 0, bytes_in_delta = 0, bytes_out_delta = 0;
+        uint64_t reads_busy_delta = 0, disk_io_errors_delta = 0;
+        uint64_t hard_stalls_delta = 0, evictions_delta = 0;
+        uint64_t spills_delta = 0, promotes_delta = 0;
+        uint64_t uring_sqes_delta = 0;
+        uint32_t workers_dead = 0;
+        uint8_t breaker = 0, stalled = 0;
+        // Aggregate per-op latency-histogram delta (all ops summed;
+        // the power-of-two LatHist geometry) — what burn-rate math
+        // needs — plus the per-op count deltas for attribution.
+        uint64_t lat_delta[LatHist::kBuckets] = {};
+        uint64_t op_count_delta[kMaxOp] = {};
+    };
+    static constexpr size_t kHistCap = 512;  // ~8.5 min at 1 Hz
+    bool hist_enabled_ = true;               // ISTPU_HISTORY=0 disables
+    mutable Mutex hist_mu_{kRankHistory};
+    std::vector<HistSample> hist_ring_ GUARDED_BY(hist_mu_);
+    uint64_t hist_recorded_ GUARDED_BY(hist_mu_) = 0;
+    // Sampler-thread-only previous-cumulative memory for the deltas.
+    struct HistPrev {
+        uint64_t ops = 0, bytes_in = 0, bytes_out = 0;
+        uint64_t reads_busy = 0, disk_io_errors = 0, hard_stalls = 0;
+        uint64_t evictions = 0, spills = 0, promotes = 0;
+        uint64_t uring_sqes = 0;
+        uint64_t lat[LatHist::kBuckets] = {};
+        uint64_t op_count[kMaxOp] = {};
+        bool valid = false;
+    } hist_prev_;
 };
 
 }  // namespace istpu

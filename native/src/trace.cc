@@ -94,13 +94,18 @@ uint64_t Tracer::thread_trace_id() { return tls_trace_id; }
 
 void Tracer::record(SpanKind kind, uint8_t op, uint64_t t0_us,
                     uint64_t dur_us, uint16_t arg) {
+    record_id(kind, op, t0_us, dur_us, tls_trace_id, arg);
+}
+
+void Tracer::record_id(SpanKind kind, uint8_t op, uint64_t t0_us,
+                       uint64_t dur_us, uint64_t trace_id, uint16_t arg) {
     if (!enabled_) return;
     TraceRing* r = tls_ring;
     if (r == nullptr) {
         dropped_.fetch_add(1, std::memory_order_relaxed);
         return;
     }
-    r->record(kind, op, t0_us, dur_us, tls_trace_id, arg);
+    r->record(kind, op, t0_us, dur_us, trace_id, arg);
 }
 
 void Tracer::lock_wait(uint64_t t0_us, uint64_t us) {

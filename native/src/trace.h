@@ -193,6 +193,15 @@ class Tracer {
     void record(SpanKind kind, uint8_t op, uint64_t t0_us, uint64_t dur_us,
                 uint16_t arg = 0);
 
+    // Same, but with an EXPLICIT trace id instead of the thread-local
+    // one: the background workers (reclaim/spill/promote) record their
+    // spans with the id their queue item carried from the FOREGROUND op
+    // that triggered it, so "this put was slow because reclaim pass N
+    // evicted for it" falls out of the timeline instead of requiring
+    // overlap guesswork (causal attribution, ISSUE 11).
+    void record_id(SpanKind kind, uint8_t op, uint64_t t0_us,
+                   uint64_t dur_us, uint64_t trace_id, uint16_t arg = 0);
+
     // Always-on wait accounting. `span` additionally records a span
     // when tracing is on and the wait is non-zero.
     void lock_wait(uint64_t t0_us, uint64_t us);

@@ -1,0 +1,618 @@
+"""End-to-end observability (ISSUE 11).
+
+Covers the four planes and their seams:
+  - client telemetry: per-op histograms + machinery counters on
+    InfinityConnection, aggregation on ShardedConnection, the
+    ISTPU_CLIENT_STATS=0 bench kill switch, ISTPU_LOG_JSON trace-id
+    log correlation;
+  - causal background attribution: promote spans carry the foreground
+    op's trace id;
+  - metrics-history ring: GET /history populates, survives purge
+    (gauges reset, ring NOT cleared), lands in every watchdog bundle
+    as history.json, renders as istpu_top sparklines offline;
+  - SLO tracker: burn-rate math over a synthetic ring, the /slo + /metrics
+    surfaces, and the acceptance path — a failpoint-injected latency
+    storm (disk.pread delay) driving burn rate over threshold into a
+    slo_burn verdict whose bundle contains the lead-up;
+  - istpu_trace: one merged timeline where a single trace id spans
+    client spans and both shards' server spans.
+
+All servers ride ephemeral ports and tmp dirs; watchdog/history
+cadence is tightened via ISTPU_WATCHDOG_INTERVAL_MS.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from infinistore_tpu import InfiniStoreServer, ServerConfig
+from infinistore_tpu.config import ClientConfig
+from infinistore_tpu.lib import InfinityConnection
+from infinistore_tpu.server import SLOTracker
+from infinistore_tpu.sharded import ShardedConnection
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ISTPU_TOP = os.path.join(REPO, "tools", "istpu_top.py")
+ISTPU_TRACE = os.path.join(REPO, "tools", "istpu_trace.py")
+
+
+def _load_tool(path, name):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _connect(port, **kw):
+    conn = InfinityConnection(
+        ClientConfig(host_addr="127.0.0.1", service_port=port,
+                     connection_type="STREAM", **kw)
+    )
+    conn.connect()
+    return conn
+
+
+def _wait_for(pred, timeout=8.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+@pytest.fixture()
+def fast_sampler(monkeypatch):
+    monkeypatch.setenv("ISTPU_WATCHDOG_INTERVAL_MS", "50")
+    monkeypatch.setenv("ISTPU_WATCHDOG_COOLDOWN_MS", "200")
+
+
+def _small_server(**kw):
+    srv = InfiniStoreServer(
+        ServerConfig(service_port=0, prealloc_size=0.01,
+                     minimal_allocate_size=4, **kw)
+    )
+    srv.start()
+    return srv
+
+
+# ---------------------------------------------------------------------------
+# client telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_client_stats_records_ops_and_histograms():
+    srv = _small_server()
+    try:
+        conn = _connect(srv.service_port)
+        try:
+            src = np.arange(4096, dtype=np.uint8)
+            for i in range(8):
+                conn.put_cache(src, [(f"cs{i}", 0)], 4096)
+            conn.sync()
+            dst = np.zeros_like(src)
+            for i in range(8):
+                conn.read_cache(dst, [(f"cs{i}", 0)], 4096)
+            assert conn.check_exist("cs0")
+            cs = conn.client_stats()
+            assert cs["enabled"]
+            assert cs["ops"]["put_cache"]["count"] == 8
+            assert cs["ops"]["read_cache"]["count"] == 8
+            assert cs["ops"]["check_exist"]["count"] == 1
+            r = cs["ops"]["read_cache"]
+            # Histogram invariants: LatHist geometry, counts add up,
+            # percentiles are midpoints of populated buckets.
+            assert len(r["hist"]) == 20
+            assert sum(r["hist"]) == r["count"]
+            assert r["p50_us"] > 0 and r["p99_us"] >= r["p50_us"]
+            assert r["total_us"] >= r["count"]  # >= 1 us per loopback op
+            # Machinery counters exist even when untouched.
+            for k in ("pin_cache_hits", "pin_cache_misses"):
+                assert k in cs["counters"]
+            assert cs["counters"].get("reconnects", 0) == 0
+        finally:
+            conn.close()
+    finally:
+        srv.stop()
+
+
+def test_client_stats_kill_switch(monkeypatch):
+    monkeypatch.setenv("ISTPU_CLIENT_STATS", "0")
+    srv = _small_server()
+    try:
+        conn = _connect(srv.service_port)  # flag read at construction
+        try:
+            src = np.arange(1024, dtype=np.uint8)
+            conn.put_cache(src, [("ks0", 0)], 1024)
+            conn.sync()
+            cs = conn.client_stats()
+            assert cs["enabled"] is False
+            assert cs["ops"] == {}
+        finally:
+            conn.close()
+    finally:
+        srv.stop()
+
+
+def test_client_stats_counts_reconnects():
+    srv = _small_server()
+    try:
+        conn = _connect(srv.service_port, auto_reconnect=True)
+        try:
+            src = np.arange(1024, dtype=np.uint8)
+            conn.put_cache(src, [("rc0", 0)], 1024)
+            conn.sync()
+            conn.reconnect()
+            assert conn.check_exist("rc0")
+            cs = conn.client_stats()
+            assert cs["counters"]["reconnects"] >= 1
+        finally:
+            conn.close()
+        # The documented contract: final tallies survive close()
+        # (pin-cache counts are harvested off retiring handles).
+        cs = conn.client_stats()
+        assert cs["ops"]["put_cache"]["count"] == 1
+        assert "pin_cache_hits" in cs["counters"]
+    finally:
+        srv.stop()
+
+
+def test_sharded_client_stats_aggregation():
+    srvs = [_small_server() for _ in range(2)]
+    try:
+        sc = ShardedConnection([
+            ClientConfig(host_addr="127.0.0.1", service_port=s.service_port)
+            for s in srvs
+        ])
+        sc.connect()
+        try:
+            src = np.arange(4096, dtype=np.uint8)
+            blocks = [(f"agg{i}", 0) for i in range(64)]
+            sc.put_cache(src, blocks, 4096)
+            dst = np.zeros_like(src)
+            sc.read_cache(dst, blocks, 4096)
+            cs = sc.client_stats()
+            assert cs["enabled"]
+            assert len(cs["per_shard"]) == 2
+            # The aggregate equals the per-shard sum, bucket-exact.
+            per_reads = [
+                ps["ops"].get("read_cache", {}).get("count", 0)
+                for ps in cs["per_shard"]
+            ]
+            assert all(n > 0 for n in per_reads), per_reads
+            assert cs["ops"]["read_cache"]["count"] == sum(per_reads)
+            agg_hist = cs["ops"]["read_cache"]["hist"]
+            assert sum(agg_hist) == sum(per_reads)
+        finally:
+            sc.close()
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+def test_logger_json_mode_injects_trace_id(monkeypatch):
+    from infinistore_tpu import lib as libmod
+
+    captured = []
+
+    class _StubLib:
+        def ist_log_msg(self, level, msg):
+            captured.append((level, msg.decode()))
+
+    monkeypatch.setattr(libmod._native, "get_lib", lambda: _StubLib())
+    monkeypatch.setenv("ISTPU_LOG_JSON", "1")
+    libmod._log_tls.trace_id = 0xABCDEF
+    try:
+        libmod.Logger.warning("storm incoming")
+    finally:
+        libmod._log_tls.trace_id = 0
+    assert captured
+    level, line = captured[-1]
+    blob = json.loads(line)
+    assert blob["msg"] == "storm incoming"
+    assert blob["level"] == "warning"
+    assert blob["trace_id"] == "0xabcdef"
+    assert blob["ts"] > 0
+    # Without the flag the line goes through verbatim.
+    monkeypatch.delenv("ISTPU_LOG_JSON")
+    libmod.Logger.warning("plain line")
+    assert captured[-1][1] == "plain line"
+
+
+# ---------------------------------------------------------------------------
+# metrics-history ring
+# ---------------------------------------------------------------------------
+
+
+def test_history_populates_and_survives_purge(fast_sampler):
+    srv = _small_server()
+    try:
+        conn = _connect(srv.service_port)
+        try:
+            src = np.arange(4096, dtype=np.uint8)
+            for i in range(32):
+                conn.put_cache(src, [(f"h{i}", 0)], 4096)
+            conn.sync()
+            # Wait until a sample OBSERVED the populated store.
+            assert _wait_for(lambda: any(
+                s["kvmap_len"] >= 32 and s["ops_delta"] > 0
+                for s in srv.history()["history"]))
+            h = srv.history()
+            assert h["enabled"] == 1 and h["capacity"] == 512
+            pre_recorded = h["recorded"]
+            # Sample invariants: monotonic stamps, latency deltas sum
+            # to op deltas over the whole ring (every op lands in
+            # exactly one bucket).
+            stamps = [s["t_us"] for s in h["history"]]
+            assert stamps == sorted(stamps)
+            assert sum(sum(s["lat_delta"]) for s in h["history"]) == \
+                sum(s["ops_delta"] for s in h["history"])
+            # op_deltas carries the per-op split.
+            assert any("OP_PUT" in s["op_deltas"] or s["op_deltas"]
+                       for s in h["history"])
+            # PURGE: gauges reset in later samples, ring NOT cleared.
+            srv.purge()
+            assert _wait_for(lambda: (
+                srv.history()["recorded"] > pre_recorded
+                and srv.history()["history"][-1]["kvmap_len"] == 0))
+            h2 = srv.history()
+            assert h2["recorded"] > pre_recorded  # never reset
+            assert any(s["kvmap_len"] >= 32 for s in h2["history"]), \
+                "pre-purge samples must survive purge (lead-up evidence)"
+        finally:
+            conn.close()
+    finally:
+        srv.stop()
+
+
+def test_history_kill_switch_is_bench_only(fast_sampler, monkeypatch):
+    monkeypatch.setenv("ISTPU_HISTORY", "0")
+    srv = _small_server()
+    try:
+        time.sleep(0.3)
+        h = srv.history()
+        assert h["enabled"] == 0
+        assert h["history"] == []
+        assert srv.stats()["history"]["enabled"] == 0
+    finally:
+        srv.stop()
+
+
+def test_bundle_contains_history_and_top_renders_sparklines(
+        tmp_path, fast_sampler):
+    d = tmp_path / "bundles"
+    srv = InfiniStoreServer(
+        ServerConfig(service_port=0, prealloc_size=0.01,
+                     minimal_allocate_size=4, bundle_dir=str(d))
+    )
+    srv.start()
+    try:
+        conn = _connect(srv.service_port)
+        try:
+            src = np.arange(4096, dtype=np.uint8)
+            for i in range(16):
+                conn.put_cache(src, [(f"b{i}", 0)], 4096)
+            conn.sync()
+        finally:
+            conn.close()
+        assert _wait_for(
+            lambda: srv.history()["recorded"] >= 2)
+        # Any verdict captures a bundle; drive the control-plane one.
+        assert srv.slo_trip("test: synthetic burn", 4200, 60)
+        bundles = sorted(
+            x for x in os.listdir(d) if x.startswith("bundle-"))
+        assert bundles and bundles[-1].endswith("slo_burn")
+        bdir = os.path.join(str(d), bundles[-1])
+        # history.json present and NON-EMPTY (the lead-up satellite).
+        hist = json.load(open(os.path.join(bdir, "history.json")))
+        assert hist["history"], "bundle history must hold the lead-up"
+        assert any(s["ops_delta"] > 0 for s in hist["history"])
+        manifest = json.load(open(os.path.join(bdir, "manifest.json")))
+        assert "history.json" in manifest["files"]
+        # The slo_burn event rode the bundle's event drain.
+        names = [e["name"] for e in json.load(
+            open(os.path.join(bdir, "events.json")))["events"]]
+        assert "watchdog.slo_burn" in names
+        # istpu_top --bundle renders the sparklines OFFLINE.
+        r = subprocess.run(
+            [sys.executable, ISTPU_TOP, "--bundle", bdir],
+            capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stderr
+        assert "trigger=slo_burn" in r.stdout
+        assert "history (" in r.stdout
+        assert "occupancy" in r.stdout and "ops/s" in r.stdout
+        assert any(c in r.stdout for c in "▁▂▃▄▅▆▇█")
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# SLO tracker
+# ---------------------------------------------------------------------------
+
+
+def _sample(t_us, ops, bad, errs=0):
+    lat = [0] * 20
+    lat[2] = ops - bad   # ~4-7 us: fast ops
+    lat[14] = bad        # ~16-32 ms: over any sane threshold
+    return {"t_us": t_us, "ops_delta": ops,
+            "disk_io_errors_delta": errs, "lat_delta": lat}
+
+
+def test_slo_burn_math_on_synthetic_ring():
+    class _NoServer:
+        pass
+
+    tr = SLOTracker(_NoServer(), latency_threshold_ms=1.0,
+                    latency_objective=0.99,
+                    availability_objective=0.99, short_window_s=10,
+                    long_window_s=30, burn_threshold=2.0)
+    now = 100_000_000
+    # Healthy ring: 1% budget, zero bad -> burn 0, not burning.
+    ring = {"enabled": 1, "now_us": now,
+            "history": [_sample(now - i * 1_000_000, 100, 0)
+                        for i in range(20)]}
+    st = tr.status(history=ring)
+    assert st["short"]["latency_burn_rate"] == 0.0
+    assert not st["burning"]
+    # 10% bad in BOTH windows -> burn 10x the 1% budget = 10 > 2.
+    ring = {"enabled": 1, "now_us": now,
+            "history": [_sample(now - i * 1_000_000, 100, 10)
+                        for i in range(20)]}
+    st = tr.status(history=ring)
+    assert st["short"]["latency_burn_rate"] == pytest.approx(10.0)
+    assert st["long"]["latency_burn_rate"] == pytest.approx(10.0)
+    assert st["burning"] and st["latency_burning"]
+    # Bad ops ONLY outside the short window -> long burns, short does
+    # not -> the multi-window guard holds fire (blip over, not firing).
+    hist = [_sample(now - i * 1_000_000, 100, 0) for i in range(10)]
+    hist += [_sample(now - i * 1_000_000, 100, 50)
+             for i in range(11, 21)]
+    st = tr.status(history={"enabled": 1, "now_us": now,
+                            "history": hist})
+    assert st["long"]["latency_burn_rate"] >= 2.0
+    assert st["short"]["latency_burn_rate"] == 0.0
+    assert not st["burning"]
+    # Availability objective: IO errors burn their own budget.
+    ring = {"enabled": 1, "now_us": now,
+            "history": [_sample(now - i * 1_000_000, 100, 0, errs=5)
+                        for i in range(20)]}
+    st = tr.status(history=ring)
+    assert st["short"]["availability_burn_rate"] == pytest.approx(5.0)
+    assert st["burning"] and st["availability_burning"]
+
+
+def test_slo_burn_verdict_from_latency_storm(tmp_path, fast_sampler):
+    """Acceptance: a disk.pread delay storm drives burn rate over
+    threshold and produces a slo_burn verdict whose bundle contains
+    history.json covering the lead-up."""
+    d = tmp_path / "bundles"
+    ssd = tmp_path / "ssd"
+    ssd.mkdir()
+    srv = InfiniStoreServer(
+        ServerConfig(service_port=0, prealloc_size=0.002,
+                     minimal_allocate_size=4, ssd_path=str(ssd),
+                     ssd_size=0.02, bundle_dir=str(d))
+    )
+    srv.start()
+    try:
+        conn = _connect(srv.service_port)
+        try:
+            src = np.zeros(4096, dtype=np.uint8)
+            # Overflow the 2 MB pool so the reclaimer spills cold keys
+            # to the disk tier.
+            for i in range(1024):
+                conn.put_cache(src, [(f"storm{i}", 0)], 4096)
+            conn.sync()
+            assert _wait_for(lambda: srv.stats()["spills"] > 0)
+            # THE STORM: every tier pread now takes +20 ms.
+            srv.fault("disk.pread=every(1):delay(20000)")
+            dst = np.zeros_like(src)
+            t_end = time.time() + 1.0
+            slow_reads = 0
+            i = 0
+            while time.time() < t_end:
+                # Oldest keys live on disk; each cold read pays the
+                # delayed pread inline.
+                conn.read_cache(dst, [(f"storm{i % 64}", 0)], 4096)
+                slow_reads += 1
+                i += 1
+            assert srv.stats()["disk_reads_inline"] > 0
+            # Let the sampler observe the storm window.
+            assert _wait_for(lambda: any(
+                sum(s["lat_delta"][13:]) > 0
+                for s in srv.history()["history"]))
+            tracker = SLOTracker(
+                srv, latency_threshold_ms=5.0,
+                latency_objective=0.999,
+                short_window_s=3.0, long_window_s=6.0,
+                burn_threshold=2.0, interval_s=0.05,
+            )
+            st = tracker.poll_once()
+            assert st["burning"], st
+            assert tracker.trips == 1
+            wd = srv.stats()["watchdog"]
+            assert wd["slo_trips"] == 1
+            assert wd["last_trigger"] == "slo_burn"
+            assert "watchdog.slo_burn" in [
+                e["name"] for e in srv.events()["events"]]
+            bundles = sorted(
+                x for x in os.listdir(d) if x.endswith("slo_burn"))
+            assert bundles, "slo_burn verdict captured no bundle"
+            hist = json.load(open(
+                os.path.join(str(d), bundles[-1], "history.json")))
+            # The bundle's ring covers the LEAD-UP: samples from the
+            # storm (slow buckets populated) are in there.
+            assert any(sum(s["lat_delta"][13:]) > 0
+                       for s in hist["history"])
+            # Native cooldown: an immediate re-poll cannot double-trip.
+            tracker.poll_once()
+            assert srv.stats()["watchdog"]["slo_trips"] == 1
+            srv.fault("off")
+        finally:
+            conn.close()
+    finally:
+        srv.stop()
+
+
+def test_slo_and_history_endpoints_and_metrics(fast_sampler):
+    from infinistore_tpu.server import make_control_plane
+    import threading
+    import urllib.request
+
+    srv = _small_server()
+    cp = make_control_plane(srv)
+    port = cp.server_address[1]
+    t = threading.Thread(target=cp.serve_forever, daemon=True)
+    t.start()
+    try:
+        conn = _connect(srv.service_port)
+        try:
+            src = np.arange(1024, dtype=np.uint8)
+            conn.put_cache(src, [("m0", 0)], 1024)
+            conn.sync()
+        finally:
+            conn.close()
+        time.sleep(0.15)
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                return r.read().decode()
+
+        h = json.loads(get("/history"))
+        assert h["capacity"] == 512
+        slo = json.loads(get("/slo"))
+        assert "short" in slo and "long" in slo
+        assert slo["burning"] is False
+        m = get("/metrics")
+        assert "infinistore_build_info{" in m
+        assert 'kind="slo_burn"' in m
+        assert 'infinistore_slo_burn_rate{slo="latency",window="short"}' in m
+        assert "infinistore_slo_burning 0" in m
+        assert "infinistore_history_samples_total" in m
+    finally:
+        cp.shutdown()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# causal background attribution + merged timeline
+# ---------------------------------------------------------------------------
+
+
+def test_promote_spans_carry_foreground_trace_id(tmp_path):
+    ssd = tmp_path / "ssd"
+    ssd.mkdir()
+    srv = InfiniStoreServer(
+        ServerConfig(service_port=0, prealloc_size=0.002,
+                     minimal_allocate_size=4, ssd_path=str(ssd),
+                     ssd_size=0.02, trace=True)
+    )
+    srv.start()
+    try:
+        conn = _connect(srv.service_port, trace=True)
+        try:
+            src = np.zeros(4096, dtype=np.uint8)
+            for i in range(1024):
+                conn.put_cache(src, [(f"attr{i}", 0)], 4096)
+            conn.sync()
+            assert _wait_for(lambda: srv.stats()["spills"] > 0)
+            # The explicit will-read signal queues promotions under
+            # THIS op's trace id.
+            counts = conn.prefetch([f"attr{i}" for i in range(8)],
+                                   wait=True)
+            tid = conn.last_trace_id
+            assert tid != 0
+            assert counts["queued"] > 0, counts
+            assert _wait_for(
+                lambda: srv.stats()["promotes_async"] > 0)
+            spans = srv.trace()["traceEvents"]
+            promote_spans = [
+                e for e in spans
+                if e.get("name") in ("promote_batch", "promote_read")
+            ]
+            assert promote_spans, "promotion recorded no spans"
+            tids = {e.get("args", {}).get("trace_id")
+                    for e in promote_spans}
+            assert ("0x%x" % tid) in tids, (
+                "background promote spans must carry the foreground "
+                f"prefetch's trace id (got {tids})")
+        finally:
+            conn.close()
+    finally:
+        srv.stop()
+
+
+def test_istpu_trace_merges_client_and_two_shards(tmp_path):
+    """Acceptance: one merged timeline where a single trace id spans
+    client spans and BOTH shards' server spans."""
+    srvs = [
+        InfiniStoreServer(ServerConfig(
+            service_port=0, prealloc_size=0.01,
+            minimal_allocate_size=4, trace=True))
+        for _ in range(2)
+    ]
+    ports = [s.start() for s in srvs]
+    sc = ShardedConnection([
+        ClientConfig(host_addr="127.0.0.1", service_port=p, trace=True)
+        for p in ports
+    ])
+    sc.connect()
+    try:
+        src = np.arange(4096, dtype=np.uint8)
+        blocks = [(f"mt{i}", 0) for i in range(64)]
+        sc.put_cache(src, blocks, 4096)
+        dst = np.zeros_like(src)
+        sc.read_cache(dst, blocks, 4096)
+        tid = sc.last_trace_id
+        assert tid != 0
+        client_f = tmp_path / "client.json"
+        client_f.write_text(sc.client_trace_json())
+        shard_fs = []
+        for i, s in enumerate(srvs):
+            p = tmp_path / f"shard{i}.json"
+            p.write_text(s.trace_json())
+            shard_fs.append(str(p))
+    finally:
+        sc.close()
+        for s in srvs:
+            s.stop()
+    # Module API: the merged timeline, filtered to the one trace id.
+    mod = _load_tool(ISTPU_TRACE, "istpu_trace_mod")
+    out = mod.merge(
+        [json.loads(client_f.read_text())],
+        [json.loads(open(p).read()) for p in shard_fs],
+        trace_id=tid,
+    )
+    spans = [e for e in out["traceEvents"] if e.get("ph") == "X"]
+    pids = {e["pid"] for e in spans}
+    assert pids == {0, 1, 2}, (
+        f"trace {tid:#x} must span client (0) and both shards (1, 2); "
+        f"got pids {pids}")
+    # CLI: same merge through the tool's argv surface.
+    merged_path = tmp_path / "merged.json"
+    r = subprocess.run(
+        [sys.executable, ISTPU_TRACE,
+         "--shard-file", shard_fs[0], "--shard-file", shard_fs[1],
+         "--client-file", str(client_f),
+         "--trace-id", hex(tid), "-o", str(merged_path)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    blob = json.loads(merged_path.read_text())
+    spans = [e for e in blob["traceEvents"] if e.get("ph") == "X"]
+    assert {e["pid"] for e in spans} == {0, 1, 2}
+    # Same-host clock: no alignment shift may have been applied, and
+    # every server span of the op nests inside the client op window.
+    client_spans = [e for e in spans if e["pid"] == 0]
+    lo = min(e["ts"] for e in client_spans)
+    hi = max(e["ts"] + e.get("dur", 0) for e in client_spans)
+    for e in spans:
+        if e["pid"] != 0:
+            assert lo - 1000 <= e["ts"] <= hi + 1000

@@ -246,6 +246,24 @@ def test_undocumented_endpoint_fails(tree):
     assert "/kvmap_len_v2" in r.stderr
 
 
+def test_dropped_slo_endpoint_fails_golden(tree):
+    # ISSUE 11 seeded mutation: silently deleting the /slo endpoint
+    # from the control plane must fail the golden's new `endpoints`
+    # section — dashboards depend on it exactly like bindings depend
+    # on exports. (Renaming would ALSO trip the undocumented-endpoint
+    # check; deletion only the golden catches.)
+    mutate(tree, "infinistore_tpu/server.py",
+           'elif self.path == "/slo":',
+           'elif self.path == "/slo_disabled_never_matches":')
+    # Keep the docs check quiet so the failure isolates the golden
+    # endpoint pin (the mutated path is undocumented too).
+    mutate(tree, "docs/api.md", "`GET /slo`",
+           "`GET /slo` `/slo_disabled_never_matches`")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "'endpoints' drifted" in r.stderr
+
+
 def test_make_analyze_exits_zero():
     # With clang installed this is the -Wthread-safety -Werror proof
     # pass; without it the target reports the skip and still exits 0 —

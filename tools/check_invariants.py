@@ -389,7 +389,8 @@ def _collect_cites(lines):
     return out
 
 
-def build_surface(common, abi, exports, failpoints, events):
+def build_surface(common, abi, exports, failpoints, events,
+                  endpoints=(), stats_keys=()):
     return {
         "abi_version": abi,
         "wire": {
@@ -403,6 +404,12 @@ def build_surface(common, abi, exports, failpoints, events):
         "exports": sorted(exports),
         "failpoints": sorted(failpoints),
         "events": sorted(events),
+        # ISSUE 11: the HTTP control-plane endpoint set and the native
+        # stats_json key set are wire-visible surface too — a silently
+        # dropped /slo or renamed stats key breaks dashboards the same
+        # way a dropped export breaks the binding layer.
+        "endpoints": sorted(endpoints),
+        "stats_keys": sorted(stats_keys),
     }
 
 
@@ -417,7 +424,7 @@ def check_golden(root, surface, abi_floor):
     with open(path, encoding="utf-8") as f:
         golden = json.load(f)
     for section in ("wire", "ops", "statuses", "exports", "failpoints",
-                    "events"):
+                    "events", "endpoints", "stats_keys"):
         if golden.get(section) != surface[section]:
             errs.append(
                 f"golden: '{section}' drifted from tools/abi_surface.json "
@@ -460,7 +467,8 @@ def main(argv=None):
     metric_refs, _families = parse_metrics_refs(root)
     endpoints = parse_endpoints(root)
     surface = build_surface(common, abi, exports, sites,
-                            ev_catalog.values())
+                            ev_catalog.values(), endpoints=endpoints,
+                            stats_keys=stats_keys)
 
     if args.write_golden:
         path = os.path.join(root, "tools", "abi_surface.json")

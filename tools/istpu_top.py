@@ -65,10 +65,81 @@ def _fmt_age(us):
     return f"{us / 1e6:.1f}s"
 
 
-def render_frame(stats, debug, events, prev=None, dt=None, tail=10):
-    """Render one dashboard frame from the three JSON blobs. ``prev``
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values, width=48):
+    """Unicode sparkline over the last `width` values (linear scale,
+    min..max of the shown window; flat series render as a low bar)."""
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_BLOCKS[0] * len(vals)
+    return "".join(
+        _SPARK_BLOCKS[min(len(_SPARK_BLOCKS) - 1,
+                          int((v - lo) / span * (len(_SPARK_BLOCKS) - 1)))]
+        for v in vals
+    )
+
+
+def _hist_p99(lat_delta):
+    """Midpoint p99 over one sample's aggregate latency-bucket delta
+    (the server's LatHist convention)."""
+    total = sum(lat_delta)
+    if total == 0:
+        return 0
+    rank = int(0.99 * (total - 1)) + 1
+    seen = 0
+    for b, n in enumerate(lat_delta):
+        seen += n
+        if seen >= rank:
+            return (1 << b) + (1 << b) // 2
+    return 0
+
+
+def render_history(history, width=48):
+    """Sparkline panel over the metrics-history ring (GET /history or
+    a bundle's history.json): pool occupancy, ops/s, per-sample p99,
+    and the background queue depths — the minutes of LEAD-UP that a
+    point-in-time stats blob cannot show."""
+    samples = (history or {}).get("history", [])
+    if not samples:
+        return []
+    interval_s = max((history.get("interval_ms", 1000)) / 1000.0, 1e-3)
+    occ = [
+        s.get("used_bytes", 0) / s.get("pool_bytes", 1)
+        if s.get("pool_bytes") else 0.0
+        for s in samples
+    ]
+    ops = [s.get("ops_delta", 0) / interval_s for s in samples]
+    p99 = [_hist_p99(s.get("lat_delta", [])) for s in samples]
+    queues = [
+        s.get("spill_queue_depth", 0) + s.get("promote_queue_depth", 0)
+        for s in samples
+    ]
+    span_s = len(samples[-width:]) * interval_s
+    lines = ["", f"history ({len(samples)} samples, ~{span_s:.0f}s shown):"]
+    rows = [
+        ("occupancy", occ, f"{occ[-1] * 100:5.1f}%"),
+        ("ops/s", ops, f"{ops[-1]:8.0f}"),
+        ("p99", p99, _fmt_age(p99[-1])),
+        ("queues", queues, f"{queues[-1]}"),
+    ]
+    for label, series, last in rows:
+        lines.append(f"  {label:<10}{_spark(series, width)} {last}")
+    return lines
+
+
+def render_frame(stats, debug, events, prev=None, dt=None, tail=10,
+                 history=None):
+    """Render one dashboard frame from the JSON blobs. ``prev``
     (the previous stats blob) + ``dt`` enable the throughput deltas;
-    without them the counters are shown as absolutes (bundle mode)."""
+    without them the counters are shown as absolutes (bundle mode).
+    ``history`` (GET /history or a bundle's history.json) adds the
+    sparkline lead-up panel."""
     lines = []
     eng = stats.get("engine", "?")
     wd = stats.get("watchdog", {})
@@ -180,6 +251,9 @@ def render_frame(stats, debug, events, prev=None, dt=None, tail=10):
                 f"outq {_fmt_bytes(c.get('outq_bytes', 0))}"
             )
 
+    # History sparklines (the lead-up, not just this instant).
+    lines.extend(render_history(history))
+
     # Recent events tail.
     evs = (events or {}).get("events", [])
     lines.append("")
@@ -212,10 +286,14 @@ def run_live(args):
                 return 1
             time.sleep(args.interval)
             continue
+        try:
+            history = _get_json(base, "/history")
+        except Exception:  # noqa: BLE001 — pre-v11 server: no panel
+            history = {}
         now = time.monotonic()
         frame = render_frame(stats, debug, events, prev=prev,
                              dt=(now - prev_t) if prev_t else None,
-                             tail=args.tail)
+                             tail=args.tail, history=history)
         if not args.once:
             sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
         print(frame)
@@ -248,7 +326,8 @@ def run_bundle(args):
         print(f"detail: {manifest.get('detail', '')}")
         print()
     print(render_frame(load("stats.json"), load("debug_state.json"),
-                       load("events.json"), tail=args.tail))
+                       load("events.json"), tail=args.tail,
+                       history=load("history.json")))
     return 0
 
 

@@ -1,0 +1,174 @@
+#!/usr/bin/env python3
+"""istpu_trace — merge per-shard server traces and client spans into
+one Perfetto timeline, keyed by trace id.
+
+A ShardedConnection op fans one trace id out to every shard, each
+shard's span rings record its server-side sub-spans under that id
+(GET /trace), and a tracing client (``ClientConfig(trace=True)``)
+records its own op spans client-side (``client_trace_json()``). This
+tool drains all of them and emits ONE Chrome trace-event JSON where a
+single trace id spans the client track and every shard's tracks —
+load it at ui.perfetto.dev and the whole distributed op reads as one
+timeline.
+
+Sources (mix freely):
+
+  --shard HOST:MANAGE_PORT    drain GET /trace from a live shard
+  --shard-file FILE           a saved /trace export (offline / tests)
+  --client-file FILE          a saved client_trace_json() export
+
+Clock alignment: all span timestamps are CLOCK_MONOTONIC microseconds.
+On one host (client + shards sharing a kernel) they already align —
+Python's time.monotonic_ns and the native now_us read the same clock.
+Across hosts each shard's clock has an arbitrary offset, so each
+shard timeline is shifted to center its earliest span of the first
+trace id it SHARES with the client inside that client span
+(``--no-align`` disables; exact cross-host sync is out of scope).
+
+  istpu_trace.py --shard h1:18080 --shard h2:18080 \\
+      --client-file client.json -o merged.json [--trace-id 0x...]
+
+Run from anywhere; stdlib only.
+"""
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def _load_url(hostport, timeout=5.0):
+    if "://" not in hostport:
+        hostport = f"http://{hostport}"
+    with urllib.request.urlopen(f"{hostport}/trace",
+                                timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _load_file(path):
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _span_tid(evt):
+    """The trace id stamped on a span event (0 = untraced)."""
+    try:
+        return int(evt.get("args", {}).get("trace_id", "0x0"), 16)
+    except (TypeError, ValueError):
+        return 0
+
+
+def _retag(events, pid, process_name):
+    """Re-home one source's events under its own pid, prefixed with a
+    process_name metadata row so Perfetto labels the track group."""
+    out = [{
+        "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+        "args": {"name": process_name},
+    }]
+    for e in events:
+        e = dict(e)
+        e["pid"] = pid
+        out.append(e)
+    return out
+
+
+def _align_offset(client_events, shard_events):
+    """Clock offset (µs, added to the shard's timestamps) that centers
+    the shard's earliest span of the first SHARED trace id inside the
+    matching client span. 0 when nothing is shared or the clocks
+    already agree to within the client span (the same-host case)."""
+    client_by_tid = {}
+    for e in client_events:
+        if e.get("ph") != "X":
+            continue
+        t = _span_tid(e)
+        if t and t not in client_by_tid:
+            client_by_tid[t] = e
+    best = None
+    for e in shard_events:
+        if e.get("ph") != "X":
+            continue
+        t = _span_tid(e)
+        if t in client_by_tid:
+            if best is None or e["ts"] < best[0]:
+                best = (e["ts"], client_by_tid[t])
+    if best is None:
+        return 0
+    sts, ce = best
+    # Already inside the client span (same clock): leave untouched.
+    if ce["ts"] <= sts <= ce["ts"] + ce.get("dur", 0):
+        return 0
+    # Center the server span group at the client span's midpoint.
+    return int(ce["ts"] + ce.get("dur", 0) // 2 - sts)
+
+
+def merge(client_blobs, shard_blobs, trace_id=0, align=True):
+    """Merge client + shard trace blobs into one trace-event dict.
+    ``trace_id`` (non-zero) filters spans to that id (metadata rows
+    are always kept, so thread names survive)."""
+    client_events = []
+    for blob in client_blobs:
+        client_events += blob.get("traceEvents", [])
+    merged = _retag(client_events, 0, "client")
+    for i, blob in enumerate(shard_blobs):
+        events = blob.get("traceEvents", [])
+        off = _align_offset(client_events, events) if align else 0
+        shifted = []
+        for e in events:
+            e = dict(e)
+            if off and "ts" in e:
+                e["ts"] = e["ts"] + off
+            shifted.append(e)
+        merged += _retag(shifted, i + 1, f"shard{i}")
+    if trace_id:
+        merged = [
+            e for e in merged
+            if e.get("ph") != "X" or _span_tid(e) == trace_id
+        ]
+    return {"displayTimeUnit": "ms", "traceEvents": merged}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0], prog="istpu_trace")
+    ap.add_argument("--shard", action="append", default=[],
+                    help="HOST:MANAGE_PORT of a live shard "
+                         "(repeatable, in shard order)")
+    ap.add_argument("--shard-file", action="append", default=[],
+                    help="saved GET /trace export (repeatable; "
+                         "appended after --shard sources)")
+    ap.add_argument("--client-file", action="append", default=[],
+                    help="saved client_trace_json() export "
+                         "(repeatable)")
+    ap.add_argument("--trace-id", default="",
+                    help="filter spans to one trace id (hex, e.g. "
+                         "0x1f2e...)")
+    ap.add_argument("--no-align", action="store_true",
+                    help="skip the cross-host clock-offset heuristic")
+    ap.add_argument("-o", "--out", default="",
+                    help="output path (default: stdout)")
+    args = ap.parse_args(argv)
+    if not args.shard and not args.shard_file:
+        ap.error("need at least one --shard or --shard-file")
+    shard_blobs = [_load_url(s) for s in args.shard]
+    shard_blobs += [_load_file(p) for p in args.shard_file]
+    client_blobs = [_load_file(p) for p in args.client_file]
+    tid = int(args.trace_id, 16) if args.trace_id else 0
+    out = merge(client_blobs, shard_blobs, trace_id=tid,
+                align=not args.no_align)
+    text = json.dumps(out)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text)
+        nspans = sum(1 for e in out["traceEvents"]
+                     if e.get("ph") == "X")
+        print(f"wrote {args.out}: {nspans} spans from "
+              f"{len(client_blobs)} client + "
+              f"{len(shard_blobs)} shard source(s)")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
