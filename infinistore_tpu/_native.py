@@ -146,7 +146,7 @@ def _decls(lib):
             "ist_conn_create",
             c.c_void_p,
             [c.c_char_p, c.c_uint16, c.c_int, c.c_uint64, c.c_int,
-             c.c_int, c.c_uint32, c.c_uint64],
+             c.c_int, c.c_uint32, c.c_uint64, c.c_int],
         ),
         ("ist_conn_connect", c.c_int, [c.c_void_p]),
         ("ist_conn_close", None, [c.c_void_p]),
@@ -221,6 +221,19 @@ def _decls(lib):
         ),
         ("ist_lease_flush", c.c_uint32, [c.c_void_p]),
         ("ist_lease_take_error", c.c_uint32, [c.c_void_p]),
+        # one-sided fabric plane (ABI v12)
+        (
+            "ist_fabric_put",
+            c.c_uint32,
+            [c.c_void_p, c.c_uint32, c.c_char_p, c.c_uint64, c.c_uint32,
+             c.POINTER(c.c_void_p), c.c_int],
+        ),
+        (
+            "ist_conn_fabric_telemetry",
+            None,
+            [c.c_void_p, c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
+             c.POINTER(c.c_uint64), c.POINTER(c.c_int)],
+        ),
         ("ist_commit", c.c_uint32, [c.c_void_p, c.POINTER(c.c_uint64), c.c_uint32]),
         (
             "ist_pin",
@@ -272,8 +285,10 @@ def _decls(lib):
         ("ist_mm_total_bytes", c.c_uint64, [c.c_void_p]),
         ("ist_mm_num_pools", c.c_uint64, [c.c_void_p]),
     ]
-    # ABI probe FIRST: a stale prebuilt library would lack the v11
-    # observability entry points (ist_server_history /
+    # ABI probe FIRST: a stale prebuilt library would lack the v12
+    # fabric entry points (ist_fabric_put / ist_conn_fabric_telemetry),
+    # misparse the v12 ist_conn_create trailing use_fabric flag, lack
+    # the v11 observability entry points (ist_server_history /
     # ist_server_slo_trip / ist_conn_telemetry), misparse the v10
     # ist_server_create argument list (trailing watchdog/
     # bundle_dir/bundle_keep), lack the v10 flight-recorder entry
@@ -292,9 +307,9 @@ def _decls(lib):
         ver = int(lib.ist_abi_version())
     except AttributeError:
         ver = 1
-    if ver < 11:
+    if ver < 12:
         raise RuntimeError(
-            f"stale native library at {_LIB_PATH} (ABI v{ver} < v11): "
+            f"stale native library at {_LIB_PATH} (ABI v{ver} < v12): "
             "rebuild with `make -C native` (or delete the .so to let "
             "the import auto-build)"
         )

@@ -64,6 +64,17 @@ class ClientConfig:
         # client-side MR cache. Off by default: leased put_cache is
         # pipelined (visible after sync()), not synchronous.
         self.use_lease = kwargs.get("use_lease", False)
+        # One-sided fabric plane (requires use_lease; docs/design.md
+        # "One-sided fabric engine"). Same host against an
+        # engine=fabric server: deferred commit records post into a
+        # per-connection shared-memory doorbell ring instead of TCP
+        # frames, so leased puts touch the socket only for a rare kick
+        # and the tiny responses. Cross host: puts ride one
+        # OP_FABRIC_WRITE frame per batch, scattered server-side
+        # straight into lease-carved blocks (commit included — no
+        # allocate round trip). Servers/engines without fabric degrade
+        # silently to the existing paths.
+        self.use_fabric = kwargs.get("use_fabric", False)
         # Pool blocks per OP_LEASE acquire (one RTT buys this many
         # future allocations) and the deferred-commit flush watermark.
         self.lease_blocks = kwargs.get("lease_blocks", 4096)
@@ -116,6 +127,11 @@ class ClientConfig:
             raise Exception("flush_size must be positive")
         if self.retry_backoff_ms < 0:
             raise Exception("retry_backoff_ms must be >= 0")
+        if self.use_fabric and not self.use_lease:
+            # The fabric plane carves every destination out of a block
+            # lease; without one there is nothing to negotiate and the
+            # flag would be a silent no-op.
+            raise Exception("use_fabric requires use_lease")
 
 
 class ServerConfig:
@@ -201,9 +217,14 @@ class ServerConfig:
         # registered as fixed kernel buffers, zero-copy sends for
         # OP_READ responses, multishot recv for header traffic,
         # optional SQPOLL — failing loudly at start() on kernels
-        # without io_uring; "auto" (default) probes at startup and
-        # falls back to epoll with one log line (the stats blob's
-        # "engine" key reports what was selected).
+        # without io_uring; "fabric" = the one-sided data plane
+        # (docs/design.md "One-sided fabric engine") — epoll control
+        # loop plus per-connection shared-memory commit rings so a
+        # leased same-host client's put path never touches the socket
+        # (falls back to the auto selection LOUDLY when POSIX shm is
+        # unavailable); "auto" (default) probes at startup and falls
+        # back to epoll with one log line (the stats blob's "engine"
+        # key reports what was selected).
         self.engine = kwargs.get("engine", "auto")
         # Anomaly watchdog + diagnostic bundles (docs/design.md "Flight
         # recorder & watchdog"; ISTPU_WATCHDOG=0/1 overrides). A native
@@ -265,8 +286,8 @@ class ServerConfig:
             raise Exception("max_outq_size must be positive (MB)")
         if self.workers < 0 or self.workers > 64:
             raise Exception("workers must be in [0, 64] (0 = auto)")
-        if self.engine not in ("auto", "epoll", "uring"):
-            raise Exception("engine must be auto, epoll or uring")
+        if self.engine not in ("auto", "epoll", "uring", "fabric"):
+            raise Exception("engine must be auto, epoll, uring or fabric")
         if self.bundle_keep < 1:
             raise Exception("bundle_keep must be >= 1")
         if 0.0 < self.reclaim_high < 1.0:

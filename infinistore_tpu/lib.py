@@ -311,6 +311,10 @@ class InfinityConnection:
         self.connected = False
         self.shm_connected = False
         self.stream_connected = False
+        # Negotiated cross-host fabric mode (set per connect from the
+        # native telemetry): gates the put path so non-fabric servers
+        # never pay the per-put argument prep for a doomed attempt.
+        self._fabric_stream = False
         # Keep (callback, buffers) alive until async ops complete.
         self._keepalive = {}
         self._keepalive_id = 0
@@ -348,6 +352,10 @@ class InfinityConnection:
         # (close/reconnect) — the counters live on the handle, and
         # client_stats() promises the final totals even after close.
         self._pin_cache_base = [0, 0]
+        # Fabric counters accumulated from retired handles (same
+        # harvest-on-reconnect discipline as the pin-cache tallies):
+        # ring_posts, doorbells, ring_fallbacks.
+        self._fabric_base = [0, 0, 0]
 
     # ------------------------------------------------------------------
     # connection lifecycle
@@ -376,6 +384,7 @@ class InfinityConnection:
             1 if self.config.use_lease else 0,
             self.config.lease_blocks,
             self.config.flush_size,
+            1 if self.config.use_fabric else 0,
         )
         if not h:
             raise Exception("Failed to create connection")
@@ -396,6 +405,18 @@ class InfinityConnection:
         self._h = h
         self.shm_connected = shm_active
         self.stream_connected = not shm_active
+        # One telemetry read caches what connect_server actually
+        # negotiated (stream mode only exists against fabric-capable
+        # servers with use_lease) — the put path gates on this, not on
+        # the config wish.
+        self._fabric_stream = False
+        if self.config.use_fabric:
+            z = ct.c_uint64(0)
+            modes = ct.c_int(0)
+            self._lib.ist_conn_fabric_telemetry(
+                h, ct.byref(z), ct.byref(z), ct.byref(z),
+                ct.byref(modes))
+            self._fabric_stream = bool(modes.value & 2)
         self.connected = True
         self._ever_connected = True
         return 0
@@ -448,6 +469,7 @@ class InfinityConnection:
         self.connected = False
         self.shm_connected = False
         self.stream_connected = False
+        self._fabric_stream = False
         self._ever_connected = False  # explicit close: no auto re-dial
 
     def __enter__(self):
@@ -678,13 +700,26 @@ class InfinityConnection:
             _log_tls.trace_id = 0
 
     def _harvest_pin_counts(self, h):
-        """Fold a retiring handle's native pin-cache tallies into
-        the Python-side base (the counters die with the handle)."""
+        """Fold a retiring handle's native pin-cache AND fabric
+        tallies into the Python-side bases (the counters die with the
+        handle — without this a reconnect would silently reset
+        client_stats()'s fabric section while its neighbors keep
+        history)."""
         hits = ct.c_uint64(0)
         misses = ct.c_uint64(0)
         self._lib.ist_conn_telemetry(h, ct.byref(hits), ct.byref(misses))
         self._pin_cache_base[0] += int(hits.value)
         self._pin_cache_base[1] += int(misses.value)
+        posts = ct.c_uint64(0)
+        bells = ct.c_uint64(0)
+        falls = ct.c_uint64(0)
+        modes = ct.c_int(0)
+        self._lib.ist_conn_fabric_telemetry(
+            h, ct.byref(posts), ct.byref(bells), ct.byref(falls),
+            ct.byref(modes))
+        self._fabric_base[0] += int(posts.value)
+        self._fabric_base[1] += int(bells.value)
+        self._fabric_base[2] += int(falls.value)
 
     def client_stats(self):
         """Client-side telemetry: per-op latency histograms (power-of-
@@ -702,10 +737,18 @@ class InfinityConnection:
         # freed Connection*. Parked (already-harvested) handles are
         # skipped — their counts live in the base; reading them again
         # would double count.
+        posts = ct.c_uint64(0)
+        bells = ct.c_uint64(0)
+        falls = ct.c_uint64(0)
+        modes = ct.c_int(0)
         with self._reconnect_lock:
             if self._h and self._h not in self._dead_handles:
                 self._lib.ist_conn_telemetry(
                     self._h, ct.byref(hits), ct.byref(misses)
+                )
+                self._lib.ist_conn_fabric_telemetry(
+                    self._h, ct.byref(posts), ct.byref(bells),
+                    ct.byref(falls), ct.byref(modes),
                 )
             out["counters"]["pin_cache_hits"] = (
                 self._pin_cache_base[0] + int(hits.value)
@@ -713,6 +756,19 @@ class InfinityConnection:
             out["counters"]["pin_cache_misses"] = (
                 self._pin_cache_base[1] + int(misses.value)
             )
+            # One-sided fabric plane (use_fabric): shm-ring commit
+            # records posted, doorbell frames sent, ring-full TCP
+            # fallbacks (retired handles' tallies folded in, same as
+            # the pin-cache counters), and which fabric mode this
+            # connection runs.
+            out["fabric"] = {
+                "ring_posts": self._fabric_base[0] + int(posts.value),
+                "doorbells": self._fabric_base[1] + int(bells.value),
+                "ring_fallbacks":
+                    self._fabric_base[2] + int(falls.value),
+                "ring_active": bool(modes.value & 1),
+                "stream_active": bool(modes.value & 2),
+            }
         return out
 
     def client_trace_events(self, pid=0, label="client"):
@@ -975,7 +1031,8 @@ class InfinityConnection:
 
     write_cache_async = rdma_write_cache_async
 
-    def _put_async_native(self, cache, blocks, page_size, cb):
+    def _put_async_native(self, cache, blocks, page_size, cb,
+                          try_fabric=True):
         """One-call put of (key, offset) pairs.
 
         STREAM path: a single OP_PUT round trip (server allocates, scatters
@@ -996,6 +1053,17 @@ class InfinityConnection:
             # page larger than any lease) — fall through to the legacy
             # allocate+write+commit path below.
             if self._lease_put_native(arr, blocks, page_bytes, keys):
+                cb(OK)
+                return
+        if try_fabric and self._fabric_stream:
+            # Cross-host fabric put (OP_FABRIC_WRITE; gated on the
+            # NEGOTIATED stream mode, so non-fabric servers never pay
+            # the prep): one frame whose payload the server scatters
+            # straight into lease-carved blocks — commit included, no
+            # allocate round trip. The native call blocks until the
+            # server's commit response; PARTIAL (fragmented grant,
+            # oversized batch) falls through to the legacy put.
+            if self._fabric_put_native(arr, blocks, page_bytes, keys):
                 cb(OK)
                 return
         if self.shm_connected:
@@ -1053,6 +1121,39 @@ class InfinityConnection:
         if st == _native.PARTIAL:
             return False  # lease path unfit for this shape
         raise InfiniStoreError(st, "leased put failed")
+
+    def _fabric_put_native(self, arr, blocks, page_bytes, keys):
+        """Blocking cross-host one-sided put (OP_FABRIC_WRITE): the
+        batch mirror-carves out of ONE lease client-side and the
+        server scatters the single frame's payload straight into the
+        carved pool blocks, committing at payload end. True = handled;
+        False = fabric path unfit for this shape (fall back to the
+        legacy put)."""
+        esize = arr.itemsize
+        base = arr.ctypes.data
+        nbytes = arr.nbytes
+        byte_offs = (
+            np.asarray([off for _, off in blocks], dtype=np.int64) * esize
+        )
+        if len(byte_offs) and (
+            int(byte_offs.min()) < 0
+            or int(byte_offs.max()) + page_bytes > nbytes
+        ):
+            raise ValueError("offset out of tensor bounds")
+        srcs = np.uint64(base) + byte_offs.astype(np.uint64)
+        src_arr = np.ascontiguousarray(srcs, dtype=np.uint64)
+        blob = pack_keys(keys)
+        st = self._lib.ist_fabric_put(
+            self._h, page_bytes, blob, len(blob), len(keys),
+            src_arr.ctypes.data_as(ct.POINTER(ct.c_void_p)),
+            self.config.timeout_ms,
+        )
+        if st == OK:
+            self._telemetry.bump("fabric_puts")
+            return True
+        if st == _native.PARTIAL:
+            return False
+        raise InfiniStoreError(st, "fabric put failed")
 
     def put_cache(self, cache, blocks, page_size):
         """Synchronous one-call put of (key, offset) pairs. In lease
@@ -1117,6 +1218,22 @@ class InfinityConnection:
                 return 0
             # PARTIAL (lease path unfit): fall through to the legacy
             # allocate + one-sided write below.
+        try_fabric = True
+        if self._fabric_stream:
+            # Cross-host fabric put: blocking native call (one frame,
+            # commit included) — run it off the event loop. On PARTIAL
+            # the legacy path below must NOT retry the fabric attempt
+            # (it would repeat the lease churn synchronously ON the
+            # loop).
+            arr = _as_src_array(cache)
+            keys = [k for k, _ in blocks]
+            handled = await asyncio.get_running_loop().run_in_executor(
+                None, self._fabric_put_native, arr, blocks,
+                page_size * arr.itemsize, keys,
+            )
+            if handled:
+                return 0
+            try_fabric = False
         if self.shm_connected:
             # The SHM put needs a blocking allocate rpc first — run it off
             # the event loop, then the async one-sided write.
@@ -1133,7 +1250,8 @@ class InfinityConnection:
         def cb(status):
             loop.call_soon_threadsafe(_finish_future, future, status, "put")
 
-        self._put_async_native(cache, blocks, page_size, cb)
+        self._put_async_native(cache, blocks, page_size, cb,
+                               try_fabric=try_fabric)
         return await future
 
     def local_gpu_write_cache(self, cache, blocks, page_size):

@@ -508,6 +508,25 @@ def _prometheus_metrics(stats, slo=None):
         ("uring_copies_avoided", "uring_copies_avoided",
          "payload bytes moved without a kernel bounce copy (direct "
          "pool reads + zero-copy sends)"),
+        # One-sided fabric plane (ISSUE 12). The ring-plane counters
+        # (attaches/commit_records/one_sided_puts/doorbells) move only
+        # under engine=fabric; fabric_writes is protocol-level — the
+        # cross-host OP_FABRIC_WRITE rides the shared state machine
+        # and counts on ANY engine serving a use_fabric client.
+        ("fabric_attaches", "fabric_attaches",
+         "per-connection shm commit rings attached (OP_FABRIC_ATTACH "
+         "grants on the fabric engine)"),
+        ("fabric_commit_records", "fabric_commit_records",
+         "commit records drained from the shm doorbell rings"),
+        ("fabric_one_sided_puts", "fabric_one_sided_puts",
+         "keys committed whose payload the server never touched (the "
+         "client wrote it one-sided; the commit arrived via the ring)"),
+        ("fabric_doorbells", "fabric_doorbells",
+         "doorbell frames received (sent only when the worker "
+         "advertised an idle ring)"),
+        ("fabric_writes", "fabric_writes",
+         "keys carried by cross-host OP_FABRIC_WRITE frames (payload "
+         "scattered straight into lease-carved blocks)"),
     ]
     lines = []
     # Selected transport engine as an info-style gauge: the engine name
@@ -1015,12 +1034,16 @@ def parse_args(argv=None):
                         "Perfetto-loadable JSON via GET /trace. "
                         "ISTPU_TRACE=1/0 overrides")
     p.add_argument("--engine", default="auto",
-                   choices=["auto", "epoll", "uring"],
+                   choices=["auto", "epoll", "uring", "fabric"],
                    help="transport engine for the worker IO loops: "
                         "epoll (readiness loop, portable), uring "
                         "(io_uring: registered pool buffers, zero-copy "
                         "sends, multishot recv; fails at startup on "
-                        "kernels without io_uring), or auto (probe and "
+                        "kernels without io_uring), fabric (one-sided "
+                        "data plane: per-connection shm commit rings, "
+                        "leased same-host puts never touch the socket; "
+                        "falls back to the auto selection loudly "
+                        "without POSIX shm), or auto (probe and "
                         "fall back to epoll, logged once; the /stats "
                         "'engine' key reports the selection). The "
                         "ISTPU_ENGINE env var overrides")

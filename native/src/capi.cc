@@ -125,10 +125,18 @@ extern "C" {
 // (control-plane SLO burn verdict: watchdog.slo_burn event + bundle)
 // and ist_conn_telemetry (client pin-cache hit/miss) entry points,
 // stats gains the history section and watchdog.slo_trips, the
-// spill/promote cancel events carry key hashes in a0).
+// spill/promote cancel events carry key hashes in a0; v12: one-sided
+// fabric data plane — trailing `use_fabric` int on ist_conn_create,
+// new ist_fabric_put (cross-host one-sided put over OP_FABRIC_WRITE)
+// and ist_conn_fabric_telemetry (ring posts / doorbells / ring-full
+// fallbacks + active-mode flags) entry points, ServerConfig.engine
+// accepts "fabric", wire ops 21-23 (FABRIC_ATTACH / FABRIC_WRITE /
+// FABRIC_DOORBELL), stats gains the fabric_* counters, new
+// engine.fabric_setup and fabric.doorbell failpoints and the fabric.*
+// event rows).
 // _native.py probes this at load so a stale prebuilt library fails
 // loudly instead of feeding unparseable blobs to the server.
-uint32_t ist_abi_version(void) { return 11; }
+uint32_t ist_abi_version(void) { return 12; }
 
 void ist_set_log_level(int level) { set_log_level(level); }
 void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }
@@ -330,7 +338,8 @@ int ist_server_shm_prefix(void* h, char* buf, int cap) {
 
 void* ist_conn_create(const char* host, uint16_t port, int use_shm,
                       uint64_t window_bytes, int timeout_ms, int use_lease,
-                      uint32_t lease_blocks, uint64_t flush_bytes) {
+                      uint32_t lease_blocks, uint64_t flush_bytes,
+                      int use_fabric) {
     ClientConfig cfg;
     cfg.host = host ? host : "127.0.0.1";
     cfg.port = port;
@@ -340,6 +349,10 @@ void* ist_conn_create(const char* host, uint16_t port, int use_shm,
     cfg.use_lease = use_lease != 0;
     if (lease_blocks) cfg.lease_blocks = lease_blocks;
     if (flush_bytes) cfg.flush_bytes = flush_bytes;
+    // One-sided fabric plane (v12): shm commit ring same-host,
+    // OP_FABRIC_WRITE cross-host; requires use_lease and degrades
+    // silently against servers/engines without it.
+    cfg.use_fabric = use_fabric != 0;
     return new Connection(cfg);
 }
 
@@ -677,6 +690,73 @@ uint32_t ist_lease_take_error(void* h) {
     auto* c = static_cast<Connection*>(h);
     if (c == nullptr) return INTERNAL_ERROR;
     return c->lease_take_error();
+}
+
+// ---- one-sided fabric plane (ABI v12) ----------------------------------
+
+// Blocking cross-host one-sided put over OP_FABRIC_WRITE: the batch
+// mirror-carves out of one lease client-side and ships one frame whose
+// payload the server scatters straight into the carved pool blocks
+// (zero-copy under engine=uring via the registered-buffer plan).
+// Returns OK once committed server-side, PARTIAL when the fabric
+// stream path is unfit for this connection/shape (caller falls back to
+// the legacy put), or the failure status. On timeout the connection is
+// hard-failed (the in-flight frame still references caller buffers),
+// exactly like the direct-read path.
+uint32_t ist_fabric_put(void* h, uint32_t block_size,
+                        const uint8_t* keys_blob, uint64_t blob_len,
+                        uint32_t nkeys, const void* const* srcs,
+                        int timeout_ms) {
+    auto* c = static_cast<Connection*>(h);
+    if (c == nullptr) return INTERNAL_ERROR;
+    std::vector<uint8_t> kb;
+    if (!keys_body(keys_blob, blob_len, nkeys, kb)) return BAD_REQUEST;
+    std::vector<const void*> sp(srcs, srcs + nkeys);
+    struct Wait {
+        std::mutex mu;
+        std::condition_variable cv;
+        bool fired = false;
+        uint32_t st = TIMEOUT_ERR;
+    };
+    auto w = std::make_shared<Wait>();
+    uint32_t st = c->fabric_put(
+        block_size, std::move(kb), nkeys, std::move(sp),
+        [w](uint32_t status, std::vector<uint8_t>) {
+            std::lock_guard<std::mutex> lk(w->mu);
+            w->st = status;
+            w->fired = true;
+            w->cv.notify_all();
+        });
+    if (st != OK) return st;  // unfit/refused: nothing submitted
+    if (timeout_ms <= 0) timeout_ms = 10000;
+    std::unique_lock<std::mutex> lk(w->mu);
+    if (!w->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                        [&] { return w->fired; })) {
+        lk.unlock();
+        c->hard_fail();
+        return TIMEOUT_ERR;
+    }
+    return w->st;
+}
+
+// Fabric client telemetry (client_stats()): shm-ring commit records
+// posted, doorbell frames sent, ring-full TCP fallbacks; *modes gets
+// bit 0 = commit ring mapped, bit 1 = cross-host stream mode active.
+void ist_conn_fabric_telemetry(void* h, uint64_t* ring_posts,
+                               uint64_t* doorbells,
+                               uint64_t* ring_fallbacks, int* modes) {
+    uint64_t posts = 0, bells = 0, falls = 0;
+    int m = 0;
+    if (h != nullptr) {
+        auto* c = static_cast<Connection*>(h);
+        c->fabric_stats(&posts, &bells, &falls);
+        m = (c->fabric_ring_active() ? 1 : 0) |
+            (c->fabric_stream_active() ? 2 : 0);
+    }
+    if (ring_posts != nullptr) *ring_posts = posts;
+    if (doorbells != nullptr) *doorbells = bells;
+    if (ring_fallbacks != nullptr) *ring_fallbacks = falls;
+    if (modes != nullptr) *modes = m;
 }
 
 // Commit previously allocated tokens (used by the zero-copy Python path

@@ -16,6 +16,7 @@
 #include <cstdlib>
 #include <cstring>
 
+#include "events.h"
 #include "log.h"
 
 namespace istpu {
@@ -279,6 +280,15 @@ int Connection::connect_server() {
                       "falling back to legacy ops");
         }
     }
+    // One-sided fabric negotiation, still on the blocking bootstrap
+    // socket (like HELLO): probes OP_FABRIC_ATTACH support, maps the
+    // shm commit ring when the server's fabric engine granted one,
+    // and enables the cross-host OP_FABRIC_WRITE mode when there is
+    // no shm to write through one-sided. Only a transport failure
+    // aborts the connect; "no fabric here" degrades silently.
+    if (cfg_.use_fabric && cfg_.use_lease) {
+        if (!fabric_bootstrap_attach()) return -1;
+    }
 
     // Switch to the IO thread regime.
     int fl = fcntl(fd_, F_GETFL, 0);
@@ -370,6 +380,16 @@ void Connection::close_conn() {
     {
         std::lock_guard<std::mutex> clk(cache_mu_);
         pin_cache_.clear();
+    }
+    // Fabric ring teardown: the IO thread (its only writer) has
+    // joined, so the unmap cannot race a post; the server unlinks the
+    // shm object when it sees the close.
+    fab_ring_.store(false);
+    fabric_stream_ = false;
+    if (fab_hdr_ != nullptr) {
+        munmap(fab_hdr_, fab_map_bytes_);
+        fab_hdr_ = nullptr;
+        fab_map_bytes_ = 0;
     }
     // Unmap pools AND the ctl page under pools_mu_: cached_read holds
     // that mutex across its pool copies and epoch loads, so a reader
@@ -955,6 +975,29 @@ void Connection::commit_batch_async(std::vector<uint8_t> body, DoneFn done) {
             if (done) done(st, std::move(b));
             finish_op();
         };
+        // Fabric ring first: the record lands one-sided in shm and
+        // only a rare doorbell touches the socket; the response (and
+        // so sync()/error-latch semantics) is identical. A full ring
+        // falls through to the TCP frame — safe in THAT direction
+        // because the server drains the ring before any TCP op. The
+        // reverse needs the fab_tcp_inflight_ gate: once a fallback
+        // frame is in flight, later commits must NOT take the ring
+        // (the server's poll-tick drain could apply their carve
+        // replay before the frame arrives off the socket — silent
+        // cross-batch divergence of the mirrored cursor); they stay
+        // on TCP until every fallback has its response.
+        const bool ring = fab_ring_.load(std::memory_order_relaxed);
+        if (ring && fab_tcp_inflight_ == 0 && try_ring_post(*body_p, p)) {
+            return;
+        }
+        if (ring) {
+            fab_tcp_inflight_++;
+            p.done = [this, inner = std::move(p.done)](
+                         uint32_t st, std::vector<uint8_t> b) {
+                fab_tcp_inflight_--;  // IO thread (completion context)
+                if (inner) inner(st, std::move(b));
+            };
+        }
         enqueue_msg(OP_COMMIT_BATCH, std::move(*body_p), {}, std::move(p));
     };
     {
@@ -1002,8 +1045,11 @@ uint32_t Connection::acquire_lease_locked(uint32_t min_blocks) {
         if (run.pool_idx > max_pool) max_pool = run.pool_idx;
     }
     if (!r.ok()) return INTERNAL_ERROR;
-    bool mapped;
-    {
+    // Cross-host fabric mode never dereferences the grant locally (the
+    // server scatters OP_FABRIC_WRITE payload itself), so the runs
+    // only need to be a valid carve cursor — no mapping required.
+    bool mapped = !shm_active_;
+    if (!mapped) {
         std::lock_guard<std::mutex> plk(pools_mu_);
         mapped = max_pool < pools_.size();
     }
@@ -1301,7 +1347,20 @@ bool Connection::cached_read_impl(uint32_t block_size,
             auto it = pin_cache_.find(keys[i]);
             if (it == pin_cache_.end()) return false;
             const CachedLoc& loc = it->second;
-            if (loc.epoch != e1 || loc.size < block_size ||
+            if (loc.epoch != e1) {
+                // The store epoch moved since this location was
+                // learned (evict/spill/delete/purge): the one-sided
+                // read is invalid, fall back to the pinned RPC path
+                // (which re-seeds at the current epoch). Recorded —
+                // for fabric connections only, the plane the event
+                // row documents — so an epoch storm pushing every
+                // read onto RPC is visible in the flight recorder.
+                if (cfg_.use_fabric) {
+                    events_emit(EV_FABRIC_EPOCH_MISS, e1, loc.epoch);
+                }
+                return false;
+            }
+            if (loc.size < block_size ||
                 loc.pool_idx >= pools_.size() ||
                 loc.offset + block_size > pools_[loc.pool_idx].size) {
                 return false;
@@ -1316,7 +1375,245 @@ bool Connection::cached_read_impl(uint32_t block_size,
     // reads (an ARM host could otherwise validate against a pre-copy
     // epoch while the bytes raced an eviction).
     std::atomic_thread_fence(std::memory_order_acquire);
-    return ctl_epoch(std::memory_order_acquire) == e1;
+    const uint64_t e2 = ctl_epoch(std::memory_order_acquire);
+    if (e2 != e1) {
+        // Epoch moved under the copy (evict/spill/delete/purge): the
+        // one-sided read is invalid and the caller falls back to the
+        // pinned RPC path — the detected-and-retried half of the
+        // optimistic protocol, flight-recorded (fabric connections
+        // only) so a fabric epoch storm (churning pool forcing every
+        // read back onto RPC) is visible.
+        if (cfg_.use_fabric) events_emit(EV_FABRIC_EPOCH_MISS, e1, e2);
+        return false;
+    }
+    return true;
+}
+
+// ---------------------------------------------------------------------------
+// One-sided fabric plane (fabric.h; docs/design.md "One-sided fabric
+// engine")
+// ---------------------------------------------------------------------------
+
+bool Connection::fabric_bootstrap_attach() {
+    // want_ring=0 from a STREAM connection: negotiate the protocol
+    // (OP_FABRIC_WRITE support) without making the server carve a shm
+    // ring this client could never map.
+    uint32_t want_ring = shm_active_ ? 1 : 0;
+    WireHeader h = make_header(OP_FABRIC_ATTACH, 0, 4, 0);
+    uint8_t frame[sizeof(WireHeader) + 4];
+    memcpy(frame, &h, sizeof(h));
+    memcpy(frame + sizeof(h), &want_ring, 4);
+    if (!send_exact(fd_, frame, sizeof(frame))) return false;
+    WireHeader rh;
+    if (!recv_exact(fd_, &rh, sizeof(rh)) || !header_valid(rh) ||
+        rh.payload_len != 0) {
+        return false;
+    }
+    std::vector<uint8_t> body(rh.body_len);
+    if (!recv_exact(fd_, body.data(), body.size())) return false;
+    BufReader r(body.data(), body.size());
+    if (r.u32() != OK) {
+        // Pre-fabric server (BAD_REQUEST from the unknown-op default):
+        // stay on the legacy paths, the connection itself is fine.
+        return true;
+    }
+    uint32_t active = r.u32();
+    std::string name = r.str();
+    uint64_t bytes = r.u64();
+    if (!r.ok()) return true;
+    // Protocol negotiated. Without a ring grant (non-fabric engine,
+    // cross-host, no shm) the stream mode carries the one-sided puts.
+    if (!shm_active_) {
+        fabric_stream_ = true;
+        return true;
+    }
+    if (!active || name.empty() || bytes == 0) return true;
+    int fd = shm_open(("/" + name).c_str(), O_RDWR, 0);
+    if (fd < 0) return true;  // remote server: ring not reachable
+    size_t total = kFabricHdrBytes + size_t(bytes);
+    void* mem =
+        mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    close(fd);
+    if (mem == MAP_FAILED) return true;
+    auto* hdr = static_cast<FabricRingHdr*>(mem);
+    if (hdr->magic != FABRIC_MAGIC || hdr->version != FABRIC_VERSION ||
+        hdr->data_cap != bytes) {
+        munmap(mem, total);
+        return true;
+    }
+    fab_hdr_ = hdr;
+    fab_map_bytes_ = total;
+    fab_ring_.store(true);
+    IST_INFO("fabric commit ring attached (%s, %llu B)", name.c_str(),
+             (unsigned long long)bytes);
+    return true;
+}
+
+bool Connection::try_ring_post(std::vector<uint8_t>& body,
+                               Pending& pending) {
+    FabricRingHdr* h = fab_hdr_;
+    if (h == nullptr) return false;
+    // fail_all() fails queued submissions by RUNNING them, relying on
+    // enqueue_msg's broken_ check to complete each Pending with an
+    // error. The ring path must refuse the same way: posting here
+    // would hand the server a record for a batch the client is about
+    // to report failed, and register a Pending that can never
+    // complete (pending_ was already cleared) — wedging sync().
+    if (broken_.load()) return false;
+    const uint64_t cap = h->data_cap;
+    uint64_t seq = next_seq_++;
+    // Record = u32 len + u64 client_seq + the OP_COMMIT_BATCH body
+    // bytes exactly as the TCP frame would carry them.
+    const uint64_t rec = 8 + body.size();
+    const uint64_t need = 4 + rec;
+    if (rec > cap / 2) {
+        next_seq_--;  // oversized: the TCP path takes this batch
+        return false;
+    }
+    uint64_t tail = h->tail.load(std::memory_order_relaxed);
+    uint64_t head = h->head.load(std::memory_order_acquire);
+    uint8_t* data = fabric_data(h);
+    uint64_t pos = tail % cap;
+    uint64_t run = fabric_run_to_end(tail, cap);
+    uint64_t pad = run < need ? run : 0;  // wrap: skip the sliver
+    if ((tail - head) + pad + need > cap) {
+        // Ring full — the server is behind. Fall back to a TCP commit
+        // frame (drained in order server-side) and flight-record the
+        // stall: a persistently full ring means the doorbell plane is
+        // not keeping up with offered load.
+        next_seq_--;
+        fab_fallbacks_.fetch_add(1, std::memory_order_relaxed);
+        events_emit(EV_FABRIC_DOORBELL_STALL, tail - head, need);
+        return false;
+    }
+    if (pad > 0) {
+        if (run >= 4) {
+            uint32_t mark = kFabricWrapMark;
+            memcpy(data + pos, &mark, 4);
+        }
+        tail += pad;
+        pos = 0;
+    }
+    uint32_t len = uint32_t(rec);
+    memcpy(data + pos, &len, 4);
+    memcpy(data + pos + 4, &seq, 8);
+    if (!body.empty()) {
+        memcpy(data + pos + 12, body.data(), body.size());
+    }
+    // seq_cst publication pairs with the consumer's need_kick store /
+    // tail re-load (fabric.h doorbell protocol): either the server's
+    // run-dry re-check sees this tail, or the load below sees
+    // need_kick=1 and we kick it over TCP.
+    h->tail.store(tail + need, std::memory_order_seq_cst);
+    fab_posts_.fetch_add(1, std::memory_order_relaxed);
+    pending_[seq] = std::move(pending);
+    uint32_t armed = 1;
+    if (h->need_kick.load(std::memory_order_seq_cst) == 1 &&
+        h->need_kick.compare_exchange_strong(armed, 0)) {
+        fab_doorbells_.fetch_add(1, std::memory_order_relaxed);
+        Pending bell;
+        bell.op = OP_FABRIC_DOORBELL;
+        bell.done = [](uint32_t, std::vector<uint8_t>) {};
+        enqueue_msg(OP_FABRIC_DOORBELL, {}, {}, std::move(bell));
+    }
+    return true;
+}
+
+uint32_t Connection::fabric_put(uint32_t block_size,
+                                std::vector<uint8_t> keys_wire,
+                                uint32_t nkeys,
+                                std::vector<const void*> srcs,
+                                DoneFn done) {
+    if (broken_.load() || !running_.load()) return INTERNAL_ERROR;
+    if (!fabric_stream_ || server_block_size_ == 0 || block_size == 0 ||
+        nkeys == 0 || keys_wire.size() < 4 || nkeys != srcs.size()) {
+        return PARTIAL;  // caller falls back to the legacy put
+    }
+    uint32_t wire_count = 0;
+    memcpy(&wire_count, keys_wire.data(), 4);
+    if (wire_count != nkeys) return BAD_REQUEST;
+    const uint32_t bs = server_block_size_;
+    const uint32_t nb = uint32_t((uint64_t(block_size) + bs - 1) / bs);
+    std::lock_guard<std::mutex> lk(lease_mu_);
+    // The frame carries ONE lease id, so the whole batch must carve
+    // from one grant. Count what the current grant still fits WITHOUT
+    // consuming (the same skip-small-runs rule the carve applies),
+    // re-leasing once when short.
+    auto fits = [&]() -> uint64_t {
+        if (!lease_valid_) return 0;
+        uint64_t n = 0;
+        uint32_t off = lease_block_off_;
+        for (size_t ri = lease_run_idx_; ri < lease_runs_.size(); ++ri) {
+            n += (lease_runs_[ri].nblocks - off) / nb;
+            off = 0;
+        }
+        return n;
+    };
+    if (fits() < nkeys) {
+        uint64_t want = uint64_t(nkeys) * nb;
+        if (want > MAX_LEASE_BLOCKS) return PARTIAL;
+        uint32_t st = acquire_lease_locked(
+            uint32_t(want > cfg_.lease_blocks ? want
+                                              : cfg_.lease_blocks));
+        if (st != OK) return st;
+        if (fits() < nkeys) return PARTIAL;  // fragmented grant
+    }
+    const uint64_t lease_id = lease_id_;
+    // Mirror carve: advance the cursor exactly as the server replays
+    // it when the frame arrives (fits() above guarantees bounds).
+    for (uint32_t i = 0; i < nkeys; ++i) {
+        while (lease_run_idx_ < lease_runs_.size() &&
+               lease_runs_[lease_run_idx_].nblocks - lease_block_off_ <
+                   nb) {
+            lease_run_idx_++;
+            lease_block_off_ = 0;
+        }
+        lease_block_off_ += nb;
+        if (lease_block_off_ == lease_runs_[lease_run_idx_].nblocks) {
+            lease_run_idx_++;
+            lease_block_off_ = 0;
+        }
+    }
+    // Submit while still under lease_mu_: fabric frames must hit the
+    // FIFO submit queue (and hence the socket) in carve order, and the
+    // next put's possible lease acquire/revoke must queue after this
+    // frame.
+    inflight_++;
+    uint64_t payload = uint64_t(block_size) * nkeys;
+    auto ks = std::make_shared<std::vector<uint8_t>>(std::move(keys_wire));
+    auto sp = std::make_shared<std::vector<const void*>>(std::move(srcs));
+    Submit s;
+    s.window_cost = payload;
+    s.fn = [this, lease_id, block_size, ks, sp, payload,
+            done = std::move(done)]() mutable {
+        std::vector<uint8_t> body;
+        BufWriter w(body);
+        w.u64(lease_id);
+        w.u32(block_size);
+        w.bytes(ks->data(), ks->size());
+        std::vector<std::pair<const uint8_t*, size_t>> segs;
+        segs.reserve(sp->size());
+        for (const void* p : *sp) {
+            segs.emplace_back(static_cast<const uint8_t*>(p),
+                              block_size);
+        }
+        Pending pend;
+        pend.op = OP_FABRIC_WRITE;
+        pend.payload_bytes = payload;
+        pend.done = [this, sp, done = std::move(done)](
+                        uint32_t status, std::vector<uint8_t> b) {
+            if (done) done(status, std::move(b));
+            finish_op();
+        };
+        enqueue_msg(OP_FABRIC_WRITE, std::move(body), std::move(segs),
+                    std::move(pend));
+    };
+    {
+        std::lock_guard<std::mutex> slk(submit_mu_);
+        submits_.push_back(std::move(s));
+    }
+    wake();
+    return OK;
 }
 
 void Connection::hard_fail() {

@@ -45,6 +45,7 @@
 #include <vector>
 
 #include "common.h"
+#include "fabric.h"
 #include "protocol.h"
 
 namespace istpu {
@@ -62,6 +63,16 @@ struct ClientConfig {
     bool use_lease = false;
     uint32_t lease_blocks = 4096;      // blocks per OP_LEASE acquire
     uint64_t flush_bytes = 16u << 20;  // deferred-commit watermark
+    // One-sided fabric plane (docs/design.md "One-sided fabric
+    // engine"; requires use_lease). Same host against an
+    // engine=fabric server: deferred commit records post into the
+    // per-connection shm ring (fabric.h) instead of TCP frames — the
+    // put path's only socket traffic is a rare doorbell and the tiny
+    // responses. Cross host (no shm): puts ride OP_FABRIC_WRITE, one
+    // frame per batch scattered server-side straight into
+    // lease-carved blocks. Off, unsupported servers, or probe
+    // failures all degrade silently to the existing paths.
+    bool use_fabric = false;
 };
 
 // Process-wide parallel memcpy engine: min(4, cores-2) workers plus the
@@ -206,6 +217,29 @@ class Connection {
     void pin_cache_stats(uint64_t* hits, uint64_t* misses) const {
         *hits = pin_cache_hits_.load(std::memory_order_relaxed);
         *misses = pin_cache_misses_.load(std::memory_order_relaxed);
+    }
+
+    // --- one-sided fabric plane (use_fabric) ---
+    // Cross-host put over OP_FABRIC_WRITE: mirror-carve the whole
+    // batch out of ONE lease (re-leasing once when the grant runs
+    // short) and ship {lease_id, block_size, keys} + payload as a
+    // single frame the server scatters straight into the carved
+    // blocks. Returns OK with `done` pending, PARTIAL when the path
+    // is unfit (no fabric negotiation, fragmented grant, oversized
+    // batch — caller falls back to the legacy put), or the lease
+    // acquire's error.
+    uint32_t fabric_put(uint32_t block_size,
+                        std::vector<uint8_t> keys_wire, uint32_t nkeys,
+                        std::vector<const void*> srcs, DoneFn done);
+    bool fabric_ring_active() const { return fab_ring_.load(); }
+    bool fabric_stream_active() const { return fabric_stream_; }
+    // Telemetry (client_stats()): commit records posted to the shm
+    // ring, doorbell frames sent, and ring-full TCP fallbacks.
+    void fabric_stats(uint64_t* posts, uint64_t* doorbells,
+                      uint64_t* fallbacks) const {
+        *posts = fab_posts_.load(std::memory_order_relaxed);
+        *doorbells = fab_doorbells_.load(std::memory_order_relaxed);
+        *fallbacks = fab_fallbacks_.load(std::memory_order_relaxed);
     }
 
     // Pool mapping access for the zero-copy Python path.
@@ -392,6 +426,34 @@ class Connection {
 
     // Mapped server ctl page (read-only): the store epoch word.
     CtlPage* ctl_map_ = nullptr;
+
+    // --- one-sided fabric plane (fabric.h) ---
+    // OP_FABRIC_ATTACH handshake on the still-blocking bootstrap
+    // socket (connect_server): probes protocol support and maps the
+    // shm commit ring when the server's fabric engine granted one.
+    bool fabric_bootstrap_attach();
+    // Post one commit-record body into the ring (IO thread only; the
+    // producer cursor has exactly one writer). Registers `pending`
+    // under a fresh seq and sends a doorbell frame iff the server
+    // advertised it went idle. false = ring full/oversized — the
+    // caller ships the same body as a TCP OP_COMMIT_BATCH instead
+    // (the server drains the ring before any TCP op, preserving the
+    // carve-cursor order across the two channels).
+    bool try_ring_post(std::vector<uint8_t>& body, Pending& pending);
+    FabricRingHdr* fab_hdr_ = nullptr;
+    size_t fab_map_bytes_ = 0;
+    std::atomic<bool> fab_ring_{false};
+    // TCP-fallback commits still in flight (IO-thread-only). While
+    // nonzero the ring is NOT used: a record posted after a fallback
+    // frame could be drained on the server's poll tick BEFORE the
+    // frame arrives off the socket, replaying the carve out of order
+    // — commits stay on TCP (in-order by construction) until every
+    // fallback has its response, then the ring resumes.
+    size_t fab_tcp_inflight_ = 0;
+    bool fabric_stream_ = false;  // cross-host OP_FABRIC_WRITE mode
+    std::atomic<uint64_t> fab_posts_{0};
+    std::atomic<uint64_t> fab_doorbells_{0};
+    std::atomic<uint64_t> fab_fallbacks_{0};
 };
 
 }  // namespace istpu

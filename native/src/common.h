@@ -75,6 +75,20 @@ enum Op : uint8_t {
     // queued). Fire-and-forget from the client's perspective — the
     // promotion itself runs on the server's worker thread.
     OP_PREFETCH = 20,
+    // One-sided fabric plane (fabric.h; docs/design.md "One-sided
+    // fabric engine" — the reference's RDMA-WRITE-for-payload /
+    // SEND-RECV-for-control split recovered on shm + TCP):
+    OP_FABRIC_ATTACH = 21,   // negotiate this connection's shm commit
+                             // ring; answers active=0 on non-fabric
+                             // engines (client falls back silently)
+    OP_FABRIC_WRITE = 22,    // cross-host emulated one-sided write:
+                             // {lease_id, block_size, keys} + payload
+                             // scattered straight into lease-CARVED
+                             // blocks (the server replays the carve —
+                             // the wire never carries offsets a
+                             // client could forge) and committed at
+                             // payload end
+    OP_FABRIC_DOORBELL = 23, // header-only kick: drain my commit ring
 };
 
 // ---------------------------------------------------------------------------

@@ -5,8 +5,11 @@
 // shared protocol state machine, writev gathers straight out of pool
 // blocks. It is the "auto" fallback on hosts without io_uring, the
 // forced engine=epoll path, and the byte-compatibility reference the
-// engine parity suite (tests/test_engine.py) pins the uring engine
-// against.
+// engine parity suite (tests/test_engine.py) pins the uring and
+// fabric engines against. The class lives in engine_epoll.h so the
+// fabric engine can layer its shm commit rings on this loop.
+#include "engine_epoll.h"
+
 #include <errno.h>
 #include <string.h>
 #include <sys/epoll.h>
@@ -16,284 +19,274 @@
 #include <time.h>
 #include <unistd.h>
 
-#include "engine.h"
 #include "failpoint.h"
 #include "log.h"
 #include "server.h"
 
 namespace istpu {
 
-class EngineEpoll final : public Engine {
-   public:
-    EngineEpoll(Server& srv, Worker& w) : s_(srv), w_(w) {}
-    ~EngineEpoll() override { shutdown(); }
+EngineEpoll::~EngineEpoll() { EngineEpoll::shutdown(); }
 
-    const char* name() const override { return "epoll"; }
-
-    bool init() override {
-        ep_ = epoll_create1(EPOLL_CLOEXEC);
-        if (ep_ < 0) {
-            IST_ERROR("epoll_create1: %s", strerror(errno));
-            return false;
-        }
-        epoll_event ev{};
-        ev.events = EPOLLIN;
-        ev.data.fd = w_.wake_fd;
-        epoll_ctl(ep_, EPOLL_CTL_ADD, w_.wake_fd, &ev);
-        if (w_.listen_fd >= 0) {
-            ev.data.fd = w_.listen_fd;
-            epoll_ctl(ep_, EPOLL_CTL_ADD, w_.listen_fd, &ev);
-        }
-        return true;
+bool EngineEpoll::init() {
+    ep_ = epoll_create1(EPOLL_CLOEXEC);
+    if (ep_ < 0) {
+        IST_ERROR("epoll_create1: %s", strerror(errno));
+        return false;
     }
-
-    void shutdown() override {
-        if (ep_ >= 0) {
-            close(ep_);
-            ep_ = -1;
-        }
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = w_.wake_fd;
+    epoll_ctl(ep_, EPOLL_CTL_ADD, w_.wake_fd, &ev);
+    if (w_.listen_fd >= 0) {
+        ev.data.fd = w_.listen_fd;
+        epoll_ctl(ep_, EPOLL_CTL_ADD, w_.listen_fd, &ev);
     }
+    return true;
+}
 
-    void poll() override {
-        constexpr int kMaxEvents = 64;
-        epoll_event events[kMaxEvents];
-        int n = epoll_wait(ep_, events, kMaxEvents, 500);
-        if (n < 0) {
-            if (errno == EINTR) return;
-            IST_ERROR("epoll_wait: %s", strerror(errno));
-            // Treat a broken epoll fd like a stop: the outer loop
-            // re-checks running_ and a dead loop is visible in stats
-            // (connections stop progressing) instead of spinning.
-            struct timespec ts {0, 100 * 1000 * 1000};
-            nanosleep(&ts, nullptr);
-            return;
+void EngineEpoll::shutdown() {
+    if (ep_ >= 0) {
+        close(ep_);
+        ep_ = -1;
+    }
+}
+
+void EngineEpoll::poll() { poll_once(500); }
+
+void EngineEpoll::poll_once(int timeout_ms) {
+    constexpr int kMaxEvents = 64;
+    epoll_event events[kMaxEvents];
+    int n = epoll_wait(ep_, events, kMaxEvents, timeout_ms);
+    if (n < 0) {
+        if (errno == EINTR) return;
+        IST_ERROR("epoll_wait: %s", strerror(errno));
+        // Treat a broken epoll fd like a stop: the outer loop
+        // re-checks running_ and a dead loop is visible in stats
+        // (connections stop progressing) instead of spinning.
+        struct timespec ts {0, 100 * 1000 * 1000};
+        nanosleep(&ts, nullptr);
+        return;
+    }
+    for (int i = 0; i < n; ++i) {
+        int fd = events[i].data.fd;
+        uint32_t evs = events[i].events;
+        if (fd == w_.wake_fd) {
+            uint64_t v;
+            ssize_t r = read(w_.wake_fd, &v, sizeof(v));
+            (void)r;
+            s_.adopt_pending(w_);
+            continue;
         }
-        for (int i = 0; i < n; ++i) {
-            int fd = events[i].data.fd;
-            uint32_t evs = events[i].events;
-            if (fd == w_.wake_fd) {
-                uint64_t v;
-                ssize_t r = read(w_.wake_fd, &v, sizeof(v));
-                (void)r;
-                s_.adopt_pending(w_);
-                continue;
+        if (fd == w_.listen_fd) {  // this worker's own acceptor
+            s_.accept_ready(w_, fd);
+            continue;
+        }
+        auto it = w_.conns.find(fd);
+        if (it == w_.conns.end()) continue;
+        Conn& c = *it->second;
+        if (evs & (EPOLLHUP | EPOLLERR)) {
+            s_.close_conn(w_, fd);
+            continue;
+        }
+        if (evs & EPOLLIN) {
+            on_readable(c);
+            if (w_.conns.find(fd) == w_.conns.end()) continue;
+        }
+        if (evs & EPOLLOUT) on_writable(c);
+    }
+}
+
+void EngineEpoll::conn_added(Conn& c) {
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = c.fd;
+    epoll_ctl(ep_, EPOLL_CTL_ADD, c.fd, &ev);
+}
+
+void EngineEpoll::conn_closing(Conn& c) {
+    epoll_ctl(ep_, EPOLL_CTL_DEL, c.fd, nullptr);
+}
+
+void EngineEpoll::output_ready(Conn& c) {
+    if (!flush_out(c)) {
+        c.dead = true;
+        return;
+    }
+    update(c);
+}
+
+// Keep EPOLLOUT armed exactly while the out queue is non-empty.
+void EngineEpoll::update(Conn& c) {
+    bool want = !c.outq.empty();
+    if (want == c.want_write) return;
+    c.want_write = want;
+    epoll_event ev{};
+    ev.events = EPOLLIN | (want ? uint32_t(EPOLLOUT) : 0u);
+    ev.data.fd = c.fd;
+    epoll_ctl(ep_, EPOLL_CTL_MOD, c.fd, &ev);
+}
+
+void EngineEpoll::on_readable(Conn& c) {
+    // Injected receive failure: the connection drops exactly as on
+    // a real socket error — the close path aborts the client's
+    // inflight tokens, releases its pins and reclaims its block
+    // leases, and an auto_reconnect client re-dials. One relaxed
+    // load when disarmed.
+    if (IST_FAILPOINT("sock.recv")) {
+        IST_WARN("sock.recv failpoint: dropping fd=%d", c.fd);
+        return s_.close_conn(w_, c.fd);
+    }
+    while (true) {
+        if (c.state == RState::HDR) {
+            ssize_t r = recv(
+                c.fd, reinterpret_cast<uint8_t*>(&c.hdr) + c.hdr_got,
+                sizeof(WireHeader) - c.hdr_got, 0);
+            if (r == 0) return s_.close_conn(w_, c.fd);
+            if (r < 0) {
+                if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+                return s_.close_conn(w_, c.fd);
             }
-            if (fd == w_.listen_fd) {  // this worker's own acceptor
-                s_.accept_ready(w_, fd);
-                continue;
+            s_.bytes_in_ += uint64_t(r);
+            w_.bytes_in.fetch_add(uint64_t(r),
+                                  std::memory_order_relaxed);
+            c.hdr_got += size_t(r);
+            if (c.hdr_got < sizeof(WireHeader)) continue;
+            if (!header_valid(c.hdr)) {
+                IST_WARN("bad header from fd=%d, closing", c.fd);
+                return s_.close_conn(w_, c.fd);
             }
-            auto it = w_.conns.find(fd);
-            if (it == w_.conns.end()) continue;
-            Conn& c = *it->second;
-            if (evs & (EPOLLHUP | EPOLLERR)) {
-                s_.close_conn(w_, fd);
-                continue;
-            }
-            if (evs & EPOLLIN) {
-                on_readable(c);
-                if (w_.conns.find(fd) == w_.conns.end()) continue;
-            }
-            if (evs & EPOLLOUT) on_writable(c);
-        }
-    }
-
-    void conn_added(Conn& c) override {
-        epoll_event ev{};
-        ev.events = EPOLLIN;
-        ev.data.fd = c.fd;
-        epoll_ctl(ep_, EPOLL_CTL_ADD, c.fd, &ev);
-    }
-
-    void conn_closing(Conn& c) override {
-        epoll_ctl(ep_, EPOLL_CTL_DEL, c.fd, nullptr);
-    }
-
-    void output_ready(Conn& c) override {
-        if (!flush_out(c)) {
-            c.dead = true;
-            return;
-        }
-        update(c);
-    }
-
-   private:
-    // Keep EPOLLOUT armed exactly while the out queue is non-empty.
-    void update(Conn& c) {
-        bool want = !c.outq.empty();
-        if (want == c.want_write) return;
-        c.want_write = want;
-        epoll_event ev{};
-        ev.events = EPOLLIN | (want ? uint32_t(EPOLLOUT) : 0u);
-        ev.data.fd = c.fd;
-        epoll_ctl(ep_, EPOLL_CTL_MOD, c.fd, &ev);
-    }
-
-    void on_readable(Conn& c) {
-        // Injected receive failure: the connection drops exactly as on
-        // a real socket error — the close path aborts the client's
-        // inflight tokens, releases its pins and reclaims its block
-        // leases, and an auto_reconnect client re-dials. One relaxed
-        // load when disarmed.
-        if (IST_FAILPOINT("sock.recv")) {
-            IST_WARN("sock.recv failpoint: dropping fd=%d", c.fd);
-            return s_.close_conn(w_, c.fd);
-        }
-        while (true) {
-            if (c.state == RState::HDR) {
-                ssize_t r = recv(
-                    c.fd, reinterpret_cast<uint8_t*>(&c.hdr) + c.hdr_got,
-                    sizeof(WireHeader) - c.hdr_got, 0);
-                if (r == 0) return s_.close_conn(w_, c.fd);
-                if (r < 0) {
-                    if (errno == EAGAIN || errno == EWOULDBLOCK) return;
-                    return s_.close_conn(w_, c.fd);
-                }
-                s_.bytes_in_ += uint64_t(r);
-                w_.bytes_in.fetch_add(uint64_t(r),
-                                      std::memory_order_relaxed);
-                c.hdr_got += size_t(r);
-                if (c.hdr_got < sizeof(WireHeader)) continue;
-                if (!header_valid(c.hdr)) {
-                    IST_WARN("bad header from fd=%d, closing", c.fd);
-                    return s_.close_conn(w_, c.fd);
-                }
-                c.body.resize(c.hdr.body_len);
-                c.body_got = 0;
-                c.state = RState::BODY;
-                if (c.hdr.body_len == 0) {
-                    s_.handle_message(c);
-                    if (c.dead) return s_.close_conn(w_, c.fd);
-                    continue;
-                }
-            } else if (c.state == RState::BODY) {
-                ssize_t r = recv(c.fd, c.body.data() + c.body_got,
-                                 c.body.size() - c.body_got, 0);
-                if (r == 0) return s_.close_conn(w_, c.fd);
-                if (r < 0) {
-                    if (errno == EAGAIN || errno == EWOULDBLOCK) return;
-                    return s_.close_conn(w_, c.fd);
-                }
-                s_.bytes_in_ += uint64_t(r);
-                w_.bytes_in.fetch_add(uint64_t(r),
-                                      std::memory_order_relaxed);
-                c.body_got += size_t(r);
-                if (c.body_got < c.body.size()) continue;
+            c.body.resize(c.hdr.body_len);
+            c.body_got = 0;
+            c.state = RState::BODY;
+            if (c.hdr.body_len == 0) {
                 s_.handle_message(c);
                 if (c.dead) return s_.close_conn(w_, c.fd);
-            } else {
-                // PAYLOAD: scatter OP_WRITE payload straight into pool
-                // blocks — the TCP analogue of one-sided RDMA WRITE
-                // landing in the pool. One readv covers up to 64
-                // destination runs (adjacent pool blocks merge into one
-                // iovec), so a 64-block batch costs one syscall instead
-                // of 64. DRAIN reads into the sink through the same
-                // shared plan builder.
-                while (c.payload_left > 0) {
-                    iovec iov[64];
-                    int niov = s_.payload_iov(c, iov, 64);
-                    ssize_t r = readv(c.fd, iov, niov);
-                    if (r == 0) return s_.close_conn(w_, c.fd);
-                    if (r < 0) {
-                        if (errno == EAGAIN || errno == EWOULDBLOCK) {
-                            return;
-                        }
-                        return s_.close_conn(w_, c.fd);
+                continue;
+            }
+        } else if (c.state == RState::BODY) {
+            ssize_t r = recv(c.fd, c.body.data() + c.body_got,
+                             c.body.size() - c.body_got, 0);
+            if (r == 0) return s_.close_conn(w_, c.fd);
+            if (r < 0) {
+                if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+                return s_.close_conn(w_, c.fd);
+            }
+            s_.bytes_in_ += uint64_t(r);
+            w_.bytes_in.fetch_add(uint64_t(r),
+                                  std::memory_order_relaxed);
+            c.body_got += size_t(r);
+            if (c.body_got < c.body.size()) continue;
+            s_.handle_message(c);
+            if (c.dead) return s_.close_conn(w_, c.fd);
+        } else {
+            // PAYLOAD: scatter OP_WRITE payload straight into pool
+            // blocks — the TCP analogue of one-sided RDMA WRITE
+            // landing in the pool. One readv covers up to 64
+            // destination runs (adjacent pool blocks merge into one
+            // iovec), so a 64-block batch costs one syscall instead
+            // of 64. DRAIN reads into the sink through the same
+            // shared plan builder.
+            while (c.payload_left > 0) {
+                iovec iov[64];
+                int niov = s_.payload_iov(c, iov, 64);
+                ssize_t r = readv(c.fd, iov, niov);
+                if (r == 0) return s_.close_conn(w_, c.fd);
+                if (r < 0) {
+                    if (errno == EAGAIN || errno == EWOULDBLOCK) {
+                        return;
                     }
-                    if (c.state == RState::PAYLOAD) {
-                        s_.bytes_in_ += uint64_t(r);
-                        w_.bytes_in.fetch_add(uint64_t(r),
-                                              std::memory_order_relaxed);
-                    }
-                    s_.payload_advance(c, size_t(r));
+                    return s_.close_conn(w_, c.fd);
                 }
                 if (c.state == RState::PAYLOAD) {
-                    s_.finish_write(c);
-                    if (c.dead) return s_.close_conn(w_, c.fd);
-                } else {  // DRAIN fully consumed
-                    c.state = RState::HDR;
-                    c.hdr_got = 0;
+                    s_.bytes_in_ += uint64_t(r);
+                    w_.bytes_in.fetch_add(uint64_t(r),
+                                          std::memory_order_relaxed);
                 }
+                s_.payload_advance(c, size_t(r));
+            }
+            if (c.state == RState::PAYLOAD) {
+                s_.finish_write(c);
+                if (c.dead) return s_.close_conn(w_, c.fd);
+            } else {  // DRAIN fully consumed
+                c.state = RState::HDR;
+                c.hdr_got = 0;
             }
         }
     }
+}
 
-    void on_writable(Conn& c) {
-        if (!flush_out(c)) {
-            s_.close_conn(w_, c.fd);
-            return;
-        }
-        update(c);
+void EngineEpoll::on_writable(Conn& c) {
+    if (!flush_out(c)) {
+        s_.close_conn(w_, c.fd);
+        return;
     }
+    update(c);
+}
 
-    bool flush_out(Conn& c) {
-        // Injected send failure: callers treat false as a fatal socket
-        // error and close the connection (queued OutMsgs drop their
-        // BlockRefs — pins unwind exactly like a real peer reset).
-        if (!c.outq.empty() && IST_FAILPOINT("sock.send")) {
-            IST_WARN("sock.send failpoint: dropping fd=%d", c.fd);
+bool EngineEpoll::flush_out(Conn& c) {
+    // Injected send failure: callers treat false as a fatal socket
+    // error and close the connection (queued OutMsgs drop their
+    // BlockRefs — pins unwind exactly like a real peer reset).
+    if (!c.outq.empty() && IST_FAILPOINT("sock.send")) {
+        IST_WARN("sock.send failpoint: dropping fd=%d", c.fd);
+        return false;
+    }
+    while (!c.outq.empty()) {
+        OutMsg& m = c.outq.front();
+        iovec iov[64];
+        int niov = 0;
+        if (!m.meta_done) {
+            iov[niov].iov_base = m.meta.data() + m.off;
+            iov[niov].iov_len = m.meta.size() - m.off;
+            niov++;
+        }
+        for (size_t s = m.seg_idx; s < m.segs.size() && niov < 64;
+             ++s) {
+            size_t skip = (s == m.seg_idx && m.meta_done) ? m.off : 0;
+            iov[niov].iov_base =
+                const_cast<uint8_t*>(m.segs[s].first) + skip;
+            iov[niov].iov_len = m.segs[s].second - skip;
+            niov++;
+        }
+        ssize_t w = writev(c.fd, iov, niov);
+        if (w < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
             return false;
         }
-        while (!c.outq.empty()) {
-            OutMsg& m = c.outq.front();
-            iovec iov[64];
-            int niov = 0;
-            if (!m.meta_done) {
-                iov[niov].iov_base = m.meta.data() + m.off;
-                iov[niov].iov_len = m.meta.size() - m.off;
-                niov++;
-            }
-            for (size_t s = m.seg_idx; s < m.segs.size() && niov < 64;
-                 ++s) {
-                size_t skip = (s == m.seg_idx && m.meta_done) ? m.off : 0;
-                iov[niov].iov_base =
-                    const_cast<uint8_t*>(m.segs[s].first) + skip;
-                iov[niov].iov_len = m.segs[s].second - skip;
-                niov++;
-            }
-            ssize_t w = writev(c.fd, iov, niov);
-            if (w < 0) {
-                if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
-                return false;
-            }
-            s_.bytes_out_ += uint64_t(w);
-            w_.bytes_out.fetch_add(uint64_t(w), std::memory_order_relaxed);
-            size_t left = size_t(w);
-            // Advance cursors.
-            if (!m.meta_done) {
-                size_t take = std::min(left, m.meta.size() - m.off);
-                m.off += take;
-                left -= take;
-                if (m.off == m.meta.size()) {
-                    m.meta_done = true;
-                    m.off = 0;
-                }
-            }
-            while (left > 0 && m.seg_idx < m.segs.size()) {
-                size_t take =
-                    std::min(left, m.segs[m.seg_idx].second - m.off);
-                m.off += take;
-                left -= take;
-                if (m.off == m.segs[m.seg_idx].second) {
-                    m.seg_idx++;
-                    m.off = 0;
-                }
-            }
-            if (m.meta_done && m.seg_idx == m.segs.size()) {
-                c.outq_bytes -= m.total;
-                s_.outq_total_.fetch_sub(m.total,
-                                         std::memory_order_relaxed);
-                c.outq.pop_front();  // drops BlockRefs → unpins
-            } else if (w == 0) {
-                return true;
+        s_.bytes_out_ += uint64_t(w);
+        w_.bytes_out.fetch_add(uint64_t(w), std::memory_order_relaxed);
+        size_t left = size_t(w);
+        // Advance cursors.
+        if (!m.meta_done) {
+            size_t take = std::min(left, m.meta.size() - m.off);
+            m.off += take;
+            left -= take;
+            if (m.off == m.meta.size()) {
+                m.meta_done = true;
+                m.off = 0;
             }
         }
-        return true;
+        while (left > 0 && m.seg_idx < m.segs.size()) {
+            size_t take =
+                std::min(left, m.segs[m.seg_idx].second - m.off);
+            m.off += take;
+            left -= take;
+            if (m.off == m.segs[m.seg_idx].second) {
+                m.seg_idx++;
+                m.off = 0;
+            }
+        }
+        if (m.meta_done && m.seg_idx == m.segs.size()) {
+            c.outq_bytes -= m.total;
+            s_.outq_total_.fetch_sub(m.total,
+                                     std::memory_order_relaxed);
+            c.outq.pop_front();  // drops BlockRefs → unpins
+        } else if (w == 0) {
+            return true;
+        }
     }
-
-    Server& s_;
-    Worker& w_;
-    int ep_ = -1;
-};
+    return true;
+}
 
 bool parse_engine_kind(const std::string& s, EngineKind* out) {
     if (s == "auto" || s.empty()) {
@@ -302,6 +295,8 @@ bool parse_engine_kind(const std::string& s, EngineKind* out) {
         *out = EngineKind::kEpoll;
     } else if (s == "uring") {
         *out = EngineKind::kUring;
+    } else if (s == "fabric") {
+        *out = EngineKind::kFabric;
     } else {
         return false;
     }

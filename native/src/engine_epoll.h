@@ -1,0 +1,49 @@
+// engine_epoll.h — the portable readiness transport engine, exposed as
+// a class so the fabric engine (engine_fabric.cc) can LAYER on it: the
+// fabric data plane is shared-memory commit rings + one-sided pool
+// writes, but its control traffic (HELLO, leases, reads, doorbells)
+// still rides exactly this epoll loop. Everything protocol-visible
+// stays in the base class — the parity suite pins epoll, uring and
+// fabric as byte-identical on the wire.
+//
+// Threading contract is engine.h's: init() on the starting thread,
+// everything else on the owning worker thread only.
+#pragma once
+
+#include "engine.h"
+
+namespace istpu {
+
+class EngineEpoll : public Engine {
+   public:
+    EngineEpoll(Server& srv, Worker& w) : s_(srv), w_(w) {}
+    ~EngineEpoll() override;
+
+    const char* name() const override { return "epoll"; }
+    bool init() override;
+    void shutdown() override;
+    void poll() override;
+    void conn_added(Conn& c) override;
+    void conn_closing(Conn& c) override;
+    void output_ready(Conn& c) override;
+
+   protected:
+    // One epoll_wait + dispatch round; the timeout is a parameter so a
+    // derived engine can shorten the wait while it has deferred work
+    // (a fabric ring whose drain was skipped by a failpoint).
+    void poll_once(int timeout_ms);
+
+    Server& s_;
+    Worker& w_;
+
+   private:
+    // Keep EPOLLOUT armed exactly while the out queue is non-empty.
+    void update(Conn& c);
+    void on_readable(Conn& c);
+    void on_writable(Conn& c);
+    bool flush_out(Conn& c);
+
+    int ep_ = -1;
+};
+
+}  // namespace istpu

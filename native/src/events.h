@@ -74,6 +74,9 @@ namespace istpu {
     X(EV_WATERMARK_LOW, "pool.watermark_low", SEV_INFO)             \
     X(EV_HARD_STALL, "pool.hard_stall", SEV_WARN)                   \
     X(EV_LEASE_REVOKE, "lease.revoke", SEV_DEBUG)                   \
+    X(EV_FABRIC_ATTACH, "fabric.attach", SEV_INFO)                  \
+    X(EV_FABRIC_DOORBELL_STALL, "fabric.doorbell_stall", SEV_WARN)  \
+    X(EV_FABRIC_EPOCH_MISS, "fabric.epoch_miss", SEV_DEBUG)         \
     X(EV_PROMOTE_CANCEL, "promote.cancel", SEV_DEBUG)               \
     X(EV_SPILL_CANCEL, "spill.cancel", SEV_DEBUG)                   \
     X(EV_FAILPOINT_FIRE, "failpoint.fire", SEV_WARN)                \

@@ -1,0 +1,90 @@
+// fabric.h — the one-sided fabric data plane's shared-memory wire.
+//
+// The reference's defining transport idiom is one-sided RDMA WRITE for
+// payload with SEND/RECV only for control (design.rst, PAPER.md): the
+// client lands bytes directly in server memory and the server's CPU
+// never touches them. PR 1 already gave us the payload half on TPU
+// hosts — a leased client memcpys into its carved pool blocks through
+// the POSIX-shm mapping — but the COMMIT still rode a full TCP
+// request/response ("RPC Considered Harmful"'s extra RTT) and its key
+// blob crossed the socket byte by byte.
+//
+// This header defines the missing piece: a per-connection COMMIT RING
+// in shared memory. The client serializes each deferred commit batch
+// as one record into an SPSC byte ring the server worker drains; the
+// only TCP traffic left on the put path is an occasional header-only
+// doorbell (sent just when the consumer advertises it went idle) and
+// the tiny commit response. Server CPU per payload byte on this path
+// is ~0 — the worker replays the deterministic lease carve and
+// publishes index entries, exactly OP_COMMIT_BATCH's logic, without
+// ever reading the payload the client already placed.
+//
+// Layout of the "<shm_prefix>_fab_<conn_id>" object:
+//   [FabricRingHdr, padded to kFabricHdrBytes]
+//   [data region: hdr.data_cap bytes]
+//
+// Record framing inside the data region (byte positions are MONOTONIC
+// cursors; a record never wraps — a producer that would cross the end
+// writes a kFabricWrapMark length and skips to the next region start):
+//   u32 len   length of the record body that follows
+//   body      u64 client_seq (echoed in the TCP response)
+//             u64 lease_id
+//             u32 block_size
+//             u32 nkeys + wire key entries (u32 klen + bytes)*
+//
+// Doorbell protocol (lost-wakeup-free, the eventfd idiom over shm):
+// the consumer drains until empty, then STORES need_kick=1 (seq_cst)
+// and re-checks tail; the producer publishes tail (release), then
+// LOADS need_kick (seq_cst) and, on a successful 1→0 CAS, sends one
+// OP_FABRIC_DOORBELL frame. Either the consumer's re-check sees the
+// record or the producer sees need_kick — never neither. A full ring
+// falls back to a plain TCP OP_COMMIT_BATCH (the server drains the
+// ring before dispatching any TCP op from a fabric connection, so
+// carve-cursor order is preserved across the two channels).
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+
+namespace istpu {
+
+constexpr uint64_t FABRIC_MAGIC = 0x4241465550545349ULL;  // "ISTPUFAB"
+constexpr uint32_t FABRIC_VERSION = 1;
+constexpr size_t kFabricHdrBytes = 4096;        // one page of cursors
+constexpr uint64_t kFabricDataBytes = 1u << 20;  // commit-record region
+// A producer that cannot fit `u32 len` + body before the region end
+// writes this marker (when >= 4 bytes remain) and skips to the next
+// region start; the consumer mirrors the skip.
+constexpr uint32_t kFabricWrapMark = 0xFFFFFFFFu;
+
+#pragma pack(push, 1)
+struct FabricRingHdr {
+    uint64_t magic;
+    uint32_t version;
+    uint32_t pad0;
+    uint64_t data_cap;  // bytes in the data region
+    // SPSC commit ring: monotonic byte cursors (position = cursor %
+    // data_cap). Lock-free std::atomic from both processes —
+    // address-free on the LP64 hosts we target, same contract as the
+    // CtlPage epoch word (common.h).
+    std::atomic<uint64_t> tail;  // producer (client)
+    std::atomic<uint64_t> head;  // consumer (server worker)
+    // Doorbell arming word (protocol above).
+    std::atomic<uint32_t> need_kick;
+    uint32_t pad1;
+};
+#pragma pack(pop)
+static_assert(sizeof(FabricRingHdr) <= kFabricHdrBytes,
+              "ring header must fit its page");
+
+// Contiguous bytes available to read at `pos` before the region end.
+inline uint64_t fabric_run_to_end(uint64_t pos, uint64_t cap) {
+    return cap - (pos % cap);
+}
+
+inline uint8_t* fabric_data(FabricRingHdr* h) {
+    return reinterpret_cast<uint8_t*>(h) + kFabricHdrBytes;
+}
+
+}  // namespace istpu

@@ -211,7 +211,7 @@ const char* const kCatalog[] = {
     "disk.reserve", "disk.pwrite", "disk.pwritev", "disk.pread",
     "pool.alloc",   "worker.reclaim", "worker.spill", "worker.promote",
     "sock.recv",    "sock.send",    "lease.commit",
-    "engine.uring_setup",
+    "engine.uring_setup", "engine.fabric_setup", "fabric.doorbell",
 };
 
 bool in_catalog(const std::string& name) {

@@ -46,6 +46,13 @@
 //   engine.uring_setup  io_uring probe fails at server start: forces
 //                  engine=auto onto the epoll fallback (and a forced
 //                  engine=uring start to fail loudly) on any host
+//   engine.fabric_setup  fabric probe fails at server start: forces
+//                  engine=fabric onto the loud uring/epoll fallback
+//                  on any host (the fallback path stays testable)
+//   fabric.doorbell  one ring-drain round is skipped (a lost/delayed
+//                  doorbell): commits posted to the shm ring must
+//                  still land via the next drain attempt — the
+//                  liveness property the chaos suite pins
 #pragma once
 
 #include <atomic>

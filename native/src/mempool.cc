@@ -20,6 +20,33 @@ namespace istpu {
 // Names follow "istpu_<pid>_<port>[_idx]". Returns true when the embedded
 // pid no longer exists (safe to reclaim). Unknown formats → false (never
 // reclaim what we can't attribute).
+void* shm_create_map(const std::string& name, size_t bytes) {
+    std::string path = "/" + name;
+    int fd = shm_open(path.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    void* mem = MAP_FAILED;
+    if (ftruncate(fd, off_t(bytes)) == 0) {
+        mem = mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+    }
+    if (mem == MAP_FAILED) {
+        // Callers log/report strerror(errno): keep the REAL failure
+        // (ftruncate/mmap) across the cleanup syscalls below.
+        int saved = errno;
+        close(fd);
+        shm_unlink(path.c_str());
+        errno = saved;
+        return nullptr;
+    }
+    close(fd);
+    return mem;
+}
+
+void shm_destroy_map(void* mem, size_t bytes, const std::string& name) {
+    if (mem != nullptr) munmap(mem, bytes);
+    shm_unlink(("/" + name).c_str());
+}
+
 bool shm_owner_dead(const std::string& name) {
     if (name.rfind("istpu_", 0) != 0) return false;
     size_t start = 6;

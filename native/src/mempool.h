@@ -41,9 +41,21 @@ namespace istpu {
 
 // Reclaim /dev/shm pool objects whose owner pid is dead (crashed servers;
 // run at server start). Names embed the owner pid so live pools are never
-// touched.
+// touched. Covers every "istpu_"-prefixed object this process family
+// creates — the pools, the ctl page AND the fabric commit rings
+// ("<prefix>_fab_<conn>", engine_fabric.cc), which all derive their
+// names from the pid-embedding shm_prefix.
 void reclaim_stale_pools();
 bool shm_owner_dead(const std::string& name);
+
+// Create + map a fresh POSIX shm object of `bytes` (O_EXCL — the name
+// must not exist), zero-filled by ftruncate. Returns nullptr on any
+// failure with the object unlinked. The client-mappable-arena idiom the
+// pools use, exported for the fabric engine's per-connection commit
+// rings (fabric.h) and its runtime probe. `name` without leading '/'.
+void* shm_create_map(const std::string& name, size_t bytes);
+// Unmap + unlink an object created by shm_create_map.
+void shm_destroy_map(void* mem, size_t bytes, const std::string& name);
 
 class MemoryPool {
    public:

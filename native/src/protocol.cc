@@ -29,6 +29,9 @@ const char* op_name(uint8_t op) {
         case OP_COMMIT_BATCH: return "COMMIT_BATCH";
         case OP_LEASE_REVOKE: return "LEASE_REVOKE";
         case OP_PREFETCH: return "PREFETCH";
+        case OP_FABRIC_ATTACH: return "FABRIC_ATTACH";
+        case OP_FABRIC_WRITE: return "FABRIC_WRITE";
+        case OP_FABRIC_DOORBELL: return "FABRIC_DOORBELL";
         default: return "UNKNOWN";
     }
 }

@@ -85,8 +85,8 @@ EngineKind resolve_engine_kind(const std::string& configured,
     }
     EngineKind kind = EngineKind::kAuto;
     if (!parse_engine_kind(want, &kind)) {
-        IST_WARN("ignoring unknown engine '%s' (auto|epoll|uring); "
-                 "probing as auto",
+        IST_WARN("ignoring unknown engine '%s' "
+                 "(auto|epoll|uring|fabric); probing as auto",
                  want.c_str());
         kind = EngineKind::kAuto;
     }
@@ -361,7 +361,25 @@ bool Server::start() {
     // start() here — loudly, never mid-op.
     bool force_uring = false;
     EngineKind ekind = resolve_engine_kind(cfg_.engine, &force_uring);
-    if (ekind != EngineKind::kEpoll) {
+    if (ekind == EngineKind::kFabric) {
+        // The fabric plane needs POSIX shm for its commit rings (and
+        // the engine.fabric_setup failpoint forces this probe down for
+        // fallback testing anywhere). Unlike forced uring — where
+        // degrading would silently change syscall behavior mid-fleet —
+        // a host without shm still serves every fabric CONTROL op on
+        // the auto-selected engine, so the documented contract is a
+        // LOUD fallback: one warning plus the engine.fallback event,
+        // and stats report the engine actually selected.
+        std::string why;
+        if (!fabric_runtime_supported(&why)) {
+            events_emit(EV_ENGINE_FALLBACK, /*phase=fabric*/ 2, 0);
+            IST_WARN("engine=fabric unavailable here (%s); falling "
+                     "back to the auto selection",
+                     why.c_str());
+            ekind = EngineKind::kAuto;
+        }
+    }
+    if (ekind == EngineKind::kAuto || ekind == EngineKind::kUring) {
         std::string why;
         if (uring_runtime_supported(&why)) {
             ekind = EngineKind::kUring;
@@ -381,7 +399,10 @@ bool Server::start() {
             ekind = EngineKind::kEpoll;
         }
     }
-    engine_name_ = ekind == EngineKind::kUring ? "uring" : "epoll";
+    engine_name_ = ekind == EngineKind::kUring
+                       ? "uring"
+                       : (ekind == EngineKind::kFabric ? "fabric"
+                                                       : "epoll");
 
     // Tears down the half-built worker set on an engine-init failure so
     // a failed start() leaks no fds (the caller may retry with another
@@ -431,6 +452,8 @@ bool Server::start() {
         for (auto& w : workers_) {
             w->engine = ekind == EngineKind::kUring
                             ? make_engine_uring(*this, *w)
+                        : ekind == EngineKind::kFabric
+                            ? make_engine_fabric(*this, *w)
                             : make_engine_epoll(*this, *w);
             if (!w->engine || !w->engine->init()) {
                 ok = false;
@@ -442,10 +465,11 @@ bool Server::start() {
             if (w->engine) w->engine->shutdown();
             w->engine.reset();
         }
-        if (ekind == EngineKind::kUring && !force_uring) {
+        if ((ekind == EngineKind::kUring && !force_uring) ||
+            ekind == EngineKind::kFabric) {
             events_emit(EV_ENGINE_FALLBACK, /*phase=init*/ 1, 0);
-            IST_WARN("io_uring engine init failed; falling back to "
-                     "epoll");
+            IST_WARN("%s engine init failed; falling back to epoll",
+                     engine_name_.c_str());
             ekind = EngineKind::kEpoll;
             engine_name_ = "epoll";
             continue;  // second pass builds epoll engines
@@ -542,7 +566,10 @@ bool Server::start() {
         wd_thread_ = std::thread([this] { watchdog_loop(); });
     }
     events_emit(EV_ENGINE_SELECTED,
-                ekind == EngineKind::kUring ? 1 : 0, nworkers);
+                ekind == EngineKind::kUring
+                    ? 1
+                    : (ekind == EngineKind::kFabric ? 2 : 0),
+                nworkers);
     events_emit(EV_SERVER_START, bound_port_, nworkers);
     IST_INFO("server listening on %s:%u (pool %llu MB, block %llu KB, "
              "shm=%s, workers=%u, reuseport=%d, engine=%s)",
@@ -825,6 +852,9 @@ std::string Server::stats_json() {
         "\"connections\": %zu, \"workers\": %zu, \"reuseport\": %d, "
         "\"engine\": \"%s\", \"uring_sqes\": %llu, "
         "\"uring_zc_sends\": %llu, \"uring_copies_avoided\": %llu, "
+        "\"fabric_attaches\": %llu, \"fabric_commit_records\": %llu, "
+        "\"fabric_one_sided_puts\": %llu, \"fabric_doorbells\": %llu, "
+        "\"fabric_writes\": %llu, "
         "\"evictions\": %llu, \"spills\": %llu, "
         "\"promotes\": %llu, \"disk_bytes\": %llu, \"disk_used\": %llu, "
         "\"reclaim_runs\": %llu, \"hard_stalls\": %llu, "
@@ -850,6 +880,16 @@ std::string Server::stats_json() {
         size_t(cfg_.workers), reuseport_ ? 1 : 0, engine_name_.c_str(),
         (unsigned long long)eng_sqes, (unsigned long long)eng_zc,
         (unsigned long long)eng_nocopy,
+        (unsigned long long)fabric_attaches_.load(
+            std::memory_order_relaxed),
+        (unsigned long long)fabric_commit_records_.load(
+            std::memory_order_relaxed),
+        (unsigned long long)fabric_one_sided_puts_.load(
+            std::memory_order_relaxed),
+        (unsigned long long)fabric_doorbells_.load(
+            std::memory_order_relaxed),
+        (unsigned long long)fabric_writes_.load(
+            std::memory_order_relaxed),
         (unsigned long long)(index_ ? index_->evictions() : 0),
         (unsigned long long)(index_ ? index_->spills() : 0),
         (unsigned long long)(index_ ? index_->promotes() : 0),
@@ -1146,6 +1186,9 @@ void Server::close_conn(Worker& w, int fd) {
     // exactly like its uncommitted allocations). All of it goes through
     // the internally locked index/pool — safe alongside other workers.
     index_->abort_all_for_owner(it->second->id);
+    // An OP_FABRIC_WRITE dying mid-payload leaves carved-but-
+    // uncommitted destinations: return them like uncommitted allocs.
+    free_fabric_pending(*it->second);
     for (auto& [lease, bytes] : it->second->open_leases) {
         index_->release(lease);
     }
@@ -1359,6 +1402,20 @@ void Server::respond(Conn& c, uint64_t seq, uint8_t op,
 }
 
 void Server::handle_message(Conn& c) {
+    // Fabric connections: drain the shm commit ring BEFORE this TCP op
+    // so ring-posted commits and socket ops apply in the client's
+    // submission order (an OP_LEASE_REVOKE must never overtake the
+    // ring records committing out of that lease — the mirrored carve
+    // cursor depends on it). One branch on a plain bool for everyone
+    // else.
+    if (c.fabric) {
+        // `ordered` except for the doorbell op itself: the doorbell's
+        // whole purpose is to trigger a drain, so it is exactly the
+        // drain the fabric.doorbell failpoint simulates losing.
+        c.w->engine->fabric_drain(
+            c, /*ordered=*/c.hdr.op != OP_FABRIC_DOORBELL);
+        if (c.dead) return;
+    }
     ops_++;
     c.w->ops.fetch_add(1, std::memory_order_relaxed);
     long long t0 = now_us();
@@ -1378,6 +1435,10 @@ void Server::handle_message(Conn& c) {
     Tracer::set_thread_trace_id(c.trace_id);
     if (op == OP_PUT) {
         begin_put(c);
+        return;
+    }
+    if (op == OP_FABRIC_WRITE) {
+        begin_fabric_write(c);
         return;
     }
     // WRITE transitions to payload scatter; everything else handles inline.
@@ -1448,6 +1509,8 @@ void Server::handle_message(Conn& c) {
         case OP_PIN: op_pin(c); break;
         case OP_RELEASE: op_release(c); break;
         case OP_PREFETCH: op_prefetch(c); break;
+        case OP_FABRIC_ATTACH: op_fabric_attach(c); break;
+        case OP_FABRIC_DOORBELL: op_fabric_doorbell(c); break;
         case OP_CHECK_EXIST: op_check_exist(c); break;
         case OP_GET_MATCH_LAST_IDX: op_match(c); break;
         case OP_ABORT: op_abort(c); break;
@@ -1539,6 +1602,9 @@ void Server::begin_put(Conn& c) {
 }
 
 void Server::finish_write(Conn& c) {
+    // OP_FABRIC_WRITE rides the same PAYLOAD scatter machinery but
+    // commits through the lease-carve path, not inflight tokens.
+    if (c.hdr.op == OP_FABRIC_WRITE) return finish_fabric_write(c);
     // Re-arm the thread's trace id: the payload scatter spans epoll
     // wakeups, and other connections' ops on this worker ran (and
     // cleared the TLS id) in between.
@@ -1754,104 +1820,328 @@ void Server::op_commit_batch(Conn& c) {
         respond(c, c.hdr.seq, OP_COMMIT_BATCH, std::move(body));
         return;
     }
-    auto lit = c.block_leases.find(lease_id);
-    if (lit == c.block_leases.end()) {
+    std::vector<PoolLoc> locs;
+    bool overrun = false;
+    if (!carve_batch(c, lease_id, block_size, keys.size(), &locs,
+                     &overrun)) {
         // Unknown, fully-consumed or revoked lease (replay): fail closed
         // — nothing is committed and no pool memory is touched.
         w.u32(CONFLICT);
         respond(c, c.hdr.seq, OP_COMMIT_BATCH, std::move(body));
         return;
     }
+    commit_insert(c, c.hdr.seq, OP_COMMIT_BATCH, keys, locs, block_size,
+                  overrun, /*one_sided=*/false);
+}
+
+bool Server::carve_batch(Conn& c, uint64_t lease_id,
+                         uint32_t block_size, size_t nkeys,
+                         std::vector<PoolLoc>* locs, bool* overrun) {
+    auto lit = c.block_leases.find(lease_id);
+    if (lit == c.block_leases.end()) return false;
     Conn::BlockLease& bl = lit->second;
-    uint32_t committed = 0;
-    std::vector<uint32_t> dedup;
-    bool overrun = false;
-    uint64_t epoch = 0;
-    // Injected commit-replay failure (lease.commit): the carve below
-    // MUST still run — client and server mirror the same deterministic
-    // cursor, and skipping it would shift every later batch's
-    // destinations onto earlier bytes (silent corruption). Instead the
-    // carved blocks are returned to the pool uncommitted: the keys
-    // never become visible, and the client sees INTERNAL_ERROR in its
-    // deferred-commit error latch (ist_lease_take_error) at the next
-    // sync — a VISIBLE loss, never a torn or wrong payload.
+    const size_t bs = mm_->block_size();
+    const uint32_t nb = uint32_t((uint64_t(block_size) + bs - 1) / bs);
+    locs->reserve(nkeys);
+    *overrun = false;
+    for (size_t i = 0; i < nkeys; ++i) {
+        PoolLoc loc;
+        if (!lease_carve(bl, nb, &loc)) {
+            // More keys than the lease can hold: a mirroring client
+            // never does this (it tracks the same cursor), so fail
+            // closed. Destinations already carved this batch stand —
+            // the caller decides whether they still commit.
+            *overrun = true;
+            break;
+        }
+        locs->push_back(loc);
+    }
+    if (bl.blocks_left == 0) c.block_leases.erase(lit);
+    return true;
+}
+
+bool Server::lease_carve(Conn::BlockLease& bl, uint32_t nb,
+                         PoolLoc* out) {
+    const size_t bs = mm_->block_size();
+    // Mirror carve (the client replays this exactly): skip — and free —
+    // run remainders too small for one key, then consume nb blocks
+    // sequentially. The wire/ring never carries offsets: this
+    // deterministic replay is the only way a commit can address pool
+    // memory, so a client can only ever commit into blocks it was
+    // leased.
+    while (bl.run_idx < bl.runs.size() &&
+           bl.runs[bl.run_idx].nblocks - bl.block_off < nb) {
+        uint32_t rem = bl.runs[bl.run_idx].nblocks - bl.block_off;
+        if (rem > 0) {
+            PoolLoc loc;
+            loc.pool_idx = bl.runs[bl.run_idx].pool_idx;
+            loc.offset = bl.runs[bl.run_idx].offset +
+                         uint64_t(bl.block_off) * bs;
+            loc.ptr = mm_->pool(loc.pool_idx).base() + loc.offset;
+            mm_->deallocate(loc, size_t(rem) * bs);
+            bl.blocks_left -= rem;
+            lease_blocks_out_.fetch_sub(rem, std::memory_order_relaxed);
+        }
+        bl.run_idx++;
+        bl.block_off = 0;
+    }
+    if (bl.run_idx >= bl.runs.size()) return false;
+    const Conn::LeaseRun& run = bl.runs[bl.run_idx];
+    out->pool_idx = run.pool_idx;
+    out->offset = run.offset + uint64_t(bl.block_off) * bs;
+    out->ptr = mm_->pool(run.pool_idx).base() + out->offset;
+    bl.block_off += nb;
+    bl.blocks_left -= nb;
+    lease_blocks_out_.fetch_sub(nb, std::memory_order_relaxed);
+    if (bl.block_off == run.nblocks) {
+        bl.run_idx++;
+        bl.block_off = 0;
+    }
+    return true;
+}
+
+void Server::commit_insert(Conn& c, uint64_t seq, uint8_t resp_op,
+                           const std::vector<std::string>& keys,
+                           const std::vector<PoolLoc>& locs,
+                           uint32_t block_size, bool overrun,
+                           bool one_sided) {
+    // Injected commit-replay failure (lease.commit): the carve already
+    // ran — client and server mirror the same deterministic cursor,
+    // and skipping it would shift every later batch's destinations
+    // onto earlier bytes (silent corruption). The carved blocks are
+    // returned to the pool uncommitted: the keys never become visible,
+    // and the client sees INTERNAL_ERROR in its deferred-commit error
+    // latch (ist_lease_take_error) at the next sync — a VISIBLE loss,
+    // never a torn or wrong payload.
     const bool inject_fail = bool(IST_FAILPOINT("lease.commit"));
     const bool trace = tracer_->enabled();  // gates the clock reads too
     long long tcommit = trace ? now_us() : 0;
-    {
-        const size_t bs = mm_->block_size();
-        const uint32_t nb = uint32_t((uint64_t(block_size) + bs - 1) / bs);
-        index_->reserve(keys.size());
-        for (size_t i = 0; i < keys.size(); ++i) {
-            // Mirror carve: skip (and free) run remainders < nb.
-            while (bl.run_idx < bl.runs.size() &&
-                   bl.runs[bl.run_idx].nblocks - bl.block_off < nb) {
-                uint32_t rem = bl.runs[bl.run_idx].nblocks - bl.block_off;
-                if (rem > 0) {
-                    PoolLoc loc;
-                    loc.pool_idx = bl.runs[bl.run_idx].pool_idx;
-                    loc.offset = bl.runs[bl.run_idx].offset +
-                                 uint64_t(bl.block_off) * bs;
-                    loc.ptr = mm_->pool(loc.pool_idx).base() + loc.offset;
-                    mm_->deallocate(loc, size_t(rem) * bs);
-                    bl.blocks_left -= rem;
-                    lease_blocks_out_.fetch_sub(rem,
-                                                std::memory_order_relaxed);
-                }
-                bl.run_idx++;
-                bl.block_off = 0;
-            }
-            if (bl.run_idx >= bl.runs.size()) {
-                // More keys than the lease can hold: a client never does
-                // this (it tracks the same cursor), so fail closed. Keys
-                // already committed this message stay committed — the
-                // client sees the error at its sync barrier.
-                overrun = true;
-                break;
-            }
-            const Conn::LeaseRun& run = bl.runs[bl.run_idx];
-            PoolLoc loc;
-            loc.pool_idx = run.pool_idx;
-            loc.offset = run.offset + uint64_t(bl.block_off) * bs;
-            loc.ptr = mm_->pool(run.pool_idx).base() + loc.offset;
-            bl.block_off += nb;
-            bl.blocks_left -= nb;
-            lease_blocks_out_.fetch_sub(nb, std::memory_order_relaxed);
-            if (bl.block_off == run.nblocks) {
-                bl.run_idx++;
-                bl.block_off = 0;
-            }
-            if (inject_fail) {
-                mm_->deallocate(loc, block_size);
-                continue;
-            }
-            Status st = index_->insert_leased(keys[i], loc, block_size);
-            if (st == OK) {
-                committed++;
-            } else {
-                // First-writer-wins dedup: the existing entry stands, the
-                // client's bytes in its own leased blocks are discarded
-                // and the blocks return to the pool.
-                mm_->deallocate(loc, block_size);
-                dedup.push_back(uint32_t(i));
-            }
+    uint32_t committed = 0;
+    std::vector<uint32_t> dedup;
+    index_->reserve(locs.size());
+    for (size_t i = 0; i < locs.size(); ++i) {
+        if (inject_fail) {
+            mm_->deallocate(locs[i], block_size);
+            continue;
         }
-        epoch = index_->epoch();
-        if (bl.blocks_left == 0) c.block_leases.erase(lit);
+        Status st = index_->insert_leased(keys[i], locs[i], block_size);
+        if (st == OK) {
+            committed++;
+        } else {
+            // First-writer-wins dedup: the existing entry stands, the
+            // client's bytes in its own leased blocks are discarded
+            // and the blocks return to the pool.
+            mm_->deallocate(locs[i], block_size);
+            dedup.push_back(uint32_t(i));
+        }
     }
-    // COMMIT sub-span: the lease-carve + insert_leased loop — where a
-    // deferred leased put's data actually becomes visible.
+    uint64_t epoch = index_->epoch();
+    // COMMIT sub-span: the insert_leased loop — where a deferred
+    // leased put's data actually becomes visible.
     if (trace) {
-        tracer_->record(SPAN_COMMIT, OP_COMMIT_BATCH, uint64_t(tcommit),
+        tracer_->record(SPAN_COMMIT, resp_op, uint64_t(tcommit),
                         uint64_t(now_us() - tcommit),
                         uint16_t(committed > 0xFFFF ? 0xFFFF : committed));
     }
+    // The acceptance counter: keys published whose payload bytes the
+    // server never read — the client placed them one-sided and the
+    // commit record arrived through the shm ring.
+    if (one_sided && committed > 0) {
+        fabric_one_sided_puts_.fetch_add(committed,
+                                         std::memory_order_relaxed);
+    }
+    std::vector<uint8_t> body;
+    BufWriter w(body);
     w.u32(inject_fail ? INTERNAL_ERROR : (overrun ? BAD_REQUEST : OK));
     w.u32(committed);
     w.u64(epoch);
     w.u32(uint32_t(dedup.size()));
     for (uint32_t d : dedup) w.u32(d);
-    respond(c, c.hdr.seq, OP_COMMIT_BATCH, std::move(body));
+    respond(c, seq, resp_op, std::move(body));
+}
+
+bool Server::fabric_ingest_record(Conn& c, const uint8_t* p, size_t n) {
+    // One ring-posted commit record (fabric.h): u64 client_seq,
+    // u64 lease_id, u32 block_size, keys. The record IS a wire op that
+    // happened to arrive through shared memory — it gets the same
+    // accounting, the same carve replay and the same response shape as
+    // OP_COMMIT_BATCH (the response rides the TCP control channel, so
+    // sync()/error-latch semantics on the client are unchanged).
+    BufReader r(p, n);
+    uint64_t seq = r.u64();
+    uint64_t lease_id = r.u64();
+    uint32_t block_size = r.u32();
+    std::vector<std::string> keys;
+    r.keys(&keys);
+    if (!r.ok() || block_size == 0) return false;
+    ops_++;
+    c.w->ops.fetch_add(1, std::memory_order_relaxed);
+    long long t0 = now_us();
+    fabric_commit_records_.fetch_add(1, std::memory_order_relaxed);
+    std::vector<PoolLoc> locs;
+    bool overrun = false;
+    if (!carve_batch(c, lease_id, block_size, keys.size(), &locs,
+                     &overrun)) {
+        std::vector<uint8_t> body;
+        BufWriter w(body);
+        w.u32(CONFLICT);
+        respond(c, seq, OP_COMMIT_BATCH, std::move(body));
+        account_op(OP_COMMIT_BATCH, now_us() - t0);
+        return true;
+    }
+    commit_insert(c, seq, OP_COMMIT_BATCH, keys, locs, block_size,
+                  overrun, /*one_sided=*/true);
+    account_op(OP_COMMIT_BATCH, now_us() - t0);
+    return true;
+}
+
+void Server::op_fabric_attach(Conn& c) {
+    // Negotiate this connection's shm commit ring. Engines without a
+    // fabric plane (epoll/uring), servers without shm pools, and ring
+    // setup failures all answer active=0 — the client then keeps its
+    // TCP commit path silently (the same graceful shape as an SHM
+    // probe failing). Status stays OK so old/fuzzing clients see a
+    // well-formed response either way.
+    // Optional body: u32 want_ring. A cross-host (STREAM) client
+    // negotiates the OP_FABRIC_WRITE protocol with want_ring=0 — no
+    // point carving a shm ring it can never map. Absent body (probe
+    // from minimal clients) means "want one".
+    uint32_t want_ring = 1;
+    if (c.body.size() >= 4) {
+        BufReader r(c.body.data(), c.body.size());
+        want_ring = r.u32();
+    }
+    std::string name;
+    uint64_t bytes = 0;
+    bool was_attached = c.fabric;
+    bool active = want_ring != 0 && cfg_.enable_shm &&
+                  c.w->engine->fabric_attach(c, &name, &bytes);
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    w.u32(OK);
+    w.u32(active ? 1 : 0);
+    w.str(name);
+    w.u64(bytes);
+    if (active) {
+        c.fabric = true;
+        if (!was_attached) {
+            fabric_attaches_.fetch_add(1, std::memory_order_relaxed);
+            events_emit(EV_FABRIC_ATTACH, c.id, bytes);
+        }
+    }
+    respond(c, c.hdr.seq, OP_FABRIC_ATTACH, std::move(body));
+}
+
+void Server::op_fabric_doorbell(Conn& c) {
+    // Header-only kick: the client posted a commit record after this
+    // worker advertised need_kick. The pre-dispatch drain in
+    // handle_message usually consumed the ring already; this drain
+    // catches anything posted since. Responses for the records
+    // themselves were sent by the drain — this reply only closes the
+    // doorbell's own seq.
+    fabric_doorbells_.fetch_add(1, std::memory_order_relaxed);
+    size_t drained =
+        c.fabric ? c.w->engine->fabric_drain(c, /*ordered=*/false) : 0;
+    if (c.dead) return;
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    w.u32(OK);
+    w.u32(uint32_t(drained));
+    respond(c, c.hdr.seq, OP_FABRIC_DOORBELL, std::move(body));
+}
+
+void Server::begin_fabric_write(Conn& c) {
+    // Cross-host emulated one-sided write: {lease_id, block_size,
+    // keys} + payload. The server replays the deterministic carve to
+    // derive the scatter destinations (the frame carries NO offsets —
+    // same forgery-proofing as OP_COMMIT_BATCH), scatters the payload
+    // straight into the carved pool blocks through the shared
+    // payload_iov plan (READ_FIXED under the uring engine — no bounce
+    // copy, no per-byte state-machine wakeup), and commits at payload
+    // end. This is the SEND_ZC-framed {pool_offset, len, payload}
+    // protocol with the offset replaced by the carve replay.
+    BufReader r(c.body.data(), c.body.size());
+    uint64_t lease_id = r.u64();
+    uint32_t block_size = r.u32();
+    std::vector<std::string> keys;
+    r.keys(&keys);
+    c.fab_keys.clear();
+    c.fab_locs.clear();
+    c.wdest.clear();
+    c.wtokens.clear();
+    c.wblock_size = block_size;
+    c.fab_bsize = block_size;
+    bool ok = r.ok() && block_size > 0 &&
+              c.hdr.payload_len == uint64_t(keys.size()) * block_size;
+    uint32_t status = BAD_REQUEST;
+    if (ok) {
+        bool overrun = false;
+        if (!carve_batch(c, lease_id, block_size, keys.size(),
+                         &c.fab_locs, &overrun)) {
+            ok = false;
+            status = CONFLICT;  // unknown/consumed/revoked lease
+        } else if (overrun) {
+            // Overrun: a mirroring client never does this. Blocks
+            // carved for THIS frame return to the pool (nothing was
+            // committed yet) and the whole op fails closed.
+            ok = false;
+            free_fabric_pending(c);
+        } else {
+            for (size_t i = 0; i < keys.size(); ++i) {
+                c.wdest.emplace_back(
+                    static_cast<uint8_t*>(c.fab_locs[i].ptr),
+                    block_size);
+                c.fab_keys.push_back(std::move(keys[i]));
+            }
+        }
+    }
+    if (!ok) {
+        c.wdest.clear();
+        c.payload_left = c.hdr.payload_len;
+        c.state = RState::DRAIN;
+        c.hdr_got = 0;
+        std::vector<uint8_t> body;
+        BufWriter w(body);
+        w.u32(status);
+        respond(c, c.hdr.seq, OP_FABRIC_WRITE, std::move(body));
+        return;
+    }
+    c.payload_left = c.hdr.payload_len;
+    c.wseg = 0;
+    c.wseg_off = 0;
+    c.payload_t0 = tracer_->enabled() ? now_us() : 0;
+    c.state = RState::PAYLOAD;
+    if (c.payload_left == 0) finish_write(c);
+}
+
+void Server::finish_fabric_write(Conn& c) {
+    Tracer::set_thread_trace_id(c.trace_id);
+    const bool trace = tracer_->enabled();
+    if (trace && c.hdr.payload_len > 0 && c.payload_t0 != 0) {
+        tracer_->record(SPAN_COPY, c.hdr.op, uint64_t(c.payload_t0),
+                        uint64_t(now_us() - c.payload_t0));
+    }
+    c.payload_t0 = 0;
+    fabric_writes_.fetch_add(c.fab_keys.size(),
+                             std::memory_order_relaxed);
+    std::vector<std::string> keys = std::move(c.fab_keys);
+    std::vector<PoolLoc> locs = std::move(c.fab_locs);
+    c.fab_keys.clear();
+    c.fab_locs.clear();
+    commit_insert(c, c.hdr.seq, OP_FABRIC_WRITE, keys, locs,
+                  c.fab_bsize, /*overrun=*/false, /*one_sided=*/false);
+    finish_op_stats(c, c.hdr.op);
+    c.state = RState::HDR;
+    c.hdr_got = 0;
+}
+
+void Server::free_fabric_pending(Conn& c) {
+    for (const PoolLoc& loc : c.fab_locs) {
+        mm_->deallocate(loc, c.fab_bsize ? c.fab_bsize
+                                         : mm_->block_size());
+    }
+    c.fab_locs.clear();
+    c.fab_keys.clear();
 }
 
 void Server::op_lease_revoke(Conn& c) {

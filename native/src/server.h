@@ -126,10 +126,16 @@ struct ServerConfig {
     // Transport engine for the worker IO loops (engine.h): "epoll"
     // (readiness loop, portable), "uring" (io_uring completion loop:
     // pool arenas registered as fixed buffers, zero-copy sends,
-    // multishot recv, optional SQPOLL), or "auto" (probe io_uring at
-    // start, fall back to epoll with one log line). The ISTPU_ENGINE
-    // env var overrides; "uring" on an unsupported kernel fails
-    // start() loudly instead of degrading mid-op.
+    // multishot recv, optional SQPOLL), "fabric" (one-sided data
+    // plane: epoll control loop + per-connection shared-memory commit
+    // rings — leased same-host puts never touch the socket, and the
+    // server never touches payload; docs/design.md "One-sided fabric
+    // engine"), or "auto" (probe io_uring at start, fall back to
+    // epoll with one log line). The ISTPU_ENGINE env var overrides;
+    // "uring" on an unsupported kernel fails start() loudly instead
+    // of degrading mid-op, while "fabric" on a host without POSIX shm
+    // falls back to the auto selection LOUDLY (one warning + an
+    // engine.fallback event) — its control plane still serves.
     std::string engine = "auto";
     // Anomaly watchdog (docs/design.md "Flight recorder & watchdog"):
     // a native thread samples the worker/background heartbeats, the
@@ -295,6 +301,20 @@ struct Conn {
         uint64_t blocks_left = 0;  // unconsumed blocks, all runs
     };
     std::unordered_map<uint64_t, BlockLease> block_leases;
+    // One-sided fabric plane (fabric.h; engine=fabric only). `fabric`
+    // flips when OP_FABRIC_ATTACH created this connection's shm
+    // commit ring — handle_message then drains the ring BEFORE
+    // dispatching any TCP op, so ring-posted commits and socket ops
+    // stay in the client's submission order (the carve-cursor mirror
+    // depends on it). The in-flight OP_FABRIC_WRITE keys/destinations
+    // live here between begin_fabric_write's carve and the
+    // payload-complete commit; a connection dying mid-payload returns
+    // fab_locs to the pool (carved-but-uncommitted blocks are cleaned
+    // up exactly like uncommitted allocs).
+    bool fabric = false;
+    std::vector<std::string> fab_keys;
+    std::vector<PoolLoc> fab_locs;
+    uint32_t fab_bsize = 0;
 };
 
 // One worker loop + thread. Connections are owned by exactly one
@@ -420,6 +440,9 @@ class Server {
     // of connection state, always on the owning worker thread.
     friend class EngineEpoll;
     friend class EngineUring;
+    // Friendship does not inherit: the fabric engine (a layered
+    // EngineEpoll) needs its own grant for the ring-drain ingest.
+    friend class EngineFabric;
 
     void loop(Worker& w);
     void adopt_pending(Worker& w);
@@ -431,6 +454,47 @@ class Server {
     void handle_message(Conn& c);  // full header+body (non-WRITE) received
     void finish_write(Conn& c);    // WRITE/PUT payload fully scattered
     void begin_put(Conn& c);       // parse OP_PUT body, build scatter plan
+
+    // --- one-sided fabric plane (docs/design.md "One-sided fabric
+    // engine") -----------------------------------------------------
+    // Carve the next `nb`-block destination out of `bl` with the
+    // deterministic rule both sides mirror (skip-and-free run
+    // remainders too small for one key, consume sequentially).
+    // Returns false when the lease is exhausted (overrun).
+    bool lease_carve(Conn::BlockLease& bl, uint32_t nb, PoolLoc* out);
+    // The whole-batch carve every commit channel replays identically
+    // (TCP OP_COMMIT_BATCH, ring records, OP_FABRIC_WRITE): look up
+    // `lease_id` on `c`, carve one destination per key into *locs
+    // (stopping with *overrun on exhaustion — earlier carves stand),
+    // erase the lease once fully consumed. false = unknown/revoked
+    // lease (the caller answers CONFLICT; nothing was carved).
+    bool carve_batch(Conn& c, uint64_t lease_id, uint32_t block_size,
+                     size_t nkeys, std::vector<PoolLoc>* locs,
+                     bool* overrun);
+    // The commit half shared by OP_COMMIT_BATCH, ring-posted fabric
+    // commit records and OP_FABRIC_WRITE: publish keys[i] at locs[i]
+    // via insert_leased (first-writer-wins dedup frees the loser's
+    // blocks; the lease.commit failpoint fails the whole record
+    // visibly), then respond in the OP_COMMIT_BATCH response shape.
+    // `one_sided` marks commits whose payload the server never
+    // touched (ring records) for the fabric_one_sided_puts counter.
+    void commit_insert(Conn& c, uint64_t seq, uint8_t resp_op,
+                       const std::vector<std::string>& keys,
+                       const std::vector<PoolLoc>& locs,
+                       uint32_t block_size, bool overrun,
+                       bool one_sided);
+    // Parse + apply one ring-posted commit record (fabric.h framing,
+    // minus the u32 length). Called by the fabric engine's drain on
+    // the owning worker; false = malformed record, the caller marks
+    // the connection dead.
+    bool fabric_ingest_record(Conn& c, const uint8_t* p, size_t n);
+    void op_fabric_attach(Conn& c);
+    void op_fabric_doorbell(Conn& c);
+    void begin_fabric_write(Conn& c);   // carve plan for OP_FABRIC_WRITE
+    void finish_fabric_write(Conn& c);  // payload landed: commit + respond
+    // Return carved-but-uncommitted OP_FABRIC_WRITE destinations to
+    // the pool (connection died mid-payload).
+    void free_fabric_pending(Conn& c);
 
     // --- engine-shared RX state machine -------------------------------
     // Build the next read-scatter plan for a PAYLOAD/DRAIN connection:
@@ -546,6 +610,19 @@ class Server {
     std::atomic<uint64_t> leases_oom_{0};
     std::atomic<uint64_t> leases_busy_{0};
     std::atomic<uint64_t> next_block_lease_{1};
+    // One-sided fabric plane counters: rings attached, commit records
+    // drained from shm, keys committed whose PAYLOAD the server never
+    // touched (the acceptance counter — equals the put count on the
+    // same-host fabric path), doorbell frames received — those four
+    // move only under engine=fabric (attach grants no ring elsewhere)
+    // — and keys committed via the cross-host OP_FABRIC_WRITE
+    // emulation, which rides the SHARED protocol state machine and so
+    // counts on any engine.
+    std::atomic<uint64_t> fabric_attaches_{0};
+    std::atomic<uint64_t> fabric_commit_records_{0};
+    std::atomic<uint64_t> fabric_one_sided_puts_{0};
+    std::atomic<uint64_t> fabric_doorbells_{0};
+    std::atomic<uint64_t> fabric_writes_{0};
     LatHist op_lat_[kMaxOp];
 
     // Request tracing (trace.h): always constructed (the wait

@@ -752,3 +752,53 @@ def test_restart_under_lease_and_pin_cache_load(tmp_path):
         for conn in conns:
             conn.close()
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# One-sided fabric plane (ISSUE 12): epoch-miss fallback under churn.
+# ---------------------------------------------------------------------------
+
+
+def test_fabric_epoch_miss_reads_fall_back_zero_loss():
+    """Fabric chaos acceptance: a store-epoch bump (delete/evict/purge
+    all bump the shared ctl word) invalidates every cached one-sided
+    read location at once — the optimistic reads must detect it, fall
+    back to the pinned RPC path with ZERO lost committed keys, and the
+    fallbacks must be visible as fabric.epoch_miss flight-recorder
+    events (the client emits into the same process-global recorder in
+    this same-host test)."""
+    srv = InfiniStoreServer(
+        ServerConfig(service_port=0, prealloc_size=2 / 1024,
+                     minimal_allocate_size=4, engine="fabric")
+    )
+    port = srv.start()
+    if srv.stats().get("engine") != "fabric":
+        srv.stop()
+        pytest.skip("fabric engine unavailable (no POSIX shm)")
+    conn = connect(port, TYPE_SHM, use_lease=True, use_fabric=True)
+    try:
+        keys = [f"em{i}" for i in range(24)]
+        put_keys(conn, keys)
+        assert srv.stats()["fabric_one_sided_puts"] == len(keys)
+        # Seed + prove the one-sided cached path works at this epoch.
+        assert verify_keys(conn, keys) == len(keys)
+        assert verify_keys(conn, keys) == len(keys)
+        hits0 = conn.client_stats()["counters"]["pin_cache_hits"]
+        assert hits0 >= 1
+        mark = srv.events()["recorded"]
+        misses0 = conn.client_stats()["counters"]["pin_cache_misses"]
+        for r in range(4):
+            decoy = f"decoy{r}"
+            conn.put_cache(payload(decoy), [(decoy, 0)], BLOCK)
+            conn.sync()
+            conn.delete_keys([decoy])  # bumps the store epoch
+            # Every cached location is now stale: each read round must
+            # miss, fall back to PIN, and still return exact bytes.
+            assert verify_keys(conn, keys) == len(keys)
+        cs = conn.client_stats()["counters"]
+        assert cs["pin_cache_misses"] > misses0
+        names = [e["name"] for e in srv.events(since_seq=mark)["events"]]
+        assert "fabric.epoch_miss" in names
+    finally:
+        conn.close()
+        srv.stop()

@@ -1,4 +1,4 @@
-"""Transport-engine selection, fallback and parity (ISSUE 8).
+"""Transport-engine selection, fallback and parity (ISSUES 8 and 12).
 
 The worker IO loops ride a pluggable engine (native/src/engine.h):
 epoll (portable readiness loop, the historical behavior) or io_uring
@@ -281,6 +281,251 @@ def test_uring_counters_move(uring_reason):
         assert st["uring_copies_avoided"] > 0
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# One-sided fabric engine (ISSUE 12): selection/fallback everywhere,
+# and — where POSIX shm exists (every current CI container) — the
+# one-sided put path with its acceptance counters, the cross-host
+# OP_FABRIC_WRITE emulation, doorbell-loss liveness, and wire parity.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fabric_reason():
+    """Empty string when engine=fabric actually runs here, else the
+    skip reason. Fabric falls back LOUDLY instead of failing start, so
+    the probe reads the selection from stats."""
+    srv = _mk("fabric")
+    try:
+        srv.start()
+    except Exception as e:
+        return f"fabric engine unavailable on this host ({e})"
+    try:
+        sel = srv.stats().get("engine")
+        return "" if sel == "fabric" else (
+            f"engine=fabric fell back to {sel!r} (no POSIX shm?)")
+    finally:
+        srv.stop()
+
+
+def _fabric_conn(port, ctype=None):
+    from infinistore_tpu import TYPE_SHM
+
+    return InfinityConnection(
+        ClientConfig(host_addr="127.0.0.1", service_port=port,
+                     connection_type=ctype or TYPE_SHM,
+                     use_lease=True, use_fabric=True)
+    )
+
+
+def test_engine_fabric_forced_selects_and_serves(fabric_reason):
+    """engine=fabric reports itself on every worker and still serves
+    plain STREAM clients through its epoll control loop (wire behavior
+    is the base loop's — no fabric negotiation, no fabric counters)."""
+    if fabric_reason:
+        pytest.skip(fabric_reason)
+    srv = _mk("fabric")
+    port = srv.start()
+    try:
+        _roundtrip(port)
+        st = srv.stats()
+        assert st["engine"] == "fabric"
+        for w in st["per_worker"]:
+            assert w["engine"] == "fabric"
+        assert st["fabric_attaches"] == 0
+        assert st["fabric_one_sided_puts"] == 0
+        assert st["uring_sqes"] == 0
+    finally:
+        srv.stop()
+
+
+def test_fabric_setup_failpoint_forces_loud_fallback():
+    """The engine.fabric_setup failpoint fails the probe on ANY host:
+    engine=fabric must fall back to the auto selection (uring/epoll)
+    LOUDLY — an engine.fallback event, a served data plane, and stats
+    reporting the engine actually running. Armed through the fault()
+    API, which raises on an unknown name — so this also pins that the
+    point is in the compiled-in catalog."""
+    helper = _mk("epoll")
+    helper.start()
+    try:
+        assert helper.fault("engine.fabric_setup=every(1)") == 1
+        mark = helper.events()["recorded"]
+        srv = _mk("fabric")
+        port = srv.start()
+        try:
+            assert srv.stats()["engine"] in ("epoll", "uring")
+            names = [e["name"] for e in
+                     srv.events(since_seq=mark)["events"]]
+            assert "engine.fallback" in names
+            _roundtrip(port)
+        finally:
+            srv.stop()
+    finally:
+        helper.fault("off")
+        helper.stop()
+
+
+def test_wire_parity_fabric_vs_epoll(fabric_reason):
+    """The ISSUE-12 parity pin: the SAME scripted conversation produces
+    byte-identical response streams from an epoll server and a fabric
+    server (the fabric engine's control loop IS the epoll loop)."""
+    if fabric_reason:
+        pytest.skip(fabric_reason)
+    blobs = {}
+    for engine in ("epoll", "fabric"):
+        srv = _mk(engine, enable_shm=False)
+        port = srv.start()
+        try:
+            blobs[engine] = _run_script(port)
+        finally:
+            srv.stop()
+    assert blobs["epoll"] == blobs["fabric"]
+
+
+def test_fabric_one_sided_put_counters(fabric_reason):
+    """The acceptance pin: on the same-host fabric path the server does
+    ZERO payload work — fabric_one_sided_puts equals the put count, the
+    commit records arrive through the shm ring (not the socket), and
+    the server's bytes_in stays far below the payload size because the
+    payload bytes never cross the wire at all."""
+    if fabric_reason:
+        pytest.skip(fabric_reason)
+    srv = _mk("fabric")
+    port = srv.start()
+    conn = _fabric_conn(port)
+    try:
+        conn.connect()
+        nkeys, page = 8, 4096
+        payload_bytes = nkeys * page * 4  # float32 pages
+        src = np.random.default_rng(3).standard_normal(
+            nkeys * page).astype(np.float32)
+        conn.put_cache(src, [(f"fab{i}", i * page) for i in range(nkeys)],
+                       page)
+        conn.sync()
+        st = srv.stats()
+        assert st["fabric_attaches"] == 1
+        assert st["fabric_one_sided_puts"] == nkeys
+        assert st["fabric_commit_records"] >= 1
+        # Payload never crossed the socket: only HELLO/ATTACH/LEASE/
+        # doorbell control bytes did.
+        assert st["bytes_in"] < payload_bytes / 4
+        cs = conn.client_stats()["fabric"]
+        assert cs["ring_active"]
+        assert cs["ring_posts"] >= 1
+        dst = np.zeros_like(src)
+        conn.read_cache(dst, [(f"fab{i}", i * page) for i in range(nkeys)],
+                        page)
+        assert np.array_equal(src, dst)
+        # Second read: the commit response seeded the pin cache, so the
+        # repeat is the zero-RTT epoch-validated one-sided copy.
+        dst2 = np.zeros_like(src)
+        conn.read_cache(dst2, [(f"fab{i}", i * page)
+                               for i in range(nkeys)], page)
+        assert np.array_equal(src, dst2)
+        assert conn.client_stats()["counters"]["pin_cache_hits"] >= 1
+    finally:
+        conn.close()
+        srv.stop()
+
+
+def test_fabric_stream_write_any_engine(fabric_reason):
+    """Cross-host emulation: OP_FABRIC_WRITE rides the SHARED protocol
+    state machine, so a STREAM+fabric client gets the one-frame
+    carve-scatter-commit path against ANY new server — here an epoll
+    one (on uring hosts the payload additionally lands via the
+    registered-buffer plan)."""
+    if fabric_reason:
+        pytest.skip(fabric_reason)
+    from infinistore_tpu import TYPE_STREAM
+
+    srv = _mk("epoll")
+    port = srv.start()
+    conn = _fabric_conn(port, TYPE_STREAM)
+    try:
+        conn.connect()
+        nkeys, page = 4, 4096
+        src = np.arange(nkeys * page, dtype=np.float32)
+        conn.put_cache(src, [(f"fs{i}", i * page) for i in range(nkeys)],
+                       page)
+        conn.sync()
+        cs = conn.client_stats()["fabric"]
+        assert cs["stream_active"] and not cs["ring_active"]
+        st = srv.stats()
+        assert st["fabric_writes"] == nkeys
+        assert st["fabric_one_sided_puts"] == 0  # payload rode the wire
+        dst = np.zeros_like(src)
+        conn.read_cache(dst, [(f"fs{i}", i * page) for i in range(nkeys)],
+                        page)
+        assert np.array_equal(src, dst)
+        # Dedup re-put: first-writer-wins, same as every other put path.
+        conn.put_cache(src * 0, [(f"fs{i}", i * page)
+                                 for i in range(nkeys)], page)
+        conn.sync()
+        conn.read_cache(dst, [(f"fs{i}", i * page) for i in range(nkeys)],
+                        page)
+        assert np.array_equal(src, dst)
+    finally:
+        conn.close()
+        srv.stop()
+
+
+def test_fabric_doorbell_failpoint_delays_but_delivers(fabric_reason):
+    """fabric.doorbell chaos: skipped drain rounds (lost/delayed
+    doorbells) must DELAY ring commits, never lose them — the short
+    poll tick and the next TCP op's pre-drain retry until the records
+    land. Liveness, zero lost committed keys."""
+    if fabric_reason:
+        pytest.skip(fabric_reason)
+    srv = _mk("fabric")
+    port = srv.start()
+    conn = _fabric_conn(port)
+    try:
+        conn.connect()
+        assert srv.fault("fabric.doorbell=count(3)") == 1
+        nkeys, page = 6, 4096
+        src = np.arange(nkeys * page, dtype=np.float32)
+        conn.put_cache(src, [(f"db{i}", i * page) for i in range(nkeys)],
+                       page)
+        conn.sync()  # barriers the ring commit despite skipped drains
+        st = srv.stats()
+        assert st["failpoints_fired"] >= 1
+        assert st["fabric_one_sided_puts"] == nkeys
+        dst = np.zeros_like(src)
+        conn.read_cache(dst, [(f"db{i}", i * page) for i in range(nkeys)],
+                        page)
+        assert np.array_equal(src, dst)
+    finally:
+        srv.fault("off")
+        conn.close()
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_parity_suites_under_fabric(fabric_reason):
+    """The ISSUE-12 parity gate: the protocol fuzz, lease and trace
+    round-trip suites re-run with every server in the process forced
+    onto the fabric engine (skip-with-reason on hosts without shm,
+    mirroring the uring pattern)."""
+    if fabric_reason:
+        pytest.skip(fabric_reason)
+    import os
+
+    env = dict(os.environ)
+    env["ISTPU_ENGINE"] = "fabric"
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-x",
+         "tests/test_protocol_fuzz.py", "tests/test_lease.py",
+         "tests/test_trace.py"],
+        env=env, capture_output=True, text=True, timeout=1200,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode == 0, (
+        f"fabric parity suites failed:\n{r.stdout[-4000:]}\n"
+        f"{r.stderr[-2000:]}"
+    )
 
 
 @pytest.mark.slow

@@ -264,6 +264,24 @@ def test_dropped_slo_endpoint_fails_golden(tree):
     assert "'endpoints' drifted" in r.stderr
 
 
+def test_fabric_failpoint_catalog_pin_bites(tree):
+    # ISSUE 12 seeded mutation: renaming the fabric doorbell failpoint
+    # at its call site (engine_fabric.cc) without touching the
+    # failpoint.h catalog must fail BOTH drift directions — the new
+    # name is compiled in but uncataloged (an armable-but-invisible
+    # point), the old catalog row is stale — and the golden's pinned
+    # `failpoints` section drifts too. This is the pin that keeps
+    # chaos specs (`fabric.doorbell=...`) from silently arming
+    # nothing after a refactor.
+    mutate(tree, "native/src/engine_fabric.cc",
+           'IST_FAILPOINT("fabric.doorbell")',
+           'IST_FAILPOINT("fabric.bell")')
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "fabric.bell" in r.stderr  # compiled-in but uncataloged
+    assert "fabric.doorbell" in r.stderr  # stale catalog row
+
+
 def test_make_analyze_exits_zero():
     # With clang installed this is the -Wthread-safety -Werror proof
     # pass; without it the target reports the skip and still exits 0 —
