@@ -117,6 +117,12 @@ def _decls(lib):
             c.c_longlong,
             [c.c_void_p, c.c_char_p, c.c_longlong],
         ),
+        # workload observability plane (ABI v13)
+        (
+            "ist_server_workload",
+            c.c_longlong,
+            [c.c_void_p, c.c_char_p, c.c_longlong],
+        ),
         (
             "ist_server_slo_trip",
             c.c_int,
@@ -285,7 +291,8 @@ def _decls(lib):
         ("ist_mm_total_bytes", c.c_uint64, [c.c_void_p]),
         ("ist_mm_num_pools", c.c_uint64, [c.c_void_p]),
     ]
-    # ABI probe FIRST: a stale prebuilt library would lack the v12
+    # ABI probe FIRST: a stale prebuilt library would lack the v13
+    # workload entry point (ist_server_workload), lack the v12
     # fabric entry points (ist_fabric_put / ist_conn_fabric_telemetry),
     # misparse the v12 ist_conn_create trailing use_fabric flag, lack
     # the v11 observability entry points (ist_server_history /
@@ -307,9 +314,9 @@ def _decls(lib):
         ver = int(lib.ist_abi_version())
     except AttributeError:
         ver = 1
-    if ver < 12:
+    if ver < 13:
         raise RuntimeError(
-            f"stale native library at {_LIB_PATH} (ABI v{ver} < v12): "
+            f"stale native library at {_LIB_PATH} (ABI v{ver} < v13): "
             "rebuild with `make -C native` (or delete the .so to let "
             "the import auto-build)"
         )

@@ -155,6 +155,23 @@ class InfiniStoreServer:
             self._read_blob(self._lib.ist_server_history)
         )
 
+    def workload(self):
+        """Workload observability plane (``GET /workload``): the
+        always-on profiler's demand model — the online miss-ratio
+        curve over hypothetical pool sizes {¼, ½, 1, 2, 4}× (SHARDS
+        spatially-hashed reuse-distance sampling, byte-weighted),
+        the working-set-size estimate, ghost-ring eviction-quality
+        counters (``premature_evictions`` = get-misses on recently
+        evicted keys, ``thrash_cycles`` = spill→promote round trips),
+        the projected dedup ratio over sampled content fingerprints
+        and the hash-prefix heat classes. ``ISTPU_WORKLOAD=0`` (the
+        bench denominator only) disables recording; ``purge()``
+        clears the ghost rings and reuse stacks but never the
+        cumulative counters."""
+        return json.loads(
+            self._read_blob(self._lib.ist_server_workload)
+        )
+
     def slo_trip(self, detail, a0=0, a1=0):
         """Fire the ``slo_burn`` watchdog verdict (the SLO tracker's
         trigger): emits the ``watchdog.slo_burn`` catalog event, counts
@@ -705,7 +722,8 @@ def _prometheus_metrics(stats, slo=None):
     for kind, key in (("stall", "stall_trips"),
                       ("slow_op", "slow_op_trips"),
                       ("queue_growth", "queue_trips"),
-                      ("slo_burn", "slo_trips")):
+                      ("slo_burn", "slo_trips"),
+                      ("thrash", "thrash_trips")):
         lines.append(
             f'infinistore_watchdog_trips_total{{kind="{kind}"}} '
             f'{wd.get(key, 0)}'
@@ -734,6 +752,70 @@ def _prometheus_metrics(stats, slo=None):
     lines.append(
         f'infinistore_events_last_age_us '
         f'{ev.get("last_event_age_us", -1)}'
+    )
+    # Workload observability headline (the full model is GET
+    # /workload): the demand-side gauges ROADMAP item 5's closed-loop
+    # tuning will consume — dashboards plot WSS against pool_bytes and
+    # alert on premature-eviction movement.
+    wl = stats.get("workload", {})
+    lines.append(
+        "# HELP infinistore_workload_enabled workload profiler "
+        "recording (0 only under the ISTPU_WORKLOAD=0 bench "
+        "denominator)"
+    )
+    lines.append("# TYPE infinistore_workload_enabled gauge")
+    lines.append(
+        f'infinistore_workload_enabled {wl.get("enabled", 0)}'
+    )
+    lines.append(
+        "# HELP infinistore_workload_wss_bytes SHARDS working-set "
+        "estimate (live sampled bytes / sample rate)"
+    )
+    lines.append("# TYPE infinistore_workload_wss_bytes gauge")
+    lines.append(
+        f'infinistore_workload_wss_bytes {wl.get("wss_bytes", 0)}'
+    )
+    lines.append(
+        "# HELP infinistore_workload_predicted_miss_1x predicted LRU "
+        "miss ratio at the current pool size (reuse-distance sampler)"
+    )
+    lines.append("# TYPE infinistore_workload_predicted_miss_1x gauge")
+    lines.append(
+        f'infinistore_workload_predicted_miss_1x '
+        f'{wl.get("predicted_miss_1x_milli", 0) / 1000.0}'
+    )
+    lines.append(
+        "# HELP infinistore_workload_premature_evictions_total "
+        "get-misses on recently-evicted keys (the reclaimer dropped "
+        "something the workload still wanted)"
+    )
+    lines.append(
+        "# TYPE infinistore_workload_premature_evictions_total counter"
+    )
+    lines.append(
+        f'infinistore_workload_premature_evictions_total '
+        f'{wl.get("premature_evictions", 0)}'
+    )
+    lines.append(
+        "# HELP infinistore_workload_thrash_cycles_total "
+        "spill-then-promote round trips (two tier IOs for nothing)"
+    )
+    lines.append(
+        "# TYPE infinistore_workload_thrash_cycles_total counter"
+    )
+    lines.append(
+        f'infinistore_workload_thrash_cycles_total '
+        f'{wl.get("thrash_cycles", 0)}'
+    )
+    lines.append(
+        "# HELP infinistore_workload_dedup_ratio projected dedup "
+        "ratio over sampled content fingerprints (1.0 = no "
+        "duplication; the ROADMAP item 3 capacity multiplier)"
+    )
+    lines.append("# TYPE infinistore_workload_dedup_ratio gauge")
+    lines.append(
+        f'infinistore_workload_dedup_ratio '
+        f'{wl.get("dedup_ratio_milli", 1000) / 1000.0}'
     )
     # Metrics-history ring meta (the ring itself is GET /history).
     hist = stats.get("history", {})
@@ -825,6 +907,11 @@ def make_control_plane(server: InfiniStoreServer, snapshot_path=None,
                 # Multi-window burn-rate status over the history ring
                 # (objectives, per-window burn rates, verdict state).
                 self._send(200, slo.status())
+            elif self.path == "/workload":
+                # Workload observability plane: MRC over hypothetical
+                # pool sizes, WSS estimate, eviction-quality counters,
+                # projected dedup ratio, heat classes.
+                self._send(200, server.workload())
             elif self.path == "/trace":
                 # Chrome trace-event JSON, already serialized natively:
                 # save the body to a file and load it in Perfetto

@@ -133,10 +133,16 @@ extern "C" {
 // accepts "fabric", wire ops 21-23 (FABRIC_ATTACH / FABRIC_WRITE /
 // FABRIC_DOORBELL), stats gains the fabric_* counters, new
 // engine.fabric_setup and fabric.doorbell failpoints and the fabric.*
-// event rows).
+// event rows); v13: workload observability plane — new
+// ist_server_workload entry point (GET /workload: online miss-ratio
+// curve, SHARDS working-set estimate, ghost-ring eviction-quality
+// counters, projected dedup ratio, heat classes), stats gains the
+// workload section, history samples carry premature_evictions_delta /
+// thrash_cycles_delta / wss_bytes, new watchdog.thrash catalog event
+// + verdict kind, bundles gain workload.json.
 // _native.py probes this at load so a stale prebuilt library fails
 // loudly instead of feeding unparseable blobs to the server.
-uint32_t ist_abi_version(void) { return 12; }
+uint32_t ist_abi_version(void) { return 13; }
 
 void ist_set_log_level(int level) { set_log_level(level); }
 void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }
@@ -282,6 +288,18 @@ long long ist_server_debug_state(void* h, char* buf, long long cap) {
 long long ist_server_history(void* h, char* buf, long long cap) {
     if (h == nullptr) return -1;
     return copy_blob(static_cast<Server*>(h)->history_json(), buf, cap);
+}
+
+// Workload observability plane (GET /workload; ABI v13): the always-on
+// profiler's demand model — miss-ratio curve over hypothetical pool
+// sizes {1/4, 1/2, 1, 2, 4}x, SHARDS working-set estimate, ghost-ring
+// eviction-quality counters (premature_evictions / thrash_cycles),
+// projected dedup ratio over sampled content fingerprints and
+// hash-prefix heat classes. Same snprintf contract. purge() clears
+// the ghost rings and reuse stacks, never the cumulative counters.
+long long ist_server_workload(void* h, char* buf, long long cap) {
+    if (h == nullptr) return -1;
+    return copy_blob(static_cast<Server*>(h)->workload_json(), buf, cap);
 }
 
 // SLO burn-rate verdict (the Python SLO tracker's trigger): emits the

@@ -83,6 +83,7 @@ namespace istpu {
     X(EV_WATCHDOG_STALL, "watchdog.stall", SEV_ERROR)               \
     X(EV_WATCHDOG_SLOW_OP, "watchdog.slow_op", SEV_ERROR)           \
     X(EV_WATCHDOG_QUEUE_GROWTH, "watchdog.queue_growth", SEV_ERROR) \
+    X(EV_WATCHDOG_THRASH, "watchdog.thrash", SEV_ERROR)             \
     X(EV_SLO_BURN, "watchdog.slo_burn", SEV_ERROR)                  \
     X(EV_BUNDLE_CAPTURED, "watchdog.bundle", SEV_INFO)
 

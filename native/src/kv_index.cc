@@ -158,6 +158,10 @@ Status KVIndex::commit(uint64_t token, uint64_t owner) {
     if (mit != st.map.end() && mit->second.block == s->block) {
         mit->second.committed = true;
         lru_touch(st, mit->second, mit->first);
+        workload_.record_commit(
+            hash_of(mit->first),
+            static_cast<const uint8_t*>(s->block->loc.ptr),
+            wl_round(s->size), mm_, s->size);
         rc = OK;
     }
     ifree(st, s);
@@ -196,10 +200,20 @@ size_t KVIndex::abort_all_for_owner(uint64_t owner) {
 }
 
 bool KVIndex::peek_committed(const std::string& key, uint32_t* size_out) {
-    Stripe& st = stripes_[stripe_of(key)];
+    // Workload recording is split across the two read passes so each
+    // logical reference lands EXACTLY once: op_read/op_pin peek here
+    // for admission (size/backpressure) and answer a MISS from this
+    // pass alone (the acquire below never runs), so the miss records
+    // here; a HIT continues into acquire_*, which records it — a hit
+    // hook here too would double-count every successful read.
+    uint64_t h = hash_of(key);
+    Stripe& st = stripes_[uint32_t(h) & (kStripes - 1)];
     auto lk = lock_stripe(st);
     auto it = st.map.find(key);
-    if (it == st.map.end() || !it->second.committed) return false;
+    if (it == st.map.end() || !it->second.committed) {
+        workload_.record_get_miss(h);
+        return false;
+    }
     // Reads refresh recency (and cancel an in-flight spill — the touch
     // proves the entry hot, so the writer abandons it at completion).
     lru_touch(st, it->second, it->first);
@@ -210,16 +224,26 @@ bool KVIndex::peek_committed(const std::string& key, uint32_t* size_out) {
 Status KVIndex::acquire_block(const std::string& key, bool allow_promote,
                               BlockRef* out, uint32_t* size_out,
                               bool* promoted_out) {
-    uint32_t si = stripe_of(key);
+    uint64_t h = hash_of(key);
+    uint32_t si = uint32_t(h) & (kStripes - 1);
     Stripe& st = stripes_[si];
     auto lk = lock_stripe(st);
     auto it = st.map.find(key);
-    if (it == st.map.end() || !it->second.committed) return KEY_NOT_FOUND;
+    if (it == st.map.end() || !it->second.committed) {
+        workload_.record_get_miss(h);
+        return KEY_NOT_FOUND;
+    }
     Entry& e = it->second;
     const bool nonresident = !e.block;
     if (nonresident && !allow_promote) return BUSY;  // budget spent
     Status rc = ensure_resident(st, si, e, it->first);
     if (rc != OK) return rc;
+    // Hit recorded only on the OK path: a BUSY/OOM answer is retried
+    // by the client, and counting every retry would inflate the
+    // demand model with duplicate zero-distance references for ONE
+    // logical reference — exactly in the spill/thrash scenarios this
+    // plane exists to diagnose.
+    workload_.record_get_hit(h, wl_round(e.size), mm_);
     if (promoted_out) *promoted_out = nonresident;
     *out = e.block;
     if (size_out) *size_out = e.size;
@@ -230,12 +254,17 @@ Status KVIndex::acquire_read(const std::string& key, BlockRef* out,
                              DiskRef* disk_out,
                              std::shared_ptr<std::vector<uint8_t>>* heap_out,
                              uint32_t* size_out) {
-    uint32_t si = stripe_of(key);
+    uint64_t h = hash_of(key);
+    uint32_t si = uint32_t(h) & (kStripes - 1);
     Stripe& st = stripes_[si];
     auto lk = lock_stripe(st);
     auto it = st.map.find(key);
-    if (it == st.map.end() || !it->second.committed) return KEY_NOT_FOUND;
+    if (it == st.map.end() || !it->second.committed) {
+        workload_.record_get_miss(h);
+        return KEY_NOT_FOUND;
+    }
     Entry& e = it->second;
+    workload_.record_get_hit(h, wl_round(e.size), mm_);
     if (size_out) *size_out = e.size;
     if (e.block) {
         lru_touch(st, e, it->first);
@@ -267,11 +296,15 @@ Status KVIndex::acquire_read(const std::string& key, BlockRef* out,
 
 Status KVIndex::acquire_resident(const std::string& key, BlockRef* out,
                                  uint32_t* size_out) {
-    uint32_t si = stripe_of(key);
+    uint64_t h = hash_of(key);
+    uint32_t si = uint32_t(h) & (kStripes - 1);
     Stripe& st = stripes_[si];
     auto lk = lock_stripe(st);
     auto it = st.map.find(key);
-    if (it == st.map.end() || !it->second.committed) return KEY_NOT_FOUND;
+    if (it == st.map.end() || !it->second.committed) {
+        workload_.record_get_miss(h);
+        return KEY_NOT_FOUND;
+    }
     Entry& e = it->second;
     if (!e.block && e.disk != nullptr) {
         // Async-promote-and-retry: a PIN is an explicit "I will read
@@ -309,6 +342,10 @@ Status KVIndex::acquire_resident(const std::string& key, BlockRef* out,
     }
     Status rc = ensure_resident(st, si, e, it->first);
     if (rc != OK) return rc;
+    // OK path only (see acquire_block): a BUSY promote-and-retry
+    // answer records nothing — the retry that finally lands records
+    // the one logical reference.
+    workload_.record_get_hit(h, wl_round(e.size), mm_);
     *out = e.block;
     if (size_out) *size_out = e.size;
     return OK;
@@ -393,6 +430,12 @@ bool KVIndex::finish_promote(PromoteItem& item, BlockRef block) {
         e.promoting = false;
         e.touched = false;
         promotes_.fetch_add(1, std::memory_order_relaxed);
+        // Thrash detection: a promote of a recently-SPILLED key is a
+        // spill->promote round trip that paid two tier IOs for
+        // nothing the reclaimer could not have predicted... except it
+        // could, which is what the workload.thrash_cycles counter
+        // (and the watchdog.thrash verdict over it) exists to say.
+        workload_.record_promote(item.key_hash);
         lru_touch(st, e, mit->first);
         return true;
     }
@@ -505,6 +548,7 @@ Status KVIndex::ensure_resident(Stripe& st, uint32_t stripe_idx, Entry& e,
             return INTERNAL_ERROR;  // no location at all: cannot happen
         }
         promotes_.fetch_add(1, std::memory_order_relaxed);
+        workload_.record_promote(hash_of(key));
         // An inline promotion supersedes any queued async one (its
         // finish finds the entry resident and cancels); the flags
         // restart for the next spill cycle.
@@ -520,7 +564,31 @@ Status KVIndex::ensure_resident(Stripe& st, uint32_t stripe_idx, Entry& e,
 }
 
 bool KVIndex::check_exist(const std::string& key) {
-    return peek_committed(key, nullptr);
+    // A demand signal in its own right: the serving engine's admission
+    // probes land here, and a miss on a recently-evicted key is
+    // exactly the premature eviction the ghost ring exists to name.
+    // Own lookup (not peek_committed): one hash serves the stripe,
+    // the ghost probe and the sampler — and both workload hooks run
+    // AFTER the stripe lock drops.
+    uint64_t h = hash_of(key);
+    Stripe& st = stripes_[uint32_t(h) & (kStripes - 1)];
+    uint32_t sz = 0;
+    bool hit = false;
+    {
+        auto lk = lock_stripe(st);
+        auto it = st.map.find(key);
+        if (it != st.map.end() && it->second.committed) {
+            lru_touch(st, it->second, it->first);
+            sz = it->second.size;
+            hit = true;
+        }
+    }
+    if (!hit) {
+        workload_.record_get_miss(h);
+        return false;
+    }
+    workload_.record_get_hit(h, wl_round(sz), mm_);
+    return true;
 }
 
 int KVIndex::match_last_index(const std::vector<std::string>& keys) const {
@@ -625,7 +693,8 @@ Status KVIndex::insert_committed(const std::string& key, const uint8_t* data,
 
 Status KVIndex::insert_leased(const std::string& key, const PoolLoc& loc,
                               uint32_t size) {
-    Stripe& st = stripes_[stripe_of(key)];
+    uint64_t h = hash_of(key);
+    Stripe& st = stripes_[uint32_t(h) & (kStripes - 1)];
     auto lk = lock_stripe(st);
     auto [mit, inserted] = st.map.try_emplace(key);
     if (!inserted) return CONFLICT;  // first-writer-wins
@@ -635,6 +704,8 @@ Status KVIndex::insert_leased(const std::string& key, const PoolLoc& loc,
     e.committed = true;
     mit->second = std::move(e);
     if (track_lru()) lru_touch(st, mit->second, mit->first);
+    workload_.record_commit(h, static_cast<const uint8_t*>(loc.ptr),
+                            wl_round(size), mm_, size);
     return OK;
 }
 
@@ -663,6 +734,10 @@ size_t KVIndex::purge() {
     // blocks.
     cancel_queued_spills();
     if (promoter_) promoter_->cancel_queued();
+    // Workload profiler: ghost rings + reuse stacks clear (the keys
+    // are gone; cross-purge distances are meaningless), cumulative
+    // demand counters survive — pinned by tests/test_workload.py.
+    workload_.on_purge();
     if (n) bump_epoch();
     return n;
 }
@@ -715,6 +790,10 @@ size_t KVIndex::erase(const std::vector<std::string>& keys) {
         // the old single store lock this ordering came for free —
         // reallocation needed the same lock.)
         if (it->second.committed) bump_epoch();
+        // Explicit delete: clear any ghost/spill-ring slot so a later
+        // miss on this key is the CLIENT's doing, never counted
+        // against the reclaimer's eviction quality.
+        workload_.forget(hash_of(k));
         lru_drop(st, it->second);
         st.map.erase(it);
         n++;
@@ -855,6 +934,9 @@ size_t KVIndex::evict_from_stripe(uint32_t si, bool held, size_t want,
                 // frees the pool blocks at completion. It stays in the
                 // LRU so a failed/cancelled spill remains evictable;
                 // later selection passes skip it via the queue's ref.
+                // (The workload profiler notes the spill at ADOPTION,
+                // finish_spill — a cancelled spill is not a round
+                // trip.)
                 e.spilling = true;
                 enqueue_spill(it->key, e.block, e.size, si);
                 freed += (size_t(e.size) + bs - 1) / bs * bs;
@@ -876,6 +958,7 @@ size_t KVIndex::evict_from_stripe(uint32_t si, bool held, size_t want,
                     e.touched = false;  // second-touch restarts per cycle
                     spilled = true;
                     spills_.fetch_add(1, std::memory_order_relaxed);
+                    workload_.record_spill(hash_of(it->key));
                 } else {
                     // Smallest size the tier refused this pass: a failed
                     // 4-block store must not stop 1-block victims from
@@ -900,6 +983,10 @@ size_t KVIndex::evict_from_stripe(uint32_t si, bool held, size_t want,
         auto fwd = std::next(it).base();
         e.in_lru = false;
         if (!spilled) {
+            // Ghost the victim BEFORE the erase: a later get-miss on
+            // this hash reads as a premature eviction (the reclaimer
+            // dropped something the workload still wanted).
+            workload_.record_evict(hash_of(it->key));
             bump_epoch();  // before map.erase drops the blocks
             st.map.erase(mit);
             evictions_.fetch_add(1, std::memory_order_relaxed);
@@ -1487,6 +1574,7 @@ void KVIndex::finish_spill(SpillItem& item, int64_t off) {
                 e.touched = false;  // second-touch restarts per cycle
                 e.block.reset();  // our item.block still pins the bytes
                 spills_.fetch_add(1, std::memory_order_relaxed);
+                workload_.record_spill(item.key_hash);
                 spill_fail_min_.store(UINT32_MAX,
                                       std::memory_order_relaxed);
             } else if (!span && eviction_ && e.spilling && e.committed &&
@@ -1499,6 +1587,7 @@ void KVIndex::finish_spill(SpillItem& item, int64_t off) {
                 // eviction enabled — spill-only mode never drops
                 // committed data, so there the entry simply stays
                 // resident (and evictable by a future pass).
+                workload_.record_evict(item.key_hash);
                 bump_epoch();  // before the blocks can return to the pool
                 lru_drop(st, e);
                 st.map.erase(mit);

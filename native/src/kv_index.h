@@ -87,6 +87,7 @@
 #include "promote.h"  // Block/BlockRef, DiskSpan/DiskRef, Promoter
 #include "thread_annotations.h"
 #include "trace.h"
+#include "workload.h"
 
 namespace istpu {
 
@@ -413,6 +414,19 @@ class KVIndex {
         return promoter_ ? promoter_->inflight_bytes() : 0;
     }
 
+    // Workload observability plane (workload.h; docs/design.md
+    // "Workload observability"): the always-on profiler fed by the
+    // commit/get/evict paths below. The server's control plane reads
+    // it for /workload, the stats "workload" section, the history
+    // ring's demand deltas and the watchdog.thrash verdict.
+    WorkloadProfiler& workload() { return workload_; }
+    const WorkloadProfiler& workload() const { return workload_; }
+    // Append the /workload JSON body (profiler state against the
+    // CURRENT pool size) as object members.
+    void workload_json(std::string& out) const {
+        workload_.json(out, mm_->total_bytes());
+    }
+
     // Deep-state introspection (GET /debug/state): append per-stripe
     // entry/byte counts, location mix (pool/disk/limbo + transitional
     // SPILLING/PROMOTING flags), inflight-token counts and an LRU-age
@@ -483,8 +497,20 @@ class KVIndex {
         std::atomic<uint64_t> tail_age{UINT64_MAX};
     };
 
+    // One hash per op: the hooked hot paths compute hash_of(key) once
+    // and derive both the stripe (low bits — identical to the
+    // historical stripe_of) and the workload-profiler key from it.
+    static uint64_t hash_of(const std::string& key) {
+        return uint64_t(std::hash<std::string>{}(key));
+    }
     static uint32_t stripe_of(const std::string& key) {
-        return uint32_t(std::hash<std::string>{}(key)) & (kStripes - 1);
+        return uint32_t(hash_of(key)) & (kStripes - 1);
+    }
+    // Block-rounded pool footprint — the byte weight the reuse-
+    // distance sampler stacks (matches what eviction actually frees).
+    uint64_t wl_round(uint32_t size) const {
+        size_t bs = mm_->block_size();
+        return (uint64_t(size) + bs - 1) / bs * bs;
     }
     // Stripe-lock acquisition with contention accounting: an
     // UNCONTENDED acquisition is a plain try_lock (no clock read, no
@@ -722,6 +748,12 @@ class KVIndex {
     // Async promotion worker (promote.{h,cc}); constructed with the
     // disk tier, started by start_background when `promote` is on.
     std::unique_ptr<Promoter> promoter_;
+
+    // Always-on workload profiler (ISTPU_WORKLOAD=0 disables — the
+    // bench denominator only). Locks internally (wl_mu_, a leaf above
+    // the stripe locks); the non-sampled hot path is one mix + a
+    // predicted branch.
+    WorkloadProfiler workload_;
 };
 
 }  // namespace istpu

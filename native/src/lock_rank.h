@@ -60,6 +60,12 @@
 //                                        reserve), any queue leaf
 //                                        (DiskRef release)
 //   340   Tracer::tracks_mu_             (track creation, startup)
+//   350   Server::hist_mu_               (metrics-history ring; inputs
+//                                        gathered before taking it)
+//   360   WorkloadProfiler::wl_mu_       a stripe (the commit/get/evict
+//                                        record hooks run under the
+//                                        entry's stripe mutex); leaf —
+//                                        nothing acquired inside
 //
 // Client-side mutexes (client.h) and the log/failpoint/event-track
 // registry mutexes stay plain std::mutex: they are terminal leaves
@@ -103,6 +109,10 @@ enum LockRank : int {
     kRankHistory = 350,      // Server::hist_mu_ (metrics-history ring;
                              // leaf — the sampler gathers its inputs
                              // BEFORE taking it, drains hold nothing)
+    kRankWorkload = 360,     // WorkloadProfiler::wl_mu_ (leaf ABOVE the
+                             // stripe locks: the record hooks run under
+                             // the entry's stripe mutex, and the
+                             // profiler takes no further lock inside)
 };
 
 #ifdef ISTPU_LOCK_RANK
@@ -128,6 +138,7 @@ inline const char* rank_name(int r) {
         case kRankDiskBitmap: return "disk-bitmap";
         case kRankTraceTracks: return "trace-tracks";
         case kRankHistory: return "server-history";
+        case kRankWorkload: return "workload-profiler";
         default: return "?";
     }
 }

@@ -540,6 +540,10 @@ bool Server::start() {
     wd_stop_.store(false, std::memory_order_relaxed);
     wd_prev_ = WdPrev{};
     wd_queue_streak_ = 0;
+    wd_thrash_streak_ = 0;
+    // Thrash verdict threshold (premature evictions per interval,
+    // from the workload profiler's ghost ring; 0 disables the kind).
+    wd_thrash_ = env_u64("ISTPU_WATCHDOG_THRASH", 64);
     slo_last_trip_us_.store(0, std::memory_order_relaxed);
     // Metrics-history ring: on by default; ISTPU_HISTORY=0 (re-read
     // per start, like ISTPU_EVENTS) exists ONLY as the bench --obs-leg
@@ -1014,7 +1018,8 @@ std::string Server::stats_json() {
         // age the black box without draining it.
         long long last = events_last_us();
         static const char* kKindNames[] = {"stall", "slow_op",
-                                           "queue_growth", "slo_burn"};
+                                           "queue_growth", "slo_burn",
+                                           "thrash"};
         int lk = wd_last_kind_.load(std::memory_order_relaxed);
         long long lt = wd_last_trip_us_.load(std::memory_order_relaxed);
         uint64_t trips = 0;
@@ -1036,7 +1041,7 @@ std::string Server::stats_json() {
             ", \"watchdog\": {\"enabled\": %d, \"stalled\": %d, "
             "\"trips\": %llu, \"stall_trips\": %llu, "
             "\"slow_op_trips\": %llu, \"queue_trips\": %llu, "
-            "\"slo_trips\": %llu, "
+            "\"slo_trips\": %llu, \"thrash_trips\": %llu, "
             "\"bundles\": %llu, \"last_trigger\": \"%s\", "
             "\"last_trip_age_us\": %lld}",
             (unsigned long long)events_recorded_total(),
@@ -1056,11 +1061,50 @@ std::string Server::stats_json() {
                 std::memory_order_relaxed),
             (unsigned long long)wd_trips_[kWdSlo].load(
                 std::memory_order_relaxed),
+            (unsigned long long)wd_trips_[kWdThrash].load(
+                std::memory_order_relaxed),
             (unsigned long long)wd_bundles_.load(
                 std::memory_order_relaxed),
             (lk >= 0 && lk < kWdKinds) ? kKindNames[lk] : "",
             lt > 0 ? now_us() - lt : -1);
         out += entry;
+    }
+    if (index_ != nullptr) {
+        // Workload headline (GET /workload has the full model): the
+        // demand facts a dashboard wants next to the system gauges —
+        // working-set estimate, predicted miss at the current pool,
+        // eviction quality and the projected dedup multiplier.
+        const WorkloadProfiler& wl = index_->workload();
+        char entry[512];
+        snprintf(entry, sizeof(entry),
+                 ", \"workload\": {\"enabled\": %d, "
+                 "\"wss_bytes\": %llu, "
+                 "\"predicted_miss_1x_milli\": %llu, "
+                 "\"premature_evictions\": %llu, "
+                 "\"thrash_cycles\": %llu, "
+                 "\"dedup_ratio_milli\": %llu, "
+                 "\"accesses\": %llu, \"misses\": %llu}",
+                 wl.enabled() ? 1 : 0,
+                 (unsigned long long)wl.wss_bytes(),
+                 (unsigned long long)wl.predicted_miss_milli(),
+                 (unsigned long long)wl.premature_evictions(),
+                 (unsigned long long)wl.thrash_cycles(),
+                 (unsigned long long)wl.dedup_ratio_milli(),
+                 (unsigned long long)wl.accesses(),
+                 (unsigned long long)wl.misses());
+        out += entry;
+    }
+    out += "}";
+    return out;
+}
+
+std::string Server::workload_json() {
+    ScopedLock lk(store_mu_);
+    std::string out = "{";
+    if (index_ != nullptr) {
+        index_->workload_json(out);
+    } else {
+        out += "\"enabled\": 0";
     }
     out += "}";
     return out;
@@ -2730,6 +2774,16 @@ void Server::history_sample() {
         uint64_t sp = index_ ? index_->spills() : 0;
         uint64_t pr = index_ ? (index_->promotes() +
                                 index_->promotes_async()) : 0;
+        // Workload demand (ISSUE 13): eviction-quality counters +
+        // working-set gauge, so a bundle's history shows the demand
+        // lead-up, not just the system's reaction.
+        uint64_t prem = 0, thr = 0;
+        if (index_ != nullptr) {
+            const WorkloadProfiler& wl = index_->workload();
+            prem = wl.premature_evictions();
+            thr = wl.thrash_cycles();
+            s.wss_bytes = wl.wss_bytes();
+        }
         uint64_t lat[LatHist::kBuckets] = {};
         uint64_t opc[kMaxOp] = {};
         for (int op = 1; op < kMaxOp; ++op) {
@@ -2749,6 +2803,8 @@ void Server::history_sample() {
             s.spills_delta = sp - hist_prev_.spills;
             s.promotes_delta = pr - hist_prev_.promotes;
             s.uring_sqes_delta = sqes - hist_prev_.uring_sqes;
+            s.premature_evictions_delta = prem - hist_prev_.premature;
+            s.thrash_cycles_delta = thr - hist_prev_.thrash;
             for (int b = 0; b < kNumBuckets; ++b) {
                 s.lat_delta[b] = lat[b] - hist_prev_.lat[b];
             }
@@ -2766,6 +2822,8 @@ void Server::history_sample() {
         hist_prev_.spills = sp;
         hist_prev_.promotes = pr;
         hist_prev_.uring_sqes = sqes;
+        hist_prev_.premature = prem;
+        hist_prev_.thrash = thr;
         memcpy(hist_prev_.lat, lat, sizeof(lat));
         memcpy(hist_prev_.op_count, opc, sizeof(opc));
         hist_prev_.valid = true;
@@ -2819,7 +2877,10 @@ std::string Server::history_json() {
             "\"disk_io_errors_delta\": %llu, "
             "\"hard_stalls_delta\": %llu, \"evictions_delta\": %llu, "
             "\"spills_delta\": %llu, \"promotes_delta\": %llu, "
-            "\"uring_sqes_delta\": %llu, \"workers_dead\": %u, "
+            "\"uring_sqes_delta\": %llu, "
+            "\"premature_evictions_delta\": %llu, "
+            "\"thrash_cycles_delta\": %llu, \"wss_bytes\": %llu, "
+            "\"workers_dead\": %u, "
             "\"tier_breaker_open\": %u, \"stalled\": %u, "
             "\"lat_delta\": [",
             i ? ", " : "", s.t_us, (unsigned long long)s.used_bytes,
@@ -2836,7 +2897,10 @@ std::string Server::history_json() {
             (unsigned long long)s.evictions_delta,
             (unsigned long long)s.spills_delta,
             (unsigned long long)s.promotes_delta,
-            (unsigned long long)s.uring_sqes_delta, s.workers_dead,
+            (unsigned long long)s.uring_sqes_delta,
+            (unsigned long long)s.premature_evictions_delta,
+            (unsigned long long)s.thrash_cycles_delta,
+            (unsigned long long)s.wss_bytes, s.workers_dead,
             unsigned(s.breaker), unsigned(s.stalled));
         out.append(buf, size_t(m));
         for (int b = 0; b < LatHist::kBuckets; ++b) {
@@ -2893,6 +2957,7 @@ void Server::watchdog_sample() {
     bool stalled = false;
     uint64_t dead = 0;
     uint64_t spill_q = 0, promote_q = 0, spills = 0, promotes = 0;
+    uint64_t premature = 0;
     {
         ScopedLock lk(store_mu_);  // pins workers_/index_ against stop()
         for (const auto& w : workers_) {
@@ -2911,6 +2976,7 @@ void Server::watchdog_sample() {
             promote_q = index_->promote_queue_depth();
             spills = index_->spills() + index_->evictions();
             promotes = index_->promotes_async() + index_->promotes();
+            premature = index_->workload().premature_evictions();
             // The spill/promote loops stamp their heartbeat only when
             // WOKEN (their cv waits are untimed), so an idle worker's
             // age grows without bound — a stale heartbeat is a stall
@@ -3005,6 +3071,23 @@ void Server::watchdog_sample() {
     wd_queue_streak_ = queue_suspect ? wd_queue_streak_ + 1 : 0;
     bool queue_growth = wd_queue_streak_ >= kQueueStreak;
 
+    // ---- thrash: SUSTAINED premature-eviction rate. The workload
+    // profiler's ghost ring counts get-misses on recently-evicted
+    // keys; a rate over ISTPU_WATCHDOG_THRASH per interval for two
+    // consecutive samples means the reclaimer is evicting keys the
+    // workload re-fetches — the pool is undersized (or the eviction
+    // order is fighting the access pattern), and the bundle's
+    // workload.json carries the MRC that says WHICH.
+    constexpr int kThrashStreak = 2;
+    uint64_t prem_delta =
+        wd_prev_.valid && premature > wd_prev_.premature
+            ? premature - wd_prev_.premature
+            : 0;
+    bool thrash_suspect =
+        wd_thrash_ > 0 && wd_prev_.valid && prem_delta >= wd_thrash_;
+    wd_thrash_streak_ = thrash_suspect ? wd_thrash_streak_ + 1 : 0;
+    bool thrash_trip = wd_thrash_streak_ >= kThrashStreak;
+
     wd_prev_.valid = true;
     wd_prev_.op_count = cur_count;
     memcpy(wd_prev_.op_buckets, cur, sizeof(cur));
@@ -3013,6 +3096,7 @@ void Server::watchdog_sample() {
     wd_prev_.spills = spills;
     wd_prev_.promotes = promotes;
     wd_prev_.workers_dead = dead;
+    wd_prev_.premature = premature;
 
     // Per-kind cooldown gates BOTH the event and the bundle: a
     // persistent stall must not burn a bundle per interval. The
@@ -3054,6 +3138,19 @@ void Server::watchdog_sample() {
                      " held without drain progress");
         }
     }
+    if (thrash_trip) {
+        wd_thrash_streak_ = 0;  // re-arm after the trigger
+        if (cooled(kWdThrash)) {
+            events_emit(EV_WATCHDOG_THRASH, prem_delta, premature);
+            fire(kWdThrash, "thrash",
+                 std::to_string(prem_delta) +
+                     " premature evictions this interval (threshold " +
+                     std::to_string(wd_thrash_) + ", total " +
+                     std::to_string(premature) +
+                     "): the reclaimer is evicting keys the workload "
+                     "re-fetches");
+        }
+    }
 }
 
 void Server::capture_bundle(const char* kind, const std::string& detail) {
@@ -3079,13 +3176,18 @@ void Server::capture_bundle(const char* kind, const std::string& detail) {
     // The metrics-history ring: the bundle now shows the minutes of
     // LEAD-UP to the trigger, not just the captured instant.
     ok &= write_text_file(dir + "/history.json", history_json());
+    // The workload demand model at capture time (ISSUE 13): the MRC /
+    // WSS / eviction-quality / dedup facts that say whether the
+    // anomaly was the STORE misbehaving or the DEMAND shifting.
+    ok &= write_text_file(dir + "/workload.json", workload_json());
     char manifest[512];
     snprintf(manifest, sizeof(manifest),
              "{\"trigger\": \"%s\", \"detail\": \"%s\", "
              "\"captured_at_us\": %lld, \"capture_us\": %lld, "
              "\"seq\": %llu, \"files\": [\"stats.json\", "
              "\"events.json\", \"trace.json\", "
-             "\"debug_state.json\", \"history.json\"]}",
+             "\"debug_state.json\", \"history.json\", "
+             "\"workload.json\"]}",
              kind, json_escape(detail).c_str(), t0, now_us() - t0,
              (unsigned long long)wd_bundle_seq_);
     ok &= write_text_file(dir + "/manifest.json", manifest);

@@ -406,6 +406,17 @@ class Server {
     // bench --obs-leg denominator only. purge() never clears the ring.
     std::string history_json();
 
+    // Workload observability plane (GET /workload; docs/design.md
+    // "Workload observability"): the always-on profiler's demand
+    // model — online miss-ratio curve over hypothetical pool sizes,
+    // SHARDS working-set estimate, ghost-ring eviction-quality
+    // counters (premature_evictions / thrash_cycles), projected dedup
+    // ratio and hash-prefix heat classes. ISTPU_WORKLOAD=0 (read at
+    // server start) disables recording — the bench --workload-leg
+    // denominator only. purge() clears the ghost rings and reuse
+    // stacks but never the cumulative counters.
+    std::string workload_json();
+
     // SLO burn-rate verdict hook (the control plane's SLO tracker
     // calls this when the multi-window burn rate crosses its
     // threshold): emits the watchdog.slo_burn catalog event, counts a
@@ -662,9 +673,19 @@ class Server {
     // Verdict state the control plane reads (stats_json, /health).
     // kWdSlo is tripped from the CONTROL PLANE (slo_trip) — the SLO
     // tracker computes burn rates in Python over the history ring and
-    // calls down; the other three come from the native sampler.
-    enum WdKind { kWdStall = 0, kWdSlowOp = 1, kWdQueue = 2, kWdSlo = 3 };
-    static constexpr int kWdKinds = 4;
+    // calls down; the others come from the native sampler. kWdThrash
+    // (ISSUE 13) fires on a SUSTAINED premature-eviction rate — the
+    // workload profiler's ghost ring says the reclaimer is evicting
+    // keys the workload re-fetches (threshold ISTPU_WATCHDOG_THRASH
+    // premature evictions per interval, two consecutive samples).
+    enum WdKind {
+        kWdStall = 0,
+        kWdSlowOp = 1,
+        kWdQueue = 2,
+        kWdSlo = 3,
+        kWdThrash = 4,
+    };
+    static constexpr int kWdKinds = 5;
     std::atomic<uint64_t> wd_trips_[kWdKinds] = {};
     std::atomic<int> wd_last_kind_{-1};
     std::atomic<long long> wd_last_trip_us_{0};
@@ -678,13 +699,19 @@ class Server {
         uint64_t spill_q = 0, promote_q = 0;
         uint64_t spills = 0, promotes = 0;
         uint64_t workers_dead = 0;
+        uint64_t premature = 0;  // workload ghost-ring counter
         bool valid = false;
     } wd_prev_;
     int wd_queue_streak_ = 0;
+    int wd_thrash_streak_ = 0;
+    // Thrash verdict threshold: premature evictions per watchdog
+    // interval (ISTPU_WATCHDOG_THRASH override, 0 disables).
+    uint64_t wd_thrash_ = 64;
     uint64_t wd_bundle_seq_ GUARDED_BY(bundle_mu_) = 0;
-    // Per-kind cooldown stamps. Kinds 0-2 are watchdog-thread-only;
-    // kWdSlo is atomic-CAS'd by slo_trip (control-plane callers).
-    long long wd_last_per_kind_[3] = {};
+    // Per-kind cooldown stamps, indexed by WdKind. Kinds 0-2 and
+    // kWdThrash are watchdog-thread-only; kWdSlo is atomic-CAS'd by
+    // slo_trip (control-plane callers) and never uses its slot here.
+    long long wd_last_per_kind_[kWdKinds] = {};
     std::atomic<long long> slo_last_trip_us_{0};
 
     // --- metrics-history ring (GET /history). Sampled on the watchdog
@@ -701,6 +728,13 @@ class Server {
         uint64_t hard_stalls_delta = 0, evictions_delta = 0;
         uint64_t spills_delta = 0, promotes_delta = 0;
         uint64_t uring_sqes_delta = 0;
+        // Workload-demand lead-up (ISSUE 13): eviction-quality deltas
+        // + the working-set gauge, so a bundle's history shows the
+        // DEMAND shift that preceded an anomaly, not just the
+        // system's reaction to it.
+        uint64_t premature_evictions_delta = 0;
+        uint64_t thrash_cycles_delta = 0;
+        uint64_t wss_bytes = 0;
         uint32_t workers_dead = 0;
         uint8_t breaker = 0, stalled = 0;
         // Aggregate per-op latency-histogram delta (all ops summed;
@@ -720,6 +754,7 @@ class Server {
         uint64_t reads_busy = 0, disk_io_errors = 0, hard_stalls = 0;
         uint64_t evictions = 0, spills = 0, promotes = 0;
         uint64_t uring_sqes = 0;
+        uint64_t premature = 0, thrash = 0;
         uint64_t lat[LatHist::kBuckets] = {};
         uint64_t op_count[kMaxOp] = {};
         bool valid = false;

@@ -1,0 +1,456 @@
+#include "workload.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <utility>
+
+#include "mempool.h"
+
+namespace istpu {
+
+// Out-of-line definition for ODR-use of the in-class constexpr array
+// (pre-C++17 linkers; harmless under C++17's implicit inline).
+constexpr double WorkloadProfiler::kScales[WorkloadProfiler::kSizes];
+
+WorkloadProfiler::WorkloadProfiler() {
+    // ISTPU_WORKLOAD=0 is the bench --workload-leg denominator ONLY:
+    // like ISTPU_EVENTS/ISTPU_HISTORY, always-on is the product
+    // contract. Read at KVIndex construction (= server start).
+    if (const char* env = getenv("ISTPU_WORKLOAD")) {
+        if (env[0] == '0') enabled_ = false;
+    }
+    if (const char* env = getenv("ISTPU_WORKLOAD_RATE")) {
+        double r = atof(env);
+        if (r > 0.0 && r <= 1.0) rate_ = r;
+    }
+    inv_rate_ = 1.0 / rate_;
+    // Threshold on the FULL mixed hash; rate 1.0 must admit every key
+    // (the exact-mode escape hatch tests use).
+    sample_thresh_ =
+        rate_ >= 1.0 ? UINT64_MAX
+                     : uint64_t(rate_ * 18446744073709551615.0);
+    fen_.assign(kTimeCap + 1, 0);
+}
+
+// --- Fenwick tree over last-access stamps (byte-weighted) -------------
+
+void WorkloadProfiler::fen_add(uint32_t i, int64_t v) {
+    for (; i <= kTimeCap; i += i & (~i + 1)) {
+        fen_[i] = uint64_t(int64_t(fen_[i]) + v);
+    }
+}
+
+uint64_t WorkloadProfiler::fen_sum(uint32_t i) const {
+    uint64_t s = 0;
+    for (; i > 0; i -= i & (~i + 1)) s += fen_[i];
+    return s;
+}
+
+void WorkloadProfiler::evict_oldest_sample() {
+    // Stamps only grow, so the oldest live one is at (or past) the
+    // cursor; the walk is amortized O(1) per eviction.
+    while (min_time_ < next_time_ && times_.find(min_time_) == times_.end()) {
+        min_time_++;
+    }
+    auto it = times_.find(min_time_);
+    if (it == times_.end()) return;
+    fen_add(min_time_, -int64_t(it->second.bytes));
+    sampled_live_bytes_.fetch_sub(it->second.bytes,
+                                  std::memory_order_relaxed);
+    last_.erase(it->second.mixed);
+    times_.erase(it);
+}
+
+void WorkloadProfiler::rebuild_times() {
+    // The stamp axis filled: renumber the live samples compactly in
+    // age order. Rare (every kTimeCap sampled accesses) and O(n log n)
+    // over <= kMaxSampled live entries.
+    std::vector<std::pair<uint32_t, Stamp>> live(times_.begin(),
+                                                 times_.end());
+    std::sort(live.begin(), live.end(),
+              [](const auto& a, const auto& b) { return a.first < b.first; });
+    std::fill(fen_.begin(), fen_.end(), 0);
+    times_.clear();
+    uint32_t t = 1;
+    for (auto& [old_t, st] : live) {
+        (void)old_t;
+        fen_add(t, int64_t(st.bytes));
+        last_[st.mixed] = t;
+        times_.emplace(t, st);
+        t++;
+    }
+    next_time_ = t;
+    min_time_ = 1;
+    rebuilds_++;
+}
+
+void WorkloadProfiler::sampler_access(uint64_t mixed, uint64_t rounded,
+                                      const MM* mm) {
+    // The per-arena pool-size walk is paid HERE, on the sampled
+    // branch only — the ~(1-R) non-sampled accesses never reach it.
+    uint64_t pool_bytes = mm->total_bytes();
+    ScopedLock lk(wl_mu_);
+    sampled_accesses_.fetch_add(1, std::memory_order_relaxed);
+    auto it = last_.find(mixed);
+    if (it != last_.end()) {
+        uint32_t t = it->second;
+        // Bytes of sampled keys touched strictly more recently than
+        // this key's previous access, scaled back to the full stream.
+        uint64_t live = sampled_live_bytes_.load(std::memory_order_relaxed);
+        uint64_t upto = fen_sum(t);  // includes the key itself
+        uint64_t dist = live > upto ? live - upto : 0;
+        uint64_t scaled = uint64_t(double(dist) * inv_rate_);
+        // LRU stack position from the top = more-recent bytes + own
+        // footprint; a hit at capacity C iff that fits.
+        for (int s = 0; s < kSizes; ++s) {
+            uint64_t cap = uint64_t(double(pool_bytes) * kScales[s]);
+            if (scaled + rounded <= cap) {
+                mrc_hits_[s].fetch_add(1, std::memory_order_relaxed);
+            }
+        }
+        int b = 0;
+        uint64_t d = scaled;
+        while (d > 1 && b < kDistBuckets - 1) {
+            d >>= 1;
+            b++;
+        }
+        dist_hist_[b].fetch_add(1, std::memory_order_relaxed);
+        // Move the stamp: drop the old position, adjust for a size
+        // change (re-put under a different size).
+        Stamp& st = times_[t];
+        fen_add(t, -int64_t(st.bytes));
+        if (st.bytes != rounded) {
+            if (rounded > st.bytes) {
+                sampled_live_bytes_.fetch_add(rounded - st.bytes,
+                                              std::memory_order_relaxed);
+            } else {
+                sampled_live_bytes_.fetch_sub(st.bytes - rounded,
+                                              std::memory_order_relaxed);
+            }
+        }
+        times_.erase(t);
+    } else {
+        // First touch of a sampled key: a cold (compulsory) miss at
+        // every hypothetical size.
+        sampled_cold_.fetch_add(1, std::memory_order_relaxed);
+        sampled_live_bytes_.fetch_add(rounded, std::memory_order_relaxed);
+        if (last_.size() >= kMaxSampled) evict_oldest_sample();
+    }
+    if (next_time_ >= kTimeCap) rebuild_times();
+    uint32_t nt = next_time_++;
+    fen_add(nt, int64_t(rounded));
+    last_[mixed] = nt;
+    times_.emplace(nt, Stamp{mixed, rounded});
+}
+
+// --- lock-free rings --------------------------------------------------
+
+void WorkloadProfiler::ring_insert(std::atomic<uint64_t>* ring,
+                                   uint64_t m) {
+    if (m == 0) m = 1;  // 0 is the empty marker
+    ring[m & (kGhostCap - 1)].store(m, std::memory_order_relaxed);
+}
+
+bool WorkloadProfiler::ring_take(std::atomic<uint64_t>* ring, uint64_t m) {
+    if (m == 0) m = 1;
+    std::atomic<uint64_t>& slot = ring[m & (kGhostCap - 1)];
+    uint64_t cur = slot.load(std::memory_order_relaxed);
+    if (cur != m) return false;
+    // Exchange so one miss consumes the ghost exactly once even when
+    // two workers miss the same key concurrently.
+    return slot.exchange(0, std::memory_order_relaxed) == m;
+}
+
+void WorkloadProfiler::ring_clear(std::atomic<uint64_t>* ring) {
+    for (size_t i = 0; i < kGhostCap; ++i) {
+        ring[i].store(0, std::memory_order_relaxed);
+    }
+}
+
+// --- heat classes -----------------------------------------------------
+
+void WorkloadProfiler::heat_touch(uint64_t mixed) {
+    heat_[mixed >> 60].fetch_add(1, std::memory_order_relaxed);
+    // Periodic halving keeps the buckets a decayed RATE, not an
+    // all-time total. Edge-triggered off the touch counter's OWN
+    // fetch_add return value: exactly one decay per kHeatDecayEvery
+    // touches (reads and commits alike), and an idle store simply
+    // stops decaying.
+    uint64_t n = heat_touches_.fetch_add(1, std::memory_order_relaxed) + 1;
+    if ((n & (kHeatDecayEvery - 1)) == 0) {
+        for (int i = 0; i < kHeatBuckets; ++i) {
+            heat_[i].store(heat_[i].load(std::memory_order_relaxed) / 2,
+                           std::memory_order_relaxed);
+        }
+        heat_decays_.fetch_add(1, std::memory_order_relaxed);
+    }
+}
+
+// --- record hooks -----------------------------------------------------
+
+void WorkloadProfiler::record_get_hit(uint64_t key_hash, uint64_t rounded,
+                                      const MM* mm) {
+    if (!enabled_) return;
+    uint64_t m = mix64(key_hash);
+    accesses_.fetch_add(1, std::memory_order_relaxed);
+    heat_touch(m);
+    if (m <= sample_thresh_) sampler_access(m, rounded, mm);
+}
+
+void WorkloadProfiler::record_get_miss(uint64_t key_hash) {
+    if (!enabled_) return;
+    uint64_t m = mix64(key_hash);
+    accesses_.fetch_add(1, std::memory_order_relaxed);
+    misses_.fetch_add(1, std::memory_order_relaxed);
+    if (ring_take(ghost_, m)) {
+        premature_.fetch_add(1, std::memory_order_relaxed);
+    }
+}
+
+void WorkloadProfiler::record_commit(uint64_t key_hash, const uint8_t* data,
+                                     uint64_t rounded, const MM* mm,
+                                     uint32_t size) {
+    if (!enabled_) return;
+    uint64_t m = mix64(key_hash);
+    commits_.fetch_add(1, std::memory_order_relaxed);
+    heat_touch(m);
+    // An insertion is an access: the key (re)enters the LRU stack top.
+    if (m <= sample_thresh_) sampler_access(m, rounded, mm);
+    // Dedup fingerprint: FNV-1a over size + first/last 64 payload
+    // bytes — content-deterministic (all copies of one block admit or
+    // skip together) and bounded (<= 128 bytes hashed per commit).
+    if (data != nullptr) {
+        uint64_t fp = 0xCBF29CE484222325ull;
+        auto feed = [&fp](const uint8_t* p, size_t n) {
+            for (size_t i = 0; i < n; ++i) {
+                fp = (fp ^ p[i]) * 0x100000001B3ull;
+            }
+        };
+        feed(reinterpret_cast<const uint8_t*>(&size), sizeof(size));
+        size_t head = size < 64 ? size : 64;
+        feed(data, head);
+        if (size > 64) {
+            size_t tail = size - 64 < 64 ? size - 64 : 64;
+            feed(data + size - tail, tail);
+        }
+        // Admission PRE-test outside the lock: only admitted
+        // fingerprints pay wl_mu_ (the non-admitted commit path stays
+        // lock-free, as the header contract states).
+        if ((fp & dedup_mask_.load(std::memory_order_relaxed)) != 0) {
+            return;
+        }
+        ScopedLock lk(wl_mu_);
+        // Re-check under the lock: a concurrent overflow may have
+        // grown the mask between the pre-test and here.
+        if ((fp & dedup_mask_.load(std::memory_order_relaxed)) == 0) {
+            uint64_t& cnt = dedup_[fp];
+            cnt++;
+            dedup_samples_.fetch_add(1, std::memory_order_relaxed);
+            if (cnt == 1 && dedup_.size() > kDedupCap) {
+                // Adaptive rate: halve admission, drop entries (and
+                // their counts) that no longer match — the ratio
+                // stays total/distinct over the SURVIVING sample.
+                uint64_t mask =
+                    (dedup_mask_.load(std::memory_order_relaxed) << 1) |
+                    1;
+                dedup_mask_.store(mask, std::memory_order_relaxed);
+                for (auto it = dedup_.begin(); it != dedup_.end();) {
+                    if ((it->first & mask) != 0) {
+                        dedup_samples_.fetch_sub(
+                            it->second, std::memory_order_relaxed);
+                        it = dedup_.erase(it);
+                    } else {
+                        ++it;
+                    }
+                }
+            }
+            dedup_distinct_.store(dedup_.size(),
+                                  std::memory_order_relaxed);
+        }
+    }
+}
+
+void WorkloadProfiler::record_evict(uint64_t key_hash) {
+    if (!enabled_) return;
+    ring_insert(ghost_, mix64(key_hash));
+    ghost_inserts_.fetch_add(1, std::memory_order_relaxed);
+}
+
+void WorkloadProfiler::record_spill(uint64_t key_hash) {
+    if (!enabled_) return;
+    ring_insert(spillring_, mix64(key_hash));
+    spill_inserts_.fetch_add(1, std::memory_order_relaxed);
+}
+
+void WorkloadProfiler::record_promote(uint64_t key_hash) {
+    if (!enabled_) return;
+    if (ring_take(spillring_, mix64(key_hash))) {
+        thrash_.fetch_add(1, std::memory_order_relaxed);
+    }
+}
+
+void WorkloadProfiler::forget(uint64_t key_hash) {
+    if (!enabled_) return;
+    uint64_t m = mix64(key_hash);
+    ring_take(ghost_, m);
+    ring_take(spillring_, m);
+}
+
+void WorkloadProfiler::on_purge() {
+    if (!enabled_) return;
+    ring_clear(ghost_);
+    ring_clear(spillring_);
+    ScopedLock lk(wl_mu_);
+    std::fill(fen_.begin(), fen_.end(), 0);
+    last_.clear();
+    times_.clear();
+    next_time_ = 1;
+    min_time_ = 1;
+    sampled_live_bytes_.store(0, std::memory_order_relaxed);
+    // Counters (accesses/misses/premature/thrash/MRC/dedup) survive:
+    // the demand model is cumulative; only cross-purge DISTANCES (and
+    // ghosts of keys that no longer exist) are meaningless.
+}
+
+// --- control-plane reads ----------------------------------------------
+
+uint64_t WorkloadProfiler::wss_bytes() const {
+    return uint64_t(
+        double(sampled_live_bytes_.load(std::memory_order_relaxed)) *
+        inv_rate_);
+}
+
+uint64_t WorkloadProfiler::predicted_miss_milli(int size_idx) const {
+    uint64_t n = sampled_accesses_.load(std::memory_order_relaxed);
+    if (n == 0 || size_idx < 0 || size_idx >= kSizes) return 0;
+    uint64_t hits = mrc_hits_[size_idx].load(std::memory_order_relaxed);
+    uint64_t miss = n > hits ? n - hits : 0;
+    return miss * 1000 / n;
+}
+
+uint64_t WorkloadProfiler::dedup_ratio_milli() const {
+    uint64_t d = dedup_distinct_.load(std::memory_order_relaxed);
+    if (d == 0) return 1000;
+    return dedup_samples_.load(std::memory_order_relaxed) * 1000 / d;
+}
+
+void WorkloadProfiler::json(std::string& out, uint64_t pool_bytes) const {
+    char buf[512];
+    uint64_t acc = accesses();
+    uint64_t mis = misses();
+    uint64_t sampled = sampled_accesses_.load(std::memory_order_relaxed);
+    snprintf(buf, sizeof(buf),
+             "\"enabled\": %d, \"sample_rate\": %.6f, "
+             "\"pool_bytes\": %llu, \"accesses\": %llu, "
+             "\"misses\": %llu, \"measured_miss_ratio\": %.4f, "
+             "\"commits\": %llu, \"wss_bytes\": %llu",
+             enabled_ ? 1 : 0, rate_, (unsigned long long)pool_bytes,
+             (unsigned long long)acc, (unsigned long long)mis,
+             acc ? double(mis) / double(acc) : 0.0,
+             (unsigned long long)commits_.load(std::memory_order_relaxed),
+             (unsigned long long)wss_bytes());
+    out += buf;
+    // Raw sampler counters FIRST (delta math — the bench accuracy leg
+    // subtracts two snapshots so the population phase drops out).
+    out += ", \"sampler\": {";
+    {
+        uint64_t rb = 0, live = 0;
+        {
+            ScopedLock lk(wl_mu_);
+            rb = rebuilds_;
+            live = last_.size();
+        }
+        snprintf(buf, sizeof(buf),
+                 "\"sampled_accesses\": %llu, \"cold\": %llu, "
+                 "\"live_keys\": %llu, \"live_sampled_bytes\": %llu, "
+                 "\"rebuilds\": %llu, \"hits\": [",
+                 (unsigned long long)sampled,
+                 (unsigned long long)sampled_cold_.load(
+                     std::memory_order_relaxed),
+                 (unsigned long long)live,
+                 (unsigned long long)sampled_live_bytes_.load(
+                     std::memory_order_relaxed),
+                 (unsigned long long)rb);
+        out += buf;
+        for (int s = 0; s < kSizes; ++s) {
+            snprintf(buf, sizeof(buf), "%s%llu", s ? ", " : "",
+                     (unsigned long long)mrc_hits_[s].load(
+                         std::memory_order_relaxed));
+            out += buf;
+        }
+        out += "]}";
+    }
+    // The MRC table operators read directly: hypothetical pool scale
+    // -> predicted LRU miss ratio.
+    out += ", \"mrc\": [";
+    for (int s = 0; s < kSizes; ++s) {
+        uint64_t hits = mrc_hits_[s].load(std::memory_order_relaxed);
+        double miss =
+            sampled ? double(sampled - (hits > sampled ? sampled : hits)) /
+                          double(sampled)
+                    : 0.0;
+        snprintf(buf, sizeof(buf),
+                 "%s{\"scale\": %.2f, \"size_bytes\": %llu, "
+                 "\"miss_ratio\": %.4f}",
+                 s ? ", " : "", kScales[s],
+                 (unsigned long long)(double(pool_bytes) * kScales[s]),
+                 miss);
+        out += buf;
+    }
+    out += "], \"dist_hist\": [";
+    for (int b = 0; b < kDistBuckets; ++b) {
+        snprintf(buf, sizeof(buf), "%s%llu", b ? ", " : "",
+                 (unsigned long long)dist_hist_[b].load(
+                     std::memory_order_relaxed));
+        out += buf;
+    }
+    snprintf(buf, sizeof(buf),
+             "], \"ghost\": {\"capacity\": %zu, "
+             "\"premature_evictions\": %llu, \"thrash_cycles\": %llu, "
+             "\"evictions_noted\": %llu, \"spills_noted\": %llu}",
+             kGhostCap,
+             (unsigned long long)premature_evictions(),
+             (unsigned long long)thrash_cycles(),
+             (unsigned long long)ghost_inserts_.load(
+                 std::memory_order_relaxed),
+             (unsigned long long)spill_inserts_.load(
+                 std::memory_order_relaxed));
+    out += buf;
+    {
+        int mask_bits = 0;
+        uint64_t msk = dedup_mask_.load(std::memory_order_relaxed);
+        while (msk) {
+            mask_bits++;
+            msk >>= 1;
+        }
+        snprintf(buf, sizeof(buf),
+                 ", \"dedup\": {\"samples\": %llu, \"distinct\": %llu, "
+                 "\"ratio\": %.4f, \"sample_mask_bits\": %d}",
+                 (unsigned long long)dedup_samples_.load(
+                     std::memory_order_relaxed),
+                 (unsigned long long)dedup_distinct_.load(
+                     std::memory_order_relaxed),
+                 double(dedup_ratio_milli()) / 1000.0, mask_bits);
+        out += buf;
+    }
+    out += ", \"heat\": {\"buckets\": [";
+    uint64_t hsum = 0, hmax = 0;
+    for (int i = 0; i < kHeatBuckets; ++i) {
+        uint64_t v = heat_[i].load(std::memory_order_relaxed);
+        hsum += v;
+        if (v > hmax) hmax = v;
+        snprintf(buf, sizeof(buf), "%s%llu", i ? ", " : "",
+                 (unsigned long long)v);
+        out += buf;
+    }
+    snprintf(buf, sizeof(buf),
+             "], \"skew\": %.3f, \"decays\": %llu}",
+             hsum ? double(hmax) * kHeatBuckets / double(hsum) : 0.0,
+             (unsigned long long)heat_decays_.load(
+                 std::memory_order_relaxed));
+    out += buf;
+}
+
+}  // namespace istpu

@@ -1,0 +1,249 @@
+// workload.h — the always-on workload profiler: the store's model of
+// its own DEMAND, not its own health.
+//
+// PRs 4/10/11 made the SYSTEM observable (spans, flight recorder,
+// metrics history, SLO burn rates) but the store stayed blind to its
+// WORKLOAD: it could not say what its working set is, what the hit
+// rate would be at 2x or 0.5x pool, whether the reclaimer evicts keys
+// it re-fetches seconds later, or how much duplicate content a dedup
+// tier (ROADMAP item 3) would reclaim. This module builds exactly
+// those demand signals — the declared sensor layer for ROADMAP item
+// 5's closed-loop self-tuning ("The DMA Streaming Framework"'s
+// argument: tier IO must be orchestrated centrally FROM demand
+// signals, which first have to exist).
+//
+// Four estimators, all fed from the KVIndex commit/get/evict paths:
+//
+// 1. SHARDS-style spatially-hashed reuse-distance sampler. A key is
+//    admitted iff mix64(hash(key)) <= threshold (threshold/2^64 = the
+//    sampling rate R, ISTPU_WORKLOAD_RATE, default 1/8); admission is
+//    a pure function of the key, so EVERY access to a sampled key is
+//    seen and reuse distances over the sampled stream are unbiased
+//    once scaled by 1/R (Waldspurger et al., SHARDS). Distances are
+//    BYTE-weighted (a Fenwick tree over last-access times carries
+//    block-rounded sizes; distance = bytes of strictly-more-recently
+//    touched sampled keys, scaled by 1/R), so the miss-ratio curve
+//    reads directly against pool sizes: an access is an LRU hit at
+//    hypothetical capacity C iff scaled_distance + own_size <= C.
+//    Exact hit counters are kept for C in {1/4, 1/2, 1, 2, 4} x the
+//    CURRENT pool size (the MRC table operators actually ask about),
+//    plus an octave histogram of scaled distances for the curve
+//    shape, plus the SHARDS working-set estimate (live sampled bytes
+//    / R). The time axis is renumbered (rebuild) when the stamp
+//    counter fills, and the sampled-key table is capped — beyond the
+//    cap the OLDEST sampled key is dropped (its next access reads as
+//    cold, i.e. as a miss at every size: the safe direction).
+//
+// 2. GHOST RING of recently hard-EVICTED key hashes (open-addressed
+//    atomic slots, overwrite-on-collision). A later get-MISS on a
+//    ghosted key counts premature_evictions — the reclaimer dropped
+//    something the workload still wanted: eviction QUALITY, not just
+//    eviction counts. A parallel ring of recently-SPILLED hashes
+//    turns a later promotion of the same key into thrash_cycles (a
+//    spill→promote round trip that paid two tier IOs for nothing).
+//    Explicit deletes clear their ghost slot (a miss on a deleted key
+//    is not the reclaimer's fault); purge clears both rings.
+//
+// 3. Sampled CONTENT-HASH dedup estimator over committed blocks.
+//    Every commit pays one cheap fingerprint (FNV-1a over size +
+//    first/last 64 payload bytes); fingerprints matching the adaptive
+//    sample mask enter a bounded count table. Admission is a pure
+//    function of the CONTENT, so all copies of one block are admitted
+//    or skipped together and dedup_ratio = admitted_total /
+//    admitted_distinct is unbiased. This turns ROADMAP item 3
+//    (refcounted content-addressed blocks) from a guess into a
+//    measured capacity multiplier.
+//
+// 4. HEAT CLASSES: 16 hash-prefix buckets with periodically-halved
+//    access counters — hot-prefix skew (every request re-reading one
+//    system-prompt chain) shows up as one bucket dwarfing the mean.
+//
+// Cost contract: the non-sampled hot path is one 64-bit mix + a
+// predicted branch (plus one relaxed add for the heat bucket); only
+// sampled keys (~R of accesses) take the profiler mutex. The dedup
+// fingerprint reads <= 128 payload bytes per commit — noise next to
+// the payload memcpy it rides behind. ISTPU_WORKLOAD=0 (read at
+// KVIndex construction) disables everything and is the bench
+// --workload-leg denominator (workload_overhead_p50_ratio <= 1.02).
+//
+// Locking: wl_mu_ is a LEAF above every stripe lock (kRankWorkload,
+// lock_rank.h) — record hooks run under the entry's stripe mutex.
+// The rings, heat buckets and counters are lock-free atomics.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "lock_rank.h"
+#include "thread_annotations.h"
+
+namespace istpu {
+
+class MM;  // mempool.h; pool size read lazily on the sampled branch
+
+class WorkloadProfiler {
+   public:
+    // Hypothetical pool scales the exact MRC counters track.
+    static constexpr int kSizes = 5;
+    static constexpr double kScales[kSizes] = {0.25, 0.5, 1.0, 2.0, 4.0};
+
+    WorkloadProfiler();  // reads ISTPU_WORKLOAD / ISTPU_WORKLOAD_RATE
+
+    bool enabled() const { return enabled_; }
+    double sample_rate() const { return rate_; }
+
+    // --- record hooks (KVIndex data plane; all no-op when disabled) --
+    // A read-path lookup that found a committed entry. `rounded` is
+    // the entry's block-rounded pool footprint; `mm` supplies the
+    // current pool capacity (the 1x point of the MRC), read ONLY on
+    // the sampled branch — the non-sampled hot path never pays the
+    // per-arena total_bytes() walk.
+    void record_get_hit(uint64_t key_hash, uint64_t rounded,
+                        const MM* mm);
+    // A read-path lookup that found nothing: probes the ghost ring
+    // (premature_evictions) and counts toward the measured miss rate.
+    void record_get_miss(uint64_t key_hash);
+    // A commit made `size` bytes visible under the key: an insertion
+    // access for the sampler + the dedup fingerprint over `data`.
+    void record_commit(uint64_t key_hash, const uint8_t* data,
+                       uint64_t rounded, const MM* mm, uint32_t size);
+    // The reclaimer (or inline last resort) hard-EVICTED the key.
+    void record_evict(uint64_t key_hash);
+    // The key's bytes moved pool -> disk tier (spill adopted).
+    void record_spill(uint64_t key_hash);
+    // The key promoted disk -> pool; a recently-spilled key counts a
+    // thrash cycle.
+    void record_promote(uint64_t key_hash);
+    // Explicit delete: the key leaving is the CLIENT's choice — a
+    // later miss on it must not read as a premature eviction.
+    void forget(uint64_t key_hash);
+    // purge(): ghost/spill rings and the sampler's last-access state
+    // clear (distances across a purge are meaningless); the
+    // cumulative counters SURVIVE — purge is a workload event, not an
+    // amnesty for past eviction quality.
+    void on_purge();
+
+    // --- control-plane reads ----------------------------------------
+    uint64_t accesses() const {
+        return accesses_.load(std::memory_order_relaxed);
+    }
+    uint64_t misses() const {
+        return misses_.load(std::memory_order_relaxed);
+    }
+    uint64_t premature_evictions() const {
+        return premature_.load(std::memory_order_relaxed);
+    }
+    uint64_t thrash_cycles() const {
+        return thrash_.load(std::memory_order_relaxed);
+    }
+    // SHARDS working-set estimate (live sampled bytes / rate).
+    uint64_t wss_bytes() const;
+    // Predicted LRU miss ratio at the CURRENT pool size, in millis
+    // (0..1000); 0 when nothing was sampled yet.
+    uint64_t predicted_miss_milli(int size_idx = 2) const;
+    // Projected dedup ratio in millis (1000 = no duplication; 2000 =
+    // half the bytes are duplicates).
+    uint64_t dedup_ratio_milli() const;
+
+    // Append the full /workload JSON object body (no outer braces).
+    void json(std::string& out, uint64_t pool_bytes) const;
+
+   private:
+    // Sampler geometry. kTimeCap bounds the Fenwick time axis (a
+    // rebuild renumbers live stamps when it fills); kMaxSampled
+    // bounds the sampled-key table (beyond it the oldest sample is
+    // dropped — its next access reads cold, the conservative
+    // direction for a miss-ratio estimate).
+    static constexpr uint32_t kTimeCap = 1u << 17;
+    static constexpr size_t kMaxSampled = 1u << 15;
+    static constexpr size_t kGhostCap = 8192;   // power of two
+    static constexpr size_t kDedupCap = 16384;
+    static constexpr int kHeatBuckets = 16;
+    static constexpr int kDistBuckets = 48;     // octave histogram
+    static constexpr uint64_t kHeatDecayEvery = 8192;
+
+    struct Stamp {
+        uint64_t mixed = 0;   // the sampled key
+        uint64_t bytes = 0;   // block-rounded footprint at that access
+    };
+
+    static uint64_t mix64(uint64_t x) {
+        // splitmix64 finalizer: decorrelates the admission test and
+        // the ring/heat indices from the stripe index (which consumes
+        // the raw hash's low bits).
+        x += 0x9E3779B97F4A7C15ull;
+        x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+        x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+        return x ^ (x >> 31);
+    }
+
+    void fen_add(uint32_t i, int64_t v) REQUIRES(wl_mu_);
+    uint64_t fen_sum(uint32_t i) const REQUIRES(wl_mu_);
+    void sampler_access(uint64_t mixed, uint64_t rounded,
+                        const MM* mm);
+    void evict_oldest_sample() REQUIRES(wl_mu_);
+    void rebuild_times() REQUIRES(wl_mu_);
+    void heat_touch(uint64_t mixed);
+    // Lock-free open-addressed single-slot ring ops (hash value IS
+    // the payload; 0 = empty; collisions overwrite — an estimator's
+    // trade, documented in docs/design.md).
+    static void ring_insert(std::atomic<uint64_t>* ring, uint64_t m);
+    static bool ring_take(std::atomic<uint64_t>* ring, uint64_t m);
+    static void ring_clear(std::atomic<uint64_t>* ring);
+
+    bool enabled_ = true;
+    double rate_ = 0.125;
+    uint64_t sample_thresh_ = 0;  // admit iff mix64(h) <= thresh
+    double inv_rate_ = 8.0;
+
+    // Measured demand (reads only; exact, not sampled).
+    std::atomic<uint64_t> accesses_{0};
+    std::atomic<uint64_t> misses_{0};
+    std::atomic<uint64_t> commits_{0};
+
+    // Ghost rings + quality counters.
+    std::atomic<uint64_t> ghost_[kGhostCap] = {};
+    std::atomic<uint64_t> spillring_[kGhostCap] = {};
+    std::atomic<uint64_t> premature_{0};
+    std::atomic<uint64_t> thrash_{0};
+    std::atomic<uint64_t> ghost_inserts_{0};
+    std::atomic<uint64_t> spill_inserts_{0};
+
+    // Heat classes. The decay cadence rides its own touch counter
+    // (edge-triggered off the fetch_add return value): keying it on
+    // accesses_ would halve the buckets on EVERY commit of a put-only
+    // phase, since commits bump commits_, not accesses_.
+    std::atomic<uint64_t> heat_[kHeatBuckets] = {};
+    std::atomic<uint64_t> heat_touches_{0};
+    std::atomic<uint64_t> heat_decays_{0};
+
+    // Sampler + dedup state (sampled keys / admitted fingerprints
+    // only — the profiler mutex is OFF the non-sampled hot path).
+    mutable Mutex wl_mu_{kRankWorkload};
+    std::vector<uint64_t> fen_ GUARDED_BY(wl_mu_);
+    std::unordered_map<uint64_t, uint32_t> last_ GUARDED_BY(wl_mu_);
+    std::unordered_map<uint32_t, Stamp> times_ GUARDED_BY(wl_mu_);
+    uint32_t next_time_ GUARDED_BY(wl_mu_) = 1;
+    uint32_t min_time_ GUARDED_BY(wl_mu_) = 1;  // oldest-sample cursor
+    uint64_t rebuilds_ GUARDED_BY(wl_mu_) = 0;
+    std::atomic<uint64_t> sampled_live_bytes_{0};
+    std::atomic<uint64_t> sampled_accesses_{0};
+    std::atomic<uint64_t> sampled_cold_{0};
+    std::atomic<uint64_t> mrc_hits_[kSizes] = {};
+    std::atomic<uint64_t> dist_hist_[kDistBuckets] = {};
+
+    std::unordered_map<uint64_t, uint64_t> dedup_ GUARDED_BY(wl_mu_);
+    // Admission mask (admit iff (fp & mask) == 0): ATOMIC so the
+    // per-commit admission pre-test runs before wl_mu_ is taken —
+    // the lock is paid only for admitted fingerprints, matching the
+    // stated contract. Written under wl_mu_ (the grow path), read
+    // relaxed anywhere; the locked path re-checks after acquiring.
+    std::atomic<uint64_t> dedup_mask_{0};
+    std::atomic<uint64_t> dedup_samples_{0};
+    std::atomic<uint64_t> dedup_distinct_{0};
+};
+
+}  // namespace istpu

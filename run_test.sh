@@ -63,7 +63,7 @@ if [ "${ISTPU_ASAN:-0}" = "1" ] && [ "${ISTPU_TSAN:-0}" != "1" ]; then
     # libubsan is linked into the .so itself (DT_NEEDED), so only the
     # ASAN runtime needs preloading. detect_leaks=0: CPython
     # intentionally leaks interned objects at exit.
-    SMOKE="${ISTPU_ASAN_TESTS:-tests/test_concurrency.py tests/test_trace.py tests/test_prefetch.py tests/test_chaos.py tests/test_engine.py tests/test_events.py}"
+    SMOKE="${ISTPU_ASAN_TESTS:-tests/test_concurrency.py tests/test_trace.py tests/test_prefetch.py tests/test_chaos.py tests/test_engine.py tests/test_events.py tests/test_workload.py}"
     exec env \
         LD_PRELOAD="$ASAN_RT" \
         ASAN_OPTIONS="detect_leaks=0 abort_on_error=1" \
@@ -101,7 +101,7 @@ if [ "${ISTPU_TSAN:-0}" = "1" ]; then
     # heartbeats/histograms, and the RelaxedCell connection mirrors
     # are exactly the racy-by-design claims the race detector should
     # be pointed at.
-    SMOKE="${ISTPU_TSAN_TESTS:-tests/test_concurrency.py tests/test_trace.py tests/test_prefetch.py tests/test_chaos.py tests/test_engine.py tests/test_events.py}"
+    SMOKE="${ISTPU_TSAN_TESTS:-tests/test_concurrency.py tests/test_trace.py tests/test_prefetch.py tests/test_chaos.py tests/test_engine.py tests/test_events.py tests/test_workload.py}"
     # detect_deadlocks=0: TSAN's lock-order detector keeps a 64-entry
     # held-locks table per thread and CHECK-fails (FATAL) on the index's
     # cross-stripe ops, which legitimately hold 16 ordered stripe locks

@@ -327,6 +327,44 @@ def test_obs_leg_emits_overhead_keys():
     assert out["history_recorded"] >= 2
 
 
+def test_workload_leg_emits_accuracy_and_overhead_keys():
+    """The workload-observability leg (ISSUE 13) must land its keys in
+    the artifact: the profiler-on vs ISTPU_WORKLOAD=0 read p50s plus
+    the <=1.02 acceptance ratio (asserted only as sane here — CI noise
+    is checked at the acceptance level), and the Zipfian accuracy
+    numbers, which ARE asserted here because the trace, the hash
+    admission and the exact-LRU eviction order are all deterministic:
+    the sampler's predicted miss ratio at the real pool size must be
+    within 0.05 of both the measured miss rate and the exact
+    stack-distance simulation."""
+    env = _env(600)
+    env["ISTPU_WORKLOAD_KEYS"] = "256"   # small: keep the test fast
+    env["ISTPU_WORKLOAD_TRACE"] = "4096"
+    p = subprocess.run(
+        [sys.executable, BENCH, "--workload-leg", "0"], env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert p.returncode == 0, p.stderr[-400:]
+    outs = _parse_artifacts(
+        [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+    )
+    assert outs, p.stdout[-400:]
+    out = outs[-1]
+    assert "workload_error" not in out, out
+    assert out["workload_on_p50_read_us"] > 0
+    assert out["workload_off_p50_read_us"] > 0
+    assert out["workload_overhead_p50_ratio"] > 0
+    # The on-leg really recorded; the off-leg (kill switch) did not.
+    assert out["workload_accesses"] > 0
+    assert out["workload_off_accesses"] == 0
+    # Deterministic accuracy pins (ISSUE 13 acceptance).
+    assert 0.0 < out["workload_measured_miss_ratio"] < 1.0
+    assert out["workload_accuracy_err"] <= 0.05, out
+    assert out["workload_vs_exact_err"] <= 0.05, out
+    assert out["workload_wss_bytes"] > 0
+    assert out["workload_premature_evictions"] > 0
+
+
 def test_probe_failure_cached_across_runs(tmp_path, monkeypatch):
     """A failed probe is persisted; the next run (within the TTL) skips
     the probe subprocess entirely — no 180 s re-burn (the BENCH_r05

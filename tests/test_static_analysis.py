@@ -264,6 +264,40 @@ def test_dropped_slo_endpoint_fails_golden(tree):
     assert "'endpoints' drifted" in r.stderr
 
 
+def test_dropped_workload_endpoint_fails_golden(tree):
+    # ISSUE 13 seeded mutation: silently deleting the /workload
+    # endpoint from the control plane must fail the golden's
+    # `endpoints` pin — the MRC/WSS dashboard depends on it exactly
+    # like bindings depend on exports. (The doc edit keeps the
+    # undocumented-endpoint check quiet so the failure isolates the
+    # golden pin, same shape as the /slo mutation above.)
+    mutate(tree, "infinistore_tpu/server.py",
+           'elif self.path == "/workload":',
+           'elif self.path == "/workload_disabled_never_matches":')
+    mutate(tree, "docs/api.md", "`GET /workload`",
+           "`GET /workload` `/workload_disabled_never_matches`")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "'endpoints' drifted" in r.stderr
+
+
+def test_thrash_event_catalog_pin_bites(tree):
+    # ISSUE 13 seeded mutation: renaming the watchdog.thrash verdict's
+    # emit id (server.cc) without touching the events.h catalog must
+    # fail BOTH drift directions — the new id is emitted but
+    # uncataloged (the drain would render "?"), the old catalog row is
+    # stale — so the thrash verdict can never silently detach from its
+    # catalog row (and hence from the docs table) after a refactor.
+    mutate(tree, "native/src/server.cc",
+           "events_emit(EV_WATCHDOG_THRASH,",
+           "events_emit(EV_WATCHDOG_THRASHING,")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "EV_WATCHDOG_THRASHING" in r.stderr  # emitted, uncataloged
+    assert "EV_WATCHDOG_THRASH" in r.stderr     # stale catalog row
+    assert "stale catalog row" in r.stderr
+
+
 def test_fabric_failpoint_catalog_pin_bites(tree):
     # ISSUE 12 seeded mutation: renaming the fabric doorbell failpoint
     # at its call site (engine_fabric.cc) without touching the

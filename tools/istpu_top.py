@@ -128,18 +128,68 @@ def render_history(history, width=48):
         ("p99", p99, _fmt_age(p99[-1])),
         ("queues", queues, f"{queues[-1]}"),
     ]
+    # Workload-demand rows (v13 samples; pre-v13 rings simply lack the
+    # keys and the rows are skipped).
+    prem = [s.get("premature_evictions_delta", 0) for s in samples]
+    if any(prem):
+        rows.append(("premature", prem, f"{prem[-1]}"))
+    wss = [s.get("wss_bytes", 0) for s in samples]
+    if any(wss):
+        rows.append(("wss", wss, _fmt_bytes(wss[-1])))
     for label, series, last in rows:
         lines.append(f"  {label:<10}{_spark(series, width)} {last}")
     return lines
 
 
+def render_workload(workload):
+    """Workload-demand panel (GET /workload or a bundle's
+    workload.json): MRC table over hypothetical pool sizes, WSS
+    estimate, eviction-quality counters, dedup projection and heat
+    classes. Empty/missing blob (pre-v13 server or bundle, or the
+    ISTPU_WORKLOAD=0 denominator run) renders nothing — graceful
+    degrade, never a crash."""
+    wl = workload or {}
+    if not wl or not wl.get("accesses"):
+        if wl and not wl.get("enabled", 1):
+            return ["", "workload: profiler disabled (ISTPU_WORKLOAD=0)"]
+        return []
+    lines = ["", (
+        f"workload: wss={_fmt_bytes(wl.get('wss_bytes', 0))}  "
+        f"measured_miss={wl.get('measured_miss_ratio', 0.0):.3f}  "
+        f"premature_evict={wl.get('ghost', {}).get('premature_evictions', 0)}"
+        f"  thrash={wl.get('ghost', {}).get('thrash_cycles', 0)}  "
+        f"dedup={wl.get('dedup', {}).get('ratio', 1.0):.2f}x"
+    )]
+    mrc = wl.get("mrc", [])
+    if mrc:
+        lines.append(
+            "  MRC  " + "  ".join(
+                f"{m.get('scale', 0):.2g}x:{m.get('miss_ratio', 0):.3f}"
+                for m in mrc
+            )
+        )
+    heat = wl.get("heat", {})
+    buckets = heat.get("buckets", [])
+    if buckets and sum(buckets):
+        total = float(sum(buckets))
+        shares = [b / total for b in buckets]
+        lines.append(
+            f"  heat {_spark(shares, width=len(shares))} "
+            f"skew={heat.get('skew', 0):.2f} "
+            f"(1.0 = uniform, {len(buckets)} hash-prefix classes)"
+        )
+    return lines
+
+
 def render_frame(stats, debug, events, prev=None, dt=None, tail=10,
-                 history=None):
+                 history=None, workload=None):
     """Render one dashboard frame from the JSON blobs. ``prev``
     (the previous stats blob) + ``dt`` enable the throughput deltas;
     without them the counters are shown as absolutes (bundle mode).
     ``history`` (GET /history or a bundle's history.json) adds the
-    sparkline lead-up panel."""
+    sparkline lead-up panel; ``workload`` (GET /workload or a
+    bundle's workload.json) the demand panel — both degrade
+    gracefully when absent (pre-v13 servers/bundles)."""
     lines = []
     eng = stats.get("engine", "?")
     wd = stats.get("watchdog", {})
@@ -254,6 +304,9 @@ def render_frame(stats, debug, events, prev=None, dt=None, tail=10,
     # History sparklines (the lead-up, not just this instant).
     lines.extend(render_history(history))
 
+    # Workload demand panel (MRC / WSS / eviction quality / dedup).
+    lines.extend(render_workload(workload))
+
     # Recent events tail.
     evs = (events or {}).get("events", [])
     lines.append("")
@@ -290,10 +343,15 @@ def run_live(args):
             history = _get_json(base, "/history")
         except Exception:  # noqa: BLE001 — pre-v11 server: no panel
             history = {}
+        try:
+            workload = _get_json(base, "/workload")
+        except Exception:  # noqa: BLE001 — pre-v13 server: no panel
+            workload = {}
         now = time.monotonic()
         frame = render_frame(stats, debug, events, prev=prev,
                              dt=(now - prev_t) if prev_t else None,
-                             tail=args.tail, history=history)
+                             tail=args.tail, history=history,
+                             workload=workload)
         if not args.once:
             sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
         print(frame)
@@ -327,7 +385,8 @@ def run_bundle(args):
         print()
     print(render_frame(load("stats.json"), load("debug_state.json"),
                        load("events.json"), tail=args.tail,
-                       history=load("history.json")))
+                       history=load("history.json"),
+                       workload=load("workload.json")))
     return 0
 
 
