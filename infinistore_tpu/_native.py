@@ -135,6 +135,37 @@ def _decls(lib):
         ),
         ("ist_server_snapshot", c.c_longlong, [c.c_void_p, c.c_char_p]),
         ("ist_server_restore", c.c_longlong, [c.c_void_p, c.c_char_p]),
+        # cluster robustness tier (ABI v14): range migration over the
+        # snapshot codec, the shard-directory mirror, the migration
+        # verdict, and the control-plane/client-side chaos eval.
+        (
+            "ist_server_snapshot_range",
+            c.c_longlong,
+            [c.c_void_p, c.c_char_p, c.c_uint64, c.c_uint64],
+        ),
+        (
+            "ist_server_delete_range",
+            c.c_longlong,
+            [c.c_void_p, c.c_uint64, c.c_uint64],
+        ),
+        (
+            "ist_server_cluster_set",
+            c.c_int,
+            [c.c_void_p, c.c_uint64, c.c_char_p, c.c_longlong,
+             c.c_uint64, c.c_uint64],
+        ),
+        (
+            "ist_server_cluster",
+            c.c_longlong,
+            [c.c_void_p, c.c_char_p, c.c_longlong],
+        ),
+        (
+            "ist_server_migration_trip",
+            c.c_int,
+            [c.c_void_p, c.c_char_p, c.c_uint64, c.c_uint64],
+        ),
+        ("ist_cluster_failpoint", c.c_int, [c.c_char_p]),
+        ("ist_fault_arm", c.c_int, [c.c_char_p, c.c_char_p, c.c_int]),
         ("ist_server_shm_prefix", c.c_int, [c.c_void_p, c.c_char_p, c.c_int]),
         # fault injection (failpoint subsystem, ABI v8)
         (
@@ -291,7 +322,11 @@ def _decls(lib):
         ("ist_mm_total_bytes", c.c_uint64, [c.c_void_p]),
         ("ist_mm_num_pools", c.c_uint64, [c.c_void_p]),
     ]
-    # ABI probe FIRST: a stale prebuilt library would lack the v13
+    # ABI probe FIRST: a stale prebuilt library would lack the v14
+    # cluster entry points (ist_server_cluster_set / ist_server_cluster
+    # / ist_server_snapshot_range / ist_server_delete_range /
+    # ist_server_migration_trip / ist_cluster_failpoint /
+    # ist_fault_arm), lack the v13
     # workload entry point (ist_server_workload), lack the v12
     # fabric entry points (ist_fabric_put / ist_conn_fabric_telemetry),
     # misparse the v12 ist_conn_create trailing use_fabric flag, lack
@@ -314,9 +349,9 @@ def _decls(lib):
         ver = int(lib.ist_abi_version())
     except AttributeError:
         ver = 1
-    if ver < 13:
+    if ver < 14:
         raise RuntimeError(
-            f"stale native library at {_LIB_PATH} (ABI v{ver} < v13): "
+            f"stale native library at {_LIB_PATH} (ABI v{ver} < v14): "
             "rebuild with `make -C native` (or delete the .so to let "
             "the import auto-build)"
         )

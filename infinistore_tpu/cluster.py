@@ -1,0 +1,496 @@
+"""Cluster robustness tier: shard directory, replica placement and live
+key-range rebalance (ISSUE 14; ROADMAP item 2).
+
+The scale-out story through PR 13 was ``ShardedConnection``'s static
+``crc32 % n`` hash with per-shard degrade: a dead shard's keys simply
+vanished, and adding capacity meant restarting every client with a new
+config list. This module supplies the three pieces that turn the static
+fan-out into an elastic cluster:
+
+- **Directory** (:func:`build_directory`, :class:`HashRing`): an
+  epoch-numbered shard map — a consistent-hash ring of virtual nodes
+  with N-way replica sets — pushed to every shard's control plane
+  (``POST /directory``) and served back (``GET /directory``). Clients
+  ride directory epochs the way the pin cache rides the ctl-page epoch:
+  a stale push answers ``WRONG_EPOCH`` plus the current map, and a
+  stale client discovers re-routing through an explicit refresh or a
+  read miss, never through silent misroute. The ring coordinate is
+  ``zlib.crc32`` — byte-identical to the native ``KVIndex::ring_hash``,
+  which is what makes server-side range export/evict and client-side
+  routing agree on every key's position.
+
+- **Replica placement**: a key's replica set is the first
+  ``replication`` DISTINCT shards clockwise from its ring point. Writes
+  fan to the whole set; reads prefer the least-loaded live replica and
+  fail over along the set, so a replica death keeps hot prefix chains
+  servable (``sharded.py`` implements the data path; this module only
+  answers "which shards").
+
+- **Live rebalance** (:class:`ClusterCoordinator`): key-range migration
+  riding machinery the store already trusts — the source spills the
+  moving range through the snapshot extent codec
+  (``ist_server_snapshot_range``), the target adopts via the restore
+  path, commit is a directory epoch bump pushed to every shard, and
+  only then does the source evict the moved range
+  (``ist_server_delete_range``). The zero-loss argument is the
+  ordering: a committed key is always present on (a) its old owner
+  until the evict step, and (b) its new owner from the adopt step, and
+  the epoch bump between them re-routes readers — there is no instant
+  at which neither holds the bytes. A migration that stalls (an export
+  or adopt call exceeding its deadline) fires exactly one
+  ``watchdog.migration`` verdict on the stalled shard, whose diagnostic
+  bundle carries ``cluster.json`` — the directory AND the range cursor
+  it died holding. The ``cluster.*`` failpoints (armed like any other:
+  ``POST /fault`` / ``ISTPU_FAILPOINTS``) kill a source mid-range,
+  crash a target mid-adopt, or refuse a directory push, which is the
+  chaos harness ``tests/test_cluster.py`` drives.
+
+Deployment note: export/adopt move bytes through spool files, so the
+coordinator assumes the source and target can reach a shared spool
+path (same host, NFS, or an object-store fuse mount). A streaming
+cross-host hop is the natural follow-on once the fabric engine grows a
+server-to-server channel.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+import zlib
+
+RING_SPAN = 1 << 32
+
+# Migration phases mirrored into the native cluster state (stats
+# "cluster.migration_phase", cluster.migration_phase events, bundles).
+PHASE_IDLE = -1
+PHASE_EXPORT = 1
+PHASE_ADOPT = 2
+PHASE_EVICT = 3
+
+
+def eval_failpoint(name, kill_exit=137):
+    """Evaluate one ``cluster.*`` failpoint against the process-global
+    native registry (armed via POST /fault, ``ISTPU_FAILPOINTS`` or
+    ``ist_fault_arm``). Returns 0 (pass; delay policies have already
+    slept) or a positive errno the caller should fail with. A ``kill``
+    action exits THIS process on the spot — the chaos semantics for a
+    migration source/target dying mid-range (the arming side chooses
+    which process dies by choosing which process's registry it arms).
+    """
+    from . import _native
+
+    rc = int(_native.get_lib().ist_cluster_failpoint(name.encode()))
+    if rc == -2:
+        import os
+
+        os._exit(kill_exit)
+    if rc == -1:
+        raise ValueError(f"unknown cluster failpoint {name!r}")
+    return rc
+
+
+def ring_hash(key):
+    """The shared ring coordinate: zlib.crc32, byte-identical to the
+    native ``KVIndex::ring_hash`` (both sides MUST agree or a range
+    migration would move the wrong keys)."""
+    return zlib.crc32(key.encode() if isinstance(key, str) else key)
+
+
+def in_range(h, lo, hi):
+    """h in [lo, hi) with wrap-around (lo > hi spans the ring origin)."""
+    if lo <= hi:
+        return lo <= h < hi
+    return h >= lo or h < hi
+
+
+class HashRing:
+    """Consistent-hash ring over a directory's shard list.
+
+    Each shard contributes ``vnodes`` points (crc32 of
+    ``"shard:<id>#<i>"`` — stable across processes); a key belongs to
+    the first point clockwise from its own hash, and its replica set is
+    the first ``replication`` DISTINCT shards continuing clockwise.
+    Virtual nodes keep per-shard load within a few percent of uniform
+    at 64 points/shard and — the property rebalance relies on — make an
+    added shard take many SMALL ranges from all existing shards instead
+    of one giant range from one victim.
+    """
+
+    def __init__(self, shard_ids, vnodes=64, replication=1):
+        if not shard_ids:
+            raise ValueError("ring needs at least one shard")
+        self.shard_ids = list(shard_ids)
+        self.vnodes = int(vnodes)
+        self.replication = max(1, int(replication))
+        points = []
+        for sid in self.shard_ids:
+            for i in range(self.vnodes):
+                points.append((ring_hash(f"shard:{sid}#{i}"), sid))
+        # Ties (two vnodes hashing identically) resolve by shard id so
+        # every party sorts the ring identically.
+        points.sort()
+        self._points = points
+        self._hashes = [h for h, _ in points]
+
+    def _successor_idx(self, h):
+        """Index of the first ring point with hash > h (wrapping)."""
+        import bisect
+
+        i = bisect.bisect_right(self._hashes, h)
+        return i % len(self._points)
+
+    def replica_set(self, key):
+        return self.replica_set_at(ring_hash(key))
+
+    def replica_set_at(self, h):
+        """First ``replication`` distinct shards clockwise from ring
+        coordinate ``h`` (all shards when the ring is smaller)."""
+        want = min(self.replication, len(self.shard_ids))
+        out = []
+        i = self._successor_idx(h)
+        for _ in range(len(self._points)):
+            sid = self._points[i][1]
+            if sid not in out:
+                out.append(sid)
+                if len(out) == want:
+                    break
+            i = (i + 1) % len(self._points)
+        return out
+
+    def boundaries(self):
+        """Every ring point hash, sorted (segment edges)."""
+        return sorted(set(self._hashes))
+
+
+def build_directory(shards, epoch=1, vnodes=64, replication=1):
+    """Assemble a directory blob. ``shards``: iterable of dicts with
+    ``id`` plus whatever the clients need to dial them (``host``,
+    ``service_port``, ``manage_port``). The blob is what ``POST
+    /directory`` pushes and ``GET /directory`` serves."""
+    out = {
+        "epoch": int(epoch),
+        "vnodes": int(vnodes),
+        "replication": int(replication),
+        "shards": [dict(s) for s in shards],
+    }
+    ids = [s["id"] for s in out["shards"]]
+    if len(set(ids)) != len(ids):
+        raise ValueError(f"duplicate shard ids in directory: {ids}")
+    return out
+
+
+def directory_ring(directory):
+    return HashRing(
+        [s["id"] for s in directory["shards"]],
+        vnodes=directory.get("vnodes", 64),
+        replication=directory.get("replication", 1),
+    )
+
+
+def compute_moves(old_dir, new_dir):
+    """Diff two directories into range moves and evictions.
+
+    Returns ``(moves, evictions)`` where moves are
+    ``{"lo", "hi", "src", "dst"}`` (copy the range from shard src to
+    shard dst, a NEW member of that range's replica set) and evictions
+    are ``{"lo", "hi", "shard"}`` (shard left the range's replica set;
+    drop its copy after the epoch commit). Each joiner is paired with
+    EVERY old member of the range, not just the old primary: a key
+    committed while one old replica was down lives only on its peers
+    (the documented replica repair debt), so exporting from a single
+    member could hand the joiner an incomplete range — and the
+    post-commit evict of an ousted peer would then delete the only
+    surviving copy. Adopts are first-writer-wins, so the duplicate
+    exports dedup on the target at the cost of R× export IO. Segments
+    are delimited by the union of both rings' vnode points — within a
+    segment every key has the same old and new replica sets — and
+    adjacent segments with identical actions merge.
+    """
+    old_ring = directory_ring(old_dir)
+    new_ring = directory_ring(new_dir)
+    bounds = sorted(set(old_ring.boundaries() + new_ring.boundaries()))
+    if not bounds:
+        return [], []
+    moves, evictions = [], []
+    n = len(bounds)
+    for i in range(n):
+        lo = bounds[i]
+        hi = bounds[(i + 1) % n] if i + 1 < n else bounds[0]
+        # The final segment wraps from the last boundary through the
+        # ring origin to the first; in_range/native both honor lo > hi.
+        if lo == hi:  # single-boundary degenerate ring
+            hi = (lo + RING_SPAN - 1) % RING_SPAN
+        old_set = old_ring.replica_set_at(lo)
+        new_set = new_ring.replica_set_at(lo)
+        if old_set == new_set:
+            continue
+        for dst in new_set:
+            if dst not in old_set:
+                for src in old_set:
+                    moves.append(
+                        {"lo": lo, "hi": hi, "src": src, "dst": dst}
+                    )
+        for sid in old_set:
+            if sid not in new_set:
+                evictions.append({"lo": lo, "hi": hi, "shard": sid})
+
+    def merge(items, keyfields):
+        """Adjacent segments (hi == next lo) with identical actors
+        merge into one range — vnode granularity would otherwise issue
+        hundreds of tiny exports."""
+        out = []
+        for it in sorted(items, key=lambda x: x["lo"]):
+            if out and out[-1]["hi"] == it["lo"] and all(
+                out[-1][f] == it[f] for f in keyfields
+            ):
+                out[-1]["hi"] = it["hi"]
+            else:
+                out.append(dict(it))
+        return out
+
+    return merge(moves, ("src", "dst")), merge(evictions, ("shard",))
+
+
+# -- control-plane HTTP helpers --------------------------------------------
+
+
+def _http_json(method, url, body=None, timeout=10.0):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read().decode() or "{}")
+        except ValueError:
+            payload = {}
+        return e.code, payload
+
+
+def fetch_directory(manage_addr, timeout=10.0):
+    """GET /directory from ``host:port`` → the directory response
+    (``{"epoch", "directory", "shard_id", ...}``)."""
+    st, body = _http_json("GET", f"http://{manage_addr}/directory",
+                          timeout=timeout)
+    if st != 200:
+        raise RuntimeError(f"GET /directory on {manage_addr}: HTTP {st}")
+    return body
+
+
+class WrongEpoch(RuntimeError):
+    """A directory push was stale; ``current`` carries the shard's
+    newer map (the caller should adopt it and retry from there)."""
+
+    def __init__(self, addr, current):
+        super().__init__(f"WRONG_EPOCH from {addr}")
+        self.current = current
+
+
+def push_directory(directory, manage_addrs, timeout=10.0):
+    """POST the directory to every shard's control plane. Raises
+    :class:`WrongEpoch` when a shard already holds a NEWER epoch
+    (returning that map), and RuntimeError listing unreachable/refusing
+    shards otherwise — partial propagation is surfaced, never silent
+    (stale shards would misroute reads they still receive)."""
+    failed = []
+    for addr in manage_addrs:
+        try:
+            st, body = _http_json("POST", f"http://{addr}/directory",
+                                  body=directory, timeout=timeout)
+        except OSError as e:
+            failed.append((addr, repr(e)))
+            continue
+        if st == 409 and body.get("error") == "WRONG_EPOCH":
+            raise WrongEpoch(addr, body.get("directory"))
+        if st != 200:
+            failed.append((addr, body.get("error", f"HTTP {st}")))
+    if failed:
+        raise RuntimeError(f"directory push failed on {failed}")
+    return directory["epoch"]
+
+
+class MigrationStalled(RuntimeError):
+    """A range move stopped advancing; the verdict (one
+    ``watchdog.migration`` trip + bundle) has already been fired on the
+    stalled shard before this raises."""
+
+
+class ClusterCoordinator:
+    """Drives live key-range rebalance over the shards' control planes.
+
+    ``manage_addr(shard)``: shards are the directory's shard dicts; the
+    default reads ``host``/``manage_port``. ``spool_dir`` must be
+    reachable by source and target (see the module docstring).
+
+    The coordinator is deliberately stateless between calls: every bit
+    of migration state that matters for forensics (phase, cursor,
+    directory epoch) lives in the SHARDS' native cluster mirror, so a
+    coordinator crash mid-migration leaves self-describing servers —
+    the old epoch still routes, sources still hold their ranges, and a
+    re-run converges (exports overwrite their spool files, adopts are
+    first-writer-wins, evicts are idempotent).
+    """
+
+    def __init__(self, spool_dir, chunks=4, chunk_timeout_s=30.0,
+                 http_timeout_s=None):
+        self.spool_dir = spool_dir
+        self.chunks = max(1, int(chunks))
+        self.chunk_timeout_s = float(chunk_timeout_s)
+        # Per-request cap; chunk_timeout_s is the stall DEADLINE (a
+        # request past it is a stalled migration, not a slow one).
+        self.http_timeout_s = (
+            float(http_timeout_s)
+            if http_timeout_s is not None
+            else self.chunk_timeout_s
+        )
+
+    @staticmethod
+    def manage_addr(shard):
+        return f"{shard.get('host', '127.0.0.1')}:{shard['manage_port']}"
+
+    def _migrate(self, addr, body, timeout=None):
+        return _http_json(
+            "POST", f"http://{addr}/migrate", body=body,
+            timeout=timeout if timeout is not None else self.http_timeout_s,
+        )
+
+    def _fire_stall(self, addr, detail, phase, cursor):
+        try:
+            self._migrate(addr, {
+                "action": "verdict", "detail": detail,
+                "a0": int(phase), "a1": int(cursor),
+            }, timeout=self.http_timeout_s)
+        except OSError:
+            pass  # a dead shard cannot bundle; the raise below still tells
+
+    @staticmethod
+    def _split(lo, hi, chunks):
+        """[lo, hi) (wrapping) into up to `chunks` contiguous subranges."""
+        span = (hi - lo) % RING_SPAN
+        if span == 0:
+            span = RING_SPAN
+        chunks = min(chunks, span) or 1
+        step = span // chunks
+        edges = [(lo + i * step) % RING_SPAN for i in range(chunks)]
+        edges.append(hi % RING_SPAN)
+        return [(edges[i], edges[i + 1]) for i in range(chunks)]
+
+    def move_range(self, src_shard, dst_shard, lo, hi, tag=""):
+        """Copy [lo, hi) from src to dst: chunked export on the source
+        (each chunk advances the source's migration cursor), then adopt
+        on the target. Stalls fire the verdict on the stalled shard and
+        raise. Returns (exported, adopted) entry counts."""
+        src_addr = self.manage_addr(src_shard)
+        dst_addr = self.manage_addr(dst_shard)
+        subranges = self._split(lo, hi, self.chunks)
+        files, exported = [], 0
+        for i, (clo, chi) in enumerate(subranges):
+            path = (f"{self.spool_dir}/migrate-{src_shard['id']}-"
+                    f"{dst_shard['id']}-{tag}{i}.snap")
+            t0 = time.monotonic()
+            try:
+                st, body = self._migrate(src_addr, {
+                    "action": "export", "lo": clo, "hi": chi,
+                    "path": path, "cursor": i + 1,
+                    "total": len(subranges),
+                }, timeout=self.chunk_timeout_s)
+            except OSError as e:
+                # Timeout or a source death mid-range. Fire the verdict
+                # (best-effort — a killed source cannot answer) so the
+                # stall self-diagnoses with the cursor it died holding.
+                self._fire_stall(
+                    src_addr,
+                    f"range export [{clo:#x},{chi:#x}) chunk {i + 1}/"
+                    f"{len(subranges)} stalled after "
+                    f"{time.monotonic() - t0:.1f}s: {e!r}",
+                    PHASE_EXPORT, i + 1)
+                raise MigrationStalled(
+                    f"export chunk {i + 1} on {src_addr}: {e!r}") from e
+            if st != 200:
+                raise RuntimeError(
+                    f"export chunk {i + 1} on {src_addr}: "
+                    f"{body.get('error', f'HTTP {st}')}")
+            exported += int(body.get("exported", 0))
+            files.append(path)
+        adopted = 0
+        try:
+            st, body = self._migrate(dst_addr, {
+                "action": "import", "paths": files,
+                "total": len(files),
+            }, timeout=self.chunk_timeout_s)
+        except OSError as e:
+            self._fire_stall(
+                src_addr,
+                f"target {dst_addr} adopt of [{lo:#x},{hi:#x}) stalled/"
+                f"died: {e!r}", PHASE_ADOPT, len(files))
+            raise MigrationStalled(
+                f"adopt on {dst_addr}: {e!r}") from e
+        if st != 200:
+            raise RuntimeError(
+                f"adopt on {dst_addr}: {body.get('error', f'HTTP {st}')}")
+        adopted = int(body.get("adopted", 0))
+        return exported, adopted
+
+    def rebalance(self, old_dir, new_dir, extra_addrs=()):
+        """The full live-rebalance protocol: copy every changed range,
+        COMMIT via the epoch bump push, then evict ousted copies.
+        ``extra_addrs``: manage addresses beyond the union of both
+        directories (decommissioned shards that should still learn the
+        new map). Returns a summary dict."""
+        if new_dir["epoch"] <= old_dir["epoch"]:
+            raise ValueError("new directory must bump the epoch")
+        shards = {s["id"]: s for s in old_dir["shards"]}
+        shards.update({s["id"]: s for s in new_dir["shards"]})
+        moves, evictions = compute_moves(old_dir, new_dir)
+        exported = adopted = evicted = 0
+        for i, mv in enumerate(moves):
+            e, a = self.move_range(shards[mv["src"]], shards[mv["dst"]],
+                                   mv["lo"], mv["hi"], tag=f"m{i}-")
+            exported += e
+            adopted += a
+        # COMMIT: the epoch bump. From here readers route by the new
+        # map; sources still hold their old copies, so a straggler
+        # client on the old epoch keeps reading correct bytes until the
+        # evict below — and discovers the bump on its next refresh.
+        addrs = [self.manage_addr(s) for s in shards.values()]
+        addrs += [a for a in extra_addrs if a not in addrs]
+        push_directory(new_dir, addrs, timeout=self.http_timeout_s)
+        for ev in evictions:
+            addr = self.manage_addr(shards[ev["shard"]])
+            st, body = self._migrate(addr, {
+                "action": "evict", "lo": ev["lo"], "hi": ev["hi"],
+            })
+            if st == 200:
+                evicted += int(body.get("evicted", 0))
+        return {
+            "epoch": new_dir["epoch"],
+            "moves": len(moves),
+            "exported": exported,
+            "adopted": adopted,
+            "evicted": evicted,
+        }
+
+    def add_shard(self, old_dir, new_shard, extra_addrs=()):
+        """Grow the cluster by one shard: derive the next directory
+        (epoch + 1), migrate the ranges the ring hands it, commit,
+        evict. Returns (new_dir, summary)."""
+        new_dir = build_directory(
+            old_dir["shards"] + [new_shard],
+            epoch=old_dir["epoch"] + 1,
+            vnodes=old_dir.get("vnodes", 64),
+            replication=old_dir.get("replication", 1),
+        )
+        return new_dir, self.rebalance(old_dir, new_dir,
+                                       extra_addrs=extra_addrs)
+
+
+__all__ = [
+    "RING_SPAN", "PHASE_IDLE", "PHASE_EXPORT", "PHASE_ADOPT",
+    "PHASE_EVICT", "ring_hash", "in_range", "HashRing",
+    "build_directory", "directory_ring", "compute_moves",
+    "fetch_directory", "push_directory", "WrongEpoch",
+    "MigrationStalled", "ClusterCoordinator",
+]

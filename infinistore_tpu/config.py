@@ -243,6 +243,14 @@ class ServerConfig:
         self.watchdog = kwargs.get("watchdog", True)
         self.bundle_dir = kwargs.get("bundle_dir", "")
         self.bundle_keep = kwargs.get("bundle_keep", 4)
+        # Cluster tier (docs/design.md "Cluster tier"): this server's
+        # shard identity in the replicated shard directory. -1 (the
+        # default) = not a cluster member — GET /directory still
+        # answers (epoch 0, no map) and every cluster endpoint stays
+        # inert until a directory naming this shard is pushed. The id
+        # itself is assigned by the operator/coordinator; it only has
+        # to be unique within one directory.
+        self.shard_id = kwargs.get("shard_id", -1)
         # Accepted for reference CLI compatibility; unused on TPU hosts.
         self.dev_name = kwargs.get("dev_name", "")
         self.link_type = kwargs.get("link_type", "")
@@ -261,8 +269,11 @@ class ServerConfig:
         # port is returned by InfiniStoreServer.start()).
         if self.service_port is None or self.service_port < 0:
             raise Exception("Service port invalid")
-        if not self.manage_port:
-            raise Exception("Manage port is 0")
+        # manage_port 0 = bind an ephemeral manage port (like
+        # service_port 0) — multi-shard harnesses discover it through
+        # --port-file; negative/None is still a config error.
+        if self.manage_port is None or self.manage_port < 0:
+            raise Exception("Manage port invalid")
         if self.log_level not in _LOG_LEVELS:
             raise Exception("log level should be error, debug, info or warning")
         # The reference floors block granularity at 16 KB (lib.py:127);

@@ -198,6 +198,32 @@ def _hist_percentile_us(buckets, q):
     return 1 << len(buckets)
 
 
+def merge_fabric_stats(per_stats):
+    """Merge per-connection ``client_stats()["fabric"]`` sections into
+    one deployment-level view (ISSUE 14 satellite — PR 12 stopped the
+    fabric telemetry at the single connection, so sharded deployments
+    reported no fabric section and a silently-lost one-sided put path
+    was invisible). Counters sum; ``ring_active`` is the AND across
+    members ("does EVERY shard run the one-sided commit plane" — one
+    downgraded shard is exactly the deployment bug to surface) while
+    ``any_ring_active`` keeps the existence answer; ``stream_active``
+    ORs (any cross-host member selects the stream shape)."""
+    merged = {
+        "ring_posts": 0, "doorbells": 0, "ring_fallbacks": 0,
+        "ring_active": bool(per_stats), "any_ring_active": False,
+        "stream_active": False,
+    }
+    for ps in per_stats:
+        f = ps.get("fabric", {})
+        merged["ring_posts"] += f.get("ring_posts", 0)
+        merged["doorbells"] += f.get("doorbells", 0)
+        merged["ring_fallbacks"] += f.get("ring_fallbacks", 0)
+        merged["ring_active"] &= bool(f.get("ring_active"))
+        merged["any_ring_active"] |= bool(f.get("ring_active"))
+        merged["stream_active"] |= bool(f.get("stream_active"))
+    return merged
+
+
 class _ClientTelemetry:
     """Client-side op telemetry (ISSUE 11): per-op latency histograms in
     the SAME power-of-two bucket geometry as the server's LatHist

@@ -219,6 +219,58 @@ class InfiniStoreServer:
             raise Exception(f"snapshot to {path} failed")
         return n
 
+    def snapshot_range(self, path, ring_lo, ring_hi):
+        """Range-filtered snapshot (the cluster tier's migration export
+        half): every committed entry whose CRC-32 ring coordinate falls
+        in ``[ring_lo, ring_hi)`` — wrap-around when lo > hi — in the
+        ordinary snapshot format, adopted on the target via
+        :meth:`restore`. Returns entries written."""
+        n = int(self._lib.ist_server_snapshot_range(
+            self._h, path.encode(), int(ring_lo), int(ring_hi)))
+        if n < 0:
+            raise Exception(f"range snapshot to {path} failed")
+        return n
+
+    def delete_range(self, ring_lo, ring_hi):
+        """Drop every committed entry in the ring-hash range (the
+        migration commit's source-side evict; per-entry epoch bumps
+        like delete). Returns entries erased."""
+        n = int(self._lib.ist_server_delete_range(
+            self._h, int(ring_lo), int(ring_hi)))
+        if n < 0:
+            raise Exception("delete_range failed")
+        return n
+
+    def cluster(self):
+        """The native cluster mirror (``GET /directory`` body, minus
+        the shard_id the control plane injects): ``{"epoch",
+        "migration_phase", "migration_cursor", "migration_total",
+        "directory": pushed-blob-or-None}``."""
+        return json.loads(
+            self._read_blob(self._lib.ist_server_cluster, initial=8192)
+        )
+
+    def set_cluster(self, epoch, directory=None, phase=-1, cursor=0,
+                    total=0):
+        """Push directory/migration state into the native mirror (so
+        stats/history carry the epoch and bundles carry cluster.json).
+        Returns False when ``epoch`` is OLDER than the stored one
+        (nothing applied — the caller answers WRONG_EPOCH)."""
+        blob = b"" if directory is None else json.dumps(directory).encode()
+        rc = int(self._lib.ist_server_cluster_set(
+            self._h, int(epoch), blob, int(phase), int(cursor),
+            int(total)))
+        return rc == 0
+
+    def migration_trip(self, detail, a0=0, a1=0):
+        """Fire the ``watchdog.migration`` verdict (the rebalance
+        coordinator's stalled-range trigger): catalog event + trip +
+        diagnostic bundle whose cluster.json carries the directory and
+        range cursor. False while the per-kind cooldown holds."""
+        return int(self._lib.ist_server_migration_trip(
+            self._h, str(detail).encode(), int(a0), int(a1)
+        )) == 1
+
     def restore(self, path):
         """Load a snapshot (existing keys win; stops when the pool is
         full, keeping what fits; a truncated tail keeps the valid
@@ -723,7 +775,8 @@ def _prometheus_metrics(stats, slo=None):
                       ("slow_op", "slow_op_trips"),
                       ("queue_growth", "queue_trips"),
                       ("slo_burn", "slo_trips"),
-                      ("thrash", "thrash_trips")):
+                      ("thrash", "thrash_trips"),
+                      ("migration", "migration_trips")):
         lines.append(
             f'infinistore_watchdog_trips_total{{kind="{kind}"}} '
             f'{wd.get(key, 0)}'
@@ -816,6 +869,36 @@ def _prometheus_metrics(stats, slo=None):
     lines.append(
         f'infinistore_workload_dedup_ratio '
         f'{wl.get("dedup_ratio_milli", 1000) / 1000.0}'
+    )
+    # Cluster tier (GET /directory has the full map): the directory
+    # epoch dashboards correlate with re-routing, and the live
+    # migration cursor (phase -1 = no migration in flight).
+    cl = stats.get("cluster", {})
+    lines.append(
+        "# HELP infinistore_cluster_epoch shard-directory epoch in "
+        "force (0 = not a cluster member)"
+    )
+    lines.append("# TYPE infinistore_cluster_epoch gauge")
+    lines.append(
+        f'infinistore_cluster_epoch {cl.get("epoch", 0)}'
+    )
+    lines.append(
+        "# HELP infinistore_cluster_migration_phase live key-range "
+        "migration phase (-1 idle, 1 export, 2 adopt, 3 evict)"
+    )
+    lines.append("# TYPE infinistore_cluster_migration_phase gauge")
+    lines.append(
+        f'infinistore_cluster_migration_phase '
+        f'{cl.get("migration_phase", -1)}'
+    )
+    lines.append(
+        "# HELP infinistore_cluster_migration_cursor chunks of the "
+        "in-flight range move completed on this shard"
+    )
+    lines.append("# TYPE infinistore_cluster_migration_cursor gauge")
+    lines.append(
+        f'infinistore_cluster_migration_cursor '
+        f'{cl.get("migration_cursor", 0)}'
     )
     # Metrics-history ring meta (the ring itself is GET /history).
     hist = stats.get("history", {})
@@ -912,6 +995,14 @@ def make_control_plane(server: InfiniStoreServer, snapshot_path=None,
                 # pool sizes, WSS estimate, eviction-quality counters,
                 # projected dedup ratio, heat classes.
                 self._send(200, server.workload())
+            elif self.path == "/directory":
+                # Cluster tier: the shard directory this server holds
+                # (epoch-numbered map + live migration phase/cursor)
+                # plus this server's own shard identity. Epoch 0 with a
+                # null directory = not (yet) a cluster member.
+                blob = server.cluster()
+                blob["shard_id"] = server.config.shard_id
+                self._send(200, blob)
             elif self.path == "/trace":
                 # Chrome trace-event JSON, already serialized natively:
                 # save the body to a file and load it in Perfetto
@@ -990,10 +1081,129 @@ def make_control_plane(server: InfiniStoreServer, snapshot_path=None,
             else:
                 self._send(404, {"error": "not found"})
 
+        def _json_body(self):
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            raw = self.rfile.read(length).decode(errors="replace")
+            try:
+                body = json.loads(raw) if raw.strip() else {}
+            except ValueError:
+                return None
+            return body if isinstance(body, dict) else None
+
+        def _post_directory(self):
+            """Install a pushed directory epoch. The WRONG_EPOCH
+            contract (the ctl-page-epoch idiom, cluster-sized): a
+            push older than what this shard holds is answered 409 +
+            the CURRENT map — the pusher learns the truth in the same
+            round trip, and a stale coordinator can never roll a shard
+            backwards."""
+            body = self._json_body()
+            if body is None or "epoch" not in body:
+                self._send(400, {"error": "directory body needs epoch"})
+                return
+            from .cluster import eval_failpoint
+
+            rc = eval_failpoint("cluster.directory_push")
+            if rc:
+                # Chaos: this shard refuses the push (partial
+                # propagation). 503 = retryable, distinct from the
+                # WRONG_EPOCH consistency answer.
+                self._send(503, {"error": "PUSH_REFUSED",
+                                 "errno": rc})
+                return
+            if not server.set_cluster(int(body["epoch"]), directory=body):
+                cur = server.cluster()
+                # The refused pusher gets the held MAP itself (plus the
+                # epoch for a quick compare) — the thing it should
+                # adopt and retry from, not the whole native mirror.
+                self._send(409, {"error": "WRONG_EPOCH",
+                                 "epoch": cur.get("epoch", 0),
+                                 "directory": cur.get("directory")})
+                return
+            self._send(200, {"epoch": int(body["epoch"])})
+
+        def _post_migrate(self):
+            """The live-rebalance data-plane verbs, driven by
+            cluster.ClusterCoordinator. All of them ride machinery the
+            store already owns: export = the snapshot extent codec over
+            one ring range, import = the restore path (first-writer-
+            wins), evict = ranged delete with per-entry epoch bumps,
+            verdict = the watchdog.migration trip. The cluster.*
+            failpoints fire here — kill exits the process (a source or
+            target dying mid-range), err fails the step loudly."""
+            from . import cluster as _cluster
+
+            body = self._json_body()
+            if body is None:
+                self._send(400, {"error": "bad JSON body"})
+                return
+            action = body.get("action")
+            epoch = server.cluster().get("epoch", 0)
+            try:
+                if action == "export":
+                    rc = _cluster.eval_failpoint("cluster.migrate_export")
+                    if rc:
+                        self._send(500, {"error": "export failed",
+                                         "errno": rc})
+                        return
+                    n = server.snapshot_range(
+                        body["path"], int(body["lo"]), int(body["hi"]))
+                    server.set_cluster(
+                        epoch, phase=_cluster.PHASE_EXPORT,
+                        cursor=int(body.get("cursor", 0)),
+                        total=int(body.get("total", 0)))
+                    self._send(200, {"exported": n})
+                elif action == "import":
+                    adopted = 0
+                    paths = body.get("paths", [])
+                    for i, path in enumerate(paths):
+                        rc = _cluster.eval_failpoint(
+                            "cluster.migrate_adopt")
+                        if rc:
+                            self._send(500, {"error": "adopt failed",
+                                             "errno": rc,
+                                             "adopted": adopted})
+                            return
+                        adopted += server.restore(path)
+                        server.set_cluster(
+                            epoch, phase=_cluster.PHASE_ADOPT,
+                            cursor=i + 1,
+                            total=int(body.get("total", len(paths))))
+                    self._send(200, {"adopted": adopted})
+                elif action == "evict":
+                    server.set_cluster(epoch,
+                                       phase=_cluster.PHASE_EVICT,
+                                       cursor=0, total=0)
+                    n = server.delete_range(int(body["lo"]),
+                                            int(body["hi"]))
+                    # Evict is the migration's last local step: return
+                    # the mirror to idle so the phase gauge (-1 idle)
+                    # does not report a migration forever. Export/adopt
+                    # phases on the OTHER shards were already reset by
+                    # the commit's directory push (set_cluster's
+                    # default phase is -1).
+                    server.set_cluster(epoch, phase=_cluster.PHASE_IDLE)
+                    self._send(200, {"evicted": n})
+                elif action == "verdict":
+                    fired = server.migration_trip(
+                        body.get("detail", "migration stalled"),
+                        int(body.get("a0", 0)), int(body.get("a1", 0)))
+                    self._send(200, {"fired": bool(fired)})
+                else:
+                    self._send(400, {"error": f"unknown action {action!r}"})
+            except KeyError as e:
+                self._send(400, {"error": f"missing field {e}"})
+            except Exception as e:  # noqa: BLE001 — surfaced to caller
+                self._send(500, {"error": str(e)})
+
         def do_POST(self):
             if self.path == "/purge":
                 n = server.purge()
                 self._send(200, {"purged": n})
+            elif self.path == "/directory":
+                self._post_directory()
+            elif self.path == "/migrate":
+                self._post_migrate()
             elif self.path == "/fault":
                 # Arm/disarm failpoints at runtime. Body: either a raw
                 # spec string ("disk.pwrite=once:err(5);...") or JSON
@@ -1148,6 +1358,13 @@ def parse_args(argv=None):
     p.add_argument("--bundle-keep", type=int, default=4,
                    help="diagnostic bundles retained in --bundle-dir "
                         "(oldest pruned first)")
+    p.add_argument("--shard-id", type=int, default=-1,
+                   help="this server's shard identity in the cluster "
+                        "tier's replicated shard directory (GET "
+                        "/directory reports it; POST /directory "
+                        "installs epoch-numbered maps; POST /migrate "
+                        "drives live key-range rebalance). -1 = not a "
+                        "cluster member")
     p.add_argument("--no-slo", action="store_true",
                    help="disable the SLO burn-rate tracker thread "
                         "(GET /slo still computes on demand)")
@@ -1179,6 +1396,12 @@ def parse_args(argv=None):
                    help="snapshot file for warm restarts: loaded at "
                         "startup when present, written by POST "
                         "/snapshot and on SIGINT/SIGTERM shutdown")
+    p.add_argument("--port-file", default="",
+                   help="write {\"service_port\", \"manage_port\", "
+                        "\"pid\"} as JSON here once both planes are "
+                        "up — how a supervisor (or the cluster chaos "
+                        "harness) discovers ephemeral ports without "
+                        "scraping logs")
     p.add_argument("--no-oom-protect", action="store_true")
     p.add_argument("--selftest", action="store_true",
                    help="start an ephemeral server, run the loopback "
@@ -1227,6 +1450,7 @@ def main(argv=None):
         watchdog=not args.no_watchdog,
         bundle_dir=args.bundle_dir,
         bundle_keep=args.bundle_keep,
+        shard_id=args.shard_id,
     )
     server = InfiniStoreServer(config)
     server.start()
@@ -1273,6 +1497,19 @@ def main(argv=None):
     httpd = make_control_plane(server, snapshot_path=args.snapshot_path,
                                slo=slo)
     Logger.info(f"manage plane on :{config.manage_port}")
+
+    if args.port_file:
+        import os
+
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "service_port": server.service_port,
+                "manage_port": httpd.server_address[1],
+                "shard_id": config.shard_id,
+                "pid": os.getpid(),
+            }, f)
+        os.rename(tmp, args.port_file)  # atomic: readers never see half
 
     stop = threading.Event()
 

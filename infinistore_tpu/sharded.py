@@ -54,6 +54,33 @@ unaffected; after the background reconnect succeeds the shard rejoins
 empty-handed for the lost keys (they 404 until re-put). Callers that
 need fail-stop semantics instead pass ``degrade_on_failure=False`` and
 get the original throw-through behavior.
+
+Cluster directory mode (ISSUE 14; docs/design.md "Cluster tier"): with
+a ``directory`` (an epoch-numbered shard map from
+``infinistore_tpu.cluster``) — or the ``replication``/``vnodes``
+shortcut, which synthesizes one over ``configs`` — routing moves from
+``crc32 % n`` to the directory's virtual-node consistent-hash ring:
+
+- **writes** (``put_cache`` / ``put_cache_async``) fan to every shard
+  in the key's N-way replica set; a key counts LOST only when every
+  targeted replica dropped it, so one shard death loses nothing that
+  was committed while its replica peer lived. The low-level
+  allocate/write_cache surface stays primary-routed (one block array
+  cannot carry N replicas' tokens) — callers that need the replication
+  guarantee use the fused puts, which is what the serving engine and
+  TpuKVStore do.
+- **reads** (``read_cache`` / ``check_exist`` / ``prefetch`` /
+  ``get_match_last_index``) go to the LEAST-LOADED live replica and
+  fail over along the replica set; the old degrade-to-absent answer
+  is the last resort after every replica failed, not the first
+  response — a dead replica keeps hot prefix chains servable.
+- **epochs**: the client rides directory epochs the way the pin cache
+  rides the ctl-page epoch. ``refresh_directory()`` adopts a newer
+  map (adding connections for new shards); a read that misses every
+  replica refreshes once and re-routes before answering absent, so a
+  stale client observes a re-route or a miss — never silently reads
+  a range that moved away ("WRONG_EPOCH, then the new map", the same
+  contract the control plane's POST /directory gives stale pushers).
 """
 
 import asyncio
@@ -76,6 +103,14 @@ def _shard_of(key, n):
     # spread over content-hash keys is uniform (verified to <2% skew on
     # 40k uuids across 3 and 4 shards).
     return zlib.crc32(key.encode()) % n
+
+
+def retry_has_untried(pairs, tried, replicas_of):
+    """True while some pending key still has a replica its read ladder
+    has not attempted (module-level for testability)."""
+    return any(
+        set(replicas_of(k)) - tried.get(k, set()) for k, _ in pairs
+    )
 
 
 class _ShardDown(Exception):
@@ -121,12 +156,59 @@ class ShardedConnection:
     """
 
     def __init__(self, configs, degrade_on_failure=True, io_threads=None,
-                 recover_interval_s=0.5):
+                 recover_interval_s=0.5, directory=None,
+                 directory_addrs=None, replication=None, vnodes=64):
         if not configs:
             raise ValueError("need at least one shard config")
         self.conns = [InfinityConnection(c) for c in configs]
         self.n = len(configs)
         self.io_threads = io_threads
+        # Cluster directory mode (module docstring): an explicit
+        # directory blob, or the replication/vnodes shortcut that
+        # synthesizes one over `configs` (shard ids = config order).
+        # Legacy static-hash routing (directory None, replication
+        # None/1 default) is byte-identical to every prior release.
+        self.directory = None
+        self.directory_epoch = 0
+        self.directory_addrs = list(directory_addrs or [])
+        self.replication = 1
+        # Miss-path refresh pacing (refresh_directory docstring).
+        self.refresh_min_interval_s = 1.0
+        self._last_refresh_t = -1e9
+        # Serializes refresh_directory/apply_directory end to end
+        # (RLock: refresh calls apply while holding it). Concurrent
+        # miss-path refreshes from user threads would otherwise
+        # double-install the same epoch — each dialing (and leaking)
+        # its own connection for the same new shard.
+        self._apply_lock = threading.RLock()
+        self._ring = None
+        self._sid_to_idx = {}
+        self._dir_lock = threading.Lock()
+        # Per-shard in-flight sub-call gauge (the read fan-out's
+        # least-loaded replica choice). GIL-atomic int bumps — a
+        # heuristic, not an invariant.
+        self._load = [0] * self.n
+        if directory is None and replication is not None:
+            from .cluster import build_directory
+
+            directory = build_directory(
+                [{"id": i, "host": c.host_addr,
+                  "service_port": c.service_port}
+                 for i, c in enumerate(configs)],
+                epoch=1, vnodes=vnodes, replication=replication,
+            )
+        if directory is not None:
+            if len(directory["shards"]) != len(configs):
+                raise ValueError(
+                    "directory names "
+                    f"{len(directory['shards'])} shards but "
+                    f"{len(configs)} configs were given (order must "
+                    "match shard-for-shard)")
+            self._install_directory(directory)
+        # Template for dialing shards a FUTURE directory epoch adds
+        # (apply_directory): the first config's knobs with host/port
+        # swapped in.
+        self._config_template = configs[0]
         # Recovery prober cadence (ISSUE 6 satellite): base interval
         # between redial passes; a pass in which NO dead shard came
         # back doubles the wait up to 8x base (bounded backoff — a
@@ -289,7 +371,177 @@ class ShardedConnection:
         return False
 
     def shard_of(self, key):
-        return _shard_of(key, self.n)
+        """The shard index a key's writes route to first: the legacy
+        static hash, or — directory mode — the key's primary replica
+        on the ring."""
+        return self._primary(key)
+
+    # -- cluster directory plumbing ------------------------------------
+
+    @classmethod
+    def from_directory(cls, directory, config_template=None, **kw):
+        """Build a sharded client FROM a directory blob (fetched via
+        ``cluster.fetch_directory`` or built by the coordinator): one
+        ClientConfig per directory shard, knobs copied from
+        ``config_template`` with host/service_port swapped in.
+        ``directory_addrs`` defaults to every shard's manage address
+        so epoch refresh works out of the box."""
+        import copy
+
+        from .config import ClientConfig
+
+        configs = []
+        addrs = kw.pop("directory_addrs", None)
+        if addrs is None:
+            addrs = [
+                f"{s.get('host', '127.0.0.1')}:{s['manage_port']}"
+                for s in directory["shards"] if "manage_port" in s
+            ]
+        for s in directory["shards"]:
+            c = (copy.copy(config_template) if config_template is not None
+                 else ClientConfig())
+            c.host_addr = s.get("host", "127.0.0.1")
+            c.service_port = s["service_port"]
+            configs.append(c)
+        return cls(configs, directory=directory, directory_addrs=addrs,
+                   **kw)
+
+    def _install_directory(self, directory):
+        """Adopt a directory blob: ring + id→conn-index map + epoch.
+        Caller ensures conns[] already covers every shard id (order
+        for the constructor, apply_directory for later epochs)."""
+        from .cluster import directory_ring
+
+        ring = directory_ring(directory)
+        with self._dir_lock:
+            self.directory = directory
+            self.directory_epoch = directory["epoch"]
+            self.replication = max(1, directory.get("replication", 1))
+            self._sid_to_idx = {
+                s["id"]: i for i, s in enumerate(directory["shards"])
+            }
+            self._ring = ring
+
+    def apply_directory(self, directory):
+        """Adopt a NEWER directory epoch at runtime: new shards get
+        connections dialed from the config template (a dial failure
+        degrades like any shard death — the prober keeps redialing);
+        shards no longer in the map keep their connections open but
+        stop receiving routes (their pool entries were evicted by the
+        migration commit). Returns True when the epoch advanced."""
+        with self._apply_lock:
+            return self._apply_directory_locked(directory)
+
+    def _apply_directory_locked(self, directory):
+        if directory["epoch"] <= self.directory_epoch:
+            return False
+        import copy
+
+        known = {s["id"] for s in (self.directory or {}).get("shards", [])}
+        # Conn indices of surviving shards stay STABLE: the loop below
+        # only EXTENDS conns/health arrays for unknown ids, never
+        # reorders — health/forensics arrays are index-aligned.
+        old_index = dict(self._sid_to_idx)
+        for s in directory["shards"]:
+            if s["id"] in known:
+                continue
+            c = copy.copy(self._config_template)
+            c.host_addr = s.get("host", "127.0.0.1")
+            c.service_port = s["service_port"]
+            conn = InfinityConnection(c)
+            self.conns.append(conn)
+            self.degraded.append(False)
+            self.shard_health.append(
+                {"failures": 0, "reconnects": 0, "last_error": ""})
+            self._load.append(0)
+            idx = len(self.conns) - 1
+            old_index[s["id"]] = idx
+            if self.connected:
+                try:
+                    conn.connect()
+                except Exception as e:  # noqa: BLE001 — degrade ladder
+                    if not (self.degrade and _is_conn_failure(e)):
+                        raise
+                    self._mark_dead(idx, e)
+            if "manage_port" in s:
+                addr = f"{s.get('host', '127.0.0.1')}:{s['manage_port']}"
+                if addr not in self.directory_addrs:
+                    self.directory_addrs.append(addr)
+        from .cluster import directory_ring
+
+        ring = directory_ring(directory)
+        with self._dir_lock:
+            self.directory = directory
+            self.directory_epoch = directory["epoch"]
+            self.replication = max(1, directory.get("replication", 1))
+            self._sid_to_idx = {
+                s["id"]: old_index[s["id"]] for s in directory["shards"]
+            }
+            self._ring = ring
+            self.n = len(self.conns)
+        return True
+
+    def refresh_directory(self, force=False):
+        """Poll the manage planes for a newer directory epoch (the
+        ctl-page-epoch idiom at cluster scale); adopts and returns True
+        when one shard answers with epoch > ours. Quietly False when no
+        address answers — routing keeps the map it has.
+
+        Rate-limited (``refresh_min_interval_s``, default 1 s) unless
+        ``force``: the read ladder calls this on replica-exhausted
+        misses, and an ordinary miss-heavy workload — where every miss
+        is just a miss — must not turn each one into a blocking
+        control-plane HTTP probe."""
+        if not self.directory_addrs:
+            return False
+        from .cluster import fetch_directory
+
+        with self._apply_lock:
+            # Stamp + fetch + apply all under the lock: a second
+            # thread blocked here re-checks the stamp and skips
+            # instead of re-fetching the epoch the winner installed.
+            now = time.monotonic()
+            if not force and now - self._last_refresh_t < \
+                    self.refresh_min_interval_s:
+                return False
+            self._last_refresh_t = now
+            for addr in self.directory_addrs:
+                try:
+                    blob = fetch_directory(addr, timeout=5.0)
+                except Exception:  # noqa: BLE001 — next address
+                    continue
+                d = blob.get("directory")
+                if d and d.get("epoch", 0) > self.directory_epoch:
+                    return self.apply_directory(d)
+        return False
+
+    def _primary(self, key):
+        if self._ring is None:
+            return _shard_of(key, self.n)
+        return self._replicas(key)[0]
+
+    def _replicas(self, key):
+        """Conn indices of the key's replica set (ring order); length 1
+        in legacy mode."""
+        if self._ring is None:
+            return [_shard_of(key, self.n)]
+        with self._dir_lock:
+            ring, m = self._ring, self._sid_to_idx
+        return [m[sid] for sid in ring.replica_set(key) if sid in m]
+
+    def _choose_read_shard(self, key, tried=()):
+        """The read fan-out's replica choice: among the key's replicas
+        not yet tried, prefer live (non-degraded) ones and the lowest
+        in-flight load; fall back to a degraded one (it may have
+        rejoined) only when no live candidate remains. None = every
+        replica tried."""
+        reps = [s for s in self._replicas(key) if s not in tried]
+        if not reps:
+            return None
+        live = [s for s in reps
+                if not (self.degrade and self.degraded[s])]
+        pool = live or reps
+        return min(pool, key=lambda s: (self._load[s], s))
 
     def set_trace_id(self, trace_id):
         """Pin ``trace_id`` onto every healthy shard connection (0
@@ -371,14 +623,18 @@ class ShardedConnection:
 
     # -- fan-out plumbing ----------------------------------------------
 
-    def _run_shard_calls(self, calls):
+    def _run_shard_calls(self, calls, tolerate=()):
         """Run [(shard, fn, args)] concurrently on the shard pool;
         returns [(ok, value_or_exc)] in call order. Known-down shards
         are skipped up front; a connection-class failure marks its
         shard down (degrade mode) and comes back as (False, exc) for
         the caller to apply op semantics; anything else re-raises after
         every in-flight call has been collected (never orphan a native
-        call)."""
+        call). ``tolerate``: exception types additionally returned as
+        (False, exc) WITHOUT marking the shard down or re-raising —
+        the read ladder passes InfiniStoreKeyNotFound so a key absent
+        on one replica (written while it was down, or moved by a
+        migration) retries the next replica instead of failing the op."""
         out = [None] * len(calls)
         live = []
         for j, (s, fn, args) in enumerate(calls):
@@ -386,16 +642,27 @@ class ShardedConnection:
                 out[j] = (False, _ShardDown(s))
             else:
                 live.append((j, s, fn, args))
+        # In-flight gauge around each sub-call: the least-loaded
+        # replica choice reads it. GIL-atomic += on ints; the finally
+        # keeps it balanced on every exception path.
+        def run(s, fn, args):
+            self._load[s] += 1
+            try:
+                return fn(*args)
+            finally:
+                self._load[s] -= 1
+
         if len(live) <= 1 or self._pool is None or not self.parallel:
             results = []
             for j, s, fn, args in live:
                 try:
-                    results.append((j, s, True, fn(*args)))
+                    results.append((j, s, True, run(s, fn, args)))
                 except BaseException as e:  # noqa: BLE001 — sorted below
                     results.append((j, s, False, e))
         else:
             futs = [
-                (j, s, self._pool.submit(fn, *args)) for j, s, fn, args in live
+                (j, s, self._pool.submit(run, s, fn, args))
+                for j, s, fn, args in live
             ]
             results = []
             for j, s, f in futs:
@@ -408,6 +675,8 @@ class ShardedConnection:
             if not ok:
                 if self.degrade and _is_conn_failure(v):
                     self._mark_dead(s, v)
+                elif tolerate and isinstance(v, tolerate):
+                    pass  # caller applies replica-retry semantics
                 elif first_err is None:
                     first_err = v
             out[j] = (ok, v)
@@ -430,10 +699,11 @@ class ShardedConnection:
     # -- partitioned data path -----------------------------------------
 
     def _partition(self, keys):
-        """→ per-shard (indices, keys) preserving input order per shard."""
+        """→ per-shard (indices, keys) preserving input order per
+        shard; routes by the primary replica in directory mode."""
         parts = {}
         for i, k in enumerate(keys):
-            s = _shard_of(k, self.n)
+            s = self._primary(k)
             if s not in parts:
                 parts[s] = ([], [])
             parts[s][0].append(i)
@@ -527,10 +797,12 @@ class ShardedConnection:
         every shard's deferred commit batch. Lease-less shards take the
         classic allocate+write path unchanged."""
         self._stamp_trace()
+        if self._ring is not None and self.replication > 1:
+            return self._put_cache_replicated(cache, blocks, page_size)
         if any(c.config.use_lease for c in self.conns):
             parts = {}
             for k, off in blocks:
-                parts.setdefault(_shard_of(k, self.n), []).append((k, off))
+                parts.setdefault(self._primary(k), []).append((k, off))
             parts = list(parts.items())
             results = self._run_shard_calls(
                 [(s, self.conns[s].put_cache, (cache, pairs, page_size))
@@ -554,33 +826,92 @@ class ShardedConnection:
         self.sync()
         return 0
 
+    def _replica_write_parts(self, blocks):
+        """Partition (key, offset) pairs so every key lands on EVERY
+        shard of its replica set — the N-way write fan."""
+        parts = {}
+        for k, off in blocks:
+            for s in self._replicas(k):
+                parts.setdefault(s, []).append((k, off))
+        return list(parts.items())
+
+    def _count_replica_losses(self, parts, ok_flags):
+        """A key is LOST only when every replica that was supposed to
+        hold it failed — one surviving copy keeps it readable through
+        the fan-out ladder. Failed-but-survived keys are the replica
+        repair debt the rejoining shard carries (absent there until
+        re-put), which the health counters do not double-book."""
+        acked, attempted = set(), set()
+        for (s, pairs), ok in zip(parts, ok_flags):
+            for k, _off in pairs:
+                attempted.add(k)
+                if ok:
+                    acked.add(k)
+        lost = len(attempted - acked)
+        if lost:
+            with self._health_lock:
+                self.health["lost_write_keys"] += lost
+        return lost
+
+    def _put_cache_replicated(self, cache, blocks, page_size):
+        """Directory-mode put: each key's batch rides every replica's
+        per-shard put_cache (lease-mode shards keep their zero-RTT
+        path — replication costs R× bytes, never a protocol change),
+        then one sync barriers the fan. Committed = acked by every
+        replica that was LIVE at put time; with R >= 2 a single shard
+        death therefore never loses a committed key, the chaos
+        acceptance tests/test_cluster.py pins."""
+        parts = self._replica_write_parts(blocks)
+        results = self._run_shard_calls(
+            [(s, self.conns[s].put_cache, (cache, pairs, page_size))
+             for s, pairs in parts]
+        )
+        self._count_replica_losses(parts, [ok for ok, _v in results])
+        self.sync()
+        return 0
+
     async def put_cache_async(self, cache, blocks, page_size):
         """Async sharded put: per-shard put_cache_async concurrently.
         Down shards drop their whole partition, counted entirely in
         ``lost_write_keys`` — allocate+write fuse inside the per-shard
         call here, so the sync path's skipped-alloc/lost-write split
-        does not apply (no separate allocate ever ran for these keys)."""
-        parts = {}
-        for k, off in blocks:
-            parts.setdefault(_shard_of(k, self.n), []).append((k, off))
+        does not apply (no separate allocate ever ran for these keys).
+        Directory mode fans each key to its whole replica set and
+        counts a key lost only when EVERY replica dropped it (the
+        same contract as the sync path)."""
+        replicated = self._ring is not None and self.replication > 1
+        if replicated:
+            parts = dict(self._replica_write_parts(blocks))
+        else:
+            parts = {}
+            for k, off in blocks:
+                parts.setdefault(self._primary(k), []).append((k, off))
         live = {s: p for s, p in parts.items()
                 if not (self.degrade and self.degraded[s])}
-        dropped = sum(len(p) for s, p in parts.items() if s not in live)
         results = await asyncio.gather(
             *[self.conns[s].put_cache_async(cache, pairs, page_size)
               for s, pairs in live.items()],
             return_exceptions=True,
         )
+        ok_by_shard = {s: False for s in parts}
         for (s, pairs), r in zip(live.items(), results):
             if isinstance(r, BaseException):
                 if self.degrade and _is_conn_failure(r):
                     self._mark_dead(s, r)
-                    dropped += len(pairs)
                 else:
                     raise r
-        if dropped:
-            with self._health_lock:
-                self.health["lost_write_keys"] += dropped
+            else:
+                ok_by_shard[s] = True
+        if replicated:
+            self._count_replica_losses(
+                list(parts.items()),
+                [ok_by_shard[s] for s in parts])
+        else:
+            dropped = sum(
+                len(p) for s, p in parts.items() if not ok_by_shard[s])
+            if dropped:
+                with self._health_lock:
+                    self.health["lost_write_keys"] += dropped
         return 0
 
     def reconnect(self):
@@ -593,10 +924,21 @@ class ShardedConnection:
             self.degraded = [False] * self.n
         return 0
 
-    def _read_parts(self, blocks):
+    def _read_parts(self, blocks, tried=None):
+        """Partition read pairs by target shard. Legacy: the static
+        hash. Directory mode: the least-loaded live replica not yet in
+        ``tried[key]`` (the failover ladder's chooser); pairs whose
+        every replica has been tried land under the ``None`` bucket —
+        exhausted, degrade-to-absent is all that is left for them."""
         parts = {}
+        if self._ring is None:
+            for k, off in blocks:
+                parts.setdefault(_shard_of(k, self.n), []).append((k, off))
+            return parts
         for k, off in blocks:
-            parts.setdefault(_shard_of(k, self.n), []).append((k, off))
+            s = self._choose_read_shard(
+                k, tried.get(k, ()) if tried else ())
+            parts.setdefault(s, []).append((k, off))
         return parts
 
     def _read_chunks(self, pairs):
@@ -614,41 +956,132 @@ class ShardedConnection:
         with self._health_lock:
             self.health["missed_read_keys"] += len(missed)
         raise InfiniStoreKeyNotFound(
-            404, f"shard(s) unavailable for keys {missed[:4]}"
+            404, "keys unavailable (shard down) or absent on every "
+            f"replica: {missed[:4]}"
             + ("..." if len(missed) > 4 else "")
         )
 
+    def _replica_read_call(self, conn, cache, chunk, page_size):
+        """One replicated-read sub-call, with the cluster.replica_read
+        chaos gate in front: an armed failpoint simulates the replica
+        dying exactly at read time (the fan-out must fail over), which
+        is how tests kill a replica mid-read deterministically."""
+        from .cluster import eval_failpoint
+
+        rc = eval_failpoint("cluster.replica_read")
+        if rc:
+            raise InfiniStoreError(
+                INTERNAL_ERROR,
+                f"injected replica read failure (errno {rc})")
+        return conn.read_cache(cache, chunk, page_size)
+
+    def _read_pass(self, cache, pairs, page_size, tried, isolate):
+        """One fan-out attempt over ``pairs``: route each key to its
+        chosen replica, run the sub-calls, record the attempt in
+        ``tried`` and return the pairs that still need another replica
+        (plus the pairs whose replica set is exhausted). ``isolate``
+        accumulates keys from chunks that failed with a DEFINITIVE
+        KeyNotFound: batch reads are all-or-nothing server-side, so
+        one genuinely absent key fails its whole chunk — retrying
+        those pairs as single-pair chunks confines the miss to the
+        missing key instead of re-reading the chunk against every
+        replica (the miss-amplification fix)."""
+        parts = list(self._read_parts(pairs, tried=tried).items())
+        exhausted = []
+        calls, tags = [], []
+        for s, chunk_pairs in parts:
+            if s is None:
+                exhausted.extend(chunk_pairs)
+                continue
+            for k, _ in chunk_pairs:
+                tried.setdefault(k, set()).add(s)
+            grouped = [p for p in chunk_pairs if p[0] not in isolate]
+            chunks = self._read_chunks(grouped) if grouped else []
+            chunks += [[p] for p in chunk_pairs if p[0] in isolate]
+            for chunk in chunks:
+                fn = (self.conns[s].read_cache if self._ring is None
+                      else self._replica_read_call)
+                args = ((cache, chunk, page_size) if self._ring is None
+                        else (self.conns[s], cache, chunk, page_size))
+                calls.append((s, fn, args))
+                tags.append(chunk)
+        results = self._run_shard_calls(
+            calls,
+            tolerate=(InfiniStoreKeyNotFound,)
+            if self._ring is not None else (),
+        )
+        retry = []
+        for chunk, (ok, v) in zip(tags, results):
+            if ok:
+                continue
+            if isinstance(v, InfiniStoreKeyNotFound):
+                isolate.update(k for k, _ in chunk)
+            retry.extend(chunk)
+        return retry, exhausted
+
     def read_cache(self, cache, blocks, page_size):
         """Read (key, offset) pairs from their owning shards
-        (concurrent). If a shard is down, the HEALTHY shards' pages
-        still land in ``cache``, then the call raises
-        InfiniStoreKeyNotFound for the unreachable keys — identical to
-        the evicted-key miss every cache-style caller already handles."""
+        (concurrent). Directory mode reads the least-loaded live
+        replica and FAILS OVER along each key's replica set (a replica
+        death mid-read retries the survivors; a key absent on one
+        replica — written while that replica was down — is found on
+        its peer). Only when every replica of a key has failed (and,
+        with directory_addrs, a directory refresh brought no newer
+        epoch to re-route under) does the call raise
+        InfiniStoreKeyNotFound for the leftovers — the same
+        degrade-to-absent the static-hash client answered FIRST, now
+        demoted to the last resort. Healthy keys' pages land in
+        ``cache`` regardless."""
         self._stamp_trace()
-        parts = list(self._read_parts(blocks).items())
-        calls, tags = [], []
-        for s, pairs in parts:
-            for chunk in self._read_chunks(pairs):
-                calls.append(
-                    (s, self.conns[s].read_cache, (cache, chunk, page_size))
-                )
-                tags.append(chunk)
-        results = self._run_shard_calls(calls)
-        missed = [
-            k for chunk, (ok, _v) in zip(tags, results)
-            if not ok for k, _ in chunk
-        ]
+        tried = {}
+        isolate = set()
+        pending = list(blocks)
+        missed = []
+        refreshed = False
+        # Budget: a full ladder over the CURRENT map, and — after the
+        # one refresh — a full ladder over the new map too (the tried
+        # reset restarts the replica walk; the refreshed flag bounds
+        # the loop).
+        max_passes = (1 if self._ring is None
+                      else 2 * (max(self.replication, 1) + 1))
+        for _ in range(max_passes):
+            if not pending:
+                break
+            retry, exhausted = self._read_pass(
+                cache, pending, page_size, tried, isolate)
+            missed.extend(exhausted)
+            pending = retry
+            if pending and not retry_has_untried(pending, tried,
+                                                 self._replicas):
+                # Every replica of every pending key has failed. The
+                # pin-cache-epoch move: ONE directory refresh — a
+                # migration may have re-homed the range — then one
+                # more ladder under the new map.
+                if (not refreshed and self.directory_addrs
+                        and self.refresh_directory()):
+                    refreshed = True
+                    tried = {}
+                    continue
+                break
+        missed.extend(pending)
         if missed:
-            self._raise_missed(missed)
+            self._raise_missed([k for k, _ in missed])
         return 0
 
     async def read_cache_async(self, cache, blocks, page_size):
-        """Async sharded read; same degrade contract as read_cache."""
-        parts = list(self._read_parts(blocks).items())
+        """Async sharded read; same degrade contract as read_cache.
+        Directory mode routes each key to its preferred live replica
+        (one attempt — the async surface trades the failover ladder
+        for latency; callers that need the ladder use the sync path)."""
+        routed = self._read_parts(blocks)
+        # Directory mode's None bucket: every replica degraded —
+        # nothing to dial, straight to the miss answer.
+        missed = [k for k, _ in routed.pop(None, [])]
+        parts = list(routed.items())
         live = [(s, p) for s, p in parts
                 if not (self.degrade and self.degraded[s])]
-        missed = [k for s, p in parts
-                  if self.degrade and self.degraded[s] for k, _ in p]
+        missed += [k for s, p in parts
+                   if self.degrade and self.degraded[s] for k, _ in p]
         results = await asyncio.gather(
             *[self.conns[s].read_cache_async(cache, pairs, page_size)
               for s, pairs in live],
@@ -675,7 +1108,11 @@ class ShardedConnection:
         parts = {}
         for k, b in zip(keys, blocks):
             if b["status"] == _OK and b["token"] != FAKE_TOKEN:
-                parts.setdefault(_shard_of(k, self.n), []).append(
+                # Route by the same shard allocate() used (ring primary
+                # in directory mode): tokens are per-shard numbers, so
+                # a mis-routed abort could cancel an UNRELATED in-flight
+                # allocation that happens to hold the same token id.
+                parts.setdefault(self._primary(k), []).append(
                     int(b["token"])
                 )
         self._run_shard_calls(
@@ -727,12 +1164,23 @@ class ShardedConnection:
 
     def check_exist(self, key):
         """Routed to the owning shard; a down shard's keys are absent
-        (False), matching the read contract."""
-        s = _shard_of(key, self.n)
-        [(ok, v)] = self._run_shard_calls(
-            [(s, self.conns[s].check_exist, (key,))]
-        )
-        return v if ok else False
+        (False), matching the read contract. Directory mode walks the
+        replica set (a key written while one replica was down exists
+        only on its peers) before answering False."""
+        tried = set()
+        for _ in range(max(1, self.replication)):
+            s = self._choose_read_shard(key, tried)
+            if s is None:
+                return False
+            tried.add(s)
+            [(ok, v)] = self._run_shard_calls(
+                [(s, self.conns[s].check_exist, (key,))]
+            )
+            if ok and v:
+                return v
+            if ok and self._ring is None:
+                return v  # definitive single-owner answer
+        return False
 
     def _merge_match(self, keys, parts, shard_matches):
         """Merge per-shard prefix-search results into the global longest
@@ -768,14 +1216,42 @@ class ShardedConnection:
         variant (TpuKVStore.cached_prefix_len depends on it). A down
         shard reports -1 for its subsequence, so its first owned key
         becomes the hole: prefix reuse SHRINKS under failure, it never
-        claims unreachable pages."""
-        parts = list(self._partition(keys).items())
-        results = self._run_shard_calls(
-            [(s, self.conns[s]._match_last_index_raw, (ks,))
-             for s, (_idxs, ks) in parts]
-        )
+        claims unreachable pages. Directory mode probes each key's
+        preferred LIVE replica instead of a fixed owner, so a replica
+        death does not shrink the reusable prefix while its peer still
+        holds the chain — the hot-prefix availability property."""
+        attempts = 1 if self._ring is None else max(self.replication, 1)
+        for attempt in range(attempts):
+            parts = list(self._match_partition(keys).items())
+            results = self._run_shard_calls(
+                [(s, self.conns[s]._match_last_index_raw, (ks,))
+                 for s, (_idxs, ks) in parts]
+            )
+            if all(ok for ok, _v in results) or attempt + 1 == attempts:
+                break
+            # Directory mode: a sub-call just DISCOVERED a dead replica
+            # (marked degraded above). Re-partition — the chooser now
+            # routes those keys to live peers — instead of letting the
+            # first failure after a death shrink the reusable prefix.
         matches = [v if ok else -1 for ok, v in results]
         return self._merge_match(keys, parts, matches)
+
+    def _match_partition(self, keys):
+        """Prefix-probe partition: like _partition, but in directory
+        mode each key routes to its preferred LIVE replica (the
+        chooser the read ladder uses) rather than a fixed owner."""
+        if self._ring is None:
+            return self._partition(keys)
+        parts = {}
+        for i, k in enumerate(keys):
+            s = self._choose_read_shard(k)
+            if s is None:  # cannot happen with an empty tried set
+                s = self._primary(k)
+            if s not in parts:
+                parts[s] = ([], [])
+            parts[s][0].append(i)
+            parts[s][1].append(k)
+        return parts
 
     async def get_match_last_index_async(self, keys):
         # Default executor, NOT self._pool: the sync raw variant fans
@@ -795,9 +1271,13 @@ class ShardedConnection:
         that shard (concurrent fan-out). Advisory like the single-server
         call — a down shard's partition is silently skipped (its keys
         would miss on read anyway, the documented degrade contract).
-        ``wait=True`` merges the per-shard count dicts."""
+        ``wait=True`` merges the per-shard count dicts.
+
+        Directory mode routes each key to the same preferred live
+        replica the read fan-out would pick — warming a replica the
+        reads will not touch would spend tier bandwidth for nothing."""
         self._stamp_trace()
-        parts = list(self._partition(keys).items())
+        parts = list(self._match_partition(keys).items())
         results = self._run_shard_calls(
             [(s, self.conns[s].prefetch, (ks, wait))
              for s, (_idxs, ks) in parts]
@@ -809,9 +1289,19 @@ class ShardedConnection:
             if ok and isinstance(v, dict):
                 for k in merged:
                     merged[k] += v.get(k, 0)
+            elif ok:
+                # ClientConfig.prefetch=False on that conn: the call
+                # succeeded but was an advisory no-op (v is None). The
+                # keys are NOT missing — the shard is healthy and reads
+                # will serve them — they were simply not queued. The
+                # dead-shard chaos test surfaced this miscount: a fully
+                # healthy store used to report every key "missing"
+                # whenever client-side prefetch was disabled, lying to
+                # callers that use `missing` as a re-put signal.
+                merged["skipped"] += len(ks)
             else:
-                # Down shard (or prefetch disabled on that conn): its
-                # keys are unreachable/unqueued, never resident.
+                # Down shard: its keys are unreachable/unqueued on the
+                # chosen replica, never resident.
                 merged["missing"] += len(ks)
         return merged
 
@@ -822,12 +1312,34 @@ class ShardedConnection:
         )
 
     def delete_keys(self, keys):
-        parts = list(self._partition(keys).items())
-        results = self._run_shard_calls(
-            [(s, self.conns[s].delete_keys, (ks,))
-             for s, (_idxs, ks) in parts]
-        )
-        return sum(v for ok, v in results if ok)
+        """Delete from the owning shard — or, directory mode, from
+        EVERY replica (a delete that skipped a replica would resurrect
+        the key through the read ladder). Returns keys deleted on at
+        least one shard in directory mode, the summed count otherwise."""
+        if self._ring is None or self.replication <= 1:
+            parts = list(self._partition(keys).items())
+            results = self._run_shard_calls(
+                [(s, self.conns[s].delete_keys, (ks,))
+                 for s, (_idxs, ks) in parts]
+            )
+            return sum(v for ok, v in results if ok)
+        # One call set per REPLICA RANK (rank 0 = primaries): replica
+        # copies must all go, but summing their per-shard counts would
+        # over-report, so only the primary rank's counts are returned —
+        # the primary holds exactly the committed keys.
+        calls, rank0 = [], []
+        for rank in range(self.replication):
+            parts = {}
+            for k in keys:
+                reps = self._replicas(k)
+                if rank < len(reps):
+                    parts.setdefault(reps[rank], []).append(k)
+            for s, ks in parts.items():
+                calls.append((s, self.conns[s].delete_keys, (ks,)))
+                rank0.append(rank == 0)
+        results = self._run_shard_calls(calls)
+        return sum(v for primary, (ok, v) in zip(rank0, results)
+                   if primary and ok)
 
     def client_stats(self):
         """Client-side telemetry aggregated across shards (ISSUE 11):
@@ -862,10 +1374,17 @@ class ShardedConnection:
         for s in ops.values():
             s["p50_us"] = _hist_percentile_us(s["hist"], 0.50)
             s["p99_us"] = _hist_percentile_us(s["hist"], 0.99)
+        # One-sided fabric telemetry, merged (ISSUE 14 satellite): see
+        # lib.merge_fabric_stats for the AND/OR semantics of the mode
+        # flags.
+        from .lib import merge_fabric_stats
+
+        fabric = merge_fabric_stats(per)
         return {
             "enabled": any(ps.get("enabled") for ps in per),
             "ops": ops,
             "counters": counters,
+            "fabric": fabric,
             "per_shard": per,
         }
 
@@ -913,6 +1432,11 @@ class ShardedConnection:
                 for i, h in enumerate(self.shard_health)
             ]
             summary["recover_interval_s"] = self.recover_interval_s
+            # Cluster directory mode: the epoch routing runs under and
+            # the replica factor — what an operator needs next to the
+            # per-shard forensics to judge "is this client stale".
+            summary["directory_epoch"] = self.directory_epoch
+            summary["replication"] = self.replication
         return per + [{"sharded_health": summary}]
 
 

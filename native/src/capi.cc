@@ -11,6 +11,8 @@
 // structured arrays (PYBIND11_NUMPY_DTYPE(remote_block_t), pybind.cpp:47);
 // here the caller passes a preallocated RemoteBlock[n] that numpy can view
 // with a structured dtype — the same zero-copy effect.
+#include <errno.h>
+
 #include <chrono>
 #include <condition_variable>
 #include <cstring>
@@ -139,10 +141,20 @@ extern "C" {
 // counters, projected dedup ratio, heat classes), stats gains the
 // workload section, history samples carry premature_evictions_delta /
 // thrash_cycles_delta / wss_bytes, new watchdog.thrash catalog event
-// + verdict kind, bundles gain workload.json.
+// + verdict kind, bundles gain workload.json; v14: cluster robustness
+// tier — new ist_server_cluster_set / ist_server_cluster (epoch-
+// numbered shard-directory mirror: stats/history gain the cluster
+// section and cluster_epoch, bundles gain cluster.json),
+// ist_server_snapshot_range / ist_server_delete_range (key-range
+// migration over the snapshot extent codec, CRC-32 ring coordinates
+// shared with the Python router), ist_server_migration_trip (new
+// watchdog.migration verdict kind + catalog event),
+// ist_cluster_failpoint / ist_fault_arm (control-plane/client-side
+// chaos eval of the new cluster.* failpoints), new cluster.epoch_bump
+// / cluster.migration_phase catalog events.
 // _native.py probes this at load so a stale prebuilt library fails
 // loudly instead of feeding unparseable blobs to the server.
-uint32_t ist_abi_version(void) { return 13; }
+uint32_t ist_abi_version(void) { return 14; }
 
 void ist_set_log_level(int level) { set_log_level(level); }
 void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }
@@ -257,6 +269,115 @@ long long ist_server_restore(void* h, const char* path) {
     } catch (...) {
         return -1;
     }
+}
+
+// ---- cluster tier (ABI v14) --------------------------------------------
+
+// Range-filtered snapshot: every committed entry whose CRC-32 ring
+// coordinate (KVIndex::ring_hash — byte-identical to the Python
+// router's zlib.crc32) falls in [ring_lo, ring_hi) (wrap-around when
+// lo > hi) serializes to `path` in the ordinary snapshot format. The
+// live-rebalance export half: the target adopts the file with
+// ist_server_restore. Returns entries written, -1 on IO error.
+long long ist_server_snapshot_range(void* h, const char* path,
+                                    uint64_t ring_lo, uint64_t ring_hi) {
+    if (h == nullptr || path == nullptr) return -1;
+    try {
+        return static_cast<Server*>(h)->snapshot(path, ring_lo, ring_hi);
+    } catch (...) {
+        return -1;
+    }
+}
+
+// Drop every committed entry in the ring-hash range (the migration
+// commit's source-side evict; per-entry epoch bumps exactly like
+// OP_DELETE). Returns entries erased, -1 on a null handle.
+long long ist_server_delete_range(void* h, uint64_t ring_lo,
+                                  uint64_t ring_hi) {
+    if (h == nullptr) return -1;
+    try {
+        return static_cast<Server*>(h)->delete_range(ring_lo, ring_hi);
+    } catch (...) {
+        return -1;
+    }
+}
+
+// Push the epoch-numbered shard-directory blob (and live migration
+// phase/cursor/total) down to the native mirror — stats/history carry
+// the epoch, bundles carry cluster.json, GET /directory serves the
+// blob back. Returns 0 applied, -1 when `epoch` is OLDER than the
+// stored one (nothing applied; the control plane answers WRONG_EPOCH).
+int ist_server_cluster_set(void* h, uint64_t epoch, const char* dir_json,
+                           long long phase, uint64_t cursor,
+                           uint64_t total) {
+    if (h == nullptr) return -1;
+    return static_cast<Server*>(h)->cluster_set(
+        epoch, dir_json != nullptr ? dir_json : "", phase, cursor, total);
+}
+
+// The native cluster mirror as JSON: {"epoch", "migration_phase",
+// "migration_cursor", "migration_total", "directory": blob-or-null}.
+// Same snprintf contract as ist_server_stats.
+long long ist_server_cluster(void* h, char* buf, long long cap) {
+    if (h == nullptr) return -1;
+    return copy_blob(static_cast<Server*>(h)->cluster_json(), buf, cap);
+}
+
+// Migration-stall verdict (the rebalance coordinator's trigger):
+// watchdog.migration catalog event, a migration trip and — with a
+// bundle dir — a diagnostic bundle whose cluster.json carries the
+// directory + range cursor. Returns 1 fired, 0 cooling, -1 null handle.
+int ist_server_migration_trip(void* h, const char* detail, uint64_t a0,
+                              uint64_t a1) {
+    if (h == nullptr) return -1;
+    return static_cast<Server*>(h)->migration_trip(
+               detail != nullptr ? detail : "", a0, a1)
+               ? 1
+               : 0;
+}
+
+// Evaluate one cluster.* failpoint from the control plane / client
+// fan-out (the chaos harness for paths that live in Python: range
+// export chunks, target adopts, replicated-read sub-calls, directory
+// pushes). Encoding: 0 = pass (delay policies sleep inside check()),
+// > 0 = fail with that errno, -2 = the caller must treat this process
+// as killed here (os._exit — a migration source/target dying
+// mid-range), -1 = unknown point. Call sites stay LITERAL per point so
+// the invariant linter pins each catalog row to a live site.
+int ist_cluster_failpoint(const char* point) {
+    if (point == nullptr) return -1;
+    FailHit hit;
+    if (strcmp(point, "cluster.migrate_export") == 0) {
+        hit = IST_FAILPOINT("cluster.migrate_export");
+    } else if (strcmp(point, "cluster.migrate_adopt") == 0) {
+        hit = IST_FAILPOINT("cluster.migrate_adopt");
+    } else if (strcmp(point, "cluster.replica_read") == 0) {
+        hit = IST_FAILPOINT("cluster.replica_read");
+    } else if (strcmp(point, "cluster.directory_push") == 0) {
+        hit = IST_FAILPOINT("cluster.directory_push");
+    } else {
+        return -1;
+    }
+    if (!hit) return 0;
+    if (hit.action == FAIL_KILL) return -2;
+    return hit.err > 0 ? hit.err : EIO;
+}
+
+// Arm/disarm failpoints WITHOUT a server handle: the registry is
+// process-global, and the client-side cluster chaos (replica-read
+// failover) runs in processes that host no server — ist_server_fault's
+// handle anchor would force a throwaway store just to arm a point.
+// Same spec grammar/all-or-nothing contract as ist_server_fault.
+int ist_fault_arm(const char* spec, char* err, int errcap) {
+    if (spec == nullptr) return -1;
+    std::string why;
+    int n = failpoints_arm_spec(spec, &why);
+    if (n < 0 && err != nullptr && errcap > 0) {
+        int c = int(why.size()) >= errcap ? errcap - 1 : int(why.size());
+        memcpy(err, why.data(), size_t(c));
+        err[c] = 0;
+    }
+    return n;
 }
 
 // Drain the flight recorder (events.h) as JSON: every stable event

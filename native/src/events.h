@@ -85,6 +85,9 @@ namespace istpu {
     X(EV_WATCHDOG_QUEUE_GROWTH, "watchdog.queue_growth", SEV_ERROR) \
     X(EV_WATCHDOG_THRASH, "watchdog.thrash", SEV_ERROR)             \
     X(EV_SLO_BURN, "watchdog.slo_burn", SEV_ERROR)                  \
+    X(EV_WATCHDOG_MIGRATION, "watchdog.migration", SEV_ERROR)       \
+    X(EV_CLUSTER_EPOCH_BUMP, "cluster.epoch_bump", SEV_INFO)        \
+    X(EV_CLUSTER_MIGRATION_PHASE, "cluster.migration_phase", SEV_INFO) \
     X(EV_BUNDLE_CAPTURED, "watchdog.bundle", SEV_INFO)
 
 enum EventSeverity : uint8_t {

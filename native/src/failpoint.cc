@@ -212,6 +212,8 @@ const char* const kCatalog[] = {
     "pool.alloc",   "worker.reclaim", "worker.spill", "worker.promote",
     "sock.recv",    "sock.send",    "lease.commit",
     "engine.uring_setup", "engine.fabric_setup", "fabric.doorbell",
+    "cluster.migrate_export", "cluster.migrate_adopt",
+    "cluster.replica_read", "cluster.directory_push",
 };
 
 bool in_catalog(const std::string& name) {
@@ -323,9 +325,15 @@ bool parse_point(const std::string& text, ParsedPoint* out,
                        text + "'";
             return false;
         }
-        if (!is_worker && out->action == FAIL_KILL) {
-            *err_out = "kill is only valid on worker.* points in '" +
-                       text + "'";
+        // cluster.* points are evaluated from the control plane
+        // (ist_cluster_failpoint), where kill means "this PROCESS dies
+        // here" — the chaos harness for killing a migration source/
+        // target mid-range. Everywhere else kill would fire into a
+        // no-op, so it stays worker/cluster-only.
+        const bool is_cluster = out->name.compare(0, 8, "cluster.") == 0;
+        if (!is_worker && !is_cluster && out->action == FAIL_KILL) {
+            *err_out = "kill is only valid on worker.*/cluster.* points "
+                       "in '" + text + "'";
             return false;
         }
     }

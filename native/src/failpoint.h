@@ -53,6 +53,20 @@
 //                  doorbell): commits posted to the shm ring must
 //                  still land via the next drain attempt — the
 //                  liveness property the chaos suite pins
+//   cluster.migrate_export  source-side range-export chunk fails
+//                  (err), stalls (delay) or the source process dies
+//                  mid-range (kill — evaluated from the control plane
+//                  via ist_cluster_failpoint, which turns kill into a
+//                  process exit)
+//   cluster.migrate_adopt  target-side adopt of a spooled range
+//                  chunk fails (err) or the target crashes mid-adopt
+//                  (kill; same eval path as above)
+//   cluster.replica_read  client-side replicated-read sub-call fails
+//                  (a replica death seen exactly at read time; the
+//                  fan-out must fail over to the next live replica)
+//   cluster.directory_push  a directory epoch push to this shard is
+//                  refused (the epoch-bump propagation path under
+//                  partial failure)
 #pragma once
 
 #include <atomic>

@@ -649,7 +649,31 @@ bool KVIndex::release(uint64_t lease_id) {
     return leases_.erase(lease_id) > 0;
 }
 
-std::vector<KVIndex::SnapshotItem> KVIndex::snapshot_items() const {
+uint32_t KVIndex::ring_hash(const std::string& key) {
+    // Standard CRC-32 (reflected 0xEDB88320), byte-identical to
+    // Python's zlib.crc32 — the shared ring coordinate. Table built
+    // once; the cluster paths that call this are control-plane-rate.
+    static const uint32_t* table = [] {
+        static uint32_t t[256];
+        for (uint32_t i = 0; i < 256; ++i) {
+            uint32_t c = i;
+            for (int k = 0; k < 8; ++k) {
+                c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+            }
+            t[i] = c;
+        }
+        return t;
+    }();
+    uint32_t crc = 0xFFFFFFFFu;
+    for (unsigned char ch : key) {
+        crc = table[(crc ^ ch) & 0xFFu] ^ (crc >> 8);
+    }
+    return crc ^ 0xFFFFFFFFu;
+}
+
+std::vector<KVIndex::SnapshotItem> KVIndex::snapshot_items(
+    uint64_t ring_lo, uint64_t ring_hi) const {
+    const bool whole_ring = ring_lo == 0 && ring_hi >= kRingSpan;
     std::vector<UniqueLock> locks;
     locks.reserve(kStripes);
     for (const Stripe& st : stripes_) locks.emplace_back(st.mu);
@@ -658,6 +682,10 @@ std::vector<KVIndex::SnapshotItem> KVIndex::snapshot_items() const {
         out.reserve(out.size() + st.map.size());
         for (const auto& [key, e] : st.map) {
             if (!e.committed) continue;
+            if (!whole_ring &&
+                !ring_in_range(ring_hash(key), ring_lo, ring_hi)) {
+                continue;
+            }
             SnapshotItem it;
             it.key = key;
             it.block = e.block;
@@ -797,6 +825,32 @@ size_t KVIndex::erase(const std::vector<std::string>& keys) {
         lru_drop(st, it->second);
         st.map.erase(it);
         n++;
+    }
+    return n;
+}
+
+size_t KVIndex::erase_range(uint64_t ring_lo, uint64_t ring_hi) {
+    // Migration-commit cleanup: drop the moved range from this (source)
+    // shard. Stripe at a time — the moved keys' readers have already
+    // been re-routed by the directory epoch bump, so there is no
+    // consistency window to close beyond the per-entry epoch bump
+    // erase() also does.
+    size_t n = 0;
+    for (Stripe& st : stripes_) {
+        std::vector<std::string> victims;
+        {
+            ScopedLock lk(st.mu);
+            for (const auto& [key, e] : st.map) {
+                if (e.committed &&
+                    ring_in_range(ring_hash(key), ring_lo, ring_hi)) {
+                    victims.push_back(key);
+                }
+            }
+        }
+        // Reuse erase(): per-key stripe lock, epoch-bump-before-free,
+        // ghost-ring forget — the migration evict must not read as the
+        // reclaimer's eviction quality.
+        n += erase(victims);
     }
     return n;
 }

@@ -318,9 +318,38 @@ class KVIndex {
     };
     // Collect handles to every committed entry (cheap: refs only; locks
     // all stripes in index order — a vector-held lock set outside the
-    // static lattice — serialize afterwards without them).
-    std::vector<SnapshotItem> snapshot_items() const
+    // static lattice — serialize afterwards without them). The
+    // optional [lo, hi) ring-hash window (ring_hash(key), the cluster
+    // tier's key-range codec) filters to one migrating range; lo > hi
+    // wraps around the ring. Defaults cover the whole ring (the
+    // historical full snapshot).
+    std::vector<SnapshotItem> snapshot_items(
+        uint64_t ring_lo = 0, uint64_t ring_hi = kRingSpan) const
         NO_THREAD_SAFETY_ANALYSIS;
+
+    // The cluster tier's key-placement hash: CRC-32 (zlib polynomial),
+    // chosen because the Python client routes with zlib.crc32 — both
+    // sides MUST agree on the ring coordinate of every key or a range
+    // migration would move the wrong keys. Distinct from the index's
+    // own stripe/workload hash on purpose: placement is wire-visible
+    // surface, stripe hashing is an internal detail free to change.
+    static uint32_t ring_hash(const std::string& key);
+    static constexpr uint64_t kRingSpan = 1ull << 32;
+    // True when ring_hash(key) falls in [lo, hi) with wrap-around
+    // semantics (lo > hi spans the ring's origin).
+    static bool ring_in_range(uint32_t h, uint64_t lo, uint64_t hi) {
+        if (lo <= hi) return h >= lo && uint64_t(h) < hi;
+        return uint64_t(h) >= lo || uint64_t(h) < hi;
+    }
+
+    // Erase every COMMITTED entry whose ring_hash falls in [lo, hi)
+    // (wrap-around like snapshot_items): the migration commit's
+    // source-side cleanup. Inflight entries are never touched — a
+    // writer racing the migration keeps its token; first-writer-wins
+    // resolves it exactly like any other race. Epoch-bump-per-entry
+    // mirrors erase() (pin caches must never serve a moved key's
+    // recycled blocks).
+    size_t erase_range(uint64_t ring_lo, uint64_t ring_hi);
 
     // Directly insert a COMMITTED entry (snapshot restore): pool
     // allocate + copy + visible immediately, no token round-trip.

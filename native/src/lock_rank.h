@@ -97,6 +97,10 @@ enum LockRank : int {
     kRankWorkerConns = 40,   // Server::Worker::conns_mu (owner-thread
                              // map mutation + control-plane debug
                              // iteration; taken after store_mu_)
+    kRankCluster = 45,       // Server::cluster_mu_ (directory blob;
+                             // read under store_mu_ by stats_json and
+                             // under bundle_mu_ by capture_bundle —
+                             // hence above both, below the stripes)
     kRankStripeBase = 100,   // KVIndex stripe s -> kRankStripeBase + s
     kRankReclaim = 200,      // KVIndex::reclaim_mu_
     kRankSpillQueue = 210,   // KVIndex::spill_mu_
@@ -130,6 +134,7 @@ inline const char* rank_name(int r) {
         case kRankStoreLifetime: return "server-store-lifetime";
         case kRankWorkerPending: return "worker-pending";
         case kRankWorkerConns: return "worker-conns";
+        case kRankCluster: return "server-cluster";
         case kRankReclaim: return "reclaim-kick";
         case kRankSpillQueue: return "spill-queue";
         case kRankPromoteQueue: return "promote-queue";

@@ -681,7 +681,8 @@ size_t Server::purge() {
 static constexpr uint64_t SNAP_MAGIC = 0x50414e5355505453ULL;  // "STPUSNAP"
 static constexpr uint32_t SNAP_VERSION = 1;
 
-long long Server::snapshot(const std::string& path) {
+long long Server::snapshot(const std::string& path, uint64_t ring_lo,
+                           uint64_t ring_hi) {
     // snap_mu_ serializes concurrent snapshots (a shared tmp would let
     // two writers publish an interleaved file) and blocks stop()'s
     // teardown while the collected refs below are alive (their
@@ -696,7 +697,7 @@ long long Server::snapshot(const std::string& path) {
         // data plane.
         ScopedLock lk(store_mu_);
         if (!index_) return -1;
-        items = index_->snapshot_items();
+        items = index_->snapshot_items(ring_lo, ring_hi);
     }
     std::string tmp = path + ".tmp." + std::to_string(getpid());
     FILE* f = fopen(tmp.c_str(), "wb");
@@ -834,6 +835,93 @@ long long Server::restore(const std::string& path) {
     }
     fclose(f);
     return loaded;
+}
+
+long long Server::delete_range(uint64_t ring_lo, uint64_t ring_hi) {
+    ScopedLock lk(store_mu_);
+    if (!index_) return -1;
+    return (long long)index_->erase_range(ring_lo, ring_hi);
+}
+
+int Server::cluster_set(uint64_t epoch, const std::string& dir_json,
+                        long long phase, uint64_t cursor,
+                        uint64_t total) {
+    // The whole read-modify-write runs under cluster_mu_: two
+    // concurrent pushes (ThreadingHTTPServer handles POSTs in
+    // parallel threads) must never interleave the epoch check with
+    // the blob store, or a stale retry racing a fresh push could roll
+    // the shard's map backwards — exactly what WRONG_EPOCH promises
+    // cannot happen. The scalars stay atomics only so stats/history
+    // read them lock-free.
+    bool bumped = false;
+    uint64_t cur;
+    {
+        ScopedLock lk(cluster_mu_);
+        cur = cluster_epoch_.load(std::memory_order_relaxed);
+        if (epoch < cur) return -1;  // stale: caller answers WRONG_EPOCH
+        if (!dir_json.empty()) cluster_dir_json_ = dir_json;
+        cluster_phase_.store(phase, std::memory_order_relaxed);
+        cluster_cursor_.store(cursor, std::memory_order_relaxed);
+        cluster_total_.store(total, std::memory_order_relaxed);
+        if (epoch > cur) {
+            cluster_epoch_.store(epoch, std::memory_order_relaxed);
+            bumped = true;
+        }
+    }
+    if (bumped) {
+        events_emit(EV_CLUSTER_EPOCH_BUMP, cur, epoch);
+        IST_INFO("cluster: directory epoch %llu -> %llu",
+                 (unsigned long long)cur, (unsigned long long)epoch);
+    }
+    if (phase >= 0) {
+        events_emit(EV_CLUSTER_MIGRATION_PHASE, uint64_t(phase), cursor);
+    }
+    return 0;
+}
+
+std::string Server::cluster_json() const {
+    char head[192];
+    snprintf(head, sizeof(head),
+             "{\"epoch\": %llu, \"migration_phase\": %lld, "
+             "\"migration_cursor\": %llu, \"migration_total\": %llu, "
+             "\"directory\": ",
+             (unsigned long long)cluster_epoch_.load(
+                 std::memory_order_relaxed),
+             cluster_phase_.load(std::memory_order_relaxed),
+             (unsigned long long)cluster_cursor_.load(
+                 std::memory_order_relaxed),
+             (unsigned long long)cluster_total_.load(
+                 std::memory_order_relaxed));
+    std::string out = head;
+    {
+        ScopedLock lk(cluster_mu_);
+        out += cluster_dir_json_.empty() ? "null" : cluster_dir_json_;
+    }
+    out += "}";
+    return out;
+}
+
+bool Server::migration_trip(const std::string& detail, uint64_t a0,
+                            uint64_t a1) {
+    // Control-plane entry (the rebalance coordinator's stalled-range
+    // verdict) — same CAS-cooldown shape as slo_trip, so a coordinator
+    // retry loop cannot burn a bundle per poll.
+    long long now = now_us();
+    long long prev = migration_last_trip_us_.load(std::memory_order_relaxed);
+    if (prev != 0 && now - prev < (long long)wd_cooldown_us_) {
+        return false;
+    }
+    if (!migration_last_trip_us_.compare_exchange_strong(
+            prev, now, std::memory_order_relaxed)) {
+        return false;  // a concurrent coordinator call won the trip
+    }
+    events_emit(EV_WATCHDOG_MIGRATION, a0, a1);
+    wd_trips_[kWdMigration].fetch_add(1, std::memory_order_relaxed);
+    wd_last_kind_.store(int(kWdMigration), std::memory_order_relaxed);
+    wd_last_trip_us_.store(now, std::memory_order_relaxed);
+    IST_WARN("watchdog migration: %s", detail.c_str());
+    if (!bundle_dir_.empty()) capture_bundle("migration", detail);
+    return true;
 }
 
 std::string Server::stats_json() {
@@ -1019,7 +1107,7 @@ std::string Server::stats_json() {
         long long last = events_last_us();
         static const char* kKindNames[] = {"stall", "slow_op",
                                            "queue_growth", "slo_burn",
-                                           "thrash"};
+                                           "thrash", "migration"};
         int lk = wd_last_kind_.load(std::memory_order_relaxed);
         long long lt = wd_last_trip_us_.load(std::memory_order_relaxed);
         uint64_t trips = 0;
@@ -1042,6 +1130,7 @@ std::string Server::stats_json() {
             "\"trips\": %llu, \"stall_trips\": %llu, "
             "\"slow_op_trips\": %llu, \"queue_trips\": %llu, "
             "\"slo_trips\": %llu, \"thrash_trips\": %llu, "
+            "\"migration_trips\": %llu, "
             "\"bundles\": %llu, \"last_trigger\": \"%s\", "
             "\"last_trip_age_us\": %lld}",
             (unsigned long long)events_recorded_total(),
@@ -1062,6 +1151,8 @@ std::string Server::stats_json() {
             (unsigned long long)wd_trips_[kWdSlo].load(
                 std::memory_order_relaxed),
             (unsigned long long)wd_trips_[kWdThrash].load(
+                std::memory_order_relaxed),
+            (unsigned long long)wd_trips_[kWdMigration].load(
                 std::memory_order_relaxed),
             (unsigned long long)wd_bundles_.load(
                 std::memory_order_relaxed),
@@ -1092,6 +1183,25 @@ std::string Server::stats_json() {
                  (unsigned long long)wl.dedup_ratio_milli(),
                  (unsigned long long)wl.accesses(),
                  (unsigned long long)wl.misses());
+        out += entry;
+    }
+    {
+        // Cluster tier headline (GET /directory serves the full
+        // directory blob): the epoch the dashboards correlate with
+        // re-routing, plus the live migration cursor.
+        char entry[192];
+        snprintf(entry, sizeof(entry),
+                 ", \"cluster\": {\"epoch\": %llu, "
+                 "\"migration_phase\": %lld, "
+                 "\"migration_cursor\": %llu, "
+                 "\"migration_total\": %llu}",
+                 (unsigned long long)cluster_epoch_.load(
+                     std::memory_order_relaxed),
+                 cluster_phase_.load(std::memory_order_relaxed),
+                 (unsigned long long)cluster_cursor_.load(
+                     std::memory_order_relaxed),
+                 (unsigned long long)cluster_total_.load(
+                     std::memory_order_relaxed));
         out += entry;
     }
     out += "}";
@@ -2829,6 +2939,7 @@ void Server::history_sample() {
         hist_prev_.valid = true;
     }
     s.stalled = wd_stalled_.load(std::memory_order_relaxed) ? 1 : 0;
+    s.cluster_epoch = cluster_epoch_.load(std::memory_order_relaxed);
     ScopedLock lk(hist_mu_);
     if (hist_ring_.size() < kHistCap) {
         hist_ring_.push_back(s);
@@ -2880,6 +2991,7 @@ std::string Server::history_json() {
             "\"uring_sqes_delta\": %llu, "
             "\"premature_evictions_delta\": %llu, "
             "\"thrash_cycles_delta\": %llu, \"wss_bytes\": %llu, "
+            "\"cluster_epoch\": %llu, "
             "\"workers_dead\": %u, "
             "\"tier_breaker_open\": %u, \"stalled\": %u, "
             "\"lat_delta\": [",
@@ -2900,7 +3012,8 @@ std::string Server::history_json() {
             (unsigned long long)s.uring_sqes_delta,
             (unsigned long long)s.premature_evictions_delta,
             (unsigned long long)s.thrash_cycles_delta,
-            (unsigned long long)s.wss_bytes, s.workers_dead,
+            (unsigned long long)s.wss_bytes,
+            (unsigned long long)s.cluster_epoch, s.workers_dead,
             unsigned(s.breaker), unsigned(s.stalled));
         out.append(buf, size_t(m));
         for (int b = 0; b < LatHist::kBuckets; ++b) {
@@ -3180,6 +3293,10 @@ void Server::capture_bundle(const char* kind, const std::string& detail) {
     // WSS / eviction-quality / dedup facts that say whether the
     // anomaly was the STORE misbehaving or the DEMAND shifting.
     ok &= write_text_file(dir + "/workload.json", workload_json());
+    // Cluster tier (ISSUE 14): the directory + migration cursor in
+    // force at capture time — a migration-stall bundle answers "which
+    // range, how far, under which epoch" without a live server.
+    ok &= write_text_file(dir + "/cluster.json", cluster_json());
     char manifest[512];
     snprintf(manifest, sizeof(manifest),
              "{\"trigger\": \"%s\", \"detail\": \"%s\", "
@@ -3187,7 +3304,7 @@ void Server::capture_bundle(const char* kind, const std::string& detail) {
              "\"seq\": %llu, \"files\": [\"stats.json\", "
              "\"events.json\", \"trace.json\", "
              "\"debug_state.json\", \"history.json\", "
-             "\"workload.json\"]}",
+             "\"workload.json\", \"cluster.json\"]}",
              kind, json_escape(detail).c_str(), t0, now_us() - t0,
              (unsigned long long)wd_bundle_seq_);
     ok &= write_text_file(dir + "/manifest.json", manifest);

@@ -432,9 +432,44 @@ class Server {
     // load a snapshot back (existing keys win; stops at pool-full).
     // Returns entries written/loaded, -1 on IO/format error. Beyond
     // reference parity: the reference's store is volatile ("restart =>
-    // cache cold", SURVEY.md §5 checkpoint/resume: none).
-    long long snapshot(const std::string& path);
+    // cache cold", SURVEY.md §5 checkpoint/resume: none). The optional
+    // [ring_lo, ring_hi) window (KVIndex::ring_hash coordinates,
+    // wrap-around when lo > hi) filters the snapshot to one key range —
+    // the cluster tier's live-rebalance codec: a migrating range leaves
+    // the source as ordinary snapshot extents and enters the target
+    // through restore(), so the migration data path is the format the
+    // store already trusts for warm restarts.
+    long long snapshot(const std::string& path, uint64_t ring_lo = 0,
+                       uint64_t ring_hi = KVIndex::kRingSpan);
     long long restore(const std::string& path);
+    // Drop every committed entry in the ring-hash range (the migration
+    // commit's source-side evict; KVIndex::erase_range semantics).
+    long long delete_range(uint64_t ring_lo, uint64_t ring_hi);
+
+    // --- cluster tier (docs/design.md "Cluster tier") ----------------
+    // The shard-directory mirror: the Python control plane pushes the
+    // epoch-numbered directory blob (and live migration phase/cursor)
+    // down so (a) GET /directory serves it without re-deriving state,
+    // (b) stats/history carry the epoch next to the system gauges and
+    // (c) every watchdog bundle snapshots it as cluster.json — a
+    // stalled migration's bundle carries the directory AND the range
+    // cursor it died holding. Returns -1 when `epoch` is older than
+    // the stored one (nothing applied — the caller answers
+    // WRONG_EPOCH), 0 otherwise; an epoch ADVANCE emits
+    // cluster.epoch_bump, a phase/cursor update (phase >= 0) emits
+    // cluster.migration_phase.
+    int cluster_set(uint64_t epoch, const std::string& dir_json,
+                    long long phase, uint64_t cursor, uint64_t total);
+    // {"epoch", "migration_phase", "migration_cursor",
+    //  "migration_total", "directory": <pushed blob or null>}.
+    std::string cluster_json() const;
+    // Migration-stall verdict (fired by the rebalance coordinator when
+    // a range move stops advancing): watchdog.migration event, a
+    // kWdMigration trip and a diagnostic bundle whose cluster.json
+    // carries the directory + cursor. Same CAS cooldown shape as
+    // slo_trip. a0/a1 by convention: migration phase, range cursor.
+    bool migration_trip(const std::string& detail, uint64_t a0 = 0,
+                        uint64_t a1 = 0);
 
     uint16_t bound_port() const { return bound_port_; }
     const std::string& shm_prefix() const { return cfg_.shm_prefix; }
@@ -684,8 +719,12 @@ class Server {
         kWdQueue = 2,
         kWdSlo = 3,
         kWdThrash = 4,
+        // Cluster tier: a range migration that stopped advancing
+        // (tripped from the control plane by the rebalance
+        // coordinator, like kWdSlo — never by the native sampler).
+        kWdMigration = 5,
     };
-    static constexpr int kWdKinds = 5;
+    static constexpr int kWdKinds = 6;
     std::atomic<uint64_t> wd_trips_[kWdKinds] = {};
     std::atomic<int> wd_last_kind_{-1};
     std::atomic<long long> wd_last_trip_us_{0};
@@ -713,6 +752,20 @@ class Server {
     // slo_trip (control-plane callers) and never uses its slot here.
     long long wd_last_per_kind_[kWdKinds] = {};
     std::atomic<long long> slo_last_trip_us_{0};
+    std::atomic<long long> migration_last_trip_us_{0};
+
+    // --- cluster tier state (pushed by the Python control plane via
+    // cluster_set; read by stats_json/history/bundles/GET /directory).
+    // The scalars are atomics so the ~1 Hz history sampler and
+    // stats_json read them lock-free; the directory blob itself needs
+    // cluster_mu_ (rank 45 — above store_mu_, so stats_json may read
+    // it while holding the store lock).
+    mutable Mutex cluster_mu_{kRankCluster};
+    std::string cluster_dir_json_ GUARDED_BY(cluster_mu_);
+    std::atomic<uint64_t> cluster_epoch_{0};
+    std::atomic<long long> cluster_phase_{-1};   // -1 = no migration
+    std::atomic<uint64_t> cluster_cursor_{0};
+    std::atomic<uint64_t> cluster_total_{0};
 
     // --- metrics-history ring (GET /history). Sampled on the watchdog
     // thread (which now runs whenever history OR verdicts are enabled);
@@ -735,6 +788,10 @@ class Server {
         uint64_t premature_evictions_delta = 0;
         uint64_t thrash_cycles_delta = 0;
         uint64_t wss_bytes = 0;
+        // Cluster tier: directory epoch in force at the sample — the
+        // chaos acceptance reads p99 deltas AROUND an epoch bump, and
+        // a bundle's history shows exactly when re-routing took effect.
+        uint64_t cluster_epoch = 0;
         uint32_t workers_dead = 0;
         uint8_t breaker = 0, stalled = 0;
         // Aggregate per-op latency-histogram delta (all ops summed;

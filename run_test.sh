@@ -37,11 +37,14 @@ python tools/check_invariants.py
 # induced background-worker death, alloc + socket faults, server
 # restart under leased load). The same file also rides the ISTPU_TSAN=1
 # suite below — the injected paths flip breaker/liveness state exactly
-# where the race detector should be watching.
+# where the race detector should be watching. tests/test_cluster.py
+# (ISSUE 14) rides this leg too: shard kills, replica-read failover,
+# migration stalls/crashes are fault-injection chaos of the same kind,
+# one level up.
 if [ "${ISTPU_CHAOS:-0}" = "1" ] && [ "${ISTPU_TSAN:-0}" != "1" ]; then
     make -C native
     exec env JAX_PLATFORMS=cpu \
-        python -m pytest tests/test_chaos.py -q "$@"
+        python -m pytest tests/test_chaos.py tests/test_cluster.py -q "$@"
 fi
 
 if [ "${ISTPU_ASAN:-0}" = "1" ] && [ "${ISTPU_TSAN:-0}" != "1" ]; then

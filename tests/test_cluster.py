@@ -1,0 +1,642 @@
+"""Cluster robustness tier: directory / replication / live rebalance
+chaos acceptance (ISSUE 14; docs/design.md "Cluster tier").
+
+Deterministic, failpoint-driven where the scenario allows it
+(``cluster.*`` points, armable in whichever PROCESS should misbehave),
+real SIGKILLs of subprocess shards where the scenario is process
+death. The acceptance properties pinned here:
+
+- kill a shard under mixed put/get load (replication=2) → ZERO lost
+  committed keys, hot-prefix chains still servable from replicas;
+- add a shard → directory epoch bump + live range migration completes
+  with p99 bounded (asserted from history-ring latency deltas) and a
+  stale client re-routes through refresh-on-miss, never misreads;
+- a forced-stall migration fires EXACTLY ONE ``watchdog.migration``
+  verdict whose bundle carries the directory + range cursor and
+  renders through ``istpu_top --bundle``;
+- a target crashing mid-adopt / a source dying mid-range aborts the
+  migration with zero lost committed keys (the old epoch still
+  routes, replicas still serve).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from infinistore_tpu import ClientConfig, InfiniStoreServer, ServerConfig
+from infinistore_tpu import cluster as cl
+from infinistore_tpu.server import make_control_plane
+from infinistore_tpu.sharded import ShardedConnection
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- harness ---------------------------------------------------------------
+
+
+class _Shard:
+    """One in-process shard: native server + threaded control plane."""
+
+    def __init__(self, shard_id, **cfg):
+        defaults = dict(
+            service_port=0, manage_port=0, prealloc_size=0.0625,
+            minimal_allocate_size=16, shard_id=shard_id,
+            log_level="error",
+        )
+        defaults.update(cfg)
+        self.srv = InfiniStoreServer(ServerConfig(**defaults))
+        self.srv.start()
+        self.httpd = make_control_plane(self.srv)
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self.thread.start()
+        self.shard_id = shard_id
+
+    @property
+    def service_port(self):
+        return self.srv.service_port
+
+    @property
+    def manage_port(self):
+        return self.httpd.server_address[1]
+
+    @property
+    def manage_addr(self):
+        return f"127.0.0.1:{self.manage_port}"
+
+    def entry(self):
+        return {"id": self.shard_id, "host": "127.0.0.1",
+                "service_port": self.service_port,
+                "manage_port": self.manage_port}
+
+    def stop(self):
+        try:
+            self.httpd.shutdown()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+        self.srv.stop()
+
+
+def _spawn_shard(tmpdir, shard_id, env_extra=None):
+    """One SUBPROCESS shard (the killable kind), ports discovered via
+    --port-file."""
+    pf = os.path.join(tmpdir, f"shard{shard_id}.ports")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("ISTPU_FAILPOINTS", None)
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "infinistore_tpu.server",
+         "--service-port", "0", "--manage-port", "0",
+         "--shard-id", str(shard_id), "--port-file", pf,
+         "--prealloc-size", "0.0625", "--minimal-allocate-size", "16",
+         "--log-level", "error", "--no-oom-protect", "--no-slo"],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    deadline = time.monotonic() + 90
+    while not os.path.exists(pf):
+        if proc.poll() is not None:
+            raise RuntimeError(f"shard {shard_id} died at startup")
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise RuntimeError(f"shard {shard_id} startup timeout")
+        time.sleep(0.05)
+    with open(pf) as f:
+        ports = json.load(f)
+    return proc, ports
+
+
+def _directory_of(shards, epoch=1, vnodes=32, replication=2):
+    return cl.build_directory(
+        [s.entry() for s in shards], epoch=epoch, vnodes=vnodes,
+        replication=replication)
+
+
+def _client(directory, addrs=None, **kw):
+    sc = ShardedConnection.from_directory(
+        directory,
+        config_template=ClientConfig(host_addr="127.0.0.1",
+                                     service_port=1),
+        recover_interval_s=kw.pop("recover_interval_s", 30),
+        directory_addrs=addrs, **kw)
+    sc.connect()
+    return sc
+
+
+def _pages(n, width=512, seed=7):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 255, size=(n, width), dtype=np.uint8)
+
+
+def _disarm():
+    from infinistore_tpu import _native
+
+    _native.get_lib().ist_fault_arm(b"off", None, 0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    # The failpoint registry is process-global; a leaked arming from
+    # one test must never fire in the next.
+    _disarm()
+    yield
+    _disarm()
+
+
+# -- directory / ring unit coverage ----------------------------------------
+
+
+def test_ring_hash_matches_native_range_codec():
+    # The Python router (zlib.crc32) and the native range snapshot
+    # (KVIndex::ring_hash) MUST place every key identically, or a
+    # migration would move the wrong keys. Pin it end to end: the
+    # native half-ring export must contain exactly the keys the
+    # Python hash puts there.
+    sh = _Shard(0)
+    try:
+        conn_cfg = ClientConfig(host_addr="127.0.0.1",
+                                service_port=sh.service_port)
+        from infinistore_tpu.lib import InfinityConnection
+
+        conn = InfinityConnection(conn_cfg)
+        conn.connect()
+        keys = [f"parity-{i}" for i in range(128)]
+        data = _pages(128)
+        conn.put_cache(data, [(k, i * 512) for i, k in enumerate(keys)],
+                       512)
+        conn.sync()
+        lo, hi = 1 << 30, 3 << 30
+        expect = sorted(k for k in keys
+                        if cl.in_range(cl.ring_hash(k), lo, hi))
+        path = tempfile.mktemp()
+        n = sh.srv.snapshot_range(path, lo, hi)
+        assert n == len(expect)
+        # Wrap-around window covers the complement exactly.
+        n2 = sh.srv.snapshot_range(path, hi, lo)
+        assert n2 == 128 - len(expect)
+        os.unlink(path)
+        conn.close()
+    finally:
+        sh.stop()
+
+
+def test_replica_sets_distinct_and_deterministic():
+    ring = cl.HashRing([0, 1, 2, 3], vnodes=64, replication=3)
+    ring2 = cl.HashRing([0, 1, 2, 3], vnodes=64, replication=3)
+    seen = set()
+    for i in range(500):
+        rs = ring.replica_set(f"key-{i}")
+        assert len(rs) == 3 and len(set(rs)) == 3
+        assert rs == ring2.replica_set(f"key-{i}")  # process-stable
+        seen.update(rs)
+    assert seen == {0, 1, 2, 3}
+    # Replication capped at cluster size.
+    assert len(cl.HashRing([0], replication=3).replica_set("x")) == 1
+
+
+def test_compute_moves_covers_new_members():
+    # Every shard that JOINS a range's replica set must be the dst of
+    # a move covering that range, and every OUSTED member must be
+    # evicted — checked against 1000 sampled ring points.
+    d1 = cl.build_directory(
+        [{"id": i} for i in range(3)], epoch=1, vnodes=32, replication=2)
+    d2 = cl.build_directory(
+        [{"id": i} for i in range(4)], epoch=2, vnodes=32, replication=2)
+    moves, evictions = cl.compute_moves(d1, d2)
+    r1, r2 = cl.directory_ring(d1), cl.directory_ring(d2)
+    for i in range(1000):
+        h = cl.ring_hash(f"sample-{i}")
+        old, new = set(r1.replica_set_at(h)), set(r2.replica_set_at(h))
+        for joiner in new - old:
+            # EVERY old member must export to the joiner, not just the
+            # old primary: a key committed while one old replica was
+            # down lives only on its peers, and an ousted peer's
+            # post-commit evict would otherwise delete the only copy
+            # (the repair-debt zero-loss hole the review closed).
+            srcs = {m["src"] for m in moves
+                    if m["dst"] == joiner
+                    and cl.in_range(h, m["lo"], m["hi"])}
+            assert srcs == old, (h, joiner, srcs, old)
+        for ousted in old - new:
+            assert any(
+                e["shard"] == ousted and cl.in_range(h, e["lo"], e["hi"])
+                for e in evictions), (h, ousted)
+
+
+def test_directory_push_wrong_epoch():
+    sh = _Shard(0)
+    try:
+        d2 = cl.build_directory([sh.entry()], epoch=2)
+        cl.push_directory(d2, [sh.manage_addr])
+        blob = cl.fetch_directory(sh.manage_addr)
+        assert blob["epoch"] == 2 and blob["shard_id"] == 0
+        # A stale push answers WRONG_EPOCH + the current map — never
+        # applied, never silent.
+        d1 = cl.build_directory([sh.entry()], epoch=1)
+        with pytest.raises(cl.WrongEpoch) as ei:
+            cl.push_directory(d1, [sh.manage_addr])
+        assert ei.value.current["epoch"] == 2
+        # Same-epoch re-push is idempotent (coordinator retries).
+        cl.push_directory(d2, [sh.manage_addr])
+    finally:
+        sh.stop()
+
+
+def test_directory_push_refused_failpoint():
+    sh = _Shard(0)
+    try:
+        sh.srv.fault("cluster.directory_push=once")
+        d = cl.build_directory([sh.entry()], epoch=3)
+        with pytest.raises(RuntimeError, match="PUSH_REFUSED"):
+            cl.push_directory(d, [sh.manage_addr])
+        # The refusal consumed the once-arming; the retry propagates.
+        cl.push_directory(d, [sh.manage_addr])
+        assert cl.fetch_directory(sh.manage_addr)["epoch"] == 3
+    finally:
+        sh.stop()
+
+
+# -- failover --------------------------------------------------------------
+
+
+def test_replica_read_failover_failpoint():
+    # "Kill a replica mid-read": the injected cluster.replica_read
+    # failure hits exactly one fan-out sub-call; the ladder must
+    # retry the key's other replica and the caller sees bytes, not an
+    # error.
+    shards = [_Shard(i) for i in range(2)]
+    sc = None
+    try:
+        d = _directory_of(shards, replication=2)
+        sc = _client(d)
+        keys = [f"rr-{i}" for i in range(64)]
+        data = _pages(64)
+        sc.put_cache(data, [(k, i * 512) for i, k in enumerate(keys)],
+                     512)
+        from infinistore_tpu import _native
+
+        assert _native.get_lib().ist_fault_arm(
+            b"cluster.replica_read=once", None, 0) == 1
+        dst = np.zeros_like(data)
+        sc.read_cache(dst, [(k, i * 512) for i, k in enumerate(keys)],
+                      512)
+        assert np.array_equal(dst, data)
+    finally:
+        if sc is not None:
+            sc.close()
+        for s in shards:
+            s.stop()
+
+
+def test_hot_prefix_chain_survives_replica_death():
+    # The system-prompt property: a prefix chain spread over shards
+    # keeps its FULL reusable length through a shard death when
+    # replication >= 2 — the availability motivation of the tier.
+    shards = [_Shard(i) for i in range(3)]
+    sc = None
+    try:
+        d = _directory_of(shards, replication=2)
+        sc = _client(d)
+        chain = [f"sysprompt/layer{i:03d}" for i in range(48)]
+        data = _pages(48)
+        sc.put_cache(data, [(k, i * 512) for i, k in enumerate(chain)],
+                     512)
+        assert sc.get_match_last_index(chain) == 47
+        shards[1].stop()  # any one death
+        assert sc.get_match_last_index(chain) == 47
+        assert sc.check_exist(chain[0])
+        assert sc.prefetch(chain, wait=True)["missing"] == 0
+    finally:
+        if sc is not None:
+            sc.close()
+        for i in (0, 2):
+            shards[i].stop()
+
+
+@pytest.mark.slow
+def test_kill_shard_under_load_zero_lost_keys(tmp_path):
+    # THE chaos acceptance: three SUBPROCESS shards, replication=2,
+    # mixed put/get batches; SIGKILL one shard between batches; keep
+    # the load running; then audit EVERY committed key byte for byte.
+    procs, entries = [], []
+    for i in range(3):
+        proc, ports = _spawn_shard(str(tmp_path), i)
+        procs.append(proc)
+        entries.append({"id": i, "host": "127.0.0.1",
+                        "service_port": ports["service_port"],
+                        "manage_port": ports["manage_port"]})
+    sc = None
+    try:
+        d = cl.build_directory(entries, epoch=1, vnodes=32,
+                               replication=2)
+        for e in entries:
+            cl.push_directory(d, [f"127.0.0.1:{e['manage_port']}"])
+        sc = ShardedConnection.from_directory(
+            d, ClientConfig(host_addr="127.0.0.1", service_port=1),
+            recover_interval_s=30)
+        sc.connect()
+        width = 512
+        committed = {}
+        rng = np.random.default_rng(11)
+
+        def batch(tag, n=40):
+            keys = [f"{tag}-{j:03d}" for j in range(n)]
+            data = rng.integers(0, 255, size=(n, width), dtype=np.uint8)
+            sc.put_cache(data, [(k, j * width)
+                                for j, k in enumerate(keys)], width)
+            for j, k in enumerate(keys):
+                committed[k] = data[j].copy()
+            # mixed load: read a sample back between puts
+            sample = list(committed)[-16:]
+            dst = np.zeros((len(sample), width), dtype=np.uint8)
+            sc.read_cache(dst, [(k, j * width)
+                                for j, k in enumerate(sample)], width)
+
+        for b in range(3):
+            batch(f"pre{b}")
+        os.kill(procs[1].pid, signal.SIGKILL)
+        procs[1].wait(timeout=30)
+        for b in range(3):
+            batch(f"post{b}")
+        # Audit: every committed key must read back byte-identical.
+        keys = sorted(committed)
+        dst = np.zeros((len(keys), width), dtype=np.uint8)
+        sc.read_cache(dst, [(k, j * width)
+                            for j, k in enumerate(keys)], width)
+        lost = sum(
+            1 for j, k in enumerate(keys)
+            if not np.array_equal(dst[j], committed[k]))
+        assert lost == 0
+        assert sc.health["lost_write_keys"] == 0
+        health = sc.stats()[-1]["sharded_health"]
+        assert health["degraded_shards"] == [1]
+        assert health["replication"] == 2
+    finally:
+        if sc is not None:
+            sc.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+
+
+# -- live rebalance --------------------------------------------------------
+
+
+def test_add_shard_live_rebalance_epoch_and_p99(tmp_path, monkeypatch):
+    # Acceptance: add a shard → epoch bump + live migration completes;
+    # p99 bounded through the move, asserted from the shards'
+    # history-ring latency deltas; a STALE client re-routes through
+    # refresh-on-miss and reads every key byte-identically.
+    monkeypatch.setenv("ISTPU_WATCHDOG_INTERVAL_MS", "100")
+    shards = [_Shard(i) for i in range(2)]
+    sc = None
+    stop_load = threading.Event()
+    load_errors = []
+    try:
+        d1 = _directory_of(shards, epoch=1, replication=1)
+        cl.push_directory(d1, [s.manage_addr for s in shards])
+        sc = _client(d1, addrs=[s.manage_addr for s in shards])
+        keys = [f"reb-{i:04d}" for i in range(400)]
+        data = _pages(400)
+        pairs = [(k, i * 512) for i, k in enumerate(keys)]
+        sc.put_cache(data, pairs, 512)
+
+        # Background read load ACROSS the migration (the p99 the
+        # history rings measure is this traffic's).
+        reader = _client(d1, addrs=[s.manage_addr for s in shards])
+
+        def load():
+            dst = np.zeros_like(data)
+            while not stop_load.is_set():
+                try:
+                    reader.read_cache(dst, pairs, 512)
+                except Exception as e:  # noqa: BLE001 — audit below
+                    load_errors.append(repr(e))
+                    return
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+        time.sleep(0.3)  # a few pre-migration history samples
+
+        shards.append(_Shard(2))
+        coord = cl.ClusterCoordinator(str(tmp_path), chunks=4,
+                                      chunk_timeout_s=30)
+        d2, summary = coord.add_shard(d1, shards[2].entry())
+        assert summary["epoch"] == 2
+        assert summary["adopted"] == summary["exported"] > 0
+        assert summary["evicted"] == summary["exported"]
+        time.sleep(0.4)  # post-migration samples
+        stop_load.set()
+        t.join(timeout=30)
+        assert not load_errors, load_errors
+
+        # Epoch bump visible everywhere: shard stats, history samples.
+        for s in shards:
+            assert s.srv.stats()["cluster"]["epoch"] == 2
+        hist = shards[0].srv.history()["history"]
+        epochs = {h["cluster_epoch"] for h in hist}
+        assert 2 in epochs  # the bump landed in the ring
+        # p99 bounded through the whole window: fold every sample's
+        # lat_delta together and bound the 99th percentile bucket.
+        buckets = None
+        for s in shards[:2]:
+            for h in s.srv.history()["history"]:
+                lat = h.get("lat_delta", [])
+                if buckets is None:
+                    buckets = [0] * len(lat)
+                for b, n in enumerate(lat):
+                    buckets[b] += n
+        total = sum(buckets or [])
+        assert total > 0
+        seen, p99_bucket = 0, len(buckets) - 1
+        rank = int(0.99 * (total - 1)) + 1
+        for b, n in enumerate(buckets):
+            seen += n
+            if seen >= rank:
+                p99_bucket = b
+                break
+        # 2^17 us = 131 ms: a loose-but-real bound — a migration that
+        # serialized reads behind multi-second exports would blow it.
+        assert p99_bucket <= 17, (p99_bucket, buckets)
+
+        # Stale client (sc still at epoch 1) re-routes on miss.
+        dst = np.zeros_like(data)
+        sc.read_cache(dst, pairs, 512)
+        assert np.array_equal(dst, data)
+        assert sc.directory_epoch == 2
+        assert len(sc.conns) == 3  # dialed the new shard itself
+        # Fresh client over the new map.
+        sc2 = _client(d2)
+        dst2 = np.zeros_like(data)
+        sc2.read_cache(dst2, pairs, 512)
+        assert np.array_equal(dst2, data)
+        sc2.close()
+        reader.close()
+    finally:
+        stop_load.set()
+        if sc is not None:
+            sc.close()
+        for s in shards:
+            s.stop()
+
+
+def test_migration_stall_fires_exactly_one_verdict(tmp_path):
+    # Acceptance: a forced-stall migration (delayed export chunk) must
+    # fire EXACTLY ONE watchdog.migration verdict on the source, whose
+    # bundle carries the directory + range cursor (cluster.json) and
+    # renders through istpu_top --bundle.
+    bundle_dir = str(tmp_path / "bundles")
+    os.makedirs(bundle_dir)
+    src = _Shard(0, bundle_dir=bundle_dir)
+    dst = _Shard(1)
+    try:
+        d1 = cl.build_directory([src.entry()], epoch=1, vnodes=16)
+        cl.push_directory(d1, [src.manage_addr])
+        # Stall the SECOND chunk: the cursor the bundle carries then
+        # proves mid-range progress, not a stillborn migration.
+        src.srv.fault("cluster.migrate_export=every(2):delay(2500000)")
+        coord = cl.ClusterCoordinator(str(tmp_path / "spool"),
+                                      chunks=3, chunk_timeout_s=0.6)
+        os.makedirs(str(tmp_path / "spool"), exist_ok=True)
+        before = src.srv.stats()["watchdog"]["migration_trips"]
+        with pytest.raises(cl.MigrationStalled):
+            coord.move_range(src.entry(), dst.entry(), 0,
+                             cl.RING_SPAN // 2)
+        # The delayed handler thread is still sleeping; the verdict
+        # must already have fired, and exactly once.
+        st = src.srv.stats()["watchdog"]
+        assert st["migration_trips"] == before + 1
+        evs = [e for e in src.srv.events()["events"]
+               if e["name"] == "watchdog.migration"]
+        assert len(evs) == 1
+        bundles = sorted(os.listdir(bundle_dir))
+        mig = [b for b in bundles if b.endswith("-migration")]
+        assert len(mig) == 1
+        bdir = os.path.join(bundle_dir, mig[0])
+        manifest = json.load(open(os.path.join(bdir, "manifest.json")))
+        assert manifest["trigger"] == "migration"
+        assert "cluster.json" in manifest["files"]
+        cluster = json.load(open(os.path.join(bdir, "cluster.json")))
+        assert cluster["directory"]["epoch"] == 1
+        assert cluster["migration_phase"] == cl.PHASE_EXPORT
+        assert cluster["migration_cursor"] >= 1  # chunk 1 landed
+        # Renders offline through the acceptance reader.
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "istpu_top.py"),
+             "--bundle", bdir],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        assert "cluster: epoch=1" in r.stdout
+        assert "migration=export" in r.stdout
+        time.sleep(2.0)  # let the delayed export drain before teardown
+    finally:
+        src.stop()
+        dst.stop()
+
+
+def test_target_crash_mid_adopt_keeps_old_epoch_serving(tmp_path):
+    # Chaos: the TARGET process dies mid-adopt (kill-action failpoint
+    # armed in ITS registry via its env). The migration aborts before
+    # the epoch bump, so the old map still routes and zero committed
+    # keys are lost.
+    src = _Shard(0)
+    proc, ports = _spawn_shard(
+        str(tmp_path), 1,
+        env_extra={"ISTPU_FAILPOINTS": "cluster.migrate_adopt=once:kill"})
+    sc = None
+    try:
+        d1 = cl.build_directory([src.entry()], epoch=1, vnodes=16)
+        cl.push_directory(d1, [src.manage_addr])
+        sc = _client(d1)
+        keys = [f"adopt-{i:03d}" for i in range(100)]
+        data = _pages(100)
+        pairs = [(k, i * 512) for i, k in enumerate(keys)]
+        sc.put_cache(data, pairs, 512)
+        new_entry = {"id": 1, "host": "127.0.0.1",
+                     "service_port": ports["service_port"],
+                     "manage_port": ports["manage_port"]}
+        coord = cl.ClusterCoordinator(str(tmp_path), chunks=2,
+                                      chunk_timeout_s=10)
+        with pytest.raises(cl.MigrationStalled, match="adopt"):
+            coord.rebalance(d1, cl.build_directory(
+                [src.entry(), new_entry], epoch=2, vnodes=16))
+        assert proc.wait(timeout=30) == 137  # the kill action exited it
+        # Old epoch still in force; every key still readable.
+        assert src.srv.stats()["cluster"]["epoch"] == 1
+        dst = np.zeros_like(data)
+        sc.read_cache(dst, pairs, 512)
+        assert np.array_equal(dst, data)
+    finally:
+        if sc is not None:
+            sc.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        src.stop()
+
+
+@pytest.mark.slow
+def test_source_killed_mid_range_replicas_still_serve(tmp_path):
+    # Chaos: the SOURCE process dies mid-range (kill failpoint on its
+    # second export chunk). With replication=2 the committed keys
+    # survive on replica peers and the aborted migration loses
+    # nothing.
+    procs, entries = [], []
+    for i in range(2):
+        env = ({"ISTPU_FAILPOINTS":
+                "cluster.migrate_export=every(2):kill"}
+               if i == 0 else None)
+        proc, ports = _spawn_shard(str(tmp_path), i, env_extra=env)
+        procs.append(proc)
+        entries.append({"id": i, "host": "127.0.0.1",
+                        "service_port": ports["service_port"],
+                        "manage_port": ports["manage_port"]})
+    newcomer = _Shard(2)
+    sc = None
+    try:
+        d1 = cl.build_directory(entries, epoch=1, vnodes=16,
+                                replication=2)
+        for e in entries:
+            cl.push_directory(d1, [f"127.0.0.1:{e['manage_port']}"])
+        sc = ShardedConnection.from_directory(
+            d1, ClientConfig(host_addr="127.0.0.1", service_port=1),
+            recover_interval_s=30)
+        sc.connect()
+        keys = [f"srckill-{i:03d}" for i in range(120)]
+        data = _pages(120)
+        pairs = [(k, i * 512) for i, k in enumerate(keys)]
+        sc.put_cache(data, pairs, 512)
+        coord = cl.ClusterCoordinator(str(tmp_path), chunks=3,
+                                      chunk_timeout_s=8)
+        d2 = cl.build_directory(entries + [newcomer.entry()], epoch=2,
+                                vnodes=16, replication=2)
+        with pytest.raises((cl.MigrationStalled, RuntimeError)):
+            coord.rebalance(d1, d2)
+        deadline = time.monotonic() + 10
+        while (all(p.poll() is None for p in procs)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert any(p.poll() is not None for p in procs)  # a source died
+        # Every committed key still reads byte-identical through the
+        # replica ladder under the OLD epoch.
+        dst = np.zeros_like(data)
+        sc.read_cache(dst, pairs, 512)
+        assert np.array_equal(dst, data)
+    finally:
+        if sc is not None:
+            sc.close()
+        newcomer.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)

@@ -741,3 +741,128 @@ def test_io_threads_auto_upgrades_on_multiworker_server(rng, monkeypatch):
         conn.close()
         for s in servers:
             s.stop()
+
+
+def test_two_shard_fabric_parity(rng):
+    # ISSUE 14 satellite: use_fabric wired through ShardedConnection —
+    # each shard negotiates its OWN commit ring, every put commits
+    # one-sided on its owning shard (fabric_one_sided_puts sums to the
+    # key count), reads are byte-identical, and client_stats() now
+    # merges the per-shard fabric telemetry (PR 12 stopped at lib.py,
+    # so a sharded deployment silently losing the one-sided path was
+    # invisible).
+    servers = []
+    for _ in range(2):
+        s = InfiniStoreServer(
+            ServerConfig(service_port=0, prealloc_size=0.03125,
+                         minimal_allocate_size=16, engine="fabric")
+        )
+        s.start()
+        servers.append(s)
+    if any(srv.stats()["engine"] != "fabric" for srv in servers):
+        for s in servers:
+            s.stop()
+        pytest.skip("no POSIX shm: fabric engine fell back")
+    conn = ShardedConnection(
+        [ClientConfig(host_addr="127.0.0.1", service_port=s.service_port,
+                      use_lease=True, use_fabric=True)
+         for s in servers]
+    )
+    conn.connect()
+    try:
+        page = 2048
+        n = 64
+        src = rng.integers(0, 255, size=n * page, dtype=np.uint8)
+        keys = [f"fab-{i}" for i in range(n)]
+        pairs = [(k, i * page) for i, k in enumerate(keys)]
+        conn.put_cache(src, pairs, page)
+        dst = np.zeros_like(src)
+        conn.read_cache(dst, pairs, page)
+        assert np.array_equal(src, dst)
+        one_sided = sum(
+            srv.stats()["fabric_one_sided_puts"] for srv in servers)
+        assert one_sided == n  # every key committed via a shm ring
+        # Both shards actually own part of the batch (ring negotiation
+        # happened per shard, not just on shard 0).
+        assert all(
+            srv.stats()["fabric_one_sided_puts"] > 0 for srv in servers)
+        cs = conn.client_stats()
+        assert cs["fabric"]["ring_posts"] >= 2  # one flush per shard
+        assert cs["fabric"]["ring_active"] is True
+        assert cs["fabric"]["any_ring_active"] is True
+        assert cs["fabric"]["ring_fallbacks"] == 0
+        assert len(cs["per_shard"]) == 2
+    finally:
+        conn.close()
+        for s in servers:
+            s.stop()
+
+
+def test_prefetch_fanout_against_dead_shard():
+    # ISSUE 14 satellite: chaos-test the prefetch() fan-out against a
+    # degraded shard. The dead shard's keys must come back "missing"
+    # (unreachable), the healthy shard's keys must keep their REAL
+    # statuses, nothing may raise, and — the miscount this test
+    # surfaced — keys on a HEALTHY shard whose client runs
+    # prefetch=False must count "skipped" (advisory no-op), never
+    # "missing" (they are resident and readable).
+    servers = [_mk_server() for _ in range(2)]
+    conn = ShardedConnection(
+        [ClientConfig(host_addr="127.0.0.1", service_port=s.service_port)
+         for s in servers],
+        recover_interval_s=30,
+    )
+    conn.connect()
+    try:
+        page = 512
+        keys = [f"pf-{i}" for i in range(48)]
+        src = np.zeros(48 * page, dtype=np.uint8)
+        conn.put_cache(src, [(k, i * page) for i, k in enumerate(keys)],
+                       page)
+        by_shard = [
+            [k for k in keys if conn.shard_of(k) == s] for s in range(2)
+        ]
+        assert all(by_shard)  # both shards own some keys
+        servers[1].stop()
+        # First op after the kill IS the prefetch: it discovers the
+        # death itself (conn failure -> degrade), keeps the healthy
+        # shard's statuses and never raises.
+        r = conn.prefetch(keys, wait=True)
+        assert r["missing"] == len(by_shard[1])
+        assert r["resident"] == len(by_shard[0])
+        assert conn.degraded[1]
+        # Degraded-at-call-time path (skipped up front, not mid-call).
+        r2 = conn.prefetch(keys, wait=True)
+        assert r2["missing"] == len(by_shard[1])
+        assert r2["resident"] == len(by_shard[0])
+        # Fire-and-forget stays advisory and silent against the dead
+        # shard.
+        assert conn.prefetch(keys, wait=False) is None
+    finally:
+        conn.close()
+        servers[0].stop()
+
+
+def test_prefetch_disabled_counts_skipped_not_missing():
+    # The fixed miscount in isolation: healthy shards, client-side
+    # prefetch disabled -> every key "skipped", zero "missing".
+    servers = [_mk_server() for _ in range(2)]
+    conn = ShardedConnection(
+        [ClientConfig(host_addr="127.0.0.1", service_port=s.service_port,
+                      prefetch=False)
+         for s in servers]
+    )
+    conn.connect()
+    try:
+        page = 512
+        keys = [f"pfd-{i}" for i in range(24)]
+        src = np.zeros(24 * page, dtype=np.uint8)
+        conn.put_cache(src, [(k, i * page) for i, k in enumerate(keys)],
+                       page)
+        r = conn.prefetch(keys, wait=True)
+        assert r == {"resident": 0, "queued": 0, "missing": 0,
+                     "skipped": len(keys)}
+    finally:
+        conn.close()
+        for s in servers:
+            s.stop()

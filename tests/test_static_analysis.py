@@ -316,6 +316,74 @@ def test_fabric_failpoint_catalog_pin_bites(tree):
     assert "fabric.doorbell" in r.stderr  # stale catalog row
 
 
+def test_dropped_directory_endpoint_fails_golden(tree):
+    # ISSUE 14 seeded mutation: silently deleting the /directory
+    # endpoint must fail the golden's `endpoints` pin — every cluster
+    # client's epoch refresh and the coordinator's push path depend on
+    # it. The handler string appears in BOTH do_GET and do_POST, so
+    # the mutation hits every occurrence (one survivor would keep the
+    # endpoint in the parsed set and hide the drift).
+    mutate(tree, "infinistore_tpu/server.py",
+           'self.path == "/directory":',
+           'self.path == "/directory_disabled_never_matches":',
+           count=2)
+    # Keep the docs check quiet so the failure isolates the golden pin.
+    mutate(tree, "docs/api.md", "`GET /directory`",
+           "`GET /directory` `/directory_disabled_never_matches`")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "'endpoints' drifted" in r.stderr
+
+
+def test_added_directory_endpoint_fails_golden(tree):
+    # ...and the REVERSE drift direction: a grown endpoint surface
+    # (documented, so only the golden can catch it) must also fail
+    # until the golden is regenerated — surface growth needs the same
+    # deliberate golden+ABI step as surface loss.
+    mutate(tree, "infinistore_tpu/server.py",
+           'elif self.path == "/directory":',
+           'elif self.path == "/directory2":\n'
+           '                self._send(200, {})\n'
+           '            elif self.path == "/directory":')
+    mutate(tree, "docs/api.md", "`GET /directory`",
+           "`GET /directory` `/directory2`")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "'endpoints' drifted" in r.stderr
+
+
+def test_migration_event_catalog_pin_bites(tree):
+    # ISSUE 14 seeded mutation: renaming the watchdog.migration
+    # verdict's emit id (server.cc migration_trip) without touching
+    # the events.h catalog must fail BOTH drift directions — the new
+    # id is emitted but uncataloged, the old catalog row is stale —
+    # so the migration verdict can never silently detach from its
+    # catalog row (and the docs table) after a refactor.
+    mutate(tree, "native/src/server.cc",
+           "events_emit(EV_WATCHDOG_MIGRATION,",
+           "events_emit(EV_WATCHDOG_MIGRATING,")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "EV_WATCHDOG_MIGRATING" in r.stderr  # emitted, uncataloged
+    assert "EV_WATCHDOG_MIGRATION" in r.stderr  # stale catalog row
+    assert "stale catalog row" in r.stderr
+
+
+def test_cluster_failpoint_catalog_pin_bites(tree):
+    # ISSUE 14 seeded mutation: renaming a cluster failpoint at its
+    # eval site (capi.cc ist_cluster_failpoint) without the
+    # failpoint.h catalog must fail both directions, exactly like the
+    # fabric pin above — a chaos spec (`cluster.migrate_export=...`)
+    # must never silently arm nothing after a refactor.
+    mutate(tree, "native/src/capi.cc",
+           'IST_FAILPOINT("cluster.migrate_export")',
+           'IST_FAILPOINT("cluster.range_export")')
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "cluster.range_export" in r.stderr  # compiled, uncataloged
+    assert "cluster.migrate_export" in r.stderr  # stale catalog row
+
+
 def test_make_analyze_exits_zero():
     # With clang installed this is the -Wthread-safety -Werror proof
     # pass; without it the target reports the skip and still exits 0 —

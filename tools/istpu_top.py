@@ -181,8 +181,47 @@ def render_workload(workload):
     return lines
 
 
+def render_cluster(cluster, shard_health=None):
+    """Cluster-tier panel (GET /directory or a bundle's cluster.json):
+    directory epoch, per-shard role/health and the live migration
+    cursor. Missing/empty blob (single-node server, pre-v14 server or
+    bundle) renders nothing — graceful degrade, never a crash."""
+    cl = cluster or {}
+    directory = cl.get("directory")
+    if not cl or (not directory and not cl.get("epoch")):
+        return []
+    lines = [""]
+    phase_names = {-1: "idle", 1: "export", 2: "adopt", 3: "evict"}
+    phase = cl.get("migration_phase", -1)
+    mig = phase_names.get(phase, str(phase))
+    if phase >= 0:
+        mig += (f" {cl.get('migration_cursor', 0)}"
+                f"/{cl.get('migration_total', 0)}")
+    lines.append(
+        f"cluster: epoch={cl.get('epoch', 0)}  "
+        f"shard_id={cl.get('shard_id', '?')}  migration={mig}"
+    )
+    if directory:
+        lines.append(
+            f"  directory: {len(directory.get('shards', []))} shards  "
+            f"replication={directory.get('replication', 1)}  "
+            f"vnodes={directory.get('vnodes', '?')}"
+        )
+        self_id = cl.get("shard_id")
+        for s in directory.get("shards", []):
+            role = "self" if s.get("id") == self_id else "peer"
+            health = (shard_health or {}).get(s.get("id"), "?")
+            lines.append(
+                f"  shard {s.get('id'):>3} [{role}] "
+                f"{s.get('host', '?')}:{s.get('service_port', '?')} "
+                f"health={health}"
+            )
+    return lines
+
+
 def render_frame(stats, debug, events, prev=None, dt=None, tail=10,
-                 history=None, workload=None):
+                 history=None, workload=None, cluster=None,
+                 shard_health=None):
     """Render one dashboard frame from the JSON blobs. ``prev``
     (the previous stats blob) + ``dt`` enable the throughput deltas;
     without them the counters are shown as absolutes (bundle mode).
@@ -307,6 +346,9 @@ def render_frame(stats, debug, events, prev=None, dt=None, tail=10,
     # Workload demand panel (MRC / WSS / eviction quality / dedup).
     lines.extend(render_workload(workload))
 
+    # Cluster panel (directory epoch, shard roster, migration cursor).
+    lines.extend(render_cluster(cluster, shard_health=shard_health))
+
     # Recent events tail.
     evs = (events or {}).get("events", [])
     lines.append("")
@@ -347,11 +389,30 @@ def run_live(args):
             workload = _get_json(base, "/workload")
         except Exception:  # noqa: BLE001 — pre-v13 server: no panel
             workload = {}
+        try:
+            cluster = _get_json(base, "/directory")
+        except Exception:  # noqa: BLE001 — pre-v14 server: no panel
+            cluster = {}
+        # Best-effort peer health: one short /health probe per
+        # directory shard (clusters are small; a dead peer costs the
+        # probe timeout once per frame and renders as "down").
+        shard_health = {}
+        for s in (cluster.get("directory") or {}).get("shards", []):
+            if "manage_port" not in s:
+                continue
+            try:
+                h = _get_json(
+                    f"http://{s.get('host', args.host)}"
+                    f":{s['manage_port']}", "/health", timeout=0.5)
+                shard_health[s["id"]] = h.get("status", "?")
+            except Exception:  # noqa: BLE001 — dead peer
+                shard_health[s["id"]] = "down"
         now = time.monotonic()
         frame = render_frame(stats, debug, events, prev=prev,
                              dt=(now - prev_t) if prev_t else None,
                              tail=args.tail, history=history,
-                             workload=workload)
+                             workload=workload, cluster=cluster,
+                             shard_health=shard_health)
         if not args.once:
             sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
         print(frame)
@@ -386,7 +447,8 @@ def run_bundle(args):
     print(render_frame(load("stats.json"), load("debug_state.json"),
                        load("events.json"), tail=args.tail,
                        history=load("history.json"),
-                       workload=load("workload.json")))
+                       workload=load("workload.json"),
+                       cluster=load("cluster.json")))
     return 0
 
 
