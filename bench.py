@@ -988,6 +988,134 @@ def bench_obs_overhead(nkeys=None, block_kb=4, passes=5):
     return out
 
 
+def bench_cluster_obs(nkeys=None, block_kb=4, passes=5):
+    """Cluster-observability overhead leg (ISSUE 15 acceptance:
+    `cluster_obs_overhead_p50_ratio <= 1.02` on CI).
+
+    A 2-shard in-process fleet (native servers + threaded control
+    planes, directory pushed, replication=2 so the digest pass has
+    real replica pairs to compare). Leg A reads a shard's data plane
+    with NO aggregator; leg B reads the SAME shard while a
+    FleetAggregator scrapes the whole fleet at 100 ms with divergence
+    digests EVERY pass (harsher than the 5-pass default) — the ratio
+    bounds what fleet scraping costs a victim shard's data-plane p50.
+    Interleaved pairs + median of per-pair ratios, the same noise
+    discipline as every overhead leg since PR 6.
+
+    Emits:
+      cluster_obs_nkeys                keys per pass
+      cluster_obs_off_p50_read_us     no-aggregator read p50
+      cluster_obs_on_p50_read_us      scraped read p50
+      cluster_obs_overhead_p50_ratio  median of pair ratios (<= 1.02)
+      cluster_obs_scrapes             scrape passes the on-leg ran
+      cluster_obs_digest_ranges       ranges each digest pass compared
+    """
+    import os
+    import threading
+
+    import numpy as np
+
+    from infinistore_tpu import (
+        ClientConfig,
+        InfiniStoreServer,
+        InfinityConnection,
+        ServerConfig,
+    )
+    from infinistore_tpu import cluster as _cl
+    from infinistore_tpu.server import make_control_plane
+
+    if nkeys is None:
+        nkeys = int(os.environ.get("ISTPU_CLUSTER_OBS_KEYS", "512"))
+    block_bytes = block_kb << 10
+
+    shards = []
+    try:
+        for sid in range(2):
+            srv = InfiniStoreServer(
+                ServerConfig(
+                    service_port=0, manage_port=0,
+                    prealloc_size=max(4 * nkeys * block_bytes, 1 << 20)
+                    / (1 << 30),
+                    minimal_allocate_size=block_kb, shard_id=sid,
+                )
+            )
+            srv.start()
+            httpd = make_control_plane(srv)
+            t = threading.Thread(target=httpd.serve_forever,
+                                 daemon=True)
+            t.start()
+            shards.append((srv, httpd))
+        entries = [
+            {"id": sid, "host": "127.0.0.1",
+             "service_port": srv.service_port,
+             "manage_port": httpd.server_address[1]}
+            for sid, (srv, httpd) in enumerate(shards)
+        ]
+        directory = _cl.build_directory(entries, epoch=1, vnodes=16,
+                                        replication=2)
+        addrs = [f"127.0.0.1:{e['manage_port']}" for e in entries]
+        _cl.push_directory(directory, addrs)
+
+        conn = InfinityConnection(
+            ClientConfig(host_addr="127.0.0.1",
+                         service_port=shards[0][0].service_port,
+                         connection_type="STREAM")
+        )
+        conn.connect()
+        src = np.random.default_rng(15).integers(
+            0, 255, block_bytes, dtype=np.uint8)
+        dst = np.zeros(block_bytes, dtype=np.uint8)
+        for i in range(nkeys):
+            conn.put_cache(src, [(f"cobs{i}", 0)], block_bytes)
+        conn.sync()
+
+        def read_pass():
+            lats = []
+            for i in range(nkeys):
+                t0 = time.perf_counter()
+                conn.read_cache(dst, [(f"cobs{i}", 0)], block_bytes)
+                lats.append(time.perf_counter() - t0)
+            return float(np.percentile(np.array(lats) * 1e6, 50))
+
+        agg = _cl.FleetAggregator(seed_addrs=addrs,
+                                  scrape_interval_s=0.1,
+                                  digest_every=1)
+        n_ranges = len(_cl.divergence_ranges(directory))
+        off_p50 = on_p50 = None
+        ratios = []
+        read_pass()  # shared warmup, unmeasured
+        try:
+            for _ in range(passes):
+                a = read_pass()          # aggregator idle
+                agg.start()
+                agg.scrape()             # at least one full scrape
+                b = read_pass()          # aggregator scraping
+                agg.stop()
+                off_p50 = a if off_p50 is None else min(off_p50, a)
+                on_p50 = b if on_p50 is None else min(on_p50, b)
+                ratios.append(b / a if a else 0.0)
+        finally:
+            agg.stop()
+            conn.close()
+        scrapes = (agg.cached_status() or {}).get("scrapes", 0)
+        return {
+            "cluster_obs_nkeys": nkeys,
+            "cluster_obs_off_p50_read_us": round(off_p50, 1),
+            "cluster_obs_on_p50_read_us": round(on_p50, 1),
+            "cluster_obs_overhead_p50_ratio":
+                round(sorted(ratios)[len(ratios) // 2], 3),
+            "cluster_obs_scrapes": scrapes,
+            "cluster_obs_digest_ranges": n_ranges,
+        }
+    finally:
+        for srv, httpd in shards:
+            try:
+                httpd.shutdown()
+            except Exception:
+                pass
+            srv.stop()
+
+
 def zipf_trace(nkeys, length, alpha=0.9, seed=1234):
     """Deterministic Zipfian reference trace: key INDICES drawn from a
     rank-frequency power law (rank r with weight r^-alpha) by a seeded
@@ -3365,6 +3493,16 @@ def main():
         except Exception as e:
             print(json.dumps({"obs_overhead_error": str(e)[:200]}))
         return 0
+    if "--cluster-obs-leg" in sys.argv:
+        # Cluster-observability overhead A/B (ISSUE 15 acceptance:
+        # fleet scrape overhead on a victim shard's data-plane p50
+        # <= 1.02); boots its own 2-shard fleet, port argument
+        # accepted but unused.
+        try:
+            print(json.dumps(bench_cluster_obs()))
+        except Exception as e:
+            print(json.dumps({"cluster_obs_error": str(e)[:200]}))
+        return 0
     if "--workload-leg" in sys.argv:
         # Workload-observability leg (ISSUE 13 acceptance: overhead
         # ratio <= 1.02, |predicted - measured| miss <= 0.05 on the
@@ -3555,6 +3693,14 @@ def main():
             out.update(bench_obs_overhead())
         except Exception as e:
             out["obs_overhead_error"] = str(e)[:200]
+        publish()
+        # Cluster-observability leg (ISSUE 15 acceptance: fleet scrape
+        # overhead on a shard's data-plane p50 <= 1.02). CPU-only,
+        # boots its own 2-shard fleet.
+        try:
+            out.update(bench_cluster_obs())
+        except Exception as e:
+            out["cluster_obs_error"] = str(e)[:200]
         publish()
         # Workload-observability leg (ISSUE 13 acceptance: overhead
         # <= 1.02 + Zipfian miss-ratio accuracy <= 0.05). CPU-only,

@@ -164,6 +164,19 @@ def _decls(lib):
             c.c_int,
             [c.c_void_p, c.c_char_p, c.c_uint64, c.c_uint64],
         ),
+        # cluster observability plane (ABI v15): replica-divergence
+        # digest + the aggregator-fired cluster verdicts.
+        (
+            "ist_server_digest_range",
+            c.c_int,
+            [c.c_void_p, c.c_uint64, c.c_uint64, c.POINTER(c.c_uint64),
+             c.POINTER(c.c_uint64), c.POINTER(c.c_uint64)],
+        ),
+        (
+            "ist_server_cluster_trip",
+            c.c_int,
+            [c.c_void_p, c.c_int, c.c_char_p, c.c_uint64, c.c_uint64],
+        ),
         ("ist_cluster_failpoint", c.c_int, [c.c_char_p]),
         ("ist_fault_arm", c.c_int, [c.c_char_p, c.c_char_p, c.c_int]),
         ("ist_server_shm_prefix", c.c_int, [c.c_void_p, c.c_char_p, c.c_int]),
@@ -322,7 +335,9 @@ def _decls(lib):
         ("ist_mm_total_bytes", c.c_uint64, [c.c_void_p]),
         ("ist_mm_num_pools", c.c_uint64, [c.c_void_p]),
     ]
-    # ABI probe FIRST: a stale prebuilt library would lack the v14
+    # ABI probe FIRST: a stale prebuilt library would lack the v15
+    # cluster-observability entry points (ist_server_digest_range /
+    # ist_server_cluster_trip), lack the v14
     # cluster entry points (ist_server_cluster_set / ist_server_cluster
     # / ist_server_snapshot_range / ist_server_delete_range /
     # ist_server_migration_trip / ist_cluster_failpoint /
@@ -349,9 +364,9 @@ def _decls(lib):
         ver = int(lib.ist_abi_version())
     except AttributeError:
         ver = 1
-    if ver < 14:
+    if ver < 15:
         raise RuntimeError(
-            f"stale native library at {_LIB_PATH} (ABI v{ver} < v14): "
+            f"stale native library at {_LIB_PATH} (ABI v{ver} < v15): "
             "rebuild with `make -C native` (or delete the .so to let "
             "the import auto-build)"
         )

@@ -53,6 +53,7 @@ server-to-server channel.
 """
 
 import json
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -294,7 +295,16 @@ def push_directory(directory, manage_addrs, timeout=10.0):
     :class:`WrongEpoch` when a shard already holds a NEWER epoch
     (returning that map), and RuntimeError listing unreachable/refusing
     shards otherwise — partial propagation is surfaced, never silent
-    (stale shards would misroute reads they still receive)."""
+    (stale shards would misroute reads they still receive).
+
+    The blob is stamped with ``pushed_at_unix_us`` (the pusher's wall
+    clock) before the first POST: every shard records its own adoption
+    wall-clock stamp natively, and the fleet aggregator's epoch-
+    propagation-lag gauge is the per-shard ``adopt - pushed`` delta —
+    wall clocks, because monotonic clocks never compare across
+    processes."""
+    directory = dict(directory)
+    directory.setdefault("pushed_at_unix_us", int(time.time() * 1e6))
     failed = []
     for addr in manage_addrs:
         try:
@@ -487,10 +497,745 @@ class ClusterCoordinator:
                                        extra_addrs=extra_addrs)
 
 
+def divergence_ranges(directory):
+    """The ring split into the minimal set of ``(lo, hi, replica_ids)``
+    segments over which every key has the SAME replica set, adjacent
+    same-set segments merged (vnode granularity would otherwise hand
+    the digest pass hundreds of micro-ranges). Single-replica segments
+    are skipped — one copy cannot diverge from itself."""
+    if not directory.get("shards") or \
+            directory.get("replication", 1) <= 1:
+        return []
+    ring = directory_ring(directory)
+    bounds = ring.boundaries()
+    n = len(bounds)
+    segs = []
+    for i in range(n):
+        lo = bounds[i]
+        hi = bounds[(i + 1) % n] if i + 1 < n else bounds[0]
+        if lo == hi:  # single-boundary degenerate ring
+            hi = (lo + RING_SPAN - 1) % RING_SPAN
+        reps = tuple(ring.replica_set_at(lo))
+        if len(reps) < 2:
+            continue
+        if segs and segs[-1][1] == lo and segs[-1][2] == reps:
+            segs[-1] = (segs[-1][0], hi, reps)
+        else:
+            segs.append((lo, hi, reps))
+    # The last segment wraps to the first boundary; merge across the
+    # origin when the sets match so the wrap seam is one range too.
+    if len(segs) > 1 and segs[-1][1] == segs[0][0] \
+            and segs[-1][2] == segs[0][2]:
+        segs[0] = (segs[-1][0], segs[0][1], segs[0][2])
+        segs.pop()
+    return segs
+
+
+class FleetAggregator:
+    """Fleet-wide observability over the shard directory (ISSUE 15).
+
+    One aggregator scrapes every shard's control plane (``/stats``,
+    ``/slo``, ``/history``, ``POST /digest``) and serves three merged
+    views through whichever shard's control plane hosts it:
+
+    - ``GET /cluster/status`` (:meth:`status`): per-shard gauges +
+      health, occupancy/key skew, epoch-propagation lag per shard
+      (push→adopt wall-clock delta + WRONG_EPOCH rejection counts),
+      live migration progress (cursor rate → ETA, keys/bytes adopted
+      by the target since the migration began) and the replica-
+      divergence table.
+    - ``GET /cluster/slo`` (:meth:`slo`): bucket-summed burn-rate
+      windows across shards plus the QUORUM availability semantics the
+      PR 14 data path promises — a key-range counts DOWN only when
+      every replica of it is down, so one dead shard under
+      replication=2 burns nothing (mirroring "a key is lost only when
+      EVERY targeted replica dropped it").
+    - ``GET /cluster/history`` (:meth:`history`): the shards' metrics-
+      history rings merged sample-by-sample (aligned from the TAIL —
+      all shards sample at the same cadence but their monotonic clocks
+      never compare), counters and latency-histogram deltas summed
+      BUCKET-WISE in the shared LatHist geometry so merged percentiles
+      stay exact.
+
+    Verdicts (:meth:`poll_once`, or the :meth:`start` thread): a
+    divergent range persisting ``divergence_streak`` digest passes
+    fires ``watchdog.replica_divergence`` on the LOCAL server; a shard
+    serving an epoch behind the fleet maximum for longer than
+    ``epoch_lag_trip_s`` fires ``watchdog.epoch_lag``. Both ride the
+    native verdict machinery (event + trip counter + diagnostic
+    bundle, per-kind cooldown), and after a trip the aggregator drops
+    ``fleet.json`` — the full :meth:`status` snapshot of EVERY shard —
+    into the freshly captured bundle so ``istpu_top --bundle`` renders
+    the whole fleet, not just the shard that happened to host the
+    aggregator.
+
+    Divergence digests are the expensive scrape half (each range costs
+    the shard one committed-key walk), so they run every
+    ``digest_every``-th scrape, batched as ONE ``POST /digest`` per
+    shard carrying that shard's whole range list.
+    """
+
+    def __init__(self, server=None, directory=None, seed_addrs=(),
+                 scrape_interval_s=1.0, digest_every=5,
+                 divergence_streak=2, epoch_lag_trip_s=30.0,
+                 http_timeout_s=2.0):
+        self.server = server
+        self._directory = directory
+        self.seed_addrs = list(seed_addrs)
+        self.scrape_interval_s = max(float(scrape_interval_s), 0.05)
+        self.digest_every = max(1, int(digest_every))
+        self.divergence_streak = max(1, int(divergence_streak))
+        self.epoch_lag_trip_s = float(epoch_lag_trip_s)
+        self.http_timeout_s = float(http_timeout_s)
+        self.trips = {"replica_divergence": 0, "epoch_lag": 0}
+        self._lock = threading.Lock()
+        # Serializes whole scrape passes: control-plane handler
+        # threads (TTL-expired /cluster/* pulls) and the poll thread
+        # all funnel here, and the divergence STREAK counters must
+        # advance at most once per real pass — two back-to-back
+        # passes racing a write fan-out would otherwise reach the
+        # verdict streak inside one write window.
+        self._scrape_lock = threading.Lock()
+        self._status = None          # last scrape result
+        self._status_t = 0.0         # monotonic stamp (TTL cache)
+        self._scrapes = 0
+        self._divergent = {}         # range key -> consecutive passes
+        self._lag_since = {}         # shard id -> monotonic first-seen
+        self._mig_base = {}          # shard id -> (kvmap, used) baseline
+        self._mig_prev = {}          # shard id -> (cursor, monotonic t)
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- directory discovery -------------------------------------------
+
+    def directory(self):
+        """The directory the aggregator scrapes by: the freshest of
+        the explicit blob, the local server's native mirror, and
+        whatever the seed addresses answer."""
+        best = self._directory
+
+        def better(d):
+            # >= on purpose: at EQUAL epochs the shard-held copy wins —
+            # push_directory stamps pushed_at_unix_us into the pushed
+            # blob only, and the lag math needs the stamped one.
+            return d and d.get("epoch", 0) >= (best or {}).get("epoch", 0)
+
+        if self.server is not None:
+            try:
+                d = self.server.cluster().get("directory")
+                if better(d):
+                    best = d
+            except Exception:  # noqa: BLE001 — keep the held map
+                pass
+        if best is None:
+            for addr in self.seed_addrs:
+                try:
+                    d = fetch_directory(
+                        addr, timeout=self.http_timeout_s
+                    ).get("directory")
+                except Exception:  # noqa: BLE001 — next seed
+                    continue
+                if better(d):
+                    best = d
+        self._directory = best
+        return best
+
+    @staticmethod
+    def _addr(shard):
+        return f"{shard.get('host', '127.0.0.1')}:{shard['manage_port']}"
+
+    def _get(self, addr, path):
+        st, body = _http_json("GET", f"http://{addr}{path}",
+                              timeout=self.http_timeout_s)
+        if st != 200:
+            raise RuntimeError(f"GET {path} on {addr}: HTTP {st}")
+        return body
+
+    # -- scrape --------------------------------------------------------
+
+    def scrape(self):
+        """One scrape pass over every directory shard; returns (and
+        caches) the /cluster/status blob. Down shards are marked, not
+        raised — a fleet view with holes beats no view. Whole passes
+        serialize on ``_scrape_lock``; a caller that blocked behind a
+        concurrent pass adopts that pass's result instead of running
+        its own back-to-back (verdict streaks count REAL passes)."""
+        t0 = time.monotonic()
+        with self._scrape_lock:
+            with self._lock:
+                cached, tc = self._status, self._status_t
+            if cached is not None and tc >= t0:
+                return cached  # a concurrent pass finished while we waited
+            return self._scrape_locked()
+
+    def _scrape_locked(self):
+        directory = self.directory()
+        now_unix = int(time.time() * 1e6)
+        shards = []
+        per_stats = {}
+        for s in (directory or {}).get("shards", []):
+            if "manage_port" not in s:
+                continue
+            addr = self._addr(s)
+            row = {"id": s["id"], "addr": addr, "up": False}
+            try:
+                st = self._get(addr, "/stats")
+            except Exception as e:  # noqa: BLE001 — down shard
+                row["error"] = repr(e)[:120]
+                shards.append(row)
+                continue
+            per_stats[s["id"]] = st
+            cl = st.get("cluster", {})
+            wd = st.get("watchdog", {})
+            # Aggregate p99 across ops from the shared power-of-two
+            # buckets (exact merge, same geometry everywhere).
+            hist = []
+            for op in st.get("op_stats", {}).values():
+                for b, v in enumerate(op.get("hist") or []):
+                    if b >= len(hist):
+                        hist.append(v)
+                    else:
+                        hist[b] += v
+            row.update({
+                "up": True,
+                "epoch": cl.get("epoch", 0),
+                "adopt_unix_us": cl.get("adopt_unix_us", 0),
+                "wrong_epoch_rejections":
+                    cl.get("wrong_epoch_rejections", 0),
+                "migration_phase": cl.get("migration_phase", -1),
+                "migration_cursor": cl.get("migration_cursor", 0),
+                "migration_total": cl.get("migration_total", 0),
+                "used_bytes": st.get("used_bytes", 0),
+                "pool_bytes": st.get("pool_bytes", 0),
+                "occupancy": (st.get("used_bytes", 0)
+                              / st.get("pool_bytes", 1)
+                              if st.get("pool_bytes") else 0.0),
+                "kvmap_len": st.get("kvmap_len", 0),
+                "ops": st.get("ops", 0),
+                "connections": st.get("connections", 0),
+                "workers_dead": st.get("workers_dead", 0),
+                "tier_breaker_open": st.get("tier_breaker_open", 0),
+                "spill_queue_depth": st.get("spill_queue_depth", 0),
+                "promote_queue_depth": st.get("promote_queue_depth", 0),
+                "p99_us": _hist_p99(hist or []),
+                "watchdog_stalled": wd.get("stalled", 0),
+                "watchdog_trips": wd.get("trips", 0),
+            })
+            shards.append(row)
+        # Epoch riding, aggregator-side: any shard reporting a NEWER
+        # epoch than the held map (visible for free in the /stats
+        # cluster section) triggers one /directory fetch from it, so a
+        # standalone aggregator follows rebalances instead of freezing
+        # on the epoch it bootstrapped with — skew math, divergence
+        # ranges and quorum spans must all run over current placement.
+        held = (directory or {}).get("epoch", 0)
+        ahead = [r for r in shards if r.get("up")
+                 and r.get("epoch", 0) > held]
+        if ahead:
+            try:
+                d = fetch_directory(
+                    max(ahead, key=lambda r: r["epoch"])["addr"],
+                    timeout=self.http_timeout_s).get("directory")
+            except Exception:  # noqa: BLE001 — next scrape retries
+                d = None
+            if d and d.get("epoch", 0) > held:
+                self._directory = directory = d
+        self._scrapes += 1
+        status = {
+            "epoch": max([r.get("epoch", 0) for r in shards] + [0]),
+            "directory": directory,
+            "scraped_at_unix_us": now_unix,
+            "scrapes": self._scrapes,
+            "shards": shards,
+            "down_shards": [r["id"] for r in shards if not r["up"]],
+        }
+        status["skew"] = self._skew(shards)
+        status["epoch_lag"] = self._epoch_lag(directory, shards,
+                                              now_unix)
+        status["migration"] = self._migration(shards)
+        run_digests = (self._scrapes % self.digest_every) == 0 \
+            or self._status is None
+        if run_digests:
+            status["divergence"] = self._divergence(directory, shards)
+        else:
+            status["divergence"] = (self._status or {}).get(
+                "divergence",
+                {"checked_ranges": 0, "divergent": [], "gauge": 0,
+                 "pass": 0})
+        with self._lock:
+            self._status = status
+            self._status_t = time.monotonic()
+        return status
+
+    @staticmethod
+    def _skew(shards):
+        """Load-imbalance facts across UP shards: occupancy spread and
+        the key-count imbalance (max/mean — 1.0 is perfect)."""
+        up = [r for r in shards if r["up"]]
+        if not up:
+            return {"up_shards": 0}
+        occ = [r["occupancy"] for r in up]
+        keys = [r["kvmap_len"] for r in up]
+        mean_keys = sum(keys) / len(keys)
+        return {
+            "up_shards": len(up),
+            "occupancy_max": round(max(occ), 4),
+            "occupancy_min": round(min(occ), 4),
+            "occupancy_spread": round(max(occ) - min(occ), 4),
+            "keys_max": max(keys),
+            "keys_imbalance": (round(max(keys) / mean_keys, 3)
+                               if mean_keys else 1.0),
+            "epoch_skew": max(r["epoch"] for r in up)
+            - min(r["epoch"] for r in up),
+        }
+
+    def _epoch_lag(self, directory, shards, now_unix):
+        """Per-shard directory-epoch propagation lag. A shard AT the
+        fleet-max epoch reports its achieved push→adopt delta; a shard
+        BEHIND it reports a still-growing lag from the newest push
+        stamp (the blob carries pushed_at_unix_us)."""
+        pushed = (directory or {}).get("pushed_at_unix_us", 0)
+        fleet_max = max([r.get("epoch", 0) for r in shards] + [0])
+        per = {}
+        for r in shards:
+            if not r["up"]:
+                per[str(r["id"])] = -1
+                continue
+            if r.get("epoch", 0) < fleet_max:
+                per[str(r["id"])] = (max(0, now_unix - pushed)
+                                    if pushed else -1)
+            elif pushed and r.get("adopt_unix_us", 0) >= pushed:
+                per[str(r["id"])] = r["adopt_unix_us"] - pushed
+            else:
+                per[str(r["id"])] = 0
+        lags = [v for v in per.values() if v >= 0]
+        return {
+            "pushed_at_unix_us": pushed,
+            "per_shard_us": per,
+            "max_lag_us": max(lags) if lags else 0,
+            "behind_shards": [r["id"] for r in shards
+                              if r["up"] and r.get("epoch", 0) < fleet_max],
+            "wrong_epoch_rejections": sum(
+                r.get("wrong_epoch_rejections", 0) for r in shards
+                if r["up"]),
+        }
+
+    def _migration(self, shards):
+        """Live migration progress: the cursor the shards mirror
+        natively, its rate across scrapes (→ ETA), and the keys/bytes
+        the migrating shard gained/lost since the phase left idle."""
+        active = [r for r in shards
+                  if r["up"] and r.get("migration_phase", -1) >= 0
+                  and r.get("migration_phase") != PHASE_IDLE]
+        out = {"active": bool(active), "shards": []}
+        seen = set()
+        for r in active:
+            sid = r["id"]
+            seen.add(sid)
+            cursor = r.get("migration_cursor", 0)
+            total = r.get("migration_total", 0)
+            now = time.monotonic()
+            base = self._mig_base.setdefault(
+                sid, (r["kvmap_len"], r["used_bytes"]))
+            prev = self._mig_prev.get(sid)
+            rate = 0.0
+            if prev is not None and now > prev[1]:
+                rate = max(0.0, (cursor - prev[0]) / (now - prev[1]))
+            self._mig_prev[sid] = (cursor, now)
+            eta = ((total - cursor) / rate
+                   if rate > 0 and total > cursor else -1.0)
+            out["shards"].append({
+                "id": sid,
+                "phase": r.get("migration_phase"),
+                "cursor": cursor,
+                "total": total,
+                "rate_chunks_per_s": round(rate, 3),
+                "eta_s": round(eta, 1) if eta >= 0 else -1,
+                "keys_delta": r["kvmap_len"] - base[0],
+                "bytes_delta": r["used_bytes"] - base[1],
+            })
+        # Idle shards drop their baselines — the next migration gets a
+        # fresh zero, not last month's deltas.
+        for sid in list(self._mig_base):
+            if sid not in seen:
+                self._mig_base.pop(sid, None)
+                self._mig_prev.pop(sid, None)
+        return out
+
+    def _divergence(self, directory, shards):
+        """One digest pass: every multi-replica range's digest compared
+        across its replica set (one batched POST /digest per shard).
+        Persistent divergence (``divergence_streak`` passes) is what
+        the verdict loop trips on — a write mid-fan-out diverges for
+        one pass by design."""
+        up = {r["id"]: r for r in shards if r["up"]}
+        segs = divergence_ranges(directory or {})
+        by_shard = {}
+        for lo, hi, reps in segs:
+            for sid in reps:
+                if sid in up:
+                    by_shard.setdefault(sid, []).append((lo, hi))
+        digests = {}  # (sid, lo, hi) -> {digest, count, bytes}
+        for sid, ranges in by_shard.items():
+            try:
+                st, body = _http_json(
+                    "POST", f"http://{up[sid]['addr']}/digest",
+                    body={"ranges": [[lo, hi] for lo, hi in ranges]},
+                    timeout=self.http_timeout_s)
+            except OSError:
+                continue
+            if st != 200:
+                continue
+            for d in body.get("digests", []):
+                digests[(sid, d["lo"], d["hi"])] = d
+        divergent = []
+        fresh = set()
+        for lo, hi, reps in segs:
+            got = [(sid, digests.get((sid, lo, hi))) for sid in reps
+                   if sid in up]
+            got = [(sid, d) for sid, d in got if d is not None]
+            if len(got) < 2:
+                continue  # 0/1 reachable replicas: nothing to compare
+            if len({d["digest"] for _sid, d in got}) > 1:
+                key = f"{lo:08x}-{hi:08x}"
+                fresh.add(key)
+                self._divergent[key] = self._divergent.get(key, 0) + 1
+                divergent.append({
+                    "range": key, "lo": lo, "hi": hi,
+                    "passes": self._divergent[key],
+                    "replicas": [
+                        {"id": sid, "digest": d["digest"],
+                         "count": d["count"], "bytes": d["bytes"]}
+                        for sid, d in got
+                    ],
+                })
+        for key in list(self._divergent):
+            if key not in fresh:
+                del self._divergent[key]  # converged (anti-entropy ran)
+        return {
+            "checked_ranges": len(segs),
+            "divergent": divergent,
+            "gauge": len(divergent),
+            "pass": self._scrapes,
+        }
+
+    # -- merged views --------------------------------------------------
+
+    def status(self, max_age_s=None):
+        """The /cluster/status blob; re-scrapes when the cache is older
+        than ``max_age_s`` (default: the scrape interval)."""
+        ttl = self.scrape_interval_s if max_age_s is None else max_age_s
+        with self._lock:
+            cached, t = self._status, self._status_t
+        if cached is not None and time.monotonic() - t < ttl:
+            return cached
+        return self.scrape()
+
+    def cached_status(self):
+        """The last scrape without touching the network (the /metrics
+        renderer uses this — a metrics pull must never fan out HTTP
+        probes of its own). None before the first scrape."""
+        with self._lock:
+            return self._status
+
+    def slo(self):
+        """The /cluster/slo blob: per-shard burn windows SUMMED (ops /
+        bad / errors — counts, so addition is exact; burn rates
+        recomputed from the sums) + the quorum availability objective:
+        a key-range is DOWN only when EVERY replica of it is down."""
+        status = self.status()
+        directory = status.get("directory")
+        up_ids = {r["id"] for r in status["shards"] if r["up"]}
+        per_slo = {}
+        for r in status["shards"]:
+            if not r["up"]:
+                continue
+            try:
+                per_slo[r["id"]] = self._get(r["addr"], "/slo")
+            except Exception:  # noqa: BLE001 — scrape hole
+                continue
+        merged = {}
+        objectives = {}
+        burn_threshold = 2.0
+        for blob in per_slo.values():
+            objectives = {
+                "latency": blob.get("latency", {}),
+                "availability": blob.get("availability", {}),
+            }
+            burn_threshold = blob.get("burn_threshold", 2.0)
+            for win in ("short", "long"):
+                w = blob.get(win, {})
+                m = merged.setdefault(win, {
+                    "window_s": w.get("window_s", 0),
+                    "ops": 0, "bad": 0, "errors": 0})
+                m["ops"] += w.get("ops", 0)
+                m["bad"] += w.get("bad", 0)
+                m["errors"] += w.get("errors", 0)
+        lat_obj = (objectives.get("latency", {}) or {}).get(
+            "objective", 0.999)
+        avail_obj = (objectives.get("availability", {}) or {}).get(
+            "objective", 0.999)
+        for w in merged.values():
+            total = w["ops"]
+            w["latency_burn_rate"] = round(
+                (w["bad"] / total) / (1.0 - lat_obj) if total else 0.0,
+                3)
+            w["availability_burn_rate"] = round(
+                (w["errors"] / total) / (1.0 - avail_obj)
+                if total else 0.0, 3)
+        merged.setdefault("short", {
+            "window_s": 0, "ops": 0, "bad": 0, "errors": 0,
+            "latency_burn_rate": 0.0, "availability_burn_rate": 0.0})
+        merged.setdefault("long", dict(merged["short"]))
+        # Quorum availability over the RING: span covered by >= 1 live
+        # replica / total span. One dead shard at replication=2 leaves
+        # every range covered — availability 1.0, nothing burning —
+        # which is exactly the PR 14 data-path promise ("lost only if
+        # EVERY replica dropped it") restated for the SLO plane.
+        covered = down_span = 0
+        ranges_down = []
+        if directory:
+            ring = directory_ring(directory)
+            bounds = ring.boundaries()
+            n = len(bounds)
+            for i in range(n):
+                lo = bounds[i]
+                hi = bounds[(i + 1) % n] if i + 1 < n else bounds[0]
+                span = (hi - lo) % RING_SPAN or RING_SPAN
+                reps = ring.replica_set_at(lo)
+                if any(sid in up_ids for sid in reps):
+                    covered += span
+                else:
+                    down_span += span
+                    if len(ranges_down) < 16:
+                        ranges_down.append(f"{lo:08x}-{hi:08x}")
+        total_span = covered + down_span
+        quorum_avail = covered / total_span if total_span else 1.0
+        quorum_burn = round(
+            (1.0 - quorum_avail) / (1.0 - avail_obj), 3)
+        lat_burning = all(
+            merged[w]["latency_burn_rate"] >= burn_threshold
+            for w in ("short", "long")) and merged["short"]["ops"] > 0
+        avail_burning = all(
+            merged[w]["availability_burn_rate"] >= burn_threshold
+            for w in ("short", "long")) and merged["short"]["ops"] > 0
+        quorum_burning = quorum_burn >= burn_threshold
+        return {
+            "enabled": bool(per_slo),
+            "shards_reporting": len(per_slo),
+            "down_shards": status["down_shards"],
+            "latency": objectives.get("latency", {}),
+            "availability": objectives.get("availability", {}),
+            "burn_threshold": burn_threshold,
+            "short": merged["short"],
+            "long": merged["long"],
+            "quorum": {
+                "availability": round(quorum_avail, 6),
+                "burn_rate": quorum_burn,
+                "ranges_down": ranges_down,
+                "down_span_frac": round(
+                    down_span / total_span if total_span else 0.0, 6),
+            },
+            "latency_burning": lat_burning,
+            "availability_burning": avail_burning,
+            "quorum_burning": quorum_burning,
+            "burning": lat_burning or avail_burning or quorum_burning,
+        }
+
+    def history(self):
+        """The /cluster/history blob: the shards' rings merged sample-
+        by-sample. Alignment is from the TAIL (newest sample of each
+        shard merges together) because every shard samples at the same
+        native cadence while their monotonic t_us values share no
+        origin; merged t_us counts back from the aggregator's clock at
+        the shared interval. Deltas and lat_delta sum bucket-wise — the
+        LatHist geometry is identical everywhere, so merged percentile
+        math stays exact."""
+        status = self.status()
+        rings = {}
+        interval_ms = 1000
+        buckets = 0
+        for r in status["shards"]:
+            if not r["up"]:
+                continue
+            try:
+                h = self._get(r["addr"], "/history")
+            except Exception:  # noqa: BLE001 — scrape hole
+                continue
+            rings[r["id"]] = h.get("history", [])
+            interval_ms = h.get("interval_ms", interval_ms) or 1000
+            buckets = max(buckets, h.get("buckets", 0))
+        depth = max((len(v) for v in rings.values()), default=0)
+        now_us = int(time.monotonic() * 1e6)
+        merged = []
+        sum_keys = (
+            "used_bytes", "pool_bytes", "kvmap_len", "connections",
+            "spill_queue_depth", "promote_queue_depth", "ops_delta",
+            "bytes_in_delta", "bytes_out_delta", "reads_busy_delta",
+            "disk_io_errors_delta", "hard_stalls_delta",
+            "evictions_delta", "spills_delta", "promotes_delta",
+            "premature_evictions_delta", "thrash_cycles_delta",
+            "wss_bytes", "workers_dead",
+        )
+        for back in range(depth, 0, -1):
+            out = {k: 0 for k in sum_keys}
+            out["t_us"] = now_us - back * interval_ms * 1000
+            out["lat_delta"] = [0] * buckets
+            out["shards_reporting"] = 0
+            epochs = []
+            for samples in rings.values():
+                if back > len(samples):
+                    continue
+                s = samples[-back]
+                out["shards_reporting"] += 1
+                for k in sum_keys:
+                    out[k] += s.get(k, 0)
+                for b, v in enumerate(s.get("lat_delta", [])):
+                    if b < buckets:
+                        out["lat_delta"][b] += v
+                epochs.append(s.get("cluster_epoch", 0))
+            # min epoch across shards AT this sample: the lag-visible
+            # view (a merged max would hide a straggler).
+            out["cluster_epoch"] = min(epochs) if epochs else 0
+            out["cluster_epoch_max"] = max(epochs) if epochs else 0
+            merged.append(out)
+        return {
+            "enabled": 1 if rings else 0,
+            "merged_from": sorted(rings),
+            "interval_ms": interval_ms,
+            "buckets": buckets,
+            "now_us": now_us,
+            "history": merged,
+        }
+
+    # -- verdict loop --------------------------------------------------
+
+    def poll_once(self):
+        """One verdict pass: scrape, then fire the cluster-aware
+        watchdog verdicts on the local server when their conditions
+        hold. Returns the status blob."""
+        status = self.scrape()
+        if self.server is None:
+            return status
+        # replica_divergence: a range divergent for >= streak passes.
+        ripe = [d for d in status["divergence"]["divergent"]
+                if d["passes"] >= self.divergence_streak]
+        if ripe:
+            d0 = ripe[0]
+            detail = (
+                f"{len(ripe)} range(s) with divergent replica digests, "
+                f"first {d0['range']} across shards "
+                f"{[r['id'] for r in d0['replicas']]} "
+                f"(persisted {d0['passes']} digest passes)"
+            )
+            if self._trip(0, detail, d0["lo"], len(ripe)):
+                self.trips["replica_divergence"] += 1
+        # epoch_lag: a shard behind the fleet-max epoch for too long.
+        behind = set(status["epoch_lag"]["behind_shards"])
+        now = time.monotonic()
+        for sid in behind:
+            self._lag_since.setdefault(sid, now)
+        for sid in list(self._lag_since):
+            if sid not in behind:
+                del self._lag_since[sid]
+        ripe_lag = [sid for sid, t0 in self._lag_since.items()
+                    if now - t0 >= self.epoch_lag_trip_s]
+        if ripe_lag:
+            sid = ripe_lag[0]
+            lag_us = status["epoch_lag"]["per_shard_us"].get(
+                str(sid), -1)
+            detail = (
+                f"shard {sid} still behind fleet epoch "
+                f"{status['epoch']} after "
+                f"{now - self._lag_since[sid]:.1f}s "
+                f"(propagation lag {lag_us} us)"
+            )
+            if self._trip(1, detail, int(sid), max(0, int(lag_us))):
+                self.trips["epoch_lag"] += 1
+        return status
+
+    def _trip(self, kind, detail, a0, a1):
+        """Fire a cluster verdict on the local server; on success drop
+        fleet.json (the full fleet snapshot) into the bundle the native
+        side just captured."""
+        try:
+            fired = self.server.cluster_trip(kind, detail, a0, a1)
+        except Exception:  # noqa: BLE001 — verdict is best-effort
+            return False
+        if fired:
+            self._write_fleet_snapshot(
+                "replica_divergence" if kind == 0 else "epoch_lag")
+        return fired
+
+    def _write_fleet_snapshot(self, kind):
+        """Append fleet.json to the newest bundle of `kind`: the native
+        capture carries only the LOCAL shard's files; the aggregator is
+        the one party holding every shard's snapshot."""
+        import os
+
+        bundle_dir = getattr(self.server.config, "bundle_dir", "")
+        if not bundle_dir:
+            bundle_dir = os.environ.get("ISTPU_BUNDLE_DIR", "")
+        if not bundle_dir or not os.path.isdir(bundle_dir):
+            return
+        suffix = f"-{kind}"
+        bundles = sorted(
+            d for d in os.listdir(bundle_dir)
+            if d.startswith("bundle-") and d.endswith(suffix)
+        )
+        if not bundles:
+            return
+        path = os.path.join(bundle_dir, bundles[-1], "fleet.json")
+        try:
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(self.cached_status() or {}, f)
+        except OSError:
+            pass  # forensics are best-effort; the bundle itself stands
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="istpu-fleet-agg"
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.scrape_interval_s):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — keep scraping
+                pass
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+
+
+def _hist_p99(hist):
+    """Midpoint p99 over one power-of-two latency histogram (the
+    LatHist convention every surface shares)."""
+    total = sum(hist)
+    if total == 0:
+        return 0
+    rank = int(0.99 * (total - 1)) + 1
+    seen = 0
+    for b, n in enumerate(hist):
+        seen += n
+        if seen >= rank:
+            return (1 << b) + (1 << b) // 2
+    return 0
+
+
 __all__ = [
     "RING_SPAN", "PHASE_IDLE", "PHASE_EXPORT", "PHASE_ADOPT",
     "PHASE_EVICT", "ring_hash", "in_range", "HashRing",
     "build_directory", "directory_ring", "compute_moves",
     "fetch_directory", "push_directory", "WrongEpoch",
-    "MigrationStalled", "ClusterCoordinator",
+    "MigrationStalled", "ClusterCoordinator", "divergence_ranges",
+    "FleetAggregator",
 ]

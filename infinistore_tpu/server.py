@@ -271,6 +271,37 @@ class InfiniStoreServer:
             self._h, str(detail).encode(), int(a0), int(a1)
         )) == 1
 
+    def digest_range(self, ring_lo, ring_hi):
+        """Replica-divergence digest over one ring-hash range (the
+        anti-entropy MEASUREMENT half — ISSUE 15): an order-
+        independent, process-deterministic mix over the committed
+        {key, size} set, so two replicas holding the same range
+        produce the same value whatever their stripe layout. Returns
+        ``{"lo", "hi", "digest" (hex string — u64 does not survive
+        JSON number parsing), "count", "bytes"}``; served by
+        ``GET/POST /digest`` for the fleet aggregator."""
+        d = ct.c_uint64()
+        n = ct.c_uint64()
+        b = ct.c_uint64()
+        rc = int(self._lib.ist_server_digest_range(
+            self._h, int(ring_lo), int(ring_hi),
+            ct.byref(d), ct.byref(n), ct.byref(b)))
+        if rc != 0:
+            raise Exception("digest_range failed")
+        return {"lo": int(ring_lo), "hi": int(ring_hi),
+                "digest": f"{d.value:016x}",
+                "count": int(n.value), "bytes": int(b.value)}
+
+    def cluster_trip(self, kind, detail, a0=0, a1=0):
+        """Fire a fleet-aggregator verdict: ``kind`` 0 =
+        ``watchdog.replica_divergence``, 1 = ``watchdog.epoch_lag``.
+        Catalog event + trip counter + diagnostic bundle under the
+        per-kind cooldown (the aggregator then drops fleet.json into
+        the bundle). False while cooling."""
+        return int(self._lib.ist_server_cluster_trip(
+            self._h, int(kind), str(detail).encode(), int(a0), int(a1)
+        )) == 1
+
     def restore(self, path):
         """Load a snapshot (existing keys win; stops when the pool is
         full, keeping what fits; a truncated tail keeps the valid
@@ -511,11 +542,14 @@ def _selftest(service_port):
         conn.close()
 
 
-def _prometheus_metrics(stats, slo=None):
+def _prometheus_metrics(stats, slo=None, aggregator=None):
     """Render the native stats blob in Prometheus text format
     (observability beyond the reference, which exposes only
     /kvmap_len + /purge + /selftest — reference server.py:29-96).
-    ``slo`` (an :class:`SLOTracker`) adds the burn-rate families."""
+    ``slo`` (an :class:`SLOTracker`) adds the burn-rate families;
+    ``aggregator`` (a :class:`cluster.FleetAggregator`) adds the
+    fleet families from its LAST scrape (never a fresh one — a
+    metrics pull must not fan out HTTP probes)."""
     g = [  # (stat key, metric name, help)
         ("kvmap_len", "keys", "committed + inflight keys in the index"),
         ("inflight", "inflight_writes", "uncommitted allocations"),
@@ -900,6 +934,59 @@ def _prometheus_metrics(stats, slo=None):
         f'infinistore_cluster_migration_cursor '
         f'{cl.get("migration_cursor", 0)}'
     )
+    lines.append(
+        "# HELP infinistore_cluster_wrong_epoch_total stale directory "
+        "pushes this shard refused with WRONG_EPOCH"
+    )
+    lines.append("# TYPE infinistore_cluster_wrong_epoch_total counter")
+    lines.append(
+        f'infinistore_cluster_wrong_epoch_total '
+        f'{cl.get("wrong_epoch_rejections", 0)}'
+    )
+    # Fleet families (ISSUE 15), rendered from the aggregator's LAST
+    # scrape only when one is attached and has scraped — a plain
+    # single-node /metrics pull carries none of these.
+    fleet = aggregator.cached_status() if aggregator is not None else None
+    if fleet is not None:
+        div = fleet.get("divergence", {})
+        lines.append(
+            "# HELP infinistore_cluster_replica_divergence key-ranges "
+            "whose replica digests disagree (per range; the "
+            "anti-entropy measurement gauge)"
+        )
+        lines.append(
+            "# TYPE infinistore_cluster_replica_divergence gauge"
+        )
+        for d in div.get("divergent", []):
+            lines.append(
+                f'infinistore_cluster_replica_divergence'
+                f'{{range="{d.get("range", "?")}"}} 1'
+            )
+        lines.append(
+            f'infinistore_cluster_replica_divergence'
+            f'{{range="_total"}} {div.get("gauge", 0)}'
+        )
+        lag = fleet.get("epoch_lag", {})
+        lines.append(
+            "# HELP infinistore_cluster_epoch_lag_us directory-epoch "
+            "propagation lag per shard (push to adopt, wall clock; "
+            "-1 = shard down)"
+        )
+        lines.append("# TYPE infinistore_cluster_epoch_lag_us gauge")
+        for sid, v in lag.get("per_shard_us", {}).items():
+            lines.append(
+                f'infinistore_cluster_epoch_lag_us{{shard="{sid}"}} {v}'
+            )
+        lines.append(
+            "# HELP infinistore_cluster_shard_up scrape health per "
+            "directory shard (1 = answering its control plane)"
+        )
+        lines.append("# TYPE infinistore_cluster_shard_up gauge")
+        for r in fleet.get("shards", []):
+            lines.append(
+                f'infinistore_cluster_shard_up'
+                f'{{shard="{r.get("id")}"}} {1 if r.get("up") else 0}'
+            )
     # Metrics-history ring meta (the ring itself is GET /history).
     hist = stats.get("history", {})
     lines.append(
@@ -945,12 +1032,21 @@ def _prometheus_metrics(stats, slo=None):
 
 
 def make_control_plane(server: InfiniStoreServer, snapshot_path=None,
-                       slo=None):
+                       slo=None, aggregator=None):
     # GET /slo always answers: without an explicitly configured tracker
     # (programmatic users, tests) a default-objective tracker computes
     # on demand — only main() starts the verdict THREAD.
     if slo is None:
         slo = SLOTracker(server)
+    # GET /cluster/* always answers too: without an explicitly
+    # configured aggregator a default one scrapes on demand, by the
+    # directory this shard holds natively (a fresh single-node server
+    # holds none → well-formed empty views, never an error). Only
+    # main()'s --cluster-aggregator starts the scrape/verdict THREAD.
+    if aggregator is None:
+        from .cluster import FleetAggregator
+
+        aggregator = FleetAggregator(server=server)
 
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code, payload):
@@ -978,7 +1074,8 @@ def make_control_plane(server: InfiniStoreServer, snapshot_path=None,
                 self._send(200, server.stats())
             elif self.path == "/metrics":
                 self._send_text(
-                    200, _prometheus_metrics(server.stats(), slo=slo)
+                    200, _prometheus_metrics(server.stats(), slo=slo,
+                                             aggregator=aggregator)
                 )
             elif self.path == "/history":
                 # Metrics-history ring: ~1 Hz snapshots with counter/
@@ -995,6 +1092,38 @@ def make_control_plane(server: InfiniStoreServer, snapshot_path=None,
                 # pool sizes, WSS estimate, eviction-quality counters,
                 # projected dedup ratio, heat classes.
                 self._send(200, server.workload())
+            elif self.path == "/cluster/status":
+                # Fleet view (ISSUE 15): per-shard gauges + health,
+                # skew, epoch-propagation lag, migration progress and
+                # the replica-divergence table — scraped from every
+                # directory shard by the aggregator.
+                self._send(200, aggregator.status())
+            elif self.path == "/cluster/slo":
+                # Quorum-aware fleet SLO: burn windows summed across
+                # shards; availability counts a key-range down only
+                # when EVERY replica of it is down (the PR 14 data-path
+                # promise restated for the SLO plane).
+                self._send(200, aggregator.slo())
+            elif self.path == "/cluster/history":
+                # The shards' metrics-history rings merged bucket-wise
+                # in the shared LatHist geometry (tail-aligned samples;
+                # merged percentiles stay exact).
+                self._send(200, aggregator.history())
+            elif self.path.startswith("/digest"):
+                # Single-range divergence digest of THIS shard:
+                # /digest?lo=N&hi=N (ring-hash coordinates, wrap-around
+                # when lo > hi). The aggregator's batched pass uses the
+                # POST form instead.
+                from urllib.parse import parse_qs, urlparse
+
+                q = parse_qs(urlparse(self.path).query)
+                try:
+                    lo = int(q.get("lo", ["0"])[0])
+                    hi = int(q.get("hi", [str(1 << 32)])[0])
+                except ValueError:
+                    self._send(400, {"error": "lo/hi must be ints"})
+                    return
+                self._send(200, server.digest_range(lo, hi))
             elif self.path == "/directory":
                 # Cluster tier: the shard directory this server holds
                 # (epoch-numbered map + live migration phase/cursor)
@@ -1200,6 +1329,24 @@ def make_control_plane(server: InfiniStoreServer, snapshot_path=None,
             if self.path == "/purge":
                 n = server.purge()
                 self._send(200, {"purged": n})
+            elif self.path == "/digest":
+                # Batched divergence digests: {"ranges": [[lo, hi],
+                # ...]} → {"digests": [{lo, hi, digest, count, bytes}]}
+                # — ONE round trip per shard per aggregator digest
+                # pass, whatever the ring's segment count.
+                body = self._json_body()
+                if body is None or not isinstance(
+                        body.get("ranges"), list):
+                    self._send(400, {"error": "body needs ranges list"})
+                    return
+                try:
+                    out = [server.digest_range(int(lo), int(hi))
+                           for lo, hi in body["ranges"]]
+                except (TypeError, ValueError):
+                    self._send(400,
+                               {"error": "ranges must be [lo, hi] ints"})
+                    return
+                self._send(200, {"digests": out})
             elif self.path == "/directory":
                 self._post_directory()
             elif self.path == "/migrate":
@@ -1368,6 +1515,18 @@ def parse_args(argv=None):
     p.add_argument("--no-slo", action="store_true",
                    help="disable the SLO burn-rate tracker thread "
                         "(GET /slo still computes on demand)")
+    p.add_argument("--cluster-aggregator", action="store_true",
+                   help="start the fleet-aggregator scrape/verdict "
+                        "thread on this node: scrapes every directory "
+                        "shard's control plane, serves the merged "
+                        "GET /cluster/{status,slo,history} views and "
+                        "fires the watchdog.replica_divergence / "
+                        "watchdog.epoch_lag verdicts (bundle + "
+                        "fleet.json). Without the flag the /cluster/* "
+                        "endpoints still compute on demand")
+    p.add_argument("--cluster-scrape-interval", type=float, default=1.0,
+                   help="fleet-aggregator scrape cadence in seconds "
+                        "(divergence digests run every 5th scrape)")
     p.add_argument("--slo-latency-ms", type=float, default=100.0,
                    help="latency SLO threshold: ops slower than this "
                         "count against the error budget")
@@ -1494,8 +1653,16 @@ def main(argv=None):
     )
     if not args.no_slo:
         slo.start()
+    from .cluster import FleetAggregator
+
+    aggregator = FleetAggregator(
+        server=server,
+        scrape_interval_s=args.cluster_scrape_interval,
+    )
+    if args.cluster_aggregator:
+        aggregator.start()
     httpd = make_control_plane(server, snapshot_path=args.snapshot_path,
-                               slo=slo)
+                               slo=slo, aggregator=aggregator)
     Logger.info(f"manage plane on :{config.manage_port}")
 
     if args.port_file:
@@ -1524,6 +1691,7 @@ def main(argv=None):
     finally:
         httpd.server_close()
         slo.stop()
+        aggregator.stop()
         if args.snapshot_path:
             try:
                 n = server.snapshot(args.snapshot_path)

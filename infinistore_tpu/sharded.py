@@ -245,6 +245,28 @@ class ShardedConnection:
             {"failures": 0, "reconnects": 0, "last_error": ""}
             for _ in range(self.n)
         ]
+        # Directory-mode failover telemetry (ISSUE 15 satellite):
+        # NOISY failover — every read served, but each one walking a
+        # replica ladder first — is invisible in the health counters
+        # above (nothing is lost) and in the per-conn native stats
+        # (each sub-call looks like an ordinary read). These live on
+        # the router, where the ladder runs; client_stats() exposes
+        # them under "failover". GIL-atomic int bumps like _load.
+        #   read_failovers    keys whose read left their first-choice
+        #                     replica (per ladder pass; a key retried
+        #                     twice counts twice — it is a RATE)
+        #   refresh_on_miss   replica-exhausted misses that triggered
+        #                     a directory refresh
+        #   replica_reads     per-shard (conn-index-aligned) count of
+        #                     read sub-calls ROUTED there — the
+        #                     replica-read distribution; a dead shard's
+        #                     share flowing to its peers is visible as
+        #                     the distribution tilting
+        self.failover_stats = {
+            "read_failovers": 0,
+            "refresh_on_miss": 0,
+            "replica_reads": [0] * self.n,
+        }
         self._health_lock = threading.Lock()
         self._reconnector = None
         # Wakes the prober out of its backoff sleep: close() must not
@@ -454,6 +476,7 @@ class ShardedConnection:
             self.shard_health.append(
                 {"failures": 0, "reconnects": 0, "last_error": ""})
             self._load.append(0)
+            self.failover_stats["replica_reads"].append(0)
             idx = len(self.conns) - 1
             old_index[s["id"]] = idx
             if self.connected:
@@ -993,6 +1016,10 @@ class ShardedConnection:
             if s is None:
                 exhausted.extend(chunk_pairs)
                 continue
+            # Replica-read distribution (failover telemetry): keys
+            # ROUTED to this shard for this pass, counted where the
+            # choice is made.
+            self.failover_stats["replica_reads"][s] += len(chunk_pairs)
             for k, _ in chunk_pairs:
                 tried.setdefault(k, set()).add(s)
             grouped = [p for p in chunk_pairs if p[0] not in isolate]
@@ -1051,17 +1078,25 @@ class ShardedConnection:
                 cache, pending, page_size, tried, isolate)
             missed.extend(exhausted)
             pending = retry
+            if retry:
+                # Failover rate: keys whose read is leaving a failed
+                # replica for the next one (counted per pass — a key
+                # that walks two dead replicas counts twice).
+                self.failover_stats["read_failovers"] += len(retry)
             if pending and not retry_has_untried(pending, tried,
                                                  self._replicas):
                 # Every replica of every pending key has failed. The
                 # pin-cache-epoch move: ONE directory refresh — a
                 # migration may have re-homed the range — then one
                 # more ladder under the new map.
-                if (not refreshed and self.directory_addrs
-                        and self.refresh_directory()):
-                    refreshed = True
-                    tried = {}
-                    continue
+                if not refreshed and self.directory_addrs:
+                    # Counted per ATTEMPT (the control-plane probe is
+                    # the cost worth watching), fired or rate-limited.
+                    self.failover_stats["refresh_on_miss"] += 1
+                    if self.refresh_directory():
+                        refreshed = True
+                        tried = {}
+                        continue
                 break
         missed.extend(pending)
         if missed:
@@ -1380,11 +1415,31 @@ class ShardedConnection:
         from .lib import merge_fabric_stats
 
         fabric = merge_fabric_stats(per)
+        # Directory-mode failover telemetry (ISSUE 15 satellite): the
+        # ladder counters live on the router (see __init__), the
+        # replica-read distribution is conn-index-aligned like the
+        # other per-shard arrays. Zeros in legacy static-hash mode —
+        # the section is always present so dashboards need no probe.
+        reads = list(self.failover_stats["replica_reads"])
+        total_reads = sum(reads)
+        failover = {
+            "read_failovers": self.failover_stats["read_failovers"],
+            "refresh_on_miss": self.failover_stats["refresh_on_miss"],
+            "replica_reads": reads,
+            # Normalized distribution (milli-fractions): the tilt a
+            # dead replica leaves on its peers, readable at a glance.
+            "replica_read_share_milli": [
+                int(1000 * r / total_reads) if total_reads else 0
+                for r in reads
+            ],
+            "directory_epoch": self.directory_epoch,
+        }
         return {
             "enabled": any(ps.get("enabled") for ps in per),
             "ops": ops,
             "counters": counters,
             "fabric": fabric,
+            "failover": failover,
             "per_shard": per,
         }
 

@@ -151,10 +151,18 @@ extern "C" {
 // watchdog.migration verdict kind + catalog event),
 // ist_cluster_failpoint / ist_fault_arm (control-plane/client-side
 // chaos eval of the new cluster.* failpoints), new cluster.epoch_bump
-// / cluster.migration_phase catalog events.
+// / cluster.migration_phase catalog events; v15: cluster
+// observability plane — new ist_server_digest_range (order-
+// independent replica-divergence digest over one ring-hash range)
+// and ist_server_cluster_trip (aggregator-fired
+// watchdog.replica_divergence / watchdog.epoch_lag verdicts), the
+// cluster mirror gains wrong_epoch_rejections / adopt_unix_us (stats
+// + cluster_json), stats watchdog section gains divergence_trips /
+// epoch_lag_trips, new cluster.wrong_epoch /
+// watchdog.replica_divergence / watchdog.epoch_lag catalog events.
 // _native.py probes this at load so a stale prebuilt library fails
 // loudly instead of feeding unparseable blobs to the server.
-uint32_t ist_abi_version(void) { return 14; }
+uint32_t ist_abi_version(void) { return 15; }
 
 void ist_set_log_level(int level) { set_log_level(level); }
 void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }
@@ -332,6 +340,41 @@ int ist_server_migration_trip(void* h, const char* detail, uint64_t a0,
     if (h == nullptr) return -1;
     return static_cast<Server*>(h)->migration_trip(
                detail != nullptr ? detail : "", a0, a1)
+               ? 1
+               : 0;
+}
+
+// ---- cluster observability plane (ABI v15) -----------------------------
+
+// Replica-divergence digest over one ring-hash range: an order-
+// independent, process-deterministic xor-mix over the committed
+// {key, size} set (KVIndex::digest_range — FNV-1a key hash, never
+// std::hash). The fleet aggregator calls this on every member of a
+// range's replica set and compares; digest/count/bytes are out-params
+// (any may be NULL). Returns 0, or -1 on a null/stopped handle.
+int ist_server_digest_range(void* h, uint64_t ring_lo, uint64_t ring_hi,
+                            uint64_t* digest, uint64_t* count,
+                            uint64_t* bytes) {
+    if (h == nullptr) return -1;
+    try {
+        return static_cast<Server*>(h)->digest_range(ring_lo, ring_hi,
+                                                     digest, count,
+                                                     bytes);
+    } catch (...) {
+        return -1;
+    }
+}
+
+// Aggregator-fired cluster verdicts: kind 0 = replica_divergence
+// (a0/a1 by convention: range lo, divergent-range count), kind 1 =
+// epoch_lag (a0/a1: lagging shard id, lag µs). Event + trip + bundle
+// under the per-kind cooldown, exactly the slo_trip shape. Returns 1
+// fired, 0 cooling, -1 null handle / unknown kind.
+int ist_server_cluster_trip(void* h, int kind, const char* detail,
+                            uint64_t a0, uint64_t a1) {
+    if (h == nullptr || kind < 0 || kind > 1) return -1;
+    return static_cast<Server*>(h)->cluster_trip(
+               kind, detail != nullptr ? detail : "", a0, a1)
                ? 1
                : 0;
 }

@@ -88,6 +88,9 @@ namespace istpu {
     X(EV_WATCHDOG_MIGRATION, "watchdog.migration", SEV_ERROR)       \
     X(EV_CLUSTER_EPOCH_BUMP, "cluster.epoch_bump", SEV_INFO)        \
     X(EV_CLUSTER_MIGRATION_PHASE, "cluster.migration_phase", SEV_INFO) \
+    X(EV_CLUSTER_WRONG_EPOCH, "cluster.wrong_epoch", SEV_WARN)      \
+    X(EV_WATCHDOG_DIVERGENCE, "watchdog.replica_divergence", SEV_ERROR) \
+    X(EV_WATCHDOG_EPOCH_LAG, "watchdog.epoch_lag", SEV_ERROR)       \
     X(EV_BUNDLE_CAPTURED, "watchdog.bundle", SEV_INFO)
 
 enum EventSeverity : uint8_t {

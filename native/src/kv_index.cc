@@ -855,6 +855,44 @@ size_t KVIndex::erase_range(uint64_t ring_lo, uint64_t ring_hi) {
     return n;
 }
 
+uint64_t KVIndex::digest_range(uint64_t ring_lo, uint64_t ring_hi,
+                               uint64_t* count, uint64_t* bytes) const {
+    // splitmix64 finalizer over the per-entry word before the xor
+    // accumulate: raw xor of structured hashes cancels too easily
+    // (two entries differing only in one size bit), the finalizer
+    // decorrelates every input bit first.
+    auto fin = [](uint64_t x) {
+        x ^= x >> 30;
+        x *= 0xBF58476D1CE4E5B9ull;
+        x ^= x >> 27;
+        x *= 0x94D049BB133111EBull;
+        x ^= x >> 31;
+        return x;
+    };
+    uint64_t acc = 0, n = 0, b = 0;
+    for (const Stripe& st : stripes_) {
+        ScopedLock lk(st.mu);
+        for (const auto& [key, e] : st.map) {
+            if (!e.committed ||
+                !ring_in_range(ring_hash(key), ring_lo, ring_hi)) {
+                continue;
+            }
+            // FNV-1a 64 over the key bytes: deterministic across
+            // processes (std::hash is not contractually so).
+            uint64_t h = 0xCBF29CE484222325ull;
+            for (unsigned char ch : key) {
+                h = (h ^ ch) * 0x100000001B3ull;
+            }
+            acc ^= fin(h ^ (uint64_t(e.size) * 0x9E3779B97F4A7C15ull));
+            n++;
+            b += e.size;
+        }
+    }
+    if (count != nullptr) *count = n;
+    if (bytes != nullptr) *bytes = b;
+    return acc;
+}
+
 size_t KVIndex::size() const {
     size_t n = 0;
     for (const Stripe& st : stripes_) {

@@ -351,6 +351,24 @@ class KVIndex {
     // recycled blocks).
     size_t erase_range(uint64_t ring_lo, uint64_t ring_hi);
 
+    // Replica-divergence digest over the committed entries of one
+    // ring-hash range (the measurement half of anti-entropy — ISSUE
+    // 15): an ORDER-INDEPENDENT xor of a per-entry mix of a
+    // deterministic key hash (FNV-1a 64, never std::hash — two shards
+    // must agree byte-for-byte across processes and builds) and the
+    // entry size. Two replicas holding the same {key -> size} set for
+    // the range produce the same digest regardless of stripe layout
+    // or insertion order; a key present on one side only (written
+    // while a replica was down) flips it. Payload CONTENT is not
+    // hashed — entries are immutable once committed (first-writer-
+    // wins), so key identity + size is the divergence signal at a
+    // cost the aggregator can afford per scrape. Stripe at a time
+    // like erase_range; `count`/`bytes` (optional) report the
+    // range's population for the fleet gauges.
+    uint64_t digest_range(uint64_t ring_lo, uint64_t ring_hi,
+                          uint64_t* count = nullptr,
+                          uint64_t* bytes = nullptr) const;
+
     // Directly insert a COMMITTED entry (snapshot restore): pool
     // allocate + copy + visible immediately, no token round-trip.
     // CONFLICT when the key exists (first-writer-wins: live data beats

@@ -11,6 +11,7 @@
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/uio.h>
+#include <time.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -843,6 +844,18 @@ long long Server::delete_range(uint64_t ring_lo, uint64_t ring_hi) {
     return (long long)index_->erase_range(ring_lo, ring_hi);
 }
 
+namespace {
+// Wall clock for the epoch-propagation lag math: the pusher stamps
+// the directory blob with ITS wall clock (pushed_at_unix_us) and the
+// aggregator subtracts this shard's adoption stamp — monotonic clocks
+// never compare across processes.
+long long unix_us() {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return (long long)ts.tv_sec * 1000000 + ts.tv_nsec / 1000;
+}
+}  // namespace
+
 int Server::cluster_set(uint64_t epoch, const std::string& dir_json,
                         long long phase, uint64_t cursor,
                         uint64_t total) {
@@ -858,13 +871,24 @@ int Server::cluster_set(uint64_t epoch, const std::string& dir_json,
     {
         ScopedLock lk(cluster_mu_);
         cur = cluster_epoch_.load(std::memory_order_relaxed);
-        if (epoch < cur) return -1;  // stale: caller answers WRONG_EPOCH
+        if (epoch < cur) {
+            // Stale push refused: count + flight-record it (the
+            // epoch-propagation telemetry the aggregator scrapes — a
+            // coordinator stuck re-pushing an old map shows up here,
+            // not as silent retries), then the caller answers
+            // WRONG_EPOCH.
+            cluster_wrong_epoch_.fetch_add(1, std::memory_order_relaxed);
+            events_emit(EV_CLUSTER_WRONG_EPOCH, epoch, cur);
+            return -1;
+        }
         if (!dir_json.empty()) cluster_dir_json_ = dir_json;
         cluster_phase_.store(phase, std::memory_order_relaxed);
         cluster_cursor_.store(cursor, std::memory_order_relaxed);
         cluster_total_.store(total, std::memory_order_relaxed);
         if (epoch > cur) {
             cluster_epoch_.store(epoch, std::memory_order_relaxed);
+            cluster_adopt_unix_us_.store(unix_us(),
+                                         std::memory_order_relaxed);
             bumped = true;
         }
     }
@@ -880,10 +904,12 @@ int Server::cluster_set(uint64_t epoch, const std::string& dir_json,
 }
 
 std::string Server::cluster_json() const {
-    char head[192];
+    char head[320];
     snprintf(head, sizeof(head),
              "{\"epoch\": %llu, \"migration_phase\": %lld, "
              "\"migration_cursor\": %llu, \"migration_total\": %llu, "
+             "\"wrong_epoch_rejections\": %llu, "
+             "\"adopt_unix_us\": %lld, "
              "\"directory\": ",
              (unsigned long long)cluster_epoch_.load(
                  std::memory_order_relaxed),
@@ -891,7 +917,10 @@ std::string Server::cluster_json() const {
              (unsigned long long)cluster_cursor_.load(
                  std::memory_order_relaxed),
              (unsigned long long)cluster_total_.load(
-                 std::memory_order_relaxed));
+                 std::memory_order_relaxed),
+             (unsigned long long)cluster_wrong_epoch_.load(
+                 std::memory_order_relaxed),
+             cluster_adopt_unix_us_.load(std::memory_order_relaxed));
     std::string out = head;
     {
         ScopedLock lk(cluster_mu_);
@@ -922,6 +951,50 @@ bool Server::migration_trip(const std::string& detail, uint64_t a0,
     IST_WARN("watchdog migration: %s", detail.c_str());
     if (!bundle_dir_.empty()) capture_bundle("migration", detail);
     return true;
+}
+
+bool Server::cluster_trip(int kind, const std::string& detail,
+                          uint64_t a0, uint64_t a1) {
+    // Fleet-aggregator verdicts (ISSUE 15). Per-kind CAS cooldown
+    // like slo_trip/migration_trip — an aggregator scraping at 1 Hz
+    // must not burn a bundle per scrape while a divergence persists.
+    const bool div = kind == 0;
+    std::atomic<long long>& stamp =
+        div ? divergence_last_trip_us_ : epoch_lag_last_trip_us_;
+    long long now = now_us();
+    long long prev = stamp.load(std::memory_order_relaxed);
+    if (prev != 0 && now - prev < (long long)wd_cooldown_us_) {
+        return false;
+    }
+    if (!stamp.compare_exchange_strong(prev, now,
+                                       std::memory_order_relaxed)) {
+        return false;  // a concurrent aggregator call won the trip
+    }
+    if (div) {
+        events_emit(EV_WATCHDOG_DIVERGENCE, a0, a1);
+    } else {
+        events_emit(EV_WATCHDOG_EPOCH_LAG, a0, a1);
+    }
+    WdKind wk = div ? kWdDivergence : kWdEpochLag;
+    wd_trips_[wk].fetch_add(1, std::memory_order_relaxed);
+    wd_last_kind_.store(int(wk), std::memory_order_relaxed);
+    wd_last_trip_us_.store(now, std::memory_order_relaxed);
+    IST_WARN("watchdog %s: %s",
+             div ? "replica_divergence" : "epoch_lag", detail.c_str());
+    if (!bundle_dir_.empty()) {
+        capture_bundle(div ? "replica_divergence" : "epoch_lag", detail);
+    }
+    return true;
+}
+
+int Server::digest_range(uint64_t ring_lo, uint64_t ring_hi,
+                         uint64_t* digest, uint64_t* count,
+                         uint64_t* bytes) {
+    ScopedLock lk(store_mu_);
+    if (!index_) return -1;
+    uint64_t d = index_->digest_range(ring_lo, ring_hi, count, bytes);
+    if (digest != nullptr) *digest = d;
+    return 0;
 }
 
 std::string Server::stats_json() {
@@ -1107,7 +1180,9 @@ std::string Server::stats_json() {
         long long last = events_last_us();
         static const char* kKindNames[] = {"stall", "slow_op",
                                            "queue_growth", "slo_burn",
-                                           "thrash", "migration"};
+                                           "thrash", "migration",
+                                           "replica_divergence",
+                                           "epoch_lag"};
         int lk = wd_last_kind_.load(std::memory_order_relaxed);
         long long lt = wd_last_trip_us_.load(std::memory_order_relaxed);
         uint64_t trips = 0;
@@ -1119,7 +1194,7 @@ std::string Server::stats_json() {
             ScopedLock hlk(hist_mu_);
             hist_rec = hist_recorded_;
         }
-        char entry[768];
+        char entry[1024];
         snprintf(
             entry, sizeof(entry),
             ", \"events\": {\"recorded\": %llu, \"overwritten\": %llu, "
@@ -1131,6 +1206,7 @@ std::string Server::stats_json() {
             "\"slow_op_trips\": %llu, \"queue_trips\": %llu, "
             "\"slo_trips\": %llu, \"thrash_trips\": %llu, "
             "\"migration_trips\": %llu, "
+            "\"divergence_trips\": %llu, \"epoch_lag_trips\": %llu, "
             "\"bundles\": %llu, \"last_trigger\": \"%s\", "
             "\"last_trip_age_us\": %lld}",
             (unsigned long long)events_recorded_total(),
@@ -1153,6 +1229,10 @@ std::string Server::stats_json() {
             (unsigned long long)wd_trips_[kWdThrash].load(
                 std::memory_order_relaxed),
             (unsigned long long)wd_trips_[kWdMigration].load(
+                std::memory_order_relaxed),
+            (unsigned long long)wd_trips_[kWdDivergence].load(
+                std::memory_order_relaxed),
+            (unsigned long long)wd_trips_[kWdEpochLag].load(
                 std::memory_order_relaxed),
             (unsigned long long)wd_bundles_.load(
                 std::memory_order_relaxed),
@@ -1189,19 +1269,24 @@ std::string Server::stats_json() {
         // Cluster tier headline (GET /directory serves the full
         // directory blob): the epoch the dashboards correlate with
         // re-routing, plus the live migration cursor.
-        char entry[192];
+        char entry[320];
         snprintf(entry, sizeof(entry),
                  ", \"cluster\": {\"epoch\": %llu, "
                  "\"migration_phase\": %lld, "
                  "\"migration_cursor\": %llu, "
-                 "\"migration_total\": %llu}",
+                 "\"migration_total\": %llu, "
+                 "\"wrong_epoch_rejections\": %llu, "
+                 "\"adopt_unix_us\": %lld}",
                  (unsigned long long)cluster_epoch_.load(
                      std::memory_order_relaxed),
                  cluster_phase_.load(std::memory_order_relaxed),
                  (unsigned long long)cluster_cursor_.load(
                      std::memory_order_relaxed),
                  (unsigned long long)cluster_total_.load(
-                     std::memory_order_relaxed));
+                     std::memory_order_relaxed),
+                 (unsigned long long)cluster_wrong_epoch_.load(
+                     std::memory_order_relaxed),
+                 cluster_adopt_unix_us_.load(std::memory_order_relaxed));
         out += entry;
     }
     out += "}";

@@ -445,6 +445,13 @@ class Server {
     // Drop every committed entry in the ring-hash range (the migration
     // commit's source-side evict; KVIndex::erase_range semantics).
     long long delete_range(uint64_t ring_lo, uint64_t ring_hi);
+    // Replica-divergence digest over one ring-hash range
+    // (KVIndex::digest_range semantics): order-independent, process-
+    // deterministic — the fleet aggregator compares it across a
+    // range's replica set. Returns 0 (digest/count/bytes written) or
+    // -1 when the store is gone.
+    int digest_range(uint64_t ring_lo, uint64_t ring_hi,
+                     uint64_t* digest, uint64_t* count, uint64_t* bytes);
 
     // --- cluster tier (docs/design.md "Cluster tier") ----------------
     // The shard-directory mirror: the Python control plane pushes the
@@ -470,6 +477,17 @@ class Server {
     // slo_trip. a0/a1 by convention: migration phase, range cursor.
     bool migration_trip(const std::string& detail, uint64_t a0 = 0,
                         uint64_t a1 = 0);
+    // Cluster-aware verdicts, tripped by the FLEET AGGREGATOR (never
+    // the native sampler — divergence and propagation lag are
+    // cross-shard facts only the scraping side can see): kind 0 =
+    // replica_divergence (a key-range's replica digests disagree),
+    // kind 1 = epoch_lag (a shard keeps serving an old directory
+    // epoch past the propagation deadline). Same CAS-cooldown shape
+    // as slo_trip/migration_trip; the bundle's cluster.json carries
+    // this shard's directory view, and the aggregator drops the fleet
+    // snapshot (fleet.json) into the bundle dir after the trip.
+    bool cluster_trip(int kind, const std::string& detail,
+                      uint64_t a0 = 0, uint64_t a1 = 0);
 
     uint16_t bound_port() const { return bound_port_; }
     const std::string& shm_prefix() const { return cfg_.shm_prefix; }
@@ -723,8 +741,14 @@ class Server {
         // (tripped from the control plane by the rebalance
         // coordinator, like kWdSlo — never by the native sampler).
         kWdMigration = 5,
+        // Cluster observability plane (ISSUE 15): both tripped by the
+        // fleet aggregator via cluster_trip — divergence and epoch
+        // propagation lag are cross-shard facts invisible to the
+        // native sampler.
+        kWdDivergence = 6,
+        kWdEpochLag = 7,
     };
-    static constexpr int kWdKinds = 6;
+    static constexpr int kWdKinds = 8;
     std::atomic<uint64_t> wd_trips_[kWdKinds] = {};
     std::atomic<int> wd_last_kind_{-1};
     std::atomic<long long> wd_last_trip_us_{0};
@@ -753,6 +777,11 @@ class Server {
     long long wd_last_per_kind_[kWdKinds] = {};
     std::atomic<long long> slo_last_trip_us_{0};
     std::atomic<long long> migration_last_trip_us_{0};
+    // Aggregator-tripped cluster verdicts (cluster_trip): per-kind
+    // CAS stamps like slo/migration — control-plane callers, never
+    // the watchdog thread's wd_last_per_kind_ slots.
+    std::atomic<long long> divergence_last_trip_us_{0};
+    std::atomic<long long> epoch_lag_last_trip_us_{0};
 
     // --- cluster tier state (pushed by the Python control plane via
     // cluster_set; read by stats_json/history/bundles/GET /directory).
@@ -766,6 +795,14 @@ class Server {
     std::atomic<long long> cluster_phase_{-1};   // -1 = no migration
     std::atomic<uint64_t> cluster_cursor_{0};
     std::atomic<uint64_t> cluster_total_{0};
+    // Epoch-propagation telemetry (ISSUE 15): stale pushes refused
+    // (each also emits cluster.wrong_epoch), and the WALL-CLOCK stamp
+    // of the last epoch ADOPTION — wall clock, not monotonic, because
+    // the lag math subtracts the pusher's stamp in another process
+    // (directory blobs carry pushed_at_unix_us; monotonic clocks do
+    // not compare across processes).
+    std::atomic<uint64_t> cluster_wrong_epoch_{0};
+    std::atomic<long long> cluster_adopt_unix_us_{0};
 
     // --- metrics-history ring (GET /history). Sampled on the watchdog
     // thread (which now runs whenever history OR verdicts are enabled);

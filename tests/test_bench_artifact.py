@@ -365,6 +365,35 @@ def test_workload_leg_emits_accuracy_and_overhead_keys():
     assert out["workload_premature_evictions"] > 0
 
 
+def test_cluster_obs_leg_emits_overhead_keys():
+    """The cluster-observability leg (ISSUE 15) must land its keys in
+    the artifact: the aggregator-scraping vs idle read p50s, the
+    <=1.02 acceptance ratio (asserted only as sane here — CI noise is
+    checked at the acceptance level), and proof the on-leg's
+    aggregator actually scraped the fleet with divergence digests
+    (a ratio over an aggregator that never ran certifies nothing)."""
+    env = _env(600)
+    env["ISTPU_CLUSTER_OBS_KEYS"] = "128"  # small: keep the test fast
+    p = subprocess.run(
+        [sys.executable, BENCH, "--cluster-obs-leg", "0"], env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert p.returncode == 0, p.stderr[-400:]
+    outs = _parse_artifacts(
+        [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+    )
+    assert outs, p.stdout[-400:]
+    out = outs[-1]
+    assert "cluster_obs_error" not in out, out
+    assert out["cluster_obs_off_p50_read_us"] > 0
+    assert out["cluster_obs_on_p50_read_us"] > 0
+    assert out["cluster_obs_overhead_p50_ratio"] > 0
+    # The on-leg's aggregator demonstrably scraped (>= one pass per
+    # interleaved pair) and had real replica pairs to digest.
+    assert out["cluster_obs_scrapes"] >= 1
+    assert out["cluster_obs_digest_ranges"] > 0
+
+
 def test_probe_failure_cached_across_runs(tmp_path, monkeypatch):
     """A failed probe is persisted; the next run (within the TTL) skips
     the probe subprocess entirely — no 180 s re-burn (the BENCH_r05

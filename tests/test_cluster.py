@@ -85,17 +85,23 @@ class _Shard:
         self.srv.stop()
 
 
-def _spawn_shard(tmpdir, shard_id, env_extra=None):
+def _spawn_shard(tmpdir, shard_id, env_extra=None, service_port=0,
+                 manage_port=0):
     """One SUBPROCESS shard (the killable kind), ports discovered via
-    --port-file."""
+    --port-file. Explicit ports exist for the RESTART scenario — a
+    respawned shard must come back at the addresses the directory
+    already names."""
     pf = os.path.join(tmpdir, f"shard{shard_id}.ports")
+    if os.path.exists(pf):
+        os.unlink(pf)  # a stale file would answer before the respawn
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("ISTPU_FAILPOINTS", None)
     if env_extra:
         env.update(env_extra)
     proc = subprocess.Popen(
         [sys.executable, "-m", "infinistore_tpu.server",
-         "--service-port", "0", "--manage-port", "0",
+         "--service-port", str(service_port),
+         "--manage-port", str(manage_port),
          "--shard-id", str(shard_id), "--port-file", pf,
          "--prealloc-size", "0.0625", "--minimal-allocate-size", "16",
          "--log-level", "error", "--no-oom-protect", "--no-slo"],
@@ -295,6 +301,58 @@ def test_replica_read_failover_failpoint():
             sc.close()
         for s in shards:
             s.stop()
+
+
+def test_client_stats_failover_section(tmp_path):
+    # ISSUE 15 satellite: NOISY failover — reads all served, but each
+    # walking a replica ladder — must be visible from the client side.
+    # client_stats()["failover"] carries read_failovers /
+    # refresh_on_miss / the per-shard replica-read distribution.
+    shards = [_Shard(i) for i in range(2)]
+    sc = None
+    try:
+        d = _directory_of(shards, replication=2)
+        sc = _client(d)
+        keys = [f"fo-{i}" for i in range(64)]
+        data = _pages(64)
+        pairs = [(k, i * 512) for i, k in enumerate(keys)]
+        sc.put_cache(data, pairs, 512)
+        dst = np.zeros_like(data)
+        sc.read_cache(dst, pairs, 512)
+        fo = sc.client_stats()["failover"]
+        assert fo["read_failovers"] == 0   # healthy fleet: no ladder
+        assert fo["refresh_on_miss"] == 0
+        assert sum(fo["replica_reads"]) > 0
+        assert len(fo["replica_reads"]) == 2
+        assert sum(fo["replica_read_share_milli"]) >= 999
+        assert fo["directory_epoch"] == 1
+        before = list(fo["replica_reads"])
+        # One injected replica-read failure: the ladder retries the
+        # peer; read_failovers counts the keys that failed over.
+        from infinistore_tpu import _native
+
+        assert _native.get_lib().ist_fault_arm(
+            b"cluster.replica_read=once", None, 0) == 1
+        sc.read_cache(dst, pairs, 512)
+        assert np.array_equal(dst, data)
+        fo2 = sc.client_stats()["failover"]
+        assert fo2["read_failovers"] > 0
+        # The failed-over keys were RE-ROUTED: total routed reads grew
+        # by more than the key count (original pass + retries).
+        assert sum(fo2["replica_reads"]) > sum(before) + len(keys)
+        # A dead shard tilts the whole distribution onto its peer.
+        shards[1].stop()
+        sc.read_cache(dst, pairs, 512)
+        fo3 = sc.client_stats()["failover"]
+        assert fo3["replica_reads"][0] > fo2["replica_reads"][0]
+    finally:
+        if sc is not None:
+            sc.close()
+        shards[0].stop()
+        try:
+            shards[1].stop()
+        except Exception:  # noqa: BLE001 — may already be stopped
+            pass
 
 
 def test_hot_prefix_chain_survives_replica_death():
@@ -640,3 +698,396 @@ def test_source_killed_mid_range_replicas_still_serve(tmp_path):
             if p.poll() is None:
                 p.kill()
                 p.wait(timeout=30)
+
+
+# -- cluster observability plane (ISSUE 15) --------------------------------
+
+
+def _http_get(addr, path, timeout=5.0):
+    import urllib.request
+
+    with urllib.request.urlopen(f"http://{addr}{path}",
+                                timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def test_cluster_views_well_formed_on_fresh_single_node():
+    # ISSUE 15 satellite: a FRESH server that is no cluster member at
+    # all must answer every /cluster/* view well-formed and
+    # non-burning — empty fleet, availability 1.0 — never an error
+    # (dashboards probe before operators configure).
+    from infinistore_tpu.server import make_control_plane
+
+    from infinistore_tpu import InfiniStoreServer as _Srv
+
+    srv = _Srv(ServerConfig(service_port=0, prealloc_size=0.01,
+                            minimal_allocate_size=4, log_level="error"))
+    srv.start()
+    httpd = make_control_plane(srv)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    addr = f"127.0.0.1:{httpd.server_address[1]}"
+    try:
+        st = _http_get(addr, "/cluster/status")
+        assert st["epoch"] == 0
+        assert st["shards"] == []
+        assert st["down_shards"] == []
+        assert st["divergence"]["gauge"] == 0
+        slo = _http_get(addr, "/cluster/slo")
+        assert slo["burning"] is False
+        assert slo["quorum"]["availability"] == 1.0
+        assert slo["short"]["ops"] == 0
+        assert slo["short"]["latency_burn_rate"] == 0.0
+        hist = _http_get(addr, "/cluster/history")
+        assert hist["history"] == []
+        assert hist["merged_from"] == []
+        # The single-shard digest endpoint answers too (empty store).
+        dig = _http_get(addr, f"/digest?lo=0&hi={cl.RING_SPAN}")
+        assert dig["count"] == 0
+        assert dig["digest"] == "0" * 16
+    finally:
+        httpd.shutdown()
+        srv.stop()
+
+
+def test_digest_range_replica_parity_and_sensitivity():
+    # Two in-process shards holding the SAME key set must digest
+    # identically per range (whatever their internal layout); one
+    # extra key on one side must flip exactly the ranges containing
+    # it. The native digest is the divergence MEASUREMENT — its
+    # determinism across processes is the whole point.
+    a, b = _Shard(0), _Shard(1)
+    try:
+        from infinistore_tpu.lib import InfinityConnection
+
+        keys = [f"par-{i:02d}" for i in range(24)]
+        pages = _pages(len(keys), width=256)
+        for shard in (a, b):
+            conn = InfinityConnection(ClientConfig(
+                host_addr="127.0.0.1", service_port=shard.service_port))
+            conn.connect()
+            # Insert in DIFFERENT orders: the digest must not care.
+            order = (range(len(keys)) if shard is a
+                     else reversed(range(len(keys))))
+            for i in order:
+                conn.put_cache(pages[i], [(keys[i], 0)], 256)
+            conn.sync()
+            conn.close()
+        full = (0, cl.RING_SPAN)
+        half = (0, cl.RING_SPAN // 2)
+        wrap = (3 * cl.RING_SPAN // 4, cl.RING_SPAN // 4)  # lo > hi
+        for lo, hi in (full, half, wrap):
+            da = a.srv.digest_range(lo, hi)
+            db = b.srv.digest_range(lo, hi)
+            assert da["digest"] == db["digest"], (lo, hi)
+            assert da["count"] == db["count"]
+            assert da["bytes"] == db["bytes"]
+        assert a.srv.digest_range(*full)["count"] == len(keys)
+        # Sensitivity: one extra key on b flips exactly the ranges
+        # containing its ring hash.
+        extra = "par-extra"
+        h = cl.ring_hash(extra)
+        conn = InfinityConnection(ClientConfig(
+            host_addr="127.0.0.1", service_port=b.service_port))
+        conn.connect()
+        conn.put_cache(pages[0], [(extra, 0)], 256)
+        conn.sync()
+        conn.close()
+        for lo, hi in (full, half, wrap):
+            da = a.srv.digest_range(lo, hi)
+            db = b.srv.digest_range(lo, hi)
+            if cl.in_range(h, lo, hi):
+                assert da["digest"] != db["digest"], (lo, hi)
+            else:
+                assert da["digest"] == db["digest"], (lo, hi)
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_fleet_kill_quorum_slo_then_divergence_verdict(tmp_path):
+    # ACCEPTANCE (a) + (b): 3 subprocess shards at replication=2 under
+    # a fleet aggregator. (a) SIGKILL one shard -> within a scrape the
+    # fleet marks it down while /cluster/slo stays quorum-available
+    # (every range keeps a live replica — the PR 14 promise restated).
+    # (b) write keys while the replica is down, restart it (empty) ->
+    # the divergence gauge goes nonzero for EXACTLY the ranges holding
+    # those keys with the restarted shard in their replica set, the
+    # watchdog.replica_divergence verdict fires once, and its bundle
+    # (with the aggregator's fleet.json) renders through istpu_top.
+    from infinistore_tpu.server import make_control_plane
+
+    procs, entries = [], []
+    for i in range(3):
+        proc, ports = _spawn_shard(str(tmp_path), i)
+        procs.append(proc)
+        entries.append({"id": i, "host": "127.0.0.1",
+                        "service_port": ports["service_port"],
+                        "manage_port": ports["manage_port"]})
+    bundle_dir = str(tmp_path / "bundles")
+    os.makedirs(bundle_dir)
+    op = _Shard(99, bundle_dir=bundle_dir)
+    agg = cl.FleetAggregator(server=op.srv, scrape_interval_s=0.1,
+                             digest_every=1, divergence_streak=2,
+                             epoch_lag_trip_s=120)
+    httpd = make_control_plane(op.srv, aggregator=agg)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    op_addr = f"127.0.0.1:{httpd.server_address[1]}"
+    sc = None
+    try:
+        d = cl.build_directory(entries, epoch=1, vnodes=16,
+                               replication=2)
+        addrs = [f"127.0.0.1:{e['manage_port']}" for e in entries]
+        # The op node adopts the map too: the aggregator reads the
+        # STAMPED blob (pushed_at_unix_us) from its local mirror.
+        cl.push_directory(d, addrs + [op_addr])
+
+        st = _http_get(op_addr, "/cluster/status")
+        assert [r["id"] for r in st["shards"] if r["up"]] == [0, 1, 2]
+        assert st["epoch"] == 1
+        assert st["divergence"]["gauge"] == 0
+        lag = st["epoch_lag"]
+        assert lag["pushed_at_unix_us"] > 0
+        assert lag["behind_shards"] == []
+
+        # (a) kill shard 1; the fleet notices within a scrape or two.
+        os.kill(procs[1].pid, signal.SIGKILL)
+        procs[1].wait(timeout=30)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            st = _http_get(op_addr, "/cluster/status")
+            if st["down_shards"] == [1]:
+                break
+            time.sleep(0.1)
+        assert st["down_shards"] == [1]
+        slo = _http_get(op_addr, "/cluster/slo")
+        # Quorum semantics: one dead shard at replication=2 leaves
+        # every range covered by its live peer — availability still
+        # meets the objective, nothing burns.
+        assert slo["quorum"]["availability"] == 1.0
+        assert slo["quorum"]["ranges_down"] == []
+        assert slo["burning"] is False
+        assert slo["down_shards"] == [1]
+
+        # (b) write keys WHILE the replica is down (they land only on
+        # the live members of each replica set)...
+        sc = ShardedConnection.from_directory(
+            d, ClientConfig(host_addr="127.0.0.1", service_port=1),
+            recover_interval_s=30)
+        sc.connect()
+        keys = [f"div-{j:02d}" for j in range(12)]
+        data = _pages(len(keys), width=256, seed=3)
+        for j, k in enumerate(keys):
+            sc.put_cache(data[j], [(k, 0)], 256)
+        sc.sync()
+        assert sc.health["lost_write_keys"] == 0
+
+        # ...then restart shard 1 EMPTY at its directory addresses.
+        proc, _ports = _spawn_shard(
+            str(tmp_path), 1,
+            service_port=entries[1]["service_port"],
+            manage_port=entries[1]["manage_port"])
+        procs[1] = proc
+        cl.push_directory(d, [f"127.0.0.1:"
+                              f"{entries[1]['manage_port']}"])
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if not agg.scrape()["down_shards"]:
+                break
+            time.sleep(0.1)
+
+        # Exactly the affected ranges: shard 1 in the replica set AND
+        # at least one while-down key hashing into the range.
+        expected = set()
+        for lo, hi, reps in cl.divergence_ranges(d):
+            if 1 in reps and any(
+                    cl.in_range(cl.ring_hash(k), lo, hi) for k in keys):
+                expected.add(f"{lo:08x}-{hi:08x}")
+        assert expected, "seed must place at least one key on shard 1"
+
+        before = op.srv.stats()["watchdog"]["divergence_trips"]
+        agg.poll_once()   # pass 1: divergence seen, streak 1
+        st = agg.poll_once()  # pass 2: streak 2 -> verdict
+        got = {dv["range"] for dv in st["divergence"]["divergent"]}
+        assert got == expected, (got, expected)
+        assert st["divergence"]["gauge"] == len(expected)
+
+        wd = op.srv.stats()["watchdog"]
+        assert wd["divergence_trips"] == before + 1
+        evs = [e for e in op.srv.events()["events"]
+               if e["name"] == "watchdog.replica_divergence"]
+        assert len(evs) == 1
+        bundles = [b for b in sorted(os.listdir(bundle_dir))
+                   if b.endswith("-replica_divergence")]
+        assert len(bundles) == 1
+        bdir = os.path.join(bundle_dir, bundles[0])
+        fleet = json.load(open(os.path.join(bdir, "fleet.json")))
+        assert {r["id"] for r in fleet["shards"]} == {0, 1, 2}
+        assert fleet["divergence"]["gauge"] == len(expected)
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "istpu_top.py"),
+             "--bundle", bdir],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        assert "fleet:" in r.stdout
+        assert "REPLICAS DISAGREE" in r.stdout
+    finally:
+        if sc is not None:
+            sc.close()
+        httpd.shutdown()
+        agg.stop()
+        op.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+
+
+def test_aggregator_rides_epoch_bumps():
+    # A standalone (seed-addressed) aggregator must FOLLOW rebalances:
+    # when a shard's /stats reports a newer epoch than the held map,
+    # the next scrape fetches and adopts that shard's directory — it
+    # must never freeze on the epoch it bootstrapped with (stale
+    # replica sets would mean false divergence verdicts and wrong
+    # quorum spans after keys move).
+    shards = [_Shard(i) for i in range(2)]
+    try:
+        addrs = [s.manage_addr for s in shards]
+        d1 = _directory_of(shards, epoch=1, vnodes=16, replication=2)
+        cl.push_directory(d1, addrs)
+        agg = cl.FleetAggregator(seed_addrs=addrs)
+        st = agg.scrape()
+        assert st["epoch"] == 1
+        assert st["directory"]["epoch"] == 1
+        # Epoch 3 pushed to the SHARDS only — the aggregator hears
+        # about it through their stats sections.
+        d3 = _directory_of(shards, epoch=3, vnodes=16, replication=2)
+        cl.push_directory(d3, addrs)
+        st = agg.scrape()
+        assert st["epoch"] == 3
+        assert st["directory"]["epoch"] == 3
+        # The adopted blob is the shard-held STAMPED copy (lag math).
+        assert st["directory"]["pushed_at_unix_us"] > 0
+        assert st["epoch_lag"]["behind_shards"] == []
+    finally:
+        for s in shards:
+            s.stop()
+
+
+def test_rebalance_migration_progress_monotonic_and_epoch_lag(tmp_path):
+    # ACCEPTANCE (c): a forced rebalance's migration-progress gauge
+    # advances MONOTONICALLY to completion in the fleet view (chunk
+    # cursor scraped from the source's native mirror while a delay
+    # failpoint paces the exports), and after the commit push the
+    # epoch lag returns to ~0 with no shard left behind.
+    shards = [_Shard(i) for i in range(2)]
+    agg = cl.FleetAggregator(scrape_interval_s=0.05, digest_every=1000)
+    sc = None
+    stop = threading.Event()
+    observed = []   # (shard_id, phase, cursor, total) per scrape
+    try:
+        d1 = _directory_of(shards, epoch=1, vnodes=16, replication=1)
+        addrs = [s.manage_addr for s in shards]
+        cl.push_directory(d1, addrs)
+        agg._directory = None
+        agg.seed_addrs = addrs  # discover the STAMPED blob
+        sc = _client(d1, addrs=addrs)
+        keys = [f"mig-{i:03d}" for i in range(120)]
+        data = _pages(len(keys), width=256, seed=5)
+        pairs = [(k, i * 256) for i, k in enumerate(keys)]
+        sc.put_cache(data, pairs, 256)
+        sc.sync()
+
+        def poll():
+            while not stop.is_set():
+                try:
+                    st = agg.scrape()
+                except Exception:  # noqa: BLE001 — keep polling
+                    continue
+                for m in st["migration"]["shards"]:
+                    observed.append((m["id"], m["phase"], m["cursor"],
+                                     m["total"]))
+                time.sleep(0.03)
+
+        t = threading.Thread(target=poll, daemon=True)
+        t.start()
+        # Pace each export chunk 120 ms so the poller SEES the cursor
+        # walk (in-process shards share this process's registry).
+        shards[0].srv.fault(
+            "cluster.migrate_export=every(1):delay(120000)")
+        chunks = 6
+        coord = cl.ClusterCoordinator(str(tmp_path), chunks=chunks,
+                                      chunk_timeout_s=30)
+        lo, hi = 0, cl.RING_SPAN // 2
+        d2 = cl.build_directory([s.entry() for s in shards], epoch=2,
+                                vnodes=16, replication=1)
+        coord.move_range(shards[0].entry(), shards[1].entry(), lo, hi)
+        cl.push_directory(d2, addrs)
+        time.sleep(0.3)
+        stop.set()
+        t.join(timeout=30)
+
+        exports = [(c, tot) for sid, ph, c, tot in observed
+                   if sid == 0 and ph == cl.PHASE_EXPORT]
+        assert exports, "the poller must catch the export in flight"
+        cursors = [c for c, _ in exports]
+        assert cursors == sorted(cursors), cursors  # monotonic
+        assert max(cursors) >= 2          # real mid-flight progress
+        assert all(tot == chunks for _, tot in exports)
+        # Completion: the fleet view returns to idle...
+        final = agg.scrape()
+        assert final["migration"]["active"] is False
+        # ...every shard is at the new epoch with ~0 propagation lag.
+        assert final["epoch"] == 2
+        lag = final["epoch_lag"]
+        assert lag["behind_shards"] == []
+        assert 0 <= lag["max_lag_us"] < 30_000_000
+    finally:
+        stop.set()
+        if sc is not None:
+            sc.close()
+        for s in shards:
+            s.stop()
+
+
+def test_istpu_trace_discovers_shards_from_cluster_status(tmp_path):
+    # ISSUE 15 satellite: istpu_trace --cluster reads the shard list
+    # from the aggregator's /cluster/status instead of requiring every
+    # shard URL on the command line (old --shard flags keep working
+    # and dedup against discovery).
+    from infinistore_tpu.server import make_control_plane
+
+    shards = [_Shard(i) for i in range(2)]
+    agg = cl.FleetAggregator(server=shards[0].srv)
+    httpd = make_control_plane(shards[0].srv, aggregator=agg)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    op_addr = f"127.0.0.1:{httpd.server_address[1]}"
+    try:
+        d = _directory_of(shards, epoch=1, replication=1)
+        cl.push_directory(d, [s.manage_addr for s in shards])
+        out = str(tmp_path / "merged.json")
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "istpu_trace.py"),
+             "--cluster", op_addr, "-o", out],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        assert "2 shard source(s)" in r.stdout
+        merged = json.load(open(out))
+        # One process_name metadata row per discovered shard.
+        names = [e["args"]["name"] for e in merged["traceEvents"]
+                 if e.get("ph") == "M"]
+        assert {"shard0", "shard1"} <= set(names)
+        # Old flags still work, and explicit shards dedup discovery.
+        r2 = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "istpu_trace.py"),
+             "--shard", shards[0].manage_addr,
+             "--cluster", op_addr, "-o", out],
+            capture_output=True, text=True, timeout=120)
+        assert r2.returncode == 0, r2.stderr
+        assert "2 shard source(s)" in r2.stdout
+    finally:
+        httpd.shutdown()
+        for s in shards:
+            s.stop()

@@ -271,6 +271,65 @@ def test_history_populates_and_survives_purge(fast_sampler):
         srv.stop()
 
 
+def test_history_ring_wraps_past_capacity(monkeypatch):
+    """ISSUE 15 satellite: the 512-sample ring must WRAP — recorded
+    grows past capacity, the blob holds exactly the newest 512 samples
+    oldest-first, and nothing corrupts at the seam (the pre-wrap start
+    index math serves a different branch than the post-wrap one)."""
+    monkeypatch.setenv("ISTPU_WATCHDOG_INTERVAL_MS", "10")  # native floor
+    srv = _small_server()
+    try:
+        assert _wait_for(
+            lambda: srv.history()["recorded"] > 530, timeout=30)
+        h = srv.history()
+        assert h["capacity"] == 512
+        assert h["recorded"] > 512
+        assert len(h["history"]) == 512, \
+            "post-wrap blob must hold exactly the ring capacity"
+        stamps = [s["t_us"] for s in h["history"]]
+        assert stamps == sorted(stamps), \
+            "post-wrap drain must still be oldest-first across the seam"
+        # Every sample is fully formed (the wrap overwrote whole
+        # slots, never produced a torn one).
+        for s in h["history"]:
+            assert len(s["lat_delta"]) == h["buckets"]
+            assert s["pool_bytes"] > 0
+    finally:
+        srv.stop()
+
+
+def test_slo_on_empty_ring_is_well_formed(fast_sampler):
+    """ISSUE 15 satellite: GET /slo on a FRESH server (zero ops, a
+    near-empty ring) answers a complete, non-burning blob — the
+    zero-denominator branches must yield 0.0 burn, never a division
+    error or a missing field."""
+    import threading
+    import urllib.request
+
+    from infinistore_tpu.server import make_control_plane
+
+    srv = _small_server()
+    cp = make_control_plane(srv)
+    t = threading.Thread(target=cp.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{cp.server_address[1]}/slo",
+                timeout=5) as r:
+            slo = json.loads(r.read())
+        for win in ("short", "long"):
+            assert slo[win]["ops"] == 0
+            assert slo[win]["latency_burn_rate"] == 0.0
+            assert slo[win]["availability_burn_rate"] == 0.0
+        assert slo["burning"] is False
+        assert slo["latency_burning"] is False
+        assert slo["availability_burning"] is False
+        assert "objective" in slo["latency"]
+    finally:
+        cp.shutdown()
+        srv.stop()
+
+
 def test_history_kill_switch_is_bench_only(fast_sampler, monkeypatch):
     monkeypatch.setenv("ISTPU_HISTORY", "0")
     srv = _small_server()

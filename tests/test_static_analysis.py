@@ -384,6 +384,52 @@ def test_cluster_failpoint_catalog_pin_bites(tree):
     assert "cluster.migrate_export" in r.stderr  # stale catalog row
 
 
+def test_dropped_cluster_status_endpoint_fails_golden(tree):
+    # ISSUE 15 seeded mutation: silently deleting /cluster/status must
+    # fail the golden's `endpoints` pin — istpu_top --cluster,
+    # istpu_trace --cluster discovery and every fleet dashboard read
+    # it. Docs patched so the failure isolates the golden pin.
+    mutate(tree, "infinistore_tpu/server.py",
+           'self.path == "/cluster/status":',
+           'self.path == "/cluster/status_disabled":')
+    mutate(tree, "docs/api.md", "`GET /cluster/status`",
+           "`GET /cluster/status` `/cluster/status_disabled`")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "'endpoints' drifted" in r.stderr
+
+
+def test_cluster_trip_event_catalog_pin_bites(tree):
+    # ISSUE 15 seeded mutation: renaming the replica-divergence
+    # verdict's emit id (server.cc cluster_trip) without the events.h
+    # catalog must fail BOTH drift directions, like the migration pin.
+    mutate(tree, "native/src/server.cc",
+           "events_emit(EV_WATCHDOG_DIVERGENCE,",
+           "events_emit(EV_WATCHDOG_DIVERGED,")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "EV_WATCHDOG_DIVERGED" in r.stderr   # emitted, uncataloged
+    assert "EV_WATCHDOG_DIVERGENCE" in r.stderr  # stale catalog row
+    assert "stale catalog row" in r.stderr
+
+
+def test_wrong_epoch_stats_key_rename_fails(tree):
+    # ISSUE 15 seeded mutation: renaming the stats_json cluster
+    # section's wrong_epoch_rejections key must fail the golden's
+    # stats_keys pin (the key set GREW with the new spelling) — the
+    # epoch-propagation telemetry must never silently go dark under a
+    # refactor. (The anchor's closing `}` scopes the mutation to the
+    # stats_json copy of the key, not cluster_json's.)
+    mutate(tree, "native/src/server.cc",
+           '"\\"wrong_epoch_rejections\\": %llu, "\n'
+           '                 "\\"adopt_unix_us\\": %lld}",',
+           '"\\"wrong_epoch_refusals\\": %llu, "\n'
+           '                 "\\"adopt_unix_us\\": %lld}",')
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "'stats_keys' drifted" in r.stderr
+
+
 def test_make_analyze_exits_zero():
     # With clang installed this is the -Wthread-safety -Werror proof
     # pass; without it the target reports the skip and still exits 0 —

@@ -12,6 +12,9 @@ Offline modes make the same renderer the reader for the black boxes the
 watchdog and the crash handler leave behind:
 
   istpu_top.py --host H --port MANAGE_PORT      live dashboard
+  istpu_top.py --cluster --host H --port P      fleet dashboard via an
+      aggregator node's /cluster/status (per-shard sparklines side by
+      side, epoch-lag / migration / replica-divergence panels)
   istpu_top.py --once                           one frame, no repaint
   istpu_top.py --bundle DIR                     render a watchdog
       diagnostic bundle (manifest + stats + debug_state + events tail)
@@ -219,6 +222,121 @@ def render_cluster(cluster, shard_health=None):
     return lines
 
 
+def render_fleet(status, cluster_slo=None, histories=None, width=32):
+    """Fleet dashboard panel (``--cluster``, GET /cluster/status, or a
+    bundle's fleet.json): per-shard health/occupancy/p99/queue rows
+    with side-by-side sparklines from each shard's history ring, the
+    epoch-propagation table, the migration-progress panel and the
+    replica-divergence table. Missing/empty blob renders nothing —
+    graceful degrade, never a crash."""
+    st = status or {}
+    shards = st.get("shards", [])
+    if not shards:
+        return []
+    lines = ["", (
+        f"fleet: epoch={st.get('epoch', 0)}  "
+        f"shards={len(shards)} "
+        f"({len(st.get('down_shards', []))} down)  "
+        f"scrapes={st.get('scrapes', 0)}"
+    )]
+    skew = st.get("skew", {})
+    if skew.get("up_shards"):
+        lines.append(
+            f"  skew: occupancy {skew.get('occupancy_min', 0) * 100:.1f}%"
+            f"..{skew.get('occupancy_max', 0) * 100:.1f}%  "
+            f"keys_imbalance={skew.get('keys_imbalance', 1.0)}x  "
+            f"epoch_skew={skew.get('epoch_skew', 0)}"
+        )
+    if cluster_slo:
+        q = cluster_slo.get("quorum", {})
+        lines.append(
+            f"  slo: quorum_availability={q.get('availability', 1.0)}"
+            f"  burn(short/long)="
+            f"{cluster_slo.get('short', {}).get('latency_burn_rate', 0)}"
+            f"/{cluster_slo.get('long', {}).get('latency_burn_rate', 0)}"
+            f"  burning={'YES' if cluster_slo.get('burning') else 'no'}"
+        )
+    lines.append("")
+    lines.append(
+        f"{'shard':<6}{'state':<6}{'occ':>7}{'keys':>8}{'p99':>8}"
+        f"{'queues':>7}{'epoch':>6}  "
+        f"{'occupancy':<{width + 1}}{'ops/s':<{width + 1}}p99"
+    )
+    for r in shards:
+        sid = r.get("id")
+        if not r.get("up"):
+            lines.append(f"{sid:<6}{'DOWN':<6}"
+                         f"{'-':>7}{'-':>8}{'-':>8}{'-':>7}{'-':>6}")
+            continue
+        h = (histories or {}).get(sid) or {}
+        samples = h.get("history", [])
+        occ_s = _spark(
+            [s.get("used_bytes", 0) / max(s.get("pool_bytes", 1), 1)
+             for s in samples], width)
+        ops_s = _spark([s.get("ops_delta", 0) for s in samples], width)
+        p99_s = _spark([_hist_p99(s.get("lat_delta", []))
+                        for s in samples], width)
+        q = (r.get("spill_queue_depth", 0)
+             + r.get("promote_queue_depth", 0))
+        state = "ok"
+        if r.get("watchdog_stalled") or r.get("workers_dead") \
+                or r.get("tier_breaker_open"):
+            state = "DEGR"
+        lines.append(
+            f"{sid:<6}{state:<6}{r.get('occupancy', 0) * 100:>6.1f}%"
+            f"{r.get('kvmap_len', 0):>8}"
+            f"{_fmt_age(r.get('p99_us', 0)):>8}{q:>7}"
+            f"{r.get('epoch', 0):>6}  "
+            f"{occ_s:<{width + 1}}{ops_s:<{width + 1}}{p99_s}"
+        )
+    lag = st.get("epoch_lag", {})
+    if lag:
+        per = lag.get("per_shard_us", {})
+        lines.append(
+            "  epoch lag: "
+            + "  ".join(
+                f"shard{sid}={_fmt_age(v) if v >= 0 else 'down'}"
+                for sid, v in sorted(per.items())
+            )
+            + f"  wrong_epoch={lag.get('wrong_epoch_rejections', 0)}"
+            + (f"  BEHIND={lag['behind_shards']}"
+               if lag.get("behind_shards") else "")
+        )
+    mig = st.get("migration", {})
+    if mig.get("active"):
+        for m in mig.get("shards", []):
+            phase_names = {1: "export", 2: "adopt", 3: "evict"}
+            eta = (f"eta {m.get('eta_s', -1):.0f}s"
+                   if m.get("eta_s", -1) >= 0 else "eta ?")
+            lines.append(
+                f"  migration: shard {m.get('id')} "
+                f"{phase_names.get(m.get('phase'), m.get('phase'))} "
+                f"{m.get('cursor', 0)}/{m.get('total', 0)} "
+                f"({m.get('rate_chunks_per_s', 0)} chunks/s, {eta}, "
+                f"keys{m.get('keys_delta', 0):+d} "
+                f"bytes{m.get('bytes_delta', 0):+d})"
+            )
+    div = st.get("divergence", {})
+    if div:
+        gauge = div.get("gauge", 0)
+        lines.append(
+            f"  divergence: {gauge} of "
+            f"{div.get('checked_ranges', 0)} ranges"
+            + (" — REPLICAS DISAGREE" if gauge else "")
+        )
+        for d in div.get("divergent", [])[:6]:
+            reps = " ".join(
+                f"shard{x.get('id')}:{x.get('digest', '?')[:8]}"
+                f"({x.get('count')})"
+                for x in d.get("replicas", [])
+            )
+            lines.append(
+                f"    range {d.get('range')} "
+                f"[{d.get('passes', 1)} passes] {reps}"
+            )
+    return lines
+
+
 def render_frame(stats, debug, events, prev=None, dt=None, tail=10,
                  history=None, workload=None, cluster=None,
                  shard_health=None):
@@ -366,6 +484,48 @@ def render_frame(stats, debug, events, prev=None, dt=None, tail=10,
     return "\n".join(lines)
 
 
+def run_cluster(args):
+    """Fleet dashboard (``--cluster``): poll the aggregator node's
+    /cluster/status + /cluster/slo and each shard's /history (for the
+    side-by-side sparklines), render one fleet frame per interval."""
+    base = f"http://{args.host}:{args.port}"
+    while True:
+        try:
+            status = _get_json(base, "/cluster/status", timeout=10.0)
+        except Exception as e:  # noqa: BLE001 — keep polling
+            print(f"istpu_top: cluster poll failed: {e}",
+                  file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        try:
+            cluster_slo = _get_json(base, "/cluster/slo", timeout=10.0)
+        except Exception:  # noqa: BLE001 — panel degrades
+            cluster_slo = {}
+        histories = {}
+        for r in status.get("shards", []):
+            if not r.get("up") or "addr" not in r:
+                continue
+            try:
+                histories[r["id"]] = _get_json(
+                    f"http://{r['addr']}", "/history", timeout=2.0)
+            except Exception:  # noqa: BLE001 — sparklines degrade
+                pass
+        lines = render_fleet(status, cluster_slo=cluster_slo,
+                             histories=histories)
+        frame = "\n".join(
+            ["istpu-top --cluster  "
+             f"aggregator={args.host}:{args.port}"] + lines
+        )
+        if not args.once:
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+        print(frame)
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
 def run_live(args):
     base = f"http://{args.host}:{args.port}"
     prev = None
@@ -449,6 +609,14 @@ def run_bundle(args):
                        history=load("history.json"),
                        workload=load("workload.json"),
                        cluster=load("cluster.json")))
+    # Fleet snapshot (ISSUE 15): present only in bundles whose verdict
+    # the aggregator fired (replica_divergence / epoch_lag) — the
+    # aggregator drops the whole fleet's scrape next to the local
+    # shard's files. Absent on every other bundle: graceful degrade.
+    fleet = load("fleet.json")
+    if fleet:
+        for line in render_fleet(fleet):
+            print(line)
     return 0
 
 
@@ -509,12 +677,21 @@ def main(argv=None):
     ap.add_argument("--decode-crash", default="",
                     help="decode a raw crash event dump "
                          "(bundle_dir/crash_events.bin)")
+    ap.add_argument("--cluster", action="store_true",
+                    help="fleet dashboard: --host/--port name the "
+                         "aggregator node (any shard serving "
+                         "/cluster/status); renders per-shard "
+                         "occupancy/ops/p99 sparklines side by side "
+                         "plus the epoch-lag, migration and "
+                         "replica-divergence panels")
     args = ap.parse_args(argv)
     if args.decode_crash:
         return decode_crash(args.decode_crash)
     if args.bundle:
         return run_bundle(args)
     try:
+        if args.cluster:
+            return run_cluster(args)
         return run_live(args)
     except KeyboardInterrupt:
         return 0

@@ -14,6 +14,12 @@ timeline.
 Sources (mix freely):
 
   --shard HOST:MANAGE_PORT    drain GET /trace from a live shard
+  --cluster HOST:MANAGE_PORT  discover the shard list from an
+                              aggregator node's GET /cluster/status
+                              (the fleet directory) instead of naming
+                              every shard by hand; discovered shards
+                              append after explicit --shard sources,
+                              duplicates dropped
   --shard-file FILE           a saved /trace export (offline / tests)
   --client-file FILE          a saved client_trace_json() export
 
@@ -48,6 +54,20 @@ def _load_url(hostport, timeout=5.0):
 def _load_file(path):
     with open(path, encoding="utf-8") as f:
         return json.load(f)
+
+
+def discover_shards(aggregator, timeout=10.0):
+    """Resolve the fleet's shard manage addresses from an aggregator
+    node's ``GET /cluster/status`` (ISSUE 15): every UP shard's `addr`
+    (host:manage_port), in directory order. Down shards are skipped —
+    their /trace would only time the drain out."""
+    if "://" not in aggregator:
+        aggregator = f"http://{aggregator}"
+    with urllib.request.urlopen(f"{aggregator}/cluster/status",
+                                timeout=timeout) as r:
+        status = json.loads(r.read().decode())
+    return [s["addr"] for s in status.get("shards", [])
+            if s.get("up") and "addr" in s]
 
 
 def _span_tid(evt):
@@ -134,6 +154,11 @@ def main(argv=None):
     ap.add_argument("--shard", action="append", default=[],
                     help="HOST:MANAGE_PORT of a live shard "
                          "(repeatable, in shard order)")
+    ap.add_argument("--cluster", default="",
+                    help="HOST:MANAGE_PORT of an aggregator node; the "
+                         "shard list comes from its GET /cluster/status "
+                         "(appended after explicit --shard sources, "
+                         "duplicates dropped)")
     ap.add_argument("--shard-file", action="append", default=[],
                     help="saved GET /trace export (repeatable; "
                          "appended after --shard sources)")
@@ -148,8 +173,17 @@ def main(argv=None):
     ap.add_argument("-o", "--out", default="",
                     help="output path (default: stdout)")
     args = ap.parse_args(argv)
+    if args.cluster:
+        try:
+            discovered = discover_shards(args.cluster)
+        except Exception as e:  # noqa: BLE001 — actionable exit
+            print(f"istpu_trace: cannot discover shards from "
+                  f"{args.cluster}: {e}", file=sys.stderr)
+            return 1
+        seen = set(args.shard)
+        args.shard += [s for s in discovered if s not in seen]
     if not args.shard and not args.shard_file:
-        ap.error("need at least one --shard or --shard-file")
+        ap.error("need at least one --shard, --cluster or --shard-file")
     shard_blobs = [_load_url(s) for s in args.shard]
     shard_blobs += [_load_file(p) for p in args.shard_file]
     client_blobs = [_load_file(p) for p in args.client_file]
