@@ -196,7 +196,7 @@ def _decls(lib):
             "ist_conn_create",
             c.c_void_p,
             [c.c_char_p, c.c_uint16, c.c_int, c.c_uint64, c.c_int,
-             c.c_int, c.c_uint32, c.c_uint64, c.c_int],
+             c.c_int, c.c_uint32, c.c_uint64, c.c_int, c.c_int],
         ),
         ("ist_conn_connect", c.c_int, [c.c_void_p]),
         ("ist_conn_close", None, [c.c_void_p]),
@@ -284,6 +284,24 @@ def _decls(lib):
             [c.c_void_p, c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
              c.POINTER(c.c_uint64), c.POINTER(c.c_int)],
         ),
+        # content-addressed dedup (ABI v16): hash-first two-phase put
+        (
+            "ist_put_hash",
+            c.c_uint32,
+            [c.c_void_p, c.c_char_p, c.c_uint64, c.c_uint32, c.c_uint32,
+             c.POINTER(c.c_uint64), c.c_char_p],
+        ),
+        (
+            "ist_content_hash",
+            None,
+            [c.c_void_p, c.c_uint64, c.POINTER(c.c_uint64),
+             c.POINTER(c.c_uint64)],
+        ),
+        (
+            "ist_conn_dedup_telemetry",
+            None,
+            [c.c_void_p, c.POINTER(c.c_uint64), c.POINTER(c.c_uint64)],
+        ),
         ("ist_commit", c.c_uint32, [c.c_void_p, c.POINTER(c.c_uint64), c.c_uint32]),
         (
             "ist_pin",
@@ -335,7 +353,10 @@ def _decls(lib):
         ("ist_mm_total_bytes", c.c_uint64, [c.c_void_p]),
         ("ist_mm_num_pools", c.c_uint64, [c.c_void_p]),
     ]
-    # ABI probe FIRST: a stale prebuilt library would lack the v15
+    # ABI probe FIRST: a stale prebuilt library would lack the v16
+    # dedup entry points (ist_put_hash / ist_content_hash /
+    # ist_conn_dedup_telemetry), misparse the v16 ist_conn_create
+    # trailing use_dedup flag, lack the v15
     # cluster-observability entry points (ist_server_digest_range /
     # ist_server_cluster_trip), lack the v14
     # cluster entry points (ist_server_cluster_set / ist_server_cluster
@@ -364,9 +385,9 @@ def _decls(lib):
         ver = int(lib.ist_abi_version())
     except AttributeError:
         ver = 1
-    if ver < 15:
+    if ver < 16:
         raise RuntimeError(
-            f"stale native library at {_LIB_PATH} (ABI v{ver} < v15): "
+            f"stale native library at {_LIB_PATH} (ABI v{ver} < v16): "
             "rebuild with `make -C native` (or delete the .so to let "
             "the import auto-build)"
         )

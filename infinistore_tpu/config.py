@@ -75,6 +75,17 @@ class ClientConfig:
         # allocate round trip). Servers/engines without fabric degrade
         # silently to the existing paths.
         self.use_fabric = kwargs.get("use_fabric", False)
+        # Content-addressed dedup (docs/design.md "Content-addressed
+        # dedup"): put_cache becomes TWO-PHASE — first OP_PUT_HASH
+        # ships each page's 128-bit content hash (computed natively
+        # with the wire-stable ist_content_hash), then only the pages
+        # the server answered NEED for ride the normal payload path.
+        # Pages the server already holds bytes for commit with ZERO
+        # payload transfer and zero pool growth (refcounted block
+        # sharing). Off by default: the probe costs one RTT per batch,
+        # which only pays for itself on workloads with cross-key
+        # duplication (multi-tenant shared prefixes).
+        self.use_dedup = kwargs.get("use_dedup", False)
         # Pool blocks per OP_LEASE acquire (one RTT buys this many
         # future allocations) and the deferred-commit flush watermark.
         self.lease_blocks = kwargs.get("lease_blocks", 4096)

@@ -904,6 +904,107 @@ def _prometheus_metrics(stats, slo=None, aggregator=None):
         f'infinistore_workload_dedup_ratio '
         f'{wl.get("dedup_ratio_milli", 1000) / 1000.0}'
     )
+    # Content-addressed dedup (ISSUE 16): the MEASURED capacity
+    # multiplier the workload profiler's dedup_ratio prediction above
+    # is scored against, plus logical-vs-physical occupancy — the
+    # users_per_gb headline is logical_bytes / pool_used_bytes.
+    dd = stats.get("dedup", {})
+    lines.append(
+        "# HELP infinistore_dedup_enabled content-addressed dedup "
+        "index active (0 only under the ISTPU_DEDUP=0 bench "
+        "denominator)"
+    )
+    lines.append("# TYPE infinistore_dedup_enabled gauge")
+    lines.append(f'infinistore_dedup_enabled {dd.get("enabled", 0)}')
+    lines.append(
+        "# HELP infinistore_dedup_hits_total commits that pinned an "
+        "existing block instead of keeping new pool bytes (hash-first "
+        "HAVE verdicts + commit-time adoption)"
+    )
+    lines.append("# TYPE infinistore_dedup_hits_total counter")
+    lines.append(
+        f'infinistore_dedup_hits_total {dd.get("dedup_hits", 0)}'
+    )
+    lines.append(
+        "# HELP infinistore_dedup_bytes_saved_total pool bytes the "
+        "dedup index declined to keep (cumulative)"
+    )
+    lines.append("# TYPE infinistore_dedup_bytes_saved_total counter")
+    lines.append(
+        f'infinistore_dedup_bytes_saved_total '
+        f'{dd.get("dedup_bytes_saved", 0)}'
+    )
+    lines.append(
+        "# HELP infinistore_dedup_hash_hits_total hash-first put "
+        "probes answered HAVE (zero payload transfer)"
+    )
+    lines.append("# TYPE infinistore_dedup_hash_hits_total counter")
+    lines.append(
+        f'infinistore_dedup_hash_hits_total '
+        f'{dd.get("dedup_hash_hits", 0)}'
+    )
+    lines.append(
+        "# HELP infinistore_dedup_hash_misses_total hash-first put "
+        "probes answered NEED (payload follows on the normal path)"
+    )
+    lines.append("# TYPE infinistore_dedup_hash_misses_total counter")
+    lines.append(
+        f'infinistore_dedup_hash_misses_total '
+        f'{dd.get("dedup_hash_misses", 0)}'
+    )
+    lines.append(
+        "# HELP infinistore_dedup_wire_hits_total HAVE verdicts whose "
+        "payload never crossed the transport (OP_PUT_HASH / ring v2 "
+        "hash records)"
+    )
+    lines.append("# TYPE infinistore_dedup_wire_hits_total counter")
+    lines.append(
+        f'infinistore_dedup_wire_hits_total '
+        f'{dd.get("dedup_wire_hits", 0)}'
+    )
+    lines.append(
+        "# HELP infinistore_dedup_wire_bytes_saved_total payload "
+        "bytes that never crossed the transport thanks to HAVE "
+        "verdicts"
+    )
+    lines.append(
+        "# TYPE infinistore_dedup_wire_bytes_saved_total counter"
+    )
+    lines.append(
+        f'infinistore_dedup_wire_bytes_saved_total '
+        f'{dd.get("dedup_wire_bytes_saved", 0)}'
+    )
+    lines.append(
+        "# HELP infinistore_dedup_logical_bytes committed bytes as "
+        "clients see them (physical occupancy is pool_used_bytes; "
+        "the gap is live dedup savings)"
+    )
+    lines.append("# TYPE infinistore_dedup_logical_bytes gauge")
+    lines.append(
+        f'infinistore_dedup_logical_bytes '
+        f'{dd.get("logical_bytes", 0)}'
+    )
+    lines.append(
+        "# HELP infinistore_dedup_saved_live_bytes logical bytes "
+        "currently served by shared blocks (drops as sharers are "
+        "deleted/evicted)"
+    )
+    lines.append("# TYPE infinistore_dedup_saved_live_bytes gauge")
+    lines.append(
+        f'infinistore_dedup_saved_live_bytes '
+        f'{dd.get("dedup_saved_live", 0)}'
+    )
+    lines.append(
+        "# HELP infinistore_dedup_measured_ratio measured capacity "
+        "multiplier logical/(logical-saved_live); score the workload "
+        "profiler's infinistore_workload_dedup_ratio prediction "
+        "against this"
+    )
+    lines.append("# TYPE infinistore_dedup_measured_ratio gauge")
+    lines.append(
+        f'infinistore_dedup_measured_ratio '
+        f'{dd.get("dedup_measured_milli", 1000) / 1000.0}'
+    )
     # Cluster tier (GET /directory has the full map): the directory
     # epoch dashboards correlate with re-routing, and the live
     # migration cursor (phase -1 = no migration in flight).

@@ -27,6 +27,7 @@
 #include "failpoint.h"
 #include "log.h"
 #include "server.h"
+#include "utils.h"
 
 using namespace istpu;
 
@@ -159,10 +160,19 @@ extern "C" {
 // cluster mirror gains wrong_epoch_rejections / adopt_unix_us (stats
 // + cluster_json), stats watchdog section gains divergence_trips /
 // epoch_lag_trips, new cluster.wrong_epoch /
-// watchdog.replica_divergence / watchdog.epoch_lag catalog events.
+// watchdog.replica_divergence / watchdog.epoch_lag catalog events;
+// v16: content-addressed dedup — trailing `use_dedup` int on
+// ist_conn_create, new ist_put_hash (hash-first two-phase put probe
+// over wire op 24 OP_PUT_HASH / the fabric ring's v2 hash-first
+// record), ist_content_hash (the wire-stable 128-bit payload hash)
+// and ist_conn_dedup_telemetry (client HAVE/NEED verdict counts)
+// entry points, stats gains the dedup section (logical vs physical
+// occupancy + measured capacity multiplier), history samples carry
+// dedup_hits_delta / dedup_bytes_saved_delta / logical_bytes /
+// dedup_saved_live.
 // _native.py probes this at load so a stale prebuilt library fails
 // loudly instead of feeding unparseable blobs to the server.
-uint32_t ist_abi_version(void) { return 15; }
+uint32_t ist_abi_version(void) { return 16; }
 
 void ist_set_log_level(int level) { set_log_level(level); }
 void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }
@@ -521,7 +531,7 @@ int ist_server_shm_prefix(void* h, char* buf, int cap) {
 void* ist_conn_create(const char* host, uint16_t port, int use_shm,
                       uint64_t window_bytes, int timeout_ms, int use_lease,
                       uint32_t lease_blocks, uint64_t flush_bytes,
-                      int use_fabric) {
+                      int use_fabric, int use_dedup) {
     ClientConfig cfg;
     cfg.host = host ? host : "127.0.0.1";
     cfg.port = port;
@@ -535,6 +545,8 @@ void* ist_conn_create(const char* host, uint16_t port, int use_shm,
     // OP_FABRIC_WRITE cross-host; requires use_lease and degrades
     // silently against servers/engines without it.
     cfg.use_fabric = use_fabric != 0;
+    // Content-addressed dedup (v16): hash-first two-phase puts.
+    cfg.use_dedup = use_dedup != 0;
     return new Connection(cfg);
 }
 
@@ -939,6 +951,63 @@ void ist_conn_fabric_telemetry(void* h, uint64_t* ring_posts,
     if (doorbells != nullptr) *doorbells = bells;
     if (ring_fallbacks != nullptr) *ring_fallbacks = falls;
     if (modes != nullptr) *modes = m;
+}
+
+// The wire-stable 128-bit content hash (utils.h content_hash128) —
+// exported so the Python layer hashes payloads with the exact function
+// OP_PUT_HASH claims are checked against.
+void ist_content_hash(const void* data, uint64_t n, uint64_t* h1,
+                      uint64_t* h2) {
+    uint64_t a = 0, b = 0;
+    if (data != nullptr || n == 0) content_hash128(data, size_t(n), &a, &b);
+    if (h1 != nullptr) *h1 = a;
+    if (h2 != nullptr) *h2 = b;
+}
+
+// Hash-first two-phase put probe (v16): sends {key, h1, h2} per key
+// (hashes[2*i], hashes[2*i+1]) and fills verdicts_out[nkeys] with
+// 0=NEED (ship payload via the normal put path), 1=HAVE (committed
+// server-side with zero payload transfer), 2=EXISTS. Returns the rpc
+// status.
+uint32_t ist_put_hash(void* h, const uint8_t* keys_blob, uint64_t blob_len,
+                      uint32_t nkeys, uint32_t block_size,
+                      const uint64_t* hashes, uint8_t* verdicts_out) {
+    auto* c = static_cast<Connection*>(h);
+    if (c == nullptr || hashes == nullptr || verdicts_out == nullptr) {
+        return INTERNAL_ERROR;
+    }
+    std::vector<uint8_t> wire;
+    if (!expand_keys(keys_blob, blob_len, nkeys, wire)) return BAD_REQUEST;
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    w.u32(block_size);
+    w.u32(nkeys);
+    BufReader kr(wire.data(), wire.size());
+    for (uint32_t i = 0; i < nkeys; ++i) {
+        std::string k = kr.str();
+        if (!kr.ok()) return BAD_REQUEST;
+        w.str(k);
+        w.u64(hashes[2 * i]);
+        w.u64(hashes[2 * i + 1]);
+    }
+    std::vector<uint8_t> resp;
+    uint32_t st = c->put_hash(std::move(body), &resp);
+    if (st != OK) return st;
+    BufReader r(resp.data(), resp.size());
+    uint32_t n = r.u32();
+    const uint8_t* v = r.raw(n);
+    if (v == nullptr || n != nkeys) return INTERNAL_ERROR;
+    memcpy(verdicts_out, v, n);
+    return OK;
+}
+
+// Dedup client telemetry (client_stats()): HAVE verdicts received
+// (puts whose payload never left this process) and NEED verdicts.
+void ist_conn_dedup_telemetry(void* h, uint64_t* have, uint64_t* need) {
+    uint64_t hv = 0, nd = 0;
+    if (h != nullptr) static_cast<Connection*>(h)->dedup_stats(&hv, &nd);
+    if (have != nullptr) *have = hv;
+    if (need != nullptr) *need = nd;
 }
 
 // Commit previously allocated tokens (used by the zero-copy Python path

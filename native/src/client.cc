@@ -1007,6 +1007,93 @@ void Connection::commit_batch_async(std::vector<uint8_t> body, DoneFn done) {
     wake();
 }
 
+void Connection::put_hash_async(std::vector<uint8_t> body, DoneFn done) {
+    // Hash-first put probe (OP_PUT_HASH). Inflight-accounted like the
+    // deferred commits — a sync() must barrier HAVE-committed keys the
+    // same as payload-carrying puts. Ring-first when the fabric ring
+    // is attached: the probe lands one-sided in shm as a flagged
+    // hash-first record and only the verdict response touches the
+    // socket, so a same-host dedup'd put keeps the one-sided shape
+    // with no extra RTT. The fab_tcp_inflight_ gate is carried over
+    // from the commit path for uniformity (hash records replay no
+    // carve, so ordering is not load-bearing here).
+    inflight_++;
+    if (broken_.load() || !running_.load()) {
+        if (done) done(INTERNAL_ERROR, {});
+        finish_op();
+        return;
+    }
+    auto body_p = std::make_shared<std::vector<uint8_t>>(std::move(body));
+    Submit s;
+    s.fn = [this, body_p, done = std::move(done)]() mutable {
+        Pending p;
+        p.op = OP_PUT_HASH;
+        p.done = [this, done = std::move(done)](uint32_t st,
+                                                std::vector<uint8_t> b) {
+            if (done) done(st, std::move(b));
+            finish_op();
+        };
+        const bool ring = fab_ring_.load(std::memory_order_relaxed);
+        if (ring && fab_tcp_inflight_ == 0 &&
+            try_ring_post(*body_p, p, /*hash_rec=*/true)) {
+            return;
+        }
+        enqueue_msg(OP_PUT_HASH, std::move(*body_p), {}, std::move(p));
+    };
+    {
+        std::lock_guard<std::mutex> lk(submit_mu_);
+        submits_.push_back(std::move(s));
+    }
+    wake();
+}
+
+uint32_t Connection::put_hash(std::vector<uint8_t> body,
+                              std::vector<uint8_t>* resp_body) {
+    struct WaitState {
+        std::mutex mu;
+        std::condition_variable cv;
+        bool done = false;
+        uint32_t status = TIMEOUT_ERR;
+        std::vector<uint8_t> body;
+    };
+    auto st = std::make_shared<WaitState>();
+    put_hash_async(std::move(body),
+                   [st](uint32_t status, std::vector<uint8_t> b) {
+                       std::lock_guard<std::mutex> lk(st->mu);
+                       st->status = status;
+                       st->body = std::move(b);
+                       st->done = true;
+                       st->cv.notify_all();
+                   });
+    std::unique_lock<std::mutex> lk(st->mu);
+    if (!st->cv.wait_for(lk, std::chrono::milliseconds(cfg_.timeout_ms),
+                         [&] { return st->done; })) {
+        return TIMEOUT_ERR;
+    }
+    // Verdict telemetry: HAVE = payload never left this process.
+    // (The IO thread already stripped the leading u32 status, so the
+    // delivered body is {u32 n, n x u8 verdicts}.)
+    if (st->status == OK) {
+        BufReader r(st->body.data(), st->body.size());
+        uint32_t n = r.u32();
+        const uint8_t* v = r.raw(n);
+        if (r.ok() && v != nullptr) {
+            uint64_t have = 0, need = 0;
+            for (uint32_t i = 0; i < n; ++i) {
+                if (v[i] == 1) {
+                    have++;
+                } else if (v[i] == 0) {
+                    need++;
+                }
+            }
+            dedup_have_.fetch_add(have, std::memory_order_relaxed);
+            dedup_need_.fetch_add(need, std::memory_order_relaxed);
+        }
+    }
+    if (resp_body) *resp_body = std::move(st->body);
+    return st->status;
+}
+
 uint32_t Connection::acquire_lease_locked(uint32_t min_blocks) {
     if (lease_valid_) {
         // Return the old lease's unconsumed remainder. Fire-and-forget,
@@ -1450,7 +1537,7 @@ bool Connection::fabric_bootstrap_attach() {
 }
 
 bool Connection::try_ring_post(std::vector<uint8_t>& body,
-                               Pending& pending) {
+                               Pending& pending, bool hash_rec) {
     FabricRingHdr* h = fab_hdr_;
     if (h == nullptr) return false;
     // fail_all() fails queued submissions by RUNNING them, relying on
@@ -1494,7 +1581,10 @@ bool Connection::try_ring_post(std::vector<uint8_t>& body,
         tail += pad;
         pos = 0;
     }
-    uint32_t len = uint32_t(rec);
+    // Ring v2: the high bit of the len word flags a hash-first record
+    // (fabric.h). Real lengths are < cap/2, so the bit is never
+    // ambiguous; the server masks it after its wrap-mark check.
+    uint32_t len = uint32_t(rec) | (hash_rec ? kFabricHashRecFlag : 0);
     memcpy(data + pos, &len, 4);
     memcpy(data + pos + 4, &seq, 8);
     if (!body.empty()) {

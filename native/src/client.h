@@ -73,6 +73,15 @@ struct ClientConfig {
     // lease-carved blocks. Off, unsupported servers, or probe
     // failures all degrade silently to the existing paths.
     bool use_fabric = false;
+    // Content-addressed dedup (docs/design.md "Content-addressed
+    // dedup"): before shipping payload, probe the server with each
+    // key's 128-bit content hash (OP_PUT_HASH / the fabric ring's
+    // hash-first record). Keys the server already holds bytes for are
+    // committed with ZERO payload transfer and zero pool growth; only
+    // the NEED subset rides the normal put path. Off by default: the
+    // probe adds an RTT (amortized over the batch), which only pays
+    // for itself on workloads with cross-key duplication.
+    bool use_dedup = false;
 };
 
 // Process-wide parallel memcpy engine: min(4, cores-2) workers plus the
@@ -240,6 +249,24 @@ class Connection {
         *posts = fab_posts_.load(std::memory_order_relaxed);
         *doorbells = fab_doorbells_.load(std::memory_order_relaxed);
         *fallbacks = fab_fallbacks_.load(std::memory_order_relaxed);
+    }
+
+    // --- content-addressed dedup probe (use_dedup) ---
+    // Hash-first half of the two-phase put: `body` is the full
+    // OP_PUT_HASH request {u32 block_size, u32 nkeys, nkeys x
+    // (u32 klen + key + u64 h1 + u64 h2)}. Rides the shm commit ring
+    // as a flagged hash-first record when attached (verdicts return on
+    // TCP keyed by client_seq — no extra RTT ahead of a same-host
+    // one-sided put), else one TCP frame. Blocking variant returns the
+    // rpc status and the verdict body {u32 status, u32 n, n x u8}.
+    void put_hash_async(std::vector<uint8_t> body, DoneFn done);
+    uint32_t put_hash(std::vector<uint8_t> body,
+                      std::vector<uint8_t>* resp_body);
+    // Client telemetry (client_stats()): HAVE verdicts (puts whose
+    // payload never left this process) and NEED verdicts received.
+    void dedup_stats(uint64_t* have, uint64_t* need) const {
+        *have = dedup_have_.load(std::memory_order_relaxed);
+        *need = dedup_need_.load(std::memory_order_relaxed);
     }
 
     // Pool mapping access for the zero-copy Python path.
@@ -439,7 +466,11 @@ class Connection {
     // caller ships the same body as a TCP OP_COMMIT_BATCH instead
     // (the server drains the ring before any TCP op, preserving the
     // carve-cursor order across the two channels).
-    bool try_ring_post(std::vector<uint8_t>& body, Pending& pending);
+    // `hash_rec` posts the body as a ring-v2 HASH-FIRST record (the
+    // len word carries kFabricHashRecFlag; fabric.h) instead of a
+    // commit record.
+    bool try_ring_post(std::vector<uint8_t>& body, Pending& pending,
+                       bool hash_rec = false);
     FabricRingHdr* fab_hdr_ = nullptr;
     size_t fab_map_bytes_ = 0;
     std::atomic<bool> fab_ring_{false};
@@ -454,6 +485,10 @@ class Connection {
     std::atomic<uint64_t> fab_posts_{0};
     std::atomic<uint64_t> fab_doorbells_{0};
     std::atomic<uint64_t> fab_fallbacks_{0};
+
+    // --- content-addressed dedup telemetry ---
+    std::atomic<uint64_t> dedup_have_{0};
+    std::atomic<uint64_t> dedup_need_{0};
 };
 
 }  // namespace istpu

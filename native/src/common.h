@@ -89,6 +89,14 @@ enum Op : uint8_t {
                              // client could forge) and committed at
                              // payload end
     OP_FABRIC_DOORBELL = 23, // header-only kick: drain my commit ring
+    // Content-addressed dedup probe (docs/design.md "Content-addressed
+    // dedup"): hash-first put. Body {u32 block_size, u32 nkeys,
+    // nkeys x (u32 klen + key + u64 h1 + u64 h2)}. Response
+    // {u32 status, u32 n, n x u8 verdict} with verdict 0=NEED (payload
+    // must follow on the normal put path), 1=HAVE (key committed by
+    // pinning the existing block — zero payload, zero pool bytes),
+    // 2=EXISTS (key already present).
+    OP_PUT_HASH = 24,
 };
 
 // ---------------------------------------------------------------------------

@@ -215,6 +215,11 @@ class EngineFabric final : public EngineEpoll {
                 h->head.store(head + run, std::memory_order_release);
                 continue;
             }
+            // Ring v2: the high bit flags a hash-first put record
+            // (fabric.h). Masked after the wrap-mark check (the mark
+            // has all bits set) and before the bounds checks below.
+            const bool hash_rec = (len & kFabricHashRecFlag) != 0;
+            len &= ~kFabricHashRecFlag;
             if (uint64_t(len) + 4 > run || head + 4 + len > tail ||
                 len > cap / 2) {
                 // Torn/hostile framing: the ring is shared memory a
@@ -226,7 +231,8 @@ class EngineFabric final : public EngineEpoll {
                 c.dead = true;
                 break;
             }
-            bool ok = s_.fabric_ingest_record(c, data + pos + 4, len);
+            bool ok =
+                s_.fabric_ingest_record(c, data + pos + 4, len, hash_rec);
             h->head.store(head + 4 + len, std::memory_order_release);
             applied++;
             if (!ok || c.dead) {

@@ -50,13 +50,22 @@
 namespace istpu {
 
 constexpr uint64_t FABRIC_MAGIC = 0x4241465550545349ULL;  // "ISTPUFAB"
-constexpr uint32_t FABRIC_VERSION = 1;
+constexpr uint32_t FABRIC_VERSION = 2;  // v2: hash-first records
 constexpr size_t kFabricHdrBytes = 4096;        // one page of cursors
 constexpr uint64_t kFabricDataBytes = 1u << 20;  // commit-record region
 // A producer that cannot fit `u32 len` + body before the region end
 // writes this marker (when >= 4 bytes remain) and skips to the next
 // region start; the consumer mirrors the skip.
 constexpr uint32_t kFabricWrapMark = 0xFFFFFFFFu;
+// Ring v2 (content-addressed dedup): a record whose `u32 len` word has
+// this bit set carries a HASH-FIRST put probe instead of a commit
+// batch — body u64 client_seq + the OP_PUT_HASH request shape
+// {u32 block_size, u32 nkeys, nkeys x (u32 klen + key + u64 h1 +
+// u64 h2)}; the verdict response rides TCP keyed by client_seq, same
+// as commit-record responses. The bit is masked off AFTER the
+// wrap-mark check (the mark has all bits set) and BEFORE the
+// corruption bounds checks, so real lengths stay < data_cap/2.
+constexpr uint32_t kFabricHashRecFlag = 0x80000000u;
 
 #pragma pack(push, 1)
 struct FabricRingHdr {

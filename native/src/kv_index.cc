@@ -22,6 +22,11 @@ KVIndex::KVIndex(MM* mm, bool eviction, DiskTier* disk,
     // deployments that need the pre-segmentation semantics verbatim.
     const char* env = getenv("ISTPU_EXACT_LRU");
     exact_lru_ = env != nullptr && env[0] == '1';
+    // ISTPU_DEDUP=0: disable content addressing end to end (commit-time
+    // adoption AND put_by_hash answer as if no canonical ever matches).
+    // The bench --dedup-leg's off denominator; on by default.
+    const char* denv = getenv("ISTPU_DEDUP");
+    dedup_enabled_ = denv == nullptr || denv[0] != '0';
     // Per-index stripe ranks (single-threaded here): cross-stripe ops
     // lock in index order = ascending rank for the runtime checker.
     for (uint32_t i = 0; i < kStripes; ++i) {
@@ -156,14 +161,29 @@ Status KVIndex::commit(uint64_t token, uint64_t owner) {
     // allocated (a purge+reallocate between allocate and commit must not
     // make someone else's bytes visible under this key).
     if (mit != st.map.end() && mit->second.block == s->block) {
-        mit->second.committed = true;
-        lru_touch(st, mit->second, mit->first);
+        Entry& e = mit->second;
+        // Content-addressed dedup: if a live canonical block holds
+        // byte-identical content, the entry adopts it and the fresh
+        // block frees when the inflight ref drops below (zero extra
+        // pool bytes for the duplicate). Otherwise this block becomes
+        // the canonical for its content.
+        dedup_adopt_or_register(
+            &e.block, static_cast<const uint8_t*>(s->block->loc.ptr),
+            s->size);
+        e.committed = true;
+        dedup_block_attached(e.block, s->size);
+        logical_bytes_.fetch_add(s->size, std::memory_order_relaxed);
+        lru_touch(st, e, mit->first);
         workload_.record_commit(
             hash_of(mit->first),
-            static_cast<const uint8_t*>(s->block->loc.ptr),
+            static_cast<const uint8_t*>(e.block->loc.ptr),
             wl_round(s->size), mm_, s->size);
         rc = OK;
     }
+    // Drops the inflight ref under the stripe lock: for an adopted
+    // commit this is the fresh block's LAST ref, returning its bytes
+    // to the pool (arena rank 300+a > stripe rank — legal here, and
+    // exactly why dedup_mu_ was released before this point).
     ifree(st, s);
     return rc;
 }
@@ -426,6 +446,7 @@ bool KVIndex::finish_promote(PromoteItem& item, BlockRef block) {
         // promotion never invalidates a cached pool location (the
         // entry had none while disk-resident).
         e.block = std::move(block);
+        dedup_block_attached(e.block, e.size);  // re-materialized hold
         e.disk.reset();  // item.disk still pins the extent until dropped
         e.promoting = false;
         e.touched = false;
@@ -508,6 +529,7 @@ Status KVIndex::ensure_resident(Stripe& st, uint32_t stripe_idx, Entry& e,
                 }
             }
             e.block = std::move(block);
+            dedup_block_attached(e.block, e.size);  // re-materialized
             e.disk.reset();  // frees the disk extent
         } else if (e.heap) {
             // Already in limbo and the pool is still full: retryable.
@@ -544,6 +566,7 @@ Status KVIndex::ensure_resident(Stripe& st, uint32_t stripe_idx, Entry& e,
             auto block = std::make_shared<Block>(mm_, loc, e.size);
             memcpy(loc.ptr, tmp.data(), e.size);
             e.block = std::move(block);
+            dedup_block_attached(e.block, e.size);  // re-materialized
         } else {
             return INTERNAL_ERROR;  // no location at all: cannot happen
         }
@@ -704,17 +727,37 @@ Status KVIndex::insert_committed(const std::string& key, const uint8_t* data,
     ScopedLock lk(st.mu);
     auto [mit, inserted] = st.map.try_emplace(key);
     if (!inserted) return CONFLICT;  // live data beats snapshot data
-    PoolLoc loc;
-    if (!mm_->allocate(size, &loc)) {  // no evict_lru: see header contract
-        st.map.erase(mit);
-        return OUT_OF_MEMORY;
-    }
-    memcpy(loc.ptr, data, size);
     Entry e;
-    e.block = std::make_shared<Block>(mm_, loc, size);
+    // Snapshot/migration restore re-dedups: hash BEFORE allocating so
+    // a restored duplicate adopts the canonical block with ZERO pool
+    // allocation — a snapshot round-trip of refcounted blocks restores
+    // the physical sharing, not N private copies.
+    uint64_t h1 = 0, h2 = 0;
+    const bool hashed = dedup_enabled_ && size > 0;
+    if (hashed) content_hash128(data, size, &h1, &h2);
+    BlockRef canon;
+    if (hashed && dedup_lookup(h1, h2, size, &canon) &&
+        memcmp(canon->loc.ptr, data, size) == 0) {
+        e.block = std::move(canon);
+        dedup_hits_.fetch_add(1, std::memory_order_relaxed);
+        dedup_bytes_saved_.fetch_add(size, std::memory_order_relaxed);
+    } else {
+        canon.reset();  // aliased lookup survivor, if any (stripe held)
+        PoolLoc loc;
+        // no evict_lru: see header contract
+        if (!mm_->allocate(size, &loc)) {
+            st.map.erase(mit);
+            return OUT_OF_MEMORY;
+        }
+        memcpy(loc.ptr, data, size);
+        e.block = std::make_shared<Block>(mm_, loc, size);
+        if (hashed) dedup_register(h1, h2, size, e.block);
+    }
     e.size = size;
     e.committed = true;
     mit->second = std::move(e);
+    dedup_block_attached(mit->second.block, size);
+    logical_bytes_.fetch_add(size, std::memory_order_relaxed);
     if (track_lru()) lru_touch(st, mit->second, mit->first);
     return OK;
 }
@@ -728,13 +771,170 @@ Status KVIndex::insert_leased(const std::string& key, const PoolLoc& loc,
     if (!inserted) return CONFLICT;  // first-writer-wins
     Entry e;
     e.block = std::make_shared<Block>(mm_, loc, size);
+    // Content-addressed dedup: adopting a canonical drops the ONLY ref
+    // to the fresh wrapper right here (stripe held, arena ranks above
+    // stripes) — the client's leased blocks return to the pool and the
+    // duplicate costs zero pool bytes.
+    dedup_adopt_or_register(
+        &e.block, static_cast<const uint8_t*>(loc.ptr), size);
     e.size = size;
     e.committed = true;
     mit->second = std::move(e);
+    dedup_block_attached(mit->second.block, size);
+    logical_bytes_.fetch_add(size, std::memory_order_relaxed);
     if (track_lru()) lru_touch(st, mit->second, mit->first);
-    workload_.record_commit(h, static_cast<const uint8_t*>(loc.ptr),
-                            wl_round(size), mm_, size);
+    workload_.record_commit(
+        h, static_cast<const uint8_t*>(mit->second.block->loc.ptr),
+        wl_round(size), mm_, size);
     return OK;
+}
+
+// --- content-addressed dedup (docs/design.md "Content-addressed
+// dedup") ------------------------------------------------------------
+
+bool KVIndex::dedup_lookup(uint64_t h1, uint64_t h2, uint32_t size,
+                           BlockRef* canon) {
+    if (!dedup_enabled_ || size == 0) return false;
+    BlockRef cand;
+    {
+        // STRICT leaf discipline (lock_rank.h rank 370): only the map
+        // probe and the weak->strong upgrade happen under dedup_mu_.
+        // The ref moves OUT before any drop can happen — dropping a
+        // last BlockRef takes a pool-arena mutex (rank 300+a), which
+        // would invert the order under this lock.
+        ScopedLock lk(dedup_mu_);
+        auto it = dedup_map_.find(h1);
+        if (it == dedup_map_.end()) return false;
+        if (it->second.h2 != h2 || it->second.size != size) return false;
+        cand = it->second.block.lock();
+        if (!cand) {
+            dedup_map_.erase(it);  // canonical died: lazy cleanup
+            return false;
+        }
+    }
+    *canon = std::move(cand);
+    return true;
+}
+
+void KVIndex::dedup_register(uint64_t h1, uint64_t h2, uint32_t size,
+                             const BlockRef& b) {
+    if (!dedup_enabled_ || size == 0 || !b) return;
+    ScopedLock lk(dedup_mu_);
+    DedupSlot& s = dedup_map_[h1];
+    // First writer wins while the incumbent lives (mirrors the key
+    // map's rule); an expired incumbent is replaced in place.
+    if (s.block.expired()) {
+        s.block = b;
+        s.h2 = h2;
+        s.size = size;
+    }
+    if (++dedup_registrations_ % kDedupSweepEvery == 0) {
+        // Amortized sweep: expired weak_ptrs cost only control-block
+        // frees (heap, no pool locks), safe under the leaf mutex.
+        for (auto it = dedup_map_.begin(); it != dedup_map_.end();) {
+            if (it->second.block.expired()) {
+                it = dedup_map_.erase(it);
+            } else {
+                ++it;
+            }
+        }
+    }
+}
+
+bool KVIndex::dedup_adopt_or_register(BlockRef* slot,
+                                      const uint8_t* payload,
+                                      uint32_t size) {
+    if (!dedup_enabled_ || size == 0 || !*slot) return false;
+    uint64_t h1 = 0, h2 = 0;
+    content_hash128(payload, size, &h1, &h2);
+    BlockRef canon;
+    if (dedup_lookup(h1, h2, size, &canon) && canon != *slot &&
+        memcmp(canon->loc.ptr, payload, size) == 0) {
+        // Byte-verified duplicate: adopt. The swapped-out ref drops
+        // here or at the caller's unwind — under the stripe lock,
+        // where pool-arena acquisition is legal.
+        *slot = std::move(canon);
+        dedup_hits_.fetch_add(1, std::memory_order_relaxed);
+        dedup_bytes_saved_.fetch_add(size, std::memory_order_relaxed);
+        return true;
+    }
+    // Miss (or a 128-bit alias that failed the memcmp — counted
+    // nowhere: the workload estimator's aliasing is exactly what the
+    // cross-validation test scores): this block becomes canonical.
+    dedup_register(h1, h2, size, *slot);
+    return false;
+}
+
+void KVIndex::dedup_block_attached(const BlockRef& b, uint32_t size) {
+    if (!dedup_enabled_ || !b) return;
+    // Second-or-later committed sharer: these bytes ride an existing
+    // block — live savings grow. First sharer owns the physical bytes.
+    if (b->dedup_sharers.fetch_add(1, std::memory_order_relaxed) >= 1) {
+        dedup_saved_live_.fetch_add(size, std::memory_order_relaxed);
+    }
+}
+
+void KVIndex::dedup_block_released(Entry& e) {
+    if (!dedup_enabled_ || !e.block) return;
+    // Sharers remain after this hold ends: the DEPARTING entry's
+    // bytes were the shared ones (ownership of the physical bytes
+    // passes to a survivor — which entry attached first is
+    // irrelevant). Last hold out: the block leaves with its owner,
+    // savings unchanged.
+    if (e.block->dedup_sharers.fetch_sub(1, std::memory_order_relaxed)
+        >= 2) {
+        dedup_saved_live_.fetch_sub(e.size, std::memory_order_relaxed);
+    }
+}
+
+void KVIndex::dedup_entry_removed(Entry& e) {
+    if (!e.committed) return;
+    logical_bytes_.fetch_sub(e.size, std::memory_order_relaxed);
+    dedup_block_released(e);
+}
+
+int KVIndex::put_by_hash(const std::string& key, uint32_t size,
+                         uint64_t h1, uint64_t h2) {
+    uint64_t h = hash_of(key);
+    Stripe& st = stripes_[uint32_t(h) & (kStripes - 1)];
+    auto lk = lock_stripe(st);
+    auto mit = st.map.find(key);
+    if (mit != st.map.end()) {
+        // Committed or inflight: the put is already satisfied
+        // first-writer-wins style (the allocate path would have
+        // answered CONFLICT/FAKE_TOKEN) — no payload wanted.
+        return 2;  // EXISTS
+    }
+    BlockRef canon;
+    if (!dedup_lookup(h1, h2, size, &canon)) {
+        // No canonical: payload must follow on the normal put path.
+        // Nothing is reserved here on purpose — two clients probing
+        // the same key race to the ordinary allocate, where
+        // first-writer-wins already resolves it; a reservation would
+        // only add an orphan state to clean up.
+        dedup_hash_misses_.fetch_add(1, std::memory_order_relaxed);
+        return 0;  // NEED
+    }
+    // HAVE: commit the key by adopting the canonical block — zero
+    // pool bytes, zero payload transfer. This trusts the client's
+    // 128-bit hash claim (there are no bytes to memcmp); see the
+    // design.md security note.
+    Entry e;
+    e.block = std::move(canon);
+    e.size = size;
+    e.committed = true;
+    const uint8_t* payload =
+        static_cast<const uint8_t*>(e.block->loc.ptr);
+    auto [nit, inserted] = st.map.try_emplace(key, std::move(e));
+    (void)inserted;  // find() above miss + stripe lock held => inserts
+    dedup_block_attached(nit->second.block, size);
+    logical_bytes_.fetch_add(size, std::memory_order_relaxed);
+    dedup_hits_.fetch_add(1, std::memory_order_relaxed);
+    dedup_hash_hits_.fetch_add(1, std::memory_order_relaxed);
+    dedup_bytes_saved_.fetch_add(size, std::memory_order_relaxed);
+    if (track_lru()) lru_touch(st, nit->second, nit->first);
+    workload_.record_commit(h, payload, wl_round(size), mm_, size);
+    return 1;  // HAVE
 }
 
 size_t KVIndex::purge() {
@@ -750,6 +950,16 @@ size_t KVIndex::purge() {
             st.map.clear();
             st.lru.clear();
             st.tail_age.store(UINT64_MAX, std::memory_order_relaxed);
+        }
+        // Dedup plane resets with the entries (no commit can race: all
+        // stripe locks are held). Cumulative hit counters survive like
+        // the other counters; the live gauges and the canonical map
+        // go with the data they described.
+        logical_bytes_.store(0, std::memory_order_relaxed);
+        dedup_saved_live_.store(0, std::memory_order_relaxed);
+        {
+            ScopedLock dlk(dedup_mu_);
+            dedup_map_.clear();
         }
     }
     // Determinism barrier, after the stripe locks drop (the writer
@@ -822,6 +1032,7 @@ size_t KVIndex::erase(const std::vector<std::string>& keys) {
         // miss on this key is the CLIENT's doing, never counted
         // against the reclaimer's eviction quality.
         workload_.forget(hash_of(k));
+        dedup_entry_removed(it->second);
         lru_drop(st, it->second);
         st.map.erase(it);
         n++;
@@ -1046,6 +1257,7 @@ size_t KVIndex::evict_from_stripe(uint32_t si, bool held, size_t want,
                 if (off >= 0) {
                     e.disk = std::make_shared<DiskSpan>(disk_, off, e.size);
                     bump_epoch();  // before the blocks return to the pool
+                    dedup_block_released(e);  // disk copy is private again
                     e.block.reset();  // frees the pool blocks
                     e.touched = false;  // second-touch restarts per cycle
                     spilled = true;
@@ -1080,6 +1292,7 @@ size_t KVIndex::evict_from_stripe(uint32_t si, bool held, size_t want,
             // dropped something the workload still wanted).
             workload_.record_evict(hash_of(it->key));
             bump_epoch();  // before map.erase drops the blocks
+            dedup_entry_removed(e);
             st.map.erase(mit);
             evictions_.fetch_add(1, std::memory_order_relaxed);
         }
@@ -1664,6 +1877,11 @@ void KVIndex::finish_spill(SpillItem& item, int64_t off) {
                 e.disk = std::move(span);
                 e.spilling = false;
                 e.touched = false;  // second-touch restarts per cycle
+                // A spilled entry has a PRIVATE disk copy: any dedup
+                // saving this entry carried ends here. (A SHARED block
+                // never reaches this point — use_count would be > 2 —
+                // so this fires only after sharing already dropped.)
+                dedup_block_released(e);
                 e.block.reset();  // our item.block still pins the bytes
                 spills_.fetch_add(1, std::memory_order_relaxed);
                 workload_.record_spill(item.key_hash);
@@ -1681,6 +1899,7 @@ void KVIndex::finish_spill(SpillItem& item, int64_t off) {
                 // resident (and evictable by a future pass).
                 workload_.record_evict(item.key_hash);
                 bump_epoch();  // before the blocks can return to the pool
+                dedup_entry_removed(e);
                 lru_drop(st, e);
                 st.map.erase(mit);
                 evictions_.fetch_add(1, std::memory_order_relaxed);

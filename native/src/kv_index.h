@@ -129,6 +129,10 @@ struct Entry {
     // resident; guarded by the stripe mutex).
     std::list<LruNode>::iterator lru_it{};
     bool in_lru = false;
+    // Content-addressed dedup sharing is tracked per BLOCK, not per
+    // entry (Block::dedup_sharers): the first writer can die while
+    // sharers remain, so "who owns the physical bytes" is a property
+    // of the block's committed-holder count, not of any one entry.
 };
 
 class KVIndex {
@@ -386,6 +390,66 @@ class KVIndex {
     // leased blocks). This is the second phase of OP_COMMIT_BATCH.
     Status insert_leased(const std::string& key, const PoolLoc& loc,
                          uint32_t size);
+
+    // --- content-addressed dedup (docs/design.md "Content-addressed
+    // dedup"). Commit-time: every committed publication computes
+    // content_hash128 over the full payload; a byte-verified match
+    // against a live canonical block ADOPTS it (the duplicate's own
+    // bytes free back to the pool), otherwise the new block registers
+    // as canonical. Hash-first: OP_PUT_HASH answers below WITHOUT any
+    // payload on the wire.
+    //
+    // put_by_hash verdicts (the OP_PUT_HASH wire bytes):
+    //   0 NEED   — no canonical match; payload must follow on the
+    //              normal put path (nothing was reserved: first-
+    //              writer-wins resolves the race if two clients probe
+    //              the same key).
+    //   1 HAVE   — key committed by adopting the canonical block for
+    //              (h1, h2, size); zero pool bytes, zero payload
+    //              (counted dedup_hits / dedup_bytes_saved).
+    //   2 EXISTS — key already present (committed or inflight); the
+    //              put is already satisfied first-writer-wins style.
+    // HAVE trusts the 128-bit client hash claim — see the design.md
+    // security note (commit-time adoption always memcmp-verifies; the
+    // hash-first path has no bytes to compare).
+    int put_by_hash(const std::string& key, uint32_t size, uint64_t h1,
+                    uint64_t h2);
+
+    bool dedup_enabled() const { return dedup_enabled_; }
+    uint64_t dedup_hits() const {
+        return dedup_hits_.load(std::memory_order_relaxed);
+    }
+    uint64_t dedup_bytes_saved() const {
+        return dedup_bytes_saved_.load(std::memory_order_relaxed);
+    }
+    uint64_t dedup_hash_hits() const {
+        return dedup_hash_hits_.load(std::memory_order_relaxed);
+    }
+    uint64_t dedup_hash_misses() const {
+        return dedup_hash_misses_.load(std::memory_order_relaxed);
+    }
+    // Sum of committed entry sizes (what clients think they stored)
+    // vs the live bytes dedup is currently saving — the unique-vs-
+    // logical gauge pair istpu_top renders as logical/physical
+    // occupancy.
+    uint64_t logical_bytes() const {
+        return logical_bytes_.load(std::memory_order_relaxed);
+    }
+    uint64_t dedup_saved_live() const {
+        return dedup_saved_live_.load(std::memory_order_relaxed);
+    }
+    // MEASURED capacity multiplier in milli (1000 = no dedup):
+    // logical / (logical - saved_live). Exact on delete-free traces;
+    // after first-writer deletions it is the live-entry approximation
+    // (savings follow the surviving adopters). The workload plane's
+    // sampled dedup_ratio_milli is the PREDICTION this is scored
+    // against.
+    uint64_t dedup_measured_milli() const {
+        uint64_t logical = logical_bytes();
+        uint64_t saved = dedup_saved_live();
+        if (logical == 0 || saved >= logical) return 1000;
+        return logical * 1000 / (logical - saved);
+    }
 
     // Drops all entries; inflight tokens survive harmlessly. All-stripe
     // vector-held lock set (see match_last_index).
@@ -795,6 +859,70 @@ class KVIndex {
     // Async promotion worker (promote.{h,cc}); constructed with the
     // disk tier, started by start_background when `promote` is on.
     std::unique_ptr<Promoter> promoter_;
+
+    // --- content-addressed dedup index --------------------------------
+    // content-hash -> canonical block. weak_ptr: the index never keeps
+    // a block alive (a freed canonical simply expires out — lazily on
+    // lookup, wholesale in an amortized sweep). dedup_mu_ is a STRICT
+    // leaf (kRankDedup): held only across the map op + weak_ptr::lock,
+    // NEVER across a BlockRef drop — dropping the last ref takes a
+    // pool-arena mutex (rank 300+a < 370), so refs acquired under it
+    // are moved out and released under the caller's stripe lock.
+    struct DedupSlot {
+        std::weak_ptr<Block> block;
+        uint64_t h2 = 0;
+        uint32_t size = 0;
+    };
+    // Lookup (h1, h2, size): true iff a live canonical block with that
+    // identity exists; *canon pinned. Expired slots are erased lazily.
+    // Does NOT memcmp — callers with payload bytes verify before
+    // adopting (hash-first callers have nothing to compare).
+    bool dedup_lookup(uint64_t h1, uint64_t h2, uint32_t size,
+                      BlockRef* canon);
+    // Register `b` as the canonical block for (h1, h2, size); first
+    // writer wins on h1 collision with a still-live slot. Amortized
+    // expired-slot sweep every kDedupSweepEvery registrations.
+    void dedup_register(uint64_t h1, uint64_t h2, uint32_t size,
+                        const BlockRef& b);
+    // Payload-verified adoption attempt for the commit-time paths:
+    // hashes `payload`, looks up a canonical, memcmp-verifies, and on
+    // a match swaps it into *slot (counting the hit). Registers the
+    // caller's block as canonical on a miss (when *slot is set).
+    // Returns true iff adopted. Call under the entry's stripe mutex.
+    bool dedup_adopt_or_register(BlockRef* slot, const uint8_t* payload,
+                                 uint32_t size);
+    // A committed entry took hold of block `b` (fresh commit,
+    // adoption, promote re-materialization): bump the block's
+    // committed-sharer count; a second-or-later sharer's bytes are
+    // live savings. Stripe mutex held. Exactly one release below must
+    // pair with every attach — the sharer count, NOT use_count()
+    // (inflated by transient read/spill refs), drives the exact
+    // invariant used_bytes == logical_bytes - dedup_saved_live on
+    // disk-free workloads.
+    void dedup_block_attached(const BlockRef& b, uint32_t size);
+    // A committed entry's hold on its block ends while the entry
+    // survives (spill adoption: the disk copy is private): drop the
+    // sharer count; if sharers remain, the DEPARTING bytes were the
+    // shared ones. Stripe mutex held.
+    void dedup_block_released(Entry& e);
+    // A committed entry is dying (erase/evict-drop/erase_range):
+    // retire its logical bytes + release its block hold. Stripe mutex
+    // held.
+    void dedup_entry_removed(Entry& e);
+    static constexpr uint64_t kDedupSweepEvery = 4096;
+    mutable Mutex dedup_mu_{kRankDedup};
+    std::unordered_map<uint64_t, DedupSlot> dedup_map_
+        GUARDED_BY(dedup_mu_);
+    uint64_t dedup_registrations_ GUARDED_BY(dedup_mu_) = 0;
+    // ISTPU_DEDUP=0 (read once at construction) disables content
+    // addressing end to end — the bench --dedup-leg denominator.
+    bool dedup_enabled_ = true;
+    std::atomic<uint64_t> dedup_hits_{0};
+    std::atomic<uint64_t> dedup_bytes_saved_{0};
+    std::atomic<uint64_t> dedup_hash_hits_{0};
+    std::atomic<uint64_t> dedup_hash_misses_{0};
+    std::atomic<uint64_t> logical_bytes_{0};
+    std::atomic<uint64_t> dedup_saved_live_{0};
 
     // Always-on workload profiler (ISTPU_WORKLOAD=0 disables — the
     // bench denominator only). Locks internally (wl_mu_, a leaf above

@@ -66,6 +66,13 @@
 //                                        record hooks run under the
 //                                        entry's stripe mutex); leaf —
 //                                        nothing acquired inside
+//   370   KVIndex::dedup_mu_             a stripe (commit-time dedup
+//                                        lookup/registration); STRICT
+//                                        leaf: held only across the
+//                                        hash-map op + weak_ptr::lock —
+//                                        never across a BlockRef drop
+//                                        (which takes a pool arena,
+//                                        rank 300+a)
 //
 // Client-side mutexes (client.h) and the log/failpoint/event-track
 // registry mutexes stay plain std::mutex: they are terminal leaves
@@ -117,6 +124,10 @@ enum LockRank : int {
                              // stripe locks: the record hooks run under
                              // the entry's stripe mutex, and the
                              // profiler takes no further lock inside)
+    kRankDedup = 370,        // KVIndex::dedup_mu_ (content-hash index;
+                             // strict leaf — scoped to the map op +
+                             // weak_ptr::lock, released before any
+                             // BlockRef can drop)
 };
 
 #ifdef ISTPU_LOCK_RANK
@@ -144,6 +155,7 @@ inline const char* rank_name(int r) {
         case kRankTraceTracks: return "trace-tracks";
         case kRankHistory: return "server-history";
         case kRankWorkload: return "workload-profiler";
+        case kRankDedup: return "dedup-index";
         default: return "?";
     }
 }

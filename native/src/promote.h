@@ -70,6 +70,12 @@ struct Block {
     MM* mm;
     PoolLoc loc;
     size_t size;
+    // Committed index entries currently holding this block (content-
+    // addressed dedup, docs/design.md): maintained by KVIndex::
+    // dedup_block_attached/_released, NOT by use_count() — transient
+    // refs (reads, spill queue) must not count as sharers. Drives the
+    // exact dedup_saved_live accounting: logical - saved == physical.
+    std::atomic<uint32_t> dedup_sharers{0};
 };
 using BlockRef = std::shared_ptr<Block>;
 

@@ -32,6 +32,7 @@ const char* op_name(uint8_t op) {
         case OP_FABRIC_ATTACH: return "FABRIC_ATTACH";
         case OP_FABRIC_WRITE: return "FABRIC_WRITE";
         case OP_FABRIC_DOORBELL: return "FABRIC_DOORBELL";
+        case OP_PUT_HASH: return "PUT_HASH";
         default: return "UNKNOWN";
     }
 }

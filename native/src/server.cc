@@ -1241,6 +1241,40 @@ std::string Server::stats_json() {
         out += entry;
     }
     if (index_ != nullptr) {
+        // Content-addressed dedup (docs/design.md "Content-addressed
+        // dedup"): logical vs physical occupancy plus the measured
+        // capacity multiplier that the workload estimator's
+        // dedup_ratio_milli PREDICTION (below) is scored against.
+        // dedup_wire_* count HAVE verdicts whose payload never crossed
+        // the transport; dedup_hits also include commit-time adoption
+        // of payload that did arrive.
+        char entry[512];
+        snprintf(entry, sizeof(entry),
+                 ", \"dedup\": {\"enabled\": %d, "
+                 "\"dedup_hits\": %llu, "
+                 "\"dedup_bytes_saved\": %llu, "
+                 "\"dedup_hash_hits\": %llu, "
+                 "\"dedup_hash_misses\": %llu, "
+                 "\"dedup_wire_hits\": %llu, "
+                 "\"dedup_wire_bytes_saved\": %llu, "
+                 "\"logical_bytes\": %llu, "
+                 "\"dedup_saved_live\": %llu, "
+                 "\"dedup_measured_milli\": %llu}",
+                 index_->dedup_enabled() ? 1 : 0,
+                 (unsigned long long)index_->dedup_hits(),
+                 (unsigned long long)index_->dedup_bytes_saved(),
+                 (unsigned long long)index_->dedup_hash_hits(),
+                 (unsigned long long)index_->dedup_hash_misses(),
+                 (unsigned long long)dedup_wire_hits_.load(
+                     std::memory_order_relaxed),
+                 (unsigned long long)dedup_wire_bytes_saved_.load(
+                     std::memory_order_relaxed),
+                 (unsigned long long)index_->logical_bytes(),
+                 (unsigned long long)index_->dedup_saved_live(),
+                 (unsigned long long)index_->dedup_measured_milli());
+        out += entry;
+    }
+    if (index_ != nullptr) {
         // Workload headline (GET /workload has the full model): the
         // demand facts a dashboard wants next to the system gauges —
         // working-set estimate, predicted miss at the current pool,
@@ -1748,6 +1782,7 @@ void Server::handle_message(Conn& c) {
         case OP_PIN: op_pin(c); break;
         case OP_RELEASE: op_release(c); break;
         case OP_PREFETCH: op_prefetch(c); break;
+        case OP_PUT_HASH: op_put_hash(c); break;
         case OP_FABRIC_ATTACH: op_fabric_attach(c); break;
         case OP_FABRIC_DOORBELL: op_fabric_doorbell(c); break;
         case OP_CHECK_EXIST: op_check_exist(c); break;
@@ -2199,13 +2234,52 @@ void Server::commit_insert(Conn& c, uint64_t seq, uint8_t resp_op,
     respond(c, seq, resp_op, std::move(body));
 }
 
-bool Server::fabric_ingest_record(Conn& c, const uint8_t* p, size_t n) {
+bool Server::fabric_ingest_record(Conn& c, const uint8_t* p, size_t n,
+                                  bool hash_rec) {
     // One ring-posted commit record (fabric.h): u64 client_seq,
     // u64 lease_id, u32 block_size, keys. The record IS a wire op that
     // happened to arrive through shared memory — it gets the same
     // accounting, the same carve replay and the same response shape as
     // OP_COMMIT_BATCH (the response rides the TCP control channel, so
     // sync()/error-latch semantics on the client are unchanged).
+    // Ring v2 hash-first records (flag bit on the len word) are the
+    // same idea for OP_PUT_HASH: a same-host dedup'd put stays
+    // one-sided — probe posted through shm, verdicts on TCP — with no
+    // extra RTT ahead of the payload path.
+    if (hash_rec) {
+        BufReader hr(p, n);
+        uint64_t seq = hr.u64();
+        uint32_t block_size = hr.u32();
+        uint32_t nk = hr.u32();
+        if (!hr.ok() || block_size == 0 || nk > MAX_KEYS_PER_OP) {
+            return false;
+        }
+        ops_++;
+        c.w->ops.fetch_add(1, std::memory_order_relaxed);
+        long long t0 = now_us();
+        std::vector<uint8_t> verdicts(nk, 0);
+        for (uint32_t i = 0; i < nk; ++i) {
+            std::string key = hr.str();
+            uint64_t h1 = hr.u64();
+            uint64_t h2 = hr.u64();
+            if (!hr.ok()) return false;
+            int v = index_->put_by_hash(key, block_size, h1, h2);
+            verdicts[i] = uint8_t(v);
+            if (v == 1) {
+                dedup_wire_hits_.fetch_add(1, std::memory_order_relaxed);
+                dedup_wire_bytes_saved_.fetch_add(
+                    block_size, std::memory_order_relaxed);
+            }
+        }
+        std::vector<uint8_t> body;
+        BufWriter w(body);
+        w.u32(OK);
+        w.u32(nk);
+        w.bytes(verdicts.data(), verdicts.size());
+        respond(c, seq, OP_PUT_HASH, std::move(body));
+        account_op(OP_PUT_HASH, now_us() - t0);
+        return true;
+    }
     BufReader r(p, n);
     uint64_t seq = r.u64();
     uint64_t lease_id = r.u64();
@@ -2735,6 +2809,51 @@ void Server::op_prefetch(Conn& c) {
     respond(c, c.hdr.seq, OP_PREFETCH, std::move(body));
 }
 
+void Server::op_put_hash(Conn& c) {
+    // OP_PUT_HASH (docs/design.md "Content-addressed dedup"): the
+    // hash-first half of the two-phase put. Per key the index answers
+    // 0 NEED (payload must follow on the normal put/lease path — no
+    // reservation is made, first-writer-wins resolves probe races),
+    // 1 HAVE (the key was committed HERE by pinning the block already
+    // holding these bytes: zero payload transferred, zero pool bytes),
+    // or 2 EXISTS (key already present). A HAVE trusts the client's
+    // 128-bit hash claim — see the design.md security note.
+    BufReader r(c.body.data(), c.body.size());
+    uint32_t block_size = r.u32();
+    uint32_t n = r.u32();
+    std::vector<uint8_t> body;
+    BufWriter w(body);
+    if (!r.ok() || block_size == 0 || n > MAX_KEYS_PER_OP) {
+        w.u32(BAD_REQUEST);
+        respond(c, c.hdr.seq, OP_PUT_HASH, std::move(body));
+        return;
+    }
+    std::vector<uint8_t> verdicts(n, 0);
+    for (uint32_t i = 0; i < n; ++i) {
+        std::string key = r.str();
+        uint64_t h1 = r.u64();
+        uint64_t h2 = r.u64();
+        if (!r.ok()) {
+            std::vector<uint8_t> bad;
+            BufWriter bw(bad);
+            bw.u32(BAD_REQUEST);
+            respond(c, c.hdr.seq, OP_PUT_HASH, std::move(bad));
+            return;
+        }
+        int v = index_->put_by_hash(key, block_size, h1, h2);
+        verdicts[i] = uint8_t(v);
+        if (v == 1) {
+            dedup_wire_hits_.fetch_add(1, std::memory_order_relaxed);
+            dedup_wire_bytes_saved_.fetch_add(block_size,
+                                              std::memory_order_relaxed);
+        }
+    }
+    w.u32(OK);
+    w.u32(n);
+    w.bytes(verdicts.data(), verdicts.size());
+    respond(c, c.hdr.seq, OP_PUT_HASH, std::move(body));
+}
+
 void Server::op_release(Conn& c) {
     BufReader r(c.body.data(), c.body.size());
     uint64_t lease = r.u64();
@@ -2979,6 +3098,14 @@ void Server::history_sample() {
             thr = wl.thrash_cycles();
             s.wss_bytes = wl.wss_bytes();
         }
+        // Content-addressed dedup (ISSUE 16): hit/savings deltas plus
+        // the logical-occupancy gauges.
+        uint64_t dh = index_ ? index_->dedup_hits() : 0;
+        uint64_t ds = index_ ? index_->dedup_bytes_saved() : 0;
+        if (index_ != nullptr) {
+            s.logical_bytes = index_->logical_bytes();
+            s.dedup_saved_live = index_->dedup_saved_live();
+        }
         uint64_t lat[LatHist::kBuckets] = {};
         uint64_t opc[kMaxOp] = {};
         for (int op = 1; op < kMaxOp; ++op) {
@@ -3000,6 +3127,8 @@ void Server::history_sample() {
             s.uring_sqes_delta = sqes - hist_prev_.uring_sqes;
             s.premature_evictions_delta = prem - hist_prev_.premature;
             s.thrash_cycles_delta = thr - hist_prev_.thrash;
+            s.dedup_hits_delta = dh - hist_prev_.dedup_hits;
+            s.dedup_bytes_saved_delta = ds - hist_prev_.dedup_saved;
             for (int b = 0; b < kNumBuckets; ++b) {
                 s.lat_delta[b] = lat[b] - hist_prev_.lat[b];
             }
@@ -3019,6 +3148,8 @@ void Server::history_sample() {
         hist_prev_.uring_sqes = sqes;
         hist_prev_.premature = prem;
         hist_prev_.thrash = thr;
+        hist_prev_.dedup_hits = dh;
+        hist_prev_.dedup_saved = ds;
         memcpy(hist_prev_.lat, lat, sizeof(lat));
         memcpy(hist_prev_.op_count, opc, sizeof(opc));
         hist_prev_.valid = true;
@@ -3076,6 +3207,9 @@ std::string Server::history_json() {
             "\"uring_sqes_delta\": %llu, "
             "\"premature_evictions_delta\": %llu, "
             "\"thrash_cycles_delta\": %llu, \"wss_bytes\": %llu, "
+            "\"dedup_hits_delta\": %llu, "
+            "\"dedup_bytes_saved_delta\": %llu, "
+            "\"logical_bytes\": %llu, \"dedup_saved_live\": %llu, "
             "\"cluster_epoch\": %llu, "
             "\"workers_dead\": %u, "
             "\"tier_breaker_open\": %u, \"stalled\": %u, "
@@ -3098,6 +3232,10 @@ std::string Server::history_json() {
             (unsigned long long)s.premature_evictions_delta,
             (unsigned long long)s.thrash_cycles_delta,
             (unsigned long long)s.wss_bytes,
+            (unsigned long long)s.dedup_hits_delta,
+            (unsigned long long)s.dedup_bytes_saved_delta,
+            (unsigned long long)s.logical_bytes,
+            (unsigned long long)s.dedup_saved_live,
             (unsigned long long)s.cluster_epoch, s.workers_dead,
             unsigned(s.breaker), unsigned(s.stalled));
         out.append(buf, size_t(m));

@@ -551,7 +551,8 @@ class Server {
     // minus the u32 length). Called by the fabric engine's drain on
     // the owning worker; false = malformed record, the caller marks
     // the connection dead.
-    bool fabric_ingest_record(Conn& c, const uint8_t* p, size_t n);
+    bool fabric_ingest_record(Conn& c, const uint8_t* p, size_t n,
+                              bool hash_rec = false);
     void op_fabric_attach(Conn& c);
     void op_fabric_doorbell(Conn& c);
     void begin_fabric_write(Conn& c);   // carve plan for OP_FABRIC_WRITE
@@ -605,6 +606,7 @@ class Server {
     void op_pin(Conn& c);
     void op_release(Conn& c);
     void op_prefetch(Conn& c);
+    void op_put_hash(Conn& c);
     void op_check_exist(Conn& c);
     void op_match(Conn& c);
     void op_simple(Conn& c);  // SYNC / PURGE / STATS / DELETE
@@ -687,6 +689,13 @@ class Server {
     std::atomic<uint64_t> fabric_one_sided_puts_{0};
     std::atomic<uint64_t> fabric_doorbells_{0};
     std::atomic<uint64_t> fabric_writes_{0};
+    // Hash-first put verdicts that answered HAVE on the WIRE (TCP
+    // OP_PUT_HASH or the fabric hash record) — payload bytes that
+    // never crossed the transport, as opposed to the index's
+    // dedup_hits which also count commit-time adoption of payload
+    // that DID arrive.
+    std::atomic<uint64_t> dedup_wire_hits_{0};
+    std::atomic<uint64_t> dedup_wire_bytes_saved_{0};
     LatHist op_lat_[kMaxOp];
 
     // Request tracing (trace.h): always constructed (the wait
@@ -825,6 +834,13 @@ class Server {
         uint64_t premature_evictions_delta = 0;
         uint64_t thrash_cycles_delta = 0;
         uint64_t wss_bytes = 0;
+        // Content-addressed dedup (ISSUE 16): hit/savings deltas plus
+        // the logical-vs-physical gauges so a bundle shows the
+        // capacity multiplier trajectory, not just its endpoint.
+        uint64_t dedup_hits_delta = 0;
+        uint64_t dedup_bytes_saved_delta = 0;
+        uint64_t logical_bytes = 0;
+        uint64_t dedup_saved_live = 0;
         // Cluster tier: directory epoch in force at the sample — the
         // chaos acceptance reads p99 deltas AROUND an epoch bump, and
         // a bundle's history shows exactly when re-routing took effect.
@@ -849,6 +865,7 @@ class Server {
         uint64_t evictions = 0, spills = 0, promotes = 0;
         uint64_t uring_sqes = 0;
         uint64_t premature = 0, thrash = 0;
+        uint64_t dedup_hits = 0, dedup_saved = 0;
         uint64_t lat[LatHist::kBuckets] = {};
         uint64_t op_count[kMaxOp] = {};
         bool valid = false;

@@ -7,6 +7,7 @@
 
 #include <atomic>
 #include <cstdio>
+#include <cstring>
 #include <initializer_list>
 
 namespace istpu {
@@ -59,6 +60,53 @@ long long now_us() {
     struct timespec ts;
     clock_gettime(CLOCK_MONOTONIC, &ts);
     return (long long)ts.tv_sec * 1000000 + ts.tv_nsec / 1000;
+}
+
+namespace {
+
+inline uint64_t mix64(uint64_t x) {
+    // splitmix64 finalizer: full-avalanche 64-bit mix.
+    x ^= x >> 30;
+    x *= 0xbf58476d1ce4e5b9ULL;
+    x ^= x >> 27;
+    x *= 0x94d049bb133111ebULL;
+    x ^= x >> 31;
+    return x;
+}
+
+inline uint64_t load64(const uint8_t* p) {
+    uint64_t v;
+    memcpy(&v, p, 8);  // unaligned-safe; x86/ARM LE hosts only
+    return v;
+}
+
+}  // namespace
+
+void content_hash128(const void* data, size_t n, uint64_t* h1,
+                     uint64_t* h2) {
+    // Two independently-seeded accumulator lanes over 8-byte words.
+    // Each step: absorb a mixed word, then rotate-multiply — the same
+    // shape as wyhash/xxh3's scalar fallback. The tail word is
+    // length-padded so "abc" and "abc\0" differ.
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    uint64_t a = 0x9e3779b97f4a7c15ULL ^ n;
+    uint64_t b = 0xc2b2ae3d27d4eb4fULL ^ (n * 0x165667b19e3779f9ULL);
+    size_t i = 0;
+    for (; i + 8 <= n; i += 8) {
+        uint64_t w = load64(p + i);
+        a = (a ^ mix64(w + 0x8ebc6af09c88c6e3ULL)) * 0x2545f4914f6cdd1dULL;
+        a = (a << 23) | (a >> 41);
+        b = (b ^ mix64(w + 0x589965cc75374cc3ULL)) * 0xff51afd7ed558ccdULL;
+        b = (b << 29) | (b >> 35);
+    }
+    uint64_t tail = uint64_t(n) << 56;
+    for (size_t j = 0; i + j < n; ++j) {
+        tail |= uint64_t(p[i + j]) << (8 * j);
+    }
+    a = mix64(a ^ tail);
+    b = mix64(b ^ (tail * 0x9e3779b97f4a7c15ULL) ^ a);
+    *h1 = mix64(a ^ (b >> 32));
+    *h2 = mix64(b ^ (a << 1));
 }
 
 }  // namespace istpu

@@ -8,6 +8,9 @@
 // backtrace() instead of boost.
 #pragma once
 
+#include <cstddef>
+#include <cstdint>
+
 namespace istpu {
 
 // Install SIGSEGV/SIGBUS/SIGABRT handlers that dump a native backtrace to
@@ -25,5 +28,18 @@ void install_crash_hook(CrashHook fn);
 
 // Monotonic microseconds (per-op latency accounting).
 long long now_us();
+
+// Strong 128-bit content hash over the FULL payload (the dedup index's
+// identity function; docs/design.md "Content-addressed dedup"). Two
+// independently-seeded 64-bit multiply/xor-rotate lanes over 8-byte
+// words, finalized splitmix-style — not cryptographic, but 128 bits of
+// well-mixed state makes an accidental collision astronomically
+// unlikely, and commit-time adoption additionally memcmp-verifies.
+// WIRE-VISIBLE: OP_PUT_HASH carries (h1, h2) computed by clients, so
+// this function is part of the protocol and must stay byte-stable.
+// (PR 13's first/last-64B FNV fingerprint remains the workload
+// profiler's cheap SAMPLER; this is the real thing the index keys on.)
+void content_hash128(const void* data, size_t n, uint64_t* h1,
+                     uint64_t* h2);
 
 }  // namespace istpu

@@ -394,6 +394,50 @@ def test_cluster_obs_leg_emits_overhead_keys():
     assert out["cluster_obs_digest_ranges"] > 0
 
 
+def test_dedup_leg_emits_keys():
+    """The content-addressed dedup leg (ISSUE 16) must land its keys
+    in the artifact and pin the acceptance numbers that are
+    deterministic at this scale: a duplicate put transfers ZERO
+    payload bytes (dedup_hit_put_bytes == 0 — the HAVE verdicts'
+    wire-bytes-saved delta covers the duplicate pass exactly), the
+    MEASURED capacity multiplier is at least the PR-18 estimator's
+    prediction and within 0.1 of it (same deterministic trace), and
+    the dedup'd store packs strictly more users per GB than the
+    ISTPU_DEDUP=0 denominator. The read p50 ratio is asserted only as
+    sane here — CI noise is checked at the acceptance level."""
+    env = _env(600)
+    env["ISTPU_DEDUP_KEYS"] = "256"  # small: keep the test fast
+    p = subprocess.run(
+        [sys.executable, BENCH, "--dedup-leg", "0"], env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert p.returncode == 0, p.stderr[-400:]
+    outs = _parse_artifacts(
+        [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+    )
+    assert outs, p.stdout[-400:]
+    out = outs[-1]
+    assert "dedup_error" not in out, out
+    assert out["dedup_on_p50_read_us"] > 0
+    assert out["dedup_off_p50_read_us"] > 0
+    assert out["dedup_read_p50_ratio"] > 0
+    # Zero-byte duplicate puts: the whole point of the hash-first path.
+    assert out["dedup_dup_logical_bytes"] > 0
+    assert out["dedup_hit_put_bytes"] == 0, out
+    # Measured >= predicted, and the estimator cross-validates within
+    # 0.1 on the deterministic trace (ISSUE 16 acceptance).
+    assert out["dedup_capacity_multiplier"] >= out["dedup_estimator_ratio"]
+    assert abs(out["dedup_capacity_multiplier"]
+               - out["dedup_estimator_ratio"]) <= 0.1, out
+    assert out["dedup_capacity_multiplier"] > 1.5
+    # The capacity story: physical bytes shrank, users/GB grew.
+    assert out["dedup_hits"] > 0
+    assert out["dedup_bytes_saved"] > 0
+    assert out["dedup_physical_bytes"] < out["dedup_physical_bytes_nodedup"]
+    assert out["dedup_logical_bytes"] > out["dedup_physical_bytes"]
+    assert out["users_per_gb"] > out["users_per_gb_nodedup"]
+
+
 def test_probe_failure_cached_across_runs(tmp_path, monkeypatch):
     """A failed probe is persisted; the next run (within the TTL) skips
     the probe subprocess entirely — no 180 s re-burn (the BENCH_r05

@@ -430,6 +430,57 @@ def test_wrong_epoch_stats_key_rename_fails(tree):
     assert "'stats_keys' drifted" in r.stderr
 
 
+def test_removed_put_hash_op_fails(tree):
+    # ISSUE 16 seeded mutation, op pin direction 1: deleting the
+    # OP_PUT_HASH wire op from common.h must fail the golden's `ops`
+    # section — a v16 client's hash-first put would hit UNSUPPORTED and
+    # dedup would silently degrade to full-payload transfer.
+    mutate(tree, "native/src/common.h", "    OP_PUT_HASH = 24,", "")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "'ops' drifted" in r.stderr
+
+
+def test_removed_put_hash_doc_row_fails(tree):
+    # ISSUE 16 seeded mutation, op pin direction 2: the op exists in
+    # code but every api.md mention vanished (the wire-table row AND
+    # the ClientConfig use_dedup cross-reference — the doc check is
+    # word-boundary over the whole file, so both must go to trip it;
+    # the suffixed spelling fails the \b match by design).
+    mutate(tree, "docs/api.md", "OP_PUT_HASH", "OP_PUT_HASH_REDACTED",
+           count=2)
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "OP_PUT_HASH" in r.stderr and "wire table" in r.stderr
+
+
+def test_dedup_hits_stats_key_rename_fails(tree):
+    # ISSUE 16 seeded mutation, stats pin both directions at once:
+    # renaming the stats_json dedup section's dedup_hits key removes
+    # the pinned spelling AND adds an unpinned one — the golden's
+    # stats_keys section must catch either, so the capacity-multiplier
+    # telemetry can never silently go dark under a refactor. (The
+    # colon-anchored spelling scopes the mutation to the stats emitter,
+    # not the history ring's dedup_hits_delta.)
+    mutate(tree, "native/src/server.cc", '\\"dedup_hits\\":',
+           '\\"dedup_hitz\\":')
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "'stats_keys' drifted" in r.stderr
+
+
+def test_added_dedup_stats_key_fails_golden(tree):
+    # ISSUE 16 seeded mutation, stats pin grow direction in isolation:
+    # a brand-new dedup stats key without a golden regen is silent
+    # surface growth, exactly like an export without an ABI bump.
+    mutate(tree, "native/src/server.cc",
+           '"\\"dedup_hits\\": %llu, "',
+           '"\\"dedup_hits\\": %llu, \\"dedup_bogus_total\\": 0, "')
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "'stats_keys' drifted" in r.stderr
+
+
 def test_make_analyze_exits_zero():
     # With clang installed this is the -Wthread-safety -Werror proof
     # pass; without it the target reports the skip and still exits 0 —

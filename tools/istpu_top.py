@@ -404,6 +404,20 @@ def render_frame(stats, debug, events, prev=None, dt=None, tail=10,
             f"{_fmt_bytes(stats.get('disk_used', 0))}/{_fmt_bytes(disk_b)}"
             f"  io_errors={stats.get('disk_io_errors', 0)}"
         )
+    # Logical vs physical occupancy (ISSUE 16): with dedup active the
+    # logical bar can exceed 100% of physical usage — that overhang IS
+    # the capacity multiplier.
+    dd = stats.get("dedup", {})
+    if dd.get("enabled"):
+        logical = dd.get("logical_bytes", 0)
+        lines.append(
+            f"lgcl {_bar(logical / pool_b)} "
+            f"{_fmt_bytes(logical)} logical  "
+            f"x{dd.get('dedup_measured_milli', 1000) / 1000.0:.2f} "
+            f"dedup  hits={dd.get('dedup_hits', 0)} "
+            f"saved={_fmt_bytes(dd.get('dedup_bytes_saved', 0))} "
+            f"(wire {_fmt_bytes(dd.get('dedup_wire_bytes_saved', 0))})"
+        )
     lines.append(
         f"queues: spill={stats.get('spill_queue_depth', 0)} "
         f"promote={stats.get('promote_queue_depth', 0)}  "
