@@ -385,9 +385,9 @@ def _decls(lib):
         ver = int(lib.ist_abi_version())
     except AttributeError:
         ver = 1
-    if ver < 16:
+    if ver < 17:
         raise RuntimeError(
-            f"stale native library at {_LIB_PATH} (ABI v{ver} < v16): "
+            f"stale native library at {_LIB_PATH} (ABI v{ver} < v17): "
             "rebuild with `make -C native` (or delete the .so to let "
             "the import auto-build)"
         )

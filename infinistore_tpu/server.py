@@ -810,7 +810,8 @@ def _prometheus_metrics(stats, slo=None, aggregator=None):
                       ("queue_growth", "queue_trips"),
                       ("slo_burn", "slo_trips"),
                       ("thrash", "thrash_trips"),
-                      ("migration", "migration_trips")):
+                      ("migration", "migration_trips"),
+                      ("io_deadline", "io_deadline_trips")):
         lines.append(
             f'infinistore_watchdog_trips_total{{kind="{kind}"}} '
             f'{wd.get(key, 0)}'
@@ -822,6 +823,57 @@ def _prometheus_metrics(stats, slo=None, aggregator=None):
     lines.append("# TYPE infinistore_watchdog_bundles_total counter")
     lines.append(
         f'infinistore_watchdog_bundles_total {wd.get("bundles", 0)}'
+    )
+    # Background-IO scheduler (ABI v17+): per-class served/miss
+    # counters are the starvation dashboard — a moving
+    # promote-class deadline_misses series means interactive reads
+    # are waiting behind bulk background IO.
+    io = stats.get("iosched", {})
+    lines.append(
+        "# HELP infinistore_iosched_enabled background-IO scheduler "
+        "active (0 = ISTPU_IOSCHED=0 or pre-v17 native)"
+    )
+    lines.append("# TYPE infinistore_iosched_enabled gauge")
+    lines.append(f'infinistore_iosched_enabled {io.get("enabled", 0)}')
+    lines.append(
+        "# HELP infinistore_iosched_budget_mbps shared disk budget "
+        "(0 = unlimited, accounting only)"
+    )
+    lines.append("# TYPE infinistore_iosched_budget_mbps gauge")
+    lines.append(
+        f'infinistore_iosched_budget_mbps {io.get("budget_mbps", 0)}'
+    )
+    lines.append(
+        "# HELP infinistore_iosched_served_total scheduler grants "
+        "by deadline class"
+    )
+    lines.append("# TYPE infinistore_iosched_served_total counter")
+    for c in io.get("classes", []):
+        lines.append(
+            f'infinistore_iosched_served_total'
+            f'{{cls="{c.get("name", "?")}"}} {c.get("served", 0)}'
+        )
+    lines.append(
+        "# HELP infinistore_iosched_deadline_misses_total acquires "
+        "that proceeded past their class deadline bound"
+    )
+    lines.append(
+        "# TYPE infinistore_iosched_deadline_misses_total counter"
+    )
+    for c in io.get("classes", []):
+        lines.append(
+            f'infinistore_iosched_deadline_misses_total'
+            f'{{cls="{c.get("name", "?")}"}} '
+            f'{c.get("deadline_misses", 0)}'
+        )
+    lines.append(
+        "# HELP infinistore_iosched_decisions_total closed-loop "
+        "controller knob changes (iosched.decision events)"
+    )
+    lines.append("# TYPE infinistore_iosched_decisions_total counter")
+    lines.append(
+        f'infinistore_iosched_decisions_total '
+        f'{io.get("iosched_decisions", 0)}'
     )
     lines.append(
         "# HELP infinistore_events_recorded_total flight-recorder "

@@ -169,10 +169,19 @@ extern "C" {
 // entry points, stats gains the dedup section (logical vs physical
 // occupancy + measured capacity multiplier), history samples carry
 // dedup_hits_delta / dedup_bytes_saved_delta / logical_bytes /
-// dedup_saved_live.
+// dedup_saved_live; v17: unified background-IO scheduler — spill/
+// promote/prefetch/snapshot/migration IO flows through deadline-
+// classed admission (io_sched.h, env knobs ISTPU_IOSCHED /
+// ISTPU_IO_BUDGET_MBPS / ISTPU_IOSCHED_AUTOTUNE), stats gains the
+// iosched section (per-class depth/served/misses + budget tokens) and
+// watchdog.io_deadline_trips, history samples carry
+// iosched_served_delta / iosched_deadline_misses_delta /
+// iosched_decisions_delta, new iosched.decision /
+// watchdog.io_deadline catalog events, reclaim.pass_begin/end args
+// become headroom target/actual.
 // _native.py probes this at load so a stale prebuilt library fails
 // loudly instead of feeding unparseable blobs to the server.
-uint32_t ist_abi_version(void) { return 16; }
+uint32_t ist_abi_version(void) { return 17; }
 
 void ist_set_log_level(int level) { set_log_level(level); }
 void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }

@@ -91,7 +91,9 @@ namespace istpu {
     X(EV_CLUSTER_WRONG_EPOCH, "cluster.wrong_epoch", SEV_WARN)      \
     X(EV_WATCHDOG_DIVERGENCE, "watchdog.replica_divergence", SEV_ERROR) \
     X(EV_WATCHDOG_EPOCH_LAG, "watchdog.epoch_lag", SEV_ERROR)       \
-    X(EV_BUNDLE_CAPTURED, "watchdog.bundle", SEV_INFO)
+    X(EV_BUNDLE_CAPTURED, "watchdog.bundle", SEV_INFO)              \
+    X(EV_IOSCHED_DECISION, "iosched.decision", SEV_INFO)            \
+    X(EV_WATCHDOG_IO_DEADLINE, "watchdog.io_deadline", SEV_ERROR)
 
 enum EventSeverity : uint8_t {
     SEV_DEBUG = 0,

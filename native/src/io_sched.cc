@@ -1,0 +1,189 @@
+// io_sched.cc — unified background-IO scheduler (see io_sched.h).
+
+#include "io_sched.h"
+
+#include <algorithm>
+#include <chrono>
+
+#include "utils.h"
+
+namespace istpu {
+
+const char* io_class_name(int cls) {
+    switch (cls) {
+        case kIoPromote: return "promote";
+        case kIoPrefetch: return "prefetch";
+        case kIoMigration: return "migration";
+        case kIoSpill: return "spill";
+        case kIoSnapshot: return "snapshot";
+        default: return "?";
+    }
+}
+
+// Per-class deadline bounds. The promote bound is the contract the
+// starvation test pins: a demand promote waits at most this long for
+// budget no matter how deep the snapshot/spill backlog is. Spill gets
+// a tighter bound than snapshot because the reclaimer's watermark
+// math depends on spill progress; snapshot is pure bulk.
+static const uint64_t kDeadlineUs[kIoClasses] = {
+    10 * 1000,    // promote: 10 ms — demand path, strictly ahead
+    100 * 1000,   // prefetch: 100 ms
+    500 * 1000,   // migration: 500 ms
+    1000 * 1000,  // spill: 1 s
+    2000 * 1000,  // snapshot: 2 s — bulk, lowest priority
+};
+
+void IoScheduler::configure(bool enabled, uint64_t budget_mbps) {
+    {
+        ScopedLock lk(mu_);
+        // Start with a full one-second burst allowance so a backlog
+        // spike against an idle store is absorbed without misses.
+        tokens_ = int64_t(budget_mbps) * (1 << 20);
+        last_refill_us_ = now_us();
+    }
+    budget_mbps_.store(budget_mbps, std::memory_order_relaxed);
+    enabled_.store(enabled, std::memory_order_relaxed);
+    cv_.notify_all();
+}
+
+void IoScheduler::refill_locked(long long now) {
+    uint64_t mbps = budget_mbps_.load(std::memory_order_relaxed);
+    if (mbps == 0 || now <= last_refill_us_) {
+        last_refill_us_ = now;
+        return;
+    }
+    long long dt = now - last_refill_us_;
+    last_refill_us_ = now;
+    // bytes = MB/s * 2^20 * dt_us / 1e6; cap the bucket at one
+    // budget-second of burst.
+    int64_t add = int64_t(double(mbps) * double(1 << 20) *
+                          double(dt) / 1e6);
+    int64_t cap = int64_t(mbps) * (1 << 20);
+    tokens_ = std::min(tokens_ + add, cap);
+}
+
+bool IoScheduler::acquire(IoClass cls, uint64_t bytes) {
+    if (!enabled_.load(std::memory_order_relaxed)) return true;
+    long long t0 = now_us();
+    bool in_bound = true;
+    uint64_t mbps = budget_mbps_.load(std::memory_order_relaxed);
+    if (mbps != 0) {
+        UniqueLock lk(mu_);
+        waiting_[cls]++;
+        long long deadline = t0 + (long long)kDeadlineUs[cls];
+        for (;;) {
+            long long now = now_us();
+            refill_locked(now);
+            // Strict priority: a class may draw tokens only when no
+            // HIGHER class (lower enum value) is waiting.
+            bool preempted = false;
+            for (int c = 0; c < cls; ++c) {
+                if (waiting_[c] > 0) { preempted = true; break; }
+            }
+            if (!preempted && tokens_ >= int64_t(bytes)) {
+                tokens_ -= int64_t(bytes);
+                break;
+            }
+            if (now >= deadline) {
+                // Deadline miss: proceed anyway, bucket into deficit
+                // so the missed grant still pays its bandwidth back
+                // before lower classes run again.
+                tokens_ -= int64_t(bytes);
+                in_bound = false;
+                break;
+            }
+            // Sleep until refill could plausibly cover the shortfall
+            // (bounded by the deadline and a 10 ms re-check so a
+            // higher-class waiter clearing unblocks us promptly).
+            cv_.wait_for(lk, std::chrono::microseconds(std::min(
+                                 deadline - now, (long long)10000)));
+        }
+        waiting_[cls]--;
+        lk.unlock();
+        cv_.notify_all();
+    }
+    long long waited = now_us() - t0;
+    served_[cls].fetch_add(1, std::memory_order_relaxed);
+    bytes_[cls].fetch_add(bytes, std::memory_order_relaxed);
+    if (!in_bound) misses_[cls].fetch_add(1, std::memory_order_relaxed);
+    uint64_t prev = max_wait_us_[cls].load(std::memory_order_relaxed);
+    while (uint64_t(waited) > prev &&
+           !max_wait_us_[cls].compare_exchange_weak(
+               prev, uint64_t(waited), std::memory_order_relaxed)) {
+    }
+    if (cls == kIoSpill) {
+        // Spill byte-rate EWMA (alpha 1/4 per update) feeding the
+        // sized-to-backlog headroom target. Rate sample = bytes over
+        // the gap since the previous spill grant (floored at 1 ms so
+        // a burst of back-to-back grants cannot divide by ~zero).
+        long long mark =
+            spill_rate_mark_us_.exchange(now_us(),
+                                         std::memory_order_relaxed);
+        long long gap = std::max(now_us() - mark, (long long)1000);
+        if (mark != 0) {
+            uint64_t inst = uint64_t(double(bytes) * 1e6 / double(gap));
+            uint64_t ewma =
+                spill_ewma_bps_.load(std::memory_order_relaxed);
+            spill_ewma_bps_.store(ewma - ewma / 4 + inst / 4,
+                                  std::memory_order_relaxed);
+        }
+    }
+    return in_bound;
+}
+
+uint64_t IoScheduler::headroom_bytes(uint64_t total_bytes, double high,
+                                     double low) const {
+    uint64_t band = uint64_t(std::max(high - low, 0.0) *
+                             double(total_bytes));
+    if (!enabled_.load(std::memory_order_relaxed)) return band;
+    // Two seconds of the observed spill drain rate, clamped into the
+    // watermark band: heavy overflow reclaims the full band (today's
+    // behavior), light overflow frees only what the backlog needs —
+    // fewer premature evictions for the same safety margin.
+    uint64_t want =
+        2 * spill_ewma_bps_.load(std::memory_order_relaxed);
+    return std::max(std::min(want, band), band / 4);
+}
+
+IoScheduler::ClassStats IoScheduler::class_stats(int cls) const {
+    ClassStats s;
+    {
+        ScopedLock lk(mu_);
+        s.waiting = waiting_[cls];
+    }
+    s.served = served_[cls].load(std::memory_order_relaxed);
+    s.bytes = bytes_[cls].load(std::memory_order_relaxed);
+    s.deadline_misses = misses_[cls].load(std::memory_order_relaxed);
+    s.max_wait_us = max_wait_us_[cls].load(std::memory_order_relaxed);
+    return s;
+}
+
+uint64_t IoScheduler::served_total() const {
+    uint64_t n = 0;
+    for (int c = 0; c < kIoClasses; ++c)
+        n += served_[c].load(std::memory_order_relaxed);
+    return n;
+}
+
+uint64_t IoScheduler::deadline_misses_total() const {
+    uint64_t n = 0;
+    for (int c = 0; c < kIoClasses; ++c)
+        n += misses_[c].load(std::memory_order_relaxed);
+    return n;
+}
+
+uint64_t IoScheduler::promote_deadline_misses() const {
+    return misses_[kIoPromote].load(std::memory_order_relaxed);
+}
+
+int64_t IoScheduler::budget_tokens() const {
+    if (budget_mbps_.load(std::memory_order_relaxed) == 0) return 0;
+    ScopedLock lk(mu_);
+    return tokens_;
+}
+
+uint64_t IoScheduler::deadline_bound_us(int cls) const {
+    return (cls >= 0 && cls < kIoClasses) ? kDeadlineUs[cls] : 0;
+}
+
+}  // namespace istpu

@@ -1,0 +1,186 @@
+// io_sched.h — the unified background-IO scheduler: one prioritized
+// admission point for every disk-bound background byte the store
+// moves.
+//
+// Before this module the store ran four independent background IO
+// paths — the spill writer (PR 3), the promotion worker (PR 5), the
+// snapshot writer and the cluster tier's migration restore/adopt — each
+// with its own queue and admission rule, all competing blindly for the
+// same disk bandwidth. A snapshot could starve a demand promote; a
+// migration adopt could bury the spill writer the reclaimer was
+// waiting on. "The DMA Streaming Framework" (PAPERS.md) argues for
+// exactly this consolidation — orchestrate tier IO centrally under a
+// shared bandwidth budget rather than per-request-thread — and "RPC
+// Considered Harmful" motivates keeping demand-path work strictly
+// ahead of bulk transfer.
+//
+// Design:
+//
+//   - DEADLINE CLASSES, strict priority: demand promote > prefetch >
+//     migration > spill > snapshot. The existing worker threads stay;
+//     they become class-tagged consumers that call acquire(cls, bytes)
+//     immediately before their disk IO. When the shared budget is
+//     contended, tokens are granted to the highest-priority waiting
+//     class first.
+//   - SHARED TOKEN BUCKET: ISTPU_IO_BUDGET_MBPS megabytes/second of
+//     disk bandwidth across ALL background classes (0 = unlimited —
+//     acquire still class-accounts but never waits). Refill is
+//     computed on demand from the monotonic clock; burst capacity is
+//     one budget-second so an idle store can absorb a backlog spike
+//     without deadline misses.
+//   - DEADLINE BOUND, never a correctness gate: a waiter that cannot
+//     get tokens within its class bound proceeds ANYWAY (the bucket
+//     goes into deficit) and the class's deadline-miss counter trips —
+//     background IO is throttled, never wedged. The promote bound is
+//     three orders of magnitude tighter than the snapshot bound; the
+//     starvation test pins that a saturating snapshot+spill backlog
+//     cannot delay a demand promote past its bound.
+//   - SIZED-TO-BACKLOG HEADROOM: headroom_bytes() turns the observed
+//     spill-class byte rate (EWMA) into a reclaim headroom target, so
+//     the reclaimer frees what the backlog actually needs instead of
+//     bluntly evicting down to the low watermark every pass.
+//   - CLOSED LOOP: the controller tick (Server::iosched_tick, riding
+//     the watchdog thread) consumes queue depths, history deltas and
+//     the workload plane's thrash/WSS signals and retunes spill
+//     aggressiveness, promotion admission, prefetch depth and the
+//     reclaim watermarks through the scheduler-held knob atomics;
+//     every change is an `iosched.decision` flight-recorder event.
+//
+// Lock order: mu_ is kRankIoSched (240) — acquired by the snapshot
+// writer holding snap_mu_ (10), by the spill/promote/restore workers
+// holding nothing, and by the controller tick holding nothing. It is
+// never held across a disk IO or any other ranked acquisition.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+
+#include "lock_rank.h"
+#include "thread_annotations.h"
+
+namespace istpu {
+
+// Priority order IS the enum order: lower value = served first.
+enum IoClass : int {
+    kIoPromote = 0,   // demand promote (second-touch get, OP_PIN)
+    kIoPrefetch = 1,  // OP_PREFETCH-queued promotes
+    kIoMigration = 2, // snapshot restore / cluster range adopt
+    kIoSpill = 3,     // reclaim spill writes
+    kIoSnapshot = 4,  // snapshot file writes
+    kIoClasses = 5,
+};
+
+const char* io_class_name(int cls);
+
+// Controller knob ids (a0 of the iosched.decision event; a1 = the new
+// value in the unit noted). tools/istpu_top.py renders these names.
+enum IoKnob : int {
+    kKnobReclaimLow = 0,    // reclaim low watermark, milli-fraction
+    kKnobPromoteCap = 1,    // promotion admission cap, milli-fraction
+    kKnobPrefetchDepth = 2, // max queued prefetch-class promotes
+    kKnobSpillBatchMult = 3,// spill batch-size multiplier
+    kKnobs = 4,
+};
+
+class IoScheduler {
+   public:
+    IoScheduler() = default;
+    IoScheduler(const IoScheduler&) = delete;
+    IoScheduler& operator=(const IoScheduler&) = delete;
+
+    // Server start: arm (or disarm, the ISTPU_IOSCHED=0 bench
+    // denominator) and set the shared budget. Idempotent; resets the
+    // bucket so a fresh server in the same process starts full.
+    void configure(bool enabled, uint64_t budget_mbps);
+
+    bool enabled() const {
+        return enabled_.load(std::memory_order_relaxed);
+    }
+    uint64_t budget_mbps() const {
+        return budget_mbps_.load(std::memory_order_relaxed);
+    }
+
+    // The one admission point: block until `bytes` of budget are
+    // granted or the class deadline bound expires. Returns true when
+    // the grant landed inside the bound, false on a deadline miss (the
+    // caller proceeds either way — the miss is an observability fact,
+    // not a refusal). Strict priority: while any higher class is
+    // waiting, lower classes are not granted tokens. Disabled
+    // scheduler: immediate true, nothing counted.
+    bool acquire(IoClass cls, uint64_t bytes);
+
+    // Sized-to-backlog reclaim headroom target (bytes): what the next
+    // reclaim pass should free, derived from the spill-class byte-rate
+    // EWMA, clamped to [band/4, band] where band = (high-low)*total.
+    // Disabled scheduler: returns band (the blunt reclaim-to-low
+    // behavior, unchanged).
+    uint64_t headroom_bytes(uint64_t total_bytes, double high,
+                            double low) const;
+
+    // ---- per-class telemetry (stats "iosched" section, /metrics,
+    // history deltas, istpu_top panel).
+    struct ClassStats {
+        uint64_t waiting = 0;         // currently blocked in acquire()
+        uint64_t served = 0;          // grants (cumulative)
+        uint64_t bytes = 0;           // granted bytes (cumulative)
+        uint64_t deadline_misses = 0; // bound expiries (cumulative)
+        uint64_t max_wait_us = 0;     // worst grant wait ever seen
+    };
+    ClassStats class_stats(int cls) const;
+    uint64_t served_total() const;
+    uint64_t deadline_misses_total() const;
+    // Deadline misses on the demand-promote class only (the watchdog
+    // io_deadline verdict keys on the delta of this).
+    uint64_t promote_deadline_misses() const;
+    // Signed token balance (negative = deficit from deadline-expired
+    // grants); 0 budget reports 0.
+    int64_t budget_tokens() const;
+    uint64_t deadline_bound_us(int cls) const;
+
+    // ---- controller knob storage. The scheduler owns the atomics so
+    // every consumer (KVIndex, Promoter, the reclaim loop) reads one
+    // place and the controller writes one place; Server::iosched_tick
+    // emits the iosched.decision event on every change.
+    void set_knob(IoKnob k, uint64_t v) {
+        knobs_[k].store(v, std::memory_order_relaxed);
+    }
+    uint64_t knob(IoKnob k) const {
+        return knobs_[k].load(std::memory_order_relaxed);
+    }
+    uint64_t decisions() const {
+        return decisions_.load(std::memory_order_relaxed);
+    }
+    void count_decision() {
+        decisions_.fetch_add(1, std::memory_order_relaxed);
+    }
+
+   private:
+    // Refill the bucket from the monotonic clock; caller holds mu_.
+    void refill_locked(long long now) REQUIRES(mu_);
+
+    std::atomic<bool> enabled_{false};
+    std::atomic<uint64_t> budget_mbps_{0};
+
+    mutable Mutex mu_{kRankIoSched};
+    CondVar cv_;
+    // Token bucket in BYTES, signed: deadline-expired grants push it
+    // into deficit so a missed deadline still pays its bandwidth back
+    // before lower classes run again.
+    int64_t tokens_ GUARDED_BY(mu_) = 0;
+    long long last_refill_us_ GUARDED_BY(mu_) = 0;
+    uint64_t waiting_[kIoClasses] GUARDED_BY(mu_) = {};
+
+    std::atomic<uint64_t> served_[kIoClasses] = {};
+    std::atomic<uint64_t> bytes_[kIoClasses] = {};
+    std::atomic<uint64_t> misses_[kIoClasses] = {};
+    std::atomic<uint64_t> max_wait_us_[kIoClasses] = {};
+    // Spill-class byte rate EWMA (bytes/sec, updated on spill grants)
+    // feeding headroom_bytes().
+    std::atomic<uint64_t> spill_ewma_bps_{0};
+    std::atomic<long long> spill_rate_mark_us_{0};
+
+    std::atomic<uint64_t> knobs_[kKnobs] = {};
+    std::atomic<uint64_t> decisions_{0};
+};
+
+}  // namespace istpu

@@ -392,7 +392,8 @@ void KVIndex::prefetch(const std::vector<std::string>& keys, uint8_t* out) {
                    promoter_->alive()) {
             out[i] = 2;  // already on its way
         } else if (e.disk != nullptr &&
-                   maybe_enqueue_promote(st, e, it->first, si)) {
+                   maybe_enqueue_promote(st, e, it->first, si,
+                                         /*prefetch=*/true)) {
             // Explicit future-use signal: bypass second-touch.
             out[i] = 2;
         } else {
@@ -402,7 +403,8 @@ void KVIndex::prefetch(const std::vector<std::string>& keys, uint8_t* out) {
 }
 
 bool KVIndex::maybe_enqueue_promote(Stripe& st, Entry& e,
-                                    const std::string& key, uint32_t si) {
+                                    const std::string& key, uint32_t si,
+                                    bool prefetch) {
     (void)st;  // the lock fact (REQUIRES(st.mu)) is the parameter's job
     // alive(): a dead worker's queue must not keep accepting items —
     // every DiskRef queued there would pin its extent forever.
@@ -411,6 +413,16 @@ bool KVIndex::maybe_enqueue_promote(Stripe& st, Entry& e,
         return false;
     }
     if (!e.disk || e.promoting) return false;
+    // Prefetch-depth knob (controller-tuned): OP_PREFETCH kicks are
+    // speculative, so once the promote queue is this deep, further
+    // prefetches are refused (out[i]=3 — the get path still serves
+    // them from disk). Demand promotes are never depth-gated.
+    if (prefetch && io_sched_ != nullptr && io_sched_->enabled()) {
+        uint64_t depth = io_sched_->knob(kKnobPrefetchDepth);
+        if (depth != 0 && promoter_->queue_depth() >= depth) {
+            return false;
+        }
+    }
     if (!promoter_->may_admit(e.size)) {
         // PROMOTION PRESSURE: the pool rests anywhere in [low, high)
         // between reclaim passes, so headroom to the high watermark can
@@ -429,7 +441,8 @@ bool KVIndex::maybe_enqueue_promote(Stripe& st, Entry& e,
     e.promoting = true;
     promoter_->enqueue(PromoteItem{key, e.disk, e.size, si,
                                    Tracer::thread_trace_id(),
-                                   uint64_t(std::hash<std::string>{}(key))});
+                                   uint64_t(std::hash<std::string>{}(key)),
+                                   prefetch});
     return true;
 }
 
@@ -1568,13 +1581,53 @@ void KVIndex::reclaim_loop() {
             // that caused it.
             long long tpass = trace ? now_us() : 0;
             size_t pass_victims = 0;
-            size_t floor_bytes = size_t(low_ * double(total));
+            // Effective low watermark: the controller can lift it above
+            // the configured base (reclaim-low knob, milli-fraction)
+            // when premature evictions say the pool is churning.
+            double eff_low = low_;
+            if (io_sched_ != nullptr && io_sched_->enabled()) {
+                uint64_t milli = io_sched_->knob(kKnobReclaimLow);
+                if (milli != 0) {
+                    double k = double(milli) / 1000.0;
+                    if (k > low_ && k < high_) eff_low = k;
+                }
+            }
+            // Sized-to-backlog floor: instead of bluntly evicting down
+            // to LOW every pass, free only the headroom the observed
+            // spill drain rate says the backlog needs —
+            // floor = max(low*total, high*total - headroom). A null or
+            // disabled scheduler reports the full (high-low) band, so
+            // this degenerates to the historical reclaim-to-low.
+            size_t high_bytes = size_t(high_ * double(total));
+            size_t floor_lo = size_t(eff_low * double(total));
+            uint64_t headroom =
+                io_sched_ != nullptr
+                    ? io_sched_->headroom_bytes(total, high_, eff_low)
+                    : uint64_t(high_bytes - floor_lo);
+            size_t floor_bytes = uint64_t(high_bytes) > headroom
+                                     ? size_t(high_bytes - headroom)
+                                     : floor_lo;
+            if (floor_bytes < floor_lo) floor_bytes = floor_lo;
+            // Spill batch multiplier (controller knob): a deep backlog
+            // widens the per-round victim budget so the writer's
+            // extent-merge batching sees longer runs.
+            size_t eff_batch = batch_bytes;
+            if (io_sched_ != nullptr && io_sched_->enabled()) {
+                uint64_t mult = io_sched_->knob(kKnobSpillBatchMult);
+                if (mult > 8) mult = 8;
+                if (mult > 1) eff_batch = batch_bytes * size_t(mult);
+            }
             // Thread-bind the kick's id (consumed at wake, above):
             // spill items the pass enqueues (enqueue_spill reads the
             // thread id) inherit it, so the whole kick → scan → spill
             // chain carries one trace id.
             Tracer::set_thread_trace_id(pass_tid);
-            events_emit(EV_RECLAIM_PASS_BEGIN, mm_->used_bytes(), total);
+            // a0 = this pass's headroom TARGET (bytes to hold free
+            // below high), a1 = ACTUAL headroom at pass start.
+            size_t used_now = mm_->used_bytes();
+            events_emit(EV_RECLAIM_PASS_BEGIN, headroom,
+                        high_bytes > used_now ? high_bytes - used_now
+                                              : 0);
             // Victim-age cap for the WHOLE pass: entries touched — or
             // promotion-adopted — after this snapshot are off-limits,
             // so a reclaim-to-low pass can never race a fresh
@@ -1591,7 +1644,7 @@ void KVIndex::reclaim_loop() {
                     spill_inflight_bytes_.load(std::memory_order_relaxed);
                 if (used <= floor_bytes + inflight) break;
                 size_t want = used - floor_bytes - inflight;
-                if (want > batch_bytes) want = batch_bytes;
+                if (want > eff_batch) want = eff_batch;
                 long long tscan = trace ? now_us() : 0;
                 size_t victims = evict_internal(want, -1, true, pass_cap);
                 if (trace) {
@@ -1612,7 +1665,11 @@ void KVIndex::reclaim_loop() {
             }
             size_t used_after = mm_->used_bytes();
             Tracer::set_thread_trace_id(0);
-            events_emit(EV_RECLAIM_PASS_END, pass_victims, used_after);
+            // a0 = victims, a1 = ACTUAL headroom after the pass (pair
+            // with pass_begin's target to see how close reclaim came).
+            events_emit(EV_RECLAIM_PASS_END, pass_victims,
+                        high_bytes > used_after ? high_bytes - used_after
+                                                : 0);
             if (used_after <= floor_bytes) {
                 events_emit(EV_WATERMARK_LOW, used_after, total);
             }
@@ -1766,8 +1823,16 @@ void KVIndex::process_spill_batch(std::vector<SpillItem>& batch) {
         long long tw0 = trace ? now_us() : 0;
         uint32_t n = uint32_t(gj - gi + 1);
         std::vector<uint32_t> sizes(n);
+        uint64_t group_bytes = 0;
         for (uint32_t k = 0; k < n; ++k) {
             sizes[k] = batch[spans[gi + k].idx].size;
+            group_bytes += sizes[k];
+        }
+        // Spill-class budget for the whole merged write (io_sched.h):
+        // charged before the IO, outside all locks; the per-victim
+        // fallback below reuses the grant (same bytes either way).
+        if (io_sched_ != nullptr) {
+            io_sched_->acquire(kIoSpill, group_bytes);
         }
         std::vector<int64_t> sub(n, -1);
         const SpillItem& first = batch[spans[gi].idx];
@@ -1808,6 +1873,10 @@ void KVIndex::process_spill_batch(std::vector<SpillItem>& batch) {
             const SpillItem& it = batch[singles[i + k]];
             srcs[k] = it.block->loc.ptr;
             sizes[k] = it.size;
+        }
+        // Spill-class budget for the gather run (see above).
+        if (io_sched_ != nullptr) {
+            io_sched_->acquire(kIoSpill, total);
         }
         std::vector<int64_t> sub(n, -1);
         if (disk_->store_gather(srcs.data(), sizes.data(), n,

@@ -82,6 +82,7 @@
 #include "common.h"
 #include "disk_tier.h"
 #include "events.h"
+#include "io_sched.h"
 #include "lock_rank.h"
 #include "mempool.h"
 #include "promote.h"  // Block/BlockRef, DiskSpan/DiskRef, Promoter
@@ -184,6 +185,17 @@ class KVIndex {
     // Stop + join the background threads; queued spills are dropped
     // (their entries simply stay resident). Idempotent.
     void stop_background();
+
+    // Wire the server's background-IO scheduler in (before
+    // start_background). The index reads EFFECTIVE tuning through it —
+    // reclaim-low watermark, prefetch admission depth, spill batch
+    // multiplier, sized-to-backlog reclaim headroom — while high_/low_
+    // stay the configured bases. Null / disabled scheduler: historical
+    // behavior, bit for bit.
+    void set_io_scheduler(IoScheduler* s) {
+        io_sched_ = s;
+        if (promoter_) promoter_->set_io_scheduler(s);
+    }
 
     uint64_t epoch() const {
         return epoch_ ? epoch_->load(std::memory_order_relaxed) : 0;
@@ -744,9 +756,13 @@ class KVIndex {
     // Queue a disk-resident entry to the promotion worker if admission
     // (pool headroom vs the high watermark) allows. `st` is the
     // entry's stripe, held; the promote queue mutex is a leaf.
-    // True iff queued (the PROMOTING flag is set).
+    // `prefetch` tags the queued item with the prefetch IO class
+    // (OP_PREFETCH kicks) instead of demand-promote, and subjects it
+    // to the controller's prefetch-depth knob. True iff queued (the
+    // PROMOTING flag is set).
     bool maybe_enqueue_promote(Stripe& st, Entry& e,
-                               const std::string& key, uint32_t si)
+                               const std::string& key, uint32_t si,
+                               bool prefetch = false)
         REQUIRES(st.mu);
     // Worker-side adoption: re-locks the item's stripe and adopts
     // `block` only if the entry is unchanged (same DiskSpan, still
@@ -812,6 +828,10 @@ class KVIndex {
     std::atomic<long long> reclaim_heartbeat_us_{0};
     std::atomic<long long> spill_heartbeat_us_{0};
     double high_ = 0.0, low_ = 0.0;
+    // Background-IO scheduler (server-owned; null in bare-index tests).
+    // Spill-class admission, sized-to-backlog headroom and the
+    // controller knobs all route through it when enabled.
+    IoScheduler* io_sched_ = nullptr;
     std::thread reclaim_thread_;
     Mutex reclaim_mu_{kRankReclaim};
     CondVar reclaim_cv_;

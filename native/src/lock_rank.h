@@ -46,6 +46,10 @@
 //   220   Promoter::mu_                  a stripe (maybe_enqueue_promote)
 //   230   KVIndex::leases_mu_            store_mu_ (never a stripe: the
 //                                        server gathers refs first)
+//   240   IoScheduler::mu_               snap_mu_ (snapshot writer);
+//                                        nothing on the spill/promote/
+//                                        restore workers or the
+//                                        controller tick
 //   290   MM::extend_mu_                 nothing ranked (extension holds
 //                                        it WHILE allocating from the
 //                                        appended pool's arenas, so it
@@ -113,6 +117,13 @@ enum LockRank : int {
     kRankSpillQueue = 210,   // KVIndex::spill_mu_
     kRankPromoteQueue = 220, // Promoter::mu_
     kRankPinLeases = 230,    // KVIndex::leases_mu_
+    kRankIoSched = 240,      // IoScheduler::mu_ (token bucket + per-
+                             // class waiter state; acquired by the
+                             // class-tagged background workers with at
+                             // most snap_mu_ held (snapshot path) and
+                             // by the controller tick with nothing —
+                             // above every background queue leaf,
+                             // below the pool arenas it never touches)
     kRankPoolExtend = 290,   // MM::extend_mu_ (held across arena locks)
     kRankPoolArenaBase = 300,  // MemoryPool arena a -> base + a (a < 8)
     kRankDiskBitmap = 320,   // DiskTier::mu_
@@ -150,6 +161,7 @@ inline const char* rank_name(int r) {
         case kRankSpillQueue: return "spill-queue";
         case kRankPromoteQueue: return "promote-queue";
         case kRankPinLeases: return "pin-leases";
+        case kRankIoSched: return "io-sched";
         case kRankPoolExtend: return "pool-extend";
         case kRankDiskBitmap: return "disk-bitmap";
         case kRankTraceTracks: return "trace-tracks";

@@ -91,7 +91,15 @@ bool Promoter::may_admit(uint32_t size) const {
     uint64_t rounded = (uint64_t(size) + bs - 1) / bs * bs;
     uint64_t total = mm_->total_bytes();
     if (total == 0) return false;
-    uint64_t cap = uint64_t(cap_frac_ * double(total));
+    // cap_frac_ is the configured base; the IO-scheduler controller
+    // may tighten (premature evictions observed) or relax (spare
+    // headroom) admission at runtime through the promote-cap knob.
+    double cap_frac = cap_frac_;
+    if (sched_ != nullptr && sched_->enabled()) {
+        uint64_t milli = sched_->knob(kKnobPromoteCap);
+        if (milli != 0) cap_frac = double(milli) / 1000.0;
+    }
+    uint64_t cap = uint64_t(cap_frac * double(total));
     uint64_t claimed = inflight_bytes_.load(std::memory_order_relaxed);
     return mm_->used_bytes() + claimed + rounded <= cap;
 }
@@ -242,7 +250,25 @@ void Promoter::process_batch(std::vector<PromoteItem>& batch) {
     auto groups = merge_adjacent(spans, kMaxPromoteGroupBytes);
     std::vector<uint8_t> scratch;
     const bool trace = ring_ != nullptr;
+    // One budget acquisition per merged pread (io_sched.h), charged
+    // BEFORE the IO and outside all locks. A group is prefetch-class
+    // only when every item in it was queued by OP_PREFETCH — one
+    // demand item promotes the whole read to the demand class (its
+    // deadline bound is the one a waiting get actually feels).
+    auto acquire_io = [&](size_t gi, size_t gj) {
+        if (sched_ == nullptr) return;
+        uint64_t group_bytes = 0;
+        bool all_prefetch = true;
+        for (size_t k = gi; k <= gj; ++k) {
+            const PromoteItem& it = batch[spans[k].idx];
+            group_bytes += it.size;
+            if (!it.prefetch) all_prefetch = false;
+        }
+        sched_->acquire(all_prefetch ? kIoPrefetch : kIoPromote,
+                        group_bytes);
+    };
     for (auto [gi, gj] : groups) {
+        acquire_io(gi, gj);
         if (gi == gj) {
             promote_one(batch[spans[gi].idx], nullptr);
             continue;

@@ -48,6 +48,7 @@
 #include <vector>
 
 #include "disk_tier.h"
+#include "io_sched.h"
 #include "lock_rank.h"
 #include "mempool.h"
 #include "thread_annotations.h"
@@ -130,6 +131,10 @@ struct PromoteItem {
     // Tag lifetime: enqueue → finish_promote/drop (re-queues re-stamp).
     uint64_t trace_id = 0;
     uint64_t key_hash = 0;
+    // IO-class tag (io_sched.h): OP_PREFETCH kicks ride the prefetch
+    // class; everything else (second-touch get, OP_PIN) is a demand
+    // promote and gets the tight deadline bound.
+    bool prefetch = false;
 };
 
 class Promoter {
@@ -159,8 +164,14 @@ class Promoter {
     bool died() const { return died_.load(std::memory_order_relaxed); }
 
     // Pool-headroom admission check (no locks; callable under a stripe
-    // lock).
+    // lock). The cap is cap_frac_ unless the background-IO scheduler's
+    // controller has written a promote-cap knob (milli-fraction).
     bool may_admit(uint32_t size) const;
+
+    // Wire the server's background-IO scheduler in (before start()):
+    // the worker acquires promote/prefetch-class budget per merged
+    // read, and admission reads the controller's cap knob through it.
+    void set_io_scheduler(IoScheduler* s) { sched_ = s; }
 
     // Queue one promotion. Caller holds the item's stripe lock and has
     // already set the entry's PROMOTING flag; the queue mutex is a leaf.
@@ -203,6 +214,7 @@ class Promoter {
     DiskTier* disk_;
     Tracer* tracer_;
     TraceRing* ring_ = nullptr;
+    IoScheduler* sched_ = nullptr;
     double cap_frac_ = 1.0;
 
     std::atomic<bool> running_{false};

@@ -286,6 +286,34 @@ bool Server::start() {
     index_ = std::make_unique<KVIndex>(mm_.get(), cfg_.enable_eviction,
                                        disk_.get(), epoch_word(),
                                        tracer_.get());
+    // Unified background-IO scheduler (io_sched.h): env knobs resolved
+    // here and the scheduler wired into the index/promoter BEFORE the
+    // background threads spawn. ISTPU_IOSCHED=0 is the bench overhead
+    // denominator; ISTPU_IO_BUDGET_MBPS=0 (default) means unlimited
+    // bandwidth — classes are still accounted but never wait.
+    {
+        bool io_on = true;
+        if (const char* env = getenv("ISTPU_IOSCHED")) {
+            if (env[0] != '\0') io_on = env[0] == '1';
+        }
+        iosched_.configure(io_on, env_u64("ISTPU_IO_BUDGET_MBPS", 0));
+        iosched_autotune_ = io_on;
+        if (const char* env = getenv("ISTPU_IOSCHED_AUTOTUNE")) {
+            if (env[0] != '\0' && io_on) {
+                iosched_autotune_ = env[0] == '1';
+            }
+        }
+        // Knob bases seed from the configured watermarks so the first
+        // controller tick adjusts from reality, not from zero.
+        iosched_.set_knob(kKnobReclaimLow,
+                          uint64_t(cfg_.reclaim_low * 1000.0));
+        iosched_.set_knob(kKnobPromoteCap,
+                          uint64_t(cfg_.reclaim_high * 1000.0));
+        iosched_.set_knob(kKnobPrefetchDepth, 256);
+        iosched_.set_knob(kKnobSpillBatchMult, 1);
+        io_tick_prev_ = IoTickPrev{};
+        index_->set_io_scheduler(&iosched_);
+    }
     // Background reclaim pipeline (no-op unless eviction/spill is
     // configured and the watermarks enable it): puts should normally
     // find free blocks without ever paying reclaim inline. With a disk
@@ -567,7 +595,9 @@ bool Server::start() {
         // window instead of silently swallowing it into the baseline.
         history_sample();
     }
-    if (wd_enabled_ || hist_enabled_) {
+    // The controller tick rides the watchdog thread too, so autotune
+    // alone (verdicts and history both off) still gets its ~1 Hz loop.
+    if (wd_enabled_ || hist_enabled_ || iosched_autotune_) {
         wd_thread_ = std::thread([this] { watchdog_loop(); });
     }
     events_emit(EV_ENGINE_SELECTED,
@@ -729,6 +759,10 @@ long long Server::snapshot(const std::string& path, uint64_t ring_lo,
             }
             p = tmpbuf.data();
         }
+        // Snapshot-class budget (io_sched.h): lowest priority — a
+        // saturating snapshot must never delay a demand promote.
+        // snap_mu_ (rank 10) < kRankIoSched (240): in-order acquire.
+        iosched_.acquire(kIoSnapshot, it.size);
         uint32_t klen = uint32_t(it.key.size());
         fwrite(&klen, sizeof(klen), 1, f);
         fwrite(it.key.data(), 1, klen, f);
@@ -805,6 +839,11 @@ long long Server::restore(const std::string& path) {
             }
             if (entry_ok) {
                 data.resize(size);
+                // Migration-class budget (io_sched.h): restore/adopt is
+                // bulk ingest — above spill/snapshot (the cluster tier
+                // wants ranges moved), below demand promote/prefetch.
+                // No locks held here.
+                iosched_.acquire(kIoMigration, size);
                 entry_ok = size == 0 ||
                            fread(data.data(), 1, size, f) == size;
             }
@@ -1182,7 +1221,7 @@ std::string Server::stats_json() {
                                            "queue_growth", "slo_burn",
                                            "thrash", "migration",
                                            "replica_divergence",
-                                           "epoch_lag"};
+                                           "epoch_lag", "io_deadline"};
         int lk = wd_last_kind_.load(std::memory_order_relaxed);
         long long lt = wd_last_trip_us_.load(std::memory_order_relaxed);
         uint64_t trips = 0;
@@ -1194,7 +1233,7 @@ std::string Server::stats_json() {
             ScopedLock hlk(hist_mu_);
             hist_rec = hist_recorded_;
         }
-        char entry[1024];
+        char entry[1280];
         snprintf(
             entry, sizeof(entry),
             ", \"events\": {\"recorded\": %llu, \"overwritten\": %llu, "
@@ -1207,6 +1246,7 @@ std::string Server::stats_json() {
             "\"slo_trips\": %llu, \"thrash_trips\": %llu, "
             "\"migration_trips\": %llu, "
             "\"divergence_trips\": %llu, \"epoch_lag_trips\": %llu, "
+            "\"io_deadline_trips\": %llu, "
             "\"bundles\": %llu, \"last_trigger\": \"%s\", "
             "\"last_trip_age_us\": %lld}",
             (unsigned long long)events_recorded_total(),
@@ -1234,11 +1274,51 @@ std::string Server::stats_json() {
                 std::memory_order_relaxed),
             (unsigned long long)wd_trips_[kWdEpochLag].load(
                 std::memory_order_relaxed),
+            (unsigned long long)wd_trips_[kWdIoDeadline].load(
+                std::memory_order_relaxed),
             (unsigned long long)wd_bundles_.load(
                 std::memory_order_relaxed),
             (lk >= 0 && lk < kWdKinds) ? kKindNames[lk] : "",
             lt > 0 ? now_us() - lt : -1);
         out += entry;
+    }
+    {
+        // Background-IO scheduler (io_sched.h): one headline plus a
+        // per-class breakdown in priority order. budget_tokens is
+        // SIGNED — negative means deadline-expired grants put the
+        // bucket into deficit.
+        char head[384];
+        snprintf(head, sizeof(head),
+                 ", \"iosched\": {\"enabled\": %d, \"autotune\": %d, "
+                 "\"budget_mbps\": %llu, \"budget_tokens\": %lld, "
+                 "\"iosched_served\": %llu, "
+                 "\"iosched_deadline_misses\": %llu, "
+                 "\"iosched_decisions\": %llu, \"classes\": [",
+                 iosched_.enabled() ? 1 : 0, iosched_autotune_ ? 1 : 0,
+                 (unsigned long long)iosched_.budget_mbps(),
+                 (long long)iosched_.budget_tokens(),
+                 (unsigned long long)iosched_.served_total(),
+                 (unsigned long long)iosched_.deadline_misses_total(),
+                 (unsigned long long)iosched_.decisions());
+        out += head;
+        for (int c = 0; c < kIoClasses; ++c) {
+            IoScheduler::ClassStats cs = iosched_.class_stats(c);
+            char entry[320];
+            snprintf(entry, sizeof(entry),
+                     "%s{\"name\": \"%s\", \"depth\": %llu, "
+                     "\"served\": %llu, \"bytes\": %llu, "
+                     "\"deadline_misses\": %llu, \"max_wait_us\": %llu, "
+                     "\"deadline_bound_us\": %llu}",
+                     c == 0 ? "" : ", ", io_class_name(c),
+                     (unsigned long long)cs.waiting,
+                     (unsigned long long)cs.served,
+                     (unsigned long long)cs.bytes,
+                     (unsigned long long)cs.deadline_misses,
+                     (unsigned long long)cs.max_wait_us,
+                     (unsigned long long)iosched_.deadline_bound_us(c));
+            out += entry;
+        }
+        out += "]}";
     }
     if (index_ != nullptr) {
         // Content-addressed dedup (docs/design.md "Content-addressed
@@ -3054,6 +3134,9 @@ void Server::watchdog_loop() {
         lk.unlock();
         if (hist_enabled_) history_sample();
         if (wd_enabled_) watchdog_sample();
+        // Closed loop LAST: the controller consumes the tick's fresh
+        // history deltas and verdict state when retuning the knobs.
+        if (iosched_autotune_ && iosched_.enabled()) iosched_tick();
         lk.lock();
     }
 }
@@ -3106,6 +3189,11 @@ void Server::history_sample() {
             s.logical_bytes = index_->logical_bytes();
             s.dedup_saved_live = index_->dedup_saved_live();
         }
+        // Background-IO scheduler activity (grants, deadline misses,
+        // controller decisions).
+        uint64_t ios = iosched_.served_total();
+        uint64_t iom = iosched_.deadline_misses_total();
+        uint64_t iod = iosched_.decisions();
         uint64_t lat[LatHist::kBuckets] = {};
         uint64_t opc[kMaxOp] = {};
         for (int op = 1; op < kMaxOp; ++op) {
@@ -3129,6 +3217,10 @@ void Server::history_sample() {
             s.thrash_cycles_delta = thr - hist_prev_.thrash;
             s.dedup_hits_delta = dh - hist_prev_.dedup_hits;
             s.dedup_bytes_saved_delta = ds - hist_prev_.dedup_saved;
+            s.iosched_served_delta = ios - hist_prev_.iosched_served;
+            s.iosched_misses_delta = iom - hist_prev_.iosched_misses;
+            s.iosched_decisions_delta =
+                iod - hist_prev_.iosched_decisions;
             for (int b = 0; b < kNumBuckets; ++b) {
                 s.lat_delta[b] = lat[b] - hist_prev_.lat[b];
             }
@@ -3150,6 +3242,9 @@ void Server::history_sample() {
         hist_prev_.thrash = thr;
         hist_prev_.dedup_hits = dh;
         hist_prev_.dedup_saved = ds;
+        hist_prev_.iosched_served = ios;
+        hist_prev_.iosched_misses = iom;
+        hist_prev_.iosched_decisions = iod;
         memcpy(hist_prev_.lat, lat, sizeof(lat));
         memcpy(hist_prev_.op_count, opc, sizeof(opc));
         hist_prev_.valid = true;
@@ -3210,6 +3305,9 @@ std::string Server::history_json() {
             "\"dedup_hits_delta\": %llu, "
             "\"dedup_bytes_saved_delta\": %llu, "
             "\"logical_bytes\": %llu, \"dedup_saved_live\": %llu, "
+            "\"iosched_served_delta\": %llu, "
+            "\"iosched_deadline_misses_delta\": %llu, "
+            "\"iosched_decisions_delta\": %llu, "
             "\"cluster_epoch\": %llu, "
             "\"workers_dead\": %u, "
             "\"tier_breaker_open\": %u, \"stalled\": %u, "
@@ -3236,6 +3334,9 @@ std::string Server::history_json() {
             (unsigned long long)s.dedup_bytes_saved_delta,
             (unsigned long long)s.logical_bytes,
             (unsigned long long)s.dedup_saved_live,
+            (unsigned long long)s.iosched_served_delta,
+            (unsigned long long)s.iosched_misses_delta,
+            (unsigned long long)s.iosched_decisions_delta,
             (unsigned long long)s.cluster_epoch, s.workers_dead,
             unsigned(s.breaker), unsigned(s.stalled));
         out.append(buf, size_t(m));
@@ -3260,6 +3361,101 @@ std::string Server::history_json() {
                  (unsigned long long)hist_recorded_);
     out.append(buf, size_t(m));
     return out;
+}
+
+void Server::iosched_tick() {
+    // Closed-loop knob retune (~1 Hz, watchdog thread; docs/design.md
+    // "Background-IO scheduler"). Inputs are the same signals the
+    // watchdog and history sampler already consume — background queue
+    // depths, the workload plane's premature-eviction (thrash) rate,
+    // demand-class deadline misses. Every knob CHANGE is a flight-
+    // recorder decision event (a0 = IoKnob id, a1 = the new value), so
+    // a bundle shows exactly what the controller did and when. All
+    // moves are single bounded steps per tick: the loop converges by
+    // small corrections, never slams a knob across its range.
+    uint64_t spill_q = 0, premature = 0;
+    {
+        ScopedLock lk(store_mu_);  // pins index_ against stop()
+        if (index_ == nullptr) return;
+        spill_q = index_->spill_queue_depth();
+        premature = index_->workload().premature_evictions();
+    }
+    uint64_t misses = iosched_.promote_deadline_misses();
+    uint64_t prem_delta =
+        io_tick_prev_.valid && premature > io_tick_prev_.premature
+            ? premature - io_tick_prev_.premature
+            : 0;
+    uint64_t miss_delta =
+        io_tick_prev_.valid && misses > io_tick_prev_.promote_misses
+            ? misses - io_tick_prev_.promote_misses
+            : 0;
+    bool first = !io_tick_prev_.valid;
+    io_tick_prev_.premature = premature;
+    io_tick_prev_.promote_misses = misses;
+    io_tick_prev_.valid = true;
+    if (first) return;  // no deltas yet — observe one interval first
+
+    auto update = [&](IoKnob k, uint64_t v) {
+        if (iosched_.knob(k) == v) return;
+        iosched_.set_knob(k, v);
+        iosched_.count_decision();
+        events_emit(EV_IOSCHED_DECISION, uint64_t(k), v);
+    };
+    const uint64_t low_base = uint64_t(cfg_.reclaim_low * 1000.0);
+    const uint64_t high_milli = uint64_t(cfg_.reclaim_high * 1000.0);
+
+    // SPILL AGGRESSIVENESS: a deep spill backlog widens the per-round
+    // victim budget (longer extent-merge runs, fewer syscalls); a
+    // drained queue decays it back so idle stores keep small batches.
+    uint64_t mult = iosched_.knob(kKnobSpillBatchMult);
+    if (mult < 1) mult = 1;
+    if (spill_q > 128 && mult < 4) {
+        update(kKnobSpillBatchMult, mult + 1);
+    } else if (spill_q < 16 && mult > 1) {
+        update(kKnobSpillBatchMult, mult - 1);
+    }
+
+    // PREFETCH DEPTH: speculative reads are the first thing to shed
+    // when the demand class misses deadlines or the pool is churning
+    // (premature evictions); headroom grows it back multiplicatively.
+    uint64_t pd = iosched_.knob(kKnobPrefetchDepth);
+    if (pd == 0) pd = 256;
+    if (miss_delta > 0 || prem_delta >= wd_thrash_) {
+        uint64_t next = pd / 2;
+        update(kKnobPrefetchDepth, next < 16 ? 16 : next);
+    } else if (prem_delta == 0 && pd < 1024) {
+        uint64_t next = pd * 2;
+        update(kKnobPrefetchDepth, next > 1024 ? 1024 : next);
+    }
+
+    // PROMOTION ADMISSION: thrash means promotion and reclaim are
+    // cycling the same bytes — tighten the cap a step (floor midway
+    // between the watermarks); calm intervals relax it back toward
+    // the configured high-watermark base.
+    uint64_t cap = iosched_.knob(kKnobPromoteCap);
+    if (cap == 0) cap = high_milli;
+    uint64_t cap_floor = (low_base + high_milli) / 2;
+    if (prem_delta >= wd_thrash_ && cap > cap_floor) {
+        update(kKnobPromoteCap,
+               cap >= cap_floor + 10 ? cap - 10 : cap_floor);
+    } else if (prem_delta == 0 && cap < high_milli) {
+        update(kKnobPromoteCap,
+               cap + 10 > high_milli ? high_milli : cap + 10);
+    }
+
+    // RECLAIM LOW WATERMARK: premature evictions say reclaim digs too
+    // deep — lift the effective low a step (shallower passes keep the
+    // re-fetched keys resident); calm intervals decay it back to the
+    // configured base so a one-off burst does not pin the pool full.
+    uint64_t lo = iosched_.knob(kKnobReclaimLow);
+    if (lo == 0) lo = low_base;
+    uint64_t lo_ceil = high_milli > 20 ? high_milli - 20 : low_base;
+    if (prem_delta > 0 && lo < lo_ceil) {
+        update(kKnobReclaimLow, lo + 10 > lo_ceil ? lo_ceil : lo + 10);
+    } else if (prem_delta == 0 && lo > low_base) {
+        update(kKnobReclaimLow,
+               lo >= low_base + 10 ? lo - 10 : low_base);
+    }
 }
 
 bool Server::slo_trip(const std::string& detail, uint64_t a0,
@@ -3424,6 +3620,19 @@ void Server::watchdog_sample() {
     wd_thrash_streak_ = thrash_suspect ? wd_thrash_streak_ + 1 : 0;
     bool thrash_trip = wd_thrash_streak_ >= kThrashStreak;
 
+    // ---- io_deadline: demand-promote grants that blew their deadline
+    // bound this interval. The bound is the scheduler's hard contract
+    // (strict priority keeps the demand class ahead of any snapshot/
+    // spill backlog), so ANY miss delta is a verdict — no streak; the
+    // per-kind cooldown below still caps it at one trip per window,
+    // which is what the exactly-one-verdict test pins.
+    uint64_t io_misses = iosched_.promote_deadline_misses();
+    uint64_t io_miss_delta =
+        wd_prev_.valid && io_misses > wd_prev_.io_promote_misses
+            ? io_misses - wd_prev_.io_promote_misses
+            : 0;
+    bool io_deadline_trip = iosched_.enabled() && io_miss_delta > 0;
+
     wd_prev_.valid = true;
     wd_prev_.op_count = cur_count;
     memcpy(wd_prev_.op_buckets, cur, sizeof(cur));
@@ -3433,6 +3642,7 @@ void Server::watchdog_sample() {
     wd_prev_.promotes = promotes;
     wd_prev_.workers_dead = dead;
     wd_prev_.premature = premature;
+    wd_prev_.io_promote_misses = io_misses;
 
     // Per-kind cooldown gates BOTH the event and the bundle: a
     // persistent stall must not burn a bundle per interval. The
@@ -3486,6 +3696,16 @@ void Server::watchdog_sample() {
                      "): the reclaimer is evicting keys the workload "
                      "re-fetches");
         }
+    }
+    if (io_deadline_trip && cooled(kWdIoDeadline)) {
+        events_emit(EV_WATCHDOG_IO_DEADLINE, io_miss_delta, io_misses);
+        fire(kWdIoDeadline, "io_deadline",
+             std::to_string(io_miss_delta) +
+                 " demand-promote deadline misses this interval (bound " +
+                 std::to_string(iosched_.deadline_bound_us(kIoPromote)) +
+                 " us, total " + std::to_string(io_misses) +
+                 "): the IO budget is too small for the demand-path "
+                 "load");
     }
 }
 

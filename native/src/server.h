@@ -635,6 +635,26 @@ class Server {
     std::unique_ptr<DiskTier> disk_;
     std::unique_ptr<KVIndex> index_;
 
+    // Unified background-IO scheduler (io_sched.h): every disk-bound
+    // background byte — spill, promote, prefetch, snapshot, migration
+    // restore — acquires class-tagged budget through it. Owned here
+    // (outlives index_/disk_ teardown); wired into index_/promoter at
+    // start(). Env knobs resolved at start(): ISTPU_IOSCHED (default
+    // on), ISTPU_IO_BUDGET_MBPS (default 0 = unlimited),
+    // ISTPU_IOSCHED_AUTOTUNE (default on; needs the watchdog thread).
+    IoScheduler iosched_;
+    bool iosched_autotune_ = true;
+    // Controller tick (watchdog thread, ~1 Hz): closed-loop retune of
+    // the scheduler knobs from queue depths + workload-plane signals;
+    // every change emits iosched.decision.
+    void iosched_tick();
+    // Controller-thread-only memory (previous cumulative counters).
+    struct IoTickPrev {
+        uint64_t premature = 0;  // workload ghost-ring counter
+        uint64_t promote_misses = 0;  // demand-class deadline misses
+        bool valid = false;
+    } io_tick_prev_;
+
     // Store-epoch control page. With SHM enabled it lives in a shared
     // "<prefix>_ctl" object that clients map and poll locally (zero-RTT
     // pin-cache validation); otherwise it is private heap memory and
@@ -756,8 +776,13 @@ class Server {
         // native sampler.
         kWdDivergence = 6,
         kWdEpochLag = 7,
+        // Background-IO scheduler (io_sched.h): demand-promote grants
+        // blew their deadline bound this interval — the strict-
+        // priority contract is being violated in practice (budget far
+        // too small, or a bug). Native sampler, delta-triggered.
+        kWdIoDeadline = 8,
     };
-    static constexpr int kWdKinds = 8;
+    static constexpr int kWdKinds = 9;
     std::atomic<uint64_t> wd_trips_[kWdKinds] = {};
     std::atomic<int> wd_last_kind_{-1};
     std::atomic<long long> wd_last_trip_us_{0};
@@ -772,6 +797,7 @@ class Server {
         uint64_t spills = 0, promotes = 0;
         uint64_t workers_dead = 0;
         uint64_t premature = 0;  // workload ghost-ring counter
+        uint64_t io_promote_misses = 0;  // iosched demand-class misses
         bool valid = false;
     } wd_prev_;
     int wd_queue_streak_ = 0;
@@ -822,6 +848,8 @@ class Server {
         uint64_t used_bytes = 0, pool_bytes = 0;
         uint64_t kvmap = 0, conns = 0;
         uint64_t spill_q = 0, promote_q = 0;
+        uint64_t iosched_served_delta = 0, iosched_misses_delta = 0;
+        uint64_t iosched_decisions_delta = 0;
         uint64_t ops_delta = 0, bytes_in_delta = 0, bytes_out_delta = 0;
         uint64_t reads_busy_delta = 0, disk_io_errors_delta = 0;
         uint64_t hard_stalls_delta = 0, evictions_delta = 0;
@@ -866,6 +894,8 @@ class Server {
         uint64_t uring_sqes = 0;
         uint64_t premature = 0, thrash = 0;
         uint64_t dedup_hits = 0, dedup_saved = 0;
+        uint64_t iosched_served = 0, iosched_misses = 0;
+        uint64_t iosched_decisions = 0;
         uint64_t lat[LatHist::kBuckets] = {};
         uint64_t op_count[kMaxOp] = {};
         bool valid = false;

@@ -1,0 +1,83 @@
+"""Phase-shifting workload scenario (ISSUE 17 satellite).
+
+One deterministic op sequence shared by ``bench.py --iosched-leg`` and
+the iosched tests, modeling the traffic shape the background-IO
+scheduler exists for:
+
+  1. ``bulk_load``   — every key written once in insertion order: the
+     pool overfills past reclaim_high, so the spill/reclaim machinery
+     is saturated when phase 2 starts.
+  2. ``interactive`` — a Zipfian read trace (bench.zipf_trace, same
+     seeded generator as the workload-observability oracle): hot-key
+     gets that demand-promote against the spill backlog. This is the
+     phase whose p99 the scheduler protects.
+  3. ``scan``        — one sequential sweep over the whole key space:
+     a cold scan that floods prefetch/promote with low-value work and
+     hands the closed-loop controller something to throttle.
+
+The sequence is a pure function of (nkeys, interactive_len, alpha,
+seed), so two servers replaying it see byte-identical traffic —
+bench A/B legs and the deterministic starvation test replay EXACTLY
+the same ops.
+"""
+
+import importlib.util
+import os
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PHASES = ("bulk_load", "interactive", "scan")
+
+_bench = None
+
+
+def _bench_module():
+    """Load bench.py by path (tests/ is not a package and bench.py is
+    not importable as a module name) — the scenario is BUILT ON its
+    zipf_trace so both replay the identical seeded trace."""
+    global _bench
+    if _bench is None:
+        spec = importlib.util.spec_from_file_location(
+            "bench_for_scenario", os.path.join(REPO, "bench.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _bench = mod
+    return _bench
+
+
+def build_scenario(nkeys, interactive_len=None, alpha=0.9, seed=4242):
+    """Return the full op list: ``(phase, op, key_index)`` triples
+    where op is "put" (bulk_load) or "get" (interactive, scan)."""
+    if interactive_len is None:
+        interactive_len = 4 * nkeys
+    ops = [("bulk_load", "put", i) for i in range(nkeys)]
+    trace = _bench_module().zipf_trace(
+        nkeys, interactive_len, alpha=alpha, seed=seed)
+    ops.extend(("interactive", "get", k) for k in trace)
+    ops.extend(("scan", "get", i) for i in range(nkeys))
+    return ops
+
+
+def run_scenario(ops, put_fn, get_fn, clock=time.perf_counter):
+    """Replay the op list, timing every op. put_fn/get_fn take a key
+    INDEX (the caller owns key naming and payloads). Returns
+    ``{phase: [latency_seconds, ...]}`` in op order — callers take
+    p50/p99 per phase or sum for throughput."""
+    lats = {p: [] for p in PHASES}
+    for phase, op, idx in ops:
+        fn = put_fn if op == "put" else get_fn
+        t0 = clock()
+        fn(idx)
+        lats[phase].append(clock() - t0)
+    return lats
+
+
+def phase_percentile(lats, phase, pct):
+    """Percentile (in MICROSECONDS) of one phase's latencies, nearest-
+    rank — no numpy dependency so tests can call it on tiny lists."""
+    xs = sorted(lats.get(phase, []))
+    if not xs:
+        return 0.0
+    k = min(len(xs) - 1, max(0, int(round(pct / 100.0 * len(xs))) - 1))
+    return xs[k] * 1e6

@@ -481,6 +481,37 @@ def test_added_dedup_stats_key_fails_golden(tree):
     assert "'stats_keys' drifted" in r.stderr
 
 
+def test_iosched_decision_event_catalog_pin_bites(tree):
+    # ISSUE 17 seeded mutation: renaming the closed-loop controller's
+    # decision event at its emit site (server.cc iosched_tick) without
+    # touching the events.h catalog must fail BOTH drift directions —
+    # the new id is emitted but uncataloged, the old catalog row is
+    # stale — so "every autotune decision is a flight-recorder event"
+    # can never silently stop being true after a refactor.
+    mutate(tree, "native/src/server.cc",
+           "events_emit(EV_IOSCHED_DECISION,",
+           "events_emit(EV_IOSCHED_DECIDED,")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "EV_IOSCHED_DECIDED" in r.stderr  # emitted, uncataloged
+    assert "EV_IOSCHED_DECISION" in r.stderr  # stale catalog row
+    assert "stale catalog row" in r.stderr
+
+
+def test_iosched_stats_key_rename_fails(tree):
+    # ISSUE 17 seeded mutation: renaming the iosched section's served
+    # counter in stats_json must fail the golden's stats_keys pin in
+    # both directions at once (old key gone, new key unpinned) — the
+    # scheduler telemetry /metrics and istpu_top read must never
+    # silently go dark under a refactor.
+    mutate(tree, "native/src/server.cc",
+           '"\\"iosched_served\\": %llu, "',
+           '"\\"iosched_grants\\": %llu, "')
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "'stats_keys' drifted" in r.stderr
+
+
 def test_make_analyze_exits_zero():
     # With clang installed this is the -Wthread-safety -Werror proof
     # pass; without it the target reports the skip and still exits 0 —

@@ -139,6 +139,16 @@ def render_history(history, width=48):
     wss = [s.get("wss_bytes", 0) for s in samples]
     if any(wss):
         rows.append(("wss", wss, _fmt_bytes(wss[-1])))
+    # Background-IO scheduler rows (v17 samples; absent keys → skipped).
+    ios = [s.get("iosched_served_delta", 0) for s in samples]
+    if any(ios):
+        rows.append(("io served", ios, f"{ios[-1]}"))
+    iom = [s.get("iosched_deadline_misses_delta", 0) for s in samples]
+    if any(iom):
+        rows.append(("io misses", iom, f"{iom[-1]}"))
+    iod = [s.get("iosched_decisions_delta", 0) for s in samples]
+    if any(iod):
+        rows.append(("io tunes", iod, f"{iod[-1]}"))
     for label, series, last in rows:
         lines.append(f"  {label:<10}{_spark(series, width)} {last}")
     return lines
@@ -428,6 +438,31 @@ def render_frame(stats, debug, events, prev=None, dt=None, tail=10,
         f"{_fmt_age(stats.get('spill_heartbeat_age_us', -1))}/"
         f"{_fmt_age(stats.get('promote_heartbeat_age_us', -1))}"
     )
+
+    # Background-IO scheduler panel (ABI v17+; pre-v17 stats blobs
+    # simply lack the section and the panel is skipped).
+    io = stats.get("iosched", {})
+    if io.get("enabled"):
+        budget = io.get("budget_mbps", 0)
+        lines.append(
+            f"iosched: budget="
+            f"{f'{budget} MB/s' if budget else 'unlimited'}  "
+            f"autotune={'on' if io.get('autotune') else 'off'}  "
+            f"served={io.get('iosched_served', 0)}  "
+            f"misses={io.get('iosched_deadline_misses', 0)}  "
+            f"tunes={io.get('iosched_decisions', 0)}"
+        )
+        classes = io.get("classes", [])
+        if classes:
+            cells = []
+            for c in classes:
+                miss = c.get("deadline_misses", 0)
+                bang = f"!{miss}" if miss else ""
+                cells.append(
+                    f"{c.get('name', '?')}:{c.get('served', 0)}{bang}"
+                    f" w{_fmt_age(c.get('max_wait_us', 0))}"
+                )
+            lines.append("  " + "  ".join(cells))
 
     # Per-op latency table.
     op_stats = stats.get("op_stats", {})
