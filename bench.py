@@ -1827,6 +1827,278 @@ def bench_iosched(nkeys=None, block_kb=16, passes=5):
     return out
 
 
+def bench_conn_scale(block_kb=4):
+    """Connection-scale leg (ISSUE 18 acceptance: one store shard holds
+    the target concurrent connections with bounded memory and a flat
+    accept/wakeup path — RSS per idle conn <= 64 KB, active p99 at max
+    conns within 1.3x of the 100-conn baseline, one-sided puts still
+    riding the fabric ring under full idle-conn load).
+
+    Shape: one fabric server (2 workers), 4 ACTIVE fabric clients
+    replaying the tests/scenario.py deterministic phase trace
+    round-robin, plus a ramp of IDLE raw TCP connections 100 -> target
+    (ISTPU_CONN_SCALE_TARGET, default 2000; auto-clamped to the
+    process FD rlimit after a best-effort raise to the hard limit —
+    both socket ends live in THIS process, so each idle conn costs two
+    fds). Accept cost is timed per ramp burst and confirmed against
+    the server's accepts_total (connect() returns on the kernel
+    handshake, long before the worker accept4s). During the max-conns
+    latency pass a churn thread close/reconnects idle sockets so the
+    p99 is measured under accept+close pressure, not a static fd set.
+
+    Emits:
+      conn_scale_target / conn_scale_max_conns    ramp goal vs reached
+      conn_scale_accepts_per_sec                  whole-ramp rate
+      conn_scale_{p50,p99}_us_base                4 actives + 100 conns
+      conn_scale_{p50,p99}_us_max                 ... + target conns
+      conn_scale_p99_ratio                        max/base (accept 1.3)
+      conn_scale_rss_per_idle_conn_bytes          RSS delta / idle conns
+      conn_scale_bytes_per_conn                   server staging-buffer
+                                                  accounting at peak
+      conn_scale_ring_hit_rate                    attaches vs pool-full
+                                                  denials
+      conn_scale_one_sided_puts / conn_scale_active_puts
+      conn_scale_churn_cycles                     close/reconnects paid
+                                                  by the max-conns pass
+    """
+    import os
+    import resource
+    import socket
+    import threading
+
+    import numpy as np
+
+    from infinistore_tpu import (
+        TYPE_SHM,
+        ClientConfig,
+        InfiniStoreServer,
+        InfinityConnection,
+        ServerConfig,
+        TYPE_STREAM,
+    )
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    try:
+        import scenario
+    finally:
+        sys.path.pop(0)
+
+    # FD-rlimit auto-scale: raise soft to hard (best-effort), then clamp
+    # the ramp target to the headroom. Idle conns cost TWO fds here
+    # (client socket + in-process server's accepted socket) plus the
+    # process's own baseline (pool files, shm rings, python).
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < hard:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+            soft = hard
+        except (ValueError, OSError):
+            pass
+    want = int(os.environ.get("ISTPU_CONN_SCALE_TARGET", "2000"))
+    headroom = (soft - 256) // 2
+    target = max(100, min(want, headroom))
+    nkeys = int(os.environ.get("ISTPU_CONN_SCALE_KEYS", "128"))
+    block_bytes = block_kb << 10
+    n_active = 4
+    src = np.random.default_rng(23).integers(
+        0, 255, (nkeys, block_bytes), dtype=np.uint8
+    )
+    dst = np.zeros(block_bytes, dtype=np.uint8)
+    out = {
+        "conn_scale_target": target,
+        "conn_scale_fd_soft_limit": soft,
+        "conn_scale_nkeys": nkeys,
+    }
+
+    srv = InfiniStoreServer(
+        ServerConfig(
+            service_port=0,
+            engine="fabric",
+            workers=2,
+            # Leased fabric writers carve multi-MB regions per client
+            # up front — size the pool for the carves, not the keys
+            # (4 MB pools OOM the first leased put at 4 clients).
+            prealloc_size=max(4 * nkeys * block_bytes,
+                              1 << 28) / (1 << 30),
+            minimal_allocate_size=block_kb,
+        )
+    )
+    port = srv.start()
+    idle = []
+    actives = []
+    try:
+        fabric_ok = srv.stats().get("engine") == "fabric"
+        out["conn_scale_engine"] = srv.stats().get("engine")
+        for _ in range(n_active):
+            conn = InfinityConnection(ClientConfig(
+                host_addr="127.0.0.1", service_port=port,
+                connection_type=TYPE_SHM if fabric_ok else TYPE_STREAM,
+                use_lease=True, use_fabric=fabric_ok,
+            ))
+            conn.connect()
+            actives.append(conn)
+
+        def rss_bytes():
+            with open("/proc/self/status") as f:
+                for ln in f:
+                    if ln.startswith("VmRSS:"):
+                        return int(ln.split()[1]) << 10
+            return 0
+
+        def accepts_total():
+            return int(srv.stats().get("accepts_total", 0))
+
+        def open_idle(n):
+            """Open n idle raw conns; return the accept-confirmed burst
+            seconds (the accept path's cost, not the connect()s')."""
+            expect = accepts_total() + n
+            t0 = time.perf_counter()
+            for _ in range(n):
+                s = socket.create_connection(
+                    ("127.0.0.1", port), timeout=30)
+                idle.append(s)
+            deadline = time.perf_counter() + 60.0
+            while (accepts_total() < expect
+                   and time.perf_counter() < deadline):
+                time.sleep(0.002)
+            return time.perf_counter() - t0
+
+        ops = scenario.build_scenario(nkeys, interactive_len=4 * nkeys)
+
+        def scenario_pass():
+            """Replay the trace round-robin over the active conns; all
+            actives share the key space (last write wins — identical
+            payload per key, so reads stay byte-stable)."""
+            k = [0]
+
+            def pick():
+                k[0] += 1
+                return actives[k[0] % n_active]
+
+            def put_sync(i):
+                # Per-op sync: fabric commits are async, and the next
+                # scenario op may read this key through a DIFFERENT
+                # active conn — the put must be durable before the op
+                # is scored done.
+                conn = pick()
+                conn.put_cache(src[i], [(f"cs{i}", 0)], block_bytes)
+                conn.sync()
+
+            lats = scenario.run_scenario(
+                ops,
+                put_sync,
+                lambda i: pick().read_cache(
+                    dst, [(f"cs{i}", 0)], block_bytes),
+            )
+            return {
+                "p50": scenario.phase_percentile(
+                    lats, "interactive", 50),
+                "p99": scenario.phase_percentile(
+                    lats, "interactive", 99),
+            }
+
+        # Baseline: 100 total conns (actives + idles), unmeasured
+        # warmup pass first so lease/ring attach and lazy buffer costs
+        # don't land in the baseline percentiles.
+        base_burst = open_idle(100 - n_active)
+        scenario_pass()
+        rss_base = rss_bytes()
+        base = scenario_pass()
+
+        # Ramp 100 -> target, doubling, timing each accept burst.
+        levels = [100]
+        while levels[-1] < target:
+            levels.append(min(target, levels[-1] * 2))
+        burst_s = base_burst
+        ramped = 100
+        for lvl in levels[1:]:
+            burst_s += open_idle(lvl - ramped)
+            ramped = lvl
+        n_idle = len(idle)
+        out["conn_scale_accepts_per_sec"] = round(
+            n_idle / burst_s if burst_s > 0 else 0.0, 1)
+        rss_max = rss_bytes()
+        out["conn_scale_rss_per_idle_conn_bytes"] = int(
+            max(0, rss_max - rss_base) / max(1, n_idle - 96))
+
+        st = srv.stats()
+        out["conn_scale_max_conns"] = int(st.get("connections", 0))
+        out["conn_scale_bytes_per_conn"] = int(
+            st.get("bytes_per_conn", 0))
+
+        # Max-conns latency pass under churn: a background thread
+        # close/reconnects idle sockets so accepts and hangups
+        # interleave with the measured ops (ISSUE 18: "p99 under
+        # churn"), then one churn-free settle check of the conn count.
+        stop = threading.Event()
+        cycles = [0]
+
+        def churn():
+            while not stop.is_set():
+                s = idle.pop(0)
+                try:
+                    s.close()
+                    idle.append(socket.create_connection(
+                        ("127.0.0.1", port), timeout=30))
+                except OSError:
+                    return
+                cycles[0] += 1
+                stop.wait(0.01)
+
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        try:
+            peak = scenario_pass()
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        out["conn_scale_churn_cycles"] = cycles[0]
+        out.update({
+            "conn_scale_p50_us_base": round(base["p50"], 1),
+            "conn_scale_p99_us_base": round(base["p99"], 1),
+            "conn_scale_p50_us_max": round(peak["p50"], 1),
+            "conn_scale_p99_us_max": round(peak["p99"], 1),
+            "conn_scale_p99_ratio": round(
+                peak["p99"] / base["p99"] if base["p99"] else 0.0, 3),
+        })
+
+        # Ring-pool economics at peak: every active writer should have
+        # kept its ring (4 writers vs a 64-ring default pool), so the
+        # hit rate is attaches / (attaches + pool-full denials) and the
+        # one-sided counter tracks ring-path DATA puts. Only the first
+        # scenario pass moves payload bytes — repeat puts of the same
+        # key/payload dedup into zero-byte hash-first commits, which
+        # post no ring record — so the ring-writer pin is
+        # one_sided_puts >= active_puts (= nkeys distinct payloads).
+        st = srv.stats()
+        att = int(st.get("fabric_attaches", 0))
+        den = int(st.get("fabric_ring_attach_denied", 0))
+        out.update({
+            "conn_scale_ring_hit_rate": round(
+                att / (att + den) if (att + den) else 1.0, 3),
+            "conn_scale_ring_detaches": int(
+                st.get("fabric_ring_detaches", 0)),
+            "conn_scale_one_sided_puts": int(
+                st.get("fabric_one_sided_puts", 0)),
+            "conn_scale_active_puts": nkeys,
+            "conn_scale_conns_shed": int(st.get("conns_shed", 0)),
+        })
+    finally:
+        for s in idle:
+            try:
+                s.close()
+            except OSError:
+                pass
+        for conn in actives:
+            try:
+                conn.close()
+            except Exception:
+                pass
+        srv.stop()
+    return out
+
+
 def bench_sharded(n_shards=4, nkeys=4096, block_kb=4, workers=1,
                   io_threads=None, passes=2):
     """Sharded-store leg (BASELINE config 5 scaled to one host): the same
@@ -2650,7 +2922,16 @@ def run_probe_once(runner):
     (probe_skip_cached: true), the cap honors ISTPU_PROBE_TIMEOUT
     (default 60 s — a healthy probe finishes in single-digit seconds),
     and the full error text appears exactly once (per-leg skip markers
-    reference it instead of duplicating it)."""
+    reference it instead of duplicating it).
+
+    A FAILED first attempt is retried exactly once before the failure
+    is believed (ISSUE 18 satellite): the observed probe loss modes
+    include one-off init flakes (a slow first device open inside a
+    tight cap) that a single retry clears, and a false "wedged"
+    diagnosis costs every device leg in the run. The artifact records
+    probe_retries (0 = first try decided, 1 = retry ran) so a flaky-
+    but-healing tunnel is visible across runs. Budget-skipped probes
+    (no outcome) are neither retried nor cached."""
     global _PROBE_CACHE
     if _PROBE_CACHE is None:
         import os
@@ -2662,7 +2943,19 @@ def run_probe_once(runner):
             _PROBE_CACHE = cached
             return _PROBE_CACHE
         cap = float(os.environ.get("ISTPU_PROBE_TIMEOUT", "60"))
-        _PROBE_CACHE = runner("--probe-leg", "probe_error", cap)
+        res = runner("--probe-leg", "probe_error", cap)
+        retries = 0
+        if _probe_failed(res):
+            retries = 1
+            retry = runner("--probe-leg", "probe_error", cap)
+            # A budget-skipped retry (no outcome) must not overwrite
+            # the first attempt's real diagnosis.
+            if retry.get("probe_ok") or _probe_failed(retry):
+                res = retry
+        if "probe_skipped" not in res:
+            res = dict(res)
+            res["probe_retries"] = retries
+        _PROBE_CACHE = res
         _store_probe_result(_PROBE_CACHE)
     return _PROBE_CACHE
 
@@ -3981,6 +4274,18 @@ def main():
         except Exception as e:
             print(json.dumps({"iosched_error": str(e)[:200]}))
         return 0
+    if "--conn-scale-leg" in sys.argv:
+        # Connection-scale leg (ISSUE 18 acceptance: RSS per idle conn
+        # <= 64 KB, max-conns p99 within 1.3x of the 100-conn base,
+        # one-sided puts still on the ring at full idle load); boots
+        # its own server, port argument accepted but unused.
+        # ISTPU_CONN_SCALE_TARGET shrinks the ramp for the test fast
+        # path; the FD rlimit clamps it on constrained hosts.
+        try:
+            print(json.dumps(bench_conn_scale()))
+        except Exception as e:
+            print(json.dumps({"conn_scale_error": str(e)[:200]}))
+        return 0
     if "--engine-ab-leg" in sys.argv:
         # Transport-engine epoll vs uring A/B (ISSUE 8; distinct from
         # --engine-leg, the TPU serving-engine leg). Boots its own
@@ -4212,6 +4517,20 @@ def main():
                 out.update(bench_iosched())
         except Exception as e:
             out["iosched_error"] = str(e)[:200]
+        publish()
+        # Connection-scale leg (ISSUE 18 acceptance: RSS/idle-conn <=
+        # 64 KB, max-conns p99 <= 1.3x the 100-conn base, ring-path
+        # puts intact at full idle load). CPU-only, own server,
+        # budget-aware like the workload/dedup/iosched legs.
+        try:
+            if remaining() < 120:
+                out["conn_scale_skipped"] = (
+                    f"budget exhausted ({remaining():.0f}s left)"
+                )
+            else:
+                out.update(bench_conn_scale())
+        except Exception as e:
+            out["conn_scale_error"] = str(e)[:200]
         publish()
         # Sharded leg is CPU-only: run it BEFORE any tunnel-bound leg so
         # a wedged tunnel can never cost it (it boots its own servers;

@@ -284,6 +284,12 @@ def _decls(lib):
             [c.c_void_p, c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
              c.POINTER(c.c_uint64), c.POINTER(c.c_int)],
         ),
+        # ring-pool lifecycle (ABI v18): detaches / re-attaches
+        (
+            "ist_conn_fabric_ring_stats",
+            None,
+            [c.c_void_p, c.POINTER(c.c_uint64), c.POINTER(c.c_uint64)],
+        ),
         # content-addressed dedup (ABI v16): hash-first two-phase put
         (
             "ist_put_hash",
@@ -353,7 +359,8 @@ def _decls(lib):
         ("ist_mm_total_bytes", c.c_uint64, [c.c_void_p]),
         ("ist_mm_num_pools", c.c_uint64, [c.c_void_p]),
     ]
-    # ABI probe FIRST: a stale prebuilt library would lack the v16
+    # ABI probe FIRST: a stale prebuilt library would lack the v18
+    # ring-pool entry point (ist_conn_fabric_ring_stats), lack the v16
     # dedup entry points (ist_put_hash / ist_content_hash /
     # ist_conn_dedup_telemetry), misparse the v16 ist_conn_create
     # trailing use_dedup flag, lack the v15
@@ -385,9 +392,9 @@ def _decls(lib):
         ver = int(lib.ist_abi_version())
     except AttributeError:
         ver = 1
-    if ver < 17:
+    if ver < 18:
         raise RuntimeError(
-            f"stale native library at {_LIB_PATH} (ABI v{ver} < v17): "
+            f"stale native library at {_LIB_PATH} (ABI v{ver} < v18): "
             "rebuild with `make -C native` (or delete the .so to let "
             "the import auto-build)"
         )

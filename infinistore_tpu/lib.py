@@ -381,7 +381,7 @@ class InfinityConnection:
         # Fabric counters accumulated from retired handles (same
         # harvest-on-reconnect discipline as the pin-cache tallies):
         # ring_posts, doorbells, ring_fallbacks.
-        self._fabric_base = [0, 0, 0]
+        self._fabric_base = [0, 0, 0, 0, 0]
 
     # ------------------------------------------------------------------
     # connection lifecycle
@@ -747,6 +747,12 @@ class InfinityConnection:
         self._fabric_base[0] += int(posts.value)
         self._fabric_base[1] += int(bells.value)
         self._fabric_base[2] += int(falls.value)
+        det = ct.c_uint64(0)
+        rea = ct.c_uint64(0)
+        self._lib.ist_conn_fabric_ring_stats(
+            h, ct.byref(det), ct.byref(rea))
+        self._fabric_base[3] += int(det.value)
+        self._fabric_base[4] += int(rea.value)
 
     def client_stats(self):
         """Client-side telemetry: per-op latency histograms (power-of-
@@ -768,6 +774,8 @@ class InfinityConnection:
         bells = ct.c_uint64(0)
         falls = ct.c_uint64(0)
         modes = ct.c_int(0)
+        det = ct.c_uint64(0)
+        rea = ct.c_uint64(0)
         with self._reconnect_lock:
             if self._h and self._h not in self._dead_handles:
                 self._lib.ist_conn_telemetry(
@@ -776,6 +784,9 @@ class InfinityConnection:
                 self._lib.ist_conn_fabric_telemetry(
                     self._h, ct.byref(posts), ct.byref(bells),
                     ct.byref(falls), ct.byref(modes),
+                )
+                self._lib.ist_conn_fabric_ring_stats(
+                    self._h, ct.byref(det), ct.byref(rea)
                 )
             out["counters"]["pin_cache_hits"] = (
                 self._pin_cache_base[0] + int(hits.value)
@@ -795,6 +806,13 @@ class InfinityConnection:
                     self._fabric_base[2] + int(falls.value),
                 "ring_active": bool(modes.value & 1),
                 "stream_active": bool(modes.value & 2),
+                # Ring-pool lifecycle (ABI v18): server-initiated
+                # detaches (LRU reclaim under ISTPU_FABRIC_RING_POOL
+                # pressure) and successful re-attaches after one.
+                "ring_detaches":
+                    self._fabric_base[3] + int(det.value),
+                "ring_reattaches":
+                    self._fabric_base[4] + int(rea.value),
             }
             # Hash-first dedup probe verdicts (use_dedup, ABI v16):
             # HAVE = duplicate puts committed with zero payload bytes.

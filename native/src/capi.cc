@@ -181,7 +181,17 @@ extern "C" {
 // become headroom target/actual.
 // _native.py probes this at load so a stale prebuilt library fails
 // loudly instead of feeding unparseable blobs to the server.
-uint32_t ist_abi_version(void) { return 17; }
+//
+// v18 (connection-scale data plane): fabric commit rings become a
+// fixed pool (ISTPU_FABRIC_RING_POOL) with LRU reclaim of idle rings —
+// new ist_conn_fabric_ring_stats entry point (client-observed
+// detaches/re-attaches), stats gains accepts_total / conns_shed /
+// conn_buf_bytes / bytes_per_conn / fabric_ring_detaches /
+// fabric_ring_attach_denied / fabric_ring_pool, new conn.shed /
+// fabric.ring_detach catalog events, conn.accept / conn.shed
+// failpoints, and /debug/state caps its per-conn listing at
+// ISTPU_DEBUG_CONN_CAP with an aggregate for the remainder.
+uint32_t ist_abi_version(void) { return 18; }
 
 void ist_set_log_level(int level) { set_log_level(level); }
 void ist_log_msg(int level, const char* msg) { log_msg(level, msg); }
@@ -960,6 +970,21 @@ void ist_conn_fabric_telemetry(void* h, uint64_t* ring_posts,
     if (doorbells != nullptr) *doorbells = bells;
     if (ring_fallbacks != nullptr) *ring_fallbacks = falls;
     if (modes != nullptr) *modes = m;
+}
+
+// Ring-pool lifecycle telemetry (ABI v18): server-initiated ring
+// detaches this client observed (LRU reclaim under
+// ISTPU_FABRIC_RING_POOL pressure) and successful re-attaches after
+// one. A detached connection keeps working — commits ride TCP — so
+// these are the only client-visible trace of the reclaim.
+void ist_conn_fabric_ring_stats(void* h, uint64_t* detaches,
+                                uint64_t* reattaches) {
+    uint64_t det = 0, rea = 0;
+    if (h != nullptr) {
+        static_cast<Connection*>(h)->fabric_ring_stats(&det, &rea);
+    }
+    if (detaches != nullptr) *detaches = det;
+    if (reattaches != nullptr) *reattaches = rea;
 }
 
 // The wire-stable 128-bit content hash (utils.h content_hash128) —

@@ -6,6 +6,7 @@
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <sched.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/mman.h>
@@ -219,9 +220,11 @@ void CopyPool::run(std::vector<Seg> segs) {
     }
 }
 
-Connection::Connection(const ClientConfig& cfg) : cfg_(cfg) {
-    rdrain_.resize(1 << 20);
-}
+// rdrain_ is sized lazily at its first use (handle_readable's
+// beyond-the-plan branch): most connections never over-read a scatter
+// plan, and eagerly paying 1 MB per Connection here is exactly the
+// per-conn fixed cost the connection-scale work removes.
+Connection::Connection(const ClientConfig& cfg) : cfg_(cfg) {}
 
 Connection::~Connection() { close_conn(); }
 
@@ -391,6 +394,9 @@ void Connection::close_conn() {
         fab_hdr_ = nullptr;
         fab_map_bytes_ = 0;
     }
+    fab_detached_ = false;
+    fab_attach_inflight_ = false;
+    fab_reattach_backoff_ = 0;
     // Unmap pools AND the ctl page under pools_mu_: cached_read holds
     // that mutex across its pool copies and epoch loads, so a reader
     // mid-copy on another thread excludes this teardown (the same
@@ -986,6 +992,7 @@ void Connection::commit_batch_async(std::vector<uint8_t> body, DoneFn done) {
         // replay before the frame arrives off the socket — silent
         // cross-batch divergence of the mirrored cursor); they stay
         // on TCP until every fallback has its response.
+        maybe_request_ring();  // async re-attach after a pool reclaim
         const bool ring = fab_ring_.load(std::memory_order_relaxed);
         if (ring && fab_tcp_inflight_ == 0 && try_ring_post(*body_p, p)) {
             return;
@@ -1033,6 +1040,7 @@ void Connection::put_hash_async(std::vector<uint8_t> body, DoneFn done) {
             if (done) done(st, std::move(b));
             finish_op();
         };
+        maybe_request_ring();  // async re-attach after a pool reclaim
         const bool ring = fab_ring_.load(std::memory_order_relaxed);
         if (ring && fab_tcp_inflight_ == 0 &&
             try_ring_post(*body_p, p, /*hash_rec=*/true)) {
@@ -1547,6 +1555,14 @@ bool Connection::try_ring_post(std::vector<uint8_t>& body,
     // to report failed, and register a Pending that can never
     // complete (pending_ was already cleared) — wedging sync().
     if (broken_.load()) return false;
+    // Ring-pool detach, quiet half: the server flipped the ring to
+    // DETACHING (LRU reclaim under pool pressure) before this post
+    // started. Nothing of ours is in flight — drop the carcass mapping
+    // and take the TCP path; maybe_request_ring() re-attaches later.
+    if (h->state.load(std::memory_order_relaxed) != kFabricRingActive) {
+        handle_ring_detach();
+        return false;
+    }
     const uint64_t cap = h->data_cap;
     uint64_t seq = next_seq_++;
     // Record = u32 len + u64 client_seq + the OP_COMMIT_BATCH body
@@ -1595,6 +1611,39 @@ bool Connection::try_ring_post(std::vector<uint8_t>& body,
     // run-dry re-check sees this tail, or the load below sees
     // need_kick=1 and we kick it over TCP.
     h->tail.store(tail + need, std::memory_order_seq_cst);
+    // Ring-pool detach, racing half (fabric.h documents the Dekker):
+    // the seq_cst tail publish above against the server's seq_cst
+    // state store means exactly one of two worlds holds — either the
+    // server's final ordered drain sees our tail (record consumed),
+    // or we see state=DETACHING here and classify. Wait for the
+    // drain's completion flag, then read the FINAL head: past our
+    // record's end cursor means it was applied server-side (the TCP
+    // response for `seq` is coming — register pending and report
+    // posted); short of it means the record was never seen (give the
+    // seq back and let the caller resend the same body over TCP — no
+    // double-commit in either world).
+    if (h->state.load(std::memory_order_seq_cst) ==
+        kFabricRingDetaching) {
+        for (uint32_t spin = 0;
+             h->detach_done.load(std::memory_order_acquire) == 0;
+             ++spin) {
+            // The drain is a bounded in-memory walk; this only trips
+            // if the server died mid-detach, where the socket is
+            // about to break and fail this op anyway.
+            if (spin > (1u << 20)) break;
+            sched_yield();
+        }
+        const bool consumed =
+            h->head.load(std::memory_order_acquire) >= tail + need;
+        handle_ring_detach();
+        if (!consumed) {
+            next_seq_--;
+            return false;
+        }
+        fab_posts_.fetch_add(1, std::memory_order_relaxed);
+        pending_[seq] = std::move(pending);
+        return true;  // no doorbell: the drain already ran
+    }
     fab_posts_.fetch_add(1, std::memory_order_relaxed);
     pending_[seq] = std::move(pending);
     uint32_t armed = 1;
@@ -1607,6 +1656,70 @@ bool Connection::try_ring_post(std::vector<uint8_t>& body,
         enqueue_msg(OP_FABRIC_DOORBELL, {}, {}, std::move(bell));
     }
     return true;
+}
+
+void Connection::handle_ring_detach() {
+    if (fab_hdr_ == nullptr) return;
+    fab_ring_.store(false);
+    munmap(fab_hdr_, fab_map_bytes_);
+    fab_hdr_ = nullptr;
+    fab_map_bytes_ = 0;
+    fab_detached_ = true;
+    fab_reattach_backoff_ = 0;  // first re-attach ask is immediate
+    fab_detaches_.fetch_add(1, std::memory_order_relaxed);
+    IST_INFO("fabric ring detached by server (pool reclaim); "
+             "commits fall back to TCP");
+}
+
+void Connection::maybe_request_ring() {
+    if (fab_hdr_ != nullptr || !fab_detached_ || fab_attach_inflight_ ||
+        !shm_active_ || broken_.load()) {
+        return;
+    }
+    if (fab_reattach_backoff_ > 0) {
+        fab_reattach_backoff_--;
+        return;
+    }
+    fab_attach_inflight_ = true;
+    std::vector<uint8_t> body(4);
+    uint32_t want_ring = 1;
+    memcpy(body.data(), &want_ring, 4);
+    Pending p;
+    p.op = OP_FABRIC_ATTACH;
+    p.done = [this](uint32_t st, std::vector<uint8_t> b) {
+        // IO thread (completion context), like the fab_tcp_inflight_
+        // bookkeeping.
+        fab_attach_inflight_ = false;
+        // A denial (pool still saturated → active=0) backs off by
+        // post count, not time: under load the retry cadence scales
+        // with traffic, and an idle client stops asking entirely.
+        fab_reattach_backoff_ = 256;
+        if (st != OK) return;
+        BufReader r(b.data(), b.size());
+        uint32_t active = r.u32();
+        std::string name = r.str();
+        uint64_t bytes = r.u64();
+        if (!r.ok() || !active || name.empty() || bytes == 0) return;
+        int fd = shm_open(("/" + name).c_str(), O_RDWR, 0);
+        if (fd < 0) return;
+        size_t total = kFabricHdrBytes + size_t(bytes);
+        void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE,
+                         MAP_SHARED, fd, 0);
+        close(fd);
+        if (mem == MAP_FAILED) return;
+        auto* hdr = static_cast<FabricRingHdr*>(mem);
+        if (hdr->magic != FABRIC_MAGIC ||
+            hdr->version != FABRIC_VERSION || hdr->data_cap != bytes) {
+            munmap(mem, total);
+            return;
+        }
+        fab_hdr_ = hdr;
+        fab_map_bytes_ = total;
+        fab_reattaches_.fetch_add(1, std::memory_order_relaxed);
+        fab_ring_.store(true);
+        IST_INFO("fabric commit ring re-attached (%s)", name.c_str());
+    };
+    enqueue_msg(OP_FABRIC_ATTACH, std::move(body), {}, std::move(p));
 }
 
 uint32_t Connection::fabric_put(uint32_t block_size,
@@ -2002,6 +2115,7 @@ bool Connection::handle_readable() {
                     seg_off = 0;
                 }
                 if (niov == 0) {  // beyond the scatter plan: drain
+                    if (rdrain_.empty()) rdrain_.resize(1 << 20);
                     iov[0].iov_base = rdrain_.data();
                     iov[0].iov_len = rdrain_.size() > rpayload_left_
                                          ? size_t(rpayload_left_)

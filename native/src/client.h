@@ -250,6 +250,14 @@ class Connection {
         *doorbells = fab_doorbells_.load(std::memory_order_relaxed);
         *fallbacks = fab_fallbacks_.load(std::memory_order_relaxed);
     }
+    // Ring-pool lifecycle telemetry: server-initiated detaches this
+    // client observed (LRU reclaim under ISTPU_FABRIC_RING_POOL
+    // pressure) and successful ring re-attaches after one.
+    void fabric_ring_stats(uint64_t* detaches,
+                           uint64_t* reattaches) const {
+        *detaches = fab_detaches_.load(std::memory_order_relaxed);
+        *reattaches = fab_reattaches_.load(std::memory_order_relaxed);
+    }
 
     // --- content-addressed dedup probe (use_dedup) ---
     // Hash-first half of the two-phase put: `body` is the full
@@ -471,9 +479,22 @@ class Connection {
     // commit record.
     bool try_ring_post(std::vector<uint8_t>& body, Pending& pending,
                        bool hash_rec = false);
+    // Server-initiated ring detach observed (hdr state left ACTIVE):
+    // unmap the carcass, flip to the TCP commit path, remember to
+    // re-attach. IO thread only.
+    void handle_ring_detach();
+    // After a detach, ask the server for a fresh ring (async
+    // OP_FABRIC_ATTACH) at most one request in flight, with a
+    // post-count backoff after a denial so a saturated pool is not
+    // hammered. IO thread only.
+    void maybe_request_ring();
     FabricRingHdr* fab_hdr_ = nullptr;
     size_t fab_map_bytes_ = 0;
     std::atomic<bool> fab_ring_{false};
+    // --- ring-pool detach/re-attach state (IO-thread-only) ---
+    bool fab_detached_ = false;         // ever lost a ring to reclaim
+    bool fab_attach_inflight_ = false;  // re-attach RPC outstanding
+    uint32_t fab_reattach_backoff_ = 0;  // posts to skip before retry
     // TCP-fallback commits still in flight (IO-thread-only). While
     // nonzero the ring is NOT used: a record posted after a fallback
     // frame could be drained on the server's poll tick BEFORE the
@@ -485,6 +506,8 @@ class Connection {
     std::atomic<uint64_t> fab_posts_{0};
     std::atomic<uint64_t> fab_doorbells_{0};
     std::atomic<uint64_t> fab_fallbacks_{0};
+    std::atomic<uint64_t> fab_detaches_{0};
+    std::atomic<uint64_t> fab_reattaches_{0};
 
     // --- content-addressed dedup telemetry ---
     std::atomic<uint64_t> dedup_have_{0};

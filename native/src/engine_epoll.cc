@@ -155,7 +155,9 @@ void EngineEpoll::on_readable(Conn& c) {
                 IST_WARN("bad header from fd=%d, closing", c.fd);
                 return s_.close_conn(w_, c.fd);
             }
+            size_class_reserve(c.body, c.hdr.body_len);
             c.body.resize(c.hdr.body_len);
+            s_.account_conn_bufs(c);
             c.body_got = 0;
             c.state = RState::BODY;
             if (c.hdr.body_len == 0) {
@@ -210,6 +212,7 @@ void EngineEpoll::on_readable(Conn& c) {
             } else {  // DRAIN fully consumed
                 c.state = RState::HDR;
                 c.hdr_got = 0;
+                s_.diet_conn_bufs(c);
             }
         }
     }

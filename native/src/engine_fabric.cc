@@ -46,6 +46,7 @@
 #include <vector>
 
 #include "engine_epoll.h"
+#include "events.h"
 #include "fabric.h"
 #include "failpoint.h"
 #include "log.h"
@@ -72,6 +73,10 @@ struct FabConn {
     // the connection.
     uint64_t data_cap = 0;
     std::string shm_name;  // without the leading '/'
+    // LRU stamp for pool reclaim (ISSUE 18): the engine's activity
+    // sequence at this ring's last attach/drain. Worker-private, like
+    // everything else here.
+    uint64_t last_active_seq = 0;
 };
 
 }  // namespace
@@ -92,6 +97,13 @@ class EngineFabric final : public EngineEpoll {
             fabric_verbs_supported(&why);
             IST_INFO("fabric engine: %s", why.c_str());
         }
+        // Ring-pool quota (ISSUE 18): rings_ is worker-private (the
+        // engine threading contract — no locks anywhere here), so the
+        // global ISTPU_FABRIC_RING_POOL budget is split evenly across
+        // workers. Floor of 1 keeps a single active writer per worker
+        // functional even under a tiny pool.
+        ring_quota_ = s_.fabric_ring_pool_ / s_.workers();
+        if (ring_quota_ == 0) ring_quota_ = 1;
         return EngineEpoll::init();
     }
 
@@ -142,6 +154,18 @@ class EngineFabric final : public EngineEpoll {
             *data_bytes = fc->data_cap;  // server-side truth, not shm
             return true;
         }
+        // Pool admission (ISSUE 18): a ring costs ~1 MB of shm, so at
+        // 10k conns the old ring-per-conn design pinned ~10 GB. The
+        // pool caps resident rings at the per-worker quota; over
+        // quota, an idle ring is reclaimed (LRU among empty rings) —
+        // its conn falls back to TCP commits and may re-attach later.
+        // No idle victim means every ring has records in flight:
+        // deny, count it, and let the client stay on TCP.
+        if (rings_.size() >= ring_quota_ && !reclaim_idle_ring()) {
+            s_.fabric_ring_attach_denied_.fetch_add(
+                1, std::memory_order_relaxed);
+            return false;
+        }
         std::string name =
             s_.cfg_.shm_prefix + "_fab_" + std::to_string(c.id);
         size_t total = kFabricHdrBytes + size_t(kFabricDataBytes);
@@ -163,6 +187,9 @@ class EngineFabric final : public EngineEpoll {
         fc->hdr->version = FABRIC_VERSION;
         fc->hdr->data_cap = kFabricDataBytes;
         fc->hdr->magic = FABRIC_MAGIC;
+        fc->hdr->state.store(kFabricRingActive,
+                             std::memory_order_relaxed);
+        fc->last_active_seq = ++activity_seq_;
         c.eng = fc.get();
         *shm_name = name;
         *data_bytes = kFabricDataBytes;
@@ -186,10 +213,16 @@ class EngineFabric final : public EngineEpoll {
         FabricRingHdr* h = fc->hdr;
         const uint64_t cap = fc->data_cap;  // NEVER hdr->data_cap
         uint8_t* data = fabric_data(h);
+        fc->last_active_seq = ++activity_seq_;
         size_t applied = 0;
         for (;;) {
             uint64_t head = h->head.load(std::memory_order_relaxed);
-            uint64_t tail = h->tail.load(std::memory_order_acquire);
+            // seq_cst (free on x86) rather than acquire: the detach
+            // handshake is a Dekker between this load and the client's
+            // tail-publish / state-recheck pair — the final ordered
+            // drain under state=DETACHING must see any tail a client
+            // published while it still observed state=ACTIVE.
+            uint64_t tail = h->tail.load(std::memory_order_seq_cst);
             if (head == tail) {
                 // Ran dry: advertise sleep, then re-check the tail so
                 // a record published between the two can never be
@@ -263,8 +296,65 @@ class EngineFabric final : public EngineEpoll {
         }
     }
 
+    // Detach handshake, server side (fabric.h documents the client
+    // half). Order matters:
+    //   1. state=DETACHING (seq_cst) — the Dekker store paired with
+    //      the client's post-publish state recheck.
+    //   2. final ORDERED drain — consumes every record whose tail a
+    //      client published while it still saw state=ACTIVE, and
+    //      advances head past them so the client can classify any
+    //      racing record as consumed (head >= its end cursor) vs lost.
+    //   3. detach_done=1 (release) — the client's spin target; after
+    //      this the header words are final.
+    //   4. unmap + shm_unlink. The client's own mapping keeps the
+    //      pages alive until it munmaps; the name is gone so nothing
+    //      new can attach to the carcass.
+    // c.fabric stays TRUE (the conn keeps its lease/pin state and the
+    // commit protocol; only the ring transport is gone — commits ride
+    // TCP until a re-attach). c.eng=nullptr makes every ring hook
+    // (fabric_drain, pre-dispatch ordered drains) a no-op.
+    void detach_ring(FabConn& fc) {
+        Conn& c = *fc.conn;
+        fc.hdr->state.store(kFabricRingDetaching,
+                            std::memory_order_seq_cst);
+        fabric_drain(c, /*ordered=*/true);
+        fc.hdr->detach_done.store(1, std::memory_order_release);
+        s_.fabric_ring_detaches_.fetch_add(1,
+                                           std::memory_order_relaxed);
+        events_emit(EV_FABRIC_RING_DETACH, c.id, uint64_t(w_.idx));
+        c.eng = nullptr;
+        destroy_ring(fc);
+    }
+
+    // LRU reclaim: victim = the EMPTY ring (head==tail after the
+    // seq_cst fence in detach_ring would drain stragglers anyway,
+    // but empty-now is the cheap idleness signal) with the oldest
+    // activity stamp. Rings with records in flight are never chosen —
+    // reclaiming an active writer mid-batch would burn its ring
+    // bandwidth for nothing.
+    bool reclaim_idle_ring() {
+        uint64_t victim_id = 0;
+        uint64_t oldest = UINT64_MAX;
+        bool found = false;
+        for (auto& [id, fc] : rings_) {
+            if (ring_nonempty(*fc)) continue;
+            if (fc->last_active_seq < oldest) {
+                oldest = fc->last_active_seq;
+                victim_id = id;
+                found = true;
+            }
+        }
+        if (!found) return false;
+        auto it = rings_.find(victim_id);
+        detach_ring(*it->second);
+        rings_.erase(it);
+        return true;
+    }
+
     std::unordered_map<uint64_t, std::unique_ptr<FabConn>> rings_;
     std::vector<uint64_t> ids_;  // drain-loop snapshot scratch
+    uint64_t ring_quota_ = 1;    // per-worker share of the ring pool
+    uint64_t activity_seq_ = 0;  // monotonic LRU clock for rings
 };
 
 bool fabric_runtime_supported(std::string* why) {

@@ -128,6 +128,7 @@ constexpr uint8_t kOpSendZc = 47;     // IORING_OP_SEND_ZC      (6.0)
 constexpr uint8_t kOpSendmsgZc = 48;  // IORING_OP_SENDMSG_ZC   (6.1)
 constexpr uint16_t kRecvMultishot = 1u << 1;    // IORING_RECV_MULTISHOT
 constexpr uint16_t kRecvsendFixedBuf = 1u << 2; // IORING_RECVSEND_FIXED_BUF
+constexpr uint16_t kAcceptMultishot = 1u << 0;  // IORING_ACCEPT_MULTISHOT (5.19)
 constexpr uint32_t kCqeFBuffer = 1u << 0;       // IORING_CQE_F_BUFFER
 constexpr uint32_t kCqeFMore = 1u << 1;         // IORING_CQE_F_MORE
 constexpr uint32_t kCqeFNotif = 1u << 3;        // IORING_CQE_F_NOTIF
@@ -376,6 +377,7 @@ enum UdTag : uint64_t {
     kTagListen = 6,
     kTagTimeout = 7,
     kTagCancel = 8,
+    kTagMsAccept = 9, // multishot accept (CQE res = accepted fd)
 };
 constexpr uint64_t make_ud(uint64_t tag, uint64_t v) {
     return (tag << 56) | (v & ((1ull << 56) - 1));
@@ -483,6 +485,20 @@ class EngineUring final : public Engine {
         io_uring_sqe* e = sqe(IORING_OP_POLL_ADD, fd, ud);
         if (e != nullptr) e->poll_events = POLLIN;
     }
+    // Multishot accept (5.19+): ONE standing SQE yields a CQE per
+    // accepted socket (res = the new fd) until the kernel clears
+    // F_MORE — the 10k-conn accept path stops paying one POLL_ADD
+    // re-arm + accept4 syscall per connection. Support is not
+    // probeable (it rides the ioprio flag, not an opcode), so the
+    // first completion's -EINVAL demotes PERMANENTLY to the classic
+    // poll+accept4 path.
+    void arm_ms_accept() {
+        io_uring_sqe* e = sqe(IORING_OP_ACCEPT, w_.listen_fd,
+                              make_ud(kTagMsAccept, 0));
+        if (e == nullptr) return;
+        e->ioprio = kAcceptMultishot;
+        e->accept_flags = SOCK_NONBLOCK | SOCK_CLOEXEC;
+    }
     void arm_timeout() {
         ts_.tv_sec = 0;
         ts_.tv_nsec = 500ll * 1000 * 1000;  // the epoll_wait(500ms) twin
@@ -552,6 +568,11 @@ class EngineUring final : public Engine {
     bool zc_ok_ = false;       // IORING_OP_SEND_ZC
     bool zc_msg_ok_ = false;   // IORING_OP_SENDMSG_ZC
     bool ms_ok_ = false;       // multishot recv + provided-buffer ring
+    // Multishot accept: wanted (ISTPU_URING_MS_ACCEPT, default on,
+    // probed as "op ACCEPT supported" in init) and still believed to
+    // work (flipped off permanently by a runtime -EINVAL — the flag
+    // predates any probe surface).
+    bool ms_accept_ok_ = false;
     bool bufs_registered_ = false;
     struct RegBuf {
         uint8_t* base;
@@ -620,6 +641,14 @@ bool EngineUring::init() {
     // the pbuf-ring registration succeeding (5.19+) AND the ZC probe
     // (6.0+) so a 5.19-6.0 kernel never sees an EINVAL storm.
     ms_ok_ = want_ms && zc_ok_ && setup_pbuf_ring();
+    // Multishot accept (ISSUE 18): the flag is unprobeable (it rides
+    // ioprio, not an opcode), so attempt it whenever wanted — an old
+    // kernel answers the standing SQE with one -EINVAL CQE and the
+    // dispatch demotes permanently to the classic poll+accept4 path.
+    ms_accept_ok_ = true;
+    if (const char* env = getenv("ISTPU_URING_MS_ACCEPT")) {
+        ms_accept_ok_ = env[0] != '0';
+    }
     // NOTE: no SQE is armed (and nothing is submitted) here. init()
     // runs on the STARTING thread, and io_uring binds each request's
     // completion task-work to the task that submitted it — arming the
@@ -747,7 +776,11 @@ void EngineUring::poll() {
         armed_initial_ = true;
         arm_poll(w_.wake_fd, make_ud(kTagWake, 0));
         if (w_.listen_fd >= 0) {
-            arm_poll(w_.listen_fd, make_ud(kTagListen, 0));
+            if (ms_accept_ok_) {
+                arm_ms_accept();
+            } else {
+                arm_poll(w_.listen_fd, make_ud(kTagListen, 0));
+            }
         }
         arm_timeout();
     }
@@ -830,6 +863,31 @@ void EngineUring::dispatch(const io_uring_cqe& cqe) {
             s_.accept_ready(w_, w_.listen_fd);
             arm_poll(w_.listen_fd, make_ud(kTagListen, 0));
             return;
+        case kTagMsAccept: {
+            if (cqe.res >= 0) {
+                // One accepted socket per CQE (already NONBLOCK|CLOEXEC
+                // from accept_flags): straight into the shared adopt
+                // path — failpoints, cap/shed, Conn construction.
+                s_.adopt_accepted(w_, int(cqe.res));
+            } else if (cqe.res == -EINVAL) {
+                // Kernel without IORING_ACCEPT_MULTISHOT (or without
+                // OP_ACCEPT at all): permanent demotion to the classic
+                // poll+accept4 path.
+                if (ms_accept_ok_) {
+                    ms_accept_ok_ = false;
+                    IST_INFO("worker %d: multishot accept unsupported; "
+                             "using poll+accept4",
+                             w_.idx);
+                }
+                arm_poll(w_.listen_fd, make_ud(kTagListen, 0));
+                return;
+            }
+            // Transient errors (ECONNABORTED, EMFILE...) surface as a
+            // terminal CQE; re-arm the standing accept either way when
+            // the kernel stopped the multishot.
+            if ((cqe.flags & kCqeFMore) == 0) arm_ms_accept();
+            return;
+        }
         case kTagZc:
             on_zc(uint32_t(v), cqe);
             return;
@@ -1090,6 +1148,7 @@ void EngineUring::on_rx(UConn& u, const io_uring_cqe& cqe,
             } else {
                 c->state = RState::HDR;
                 c->hdr_got = 0;
+                s_.diet_conn_bufs(*c);
             }
         }
     } else {

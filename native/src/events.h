@@ -64,6 +64,7 @@ namespace istpu {
     X(EV_ENGINE_FALLBACK, "engine.fallback", SEV_WARN)              \
     X(EV_CONN_ACCEPT, "conn.accept", SEV_DEBUG)                     \
     X(EV_CONN_CLOSE, "conn.close", SEV_DEBUG)                       \
+    X(EV_CONN_SHED, "conn.shed", SEV_WARN)                          \
     X(EV_BREAKER_OPEN, "tier.breaker_open", SEV_ERROR)              \
     X(EV_BREAKER_CLOSE, "tier.breaker_close", SEV_INFO)             \
     X(EV_DISK_IO_ERROR, "tier.io_error", SEV_ERROR)                 \
@@ -75,6 +76,7 @@ namespace istpu {
     X(EV_HARD_STALL, "pool.hard_stall", SEV_WARN)                   \
     X(EV_LEASE_REVOKE, "lease.revoke", SEV_DEBUG)                   \
     X(EV_FABRIC_ATTACH, "fabric.attach", SEV_INFO)                  \
+    X(EV_FABRIC_RING_DETACH, "fabric.ring_detach", SEV_INFO)        \
     X(EV_FABRIC_DOORBELL_STALL, "fabric.doorbell_stall", SEV_WARN)  \
     X(EV_FABRIC_EPOCH_MISS, "fabric.epoch_miss", SEV_DEBUG)         \
     X(EV_PROMOTE_CANCEL, "promote.cancel", SEV_DEBUG)               \

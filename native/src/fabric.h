@@ -50,7 +50,7 @@
 namespace istpu {
 
 constexpr uint64_t FABRIC_MAGIC = 0x4241465550545349ULL;  // "ISTPUFAB"
-constexpr uint32_t FABRIC_VERSION = 2;  // v2: hash-first records
+constexpr uint32_t FABRIC_VERSION = 3;  // v3: pooled rings + detach words
 constexpr size_t kFabricHdrBytes = 4096;        // one page of cursors
 constexpr uint64_t kFabricDataBytes = 1u << 20;  // commit-record region
 // A producer that cannot fit `u32 len` + body before the region end
@@ -67,6 +67,31 @@ constexpr uint32_t kFabricWrapMark = 0xFFFFFFFFu;
 // corruption bounds checks, so real lengths stay < data_cap/2.
 constexpr uint32_t kFabricHashRecFlag = 0x80000000u;
 
+// Ring v3 (pooled rings, ISSUE 18): rings are a fixed-size POOL, not a
+// per-connection entitlement — an idle ring can be RECLAIMED for
+// another connection while the producer still holds its mapping. The
+// detach handshake mirrors the doorbell's Dekker shape so no posted
+// record is ever silently dropped:
+//
+//   server (reclaim): store state=DETACHING (seq_cst) → one final
+//     drain advancing `head` past everything already published →
+//     store detach_done=1 (release) → munmap + shm_unlink. The
+//     client's own mapping keeps the pages alive, so it can still
+//     read head/detach_done after the unlink.
+//   client (post):   check state==ACTIVE before writing the record;
+//     publish tail (seq_cst, unchanged); re-check state (seq_cst).
+//     If still ACTIVE, the server's final drain is guaranteed to have
+//     seen the tail (either order of the two seq_cst stores loses).
+//     If DETACHING, spin for detach_done, then compare `head` with
+//     the record's end cursor: consumed → await the TCP response as
+//     usual; not consumed → the record is LOST, erase the pending
+//     entry and resend via the TCP frame path (head tells the truth,
+//     so there is no double-commit).
+enum FabricRingState : uint32_t {
+    kFabricRingActive = 0,
+    kFabricRingDetaching = 1,
+};
+
 #pragma pack(push, 1)
 struct FabricRingHdr {
     uint64_t magic;
@@ -82,6 +107,11 @@ struct FabricRingHdr {
     // Doorbell arming word (protocol above).
     std::atomic<uint32_t> need_kick;
     uint32_t pad1;
+    // v3 pooled-ring detach words (handshake above). Both live in the
+    // header page so the producer's mapping still reads them after the
+    // consumer unlinks the shm object.
+    std::atomic<uint32_t> state;        // FabricRingState
+    std::atomic<uint32_t> detach_done;  // 1 once the final drain ran
 };
 #pragma pack(pop)
 static_assert(sizeof(FabricRingHdr) <= kFabricHdrBytes,

@@ -211,6 +211,7 @@ const char* const kCatalog[] = {
     "disk.reserve", "disk.pwrite", "disk.pwritev", "disk.pread",
     "pool.alloc",   "worker.reclaim", "worker.spill", "worker.promote",
     "sock.recv",    "sock.send",    "lease.commit",
+    "conn.accept",  "conn.shed",
     "engine.uring_setup", "engine.fabric_setup", "fabric.doorbell",
     "cluster.migrate_export", "cluster.migrate_adopt",
     "cluster.replica_read", "cluster.directory_push",

@@ -42,6 +42,14 @@
 //   worker.promote async promotion-worker thread dies (kill)
 //   sock.recv      worker-side socket read fails (connection drops)
 //   sock.send      worker-side socket write fails (connection drops)
+//   conn.accept    accept-time failure: the just-accepted socket is
+//                  closed before a Conn exists (a storm-time resource
+//                  failure — EMFILE, memory) so churn paths are
+//                  exercised without real fd exhaustion
+//   conn.shed      forces the per-worker connection-cap shed decision
+//                  regardless of occupancy: the new socket is closed
+//                  loudly with a conn.shed event, exactly the
+//                  over-cap path, at any connection count
 //   lease.commit   OP_COMMIT_BATCH replay fails server-side
 //   engine.uring_setup  io_uring probe fails at server start: forces
 //                  engine=auto onto the epoll fallback (and a forced

@@ -37,6 +37,12 @@ namespace {
 // the client's retry makes monotonic progress in bounded slices.
 constexpr uint64_t kMaxPromotesPerOp = 64;
 
+// Accepts drained per readiness event (accept_ready): bounds the time
+// one accept storm can hold a worker away from its established
+// connections. Level-triggered readiness re-fires until the backlog is
+// empty, so nothing is lost by stopping at the bound.
+constexpr int kAcceptBurst = 64;
+
 void set_nonblock(int fd) {
     int fl = fcntl(fd, F_GETFL, 0);
     fcntl(fd, F_SETFL, fl | O_NONBLOCK);
@@ -324,6 +330,22 @@ bool Server::start() {
 
     uint32_t nworkers = resolve_workers(cfg_.workers);
     cfg_.workers = nworkers;
+    // Connection-scale knobs (ISSUE 18), resolved HERE — before the
+    // listeners (backlog) and before engine construction (EngineFabric
+    // reads fabric_ring_pool_ in init). The kernel clamps the backlog
+    // to net.core.somaxconn itself; the bound below only keeps the
+    // int cast sane.
+    {
+        uint64_t bl = env_u64("ISTPU_LISTEN_BACKLOG", uint64_t(SOMAXCONN));
+        if (bl == 0) bl = uint64_t(SOMAXCONN);
+        if (bl > (1u << 20)) bl = 1u << 20;
+        listen_backlog_ = uint32_t(bl);
+        conn_cap_ = env_u64("ISTPU_CONN_CAP", 0);
+        debug_conn_cap_ = env_u64("ISTPU_DEBUG_CONN_CAP", 256);
+        if (debug_conn_cap_ == 0) debug_conn_cap_ = 256;
+        fabric_ring_pool_ = env_u64("ISTPU_FABRIC_RING_POOL", 64);
+        if (fabric_ring_pool_ == 0) fabric_ring_pool_ = 1;
+    }
     // SO_REUSEPORT acceptors: with several workers, each gets its own
     // listen socket bound to the same port so the KERNEL spreads
     // accepts and a new connection lands directly on its owning worker
@@ -353,7 +375,7 @@ bool Server::start() {
             return -1;
         }
         if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
-            listen(fd, 128) != 0) {
+            listen(fd, int(listen_backlog_)) != 0) {
             close(fd);
             return -1;
         }
@@ -1047,7 +1069,7 @@ std::string Server::stats_json() {
         eng_zc += w->eng_zc_sends.load(std::memory_order_relaxed);
         eng_nocopy += w->eng_copies_avoided.load(std::memory_order_relaxed);
     }
-    char head[4096];
+    char head[8192];
     snprintf(
         head, sizeof(head),
         "{\"kvmap_len\": %zu, \"inflight\": %zu, \"leases\": %zu, "
@@ -1059,6 +1081,11 @@ std::string Server::stats_json() {
         "\"fabric_attaches\": %llu, \"fabric_commit_records\": %llu, "
         "\"fabric_one_sided_puts\": %llu, \"fabric_doorbells\": %llu, "
         "\"fabric_writes\": %llu, "
+        "\"fabric_ring_detaches\": %llu, "
+        "\"fabric_ring_attach_denied\": %llu, "
+        "\"fabric_ring_pool\": %llu, "
+        "\"accepts_total\": %llu, \"conns_shed\": %llu, "
+        "\"conn_buf_bytes\": %llu, \"bytes_per_conn\": %llu, "
         "\"evictions\": %llu, \"spills\": %llu, "
         "\"promotes\": %llu, \"disk_bytes\": %llu, \"disk_used\": %llu, "
         "\"reclaim_runs\": %llu, \"hard_stalls\": %llu, "
@@ -1094,6 +1121,18 @@ std::string Server::stats_json() {
             std::memory_order_relaxed),
         (unsigned long long)fabric_writes_.load(
             std::memory_order_relaxed),
+        (unsigned long long)fabric_ring_detaches_.load(
+            std::memory_order_relaxed),
+        (unsigned long long)fabric_ring_attach_denied_.load(
+            std::memory_order_relaxed),
+        (unsigned long long)fabric_ring_pool_,
+        (unsigned long long)accepts_total_.load(std::memory_order_relaxed),
+        (unsigned long long)conns_shed_.load(std::memory_order_relaxed),
+        (unsigned long long)conn_buf_bytes_.load(std::memory_order_relaxed),
+        (unsigned long long)(conn_buf_bytes_.load(std::memory_order_relaxed) /
+                             (n_conns_.load(std::memory_order_relaxed) > 0
+                                  ? n_conns_.load(std::memory_order_relaxed)
+                                  : 1)),
         (unsigned long long)(index_ ? index_->evictions() : 0),
         (unsigned long long)(index_ ? index_->spills() : 0),
         (unsigned long long)(index_ ? index_->promotes() : 0),
@@ -1481,52 +1520,95 @@ void Server::adopt_pending(Worker& w) {
 }
 
 void Server::accept_ready(Worker& w, int ready_fd) {
-    while (true) {
+    // Bounded accept burst: level-triggered epoll (and the uring
+    // engine's re-armed POLL_ADD) re-fires while the backlog is
+    // non-empty, so draining a bounded batch per readiness event lets
+    // an accept storm interleave with established connections' IO
+    // instead of head-of-line blocking this worker for the whole
+    // backlog.
+    for (int burst = 0; burst < kAcceptBurst; ++burst) {
         int fd = accept4(ready_fd, nullptr, nullptr,
                          SOCK_NONBLOCK | SOCK_CLOEXEC);
         if (fd < 0) return;
-        tune_socket(fd);
-        // SO_REUSEPORT mode: the kernel already spread this connection
-        // to THIS worker's socket — adopt it locally, zero cross-thread
-        // hops. Fallback mode (worker 0 accepts everything): least-
-        // loaded assignment by live connection count; ties go to the
-        // lowest index, so workers=1 puts everything on worker 0
-        // exactly like the historical single loop.
-        Worker* target = &w;
-        if (!reuseport_) {
-            target = workers_[0].get();
-            for (auto& wk : workers_) {
-                if (wk->nconns.load(std::memory_order_relaxed) <
-                    target->nconns.load(std::memory_order_relaxed)) {
-                    target = wk.get();
-                }
+        adopt_accepted(w, fd);
+    }
+}
+
+void Server::adopt_accepted(Worker& w, int fd) {
+    accepts_total_.fetch_add(1, std::memory_order_relaxed);
+    // conn.accept: a storm-time resource failure (EMFILE, allocation)
+    // right after accept — the socket closes before a Conn exists, so
+    // churn handling is exercisable without real fd exhaustion.
+    if (IST_FAILPOINT("conn.accept")) {
+        close(fd);
+        return;
+    }
+    tune_socket(fd);
+    // SO_REUSEPORT mode: the kernel already spread this connection
+    // to THIS worker's socket — adopt it locally, zero cross-thread
+    // hops. Fallback mode (worker 0 accepts everything): least-
+    // loaded assignment by live connection count; ties go to the
+    // lowest index, so workers=1 puts everything on worker 0
+    // exactly like the historical single loop.
+    Worker* target = &w;
+    if (!reuseport_) {
+        target = workers_[0].get();
+        for (auto& wk : workers_) {
+            if (wk->nconns.load(std::memory_order_relaxed) <
+                target->nconns.load(std::memory_order_relaxed)) {
+                target = wk.get();
             }
         }
-        auto c = std::make_unique<Conn>();
-        c->fd = fd;
-        c->id = next_conn_id_.fetch_add(1, std::memory_order_relaxed);
-        c->w = target;
-        target->nconns.fetch_add(1, std::memory_order_relaxed);
-        n_conns_++;
-        events_emit(EV_CONN_ACCEPT, c->id, uint64_t(target->idx));
-        IST_DEBUG("accepted fd=%d -> worker %d", fd, target->idx);
-        if (target == &w) {
-            Conn& ref = *c;
-            {
-                ScopedLock clk(target->conns_mu);
-                target->conns[fd] = std::move(c);
-            }
-            target->engine->conn_added(ref);
-        } else {
-            c->handoff_t0 = now_us();
-            {
-                ScopedLock lk(target->pending_mu);
-                target->pending.push_back(std::move(c));
-            }
-            uint64_t one = 1;
-            ssize_t r = write(target->wake_fd, &one, sizeof(one));
-            (void)r;
+    }
+    // Per-worker connection cap: over-cap connects are SHED — closed
+    // immediately with a WARN-severity conn.shed event and a counter —
+    // instead of accepted into a worker that can no longer serve them
+    // or left to time out invisibly in the listen backlog. conn.shed
+    // (the failpoint) forces the same decision at any occupancy so the
+    // chaos suite can exercise the shed path without 10k real fds.
+    uint32_t occ = target->nconns.load(std::memory_order_relaxed);
+    bool shed = conn_cap_ != 0 && occ >= conn_cap_;
+    if (IST_FAILPOINT("conn.shed")) shed = true;
+    if (shed) {
+        uint64_t nshed =
+            conns_shed_.fetch_add(1, std::memory_order_relaxed) + 1;
+        events_emit(EV_CONN_SHED, uint64_t(target->idx), occ);
+        // Loud but bounded: an accept storm sheds thousands — log the
+        // first and every 64th (the event + counter carry the rest).
+        if (nshed == 1 || nshed % 64 == 0) {
+            IST_WARN(
+                "shedding connection: worker %d at %u conns (cap %llu, "
+                "%llu shed total)",
+                target->idx, occ, (unsigned long long)conn_cap_,
+                (unsigned long long)nshed);
         }
+        close(fd);
+        return;
+    }
+    auto c = std::make_unique<Conn>();
+    c->fd = fd;
+    c->id = next_conn_id_.fetch_add(1, std::memory_order_relaxed);
+    c->w = target;
+    target->nconns.fetch_add(1, std::memory_order_relaxed);
+    n_conns_++;
+    events_emit(EV_CONN_ACCEPT, c->id, uint64_t(target->idx));
+    IST_DEBUG("accepted fd=%d -> worker %d", fd, target->idx);
+    if (target == &w) {
+        Conn& ref = *c;
+        {
+            ScopedLock clk(target->conns_mu);
+            target->conns[fd] = std::move(c);
+        }
+        target->engine->conn_added(ref);
+    } else {
+        c->handoff_t0 = now_us();
+        {
+            ScopedLock lk(target->pending_mu);
+            target->pending.push_back(std::move(c));
+        }
+        uint64_t one = 1;
+        ssize_t r = write(target->wake_fd, &one, sizeof(one));
+        (void)r;
     }
 }
 
@@ -1551,6 +1633,8 @@ void Server::close_conn(Worker& w, int fd) {
     it->second->block_leases.clear();
     outq_total_.fetch_sub(it->second->outq_bytes, std::memory_order_relaxed);
     lease_total_.fetch_sub(it->second->lease_bytes, std::memory_order_relaxed);
+    conn_buf_bytes_.fetch_sub(it->second->buf_accounted,
+                              std::memory_order_relaxed);
     // Engine teardown before the fd closes: epoll unregisters; uring
     // cancels in-flight submissions and keeps any zero-copy pins alive
     // until their kernel notifications drain.
@@ -1567,6 +1651,43 @@ void Server::close_conn(Worker& w, int fd) {
     w.nconns.fetch_sub(1, std::memory_order_relaxed);
     n_conns_--;
     IST_DEBUG("closed fd=%d", fd);
+}
+
+// ---------------------------------------------------------------------------
+// Connection memory diet (ISSUE 18). Staging buffers (body + sink) are
+// born empty, grow in size classes on demand (size_class_reserve), and
+// are trimmed back at message completion when a bulk op left them
+// oversized — so the steady-state heap cost of a connection tracks its
+// CURRENT message, not the largest one it ever handled, and an idle
+// connection's staging cost is zero. The aggregate gauge feeds
+// bytes_per_conn in /stats and /debug/state.
+// ---------------------------------------------------------------------------
+
+// Capacity a connection may retain across messages without being
+// trimmed: covers the sink's 64 KB working size and every small
+// control-op body, so only genuinely bulk ops pay a re-allocation on
+// their next use.
+static constexpr size_t kConnBufRetain = size_t(64) << 10;
+
+void Server::account_conn_bufs(Conn& c) {
+    size_t now = c.body.capacity() + c.sink.capacity();
+    if (now == c.buf_accounted) return;
+    // Unsigned wraparound makes one fetch_add both directions.
+    conn_buf_bytes_.fetch_add(uint64_t(now) - uint64_t(c.buf_accounted),
+                              std::memory_order_relaxed);
+    c.buf_accounted = now;
+}
+
+void Server::diet_conn_bufs(Conn& c) {
+    if (c.body.capacity() > kConnBufRetain) {
+        c.body.clear();
+        c.body.shrink_to_fit();
+    }
+    if (c.sink.capacity() > kConnBufRetain) {
+        c.sink.clear();
+        c.sink.shrink_to_fit();
+    }
+    account_conn_bufs(c);
 }
 
 // ---------------------------------------------------------------------------
@@ -1611,7 +1732,10 @@ int Server::payload_iov(Conn& c, struct iovec* iov, int max) {
     }
     // Sink path (DRAIN, or PAYLOAD past the plan): bounded buffer,
     // sized before any pointer capture and never resized mid-scatter.
-    if (c.sink.size() < (1u << 16)) c.sink.resize(1u << 16);
+    if (c.sink.size() < (1u << 16)) {
+        c.sink.resize(1u << 16);
+        account_conn_bufs(c);
+    }
     iov[0].iov_base = c.sink.data();
     iov[0].iov_len = c.sink.size() > c.payload_left
                          ? size_t(c.payload_left)
@@ -1651,7 +1775,9 @@ bool Server::ingest_bytes(Conn& c, const uint8_t* p, size_t n,
                 IST_WARN("bad header from fd=%d, closing", c.fd);
                 return false;
             }
+            size_class_reserve(c.body, c.hdr.body_len);
             c.body.resize(c.hdr.body_len);
+            account_conn_bufs(c);
             c.body_got = 0;
             c.state = RState::BODY;
             if (c.hdr.body_len == 0) {
@@ -1704,6 +1830,7 @@ bool Server::ingest_bytes(Conn& c, const uint8_t* p, size_t n,
                 } else {
                     c.state = RState::HDR;
                     c.hdr_got = 0;
+                    diet_conn_bufs(c);
                 }
             } else {
                 return true;  // engine reads the rest directly
@@ -1807,7 +1934,11 @@ void Server::handle_message(Conn& c) {
         if (ok) {
             // Size the per-connection sink FIRST: pointers captured below
             // must stay stable for the whole payload scatter.
-            if (c.sink.size() < block_size) c.sink.resize(block_size);
+            if (c.sink.size() < block_size) {
+                size_class_reserve(c.sink, block_size);
+                c.sink.resize(block_size);
+                account_conn_bufs(c);
+            }
             for (uint32_t i = 0; i < n; ++i) {
                 uint64_t tok = r.u64();
                 c.wtokens.push_back(tok);
@@ -1883,6 +2014,7 @@ void Server::handle_message(Conn& c) {
     finish_op_stats(c, op);
     c.state = RState::HDR;
     c.hdr_got = 0;
+    diet_conn_bufs(c);
 }
 
 void Server::account_op(uint8_t op, long long us) {
@@ -1925,7 +2057,11 @@ void Server::begin_put(Conn& c) {
         respond(c, c.hdr.seq, OP_PUT, std::move(body));
         return;
     }
-    if (c.sink.size() < block_size) c.sink.resize(block_size);
+    if (c.sink.size() < block_size) {
+        size_class_reserve(c.sink, block_size);
+        c.sink.resize(block_size);
+        account_conn_bufs(c);
+    }
     c.wput_oom = false;
     index_->reserve(keys.size());
     for (auto& k : keys) {
@@ -2008,6 +2144,7 @@ void Server::finish_write(Conn& c) {
     finish_op_stats(c, c.hdr.op);
     c.state = RState::HDR;
     c.hdr_got = 0;
+    diet_conn_bufs(c);
 }
 
 void Server::op_hello(Conn& c) {
@@ -2526,6 +2663,7 @@ void Server::finish_fabric_write(Conn& c) {
     finish_op_stats(c, c.hdr.op);
     c.state = RState::HDR;
     c.hdr_got = 0;
+    diet_conn_bufs(c);
 }
 
 void Server::free_fabric_pending(Conn& c) {
@@ -3041,10 +3179,24 @@ std::string Server::debug_state_json() {
              engine_name_.c_str(), workers_.size(),
              start_us_ > 0 ? now_us() - start_us_ : 0);
     out += buf;
+    // Per-conn rows are capped at ISTPU_DEBUG_CONN_CAP (ISSUE 18): at
+    // 10k connections an uncapped snapshot is megabytes of JSON and
+    // O(conns) string work on the control plane — past the cap the
+    // remainder is SUMMARIZED (count + aggregate cursors), keeping the
+    // observability cost O(cap) while losing no aggregate signal.
     bool first = true;
+    uint64_t listed = 0, omitted = 0;
+    uint64_t om_outq = 0, om_lease = 0, om_payload = 0;
     for (const auto& w : workers_) {
         ScopedLock clk(w->conns_mu);
         for (const auto& [fd, c] : w->conns) {
+            if (listed >= debug_conn_cap_) {
+                omitted++;
+                om_outq += uint64_t(c->outq_bytes);
+                om_lease += uint64_t(c->lease_bytes);
+                om_payload += uint64_t(c->payload_left);
+                continue;
+            }
             const char* phase = "hdr";
             switch (RState(c->state)) {
                 case RState::HDR: phase = "hdr"; break;
@@ -3065,9 +3217,27 @@ std::string Server::debug_state_json() {
                      (unsigned long long)uint64_t(c->lease_bytes));
             out += buf;
             first = false;
+            listed++;
         }
     }
-    out += "], \"worker_state\": [";
+    uint64_t cbb = conn_buf_bytes_.load(std::memory_order_relaxed);
+    uint64_t nc = n_conns_.load(std::memory_order_relaxed);
+    snprintf(buf, sizeof(buf),
+             "], \"connections_listed\": %llu, "
+             "\"connections_omitted\": %llu, "
+             "\"omitted\": {\"outq_bytes\": %llu, \"lease_bytes\": %llu, "
+             "\"payload_left\": %llu}, "
+             "\"conn_cap\": %llu, \"debug_conn_cap\": %llu, "
+             "\"conn_buf_bytes\": %llu, \"bytes_per_conn\": %llu, "
+             "\"worker_state\": [",
+             (unsigned long long)listed, (unsigned long long)omitted,
+             (unsigned long long)om_outq, (unsigned long long)om_lease,
+             (unsigned long long)om_payload,
+             (unsigned long long)conn_cap_,
+             (unsigned long long)debug_conn_cap_,
+             (unsigned long long)cbb,
+             (unsigned long long)(cbb / (nc > 0 ? nc : 1)));
+    out += buf;
     for (size_t i = 0; i < workers_.size(); ++i) {
         Worker& w = *workers_[i];
         size_t pending = 0;

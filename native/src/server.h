@@ -315,7 +315,29 @@ struct Conn {
     std::vector<std::string> fab_keys;
     std::vector<PoolLoc> fab_locs;
     uint32_t fab_bsize = 0;
+    // Connection memory diet (ISSUE 18): heap bytes currently charged
+    // to the global conn_buf_bytes_ gauge for this connection's
+    // staging buffers (body + sink). Owner-thread-only; close_conn
+    // returns the charge. The buffers themselves are LAZY — empty at
+    // accept, size-classed on first growth, trimmed back down at
+    // message completion when a bulk op left them oversized — so an
+    // idle connection's heap cost is the Conn struct plus engine
+    // state, not a payload-sized staging area.
+    size_t buf_accounted = 0;
 };
+
+// Size-class growth for per-connection staging buffers: capacity
+// advances in power-of-two classes from 4 KB so 10k connections
+// churning through mixed body sizes converge onto a handful of
+// allocator size classes instead of 10k bespoke capacities (heap
+// fragmentation is the hidden per-conn cost at scale). Never shrinks;
+// diet_conn_bufs handles release.
+inline void size_class_reserve(std::vector<uint8_t>& v, size_t need) {
+    if (v.capacity() >= need) return;
+    size_t cls = size_t(4) << 10;
+    while (cls < need) cls <<= 1;
+    v.reserve(cls);
+}
 
 // One worker loop + thread. Connections are owned by exactly one
 // worker. With SO_REUSEPORT (the default for workers > 1) every
@@ -514,10 +536,29 @@ class Server {
     // (adopt locally), or — fallback mode, worker 0 only — the shared
     // listen_fd_ with least-loaded handoff.
     void accept_ready(Worker& w, int ready_fd);
+    // Adopt one just-accepted socket on `w`'s accept path: failpoint
+    // gates (conn.accept / conn.shed), the per-worker connection-cap
+    // shed decision (close + conn.shed event — loud, never a silent
+    // backlog overflow), then Conn construction and local-adopt or
+    // least-loaded handoff. Shared by accept_ready (epoll readiness /
+    // uring poll fallback) and the uring engine's multishot-accept
+    // completions.
+    void adopt_accepted(Worker& w, int fd);
     void close_conn(Worker& w, int fd);
     void handle_message(Conn& c);  // full header+body (non-WRITE) received
     void finish_write(Conn& c);    // WRITE/PUT payload fully scattered
     void begin_put(Conn& c);       // parse OP_PUT body, build scatter plan
+
+    // --- connection memory diet (ISSUE 18) ---------------------------
+    // Reconcile this connection's staging-buffer capacity (body +
+    // sink) against the global conn_buf_bytes_ gauge. Owner-thread-
+    // only; the gauge itself is an atomic so stats_json can read it.
+    void account_conn_bufs(Conn& c);
+    // Message-completion trim: release oversized staging capacity
+    // (anything above one size class) so a single bulk op does not pin
+    // a payload-sized buffer for the connection's remaining life, then
+    // re-account. Called from the HDR-reset points.
+    void diet_conn_bufs(Conn& c);
 
     // --- one-sided fabric plane (docs/design.md "One-sided fabric
     // engine") -----------------------------------------------------
@@ -615,6 +656,23 @@ class Server {
     uint16_t bound_port_ = 0;
     int listen_fd_ = -1;
     bool reuseport_ = false;  // per-worker SO_REUSEPORT acceptors active
+    // Connection-scale knobs, resolved once at start() BEFORE the
+    // engines are constructed (EngineFabric reads the ring-pool size
+    // at init): listen backlog (ISTPU_LISTEN_BACKLOG, default
+    // SOMAXCONN — the hardcoded 128 capped accept storms well below
+    // what the kernel allows), per-WORKER connection cap
+    // (ISTPU_CONN_CAP, 0 = uncapped; over-cap connects are shed
+    // loudly with a conn.shed event instead of left to time out in
+    // the backlog), the per-conn observability cap
+    // (ISTPU_DEBUG_CONN_CAP: /debug/state and /stats per-conn
+    // sections list at most this many connections and summarize the
+    // rest, so the control plane stays O(cap) at 10k conns), and the
+    // fabric ring-pool size (ISTPU_FABRIC_RING_POOL, split evenly
+    // across workers by EngineFabric).
+    uint32_t listen_backlog_ = 0;
+    uint64_t conn_cap_ = 0;
+    uint64_t debug_conn_cap_ = 256;
+    uint64_t fabric_ring_pool_ = 64;
     std::string engine_name_ = "epoll";  // resolved at start()
     std::atomic<bool> running_{false};
     std::vector<std::unique_ptr<Worker>> workers_;
@@ -667,6 +725,17 @@ class Server {
     }
 
     std::atomic<uint64_t> n_conns_{0};  // stats-safe connection count
+    // Accept-path counters (ISSUE 18): total sockets accepted over the
+    // server's life (accepts/sec is the bench's accept-cost metric)
+    // and connects shed at the per-worker cap (each also emits
+    // conn.shed).
+    std::atomic<uint64_t> accepts_total_{0};
+    std::atomic<uint64_t> conns_shed_{0};
+    // Aggregate heap bytes held by per-connection staging buffers
+    // (body + sink capacities, maintained by account_conn_bufs);
+    // stats_json divides by n_conns_ for the pinned bytes_per_conn
+    // gauge the memory diet is scored on.
+    std::atomic<uint64_t> conn_buf_bytes_{0};
 
     // stats
     static constexpr int kMaxOp = 32;
@@ -709,6 +778,13 @@ class Server {
     std::atomic<uint64_t> fabric_one_sided_puts_{0};
     std::atomic<uint64_t> fabric_doorbells_{0};
     std::atomic<uint64_t> fabric_writes_{0};
+    // Pooled-ring lifecycle counters (ISSUE 18): idle rings reclaimed
+    // via the detach handshake (each also emits fabric.ring_detach)
+    // and attach requests denied because the worker's pool quota was
+    // exhausted with no idle victim (the denied client stays on TCP;
+    // pool hit rate = attaches / (attaches + denied)).
+    std::atomic<uint64_t> fabric_ring_detaches_{0};
+    std::atomic<uint64_t> fabric_ring_attach_denied_{0};
     // Hash-first put verdicts that answered HAVE on the WIRE (TCP
     // OP_PUT_HASH or the fabric hash record) — payload bytes that
     // never crossed the transport, as opposed to the index's

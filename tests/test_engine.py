@@ -502,6 +502,78 @@ def test_fabric_doorbell_failpoint_delays_but_delivers(fabric_reason):
         srv.stop()
 
 
+def test_fabric_ring_pool_lru_reclaim(fabric_reason, monkeypatch):
+    """Ring-pool LRU reclaim (ISSUE 18): with the pool capped at 2
+    rings, a third attaching connection reclaims the LONGEST-IDLE ring
+    (conn A's). A keeps working byte-correctly over the TCP fallback,
+    the detach is visible server-side (fabric_ring_detaches counter +
+    fabric.ring_detach event) and client-side (ring_detaches), and A
+    re-attaches to a fresh ring and resumes one-sided posting."""
+    if fabric_reason:
+        pytest.skip(fabric_reason)
+    monkeypatch.setenv("ISTPU_FABRIC_RING_POOL", "2")
+    # Three concurrent lease holders: size the pool so every grant fits.
+    srv = _mk("fabric", workers=1, prealloc_size=0.5)
+    port = srv.start()
+    page = 4096
+    a = _fabric_conn(port)
+    b = _fabric_conn(port)
+    c = _fabric_conn(port)
+    try:
+        a.connect()
+        src_a = np.arange(page, dtype=np.float32)
+        a.put_cache(src_a, [("pool_a0", 0)], page)
+        a.sync()
+        assert a.client_stats()["fabric"]["ring_active"]
+        b.connect()
+        b.put_cache(src_a * 2, [("pool_b0", 0)], page)
+        b.sync()
+        # Pool is full (2 rings, 1 worker). C's bootstrap attach must
+        # reclaim the longest-idle ring — A's — before its own grant.
+        c.connect()
+        st = srv.stats()
+        assert st["fabric_ring_detaches"] == 1
+        assert c.client_stats()["fabric"]["ring_active"]
+        names = [e["name"] for e in srv.events()["events"]]
+        assert "fabric.ring_detach" in names
+        # A's next put discovers the detach mid-post and falls back to
+        # TCP — the commit must still land byte-correctly.
+        src_a1 = np.arange(page, dtype=np.float32) + 7
+        a.put_cache(src_a1, [("pool_a1", 0)], page)
+        a.sync()
+        cs = a.client_stats()["fabric"]
+        assert cs["ring_detaches"] == 1
+        # A asks for a fresh ring on subsequent commits; the grant
+        # reclaims another idle ring (B's or C's — both newer than
+        # nothing, A has none). Bounded retry loop: the attach RPC is
+        # async, one commit behind.
+        reattached = False
+        for i in range(20):
+            a.put_cache(src_a1 * (i + 2), [(f"pool_a{i + 2}", 0)], page)
+            a.sync()
+            if a.client_stats()["fabric"]["ring_active"]:
+                reattached = True
+                break
+        assert reattached
+        assert a.client_stats()["fabric"]["ring_reattaches"] == 1
+        posts_before = a.client_stats()["fabric"]["ring_posts"]
+        a.put_cache(src_a1 * 99, [("pool_final", 0)], page)
+        a.sync()
+        assert a.client_stats()["fabric"]["ring_posts"] > posts_before
+        # Everything A ever wrote — ring, TCP fallback, fresh ring —
+        # reads back intact.
+        for key, src in (("pool_a0", src_a), ("pool_a1", src_a1),
+                         ("pool_final", src_a1 * 99)):
+            dst = np.zeros_like(src)
+            a.read_cache(dst, [(key, 0)], page)
+            assert np.array_equal(src, dst), key
+    finally:
+        a.close()
+        b.close()
+        c.close()
+        srv.stop()
+
+
 @pytest.mark.slow
 def test_parity_suites_under_fabric(fabric_reason):
     """The ISSUE-12 parity gate: the protocol fuzz, lease and trace

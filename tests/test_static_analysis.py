@@ -316,6 +316,53 @@ def test_fabric_failpoint_catalog_pin_bites(tree):
     assert "fabric.doorbell" in r.stderr  # stale catalog row
 
 
+def test_conn_shed_event_catalog_pin_bites(tree):
+    # ISSUE 18 seeded mutation: renaming the shed path's emit id
+    # (server.cc) without touching the events.h catalog must fail BOTH
+    # drift directions — the new id is emitted but uncataloged, the old
+    # catalog row is stale — so the accept path's shed policy can never
+    # silently detach from its catalog row (and hence the docs table
+    # and the golden's pinned `events` section) after a refactor.
+    mutate(tree, "native/src/server.cc",
+           "events_emit(EV_CONN_SHED,",
+           "events_emit(EV_CONN_SHEDDED,")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "EV_CONN_SHEDDED" in r.stderr  # emitted, uncataloged
+    assert "EV_CONN_SHED" in r.stderr     # stale catalog row
+    assert "stale catalog row" in r.stderr
+
+
+def test_conn_shed_failpoint_catalog_pin_bites(tree):
+    # ISSUE 18 seeded mutation: renaming the shed failpoint at its call
+    # site (server.cc) without touching the failpoint catalog must fail
+    # both directions, exactly like the fabric.doorbell pin above —
+    # this is what keeps the CI chaos step's `conn.shed=...` specs from
+    # silently arming nothing.
+    mutate(tree, "native/src/server.cc",
+           'IST_FAILPOINT("conn.shed")',
+           'IST_FAILPOINT("conn.drop")')
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "conn.drop" in r.stderr  # compiled-in but uncataloged
+    assert "conn.shed" in r.stderr  # stale catalog row
+
+
+def test_ring_detach_event_catalog_pin_bites(tree):
+    # ISSUE 18 seeded mutation: the ring-pool LRU reclaim's detach
+    # event (engine_fabric.cc) is the only externally visible record
+    # that a writer's commit ring was taken away — renaming its emit id
+    # without the catalog must fail both drift directions so the
+    # detach protocol can never go dark.
+    mutate(tree, "native/src/engine_fabric.cc",
+           "events_emit(EV_FABRIC_RING_DETACH,",
+           "events_emit(EV_FABRIC_RING_DROP,")
+    r = run_linter(str(tree))
+    assert r.returncode != 0
+    assert "EV_FABRIC_RING_DROP" in r.stderr    # emitted, uncataloged
+    assert "EV_FABRIC_RING_DETACH" in r.stderr  # stale catalog row
+
+
 def test_dropped_directory_endpoint_fails_golden(tree):
     # ISSUE 14 seeded mutation: silently deleting the /directory
     # endpoint must fail the golden's `endpoints` pin — every cluster
